@@ -1,0 +1,114 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of PaddlePaddle (reference snapshot
+at /root/reference), designed for TPU: jax/XLA is the compute path, the eager
+API is a tape over jax.vjp, `to_static` is whole-graph jax.jit capture, and
+distribution is jax.sharding over device meshes (SPMD) rather than
+NCCL-style message passing. See SURVEY.md for the capability map.
+"""
+__version__ = "0.1.0"
+
+from . import core
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001  (paddle.bool)
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.rng import get_rng_state_tracker, seed  # noqa: F401
+from .tensor_core import Parameter, Tensor  # noqa: F401
+
+from . import ops  # installs Tensor methods; must precede api re-export
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+from .autograd import enable_grad, no_grad  # noqa: F401
+from .autograd.engine import grad, is_grad_enabled  # noqa: F401
+from .autograd import backward as _autograd_backward  # noqa: F401
+
+from . import autograd  # noqa: F401
+
+# Subpackages below are imported lazily-but-eagerly as they land; each import
+# line is appended when the subsystem is built (nn, optimizer, io, amp, jit,
+# static, distributed, vision, hapi, profiler, ...).
+import importlib as _importlib
+
+for _sub in (
+    "nn",
+    "optimizer",
+    "metric",
+    "io",
+    "amp",
+    "framework",
+    "jit",
+    "static",
+    "distributed",
+    "vision",
+    "text",
+    "device",
+    "profiler",
+    "incubate",
+    "hapi",
+    "linalg",
+):
+    try:
+        globals()[_sub] = _importlib.import_module("." + _sub, __name__)
+    except ImportError:
+        pass
+
+if "framework" in globals() and hasattr(globals()["framework"], "io_state"):
+    from .framework.io_state import load, save  # noqa: F401
+if "hapi" in globals() and hasattr(globals()["hapi"], "model"):
+    from .hapi.model import Model  # noqa: F401
+
+# paddle.disable_static / enable_static are no-ops: eager IS the default and
+# static capture happens through paddle_tpu.jit.to_static (jax.jit).
+_static_mode = False
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def is_grad_enabled_():
+    from .autograd.engine import is_grad_enabled as f
+
+    return f()
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes)

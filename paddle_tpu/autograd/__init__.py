@@ -1,0 +1,49 @@
+from .engine import grad, is_grad_enabled  # noqa: F401
+from .engine import no_grad_guard as _no_grad_guard
+from .engine import enable_grad_guard as _enable_grad_guard
+
+
+class no_grad:
+    """Context manager + decorator (paddle.no_grad,
+    reference: python/paddle/fluid/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self._ctx = _no_grad_guard()
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._ctx = _enable_grad_guard()
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    from .engine import run_backward
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), list(grad_tensors), retain_graph=retain_graph)
+
+
+from .py_layer import PyLayer, PyLayerContext  # noqa: E402,F401

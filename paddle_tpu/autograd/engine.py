@@ -1,0 +1,335 @@
+"""Tape-based eager autograd over jax.vjp.
+
+TPU-native replacement for the reference's eager autograd engine
+(reference: paddle/fluid/eager/grad_node_info.h:168 `GradNodeBase`,
+paddle/fluid/eager/backward.cc:105 `RunBackward`, :394 `Backward`).
+
+Design: every differentiable op funnels through `apply(name, jfn, tensors)`.
+When grad is required we call `jax.vjp(jfn, *values)` — forward executes
+eagerly (or traces, under jax.jit) and we keep the vjp closure on a GradNode.
+`run_backward` does the same queue + pending-count traversal as the
+reference's RunBackward. Higher-order grad (create_graph=True) re-linearizes
+the forward (node stores `jfn` and input tensors) so grads of grads flow
+through the original inputs, not just cotangents.
+"""
+import contextlib
+import threading
+from collections import defaultdict, deque
+
+import jax
+import numpy as np
+
+__all__ = [
+    "apply",
+    "no_grad_guard",
+    "enable_grad_guard",
+    "is_grad_enabled",
+    "run_backward",
+    "grad",
+    "GradNode",
+    "register_tensor_class",
+    "wrap",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_state = _State()
+
+
+def is_grad_enabled():
+    return _state.grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    old = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = old
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    old = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = old
+
+
+_tensor_cls = None
+
+
+def register_tensor_class(cls):
+    global _tensor_cls
+    _tensor_cls = cls
+
+
+def wrap(value, stop_gradient=True):
+    return _tensor_cls(value, stop_gradient=stop_gradient)
+
+
+class GradNode:
+    """One recorded op on the tape (≈ egr::GradNodeBase)."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "jfn",
+        "inputs",
+        "n_outputs",
+        "out_meta",
+    )
+
+    def __init__(self, name, vjp_fn, jfn, inputs, out_meta):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.jfn = jfn  # kept for create_graph re-linearization
+        self.inputs = inputs  # tuple[Tensor]
+        self.n_outputs = len(out_meta)
+        self.out_meta = out_meta  # [(shape, dtype)]
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def apply(name, jfn, tensors, n_outputs=None):
+    """Run `jfn(*[t.value])`, recording a GradNode if grad is needed.
+
+    `tensors` must all be Tensor instances; non-tensor attrs belong inside the
+    jfn closure. Multi-output jfns must return a tuple. Integer/bool outputs
+    are treated as non-differentiable (stop_gradient=True on the result;
+    float0 cotangents fed to vjp).
+    """
+    vals = tuple(t._value for t in tensors)
+    need = _state.grad_enabled and any(not t.stop_gradient for t in tensors)
+    if not need:
+        out = jfn(*vals)
+        if isinstance(out, (tuple, list)):
+            return tuple(wrap(o, True) for o in out)
+        return wrap(out, True)
+
+    outs, vjp_fn = jax.vjp(jfn, *vals)
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+    out_meta = [(o.shape, o.dtype) for o in outs_t]
+    node = GradNode(name, vjp_fn, jfn, tuple(tensors), out_meta)
+    result = []
+    for i, o in enumerate(outs_t):
+        nondiff = not np.issubdtype(np.dtype(o.dtype), np.inexact)
+        t = wrap(o, stop_gradient=nondiff)
+        if not nondiff:
+            t._grad_node = node
+            t._out_index = i
+        result.append(t)
+    return tuple(result) if multi else result[0]
+
+
+def _ones_like_meta(shape, dtype):
+    import jax.numpy as jnp
+
+    return jnp.ones(shape, dtype)
+
+
+def _discover(root_nodes, seeds_per_node):
+    """BFS the node graph; return expected contribution count per node."""
+    expected = defaultdict(int)
+    for n, c in seeds_per_node.items():
+        expected[n] += c
+    seen = set(root_nodes)
+    stack = list(root_nodes)
+    while stack:
+        n = stack.pop()
+        for it in n.inputs:
+            cn = it._grad_node
+            if cn is not None:
+                expected[cn] += 1
+                if cn not in seen:
+                    seen.add(cn)
+                    stack.append(cn)
+    return expected
+
+
+def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
+                 grad_sinks=None, accumulate_leaf=True):
+    """Reverse traversal (≈ backward.cc:105 RunBackward).
+
+    roots: list[Tensor]; root_grads: list[Tensor|None].
+    grad_sinks: optional dict  id(tensor) -> [tensor, accumulated-grad] used by
+    `grad()` to collect gradients for arbitrary (possibly non-leaf) tensors.
+    """
+    import jax.numpy as jnp
+
+    seeds = defaultdict(int)
+    root_nodes = []
+    for t in roots:
+        n = t._grad_node
+        if n is not None:
+            seeds[n] += 1
+            if n not in root_nodes:
+                root_nodes.append(n)
+    expected = _discover(root_nodes, seeds)
+
+    contrib = defaultdict(int)
+    outgrads = {}
+    ready = deque()
+
+    def _sink(tensor, g):
+        if grad_sinks is not None and id(tensor) in grad_sinks:
+            slot = grad_sinks[id(tensor)]
+            slot[1] = g if slot[1] is None else _add_grads(slot[1], g)
+
+    def _add_grads(a, b):
+        if create_graph:
+            from ..ops.math import add as t_add
+
+            return t_add(a, b)
+        return wrap(a._value + b._value, a.stop_gradient and b.stop_gradient)
+
+    def _accum_leaf(tensor, g):
+        _sink(tensor, g)
+        if accumulate_leaf and not tensor.stop_gradient:
+            if tensor.grad is None:
+                tensor.grad = g
+            else:
+                tensor.grad = _add_grads(tensor.grad, g)
+
+    def _add_outgrad(node, idx, g):
+        slots = outgrads.setdefault(node, [None] * node.n_outputs)
+        slots[idx] = g if slots[idx] is None else _add_grads(slots[idx], g)
+        contrib[node] += 1
+        if contrib[node] == expected[node]:
+            ready.append(node)
+
+    # Seed root grads.
+    for t, g in zip(roots, root_grads):
+        if g is None:
+            if not np.issubdtype(np.dtype(t._value.dtype), np.inexact):
+                raise ValueError("backward() root must be floating point")
+            g = wrap(_ones_like_meta(t._value.shape, t._value.dtype), True)
+        n = t._grad_node
+        if n is None:
+            _accum_leaf(t, g)
+        else:
+            _sink(t, g)
+            _add_outgrad(n, t._out_index, g)
+
+    # Drain queue.
+    while ready:
+        node = ready.popleft()
+        slots = outgrads.pop(node, [None] * node.n_outputs)
+        if node.vjp_fn is None and not create_graph:
+            raise RuntimeError(
+                f"grad graph for {node.name} already freed; "
+                "pass retain_graph=True to backward() to reuse it"
+            )
+        if create_graph:
+            in_grads = _node_grad_recorded(node, slots)
+        else:
+            cts = []
+            for (shape, dtype), g in zip(node.out_meta, slots):
+                if g is None:
+                    cts.append(jnp.zeros(shape, dtype))
+                else:
+                    cts.append(g._value)
+            arg = tuple(cts) if node.n_outputs > 1 else cts[0]
+            raw = node.vjp_fn(arg)
+            in_grads = [
+                None
+                if g is None
+                or (isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0)
+                else wrap(g, True)
+                for g in raw
+            ]
+        if not retain_graph and not create_graph:
+            node.vjp_fn = None
+        for it, ig in zip(node.inputs, in_grads):
+            if ig is None:
+                continue
+            if getattr(ig, "_value", None) is not None and isinstance(
+                ig._value, np.ndarray
+            ) and ig._value.dtype == jax.dtypes.float0:
+                continue
+            hooks = getattr(it, "_backward_hooks", None)
+            if hooks:
+                for hook in hooks:
+                    out = hook(ig)
+                    if out is not None:
+                        ig = out
+            cn = it._grad_node
+            if cn is None:
+                _accum_leaf(it, ig)
+            else:
+                _sink(it, ig)
+                _add_outgrad(cn, it._out_index, ig)
+
+
+def _node_grad_recorded(node, slots):
+    """create_graph path: recompute forward+vjp through `apply` so the grads
+    themselves land on the tape (second-order autodiff)."""
+    import jax.numpy as jnp
+
+    k = len(node.inputs)
+    if node.jfn is None:
+        raise NotImplementedError(
+            f"create_graph=True through {node.name} is not supported "
+            "(custom PyLayer backward is opaque to re-linearization); "
+            "implement the op functionally or without create_graph"
+        )
+    ct_tensors = []
+    for (shape, dtype), g in zip(node.out_meta, slots):
+        if g is None:
+            g = wrap(jnp.zeros(shape, dtype), True)
+        ct_tensors.append(g)
+    jfn = node.jfn
+    multi = node.n_outputs > 1
+
+    def gradfn(*args):
+        xs, cts = args[:k], args[k:]
+        _, vjp = jax.vjp(jfn, *xs)
+        raw = vjp(tuple(cts) if multi else cts[0])
+        return tuple(raw)
+
+    outs = apply("grad:" + node.name, gradfn, tuple(node.inputs) + tuple(ct_tensors))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return list(outs)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad equivalent (reference: eager/general_grad.h GeneralGrad)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    sinks = {id(t): [t, None] for t in inputs}
+    run_backward(
+        list(outputs),
+        list(grad_outputs),
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        grad_sinks=sinks,
+        accumulate_leaf=False,
+    )
+    results = []
+    for t in inputs:
+        g = sinks[id(t)][1]
+        if g is None and not allow_unused:
+            raise ValueError(
+                "one of the inputs receives no gradient; "
+                "pass allow_unused=True to permit this"
+            )
+        results.append(g)
+    return results

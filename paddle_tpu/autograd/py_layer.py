@@ -1,0 +1,97 @@
+"""Custom autograd op (paddle.autograd.PyLayer).
+
+reference: python/paddle/autograd/py_layer.py + paddle/fluid/eager/pylayer/.
+Implemented directly on the tape: forward runs under no_grad, a GradNode is
+created whose backward calls the user's static backward().
+"""
+import jax.numpy as jnp
+
+from . import engine
+
+
+class PyLayerContext:
+    def __init__(self):
+        self.saved_tensor_list = []
+        self._materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self.saved_tensor_list = list(tensors)
+
+    def saved_tensor(self):
+        return self.saved_tensor_list
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class _PyLayerNode(engine.GradNode):
+    __slots__ = ("ctx", "backward_fn")
+
+    def __init__(self, ctx, backward_fn, inputs, out_meta):
+        super().__init__("PyLayer", None, None, inputs, out_meta)
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor_core import Tensor
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with engine.no_grad_guard():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        outs_t = tuple(outs) if multi else (outs,)
+        if not need:
+            return outs
+        out_meta = [(tuple(o.shape), o.dtype) for o in outs_t]
+        node = _PyLayerNode(ctx, cls.backward, tuple(tensor_inputs), out_meta)
+        result = []
+        for i, o in enumerate(outs_t):
+            t = Tensor(o._value, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            result.append(t)
+        # Custom execution in engine: monkey-free — engine calls vjp_fn; we
+        # instead give the node a vjp_fn shim that calls user backward.
+        def _vjp(cts):
+            from ..tensor_core import Tensor as T
+
+            if node.n_outputs == 1:
+                cts = (cts,)
+            ct_tensors = [T(c, True) for c in cts]
+            with engine.no_grad_guard():
+                gin = cls.backward(ctx, *ct_tensors)
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            vals = []
+            for g in gin:
+                if g is None:
+                    vals.append(None)
+                else:
+                    vals.append(g._value if isinstance(g, T) else jnp.asarray(g))
+            return tuple(vals)
+
+        node.vjp_fn = _vjp
+        return tuple(result) if multi else result[0]

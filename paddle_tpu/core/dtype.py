@@ -1,0 +1,112 @@
+"""Dtype system.
+
+TPU-native replacement for the reference's VarType/phi DataType enum
+(reference: paddle/fluid/framework/framework.proto:117 `VarType`,
+paddle/phi/common/data_type.h). We expose numpy dtype objects directly so that
+`x.dtype == paddle_tpu.float32` works and interop with jax/numpy is free.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype constants (np.dtype instances — hashable, comparable).
+bool_ = np.dtype("bool")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype  # np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+_COMPLEX = {complex64, complex128}
+
+# Process-wide default dtype (paddle.set_default_dtype /
+# python/paddle/framework/framework.py in the reference).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def _narrow_if_no_x64(d):
+    """Without jax x64, 64-bit dtypes silently narrow (TPU-native behavior;
+    avoids per-op UserWarning spam when user code asks for paddle's int64)."""
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return d
+    return {int64: int32, uint64: uint32, float64: float32,
+            complex128: complex64}.get(d, d)
+
+
+def convert_dtype(dtype):
+    """Normalize str/np.dtype/jnp type → canonical np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _narrow_if_no_x64(_NAME_TO_DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    if isinstance(dtype, np.dtype):
+        return _narrow_if_no_x64(dtype)
+    # jnp.float32 style (type objects) and python builtins
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return _default_dtype
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        raise ValueError(f"Cannot convert {dtype!r} to a dtype")
+
+
+def is_floating_point(dtype):
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype):
+    return convert_dtype(dtype) in _COMPLEX

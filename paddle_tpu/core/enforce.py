@@ -1,0 +1,46 @@
+"""Error enforcement helpers.
+
+Equivalent of the reference's PADDLE_ENFORCE macro family
+(reference: paddle/phi/core/enforce.h). Python-level: raise typed errors with
+a clear message; no C++ stack dance needed.
+"""
+
+
+class EnforceNotMet(RuntimeError):
+    pass
+
+
+class InvalidArgumentError(ValueError):
+    pass
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class UnimplementedError(NotImplementedError):
+    pass
+
+
+class OutOfRangeError(IndexError):
+    pass
+
+
+def enforce(cond, msg="", exc=EnforceNotMet):
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg=""):
+    if a != b:
+        raise InvalidArgumentError(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_gt(a, b, msg=""):
+    if not a > b:
+        raise InvalidArgumentError(f"{msg} (expected {a!r} > {b!r})")
+
+
+def enforce_shape_match(s1, s2, msg=""):
+    if tuple(s1) != tuple(s2):
+        raise InvalidArgumentError(f"{msg} (shape {tuple(s1)} vs {tuple(s2)})")

@@ -1,0 +1,75 @@
+"""Runtime flag registry.
+
+TPU-native equivalent of the reference's gflags-based
+`PADDLE_DEFINE_EXPORTED_*` registry (reference:
+paddle/fluid/platform/flags.cc:24 `GetExportedFlagInfoMap`, python
+`paddle.set_flags`). Flags are plain python values, seedable from `FLAGS_*`
+environment variables, settable at runtime via set_flags().
+"""
+import os
+import threading
+
+_lock = threading.Lock()
+_registry = {}
+
+
+class _FlagInfo:
+    __slots__ = ("name", "default", "value", "doc", "type")
+
+    def __init__(self, name, default, doc):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.doc = doc
+        self.type = type(default)
+
+
+def _coerce(ty, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name, default, doc=""):
+    with _lock:
+        if name in _registry:
+            return _registry[name].value
+        info = _FlagInfo(name, default, doc)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            info.value = _coerce(info.type, env)
+        _registry[name] = info
+        return info.value
+
+
+def get_flags(names=None):
+    if names is None:
+        names = list(_registry)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _registry[n].value for n in names if n in _registry}
+
+
+def set_flags(flags):
+    with _lock:
+        for name, value in flags.items():
+            name = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+            if name not in _registry:
+                _registry[name] = _FlagInfo(name, value, "")
+            else:
+                info = _registry[name]
+                info.value = _coerce(info.type, value)
+
+
+def get_flag(name):
+    return _registry[name].value if name in _registry else None
+
+
+# Core flags (subset of reference's 74; grown as subsystems land).
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug)")
+define_flag("allocator_strategy", "xla", "memory handled by XLA/PJRT on TPU")
+define_flag("eager_delete_tensor_gb", 0.0, "no-op: XLA owns buffers")
+define_flag("use_pallas_kernels", True, "use pallas kernels for hot ops on TPU")
+define_flag("log_level", 0, "verbose log level (VLOG equivalent)")

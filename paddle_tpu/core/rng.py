@@ -1,0 +1,103 @@
+"""Stateful RNG over jax.random.
+
+The reference has a global stateful generator (paddle/fluid/framework/generator.cc)
+plus the model-parallel RNGStatesTracker
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py).
+TPU-native design: a named-stream key tracker over jax PRNG keys. Eager ops
+split a fresh subkey per call; traced code should take keys explicitly (the
+framework's jitted train steps thread a per-step seed).
+"""
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Splittable stateful PRNG stream."""
+
+    def __init__(self, seed=0):
+        self._seed = int(seed)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._count = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def next_key(self):
+        """A fresh PRNGKey. Deterministic in (seed, call index)."""
+        with self._lock:
+            c = self._count
+            self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), c)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = int(state[0]), int(state[1])
+
+
+class RNGStatesTracker:
+    """Named RNG streams — used for TP dropout determinism.
+
+    Mirrors the semantics of the reference's RNGStatesTracker
+    (fleet/layers/mpu/random.py): 'global' stream shared across
+    model-parallel ranks, 'local' streams offset per rank.
+    """
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name, seed):
+        if name in self.states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self.states[name] = Generator(seed)
+
+    def get(self, name):
+        return self.states[name]
+
+    def reset(self):
+        self.states = {}
+
+    def rng_state(self, name="global"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            global _default_generator
+            old = _default_generator
+            _default_generator = self.states[name]
+            try:
+                yield
+            finally:
+                _default_generator = old
+
+        return _ctx()
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+_tracker = RNGStatesTracker()
+
+
+def default_generator():
+    return _default_generator
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def seed(s):
+    """paddle.seed equivalent."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()

@@ -1,0 +1,182 @@
+"""Functional op namespace + Tensor method installation.
+
+Mirrors how the reference monkey-patches generated ops onto the Tensor type
+(reference: python/paddle/fluid/dygraph/math_op_patch.py,
+paddle/fluid/pybind/eager_method.cc). All ops funnel through the autograd
+tape in ..autograd.engine.
+"""
+from . import activation, creation, linalg, manipulation, math  # noqa: F401
+from ._helpers import get_op, list_ops, register_op  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import (  # noqa: F401
+    broadcast_tensors,
+    broadcast_to,
+    bucketize,
+    cast,
+    chunk,
+    concat,
+    expand,
+    expand_as,
+    flatten,
+    flip,
+    gather,
+    gather_nd,
+    index_sample,
+    index_select,
+    masked_fill,
+    masked_select,
+    mode,
+    moveaxis,
+    nonzero,
+    pad,
+    put_along_axis,
+    repeat_interleave,
+    reshape,
+    reshape_,
+    roll,
+    rot90,
+    scatter,
+    scatter_nd,
+    scatter_nd_add,
+    searchsorted,
+    slice,
+    sort,
+    split,
+    squeeze,
+    stack,
+    strided_slice,
+    swapaxes,
+    take_along_axis,
+    tensordot,
+    tile,
+    topk,
+    transpose,
+    unbind,
+    unique,
+    unique_consecutive,
+    unsqueeze,
+    where,
+    argsort,
+    kthvalue,
+)
+from .math import *  # noqa: F401,F403
+
+
+def _install_tensor_methods():
+    from ..tensor_core import Tensor
+
+    from . import activation as _act
+    from . import creation as _cre
+    from . import linalg as _lin
+    from . import manipulation as _man
+    from . import math as _math
+
+    method_sources = {}
+    for m in (_math, _man, _lin, _act):
+        for name in dir(m):
+            fn = getattr(m, name)
+            if callable(fn) and not name.startswith("_"):
+                method_sources.setdefault(name, fn)
+
+    skip = {"to_tensor", "meshgrid", "broadcast_tensors", "einsum", "multi_dot"}
+    for name, fn in method_sources.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # extras under different method names
+    Tensor.mean = _math.mean
+    Tensor.sum = _math.sum
+    Tensor.max = _math.max
+    Tensor.min = _math.min
+    Tensor.prod = _math.prod
+    Tensor.abs = _math.abs
+    Tensor.matmul = _lin.matmul
+    Tensor.mm = _lin.mm
+    Tensor.dot = _lin.dot
+    Tensor.norm = _lin.norm
+    Tensor.zero_like = _cre.zeros_like
+
+    # in-place-suffixed aliases used by user code (functional under the hood).
+    # The tape must reference a snapshot of the pre-mutation tensor, never
+    # `self` (a node whose input is its own output tensor deadlocks backward).
+    def _inplace(opname):
+        fn = method_sources[opname]
+
+        def method(self, *args, **kwargs):
+            old = _snapshot_for_inplace(self, opname)
+            out = fn(old, *args, **kwargs)
+            self._value = out._value
+            self._grad_node = out._grad_node
+            self._out_index = out._out_index
+            self.stop_gradient = out.stop_gradient
+            return self
+
+        return method
+
+    for nm in ("add", "subtract", "multiply", "scale", "clip", "floor",
+               "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round",
+               "tanh", "squeeze", "unsqueeze", "flatten"):
+        setattr(Tensor, nm + "_", _inplace(nm))
+
+    # operator overloads
+    Tensor.__add__ = lambda s, o: _math.add(s, o)
+    Tensor.__radd__ = lambda s, o: _math.add(s, o)
+    Tensor.__sub__ = lambda s, o: _math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: _math.subtract(_to(o, s), s)
+    Tensor.__mul__ = lambda s, o: _math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: _math.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: _math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: _math.divide(_to(o, s), s)
+    Tensor.__floordiv__ = lambda s, o: _math.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: _math.mod(s, o)
+    Tensor.__pow__ = lambda s, o: _math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: _math.pow(_to(o, s), s)
+    Tensor.__neg__ = lambda s: _math.neg(s)
+    Tensor.__abs__ = lambda s: _math.abs(s)
+    Tensor.__matmul__ = lambda s, o: _lin.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: _lin.matmul(_to(o, s), s)
+    Tensor.__eq__ = lambda s, o: _math.equal(s, o)
+    Tensor.__ne__ = lambda s, o: _math.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: _math.less_than(s, o)
+    Tensor.__le__ = lambda s, o: _math.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: _math.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: _math.greater_equal(s, o)
+    Tensor.__invert__ = lambda s: _math.logical_not(s)
+    Tensor.__and__ = lambda s, o: _math.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: _math.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: _math.bitwise_xor(s, o)
+    Tensor.__hash__ = lambda s: id(s)
+
+
+def _to(obj, like):
+    from ._helpers import ensure_tensor
+
+    return ensure_tensor(obj)
+
+
+def _snapshot_for_inplace(t, opname):
+    """Pre-mutation view of `t` for in-place ops so the recorded GradNode's
+    input is not the op's own output (reference semantics: eager inplace
+    version counting, paddle/fluid/eager/tensor_wrapper.h)."""
+    from ..autograd import engine as _engine
+    from ..tensor_core import Tensor
+
+    if (
+        _engine.is_grad_enabled()
+        and not t.stop_gradient
+        and t._grad_node is None
+    ):
+        raise RuntimeError(
+            f"{opname}_: in-place modification of a leaf Tensor that "
+            "requires grad is not supported; use paddle.no_grad() or the "
+            "out-of-place op"
+        )
+    old = Tensor(t._value, stop_gradient=t.stop_gradient)
+    old._grad_node = t._grad_node
+    old._out_index = t._out_index
+    return old
+
+
+_install_tensor_methods()

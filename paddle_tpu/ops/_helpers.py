@@ -1,0 +1,135 @@
+"""Op-definition helpers.
+
+TPU-native replacement for the reference's kernel registry + codegen
+(reference: paddle/phi/core/kernel_registry.h PD_REGISTER_KERNEL and
+paddle/phi/api/yaml/ generators). There is exactly one backend — XLA — so the
+"registry" is: every op is a jax-traceable function funneled through the
+autograd tape via `engine.apply`. Pallas kernels slot in by simply being the
+jfn for their op.
+"""
+import functools
+
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core import dtype as dtype_mod
+
+_OP_REGISTRY = {}
+
+
+def register_op(name, fn):
+    _OP_REGISTRY[name] = fn
+    return fn
+
+
+def get_op(name):
+    return _OP_REGISTRY[name]
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def ensure_tensor(x, dtype=None):
+    from ..tensor_core import Tensor
+
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=dtype), stop_gradient=True)
+
+
+def value_of(x):
+    from ..tensor_core import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def unary_op(name, jfn, doc=None):
+    """Build `op(x, name=None)` from an array function."""
+
+    def op(x, name=None):
+        x = ensure_tensor(x)
+        return engine.apply(op.__name__, jfn, (x,))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} (thin XLA lowering)."
+    register_op(name, op)
+    return op
+
+
+def binary_op(name, jfn, doc=None):
+    """Build `op(x, y, name=None)`; y may be a python scalar."""
+
+    def op(x, y, name=None):
+        from ..tensor_core import Tensor
+
+        if not isinstance(x, Tensor) and isinstance(y, Tensor):
+            x = ensure_tensor(x, dtype=_scalar_dtype_for(x, y))
+        elif not isinstance(x, Tensor):
+            x = ensure_tensor(x)
+        if not isinstance(y, Tensor):
+            c = _const_for(y, x)
+            return engine.apply(op.__name__, lambda a: jfn(a, c), (x,))
+        y = ensure_tensor(y)
+        return engine.apply(op.__name__, jfn, (x, y))
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting."
+    register_op(name, op)
+    return op
+
+
+def _scalar_dtype_for(scalar, tensor):
+    td = tensor.dtype
+    if isinstance(scalar, bool):
+        return None
+    if isinstance(scalar, int) and dtype_mod.is_floating_point(td):
+        return td
+    if isinstance(scalar, float) and dtype_mod.is_floating_point(td):
+        return td
+    return None
+
+
+def _const_for(scalar, tensor):
+    """Keep python scalars weakly typed so x(float32) + 2 stays float32."""
+    if isinstance(scalar, (int, float, bool, complex)):
+        return scalar
+    return jnp.asarray(scalar)
+
+
+def reduce_op(name, jfn, doc=None):
+    """Build `op(x, axis=None, keepdim=False, name=None)`."""
+
+    def op(x, axis=None, keepdim=False, name=None):
+        x = ensure_tensor(x)
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        elif axis is not None:
+            axis = int(axis)
+        return engine.apply(
+            op.__name__, lambda a: jfn(a, axis=axis, keepdims=keepdim), (x,)
+        )
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Reduction {name} over axis."
+    register_op(name, op)
+    return op
+
+
+def defop(name):
+    """Decorator: register a hand-written op under `name`."""
+
+    def deco(fn):
+        fn.__name__ = name
+        register_op(name, fn)
+        return fn
+
+    return deco
+
+
+def apply_jfn(name, jfn, *tensors):
+    """Shortcut for hand-written ops."""
+    return engine.apply(name, jfn, tuple(ensure_tensor(t) for t in tensors))

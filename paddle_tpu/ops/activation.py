@@ -1,0 +1,216 @@
+"""Activation ops (paddle.nn.functional activations).
+
+reference: paddle/fluid/operators/activation_op.cc + phi activation kernels
+(paddle/phi/kernels/activation_kernel.h). One jax.nn call each; XLA fuses
+them into surrounding matmuls.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ._helpers import apply_jfn, defop, ensure_tensor, unary_op
+
+relu = unary_op("relu", jax.nn.relu)
+relu6 = unary_op("relu6", jax.nn.relu6)
+sigmoid = unary_op("sigmoid", jax.nn.sigmoid)
+silu = unary_op("silu", jax.nn.silu)
+swish = unary_op("swish", jax.nn.silu)
+tanh = unary_op("tanh_act", jnp.tanh)
+softplus_default = None
+mish = unary_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = unary_op("tanhshrink", lambda a: a - jnp.tanh(a))
+softsign = unary_op("softsign", jax.nn.soft_sign)
+log_sigmoid = unary_op("log_sigmoid", jax.nn.log_sigmoid)
+
+
+@defop("gelu")
+def gelu(x, approximate=False, name=None):
+    return apply_jfn(
+        "gelu", lambda a: jax.nn.gelu(a, approximate=approximate), ensure_tensor(x)
+    )
+
+
+@defop("leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_jfn(
+        "leaky_relu",
+        lambda a: jax.nn.leaky_relu(a, negative_slope),
+        ensure_tensor(x),
+    )
+
+
+@defop("elu")
+def elu(x, alpha=1.0, name=None):
+    return apply_jfn("elu", lambda a: jax.nn.elu(a, alpha), ensure_tensor(x))
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_jfn(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+        ensure_tensor(x),
+    )
+
+
+@defop("celu")
+def celu(x, alpha=1.0, name=None):
+    return apply_jfn("celu", lambda a: jax.nn.celu(a, alpha), ensure_tensor(x))
+
+
+@defop("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_jfn(
+        "hardtanh", lambda a: jnp.clip(a, min, max), ensure_tensor(x)
+    )
+
+
+@defop("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_jfn(
+        "hardsigmoid",
+        lambda a: jnp.clip(slope * a + offset, 0.0, 1.0),
+        ensure_tensor(x),
+    )
+
+
+@defop("hardswish")
+def hardswish(x, name=None):
+    return apply_jfn(
+        "hardswish",
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0,
+        ensure_tensor(x),
+    )
+
+
+@defop("hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_jfn(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+        ensure_tensor(x),
+    )
+
+
+@defop("softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return apply_jfn(
+        "softshrink",
+        lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0),
+        ensure_tensor(x),
+    )
+
+
+@defop("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_jfn(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, 0.0).astype(a.dtype),
+        ensure_tensor(x),
+    )
+
+
+@defop("softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def jfn(a):
+        # double-where keeps the unselected exp branch finite so its vjp
+        # contributes 0, not 0*inf=NaN
+        big = beta * a > threshold
+        safe = jnp.where(big, 0.0, beta * a)
+        return jnp.where(big, a, (1.0 / beta) * jnp.log1p(jnp.exp(safe)))
+
+    return apply_jfn("softplus", jfn, ensure_tensor(x))
+
+
+@defop("prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def jfn(a, w):
+        if w.size > 1 and a.ndim > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return engine.apply("prelu", jfn, (x, weight))
+
+
+@defop("rrelu")
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    from ..core import rng
+
+    x = ensure_tensor(x)
+    if training:
+        k = rng.next_key()
+
+        def jfn(a):
+            r = jax.random.uniform(k, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, r * a)
+
+        return apply_jfn("rrelu", jfn, x)
+    mid = (lower + upper) / 2.0
+    return apply_jfn("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+@defop("softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_jfn("softmax", lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+@defop("log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_jfn(
+        "log_softmax", lambda a: jax.nn.log_softmax(a, axis=axis), x
+    )
+
+
+@defop("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core import rng
+
+    x = ensure_tensor(x)
+    k = rng.next_key()
+
+    def jfn(a):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(k, a.shape, a.dtype, 1e-20, 1.0)
+        ))
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(
+                onehot, idx, 1.0, axis=axis, inplace=False
+            ) if hasattr(jnp, "put_along_axis") else jnp.take_along_axis(
+                jnp.eye(y.shape[axis], dtype=y.dtype), idx, 0
+            )
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+
+    return apply_jfn("gumbel_softmax", jfn, x)
+
+
+@defop("maxout")
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def jfn(a):
+        shp = list(a.shape)
+        c = shp[axis]
+        shp[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shp), axis=axis + 1)
+
+    return apply_jfn("maxout", jfn, x)
+
+
+@defop("glu")
+def glu(x, axis=-1, name=None):
+    return apply_jfn("glu", lambda a: jax.nn.glu(a, axis=axis), ensure_tensor(x))

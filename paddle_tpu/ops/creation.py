@@ -1,0 +1,356 @@
+"""Tensor creation ops (paddle.ones/zeros/to_tensor/...).
+
+reference: python/paddle/tensor/creation.py; kernels
+paddle/phi/kernels/full_kernel.h, arange_kernel.h, etc.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core import rng
+from ..tensor_core import Tensor
+from ..core.dtype import convert_dtype as _cd
+
+
+def _i64():
+    return _cd("int64")
+
+from ._helpers import defop, ensure_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "rand",
+    "randn",
+    "normal",
+    "uniform",
+    "randint",
+    "randperm",
+    "bernoulli",
+    "multinomial",
+    "tril",
+    "triu",
+    "meshgrid",
+    "diag",
+    "diagflat",
+    "assign",
+    "clone",
+    "numel",
+    "one_hot",
+]
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default or dtype_mod.get_default_dtype()
+    return d
+
+
+@defop("to_tensor")
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtype_mod.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None and not isinstance(data, (np.ndarray, jax.Array)):
+        # python scalars/lists of floats default to the framework dtype
+        # (reference: python/paddle/tensor/creation.py to_tensor semantics)
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            d = dtype_mod.get_default_dtype()
+    v = jnp.asarray(data, dtype=d)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+@defop("zeros")
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_norm_shape(shape), _dt(dtype)), True)
+
+
+@defop("ones")
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_norm_shape(shape), _dt(dtype)), True)
+
+
+@defop("full")
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtype_mod.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtype_mod.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_norm_shape(shape), fill_value, _dt(dtype)), True)
+
+
+@defop("empty")
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@defop("zeros_like")
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._value, dtype=dtype_mod.convert_dtype(dtype)), True)
+
+
+@defop("ones_like")
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._value, dtype=dtype_mod.convert_dtype(dtype)), True)
+
+
+@defop("full_like")
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(
+        jnp.full_like(x._value, fill_value, dtype=dtype_mod.convert_dtype(dtype)), True
+    )
+
+
+@defop("empty_like")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@defop("arange")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            dtype_mod.int64
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtype_mod.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)), True)
+
+
+@defop("linspace")
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)), True)
+
+
+@defop("logspace")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)), True
+    )
+
+
+@defop("eye")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns),
+                          dtype=_dt(dtype)), True)
+
+
+# ---- random ----
+@defop("rand")
+def rand(shape, dtype=None, name=None):
+    return Tensor(
+        jax.random.uniform(rng.next_key(), _norm_shape(shape), _dt(dtype)), True
+    )
+
+
+@defop("randn")
+def randn(shape, dtype=None, name=None):
+    return Tensor(
+        jax.random.normal(rng.next_key(), _norm_shape(shape), _dt(dtype)), True
+    )
+
+
+@defop("normal")
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = ()
+    k = rng.next_key()
+    return Tensor(
+        jax.random.normal(k, _norm_shape(shape), dtype_mod.get_default_dtype())
+        * std
+        + mean,
+        True,
+    )
+
+
+@defop("uniform")
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return Tensor(
+        jax.random.uniform(k, _norm_shape(shape), _dt(dtype), min, max), True
+    )
+
+
+@defop("randint")
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = dtype_mod.convert_dtype(dtype) or dtype_mod.int64
+    return Tensor(
+        jax.random.randint(rng.next_key(), _norm_shape(shape), low, high, d), True
+    )
+
+
+@defop("randperm")
+def randperm(n, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or dtype_mod.int64
+    return Tensor(
+        jax.random.permutation(rng.next_key(), jnp.arange(n, dtype=d)), True
+    )
+
+
+@defop("bernoulli")
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(
+        jax.random.bernoulli(rng.next_key(), x._value).astype(x._value.dtype), True
+    )
+
+
+@defop("multinomial")
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    probs = jnp.maximum(x._value, 0.0)
+    if replacement:
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if x.ndim == 1:
+            out = jax.random.categorical(
+                rng.next_key(), logits, shape=(num_samples,)
+            )
+        else:
+            out = jax.random.categorical(
+                rng.next_key(), logits[:, None, :], axis=-1,
+                shape=(logits.shape[0], num_samples),
+            )
+        return Tensor(out.astype(_i64()), True)
+    # without replacement: per-row jax.random.choice
+    if x.ndim == 1:
+        out = jax.random.choice(
+            rng.next_key(), probs.shape[0], (num_samples,), replace=False,
+            p=probs / jnp.sum(probs),
+        )
+    else:
+        rows = [
+            jax.random.choice(
+                rng.next_key(), probs.shape[1], (num_samples,), replace=False,
+                p=probs[r] / jnp.sum(probs[r]),
+            )
+            for r in range(probs.shape[0])
+        ]
+        out = jnp.stack(rows)
+    return Tensor(out.astype(_i64()), True)
+
+
+# ---- structured ----
+@defop("tril")
+def tril(x, diagonal=0, name=None):
+    from ._helpers import apply_jfn
+
+    return apply_jfn("tril", lambda a: jnp.tril(a, diagonal), x)
+
+
+@defop("triu")
+def triu(x, diagonal=0, name=None):
+    from ._helpers import apply_jfn
+
+    return apply_jfn("triu", lambda a: jnp.triu(a, diagonal), x)
+
+
+@defop("meshgrid")
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    vals = [ensure_tensor(a)._value for a in args]
+    outs = jnp.meshgrid(*vals, indexing="ij")
+    return [Tensor(o, True) for o in outs]
+
+
+@defop("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    from ._helpers import apply_jfn
+
+    x = ensure_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def jfn(a):
+            d = jnp.diag(a, offset)
+            mask = jnp.eye(d.shape[0], dtype=bool)
+            mask = jnp.roll(mask, offset, axis=1) if offset else mask
+            return jnp.where(mask, d, padding_value).astype(a.dtype)
+
+        return apply_jfn("diag", jfn, x)
+    return apply_jfn("diag", lambda a: jnp.diag(a, offset), x)
+
+
+@defop("diagflat")
+def diagflat(x, offset=0, name=None):
+    from ._helpers import apply_jfn
+
+    return apply_jfn("diagflat", lambda a: jnp.diagflat(a, offset), x)
+
+
+@defop("assign")
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    if output is None:
+        return Tensor(x._value, True)
+    output.set_value(x._value)
+    return output
+
+
+@defop("clone")
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+@defop("numel")
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size, _i64()), True)
+
+
+@defop("one_hot")
+def one_hot(x, num_classes, name=None):
+    from ._helpers import apply_jfn
+
+    return apply_jfn(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=dtype_mod.get_default_dtype()),
+        x,
+    )

@@ -1,0 +1,286 @@
+"""Linear algebra ops (paddle.tensor.linalg / paddle.linalg equivalents).
+
+reference: python/paddle/tensor/linalg.py; matmul kernel
+paddle/phi/kernels/gpu/matmul_kernel.cu (cuBLAS). Here matmul lowers straight
+onto the MXU via jnp.matmul (bf16/int8 handled by dtype); no BLAS wrapper
+layer exists or is needed.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ._helpers import apply_jfn, defop, ensure_tensor
+
+
+@defop("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def jfn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return engine.apply("matmul", jfn, (x, y))
+
+
+@defop("mm")
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+@defop("bmm")
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+@defop("dot")
+def dot(x, y, name=None):
+    return engine.apply(
+        "dot",
+        lambda a, b: jnp.sum(a * b, axis=-1),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("mv")
+def mv(x, vec, name=None):
+    return engine.apply(
+        "mv", lambda a, v: a @ v, (ensure_tensor(x), ensure_tensor(vec))
+    )
+
+
+@defop("t")
+def t(input, name=None):
+    x = ensure_tensor(input)
+    if x.ndim < 2:
+        return x.clone()
+    return apply_jfn("t", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+@defop("norm")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def jfn(a):
+        if p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p in (float("inf"), "inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p in (float("-inf"), "-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_jfn("norm", jfn, x)
+
+
+@defop("dist")
+def dist(x, y, p=2, name=None):
+    return engine.apply(
+        "dist",
+        lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("cond")
+def cond_number(x, p=None, name=None):
+    return apply_jfn("cond", lambda a: jnp.linalg.cond(a, p), ensure_tensor(x))
+
+
+@defop("cross")
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1
+    )
+    return engine.apply(
+        "cross", lambda a, b: jnp.cross(a, b, axis=ax), (x, y)
+    )
+
+
+@defop("histogram")
+def histogram(input, bins=100, min=0, max=0, name=None):
+    import numpy as np
+
+    from ..tensor_core import Tensor
+
+    a = np.asarray(ensure_tensor(input)._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    h, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(h.astype(np.int64)), True)
+
+
+@defop("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    if weights is None:
+        return apply_jfn(
+            "bincount", lambda a: jnp.bincount(a, length=None if minlength == 0 else minlength), x
+        )
+    w = ensure_tensor(weights)
+    return engine.apply(
+        "bincount",
+        lambda a, ww: jnp.bincount(a, ww, length=None if minlength == 0 else minlength),
+        (x, w),
+    )
+
+
+@defop("matrix_power")
+def matrix_power(x, n, name=None):
+    return apply_jfn(
+        "matrix_power", lambda a: jnp.linalg.matrix_power(a, n), ensure_tensor(x)
+    )
+
+
+@defop("inverse")
+def inverse(x, name=None):
+    return apply_jfn("inverse", jnp.linalg.inv, ensure_tensor(x))
+
+
+@defop("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_jfn(
+        "pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian),
+        ensure_tensor(x),
+    )
+
+
+@defop("det")
+def det(x, name=None):
+    return apply_jfn("det", jnp.linalg.det, ensure_tensor(x))
+
+
+@defop("slogdet")
+def slogdet(x, name=None):
+    out = engine.apply(
+        "slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), (ensure_tensor(x),)
+    )
+    from .manipulation import stack
+
+    return stack(list(out), axis=0)
+
+
+@defop("svd")
+def svd(x, full_matrices=False, name=None):
+    return engine.apply(
+        "svd",
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        (ensure_tensor(x),),
+    )
+
+
+@defop("qr")
+def qr(x, mode="reduced", name=None):
+    return engine.apply(
+        "qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (ensure_tensor(x),)
+    )
+
+
+@defop("eigh")
+def eigh(x, UPLO="L", name=None):
+    return engine.apply(
+        "eigh",
+        lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=(UPLO == "L"))),
+        (ensure_tensor(x),),
+    )
+
+
+@defop("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_jfn("eigvalsh", jnp.linalg.eigvalsh, ensure_tensor(x))
+
+
+@defop("cholesky")
+def cholesky(x, upper=False, name=None):
+    def jfn(a):
+        c = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+
+    return apply_jfn("cholesky", jfn, ensure_tensor(x))
+
+
+@defop("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    return engine.apply(
+        "cholesky_solve",
+        lambda b, L: jax.scipy.linalg.cho_solve((L, not upper), b),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("solve")
+def solve(x, y, name=None):
+    return engine.apply(
+        "solve", jnp.linalg.solve, (ensure_tensor(x), ensure_tensor(y))
+    )
+
+
+@defop("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return engine.apply(
+        "triangular_solve",
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        ),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("lstsq")
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    out = engine.apply(
+        "lstsq",
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+    return out
+
+
+@defop("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_jfn(
+        "matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=tol),
+        ensure_tensor(x),
+    )
+
+
+@defop("multi_dot")
+def multi_dot(x, name=None):
+    tensors = tuple(ensure_tensor(t) for t in x)
+    return engine.apply(
+        "multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), tensors
+    )
+
+
+@defop("einsum")
+def einsum(equation, *operands):
+    tensors = tuple(ensure_tensor(t) for t in operands)
+    return engine.apply(
+        "einsum", lambda *xs: jnp.einsum(equation, *xs), tensors
+    )
+
+
+@defop("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return apply_jfn(
+        "corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), ensure_tensor(x)
+    )
+
+
+@defop("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_jfn(
+        "cov",
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+        ensure_tensor(x),
+    )

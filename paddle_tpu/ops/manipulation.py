@@ -1,0 +1,665 @@
+"""Shape / indexing / layout ops (paddle.tensor.manipulation equivalents).
+
+reference: python/paddle/tensor/manipulation.py; phi kernels
+paddle/phi/kernels/{reshape,concat,split,gather,scatter,...}_kernel.h.
+All static-shape, XLA-friendly: dynamic result shapes (masked_select, nonzero)
+are eager-only by design, same as the reference marks them non-inferable.
+"""
+import builtins
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core.dtype import convert_dtype as _cd
+
+
+def _i64():
+    return _cd("int64")
+
+from ..core import dtype as dtype_mod
+from ..tensor_core import Tensor
+from ._helpers import apply_jfn, defop, ensure_tensor
+
+
+def _axes(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop("cast")
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    x = ensure_tensor(x)
+    return apply_jfn("cast", lambda a: a.astype(d), x)
+
+
+@defop("reshape")
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+    return apply_jfn("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+@defop("reshape_")
+def reshape_(x, shape, name=None):
+    from . import _snapshot_for_inplace
+
+    out = reshape(_snapshot_for_inplace(x, "reshape"), shape)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@defop("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    new_shape = list(x.shape[:sa]) + [-1] + list(x.shape[ea + 1:])
+    return apply_jfn("flatten", lambda a: jnp.reshape(a, new_shape), x)
+
+
+@defop("transpose")
+def transpose(x, perm=None, name=None):
+    x = ensure_tensor(x)
+    p = None if perm is None else tuple(int(i) for i in perm)
+    return apply_jfn("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+@defop("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return apply_jfn(
+        "moveaxis", lambda a: jnp.moveaxis(a, source, destination), ensure_tensor(x)
+    )
+
+
+@defop("swapaxes")
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_jfn(
+        "swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), ensure_tensor(x)
+    )
+
+
+@defop("squeeze")
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        return apply_jfn("squeeze", jnp.squeeze, x)
+    ax = _axes(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    return apply_jfn("squeeze", lambda a: jnp.squeeze(a, ax), x)
+
+
+@defop("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = _axes(axis)
+    return apply_jfn("unsqueeze", lambda a: jnp.expand_dims(a, ax), x)
+
+
+@defop("concat")
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = tuple(ensure_tensor(t) for t in x)
+    return engine.apply(
+        "concat", lambda *xs: jnp.concatenate(xs, axis=axis), tensors
+    )
+
+
+@defop("stack")
+def stack(x, axis=0, name=None):
+    tensors = tuple(ensure_tensor(t) for t in x)
+    return engine.apply("stack", lambda *xs: jnp.stack(xs, axis=axis), tensors)
+
+
+@defop("split")
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if -1 in sizes:
+            known = np.sum([s for s in sizes if s >= 0])
+            sizes = [s if s >= 0 else int(dim - known) for s in sizes]
+    idx = np.cumsum(sizes)[:-1].tolist()
+    out = engine.apply(
+        "split", lambda a: tuple(jnp.split(a, idx, axis=axis)), (x,)
+    )
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+@defop("chunk")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@defop("unbind")
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis]
+    out = engine.apply(
+        "unbind",
+        lambda a: tuple(
+            jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)
+        ),
+        (x,),
+    )
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+@defop("tile")
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r) for r in repeat_times)
+    return apply_jfn("tile", lambda a: jnp.tile(a, reps), ensure_tensor(x))
+
+
+@defop("expand")
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    tgt = [int(s) for s in shape]
+    cur = x.shape
+    # -1 means keep dim
+    off = len(tgt) - len(cur)
+    for i in range(len(tgt)):
+        if tgt[i] == -1:
+            tgt[i] = cur[i - off]
+    return apply_jfn("expand", lambda a: jnp.broadcast_to(a, tgt), x)
+
+
+@defop("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@defop("expand_as")
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+@defop("broadcast_tensors")
+def broadcast_tensors(inputs, name=None):
+    tensors = tuple(ensure_tensor(t) for t in inputs)
+    out = engine.apply(
+        "broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), tensors
+    )
+    return list(out)
+
+
+@defop("flip")
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply_jfn("flip", lambda a: jnp.flip(a, ax), ensure_tensor(x))
+
+
+@defop("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_jfn("rot90", lambda a: jnp.rot90(a, k, axes), ensure_tensor(x))
+
+
+@defop("roll")
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    ax = None if axis is None else _axes(axis)
+    return apply_jfn("roll", lambda a: jnp.roll(a, shifts, ax), ensure_tensor(x))
+
+
+# ---- gather / scatter family ----
+@defop("gather")
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def jfn(a, idx):
+        idx = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(a, idx, axis=axis)
+
+    return engine.apply("gather", jfn, (x, index))
+
+
+@defop("gather_nd")
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def jfn(a, idx):
+        # k = idx.shape[-1] leading dims are gathered; k < a.ndim keeps the
+        # trailing dims (numpy advanced indexing handles both)
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return engine.apply("gather_nd", jfn, (x, index))
+
+
+@defop("take_along_axis")
+def take_along_axis(arr, indices, axis, name=None):
+    return engine.apply(
+        "take_along_axis",
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+        (ensure_tensor(arr), ensure_tensor(indices)),
+    )
+
+
+@defop("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def jfn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        idx = [
+            jnp.broadcast_to(
+                jnp.expand_dims(
+                    jnp.arange(a.shape[d]),
+                    tuple(x for x in dims if x != d),
+                ),
+                i.shape,
+            )
+            if d != axis
+            else i
+            for d in dims
+        ]
+        if reduce == "assign":
+            return a.at[tuple(idx)].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[tuple(idx)].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[tuple(idx)].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return engine.apply("put_along_axis", jfn, (arr, indices, values))
+
+
+@defop("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = (
+        ensure_tensor(x),
+        ensure_tensor(index),
+        ensure_tensor(updates),
+    )
+
+    def jfn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # accumulate mode: zero out target rows then add
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return engine.apply("scatter", jfn, (x, index, updates))
+
+
+@defop("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    return engine.apply(
+        "scatter_nd_add",
+        lambda a, i, u: a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u),
+        (ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)),
+    )
+
+
+@defop("scatter_nd")
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    return engine.apply(
+        "scatter_nd",
+        lambda i, u: jnp.zeros(tuple(shape), u.dtype)
+        .at[tuple(jnp.moveaxis(i, -1, 0))]
+        .add(u),
+        (index, updates),
+    )
+
+
+@defop("index_select")
+def index_select(x, index, axis=0, name=None):
+    return engine.apply(
+        "index_select",
+        lambda a, i: jnp.take(a, i, axis=axis),
+        (ensure_tensor(x), ensure_tensor(index)),
+    )
+
+
+@defop("index_sample")
+def index_sample(x, index):
+    return engine.apply(
+        "index_sample",
+        lambda a, i: jnp.take_along_axis(a, i, axis=1),
+        (ensure_tensor(x), ensure_tensor(index)),
+    )
+
+
+@defop("masked_select")
+def masked_select(x, mask, name=None):
+    # dynamic output shape → eager only (same restriction class as reference's
+    # LoD ops; under jit use masked_fill/where instead)
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    out = np.asarray(x._value)[np.asarray(mask._value)]
+    return Tensor(jnp.asarray(out), True)
+
+
+@defop("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = value._value if isinstance(value, Tensor) else value
+    return engine.apply(
+        "masked_fill", lambda a, m: jnp.where(m, v, a), (x, mask)
+    )
+
+
+@defop("where")
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return engine.apply(
+        "where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y)
+    )
+
+
+@defop("nonzero")
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i), True) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)), True)
+
+
+# ---- sort / search ----
+@defop("sort")
+def sort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+
+    def jfn(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply_jfn("sort", jfn, x)
+
+
+@defop("argsort")
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+
+    def jfn(a):
+        s = jnp.argsort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply_jfn("argsort", jfn, x).astype("int64")
+
+
+@defop("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def jfn(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(moved, k)
+        else:
+            v, i = jax.lax.top_k(-moved, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(_i64())
+
+    values, indices = engine.apply("topk", jfn, (x,))
+    return values, indices
+
+
+@defop("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def jfn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis).astype(_i64())
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+
+    return engine.apply("kthvalue", jfn, (x,))
+
+
+@defop("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    # host-side mode (eager-only, like the reference's CPU kernel path)
+    x = ensure_tensor(x)
+    a = np.asarray(x._value)
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for r in range(flat.shape[0]):
+        u, c = np.unique(flat[r], return_counts=True)
+        v = u[np.argmax(c)]
+        vals[r] = v
+        idxs[r] = np.nonzero(flat[r] == v)[0][-1]
+    shp = list(moved.shape[:-1])
+    out_v = vals.reshape(shp)
+    out_i = idxs.reshape(shp)
+    if keepdim:
+        out_v = np.expand_dims(out_v, axis)
+        out_i = np.expand_dims(out_i, axis)
+    return Tensor(jnp.asarray(out_v), True), Tensor(jnp.asarray(out_i), True)
+
+
+@defop("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def jfn(s, val):
+        out = jnp.searchsorted(s, val, side=side)
+        return out.astype(jnp.int32 if out_int32 else _i64())
+
+    return engine.apply("searchsorted", jfn, (ss, v))
+
+
+@defop("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+@defop("unique")
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(
+        np.asarray(x._value),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res), True)
+    return tuple(Tensor(jnp.asarray(r), True) for r in res)
+
+
+@defop("unique_consecutive")
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       name=None):
+    x = np.asarray(ensure_tensor(x)._value)
+    if axis is not None:
+        raise NotImplementedError
+    flat = x.reshape(-1)
+    keep = np.ones(len(flat), dtype=bool)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = [Tensor(jnp.asarray(flat[keep]), True)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv), True))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, len(flat)))
+        out.append(Tensor(jnp.asarray(counts), True))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+# ---- padding ----
+@defop("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form: [d0_lo, d0_hi, d1_lo, d1_hi, ...] (paddle: per-dim pairs)
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to trailing spatial dims (NCHW/NCL/NCDHW)
+        k = len(pad) // 2
+        pairs = [(0, 0)] * (nd - k)
+        # paddle order: last-dim-first pairs reversed
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+        if data_format.upper().startswith("NC"):
+            pairs = [(0, 0)] * (nd - k) + spatial[::-1]
+        else:
+            pairs = [(0, 0)] + spatial[::-1] + [(0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def jfn(a):
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return apply_jfn("pad", jfn, x)
+
+
+@defop("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        r = np.asarray(repeats._value)
+        out = np.repeat(np.asarray(x._value), r, axis=axis)
+        return Tensor(jnp.asarray(out), True)
+    return apply_jfn(
+        "repeat_interleave",
+        lambda a: jnp.repeat(a, repeats, axis=axis),
+        x,
+    )
+
+
+@defop("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    raise NotImplementedError("as_strided is not XLA-expressible; use reshape/slice")
+
+
+@defop("tensordot")
+def tensordot(x, y, axes=2, name=None):
+    return engine.apply(
+        "tensordot",
+        lambda a, b: jnp.tensordot(a, b, axes=axes),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("slice")
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        idx[ax] = builtins.slice(s, e)
+    return apply_jfn("slice", lambda a: a[tuple(idx)], input)
+
+
+@defop("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(s), int(e), int(st))
+    return apply_jfn("strided_slice", lambda a: a[tuple(idx)], x)
+
+
+def _normalize_index(idx):
+    """Convert Tensors inside an index expression to arrays."""
+    if isinstance(idx, Tensor):
+        v = idx._value
+        if v.dtype == jnp.bool_:
+            return np.asarray(v)  # boolean mask → host (dynamic shape)
+        return v
+    if isinstance(idx, tuple):
+        return tuple(_normalize_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def _getitem(x, idx):
+    nidx = _normalize_index(idx)
+
+    def has_bool(i):
+        if isinstance(i, np.ndarray) and i.dtype == np.bool_:
+            return True
+        if isinstance(i, tuple):
+            return any(has_bool(j) for j in i)
+        return False
+
+    if has_bool(nidx):
+        out = np.asarray(x._value)[
+            nidx if not isinstance(nidx, tuple) else tuple(
+                np.asarray(i) if hasattr(i, "shape") else i for i in nidx
+            )
+        ]
+        return Tensor(jnp.asarray(out), True)
+    return apply_jfn("getitem", lambda a: a[nidx], x)
+
+
+def _setitem(x, idx, value):
+    from . import _snapshot_for_inplace
+
+    nidx = _normalize_index(idx)
+    v = value._value if isinstance(value, Tensor) else value
+    vt = ensure_tensor(value) if isinstance(value, Tensor) else None
+    if isinstance(nidx, np.ndarray) and nidx.dtype == np.bool_:
+        nidx = jnp.asarray(nidx)
+    old = _snapshot_for_inplace(x, "setitem")
+    if vt is not None and (not x.stop_gradient or not vt.stop_gradient):
+        out = engine.apply(
+            "setitem", lambda a, u: a.at[nidx].set(u.astype(a.dtype)), (old, vt)
+        )
+    else:
+        out = apply_jfn(
+            "setitem",
+            lambda a: a.at[nidx].set(
+                jnp.asarray(v).astype(a.dtype)
+                if not np.isscalar(v)
+                else v
+            ),
+            old,
+        )
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient

@@ -1,0 +1,425 @@
+"""Math ops (paddle.tensor.math equivalents).
+
+reference: python/paddle/tensor/math.py (dispatching to phi kernels
+paddle/phi/kernels/elementwise_*.h, reduce_*.h, activation kernels). Here each
+op is one jnp/lax expression lowered by XLA; fusion is the compiler's job.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core.dtype import convert_dtype as _cd
+
+
+def _i64():
+    return _cd("int64")
+
+from ._helpers import (
+    apply_jfn,
+    binary_op,
+    defop,
+    ensure_tensor,
+    reduce_op,
+    unary_op,
+)
+
+# ---- elementwise binary ----
+add = binary_op("add", jnp.add)
+subtract = binary_op("subtract", jnp.subtract)
+multiply = binary_op("multiply", jnp.multiply)
+divide = binary_op("divide", jnp.true_divide)
+floor_divide = binary_op("floor_divide", jnp.floor_divide)
+mod = binary_op("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = binary_op("pow", jnp.power)
+maximum = binary_op("maximum", jnp.maximum)
+minimum = binary_op("minimum", jnp.minimum)
+fmax = binary_op("fmax", jnp.fmax)
+fmin = binary_op("fmin", jnp.fmin)
+atan2 = binary_op("atan2", jnp.arctan2)
+hypot = binary_op("hypot", jnp.hypot)
+copysign = binary_op("copysign", jnp.copysign)
+nextafter = binary_op("nextafter", jnp.nextafter)
+ldexp = binary_op("ldexp", jnp.ldexp)
+heaviside = binary_op("heaviside", jnp.heaviside)
+gcd = binary_op("gcd", jnp.gcd)
+lcm = binary_op("lcm", jnp.lcm)
+logaddexp = binary_op("logaddexp", jnp.logaddexp)
+
+# ---- elementwise unary ----
+abs = unary_op("abs", jnp.abs)
+neg = unary_op("neg", jnp.negative)
+exp = unary_op("exp", jnp.exp)
+expm1 = unary_op("expm1", jnp.expm1)
+log = unary_op("log", jnp.log)
+log2 = unary_op("log2", jnp.log2)
+log10 = unary_op("log10", jnp.log10)
+log1p = unary_op("log1p", jnp.log1p)
+sqrt = unary_op("sqrt", jnp.sqrt)
+rsqrt = unary_op("rsqrt", jax.lax.rsqrt)
+square = unary_op("square", jnp.square)
+reciprocal = unary_op("reciprocal", jnp.reciprocal)
+sin = unary_op("sin", jnp.sin)
+cos = unary_op("cos", jnp.cos)
+tan = unary_op("tan", jnp.tan)
+asin = unary_op("asin", jnp.arcsin)
+acos = unary_op("acos", jnp.arccos)
+atan = unary_op("atan", jnp.arctan)
+sinh = unary_op("sinh", jnp.sinh)
+cosh = unary_op("cosh", jnp.cosh)
+tanh = unary_op("tanh", jnp.tanh)
+asinh = unary_op("asinh", jnp.arcsinh)
+acosh = unary_op("acosh", jnp.arccosh)
+atanh = unary_op("atanh", jnp.arctanh)
+floor = unary_op("floor", jnp.floor)
+ceil = unary_op("ceil", jnp.ceil)
+round = unary_op("round", jnp.round)
+trunc = unary_op("trunc", jnp.trunc)
+frac = unary_op("frac", lambda a: a - jnp.trunc(a))
+sign = unary_op("sign", jnp.sign)
+sgn = sign
+erf = unary_op("erf", jax.scipy.special.erf)
+erfinv = unary_op("erfinv", jax.scipy.special.erfinv)
+lgamma = unary_op("lgamma", jax.scipy.special.gammaln)
+digamma = unary_op("digamma", jax.scipy.special.digamma)
+i0 = unary_op("i0", jax.scipy.special.i0)
+i0e = unary_op("i0e", jax.scipy.special.i0e)
+i1 = unary_op("i1", jax.scipy.special.i1)
+i1e = unary_op("i1e", jax.scipy.special.i1e)
+angle = unary_op("angle", jnp.angle)
+conj = unary_op("conj", jnp.conj)
+real = unary_op("real", jnp.real)
+imag = unary_op("imag", jnp.imag)
+deg2rad = unary_op("deg2rad", jnp.deg2rad)
+rad2deg = unary_op("rad2deg", jnp.rad2deg)
+
+
+@defop("_identity")
+def _identity(x, name=None):
+    return apply_jfn("identity", lambda a: a, x)
+
+
+@defop("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    if bias_after_scale:
+        out = apply_jfn("scale", lambda a: a * scale + bias, x)
+    else:
+        out = apply_jfn("scale", lambda a: (a + bias) * scale, x)
+    if act:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+@defop("increment")
+def increment(x, value=1.0, name=None):
+    # non-differentiable in-place (used by counters/schedulers)
+    x = ensure_tensor(x)
+    x._value = x._value + value
+    x._grad_node = None
+    return x
+
+
+@defop("clip")
+def clip(x, min=None, max=None, name=None):
+    from ..tensor_core import Tensor
+
+    x = ensure_tensor(x)
+    mn = min._value if isinstance(min, Tensor) else min
+    mx = max._value if isinstance(max, Tensor) else max
+    return apply_jfn("clip", lambda a: jnp.clip(a, mn, mx), x)
+
+
+@defop("lerp")
+def lerp(x, y, weight, name=None):
+    from ..tensor_core import Tensor
+
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return engine.apply(
+            "lerp", lambda a, b, w: a + w * (b - a), (x, y, weight)
+        )
+    return engine.apply("lerp", lambda a, b: a + weight * (b - a), (x, y))
+
+
+@defop("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return engine.apply(
+        "addmm",
+        lambda i, a, b: beta * i + alpha * (a @ b),
+        (ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_jfn("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+@defop("rsqrt_")
+def rsqrt_(x, name=None):
+    from . import _snapshot_for_inplace
+
+    x = ensure_tensor(x)
+    out = rsqrt(_snapshot_for_inplace(x, "rsqrt"))
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+# ---- reductions ----
+sum = reduce_op("sum", jnp.sum)
+mean = reduce_op("mean", jnp.mean)
+prod = reduce_op("prod", jnp.prod)
+max = reduce_op("max", jnp.max)
+min = reduce_op("min", jnp.min)
+amax = max
+amin = min
+nansum = reduce_op("nansum", jnp.nansum)
+nanmean = reduce_op("nanmean", jnp.nanmean)
+
+
+@defop("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x
+    )
+
+
+@defop("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x
+    )
+
+
+@defop("median")
+def median(x, axis=None, keepdim=False, name=None):
+    ax = axis
+    return apply_jfn(
+        "median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), ensure_tensor(x)
+    )
+
+
+@defop("quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_jfn(
+        "quantile",
+        lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim),
+        ensure_tensor(x),
+    )
+
+
+@defop("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        ensure_tensor(x),
+    )
+
+
+@defop("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    return apply_jfn(
+        "argmax",
+        lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(d),
+        ensure_tensor(x),
+    )
+
+
+@defop("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    return apply_jfn(
+        "argmin",
+        lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(d),
+        ensure_tensor(x),
+    )
+
+
+@defop("all")
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), ensure_tensor(x)
+    )
+
+
+@defop("any")
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), ensure_tensor(x)
+    )
+
+
+@defop("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_jfn(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(_i64()),
+        ensure_tensor(x),
+    )
+
+
+# ---- cumulative ----
+@defop("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        return apply_jfn("cumsum", lambda a: jnp.cumsum(a.reshape(-1)), x)
+    return apply_jfn("cumsum", lambda a: jnp.cumsum(a, axis=axis), x)
+
+
+@defop("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_jfn("cumprod", lambda a: jnp.cumprod(a, axis=dim), ensure_tensor(x))
+
+
+def _cum_extreme(x, axis, dtype, pick_right, opname):
+    """cummax/cummin returning (values, indices) like the reference
+    (paddle/phi/kernels/cum_maxmin_kernel.h). Pair-valued associative scan:
+    first-occurrence index wins ties."""
+    from ..core.dtype import convert_dtype
+
+    x = ensure_tensor(x)
+    d = convert_dtype(dtype)
+    ax = axis if axis is not None else 0
+
+    def jfn(a):
+        if axis is None:
+            a = a.reshape(-1)
+        n = a.shape[ax]
+        shape = [1] * a.ndim
+        shape[ax] = n
+        iota = jnp.arange(n).reshape(shape)
+        iota = jnp.broadcast_to(iota, a.shape)
+
+        def combine(l, r):
+            lv, li = l
+            rv, ri = r
+            take_r = pick_right(lv, rv)
+            return (
+                jnp.where(take_r, rv, lv),
+                jnp.where(take_r, ri, li),
+            )
+
+        v, i = jax.lax.associative_scan(combine, (a, iota), axis=ax)
+        return v, i.astype(d)
+
+    return engine.apply(opname, jfn, (x,))
+
+
+@defop("cummax")
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, lambda lv, rv: rv > lv, "cummax")
+
+
+@defop("cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, lambda lv, rv: rv < lv, "cummin")
+
+
+@defop("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = None if prepend is None else ensure_tensor(prepend)._value
+    app = None if append is None else ensure_tensor(append)._value
+    return apply_jfn(
+        "diff",
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+        ensure_tensor(x),
+    )
+
+
+@defop("trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_jfn(
+        "trace", lambda a: jnp.trace(a, offset, axis1, axis2), ensure_tensor(x)
+    )
+
+
+@defop("kron")
+def kron(x, y, name=None):
+    return engine.apply("kron", jnp.kron, (ensure_tensor(x), ensure_tensor(y)))
+
+
+@defop("inner")
+def inner(x, y, name=None):
+    return engine.apply("inner", jnp.inner, (ensure_tensor(x), ensure_tensor(y)))
+
+
+@defop("outer")
+def outer(x, y, name=None):
+    return engine.apply("outer", jnp.outer, (ensure_tensor(x), ensure_tensor(y)))
+
+
+# ---- comparison (non-differentiable outputs) ----
+equal = binary_op("equal", jnp.equal)
+not_equal = binary_op("not_equal", jnp.not_equal)
+greater_than = binary_op("greater_than", jnp.greater)
+greater_equal = binary_op("greater_equal", jnp.greater_equal)
+less_than = binary_op("less_than", jnp.less)
+less_equal = binary_op("less_equal", jnp.less_equal)
+
+
+@defop("equal_all")
+def equal_all(x, y, name=None):
+    return engine.apply(
+        "equal_all",
+        lambda a, b: jnp.array_equal(a, b),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return engine.apply(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+@defop("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return engine.apply(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (ensure_tensor(x), ensure_tensor(y)),
+    )
+
+
+isnan = unary_op("isnan", jnp.isnan)
+isinf = unary_op("isinf", jnp.isinf)
+isfinite = unary_op("isfinite", jnp.isfinite)
+
+# ---- logical / bitwise ----
+logical_and = binary_op("logical_and", jnp.logical_and)
+logical_or = binary_op("logical_or", jnp.logical_or)
+logical_xor = binary_op("logical_xor", jnp.logical_xor)
+logical_not = unary_op("logical_not", jnp.logical_not)
+bitwise_and = binary_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary_op("bitwise_not", jnp.bitwise_not)

@@ -1,0 +1,287 @@
+"""The eager Tensor.
+
+TPU-native replacement for the reference's eager Tensor
+(reference: paddle/fluid/pybind/eager.cc Tensor type,
+paddle/phi/api/include/tensor.h `paddle::experimental::Tensor`,
+paddle/fluid/eager/autograd_meta.h:61 `AutogradMeta`).
+
+A Tensor wraps a jax.Array (or a jax tracer, when code runs under
+`to_static`/`jax.jit`). AutogradMeta collapses to three fields:
+`stop_gradient`, `.grad`, and `_grad_node` (the tape creator node).
+Most math methods are installed by `paddle_tpu.ops._install_tensor_methods`.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .autograd import engine
+from .core import dtype as dtype_mod
+from .core import place as place_mod
+
+_tensor_count = 0
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "_backward_hooks",
+        "persistable",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        global _tensor_count
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        if name is None:
+            name = f"generated_tensor_{_tensor_count}"
+            _tensor_count += 1
+        self.name = name
+        self._backward_hooks = None
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        return place_mod.get_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # ---- value access ----
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """reference: paddle/fluid/eager/backward.cc:394 Backward."""
+        engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value), True)
+        else:
+            self.grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        if self._backward_hooks is None:
+            self._backward_hooks = []
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._backward_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def retain_grads(self):
+        # grads for non-leaf tensors are collected via paddle_tpu.grad();
+        # eager .grad retention for intermediates not needed in practice.
+        pass
+
+    # ---- mutation ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch {value.shape} vs {self._value.shape}"
+            )
+        self._value = value
+        self._grad_node = None
+        return self
+
+    def copy_(self, other, *_):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        self._grad_node = None
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._value = self._value * scale + bias
+        self._grad_node = None
+        return self
+
+    # ---- conversion ----
+    def astype(self, dtype):
+        from .ops.manipulation import cast
+
+        return cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def clone(self):
+        from .ops.math import _identity
+
+        return _identity(self)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = dtype_mod.convert_dtype(a)
+                return self.astype(d)
+            except (ValueError, TypeError):
+                continue
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous"
+            )
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        prefix = "Tensor(shape={}, dtype={}, stop_gradient={},\n       ".format(
+            self.shape, self.dtype.name, self.stop_gradient
+        )
+        try:
+            body = np.array2string(self.numpy(), separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return prefix + body + ")"
+
+    __str__ = __repr__
+
+    # ---- indexing ----
+    def __getitem__(self, idx):
+        from .ops.manipulation import _getitem
+
+        return _getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .ops.manipulation import _setitem
+
+        _setitem(self, idx, value)
+
+    @property
+    def T(self):
+        from .ops.linalg import t as _t
+
+        return _t(self)
+
+
+engine.register_tensor_class(Tensor)
+
+
+class Parameter(Tensor):
+    """Trainable parameter (reference: python/paddle/fluid/framework.py
+    `Parameter`/`ParamBase`)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

@@ -1,0 +1,30 @@
+"""Test bootstrap: virtual 8-device CPU mesh (SURVEY.md §4 implication (b)).
+
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # axon env presets JAX_PLATFORMS=axon
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The axon sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon, so the env var alone is too late — force via config.
+jax.config.update("jax_platforms", "cpu")
+
+# Numeric-parity tests compare against float64 numpy; keep CPU matmuls exact.
+# (On TPU the framework default stays bf16-on-MXU.)
+jax.config.update("jax_default_matmul_precision", "highest")
+# int64/float64 fidelity for numpy-parity tests (paddle defaults to int64
+# indices); on real TPU runs x64 stays off and indices are int32.
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,187 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _leaf(data):
+    t = paddle.to_tensor(data, stop_gradient=False)
+    return t
+
+
+def test_simple_backward():
+    x = _leaf([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain_and_broadcast():
+    x = _leaf([[1.0, 2.0], [3.0, 4.0]])
+    b = _leaf([10.0, 20.0])
+    y = (x * b + b).mean()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.array([[10, 20], [10, 20]]) / 4)
+    np.testing.assert_allclose(b.grad.numpy(), (np.array([1 + 3, 2 + 4]) + 2) / 4)
+
+
+def test_matmul_grad():
+    a = _leaf(np.random.randn(3, 4).astype("float32"))
+    b = _leaf(np.random.randn(4, 5).astype("float32"))
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(
+        a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5
+    )
+
+
+def test_grad_accumulation():
+    x = _leaf([2.0])
+    (x * 3).backward()
+    (x * 5).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_reused_tensor():
+    x = _leaf([2.0])
+    y = x * x * x  # x used twice in first mul, result times x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_stop_gradient_blocks():
+    x = _leaf([1.0])
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = _leaf([3.0])
+    y = (x * 2).detach()
+    z = y * 5
+    z.backward()
+    assert x.grad is None
+
+
+def test_no_grad_context():
+    x = _leaf([1.0])
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_retain_graph():
+    x = _leaf([2.0])
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_backward_twice_without_retain_raises():
+    x = _leaf([2.0])
+    y = x * x
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad_api():
+    x = _leaf([2.0])
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # grad() must not write .grad
+
+
+def test_double_grad():
+    x = _leaf([3.0])
+    y = x * x * x  # y = x^3
+    (dx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(dx.numpy(), [27.0])  # 3x^2
+    (ddx,) = paddle.grad(dx, x)
+    np.testing.assert_allclose(ddx.numpy(), [18.0])  # 6x
+
+
+def test_grad_nonleaf_input():
+    x = _leaf([2.0])
+    y = x * 3
+    z = y * y
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_multi_output_op_grad():
+    x = _leaf(np.arange(6, dtype="float32"))
+    parts = paddle.split(x, 2)
+    loss = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_topk_only_values_differentiable():
+    x = _leaf([1.0, 5.0, 3.0])
+    v, i = paddle.topk(x, 2)
+    assert i.stop_gradient
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])
+
+
+def test_register_hook():
+    x = _leaf([1.0])
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()) or (g * 2))
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_backward_with_grad_tensor():
+    x = _leaf([1.0, 1.0])
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = _leaf([3.0])
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_gather_scatter_grad():
+    x = _leaf(np.arange(5, dtype="float32"))
+    idx = paddle.to_tensor([0, 2, 4])
+    y = paddle.gather(x, idx)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1, 0, 1])
+
+
+def test_getitem_grad():
+    x = _leaf(np.ones((3, 3), np.float32))
+    y = x[1]
+    y.sum().backward()
+    expected = np.zeros((3, 3))
+    expected[1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)

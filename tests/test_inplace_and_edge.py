@@ -1,0 +1,84 @@
+"""Regression tests for review findings: in-place tape correctness, NaN-safe
+grads, multinomial semantics, cummax/cummin tuple API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_inplace_on_nonleaf_keeps_gradient_flow():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 1.0
+    y.add_(1.0)          # in-place on non-leaf
+    (y * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_inplace_on_grad_leaf_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(1.0)
+
+
+def test_inplace_under_no_grad_ok():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    with paddle.no_grad():
+        x.add_(1.0)
+    np.testing.assert_allclose(x.numpy(), [3.0])
+
+
+def test_setitem_on_nonleaf_keeps_gradient_flow():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2.0
+    y[0] = 5.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_rsqrt_inplace_records_tape():
+    a = paddle.to_tensor([4.0], stop_gradient=False)
+    b = a * 1.0
+    paddle.ops.math.rsqrt_(b)
+    b.backward()
+    np.testing.assert_allclose(a.grad.numpy(), [-0.0625], rtol=1e-5)
+
+
+def test_softplus_grad_no_nan():
+    x = paddle.to_tensor([100.0, 0.0, -100.0], stop_gradient=False)
+    y = paddle.ops.activation.softplus(x)
+    y.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.5, 0.0], atol=1e-6)
+
+
+def test_multinomial_without_replacement_distinct():
+    paddle.seed(7)
+    x = paddle.to_tensor([0.25, 0.25, 0.25, 0.25])
+    out = paddle.ops.creation.multinomial(x, num_samples=4, replacement=False)
+    assert sorted(out.numpy().tolist()) == [0, 1, 2, 3]
+
+
+def test_cummax_returns_values_and_indices():
+    x = paddle.to_tensor([1.0, 3.0, 2.0, 3.0])
+    v, i = paddle.ops.math.cummax(x, axis=0)
+    assert v.numpy().tolist() == [1.0, 3.0, 3.0, 3.0]
+    assert i.numpy().tolist() == [0, 1, 1, 1]  # first occurrence wins
+    v2, i2 = paddle.ops.math.cummin(x, axis=0)
+    assert v2.numpy().tolist() == [1.0, 1.0, 1.0, 1.0]
+    assert i2.numpy().tolist() == [0, 0, 0, 0]
+
+
+def test_pylayer_create_graph_clear_error():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, x, create_graph=True)

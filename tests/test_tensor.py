@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_constants():
+    x = paddle.ones([3], dtype="float32")
+    assert x.dtype == paddle.float32
+    y = x.astype("int64")
+    assert y.dtype == paddle.int64
+    assert paddle.ones([2], dtype=paddle.bfloat16).dtype == paddle.bfloat16
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.full([2], 7, dtype="int32").numpy().tolist() == [7, 7]
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    e = paddle.eye(3).numpy()
+    np.testing.assert_allclose(e, np.eye(3))
+    t = paddle.tril(paddle.ones([3, 3]))
+    np.testing.assert_allclose(t.numpy(), np.tril(np.ones((3, 3))))
+
+
+def test_operator_overloads():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * 2).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((2 - x).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((x / y).numpy(), np.array([1, 2, 3]) / np.array([4, 5, 6]))
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    assert (x > 1.5).numpy().tolist() == [False, True, True]
+    # scalar type preservation
+    assert (x + 1).dtype == paddle.float32
+
+
+def test_matmul_mxu_shapes():
+    a = paddle.randn([4, 8])
+    b = paddle.randn([8, 16])
+    c = paddle.matmul(a, b)
+    assert c.shape == [4, 16]
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    d = a @ b
+    np.testing.assert_allclose(d.numpy(), c.numpy(), rtol=1e-6)
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    np.testing.assert_allclose(x[0].numpy(), np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(x[:, 1, :2].numpy(), [[4, 5], [16, 17]])
+    x[0, 0, 0] = 99.0
+    assert x.numpy()[0, 0, 0] == 99.0
+
+
+def test_item_and_bool():
+    x = paddle.to_tensor([3.5])
+    assert x.item() == pytest.approx(3.5)
+    assert bool(paddle.to_tensor([True]))
+    with pytest.raises(ValueError):
+        bool(paddle.ones([2]))
+
+
+def test_reshape_family():
+    x = paddle.arange(12, dtype="float32")
+    y = x.reshape([3, 4])
+    assert y.shape == [3, 4]
+    assert y.flatten().shape == [12]
+    assert y.transpose([1, 0]).shape == [4, 3]
+    assert y.unsqueeze(0).shape == [1, 3, 4]
+    assert y.unsqueeze(0).squeeze(0).shape == [3, 4]
+
+
+def test_concat_split_stack():
+    a, b = paddle.ones([2, 3]), paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    np.testing.assert_allclose(parts[0].numpy(), a.numpy())
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    assert x.sum().item() == 15.0
+    np.testing.assert_allclose(x.sum(axis=0).numpy(), [3, 5, 7])
+    np.testing.assert_allclose(x.mean(axis=1).numpy(), [1, 4])
+    assert x.max().item() == 5.0
+    assert paddle.argmax(x, axis=1).numpy().tolist() == [2, 2]
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    assert v.numpy().tolist() == [3.0, 2.0]
+    assert i.numpy().tolist() == [0, 2]
+    s = paddle.sort(x)
+    assert s.numpy().tolist() == [1.0, 2.0, 3.0]
+    w = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    assert w.numpy().tolist() == [3.0, 0.0, 2.0]
+
+
+def test_einsum_and_linalg():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    np.testing.assert_allclose(
+        paddle.ops.einsum("ij,jk->ik", a, b).numpy(), a.numpy() @ b.numpy(),
+        rtol=1e-5,
+    )
+    sq = paddle.ops.matmul(a, a, transpose_y=True) + 3.0 * paddle.eye(3)
+    inv = paddle.ops.inverse(sq)
+    np.testing.assert_allclose(
+        (sq @ inv).numpy(), np.eye(3), atol=1e-4
+    )
+
+
+def test_set_value_and_detach():
+    x = paddle.ones([2, 2])
+    x.set_value(np.zeros((2, 2), np.float32))
+    assert x.numpy().sum() == 0
+    y = x.detach()
+    assert y.stop_gradient
+
+
+def test_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4]).numpy()
+    paddle.seed(42)
+    b = paddle.randn([4]).numpy()
+    np.testing.assert_allclose(a, b)
