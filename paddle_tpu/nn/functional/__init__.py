@@ -1,0 +1,46 @@
+"""paddle.nn.functional parity namespace.
+
+(Reference: python/paddle/nn/functional/__init__.py.) Activations live in
+ops/activation (single tape-op implementations); structural functionals in
+the sibling modules here.
+"""
+from ...ops.activation import (  # noqa: F401
+    celu,
+    elu,
+    gelu,
+    glu,
+    gumbel_softmax,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    leaky_relu,
+    log_sigmoid,
+    log_softmax,
+    maxout,
+    mish,
+    prelu,
+    relu,
+    relu6,
+    rrelu,
+    selu,
+    sigmoid,
+    silu,
+    softmax,
+    softplus,
+    softshrink,
+    softsign,
+    swish,
+    tanhshrink,
+    thresholded_relu,
+)
+from ...ops.math import tanh  # noqa: F401
+from .attention import scaled_dot_product_attention  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+from ...ops.manipulation import squeeze, unsqueeze  # noqa: F401
+from ...ops.creation import diag_embed  # noqa: F401

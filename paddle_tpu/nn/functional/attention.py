@@ -1,0 +1,85 @@
+"""Attention functional.
+
+(Reference: the fused attention CUDA ops
+paddle/fluid/operators/fused/fused_attention_op.cu and fmha_ref.h. On TPU
+the default path is the jnp softmax formulation — XLA fuses it well — and
+when shapes warrant, the Pallas flash-attention kernel
+(ops/pallas_kernels/flash_attention.py) is used instead.)
+"""
+import math
+
+import jax.numpy as jnp
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+    query = ensure_tensor(query)
+    key = ensure_tensor(key)
+    value = ensure_tensor(value)
+    tensors = [query, key, value]
+    if attn_mask is not None:
+        tensors.append(ensure_tensor(attn_mask))
+
+    use_pallas = _pallas_eligible(query)
+    if use_pallas and attn_mask is None and dropout_p == 0.0:
+        from ...ops.pallas_kernels import flash_attention
+
+        def jfn(q, k, v):
+            return flash_attention.flash_attention_bshd(q, k, v, causal=is_causal)
+
+        return apply_jfn("flash_attention", jfn, query, key, value)
+
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ...core import rng
+
+        drop_key = rng.next_key()
+
+    def jfn(q, k, v, *rest):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        # b s h d -> b h s d
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if is_causal:
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            scores = jnp.where(causal, scores, jnp.asarray(-jnp.inf, scores.dtype))
+        if rest:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                scores = jnp.where(m, scores, jnp.asarray(-jnp.inf, scores.dtype))
+            else:
+                scores = scores + m
+        w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+        w = w / w.sum(axis=-1, keepdims=True)
+        if drop_key is not None:
+            # dropout on the attention probabilities (paddle/torch semantics)
+            import jax
+
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, w.shape)
+            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vt.dtype), vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_jfn("scaled_dot_product_attention", jfn, *tensors)
+
+
+def _pallas_eligible(q):
+    """Use the Pallas kernel only on real TPU backends with tileable shapes."""
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    shape = q.shape
+    return len(shape) == 4 and shape[1] % 128 == 0 and shape[3] in (64, 128, 256)

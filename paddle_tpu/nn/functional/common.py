@@ -1,0 +1,401 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, unfold.
+
+(Reference: python/paddle/nn/functional/common.py + input.py; kernels in
+paddle/phi/kernels/. Dropout draws a fresh PRNG subkey per eager call from
+the framework generator — under jit the train step threads keys explicitly.)
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core import rng
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "linear",
+    "dropout",
+    "dropout2d",
+    "dropout3d",
+    "alpha_dropout",
+    "embedding",
+    "one_hot",
+    "pad",
+    "interpolate",
+    "upsample",
+    "unfold",
+    "pixel_shuffle",
+    "pixel_unshuffle",
+    "channel_shuffle",
+    "label_smooth",
+    "cosine_similarity",
+    "bilinear",
+    "affine_grid",
+    "grid_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout.
+
+    The single densest op in the framework — maps straight onto the MXU.
+    """
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if bias is not None:
+        return apply_jfn(
+            "linear", lambda xv, wv, bv: jnp.matmul(xv, wv) + bv, x, weight,
+            ensure_tensor(bias)
+        )
+    return apply_jfn("linear", jnp.matmul, x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_jfn("dropout_scale", lambda xv: xv * (1 - p), x)
+        return x
+    if p == 1:
+        return apply_jfn("dropout_all", jnp.zeros_like, x)
+    key = rng.next_key()
+
+    def jfn(xv):
+        shape = xv.shape
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(s if i in axes else 1 for i, s in enumerate(xv.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), 0.0).astype(xv.dtype)
+        return jnp.where(keep, xv, 0.0).astype(xv.dtype)
+
+    return apply_jfn("dropout", jfn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def jfn(xv):
+        keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+        a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p**2))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        out = jnp.where(keep, xv, alpha_p)
+        return (a * out + b).astype(xv.dtype)
+
+    return apply_jfn("alpha_dropout", jfn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row gather; vocab-parallel variant lives in distributed mpu.
+
+    (Reference: phi/kernels/embedding_kernel; padding_idx rows get zero grad
+    — implemented by zeroing the row in fwd via where, vjp then drops it.)
+    """
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def jfn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (ids.astype(jnp.int32) != pid)[..., None]
+            out = jnp.where(mask, out, 0.0)
+        return out
+
+    return apply_jfn("embedding", jfn, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return apply_jfn(
+        "one_hot",
+        lambda ids: jax.nn.one_hot(ids.astype(jnp.int32), num_classes),
+        x,
+    )
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    from ...ops.manipulation import pad as _oppad
+    return _oppad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    nd = x.ndim - 2
+
+    def out_sizes(spatial):
+        if size is not None:
+            s = size if isinstance(size, (list, tuple)) else [size] * nd
+            return tuple(int(v) for v in s)
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        return tuple(int(np.floor(sp * f)) for sp, f in zip(spatial, sf))
+
+    def jfn(xv):
+        if channel_last:
+            xv = jnp.moveaxis(xv, -1, 1)
+        spatial = xv.shape[2:]
+        outs = out_sizes(spatial)
+        if mode == "nearest":
+            out = xv
+            for i, (in_s, out_s) in enumerate(zip(spatial, outs)):
+                idx = jnp.floor(jnp.arange(out_s) * (in_s / out_s)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=2 + i)
+        elif mode in ("bilinear", "linear", "trilinear", "bicubic"):
+            method = "cubic" if mode == "bicubic" else "linear"
+            if align_corners:
+                # jax.image has no align_corners; do coordinate gather
+                out = _resize_align_corners(xv, outs, method)
+            else:
+                out = jax.image.resize(
+                    xv, xv.shape[:2] + outs, method=method
+                ).astype(xv.dtype)
+        elif mode == "area":
+            out = xv
+            for i, (in_s, out_s) in enumerate(zip(spatial, outs)):
+                if in_s % out_s == 0:
+                    k = in_s // out_s
+                    shp = out.shape[: 2 + i] + (out_s, k) + out.shape[3 + i:]
+                    out = out.reshape(shp).mean(axis=3 + i)
+                else:
+                    out = jax.image.resize(out, out.shape[:2 + i] + (out_s,) + out.shape[3 + i:], "linear")
+        else:
+            raise ValueError(f"unsupported interpolate mode {mode}")
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_jfn("interpolate", jfn, x)
+
+
+def _resize_align_corners(xv, outs, method):
+    out = xv
+    for i, out_s in enumerate(outs):
+        ax = 2 + i
+        in_s = out.shape[ax]
+        if out_s == 1 or in_s == 1:
+            coords = jnp.zeros(out_s)
+        else:
+            coords = jnp.arange(out_s) * ((in_s - 1) / (out_s - 1))
+        lo = jnp.floor(coords).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, in_s - 1)
+        w = (coords - lo).astype(out.dtype)
+        a = jnp.take(out, lo, axis=ax)
+        b = jnp.take(out, hi, axis=ax)
+        shape = [1] * out.ndim
+        shape[ax] = out_s
+        w = w.reshape(shape)
+        out = a * (1 - w) + b * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi/kernels/funcs/im2col.h)."""
+    x = ensure_tensor(x)
+    k = (kernel_sizes,) * 2 if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    s = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
+    p = (paddings,) * 2 if isinstance(paddings, int) else tuple(paddings)
+    d = (dilations,) * 2 if isinstance(dilations, int) else tuple(dilations)
+    if len(p) == 2:
+        p = (p[0], p[1], p[0], p[1])
+
+    def jfn(xv):
+        N, C, H, W = xv.shape
+        xv = jnp.pad(xv, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        oh = (xv.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (xv.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = xv[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                        j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # N,C,k*k,oh,ow
+        return out.reshape(N, C * k[0] * k[1], oh * ow)
+
+    return apply_jfn("unfold", jfn, x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def jfn(xv):
+        if data_format == "NHWC":
+            xv = jnp.moveaxis(xv, -1, 1)
+        N, C, H, W = xv.shape
+        out = xv.reshape(N, C // (r * r), r, r, H, W)
+        out = out.transpose(0, 1, 4, 2, 5, 3).reshape(N, C // (r * r), H * r, W * r)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_jfn("pixel_shuffle", jfn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def jfn(xv):
+        if data_format == "NHWC":
+            xv = jnp.moveaxis(xv, -1, 1)
+        N, C, H, W = xv.shape
+        out = xv.reshape(N, C, H // r, r, W // r, r)
+        out = out.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * r * r, H // r, W // r)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_jfn("pixel_unshuffle", jfn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def jfn(xv):
+        if data_format == "NHWC":
+            xv = jnp.moveaxis(xv, -1, 1)
+        N, C = xv.shape[:2]
+        out = xv.reshape((N, groups, C // groups) + xv.shape[2:])
+        out = jnp.swapaxes(out, 1, 2).reshape(xv.shape)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_jfn("channel_shuffle", jfn, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        pd = ensure_tensor(prior_dist)
+        return apply_jfn(
+            "label_smooth",
+            lambda y, p: (1 - epsilon) * y + epsilon * p,
+            label, pd,
+        )
+    return apply_jfn(
+        "label_smooth",
+        lambda y: (1 - epsilon) * y + epsilon / y.shape[-1],
+        label,
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def jfn(a, b):
+        num = (a * b).sum(axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_jfn("cosine_similarity", jfn, ensure_tensor(x1),
+                     ensure_tensor(x2))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    tensors = [ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+
+    def jfn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return apply_jfn("bilinear", jfn, *tensors)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = ensure_tensor(theta)
+
+    def jfn(th):
+        N, H, W = out_shape[0], out_shape[2], out_shape[3]
+        if align_corners:
+            xs = jnp.linspace(-1, 1, W)
+            ys = jnp.linspace(-1, 1, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+
+    return apply_jfn("affine_grid", jfn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+
+    def jfn(xv, g):
+        N, C, H, W = xv.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+        if mode == "nearest":
+            ix = jnp.clip(jnp.round(fx), 0, W - 1).astype(jnp.int32)
+            iy = jnp.clip(jnp.round(fy), 0, H - 1).astype(jnp.int32)
+            out = xv[jnp.arange(N)[:, None, None], :, iy, ix]
+            return jnp.moveaxis(out, -1, 1)
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0).astype(xv.dtype)
+        wy = (fy - y0).astype(xv.dtype)
+
+        def gather(ix, iy):
+            inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0) & (iy <= H - 1))
+            ix_c = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+            iy_c = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+            v = xv[jnp.arange(N)[:, None, None], :, iy_c, ix_c]  # N,Hg,Wg,C
+            if padding_mode == "zeros":
+                v = v * inb[..., None]
+            return v
+
+        v00 = gather(x0, y0)
+        v01 = gather(x0 + 1, y0)
+        v10 = gather(x0, y0 + 1)
+        v11 = gather(x0 + 1, y0 + 1)
+        out = (
+            v00 * ((1 - wx) * (1 - wy))[..., None]
+            + v01 * (wx * (1 - wy))[..., None]
+            + v10 * ((1 - wx) * wy)[..., None]
+            + v11 * (wx * wy)[..., None]
+        )
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_jfn("grid_sample", jfn, x, grid)

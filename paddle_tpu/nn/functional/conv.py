@@ -1,0 +1,189 @@
+"""Convolution functionals.
+
+TPU-native design: all convs lower to `lax.conv_general_dilated`, which XLA
+maps onto the MXU (reference implements these as cuDNN calls in
+paddle/phi/kernels/gpu/conv_kernel.cu — here the systolic array replaces
+cuDNN and XLA picks the tiling).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv1d_transpose",
+    "conv2d_transpose",
+    "conv3d_transpose",
+]
+
+
+def _norm_tuple(v, n, name):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    if len(v) != n:
+        raise ValueError(f"{name} must have {n} elements, got {v}")
+    return v
+
+
+def _norm_padding(padding, n, channel_last=False):
+    """Paddle padding: int, list[int], list[pair], or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n and all(isinstance(p, int) for p in padding):
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        pairs = [tuple(p) for p in padding]
+        if len(pairs) == n + 2:
+            # full per-dim spec; strip batch+channel at their layout positions
+            pairs = pairs[1:-1] if channel_last else pairs[2:]
+        return pairs
+    raise ValueError(f"bad padding spec: {padding}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    pad = _norm_padding(padding, n, channel_last)
+    dn = _dim_numbers(n, channel_last)
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    # paddle weight layout is [out_c, in_c/groups, *k] == OI* — transpose for
+    # channel_last dim numbers inside the jfn so autograd sees one op.
+    def jfn(xv, wv):
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)  # OI* -> *IO
+            wv = jnp.transpose(wv, perm)
+        return lax.conv_general_dilated(
+            xv,
+            wv,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+
+    out = apply_jfn(f"conv{n}d", jfn, x, weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        shape = (1, -1) + (1,) * n if not channel_last else (1,) * (n + 1) + (-1,)
+        out = apply_jfn(
+            f"conv{n}d_bias", lambda o, b: o + b.reshape(shape), out, bias
+        )
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    opad = _norm_tuple(output_padding, n, "output_padding")
+    pad = _norm_padding(padding, n, channel_last)
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    dn = _dim_numbers(n, channel_last)
+
+    # Gradient-of-conv formulation (paddle conv_transpose == input-grad of
+    # conv): use lhs_dilation (fractional stride). Padding arithmetic:
+    # lo = k_eff-1-p_lo, hi = k_eff-1-p_hi+opad with k_eff = (k-1)*d+1.
+    def jfn(xv, wv):
+        ks = wv.shape[2:]
+        if isinstance(pad, str):
+            raise ValueError("SAME/VALID strings unsupported for conv_transpose")
+        tpad = []
+        for i in range(n):
+            k_eff = (ks[i] - 1) * dilation[i] + 1
+            lo, hi = pad[i]
+            tpad.append((k_eff - 1 - lo, k_eff - 1 - hi + opad[i]))
+        # weight layout [in_c, out_c/groups, *k]: IO* — flip spatial, swap IO
+        wv = jnp.flip(wv, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ic, ocg = wv.shape[0], wv.shape[1]
+            wv = wv.reshape((groups, ic // groups, ocg) + wv.shape[2:])
+            wv = jnp.swapaxes(wv, 1, 2)
+            wv = wv.reshape((groups * ocg, ic // groups) + wv.shape[3:])
+        else:
+            wv = jnp.swapaxes(wv, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            wv = jnp.transpose(wv, perm)
+        return lax.conv_general_dilated(
+            xv,
+            wv,
+            window_strides=(1,) * n,
+            padding=tpad,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+
+    out = apply_jfn(f"conv{n}d_transpose", jfn, x, weight)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        shape = (1, -1) + (1,) * n if not channel_last else (1,) * (n + 1) + (-1,)
+        out = apply_jfn(
+            f"conv{n}d_transpose_bias", lambda o, b: o + b.reshape(shape), out, bias
+        )
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,275 @@
+"""Loss functionals.
+
+(Reference: paddle/phi/kernels/gpu/cross_entropy_kernel.cu,
+python/paddle/nn/functional/loss.py. The softmax-CE here is the
+log-sum-exp formulation XLA fuses into one kernel; the Pallas fused
+vocab-parallel variant lives in ops/pallas_kernels.)
+"""
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "nll_loss",
+    "mse_loss",
+    "l1_loss",
+    "smooth_l1_loss",
+    "binary_cross_entropy",
+    "binary_cross_entropy_with_logits",
+    "kl_div",
+    "margin_ranking_loss",
+    "hinge_embedding_loss",
+    "cosine_embedding_loss",
+    "triplet_margin_loss",
+    "log_loss",
+    "square_error_cost",
+    "sigmoid_focal_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    tensors = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def jfn(logits, lbl, *rest):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        if soft_label:
+            loss = -(lbl * logp).sum(axis=axis)
+            if reduction == "none":
+                loss = jnp.expand_dims(loss, axis)
+            return _reduce(loss, reduction)
+        lbl_i = lbl.astype(jnp.int32)
+        squeeze_axis = axis if axis >= 0 else logp.ndim + axis
+        if lbl_i.ndim == logp.ndim:
+            lbl_i = jnp.squeeze(lbl_i, axis=squeeze_axis)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, squeeze_axis), axis=squeeze_axis
+        ).squeeze(squeeze_axis)
+        loss = jnp.where(valid, -picked, 0.0)
+        if rest:
+            w = rest[0][safe] * valid.astype(logp.dtype)
+            loss = loss * rest[0][safe]
+            if reduction == "mean":
+                return loss.sum() / jnp.maximum(w.sum(), 1e-12)
+        elif reduction == "mean":
+            denom = jnp.maximum(valid.sum(), 1)
+            return loss.sum() / denom.astype(loss.dtype)
+        return _reduce(loss, reduction)
+
+    return apply_jfn("cross_entropy", jfn, *tensors)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference returns loss with the class axis kept as size-1
+    from ...ops import manipulation as manip
+    if not soft_label:
+        loss = manip.unsqueeze(loss, axis)
+    if return_softmax:
+        from ...ops.activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    tensors = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def jfn(logp, lbl, *rest):
+        lbl_i = lbl.astype(jnp.int32)
+        valid = lbl_i != ignore_index
+        safe = jnp.where(valid, lbl_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1
+        ).squeeze(1)
+        loss = jnp.where(valid, -picked, 0.0)
+        if rest:
+            w = rest[0][safe] * valid.astype(logp.dtype)
+            loss = loss * rest[0][safe]
+            if reduction == "mean":
+                return loss.sum() / jnp.maximum(w.sum(), 1e-12)
+        elif reduction == "mean":
+            return loss.sum() / jnp.maximum(valid.sum(), 1).astype(loss.dtype)
+        return _reduce(loss, reduction)
+
+    return apply_jfn("nll_loss", jfn, *tensors)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_jfn(
+        "mse_loss",
+        lambda a, b: _reduce((a - b) ** 2, reduction),
+        ensure_tensor(input), ensure_tensor(label),
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_jfn(
+        "l1_loss",
+        lambda a, b: _reduce(jnp.abs(a - b), reduction),
+        ensure_tensor(input), ensure_tensor(label),
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def jfn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(out, reduction)
+
+    return apply_jfn("smooth_l1_loss", jfn, ensure_tensor(input),
+                     ensure_tensor(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    tensors = [ensure_tensor(input), ensure_tensor(label)] + (
+        [ensure_tensor(weight)] if weight is not None else []
+    )
+
+    def jfn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+
+    return apply_jfn("binary_cross_entropy", jfn, *tensors)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    tensors = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        tensors.append(ensure_tensor(pos_weight))
+
+    def jfn(x, y, *rest):
+        # stable: max(x,0) - x*y + log(1+exp(-|x|)), with pos_weight folding
+        i = 0
+        w = rest[i] if weight is not None else None
+        if weight is not None:
+            i += 1
+        pw = rest[i] if pos_weight is not None else None
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            out = (1 - y) * x + log_w * (
+                jnp.logaddexp(0.0, -jnp.abs(x)) + jnp.maximum(-x, 0.0)
+            )
+        else:
+            out = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+
+    return apply_jfn("bce_with_logits", jfn, *tensors)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def jfn(logp, y):
+        out = jnp.where(y > 0, y * (jnp.log(jnp.clip(y, 1e-12)) - logp), 0.0)
+        if reduction == "batchmean":
+            return out.sum() / logp.shape[0]
+        return _reduce(out, reduction)
+
+    return apply_jfn("kl_div", jfn, ensure_tensor(input), ensure_tensor(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def jfn(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return apply_jfn("margin_ranking_loss", jfn, ensure_tensor(input),
+                     ensure_tensor(other), ensure_tensor(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def jfn(x, y):
+        out = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(out, reduction)
+
+    return apply_jfn("hinge_embedding_loss", jfn, ensure_tensor(input),
+                     ensure_tensor(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def jfn(a, b, y):
+        cos = (a * b).sum(-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(out, reduction)
+
+    return apply_jfn("cosine_embedding_loss", jfn, ensure_tensor(input1),
+                     ensure_tensor(input2), ensure_tensor(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def jfn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_jfn("triplet_margin_loss", jfn, ensure_tensor(input),
+                     ensure_tensor(positive), ensure_tensor(negative))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def jfn(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply_jfn("log_loss", jfn, ensure_tensor(input), ensure_tensor(label))
+
+
+def square_error_cost(input, label):
+    return apply_jfn("square_error_cost", lambda a, b: (a - b) ** 2,
+                     ensure_tensor(input), ensure_tensor(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    tensors = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        tensors.append(ensure_tensor(normalizer))
+
+    def jfn(x, y, *rest):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            out = out / rest[0]
+        return _reduce(out, reduction)
+
+    return apply_jfn("sigmoid_focal_loss", jfn, *tensors)

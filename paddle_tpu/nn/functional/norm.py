@@ -1,0 +1,202 @@
+"""Normalization functionals.
+
+(Reference: paddle/phi/kernels/gpu/batch_norm_kernel.cu, layer_norm_kernel.cu,
+group_norm_kernel.cu — cuDNN/hand-rolled CUDA there; here pure jnp, which XLA
+fuses into neighbouring ops on TPU. Running-stat updates are host-side
+buffer assignments, matching eager semantics.)
+"""
+import jax.numpy as jnp
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "instance_norm",
+    "local_response_norm",
+    "normalize",
+]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def feat_shape(xv):
+        s = [1] * xv.ndim
+        s[-1 if channel_last else 1] = -1
+        return tuple(s)
+
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    if not use_global_stats:
+        axes_of = lambda xv: tuple(
+            i for i in range(xv.ndim) if i != (xv.ndim - 1 if channel_last else 1)
+        )
+
+        def jfn(xv, *rest):
+            axes = axes_of(xv)
+            mean = xv.mean(axis=axes)
+            var = xv.var(axis=axes)
+            fs = feat_shape(xv)
+            out = (xv - mean.reshape(fs)) / jnp.sqrt(var.reshape(fs) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].reshape(fs)
+                i += 1
+            if bias is not None:
+                out = out + rest[i].reshape(fs)
+            return out, mean, var
+
+        args = [x] + ([weight] if weight is not None else []) + (
+            [bias] if bias is not None else []
+        )
+        out, batch_mean, batch_var = apply_jfn("batch_norm", jfn, *args)
+        # eager-mode running-stat update (buffers are host state, not traced)
+        if training and running_mean is not None:
+            rm = ensure_tensor(running_mean)
+            rv = ensure_tensor(running_var)
+            rm._value = rm._value * momentum + batch_mean._value * (1 - momentum)
+            rv._value = rv._value * momentum + batch_var._value * (1 - momentum)
+        return out
+
+    rm = ensure_tensor(running_mean)
+    rv = ensure_tensor(running_var)
+
+    def jfn(xv, mv, vv, *rest):
+        fs = feat_shape(xv)
+        out = (xv - mv.reshape(fs)) / jnp.sqrt(vv.reshape(fs) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(fs)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(fs)
+        return out
+
+    args = [x, rm, rv] + ([weight] if weight is not None else []) + (
+        [bias] if bias is not None else []
+    )
+    return apply_jfn("batch_norm_infer", jfn, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+
+    def jfn(xv, *rest):
+        axes = tuple(range(xv.ndim - n, xv.ndim))
+        mean = xv.mean(axis=axes, keepdims=True)
+        var = xv.var(axis=axes, keepdims=True)
+        out = (xv - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i]
+            i += 1
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    args = [x] + ([weight] if weight is not None else []) + (
+        [bias] if bias is not None else []
+    )
+    return apply_jfn("layer_norm", jfn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format == "NHWC"
+
+    def jfn(xv, *rest):
+        if channel_last:
+            xv = jnp.moveaxis(xv, -1, 1)
+        N, C = xv.shape[0], xv.shape[1]
+        g = xv.reshape((N, num_groups, C // num_groups) + xv.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = g.mean(axis=axes, keepdims=True)
+        var = g.var(axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(xv.shape)
+        fs = (1, C) + (1,) * (xv.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(fs)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(fs)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + ([weight] if weight is not None else []) + (
+        [bias] if bias is not None else []
+    )
+    return apply_jfn("group_norm", jfn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def jfn(xv, *rest):
+        axes = tuple(range(2, xv.ndim))
+        mean = xv.mean(axis=axes, keepdims=True)
+        var = xv.var(axis=axes, keepdims=True)
+        out = (xv - mean) / jnp.sqrt(var + eps)
+        fs = (1, xv.shape[1]) + (1,) * (xv.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(fs)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(fs)
+        return out
+
+    args = [x] + ([weight] if weight is not None else []) + (
+        [bias] if bias is not None else []
+    )
+    return apply_jfn("instance_norm", jfn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def jfn(xv):
+        sq = xv * xv
+        ch_axis = 1 if data_format.startswith("NC") else xv.ndim - 1
+        C = xv.shape[ch_axis]
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * xv.ndim
+        pads[ch_axis] = (pad_lo, pad_hi)
+        sq = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(xv)
+        for i in range(size):
+            idx = [slice(None)] * xv.ndim
+            idx[ch_axis] = slice(i, i + C)
+            acc = acc + sq[tuple(idx)]
+        return xv / jnp.power(k + alpha * acc, beta)
+
+    return apply_jfn("local_response_norm", jfn, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def jfn(xv):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(xv * xv, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(xv) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return xv / jnp.maximum(n, epsilon)
+
+    return apply_jfn("normalize", jfn, x)

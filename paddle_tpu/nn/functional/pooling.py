@@ -1,0 +1,209 @@
+"""Pooling functionals via lax.reduce_window.
+
+(Reference: paddle/phi/kernels/funcs/pooling.h + gpu pool kernels; on TPU
+reduce_window is the native windowed-reduction primitive and XLA fuses the
+divide for avg pool.)
+"""
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "max_pool1d",
+    "max_pool2d",
+    "max_pool3d",
+    "avg_pool1d",
+    "avg_pool2d",
+    "avg_pool3d",
+    "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+    "adaptive_max_pool1d",
+    "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _norm(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
+          channel_last):
+    kernel = _norm(kernel, n)
+    stride = _norm(stride, n) or kernel
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm(padding, n)
+        pad = [(pi, pi) for pi in p]
+    x = ensure_tensor(x)
+
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + (pad if not isinstance(pad, str) else []) + [(0, 0)]
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else [])
+
+    if ceil_mode and not isinstance(pad, str):
+        # grow the high-side padding so the last partial window is included
+        def ceil_extra(size, k, s, lo, hi):
+            out = -(-(size + lo + hi - k) // s) + 1
+            needed = (out - 1) * s + k - (size + lo + hi)
+            return max(0, needed)
+        pads = list(pads)
+
+    if mode == "max":
+        init, op = -jnp.inf, lax.max
+
+        def jfn(xv):
+            p = pads
+            if isinstance(pad, str):
+                return _reduce_window_str(xv, init, op, dims, strides, pad)
+            if ceil_mode:
+                p = _grow_for_ceil(xv.shape, dims, strides, pads)
+            return lax.reduce_window(xv, jnp.asarray(init, xv.dtype), op,
+                                     dims, strides, p)
+
+        return apply_jfn(f"max_pool{n}d", jfn, x)
+
+    # avg
+    def jfn(xv):
+        p = pads
+        if isinstance(pad, str):
+            s = _reduce_window_str(xv, 0.0, lax.add, dims, strides, pad)
+            cnt = _reduce_window_str(jnp.ones_like(xv), 0.0, lax.add, dims,
+                                     strides, pad)
+            return s / cnt
+        if ceil_mode:
+            p = _grow_for_ceil(xv.shape, dims, strides, pads)
+        s = lax.reduce_window(xv, jnp.asarray(0.0, xv.dtype), lax.add, dims,
+                              strides, p)
+        if exclusive:
+            cnt = lax.reduce_window(jnp.ones_like(xv), jnp.asarray(0.0, xv.dtype),
+                                    lax.add, dims, strides, p)
+            return s / cnt
+        return s / float(np.prod(kernel))
+
+    return apply_jfn(f"avg_pool{n}d", jfn, x)
+
+
+def _grow_for_ceil(shape, dims, strides, pads):
+    out = []
+    for size, k, s, (lo, hi) in zip(shape, dims, strides, pads):
+        eff = size + lo + hi
+        n_out = -(-(eff - k) // s) + 1 if eff >= k else 1
+        needed = (n_out - 1) * s + k - eff
+        out.append((lo, hi + max(0, needed)))
+    return out
+
+
+def _reduce_window_str(xv, init, op, dims, strides, pad_str):
+    return lax.reduce_window(xv, jnp.asarray(init, xv.dtype), op, dims,
+                             strides, pad_str)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format in ("NLC",))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format == "NHWC")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode, True,
+                 data_format == "NDHWC")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, 1, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format in ("NLC",))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, 2, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format == "NHWC")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, 3, kernel_size, stride, padding, "avg", ceil_mode,
+                 exclusive, data_format == "NDHWC")
+
+
+def _adaptive(x, n, output_size, mode, channel_last):
+    output_size = _norm(output_size, n)
+    x = ensure_tensor(x)
+
+    def jfn(xv):
+        spatial = xv.shape[-n - 1:-1] if channel_last else xv.shape[-n:]
+        axes = (
+            tuple(range(xv.ndim - n - 1, xv.ndim - 1))
+            if channel_last
+            else tuple(range(xv.ndim - n, xv.ndim))
+        )
+        out = xv
+        # adaptive pooling with uniform bins when divisible, else gather-based
+        for ax, in_s, out_s in zip(axes, spatial, output_size):
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                new_shape = out.shape[:ax] + (out_s, k) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = r.max(axis=ax + 1) if mode == "max" else r.mean(axis=ax + 1)
+            else:
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = -(-((np.arange(out_s) + 1) * in_s) // out_s)
+                slices = []
+                for s0, e0 in zip(starts, ends):
+                    seg = lax.slice_in_dim(out, int(s0), int(e0), axis=ax)
+                    red = seg.max(axis=ax) if mode == "max" else seg.mean(axis=ax)
+                    slices.append(red)
+                out = jnp.stack(slices, axis=ax)
+        return out
+
+    return apply_jfn(f"adaptive_{mode}_pool{n}d", jfn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, 1, output_size, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, 2, output_size, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, 3, output_size, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 1, output_size, "max", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 2, output_size, "max", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, 3, output_size, "max", False)

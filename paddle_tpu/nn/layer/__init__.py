@@ -1,0 +1,13 @@
+from . import (  # noqa: F401
+    activation,
+    common,
+    container,
+    conv,
+    layers,
+    loss,
+    norm,
+    pooling,
+    rnn,
+    transformer,
+)
+from .layers import Layer  # noqa: F401

@@ -1,0 +1,453 @@
+"""Layer: the module base class.
+
+TPU-native re-design of the reference's dygraph Layer
+(reference: python/paddle/fluid/dygraph/layers.py `Layer`). Parameters are
+jax arrays held in `Parameter` tensors; there is no LayerHelper/program —
+construction allocates arrays eagerly via initializers and forward runs on
+the autograd tape. The pytree of parameters is what jitted train steps and
+pjit shardings consume (`Layer.raw_state_dict`).
+"""
+import collections
+
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ...tensor_core import Parameter, Tensor
+from .. import initializer as init_mod
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._id = HookRemoveHelper.next_id
+        HookRemoveHelper.next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    """Base class for all neural network layers (paddle.nn.Layer parity)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        if name_scope is None:
+            name_scope = _camel_to_snake(self.__class__.__name__)
+        self._full_name = _unique_name(name_scope)
+        self._dtype = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+
+    # ---- mode ----
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ---- parameter/buffer creation ----
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        """Create and register an initialized Parameter.
+
+        `attr` is a ParamAttr (or False to skip: returns None, used for
+        optional biases — mirroring reference bias_attr=False).
+        """
+        if attr is False:
+            return None
+        attr = ParamAttr._to_attr(attr)
+        dtype = dtype_mod.convert_dtype(dtype or self._dtype or "float32")
+        initializer = attr.initializer or default_initializer
+        if initializer is None:
+            initializer = (
+                init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
+            )
+        value = initializer._init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        import jax.numpy as jnp
+
+        t = Tensor(jnp.zeros([], dtype_mod.convert_dtype(dtype or "float32")), name=name)
+        t.persistable = bool(persistable)
+        return t
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names_set.discard(name)
+        else:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError(
+                f"parameter {name} must be a Parameter, got {type(parameter)}"
+            )
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError(f"sublayer {name} must be a Layer")
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    # ---- traversal ----
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(
+            prefix=prefix, include_self=True
+        ) if include_sublayers else [(prefix, self)]:
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + "." + pname if name else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(
+            prefix=prefix, include_self=True
+        ) if include_sublayers else [(prefix, self)]:
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + "." + bname if name else bname), b
+
+    def children(self):
+        return [l for _, l in self.named_children()]
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self.named_children():
+            if id(layer) in layers_set:
+                continue
+            layers_set.add(id(layer))
+            sub_prefix = prefix + "." + name if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(
+                prefix=sub_prefix, include_self=False, layers_set=layers_set
+            )
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ---- hooks ----
+    def register_forward_pre_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # ---- call ----
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        if destination is None:
+            destination = collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            destination[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            if _buffer_persistable(self, name):
+                destination[name] = b
+        return destination
+
+    def _all_entries(self):
+        """name → holder mapping for both parameters and persistable buffers."""
+        entries = {}
+        for prefix, layer in self.named_sublayers(include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is not None:
+                    entries[prefix + "." + pname if prefix else pname] = (
+                        layer,
+                        "_parameters",
+                        pname,
+                    )
+            for bname, b in layer._buffers.items():
+                if b is not None:
+                    entries[prefix + "." + bname if prefix else bname] = (
+                        layer,
+                        "_buffers",
+                        bname,
+                    )
+        return entries
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load values into existing parameters/buffers (shape-checked)."""
+        import jax.numpy as jnp
+
+        entries = self._all_entries()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in entries:
+                unexpected.append(name)
+                continue
+            layer, store, key = entries[name]
+            target = getattr(layer, store)[key]
+            arr = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+            if tuple(arr.shape) != tuple(target._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loaded {tuple(arr.shape)} vs "
+                    f"expected {tuple(target._value.shape)}"
+                )
+            target._value = jnp.asarray(arr, target._value.dtype)
+        for name in entries:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def raw_state_dict(self):
+        """Pure-pytree view: name → jax.Array. Feed this to jit/pjit."""
+        return {k: v._value for k, v in self.state_dict().items()}
+
+    def load_raw_state_dict(self, tree):
+        self.set_state_dict(tree)
+
+    # ---- dtype / device movement ----
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._transform_dtype(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._transform_dtype(dtype_mod.convert_dtype(dtype))
+        return self
+
+    def _transform_dtype(self, dtype):
+        import jax.numpy as jnp
+
+        for _, p in self.named_parameters():
+            if np.issubdtype(np.dtype(p._value.dtype), np.floating):
+                p._value = jnp.asarray(p._value, dtype)
+        for _, b in self.named_buffers():
+            if np.issubdtype(np.dtype(b._value.dtype), np.floating):
+                b._value = jnp.asarray(b._value, dtype)
+        self._dtype = dtype
+
+    def float(self):
+        return self.astype("float32")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    # ---- attribute magic ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            _strip(self, name, layers, buffers)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            _strip(self, name, params, buffers)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name]._value = value._value
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                import jax.numpy as jnp
+
+                buffers[name] = Tensor(jnp.asarray(value))
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return (
+            list(super().__dir__())
+            + list(self._parameters)
+            + list(self._sub_layers)
+            + list(self._buffers)
+        )
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+def _strip(layer, name, *stores):
+    layer.__dict__.pop(name, None)
+    for s in stores:
+        if s is not None:
+            s.pop(name, None)
+
+
+def _buffer_persistable(root, qualified_name):
+    parts = qualified_name.split(".")
+    layer = root
+    for p in parts[:-1]:
+        layer = layer._sub_layers.get(p)
+        if layer is None:
+            return True
+    return parts[-1] not in layer._non_persistable_buffer_names_set
+
+
+def _addindent(s, n):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    return lines[0] + "\n" + "\n".join(" " * n + l for l in lines[1:])
+
+
+_name_counts = collections.defaultdict(int)
+
+
+def _unique_name(base):
+    c = _name_counts[base]
+    _name_counts[base] += 1
+    return f"{base}_{c}"
+
+
+def _camel_to_snake(name):
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, init_mod.Initializer):
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"cannot convert {type(attr)} to ParamAttr")

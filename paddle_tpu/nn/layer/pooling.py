@@ -1,0 +1,149 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "MaxPool1D", "MaxPool2D", "MaxPool3D",
+    "AvgPool1D", "AvgPool2D", "AvgPool3D",
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+]
+
+
+class _Pool(Layer):
+    def __init__(self, kernel_size, stride, padding, ceil_mode, data_format,
+                 **kw):
+        super().__init__()
+        self.ksize = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+        self.kw = kw
+
+    def extra_repr(self):
+        return f"kernel_size={self.ksize}, stride={self.stride}, padding={self.padding}"
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, "NCL")
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.ksize, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.ksize, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.ksize, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, "NCL",
+                         exclusive=exclusive)
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.ksize, self.stride, self.padding,
+                            exclusive=self.kw["exclusive"],
+                            ceil_mode=self.ceil_mode)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format,
+                         exclusive=exclusive)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.ksize, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode,
+                            exclusive=self.kw["exclusive"],
+                            data_format=self.data_format)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format,
+                         exclusive=exclusive)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.ksize, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode,
+                            exclusive=self.kw["exclusive"],
+                            data_format=self.data_format)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, data_format=None):
+        super().__init__()
+        self._output_size = output_size
+        self._data_format = data_format
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self._output_size)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size, data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._output_size, self._data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._output_size, self._data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._output_size)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._output_size)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size)

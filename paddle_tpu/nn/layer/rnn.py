@@ -1,0 +1,407 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is `lax.scan` — a single compiled loop XLA
+can pipeline — rather than the reference's per-step cuDNN calls or python
+loops. The whole (layers × directions) stack runs as one tape op so
+backward is one vjp through the scans.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+from .. import initializer as I
+from .layers import Layer, ParamAttr
+
+__all__ = [
+    "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _std_uniform(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import creation
+
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                creation.full([batch] + list(s), init_value, dtype)
+                for s in shape
+            )
+        return creation.full([batch] + list(shape), init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self._act
+
+        def jfn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+
+        out, h = apply_jfn("simple_rnn_cell", jfn, ensure_tensor(inputs),
+                           ensure_tensor(states), self.weight_ih,
+                           self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+
+        def jfn(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * cv + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_h, new_c
+
+        out, new_h, new_c = apply_jfn(
+            "lstm_cell", jfn, ensure_tensor(inputs), ensure_tensor(h),
+            ensure_tensor(c), self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh)
+        return out, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def jfn(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            out = (1 - z) * n + z * h
+            return out, out
+
+        out, h = apply_jfn("gru_cell", jfn, ensure_tensor(inputs),
+                           ensure_tensor(states), self.weight_ih,
+                           self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, h
+
+
+class RNN(Layer):
+    """Scan a cell over the time axis."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as manip
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        xs = manip.unbind(inputs, axis=time_axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x in xs:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = manip.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as manip
+
+        sf, sb = (initial_states if initial_states is not None else (None, None))
+        of, stf = self.rnn_fw(inputs, sf)
+        ob, stb = self.rnn_bw(inputs, sb)
+        return manip.concat([of, ob], axis=-1), (stf, stb)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net, one lax.scan per layer."""
+
+    MODE = None
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"RNN": 1, "LSTM": 4, "GRU": 3}[mode]
+        init = _std_uniform(hidden_size)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                sfx = f"_{layer}" + ("_reverse" if d else "")
+                names = [f"weight_ih{sfx}", f"weight_hh{sfx}",
+                         f"bias_ih{sfx}", f"bias_hh{sfx}"]
+                shapes = [[gate_mult * hidden_size, in_sz],
+                          [gate_mult * hidden_size, hidden_size],
+                          [gate_mult * hidden_size],
+                          [gate_mult * hidden_size]]
+                attrs = [weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr]
+                for n, s, a in zip(names, shapes, attrs):
+                    p = self.create_parameter(s, a, is_bias="bias" in n,
+                                              default_initializer=init)
+                    self.add_parameter(n, p)
+                self._param_names.append(names)
+
+    def _step(self, mode):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        if mode == "RNN":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                nh = act(x @ wi.T + bi + h @ wh.T + bh)
+                return (nh,), nh
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                xg = x @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                nh = (1 - z) * n + z * h
+                return (nh,), nh
+        else:
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                nc = f * c + i * g
+                nh = o * jnp.tanh(nc)
+                return (nh, nc), nh
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        mode = self.mode
+        n_states = 2 if mode == "LSTM" else 1
+        nl, nd, hs = self.num_layers, self.bidirect, self.hidden_size
+        time_major = self.time_major
+        step = self._step(mode)
+        params = [self._parameters[n] for names in self._param_names
+                  for n in names]
+
+        if initial_states is not None:
+            if mode == "LSTM":
+                init_h, init_c = initial_states
+                init_list = [ensure_tensor(init_h), ensure_tensor(init_c)]
+            else:
+                init_list = [ensure_tensor(initial_states)]
+        else:
+            init_list = []
+
+        # inter-layer dropout keys (applied to every layer output except the
+        # last, paddle/torch semantics), drawn eagerly from the generator
+        drop_keys = None
+        if self.dropout > 0.0 and self.training and nl > 1:
+            from ...core import rng as _rng
+
+            drop_keys = [_rng.next_key() for _ in range(nl - 1)]
+        drop_p = self.dropout
+
+        def jfn(xv, *flat):
+            ps = flat[: len(params)]
+            inits = flat[len(params):]
+            if time_major:
+                xv = jnp.swapaxes(xv, 0, 1)  # -> batch, time, feat
+            batch = xv.shape[0]
+            if inits:
+                h0_all = inits[0]
+                c0_all = inits[1] if mode == "LSTM" else None
+            else:
+                h0_all = jnp.zeros((nl * nd, batch, hs), xv.dtype)
+                c0_all = jnp.zeros((nl * nd, batch, hs), xv.dtype) if mode == "LSTM" else None
+            layer_in = xv
+            last_h, last_c = [], []
+            idx = 0
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wi, wh, bi, bh = ps[4 * idx: 4 * idx + 4]
+                    h0 = h0_all[idx]
+                    carry = (h0, c0_all[idx]) if mode == "LSTM" else (h0,)
+                    seq = jnp.swapaxes(layer_in, 0, 1)  # time-major for scan
+                    if d == 1:
+                        seq = jnp.flip(seq, 0)
+
+                    def body(c, x, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(c, x, wi, wh, bi, bh)
+
+                    carry, ys = lax.scan(body, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(jnp.swapaxes(ys, 0, 1))
+                    last_h.append(carry[0])
+                    if mode == "LSTM":
+                        last_c.append(carry[1])
+                    idx += 1
+                layer_in = (jnp.concatenate(dir_outs, -1) if nd == 2
+                            else dir_outs[0])
+                if drop_keys is not None and layer < nl - 1:
+                    keep = jax.random.bernoulli(
+                        drop_keys[layer], 1.0 - drop_p, layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1.0 - drop_p), 0.0)
+            out = layer_in
+            if time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            hN = jnp.stack(last_h, 0)
+            if mode == "LSTM":
+                return out, hN, jnp.stack(last_c, 0)
+            return out, hN
+
+        res = apply_jfn(f"{mode.lower()}_net", jfn, inputs, *params,
+                        *init_list)
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation,
+                         weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, "tanh",
+                         weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, "tanh",
+                         weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                         bias_hh_attr)

@@ -1,0 +1,126 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+import jax.numpy as jnp
+
+from ...tensor_core import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(jnp.prod(jnp.asarray(p._value.shape))) if p._value.shape else 1
+        p._value = v[offset: offset + n].reshape(p._value.shape).astype(
+            p._value.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v||.
+
+    Implemented as a forward-pre-hook recomputing the weight each call
+    (reference: python/paddle/nn/utils/weight_norm_hook.py).
+    """
+    import numpy as np
+
+    from ...tensor_core import Parameter
+
+    w = getattr(layer, name)
+    wv = w._value
+    axes = tuple(i for i in range(wv.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(wv * wv, axis=axes, keepdims=True))
+    v = Parameter(wv, trainable=True)
+    g = Parameter(g0, trainable=True)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def _compute(lyr, inputs):
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        from ...ops._helpers import apply_jfn
+
+        def jfn(vval, gval):
+            nrm = jnp.sqrt(jnp.sum(vval * vval, axis=axes, keepdims=True))
+            return gval * vval / jnp.maximum(nrm, 1e-12)
+
+        wt = apply_jfn("weight_norm", jfn, vv, gg)
+        object.__setattr__(lyr, "_wn_weight", wt)
+        lyr._parameters.pop(name, None)
+        # stash computed weight where forward looks it up
+        lyr.__dict__[name] = wt
+        return None
+
+    h = layer.register_forward_pre_hook(_compute)
+    layer.__dict__["_weight_norm_hook"] = h
+    layer.__dict__["_weight_norm_name"] = name
+    _compute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    h = layer.__dict__.pop("_weight_norm_hook", None)
+    if h is not None:
+        h.remove()
+    from ...tensor_core import Parameter
+
+    w = layer.__dict__.pop(name, None)
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None and g is not None:
+        axes = tuple(
+            i for i in range(v._value.ndim)
+            if v._value.shape[i] != g._value.shape[i] or g._value.shape[i] == 1
+        )
+        nrm = jnp.sqrt(jnp.sum(v._value ** 2, axis=axes, keepdims=True))
+        layer.add_parameter(name, Parameter(g._value * v._value / nrm))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Power-iteration spectral normalization as a forward-pre-hook."""
+    import jax
+
+    from ...core import rng
+
+    w = getattr(layer, name)
+    wv = w._value
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    u = jax.random.normal(rng.next_key(), (mat.shape[0],))
+    u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    state = {"u": u}
+
+    def _compute(lyr, inputs):
+        wt = lyr._parameters[name]
+        # power iteration runs off-tape on current values; the normalization
+        # itself goes through the tape so grads flow into the parameter
+        m = jnp.moveaxis(wt._value, dim, 0).reshape(wt._value.shape[dim], -1)
+        u = state["u"]
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"] = u
+        from ...ops._helpers import apply_jfn
+
+        def jfn(wval):
+            mm = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
+            sigma = u @ mm @ v
+            return wval / sigma
+
+        lyr.__dict__[name] = apply_jfn("spectral_norm", jfn, wt)
+        return None
+
+    layer.register_forward_pre_hook(_compute)
+    return layer

@@ -46,6 +46,7 @@ __all__ = [
     "meshgrid",
     "diag",
     "diagflat",
+    "diag_embed",
     "assign",
     "clone",
     "numel",
@@ -324,6 +325,24 @@ def diagflat(x, offset=0, name=None):
     from ._helpers import apply_jfn
 
     return apply_jfn("diagflat", lambda a: jnp.diagflat(a, offset), x)
+
+
+@defop("diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    from ._helpers import apply_jfn
+
+    x = ensure_tensor(input)
+
+    def jfn(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+
+    return apply_jfn("diag_embed", jfn, x)
 
 
 @defop("assign")

@@ -1,0 +1,426 @@
+"""Optimizer base + the optimizer family.
+
+TPU-native re-design of the reference's optimizers
+(reference: python/paddle/optimizer/optimizer.py:91 `Optimizer`, and the phi
+sgd/adam/... kernels under paddle/phi/kernels/gpu/). Each optimizer defines
+a pure per-parameter update `_update(p, g, state, lr)` returning (new_p,
+new_state); `step()` applies it eagerly, and jitted train steps can call
+`apply_gradients_tree` — the same math over a whole pytree in one compiled
+program (how TPU runs want it: one fused update, no per-param kernel
+launches).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine
+from ..tensor_core import Parameter, Tensor
+from . import lr as lr_mod
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in eager mode (pass model.parameters())"
+            )
+        self._param_groups = []
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            for g in params:
+                group = dict(g)
+                group["params"] = list(group["params"])
+                self._param_groups.append(group)
+        else:
+            self._param_groups.append({"params": params})
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._states = {}  # param name -> dict of accumulator arrays
+        self._step_count = 0
+        # States are keyed by param name; aliased names would silently share
+        # accumulators, so de-alias defensively (Tensor.__deepcopy__ already
+        # assigns fresh names to copies).
+        seen = set()
+        for p in self._parameter_list:
+            if p.name in seen:
+                i = 1
+                while f"{p.name}.dedup{i}" in seen:
+                    i += 1
+                p.name = f"{p.name}.dedup{i}"
+            seen.add(p.name)
+
+    # ---- lr ----
+    def get_lr(self):
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when learning rate is an LRScheduler"
+            )
+        self._learning_rate = float(value)
+
+    @property
+    def _parameter_list(self):
+        return [p for g in self._param_groups for p in g["params"]]
+
+    # ---- the update protocol ----
+    def _init_state(self, p):
+        """Return the fresh accumulator dict for one parameter."""
+        return {}
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        """Pure update: (param value, grad value, state dict, lr) →
+        (new param value, new state dict). `param` is the owning Parameter
+        when called eagerly (None on the jit/pytree path)."""
+        raise NotImplementedError
+
+    def _state_for(self, p):
+        if p.name not in self._states:
+            self._states[p.name] = self._init_state(p)
+        return self._states[p.name]
+
+    def _weight_decay_coeff(self, p, group):
+        # per-parameter regularizer takes precedence over optimizer-level
+        reg = getattr(p, "regularizer", None)
+        if reg is not None and hasattr(reg, "_coeff"):
+            return float(reg._coeff)
+        wd = group.get("weight_decay", self._weight_decay)
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # L2Decay regularizer object
+            wd = wd._coeff
+        return float(wd)
+
+    def step(self):
+        self._step_count += 1
+        with engine.no_grad_guard():
+            for group in self._param_groups:
+                params_grads = [
+                    (p, p.grad) for p in group["params"] if p.grad is not None
+                    and not p.stop_gradient
+                ]
+                if self._grad_clip is not None:
+                    params_grads = self._grad_clip(params_grads)
+                lr = group.get("learning_rate", None)
+                lr = self.get_lr() if lr is None else (
+                    float(lr()) if callable(lr) else float(lr)
+                )
+                for p, g in params_grads:
+                    if g is None:
+                        continue
+                    state = self._state_for(p)
+                    plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+                    wd = self._weight_decay_coeff(p, group)
+                    if wd and not self._decoupled_wd():
+                        gv = g._value + wd * p._value
+                    else:
+                        gv = g._value
+                    new_p, new_state = self._update(
+                        p._value, gv, state, plr,
+                        wd=wd if self._decoupled_wd() else 0.0, param=p)
+                    p._value = new_p.astype(p._value.dtype)
+                    self._states[p.name] = new_state
+
+    def _decoupled_wd(self):
+        return False
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # ---- functional/jit path ----
+    def apply_gradients_tree(self, params_tree, grads_tree, states_tree, lr):
+        """Pure pytree update for use inside jitted train steps.
+
+        Returns (new_params_tree, new_states_tree). `states_tree` must come
+        from `init_states_tree`.
+        """
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = states_tree
+        new_p, new_s = [], []
+        for pv, gv, sv in zip(flat_p, flat_g, flat_s):
+            wd = 0.0 if self._weight_decay is None else (
+                self._weight_decay._coeff
+                if hasattr(self._weight_decay, "_coeff")
+                else float(self._weight_decay)
+            )
+            if wd and not self._decoupled_wd():
+                gv = gv + wd * pv
+            np_, ns_ = self._update(pv, gv, sv, lr,
+                                    wd=wd if self._decoupled_wd() else 0.0)
+            new_p.append(np_.astype(pv.dtype))
+            new_s.append(ns_)
+        return jax.tree_util.tree_unflatten(treedef, new_p), new_s
+
+    def init_states_tree(self, params_tree):
+        flat_p, _ = jax.tree_util.tree_flatten(params_tree)
+
+        class _P:  # adapter so _init_state sees ._value
+            def __init__(self, v):
+                self._value = v
+
+        return [self._init_state(_P(v)) for v in flat_p]
+
+    # ---- checkpointing ----
+    def state_dict(self):
+        out = {}
+        for pname, state in self._states.items():
+            for k, v in state.items():
+                out[f"{pname}_{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        if "@step" in state_dict:
+            self._step_count = int(state_dict["@step"])
+        if "LR_Scheduler" in state_dict and isinstance(
+            self._learning_rate, lr_mod.LRScheduler
+        ):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list:
+            state = self._states.setdefault(p.name, self._init_state(p))
+            for k in list(state):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    state[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        return pv - lr * gv, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        v = self._momentum * state["velocity"] + gv
+        if self._nesterov:
+            new_p = pv - lr * (gv + self._momentum * v)
+        else:
+            new_p = pv - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * gv
+        v = b2 * state["moment2"] + (1 - b2) * gv * gv
+        if wd:
+            pv = pv * (1.0 - lr * wd)
+        mh = m / (1 - b1p)
+        vh = v / (1 - b2p)
+        new_p = pv - lr * mh / (jnp.sqrt(vh) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return True
+
+    def _weight_decay_coeff(self, p, group):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._weight_decay_coeff(p, group)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment": jnp.zeros_like(p._value),
+            "inf_norm": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        m = b1 * state["moment"] + (1 - b1) * gv
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(gv) + eps)
+        new_p = pv - (lr / (1 - b1p)) * (m / u)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        m = state["moment"] + gv * gv
+        new_p = pv - lr * gv / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p._value),
+            "avg_squared_update": jnp.zeros_like(p._value),
+        }
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * gv * gv
+        upd = gv * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(
+            asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return pv - lr * upd, {"avg_squared_grad": asg,
+                               "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        s = {
+            "mean_square": jnp.zeros_like(p._value),
+            "momentum": jnp.zeros_like(p._value),
+        }
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p._value)
+        return s
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * gv * gv
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * gv
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * gv / denom
+        new_state = {"mean_square": ms, "momentum": mom}
+        if self._centered:
+            new_state["mean_grad"] = mg
+        return pv - mom, new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * gv
+        v = b2 * state["moment2"] + (1 - b2) * gv * gv
+        mh = m / (1 - b1p)
+        vh = v / (1 - b2p)
+        r = mh / (jnp.sqrt(vh) + eps)
+        lamb_wd = self._lamb_wd
+        if param is not None and self._exclude_fn is not None and \
+                self._exclude_fn(param):
+            lamb_wd = 0.0
+        upd = r + lamb_wd * pv
+        w_norm = jnp.linalg.norm(pv.astype(jnp.float32))
+        u_norm = jnp.linalg.norm(upd.astype(jnp.float32))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return pv - lr * ratio * upd, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p
+        }

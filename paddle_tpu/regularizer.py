@@ -1,0 +1,25 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py).
+
+In the reference these append scale/sum ops into the backward program; here
+they are coefficient carriers the optimizer folds into its update (coupled
+L2 or decoupled, per optimizer).
+"""
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty: grad += coeff * sign(param). Applied by Optimizer.step
+    when set as a param's regularizer."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 penalty: grad += coeff * param."""

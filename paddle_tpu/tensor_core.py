@@ -102,6 +102,26 @@ class Tensor:
         a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __deepcopy__(self, memo):
+        # Fresh auto-generated name: copied layers (e.g. TransformerEncoder
+        # deep-copying its prototype layer) must not alias optimizer/state
+        # keys of the original parameters.
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for slot_holder in type(self).__mro__:
+            for s in getattr(slot_holder, "__slots__", ()):
+                if s == "__weakref__" or not hasattr(self, s):
+                    continue
+                object.__setattr__(new, s, getattr(self, s))
+        new._grad_node = None
+        new._out_index = 0
+        new.grad = None
+        global _tensor_count
+        new.name = f"generated_tensor_{_tensor_count}"
+        _tensor_count += 1
+        return new
+
     # ---- autograd ----
     def backward(self, grad_tensor=None, retain_graph=False):
         """reference: paddle/fluid/eager/backward.cc:394 Backward."""

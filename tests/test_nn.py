@@ -1,0 +1,354 @@
+"""nn layer tests vs torch CPU reference (SURVEY.md §4: numpy/torch-reference
+op tests, the OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+def assert_close(a, b, tol=1e-5):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+class TestFunctionalParity:
+    def test_linear(self):
+        x = np.random.randn(4, 8).astype("float32")
+        w = np.random.randn(8, 3).astype("float32")
+        b = np.random.randn(3).astype("float32")
+        out = nn.functional.linear(
+            paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b)
+        )
+        ref = tF.linear(torch.tensor(x), torch.tensor(w.T), torch.tensor(b))
+        assert_close(out.numpy(), t2n(ref))
+
+    @pytest.mark.parametrize("stride,padding,dilation,groups", [
+        (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+    ])
+    def test_conv2d(self, stride, padding, dilation, groups):
+        x = np.random.randn(2, 4, 9, 9).astype("float32")
+        w = np.random.randn(6, 4 // groups, 3, 3).astype("float32")
+        b = np.random.randn(6).astype("float32")
+        out = nn.functional.conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+            stride=stride, padding=padding, dilation=dilation, groups=groups,
+        )
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=stride, padding=padding, dilation=dilation,
+                        groups=groups)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    @pytest.mark.parametrize("stride,padding,output_padding", [
+        (1, 0, 0), (2, 1, 0), (2, 1, 1), (3, 2, 2),
+    ])
+    def test_conv2d_transpose(self, stride, padding, output_padding):
+        x = np.random.randn(2, 4, 7, 7).astype("float32")
+        w = np.random.randn(4, 5, 3, 3).astype("float32")
+        out = nn.functional.conv2d_transpose(
+            paddle.to_tensor(x), paddle.to_tensor(w), stride=stride,
+            padding=padding, output_padding=output_padding,
+        )
+        ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=stride, padding=padding,
+                                  output_padding=output_padding)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_conv1d(self):
+        x = np.random.randn(2, 4, 12).astype("float32")
+        w = np.random.randn(6, 4, 3).astype("float32")
+        out = nn.functional.conv1d(paddle.to_tensor(x), paddle.to_tensor(w),
+                                   padding=1)
+        ref = tF.conv1d(torch.tensor(x), torch.tensor(w), padding=1)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    @pytest.mark.parametrize("ceil_mode", [False, True])
+    def test_max_pool2d(self, ceil_mode):
+        x = np.random.randn(2, 3, 9, 9).astype("float32")
+        out = nn.functional.max_pool2d(paddle.to_tensor(x), 3, 2, 1,
+                                       ceil_mode=ceil_mode)
+        ref = tF.max_pool2d(torch.tensor(x), 3, 2, 1, ceil_mode=ceil_mode)
+        assert_close(out.numpy(), t2n(ref))
+
+    def test_avg_pool2d(self):
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        out = nn.functional.avg_pool2d(paddle.to_tensor(x), 2, 2, 0)
+        ref = tF.avg_pool2d(torch.tensor(x), 2, 2, 0)
+        assert_close(out.numpy(), t2n(ref))
+
+    def test_adaptive_avg_pool2d(self):
+        x = np.random.randn(2, 3, 12, 12).astype("float32")
+        out = nn.functional.adaptive_avg_pool2d(paddle.to_tensor(x), 4)
+        ref = tF.adaptive_avg_pool2d(torch.tensor(x), 4)
+        assert_close(out.numpy(), t2n(ref))
+
+    def test_batch_norm_infer(self):
+        x = np.random.randn(4, 3, 5, 5).astype("float32")
+        rm = np.random.randn(3).astype("float32")
+        rv = np.random.rand(3).astype("float32") + 0.5
+        w = np.random.randn(3).astype("float32")
+        b = np.random.randn(3).astype("float32")
+        out = nn.functional.batch_norm(
+            paddle.to_tensor(x), paddle.to_tensor(rm), paddle.to_tensor(rv),
+            paddle.to_tensor(w), paddle.to_tensor(b), training=False,
+        )
+        ref = tF.batch_norm(torch.tensor(x), torch.tensor(rm),
+                            torch.tensor(rv), torch.tensor(w),
+                            torch.tensor(b), training=False)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.randn([4, 3, 5, 5])
+        bn.train()
+        bn(x)
+        # running mean moved away from 0
+        assert np.abs(bn._mean.numpy()).sum() > 0
+
+    def test_layer_norm(self):
+        x = np.random.randn(2, 5, 8).astype("float32")
+        w = np.random.randn(8).astype("float32")
+        b = np.random.randn(8).astype("float32")
+        out = nn.functional.layer_norm(paddle.to_tensor(x), 8,
+                                       paddle.to_tensor(w),
+                                       paddle.to_tensor(b))
+        ref = tF.layer_norm(torch.tensor(x), [8], torch.tensor(w),
+                            torch.tensor(b))
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_group_norm(self):
+        x = np.random.randn(2, 6, 4, 4).astype("float32")
+        w = np.random.randn(6).astype("float32")
+        b = np.random.randn(6).astype("float32")
+        out = nn.functional.group_norm(paddle.to_tensor(x), 3, 1e-5,
+                                       paddle.to_tensor(w),
+                                       paddle.to_tensor(b))
+        ref = tF.group_norm(torch.tensor(x), 3, torch.tensor(w),
+                            torch.tensor(b))
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(8, 10).astype("float32")
+        labels = np.random.randint(0, 10, (8,))
+        out = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                          paddle.to_tensor(labels))
+        ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+        assert_close(out.numpy(), t2n(ref), 1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(8, 10).astype("float32")
+        labels = np.random.randint(0, 10, (8,))
+        labels[:3] = -100
+        out = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                          paddle.to_tensor(labels))
+        ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+        assert_close(out.numpy(), t2n(ref), 1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(8, 10).astype("float32")
+        soft = np.random.rand(8, 10).astype("float32")
+        soft /= soft.sum(1, keepdims=True)
+        out = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                          paddle.to_tensor(soft),
+                                          soft_label=True)
+        ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(soft))
+        assert_close(out.numpy(), t2n(ref), 1e-5)
+
+    def test_bce_with_logits(self):
+        x = np.random.randn(6, 4).astype("float32")
+        y = np.random.randint(0, 2, (6, 4)).astype("float32")
+        out = nn.functional.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = tF.binary_cross_entropy_with_logits(torch.tensor(x),
+                                                  torch.tensor(y))
+        assert_close(out.numpy(), t2n(ref), 1e-5)
+
+    def test_kl_div(self):
+        x = np.log(np.random.rand(6, 4).astype("float32") + 1e-3)
+        y = np.random.rand(6, 4).astype("float32")
+        out = nn.functional.kl_div(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   reduction="batchmean")
+        ref = tF.kl_div(torch.tensor(x), torch.tensor(y),
+                        reduction="batchmean")
+        assert_close(out.numpy(), t2n(ref), 1e-5)
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype("float32")
+        ids = np.array([[1, 2], [3, 9]])
+        out = nn.functional.embedding(paddle.to_tensor(ids),
+                                      paddle.to_tensor(w))
+        assert_close(out.numpy(), w[ids])
+
+    def test_interpolate_bilinear(self):
+        x = np.random.randn(1, 2, 4, 4).astype("float32")
+        out = nn.functional.interpolate(paddle.to_tensor(x), size=[8, 8],
+                                        mode="bilinear")
+        ref = tF.interpolate(torch.tensor(x), size=[8, 8], mode="bilinear")
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_unfold(self):
+        x = np.random.randn(2, 3, 6, 6).astype("float32")
+        out = nn.functional.unfold(paddle.to_tensor(x), 3, 1, 1, 1)
+        ref = tF.unfold(torch.tensor(x), 3, 1, 1, 1)
+        assert_close(out.numpy(), t2n(ref))
+
+    def test_pixel_shuffle(self):
+        x = np.random.randn(2, 8, 3, 3).astype("float32")
+        out = nn.functional.pixel_shuffle(paddle.to_tensor(x), 2)
+        ref = tF.pixel_shuffle(torch.tensor(x), 2)
+        assert_close(out.numpy(), t2n(ref))
+
+    def test_sdpa_vs_torch(self):
+        q = np.random.randn(2, 5, 2, 4).astype("float32")
+        k = np.random.randn(2, 5, 2, 4).astype("float32")
+        v = np.random.randn(2, 5, 2, 4).astype("float32")
+        out = nn.functional.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        ref = tF.scaled_dot_product_attention(
+            torch.tensor(q).permute(0, 2, 1, 3),
+            torch.tensor(k).permute(0, 2, 1, 3),
+            torch.tensor(v).permute(0, 2, 1, 3), is_causal=True,
+        ).permute(0, 2, 1, 3)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+
+class TestLayers:
+    def test_sequential_and_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        y1 = m(x)
+        sd = {k: v.numpy() for k, v in m.state_dict().items()}
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        y2 = m2(x)
+        assert_close(y1.numpy(), y2.numpy())
+
+    def test_train_eval_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        d.eval()
+        assert_close(d(x).numpy(), np.ones(100))
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any() and (out > 1).any()
+
+    def test_lstm_gradcheck(self):
+        lstm = nn.LSTM(4, 8, 1)
+        x = paddle.randn([2, 5, 4])
+        x.stop_gradient = False
+        out, _ = lstm(x)
+        loss = out.sum()
+        loss.backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_0.grad is not None
+
+    def test_lstm_vs_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        pl = nn.LSTM(I, H, 1)
+        tl = torch.nn.LSTM(I, H, 1, batch_first=True)
+        # copy paddle weights into torch
+        tl.weight_ih_l0.data = torch.tensor(pl.weight_ih_0.numpy())
+        tl.weight_hh_l0.data = torch.tensor(pl.weight_hh_0.numpy())
+        tl.bias_ih_l0.data = torch.tensor(pl.bias_ih_0.numpy())
+        tl.bias_hh_l0.data = torch.tensor(pl.bias_hh_0.numpy())
+        x = np.random.randn(B, T, I).astype("float32")
+        out_p, (h_p, c_p) = pl(paddle.to_tensor(x))
+        out_t, (h_t, c_t) = tl(torch.tensor(x))
+        assert_close(out_p.numpy(), t2n(out_t), 1e-4)
+        assert_close(h_p.numpy(), t2n(h_t), 1e-4)
+
+    def test_gru_vs_torch(self):
+        B, T, I, H = 2, 5, 4, 6
+        pl = nn.GRU(I, H, 1)
+        tl = torch.nn.GRU(I, H, 1, batch_first=True)
+        tl.weight_ih_l0.data = torch.tensor(pl.weight_ih_0.numpy())
+        tl.weight_hh_l0.data = torch.tensor(pl.weight_hh_0.numpy())
+        tl.bias_ih_l0.data = torch.tensor(pl.bias_ih_0.numpy())
+        tl.bias_hh_l0.data = torch.tensor(pl.bias_hh_0.numpy())
+        x = np.random.randn(B, T, I).astype("float32")
+        out_p, h_p = pl(paddle.to_tensor(x))
+        out_t, h_t = tl(torch.tensor(x))
+        assert_close(out_p.numpy(), t2n(out_t), 1e-4)
+
+    def test_mha_self_attention_shapes_and_grad(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 6, 16])
+        x.stop_gradient = False
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        model.eval()
+        src = paddle.randn([2, 7, 16])
+        tgt = paddle.randn([2, 5, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 5, 16]
+
+    def test_grad_clip_global_norm(self):
+        l = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+        l(x).sum().backward()
+        clip = nn.ClipGradByGlobalNorm(0.01)
+        pg = clip([(l.weight, l.weight.grad), (l.bias, l.bias.grad)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in pg))
+        assert total <= 0.0101
+
+    def test_weight_norm(self):
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+        l = nn.Linear(4, 3)
+        w0 = l.weight.numpy() if hasattr(l, "weight") else None
+        weight_norm(l, "weight")
+        x = paddle.randn([2, 4])
+        y = l(x)
+        assert "weight_v" in dict(l.named_parameters(include_sublayers=False))
+        remove_weight_norm(l, "weight")
+        y2 = l(x)
+        assert_close(y.numpy(), y2.numpy(), 1e-4)
+
+
+class TestReviewRegressions:
+    def test_sdpa_dropout_on_probs(self):
+        # with full dropout on attention probs, output must be all zeros
+        q = paddle.randn([1, 4, 2, 8])
+        out = nn.functional.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.999999, training=True)
+        assert np.abs(out.numpy()).max() < 1e-3
+
+    def test_conv_nhwc_full_padding_spec(self):
+        x = np.random.randn(1, 5, 5, 3).astype("float32")
+        w = np.random.randn(4, 3, 3, 3).astype("float32")
+        out = nn.functional.conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            padding=[[0, 0], [1, 1], [2, 2], [0, 0]], data_format="NHWC")
+        ref = tF.conv2d(torch.tensor(x).permute(0, 3, 1, 2),
+                        torch.tensor(w), padding=[1, 2]).permute(0, 2, 3, 1)
+        assert_close(out.numpy(), t2n(ref), 1e-4)
+
+    def test_rnn_interlayer_dropout(self):
+        lstm = nn.LSTM(4, 8, num_layers=2, dropout=0.9999)
+        lstm.train()
+        x = paddle.randn([2, 5, 4])
+        out, _ = lstm(x)
+        # layer-2 input is ~all zero → output nearly constant across batch
+        o = out.numpy()
+        assert np.abs(o[0] - o[1]).max() < 1e-4
+
+    def test_spectral_norm_grad_flows(self):
+        from paddle_tpu.nn.utils import spectral_norm
+
+        l = spectral_norm(nn.Linear(4, 3))
+        x = paddle.randn([2, 4])
+        l(x).sum().backward()
+        assert l._parameters["weight"].grad is not None

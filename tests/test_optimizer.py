@@ -1,0 +1,246 @@
+"""Optimizer parity tests vs torch.optim (SURVEY.md §4 OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+
+
+def assert_close(a, b, tol=1e-5):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+def _pair_models():
+    pm = nn.Linear(6, 4)
+    tm = torch.nn.Linear(6, 4)
+    tm.weight.data = torch.tensor(pm.weight.numpy().T.copy())
+    tm.bias.data = torch.tensor(pm.bias.numpy())
+    return pm, tm
+
+
+def _run_pair(pm, tm, popt, topt, steps=5):
+    for i in range(steps):
+        x = np.random.randn(8, 6).astype("float32")
+        y = np.random.randn(8, 4).astype("float32")
+        loss_p = nn.functional.mse_loss(pm(paddle.to_tensor(x)),
+                                        paddle.to_tensor(y))
+        loss_p.backward()
+        popt.step()
+        popt.clear_grad()
+
+        topt.zero_grad()
+        loss_t = torch.nn.functional.mse_loss(tm(torch.tensor(x)),
+                                              torch.tensor(y))
+        loss_t.backward()
+        topt.step()
+    assert_close(pm.weight.numpy(), tm.weight.detach().numpy().T, 2e-4)
+    assert_close(pm.bias.numpy(), tm.bias.detach().numpy(), 2e-4)
+
+
+class TestOptimizerParity:
+    def test_sgd(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm, paddle.optimizer.SGD(0.1, parameters=pm.parameters()),
+                  torch.optim.SGD(tm.parameters(), 0.1))
+
+    def test_momentum(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm,
+                  paddle.optimizer.Momentum(0.1, 0.9,
+                                            parameters=pm.parameters()),
+                  torch.optim.SGD(tm.parameters(), 0.1, momentum=0.9))
+
+    def test_adam(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm,
+                  paddle.optimizer.Adam(0.01, parameters=pm.parameters()),
+                  torch.optim.Adam(tm.parameters(), 0.01))
+
+    def test_adamw(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm,
+                  paddle.optimizer.AdamW(0.01, parameters=pm.parameters(),
+                                         weight_decay=0.1),
+                  torch.optim.AdamW(tm.parameters(), 0.01, weight_decay=0.1))
+
+    def test_rmsprop(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm,
+                  paddle.optimizer.RMSProp(0.01, rho=0.9, epsilon=1e-8,
+                                           parameters=pm.parameters()),
+                  torch.optim.RMSprop(tm.parameters(), 0.01, alpha=0.9,
+                                      eps=1e-8),
+                  steps=3)
+
+    def test_adagrad(self):
+        pm, tm = _pair_models()
+        _run_pair(pm, tm,
+                  paddle.optimizer.Adagrad(0.05, epsilon=1e-10,
+                                           parameters=pm.parameters()),
+                  torch.optim.Adagrad(tm.parameters(), 0.05),
+                  steps=3)
+
+    def test_adamax_runs(self):
+        pm, _ = _pair_models()
+        opt = paddle.optimizer.Adamax(0.01, parameters=pm.parameters())
+        x = paddle.randn([4, 6])
+        pm(x).sum().backward()
+        w0 = pm.weight.numpy().copy()
+        opt.step()
+        assert not np.allclose(pm.weight.numpy(), w0)
+
+    def test_lamb_runs(self):
+        pm, _ = _pair_models()
+        opt = paddle.optimizer.Lamb(0.01, parameters=pm.parameters())
+        x = paddle.randn([4, 6])
+        pm(x).sum().backward()
+        w0 = pm.weight.numpy().copy()
+        opt.step()
+        assert not np.allclose(pm.weight.numpy(), w0)
+
+
+class TestOptimizerInfra:
+    def test_state_dict_roundtrip(self):
+        pm = nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(0.01, parameters=pm.parameters())
+        pm(paddle.randn([2, 4])).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(0.01, parameters=pm.parameters())
+        opt2.set_state_dict(sd)
+        k = pm.weight.name
+        assert_close(np.asarray(opt2._states[k]["moment1"]),
+                     np.asarray(opt._states[k]["moment1"]))
+
+    def test_lr_scheduler_drives_optimizer(self):
+        pm = nn.Linear(4, 4)
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.1)
+        opt = paddle.optimizer.SGD(sched, parameters=pm.parameters())
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_grad_clip_in_step(self):
+        pm = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(
+            1.0, parameters=pm.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(1e-6))
+        w0 = pm.weight.numpy().copy()
+        (pm(paddle.randn([2, 4])).sum() * 1000).backward()
+        opt.step()
+        assert np.abs(pm.weight.numpy() - w0).max() < 1e-5
+
+    def test_minimize(self):
+        pm = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=pm.parameters())
+        loss = pm(paddle.randn([2, 4])).sum()
+        w0 = pm.weight.numpy().copy()
+        opt.minimize(loss)
+        assert not np.allclose(pm.weight.numpy(), w0)
+
+    def test_apply_gradients_tree(self):
+        import jax.numpy as jnp
+
+        opt = paddle.optimizer.Adam(0.01, parameters=[])
+        params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+        grads = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+        states = opt.init_states_tree(params)
+        new_p, new_s = opt.apply_gradients_tree(params, grads, states, 0.01)
+        assert not np.allclose(np.asarray(new_p["w"]), 1.0)
+
+
+class TestLRSchedules:
+    def test_cosine(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        vals = []
+        for _ in range(10):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 1.0 and vals[-1] < 0.1
+
+    def test_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=5,
+                                             start_lr=0.0, end_lr=0.1)
+        vals = [s()]
+        for _ in range(6):
+            s.step()
+            vals.append(s())
+        assert vals[0] == 0.0 and abs(vals[5] - 0.1) < 1e-9
+
+    def test_piecewise(self):
+        s = paddle.optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        vals = []
+        for _ in range(8):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.1 and vals[4] == 0.01 and vals[7] == 0.001
+
+    def test_reduce_on_plateau(self):
+        s = paddle.optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0
+
+
+class TestReviewRegressions:
+    def test_deepcopy_params_get_unique_state(self):
+        # TransformerEncoder deep-copies its prototype layer; optimizer
+        # state must not alias across copies
+        enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(8, 2, 16), 3)
+        params = enc.parameters()
+        names = [p.name for p in params]
+        assert len(set(names)) == len(names)
+        opt = paddle.optimizer.Adam(0.01, parameters=params)
+        x = paddle.randn([2, 4, 8])
+        enc(x).sum().backward()
+        opt.step()
+        assert len(opt._states) == len(params)
+
+    def test_per_param_regularizer_without_optimizer_wd(self):
+        from paddle_tpu.regularizer import L2Decay
+
+        l = nn.Linear(
+            4, 4, weight_attr=nn.ParamAttr(regularizer=L2Decay(0.5)),
+            bias_attr=nn.ParamAttr(regularizer=L2Decay(0.0)))
+        opt = paddle.optimizer.SGD(0.1, parameters=l.parameters())
+        w0 = l.weight.numpy().copy()
+        # zero grad → update comes only from the regularizer term
+        import jax.numpy as jnp
+        from paddle_tpu.tensor_core import Tensor
+        l.weight.grad = Tensor(jnp.zeros_like(l.weight._value))
+        l.bias.grad = Tensor(jnp.zeros_like(l.bias._value))
+        opt.step()
+        np.testing.assert_allclose(l.weight.numpy(), w0 * (1 - 0.1 * 0.5),
+                                   rtol=1e-5)
+
+    def test_lamb_exclude_fn(self):
+        l = nn.Linear(4, 4)
+        opt = paddle.optimizer.Lamb(
+            0.01, lamb_weight_decay=0.5, parameters=l.parameters(),
+            exclude_from_weight_decay_fn=lambda p: True)
+        import jax.numpy as jnp
+        from paddle_tpu.tensor_core import Tensor
+        l.weight.grad = Tensor(jnp.zeros_like(l.weight._value))
+        l.bias.grad = Tensor(jnp.zeros_like(l.bias._value))
+        w0 = l.weight.numpy().copy()
+        opt.step()
+        # wd excluded and grad zero → no movement
+        np.testing.assert_allclose(l.weight.numpy(), w0, atol=1e-7)
+
+    def test_adamw_group_lr_with_decay_fn(self):
+        a = nn.Linear(4, 4)
+        b = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(
+            0.01, parameters=[
+                {"params": a.parameters(), "learning_rate": 0.0},
+                {"params": b.parameters()},
+            ], apply_decay_param_fun=lambda n: False)
+        (a(paddle.randn([2, 4])).sum() + b(paddle.randn([2, 4])).sum()).backward()
+        wa = a.weight.numpy().copy()
+        wb = b.weight.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(a.weight.numpy(), wa, atol=1e-7)
+        assert not np.allclose(b.weight.numpy(), wb)
