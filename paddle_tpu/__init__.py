@@ -40,6 +40,7 @@ from .core.place import (  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core.rng import get_rng_state_tracker, seed  # noqa: F401
 from .tensor_core import Parameter, Tensor  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 from . import ops  # installs Tensor methods; must precede api re-export
 from .ops import *  # noqa: F401,F403
@@ -80,6 +81,8 @@ for _sub in (
 
 if "framework" in globals() and hasattr(globals()["framework"], "io_state"):
     from .framework.io_state import load, save  # noqa: F401
+if "nn" in globals():
+    ParamAttr = globals()["nn"].ParamAttr
 if "hapi" in globals() and hasattr(globals()["hapi"], "model"):
     from .hapi.model import Model  # noqa: F401
 

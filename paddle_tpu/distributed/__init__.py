@@ -1,0 +1,8 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Collective API, fleet facade, topology, and meta-parallel wrappers over
+jax.sharding / shard_map. Built out module-by-module; env is the rank
+contract.
+"""
+from . import env  # noqa: F401
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

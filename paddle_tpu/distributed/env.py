@@ -1,0 +1,70 @@
+"""Rank/world-size environment contract.
+
+(Reference env vars: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS — python/paddle/distributed/parallel.py:94.)
+On TPU pods jax.distributed supplies process_index/process_count once
+initialized; before that, the launcher env contract applies.
+"""
+import os
+
+__all__ = ["get_rank", "get_world_size", "ParallelEnv"]
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_count()
+    except Exception:
+        pass
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+class ParallelEnv:
+    """(reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", str(get_rank())))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return get_world_size()

@@ -1,0 +1,5 @@
+"""paddle.framework parity surface (reference: python/paddle/framework/)."""
+from . import io_state  # noqa: F401
+from . import random  # noqa: F401
+from .io_state import load, save  # noqa: F401
+from .random import get_cuda_rng_state, seed, set_cuda_rng_state  # noqa: F401

@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:574,791).
+
+Pickle-based nested state_dict I/O, with Tensors converted to numpy on save
+and rehydrated as Tensors on load. (The sharded/async distributed checkpoint
+path lives in paddle_tpu.distributed.checkpoint — this is the single-process
+object I/O the reference exposes as paddle.save.)
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor_core import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+class _TensorPayload:
+    """Pickle-stable tensor wrapper recording trainable-ness."""
+
+    def __init__(self, array, trainable=None, name=None):
+        self.array = array
+        self.trainable = trainable
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return _TensorPayload(np.asarray(obj._value), obj.trainable, obj.name)
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), None, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.trainable is not None:
+            return Parameter(obj.array, trainable=obj.trainable, name=obj.name)
+        return Tensor(obj.array, name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

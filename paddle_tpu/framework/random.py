@@ -1,0 +1,17 @@
+"""Seed control (reference: python/paddle/framework/random.py)."""
+from ..core import rng
+
+__all__ = ["seed", "get_cuda_rng_state", "set_cuda_rng_state"]
+
+
+def seed(s):
+    return rng.seed(s)
+
+
+def get_cuda_rng_state():
+    return [rng.default_generator().get_state()]
+
+
+def set_cuda_rng_state(states):
+    if states:
+        rng.default_generator().set_state(states[0])

@@ -1,0 +1,442 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+TPU-native re-design of the reference's dataloader stack
+(reference: python/paddle/fluid/dataloader/dataloader_iter.py:148 single-proc
+and :342 multi-proc over shared-mem mmap + worker processes). On TPU the
+bottleneck is keeping the host→HBM feed ahead of the step, so the design is:
+numpy batches assembled by a background worker pool (threads — collate is
+numpy/C so the GIL releases), plus a prefetch queue depth (`prefetch_factor`)
+that double-buffers ahead of consumption. Worker processes are unnecessary:
+there is no CUDA-context fork problem on TPU hosts.
+"""
+import itertools
+import math
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
+    "ComposeDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "default_collate_fn", "get_worker_info",
+]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (list, tuple)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths must equal dataset length")
+    perm = _np_rng(generator).permutation(len(dataset))
+    out, offset = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[offset: offset + n].tolist()))
+        offset += n
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+def _np_rng(generator):
+    """numpy RNG honoring a framework Generator (core.rng) if given,
+    else the framework's global seed stream so paddle.seed controls
+    shuffling."""
+    if generator is not None and hasattr(generator, "next_key"):
+        seed = int(np.asarray(generator.next_key())[-1]) & 0x7FFFFFFF
+        return np.random.RandomState(seed)
+    from ..core import rng as core_rng
+
+    seed = int(np.asarray(core_rng.next_key())[-1]) & 0x7FFFFFFF
+    return np.random.RandomState(seed)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = _np_rng(self.generator)
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sharded batch sampler (reference:
+    python/paddle/fluid/dataloader/batch_sampler.py DistributedBatchSampler).
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from ..distributed import env as dist_env
+
+            num_replicas = num_replicas or dist_env.get_world_size()
+            rank = rank if rank is not None else dist_env.get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        # pad (repeating as often as needed) to make evenly divisible —
+        # every rank must see the same number of batches or lockstep SPMD
+        # collectives deadlock
+        while len(indices) < self.total_size:
+            indices += indices[: self.total_size - len(indices)]
+        indices = indices[self.local_rank: self.total_size: self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays → Tensors (reference:
+    python/paddle/fluid/dataloader/collate.py default_collate_fn)."""
+    from ..tensor_core import Tensor
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([s._value for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.floating, np.integer)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn(list(col)) for col in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+class _WorkerInfo:
+    def __init__(self, id_, num_workers, dataset):
+        self.id = id_
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class _IterState:
+    """Worker-shared state. Holds NO reference to the consumer iterator so
+    the iterator can be garbage-collected while workers run; a weakref
+    finalizer flips `stop` when the consumer goes away."""
+
+    __slots__ = ("queue", "work_q", "stop", "done_lock", "done_workers",
+                 "n_workers", "dataset", "collate")
+
+
+_SENTINEL = object()
+
+
+def _prefetch_feed(state, index_iter):
+    seq = 0
+    for idx_batch in index_iter:
+        if state.stop.is_set():
+            break
+        state.work_q.put((seq, idx_batch))
+        seq += 1
+    for _ in range(state.n_workers):
+        state.work_q.put(None)
+
+
+def _put_stoppable(state, item):
+    """Bounded put that bails out if the consumer abandoned us."""
+    while not state.stop.is_set():
+        try:
+            state.queue.put(item, timeout=0.1)
+            return True
+        except queue_mod.Full:
+            continue
+    return False
+
+
+def _prefetch_work(state, wid):
+    _worker_info.info = _WorkerInfo(wid, state.n_workers, state.dataset)
+    while not state.stop.is_set():
+        item = state.work_q.get()
+        if item is None:
+            break
+        seq, idx_batch = item
+        try:
+            samples = [state.dataset[i] for i in idx_batch]
+            out = (seq, state.collate(samples), None)
+        except Exception as e:  # propagate to consumer
+            out = (seq, None, e)
+        if not _put_stoppable(state, out):
+            break
+    with state.done_lock:
+        state.done_workers += 1
+        if state.done_workers == state.n_workers:
+            _put_stoppable(state, _SENTINEL)
+
+
+class _PrefetchIter:
+    """Background-thread batch assembly with a bounded queue; the single
+    consumer reorders out-of-order worker results."""
+
+    def __init__(self, loader, index_iter):
+        import weakref
+
+        state = _IterState()
+        state.n_workers = max(1, loader.num_workers)
+        depth = max(2, loader.prefetch_factor * state.n_workers)
+        state.queue = queue_mod.Queue(maxsize=depth)
+        state.work_q = queue_mod.Queue()
+        state.stop = threading.Event()
+        state.done_lock = threading.Lock()
+        state.done_workers = 0
+        state.dataset = loader.dataset
+        state.collate = loader.collate_fn
+        self._state = state
+        self._reorder = {}
+        self._next_emit = 0
+        self._sentinel_seen = False
+        # when the consumer is dropped, stop the pool (threads only
+        # reference `state`, never `self`)
+        self._finalizer = weakref.finalize(self, state.stop.set)
+        threading.Thread(target=_prefetch_feed, args=(state, index_iter),
+                         daemon=True).start()
+        for i in range(state.n_workers):
+            threading.Thread(target=_prefetch_work, args=(state, i),
+                             daemon=True).start()
+
+    def __next__(self):
+        while True:
+            if self._next_emit in self._reorder:
+                _, batch, err = self._reorder.pop(self._next_emit)
+                self._next_emit += 1
+                if err is not None:
+                    self._state.stop.set()
+                    raise err
+                return batch
+            if self._sentinel_seen and not self._reorder:
+                raise StopIteration
+            item = self._state.queue.get()
+            if item is _SENTINEL:
+                self._sentinel_seen = True
+                continue
+            self._reorder[item[0]] = item
+
+    def __iter__(self):
+        return self
+
+class DataLoader:
+    """(reference: python/paddle/io/__init__.py DataLoader →
+    fluid/reader.py:326)."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no fixed length")
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_sync()
+        return _PrefetchIter(self, iter(self.batch_sampler))
+
+    def _iter_sync(self):
+        for idx_batch in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in idx_batch])

@@ -1,0 +1,3 @@
+"""paddle.vision parity (reference: python/paddle/vision/)."""
+from . import datasets, models, transforms  # noqa: F401
+from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,216 @@
+"""DataLoader/metric/save-load tests (SURVEY.md §2.11-2.12 io, metric)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import io, metric, nn
+
+
+class _SquaresDataset(io.Dataset):
+    def __init__(self, n=37):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = io.DataLoader(_SquaresDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+        np.testing.assert_allclose(batches[2][0].numpy().ravel(), [8, 9])
+
+    def test_drop_last(self):
+        dl = io.DataLoader(_SquaresDataset(10), batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+        assert len(dl) == 2
+
+    def test_shuffle_covers_all(self):
+        dl = io.DataLoader(_SquaresDataset(16), batch_size=4, shuffle=True)
+        seen = np.sort(np.concatenate([b[0].numpy().ravel() for b in dl]))
+        np.testing.assert_allclose(seen, np.arange(16))
+
+    def test_workers_preserve_order(self):
+        dl = io.DataLoader(_SquaresDataset(33), batch_size=4, num_workers=3)
+        flat = np.concatenate([b[0].numpy().ravel() for b in dl])
+        np.testing.assert_allclose(flat, np.arange(33))
+
+    def test_worker_exception_propagates(self):
+        class Bad(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.float32([i])
+
+        dl = io.DataLoader(Bad(), batch_size=2, num_workers=2)
+        try:
+            list(dl)
+            raised = False
+        except ValueError:
+            raised = True
+        assert raised
+
+    def test_iterable_dataset(self):
+        class Stream(io.IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32([i])
+
+        dl = io.DataLoader(Stream(), batch_size=3)
+        batches = list(dl)
+        assert [b.shape[0] for b in batches] == [3, 3, 1]
+
+    def test_distributed_batch_sampler_partitions(self):
+        ds = _SquaresDataset(20)
+        all_idx = []
+        for rank in range(4):
+            bs = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                            rank=rank)
+            for batch in bs:
+                all_idx.extend(batch)
+        assert sorted(set(all_idx)) == list(range(20))
+
+    def test_dict_collate(self):
+        class D(io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.float32([i]), "y": i}
+
+        b = next(iter(io.DataLoader(D(), batch_size=4)))
+        assert b["x"].shape == [4, 1]
+        assert b["y"].shape == [4]
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        lab = paddle.to_tensor(np.array([[1], [1]]))
+        correct = m.compute(pred, lab)
+        m.update(correct)
+        assert abs(m.accumulate() - 0.5) < 1e-6
+
+    def test_accuracy_topk(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(
+            np.array([[0.1, 0.5, 0.4], [0.2, 0.3, 0.5]], "float32"))
+        lab = paddle.to_tensor(np.array([[2], [1]]))
+        m.update(m.compute(pred, lab))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.0) < 1e-6 and abs(top2 - 1.0) < 1e-6
+
+    def test_precision_recall(self):
+        p = metric.Precision()
+        r = metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        m = metric.Auc()
+        preds = np.stack([1 - np.linspace(0, 1, 100),
+                          np.linspace(0, 1, 100)], 1)
+        labels = (np.linspace(0, 1, 100) > 0.5).astype("int64")
+        m.update(preds, labels)
+        assert m.accumulate() > 0.99
+
+    def test_functional_accuracy(self):
+        acc = metric.accuracy(
+            paddle.to_tensor(np.array([[0.1, 0.9], [0.9, 0.1]], "float32")),
+            paddle.to_tensor(np.array([1, 0])))
+        assert abs(float(acc.numpy()) - 1.0) < 1e-6
+
+
+class TestSaveLoad:
+    def test_layer_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 3)
+        path = str(tmp_path / "linear.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(loaded)
+        np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+    def test_optimizer_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 3)
+        opt = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+        m(paddle.randn([2, 4])).sum().backward()
+        opt.step()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), path)
+        sd = paddle.load(path)
+        opt2 = paddle.optimizer.Adam(0.01, parameters=m.parameters())
+        opt2.set_state_dict(sd)
+        k = m.weight.name
+        np.testing.assert_allclose(np.asarray(opt2._states[k]["moment1"]),
+                                   np.asarray(opt._states[k]["moment1"]))
+
+    def test_nested_object(self, tmp_path):
+        obj = {"a": [paddle.to_tensor(np.eye(3, dtype="float32")), 5],
+               "b": "text"}
+        path = str(tmp_path / "obj.pdz")
+        paddle.save(obj, path)
+        back = paddle.load(path)
+        np.testing.assert_allclose(back["a"][0].numpy(), np.eye(3))
+        assert back["a"][1] == 5 and back["b"] == "text"
+
+
+class TestReviewRegressions:
+    def test_prefetch_small_dataset_no_hang(self):
+        dl = io.DataLoader(_SquaresDataset(2), batch_size=4, num_workers=4)
+        assert len(list(dl)) == 1
+
+    def test_prefetch_abandoned_iterator_threads_exit(self):
+        import threading
+        import time
+
+        before = threading.active_count()
+        dl = io.DataLoader(_SquaresDataset(100), batch_size=1, num_workers=2,
+                           prefetch_factor=1)
+        it = iter(dl)
+        next(it)
+        del it
+        time.sleep(0.5)
+        assert threading.active_count() <= before + 1
+
+    def test_distributed_sampler_tiny_dataset_equal_batches(self):
+        ds = _SquaresDataset(1)
+        counts = []
+        for rank in range(4):
+            bs = io.DistributedBatchSampler(ds, batch_size=1, num_replicas=4,
+                                            rank=rank)
+            counts.append(len(list(bs)))
+        assert counts == [1, 1, 1, 1]
+
+    def test_seeded_shuffle_reproducible(self):
+        paddle.seed(123)
+        o1 = [b[0].numpy().ravel().tolist() for b in
+              io.DataLoader(_SquaresDataset(16), batch_size=4, shuffle=True)]
+        paddle.seed(123)
+        o2 = [b[0].numpy().ravel().tolist() for b in
+              io.DataLoader(_SquaresDataset(16), batch_size=4, shuffle=True)]
+        assert o1 == o2
+
+    def test_random_crop_with_padding(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((32, 32, 3), dtype="uint8")
+        out = T.RandomCrop(32, padding=4)(img)
+        assert out.shape == (32, 32, 3)
+        out2 = T.RandomCrop(40, pad_if_needed=True)(img)
+        assert out2.shape == (40, 40, 3)
