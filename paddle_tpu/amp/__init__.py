@@ -1,0 +1,215 @@
+"""Automatic mixed precision.
+
+TPU-native re-design of the reference AMP
+(reference: python/paddle/amp/auto_cast.py:21, grad_scaler.py:26, op lists in
+python/paddle/fluid/dygraph/amp/auto_cast.py, CUDA loss-scale ops in
+paddle/fluid/operators/amp/). Differences by design:
+- default low dtype is bfloat16 — the MXU-native type; fp16+loss-scaling is
+  kept for parity but bf16 needs no scaler.
+- the cast interposition lives in one place: the autograd tape's `apply`
+  consults `amp.state()` per op name (the reference generates per-op AMP
+  glue into every eager function).
+"""
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list", "state"]
+
+# ops that are numerically safe & fast in low precision (matmul/conv ride
+# the MXU)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "bmm", "mm", "mv",
+    "scaled_dot_product_attention", "flash_attention", "einsum",
+}
+# numerically sensitive ops forced to fp32
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "nll_loss", "binary_cross_entropy", "bce_with_logits",
+    "kl_div", "mean", "sum", "norm", "batch_norm", "batch_norm_infer",
+    "layer_norm", "group_norm", "instance_norm", "softmax_with_cross_entropy",
+    "sigmoid_focal_loss", "cosine_similarity", "pow", "square", "sqrt",
+    "rsqrt", "cumsum", "cumprod", "var", "std", "renorm", "dist", "erfinv",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def state():
+    return _state
+
+
+def white_list():
+    return (WHITE_LIST | _state.custom_white) - _state.custom_black
+
+
+def black_list():
+    return (BLACK_LIST | _state.custom_black) - _state.custom_white
+
+
+def cast_inputs_for(op_name, vals):
+    """Called from the tape: maybe cast op inputs per the AMP policy."""
+    if not _state.enabled:
+        return vals
+    low = _state.dtype
+
+    def is_float(v):
+        return jnp.issubdtype(v.dtype, jnp.floating)
+
+    if _state.level == "O2":
+        # pure low precision except the black list
+        if op_name in black_list():
+            return tuple(
+                v.astype(jnp.float32) if is_float(v) else v for v in vals
+            )
+        return tuple(v.astype(low) if is_float(v) else v for v in vals)
+    # O1: cast only white-list ops down; black list up; others follow inputs
+    if op_name in white_list():
+        return tuple(v.astype(low) if is_float(v) else v for v in vals)
+    if op_name in black_list():
+        return tuple(
+            v.astype(jnp.float32) if is_float(v) else v for v in vals
+        )
+    return vals
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    old = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+           _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = old
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the low dtype
+    (reference: paddle.amp.decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.astype(str(dtype))
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:26,
+    kernels check_finite_and_unscale + update_loss_scaling)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._value.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad._value = g.astype(p.grad._value.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled):
+        scaled.backward()
+        self.step(optimizer)
+        self.update()
+
+    def get_loss_scaling(self):
+        from ..tensor_core import Tensor
+
+        return Tensor(np.float32(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

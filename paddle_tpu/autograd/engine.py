@@ -17,7 +17,20 @@ import threading
 from collections import defaultdict, deque
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+_amp_mod = None
+
+
+def _amp():
+    """amp module accessor (lazy once: amp imports tensor machinery)."""
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _amp_mod_
+
+        _amp_mod = _amp_mod_
+    return _amp_mod
 
 __all__ = [
     "apply",
@@ -109,6 +122,10 @@ def apply(name, jfn, tensors, n_outputs=None):
     float0 cotangents fed to vjp).
     """
     vals = tuple(t._value for t in tensors)
+    # AMP interposition: one central cast point for every op (the reference
+    # generates per-op AMP glue; see paddle_tpu/amp/__init__.py)
+    if _amp()._state.enabled:
+        vals = _amp().cast_inputs_for(name, vals)
     need = _state.grad_enabled and any(not t.stop_gradient for t in tensors)
     if not need:
         out = jfn(*vals)
@@ -123,7 +140,7 @@ def apply(name, jfn, tensors, n_outputs=None):
     node = GradNode(name, vjp_fn, jfn, tuple(tensors), out_meta)
     result = []
     for i, o in enumerate(outs_t):
-        nondiff = not np.issubdtype(np.dtype(o.dtype), np.inexact)
+        nondiff = not jnp.issubdtype(o.dtype, jnp.inexact)
         t = wrap(o, stop_gradient=nondiff)
         if not nondiff:
             t._grad_node = node
@@ -211,7 +228,7 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
     # Seed root grads.
     for t, g in zip(roots, root_grads):
         if g is None:
-            if not np.issubdtype(np.dtype(t._value.dtype), np.inexact):
+            if not jnp.issubdtype(t._value.dtype, jnp.inexact):
                 raise ValueError("backward() root must be floating point")
             g = wrap(_ones_like_meta(t._value.shape, t._value.dtype), True)
         n = t._grad_node

@@ -145,6 +145,11 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        if self._num_samples is not None and self._num_samples > n and \
+                not self.replacement:
+            raise ValueError(
+                f"num_samples={self._num_samples} > dataset size {n} "
+                "requires replacement=True")
         rng = _np_rng(self.generator)
         if self.replacement:
             return iter(rng.randint(0, n, self.num_samples).tolist())
@@ -155,15 +160,18 @@ class RandomSampler(Sampler):
 
 
 class WeightedRandomSampler(Sampler):
-    def __init__(self, weights, num_samples, replacement=True):
+    def __init__(self, weights, num_samples, replacement=True,
+                 generator=None):
         self.weights = np.asarray(weights, dtype=np.float64)
         self.num_samples = num_samples
         self.replacement = replacement
+        self.generator = generator
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
-                               replace=self.replacement, p=p)
+        idx = _np_rng(self.generator).choice(
+            len(self.weights), self.num_samples,
+            replace=self.replacement, p=p)
         return iter(idx.tolist())
 
     def __len__(self):
@@ -299,13 +307,21 @@ _SENTINEL = object()
 
 def _prefetch_feed(state, index_iter):
     seq = 0
-    for idx_batch in index_iter:
-        if state.stop.is_set():
-            break
-        state.work_q.put((seq, idx_batch))
-        seq += 1
-    for _ in range(state.n_workers):
-        state.work_q.put(None)
+    err = None
+    try:
+        for idx_batch in index_iter:
+            if state.stop.is_set():
+                break
+            state.work_q.put((seq, idx_batch))
+            seq += 1
+    except Exception as e:  # sampler bug: forward it, don't hang the consumer
+        err = e
+    finally:
+        if err is not None:
+            _put_stoppable(state, (seq, None, err))
+            seq += 1
+        for _ in range(state.n_workers):
+            state.work_q.put(None)
 
 
 def _put_stoppable(state, item):

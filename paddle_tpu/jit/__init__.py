@@ -1,0 +1,405 @@
+"""paddle_tpu.jit — graph capture via jax tracing.
+
+TPU-native replacement for the reference's ENTIRE dy2static subsystem
+(reference: python/paddle/fluid/dygraph/jit.py:164 `declarative`,
+dygraph_to_static/program_translator.py:239 `StaticFunction`, the 30-file
+AST-transformer suite, and partial_program.py:121 `PartialProgramLayer`).
+Design: no AST rewriting — the python function runs once under a jax trace
+per input signature; the traced whole program becomes ONE tape op, so eager
+autograd sees a single fused node whose vjp is the XLA-compiled backward.
+This is both the API-parity layer (`@to_static`) and the performance layer
+(whole-graph XLA compilation replaces per-op dispatch).
+"""
+import functools
+import inspect
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..tensor_core import Parameter, Tensor
+
+__all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
+           "InputSpec", "TrainStep", "ignore_module", "enable_to_static"]
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+class InputSpec:
+    """(reference: python/paddle/static/input_spec.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(
+            -1 if s is None else int(s) for s in shape
+        )
+        from ..core import dtype as dtype_mod
+
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+def _sig_of(value):
+    if isinstance(value, Tensor):
+        return ("T", tuple(value._value.shape), str(value._value.dtype),
+                bool(value.stop_gradient))
+    if isinstance(value, (list, tuple)):
+        return (type(value).__name__,) + tuple(_sig_of(v) for v in value)
+    if isinstance(value, dict):
+        return ("dict",) + tuple(
+            (k, _sig_of(v)) for k, v in sorted(value.items())
+        )
+    return ("py", value if isinstance(value, (int, float, str, bool,
+                                              type(None))) else id(value))
+
+
+def _tree_tensors(obj, out):
+    """Collect Tensors in (args, kwargs) pytree, preserving structure via a
+    rebuild closure."""
+    if isinstance(obj, Tensor):
+        idx = len(out)
+        out.append(obj)
+        return ("tensor", idx)
+    if isinstance(obj, (list, tuple)):
+        spec = [_tree_tensors(v, out) for v in obj]
+        return (type(obj).__name__, spec)
+    if isinstance(obj, dict):
+        return ("dict", {k: _tree_tensors(v, out) for k, v in obj.items()})
+    return ("leaf", obj)
+
+
+def _tree_rebuild(spec, values):
+    kind = spec[0]
+    if kind == "tensor":
+        return values[spec[1]]
+    if kind in ("list", "tuple"):
+        seq = [_tree_rebuild(s, values) for s in spec[1]]
+        return seq if kind == "list" else tuple(seq)
+    if kind == "dict":
+        return {k: _tree_rebuild(s, values) for k, s in spec[1].items()}
+    return spec[1]
+
+
+class StaticFunction:
+    """Traced-function cache, one compiled program per input signature
+    (≈ ConcreteProgram cache keyed by FunctionSpec in the reference)."""
+
+    def __init__(self, fn, input_spec=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache = {}
+        self._last_concrete = None
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def _params_of(self, bound_self):
+        if bound_self is None:
+            return [], []
+        names, params = [], []
+        for n, p in bound_self.named_parameters():
+            names.append(n)
+            params.append(p)
+        for n, b in bound_self.named_buffers():
+            names.append("buffer:" + n)
+            params.append(b)
+        return names, params
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)
+        bound_self = None
+        if args and hasattr(args[0], "named_parameters"):
+            bound_self, args = args[0], args[1:]
+
+        arg_tensors = []
+        spec = _tree_tensors((args, kwargs), arg_tensors)
+        _, params = self._params_of(bound_self)
+        key = (_sig_of((args, kwargs)), id(bound_self),
+               engine.is_grad_enabled())
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._trace(bound_self, spec, arg_tensors, params)
+            self._cache[key] = entry
+        jfn, out_spec_holder = entry
+        all_inputs = list(arg_tensors) + list(params)
+        flat_out = engine.apply(
+            f"to_static:{self._fn.__name__}", jfn, tuple(all_inputs)
+        )
+        if not isinstance(flat_out, tuple):
+            flat_out = (flat_out,)
+        return _tree_rebuild(out_spec_holder[0], list(flat_out))
+
+    def _trace(self, bound_self, spec, arg_tensors, params):
+        n_args = len(arg_tensors)
+        fn = self._fn
+        out_spec_holder = [None]
+        sg_flags = [t.stop_gradient for t in arg_tensors] + [
+            p.stop_gradient for p in params
+        ]
+        param_objs = params
+
+        def jfn(*flat_vals):
+            arg_vals = flat_vals[:n_args]
+            param_vals = flat_vals[n_args:]
+            wrapped = [
+                Tensor(v, stop_gradient=sg)
+                for v, sg in zip(arg_vals, sg_flags[:n_args])
+            ]
+            args, kwargs = _tree_rebuild(spec, wrapped)
+            # temporarily swap live param values for traced ones
+            originals = [p._value for p in param_objs]
+            for p, v in zip(param_objs, param_vals):
+                p._value = v
+            try:
+                if bound_self is not None:
+                    out = fn(bound_self, *args, **kwargs)
+                else:
+                    out = fn(*args, **kwargs)
+            finally:
+                for p, v in zip(param_objs, originals):
+                    p._value = v
+            out_tensors = []
+            out_spec = _tree_tensors(out, out_tensors)
+            out_spec_holder[0] = out_spec
+            vals = tuple(t._value for t in out_tensors)
+            return vals if len(vals) != 1 else vals[0]
+
+        return jfn, out_spec_holder
+
+    @property
+    def concrete_program(self):
+        return self._last_concrete
+
+    def get_traced(self, *example_args, **example_kwargs):
+        """Return (pure_jax_fn, flat_example_vals) for export/bench."""
+        arg_tensors = []
+        spec = _tree_tensors((example_args, example_kwargs), arg_tensors)
+        bound_self = None
+        jfn, _ = self._trace(bound_self, spec, arg_tensors, [])
+        return jfn, [t._value for t in arg_tensors]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator (reference API: paddle.jit.to_static)."""
+
+    def deco(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        from ..nn import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(type(layer).forward, input_spec)
+            layer.forward = functools.partial(sf.__call__, layer)
+            layer._static_function = sf
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+# ---------------------------------------------------------------- save/load
+def _resolve_forward(layer, input_spec):
+    """Build a pure jax fn(params_dict, *inputs) from a Layer."""
+    names = []
+    params = []
+    for n, p in layer.state_dict().items():
+        names.append(n)
+        params.append(p)
+
+    def pure_fn(param_vals, *input_vals):
+        originals = [p._value for p in params]
+        for p, v in zip(params, param_vals):
+            p._value = v
+        try:
+            with engine.no_grad_guard():
+                ins = [Tensor(v) for v in input_vals]
+                out = layer.forward(*ins)
+        finally:
+            for p, v in zip(params, originals):
+                p._value = v
+        if isinstance(out, (list, tuple)):
+            return tuple(t._value for t in out)
+        return out._value
+
+    return pure_fn, names, [p._value for p in params]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer's forward as a portable StableHLO artifact +
+    params (reference: paddle.jit.save → .pdmodel/.pdiparams; here
+    .stablehlo via jax.export + .pdiparams via paddle.save).
+    """
+    import os
+
+    from ..framework.io_state import save as tensor_save
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for jit.save")
+    was_training = layer.training
+    layer.eval()
+    try:
+        pure_fn, names, param_vals = _resolve_forward(layer, input_spec)
+        shaped = [
+            jax.ShapeDtypeStruct(
+                tuple(1 if s in (-1, None) else s for s in sp.shape), sp.dtype
+            )
+            for sp in input_spec
+        ]
+        param_shaped = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        for v in param_vals]
+        exported = jax.export.export(jax.jit(pure_fn))(param_shaped, *shaped)
+        blob = exported.serialize()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(blob)
+        tensor_save({"names": names,
+                     "params": [np.asarray(v) for v in param_vals]},
+                    path + ".pdiparams")
+    finally:
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer:
+    """Inference-only loaded program (reference: paddle.jit.load →
+    TranslatedLayer, C++ twin paddle/fluid/jit/layer.cc)."""
+
+    def __init__(self, exported, names, param_vals):
+        self._exported = exported
+        self._names = names
+        self._param_vals = param_vals
+        self.training = False
+
+    def __call__(self, *inputs):
+        vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        out = self._exported.call(self._param_vals, *vals)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o) for o in out]
+            return outs if len(outs) > 1 else outs[0]
+        return Tensor(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return {n: Tensor(v) for n, v in zip(self._names, self._param_vals)}
+
+
+def load(path, **configs):
+    from ..framework.io_state import load as tensor_load
+
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    bundle = tensor_load(path + ".pdiparams", return_numpy=True)
+    param_vals = [jnp.asarray(v) for v in bundle["params"]]
+    return TranslatedLayer(exported, bundle["names"], param_vals)
+
+
+# ------------------------------------------------------------- train step
+class TrainStep:
+    """Whole-step compilation: loss + backward + optimizer update as ONE
+    XLA program over the parameter pytree. This is the idiomatic TPU
+    training path (replaces the reference's per-op executor hot loop,
+    SURVEY.md §3.3) and what bench.py runs.
+
+    loss_fn(model, *batch_tensors) -> scalar loss Tensor.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate_params=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._names = list(model.state_dict().keys())
+        self._param_objs = [model.state_dict()[n] for n in self._names]
+        self._trainable = [not p.stop_gradient for p in self._param_objs]
+        self._opt_states = None
+        self._compiled = None
+
+    def _build(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        param_objs = self._param_objs
+        trainable = self._trainable
+        opt = self.optimizer
+
+        def pure_loss(train_vals, frozen_vals, batch_vals):
+            originals = [p._value for p in param_objs]
+            it_t = iter(train_vals)
+            it_f = iter(frozen_vals)
+            for p, tr in zip(param_objs, trainable):
+                p._value = next(it_t) if tr else next(it_f)
+            try:
+                batch = [Tensor(v, stop_gradient=True) for v in batch_vals]
+                loss = loss_fn(model, *batch)
+            finally:
+                for p, v in zip(param_objs, originals):
+                    p._value = v
+            return loss._value
+
+        def step(train_vals, frozen_vals, opt_states, lr, batch_vals):
+            loss, grads = jax.value_and_grad(pure_loss)(
+                train_vals, frozen_vals, batch_vals)
+            new_vals, new_states = opt.apply_gradients_tree(
+                train_vals, grads, opt_states, lr)
+            return loss, new_vals, new_states
+
+        # donate param + optimizer-state buffers so XLA updates in place
+        # (no HBM copy per step)
+        self._compiled = jax.jit(step, donate_argnums=(0, 2))
+
+    def __call__(self, *batch):
+        if self._compiled is None:
+            self._build()
+        train_vals = [p._value for p, t in zip(self._param_objs,
+                                               self._trainable) if t]
+        frozen_vals = [p._value for p, t in zip(self._param_objs,
+                                                self._trainable) if not t]
+        if self._opt_states is None:
+            self._opt_states = self.optimizer.init_states_tree(train_vals)
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        lr = self.optimizer.get_lr()
+        loss, new_vals, self._opt_states = self._compiled(
+            train_vals, frozen_vals, self._opt_states, lr, batch_vals)
+        it = iter(new_vals)
+        for p, t in zip(self._param_objs, self._trainable):
+            if t:
+                p._value = next(it)
+        self.optimizer._step_count += 1
+        return Tensor(loss)

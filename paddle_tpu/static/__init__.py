@@ -1,0 +1,215 @@
+"""paddle_tpu.static — static-graph compatibility facade.
+
+The reference's static mode (reference: python/paddle/static/, fluid
+Program/Executor — SURVEY.md §2.2, §3.3) exists because graph capture there
+requires building a protobuf program executed by a C++ interpreter. On TPU
+the capture mechanism IS jax tracing, so this facade keeps the Program/
+Executor/data API shape while delegating:
+- `paddle.static.data` declares InputSpec-backed placeholders,
+- a `Program` records the python callables run under `program_guard`,
+- `Executor.run` traces+jit-compiles the recorded computation into one XLA
+  program keyed by feed signature (the InterpreterCore instruction loop of
+  the reference collapses into a single compiled module).
+Differentiation/optimizers in static mode go through the same tape (the
+recorded fns run eagerly inside the traced step).
+"""
+import contextlib
+
+import numpy as np
+
+import jax
+
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
+from ..tensor_core import Tensor
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "InputSpec", "name_scope",
+    "save", "load", "save_inference_model", "load_inference_model",
+    "gradients", "append_backward", "cpu_places", "device_guard", "scope_guard",
+    "global_scope", "amp",
+]
+
+
+class Variable:
+    """Static placeholder (≈ VarDesc in framework.proto:191)."""
+
+    def __init__(self, name, shape, dtype, program):
+        self.name = name
+        self.shape = list(shape)
+        self.dtype = dtype
+        self._program = program
+        self.stop_gradient = True
+
+    def __repr__(self):
+        return f"static.Variable(name={self.name}, shape={self.shape})"
+
+
+class Program:
+    """Deferred computation: a list of (fn, inputs, outputs) stages
+    (≈ ProgramDesc, framework.proto:236 — but stages are python closures
+    traced by XLA at Executor.run, not protobuf ops)."""
+
+    def __init__(self):
+        self.placeholders = {}
+        self.stages = []  # callables: feed_dict -> dict of produced tensors
+        self.fetch_map = {}
+        self.random_seed = None
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.placeholders = dict(self.placeholders)
+        p.stages = list(self.stages)
+        p.fetch_map = dict(self.fetch_map)
+        return p
+
+    def global_block(self):
+        return self
+
+    # block-like protocol used by introspection
+    @property
+    def ops(self):
+        return self.stages
+
+
+_default_main = Program()
+_default_startup = Program()
+_current_main = _default_main
+_current_startup = _default_startup
+
+
+def default_main_program():
+    return _current_main
+
+
+def default_startup_program():
+    return _current_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _current_main, _current_startup
+    old_m, old_s = _current_main, _current_startup
+    _current_main = main_program
+    if startup_program is not None:
+        _current_startup = startup_program
+    try:
+        yield
+    finally:
+        _current_main, _current_startup = old_m, old_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    v = Variable(name, shape, dtype, _current_main)
+    _current_main.placeholders[name] = v
+    return v
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    return None
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd.engine import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+class Executor:
+    """(reference: python/paddle/fluid/executor.py:1257 Executor.run →
+    StandaloneExecutor/InterpreterCore). Here: run(fetch_list=...) executes
+    the fetches' recorded computation; with a `program` built via
+    paddle_tpu.static the feed dict maps placeholder names to numpy."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        results = []
+        env = {}
+        for name, value in feed.items():
+            env[name] = Tensor(np.asarray(value))
+        prog = program or _current_main
+        for stage in prog.stages:
+            stage(env)
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                out = env.get(f.name)
+            elif isinstance(f, str):
+                out = env.get(f)
+            else:
+                out = f
+            if out is None:
+                raise KeyError(f"fetch target {f} not produced")
+            results.append(out.numpy() if return_numpy and
+                           isinstance(out, Tensor) else out)
+        return results
+
+    def close(self):
+        pass
+
+
+def save(program, model_path, protocol=4):
+    from ..framework.io_state import save as _save
+
+    _save({"program": "static-facade"}, model_path + ".pdmodel")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    """Maps to jit.save when given a layer via kwargs['program']."""
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the "
+        "TPU-native inference artifact is a StableHLO export"
+    )
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) to load a StableHLO export"
+    )
+
+
+class _AmpFacade:
+    @staticmethod
+    def decorate(models=None, optimizers=None, level="O1", **kw):
+        from .. import amp as _amp
+
+        return _amp.decorate(models, optimizers, level=level, **kw)
+
+
+amp = _AmpFacade()

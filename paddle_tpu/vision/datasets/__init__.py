@@ -40,6 +40,10 @@ class MNIST(Dataset):
         self.mode = mode
         self.transform = transform
         if image_path and os.path.exists(image_path):
+            if not (label_path and os.path.exists(label_path)):
+                raise ValueError(
+                    "label_path must point to an existing IDX label file "
+                    "when image_path is given")
             self.images, self.labels = self._load_idx(image_path, label_path)
         else:
             n = 6000 if mode == "train" else 1000
@@ -56,6 +60,7 @@ class MNIST(Dataset):
             _, n, rows, cols = struct.unpack(">IIII", f.read(16))
             images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
                 n, rows, cols)
+        opener = gzip.open if label_path.endswith(".gz") else open
         with opener(label_path, "rb") as f:
             struct.unpack(">II", f.read(8))
             labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")

@@ -174,6 +174,9 @@ class CenterCrop(BaseTransform):
         img = _as_hwc(img)
         h, w = img.shape[:2]
         th, tw = self.size
+        if h < th or w < tw:
+            raise ValueError(
+                f"image ({h},{w}) smaller than CenterCrop size {self.size}")
         i = (h - th) // 2
         j = (w - tw) // 2
         return img[i: i + th, j: j + tw]

@@ -1,0 +1,224 @@
+"""to_static / jit.save / TrainStep / AMP tests (SURVEY.md §5.8, §5.9;
+dy2static equivalence pattern of §4.5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_function_parity(self):
+        @jit.to_static
+        def f(x, y):
+            return paddle.matmul(x, y) + 1.0
+
+        x = paddle.randn([3, 4])
+        y = paddle.randn([4, 5])
+        eager = paddle.matmul(x, y) + 1.0
+        static = f(x, y)
+        np.testing.assert_allclose(static.numpy(), eager.numpy(), rtol=1e-5)
+
+    def test_layer_parity_and_cache(self):
+        net = SmallNet()
+        x = paddle.randn([2, 8])
+        eager = net(x)
+        snet = jit.to_static(net)
+        out1 = snet(x)
+        out2 = snet(x)  # cached trace
+        np.testing.assert_allclose(out1.numpy(), eager.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out2.numpy(), eager.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        assert len(net._static_function._cache) == 1
+        # new shape → new trace entry
+        snet(paddle.randn([5, 8]))
+        assert len(net._static_function._cache) == 2
+
+    def test_grad_through_to_static(self):
+        net = SmallNet()
+        snet = jit.to_static(net)
+        x = paddle.randn([4, 8])
+        loss = snet(x).sum()
+        loss.backward()
+        assert net.fc1.weight.grad is not None
+        # compare to eager grads
+        g_static = net.fc1.weight.grad.numpy().copy()
+        net.fc1.weight.grad = None
+        jit.enable_to_static(False)
+        try:
+            net(x).sum().backward()
+        finally:
+            jit.enable_to_static(True)
+        np.testing.assert_allclose(g_static, net.fc1.weight.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_control_flow_via_lax(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops._helpers import apply_jfn
+
+        @jit.to_static
+        def f(x):
+            # data-dependent branch expressed with where (compiler-friendly)
+            return paddle.where(x > 0, x * 2.0, x - 1.0)
+
+        x = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [-2.0, 4.0])
+
+
+class TestJitSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        path = str(tmp_path / "model")
+        jit.save(net, path, input_spec=[jit.InputSpec([1, 8], "float32")])
+        loaded = jit.load(path)
+        x = paddle.randn([1, 8])
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestTrainStep:
+    def test_compiled_step_matches_eager(self):
+        paddle.seed(0)
+        net_a = SmallNet()
+        net_b = SmallNet()
+        net_b.set_state_dict({k: v.numpy() for k, v in
+                              net_a.state_dict().items()})
+        opt_a = paddle.optimizer.SGD(0.1, parameters=net_a.parameters())
+        opt_b = paddle.optimizer.SGD(0.1, parameters=net_b.parameters())
+
+        def loss_fn(model, x, y):
+            return nn.functional.mse_loss(model(x), y)
+
+        step = jit.TrainStep(net_a, loss_fn, opt_a)
+        x = paddle.randn([4, 8])
+        y = paddle.randn([4, 4])
+        for _ in range(3):
+            l_jit = step(x, y)
+            l_eager = loss_fn(net_b, x, y)
+            l_eager.backward()
+            opt_b.step()
+            opt_b.clear_grad()
+        np.testing.assert_allclose(l_jit.numpy(), l_eager.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(net_a.fc1.weight.numpy(),
+                                   net_b.fc1.weight.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_adam_train_step_reduces_loss(self):
+        net = SmallNet()
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+
+        def loss_fn(model, x, y):
+            return nn.functional.mse_loss(model(x), y)
+
+        step = jit.TrainStep(net, loss_fn, opt)
+        x = paddle.randn([16, 8])
+        y = paddle.randn([16, 4])
+        losses = [float(step(x, y).numpy()) for _ in range(60)]
+        assert losses[-1] < 0.1 * losses[0]
+
+
+class TestAmp:
+    def test_autocast_casts_matmul(self):
+        x = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            y = paddle.matmul(x, x)
+        assert str(y.dtype) == "bfloat16"
+        # black-list op stays fp32
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            z = paddle.exp(x)
+        assert str(z.dtype) == "float32"
+
+    def test_autocast_off_restores(self):
+        x = paddle.randn([4, 4])
+        y = paddle.matmul(x, x)
+        assert str(y.dtype) == "float32"
+
+    def test_grad_scaler_scales_and_skips_inf(self):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.randn([2, 4])
+        loss = net(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        w0 = net.weight.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        assert not np.allclose(net.weight.numpy(), w0)
+        # now poison grads with inf: step must be skipped + scale halved x2
+        opt.clear_grad()
+        loss = (net(x) * np.inf).sum()
+        scaler.scale(loss).backward()
+        w1 = net.weight.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(net.weight.numpy(), w1)
+        assert scaler._scale < 128.0
+
+    def test_o2_decorate(self):
+        net = SmallNet()
+        paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+        assert str(net.fc1.weight.dtype) == "bfloat16"
+
+
+class TestStaticFacade:
+    def test_program_executor(self):
+        import paddle_tpu.static as static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+
+            def stage(env):
+                env["y"] = paddle.matmul(env["x"], env["x"].t()) if hasattr(
+                    env["x"], "t") else env["x"]
+
+            main.stages.append(stage)
+        exe = static.Executor()
+        out = exe.run(main, feed={"x": np.eye(4, dtype="float32")},
+                      fetch_list=["y"])
+        np.testing.assert_allclose(out[0], np.eye(4))
+
+
+class TestAmpBackward:
+    def test_amp_training_gets_grads(self):
+        # regression: bfloat16 outputs must stay differentiable
+        net = SmallNet()
+        x = paddle.randn([4, 8])
+        with paddle.amp.auto_cast():
+            y = net(x)
+            loss = y.astype("float32").sum()
+        loss.backward()
+        assert net.fc1.weight.grad is not None
+        assert str(y.dtype) == "bfloat16"
+
+    def test_amp_bf16_root_backward(self):
+        x = paddle.randn([3, 3])
+        x.stop_gradient = False
+        with paddle.amp.auto_cast():
+            out = paddle.matmul(x, x)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_blacklist_upcasts_bf16_input(self):
+        x = paddle.randn([3, 3])
+        with paddle.amp.auto_cast(level="O2"):
+            y = paddle.matmul(x, x)   # bf16
+            z = paddle.exp(y)         # black list: must run fp32
+        assert str(z.dtype) == "float32"
