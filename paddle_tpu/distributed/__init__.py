@@ -1,8 +1,49 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/).
 
-Collective API, fleet facade, topology, and meta-parallel wrappers over
-jax.sharding / shard_map. Built out module-by-module; env is the rank
-contract.
+Collectives = XLA programs over one jax.sharding.Mesh; fleet topology
+names mesh axes; parallelism = placement (see SURVEY.md §7 design map).
 """
+from . import collective  # noqa: F401
 from . import env  # noqa: F401
+from . import mesh  # noqa: F401
+from . import moe  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from . import sharding  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_group,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+    p2p_shift,
+    reduce,
+    reduce_scatter,
+    scatter,
+    spmd,
+    stream,
+    wait,
+)
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .mesh import init_mesh, global_mesh  # noqa: F401
+from .parallel_step import DistributedTrainStep  # noqa: F401
+from .sequence_parallel import ring_attention, ulysses_attention  # noqa: F401
+
+from . import fleet  # noqa: F401
+
+
+def DataParallel(layers, **kwargs):
+    """(reference: python/paddle/fluid/dygraph/parallel.py:437.) Under
+    GSPMD, gradient sync is compiled into the step when the batch is
+    dp-sharded — the wrapper is the identity, kept for API parity."""
+    return layers
+
+
+from .fleet.recompute import recompute, recompute_sequential  # noqa: F401

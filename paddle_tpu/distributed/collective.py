@@ -1,0 +1,413 @@
+"""Collective communication API.
+
+TPU-native re-design of the reference collective layer
+(reference: python/paddle/distributed/collective.py — all_reduce:751,
+broadcast:668, all_gather:956, alltoall:1236, send:1434/recv:1500; C++
+ProcessGroup.h:53; collective ops paddle/fluid/operators/collective/).
+
+Design: a collective is an XLA program primitive, not a runtime call.
+`Group` names a mesh axis (or tuple of axes). Inside an SPMD region
+(shard_map, entered via this module's `spmd()` or the parallel wrappers)
+each call lowers to lax.psum / all_gather / ppermute / all_to_all on the
+group's axis name and rides ICI. Outside SPMD, world_size==1 collectives
+are identity (matching single-rank reference behavior), so the same model
+code runs serial and parallel — parity-test requirement SURVEY.md §4(c).
+"""
+import functools
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops._helpers import apply_jfn, ensure_tensor
+from ..tensor_core import Tensor
+from . import env as env_mod
+from . import mesh as mesh_mod
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "init_parallel_env",
+    "is_initialized", "all_reduce", "all_gather", "all_gather_object",
+    "broadcast", "reduce", "scatter", "alltoall", "alltoall_single",
+    "send", "recv", "isend", "irecv", "barrier", "reduce_scatter",
+    "split_group_axes", "spmd", "get_rank", "get_world_size", "wait",
+    "stream",
+]
+
+get_rank = env_mod.get_rank
+get_world_size = env_mod.get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _SpmdState(threading.local):
+    def __init__(self):
+        self.active = False
+        self.axes = ()  # axis names bound inside current shard_map
+
+
+_spmd = _SpmdState()
+
+
+class Group:
+    """A communicator = one or more mesh axes
+    (reference Group: collective.py:60 — ranks+ring id; here: axis names)."""
+
+    _count = 0
+
+    def __init__(self, axes, ranks=None, gid=None):
+        if isinstance(axes, str):
+            axes = (axes,)
+        self.axes = tuple(axes)
+        self.ranks = ranks
+        Group._count += 1
+        self.id = gid if gid is not None else Group._count
+
+    @property
+    def nranks(self):
+        return self._static_size()
+
+    def _static_size(self):
+        return int(np.prod([mesh_mod.axis_size(a) for a in self.axes]))
+
+    @property
+    def rank(self):
+        if _spmd.active:
+            # in-SPMD: per-device rank along the group axes
+            idx = 0
+            for a in self.axes:
+                idx = idx * mesh_mod.axis_size(a) + lax.axis_index(a)
+            return idx
+        return 0
+
+    @property
+    def world_size(self):
+        return self._static_size()
+
+    @property
+    def name(self):
+        return "_".join(self.axes)
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self._static_size()})"
+
+
+_groups = {}
+_default_group = None
+_initialized = False
+
+
+def init_parallel_env(dp=None, mp=1, pp=1, sharding=1, sp=1, ep=1):
+    """Bring-up (reference: python/paddle/distributed/parallel.py:94
+    init_parallel_env — TCPStore + ProcessGroupNCCL; here: jax.distributed
+    for multi-host + global mesh construction).
+
+    With no arguments: all visible devices become the dp axis.
+    """
+    global _default_group, _initialized
+    n = len(jax.devices())
+    if dp is None:
+        dp = n // (mp * pp * sharding * sp * ep)
+    mesh_mod.init_mesh(dp=dp, mp=mp, pp=pp, sharding=sharding, sp=sp, ep=ep)
+    _default_group = Group(("dp",), gid=0)
+    _initialized = True
+    return _default_group
+
+
+def is_initialized():
+    return _initialized
+
+
+def _ensure_default():
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(("dp",), gid=0)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None):
+    """(reference collective.py:396). TPU-native: a group IS a mesh-axis
+    selection; `axes` names them. `ranks` is kept for API compat and
+    attached for bookkeeping."""
+    g = Group(axes if axes is not None else ("dp",), ranks=ranks)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _ensure_default()
+    return _groups.get(gid)
+
+
+def split_group_axes(group):
+    return (group or _ensure_default()).axes
+
+
+# --------------------------------------------------------------- spmd entry
+def spmd(fn, in_specs, out_specs, group_axes=None, check_rep=False):
+    """Run `fn` as an SPMD program over the global mesh via shard_map.
+
+    Inside `fn`, the collective API lowers to axis collectives. This is the
+    TPU-native equivalent of launching N worker processes (reference test
+    harness: unittests/test_collective_base.py spawns 2 GPU procs)."""
+    mesh = mesh_mod.global_mesh()
+    axes = group_axes or mesh_mod.mesh_axes()
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        def inner(*vals):
+            _spmd.active = True
+            _spmd.axes = tuple(axes)
+            try:
+                return fn(*vals)
+            finally:
+                _spmd.active = False
+                _spmd.axes = ()
+
+        sm = shard_map(inner, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check_rep)
+        return sm(*args)
+
+    return wrapper
+
+
+def _in_spmd():
+    return _spmd.active
+
+
+def _axes_of(group):
+    g = group or _ensure_default()
+    return g.axes if len(g.axes) > 1 else g.axes[0]
+
+
+# --------------------------------------------------------------- collectives
+def _reduce_val(v, op, axes):
+    if op in (ReduceOp.SUM, "sum"):
+        return lax.psum(v, axes)
+    if op in (ReduceOp.MAX, "max"):
+        return lax.pmax(v, axes)
+    if op in (ReduceOp.MIN, "min"):
+        return lax.pmin(v, axes)
+    if op in (ReduceOp.AVG, "avg"):
+        return lax.pmean(v, axes)
+    if op in (ReduceOp.PROD, "prod"):
+        return lax.pprod(v, axes) if hasattr(lax, "pprod") else jnp.exp(
+            lax.psum(jnp.log(v), axes))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce across the group axis (identity when the axis has
+    size 1 — the serial case)."""
+    t = ensure_tensor(tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            return tensor
+        raise RuntimeError(
+            "eager all_reduce across a >1-size axis must run inside an SPMD "
+            "region (paddle_tpu.distributed.spmd / parallelized train step)"
+        )
+    axes = _axes_of(group)
+    out = apply_jfn("c_allreduce", lambda v: _reduce_val(v, op, axes), t)
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce-to-root; on TPU the SPMD form is allreduce + mask (the root
+    distinction is meaningless inside one compiled program)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    t = ensure_tensor(tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            if isinstance(tensor_list, list):
+                tensor_list.append(t)
+                return tensor_list
+            return t
+        raise RuntimeError("all_gather outside SPMD requires world size 1")
+    axes = _axes_of(group)
+    out = apply_jfn(
+        "c_allgather",
+        lambda v: lax.all_gather(v, axes, axis=axis, tiled=True),
+        t,
+    )
+    if isinstance(tensor_list, list):
+        n = (group or _ensure_default())._static_size()
+        from ..ops.manipulation import split as t_split
+
+        tensor_list.extend(t_split(out, n, axis=axis))
+        return tensor_list
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    # host-side object gather is single-process in SPMD design
+    object_list.append(obj)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast from src rank along the group axis. In-graph: select src's
+    shard via ppermute-free formulation (all devices already execute the
+    same program; broadcast is a gather of src's value)."""
+    t = ensure_tensor(tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            return tensor
+        raise RuntimeError("broadcast across >1 ranks requires SPMD region")
+    axes = _axes_of(group)
+
+    def jfn(v):
+        # take the value living on rank `src` of the axis
+        gathered = lax.all_gather(v, axes, axis=0)
+        return gathered[src]
+
+    out = apply_jfn("c_broadcast", jfn, t)
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    t = ensure_tensor(tensor_list if isinstance(tensor_list, Tensor)
+                      else tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            return tensor
+        raise RuntimeError("scatter across >1 ranks requires SPMD region")
+    axes = _axes_of(group)
+
+    def jfn(full):
+        n = mesh_mod.axis_size(axes if isinstance(axes, str) else axes[0])
+        idx = lax.axis_index(axes)
+        chunk = full.shape[0] // n
+        return lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)
+
+    return apply_jfn("c_scatter", jfn, t)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """All-to-all (reference alltoall:1236 / MoE global_scatter). In-graph:
+    lax.all_to_all splitting axis 0."""
+    t = ensure_tensor(in_tensor_list)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            return in_tensor_list
+        raise RuntimeError("alltoall across >1 ranks requires SPMD region")
+    axes = _axes_of(group)
+    out = apply_jfn(
+        "c_alltoall",
+        lambda v: lax.all_to_all(v, axes, split_axis=0, concat_axis=0,
+                                 tiled=True),
+        t,
+    )
+    return out
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    return alltoall(in_tensor, group=group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    t = ensure_tensor(tensor_list if isinstance(tensor_list, Tensor)
+                      else tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        if g._static_size() == 1:
+            return tensor
+        raise RuntimeError("reduce_scatter across >1 ranks requires SPMD")
+    axes = _axes_of(group)
+    out = apply_jfn(
+        "c_reducescatter",
+        lambda v: lax.psum_scatter(v, axes, scatter_dimension=0, tiled=True),
+        t,
+    )
+    return out
+
+
+def _shift(v, axes, offset):
+    n = mesh_mod.axis_size(axes if isinstance(axes, str) else axes[0])
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(v, axes, perm)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send. In the SPMD design p2p is a ppermute ring shift; use
+    p2p_shift for the pipeline pattern (reference send_v2/recv_v2 ops)."""
+    raise RuntimeError(
+        "point-to-point send/recv are expressed as p2p_shift inside SPMD "
+        "programs on TPU; see paddle_tpu.distributed.p2p_shift"
+    )
+
+
+recv = send
+isend = send
+irecv = send
+
+
+def p2p_shift(tensor, group=None, offset=1):
+    """Ring-shift along the group axis (the building block of 1F1B pipeline
+    and ring attention; replaces reference p2p_communication.py)."""
+    t = ensure_tensor(tensor)
+    if not _in_spmd():
+        return tensor
+    axes = _axes_of(group)
+    return apply_jfn("p2p_shift", lambda v: _shift(v, axes, offset), t)
+
+
+def barrier(group=None):
+    if not _in_spmd():
+        # host-level: all devices synchronized by dispatch order already
+        return
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return tensor
+
+
+class _StreamFacade:
+    """paddle.distributed.communication.stream parity (async variants are
+    identical under XLA: the compiler schedules collectives)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+
+
+stream = _StreamFacade()

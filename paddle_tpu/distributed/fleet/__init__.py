@@ -1,0 +1,141 @@
+"""fleet — the unified distributed facade.
+
+(reference: python/paddle/distributed/fleet/fleet.py:101 `Fleet`,
+base/distributed_strategy.py:110 `DistributedStrategy` over protobuf
+distributed_strategy.proto:303.) The meta-optimizer pass stack of the
+reference (sharding/recompute/amp program rewriting) collapses into
+configuration of the ONE compiled SPMD step (parallel_step.py).
+"""
+from .. import collective as coll
+from .. import env as env_mod
+from .. import mesh as mesh_mod
+from ..parallel_step import DistributedTrainStep, shard_params_and_opt
+from . import topology as topo_mod
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = [
+    "init", "is_first_worker", "worker_index", "worker_num",
+    "distributed_model", "distributed_optimizer", "DistributedStrategy",
+    "HybridCommunicateGroup", "CommunicateTopology", "get_hybrid_communicate_group",
+    "DistributedTrainStep", "PipelineParallel", "TensorParallel",
+    "ShardingParallel", "fleet",
+]
+
+
+class DistributedStrategy:
+    """Dict-backed strategy (reference keeps a protobuf; the knobs kept are
+    the ones that exist in the TPU design — hybrid degrees, amp, recompute,
+    sharding level, gradient merge)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sp_degree": 1, "ep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16":
+                            False, "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._hcg = None
+        self._strategy = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        mesh_mod.reset_mesh()
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=hc.get("dp_degree", 1),
+            mp_degree=hc.get("mp_degree", 1),
+            pp_degree=hc.get("pp_degree", 1),
+            sharding_degree=hc.get("sharding_degree", 1),
+            sp_degree=hc.get("sp_degree", 1),
+            ep_degree=hc.get("ep_degree", 1),
+        )
+        self._initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def is_first_worker(self):
+        return env_mod.get_rank() == 0
+
+    def worker_index(self):
+        return env_mod.get_rank()
+
+    def worker_num(self):
+        return env_mod.get_world_size()
+
+    def barrier_worker(self):
+        coll.barrier()
+
+    def distributed_model(self, model):
+        """(reference fleet/model.py:29.) With GSPMD there is nothing to
+        wrap for DP/TP — shardings are attached to params/activations; we
+        return the model (PipelineParallel wrapping happens in
+        meta_parallel when pp_degree>1)."""
+        if self._hcg and self._hcg.get_pipe_parallel_world_size() > 1:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, self._hcg, self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """(reference fleet.py:996.) Sharding level from strategy sets the
+        ZeRO placement applied by DistributedTrainStep."""
+        optimizer._fleet_strategy = strategy or self._strategy
+        return optimizer
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+
+fleet = _Fleet()
+
+# module-level API mirroring `paddle.distributed.fleet.*`
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+class TensorParallel:
+    """Wrapper parity (reference meta_parallel/tensor_parallel.py:25) —
+    GSPMD needs no broadcast: shardings carry placement."""
+
+    def __new__(cls, layers, hcg=None, **kwargs):
+        return layers
+
+
+class ShardingParallel:
+    def __new__(cls, layers, hcg=None, **kwargs):
+        return layers
+
+
+def PipelineParallel(layers, hcg=None, strategy=None):
+    from .meta_parallel.pipeline_parallel import PipelineParallel as PP
+
+    return PP(layers, hcg, strategy)

@@ -1,0 +1,15 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_sharding,
+    shard_activation,
+)
+from .pipeline_parallel import PipelineParallel, spmd_pipeline  # noqa: F401
+from .pp_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SegmentLayers,
+    SharedLayerDesc,
+)

@@ -1,0 +1,156 @@
+"""Pipeline layer description API.
+
+(reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py — LayerDesc:59, SharedLayerDesc:78, SegmentLayers:93,
+PipelineLayer:198.) The description API is kept; execution differs: on TPU
+the stages run as ONE SPMD program (see pipeline_parallel.spmd_pipeline),
+not as per-rank processes with p2p send/recv.
+"""
+import numpy as np
+
+from .... import nn
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, nn.Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (embedding tying).
+    In SPMD the 'shared-weight allreduce' of the reference
+    (pp_utils/utils.py FusedAllReduceBuffer) is unnecessary: both uses
+    reference the SAME parameter and XLA accumulates its gradient."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split a layer list into per-stage segments (reference :93 —
+    'uniform' by count or 'layer' weighted by parameter size)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers_desc) < num_parts:
+            raise ValueError("number of layers < number of stages")
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method == "uniform":
+            bounds = [int(round(i * n / self.num_parts))
+                      for i in range(self.num_parts + 1)]
+            return bounds
+        # weighted by rough parameter count
+        weights = []
+        for d in self.descs:
+            if isinstance(d, LayerDesc):
+                w = 1
+            else:
+                w = max(1, sum(int(np.prod(p.shape))
+                               for p in getattr(d, "parameters", lambda: [])())
+                        // 1_000_000)
+            weights.append(w)
+        total = sum(weights)
+        bounds = [0]
+        acc = 0
+        target = total / self.num_parts
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * len(bounds) and len(bounds) < self.num_parts:
+                bounds.append(i + 1)
+        bounds.append(n)
+        while len(bounds) < self.num_parts + 1:
+            bounds.insert(-1, bounds[-2])
+        return bounds
+
+
+class PipelineLayer(nn.Layer):
+    """(reference :198.) Declarative stage list. On a pp=1 mesh it executes
+    sequentially; PipelineParallel / spmd_pipeline use `.segments` to map
+    stages onto the 'pp' mesh axis."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self.loss_fn = loss_fn
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+        self._shared = {}
+        built = []
+        for i, d in enumerate(self.descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    proto = self._shared[d.layer_name]
+                    layer = _SharedRef(proto, d.forward_func)
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+            elif isinstance(d, nn.Layer):
+                layer = d
+            elif callable(d):
+                layer = _FnLayer(d)
+            else:
+                raise TypeError(f"bad pipeline entry {d!r}")
+            built.append(layer)
+            self.add_sublayer(str(i), layer)
+        self.run_function = built
+        self.segments = SegmentLayers(
+            built, self.num_stages, seg_method).do_segment()
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segments[stage_id], self.segments[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+
+class _FnLayer(nn.Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _SharedRef(nn.Layer):
+    """Second occurrence of a shared layer: reuses the prototype's params."""
+
+    def __init__(self, proto, forward_func=None):
+        super().__init__()
+        self._proto = [proto]  # list → not registered as sublayer
+        self._forward_func = forward_func
+
+    def forward(self, *args):
+        proto = self._proto[0]
+        if self._forward_func is not None:
+            return self._forward_func(proto, *args)
+        return proto(*args)

@@ -1,0 +1,68 @@
+"""Activation recomputation.
+
+(reference: fleet/recompute/recompute.py:346 `recompute` — a PyLayer that
+replays forward with saved RNG state; recompute_hybrid.py for mp-aware
+offload/partition.) TPU-native: `jax.checkpoint` (remat) IS the mechanism —
+the XLA scheduler rematerializes inside the compiled backward, no RNG
+bookkeeping needed (keys are explicit values).
+"""
+import functools
+
+import jax
+
+from ...ops._helpers import apply_jfn, ensure_tensor
+from ...tensor_core import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` with rematerialized backward."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensors = []
+    specs = []
+    for a in args:
+        if isinstance(a, Tensor):
+            specs.append(("t", len(tensors)))
+            tensors.append(a)
+        else:
+            specs.append(("v", a))
+
+    fn = function
+
+    def jfn(*vals):
+        rebuilt = []
+        for kind, payload in specs:
+            if kind == "t":
+                rebuilt.append(Tensor(vals[payload],
+                                      stop_gradient=False))
+            else:
+                rebuilt.append(payload)
+        out = fn(*rebuilt, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    ck = jax.checkpoint(jfn)
+    return apply_jfn("recompute", ck, *tensors)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """(reference recompute_sequential:472) — chunked remat over a
+    Sequential's sublayers."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    chunk = max(1, n // segments)
+    out = args[0] if len(args) == 1 else args
+    for i in range(0, n, chunk):
+        seg = layers[i: i + chunk]
+
+        def run(x, seg=seg):
+            for l in seg:
+                x = l(x)
+            return x
+
+        out = recompute(run, out)
+    return out

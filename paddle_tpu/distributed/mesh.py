@@ -1,0 +1,74 @@
+"""Global device-mesh management.
+
+TPU-native replacement for the reference's communicator bootstrap
+(reference: paddle/fluid/platform/collective_helper.h:70 NCCLCommContext
+ring registry, paddle/fluid/distributed/collective/ProcessGroupNCCL.h:49).
+There are no rings and no ncclUniqueId exchange: parallelism axes are
+dimensions of ONE `jax.sharding.Mesh`, and "communicators" are mesh axis
+names referenced by compiled collectives. Axis order follows the
+reference's fixed hybrid topology [dp, pp, sharding, mp] (fleet topology.py:52)
+extended with TPU-first axes sp (sequence/context) and ep (expert).
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "init_mesh", "global_mesh", "has_mesh", "axis_size", "mesh_axes",
+    "named_sharding", "PartitionSpec", "reset_mesh",
+]
+
+_AXIS_ORDER = ("dp", "pp", "sharding", "mp", "sp", "ep")
+
+_mesh = None
+
+
+def init_mesh(dp=1, pp=1, sharding=1, mp=1, sp=1, ep=1, devices=None):
+    """Build the global mesh. Product of axis sizes must equal device count
+    (axes of size 1 are kept — they make PartitionSpecs uniform)."""
+    global _mesh
+    sizes = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp, "sp": sp,
+             "ep": ep}
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    need = int(np.prod(list(sizes.values())))
+    if devs.size != need:
+        raise ValueError(
+            f"mesh {sizes} needs {need} devices, have {devs.size}"
+        )
+    shape = tuple(sizes[a] for a in _AXIS_ORDER)
+    _mesh = Mesh(devs.reshape(shape), _AXIS_ORDER)
+    return _mesh
+
+
+def reset_mesh():
+    global _mesh
+    _mesh = None
+
+
+def global_mesh():
+    global _mesh
+    if _mesh is None:
+        # single-device default mesh
+        _mesh = Mesh(
+            np.asarray(jax.devices()[:1]).reshape((1,) * len(_AXIS_ORDER)),
+            _AXIS_ORDER,
+        )
+    return _mesh
+
+
+def has_mesh():
+    return _mesh is not None
+
+
+def mesh_axes():
+    return _AXIS_ORDER
+
+
+def axis_size(axis):
+    m = global_mesh()
+    return m.shape[axis]
+
+
+def named_sharding(*spec):
+    return NamedSharding(global_mesh(), PartitionSpec(*spec))

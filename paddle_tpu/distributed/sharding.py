@@ -1,0 +1,41 @@
+"""ZeRO-style sharded data parallelism.
+
+(reference: fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:48,
+group_sharded_stage2.py:49, group_sharded_stage3.py:60, public entry
+python/paddle/distributed/sharding/group_sharded.py.) TPU-native: the
+stages are PLACEMENTS, not runtimes —
+  stage 1/os     : optimizer states sharded over the 'sharding' axis
+  stage 2/os_g   : + gradients reduce-scattered (XLA emits reduce-scatter
+                   when grad outputs are sharded like the states)
+  stage 3/p_g_os : + parameters sharded; XLA all-gathers before use
+All three are realized by DistributedTrainStep's in/out shardings; this
+module provides the reference-shaped entry point.
+"""
+from . import parallel_step as ps
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+_LEVELS = {"os": "os", "os_g": "os_g", "p_g_os": "p_g_os",
+           1: "os", 2: "os_g", 3: "p_g_os"}
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2**23, segment_size=2**20,
+                           sync_comm=False):
+    """Attach ZeRO placements; training must go through
+    DistributedTrainStep (which reads them)."""
+    lvl = _LEVELS[level]
+    ps.shard_params_and_opt(model, optimizer, lvl)
+    optimizer._zero_level = lvl
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io_state import save
+
+    save(model.state_dict(), output + ".pdparams")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

@@ -33,6 +33,7 @@ class Tensor:
         "_backward_hooks",
         "persistable",
         "trainable",
+        "_pspec",  # jax PartitionSpec for distributed placement (or None)
         "__weakref__",
     )
 
@@ -53,6 +54,7 @@ class Tensor:
         self.name = name
         self._backward_hooks = None
         self.persistable = False
+        self._pspec = None
         self.trainable = not stop_gradient
 
     # ---- metadata ----
