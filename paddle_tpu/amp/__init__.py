@@ -138,6 +138,12 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer state since the last update(): the unscale_→clip→
+        # step pattern must not divide by scale twice, and one optimizer's
+        # overflow must not skip another's step (reference tracks
+        # OptimizerState per optimizer the same way)
+        self._unscaled = set()
+        self._found_inf_per_opt = {}
 
     def is_enable(self):
         return self._enable
@@ -150,6 +156,11 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()."
+            )
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -159,20 +170,26 @@ class GradScaler:
             if not bool(jnp.isfinite(g).all()):
                 found = True
             p.grad._value = g.astype(p.grad._value.dtype)
-        self._found_inf = found
+        self._found_inf = self._found_inf or found
+        self._found_inf_per_opt[id(optimizer)] = found
+        self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf_per_opt.get(id(optimizer), False):
             optimizer.step()
 
     def update(self):
+        self._unscaled.clear()
+        self._found_inf_per_opt.clear()
+        found_inf, self._found_inf = self._found_inf, False
         if not (self._enable and self._dynamic):
             return
-        if self._found_inf:
+        if found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:

@@ -7,6 +7,7 @@ TPU-native design: a named-stream key tracker over jax PRNG keys. Eager ops
 split a fresh subkey per call; traced code should take keys explicitly (the
 framework's jitted train steps thread a per-step seed).
 """
+import contextlib
 import threading
 
 import jax
@@ -81,6 +82,35 @@ class RNGStatesTracker:
         return _ctx()
 
 
+class _TraceKeyState(threading.local):
+    """Per-thread injected base key for traced code.
+
+    Jitted train steps install a per-step key here so that `next_key()`
+    calls made while tracing derive from a traced value instead of baking
+    a host-side constant into the compiled program (which would replay the
+    same dropout mask every step)."""
+
+    def __init__(self):
+        self.base = None
+        self.count = 0
+
+
+_trace_state = _TraceKeyState()
+
+
+@contextlib.contextmanager
+def trace_key_scope(base_key):
+    """Within this scope, next_key() folds a trace-local counter into
+    `base_key` (typically fold_in(seed_key, step)) instead of consuming the
+    stateful generator."""
+    old = (_trace_state.base, _trace_state.count)
+    _trace_state.base, _trace_state.count = base_key, 0
+    try:
+        yield
+    finally:
+        _trace_state.base, _trace_state.count = old
+
+
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))
 _tracker = RNGStatesTracker()
 
@@ -100,4 +130,8 @@ def seed(s):
 
 
 def next_key():
+    if _trace_state.base is not None:
+        c = _trace_state.count
+        _trace_state.count += 1
+        return jax.random.fold_in(_trace_state.base, c)
     return _default_generator.next_key()

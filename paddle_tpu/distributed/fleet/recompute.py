@@ -17,7 +17,13 @@ __all__ = ["recompute", "recompute_sequential"]
 
 
 def recompute(function, *args, **kwargs):
-    """Run `function(*args)` with rematerialized backward."""
+    """Run `function(*args)` with rematerialized backward.
+
+    If `function` is a Layer (or closes over Layers passed positionally), its
+    parameters are threaded through the tape as explicit inputs — the
+    reference's PyLayer saves them implicitly via autograd; here the tape op
+    must see them to produce `.grad` (grads only flow to declared inputs).
+    """
     preserve = kwargs.pop("preserve_rng_state", True)
     use_reentrant = kwargs.pop("use_reentrant", True)
     tensors = []
@@ -30,6 +36,9 @@ def recompute(function, *args, **kwargs):
             specs.append(("v", a))
 
     fn = function
+    params = list(getattr(function, "parameters", lambda: [])())
+    n_args = len(tensors)
+    tensors.extend(params)
 
     def jfn(*vals):
         rebuilt = []
@@ -39,7 +48,14 @@ def recompute(function, *args, **kwargs):
                                       stop_gradient=False))
             else:
                 rebuilt.append(payload)
-        out = fn(*rebuilt, **kwargs)
+        originals = [p._value for p in params]
+        for p, v in zip(params, vals[n_args:]):
+            p._value = v
+        try:
+            out = fn(*rebuilt, **kwargs)
+        finally:
+            for p, v in zip(params, originals):
+                p._value = v
         if isinstance(out, (tuple, list)):
             return tuple(o._value for o in out)
         return out._value
@@ -64,5 +80,10 @@ def recompute_sequential(ctx, functions, *args):
                 x = l(x)
             return x
 
+        # expose the segment's params so recompute() threads them through
+        # the tape (a plain closure has no .parameters)
+        run.parameters = lambda seg=seg: [
+            p for l in seg for p in l.parameters()
+        ]
         out = recompute(run, out)
     return out

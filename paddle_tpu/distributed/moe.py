@@ -74,9 +74,9 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, num_experts,
         # dispatch tensor [E, capacity, tokens]
         pos_idx = pos.sum(-1).astype(jnp.int32)
         disp = (
-            jax.nn.one_hot(pos_idx, capacity)[:, None, :]
-            * keep.T[..., None]
-        )  # [tokens, E, capacity] → transpose
+            jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)[:, None, :]
+            * keep[:, :, None]
+        )  # [tokens, E, capacity]
         disp = jnp.swapaxes(disp, 0, 1)  # [E, tokens, capacity]
         expert_in = jnp.einsum("etc,td->ecd", disp, x)
         expert_out = expert_fn(expert_in)  # [E, capacity, d]

@@ -30,11 +30,24 @@ def sharding_of(param_value, pspec):
     return NamedSharding(mesh, pspec if pspec is not None else P())
 
 
+def _contains_axis(entry, axis):
+    if entry is None:
+        return False
+    if isinstance(entry, (tuple, list)):
+        return axis in entry
+    return entry == axis
+
+
 def _zero_spec(pv, level, base_pspec):
     """Choose the ZeRO ('sharding' axis) placement for a param/state leaf:
-    shard the largest divisible dim not already taken by the base spec."""
+    shard the largest divisible dim not already taken by the base spec.
+    Idempotent: a spec already carrying 'sharding' (e.g. both
+    group_sharded_parallel and DistributedTrainStep(zero_level=...) were
+    applied) is returned unchanged."""
     base = tuple(base_pspec) if base_pspec is not None else ()
     base = base + (None,) * (pv.ndim - len(base))
+    if any(_contains_axis(e, "sharding") for e in base):
+        return P(*base)
     n = mesh_mod.axis_size("sharding")
     if n == 1:
         return P(*base) if any(base) else P()
@@ -110,37 +123,46 @@ class DistributedTrainStep:
         return out
 
     def _build(self, batch_vals):
+        from ..core import rng as rng_mod
+
         mesh = mesh_mod.global_mesh()
         model = self.model
         loss_fn = self.loss_fn
         opt = self.optimizer
         param_objs = self._param_objs
         trainable = self._trainable
+        base_key = rng_mod.next_key()
 
-        def pure_loss(train_vals, frozen_vals, batch_vals):
+        def pure_loss(train_vals, frozen_vals, batch_vals, step_key):
             originals = [p._value for p in param_objs]
             it_t, it_f = iter(train_vals), iter(frozen_vals)
             for p, tr in zip(param_objs, trainable):
                 p._value = next(it_t) if tr else next(it_f)
             try:
                 batch = [Tensor(v, stop_gradient=True) for v in batch_vals]
-                loss = loss_fn(model, *batch)
+                with rng_mod.trace_key_scope(step_key):
+                    loss = loss_fn(model, *batch)
+                new_frozen = [p._value for p, tr in zip(param_objs, trainable)
+                              if not tr]
             finally:
                 for p, v in zip(param_objs, originals):
                     p._value = v
-            return loss._value
+            return loss._value, new_frozen
 
         loss_f = jax.checkpoint(pure_loss) if self.remat else pure_loss
 
-        def step(train_vals, frozen_vals, opt_states, lr, batch_vals):
-            loss, grads = jax.value_and_grad(loss_f)(
-                train_vals, frozen_vals, batch_vals)
-            new_vals, new_states = opt.apply_gradients_tree(
-                train_vals, grads, opt_states, lr)
-            return loss, new_vals, new_states
-
         train_objs = [p for p, t in zip(param_objs, trainable) if t]
         frozen_objs = [p for p, t in zip(param_objs, trainable) if not t]
+
+        def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
+                 step_idx):
+            step_key = jax.random.fold_in(base_key, step_idx)
+            (loss, new_frozen), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(
+                train_vals, frozen_vals, batch_vals, step_key)
+            new_vals, new_states = opt.apply_gradients_tree(
+                train_vals, grads, opt_states, lr, param_objs=train_objs)
+            return loss, new_vals, new_states, new_frozen
         t_sh = self._param_shardings(train_objs)
         f_sh = self._param_shardings(frozen_objs)
         states = self.optimizer.init_states_tree(
@@ -157,9 +179,9 @@ class DistributedTrainStep:
         self._batch_shardings = b_sh
         self._compiled = jax.jit(
             step,
-            in_shardings=(t_sh, f_sh, s_sh, None, b_sh),
-            out_shardings=(NamedSharding(mesh, P()), t_sh, s_sh),
-            donate_argnums=(0, 2),
+            in_shardings=(t_sh, f_sh, s_sh, None, b_sh, None),
+            out_shardings=(NamedSharding(mesh, P()), t_sh, s_sh, f_sh),
+            donate_argnums=(0, 1, 2),
         )
 
     def __call__(self, *batch):
@@ -172,11 +194,13 @@ class DistributedTrainStep:
         frozen_vals = [p._value for p, t in zip(self._param_objs,
                                                 self._trainable) if not t]
         lr = self.optimizer.get_lr()
-        loss, new_vals, self._opt_states = self._compiled(
-            train_vals, frozen_vals, self._opt_states, lr, batch_vals)
+        step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
+        loss, new_vals, self._opt_states, new_frozen = self._compiled(
+            train_vals, frozen_vals, self._opt_states, lr, batch_vals,
+            step_idx)
         it = iter(new_vals)
+        it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):
-            if t:
-                p._value = next(it)
+            p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
         return Tensor(loss)

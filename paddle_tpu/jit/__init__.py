@@ -352,13 +352,20 @@ class TrainStep:
         self._compiled = None
 
     def _build(self):
+        from ..core import rng as rng_mod
+
         model = self.model
         loss_fn = self.loss_fn
         param_objs = self._param_objs
         trainable = self._trainable
         opt = self.optimizer
+        train_objs = [p for p, t in zip(param_objs, trainable) if t]
+        # per-step dropout keys: fold the step index into this base key
+        # inside the compiled program (constant-baked keys would replay the
+        # same mask every step)
+        base_key = rng_mod.next_key()
 
-        def pure_loss(train_vals, frozen_vals, batch_vals):
+        def pure_loss(train_vals, frozen_vals, batch_vals, step_key):
             originals = [p._value for p in param_objs]
             it_t = iter(train_vals)
             it_f = iter(frozen_vals)
@@ -366,22 +373,29 @@ class TrainStep:
                 p._value = next(it_t) if tr else next(it_f)
             try:
                 batch = [Tensor(v, stop_gradient=True) for v in batch_vals]
-                loss = loss_fn(model, *batch)
+                with rng_mod.trace_key_scope(step_key):
+                    loss = loss_fn(model, *batch)
+                # buffer updates (BN running stats) written during forward
+                new_frozen = [p._value for p, tr in zip(param_objs, trainable)
+                              if not tr]
             finally:
                 for p, v in zip(param_objs, originals):
                     p._value = v
-            return loss._value
+            return loss._value, new_frozen
 
-        def step(train_vals, frozen_vals, opt_states, lr, batch_vals):
-            loss, grads = jax.value_and_grad(pure_loss)(
-                train_vals, frozen_vals, batch_vals)
+        def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
+                 step_idx):
+            step_key = jax.random.fold_in(base_key, step_idx)
+            (loss, new_frozen), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(
+                train_vals, frozen_vals, batch_vals, step_key)
             new_vals, new_states = opt.apply_gradients_tree(
-                train_vals, grads, opt_states, lr)
-            return loss, new_vals, new_states
+                train_vals, grads, opt_states, lr, param_objs=train_objs)
+            return loss, new_vals, new_states, new_frozen
 
-        # donate param + optimizer-state buffers so XLA updates in place
-        # (no HBM copy per step)
-        self._compiled = jax.jit(step, donate_argnums=(0, 2))
+        # donate param + optimizer-state + buffer arrays so XLA updates in
+        # place (no HBM copy per step)
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2))
 
     def __call__(self, *batch):
         if self._compiled is None:
@@ -395,11 +409,13 @@ class TrainStep:
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
         lr = self.optimizer.get_lr()
-        loss, new_vals, self._opt_states = self._compiled(
-            train_vals, frozen_vals, self._opt_states, lr, batch_vals)
+        step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
+        loss, new_vals, self._opt_states, new_frozen = self._compiled(
+            train_vals, frozen_vals, self._opt_states, lr, batch_vals,
+            step_idx)
         it = iter(new_vals)
+        it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):
-            if t:
-                p._value = next(it)
+            p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
         return Tensor(loss)

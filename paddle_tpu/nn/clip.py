@@ -15,6 +15,13 @@ class ClipGradBase:
     def _dygraph_clip(self, params_grads):
         raise NotImplementedError
 
+    def clip_tree(self, flat_params, flat_grads, need_clip=None):
+        """Pure flat-list clip for jitted train steps (same math as the
+        eager path, over raw jax arrays)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no pure tree-path implementation"
+        )
+
 
 class ClipGradByValue(ClipGradBase):
     def __init__(self, max, min=None):
@@ -30,6 +37,13 @@ class ClipGradByValue(ClipGradBase):
             out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
         return out
 
+    def clip_tree(self, flat_params, flat_grads, need_clip=None):
+        need_clip = need_clip or [True] * len(flat_grads)
+        return [
+            jnp.clip(g, self.min, self.max) if (g is not None and nc) else g
+            for g, nc in zip(flat_grads, need_clip)
+        ]
+
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
@@ -44,6 +58,19 @@ class ClipGradByNorm(ClipGradBase):
             norm = jnp.sqrt(jnp.sum(g._value.astype(jnp.float32) ** 2))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
             out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+    def clip_tree(self, flat_params, flat_grads, need_clip=None):
+        need_clip = need_clip or [True] * len(flat_grads)
+        out = []
+        for g, nc in zip(flat_grads, need_clip):
+            if g is None or not nc:
+                out.append(g)
+                continue
+            norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(
+                self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g * scale).astype(g.dtype))
         return out
 
 
@@ -73,6 +100,22 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 continue
             out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
         return out
+
+    def clip_tree(self, flat_params, flat_grads, need_clip=None):
+        need_clip = need_clip or [True] * len(flat_grads)
+        sq = [
+            jnp.sum(g.astype(jnp.float32) ** 2)
+            for g, nc in zip(flat_grads, need_clip)
+            if g is not None and nc
+        ]
+        if not sq:
+            return flat_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [
+            (g * scale).astype(g.dtype) if (g is not None and nc) else g
+            for g, nc in zip(flat_grads, need_clip)
+        ]
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

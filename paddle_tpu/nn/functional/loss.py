@@ -50,7 +50,21 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         else:
             logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
         if soft_label:
-            loss = -(lbl * logp).sum(axis=axis)
+            if rest:
+                # class weights apply to soft labels too (reference
+                # python/paddle/nn/functional/loss.py weighted soft-label
+                # branch): weight each class term, normalize the mean by
+                # the effective per-sample weight sum
+                w = rest[0]
+                wshape = [1] * logp.ndim
+                wshape[axis if axis >= 0 else logp.ndim + axis] = -1
+                wb = w.reshape(wshape).astype(logp.dtype)
+                loss = -(lbl * wb * logp).sum(axis=axis)
+                if reduction == "mean":
+                    denom = (lbl * wb).sum(axis=axis)
+                    return loss.sum() / jnp.maximum(denom.sum(), 1e-12)
+            else:
+                loss = -(lbl * logp).sum(axis=axis)
             if reduction == "none":
                 loss = jnp.expand_dims(loss, axis)
             return _reduce(loss, reduction)

@@ -2,9 +2,11 @@
 import jax.numpy as jnp
 
 from ...tensor_core import Tensor
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
 
 __all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
-           "remove_weight_norm", "spectral_norm"]
+           "remove_weight_norm", "spectral_norm", "clip_grad_norm_",
+           "clip_grad_value_"]
 
 
 def parameters_to_vector(parameters, name=None):

@@ -147,25 +147,67 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # ---- functional/jit path ----
-    def apply_gradients_tree(self, params_tree, grads_tree, states_tree, lr):
+    def _tree_meta(self, param_objs):
+        """Per-leaf (group_lr, lr_scale, wd_coeff) — static trace constants
+        mirroring what eager `step()` reads per parameter."""
+        group_by_id = {}
+        for g in self._param_groups:
+            for q in g["params"]:
+                group_by_id[id(q)] = g
+        metas = []
+        for p in param_objs:
+            grp = group_by_id.get(id(p), self._param_groups[0])
+            glr = grp.get("learning_rate", None)
+            if callable(glr):
+                # the compiled step bakes per-group lr as a constant; a
+                # schedule would be silently frozen — fail loudly instead
+                raise NotImplementedError(
+                    "per-group callable learning_rate is not supported in "
+                    "the compiled (tree) optimizer path; use a single "
+                    "LRScheduler as the optimizer learning_rate"
+                )
+            if glr is not None:
+                glr = float(glr)
+            scale = float(p.optimize_attr.get("learning_rate", 1.0)) \
+                if getattr(p, "optimize_attr", None) else 1.0
+            metas.append((glr, scale, self._weight_decay_coeff(p, grp)))
+        return metas
+
+    def apply_gradients_tree(self, params_tree, grads_tree, states_tree, lr,
+                             param_objs=None):
         """Pure pytree update for use inside jitted train steps.
 
         Returns (new_params_tree, new_states_tree). `states_tree` must come
-        from `init_states_tree`.
+        from `init_states_tree`. When `param_objs` (the Parameter objects
+        matching the leaves, in order) is given, per-group learning rates,
+        per-param lr scaling/regularizers and AdamW's apply_decay_param_fun
+        are honored exactly as in eager `step()`; grad_clip is applied as a
+        pure transform either way.
         """
         flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
         flat_g = treedef.flatten_up_to(grads_tree)
         flat_s = states_tree
-        new_p, new_s = [], []
-        for pv, gv, sv in zip(flat_p, flat_g, flat_s):
-            wd = 0.0 if self._weight_decay is None else (
+        if self._grad_clip is not None:
+            need = None
+            if param_objs is not None:
+                need = [getattr(p, "need_clip", True) for p in param_objs]
+            flat_g = self._grad_clip.clip_tree(flat_p, flat_g, need)
+        if param_objs is not None:
+            metas = self._tree_meta(param_objs)
+        else:
+            wd_global = 0.0 if self._weight_decay is None else (
                 self._weight_decay._coeff
                 if hasattr(self._weight_decay, "_coeff")
                 else float(self._weight_decay)
             )
+            metas = [(None, 1.0, wd_global)] * len(flat_p)
+        new_p, new_s = [], []
+        for pv, gv, sv, (glr, lr_scale, wd) in zip(flat_p, flat_g, flat_s,
+                                                   metas):
+            plr = (lr if glr is None else glr) * lr_scale
             if wd and not self._decoupled_wd():
                 gv = gv + wd * pv
-            np_, ns_ = self._update(pv, gv, sv, lr,
+            np_, ns_ = self._update(pv, gv, sv, plr,
                                     wd=wd if self._decoupled_wd() else 0.0)
             new_p.append(np_.astype(pv.dtype))
             new_s.append(ns_)

@@ -222,3 +222,127 @@ class TestAmpBackward:
             y = paddle.matmul(x, x)   # bf16
             z = paddle.exp(y)         # black list: must run fp32
         assert str(z.dtype) == "float32"
+
+
+class TestTrainStepStateThreading:
+    """Regression tests for round-1 advisor findings: TrainStep must thread
+    per-step PRNG keys (fresh dropout masks), buffer updates (BN running
+    stats), and the optimizer's grad_clip/per-param options."""
+
+    def test_dropout_mask_varies_across_steps(self):
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(16, 16))
+        drop_p = 0.5
+
+        def loss_fn(model, x):
+            h = model(x)
+            h = nn.functional.dropout(h, p=drop_p, training=True)
+            return h.sum()
+
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, loss_fn, opt)
+        x = paddle.ones([8, 16])
+        l1 = float(step(x).numpy())
+        l2 = float(step(x).numpy())
+        l3 = float(step(x).numpy())
+        # lr=0 → params frozen; only the dropout mask changes the loss
+        assert not (l1 == l2 == l3), (
+            "dropout mask is baked into the compiled step")
+
+    def test_batchnorm_stats_update_under_trainstep(self):
+        net = nn.Sequential(nn.Linear(8, 4), nn.BatchNorm1D(4))
+        bn = net[1]
+        m0 = bn._mean.numpy().copy()
+
+        def loss_fn(model, x, y):
+            return nn.functional.mse_loss(model(x), y)
+
+        opt = paddle.optimizer.SGD(0.01, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, loss_fn, opt)
+        x = paddle.randn([16, 8]) + 3.0
+        y = paddle.randn([16, 4])
+        for _ in range(3):
+            step(x, y)
+        assert not np.allclose(bn._mean.numpy(), m0), (
+            "BN running mean was not updated by the compiled step")
+
+    def test_grad_clip_honored_in_compiled_step(self):
+        paddle.seed(11)
+        net_e = nn.Linear(8, 8)
+        net_j = nn.Linear(8, 8)
+        net_j.weight.set_value(net_e.weight)
+        net_j.bias.set_value(net_e.bias)
+        clip = nn.ClipGradByGlobalNorm(1e-9)
+        opt_e = paddle.optimizer.SGD(0.5, parameters=net_e.parameters(),
+                                     grad_clip=clip)
+        opt_j = paddle.optimizer.SGD(0.5, parameters=net_j.parameters(),
+                                     grad_clip=clip)
+
+        def loss_fn(model, x, y):
+            return nn.functional.mse_loss(model(x), y)
+
+        x = paddle.randn([4, 8])
+        y = paddle.randn([4, 8])
+        w0 = net_e.weight.numpy().copy()
+        loss_fn(net_e, x, y).backward()
+        opt_e.step()
+        step = paddle.jit.TrainStep(net_j, loss_fn, opt_j)
+        step(x, y)
+        # tiny clip_norm → both paths produce (near-)zero updates
+        np.testing.assert_allclose(net_e.weight.numpy(), w0, atol=1e-7)
+        np.testing.assert_allclose(net_j.weight.numpy(),
+                                   net_e.weight.numpy(), atol=1e-7)
+
+    def test_adamw_decay_fun_honored_in_compiled_step(self):
+        paddle.seed(13)
+        net = nn.Linear(8, 8)
+        no_decay = {net.bias.name}
+        opt = paddle.optimizer.AdamW(
+            0.1, parameters=net.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: n not in no_decay)
+
+        def loss_fn(model, x, y):
+            # loss independent of bias → bias update must be exactly zero
+            # (it would shrink if weight decay were wrongly applied)
+            return (model(x) - model.bias).sum() * 0.0 + (
+                nn.functional.mse_loss(model(x) - model.bias, y))
+
+        b0 = net.bias.numpy().copy()
+        step = paddle.jit.TrainStep(net, loss_fn, opt)
+        x = paddle.randn([4, 8])
+        y = paddle.randn([4, 8])
+        for _ in range(3):
+            step(x, y)
+        np.testing.assert_allclose(net.bias.numpy(), b0, atol=1e-7)
+
+
+class TestGradScalerUnscaleGuard:
+    def test_double_unscale_raises(self):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = net(paddle.randn([2, 4])).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            scaler.unscale_(opt)
+
+    def test_unscale_then_step_single_unscale(self):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = net(paddle.ones([2, 4])).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        g1 = net.weight.grad.numpy().copy()
+        scaler.step(opt)  # must NOT unscale again
+        scaler.update()
+        # grad untouched by step (lr=0, no second unscale)
+        np.testing.assert_allclose(net.weight.grad.numpy(), g1)
+        # and a fresh round after update() may unscale again
+        opt.clear_grad()
+        loss = net(paddle.ones([2, 4])).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
