@@ -1,0 +1,156 @@
+"""Driver benchmark — one JSON line on stdout.
+
+Measures the flagship GPT-small compiled train step (paddle_tpu.jit.TrainStep:
+loss + backward + AdamW in ONE XLA program) on the real chip, bf16 compute
+via amp O1. Reports MFU against the TPU v5e nominal bf16 peak.
+
+vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
+north-star is ≥0.8× GPU-reference throughput. A well-tuned GPU LLM trainer
+of the reference's era runs ≈0.35 MFU, so the comparable bar is
+0.8 × 0.35 = 0.28 MFU and vs_baseline = mfu / 0.28.
+
+Extra per-model results go to stderr; stdout carries exactly one JSON line.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+V5E_PEAK_BF16 = 197e12  # nominal chip peak, FLOP/s
+BASELINE_MFU = 0.28     # 0.8 × (typical 0.35 GPU-trainer MFU): see docstring
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def gpt_flops_per_step(cfg, batch, seq):
+    """Analytic fwd+bwd FLOPs: 6·P per token for matmuls (fwd 2P + bwd 4P)
+    plus causal attention scores/context terms."""
+    d, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    ffn = cfg.ffn_size
+    per_layer = 4 * d * d + 2 * d * ffn   # qkv+proj, fc1+fc2 weights
+    p_matmul = L * per_layer + v * d      # + tied lm head
+    tokens = batch * seq
+    matmul = 6 * p_matmul * tokens
+    attn = L * batch * (4 * seq * seq * d) * 3 * 0.5  # fwd+2×bwd, causal
+    return matmul + attn
+
+
+def bench_gpt():
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn
+    from paddle_tpu.text.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_small)
+
+    paddle.seed(0)
+    cfg = gpt_small()
+    batch, seq = 8, 1024
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            return crit(m(ids), ids)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    t0 = time.perf_counter()
+    l0 = float(step(ids).numpy())  # compile + step 0
+    log(f"[bench] gpt-small compile+step0 {time.perf_counter()-t0:.1f}s "
+        f"loss {l0:.3f}")
+    for _ in range(2):  # warmup
+        step(ids)
+    float(step(ids).numpy())  # sync
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step(ids)
+    lN = float(last.numpy())  # sync: params chain step-to-step
+    dt = (time.perf_counter() - t0) / iters
+    flops = gpt_flops_per_step(cfg, batch, seq)
+    mfu = flops / dt / V5E_PEAK_BF16
+    tokens_per_sec = batch * seq / dt
+    log(f"[bench] gpt-small: {dt*1e3:.1f} ms/step, "
+        f"{tokens_per_sec:,.0f} tok/s, mfu {mfu:.3f}, loss→{lN:.3f}")
+    return {
+        "model": "gpt-small-124M",
+        "ms_per_step": round(dt * 1e3, 2),
+        "tokens_per_sec": round(tokens_per_sec),
+        "mfu": round(mfu, 4),
+    }
+
+
+def bench_resnet():
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            return nn.functional.cross_entropy(m(x), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    batch = 64
+    x = paddle.to_tensor(
+        rng.standard_normal((batch, 3, 224, 224)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, (batch,)))
+    t0 = time.perf_counter()
+    float(step(x, y).numpy())
+    log(f"[bench] resnet50 compile+step0 {time.perf_counter()-t0:.1f}s")
+    for _ in range(2):
+        step(x, y)
+    float(step(x, y).numpy())
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step(x, y)
+    float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    log(f"[bench] resnet50: {dt*1e3:.1f} ms/step, "
+        f"{batch/dt:,.0f} img/s")
+    return {"model": "resnet50", "ms_per_step": round(dt * 1e3, 2),
+            "images_per_sec": round(batch / dt)}
+
+
+def main():
+    results = {}
+    try:
+        results["gpt"] = bench_gpt()
+    except Exception as e:  # keep the contract: always print one line
+        log(f"[bench] gpt failed: {e!r}")
+    try:
+        results["resnet"] = bench_resnet()
+    except Exception as e:
+        log(f"[bench] resnet failed: {e!r}")
+
+    if "gpt" in results:
+        mfu = results["gpt"]["mfu"]
+        line = {
+            "metric": "gpt_small_train_mfu",
+            "value": mfu,
+            "unit": "fraction_of_v5e_bf16_peak",
+            "vs_baseline": round(mfu / BASELINE_MFU, 4),
+            "detail": results,
+        }
+    else:
+        line = {"metric": "gpt_small_train_mfu", "value": 0.0,
+                "unit": "fraction_of_v5e_bf16_peak", "vs_baseline": 0.0,
+                "detail": results}
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

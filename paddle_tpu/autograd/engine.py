@@ -99,15 +99,20 @@ class GradNode:
         "inputs",
         "n_outputs",
         "out_meta",
+        "deferred_vals",
     )
 
-    def __init__(self, name, vjp_fn, jfn, inputs, out_meta):
+    def __init__(self, name, vjp_fn, jfn, inputs, out_meta,
+                 deferred_vals=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.jfn = jfn  # kept for create_graph re-linearization
         self.inputs = inputs  # tuple[Tensor]
         self.n_outputs = len(out_meta)
         self.out_meta = out_meta  # [(shape, dtype)]
+        # trace-time ops defer linearization (see apply); the forward vals
+        # are kept so a late tape backward can still jax.vjp them
+        self.deferred_vals = deferred_vals
 
     def __repr__(self):
         return f"GradNode({self.name})"
@@ -133,11 +138,23 @@ def apply(name, jfn, tensors, n_outputs=None):
             return tuple(wrap(o, True) for o in out)
         return wrap(out, True)
 
-    outs, vjp_fn = jax.vjp(jfn, *vals)
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        # Under an outer trace (jit / value_and_grad / checkpoint) the
+        # outer AD differentiates the staged ops directly — eagerly
+        # vjp-ing here would (a) trace every op twice and (b) decompose
+        # custom_vjp ops (e.g. Pallas kernels) into primitives the outer
+        # AD cannot transpose. Linearize lazily only if the tape backward
+        # is actually invoked on these tracers.
+        outs = jfn(*vals)
+        vjp_fn, deferred = None, vals
+    else:
+        outs, vjp_fn = jax.vjp(jfn, *vals)
+        deferred = None
     multi = isinstance(outs, (tuple, list))
     outs_t = tuple(outs) if multi else (outs,)
     out_meta = [(o.shape, o.dtype) for o in outs_t]
-    node = GradNode(name, vjp_fn, jfn, tuple(tensors), out_meta)
+    node = GradNode(name, vjp_fn, jfn, tuple(tensors), out_meta,
+                    deferred_vals=deferred)
     result = []
     for i, o in enumerate(outs_t):
         nondiff = not jnp.issubdtype(o.dtype, jnp.inexact)
@@ -242,6 +259,10 @@ def run_backward(roots, root_grads, retain_graph=False, create_graph=False,
     while ready:
         node = ready.popleft()
         slots = outgrads.pop(node, [None] * node.n_outputs)
+        if node.vjp_fn is None and node.deferred_vals is not None \
+                and not create_graph:  # create_graph re-linearizes anyway
+            _, node.vjp_fn = jax.vjp(node.jfn, *node.deferred_vals)
+            node.deferred_vals = None
         if node.vjp_fn is None and not create_graph:
             raise RuntimeError(
                 f"grad graph for {node.name} already freed; "

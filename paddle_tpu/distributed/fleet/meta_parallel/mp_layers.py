@@ -48,11 +48,19 @@ def mark_sharding(param, *spec):
 
 
 def shard_activation(x, *spec):
-    """with_sharding_constraint on an activation (no-op on 1-device mesh)."""
+    """with_sharding_constraint on an activation (no-op on 1-device mesh).
+
+    Axis names whose mesh size does not divide the annotated dim are
+    dropped — the spec is a layout hint, and e.g. a 4-head model on an
+    mp=8 mesh should fall back to replicating heads, not error."""
     x = ensure_tensor(x)
     mesh = mesh_mod.global_mesh()
     if all(n == 1 for n in mesh.shape.values()):
         return x
+    spec = tuple(
+        s if (s is None or d % mesh.shape[s] == 0) else None
+        for s, d in zip(spec, x.shape)
+    )
     sh = jax.sharding.NamedSharding(mesh, P(*spec))
 
     def jfn(v):

@@ -26,7 +26,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         tensors.append(ensure_tensor(attn_mask))
 
-    use_pallas = _pallas_eligible(query)
+    use_pallas = _pallas_eligible(query, key)
     if use_pallas and attn_mask is None and dropout_p == 0.0:
         from ...ops.pallas_kernels import flash_attention
 
@@ -72,8 +72,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply_jfn("scaled_dot_product_attention", jfn, *tensors)
 
 
-def _pallas_eligible(q):
-    """Use the Pallas kernel only on real TPU backends with tileable shapes."""
+def _pallas_eligible(q, k):
+    """Use the Pallas kernel only on real TPU backends with tileable shapes
+    (both q and kv sequence lengths; the kernel assumes self-attention
+    geometry for the causal diagonal)."""
+    from ...core import flags
+
+    if not flags.get_flag("use_pallas_kernels"):
+        return False
     try:
         import jax
 
@@ -82,4 +88,9 @@ def _pallas_eligible(q):
     except Exception:
         return False
     shape = q.shape
-    return len(shape) == 4 and shape[1] % 128 == 0 and shape[3] in (64, 128, 256)
+    return (
+        len(shape) == 4
+        and shape[1] % 128 == 0
+        and k.shape[1] == shape[1]
+        and shape[3] in (64, 128, 256)
+    )

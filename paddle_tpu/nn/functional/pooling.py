@@ -36,6 +36,13 @@ def _norm(v, n):
     return v * n if len(v) == 1 else v
 
 
+def _concrete_init(init, dtype):
+    """reduce_window init must be a CONCRETE scalar: jax's monoid matcher
+    (reduce_window -> the differentiable reduce_window_max/add primitives)
+    compares it by value, which fails on traced/device arrays under jit."""
+    return np.asarray(init, dtype)[()]
+
+
 def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
           channel_last):
     kernel = _norm(kernel, n)
@@ -73,7 +80,7 @@ def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
                 return _reduce_window_str(xv, init, op, dims, strides, pad)
             if ceil_mode:
                 p = _grow_for_ceil(xv.shape, dims, strides, pads)
-            return lax.reduce_window(xv, jnp.asarray(init, xv.dtype), op,
+            return lax.reduce_window(xv, _concrete_init(init, xv.dtype), op,
                                      dims, strides, p)
 
         return apply_jfn(f"max_pool{n}d", jfn, x)
@@ -88,10 +95,10 @@ def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
             return s / cnt
         if ceil_mode:
             p = _grow_for_ceil(xv.shape, dims, strides, pads)
-        s = lax.reduce_window(xv, jnp.asarray(0.0, xv.dtype), lax.add, dims,
+        s = lax.reduce_window(xv, _concrete_init(0.0, xv.dtype), lax.add, dims,
                               strides, p)
         if exclusive:
-            cnt = lax.reduce_window(jnp.ones_like(xv), jnp.asarray(0.0, xv.dtype),
+            cnt = lax.reduce_window(jnp.ones_like(xv), _concrete_init(0.0, xv.dtype),
                                     lax.add, dims, strides, p)
             return s / cnt
         return s / float(np.prod(kernel))
@@ -110,7 +117,7 @@ def _grow_for_ceil(shape, dims, strides, pads):
 
 
 def _reduce_window_str(xv, init, op, dims, strides, pad_str):
-    return lax.reduce_window(xv, jnp.asarray(init, xv.dtype), op, dims,
+    return lax.reduce_window(xv, _concrete_init(init, xv.dtype), op, dims,
                              strides, pad_str)
 
 

@@ -1,0 +1,15 @@
+"""Hand-written Pallas TPU kernels for the hottest fused ops.
+
+This package is the TPU counterpart of the reference's native fused-op
+corpus (reference: paddle/fluid/operators/fused/ — 110 files of CUDA fusion
+kernels). On TPU, XLA already fuses elementwise chains into matmuls, so only
+the ops where manual tiling beats the compiler get kernels here; everything
+else stays jnp.
+
+Kernels run in compiled mode on real TPU backends and in Pallas interpret
+mode in the CPU test tier (tests/test_pallas_kernels.py).
+"""
+from . import flash_attention  # noqa: F401
+from .flash_attention import flash_attention_bshd  # noqa: F401
+
+__all__ = ["flash_attention", "flash_attention_bshd"]

@@ -1,0 +1,9 @@
+"""paddle_tpu.text — NLP models and (later) datasets.
+
+(Reference: python/paddle/text/ exposes datasets + viterbi_decode; the
+model zoo itself lives in PaddleNLP. Here the flagship language models are
+in-tree because they are the benchmark/parallelism drivers.)
+"""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

@@ -1,0 +1,19 @@
+"""Language model zoo (reference capability: PaddleNLP model family on the
+fleet mpu layers; BASELINE.json configs 3-4)."""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTDecoderLayer,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt_1p3b,
+    gpt_medium,
+    gpt_small,
+    gpt_tiny,
+)
+
+__all__ = [
+    "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
+    "GPTPretrainingCriterion", "gpt_tiny", "gpt_small", "gpt_medium",
+    "gpt_1p3b",
+]

@@ -1,0 +1,178 @@
+"""GPT — decoder-only causal language model, the flagship transformer.
+
+The reference ships GPT through PaddleNLP on top of the fleet TP/PP layers
+(reference capability: fleet/layers/mpu/mp_layers.py + the GPT-3 hybrid
+configs named in BASELINE.json); here the model is built directly on the
+framework's tensor-parallel layers so ONE model definition runs serial,
+DP, TP, ZeRO, and sequence-parallel — the mesh axes and PartitionSpecs
+decide, not the model code (GSPMD-first design).
+
+TPU-first choices:
+- attention runs through F.scaled_dot_product_attention → the Pallas
+  flash-attention kernel on TPU (ops/pallas_kernels/flash_attention.py);
+- qkv is ONE fused ColumnParallelLinear (3·d_model output, mp-sharded) so
+  the MXU sees one big matmul;
+- the LM head is tied to the vocab-sharded embedding; the loss is
+  ParallelCrossEntropy (vocab-parallel softmax-CE, reference
+  c_softmax_with_cross_entropy_op).
+"""
+import math
+
+from ... import nn
+from ...distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    shard_activation,
+)
+from ...nn import functional as F
+from ...ops import manipulation as manip
+
+__all__ = [
+    "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
+    "GPTPretrainingCriterion", "gpt_tiny", "gpt_small", "gpt_medium",
+    "gpt_1p3b",
+]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_size=None, max_seq_len=1024,
+                 dropout=0.0, tie_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_size = ffn_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.tie_embeddings = tie_embeddings
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=2048, hidden_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=256, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_seq_len=1024, **kw)
+
+
+def gpt_medium(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, max_seq_len=1024, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                     num_heads=32, max_seq_len=2048, **kw)
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN decoder block: LN → fused-qkv attn → residual, LN → MLP →
+    residual. Column/Row parallel pairs keep the intermediate activations
+    mp-sharded with zero manual collectives."""
+
+    def __init__(self, config):
+        super().__init__()
+        d = config.hidden_size
+        self.nh = config.num_heads
+        self.hd = d // config.num_heads
+        self.ln1 = nn.LayerNorm(d)
+        self.qkv = ColumnParallelLinear(d, 3 * d, gather_output=False)
+        self.proj = RowParallelLinear(d, d, input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(d)
+        self.fc1 = ColumnParallelLinear(d, config.ffn_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(config.ffn_size, d,
+                                     input_is_parallel=True)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        b = x.shape[0]
+        s = x.shape[1]
+        h = self.ln1(x)
+        qkv = self.qkv(h)  # [b, s, 3d] (mp-sharded last dim)
+        qkv = manip.reshape(qkv, [b, s, 3, self.nh, self.hd])
+        q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), [2])
+        k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), [2])
+        v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), [2])
+        # heads ride the mp axis; sequence may ride sp (long-context)
+        q = shard_activation(q, "dp", "sp", "mp", None)
+        k = shard_activation(k, "dp", "sp", "mp", None)
+        v = shard_activation(v, "dp", "sp", "mp", None)
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = manip.reshape(attn, [b, s, self.nh * self.hd])
+        x = x + self.dropout(self.proj(attn))
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Token + position embeddings, N decoder layers, final LN."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.hidden_size)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        from ...ops.creation import arange
+
+        pos = arange(0, s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        x = shard_activation(x, "dp", "sp", None)
+        for layer in self.layers:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head tied to the (vocab-sharded) embedding by default."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+
+    def forward(self, input_ids):
+        x = self.gpt(input_ids)
+        if self.lm_head is not None:
+            return self.lm_head(x)
+        w = self.gpt.wte.weight  # [vocab, d], mp-sharded on vocab
+        logits = F.linear(x, manip.transpose(w, [1, 0]))
+        return shard_activation(logits, "dp", "sp", "mp")
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Shifted next-token vocab-parallel cross entropy."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels):
+        from ...ops.math import mean
+
+        shift_logits = manip.slice(
+            logits, [1], [0], [logits.shape[1] - 1])
+        shift_labels = manip.slice(labels, [1], [1], [labels.shape[1]])
+        loss = self.ce(shift_logits, shift_labels)
+        return mean(loss)

@@ -1,0 +1,106 @@
+"""Pallas kernel correctness vs the jnp reference, in interpret mode
+(SURVEY.md §4 implication (a): numpy/CPU-reference tier for native kernels;
+the compiled path runs on real TPU via bench.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas_kernels.flash_attention import flash_attention_bshd
+
+
+def dense_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", w, vt), 1, 2)
+
+
+def _rand_qkv(b=2, s=256, h=2, d=64, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((b, s, h, d)).astype(dtype))
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = _rand_qkv()
+        out = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_multi_block_seq(self):
+        q, k, v = _rand_qkv(b=1, s=512, h=1, d=64, seed=3)
+        out = flash_attention_bshd(q, k, v, causal=True, block_q=128,
+                                   block_k=128, interpret=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_dense(self, causal):
+        q, k, v = _rand_qkv(b=1, s=128, h=2, d=64, seed=7)
+
+        def loss_fa(q, k, v):
+            o = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = dense_attention(q, k, v, causal=causal)
+            return jnp.sum(o * o)
+
+        g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bf16_inputs(self):
+        q, k, v = _rand_qkv(b=1, s=128, h=1, d=64, seed=9)
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        out = flash_attention_bshd(qb, kb, vb, causal=True, interpret=True)
+        ref = dense_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), rtol=5e-2,
+            atol=5e-2)
+
+    def test_functional_dispatch_uses_kernel_shapes(self):
+        # the functional wrapper's eligibility gate: seq%128==0 and
+        # head_dim in {64,128,256} — make sure jnp fallback handles the
+        # ineligible shapes identically
+        from paddle_tpu.nn.functional import scaled_dot_product_attention
+        import paddle_tpu as paddle
+
+        q, k, v = _rand_qkv(b=1, s=100, h=2, d=32, seed=11)
+        out = scaled_dot_product_attention(
+            paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(k)),
+            paddle.to_tensor(np.asarray(v)), is_causal=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_ragged_seq_k_masked(self):
+        # seq_k not a multiple of block_k: padded kv tail must not leak
+        # into the softmax
+        q, k, v = _rand_qkv(b=1, s=384, h=1, d=64, seed=13)
+        out = flash_attention_bshd(q, k, v, causal=False, block_q=128,
+                                   block_k=256, interpret=True)
+        ref = dense_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_cross_length_raises(self):
+        q, _, _ = _rand_qkv(b=1, s=128, h=1, d=64)
+        _, k, v = _rand_qkv(b=1, s=256, h=1, d=64, seed=1)
+        with pytest.raises(ValueError):
+            flash_attention_bshd(q, k, v, causal=True, interpret=True)

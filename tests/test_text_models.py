@@ -1,0 +1,97 @@
+"""GPT flagship model: eager/compiled parity and TP parity on the 8-device
+mesh (SURVEY.md §4 implication (c))."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.text.models import (
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+)
+
+
+def _batch(cfg, b=2, s=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, cfg.vocab_size, (b, s)))
+
+
+class TestGPT:
+    def test_forward_shapes_and_grads(self):
+        mesh_mod.reset_mesh()
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        ids = _batch(cfg)
+        logits = model(ids)
+        assert logits.shape == [2, 64, cfg.vocab_size]
+        crit = GPTPretrainingCriterion()
+        loss = crit(logits, ids)
+        loss.backward()
+        assert model.gpt.wte.weight.grad is not None
+        assert model.gpt.layers[0].qkv.weight.grad is not None
+        assert model.gpt.layers[-1].fc2.weight.grad is not None
+
+    def test_trainstep_matches_eager_step(self):
+        mesh_mod.reset_mesh()
+        paddle.seed(1)
+        cfg = gpt_tiny()
+        m_e = GPTForCausalLM(cfg)
+        paddle.seed(1)
+        m_j = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        ids = _batch(cfg, seed=3)
+
+        opt_e = paddle.optimizer.SGD(0.1, parameters=m_e.parameters())
+        opt_j = paddle.optimizer.SGD(0.1, parameters=m_j.parameters())
+
+        def loss_fn(m, ids):
+            return crit(m(ids), ids)
+
+        l_e = loss_fn(m_e, ids)
+        l_e.backward()
+        opt_e.step()
+        step = paddle.jit.TrainStep(m_j, loss_fn, opt_j)
+        l_j = step(ids)
+        np.testing.assert_allclose(float(l_e.numpy()), float(l_j.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            m_e.gpt.layers[0].qkv.weight.numpy(),
+            m_j.gpt.layers[0].qkv.weight.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_tp_matches_serial(self):
+        cfg = gpt_tiny()
+        ids = _batch(cfg, seed=5)
+        mesh_mod.reset_mesh()
+        paddle.seed(2)
+        serial = GPTForCausalLM(cfg)
+        out_serial = serial(ids).numpy()
+
+        mesh_mod.init_mesh(mp=8)
+        paddle.seed(2)
+        tp = GPTForCausalLM(cfg)
+        out_tp = tp(ids).numpy()
+        mesh_mod.reset_mesh()
+        np.testing.assert_allclose(out_serial, out_tp, rtol=1e-4, atol=1e-4)
+
+    def test_train_loss_decreases_hybrid(self):
+        mesh_mod.init_mesh(dp=2, sharding=2, mp=2)
+        paddle.seed(3)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        def loss_fn(m, ids):
+            return crit(m(ids), ids)
+
+        step = dist.DistributedTrainStep(model, loss_fn, opt,
+                                         zero_level="os_g")
+        ids = _batch(cfg, b=4, s=64, seed=7)
+        l0 = float(step(ids).numpy())
+        for _ in range(5):
+            l = float(step(ids).numpy())
+        mesh_mod.reset_mesh()
+        assert l < l0
