@@ -12,7 +12,39 @@ import jax.numpy as jnp
 
 from ...ops._helpers import apply_jfn, ensure_tensor
 
-__all__ = ["scaled_dot_product_attention"]
+__all__ = ["scaled_dot_product_attention", "dense_attention_bshd"]
+
+
+def dense_attention_bshd(q, k, v, is_causal=False, attn_mask=None,
+                         drop_key=None, dropout_p=0.0):
+    """Pure-jnp softmax attention on [batch, seq, heads, head_dim] — the
+    XLA-fused fallback used when the Pallas kernel is not eligible. Shared
+    by scaled_dot_product_attention and the pipelined GPT block."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, jnp.asarray(-jnp.inf,
+                                                       scores.dtype))
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores,
+                               jnp.asarray(-jnp.inf, scores.dtype))
+        else:
+            scores = scores + attn_mask
+    w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    if drop_key is not None and dropout_p > 0.0:
+        import jax
+
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vt.dtype), vt)
+    return jnp.swapaxes(out, 1, 2)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -42,32 +74,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         drop_key = rng.next_key()
 
     def jfn(q, k, v, *rest):
-        scale = 1.0 / math.sqrt(q.shape[-1])
-        # b s h d -> b h s d
-        qt = jnp.swapaxes(q, 1, 2)
-        kt = jnp.swapaxes(k, 1, 2)
-        vt = jnp.swapaxes(v, 1, 2)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-        if is_causal:
-            sq, sk = scores.shape[-2], scores.shape[-1]
-            causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-            scores = jnp.where(causal, scores, jnp.asarray(-jnp.inf, scores.dtype))
-        if rest:
-            m = rest[0]
-            if m.dtype == jnp.bool_:
-                scores = jnp.where(m, scores, jnp.asarray(-jnp.inf, scores.dtype))
-            else:
-                scores = scores + m
-        w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
-        w = w / w.sum(axis=-1, keepdims=True)
-        if drop_key is not None:
-            # dropout on the attention probabilities (paddle/torch semantics)
-            import jax
-
-            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, w.shape)
-            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
-        out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(vt.dtype), vt)
-        return jnp.swapaxes(out, 1, 2)
+        return dense_attention_bshd(
+            q, k, v, is_causal=is_causal,
+            attn_mask=rest[0] if rest else None,
+            drop_key=drop_key, dropout_p=dropout_p)
 
     return apply_jfn("scaled_dot_product_attention", jfn, *tensors)
 

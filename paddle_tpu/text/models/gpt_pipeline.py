@@ -1,0 +1,181 @@
+"""Pipeline-parallel GPT: heterogeneous embedding/head stages + uniform
+decoder stack on the 1F1B SPMD schedule.
+
+(reference: fleet/meta_parallel/parallel_layers/pp_layers.py — GPT built as
+PipelineLayer([SharedLayerDesc(embedding), LayerDesc(decoder)×L,
+SharedLayerDesc(head)]) and run by pipeline_parallel.py's 1F1B. Here the
+same decomposition maps onto pipeline_1f1b: embedding runs in the outer
+program (its grad arrives through the pipeline's input cotangents), the L
+decoder layers live as STACKED parameters [L, ...] sharded over 'pp', and
+the tied head + final LN ride as post_params into the last stage's loss —
+tying needs no shared-weight allreduce, the two grad paths meet in autodiff.)
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...ops._helpers import apply_jfn
+from ...distributed.fleet.meta_parallel.pipeline_1f1b import pipeline_1f1b
+from .gpt import GPTConfig
+
+__all__ = ["PipelinedGPTForCausalLM"]
+
+
+def _layernorm(x, w, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _attention(q, k, v):
+    """Causal attention [b, s, h, hd]; Pallas kernel when eligible, else
+    the shared dense formulation from nn/functional/attention.py."""
+    from ...nn.functional.attention import (_pallas_eligible,
+                                            dense_attention_bshd)
+
+    if _pallas_eligible(q, k):
+        from ...ops.pallas_kernels.flash_attention import (
+            flash_attention_bshd)
+
+        return flash_attention_bshd(q, k, v, causal=True)
+    return dense_attention_bshd(q, k, v, is_causal=True)
+
+
+def _decoder_fwd(p, x, nh):
+    """One pre-LN decoder block as a pure function of its param dict."""
+    b, s, d = x.shape
+    hd = d // nh
+    h = _layernorm(x, p["ln1_w"], p["ln1_b"])
+    qkv = h @ p["qkv_w"] + p["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = _attention(q, k, v).reshape(b, s, d)
+    x = x + attn @ p["proj_w"] + p["proj_b"]
+    h = _layernorm(x, p["ln2_w"], p["ln2_b"])
+    x = x + jax.nn.gelu(h @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"] \
+        + p["fc2_b"]
+    return x
+
+
+class PipelinedGPTForCausalLM(nn.Layer):
+    """GPT whose decoder parameters are stacked [num_layers, ...] and
+    sharded over the 'pp' mesh axis. `forward` runs the serial scan (eval /
+    single device); `loss(ids)` runs the 1F1B pipeline schedule."""
+
+    def __init__(self, config: GPTConfig, n_micro=4):
+        super().__init__()
+        self.config = config
+        self.n_micro = n_micro
+        d, L, ffn = config.hidden_size, config.num_layers, config.ffn_size
+        mk = self.create_parameter
+        normal = nn.initializer.Normal(0.0, 0.02)
+        self.wte = mk([config.vocab_size, d], default_initializer=normal)
+        self.wpe = mk([config.max_seq_len, d], default_initializer=normal)
+        # stacked decoder params, leading dim = num_layers (sharded 'pp')
+        from ...distributed.fleet.meta_parallel.mp_layers import (
+            mark_sharding)
+
+        self._stack_names = []
+        ones = nn.initializer.Constant(1.0)
+
+        def stacked(name, shape, is_bias=False, init=None):
+            p = mk([L] + shape, is_bias=is_bias,
+                   default_initializer=init or (
+                       nn.initializer.Constant(0.0) if is_bias else normal))
+            mark_sharding(p, "pp", *([None] * len(shape)))
+            setattr(self, "stk_" + name, p)
+            self._stack_names.append(name)
+            return p
+
+        stacked("ln1_w", [d], init=ones); stacked("ln1_b", [d], True)
+        stacked("qkv_w", [d, 3 * d]); stacked("qkv_b", [3 * d], True)
+        stacked("proj_w", [d, d]); stacked("proj_b", [d], True)
+        stacked("ln2_w", [d], init=ones); stacked("ln2_b", [d], True)
+        stacked("fc1_w", [d, ffn]); stacked("fc1_b", [ffn], True)
+        stacked("fc2_w", [ffn, d]); stacked("fc2_b", [d], True)
+        self.lnf_w = mk([d], default_initializer=ones)
+        self.lnf_b = mk([d], is_bias=True)
+
+    # ---- pure pieces ----
+    def _embed(self, wte, wpe, ids):
+        return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
+
+    def _block_fn(self, stage_params, x):
+        nh = self.config.num_heads
+
+        def body(x, p):
+            return _decoder_fwd(p, x, nh), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    def _loss_fn(self, y_pred, labels, post):
+        h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
+        logits = h @ post["wte"].T
+        shift_logits = logits[:, :-1]
+        shift_labels = labels[:, 1:]
+        lp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), -1)
+        return -jnp.mean(
+            jnp.take_along_axis(lp, shift_labels[..., None], -1))
+
+    def _param_tensors(self):
+        stk = [getattr(self, "stk_" + n) for n in self._stack_names]
+        return [self.wte, self.wpe, self.lnf_w, self.lnf_b] + stk
+
+    # ---- API ----
+    def forward(self, input_ids):
+        """Serial (non-pipelined) forward to logits — eval path."""
+        tensors = self._param_tensors()
+        names = self._stack_names
+        nh = self.config.num_heads
+
+        def jfn(wte, wpe, lnf_w, lnf_b, *stk):
+            ids = input_ids._value
+            x = self._embed(wte, wpe, ids)
+            p = dict(zip(names, stk))
+
+            def body(x, pl):
+                return _decoder_fwd(pl, x, nh), None
+
+            x, _ = jax.lax.scan(body, x, p)
+            h = _layernorm(x, lnf_w, lnf_b)
+            return h @ wte.T
+
+        return apply_jfn("pipelined_gpt_forward", jfn, *tensors)
+
+    def loss(self, input_ids, labels=None):
+        """Mean LM loss via the 1F1B pipeline schedule (forward-only
+        fill-drain when grad is disabled — eval loops skip the backward
+        machinery). The global batch is split into `n_micro` micro-batches
+        on axis 0."""
+        from ...autograd import engine
+        from ...distributed.fleet.meta_parallel.pipeline_1f1b import (
+            pipeline_forward_loss)
+
+        labels = input_ids if labels is None else labels
+        tensors = self._param_tensors()
+        names = self._stack_names
+        M = self.n_micro
+        block_fn = self._block_fn
+        loss_fn = self._loss_fn
+        fwd_only = not engine.is_grad_enabled()
+
+        def jfn(wte, wpe, lnf_w, lnf_b, *stk):
+            ids = input_ids._value
+            lbl = labels._value
+            B = ids.shape[0]
+            assert B % M == 0, f"batch {B} not divisible by n_micro {M}"
+            ids_m = ids.reshape(M, B // M, ids.shape[1])
+            lbl_m = lbl.reshape(M, B // M, lbl.shape[1])
+            x_m = self._embed(wte, wpe, ids_m)
+            stacked = dict(zip(names, stk))
+            post = {"wte": wte, "lnf_w": lnf_w, "lnf_b": lnf_b}
+            if fwd_only:
+                return pipeline_forward_loss(block_fn, loss_fn, stacked,
+                                             post, (x_m, lbl_m))
+            return pipeline_1f1b(block_fn, loss_fn, stacked, post,
+                                 (x_m, lbl_m))
+
+        return apply_jfn("pipelined_gpt_loss", jfn, *tensors)

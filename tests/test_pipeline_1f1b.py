@@ -1,0 +1,130 @@
+"""1F1B SPMD pipeline: exact parity vs serial execution, heterogeneous
+embedding/head stages, tied-weight grads (SURVEY.md §4 implication (c);
+reference behavior: fleet/meta_parallel/pipeline_parallel.py:105)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_1f1b import (
+    pipeline_1f1b,
+)
+
+
+def _setup(pp=4, dp=2):
+    mesh_mod.init_mesh(pp=pp, dp=dp)
+
+
+def _block_fn(Wstack, x):
+    def body(x, w):
+        return jnp.tanh(x @ w), None
+
+    out, _ = jax.lax.scan(body, x, Wstack)
+    return out
+
+
+def _loss_fn(y_pred, labels, Wh):
+    logits = y_pred @ Wh
+    lp = jax.nn.log_softmax(logits, -1)
+    return -jnp.mean(jnp.take_along_axis(lp, labels[..., None], -1))
+
+
+class Test1F1B:
+    def test_loss_and_all_grads_match_serial(self):
+        _setup()
+        L, d, M, mb = 8, 16, 6, 2
+        rng = np.random.default_rng(0)
+        W = jnp.asarray(rng.standard_normal((L, d, d)).astype("f") * 0.3)
+        Wh = jnp.asarray(rng.standard_normal((d, 3)).astype("f") * 0.3)
+        xs = jnp.asarray(rng.standard_normal((M, mb, d)).astype("f"))
+        ys = jnp.asarray(rng.integers(0, 3, (M, mb)))
+
+        def pipe_loss(W, Wh, xs):
+            return pipeline_1f1b(_block_fn, _loss_fn, W, Wh, (xs, ys))
+
+        def serial_loss(W, Wh, xs):
+            losses = []
+            for m in range(M):
+                x = xs[m]
+                for i in range(L):
+                    x = jnp.tanh(x @ W[i])
+                losses.append(_loss_fn(x, ys[m], Wh))
+            return jnp.mean(jnp.stack(losses))
+
+        lp, gp = jax.jit(jax.value_and_grad(pipe_loss, argnums=(0, 1, 2)))(
+            W, Wh, xs)
+        ls, gs = jax.jit(jax.value_and_grad(serial_loss, argnums=(0, 1, 2)))(
+            W, Wh, xs)
+        np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+        for a, b in zip(gp, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_micro_count_independent_of_stages(self):
+        # M not a multiple of pp, and M > 2(pp-1): schedule must not care
+        _setup()
+        L, d, M, mb = 4, 8, 7, 2
+        rng = np.random.default_rng(1)
+        W = jnp.asarray(rng.standard_normal((L, d, d)).astype("f") * 0.3)
+        Wh = jnp.asarray(rng.standard_normal((d, 2)).astype("f") * 0.3)
+        xs = jnp.asarray(rng.standard_normal((M, mb, d)).astype("f"))
+        ys = jnp.asarray(rng.integers(0, 2, (M, mb)))
+        lp = jax.jit(lambda W: pipeline_1f1b(
+            _block_fn, _loss_fn, W, Wh, (xs, ys)))(W)
+        ref = []
+        for m in range(M):
+            x = xs[m]
+            for i in range(L):
+                x = jnp.tanh(x @ W[i])
+            ref.append(_loss_fn(x, ys[m], Wh))
+        np.testing.assert_allclose(float(lp), float(np.mean(ref)),
+                                   rtol=1e-5)
+
+
+class TestPipelinedGPT:
+    def _model(self, n_micro=4):
+        from paddle_tpu.text.models.gpt import GPTConfig
+        from paddle_tpu.text.models.gpt_pipeline import (
+            PipelinedGPTForCausalLM)
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=8,
+                        num_heads=2, max_seq_len=32)
+        return PipelinedGPTForCausalLM(cfg, n_micro=n_micro), cfg
+
+    def test_pipeline_loss_matches_serial_forward(self):
+        _setup()
+        model, cfg = self._model()
+        ids = paddle.to_tensor(
+            np.random.default_rng(2).integers(0, 256, (8, 16)))
+        logits = model(ids).numpy()
+        lp = jax.nn.log_softmax(
+            jnp.asarray(logits[:, :-1], jnp.float32), -1)
+        ref = -np.mean(np.take_along_axis(np.asarray(lp),
+                                          ids.numpy()[:, 1:, None], -1))
+        loss = float(model.loss(ids).numpy())
+        np.testing.assert_allclose(loss, ref, rtol=1e-4)
+
+    def test_tied_embedding_grads_and_training(self):
+        _setup()
+        model, cfg = self._model()
+        ids = paddle.to_tensor(
+            np.random.default_rng(3).integers(0, 256, (8, 16)))
+        loss = model.loss(ids)
+        loss.backward()
+        assert model.wte.grad is not None  # embedding + head paths summed
+        assert model.stk_qkv_w.grad is not None
+
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        def loss_fn(m, ids):
+            return m.loss(ids)
+
+        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        l0 = float(step(ids).numpy())
+        for _ in range(6):
+            l = float(step(ids).numpy())
+        assert l < l0
