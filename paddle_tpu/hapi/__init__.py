@@ -1,0 +1,31 @@
+"""High-level training API (`paddle.Model` analog).
+
+Reference: python/paddle/hapi/model.py:915 (Model), :1574 (fit),
+:1802 (evaluate); python/paddle/hapi/callbacks.py:1.
+
+TPU-first design: `Model.fit` drives ONE compiled XLA program per train
+step (`paddle_tpu.jit.TrainStep` — loss + backward + optimizer update),
+instead of the reference's per-op dygraph hot loop; eval/predict forward
+passes are jit-cached per input signature. Callbacks run on host between
+steps and never enter the compiled program.
+"""
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+)
+from .model import Model, summary  # noqa: F401
+
+__all__ = [
+    "Model",
+    "summary",
+    "Callback",
+    "ProgBarLogger",
+    "ModelCheckpoint",
+    "LRScheduler",
+    "EarlyStopping",
+    "ReduceLROnPlateau",
+]

@@ -1,0 +1,145 @@
+"""hapi.Model high-level loop (reference: python/paddle/hapi/model.py:915,
+test model: python/paddle/tests/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import (EarlyStopping, LRScheduler, Model,
+                             ModelCheckpoint, ReduceLROnPlateau)
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class ToyDataset(Dataset):
+    """Linearly separable 2-class blobs."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype("float32")
+        w = rng.randn(8, 2).astype("float32")
+        self.y = np.argmax(self.x @ w, axis=1).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_model():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    m = Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    m.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    return m
+
+
+class TestModelFit:
+    def test_fit_reduces_loss_and_eval_acc(self):
+        m = make_model()
+        ds = ToyDataset(64)
+        first = m.train_batch([ds.x[:16]], [ds.y[:16]])[0]
+        logs = m.fit(ds, eval_data=ds, batch_size=16, epochs=4, verbose=0)
+        assert logs["loss"][0] < first
+        res = m.evaluate(ds, batch_size=16, verbose=0)
+        assert res["acc"] > 0.8
+        assert res["loss"] < first
+
+    def test_predict(self):
+        m = make_model()
+        ds = ToyDataset(32)
+        outs = m.predict(ds, batch_size=8, stack_outputs=True)
+        assert len(outs) == 1 and outs[0].shape == (32, 2)
+
+    def test_train_batch_matches_eager_step(self):
+        # compiled hapi train_batch must equal an explicit eager step
+        paddle.seed(7)
+        net_a = nn.Linear(4, 3)
+        paddle.seed(7)
+        net_b = nn.Linear(4, 3)
+        np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy())
+        x = np.random.RandomState(0).randn(5, 4).astype("float32")
+        y = np.array([0, 1, 2, 1, 0], dtype="int64")
+
+        m = Model(net_a)
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_a.parameters())
+        m.prepare(opt_a, nn.CrossEntropyLoss())
+        loss_c = m.train_batch([x], [y])[0]
+
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        loss_e = loss_fn(net_b(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss_e.backward()
+        opt_b.step()
+        np.testing.assert_allclose(loss_c, float(loss_e.numpy()), rtol=1e-5)
+        np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = make_model()
+        ds = ToyDataset(32)
+        m.fit(ds, batch_size=16, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt" / "m")
+        m.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        m2 = make_model()
+        m2.load(path)
+        x = ds.x[:4]
+        np.testing.assert_allclose(m.predict_batch([x])[0],
+                                   m2.predict_batch([x])[0], rtol=1e-6)
+
+    def test_summary_counts(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        info = paddle.summary(net)
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+
+
+class TestCallbacks:
+    def test_early_stopping_stops(self):
+        m = make_model()
+        ds = ToyDataset(64)
+        es = EarlyStopping(monitor="acc", patience=0, verbose=0,
+                           save_best_model=False)
+        m.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[es])
+        assert m.stop_training  # patience=0 trips on first non-improvement
+
+    def test_model_checkpoint_writes(self, tmp_path):
+        m = make_model()
+        ds = ToyDataset(32)
+        cb = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path))
+        m.fit(ds, batch_size=16, epochs=2, verbose=0, callbacks=[cb])
+        assert os.path.exists(str(tmp_path / "1") + ".pdparams")
+        assert os.path.exists(str(tmp_path / "final") + ".pdparams")
+
+    def test_lr_scheduler_callback_steps(self):
+        net = nn.Linear(8, 2)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                              gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        m = Model(net)
+        m.prepare(opt, nn.CrossEntropyLoss())
+        ds = ToyDataset(32)
+        m.fit(ds, batch_size=16, epochs=1, verbose=0,
+              callbacks=[LRScheduler(by_step=True)])
+        assert opt.get_lr() < 0.1
+
+    def test_reduce_lr_on_plateau(self):
+        m = make_model()
+        m._optimizer.set_lr(0.1)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})  # no improvement -> wait=1 >= patience
+        assert abs(m._optimizer.get_lr() - 0.05) < 1e-9
