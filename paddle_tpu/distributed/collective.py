@@ -118,6 +118,7 @@ def init_parallel_env(dp=None, mp=1, pp=1, sharding=1, sp=1, ep=1):
     With no arguments: all visible devices become the dp axis.
     """
     global _default_group, _initialized
+    env_mod.ensure_multihost_initialized()
     n = len(jax.devices())
     if dp is None:
         dp = n // (mp * pp * sharding * sp * ep)
@@ -185,6 +186,17 @@ def spmd(fn, in_specs, out_specs, group_axes=None, check_rep=False):
     return wrapper
 
 
+def _check_xproc_group(group):
+    """Eager multi-controller collectives operate over ALL trainer
+    processes; subgroups are an SPMD-region (mesh-axis) concept. Raise
+    rather than silently reducing over the wrong rank set."""
+    if group is not None and group is not _default_group:
+        raise RuntimeError(
+            "eager cross-process collectives support only the default "
+            "(world) group; use an SPMD region for subgroup collectives"
+        )
+
+
 def _in_spmd():
     return _spmd.active
 
@@ -216,6 +228,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     t = ensure_tensor(tensor)
     if not _in_spmd():
         g = group or _ensure_default()
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            # eager multi-controller path: rank == trainer process
+            _check_xproc_group(group)
+            red = xproc.all_reduce_np(np.asarray(t._value), op=op)
+            out = Tensor(jnp.asarray(red), stop_gradient=True)
+            if isinstance(tensor, Tensor):
+                tensor._value = out._value
+                return tensor
+            return out
         if g._static_size() == 1:
             return tensor
         raise RuntimeError(
@@ -243,6 +266,19 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     t = ensure_tensor(tensor)
     if not _in_spmd():
         g = group or _ensure_default()
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            _check_xproc_group(group)
+            mat = xproc.all_gather_np(np.asarray(t._value))
+            parts = [Tensor(jnp.asarray(mat[i]), stop_gradient=True)
+                     for i in range(mat.shape[0])]
+            if isinstance(tensor_list, list):
+                tensor_list.extend(parts)
+                return tensor_list
+            from ..ops.manipulation import concat as t_concat
+
+            return t_concat(parts, axis=axis)
         if g._static_size() == 1:
             if isinstance(tensor_list, list):
                 tensor_list.append(t)
@@ -265,7 +301,18 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def all_gather_object(object_list, obj, group=None):
-    # host-side object gather is single-process in SPMD design
+    """Gather picklable objects from every trainer process (reference:
+    collective.py:1056). Single-process: identity. Multi-controller:
+    length-prefixed byte gather over the compiled-collective path."""
+    from . import xproc
+
+    if xproc.is_multiprocess():
+        import pickle
+
+        _check_xproc_group(group)
+        blobs = xproc.all_gather_bytes(pickle.dumps(obj))
+        object_list.extend(pickle.loads(b) for b in blobs)
+        return object_list
     object_list.append(obj)
     return object_list
 
@@ -277,6 +324,15 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     t = ensure_tensor(tensor)
     if not _in_spmd():
         g = group or _ensure_default()
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            _check_xproc_group(group)
+            red = xproc.broadcast_np(np.asarray(t._value), src=src)
+            if isinstance(tensor, Tensor):
+                tensor._value = jnp.asarray(red)
+                return tensor
+            return Tensor(jnp.asarray(red), stop_gradient=True)
         if g._static_size() == 1:
             return tensor
         raise RuntimeError("broadcast across >1 ranks requires SPMD region")
@@ -389,7 +445,12 @@ def p2p_shift(tensor, group=None, offset=1):
 
 def barrier(group=None):
     if not _in_spmd():
-        # host-level: all devices synchronized by dispatch order already
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            _check_xproc_group(group)
+            xproc.barrier()
+        # single-process: devices synchronized by dispatch order already
         return
     return None
 

@@ -7,7 +7,44 @@ initialized; before that, the launcher env contract applies.
 """
 import os
 
-__all__ = ["get_rank", "get_world_size", "ParallelEnv"]
+__all__ = ["get_rank", "get_world_size", "ParallelEnv",
+           "ensure_multihost_initialized"]
+
+
+def ensure_multihost_initialized():
+    """Multi-controller bring-up: if the launcher env contract names a
+    coordinator and >1 trainers, run `jax.distributed.initialize` (the
+    TCPStore-rendezvous analog — reference distributed/parallel.py:94,248;
+    the KV store at PADDLE_MASTER plays the TCPStore role). Idempotent;
+    no-op for single-process jobs."""
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER", "")
+    if world <= 1 or not master:
+        return False
+    import jax
+
+    # A preloaded PJRT plugin (sitecustomize-style autoregistration) may
+    # have overridden the platform choice before user code ran; re-assert
+    # the env contract so all ranks come up on the same backend.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=master,
+            num_processes=world,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    except RuntimeError as e:
+        # benign: someone (us or the user) initialized already — jax raises
+        # "distributed.initialize should only be called once".
+        msg = str(e).lower()
+        if "once" not in msg and "already" not in msg:
+            raise
+    return True
 
 
 def get_rank(group=None):

@@ -1,0 +1,154 @@
+"""Distributed job launcher — ``python -m paddle_tpu.distributed.launch``.
+
+TPU-native re-design of the reference launcher
+(reference: python/paddle/distributed/launch/main.py:18 `launch()`,
+launch/controllers/collective.py:24 CollectiveController.build_pod).
+
+The reference spawns one process per GPU and hands each a NCCL rendezvous
+via PADDLE_TRAINER_ENDPOINTS.  On TPU the natural unit is one process per
+HOST (each process owns all local chips; XLA drives ICI/DCN collectives),
+so the launcher's job collapses to:
+
+  1. set the env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+     PADDLE_MASTER / ...) for each worker process,
+  2. point every worker at one coordinator (jax.distributed uses a
+     KV-store at PADDLE_MASTER the way the reference uses TCPStore —
+     reference: python/paddle/distributed/parallel.py:94),
+  3. babysit the pod: stream logs, propagate failures, optionally
+     restart (--max_restart, reference launch/controllers/controller.py).
+
+Workers call `paddle_tpu.distributed.init_parallel_env()` which picks up
+the contract and runs `jax.distributed.initialize` (multi-controller
+SPMD bring-up) before building the global mesh.
+
+For CPU-host testing, `--nproc_per_node N` on one node emulates N hosts
+(JAX gloo collectives connect the processes).
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "parse_args"]
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job.",
+    )
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (default: auto on one node)")
+    p.add_argument("--rank", type=int, default=0,
+                   help="rank of this node (0..nnodes-1)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes in the job")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node (1 per TPU host is "
+                        "the norm; >1 emulates a pod on CPU)")
+    p.add_argument("--log_dir", default="log", help="per-rank log directory")
+    p.add_argument("--job_id", default="default", help="job id for log names")
+    p.add_argument("--devices", default=None,
+                   help="restrict visible devices (sets TPU_VISIBLE_DEVICES)")
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="restart the pod up to N times on failure")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank, master):
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    rank = args.rank * nproc + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_LOCAL_SIZE": str(nproc),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_JOB_ID": args.job_id,
+    })
+    if args.devices is not None:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def _spawn_pod(args, master):
+    """Start nproc_per_node workers; local rank 0 inherits the console."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    for lr in range(args.nproc_per_node):
+        env = _worker_env(args, lr, master)
+        rank = env["PADDLE_TRAINER_ID"]
+        if lr == 0:
+            out = None  # inherit
+        else:
+            # append so logs from failed attempts survive --max_restart
+            out = open(os.path.join(
+                args.log_dir, f"{args.job_id}.rank{rank}.log"), "a")
+        procs.append((subprocess.Popen(
+            cmd, env=env, stdout=out,
+            stderr=subprocess.STDOUT if out else None), out))
+    return procs
+
+
+def _wait_pod(procs, poll_s=0.2):
+    """Block until all exit ok or one fails (then kill the rest)."""
+    alive = {i: p for i, (p, _) in enumerate(procs)}
+    failed_rc = 0
+    while alive and not failed_rc:
+        time.sleep(poll_s)
+        for i, p in list(alive.items()):
+            rc = p.poll()
+            if rc is None:
+                continue
+            del alive[i]
+            if rc != 0:
+                failed_rc = rc
+    for p in alive.values():
+        p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in alive.values():
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for _, out in procs:
+        if out:
+            out.close()
+    return failed_rc
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    if args.training_script_args[:1] == ["--"]:
+        args.training_script_args = args.training_script_args[1:]
+    master = args.master
+    if master is None:
+        if args.nnodes > 1:
+            sys.exit("--master is required when --nnodes > 1")
+        master = f"127.0.0.1:{_free_port()}"
+    attempts = args.max_restart + 1
+    for attempt in range(attempts):
+        if attempt:
+            print(f"[launch] pod failed; restart {attempt}/{args.max_restart}",
+                  file=sys.stderr, flush=True)
+        procs = _spawn_pod(args, master)
+        rc = _wait_pod(procs)
+        if rc == 0:
+            return 0
+    sys.exit(rc)

@@ -1,0 +1,114 @@
+"""Eager cross-process collectives (multi-controller path).
+
+The reference's eager `dist.all_reduce` is a runtime NCCL call between
+trainer processes (reference: python/paddle/distributed/collective.py:751,
+paddle/fluid/distributed/collective/ProcessGroupNCCL.cc).  The TPU-native
+equivalent: each trainer process is one JAX controller; an eager
+collective is a tiny jitted SPMD program over a 1-D "proc" mesh holding
+one representative device per process.  XLA lowers it to ICI/DCN (gloo on
+CPU hosts) — no sidecar runtime, same compiled-collective machinery as
+the in-graph path.
+
+Rank semantics match the reference: rank == trainer process index.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["is_multiprocess", "all_reduce_np", "all_gather_np",
+           "broadcast_np", "barrier", "all_gather_bytes"]
+
+_REDUCERS = {
+    "sum": lambda x, ax: lax.psum(x, ax),
+    "avg": lambda x, ax: lax.pmean(x, ax),
+    "max": lambda x, ax: lax.pmax(x, ax),
+    "min": lambda x, ax: lax.pmin(x, ax),
+    # gather-then-multiply: exact for negatives/zeros/ints (log-sum-exp isn't)
+    "prod": lambda x, ax: jnp.prod(lax.all_gather(x, ax, axis=0), axis=0),
+}
+
+
+def is_multiprocess():
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def _proc_mesh():
+    """1-D mesh with one representative device per process, rank-ordered."""
+    reps = {}
+    for d in jax.devices():
+        reps.setdefault(d.process_index, d)
+    devs = [reps[i] for i in sorted(reps)]
+    return Mesh(np.array(devs), ("proc",))
+
+
+_cache = {}
+
+
+def _run(kind, nparr, op="sum", src=0):
+    mesh = _proc_mesh()
+    key = (kind, nparr.shape, str(nparr.dtype), op, src)
+    if key not in _cache:
+        if kind == "all_reduce":
+            f = shard_map(lambda x: _REDUCERS[op](x, "proc"), mesh=mesh,
+                          in_specs=P("proc"), out_specs=P("proc"))
+        elif kind == "all_gather":
+            f = shard_map(
+                lambda x: lax.all_gather(x, "proc", axis=0, tiled=True),
+                mesh=mesh, in_specs=P("proc"), out_specs=P(),
+                check_vma=False)
+        elif kind == "broadcast":
+            f = shard_map(
+                lambda x: lax.all_gather(x, "proc", axis=0,
+                                         tiled=True)[src][None],
+                mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+                check_vma=False)
+        else:
+            raise ValueError(kind)
+        _cache[key] = jax.jit(f)
+    sharding = NamedSharding(mesh, P("proc"))
+    garr = jax.make_array_from_process_local_data(sharding, nparr[None])
+    return _cache[key](garr)
+
+
+def all_reduce_np(nparr, op="sum"):
+    """nparr (local value) -> reduced np.ndarray, same shape."""
+    out = _run("all_reduce", np.ascontiguousarray(nparr), op=op)
+    return np.asarray(out.addressable_data(0))[0]
+
+
+def all_gather_np(nparr):
+    """nparr (local value) -> stacked (world,)+shape np.ndarray."""
+    out = _run("all_gather", np.ascontiguousarray(nparr))
+    return np.asarray(out.addressable_data(0))
+
+
+def broadcast_np(nparr, src=0):
+    out = _run("broadcast", np.ascontiguousarray(nparr), src=src)
+    return np.asarray(out.addressable_data(0))[0]
+
+
+def barrier():
+    """Completion of a psum across all processes is a barrier."""
+    all_reduce_np(np.zeros((1,), np.float32))
+
+
+def all_gather_bytes(payload: bytes, max_len=1 << 20):
+    """Gather variable-length byte strings (pickled objects) — the
+    reference's all_gather_object (collective.py:1056) over the same
+    compiled-collective path: length-prefixed, padded uint8 buffers."""
+    n = len(payload)
+    lens = all_gather_np(np.array([n], np.int32))[:, 0]
+    width = int(lens.max())
+    if width > max_len:
+        # raise on ALL ranks (post-gather) so no peer is left blocking
+        raise ValueError(f"object too large to gather ({width} > {max_len})")
+    buf = np.zeros((width,), np.uint8)
+    buf[:n] = np.frombuffer(payload, np.uint8)
+    mat = all_gather_np(buf)
+    return [mat[i, : int(lens[i])].tobytes() for i in range(len(lens))]

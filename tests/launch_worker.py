@@ -1,0 +1,46 @@
+"""Worker used by test_launch.py (run via paddle_tpu.distributed.launch)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+
+    t = paddle.to_tensor(np.full((2, 3), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": f"r{rank}"})
+
+    b = paddle.to_tensor(np.full((4,), float(rank * 10 + 7), np.float32))
+    dist.broadcast(b, src=1)
+
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(
+        np.full((1, 2), float(rank), np.float32)))
+
+    dist.barrier()
+    with open(os.path.join(out_dir, f"out_{rank}.json"), "w") as f:
+        json.dump({
+            "rank": rank,
+            "world": world,
+            "allreduce": t.numpy().tolist(),
+            "objs": objs,
+            "bcast": b.numpy().tolist(),
+            "gathered": [g.numpy().tolist() for g in gathered],
+        }, f)
+
+
+if __name__ == "__main__":
+    main()
