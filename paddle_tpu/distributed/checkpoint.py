@@ -1,0 +1,503 @@
+"""Sharded, asynchronous, atomic checkpointing.
+
+TPU-native re-design of the reference checkpoint stack (reference:
+python/paddle/framework/io.py:574 `paddle.save`, :791 `paddle.load`;
+sharded gathering in fleet/meta_parallel/sharding/group_sharded_stage3.py:60
+state_dict; auto-checkpoint fleet/utils/fs.py + incubate checkpoint).
+
+Key differences from the reference design:
+
+- **No gather on save.** The reference's stage-3 `state_dict()` all-gathers
+  full params onto rank 0 before writing. Here every process writes only
+  its *addressable* array shards (`Array.addressable_shards`), so a ZeRO-3
+  / TP-sharded model checkpoints with zero cross-device traffic.
+- **Async by construction.** Device→host copies are started with
+  `copy_to_host_async()` for every shard up front; with `async_save=True`
+  file writes happen on a background thread while training continues
+  (reference FLAGS_save_* has no async path).
+- **Atomic commit.** Everything is written into `<dir>.tmp` and renamed
+  into place after `meta.json` (the commit record) is complete — a killed
+  job never leaves a half-checkpoint that `load_latest` would pick up.
+
+Layout::
+
+    ckpt-000042/
+      meta.json            # commit marker: leaf table, shapes, dtypes
+      shards/<leaf>#<k>.npy
+
+Multi-controller jobs: each process writes its own shard files plus a
+``meta.rank<r>.json`` fragment; rank 0 merges fragments and commits.
+"""
+import hashlib
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor_core import Parameter, Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "Checkpointer"]
+
+_META = "meta.json"
+
+
+# ---------------------------------------------------------------- flatten
+
+def _flatten(obj, path=(), list_paths=None):
+    """Nested dict/list → [(path_tuple, leaf)]. Leaves: Tensor/jax/np
+    arrays or JSON-able scalars. `list_paths` (a set, when given) records
+    paths of list/tuple nodes so load can restore them as lists."""
+    if isinstance(obj, dict):
+        out = []
+        for k, v in obj.items():
+            out += _flatten(v, path + (str(k),), list_paths)
+        return out
+    if isinstance(obj, (list, tuple)) and not _is_leaf(obj):
+        if list_paths is not None:
+            list_paths.add("/".join(path))
+        out = []
+        for i, v in enumerate(obj):
+            out += _flatten(v, path + (str(i),), list_paths)
+        return out
+    return [(path, obj)]
+
+
+def _is_leaf(obj):
+    return isinstance(obj, (Tensor, jax.Array, np.ndarray, str, bytes,
+                            int, float, bool, type(None)))
+
+
+def _leaf_name(path):
+    tail = "_".join(path[-2:]) if path else "leaf"
+    safe = "".join(c if c.isalnum() or c in "._-" else "-" for c in tail)
+    return f"{safe}.{hashlib.sha1('/'.join(path).encode()).hexdigest()[:10]}"
+
+
+def _nest(flat, list_paths=()):
+    """[(path, value)] → nested dicts; nodes recorded in `list_paths`
+    (saved-side list/tuple containers, e.g. an LR scheduler's milestones)
+    come back as lists ordered by integer key."""
+    root = {}
+    for path, v in flat:
+        d = root
+        for k in path[:-1]:
+            d = d.setdefault(k, {})
+        d[path[-1]] = v
+
+    def _relist(node, path):
+        if not isinstance(node, dict):
+            return node
+        out = {k: _relist(v, path + (k,)) for k, v in node.items()}
+        if "/".join(path) in list_paths:
+            return [out[k] for k in sorted(out, key=int)]
+        return out
+
+    return _relist(root, ())
+
+
+_SAFE_NPY = {"float64", "float32", "float16", "int64", "int32", "int16",
+             "int8", "uint8", "uint16", "uint32", "uint64", "bool"}
+_VIEW_FOR_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _to_storage(nparr):
+    """(storage_array, logical_dtype_str). bf16/fp8 etc. are stored as
+    same-itemsize uints — npy would silently degrade them to void."""
+    dt = str(nparr.dtype)
+    if dt in _SAFE_NPY:
+        return nparr, dt
+    view = _VIEW_FOR_SIZE[nparr.dtype.itemsize]
+    return nparr.view(view), dt
+
+
+def _from_storage(nparr, logical_dtype):
+    if str(nparr.dtype) == logical_dtype:
+        return nparr
+    return nparr.view(np.dtype(logical_dtype))  # ml_dtypes registers bf16 etc.
+
+
+# ------------------------------------------------------------------- save
+
+def _proc_index():
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def save_state_dict(state, path, async_save=False):
+    """Write `state` (nested dict of Tensors / arrays / scalars) to
+    directory `path`. Every process saves only its addressable shards.
+    Returns a handle with .result() (joins the writer; re-raises errors);
+    with async_save=False the write is complete on return."""
+    rank, nproc = _proc_index()
+    if async_save and nproc > 1:
+        # the writer thread's merge barriers would race any collective the
+        # main thread issues meanwhile (mismatched programs → hang); the
+        # multi-controller path is synchronous by design
+        async_save = False
+    tmp = path + ".tmp"
+    if rank == 0:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(os.path.join(tmp, "shards"), exist_ok=True)
+    if nproc > 1:
+        from . import xproc
+
+        xproc.barrier()  # tmp dir exists before anyone writes
+        os.makedirs(os.path.join(tmp, "shards"), exist_ok=True)
+
+    leaves, scalars, pending = [], {}, []
+    list_paths, bytes_paths = set(), []
+    for p, leaf in _flatten(state, list_paths=list_paths):
+        key = "/".join(p)
+        if isinstance(leaf, Tensor):
+            leaf = leaf._value
+        if isinstance(leaf, (jax.Array, np.ndarray)) and getattr(
+                leaf, "ndim", 0) >= 0 and not isinstance(leaf, (str, bytes)):
+            arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+            entry = {"path": key, "shape": list(arr.shape),
+                     "dtype": str(arr.dtype), "shards": []}
+            base = _leaf_name(p)
+            for k, sh in enumerate(arr.addressable_shards):
+                if sh.replica_id != 0:
+                    continue
+                idx = [[(s.start or 0),
+                        (s.stop if s.stop is not None else dim)]
+                       for s, dim in zip(sh.index, arr.shape)]
+                fname = f"{base}#r{rank}s{k}.npy"
+                entry["shards"].append({"index": idx, "file": fname})
+                try:
+                    sh.data.copy_to_host_async()
+                except Exception:
+                    pass
+                pending.append((os.path.join(tmp, "shards", fname), sh.data))
+            leaves.append(entry)
+        else:
+            if isinstance(leaf, bytes):
+                bytes_paths.append(key)
+                leaf = leaf.decode("latin1")
+            scalars[key] = leaf
+
+    # Snapshot to host NOW: compiled steps donate param/opt buffers, so a
+    # device array held past this call may be deleted under the writer
+    # thread. copy_to_host_async above pipelined the D2H transfers; this
+    # loop mostly just collects them. Only file I/O is deferred.
+    pending = [(fpath, np.asarray(dev_arr)) for fpath, dev_arr in pending]
+
+    def _write():
+        for fpath, host_arr in pending:
+            storage, _ = _to_storage(host_arr)
+            np.save(fpath, storage)
+        frag = {"leaves": leaves, "scalars": scalars,
+                "lists": sorted(list_paths), "bytes": bytes_paths}
+        if nproc > 1:
+            with open(os.path.join(tmp, f"meta.rank{rank}.json"), "w") as f:
+                json.dump(frag, f)
+            from . import xproc
+
+            xproc.barrier()  # all fragments + shards on disk
+            if rank == 0:
+                seen_scalars, by_path = {}, {}
+                lists, byts = set(), set()
+                for r in range(nproc):
+                    with open(os.path.join(
+                            tmp, f"meta.rank{r}.json")) as f:
+                        fr = json.load(f)
+                    seen_scalars.update(fr["scalars"])
+                    lists.update(fr["lists"])
+                    byts.update(fr["bytes"])
+                    for e in fr["leaves"]:
+                        tgt = by_path.setdefault(e["path"], e)
+                        if tgt is not e:
+                            tgt["shards"] += e["shards"]
+                _commit(tmp, path, list(by_path.values()), seen_scalars,
+                        sorted(lists), sorted(byts))
+            xproc.barrier()  # commit visible before anyone proceeds
+        else:
+            _commit(tmp, path, leaves, scalars, sorted(list_paths),
+                    bytes_paths)
+
+    if async_save:
+        h = _AsyncHandle(_write)
+        h.start()
+        return h
+    _write()
+    return _DoneHandle()
+
+
+def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=()):
+    with open(os.path.join(tmp, _META), "w") as f:
+        json.dump({"leaves": leaves, "scalars": scalars,
+                   "lists": list(list_paths),
+                   "bytes": list(bytes_paths)}, f)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+
+
+class _AsyncHandle(threading.Thread):
+    def __init__(self, fn):
+        super().__init__(daemon=True)
+        self._fn = fn
+        self._err = None
+
+    def run(self):
+        try:
+            self._fn()
+        except BaseException as e:  # surfaced in result()
+            self._err = e
+
+    def result(self):
+        self.join()
+        if self._err is not None:
+            raise self._err
+
+
+class _DoneHandle:
+    def result(self):
+        return None
+
+
+# ------------------------------------------------------------------- load
+
+def is_complete(path):
+    return os.path.isfile(os.path.join(path, _META))
+
+
+def load_state_dict(path, shardings=None, return_numpy=False):
+    """Load a checkpoint directory into a nested dict. Array leaves come
+    back as Tensors (or numpy with return_numpy=True). `shardings` maps
+    leaf path ("a/b/c") → jax.sharding.Sharding to place a leaf sharded
+    (only the locally-needed regions are copied to each device; shard
+    files are memory-mapped, so an N-way-sharded leaf never materializes
+    fully per-host)."""
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    flat = []
+    for e in meta["leaves"]:
+        shape = tuple(e["shape"])
+        dtype = e["dtype"]
+        mmaps = []
+        for srec in e["shards"]:
+            m = np.load(os.path.join(path, "shards", srec["file"]),
+                        mmap_mode="r")
+            mmaps.append((tuple((a, b) for a, b in srec["index"]), m))
+
+        def _region(idx, _mm=mmaps, _shape=shape, _dt=dtype):
+            """Assemble the region `idx` (tuple of slices) from shards."""
+            starts = [s.start or 0 for s in idx]
+            stops = [s.stop if s.stop is not None else d
+                     for s, d in zip(idx, _shape)]
+            out = np.empty([b - a for a, b in zip(starts, stops)],
+                           dtype=np.dtype(_mm[0][1].dtype))
+            for bounds, m in _mm:
+                inter = [(max(a, s), min(b, e))
+                         for (a, b), s, e in zip(bounds, starts, stops)]
+                if any(lo >= hi for lo, hi in inter):
+                    continue
+                src = tuple(slice(lo - a, hi - a)
+                            for (a, _), (lo, hi) in zip(bounds, inter))
+                dst = tuple(slice(lo - s, hi - s)
+                            for s, (lo, hi) in zip(starts, inter))
+                out[dst] = m[src]
+            return _from_storage(out, _dt)
+
+        key = e["path"]
+        sh = (shardings or {}).get(key)
+        if sh is not None:
+            arr = jax.make_array_from_callback(shape, sh, _region)
+        else:
+            full = _region(tuple(slice(0, d) for d in shape))
+            arr = np.asarray(full) if return_numpy else jnp.asarray(full)
+        flat.append((tuple(key.split("/")),
+                     arr if return_numpy else Tensor(arr)))
+    byts = set(meta.get("bytes", ()))
+    for key, v in meta["scalars"].items():
+        if key in byts:
+            v = v.encode("latin1")
+        flat.append((tuple(key.split("/")), v))
+    return _nest(flat, set(meta.get("lists", ())))
+
+
+# ----------------------------------------------------------- Checkpointer
+
+class Checkpointer:
+    """Train-loop checkpoint manager (reference auto-checkpoint /
+    fleet.utils fs checkpoint + hapi callbacks ModelCheckpoint).
+
+    save(step) captures model params, optimizer accumulators + LR-scheduler
+    state, and a compiled train step's device-side opt states; keeps the
+    newest `keep` checkpoints; `async_save` overlaps file writes with
+    training. load_latest() restores everything and returns the step (or
+    None if no complete checkpoint exists)."""
+
+    def __init__(self, root, model=None, optimizer=None, train_step=None,
+                 keep=3, async_save=False):
+        self.root = root
+        self.model = model
+        self.train_step = train_step
+        self.optimizer = optimizer or (
+            train_step.optimizer if train_step is not None else None)
+        self.keep = keep
+        self.async_save = async_save
+        self._last = None
+
+    def _dir(self, step):
+        return os.path.join(self.root, f"ckpt-{step:08d}")
+
+    def _name_maps(self):
+        """param.name ↔ structural-key maps. Parameter.name comes from a
+        process-global counter, so it differs across re-instantiation;
+        checkpoints must be keyed by the structural state_dict key."""
+        by_pname, by_struct = {}, {}
+        if self.model is not None:
+            for sname, p in self.model.state_dict().items():
+                by_pname[p.name] = sname
+                by_struct[sname] = p.name
+        return by_pname, by_struct
+
+    @staticmethod
+    def _remap_opt_keys(sd, mapping):
+        """optimizer.state_dict keys look like f'{param.name}_{acc}';
+        rewrite the param.name prefix via mapping (longest-prefix match).
+        Non-param keys (@step, LR_Scheduler) pass through."""
+        pnames = sorted(mapping, key=len, reverse=True)
+        out = {}
+        for k, v in sd.items():
+            nk = k
+            for pn in pnames:
+                if k.startswith(pn + "_"):
+                    nk = mapping[pn] + k[len(pn):]
+                    break
+            out[nk] = v
+        return out
+
+    def save(self, step):
+        self.wait()
+        state = {"step": int(step)}
+        if self.model is not None:
+            state["model"] = dict(self.model.state_dict())
+        if self.optimizer is not None:
+            by_pname, _ = self._name_maps()
+            state["optimizer"] = self._remap_opt_keys(
+                self.optimizer.state_dict(), by_pname)
+        if self.train_step is not None:
+            opt_sd = _train_step_opt_states(self.train_step)
+            if opt_sd:
+                state["train_step_opt"] = opt_sd
+        self._last = save_state_dict(state, self._dir(step),
+                                     async_save=self.async_save)
+        self._prune()
+        return self._last
+
+    def wait(self):
+        if self._last is not None:
+            self._last.result()
+            self._last = None
+
+    def _prune(self):
+        if not self.keep:
+            return
+        rank, _ = _proc_index()
+        if rank != 0:
+            return
+        steps = sorted(self.steps())
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(self._dir(s), ignore_errors=True)
+
+    def steps(self):
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for d in os.listdir(self.root):
+            if d.startswith("ckpt-") and is_complete(
+                    os.path.join(self.root, d)):
+                try:
+                    out.append(int(d.split("-")[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def load_latest(self):
+        steps = self.steps()
+        if not steps:
+            return None
+        return self.load(steps[-1])
+
+    def load(self, step):
+        # place param leaves straight onto their current shardings (ZeRO/TP)
+        shardings = {}
+        if self.model is not None:
+            for name, p in self.model.state_dict().items():
+                if isinstance(p._value, jax.Array):
+                    shardings[f"model/{name}"] = p._value.sharding
+        ts = self.train_step
+        if ts is not None and getattr(ts, "_opt_states", None):
+            # accumulators of a live compiled step load shard-for-shard
+            # too (they are 2x param bytes under Adam — never assemble
+            # them fully per host)
+            for n, st in zip(_train_names(ts), ts._opt_states):
+                for k, v in st.items():
+                    if isinstance(v, jax.Array):
+                        shardings[f"train_step_opt/{n}/{k}"] = v.sharding
+        state = load_state_dict(self._dir(step), shardings=shardings)
+        if self.model is not None and "model" in state:
+            sd = self.model.state_dict()
+            for name, p in sd.items():
+                if name in state["model"]:
+                    p._value = state["model"][name]._value.astype(
+                        p._value.dtype)
+        if self.optimizer is not None and "optimizer" in state:
+            _, by_struct = self._name_maps()
+            self.optimizer.set_state_dict(self._remap_opt_keys(
+                state["optimizer"], by_struct))
+        if self.train_step is not None and "train_step_opt" in state:
+            _restore_train_step_opt(self.train_step,
+                                    state["train_step_opt"])
+        return int(state["step"])
+
+
+def _train_names(ts):
+    """Structural (state_dict-key) names of trainable params — stable
+    across model re-instantiation, unlike global Parameter.name counters."""
+    return [n for n, t in zip(ts._names, ts._trainable) if t]
+
+
+def _train_step_opt_states(ts):
+    """Device-side accumulator tree of a compiled TrainStep /
+    DistributedTrainStep, keyed structural-param-name → accumulator."""
+    if getattr(ts, "_opt_states", None) is None:
+        return {}
+    if all(not st for st in ts._opt_states):
+        return {}  # stateless optimizer (SGD) — nothing to record
+    return {n: dict(st)
+            for n, st in zip(_train_names(ts), ts._opt_states)}
+
+
+def _restore_train_step_opt(ts, opt_sd):
+    names = _train_names(ts)
+    missing = [n for n in names if n not in opt_sd]
+    if missing:
+        raise ValueError(
+            f"checkpoint is missing optimizer state for params {missing}; "
+            "model structure differs from the one checkpointed")
+    old = ts._opt_states
+    states = []
+    for i, n in enumerate(names):
+        st = opt_sd[n]
+        d = {}
+        for k, v in st.items():
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if old is not None and isinstance(old[i].get(k), jax.Array):
+                # step already ran: re-place onto the live sharding (the
+                # _build-time device_put won't run again)
+                val = jax.device_put(val, old[i][k].sharding)
+            d[k] = val
+        states.append(d)
+    ts._opt_states = states

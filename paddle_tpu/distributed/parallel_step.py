@@ -168,6 +168,10 @@ class DistributedTrainStep:
         states = self.optimizer.init_states_tree(
             [p._value for p in train_objs])
         s_sh = self._state_shardings(train_objs, states)
+        if self._opt_states is not None:
+            # restored from a checkpoint before the first step — keep the
+            # values, (re)place them on the computed shardings
+            states = self._opt_states
         if self.batch_specs is not None:
             b_sh = [NamedSharding(mesh, s) for s in self.batch_specs]
         else:
