@@ -118,6 +118,29 @@ class GradNode:
         return f"GradNode({self.name})"
 
 
+def _nan_guard(name, outs):
+    """FLAGS_check_nan_inf watchdog (reference: paddle/fluid/framework/
+    operator.cc:1460 CheckOpHasNanOrInf + details/nan_inf_utils). Eager
+    per-op scan attributing the first non-finite output to its op; under
+    an outer trace the values are Tracers and the jit-level check
+    (jax_debug_nans, toggled by the same flag) takes over."""
+    from ..core import flags as flags_mod
+
+    if not flags_mod.get_flag("check_nan_inf"):
+        return
+    seq = outs if isinstance(outs, (tuple, list)) else (outs,)
+    for i, o in enumerate(seq):
+        if isinstance(o, jax.core.Tracer):
+            return
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
+            if not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"NaN or Inf detected in output {i} of op '{name}' "
+                    f"(shape {tuple(o.shape)}, dtype {o.dtype}) — "
+                    "FLAGS_check_nan_inf is enabled"
+                )
+
+
 def apply(name, jfn, tensors, n_outputs=None):
     """Run `jfn(*[t.value])`, recording a GradNode if grad is needed.
 
@@ -134,6 +157,7 @@ def apply(name, jfn, tensors, n_outputs=None):
     need = _state.grad_enabled and any(not t.stop_gradient for t in tensors)
     if not need:
         out = jfn(*vals)
+        _nan_guard(name, out)
         if isinstance(out, (tuple, list)):
             return tuple(wrap(o, True) for o in out)
         return wrap(out, True)
@@ -150,6 +174,7 @@ def apply(name, jfn, tensors, n_outputs=None):
     else:
         outs, vjp_fn = jax.vjp(jfn, *vals)
         deferred = None
+    _nan_guard(name, outs)
     multi = isinstance(outs, (tuple, list))
     outs_t = tuple(outs) if multi else (outs,)
     out_meta = [(o.shape, o.dtype) for o in outs_t]

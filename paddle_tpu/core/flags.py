@@ -11,6 +11,13 @@ import threading
 
 _lock = threading.Lock()
 _registry = {}
+_hooks = {}
+
+
+def on_flag_change(name, fn):
+    """Register fn(new_value) to run whenever `name` is set via
+    set_flags (the reference's flag-callback pattern in flags.cc)."""
+    _hooks.setdefault(name, []).append(fn)
 
 
 class _FlagInfo:
@@ -53,14 +60,20 @@ def get_flags(names=None):
 
 
 def set_flags(flags):
+    changed = []
     with _lock:
         for name, value in flags.items():
             name = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
             if name not in _registry:
                 _registry[name] = _FlagInfo(name, value, "")
+                changed.append((name, value))
             else:
                 info = _registry[name]
                 info.value = _coerce(info.type, value)
+                changed.append((name, info.value))
+    for name, value in changed:
+        for fn in _hooks.get(name, ()):
+            fn(value)
 
 
 def get_flag(name):
@@ -69,6 +82,25 @@ def get_flag(name):
 
 # Core flags (subset of reference's 74; grown as subsystems land).
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug)")
+
+
+def _sync_debug_nans(v):
+    """check_nan_inf covers compiled programs too: jax_debug_nans re-runs
+    a jitted computation op-by-op on a NaN so the failing primitive is
+    attributed (the in-jit analog of the eager per-op scan)."""
+    try:
+        import jax
+
+        jax.config.update("jax_debug_nans", bool(v))
+    except Exception:
+        pass
+
+
+on_flag_change("check_nan_inf", _sync_debug_nans)
+# the env var (FLAGS_check_nan_inf=1) seeds the value without firing
+# hooks — sync the jit-level check once at import
+if get_flag("check_nan_inf"):
+    _sync_debug_nans(True)
 define_flag("allocator_strategy", "xla", "memory handled by XLA/PJRT on TPU")
 define_flag("eager_delete_tensor_gb", 0.0, "no-op: XLA owns buffers")
 define_flag("use_pallas_kernels", True, "use pallas kernels for hot ops on TPU")

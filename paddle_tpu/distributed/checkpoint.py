@@ -39,7 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..tensor_core import Parameter, Tensor
+from ..tensor_core import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "Checkpointer"]
 
@@ -53,6 +53,8 @@ def _flatten(obj, path=(), list_paths=None):
     arrays or JSON-able scalars. `list_paths` (a set, when given) records
     paths of list/tuple nodes so load can restore them as lists."""
     if isinstance(obj, dict):
+        if not obj:
+            return [(path, _EMPTY_DICT)]
         out = []
         for k, v in obj.items():
             out += _flatten(v, path + (str(k),), list_paths)
@@ -60,11 +62,22 @@ def _flatten(obj, path=(), list_paths=None):
     if isinstance(obj, (list, tuple)) and not _is_leaf(obj):
         if list_paths is not None:
             list_paths.add("/".join(path))
+        if not obj:
+            return [(path, _EMPTY_LIST)]
         out = []
         for i, v in enumerate(obj):
             out += _flatten(v, path + (str(i),), list_paths)
         return out
     return [(path, obj)]
+
+
+class _Sentinel:
+    def __init__(self, tag):
+        self.tag = tag
+
+
+_EMPTY_DICT = _Sentinel("__empty_dict__")
+_EMPTY_LIST = _Sentinel("__empty_list__")
 
 
 def _is_leaf(obj):
@@ -154,12 +167,21 @@ def save_state_dict(state, path, async_save=False):
 
     leaves, scalars, pending = [], {}, []
     list_paths, bytes_paths = set(), []
+    empties = {}
     for p, leaf in _flatten(state, list_paths=list_paths):
+        if any("/" in comp for comp in p):
+            raise ValueError(
+                f"state dict key {p!r} contains '/', which is the path "
+                "separator — rename the key")
         key = "/".join(p)
         if isinstance(leaf, Tensor):
             leaf = leaf._value
-        if isinstance(leaf, (jax.Array, np.ndarray)) and getattr(
-                leaf, "ndim", 0) >= 0 and not isinstance(leaf, (str, bytes)):
+        if isinstance(leaf, _Sentinel):
+            empties[key] = leaf.tag
+            continue
+        if isinstance(leaf, np.generic):  # numpy scalar → python scalar
+            leaf = leaf.item()
+        if isinstance(leaf, (jax.Array, np.ndarray)):
             arr = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
             entry = {"path": key, "shape": list(arr.shape),
                      "dtype": str(arr.dtype), "shards": []}
@@ -195,7 +217,8 @@ def save_state_dict(state, path, async_save=False):
             storage, _ = _to_storage(host_arr)
             np.save(fpath, storage)
         frag = {"leaves": leaves, "scalars": scalars,
-                "lists": sorted(list_paths), "bytes": bytes_paths}
+                "lists": sorted(list_paths), "bytes": bytes_paths,
+                "empties": empties}
         if nproc > 1:
             with open(os.path.join(tmp, f"meta.rank{rank}.json"), "w") as f:
                 json.dump(frag, f)
@@ -203,7 +226,7 @@ def save_state_dict(state, path, async_save=False):
 
             xproc.barrier()  # all fragments + shards on disk
             if rank == 0:
-                seen_scalars, by_path = {}, {}
+                seen_scalars, by_path, empt = {}, {}, {}
                 lists, byts = set(), set()
                 for r in range(nproc):
                     with open(os.path.join(
@@ -212,16 +235,17 @@ def save_state_dict(state, path, async_save=False):
                     seen_scalars.update(fr["scalars"])
                     lists.update(fr["lists"])
                     byts.update(fr["bytes"])
+                    empt.update(fr.get("empties", {}))
                     for e in fr["leaves"]:
                         tgt = by_path.setdefault(e["path"], e)
                         if tgt is not e:
                             tgt["shards"] += e["shards"]
                 _commit(tmp, path, list(by_path.values()), seen_scalars,
-                        sorted(lists), sorted(byts))
+                        sorted(lists), sorted(byts), empt)
             xproc.barrier()  # commit visible before anyone proceeds
         else:
             _commit(tmp, path, leaves, scalars, sorted(list_paths),
-                    bytes_paths)
+                    bytes_paths, empties)
 
     if async_save:
         h = _AsyncHandle(_write)
@@ -231,11 +255,13 @@ def save_state_dict(state, path, async_save=False):
     return _DoneHandle()
 
 
-def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=()):
+def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=(),
+            empties=None):
     with open(os.path.join(tmp, _META), "w") as f:
         json.dump({"leaves": leaves, "scalars": scalars,
                    "lists": list(list_paths),
-                   "bytes": list(bytes_paths)}, f)
+                   "bytes": list(bytes_paths),
+                   "empties": empties or {}}, f)
     if os.path.isdir(path):
         shutil.rmtree(path)
     os.replace(tmp, path)
@@ -322,6 +348,9 @@ def load_state_dict(path, shardings=None, return_numpy=False):
         if key in byts:
             v = v.encode("latin1")
         flat.append((tuple(key.split("/")), v))
+    for key, tag in meta.get("empties", {}).items():
+        flat.append((tuple(key.split("/")),
+                     {} if tag == "__empty_dict__" else []))
     return _nest(flat, set(meta.get("lists", ())))
 
 
@@ -449,10 +478,14 @@ class Checkpointer:
         state = load_state_dict(self._dir(step), shardings=shardings)
         if self.model is not None and "model" in state:
             sd = self.model.state_dict()
+            missing = [n for n in sd if n not in state["model"]]
+            if missing:
+                raise ValueError(
+                    f"checkpoint is missing model params {missing}; "
+                    "model structure differs from the one checkpointed")
             for name, p in sd.items():
-                if name in state["model"]:
-                    p._value = state["model"][name]._value.astype(
-                        p._value.dtype)
+                p._value = state["model"][name]._value.astype(
+                    p._value.dtype)
         if self.optimizer is not None and "optimizer" in state:
             _, by_struct = self._name_maps()
             self.optimizer.set_state_dict(self._remap_opt_keys(

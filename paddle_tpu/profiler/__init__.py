@@ -1,0 +1,236 @@
+"""paddle_tpu.profiler — performance tracing + step timing.
+
+TPU-native re-design of the reference profiler
+(reference: python/paddle/profiler/profiler.py:310 `Profiler`,
+make_scheduler:136, export_chrome_tracing:228, RecordEvent
+profiler/utils.py:33, step timer profiler/timer.py:1; C++ host/device
+event collection paddle/fluid/platform/profiler/).
+
+The reference collects host + CUDA events through its own profiler
+runtime. On TPU the device-side story is XLA's: `jax.profiler`
+(xprof/perfetto) captures host activity, HLO op time on the chip, and
+HBM/ICI traffic. This module wraps it in the reference's API shape:
+
+    prof = Profiler(scheduler=(2, 5), on_trace_ready=export_chrome_tracing("./log"))
+    prof.start()
+    for batch in loader:
+        train_step(batch)
+        prof.step()
+    prof.stop()
+    prof.summary()
+
+plus `RecordEvent` for user-scoped annotations and a `benchmark()` step
+timer (reader cost / batch cost / ips), usable standalone via
+timer_only=True.
+"""
+import os
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "RecordEvent", "benchmark"]
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget:
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=1, skip_first=0):
+    """Step-state schedule mirroring the reference's make_scheduler
+    (profiler.py:136): skip_first, then cycles of closed→ready→record."""
+    cycle = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready factory: traces land under `dir_name` (the
+    jax.profiler/xprof dump contains perfetto/chrome-trace artifacts)."""
+
+    def handler(prof):
+        prof._trace_dir = dir_name
+
+    handler._dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """User-scoped annotation visible on the host timeline
+    (reference profiler/utils.py:33 RecordEvent → here a
+    jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class _StepTimer:
+    """reader/batch cost + ips tracker (reference profiler/timer.py)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.step_times = []
+        self._t_last = None
+        self._reader_cost = 0.0
+
+    def before_reader(self):
+        self._t_reader = time.perf_counter()
+
+    def after_reader(self):
+        self._reader_cost = time.perf_counter() - getattr(
+            self, "_t_reader", time.perf_counter())
+
+    def step(self):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self.step_times.append(now - self._t_last)
+        self._t_last = now
+
+    def stats(self, batch_size=None):
+        if not self.step_times:
+            return {}
+        n = len(self.step_times)
+        avg = sum(self.step_times) / n
+        out = {"steps": n, "avg_batch_cost_s": avg,
+               "steps_per_sec": 1.0 / avg if avg else float("inf")}
+        if batch_size:
+            out["ips"] = batch_size / avg
+        return out
+
+
+_benchmark = _StepTimer()
+
+
+def benchmark():
+    """Global step timer (reference profiler/utils.py benchmark())."""
+    return _benchmark
+
+
+class Profiler:
+    """Reference-shaped profiler driving jax.profiler underneath.
+
+    scheduler: None (record from start() to stop()), an (on, off) batch
+    tuple, or a make_scheduler callable. on_trace_ready: see
+    export_chrome_tracing. timer_only=True skips tracing and only times
+    steps."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir=None):
+        if isinstance(scheduler, (tuple, list)):
+            on, off = scheduler
+            scheduler = make_scheduler(closed=on, ready=0, record=off - on,
+                                       repeat=1)
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._trace_dir = log_dir or getattr(on_trace_ready, "_dir", None) \
+            or "./profiler_log"
+        self._step_no = 0
+        self._tracing = False
+        self.timer = _StepTimer()
+
+    # -- tracing control --
+    def _trace_on(self):
+        if self.timer_only or self._tracing:
+            return
+        os.makedirs(self._trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self._trace_dir)
+        self._tracing = True
+
+    def _trace_off(self):
+        if not self._tracing:
+            return
+        jax.profiler.stop_trace()
+        self._tracing = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def _apply_state(self):
+        if self._scheduler is None:
+            self._trace_on()
+            return
+        st = self._scheduler(self._step_no)
+        if st in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._trace_on()
+        else:
+            self._trace_off()
+
+    # -- reference API --
+    def start(self):
+        self.timer.reset()
+        self.timer.step()  # arm the first interval
+        self._apply_state()
+
+    def stop(self):
+        self._trace_off()
+
+    def step(self, num_samples=None):
+        self.timer.step()
+        self._num_samples = num_samples
+        self._step_no += 1
+        self._apply_state()
+
+    def step_info(self, unit=None):
+        s = self.timer.stats(batch_size=getattr(self, "_num_samples", None))
+        if not s:
+            return "no steps recorded"
+        ips = s.get("ips")
+        return (f"batch_cost: {s['avg_batch_cost_s']:.5f} s "
+                f"steps/s: {s['steps_per_sec']:.2f}"
+                + (f" ips: {ips:.1f}" if ips else ""))
+
+    def summary(self, **kwargs):
+        print(self.step_info())
+        if not self.timer_only:
+            print(f"trace artifacts (xprof/perfetto): {self._trace_dir}")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

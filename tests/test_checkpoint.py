@@ -244,6 +244,17 @@ def test_restore_into_already_running_step(tmp_path):
         mesh_mod.reset_mesh()
 
 
+def test_empty_containers_np_scalars_and_bad_keys(tmp_path):
+    state = {"empty_d": {}, "empty_l": [], "best": np.float32(0.42),
+             "n": np.int64(3)}
+    ckpt.save_state_dict(state, str(tmp_path / "c"))
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    assert back["empty_d"] == {} and back["empty_l"] == []
+    assert abs(back["best"] - 0.42) < 1e-6 and back["n"] == 3
+    with pytest.raises(ValueError, match="separator"):
+        ckpt.save_state_dict({"a/b": 1}, str(tmp_path / "bad"))
+
+
 def test_keep_prunes_old(tmp_path):
     m, xs, ys = _tiny_model_and_data()
     opt = paddle.optimizer.SGD(1e-2, parameters=m.parameters())
