@@ -61,6 +61,8 @@ from .manipulation import (  # noqa: F401
     kthvalue,
 )
 from .math import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
 
 
 def _install_tensor_methods():
@@ -68,12 +70,13 @@ def _install_tensor_methods():
 
     from . import activation as _act
     from . import creation as _cre
+    from . import extras as _ext
     from . import linalg as _lin
     from . import manipulation as _man
     from . import math as _math
 
     method_sources = {}
-    for m in (_math, _man, _lin, _act):
+    for m in (_math, _man, _lin, _act, _ext):
         for name in dir(m):
             fn = getattr(m, name)
             if callable(fn) and not name.startswith("_"):

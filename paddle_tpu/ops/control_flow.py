@@ -1,0 +1,235 @@
+"""Control-flow ops: cond / while_loop / case / switch_case / scan.
+
+TPU-native re-design of the reference control-flow operator family
+(reference: python/paddle/fluid/layers/control_flow.py — cond:2352,
+while_loop:1065, case:2983, switch_case:3212; C++ ops
+paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc).
+
+The reference builds sub-blocks in a static program. Here the rule is
+the dy2static rule the rest of the framework follows:
+
+- **Eager** (concrete predicate): execute pythonically — run the taken
+  branch / loop in Python. The tape sees exactly the ops that ran, so
+  gradients work with zero extra machinery.
+- **Traced** (predicate is a jax Tracer, i.e. inside paddle.jit): lower
+  to `lax.cond` / `lax.while_loop` / `lax.switch` so the compiled
+  program has real XLA control flow (single compilation, no unrolling,
+  MXU-friendly static shapes). Reverse-mode gradient through a traced
+  while_loop is undefined in XLA — use `scan` (which carries its
+  residuals) for differentiable loops, as jax itself does.
+
+`scan` has no reference counterpart: it is the TPU-first way to express
+a differentiable fixed-length loop (reference RNN-style unrolled loops
+map to it; see nn/layer/rnn.py which already scans).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..tensor_core import Tensor
+from ._helpers import defop, ensure_tensor, value_of
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "scan"]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _wrap_tree(vals, like=None):
+    """jax values (possibly nested tuple/list) → Tensors, preserving
+    structure."""
+    if isinstance(vals, (tuple, list)):
+        return type(vals)(_wrap_tree(v) for v in vals)
+    if isinstance(vals, (jax.Array, jnp.ndarray)) or _is_tracer(vals):
+        return Tensor(vals, stop_gradient=True)
+    return vals
+
+
+def _unwrap_tree(t):
+    if isinstance(t, (tuple, list)):
+        return type(t)(_unwrap_tree(v) for v in t)
+    return t._value if isinstance(t, Tensor) else t
+
+
+@defop("cond")
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Run true_fn() or false_fn() depending on pred
+    (reference control_flow.py:2352). Branch callables take no args and
+    close over outer tensors, as in the reference."""
+    pv = value_of(ensure_tensor(pred))
+    if not _is_tracer(pv):
+        if bool(pv):
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+    # traced: both branches staged into ONE program via lax.cond
+    t_out = [None]
+
+    def _t(_):
+        out = true_fn() if true_fn is not None else ()
+        t_out[0] = out
+        return _unwrap_tree(out) if out is not None else ()
+
+    def _f(_):
+        out = false_fn() if false_fn is not None else ()
+        return _unwrap_tree(out) if out is not None else ()
+
+    res = jax.lax.cond(pv, _t, _f, operand=None)
+    # restore the branch's python structure
+    return _wrap_tree(res) if t_out[0] is not None else None
+
+
+@defop("while_loop")
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """while cond_fn(*vars): vars = body_fn(*vars)
+    (reference control_flow.py:1065). Eager runs the python loop (tape
+    gradients work); traced lowers to lax.while_loop (forward-only, as
+    in XLA)."""
+    probe = cond_fn(*loop_vars)
+    pv = value_of(ensure_tensor(probe))
+    if not _is_tracer(pv):
+        vars_ = list(loop_vars)
+        while bool(value_of(ensure_tensor(cond_fn(*vars_)))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vars_
+
+    def _c(vals):
+        return value_of(ensure_tensor(cond_fn(*_wrap_tree(tuple(vals)))))
+
+    def _b(vals):
+        out = body_fn(*_wrap_tree(tuple(vals)))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(_unwrap_tree(o) for o in out)
+
+    res = jax.lax.while_loop(_c, _b, tuple(_unwrap_tree(v)
+                                           for v in loop_vars))
+    return [Tensor(v, stop_gradient=True) for v in res]
+
+
+@defop("case")
+def case(pred_fn_pairs, default=None, name=None):
+    """First predicate that holds wins (reference control_flow.py:2983).
+    Eager: python scan over pairs. Traced: nested lax.cond chain."""
+    # reference semantics: when default is None, the LAST pair's fn is the
+    # fallback (control_flow.py:2983)
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+        if not pred_fn_pairs:
+            return default()
+    preds = [value_of(ensure_tensor(p)) for p, _ in pred_fn_pairs]
+    if not any(_is_tracer(p) for p in preds):
+        for pv, fn in zip(preds, (f for _, f in pred_fn_pairs)):
+            if bool(pv):
+                return fn()
+        return default()
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return lambda: default()
+        p, fn = pred_fn_pairs[i]
+        rest = build(i + 1)
+        return lambda: cond(p, fn, rest)
+
+    return build(0)()
+
+
+@defop("switch_case")
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Select a branch by integer index (reference control_flow.py:3212).
+    branch_fns: dict {index: fn} or list of (index, fn) / fns."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    iv = value_of(ensure_tensor(branch_index))
+    if not _is_tracer(iv):
+        i = int(iv)
+        for idx, fn in pairs:
+            if idx == i:
+                return fn()
+        # reference: unmatched index falls to default, else the LAST branch
+        return default() if default is not None else pairs[-1][1]()
+    fns = [f for _, f in pairs]
+    if default is not None:
+        fns.append(default)
+    keys = jnp.asarray([i for i, _ in pairs])
+    # map branch_index → position (unknown index → default = last)
+    pos = jnp.argmax(keys == iv)
+    pos = jnp.where(jnp.any(keys == iv), pos, len(fns) - 1)
+    out_struct = [None]
+
+    def mk(fn):
+        def call(_):
+            out = fn()
+            out_struct[0] = out
+            return _unwrap_tree(out)
+
+        return call
+
+    res = jax.lax.switch(pos, [mk(f) for f in fns], None)
+    return _wrap_tree(res)
+
+
+def _closure_tensors(fn):
+    """Trainable Tensors the body closes over — they must become explicit
+    tape operands or their gradients are silently lost."""
+    out = []
+    f = getattr(fn, "__func__", fn)
+    for cell in getattr(f, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Tensor) and not v.stop_gradient:
+            out.append(v)
+    return out
+
+
+@defop("scan")
+def scan(fn, init, xs=None, length=None, reverse=False, params=None,
+         name=None):
+    """Differentiable loop with carried state: TPU-first replacement for
+    unrolled python loops. fn(carry, x) -> (carry, y); returns
+    (final_carry, stacked_ys). Funnels through the tape so backward
+    works eagerly and under jit — including gradients for weights the
+    body closes over (direct closure cells are captured automatically;
+    pass `params=[...]` for tensors reached through nested structures)."""
+    from ..autograd import engine
+
+    init_t = ensure_tensor(init)
+    tensors = [init_t]
+    if xs is not None:
+        tensors.append(ensure_tensor(xs))
+    clos = list(params) if params is not None else _closure_tensors(fn)
+    tensors += clos
+
+    def jfn(*vals):
+        n_fixed = 2 if xs is not None else 1
+        clos_vals = vals[n_fixed:]
+        originals = [t._value for t in clos]
+
+        def body(c, x):
+            # thread closure weights as traced values for the body's ops
+            for t, v in zip(clos, clos_vals):
+                t._value = v
+            try:
+                c_out, y = fn(Tensor(c, stop_gradient=True),
+                              None if x is None else Tensor(x, True))
+            finally:
+                for t, v in zip(clos, originals):
+                    t._value = v
+            return _unwrap_tree(c_out), _unwrap_tree(y)
+
+        if xs is None:
+            c, ys = jax.lax.scan(lambda c, _: body(c, None), vals[0],
+                                 None, length=length, reverse=reverse)
+        else:
+            c, ys = jax.lax.scan(body, vals[0], vals[1], reverse=reverse)
+        return c, ys
+
+    return engine.apply("scan", jfn, tuple(tensors))

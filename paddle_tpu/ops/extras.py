@@ -1,0 +1,461 @@
+"""Op-corpus expansion: indexing, windowing, linalg and misc gaps.
+
+Closes the remaining gaps vs the reference tensor API
+(reference: python/paddle/tensor/manipulation.py, math.py, linalg.py,
+search.py — e.g. index_add:4538, unfold:5721, as_strided:5638,
+take:5850, renorm:3642, vander linalg.py:71, pdist/cdist incubate).
+Every op funnels through the autograd tape (engine.apply) so gradients
+flow wherever jax defines a VJP.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..tensor_core import Tensor
+from ._helpers import apply_jfn, defop, ensure_tensor, value_of
+
+__all__ = [
+    "cumulative_trapezoid", "logcumsumexp", "index_add", "index_put",
+    "histogramdd", "diagonal", "take", "nanmedian", "nanquantile",
+    "renorm", "nan_to_num", "vander", "polygamma", "fmod", "isreal",
+    "as_complex", "as_real", "poisson", "standard_normal", "msort",
+    "positive", "float_power", "unstack", "vsplit", "hsplit", "dsplit",
+    "as_strided", "view", "view_as", "unflatten", "unfold", "pdist",
+    "cdist", "inv", "svd_lowrank", "eig", "eigvals", "lu", "lu_unpack",
+]
+
+
+# ------------------------------------------------------------ reductions
+
+@defop("logcumsumexp")
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def jfn(v):
+        if axis is None:
+            return jax.lax.cumlogsumexp(v.reshape(-1), axis=0)
+        return jax.lax.cumlogsumexp(v, axis=axis)
+
+    return apply_jfn("logcumsumexp", jfn, x)
+
+
+@defop("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    if x is not None:
+        def jfn(yv, xv):
+            d = jnp.diff(xv, axis=axis)
+            avg = (_slice_axis(yv, axis, 1, None)
+                   + _slice_axis(yv, axis, 0, -1)) * 0.5
+            return jnp.cumsum(d * avg, axis=axis)
+
+        return apply_jfn("cumulative_trapezoid", jfn, y, x)
+
+    def jfn(yv):
+        avg = (_slice_axis(yv, axis, 1, None)
+               + _slice_axis(yv, axis, 0, -1)) * 0.5
+        return jnp.cumsum(dx * avg, axis=axis)
+
+    return apply_jfn("cumulative_trapezoid", jfn, y)
+
+
+def _slice_axis(v, axis, start, stop):
+    idx = [slice(None)] * v.ndim
+    idx[axis] = slice(start, stop)
+    return v[tuple(idx)]
+
+
+@defop("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_jfn(
+        "nanmedian",
+        lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim), x)
+
+
+@defop("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_jfn(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim), x)
+
+
+# ------------------------------------------------------------- indexing
+
+@defop("index_add")
+def index_add(x, index, axis, value, name=None):
+    """x with value added at `index` along `axis`
+    (reference manipulation.py:4538)."""
+    def jfn(xv, vv, iv):
+        perm_idx = [slice(None)] * xv.ndim
+        perm_idx[axis] = iv
+        return xv.at[tuple(perm_idx)].add(vv)
+
+    return apply_jfn("index_add", jfn, x, value, ensure_tensor(index))
+
+
+@defop("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    """x[indices] = value (or += with accumulate)
+    (reference manipulation.py:4747)."""
+    indices = tuple(ensure_tensor(i) for i in indices)
+
+    def jfn(xv, vv, *ivs):
+        if accumulate:
+            return xv.at[ivs].add(vv)
+        return xv.at[ivs].set(vv)
+
+    return apply_jfn("index_put", jfn, x, value, *indices)
+
+
+@defop("take")
+def take(x, index, mode="raise", name=None):
+    """Gather from the FLATTENED input (reference manipulation.py:5850).
+    mode: 'raise' (oob is an error — clipped in-graph, matching TPU
+    semantics), 'wrap', 'clip'."""
+    jmode = "clip" if mode == "raise" else mode
+
+    def jfn(xv, iv):
+        return jnp.take(xv.reshape(-1), iv, mode=jmode)
+
+    return apply_jfn("take", jfn, x, ensure_tensor(index))
+
+
+@defop("msort")
+def msort(x, name=None):
+    return apply_jfn("msort", lambda v: jnp.sort(v, axis=0), x)
+
+
+# ------------------------------------------------------------ windowing
+
+@defop("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Functional as_strided (reference manipulation.py:5638): gathers
+    flat indices offset + sum_d i_d * stride_d. A copy, not a view —
+    XLA owns layout; there is no aliasing on TPU."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.full(shape, int(offset), jnp.int32)
+    for d, (sz, st) in enumerate(zip(shape, stride)):
+        ar = jnp.arange(sz, dtype=jnp.int32) * st
+        idx = idx + ar.reshape((-1,) + (1,) * (len(shape) - d - 1))
+    return apply_jfn("as_strided",
+                     lambda v: jnp.take(v.reshape(-1), idx), x)
+
+
+@defop("unfold")
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (reference manipulation.py:5721):
+    result appends a window dim of length `size`."""
+    def jfn(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        windows = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(v, s, size, axis=ax)
+        )(starts)
+        # windows: (n, ..., size at ax, ...) → move n to `ax`, window
+        # length becomes the trailing dim
+        win = jnp.moveaxis(windows, 0, ax)
+        return jnp.moveaxis(win, ax + 1, -1)
+
+    return apply_jfn("unfold", jfn, x)
+
+
+@defop("view")
+def view(x, shape_or_dtype, name=None):
+    """Reshape (list/tuple) or bitcast reinterpret (dtype) — reference
+    manipulation.py:5530. Functional copy under XLA."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return apply_jfn(
+            "view", lambda v: v.reshape(tuple(shape_or_dtype)), x)
+    from ..core import dtype as dtype_mod
+
+    dt = dtype_mod.convert_dtype(shape_or_dtype)
+
+    def jfn(v):
+        old, new = np.dtype(v.dtype).itemsize, np.dtype(dt).itemsize
+        if new < old:
+            # widening count: (..., d) → (..., d*ratio), not (..., d, ratio)
+            out = jax.lax.bitcast_convert_type(v, dt)
+            return out.reshape(v.shape[:-1] + (v.shape[-1] * (old // new),))
+        if new > old:
+            ratio = new // old
+            grouped = v.reshape(v.shape[:-1] + (v.shape[-1] // ratio, ratio))
+            return jax.lax.bitcast_convert_type(grouped, dt)
+        return jax.lax.bitcast_convert_type(v, dt)
+
+    return apply_jfn("view", jfn, x)
+
+
+@defop("view_as")
+def view_as(x, other, name=None):
+    shape = tuple(value_of(ensure_tensor(other)).shape)
+    return apply_jfn("view_as", lambda v: v.reshape(shape), x)
+
+
+@defop("unflatten")
+def unflatten(x, axis, shape, name=None):
+    def jfn(v):
+        ax = axis % v.ndim
+        new = v.shape[:ax] + tuple(shape) + v.shape[ax + 1:]
+        return v.reshape(new)
+
+    return apply_jfn("unflatten", jfn, x)
+
+
+@defop("unstack")
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num or value_of(x).shape[axis]
+    outs = apply_jfn(
+        "unstack",
+        lambda v: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(v, n, axis=axis)), x)
+    return list(outs)
+
+
+def _np_style_split(name, jfn_split):
+    def op(x, num_or_indices, name=None):
+        x = ensure_tensor(x)
+        outs = apply_jfn(name, lambda v: tuple(jfn_split(v, num_or_indices)),
+                         x)
+        return list(outs)
+
+    op.__name__ = name
+    return defop(name)(op)
+
+
+vsplit = _np_style_split("vsplit", lambda v, n: jnp.vsplit(v, n))
+hsplit = _np_style_split("hsplit", lambda v, n: jnp.hsplit(v, n))
+dsplit = _np_style_split("dsplit", lambda v, n: jnp.dsplit(v, n))
+
+
+# ----------------------------------------------------------------- misc
+
+@defop("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_jfn(
+        "diagonal",
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+        x)
+
+
+@defop("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along `axis` to p-norm <= max_norm
+    (reference math.py:3642)."""
+    def jfn(v):
+        dims = tuple(d for d in range(v.ndim) if d != axis)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return apply_jfn("renorm", jfn, x)
+
+
+@defop("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_jfn(
+        "nan_to_num",
+        lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+        x)
+
+
+@defop("vander")
+def vander(x, n=None, increasing=False, name=None):
+    return apply_jfn(
+        "vander", lambda v: jnp.vander(v, N=n, increasing=increasing), x)
+
+
+@defop("polygamma")
+def polygamma(x, n, name=None):
+    from jax.scipy.special import polygamma as _pg
+
+    return apply_jfn("polygamma", lambda v: _pg(n, v), x)
+
+
+@defop("fmod")
+def fmod(x, y, name=None):
+    return apply_jfn("fmod", jnp.fmod, x, ensure_tensor(y))
+
+
+@defop("positive")
+def positive(x, name=None):
+    return apply_jfn("positive", lambda v: +v, x)
+
+
+@defop("float_power")
+def float_power(x, y, name=None):
+    return apply_jfn("float_power",
+                     lambda a, b: jnp.power(a.astype(jnp.float32),
+                                            b.astype(jnp.float32)),
+                     x, ensure_tensor(y))
+
+
+@defop("histogramdd")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = value_of(ensure_tensor(x))
+    wv = None if weights is None else value_of(ensure_tensor(weights))
+    h, edges = jnp.histogramdd(xv, bins=bins, range=ranges, density=density,
+                               weights=wv)
+    return Tensor(h, stop_gradient=True), [Tensor(e, True) for e in edges]
+
+
+# -------------------------------------------------------------- complex
+
+@defop("isreal")
+def isreal(x, name=None):
+    return apply_jfn("isreal", jnp.isreal, x)
+
+
+@defop("as_complex")
+def as_complex(x, name=None):
+    """(..., 2) float → complex (reference manipulation.py as_complex)."""
+    return apply_jfn(
+        "as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+@defop("as_real")
+def as_real(x, name=None):
+    return apply_jfn(
+        "as_real",
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+# --------------------------------------------------------------- random
+
+@defop("poisson")
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(
+        jax.random.poisson(rng.next_key(), value_of(x)).astype(
+            value_of(x).dtype),
+        stop_gradient=True)
+
+
+@defop("standard_normal")
+def standard_normal(shape, dtype=None, name=None):
+    from .creation import randn
+
+    return randn(shape, dtype=dtype)
+
+
+# --------------------------------------------------------------- linalg
+
+@defop("inv")
+def inv(x, name=None):
+    return apply_jfn("inv", jnp.linalg.inv, x)
+
+
+@defop("pdist")
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (reference incubate
+    pdist / torch-compatible)."""
+    def jfn(v):
+        n = v.shape[0]
+        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :] + 0.0, ord=p,
+                            axis=-1)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return apply_jfn("pdist", jfn, x)
+
+
+@defop("cdist")
+def cdist(x, y, p=2.0, name=None):
+    def jfn(a, b):
+        return jnp.linalg.norm(a[..., :, None, :] - b[..., None, :, :],
+                               ord=p, axis=-1)
+
+    return apply_jfn("cdist", jfn, x, ensure_tensor(y))
+
+
+@defop("svd_lowrank")
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference linalg svd_lowrank; Halko
+    et al. structure, subspace iteration on a Gaussian sketch)."""
+    xv = value_of(ensure_tensor(x))
+    if M is not None:
+        xv = xv - value_of(ensure_tensor(M))
+    k = rng.next_key()
+    m, n = xv.shape[-2], xv.shape[-1]
+    q = min(q, m, n)
+    omega = jax.random.normal(k, xv.shape[:-2] + (n, q), xv.dtype)
+    y = xv @ omega
+    for _ in range(niter):
+        y = xv @ (jnp.swapaxes(xv, -1, -2) @ y)
+    Q, _ = jnp.linalg.qr(y)
+    B = jnp.swapaxes(Q, -1, -2) @ xv
+    u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    return (Tensor(Q @ u, True), Tensor(s, True),
+            Tensor(jnp.swapaxes(vh, -1, -2), True))
+
+
+@defop("eig")
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition. XLA supports this on
+    CPU only; on TPU the computation is lifted to the host via
+    pure_callback (small-matrix host op, reference linalg.py eig)."""
+    xv = value_of(ensure_tensor(x))
+    try:
+        w, v = jnp.linalg.eig(xv)
+    except Exception:
+        cdt = jnp.complex64 if xv.dtype in (jnp.float32,) else jnp.complex128
+        w, v = jax.pure_callback(
+            lambda a: tuple(np.linalg.eig(np.asarray(a))),
+            (jax.ShapeDtypeStruct(xv.shape[:-1], cdt),
+             jax.ShapeDtypeStruct(xv.shape, cdt)), xv)
+    return Tensor(w, True), Tensor(v, True)
+
+
+@defop("eigvals")
+def eigvals(x, name=None):
+    w, _ = eig(x)
+    return w
+
+
+@defop("lu")
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization, packed LU + pivots (reference linalg.py lu)."""
+    from jax.scipy.linalg import lu_factor
+
+    xv = value_of(ensure_tensor(x))
+    lu_, piv = lu_factor(xv)
+    outs = (Tensor(lu_, True), Tensor(piv.astype(jnp.int32) + 1, True))
+    if get_infos:
+        outs = outs + (Tensor(jnp.zeros((), jnp.int32), True),)
+    return outs
+
+
+@defop("lu_unpack")
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    lu_v = value_of(ensure_tensor(lu_data))
+    piv = value_of(ensure_tensor(lu_pivots)) - 1
+    m, n = lu_v.shape[-2], lu_v.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+    U = jnp.triu(lu_v[..., :k, :])
+
+    # pivots → permutation (batched: vmap the row-swap loop over leading
+    # dims — lu_factor itself batches)
+    def one_perm(p1d):
+        perm = jnp.arange(m)
+        for i in range(p1d.shape[0]):
+            j = p1d[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        return perm
+
+    batch = piv.shape[:-1]
+    if batch:
+        flat = piv.reshape((-1, piv.shape[-1]))
+        perm = jax.vmap(one_perm)(flat).reshape(batch + (m,))
+    else:
+        perm = one_perm(piv)
+    P = jnp.swapaxes(jnp.eye(m, dtype=lu_v.dtype)[perm], -1, -2)
+    outs = []
+    outs.append(Tensor(P, True) if unpack_pivots else None)
+    outs.append(Tensor(L, True) if unpack_ludata else None)
+    outs.append(Tensor(U, True) if unpack_ludata else None)
+    return tuple(outs)

@@ -114,15 +114,16 @@ class _StepTimer:
 
     def reset(self):
         self.step_times = []
+        self.reader_costs = []
         self._t_last = None
-        self._reader_cost = 0.0
 
     def before_reader(self):
         self._t_reader = time.perf_counter()
 
     def after_reader(self):
-        self._reader_cost = time.perf_counter() - getattr(
-            self, "_t_reader", time.perf_counter())
+        self.reader_costs.append(
+            time.perf_counter() - getattr(self, "_t_reader",
+                                          time.perf_counter()))
 
     def step(self):
         now = time.perf_counter()
@@ -139,6 +140,9 @@ class _StepTimer:
                "steps_per_sec": 1.0 / avg if avg else float("inf")}
         if batch_size:
             out["ips"] = batch_size / avg
+        if self.reader_costs:
+            out["avg_reader_cost_s"] = (
+                sum(self.reader_costs) / len(self.reader_costs))
         return out
 
 

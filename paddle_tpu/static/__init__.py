@@ -19,6 +19,7 @@ import numpy as np
 
 import jax
 
+from . import nn  # noqa: F401  (paddle.static.nn.cond / while_loop / ...)
 from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 from ..tensor_core import Tensor
 

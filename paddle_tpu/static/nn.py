@@ -1,0 +1,11 @@
+"""paddle_tpu.static.nn — control-flow + layer helpers in static style
+(reference: python/paddle/static/nn/__init__.py; cond/while_loop/case/
+switch_case live here in the reference's namespace)."""
+from ..ops.control_flow import (  # noqa: F401
+    case,
+    cond,
+    switch_case,
+    while_loop,
+)
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]

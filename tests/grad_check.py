@@ -1,0 +1,44 @@
+"""Finite-difference gradient checker (reference: op_test.py:166-181
+get_numeric_gradient — central differences vs analytic backward)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def fd_grad_check(op, arrays, eps=1e-4, rtol=5e-3, atol=1e-5, seed=0,
+                  wrt=None):
+    """Compare tape gradients of sum(op(*arrays)) with central-difference
+    numeric gradients. arrays: list of float64 numpy arrays. wrt: indices
+    of inputs to check (default: all)."""
+    arrays = [np.asarray(a, np.float64) for a in arrays]
+    wrt = range(len(arrays)) if wrt is None else wrt
+
+    def f(*arrs):
+        ts = [paddle.to_tensor(a) for a in arrs]
+        out = op(*ts)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    # analytic
+    ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = op(*ts)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    out.sum().backward()
+    for i in wrt:
+        analytic = ts[i].grad.numpy()
+        numeric = np.zeros_like(arrays[i])
+        flat = arrays[i].reshape(-1)
+        nflat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            hi = float(f(*arrays).sum().numpy())
+            flat[j] = orig - eps
+            lo = float(f(*arrays).sum().numpy())
+            flat[j] = orig
+            nflat[j] = (hi - lo) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch for input {i} of {op}")

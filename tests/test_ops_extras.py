@@ -1,0 +1,313 @@
+"""Op-corpus expansion tests: numpy parity + finite-difference gradient
+tier (reference op_test.py pattern) + control-flow semantics."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from grad_check import fd_grad_check
+
+rng = np.random.default_rng(7)
+
+
+# ------------------------------------------------------- numpy parity
+
+def test_reductions_parity():
+    a = rng.standard_normal((3, 5))
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(paddle.to_tensor(a), axis=1).numpy(),
+        np.log(np.cumsum(np.exp(a), axis=1)), rtol=1e-6)
+    b = a.copy()
+    b[0, 1] = np.nan
+    np.testing.assert_allclose(
+        paddle.nanmedian(paddle.to_tensor(b)).numpy(), np.nanmedian(b))
+    np.testing.assert_allclose(
+        paddle.nanquantile(paddle.to_tensor(b), 0.75, axis=1).numpy(),
+        np.nanquantile(b, 0.75, axis=1))
+    y = rng.standard_normal(6)
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(paddle.to_tensor(y), dx=0.5).numpy(),
+        np.cumsum(0.5 * (y[1:] + y[:-1]) / 2))
+
+
+def test_indexing_parity():
+    x = rng.standard_normal((4, 3))
+    idx = np.array([0, 2])
+    v = rng.standard_normal((2, 3))
+    ref = x.copy()
+    ref[idx] += v
+    np.testing.assert_allclose(
+        paddle.index_add(paddle.to_tensor(x), paddle.to_tensor(idx), 0,
+                         paddle.to_tensor(v)).numpy(), ref)
+    ref2 = x.copy()
+    ref2[np.array([1, 3]), np.array([0, 2])] = 9.0
+    got = paddle.index_put(
+        paddle.to_tensor(x),
+        (paddle.to_tensor(np.array([1, 3])),
+         paddle.to_tensor(np.array([0, 2]))),
+        paddle.to_tensor(np.array([9.0, 9.0]))).numpy()
+    np.testing.assert_allclose(got, ref2)
+    np.testing.assert_allclose(
+        paddle.take(paddle.to_tensor(x),
+                    paddle.to_tensor(np.array([0, 5, 11]))).numpy(),
+        x.reshape(-1)[[0, 5, 11]])
+
+
+def test_windowing_parity():
+    x = rng.standard_normal(10)
+    got = paddle.unfold(paddle.to_tensor(x), 0, 4, 3).numpy()
+    ref = np.stack([x[0:4], x[3:7], x[6:10]])
+    np.testing.assert_allclose(got, ref)
+    m = rng.standard_normal((2, 6))
+    got2 = paddle.as_strided(paddle.to_tensor(m), (3, 2), (2, 1), 1).numpy()
+    flat = m.reshape(-1)
+    ref2 = np.array([[flat[1 + 2 * i + j] for j in range(2)]
+                     for i in range(3)])
+    np.testing.assert_allclose(got2, ref2)
+    np.testing.assert_allclose(
+        paddle.unflatten(paddle.to_tensor(m), 1, (2, 3)).numpy(),
+        m.reshape(2, 2, 3))
+    parts = paddle.unstack(paddle.to_tensor(m), axis=0)
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[1].numpy(), m[1])
+    np.testing.assert_allclose(
+        paddle.view(paddle.to_tensor(m), [6, 2]).numpy(), m.reshape(6, 2))
+
+
+def test_misc_parity():
+    x = rng.standard_normal((3, 4))
+    np.testing.assert_allclose(
+        paddle.diagonal(paddle.to_tensor(x)).numpy(), np.diagonal(x))
+    np.testing.assert_allclose(
+        paddle.nan_to_num(paddle.to_tensor(np.array([np.nan, np.inf, 1.0]))
+                          ).numpy(),
+        np.nan_to_num(np.array([np.nan, np.inf, 1.0])))
+    v = rng.standard_normal(4)
+    np.testing.assert_allclose(
+        paddle.vander(paddle.to_tensor(v), n=3).numpy(), np.vander(v, 3))
+    np.testing.assert_allclose(
+        paddle.fmod(paddle.to_tensor(np.array([5.0, -5.0])), 3.0).numpy(),
+        np.fmod(np.array([5.0, -5.0]), 3.0))
+    np.testing.assert_allclose(
+        paddle.msort(paddle.to_tensor(x)).numpy(), np.msort(x)
+        if hasattr(np, "msort") else np.sort(x, axis=0))
+    # renorm: every slice along axis 0 has 2-norm <= 1
+    r = paddle.renorm(paddle.to_tensor(x * 10), 2.0, 0, 1.0).numpy()
+    assert (np.linalg.norm(r, axis=1) <= 1.0 + 1e-5).all()
+
+
+def test_linalg_parity():
+    a = rng.standard_normal((4, 4))
+    np.testing.assert_allclose(
+        paddle.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a),
+        rtol=1e-8)
+    w, v = paddle.eig(paddle.to_tensor(a))
+    np.testing.assert_allclose(
+        np.sort(w.numpy().real), np.sort(np.linalg.eigvals(a).real),
+        rtol=1e-6)
+    lu_, piv = paddle.lu(paddle.to_tensor(a))
+    P, L, U = paddle.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                               rtol=1e-8, atol=1e-10)
+    # svd_lowrank reconstructs a rank-2 matrix
+    low = rng.standard_normal((8, 2)) @ rng.standard_normal((2, 6))
+    U2, s2, V2 = paddle.svd_lowrank(paddle.to_tensor(low), q=4)
+    rec = U2.numpy() @ np.diag(s2.numpy()) @ V2.numpy().T
+    np.testing.assert_allclose(rec, low, rtol=1e-5, atol=1e-7)
+    x = rng.standard_normal((5, 3))
+    y = rng.standard_normal((4, 3))
+    ref_cdist = np.linalg.norm(x[:, None, :] - y[None, :, :], axis=-1)
+    np.testing.assert_allclose(
+        paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+        ref_cdist, rtol=1e-7)
+    iu = np.triu_indices(5, 1)
+    np.testing.assert_allclose(
+        paddle.pdist(paddle.to_tensor(x)).numpy(),
+        np.linalg.norm(x[:, None, :] - x[None, :, :], axis=-1)[iu],
+        rtol=1e-7)
+
+
+def test_complex_and_random():
+    z = rng.standard_normal((3, 2))
+    c = paddle.as_complex(paddle.to_tensor(z))
+    np.testing.assert_allclose(np.real(c.numpy()), z[:, 0])
+    back = paddle.as_real(c)
+    np.testing.assert_allclose(back.numpy(), z)
+    assert paddle.isreal(paddle.to_tensor(np.array([1.0]))).numpy().all()
+    lam = paddle.full([1000], 4.0)
+    draws = paddle.poisson(lam).numpy()
+    assert 3.5 < draws.mean() < 4.5
+    assert paddle.standard_normal([3, 3]).shape == [3, 3]
+
+
+# --------------------------------------------- finite-difference tier
+
+@pytest.mark.parametrize("name,op,arrays", [
+    ("log", lambda x: paddle.log(x), [rng.uniform(0.5, 2.0, (3, 4))]),
+    ("sigmoid", lambda x: paddle.nn.functional.sigmoid(x),
+     [rng.standard_normal((3, 4))]),
+    ("matmul", lambda a, b: paddle.matmul(a, b),
+     [rng.standard_normal((3, 4)), rng.standard_normal((4, 2))]),
+    ("einsum", lambda a, b: paddle.einsum("ij,kj->ik", a, b),
+     [rng.standard_normal((3, 4)), rng.standard_normal((5, 4))]),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1),
+     [rng.standard_normal((2, 5))]),
+    ("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=0),
+     [rng.standard_normal((4, 2))]),
+    ("diagonal", lambda x: paddle.diagonal(x),
+     [rng.standard_normal((4, 4))]),
+    ("renorm", lambda x: paddle.renorm(x, 2.0, 0, 0.7),
+     [rng.standard_normal((3, 4))]),
+    ("unfold", lambda x: paddle.unfold(x, 0, 3, 2),
+     [rng.standard_normal(9)]),
+    ("cdist", lambda a, b: paddle.cdist(a, b),
+     [rng.standard_normal((4, 3)), rng.standard_normal((5, 3))]),
+    ("softmax_ce", lambda x: paddle.nn.functional.softmax(x, axis=-1),
+     [rng.standard_normal((2, 6))]),
+    ("take", lambda x: paddle.take(
+        x, paddle.to_tensor(np.array([1, 5, 7]))),
+     [rng.standard_normal((3, 3))]),
+    ("cumtrap", lambda x: paddle.cumulative_trapezoid(x, dx=0.3),
+     [rng.standard_normal(7)]),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1),
+     [rng.standard_normal((3, 5))]),
+    ("float_power", lambda x: paddle.float_power(x, 3.0),
+     [rng.uniform(0.5, 1.5, (3, 3))]),
+])
+def test_fd_grads(name, op, arrays):
+    fd_grad_check(op, arrays)
+
+
+# ------------------------------------------------------- control flow
+
+def test_cond_eager_and_grads():
+    x = paddle.to_tensor(np.array([2.0]), stop_gradient=False)
+    out = paddle.cond(paddle.to_tensor(True),
+                      lambda: x * 3.0, lambda: x * 5.0)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+
+def test_cond_traced_lowers_to_lax():
+    @paddle.jit.to_static
+    def f(x, flag):
+        return paddle.cond(flag, lambda: x * 2.0, lambda: x - 1.0)
+
+    a = paddle.to_tensor(np.array([4.0], np.float32))
+    np.testing.assert_allclose(
+        f(a, paddle.to_tensor(True)).numpy(), [8.0])
+    np.testing.assert_allclose(
+        f(a, paddle.to_tensor(False)).numpy(), [3.0])
+
+
+def test_while_loop_eager_and_traced():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body_fn(i, s):
+        return i + 1, s + i
+
+    i, s = paddle.while_loop(
+        cond_fn, body_fn,
+        [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(i.numpy()) == 5 and int(s.numpy()) == 10
+
+    @paddle.jit.to_static
+    def f(i0, s0):
+        i, s = paddle.while_loop(cond_fn, body_fn, [i0, s0])
+        return s
+
+    out = f(paddle.to_tensor(0), paddle.to_tensor(0))
+    assert int(out.numpy()) == 10
+
+
+def test_case_and_switch_case():
+    x = paddle.to_tensor(np.array([1.0]))
+    out = paddle.case(
+        [(paddle.to_tensor(False), lambda: x * 10),
+         (paddle.to_tensor(True), lambda: x * 20)],
+        default=lambda: x * 30)
+    np.testing.assert_allclose(out.numpy(), [20.0])
+    out2 = paddle.switch_case(
+        paddle.to_tensor(2),
+        {1: lambda: x * 1, 2: lambda: x * 2, 3: lambda: x * 3})
+    np.testing.assert_allclose(out2.numpy(), [2.0])
+
+    @paddle.jit.to_static
+    def f(idx):
+        return paddle.switch_case(
+            idx, {0: lambda: x * 5, 1: lambda: x * 7},
+            default=lambda: x * 0)
+
+    np.testing.assert_allclose(f(paddle.to_tensor(1)).numpy(), [7.0])
+    np.testing.assert_allclose(f(paddle.to_tensor(9)).numpy(), [0.0])
+
+
+def test_scan_closure_weight_grads():
+    # weights closed over by the body must receive gradients
+    w = paddle.to_tensor(np.array(2.0), stop_gradient=False)
+    xs = paddle.to_tensor(np.array([1.0, 2.0, 3.0]))
+    c, ys = paddle.scan(lambda c, x: (c * w + x, c),
+                        paddle.to_tensor(np.array(0.0)), xs)
+    c.backward()
+    # c = ((0*w+1)*w+2)*w+3 = w^2 + 2w + 3 → dc/dw = 2w + 2 = 6
+    np.testing.assert_allclose(w.grad.numpy(), 6.0)
+
+
+def test_unfold_negative_axis_2d():
+    x = rng.standard_normal((2, 10))
+    got = paddle.unfold(paddle.to_tensor(x), -1, 4, 3).numpy()
+    ref = np.stack([np.stack([r[0:4], r[3:7], r[6:10]]) for r in x])
+    assert got.shape == (2, 3, 4)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_switch_case_unmatched_no_default_runs_last():
+    x = paddle.to_tensor(np.array([1.0]))
+    out = paddle.switch_case(
+        paddle.to_tensor(9), {0: lambda: x * 5, 1: lambda: x * 7})
+    np.testing.assert_allclose(out.numpy(), [7.0])
+    out2 = paddle.case([(paddle.to_tensor(False), lambda: x * 5),
+                        (paddle.to_tensor(False), lambda: x * 7)])
+    np.testing.assert_allclose(out2.numpy(), [7.0])
+
+
+def test_lu_unpack_batched():
+    a = rng.standard_normal((2, 4, 4))
+    lu_, piv = paddle.lu(paddle.to_tensor(a))
+    P, L, U = paddle.lu_unpack(lu_, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-8, atol=1e-10)
+
+
+def test_view_dtype_folds_last_dim():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    got = paddle.view(paddle.to_tensor(x), "uint8")
+    assert got.shape == [2, 12]
+    back = paddle.view(got, "float32")
+    assert back.shape == [2, 3]
+    np.testing.assert_allclose(back.numpy(), x)
+
+
+def test_scan_grads_eager_and_jit():
+    xs = np.arange(1.0, 5.0)
+
+    def step(c, x):
+        return c * x, c
+
+    # eager with grad
+    xt = paddle.to_tensor(xs, stop_gradient=False)
+    c, ys = paddle.scan(step, paddle.to_tensor(np.array(1.0)), xt)
+    np.testing.assert_allclose(float(c.numpy()), 24.0)
+    c.backward()
+    np.testing.assert_allclose(xt.grad.numpy(), [24.0, 12.0, 8.0, 6.0])
+
+    @paddle.jit.to_static
+    def f(xs_):
+        c, ys = paddle.scan(step, paddle.to_tensor(np.array(1.0)), xs_)
+        return c
+
+    np.testing.assert_allclose(
+        float(f(paddle.to_tensor(xs)).numpy()), 24.0)
