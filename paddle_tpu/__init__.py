@@ -73,6 +73,7 @@ for _sub in (
     "incubate",
     "hapi",
     "linalg",
+    "rec",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

@@ -8,6 +8,7 @@ from . import collective  # noqa: F401
 from . import env  # noqa: F401
 from . import mesh  # noqa: F401
 from . import moe  # noqa: F401
+from . import ps  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from . import sharding  # noqa: F401
 from .collective import (  # noqa: F401

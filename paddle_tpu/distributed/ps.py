@@ -1,0 +1,213 @@
+"""Parameter-server path: sparse embedding tables for recommendation.
+
+TPU-native re-design of the reference PS stack (reference:
+paddle/fluid/distributed/ps/table/memory_sparse_table.h:39 (hash-grown
+rows, per-slot optimizer rules sparse_sgd_rule.cc),
+ps/service/ps_client.h:63 pull/push RPC, python
+distributed/ps/the_one_ps.py:919 TheOnePSRuntime).
+
+The reference splits the job into brpc KV servers + trainers doing async
+pull/push, because GPU memory can't hold ads-scale vocabularies. The
+TPU-native split is host-RAM vs HBM on the SAME machines:
+
+- `MemorySparseTable` — in-process host KV (id → row), rows created on
+  first touch (unbounded vocab), per-row optimizer state applied on push
+  (SGD / AdaGrad rules, as the reference applies optimizers server-side).
+  Single-process per table; multi-host id routing (reference `id % nproc`
+  table sharding) is not implemented yet — in a multi-host job give each
+  process its own table over a disjoint id space, or use
+  `ShardedEmbedding`.
+- `SparseEmbedding` — the `paddle.static.nn.sparse_embedding` analog: a
+  layer that pulls the batch's unique rows to HBM, runs the dense lookup
+  on device (tape-differentiable), and pushes row gradients back on
+  backward via a gradient hook (async-push semantics). Eager-mode by
+  design, like the reference's PS mode (the dense math still jits).
+- `ShardedEmbedding` — the SPMD alternative when the vocab fits HBM:
+  table row-sharded over a mesh axis; XLA inserts the gather/all-to-all
+  (SparseCore-style path). Works inside DistributedTrainStep.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor_core import Tensor
+from . import mesh as mesh_mod
+
+__all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
+           "SparseEmbedding", "ShardedEmbedding"]
+
+
+# ------------------------------------------------------ optimizer rules
+
+class SparseSGDRule:
+    """reference: ps/table/sparse_sgd_rule.cc naive rule."""
+
+    slot_dim = 0
+
+    def __init__(self, learning_rate=0.01):
+        self.lr = learning_rate
+
+    def init_slots(self, n, dim):
+        return np.zeros((n, 0), np.float32)
+
+    def apply(self, rows, slots, grads):
+        return rows - self.lr * grads, slots
+
+
+class SparseAdaGradRule:
+    """reference: sparse_adagrad rule — per-row accumulated g², applied
+    server-side on push."""
+
+    slot_dim = 1
+
+    def __init__(self, learning_rate=0.05, initial_g2sum=0.0, eps=1e-8):
+        self.lr = learning_rate
+        self.g0 = initial_g2sum
+        self.eps = eps
+
+    def init_slots(self, n, dim):
+        return np.full((n, 1), self.g0, np.float32)
+
+    def apply(self, rows, slots, grads):
+        g2 = slots[:, 0] + (grads * grads).mean(axis=1)
+        scale = self.lr / (np.sqrt(g2) + self.eps)
+        return rows - scale[:, None] * grads, g2[:, None]
+
+
+# --------------------------------------------------------------- table
+
+class MemorySparseTable:
+    """Host-RAM KV table with create-on-first-touch rows."""
+
+    def __init__(self, embedding_dim, rule=None, initializer=None, seed=0):
+        self.dim = embedding_dim
+        self.rule = rule or SparseAdaGradRule()
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda n: (self._rng.standard_normal((n, self.dim)) /
+                       np.sqrt(self.dim)).astype(np.float32))
+        self._rows = {}   # id -> row index in the arrays below
+        self._data = np.zeros((0, self.dim), np.float32)
+        self._slots = self.rule.init_slots(0, self.dim)
+
+    def __len__(self):
+        return len(self._rows)
+
+    def _ensure(self, ids):
+        missing = [int(i) for i in ids if int(i) not in self._rows]
+        if missing:
+            base = len(self._rows)
+            for k, i in enumerate(missing):
+                self._rows[i] = base + k
+            self._data = np.concatenate(
+                [self._data, self._init(len(missing))])
+            self._slots = np.concatenate(
+                [self._slots, self.rule.init_slots(len(missing), self.dim)])
+
+    def pull(self, ids):
+        """ids: 1-D int array → (n, dim) float32 rows (reference
+        PSClient::PullSparse)."""
+        ids = np.asarray(ids).reshape(-1)
+        self._ensure(ids)
+        idx = np.fromiter((self._rows[int(i)] for i in ids), np.int64,
+                          len(ids))
+        return self._data[idx]
+
+    def push(self, ids, grads):
+        """Apply the optimizer rule to the given rows (reference
+        PSClient::PushSparse; dedup-accumulates repeated ids)."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        self._ensure(uniq)
+        idx = np.fromiter((self._rows[int(i)] for i in uniq), np.int64,
+                          len(uniq))
+        new_rows, new_slots = self.rule.apply(
+            self._data[idx], self._slots[idx], acc)
+        self._data[idx] = new_rows
+        self._slots[idx] = new_slots
+
+    # -- checkpoint integration (paddle_tpu.distributed.checkpoint) --
+    def state_dict(self):
+        ids = np.fromiter(self._rows.keys(), np.int64, len(self._rows))
+        order = np.argsort([self._rows[int(i)] for i in ids])
+        return {"ids": ids[order], "data": self._data,
+                "slots": self._slots}
+
+    def set_state_dict(self, sd):
+        ids = np.asarray(sd["ids"]._value if isinstance(sd["ids"], Tensor)
+                         else sd["ids"]).reshape(-1)
+        self._rows = {int(i): k for k, i in enumerate(ids)}
+        self._data = np.asarray(
+            sd["data"]._value if isinstance(sd["data"], Tensor)
+            else sd["data"], np.float32)
+        self._slots = np.asarray(
+            sd["slots"]._value if isinstance(sd["slots"], Tensor)
+            else sd["slots"], np.float32)
+
+
+# --------------------------------------------------------- layer shims
+
+class SparseEmbedding:
+    """PS-backed embedding lookup (reference static.nn.sparse_embedding /
+    _pull_sparse ops). Pull unique rows → dense device lookup
+    (differentiable) → push row grads on backward via hook."""
+
+    def __init__(self, embedding_dim, table=None, rule=None, name=None):
+        self.table = table if table is not None else MemorySparseTable(
+            embedding_dim, rule=rule)
+        self.dim = embedding_dim
+
+    def __call__(self, ids):
+        from ..ops._helpers import apply_jfn
+
+        ids_np = np.asarray(
+            ids._value if isinstance(ids, Tensor) else ids).astype(np.int64)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows = Tensor(jnp.asarray(self.table.pull(uniq)),
+                      stop_gradient=False)
+        table = self.table
+
+        def _push(g):
+            table.push(uniq, np.asarray(g._value if isinstance(g, Tensor)
+                                        else g))
+            return g
+
+        rows.register_hook(_push)
+        inv_t = Tensor(jnp.asarray(inv.reshape(ids_np.shape)),
+                       stop_gradient=True)
+        return apply_jfn(
+            "sparse_embedding_lookup",
+            lambda w, i: jnp.take(w, i, axis=0), rows, inv_t)
+
+    def parameters(self):
+        return []  # rows live in the table, optimized server-side
+
+
+class ShardedEmbedding:
+    """Dense embedding row-sharded over a mesh axis — the SPMD path when
+    the vocabulary fits device memory (SparseCore-style; XLA lowers the
+    gather to collectives over ICI). Usable inside DistributedTrainStep."""
+
+    def __new__(cls, num_embeddings, embedding_dim, axis="mp", **kwargs):
+        from ..nn.layer.common import Embedding
+        from jax.sharding import PartitionSpec as P
+
+        layer = Embedding(num_embeddings, embedding_dim, **kwargs)
+        layer.weight._pspec = P(axis, None)
+        if mesh_mod.has_mesh():
+            try:
+                layer.weight._value = jax.device_put(
+                    layer.weight._value,
+                    mesh_mod.named_sharding(axis, None))
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"ShardedEmbedding: placing the table on axis "
+                    f"{axis!r} failed ({e}); the weight stays REPLICATED "
+                    "until a parallel step re-shards it", RuntimeWarning)
+        return layer

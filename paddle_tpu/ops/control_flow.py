@@ -161,17 +161,9 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     # map branch_index → position (unknown index → default = last)
     pos = jnp.argmax(keys == iv)
     pos = jnp.where(jnp.any(keys == iv), pos, len(fns) - 1)
-    out_struct = [None]
-
-    def mk(fn):
-        def call(_):
-            out = fn()
-            out_struct[0] = out
-            return _unwrap_tree(out)
-
-        return call
-
-    res = jax.lax.switch(pos, [mk(f) for f in fns], None)
+    res = jax.lax.switch(
+        pos, [(lambda f: lambda _: _unwrap_tree(f()))(f) for f in fns],
+        None)
     return _wrap_tree(res)
 
 

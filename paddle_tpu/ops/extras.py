@@ -31,7 +31,14 @@ __all__ = [
 
 @defop("logcumsumexp")
 def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+
+        dtype = dtype_mod.convert_dtype(dtype)
+
     def jfn(v):
+        if dtype is not None:
+            v = v.astype(dtype)
         if axis is None:
             return jax.lax.cumlogsumexp(v.reshape(-1), axis=0)
         return jax.lax.cumlogsumexp(v, axis=axis)
@@ -347,16 +354,24 @@ def inv(x, name=None):
     return apply_jfn("inv", jnp.linalg.inv, x)
 
 
+def _safe_p_norm(diff, p):
+    """p-norm over the last axis with a zero-safe VJP: the norm's gradient
+    at 0 is NaN (0/||0||); identical points get gradient 0 instead."""
+    sq = jnp.sum(jnp.abs(diff) ** p, axis=-1)
+    nonzero = sq > 0
+    safe = jnp.where(nonzero, sq, 1.0)
+    return jnp.where(nonzero, safe ** (1.0 / p), 0.0)
+
+
 @defop("pdist")
 def pdist(x, p=2.0, name=None):
     """Condensed pairwise distances of rows (reference incubate
-    pdist / torch-compatible)."""
+    pdist / torch-compatible). Differences are taken only for i<j pairs —
+    a full n×n norm would put the zero diagonal through norm's VJP and
+    poison every gradient with NaN."""
     def jfn(v):
-        n = v.shape[0]
-        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :] + 0.0, ord=p,
-                            axis=-1)
-        iu = jnp.triu_indices(n, k=1)
-        return d[iu]
+        iu, ju = np.triu_indices(v.shape[0], k=1)
+        return _safe_p_norm(v[iu] - v[ju], p)
 
     return apply_jfn("pdist", jfn, x)
 
@@ -364,8 +379,7 @@ def pdist(x, p=2.0, name=None):
 @defop("cdist")
 def cdist(x, y, p=2.0, name=None):
     def jfn(a, b):
-        return jnp.linalg.norm(a[..., :, None, :] - b[..., None, :, :],
-                               ord=p, axis=-1)
+        return _safe_p_norm(a[..., :, None, :] - b[..., None, :, :], p)
 
     return apply_jfn("cdist", jfn, x, ensure_tensor(y))
 
@@ -400,9 +414,17 @@ def eig(x, name=None):
     try:
         w, v = jnp.linalg.eig(xv)
     except Exception:
-        cdt = jnp.complex64 if xv.dtype in (jnp.float32,) else jnp.complex128
+        # complex128 needs x64; np.linalg.eig returns REAL arrays for an
+        # all-real spectrum, so cast to the promised complex dtype
+        cdt = (jnp.complex128 if xv.dtype == jnp.float64
+               and jax.config.jax_enable_x64 else jnp.complex64)
+
+        def _host_eig(a):
+            w_, v_ = np.linalg.eig(np.asarray(a))
+            return w_.astype(cdt), v_.astype(cdt)
+
         w, v = jax.pure_callback(
-            lambda a: tuple(np.linalg.eig(np.asarray(a))),
+            _host_eig,
             (jax.ShapeDtypeStruct(xv.shape[:-1], cdt),
              jax.ShapeDtypeStruct(xv.shape, cdt)), xv)
     return Tensor(w, True), Tensor(v, True)

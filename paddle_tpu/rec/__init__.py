@@ -1,0 +1,6 @@
+"""Recommendation model family (the reference ships these via PaddleRec
+on top of its PS runtime; DeepFM is the BASELINE.md recommendation
+config)."""
+from .models import DeepFM, FM  # noqa: F401
+
+__all__ = ["DeepFM", "FM"]

@@ -1,0 +1,110 @@
+"""DeepFM / FM for CTR prediction.
+
+Reference counterpart: the PaddleRec DeepFM config that BASELINE.md
+names as the recommendation baseline, trained on the reference's PS
+runtime (the_one_ps.py). Here the model is a plain Layer whose
+embedding backend is pluggable:
+
+- dense (default): one device table, ids must be < vocab_size; works
+  under jit/DistributedTrainStep (ShardedEmbedding for big vocabs).
+- sparse=True: PS-backed `SparseEmbedding` host tables with unbounded
+  vocab and server-side optimizer rules — the reference's async-PS
+  training shape (eager loop; the dense math still compiles).
+
+DeepFM = linear (first-order) + FM pairwise interactions + DNN over the
+concatenated field embeddings, sharing ONE embedding space keyed by
+globally-offset feature ids (the standard single-table CTR layout the
+PS tables use).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..tensor_core import Tensor
+
+__all__ = ["FM", "DeepFM"]
+
+
+class _DenseBackend:
+    def __init__(self, vocab_size, dim):
+        self.emb = nn.Embedding(vocab_size, dim)
+
+    def __call__(self, ids):
+        return self.emb(ids)
+
+    def layers(self):
+        return [self.emb]
+
+
+class _SparseBackend:
+    def __init__(self, dim, rule=None):
+        from ..distributed.ps import SparseEmbedding
+
+        self.emb = SparseEmbedding(dim, rule=rule)
+
+    def __call__(self, ids):
+        return self.emb(ids)
+
+    def layers(self):
+        return []
+
+
+class FM(nn.Layer):
+    """Factorization machine: w0 + sum_i w_i + 0.5 * sum_k ((Σv)² − Σv²).
+    ids: (B, F) int64 globally-offset feature ids."""
+
+    def __init__(self, vocab_size=None, embed_dim=8, sparse=False,
+                 sparse_rule=None):
+        super().__init__()
+        if sparse:
+            self._first = _SparseBackend(1, rule=sparse_rule)
+            self._embed = _SparseBackend(embed_dim, rule=sparse_rule)
+        else:
+            assert vocab_size is not None, "dense FM needs vocab_size"
+            self._first = _DenseBackend(vocab_size, 1)
+            self._embed = _DenseBackend(vocab_size, embed_dim)
+        for i, lyr in enumerate(self._first.layers()
+                                + self._embed.layers()):
+            setattr(self, f"_t{i}", lyr)  # register dense tables
+        self.bias = self.create_parameter([1], is_bias=True)
+
+    def _terms(self, ids):
+        first = self._first(ids).squeeze(-1).sum(axis=-1)   # (B,)
+        v = self._embed(ids)                                # (B, F, K)
+        s = v.sum(axis=1)
+        pair = 0.5 * ((s * s).sum(axis=-1)
+                      - (v * v).sum(axis=2).sum(axis=-1))   # (B,)
+        return first, pair, v
+
+    def forward(self, ids):
+        first, pair, _ = self._terms(ids)
+        return first + pair + self.bias
+
+
+class DeepFM(nn.Layer):
+    """DeepFM: FM terms + DNN over concatenated field embeddings,
+    sharing the same embedding table."""
+
+    def __init__(self, num_fields, vocab_size=None, embed_dim=8,
+                 hidden=(64, 32), sparse=False, sparse_rule=None):
+        super().__init__()
+        self.fm = FM(vocab_size=vocab_size, embed_dim=embed_dim,
+                     sparse=sparse, sparse_rule=sparse_rule)
+        dims = [num_fields * embed_dim] + list(hidden)
+        layers = []
+        for i in range(len(hidden)):
+            layers += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        layers.append(nn.Linear(dims[-1], 1))
+        self.dnn = nn.Sequential(*layers)
+
+    def forward(self, ids):
+        first, pair, v = self.fm._terms(ids)
+        b = v.shape[0]
+        deep = self.dnn(v.reshape([b, -1])).squeeze(-1)
+        return first + pair + deep + self.fm.bias
+
+    def predict(self, ids):
+        from ..nn import functional as F
+
+        return F.sigmoid(self.forward(ids))

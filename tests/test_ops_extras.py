@@ -129,6 +129,14 @@ def test_linalg_parity():
         rtol=1e-7)
 
 
+def test_pairwise_distance_grads_finite_at_zero():
+    # identical points are non-differentiable for the norm; convention:
+    # gradient 0 there, never NaN
+    a = paddle.to_tensor(np.ones((2, 3)), stop_gradient=False)
+    paddle.cdist(a, paddle.to_tensor(np.ones((2, 3)))).sum().backward()
+    assert np.isfinite(a.grad.numpy()).all()
+
+
 def test_complex_and_random():
     z = rng.standard_normal((3, 2))
     c = paddle.as_complex(paddle.to_tensor(z))
@@ -164,6 +172,7 @@ def test_complex_and_random():
      [rng.standard_normal(9)]),
     ("cdist", lambda a, b: paddle.cdist(a, b),
      [rng.standard_normal((4, 3)), rng.standard_normal((5, 3))]),
+    ("pdist", lambda x: paddle.pdist(x), [rng.standard_normal((5, 3))]),
     ("softmax_ce", lambda x: paddle.nn.functional.softmax(x, axis=-1),
      [rng.standard_normal((2, 6))]),
     ("take", lambda x: paddle.take(

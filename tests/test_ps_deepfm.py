@@ -1,0 +1,161 @@
+"""PS sparse-embedding + DeepFM tests (reference:
+memory_sparse_table.h row semantics, sparse_sgd_rule.cc optimizer rules,
+the_one_ps.py runtime shape; DeepFM is the BASELINE.md rec config)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.ps import (
+    MemorySparseTable, ShardedEmbedding, SparseEmbedding, SparseSGDRule)
+
+rng = np.random.default_rng(11)
+
+
+def test_table_create_on_touch_and_push():
+    t = MemorySparseTable(4, rule=SparseSGDRule(0.1))
+    rows = t.pull(np.array([5, 9, 5]))
+    assert rows.shape == (3, 4) and len(t) == 2
+    np.testing.assert_array_equal(rows[0], rows[2])  # same id, same row
+    before = t.pull(np.array([5]))[0].copy()
+    # repeated id in one push accumulates (reference dedup-push)
+    g = np.ones((3, 4), np.float32)
+    t.push(np.array([5, 9, 5]), g)
+    after = t.pull(np.array([5]))[0]
+    np.testing.assert_allclose(after, before - 0.1 * 2.0, rtol=1e-6)
+
+
+def test_sparse_embedding_matches_dense_sgd():
+    # same init + SGD rule == dense Embedding + SGD, on touched rows
+    dim, vocab = 3, 10
+    W0 = rng.standard_normal((vocab, dim)).astype(np.float32)
+
+    t = MemorySparseTable(dim, rule=SparseSGDRule(0.5))
+    t.pull(np.arange(vocab))
+    t._data[:] = W0
+    semb = SparseEmbedding(dim, table=t)
+
+    demb = nn.Embedding(vocab, dim)
+    demb.weight._value = paddle.to_tensor(W0)._value
+    opt = paddle.optimizer.SGD(0.5, parameters=[demb.weight])
+
+    ids = paddle.to_tensor(np.array([[1, 3], [3, 7]]))
+    out_s = semb(ids)
+    out_d = demb(ids)
+    np.testing.assert_allclose(out_s.numpy(), out_d.numpy(), rtol=1e-6)
+
+    out_s.sum().backward()     # push happens in the grad hook
+    out_d.sum().backward()
+    opt.step()
+    np.testing.assert_allclose(
+        t.pull(np.arange(vocab)), demb.weight.numpy(), rtol=1e-5,
+        atol=1e-7)
+
+
+def test_sparse_embedding_unbounded_vocab():
+    semb = SparseEmbedding(4)
+    big_ids = paddle.to_tensor(np.array([[10 ** 12, 7], [42, 10 ** 12]]))
+    out = semb(big_ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_array_equal(out.numpy()[0, 0], out.numpy()[1, 1])
+
+
+def _ctr_batch(n=64, fields=4, vocab=50, seed=0):
+    r = np.random.default_rng(seed)
+    ids = r.integers(0, vocab, (n, fields))
+    # learnable signal: label correlates with parity of field sum
+    y = ((ids.sum(axis=1) % 2) == 0).astype(np.float32)
+    return paddle.to_tensor(ids), paddle.to_tensor(y)
+
+
+def _bce(logits, y):
+    return nn.functional.binary_cross_entropy_with_logits(logits, y)
+
+
+def test_deepfm_dense_trains_under_trainstep():
+    paddle.seed(0)
+    m = paddle.rec.DeepFM(num_fields=4, vocab_size=50, embed_dim=8)
+    opt = paddle.optimizer.Adam(5e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, lambda mm, x, y: _bce(mm(x), y), opt)
+    ids, y = _ctr_batch()
+    l0 = float(step(ids, y).numpy())
+    for _ in range(30):
+        l = float(step(ids, y).numpy())
+    assert l < l0 * 0.8, (l0, l)
+    p = m.predict(ids).numpy()
+    assert ((0 <= p) & (p <= 1)).all()
+
+
+def test_deepfm_sparse_ps_trains():
+    paddle.seed(0)
+    m = paddle.rec.DeepFM(num_fields=4, embed_dim=8, sparse=True)
+    opt = paddle.optimizer.Adam(5e-3, parameters=m.parameters())
+    ids, y = _ctr_batch(n=32)
+    losses = []
+    for _ in range(25):
+        loss = _bce(m(ids), y)
+        losses.append(float(loss.numpy()))
+        loss.backward()        # embedding push via hooks
+        opt.step()             # DNN params
+        opt.clear_grad()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    # table grew only to touched features
+    assert len(m.fm._embed.emb.table) <= 50
+
+
+def test_sharded_embedding_spmd_parity():
+    mesh_mod.init_mesh(mp=8)
+    try:
+        paddle.seed(0)
+        emb = ShardedEmbedding(16, 8, axis="mp")
+        W = emb.weight.numpy()
+        ids, _ = _ctr_batch(n=8, fields=2, vocab=16)
+        out = emb(ids).numpy()
+        np.testing.assert_allclose(out, W[ids.numpy()], rtol=1e-6)
+        from jax.sharding import PartitionSpec as P
+
+        assert emb.weight._pspec == P("mp", None)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_deepfm_trains_on_virtual_mesh():
+    # dp=2 × mp=4 hybrid: DNN data-parallel, embedding table row-sharded
+    mesh_mod.init_mesh(dp=2, mp=4)
+    try:
+        import paddle_tpu.distributed as dist
+
+        paddle.seed(0)
+        m = paddle.rec.DeepFM(num_fields=4, vocab_size=48, embed_dim=8)
+        from jax.sharding import PartitionSpec as P
+        import jax
+
+        for emb in (m.fm._first.emb, m.fm._embed.emb):
+            emb.weight._pspec = P("mp", None)
+            emb.weight._value = jax.device_put(
+                emb.weight._value, mesh_mod.named_sharding("mp", None))
+        opt = paddle.optimizer.Adam(5e-3, parameters=m.parameters())
+        step = dist.DistributedTrainStep(
+            m, lambda mm, x, y: _bce(mm(x), y), opt)
+        ids, y = _ctr_batch(vocab=48)
+        l0 = float(step(ids, y).numpy())
+        for _ in range(20):
+            l = float(step(ids, y).numpy())
+        assert l < l0 * 0.9, (l0, l)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_table_checkpoint_roundtrip(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    t = MemorySparseTable(4)
+    t.pull(np.array([3, 99, 7]))
+    t.push(np.array([3, 7]), np.ones((2, 4), np.float32))
+    ckpt.save_state_dict({"table": t.state_dict()}, str(tmp_path / "c"))
+    back = ckpt.load_state_dict(str(tmp_path / "c"))
+    t2 = MemorySparseTable(4)
+    t2.set_state_dict(back["table"])
+    np.testing.assert_allclose(t2.pull(np.array([3, 99, 7])),
+                               t.pull(np.array([3, 99, 7])))
