@@ -74,6 +74,8 @@ for _sub in (
     "hapi",
     "linalg",
     "rec",
+    "distribution",
+    "audio",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

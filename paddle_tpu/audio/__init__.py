@@ -1,0 +1,12 @@
+"""paddle_tpu.audio (reference: python/paddle/audio/ — features/layers.py
+Spectrogram:33, MelSpectrogram:116, LogMelSpectrogram:231, MFCC:335)."""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,112 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py).
+
+STFT is framing + window + rfft: framing via gather (static shapes), the
+spectrogram/mel/dct stages are matmuls — all MXU/XLA-friendly and usable
+inside jitted steps."""
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..ops._helpers import apply_jfn
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center, pad_mode):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(frame_length // 2,
+                                          frame_length // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    n = 1 + (x.shape[-1] - frame_length) // hop_length
+    starts = jnp.arange(n) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]  # (..., n_frames, frame_length)
+
+
+class Spectrogram(Layer):
+    """reference layers.py:33 — |STFT|^power, (..., freq, time)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype=None):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self._window = w
+
+    def forward(self, x):
+        def jfn(v):
+            frames = _frame(v, self.n_fft, self.hop_length, self.center,
+                            self.pad_mode)
+            spec = jnp.fft.rfft(frames * self._window, axis=-1)
+            mag = jnp.abs(spec)
+            if self.power != 1.0:
+                mag = mag ** self.power
+            return jnp.swapaxes(mag, -1, -2)  # (..., freq, time)
+
+        return apply_jfn("spectrogram", jfn, x)
+
+
+class MelSpectrogram(Layer):
+    """reference layers.py:116."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype=None):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode)
+        self.fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        return apply_jfn(
+            "mel_spectrogram",
+            lambda s: jnp.einsum("mf,...ft->...mt", self.fbank, s), spec)
+
+
+class LogMelSpectrogram(Layer):
+    """reference layers.py:231."""
+
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **mel_kwargs):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        return apply_jfn(
+            "log_mel",
+            lambda m: F.power_to_db(m, self.ref_value, self.amin,
+                                    self.top_db), mel)
+
+
+class MFCC(Layer):
+    """reference layers.py:335 — DCT over log-mel."""
+
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **mel_kwargs):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        n_mels = self._log_mel._mel.fbank.shape[0]
+        self.dct = F.create_dct(n_mfcc, n_mels, norm=norm)
+
+    def forward(self, x):
+        lm = self._log_mel(x)
+        return apply_jfn(
+            "mfcc", lambda m: jnp.einsum("mk,...mt->...kt", self.dct, m),
+            lm)

@@ -1,0 +1,75 @@
+"""Elastic / fault-tolerant training (reference:
+python/paddle/distributed/fleet/elastic/manager.py:127 ElasticManager —
+etcd-watched membership, restart-on-failure; launch --elastic_level).
+
+On TPU pods the failure model is preemption/XLA aborts rather than
+stragglers joining an etcd ring, so the TPU-native pieces are:
+
+- the launcher's pod babysitting (`launch --max_restart`, which restarts
+  the whole pod — reference elastic_level 1), and
+- `run_with_fault_tolerance` here: an in-process supervision loop that
+  pairs the training function with a Checkpointer; on a step failure it
+  restores the latest complete checkpoint and resumes, preserving
+  exactly-once step semantics (train→crash→resume == uninterrupted, see
+  tests).
+
+ElasticManager keeps the reference's API shape for scripts that consult
+it (enabled / exit codes / watch loop hooks)."""
+import time
+
+__all__ = ["ElasticStatus", "ElasticManager", "run_with_fault_tolerance",
+           "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # reference manager.py ELASTIC_EXIT_CODE
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """API-shaped shim: membership is the mesh (static per pod slice);
+    `watch()` reports restart/exit from the supervised loop's results."""
+
+    def __init__(self, args=None, etcd_client=None):
+        self.enabled = bool(getattr(args, "elastic_level", 0))
+        self._status = ElasticStatus.HOLD
+
+    def pre_hook(self):
+        pass
+
+    def watch(self):
+        return self._status
+
+    def exit(self, completed=True):
+        self._status = (ElasticStatus.COMPLETED if completed
+                        else ElasticStatus.ERROR)
+
+
+def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
+                             backoff_s=0.0, on_restart=None):
+    """Run `train_fn(start_step) -> last_step`, restoring from
+    `checkpointer` (paddle_tpu.distributed.checkpoint.Checkpointer) and
+    retrying on failure.
+
+    train_fn must checkpoint through `checkpointer` as it goes; on an
+    exception the latest COMPLETE checkpoint is loaded (half-written
+    ones are invisible by construction) and train_fn is re-entered at
+    the restored step. Raises the last error after max_restarts."""
+    attempt = 0
+    while True:
+        start = checkpointer.load_latest() or 0
+        try:
+            return train_fn(start)
+        except Exception:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt)
+            if backoff_s:
+                time.sleep(backoff_s)

@@ -95,7 +95,9 @@ class MemorySparseTable:
         return len(self._rows)
 
     def _ensure(self, ids):
-        missing = [int(i) for i in ids if int(i) not in self._rows]
+        # dedupe: a new id repeated within one batch must allocate ONE row
+        missing = list(dict.fromkeys(
+            int(i) for i in ids if int(i) not in self._rows))
         if missing:
             base = len(self._rows)
             for k, i in enumerate(missing):
@@ -187,27 +189,28 @@ class SparseEmbedding:
         return []  # rows live in the table, optimized server-side
 
 
-class ShardedEmbedding:
-    """Dense embedding row-sharded over a mesh axis — the SPMD path when
-    the vocabulary fits device memory (SparseCore-style; XLA lowers the
-    gather to collectives over ICI). Usable inside DistributedTrainStep."""
+def ShardedEmbedding(num_embeddings, embedding_dim, axis="mp", **kwargs):
+    """Factory: a dense nn.Embedding whose table is row-sharded over a
+    mesh axis — the SPMD path when the vocabulary fits device memory
+    (SparseCore-style; XLA lowers the gather to collectives over ICI).
+    Usable inside DistributedTrainStep. Returns an Embedding instance
+    (kept a function, not a subclass: the sharding is placement state on
+    the weight, not behavior)."""
+    from ..nn.layer.common import Embedding
+    from jax.sharding import PartitionSpec as P
 
-    def __new__(cls, num_embeddings, embedding_dim, axis="mp", **kwargs):
-        from ..nn.layer.common import Embedding
-        from jax.sharding import PartitionSpec as P
+    layer = Embedding(num_embeddings, embedding_dim, **kwargs)
+    layer.weight._pspec = P(axis, None)
+    if mesh_mod.has_mesh():
+        try:
+            layer.weight._value = jax.device_put(
+                layer.weight._value,
+                mesh_mod.named_sharding(axis, None))
+        except Exception as e:
+            import warnings
 
-        layer = Embedding(num_embeddings, embedding_dim, **kwargs)
-        layer.weight._pspec = P(axis, None)
-        if mesh_mod.has_mesh():
-            try:
-                layer.weight._value = jax.device_put(
-                    layer.weight._value,
-                    mesh_mod.named_sharding(axis, None))
-            except Exception as e:
-                import warnings
-
-                warnings.warn(
-                    f"ShardedEmbedding: placing the table on axis "
-                    f"{axis!r} failed ({e}); the weight stays REPLICATED "
-                    "until a parallel step re-shards it", RuntimeWarning)
-        return layer
+            warnings.warn(
+                f"ShardedEmbedding: placing the table on axis "
+                f"{axis!r} failed ({e}); the weight stays REPLICATED "
+                "until a parallel step re-shards it", RuntimeWarning)
+    return layer

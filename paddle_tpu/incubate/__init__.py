@@ -1,0 +1,7 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate/ — optimizer/
+lookahead.py LookAhead:28, modelaverage.py ModelAverage:31; nn fused
+layers; distributed/models/moe lives in paddle_tpu.distributed.moe)."""
+from . import optimizer  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = ["optimizer", "nn"]

@@ -1,0 +1,14 @@
+"""incubate.nn fused layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py FusedMultiHeadAttention:30,
+FusedFeedForward:290, FusedTransformerEncoderLayer:450).
+
+On TPU "fused" means: expressed so XLA/Pallas fuse it — the standard
+nn.TransformerEncoderLayer already routes attention through the Pallas
+flash-attention kernel when eligible, so these classes alias the dense
+implementations and exist for source compatibility."""
+from ..nn.layer.transformer import (  # noqa: F401
+    MultiHeadAttention as FusedMultiHeadAttention,
+    TransformerEncoderLayer as FusedTransformerEncoderLayer,
+)
+
+__all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer"]

@@ -1,0 +1,94 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py:28, modelaverage.py:31). Both wrap an inner optimizer and
+keep their extra state as host-side pytrees of device arrays."""
+import jax.numpy as jnp
+
+from ..tensor_core import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """slow weights updated every k fast steps:
+    slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._params = list(inner_optimizer._parameter_list)
+        self._slow = [p._value for p in self._params]
+        self._step_num = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + self.alpha * (p._value
+                                                     - self._slow[i])
+                self._slow[i] = slow
+                p._value = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._step_num
+        for i, s in enumerate(self._slow):
+            sd[f"@slow_{i}"] = Tensor(s)
+        return sd
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd)
+        if "@lookahead_step" in sd:
+            self._step_num = int(sd["@lookahead_step"])
+        for i in range(len(self._slow)):
+            k = f"@slow_{i}"
+            if k in sd:
+                v = sd[k]
+                self._slow[i] = v._value if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; `apply()` swaps the
+    averaged weights in for evaluation, `restore()` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._value) for p in self._params]
+        self._count = 0
+        self._backup = None
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+
+    def step(self):
+        self._count += 1
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._value
+        if self._count > self.max_average_window:
+            # restart the window (reference's moving restart semantics)
+            for i, p in enumerate(self._params):
+                self._sum[i] = p._value
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = [p._value for p in self._params]
+        for i, p in enumerate(self._params):
+            p._value = self._sum[i] / self._count
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, v in zip(self._params, self._backup):
+            p._value = v
+        self._backup = None

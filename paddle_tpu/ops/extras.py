@@ -356,7 +356,12 @@ def inv(x, name=None):
 
 def _safe_p_norm(diff, p):
     """p-norm over the last axis with a zero-safe VJP: the norm's gradient
-    at 0 is NaN (0/||0||); identical points get gradient 0 instead."""
+    at 0 is NaN (0/||0||); identical points get gradient 0 instead.
+    p=inf (Chebyshev) and p=0 (nonzero count) follow norm's ord rules."""
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 0:
+        return jnp.sum((diff != 0).astype(diff.dtype), axis=-1)
     sq = jnp.sum(jnp.abs(diff) ** p, axis=-1)
     nonzero = sq > 0
     safe = jnp.where(nonzero, sq, 1.0)
@@ -416,8 +421,9 @@ def eig(x, name=None):
     except Exception:
         # complex128 needs x64; np.linalg.eig returns REAL arrays for an
         # all-real spectrum, so cast to the promised complex dtype
-        cdt = (jnp.complex128 if xv.dtype == jnp.float64
-               and jax.config.jax_enable_x64 else jnp.complex64)
+        wide = xv.dtype in (jnp.float64, jnp.complex128)
+        cdt = (jnp.complex128 if wide and jax.config.jax_enable_x64
+               else jnp.complex64)
 
         def _host_eig(a):
             w_, v_ = np.linalg.eig(np.asarray(a))

@@ -1,0 +1,238 @@
+"""device / distribution / audio / incubate / elastic coverage tests
+(reference: python/paddle/device, distribution/, audio/, incubate/,
+fleet/elastic/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, distribution as D, nn
+
+
+# ---------------------------------------------------------------- device
+
+def test_device_surface():
+    dev = paddle.device.get_device()
+    assert ":" in dev
+    assert paddle.device.device_count() >= 1
+    paddle.device.synchronize()
+    # memory stats are ints (0 on CPU hosts without stats)
+    assert isinstance(paddle.device.memory_allocated(), int)
+    assert isinstance(paddle.device.max_memory_allocated(), int)
+    props = paddle.device.get_device_properties()
+    assert props.name
+    # cuda alias namespace works against the accelerator
+    assert paddle.device.cuda.device_count() == paddle.device.device_count()
+    paddle.device.cuda.empty_cache()
+    with paddle.device.stream_guard(paddle.device.Stream()):
+        pass
+    assert not paddle.device.is_compiled_with_cuda()
+
+
+# ---------------------------------------------------------- distribution
+
+def test_normal_sampling_and_kl():
+    paddle.seed(0)
+    n = D.Normal(loc=1.0, scale=2.0)
+    s = n.sample([20000])
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+    assert abs(float(s.numpy().std()) - 2.0) < 0.1
+    lp = n.log_prob(paddle.to_tensor([1.0]))
+    ref = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp.numpy(), [ref], rtol=1e-5)
+    kl = D.kl_divergence(n, D.Normal(1.0, 2.0))
+    np.testing.assert_allclose(float(kl.numpy()), 0.0, atol=1e-6)
+    # entropy of N(1,2)
+    np.testing.assert_allclose(
+        float(n.entropy().numpy()),
+        0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rtol=1e-6)
+
+
+def test_categorical_uniform_beta_dirichlet():
+    paddle.seed(1)
+    c = D.Categorical(logits=paddle.to_tensor([0.0, 0.0, 0.0]))
+    draws = c.sample([3000]).numpy()
+    counts = np.bincount(draws.astype(int), minlength=3) / 3000
+    assert (abs(counts - 1 / 3) < 0.05).all()
+    np.testing.assert_allclose(float(c.entropy().numpy()), np.log(3),
+                               rtol=1e-5)
+
+    u = D.Uniform(0.0, 2.0)
+    assert float(u.log_prob(paddle.to_tensor([1.0])).numpy()) == \
+        pytest.approx(-np.log(2.0))
+    assert np.isneginf(float(u.log_prob(paddle.to_tensor([3.0])).numpy()))
+
+    b = D.Beta(2.0, 3.0)
+    np.testing.assert_allclose(float(b.mean.numpy()), 0.4, rtol=1e-6)
+    # beta log_prob vs closed form at x=0.5: log B(2,3)^-1 * x (1-x)^2
+    import math
+
+    ref = (math.lgamma(5) - math.lgamma(2) - math.lgamma(3)
+           + np.log(0.5) + 2 * np.log(0.5))
+    np.testing.assert_allclose(
+        float(b.log_prob(paddle.to_tensor([0.5])).numpy()), ref,
+        rtol=1e-5)
+
+    d = D.Dirichlet(paddle.to_tensor([1.0, 1.0, 1.0]))
+    s = d.sample([5])
+    np.testing.assert_allclose(s.numpy().sum(-1), np.ones(5), rtol=1e-5)
+    # KL(p||p) = 0
+    np.testing.assert_allclose(
+        float(D.kl_divergence(d, D.Dirichlet(
+            paddle.to_tensor([1.0, 1.0, 1.0]))).numpy()), 0.0, atol=1e-5)
+
+
+def test_transformed_and_independent():
+    paddle.seed(2)
+    base = D.Normal(0.0, 1.0)
+    logn = D.TransformedDistribution(base, [D.ExpTransform()])
+    x = paddle.to_tensor([1.5])
+    # lognormal pdf at x: N(log x)/x
+    ref = (-0.5 * np.log(1.5) ** 2 - 0.5 * np.log(2 * np.pi)
+           - np.log(1.5))
+    np.testing.assert_allclose(float(logn.log_prob(x).numpy()), ref,
+                               rtol=1e-5)
+    ind = D.Independent(D.Normal(jnp.zeros(3), jnp.ones(3)), 1)
+    lp = ind.log_prob(paddle.to_tensor([0.0, 0.0, 0.0]))
+    np.testing.assert_allclose(float(lp.numpy()),
+                               3 * (-0.5 * np.log(2 * np.pi)), rtol=1e-5)
+
+
+# ------------------------------------------------------------------ audio
+
+def test_mel_fbank_and_windows():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=400,
+                                               n_mels=40)
+    assert fb.shape == (40, 201)
+    assert float(fb.min()) >= 0.0
+    w = audio.functional.get_window("hann", 400)
+    assert w.shape == (400,) and float(w.max()) <= 1.0
+    dct = audio.functional.create_dct(13, 40)
+    assert dct.shape == (40, 13)
+    # ortho DCT columns are orthonormal
+    gram = np.asarray(dct.T @ dct)
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+def test_spectrogram_pipeline():
+    paddle.seed(3)
+    sr, n_fft, hop = 16000, 256, 128
+    t = np.arange(sr // 4) / sr
+    wave = np.sin(2 * np.pi * 1000 * t).astype(np.float32)  # 1 kHz tone
+    x = paddle.to_tensor(wave[None])
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=hop)(x)
+    assert spec.shape[1] == n_fft // 2 + 1
+    # energy peaks at the 1 kHz bin
+    peak_bin = int(np.asarray(spec.numpy()).mean(axis=-1).argmax())
+    expect = round(1000 * n_fft / sr)
+    assert abs(peak_bin - expect) <= 1
+    mel = audio.MelSpectrogram(sr=sr, n_fft=n_fft, hop_length=hop,
+                               n_mels=32)(x)
+    assert mel.shape[1] == 32
+    logmel = audio.LogMelSpectrogram(sr=sr, n_fft=n_fft, hop_length=hop,
+                                     n_mels=32)(x)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = audio.MFCC(sr=sr, n_mfcc=13, n_fft=n_fft, hop_length=hop,
+                      n_mels=32)(x)
+    assert mfcc.shape[1] == 13
+
+
+# --------------------------------------------------------------- incubate
+
+def test_lookahead_converges_and_slow_updates():
+    paddle.seed(4)
+    lin = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    opt = paddle.incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randn(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(20):
+        loss = ((lin(x) - y) ** 2).mean()
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_model_average_apply_restore():
+    lin = nn.Linear(2, 1)
+    ma = paddle.incubate.optimizer.ModelAverage(
+        parameters=lin.parameters())
+    w0 = lin.weight.numpy().copy()
+    ma.step()
+    lin.weight._value = lin.weight._value + 1.0
+    ma.step()
+    ma.apply()
+    np.testing.assert_allclose(lin.weight.numpy(), w0 + 0.5, rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(), w0 + 1.0, rtol=1e-6)
+
+
+def test_incubate_fused_aliases():
+    layer = paddle.incubate.nn.FusedMultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    assert layer(x, x, x).shape == [2, 5, 16]
+
+
+# ---------------------------------------------------------------- elastic
+
+def test_fault_tolerant_resume_matches_uninterrupted(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.fleet.elastic import (
+        run_with_fault_tolerance)
+
+    def build():
+        paddle.seed(5)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda mm, x, y: ((mm(x) - y) ** 2).mean(), opt)
+        return m, step
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 2)).astype(np.float32))
+
+    # uninterrupted: 6 steps
+    m1, step1 = build()
+    for _ in range(6):
+        ref = float(step1(x, y).numpy())
+
+    # supervised: crashes at step 4 on the first attempt
+    m2, step2 = build()
+    cp = ckpt.Checkpointer(str(tmp_path / "ft"), model=m2,
+                           train_step=step2)
+    crashed = {"done": False}
+    out = {}
+
+    def train(start):
+        for s in range(start + 1, 7):
+            if s == 4 and not crashed["done"]:
+                crashed["done"] = True
+                raise RuntimeError("simulated preemption")
+            out["loss"] = float(step2(x, y).numpy())
+            cp.save(s)
+        return 6
+
+    last = run_with_fault_tolerance(train, cp, max_restarts=2)
+    assert last == 6 and crashed["done"]
+    np.testing.assert_allclose(out["loss"], ref, rtol=1e-5)
+
+
+def test_fault_tolerance_gives_up_after_max_restarts(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.fleet.elastic import (
+        run_with_fault_tolerance)
+
+    m = nn.Linear(2, 2)
+    cp = ckpt.Checkpointer(str(tmp_path / "x"), model=m)
+
+    def always_fails(start):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_with_fault_tolerance(always_fails, cp, max_restarts=2)

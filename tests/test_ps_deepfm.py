@@ -53,6 +53,27 @@ def test_sparse_embedding_matches_dense_sgd():
         atol=1e-7)
 
 
+def test_table_duplicate_new_ids_one_row():
+    # a NEW id repeated in one batch must not corrupt the row map
+    t = MemorySparseTable(4, rule=SparseSGDRule(0.1))
+    t.pull(np.array([5, 9, 5]))
+    t.pull(np.array([11]))
+    assert len(t) == 3
+    assert len(set(t._rows.values())) == 3  # distinct rows per id
+    row11_before = t.pull(np.array([11]))[0].copy()
+    t.push(np.array([5]), np.ones((1, 4), np.float32))
+    np.testing.assert_array_equal(t.pull(np.array([11]))[0], row11_before)
+
+
+def test_cdist_inf_and_zero_norms():
+    a = paddle.to_tensor(np.array([[0.0, 0.0, 3.0], [5.0, 0.0, 0.0]]))
+    b = paddle.to_tensor(np.array([[1.0, 2.0, 0.0]]))
+    np.testing.assert_allclose(
+        paddle.cdist(a, b, p=float("inf")).numpy(), [[3.0], [4.0]])
+    np.testing.assert_allclose(
+        paddle.cdist(a, b, p=0.0).numpy(), [[3.0], [2.0]])
+
+
 def test_sparse_embedding_unbounded_vocab():
     semb = SparseEmbedding(4)
     big_ids = paddle.to_tensor(np.array([[10 ** 12, 7], [42, 10 ** 12]]))
