@@ -257,8 +257,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    """Reduce-to-root; on TPU the SPMD form is allreduce + mask (the root
-    distinction is meaningless inside one compiled program)."""
+    """Reduce-to-root. DEGRADED vs reference (collective.py:845): every
+    rank receives the reduced value, not only `dst` — in one compiled
+    SPMD program the root distinction buys nothing (XLA would all-reduce
+    anyway), and ranks other than dst are free to ignore the result.
+    Code that relies on non-dst ranks keeping their ORIGINAL tensor must
+    save it before calling."""
     return all_reduce(tensor, op=op, group=group)
 
 
@@ -353,6 +357,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Scatter slices of the src-rank tensor. DEGRADED vs reference
+    (collective.py:1120): inside one SPMD program every rank executes
+    the same code on a replicated input, so `src` is vacuous — each rank
+    slices its own chunk of the (identical) full tensor. If callers feed
+    rank-DIVERGENT inputs, the result follows each rank's own input, not
+    src's; broadcast first in that case."""
     t = ensure_tensor(tensor_list if isinstance(tensor_list, Tensor)
                       else tensor)
     if not _in_spmd():
@@ -392,6 +402,17 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    """Equal-split all-to-all. XLA's all_to_all is a static equal split;
+    ragged splits (reference alltoall_single:1326 with size lists) have
+    no efficient ICI lowering — pad to equal splits instead of passing
+    size lists."""
+    for splits in (in_split_sizes, out_split_sizes):
+        if splits is not None and len(set(splits)) > 1:
+            raise NotImplementedError(
+                "alltoall_single with unequal split sizes is not "
+                "supported on TPU (static equal splits only) — pad to "
+                "uniform splits"
+            )
     return alltoall(in_tensor, group=group)
 
 

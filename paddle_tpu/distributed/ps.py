@@ -35,7 +35,7 @@ from ..tensor_core import Tensor
 from . import mesh as mesh_mod
 
 __all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
-           "SparseEmbedding", "ShardedEmbedding"]
+           "make_sparse_table", "SparseEmbedding", "ShardedEmbedding"]
 
 
 # ------------------------------------------------------ optimizer rules
@@ -77,8 +77,41 @@ class SparseAdaGradRule:
 
 # --------------------------------------------------------------- table
 
+def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
+                      backend="auto"):
+    """Table factory. backend="auto"/"native" uses the C++ core
+    (paddle_tpu.native NativeSparseTable, mirroring the reference's C++
+    memory_sparse_table) when available and the rule is a stock
+    SGD/AdaGrad with no custom initializer; otherwise (or with
+    backend="python") the numpy MemorySparseTable. Both expose the same
+    pull/push/len/state_dict contract."""
+    if backend in ("auto", "native"):
+        from .. import native
+
+        kind = None
+        if rule is None or isinstance(rule, SparseAdaGradRule):
+            kind = "adagrad"
+        elif isinstance(rule, SparseSGDRule):
+            kind = "sgd"
+        usable = (kind is not None and initializer is None
+                  and native.is_available())
+        if usable:
+            r = rule or SparseAdaGradRule()
+            kw = dict(lr=r.lr, seed=seed)
+            if kind == "adagrad":
+                kw.update(g0=r.g0, eps=r.eps)
+            return native.NativeSparseTable(embedding_dim, rule=kind, **kw)
+        if backend == "native":
+            raise RuntimeError(
+                "native backend requested but unavailable (no g++) "
+                "or incompatible with a custom rule/initializer")
+    return MemorySparseTable(embedding_dim, rule=rule,
+                             initializer=initializer, seed=seed)
+
+
 class MemorySparseTable:
-    """Host-RAM KV table with create-on-first-touch rows."""
+    """Host-RAM KV table with create-on-first-touch rows (pure-python
+    engine; see make_sparse_table for the native C++ alternative)."""
 
     def __init__(self, embedding_dim, rule=None, initializer=None, seed=0):
         self.dim = embedding_dim
@@ -158,9 +191,10 @@ class SparseEmbedding:
     _pull_sparse ops). Pull unique rows → dense device lookup
     (differentiable) → push row grads on backward via hook."""
 
-    def __init__(self, embedding_dim, table=None, rule=None, name=None):
-        self.table = table if table is not None else MemorySparseTable(
-            embedding_dim, rule=rule)
+    def __init__(self, embedding_dim, table=None, rule=None, name=None,
+                 backend="auto"):
+        self.table = table if table is not None else make_sparse_table(
+            embedding_dim, rule=rule, backend=backend)
         self.dim = embedding_dim
 
     def __call__(self, ids):

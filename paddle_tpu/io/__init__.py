@@ -269,7 +269,12 @@ def default_collate_fn(batch):
 
         return Tensor(jnp.stack([s._value for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        # native assembler: GIL-released parallel memcpy (falls back to
+        # np.stack when the C++ library is unavailable) — the reference
+        # does batch assembly in C++ too (framework/data_feed.cc)
+        from .. import native
+
+        return Tensor(native.assemble_batch(batch))
     if isinstance(sample, (int, float, np.floating, np.integer)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):

@@ -94,6 +94,25 @@ def _tree_rebuild(spec, values):
     return spec[1]
 
 
+def _closure_modes(fn):
+    """training flags of Layers a standalone @to_static function closes
+    over — the jitted program freezes `self.training` reads at trace
+    time, so a train/eval flip on a captured layer must key a new
+    program (direct closure cells only; layers reached through nested
+    containers still need a re-decorated function)."""
+    out = []
+    f = getattr(fn, "__func__", fn)
+    for cell in getattr(f, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        tr = getattr(v, "training", None)
+        if isinstance(tr, bool):
+            out.append(tr)
+    return tuple(out)
+
+
 class StaticFunction:
     """Traced-function cache, one compiled program per input signature
     (≈ ConcreteProgram cache keyed by FunctionSpec in the reference)."""
@@ -132,14 +151,22 @@ class StaticFunction:
         arg_tensors = []
         spec = _tree_tensors((args, kwargs), arg_tensors)
         _, params = self._params_of(bound_self)
+        # the jitted program freezes python state read at trace time, so
+        # everything that may change between calls must be in the cache
+        # key (mode flags) or threaded as an argument (PRNG key below)
         key = (_sig_of((args, kwargs)), id(bound_self),
-               engine.is_grad_enabled())
+               engine.is_grad_enabled(),
+               getattr(bound_self, "training", None),
+               _closure_modes(self._fn))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._trace(bound_self, spec, arg_tensors, params)
             self._cache[key] = entry
         jfn, out_spec_holder = entry
-        all_inputs = list(arg_tensors) + list(params)
+        from ..core import rng as rng_mod
+
+        key_t = Tensor(rng_mod.next_key(), stop_gradient=True)
+        all_inputs = [key_t] + list(arg_tensors) + list(params)
         flat_out = engine.apply(
             f"to_static:{self._fn.__name__}", jfn, tuple(all_inputs)
         )
@@ -156,7 +183,9 @@ class StaticFunction:
         ]
         param_objs = params
 
-        def jfn(*flat_vals):
+        def jfn(step_key, *flat_vals):
+            from ..core import rng as rng_mod
+
             arg_vals = flat_vals[:n_args]
             param_vals = flat_vals[n_args:]
             wrapped = [
@@ -169,10 +198,14 @@ class StaticFunction:
             for p, v in zip(param_objs, param_vals):
                 p._value = v
             try:
-                if bound_self is not None:
-                    out = fn(bound_self, *args, **kwargs)
-                else:
-                    out = fn(*args, **kwargs)
+                # per-call PRNG key threaded as an ARGUMENT: dropout etc.
+                # draw from it, so the jitted program doesn't bake the
+                # trace-time key in (same-mask-every-call bug)
+                with rng_mod.trace_key_scope(step_key):
+                    if bound_self is not None:
+                        out = fn(bound_self, *args, **kwargs)
+                    else:
+                        out = fn(*args, **kwargs)
             finally:
                 for p, v in zip(param_objs, originals):
                     p._value = v
@@ -182,19 +215,27 @@ class StaticFunction:
             vals = tuple(t._value for t in out_tensors)
             return vals if len(vals) != 1 else vals[0]
 
-        return jfn, out_spec_holder
+        # jit the captured program: repeated same-signature calls hit the
+        # XLA executable cache instead of re-tracing the python function
+        # (jax caches the jaxpr by avals, so vjp/tape composition around
+        # it also stops re-entering python)
+        return jax.jit(jfn), out_spec_holder
 
     @property
     def concrete_program(self):
         return self._last_concrete
 
     def get_traced(self, *example_args, **example_kwargs):
-        """Return (pure_jax_fn, flat_example_vals) for export/bench."""
+        """Return (pure_jax_fn, flat_example_vals) for export/bench.
+        The traced fn's first argument is the per-call PRNG key; the
+        returned example vals include one."""
+        from ..core import rng as rng_mod
+
         arg_tensors = []
         spec = _tree_tensors((example_args, example_kwargs), arg_tensors)
         bound_self = None
         jfn, _ = self._trace(bound_self, spec, arg_tensors, [])
-        return jfn, [t._value for t in arg_tensors]
+        return jfn, [rng_mod.next_key()] + [t._value for t in arg_tensors]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -1,0 +1,215 @@
+"""paddle_tpu.native — C++ runtime components, loaded via ctypes.
+
+The reference implements its host runtime (PS tables, data feed,
+allocator) in C++; this package is the TPU-native equivalent for the
+pieces that stay on the host: the sparse-table KV core and the
+DataLoader batch assembler (see the .cc files for reference pointers).
+
+Build model: one shared library compiled from the .cc sources with the
+system g++ on first import, cached next to the sources keyed by a
+source hash (no pip, no pybind11 — plain C ABI + ctypes). If no
+compiler is available the callers fall back to their pure-python
+paths; `is_available()` reports which world you're in.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["sparse_table.cc", "batch_assemble.cc"]
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _source_hash():
+    h = hashlib.sha1()
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+def _build(out_path):
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", out_path] + [os.path.join(_DIR, s) for s in _SOURCES]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = os.path.join(_DIR, f"libpaddle_tpu_{_source_hash()}.so")
+        try:
+            if not os.path.exists(path):
+                tmp = path + f".tmp{os.getpid()}"
+                _build(tmp)
+                os.replace(tmp, path)
+            lib = ctypes.CDLL(path)
+        except Exception:
+            return None
+        # ---- signatures ----
+        lib.pt_table_create.restype = ctypes.c_void_p
+        lib.pt_table_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
+        lib.pt_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_table_size.restype = ctypes.c_int64
+        lib.pt_table_size.argtypes = [ctypes.c_void_p]
+        lib.pt_table_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.pt_table_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.pt_table_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.pt_table_import.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.pt_assemble_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def get_lib():
+    """The loaded CDLL, building it if needed; None when unavailable."""
+    return _load()
+
+
+def is_available():
+    return _load() is not None
+
+
+# ------------------------------------------------------------ wrappers
+
+class NativeSparseTable:
+    """ctypes wrapper over the C++ table (same contract as the python
+    MemorySparseTable storage engine: pull creates rows, push applies
+    the optimizer rule with dedup)."""
+
+    RULES = {"sgd": 0, "adagrad": 1}
+
+    def __init__(self, dim, rule="adagrad", lr=0.05, init_scale=None,
+                 g0=0.0, eps=1e-8, seed=0):
+        import numpy as np
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no g++?)")
+        self._lib = lib
+        self.dim = int(dim)
+        self.rule = rule
+        if init_scale is None:
+            init_scale = 1.0 / float(np.sqrt(dim))
+        self._h = ctypes.c_void_p(lib.pt_table_create(
+            self.dim, self.RULES[rule], float(lr), float(init_scale),
+            float(g0), float(eps), int(seed)))
+
+    def __len__(self):
+        return int(self._lib.pt_table_size(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def pull(self, ids):
+        import numpy as np
+
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.pt_table_pull(self._h, ids.ctypes.data, len(ids),
+                                out.ctypes.data)
+        return out
+
+    def push(self, ids, grads):
+        import numpy as np
+
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(len(ids), self.dim))
+        self._lib.pt_table_push(self._h, ids.ctypes.data, len(ids),
+                                grads.ctypes.data)
+
+    def state_dict(self):
+        import numpy as np
+
+        n = len(self)
+        ids = np.empty((n,), np.int64)
+        data = np.empty((n, self.dim), np.float32)
+        slot_dim = 1 if self.rule == "adagrad" else 0
+        slots = np.empty((n, slot_dim), np.float32)
+        if n:
+            self._lib.pt_table_export(self._h, ids.ctypes.data,
+                                      data.ctypes.data, slots.ctypes.data)
+        return {"ids": ids, "data": data, "slots": slots}
+
+    def set_state_dict(self, sd):
+        import numpy as np
+
+        ids = np.ascontiguousarray(_np_of(sd["ids"]).reshape(-1), np.int64)
+        data = np.ascontiguousarray(_np_of(sd["data"]), np.float32)
+        slots = np.ascontiguousarray(_np_of(sd["slots"]), np.float32)
+        # validate BEFORE crossing the ctypes boundary — the C++ side
+        # trusts the sizes and would read past a mismatched buffer
+        n = len(ids)
+        if data.shape != (n, self.dim):
+            raise ValueError(
+                f"table state 'data' has shape {data.shape}, expected "
+                f"({n}, {self.dim}) — checkpoint from a different table?")
+        slot_dim = 1 if self.rule == "adagrad" else 0
+        if slot_dim and slots.shape != (n, slot_dim):
+            raise ValueError(
+                f"table state 'slots' has shape {slots.shape}, expected "
+                f"({n}, {slot_dim})")
+        self._lib.pt_table_import(
+            self._h, ids.ctypes.data, n, data.ctypes.data,
+            slots.ctypes.data if slots.size else None)
+
+
+def _np_of(x):
+    import numpy as np
+
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+def assemble_batch(samples, out=None, n_threads=0):
+    """Stack N equal-shaped contiguous numpy samples into one batch
+    array using the native thread pool (GIL released). Falls back to
+    np.stack when the library is missing."""
+    import numpy as np
+
+    lib = get_lib()
+    samples = [np.ascontiguousarray(s) for s in samples]
+    if lib is None:
+        return np.stack(samples)
+    n = len(samples)
+    if n == 0:
+        raise ValueError("empty batch")
+    shape, dtype = samples[0].shape, samples[0].dtype
+    if dtype.hasobject:
+        # raw memcpy of PyObject* would skip increfs → refcount corruption
+        return np.stack(samples)
+    for s in samples[1:]:
+        if s.shape != shape or s.dtype != dtype:
+            return np.stack(samples)  # ragged: numpy's error/semantics
+    if out is None:
+        out = np.empty((n,) + shape, dtype)
+    ptrs = (ctypes.c_void_p * n)(
+        *[s.ctypes.data for s in samples])
+    lib.pt_assemble_batch(ptrs, n, samples[0].nbytes, out.ctypes.data,
+                          n_threads)
+    return out

@@ -1,0 +1,149 @@
+// Native sparse embedding table (host KV) — C++ core of
+// paddle_tpu.distributed.ps.MemorySparseTable.
+//
+// TPU-native counterpart of the reference PS table runtime
+// (reference: paddle/fluid/distributed/ps/table/memory_sparse_table.h:39
+// hash-grown rows; ps/table/sparse_sgd_rule.cc server-side optimizer
+// rules). The reference runs this inside brpc PS server processes; on
+// TPU hosts it runs in-process beside the device runtime, feeding
+// batched pulls to HBM. Exposed as a plain C ABI for ctypes (no
+// pybind11 in the image).
+//
+// Concurrency: a shared mutex around the id->row map; pull/push copy
+// row data outside Python (callers pass numpy buffers), so the GIL is
+// released for the whole operation.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+  int64_t dim;
+  int rule;          // 0 = sgd, 1 = adagrad
+  float lr;
+  float init_scale;  // rows init ~ N(0, init_scale)
+  float g0;          // adagrad initial accumulator
+  float eps;
+  std::unordered_map<int64_t, int64_t> rows;
+  std::vector<float> data;   // (nrows, dim)
+  std::vector<float> slots;  // (nrows, slot_dim)
+  std::mt19937_64 rng;
+  std::mutex mu;
+
+  int64_t slot_dim() const { return rule == 1 ? 1 : 0; }
+
+  int64_t ensure(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    int64_t r = static_cast<int64_t>(rows.size());
+    rows.emplace(id, r);
+    std::normal_distribution<float> nd(0.f, init_scale);
+    for (int64_t j = 0; j < dim; ++j) data.push_back(nd(rng));
+    for (int64_t j = 0; j < slot_dim(); ++j) slots.push_back(g0);
+    return r;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_table_create(int64_t dim, int rule, float lr, float init_scale,
+                      float g0, float eps, uint64_t seed) {
+  auto* t = new Table();
+  t->dim = dim;
+  t->rule = rule;
+  t->lr = lr;
+  t->init_scale = init_scale;
+  t->g0 = g0;
+  t->eps = eps;
+  t->rng.seed(seed);
+  return t;
+}
+
+void pt_table_destroy(void* h) { delete static_cast<Table*>(h); }
+
+int64_t pt_table_size(void* h) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->rows.size());
+}
+
+// out: (n, dim) float32, caller-allocated
+void pt_table_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = t->ensure(ids[i]);
+    std::memcpy(out + i * t->dim, t->data.data() + r * t->dim,
+                sizeof(float) * t->dim);
+  }
+}
+
+// grads: (n, dim). Duplicate ids are accumulated before ONE rule
+// application (reference push-dedup semantics).
+void pt_table_push(void* h, const int64_t* ids, int64_t n,
+                   const float* grads) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  std::unordered_map<int64_t, std::vector<float>> acc;
+  acc.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    auto& buf = acc[ids[i]];
+    if (buf.empty()) buf.assign(t->dim, 0.f);
+    const float* gi = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) buf[j] += gi[j];
+  }
+  for (auto& kv : acc) {
+    int64_t r = t->ensure(kv.first);
+    float* row = t->data.data() + r * t->dim;
+    const float* gacc = kv.second.data();
+    if (t->rule == 1) {  // adagrad: per-row mean-squared accumulator
+      float g2 = 0.f;
+      for (int64_t j = 0; j < t->dim; ++j) g2 += gacc[j] * gacc[j];
+      g2 /= static_cast<float>(t->dim);
+      float* slot = t->slots.data() + r;  // slot_dim == 1
+      *slot += g2;
+      float scale = t->lr / (std::sqrt(*slot) + t->eps);
+      for (int64_t j = 0; j < t->dim; ++j) row[j] -= scale * gacc[j];
+    } else {  // sgd
+      for (int64_t j = 0; j < t->dim; ++j) row[j] -= t->lr * gacc[j];
+    }
+  }
+}
+
+// Checkpoint export: ids (size,), data (size*dim), slots (size*slot_dim)
+void pt_table_export(void* h, int64_t* ids_out, float* data_out,
+                     float* slots_out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (const auto& kv : t->rows) {
+    ids_out[kv.second] = kv.first;
+  }
+  std::memcpy(data_out, t->data.data(), sizeof(float) * t->data.size());
+  if (t->slot_dim() > 0 && !t->slots.empty())
+    std::memcpy(slots_out, t->slots.data(),
+                sizeof(float) * t->slots.size());
+}
+
+void pt_table_import(void* h, const int64_t* ids, int64_t n,
+                     const float* data, const float* slots) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  t->rows.clear();
+  t->rows.reserve(n);
+  t->data.assign(data, data + n * t->dim);
+  if (t->slot_dim() > 0 && slots)
+    t->slots.assign(slots, slots + n * t->slot_dim());
+  else
+    t->slots.clear();
+  for (int64_t i = 0; i < n; ++i) t->rows.emplace(ids[i], i);
+}
+
+}  // extern "C"

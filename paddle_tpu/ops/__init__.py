@@ -110,6 +110,7 @@ def _install_tensor_methods():
         def method(self, *args, **kwargs):
             old = _snapshot_for_inplace(self, opname)
             out = fn(old, *args, **kwargs)
+            self._inplace_version += 1
             self._value = out._value
             self._grad_node = out._grad_node
             self._out_index = out._out_index

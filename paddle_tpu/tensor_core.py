@@ -34,6 +34,7 @@ class Tensor:
         "persistable",
         "trainable",
         "_pspec",  # jax PartitionSpec for distributed placement (or None)
+        "_inplace_version",  # bumped on every mutation (tensor_wrapper.h)
         "__weakref__",
     )
 
@@ -55,6 +56,7 @@ class Tensor:
         self._backward_hooks = None
         self.persistable = False
         self._pspec = None
+        self._inplace_version = 0
         self.trainable = not stop_gradient
 
     # ---- metadata ----
@@ -167,6 +169,24 @@ class Tensor:
         pass
 
     # ---- mutation ----
+    def _check_mutation(self, opname):
+        """Direct-assignment mutations on a NON-leaf sever the recorded
+        graph — the reference detects this via inplace version counting
+        (paddle/fluid/eager/tensor_wrapper.h); silently dropping the
+        grad node yields wrong gradients, so raise instead. (Recorded
+        vjps here capture values functionally, so mutating a LEAF never
+        corrupts already-recorded gradients — only severing does.)"""
+        from .autograd import engine as _engine
+
+        if (_engine.is_grad_enabled() and not self.stop_gradient
+                and self._grad_node is not None):
+            raise RuntimeError(
+                f"{opname} would overwrite a non-leaf Tensor that is part "
+                "of a recorded gradient graph; call it under "
+                "paddle.no_grad() or on a detached tensor"
+            )
+        self._inplace_version += 1  # only mutations that actually happen
+
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._value
@@ -175,6 +195,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch {value.shape} vs {self._value.shape}"
             )
+        self._check_mutation("set_value")
         self._value = value
         self._grad_node = None
         return self
@@ -183,6 +204,7 @@ class Tensor:
         return self.set_value(other)
 
     def fill_(self, v):
+        self._check_mutation("fill_")
         self._value = jnp.full_like(self._value, v)
         self._grad_node = None
         return self
@@ -190,10 +212,8 @@ class Tensor:
     def zero_(self):
         return self.fill_(0)
 
-    def scale_(self, scale=1.0, bias=0.0):
-        self._value = self._value * scale + bias
-        self._grad_node = None
-        return self
+    # scale_ is installed by ops._install_tensor_methods as a
+    # tape-recording in-place op (no graph severing) — not defined here
 
     # ---- conversion ----
     def astype(self, dtype):

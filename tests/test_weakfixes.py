@@ -1,0 +1,242 @@
+"""Weak-list hardening tests: inplace guards, collective edge semantics,
+bf16 (TPU-realistic precision) tier, DataLoader hostile inputs, and a
+jit recompilation-count guard."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io, nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- inplace version guard
+
+def test_set_value_on_nonleaf_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(RuntimeError, match="non-leaf"):
+        y.set_value(np.array([0.0, 0.0], np.float32))
+    with pytest.raises(RuntimeError, match="non-leaf"):
+        y.fill_(0.0)
+    # allowed under no_grad (and the graph is explicitly severed)
+    with paddle.no_grad():
+        y.set_value(np.array([5.0, 5.0], np.float32))
+    np.testing.assert_allclose(y.numpy(), [5.0, 5.0])
+
+
+def test_leaf_mutation_allowed_and_versioned():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    v0 = p._inplace_version
+    p.set_value(np.array([2.0], np.float32))
+    assert p._inplace_version == v0 + 1
+    q = paddle.to_tensor([3.0])
+    q.scale_(2.0)
+    assert q._inplace_version == 1
+    np.testing.assert_allclose(q.numpy(), [6.0])
+
+
+def test_inplace_op_bumps_version():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(1.0)
+    assert x._inplace_version == 1
+
+
+# --------------------------------------------- collective edge semantics
+
+def test_alltoall_single_unequal_splits_raise():
+    import paddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.ones((4, 2), np.float32))
+    with pytest.raises(NotImplementedError, match="unequal"):
+        dist.alltoall_single(t, in_split_sizes=[3, 1])
+    with pytest.raises(NotImplementedError, match="unequal"):
+        dist.alltoall_single(t, out_split_sizes=[1, 3])
+    # equal splits pass through (world size 1: identity)
+    out = dist.alltoall_single(t, in_split_sizes=[2, 2])
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_send_recv_raise_with_guidance():
+    import paddle_tpu.distributed as dist
+
+    with pytest.raises(RuntimeError, match="p2p_shift"):
+        dist.collective.send(paddle.to_tensor([1.0]), dst=1)
+
+
+# ------------------------------------------------------------- bf16 tier
+
+def test_bf16_training_tier():
+    """TPU-realistic numerics: x64 OFF, bf16 AMP compute. Runs in a
+    subprocess because jax_enable_x64 is process-global in the suite."""
+    script = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import amp, nn
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+
+        def loss_fn(mm, x, y):
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                return nn.functional.cross_entropy(mm(x), y)
+
+        step = paddle.jit.TrainStep(m, loss_fn, opt)
+        r = np.random.default_rng(0)
+        x = paddle.to_tensor(r.standard_normal((32, 16)).astype(np.float32))
+        y = paddle.to_tensor(r.integers(0, 4, (32,)))
+        l0 = float(step(x, y).numpy())
+        for _ in range(25):
+            l = float(step(x, y).numpy())
+        assert np.isfinite(l), "bf16 loss not finite"
+        assert l < l0 * 0.7, (l0, l)
+        # bf16 matmul inside autocast really is bf16
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = m[0](x)
+        assert out.dtype in ("bfloat16", jnp.bfloat16), out.dtype
+        # params stay fp32 master copies (O1)
+        assert m[0].weight._value.dtype == jnp.float32
+        print("BF16_TIER_OK")
+    """) % (ROOT,)
+    env = dict(os.environ)
+    env.pop("JAX_ENABLE_X64", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "BF16_TIER_OK" in r.stdout
+
+
+# ------------------------------------------------ DataLoader hostile use
+
+class _ExplodingDataset(io.Dataset):
+    def __init__(self, n=10, explode_at=5):
+        self.n, self.explode_at = n, explode_at
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == self.explode_at:
+            raise ValueError("poisoned sample")
+        return np.float32(i)
+
+
+def test_dataloader_propagates_dataset_exception():
+    dl = io.DataLoader(_ExplodingDataset(), batch_size=2, num_workers=2)
+    with pytest.raises(Exception, match="poisoned sample"):
+        for _ in dl:
+            pass
+
+
+def test_dataloader_empty_dataset():
+    class Empty(io.Dataset):
+        def __len__(self):
+            return 0
+
+        def __getitem__(self, i):
+            raise IndexError(i)
+
+    dl = io.DataLoader(Empty(), batch_size=4)
+    assert list(dl) == []
+
+
+def test_dataloader_batch_larger_than_dataset():
+    class Tiny(io.Dataset):
+        def __len__(self):
+            return 3
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    batches = list(io.DataLoader(Tiny(), batch_size=10, drop_last=False))
+    assert len(batches) == 1
+    assert list(io.DataLoader(Tiny(), batch_size=10, drop_last=True)) == []
+
+
+# ----------------------------- jit cache must not freeze dynamic state
+
+def test_to_static_dropout_mask_varies_across_calls():
+    paddle.seed(7)
+    drop = nn.Dropout(0.5)
+    drop.train()
+
+    @paddle.jit.to_static
+    def f(x):
+        return drop(x)
+
+    x = paddle.to_tensor(np.ones((4, 64), np.float32))
+    m1 = f(x).numpy()
+    m2 = f(x).numpy()
+    assert (m1 != m2).any(), "dropout mask identical across calls (baked key)"
+
+
+def test_to_static_standalone_fn_honors_closure_layer_mode():
+    paddle.seed(9)
+    drop = nn.Dropout(0.9)
+    drop.train()
+
+    @paddle.jit.to_static
+    def f(x):
+        return drop(x)
+
+    x = paddle.to_tensor(np.ones((2, 32), np.float32))
+    out_train = f(x).numpy()
+    drop.eval()
+    out_eval = f(x).numpy()
+    np.testing.assert_array_equal(out_eval, x.numpy())
+    assert (out_train == 0).any()
+
+
+def test_to_static_honors_train_eval_flip():
+    paddle.seed(8)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.drop = nn.Dropout(0.9)
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            return self.drop(x)
+
+    m = M()
+    x = paddle.to_tensor(np.ones((2, 32), np.float32))
+    m.train()
+    out_train = m(x).numpy()
+    m.eval()
+    out_eval = m(x).numpy()
+    np.testing.assert_array_equal(out_eval, x.numpy())  # eval: identity
+    assert (out_train == 0).any()  # train: something dropped
+
+
+# ------------------------------------------- recompilation-count guard
+
+def test_to_static_compiles_once_per_signature():
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def f(x):
+        calls["n"] += 1
+        return x * 2.0
+
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    for _ in range(5):
+        f(a)
+    assert calls["n"] == 1, f"python fn retraced {calls['n']} times"
+    f(paddle.to_tensor(np.ones((4, 3), np.float32)))  # new signature
+    assert calls["n"] == 2
+    f(a)  # cached signature again
+    assert calls["n"] == 2
