@@ -125,6 +125,62 @@ def bench_resnet():
             "images_per_sec": round(batch / dt)}
 
 
+def bench_bert():
+    """ERNIE-3.0/BERT-base MLM pretraining step (BASELINE.md config 3)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.text.models import (
+        BertForPretraining, BertPretrainingCriterion, bert_base)
+
+    paddle.seed(0)
+    cfg = bert_base()
+    batch, seq = 32, 512
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, labels, nsp):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            mlm, nsp_logits = m(ids)
+            return crit(mlm, labels, nsp_logits, nsp)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seq))
+    labels = np.full((batch, seq), -100, np.int64)
+    mask = rng.random((batch, seq)) < 0.15
+    labels[mask] = ids_np[mask]
+    ids = paddle.to_tensor(ids_np.astype(np.int32))
+    labels_t = paddle.to_tensor(labels)
+    nsp = paddle.to_tensor(rng.integers(0, 2, (batch,)))
+
+    t0 = time.perf_counter()
+    float(step(ids, labels_t, nsp).numpy())
+    log(f"[bench] bert-base compile+step0 {time.perf_counter()-t0:.1f}s")
+    for _ in range(2):
+        step(ids, labels_t, nsp)
+    float(step(ids, labels_t, nsp).numpy())
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step(ids, labels_t, nsp)
+    float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    # analytic fwd+bwd matmul FLOPs: 6·P_matmul per token + attention
+    d, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    per_layer = 4 * d * d + 2 * d * cfg.intermediate_size
+    p_matmul = L * per_layer + v * d + 2 * d * d  # + mlm head transforms
+    tokens = batch * seq
+    flops = 6 * p_matmul * tokens + L * batch * (4 * seq * seq * d) * 3
+    mfu = flops / dt / V5E_PEAK_BF16
+    samples_per_sec = batch / dt
+    log(f"[bench] bert-base: {dt*1e3:.1f} ms/step, "
+        f"{samples_per_sec:.1f} samples/s, mfu {mfu:.3f}")
+    return {"model": "bert-base-mlm", "ms_per_step": round(dt * 1e3, 2),
+            "samples_per_sec": round(samples_per_sec, 1),
+            "mfu": round(mfu, 4)}
+
+
 def main():
     results = {}
     try:
@@ -135,6 +191,10 @@ def main():
         results["resnet"] = bench_resnet()
     except Exception as e:
         log(f"[bench] resnet failed: {e!r}")
+    try:
+        results["bert"] = bench_bert()
+    except Exception as e:
+        log(f"[bench] bert failed: {e!r}")
 
     if "gpt" in results:
         mfu = results["gpt"]["mfu"]

@@ -3,6 +3,7 @@
 Collectives = XLA programs over one jax.sharding.Mesh; fleet topology
 names mesh axes; parallelism = placement (see SURVEY.md §7 design map).
 """
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import collective  # noqa: F401
 from . import env  # noqa: F401

@@ -6,6 +6,12 @@ from .mp_layers import (  # noqa: F401
     mark_sharding,
     shard_activation,
 )
+from .pipeline_1f1b import (  # noqa: F401
+    interleaved_pipeline_loss,
+    interleaved_stacking_order,
+    pipeline_1f1b,
+    pipeline_forward_loss,
+)
 from .pipeline_parallel import PipelineParallel, spmd_pipeline  # noqa: F401
 from .pp_layers import (  # noqa: F401
     LayerDesc,

@@ -38,7 +38,8 @@ from jax.sharding import PartitionSpec as P
 
 from ... import mesh as mesh_mod
 
-__all__ = ["pipeline_1f1b", "pipeline_forward_loss"]
+__all__ = ["pipeline_1f1b", "pipeline_forward_loss",
+           "interleaved_pipeline_loss", "interleaved_stacking_order"]
 
 
 def _tree_zeros(tree):
@@ -269,3 +270,61 @@ def _pipeline_bwd(block_fn, loss_fn, remat, res, g):
 
 
 pipeline_1f1b.defvjp(_pipeline_fwd, _pipeline_bwd)
+
+
+# ---------------------------------------------------------------------
+# Interleaved virtual stages
+# ---------------------------------------------------------------------
+
+def interleaved_stacking_order(pp, num_virtual):
+    """Row order for stacking global blocks into the [pp·V, ...] param
+    pytree of `interleaved_pipeline_loss`: stack row r holds global block
+    order[r]. Global block g runs in virtual pass v = g // pp on stage
+    s = g % pp, and stage s's shard is rows [s·V, (s+1)·V), so
+    order[s·V + v] = v·pp + s (the reference's round-robin layer
+    assignment, pp_layers.py SegmentLayers with virtual stages)."""
+    order = [0] * (pp * num_virtual)
+    for g in range(pp * num_virtual):
+        v, s = divmod(g, pp)
+        order[s * num_virtual + v] = g
+    return order
+
+
+def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
+                              post_params, batch, num_virtual=1,
+                              remat=True):
+    """Virtual-stage pipeline loss (reference:
+    fleet/meta_parallel/pipeline_parallel.py:416
+    PipelineParallelWithInterleave, parallel_layers/pp_layers.py:198).
+
+    Each device owns `num_virtual` NON-contiguous model chunks
+    (round-robin layer placement — the interleave memory/balance
+    property). stacked_params leaves are [pp·V, ...] sharded P('pp'),
+    rows ordered by `interleaved_stacking_order` so stage s's shard is
+    its V chunks. The forward chains V fill-drain passes over the 'pp'
+    axis; autodiff runs through the scans (activation memory O(M) per
+    stage — the reference's tick-interleaved 1F1B schedule that also
+    shrinks the bubble V× is a scheduling refinement on top of this
+    placement).
+
+    Returns mean micro-loss; differentiable w.r.t. params/post/x_micro.
+    """
+    from .pipeline_parallel import spmd_pipeline
+
+    mesh = mesh_mod.global_mesh()
+    pp = mesh.shape["pp"]
+    x_micro, y_micro = batch
+    V = num_virtual
+
+    # [pp·V, ...] → [pp, V, ...]: chunk v of every stage is [:, v]
+    def split_chunks(a):
+        return a.reshape((pp, V) + a.shape[1:])
+
+    chunked = jax.tree_util.tree_map(split_chunks, stacked_params)
+    x = x_micro
+    for v in range(V):
+        params_v = jax.tree_util.tree_map(lambda a, _v=v: a[:, _v],
+                                          chunked)
+        x = spmd_pipeline(block_fn, params_v, x, remat=remat)
+    losses = jax.vmap(lambda o, y: loss_fn(o, y, post_params))(x, y_micro)
+    return jnp.mean(losses)

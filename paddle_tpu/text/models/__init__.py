@@ -12,8 +12,25 @@ from .gpt import (  # noqa: F401
     gpt_tiny,
 )
 
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    ErnieForPretraining,
+    ErnieModel,
+    bert_base,
+    bert_tiny,
+    ernie_3_base,
+)
+
 __all__ = [
     "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
     "GPTPretrainingCriterion", "gpt_tiny", "gpt_small", "gpt_medium",
     "gpt_1p3b",
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertPretrainingCriterion", "BertForSequenceClassification",
+    "ErnieModel", "ErnieForPretraining", "bert_tiny", "bert_base",
+    "ernie_3_base",
 ]
