@@ -76,6 +76,10 @@ for _sub in (
     "rec",
     "distribution",
     "audio",
+    "inference",
+    "native",
+    "sparse",
+    "quantization",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

@@ -202,26 +202,43 @@ class Engine:
         loader = (valid_data if not isinstance(valid_data, Dataset)
                   else DataLoader(valid_data, batch_size=batch_size))
         total, n = 0.0, 0
-        with no_grad():
-            for batch in loader:
-                *xs, y = batch if isinstance(batch, (tuple, list)) \
-                    else (batch,)
-                out = self.model(*xs)
-                total += float(self.loss(out, y).numpy())
-                n += 1
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            with no_grad():
+                for batch in loader:
+                    *xs, y = batch if isinstance(batch, (tuple, list)) \
+                        else (batch,)
+                    out = self.model(*xs)
+                    bs = int(y.shape[0]) if y.ndim else 1
+                    # sample-weighted: a short final batch must not be
+                    # over-weighted in the dataset mean
+                    total += float(self.loss(out, y).numpy()) * bs
+                    n += bs
+        finally:
+            if was_training:
+                self.model.train()
         return {"loss": total / max(n, 1)}
 
     def predict(self, test_data, batch_size=1):
+        """test_data must yield MODEL INPUTS only (no labels) — the
+        reference Engine splits inputs from labels by declared specs;
+        without specs every batch element is fed to the model."""
         from ..io import DataLoader, Dataset
         from ..autograd import no_grad
 
         loader = (test_data if not isinstance(test_data, Dataset)
                   else DataLoader(test_data, batch_size=batch_size))
         outs = []
-        with no_grad():
-            for batch in loader:
-                xs = batch if isinstance(batch, (tuple, list)) else (batch,)
-                if len(xs) > 1:
-                    xs = xs[:-1]  # drop the label, keep ALL model inputs
-                outs.append(self.model(*xs))
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            with no_grad():
+                for batch in loader:
+                    xs = batch if isinstance(batch, (tuple, list)) \
+                        else (batch,)
+                    outs.append(self.model(*xs))
+        finally:
+            if was_training:
+                self.model.train()
         return outs

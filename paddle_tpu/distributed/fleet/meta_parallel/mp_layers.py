@@ -69,6 +69,20 @@ def shard_activation(x, *spec):
     return apply_jfn("shard_activation", jfn, x)
 
 
+def split_fused_qkv(qkv, batch, seq, num_heads, head_dim):
+    """[b, s, 3·d] fused-qkv (mp-sharded last dim) → (q, k, v) each
+    [b, s, nh, hd] with heads riding 'mp' and sequence free to ride
+    'sp' — the one attention input layout every transformer here uses."""
+    from ....ops import manipulation as manip
+
+    qkv = manip.reshape(qkv, [batch, seq, 3, num_heads, head_dim])
+    out = []
+    for i in range(3):
+        t = manip.squeeze(manip.slice(qkv, [2], [i], [i + 1]), [2])
+        out.append(shard_activation(t, "dp", "sp", "mp", None))
+    return tuple(out)
+
+
 class VocabParallelEmbedding(nn.Layer):
     """Embedding with the vocab dimension sharded over 'mp'
     (reference mp_layers.py:39: per-rank vocab range + masked lookup +

@@ -1,0 +1,273 @@
+"""paddle_tpu.inference — deployment predictor API.
+
+TPU-native re-design of the reference inference stack (reference:
+python/paddle/inference/__init__.py exports; C++ AnalysisPredictor
+paddle/fluid/inference/api/analysis_predictor.cc, Config
+paddle_analysis_config.h, handle-based IO paddle_inference_api.h:53).
+
+The reference loads a serialized program, runs IR passes (fusion, TRT
+subgraphs), and executes on its own runtime. Here the serialized
+artifact is a StableHLO export (paddle.jit.save) and "passes" are XLA's
+compilation — `create_predictor(config)` deserializes, places weights on
+the configured device, and compiles on first run. The handle-based
+copy_from_cpu/run/copy_to_cpu surface is kept so reference deployment
+code ports unchanged.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..jit import load as _jit_load
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PredictorPool",
+    "InferTensor", "DataType", "PlaceType", "PrecisionType",
+    "get_version", "get_num_bytes_of_data_type",
+    "convert_to_mixed_precision",
+]
+
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+def get_version():
+    import paddle_tpu
+
+    return getattr(paddle_tpu, "__version__", "0.0")
+
+
+def get_num_bytes_of_data_type(dtype):
+    return np.dtype(str(dtype)).itemsize
+
+
+class Config:
+    """reference paddle_analysis_config.h AnalysisConfig. Pass-pipeline
+    knobs collapse into XLA; device/precision knobs are honored."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config("path/model") with side files; here
+        # ONE prefix produces <prefix>.stablehlo + <prefix>.pdiparams
+        self.model_path = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._profile = False
+
+    # -- device selection --
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._device, self._device_id = "tpu", device_id  # accelerator
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass  # XLA owns host threading
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # -- precision / optimization --
+    def enable_mixed_precision(self, precision=PrecisionType.Bfloat16):
+        self._precision = precision
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+    def enable_profile(self):
+        self._profile = True
+
+    def summary(self):
+        return (f"Config(model={self.model_path}, device={self._device}:"
+                f"{self._device_id}, precision={self._precision})")
+
+
+class InferTensor:
+    """Handle-based IO tensor (reference paddle_inference_api.h Tensor:
+    copy_from_cpu / copy_to_cpu / reshape / shape)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = np.reshape(self._data, shape)
+        else:
+            self._data = np.zeros(shape, np.float32)
+
+    def copy_from_cpu(self, arr):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._data)
+
+    def shape(self):
+        return list(np.shape(self._data))
+
+
+class Predictor:
+    """reference analysis_predictor.cc Predictor: named handles + run()."""
+
+    def __init__(self, config):
+        self.config = config
+        if config.model_path is None:
+            raise ValueError("Config needs the saved-model path prefix")
+        self._layer = _jit_load(config.model_path)
+        n_in = getattr(self._layer, "_n_inputs", None) or 1
+        self._in_names = [f"x{i}" for i in range(n_in)]
+        self._inputs = {n: InferTensor(n) for n in self._in_names}
+        self._outputs = {}
+        self._device = self._pick_device()
+        self._place_params()
+
+    def _pick_device(self):
+        devs = jax.devices()
+        if self.config._device == "cpu":
+            cpus = [d for d in devs if d.platform == "cpu"]
+            return cpus[0] if cpus else devs[0]
+        accel = [d for d in devs if d.platform != "cpu"] or devs
+        return accel[min(self.config._device_id, len(accel) - 1)]
+
+    def _place_params(self):
+        # dtypes are BAKED into the StableHLO signature at export time;
+        # a precision knob that disagrees with the artifact cannot be
+        # honored here — use convert_to_mixed_precision on the files
+        if self.config._precision != PrecisionType.Float32:
+            want = np.dtype(str(self.config._precision))
+            have = {str(v.dtype) for v in self._layer._param_vals
+                    if jnp.issubdtype(v.dtype, jnp.floating)}
+            if have - {str(want)}:
+                import warnings
+
+                warnings.warn(
+                    f"artifact was exported with param dtypes {have}; "
+                    f"requested {want} — running as exported. Re-save "
+                    "with convert_to_mixed_precision for bf16 storage.",
+                    RuntimeWarning)
+        self._layer._param_vals = [jax.device_put(v, self._device)
+                                   for v in self._layer._param_vals]
+
+    # -- reference API --
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs) or ["output_0"]
+
+    def get_output_handle(self, name):
+        return self._outputs.setdefault(name, InferTensor(name))
+
+    def run(self, inputs=None):
+        """Handle-based (no args) or direct (list of arrays) execution."""
+        if inputs is None:
+            inputs = [self._inputs[n].copy_to_cpu()
+                      for n in self._in_names]
+        arrs = [jax.device_put(np.asarray(x), self._device)
+                for x in inputs]
+        out = self._layer(*arrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        host = [np.asarray(o._value.astype(jnp.float32)
+                           if o._value.dtype == jnp.bfloat16 else o._value)
+                for o in outs]
+        for i, h in enumerate(host):
+            self.get_output_handle(f"output_{i}")._data = h
+        return host
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+class PredictorPool:
+    """N predictors over one artifact (reference PredictorPool) — on TPU
+    they share the compiled executable via jax's cache."""
+
+    def __init__(self, config, size=1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(src_prefix, dst_prefix,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=None, **kw):
+    """Re-export a jit.save artifact with parameters STORED in the mixed
+    dtype (reference convert_to_mixed_precision tool). The new program
+    casts params up at its boundary, so weight storage/transfer halves
+    while the exported compute graph is reused unchanged (TPU matmuls
+    already run bf16 on the MXU via default precision)."""
+    from ..framework.io_state import save as t_save
+
+    layer = _jit_load(src_prefix)
+    cast = (jnp.bfloat16 if mixed_precision == PrecisionType.Bfloat16
+            else np.dtype(str(mixed_precision)))
+    old_vals = layer._param_vals
+    stored, orig_dtypes = [], []
+    for v in old_vals:
+        orig_dtypes.append(v.dtype)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            stored.append(v.astype(cast))
+        else:
+            stored.append(v)
+    exported = layer._exported
+
+    def fn(params, *xs):
+        up = [p.astype(d) if jnp.issubdtype(p.dtype, jnp.floating) else p
+              for p, d in zip(params, orig_dtypes)]
+        return exported.call(up, *xs)
+
+    n_params = len(old_vals)
+    in_avals = list(exported.in_avals)
+    input_shaped = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in in_avals[n_params:]]
+    param_shaped = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in stored]
+    new_exported = jax.export.export(jax.jit(fn))(param_shaped,
+                                                  *input_shaped)
+    with open(dst_prefix + ".stablehlo", "wb") as f:
+        f.write(new_exported.serialize())
+    t_save({"names": layer._names,
+            "params": [np.asarray(v) for v in stored],
+            "n_inputs": getattr(layer, "_n_inputs", None)},
+           dst_prefix + ".pdiparams")

@@ -327,7 +327,8 @@ def save(layer, path, input_spec=None, **configs):
         with open(path + ".stablehlo", "wb") as f:
             f.write(blob)
         tensor_save({"names": names,
-                     "params": [np.asarray(v) for v in param_vals]},
+                     "params": [np.asarray(v) for v in param_vals],
+                     "n_inputs": len(input_spec)},
                     path + ".pdiparams")
     finally:
         if was_training:
@@ -338,10 +339,11 @@ class TranslatedLayer:
     """Inference-only loaded program (reference: paddle.jit.load →
     TranslatedLayer, C++ twin paddle/fluid/jit/layer.cc)."""
 
-    def __init__(self, exported, names, param_vals):
+    def __init__(self, exported, names, param_vals, n_inputs=None):
         self._exported = exported
         self._names = names
         self._param_vals = param_vals
+        self._n_inputs = n_inputs
         self.training = False
 
     def __call__(self, *inputs):
@@ -369,7 +371,8 @@ def load(path, **configs):
         exported = jax.export.deserialize(f.read())
     bundle = tensor_load(path + ".pdiparams", return_numpy=True)
     param_vals = [jnp.asarray(v) for v in bundle["params"]]
-    return TranslatedLayer(exported, bundle["names"], param_vals)
+    return TranslatedLayer(exported, bundle["names"], param_vals,
+                           n_inputs=bundle.get("n_inputs"))
 
 
 # ------------------------------------------------------------- train step

@@ -1,0 +1,296 @@
+"""paddle_tpu.sparse — sparse tensors (COO/CSR) and their op corpus.
+
+TPU-native re-design of the reference sparse API (reference:
+python/paddle/incubate/sparse/ — creation.py sparse_coo_tensor:68,
+unary.py/binary.py op corpus; C++ SparseCooTensor
+paddle/phi/core/sparse_coo_tensor.h, sparse kernels
+paddle/phi/kernels/sparse/).
+
+Representation: `jax.experimental.sparse.BCOO` under a paddle-shaped
+`SparseCooTensor` wrapper whose VALUES are a framework Tensor — unary
+ops and sparse·dense matmul funnel through the autograd tape, so
+gradients flow into sparse values exactly like dense code. CSR keeps
+its compressed rows for the API but computes as COO (on TPU both lower
+to gather/scatter + dot_general; there is no separate CSR kernel zoo to
+mirror).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse", "coalesce",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "cast", "neg", "deg2rad",
+    "rad2deg", "expm1",
+    "add", "subtract", "multiply", "divide",
+    "matmul", "masked_matmul", "mv", "addmm", "to_dense",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [ndim, nnz] + values [nnz] (+ dense
+    trailing dims)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices_ = ensure_tensor(indices)
+        self.values_ = values if isinstance(values, Tensor) \
+            else ensure_tensor(values)
+        self.shape = list(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- paddle Tensor-ish surface --
+    def indices(self):
+        return self.indices_
+
+    def values(self):
+        return self.values_
+
+    @property
+    def nnz(self):
+        return int(value_of(self.values_).shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    @property
+    def stop_gradient(self):
+        return self.values_.stop_gradient
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def _bcoo(self, vvals=None):
+        idx = jnp.swapaxes(value_of(self.indices_), 0, 1)  # [nnz, ndim]
+        v = vvals if vvals is not None else value_of(self.values_)
+        return jsparse.BCOO((v, idx), shape=tuple(self.shape))
+
+    def to_dense(self):
+        idx_t = self.indices_
+        shape = tuple(self.shape)
+
+        def jfn(v):
+            idx = jnp.swapaxes(value_of(idx_t), 0, 1)
+            return jsparse.BCOO((v, idx), shape=shape).todense()
+
+        return apply_jfn("sparse_to_dense", jfn, self.values_)
+
+    def numpy(self):
+        return np.asarray(value_of(self.to_dense()))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def backward(self, *a, **k):
+        return self.values_.backward(*a, **k)
+
+    @property
+    def grad(self):
+        return self.values_.grad
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view: keeps crows/cols for the API, computes as COO."""
+
+    def __init__(self, crows, cols, values, shape):
+        crows_v = np.asarray(value_of(ensure_tensor(crows)))
+        cols_v = np.asarray(value_of(ensure_tensor(cols)))
+        rows = np.repeat(np.arange(len(crows_v) - 1),
+                         np.diff(crows_v))
+        indices = np.stack([rows, cols_v])
+        super().__init__(indices, values, shape, coalesced=True)
+        self.crows_ = ensure_tensor(crows)
+        self.cols_ = ensure_tensor(cols)
+
+    def crows(self):
+        return self.crows_
+
+    def cols(self):
+        return self.cols_
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference creation.py:68."""
+    idx = ensure_tensor(indices)
+    vals = ensure_tensor(values, dtype=dtype)
+    if not stop_gradient:
+        vals.stop_gradient = False
+    if shape is None:
+        iv = np.asarray(value_of(idx))
+        shape = list(iv.max(axis=1) + 1)
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = ensure_tensor(values, dtype=dtype)
+    if not stop_gradient:
+        vals.stop_gradient = False
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference coalesce op)."""
+    b = x._bcoo().sum_duplicates()
+    return SparseCooTensor(jnp.swapaxes(b.indices, 0, 1), Tensor(b.data),
+                           x.shape, coalesced=True)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+# ----------------------------------------------------- unary (on values)
+
+def _unary(name, fn):
+    def op(x, name_=None):
+        out_vals = apply_jfn(f"sparse_{name}", fn, x.values_)
+        return SparseCooTensor(x.indices_, out_vals, x.shape,
+                               x._coalesced)
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):
+    out_vals = apply_jfn("sparse_pow", lambda v: jnp.power(v, factor),
+                         x.values_)
+    return SparseCooTensor(x.indices_, out_vals, x.shape, x._coalesced)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vals = x.values_
+    if value_dtype is not None:
+        from ..ops.manipulation import cast as dense_cast
+
+        vals = dense_cast(vals, value_dtype)
+    idx = x.indices_
+    if index_dtype is not None:
+        from ..ops.manipulation import cast as dense_cast
+
+        idx = dense_cast(idx, index_dtype)
+    return SparseCooTensor(idx, vals, x.shape, x._coalesced)
+
+
+# ------------------------------------------------------------ binary
+
+def _ewise(name, fn):
+    def op(x, y, name_=None):
+        if is_sparse(x) and is_sparse(y):
+            xi = np.asarray(value_of(x.indices_))
+            yi = np.asarray(value_of(y.indices_))
+            if xi.shape == yi.shape and (xi == yi).all():
+                # same pattern: elementwise on values, tape-differentiable
+                out = apply_jfn(f"sparse_{name}", fn, x.values_, y.values_)
+                return SparseCooTensor(x.indices_, out, x.shape)
+            # mismatched patterns: merge via dense (sparse-sparse union
+            # has data-dependent nnz — not a jit-able shape on TPU)
+            dense = apply_jfn(f"sparse_{name}", fn, x.to_dense(),
+                              y.to_dense())
+            from ..tensor_core import Tensor as T
+
+            return _dense_to_coo(dense)
+        raise TypeError(f"sparse.{name} expects two sparse tensors")
+
+    op.__name__ = name
+    return op
+
+
+def _dense_to_coo(dense):
+    v = np.asarray(value_of(dense))
+    idx = np.stack(np.nonzero(v))
+    vals_np = v[tuple(idx)]
+    return SparseCooTensor(idx, Tensor(jnp.asarray(vals_np)), list(v.shape))
+
+
+add = _ewise("add", jnp.add)
+subtract = _ewise("subtract", jnp.subtract)
+multiply = _ewise("multiply", jnp.multiply)
+divide = _ewise("divide", jnp.divide)
+
+
+# ------------------------------------------------------------ matmul
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (reference sparse matmul; lowers to
+    bcoo_dot_general = gather + MXU dot)."""
+    if not is_sparse(x):
+        raise TypeError("sparse.matmul expects a sparse lhs")
+    y = ensure_tensor(y)
+    idx_t = x.indices_
+    shape = tuple(x.shape)
+
+    def jfn(v, d):
+        idx = jnp.swapaxes(value_of(idx_t), 0, 1)
+        return jsparse.BCOO((v, idx), shape=shape) @ d
+
+    return apply_jfn("sparse_matmul", jfn, x.values_, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    from ..ops.math import add as dense_add
+
+    return dense_add(ensure_tensor(input) * beta, matmul(x, y) * alpha)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity (reference masked_matmul /
+    SDDMM). x, y dense; mask sparse: computes only the nnz entries."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    idx_t = mask.indices_
+
+    def jfn(xv, yv):
+        idx = value_of(idx_t)
+        rows, cols = idx[0], idx[1]
+        return (xv[rows] * jnp.swapaxes(yv, 0, 1)[cols]).sum(-1)
+
+    vals = apply_jfn("sparse_masked_matmul", jfn, x, y)
+    return SparseCooTensor(mask.indices_, vals, mask.shape)

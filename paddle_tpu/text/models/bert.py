@@ -19,6 +19,7 @@ from ...distributed.fleet.meta_parallel.mp_layers import (
     RowParallelLinear,
     VocabParallelEmbedding,
     shard_activation,
+    split_fused_qkv,
 )
 from ...nn import functional as F
 from ...ops import manipulation as manip
@@ -110,13 +111,7 @@ class BertEncoderLayer(nn.Layer):
     def forward(self, x, attn_mask=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv(x)
-        qkv = manip.reshape(qkv, [b, s, 3, self.nh, self.hd])
-        q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), [2])
-        k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), [2])
-        v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), [2])
-        q = shard_activation(q, "dp", "sp", "mp", None)
-        k = shard_activation(k, "dp", "sp", "mp", None)
-        v = shard_activation(v, "dp", "sp", "mp", None)
+        q, k, v = split_fused_qkv(qkv, b, s, self.nh, self.hd)
         attn = F.scaled_dot_product_attention(q, k, v,
                                               attn_mask=attn_mask)
         attn = manip.reshape(attn, [b, s, self.nh * self.hd])

@@ -25,6 +25,7 @@ from ...distributed.fleet.meta_parallel.mp_layers import (
     RowParallelLinear,
     VocabParallelEmbedding,
     shard_activation,
+    split_fused_qkv,
 )
 from ...nn import functional as F
 from ...ops import manipulation as manip
@@ -95,14 +96,7 @@ class GPTDecoderLayer(nn.Layer):
         s = x.shape[1]
         h = self.ln1(x)
         qkv = self.qkv(h)  # [b, s, 3d] (mp-sharded last dim)
-        qkv = manip.reshape(qkv, [b, s, 3, self.nh, self.hd])
-        q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), [2])
-        k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), [2])
-        v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), [2])
-        # heads ride the mp axis; sequence may ride sp (long-context)
-        q = shard_activation(q, "dp", "sp", "mp", None)
-        k = shard_activation(k, "dp", "sp", "mp", None)
-        v = shard_activation(v, "dp", "sp", "mp", None)
+        q, k, v = split_fused_qkv(qkv, b, s, self.nh, self.hd)
         attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         attn = manip.reshape(attn, [b, s, self.nh * self.hd])
         x = x + self.dropout(self.proj(attn))

@@ -78,7 +78,18 @@ def test_engine_fit_evaluate_predict_hybrid():
     assert hist[-1] < hist[0]
     ev = engine.evaluate(_DS(16), batch_size=8)
     assert np.isfinite(ev["loss"])
-    preds = engine.predict(_DS(16), batch_size=8)
+
+    class _XOnly(paddle.io.Dataset):  # predict data: inputs only
+        def __init__(self, ds):
+            self.ds = ds
+
+        def __len__(self):
+            return len(self.ds)
+
+        def __getitem__(self, i):
+            return self.ds[i][0]
+
+    preds = engine.predict(_XOnly(_DS(16)), batch_size=8)
     assert preds[0].shape == [8, 4]
 
 
@@ -111,15 +122,19 @@ def test_engine_predict_multi_input():
         def forward(self, a, b):
             return self.fc(a + b)
 
-    class DS2(paddle.io.Dataset):
+    class DS2(paddle.io.Dataset):  # predict data: model inputs only
         def __len__(self):
             return 8
 
         def __getitem__(self, i):
-            return (np.ones(8, np.float32), np.ones(8, np.float32) * 2,
-                    np.int64(i % 2))
+            return (np.ones(8, np.float32), np.ones(8, np.float32) * 2)
 
     engine = auto.Engine(model=TwoIn(),
                          loss=nn.functional.cross_entropy)
     preds = engine.predict(DS2(), batch_size=4)
-    assert preds[0].shape == [4, 2]  # both inputs used, label dropped
+    assert preds[0].shape == [4, 2]  # every element fed to the model
+    np.testing.assert_allclose(
+        preds[0].numpy(),
+        engine.model(paddle.to_tensor(np.ones((4, 8), np.float32)),
+                     paddle.to_tensor(2 * np.ones((4, 8), np.float32))
+                     ).numpy(), rtol=1e-6)

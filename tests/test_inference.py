@@ -1,0 +1,97 @@
+"""Inference predictor tests (reference: paddle.inference Config /
+create_predictor / handle IO — analysis_predictor.cc)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+
+
+def _save_model(tmp_path, n_inputs=1):
+    paddle.seed(0)
+    if n_inputs == 1:
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        spec = [paddle.static.InputSpec([2, 8], "float32")]
+    else:
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, a, b):
+                return self.fc(a + b)
+
+        model = TwoIn()
+        spec = [paddle.static.InputSpec([2, 8], "float32"),
+                paddle.static.InputSpec([2, 8], "float32")]
+    path = str(tmp_path / "model")
+    paddle.jit.save(model, path, input_spec=spec)
+    return model, path
+
+
+def test_predictor_handle_io_matches_eager(tmp_path):
+    model, path = _save_model(tmp_path)
+    model.eval()
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["x0"]
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_multi_input_direct_run(tmp_path):
+    model, path = _save_model(tmp_path, n_inputs=2)
+    model.eval()
+    pred = inference.create_predictor(inference.Config(path))
+    assert pred.get_input_names() == ["x0", "x1"]
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((2, 8)).astype(np.float32)
+    b = rng.standard_normal((2, 8)).astype(np.float32)
+    (out,) = pred.run([a, b])
+    ref = model(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_precision_mismatch_warns_and_pool(tmp_path):
+    import pytest
+
+    model, path = _save_model(tmp_path)
+    model.eval()
+    cfg = inference.Config(path)
+    cfg.enable_mixed_precision(inference.PrecisionType.Bfloat16)
+    with pytest.warns(RuntimeWarning, match="exported"):
+        pred = inference.create_predictor(cfg)
+    x = np.ones((2, 8), np.float32)
+    (out,) = pred.run([x])  # runs as exported (fp32)
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    pool = inference.PredictorPool(inference.Config(path), size=2)
+    (o2,) = pool.retrieve(1).run([x])
+    np.testing.assert_allclose(o2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_convert_to_mixed_precision_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    model, path = _save_model(tmp_path)
+    model.eval()
+    dst = str(tmp_path / "model_bf16")
+    inference.convert_to_mixed_precision(
+        path, dst, inference.PrecisionType.Bfloat16)
+    cfg = inference.Config(dst)
+    cfg.enable_mixed_precision(inference.PrecisionType.Bfloat16)
+    pred = inference.create_predictor(cfg)  # no warning: dtypes agree
+    # stored params ARE bf16 now
+    assert all(v.dtype == jnp.bfloat16
+               for v in pred._layer._param_vals
+               if jnp.issubdtype(v.dtype, jnp.floating)
+               or v.dtype == jnp.bfloat16)
+    x = np.random.default_rng(2).standard_normal((2, 8)).astype(np.float32)
+    (out,) = pred.run([x])
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)

@@ -1,0 +1,105 @@
+"""Sparse tensor API tests (reference: python/paddle/incubate/sparse/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = np.array([[0, 1, 2], [1, 0, 2]])
+    values = np.array([1.0, 2.0, 3.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, [3, 3])
+
+
+def test_creation_and_dense_roundtrip():
+    s = _coo()
+    assert s.is_sparse() and s.is_sparse_coo() and s.nnz == 3
+    dense = s.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 0], ref[2, 2] = 1.0, 2.0, 3.0
+    np.testing.assert_array_equal(dense, ref)
+    # shape inference from indices
+    s2 = sparse.sparse_coo_tensor(np.array([[0, 4], [1, 2]]),
+                                  np.array([1.0, 1.0], np.float32))
+    assert s2.shape == [5, 3]
+
+
+def test_csr_creation():
+    crows = np.array([0, 1, 3, 3])
+    cols = np.array([2, 0, 1])
+    vals = np.array([5.0, 6.0, 7.0], np.float32)
+    s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    assert s.is_sparse_csr() and not s.is_sparse_coo()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 2], ref[1, 0], ref[1, 1] = 5.0, 6.0, 7.0
+    np.testing.assert_array_equal(s.to_dense().numpy(), ref)
+    np.testing.assert_array_equal(s.crows().numpy(), crows)
+
+
+def test_unary_ops_act_on_values():
+    s = _coo()
+    out = sparse.sqrt(sparse.square(s))
+    np.testing.assert_allclose(out.values().numpy(), [1.0, 2.0, 3.0],
+                               rtol=1e-6)
+    out2 = sparse.neg(s)
+    np.testing.assert_allclose(out2.to_dense().numpy(),
+                               -s.to_dense().numpy())
+    out3 = sparse.pow(s, 2.0)
+    np.testing.assert_allclose(out3.values().numpy(), [1.0, 4.0, 9.0])
+
+
+def test_binary_same_and_mixed_pattern():
+    a = _coo()
+    b = sparse.sparse_coo_tensor(np.array([[0, 1, 2], [1, 0, 2]]),
+                                 np.array([10.0, 20.0, 30.0], np.float32),
+                                 [3, 3])
+    c = sparse.add(a, b)
+    np.testing.assert_allclose(c.values().numpy(), [11.0, 22.0, 33.0])
+    # different pattern → dense merge path
+    d = sparse.sparse_coo_tensor(np.array([[0], [0]]),
+                                 np.array([5.0], np.float32), [3, 3])
+    e = sparse.add(a, d)
+    ref = a.to_dense().numpy() + d.to_dense().numpy()
+    np.testing.assert_allclose(e.to_dense().numpy(), ref)
+
+
+def test_matmul_and_grads():
+    s = sparse.sparse_coo_tensor(
+        np.array([[0, 1, 2], [1, 0, 2]]),
+        np.array([1.0, 2.0, 3.0], np.float32), [3, 3],
+        stop_gradient=False)
+    d = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3),
+                         stop_gradient=False)
+    out = sparse.matmul(s, d)
+    ref = s.to_dense().numpy() @ d.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    out.sum().backward()
+    assert s.values().grad is not None
+    assert d.grad is not None
+    # d(sum)/d(values_k) = sum of dense row indexed by the value's column
+    np.testing.assert_allclose(s.values().grad.numpy(),
+                               [d.numpy()[1].sum(), d.numpy()[0].sum(),
+                                d.numpy()[2].sum()], rtol=1e-6)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+    mask = sparse.sparse_coo_tensor(np.array([[0, 2], [3, 1]]),
+                                    np.array([1.0, 1.0], np.float32),
+                                    [4, 4])
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    full = x @ y
+    np.testing.assert_allclose(out.values().numpy(),
+                               [full[0, 3], full[2, 1]], rtol=1e-5)
+
+
+def test_coalesce_merges_duplicates():
+    s = sparse.sparse_coo_tensor(np.array([[0, 0], [1, 1]]),
+                                 np.array([2.0, 3.0], np.float32), [2, 2])
+    c = sparse.coalesce(s)
+    dense = c.to_dense().numpy()
+    assert dense[0, 1] == 5.0
