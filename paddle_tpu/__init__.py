@@ -80,6 +80,7 @@ for _sub in (
     "native",
     "sparse",
     "quantization",
+    "geometric",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

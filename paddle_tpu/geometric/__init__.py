@@ -1,0 +1,139 @@
+"""paddle_tpu.geometric — graph learning primitives.
+
+TPU-native re-design of the reference geometric package (reference:
+python/paddle/geometric/ — message_passing/send_recv.py send_u_recv:27,
+send_ue_recv:165, send_uv:335; math.py segment_sum/mean/max/min;
+reindex.py graph_reindex).
+
+Message passing lowers to gather + `jax.ops.segment_sum`-family scatter
+— both XLA primitives that fuse well; `num_segments` (paddle's
+out_size) keeps shapes static for jit, which is why every op threads
+it through.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "graph_reindex",
+]
+
+
+def _nseg(index, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(value_of(ensure_tensor(index))).max()) + 1
+
+
+def _segment(name, jfn_seg):
+    def op(data, segment_ids, out_size=None, name_=None):
+        n = _nseg(segment_ids, out_size)
+        ids_t = ensure_tensor(segment_ids)
+
+        def jfn(v):
+            return jfn_seg(v, value_of(ids_t), n)
+
+        return apply_jfn(f"segment_{name}", jfn, data)
+
+    op.__name__ = f"segment_{name}"
+    return op
+
+
+segment_sum = _segment("sum", lambda v, i, n: jax.ops.segment_sum(
+    v, i, num_segments=n))
+segment_max = _segment("max", lambda v, i, n: jax.ops.segment_max(
+    v, i, num_segments=n))
+segment_min = _segment("min", lambda v, i, n: jax.ops.segment_min(
+    v, i, num_segments=n))
+
+
+def _seg_mean(v, i, n):
+    s = jax.ops.segment_sum(v, i, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), i,
+                              num_segments=n)
+    return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (v.ndim - 1))
+
+
+segment_mean = _segment("mean", _seg_mean)
+
+_REDUCERS = {
+    "sum": lambda v, i, n: jax.ops.segment_sum(v, i, num_segments=n),
+    "add": lambda v, i, n: jax.ops.segment_sum(v, i, num_segments=n),
+    "mean": _seg_mean,
+    "max": lambda v, i, n: jax.ops.segment_max(v, i, num_segments=n),
+    "min": lambda v, i, n: jax.ops.segment_min(v, i, num_segments=n),
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] → scatter-reduce onto dst
+    (reference send_recv.py:27)."""
+    n = _nseg(dst_index, out_size if out_size is not None
+              else value_of(ensure_tensor(x)).shape[0])
+    src_t, dst_t = ensure_tensor(src_index), ensure_tensor(dst_index)
+    red = _REDUCERS[reduce_op]
+
+    def jfn(v):
+        msgs = jnp.take(v, value_of(src_t), axis=0)
+        return red(msgs, value_of(dst_t), n)
+
+    return apply_jfn("send_u_recv", jfn, x)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features with EDGE features, then reduce
+    (reference send_recv.py:165). message_op: add/sub/mul/div."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+    n = _nseg(dst_index, out_size if out_size is not None
+              else value_of(ensure_tensor(x)).shape[0])
+    src_t, dst_t = ensure_tensor(src_index), ensure_tensor(dst_index)
+    red = _REDUCERS[reduce_op]
+
+    def jfn(v, e):
+        msgs = comb(jnp.take(v, value_of(src_t), axis=0), e)
+        return red(msgs, value_of(dst_t), n)
+
+    return apply_jfn("send_ue_recv", jfn, x, ensure_tensor(y))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (reference
+    send_recv.py:335)."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+    src_t, dst_t = ensure_tensor(src_index), ensure_tensor(dst_index)
+
+    def jfn(xv, yv):
+        return comb(jnp.take(xv, value_of(src_t), axis=0),
+                    jnp.take(yv, value_of(dst_t), axis=0))
+
+    return apply_jfn("send_uv", jfn, x, ensure_tensor(y))
+
+
+def graph_reindex(x, neighbors, count, name=None):
+    """Compact global ids to local ids (reference reindex.py). Host-side
+    (hash-map semantics, data-dependent sizes — not a jit shape)."""
+    from ..tensor_core import Tensor
+
+    xv = np.asarray(value_of(ensure_tensor(x)))
+    nb = np.asarray(value_of(ensure_tensor(neighbors)))
+    uniq = {}
+    for i in xv.tolist():
+        uniq.setdefault(int(i), len(uniq))
+    out_nodes = list(uniq)
+    reindexed = []
+    for i in nb.tolist():
+        if int(i) not in uniq:
+            uniq[int(i)] = len(uniq)
+            out_nodes.append(int(i))
+        reindexed.append(uniq[int(i)])
+    return (Tensor(jnp.asarray(reindexed)),
+            Tensor(jnp.asarray(out_nodes)),
+            ensure_tensor(count))

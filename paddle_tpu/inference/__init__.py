@@ -140,11 +140,12 @@ class InferTensor:
 class Predictor:
     """reference analysis_predictor.cc Predictor: named handles + run()."""
 
-    def __init__(self, config):
+    def __init__(self, config, _shared_layer=None):
         self.config = config
         if config.model_path is None:
             raise ValueError("Config needs the saved-model path prefix")
-        self._layer = _jit_load(config.model_path)
+        self._layer = (_shared_layer if _shared_layer is not None
+                       else _jit_load(config.model_path))
         n_in = getattr(self._layer, "_n_inputs", None) or 1
         self._in_names = [f"x{i}" for i in range(n_in)]
         self._inputs = {n: InferTensor(n) for n in self._in_names}
@@ -220,11 +221,15 @@ def create_predictor(config):
 
 
 class PredictorPool:
-    """N predictors over one artifact (reference PredictorPool) — on TPU
-    they share the compiled executable via jax's cache."""
+    """N predictors over ONE loaded artifact (reference PredictorPool):
+    the deserialized program and the device-placed weights are shared —
+    pool members differ only in their IO handles."""
 
     def __init__(self, config, size=1):
-        self._preds = [Predictor(config) for _ in range(size)]
+        first = Predictor(config)
+        self._preds = [first] + [
+            Predictor(config, _shared_layer=first._layer)
+            for _ in range(size - 1)]
 
     def retrieve(self, idx):
         return self._preds[idx]
@@ -241,6 +246,10 @@ def convert_to_mixed_precision(src_prefix, dst_prefix,
     from ..framework.io_state import save as t_save
 
     layer = _jit_load(src_prefix)
+    if mixed_precision == PrecisionType.Int8:
+        raise NotImplementedError(
+            "int8 needs calibration scales, not a dtype cast — use "
+            "paddle_tpu.quantization.PostTrainingQuantization")
     cast = (jnp.bfloat16 if mixed_precision == PrecisionType.Bfloat16
             else np.dtype(str(mixed_precision)))
     old_vals = layer._param_vals

@@ -106,11 +106,15 @@ class QuantizedLinear(nn.Layer):
 
     def freeze(self):
         """Bake int8 weights; forward becomes int8×int8→int32·scale."""
+        if self.observer.scale is None:
+            raise RuntimeError(
+                "QuantizedLinear.freeze(): the activation observer was "
+                "never updated — run calibration (train-mode forwards or "
+                "PostTrainingQuantization.calibrate) before freezing")
         q, w_scale = quantize_weight_int8(self.inner.weight, axis=1)
         self._wq = jnp.asarray(q)
         self._w_scale = jnp.asarray(w_scale / 127.0)  # [1, out]
-        a = self.observer.scale or 1.0
-        self._a_scale = jnp.float32(a / 127.0)
+        self._a_scale = jnp.float32(self.observer.scale / 127.0)
         self._frozen = True
         return self
 

@@ -217,7 +217,7 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
 
 # ------------------------------------------------------------ binary
 
-def _ewise(name, fn):
+def _ewise(name, fn, same_pattern_only=False):
     def op(x, y, name_=None):
         if is_sparse(x) and is_sparse(y):
             xi = np.asarray(value_of(x.indices_))
@@ -226,12 +226,15 @@ def _ewise(name, fn):
                 # same pattern: elementwise on values, tape-differentiable
                 out = apply_jfn(f"sparse_{name}", fn, x.values_, y.values_)
                 return SparseCooTensor(x.indices_, out, x.shape)
+            if same_pattern_only:
+                # e.g. divide: implicit zeros would produce inf/nan
+                raise ValueError(
+                    f"sparse.{name} requires matching sparsity patterns "
+                    "(an implicit zero makes the result undefined)")
             # mismatched patterns: merge via dense (sparse-sparse union
             # has data-dependent nnz — not a jit-able shape on TPU)
             dense = apply_jfn(f"sparse_{name}", fn, x.to_dense(),
                               y.to_dense())
-            from ..tensor_core import Tensor as T
-
             return _dense_to_coo(dense)
         raise TypeError(f"sparse.{name} expects two sparse tensors")
 
@@ -240,16 +243,21 @@ def _ewise(name, fn):
 
 
 def _dense_to_coo(dense):
+    """Dense Tensor → COO. The index pattern comes from the host values
+    (stop-grad), but the VALUES are a tape gather from the dense input,
+    so gradients keep flowing."""
     v = np.asarray(value_of(dense))
-    idx = np.stack(np.nonzero(v))
-    vals_np = v[tuple(idx)]
-    return SparseCooTensor(idx, Tensor(jnp.asarray(vals_np)), list(v.shape))
+    idx = np.stack(np.nonzero(v)) if v.any() else \
+        np.zeros((v.ndim, 0), np.int64)
+    idx_tuple = tuple(jnp.asarray(row) for row in idx)
+    vals = apply_jfn("sparse_gather_coo", lambda d: d[idx_tuple], dense)
+    return SparseCooTensor(idx, vals, list(v.shape))
 
 
 add = _ewise("add", jnp.add)
 subtract = _ewise("subtract", jnp.subtract)
 multiply = _ewise("multiply", jnp.multiply)
-divide = _ewise("divide", jnp.divide)
+divide = _ewise("divide", jnp.divide, same_pattern_only=True)
 
 
 # ------------------------------------------------------------ matmul

@@ -1,9 +1,13 @@
-"""paddle_tpu.text — NLP models and (later) datasets.
+"""paddle_tpu.text — NLP models, datasets, and decoding.
 
 (Reference: python/paddle/text/ exposes datasets + viterbi_decode; the
-model zoo itself lives in PaddleNLP. Here the flagship language models are
-in-tree because they are the benchmark/parallelism drivers.)
+model zoo itself lives in PaddleNLP. Here the flagship language models
+are in-tree because they are the benchmark/parallelism drivers.)
 """
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 
-__all__ = ["models"]
+__all__ = ["models", "datasets", "Imdb", "Imikolov", "UCIHousing",
+           "ViterbiDecoder", "viterbi_decode"]

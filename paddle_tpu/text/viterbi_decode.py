@@ -1,0 +1,86 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py
+ViterbiDecoder:25 / viterbi_decode:105, phi kernels viterbi_decode_kernel).
+
+TPU-first: the forward max-product recursion is a `lax.scan` over time
+([B, C] carry, MXU-friendly [C, C] transition broadcast); backtraces are
+stacked argmax indices walked backwards with a second scan — no python
+loops, jit-safe static shapes."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials [B, L, C], transition [C, C] (rows −2/−1 are BOS/EOS
+    when include_bos_eos_tag), lengths [B] → (scores [B], paths [B, L])."""
+    pots = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens_v = value_of(ensure_tensor(lengths))
+
+    def jfn(pv, tv):
+        B, L, C = pv.shape
+        if include_bos_eos_tag:
+            # reference kernel splits transition rows [..., stop, start]
+            # (viterbi_decode_kernel.cc:222-236): row −1 = START scores,
+            # row −2 = STOP scores, both indexed by the tag
+            start = tv[-1]
+            stop = tv[-2]
+        else:
+            start = jnp.zeros((C,), pv.dtype)
+            stop = jnp.zeros((C,), pv.dtype)
+        alpha0 = pv[:, 0] + start[None, :]
+
+        def step(carry, t):
+            alpha = carry  # [B, C]
+            # scores[b, i, j] = alpha[b, i] + T[i, j] + emit[b, t, j]
+            scores = alpha[:, :, None] + tv[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, C]
+            best_score = jnp.max(scores, axis=1) + pv[:, t]  # [B, C]
+            # frozen past sequence end
+            active = (t < lens_v)[:, None]
+            alpha_new = jnp.where(active, best_score, alpha)
+            return alpha_new, best_prev
+
+        alpha, backptrs = lax.scan(step, alpha0, jnp.arange(1, L))
+        alpha_final = alpha + stop[None, :]
+        scores = jnp.max(alpha_final, axis=1)
+        last_tag = jnp.argmax(alpha_final, axis=1)  # [B]
+
+        # walk backpointers; carry = tag at position t+1, emit it, and
+        # step to the tag at position t (frozen past each seq's end)
+        def back(carry, t):
+            tag = carry  # [B] tag at position t+1
+            bp = backptrs[t]  # [B, C]: chosen prev-tag for step t→t+1
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            tag_t = jnp.where(t + 1 < lens_v, prev, tag)
+            return tag_t, tag
+
+        tag0, tags_rev = lax.scan(back, last_tag,
+                                  jnp.arange(L - 2, -1, -1))
+        # tags_rev[k] is the tag at position L-1-k (k = 0..L-2)
+        path = jnp.concatenate([tag0[None], jnp.flip(tags_rev, 0)],
+                               axis=0)  # [L, B]
+        return scores, jnp.swapaxes(path, 0, 1)
+
+    scores, path = apply_jfn("viterbi_decode", jfn, pots, trans)
+    return scores, path
+
+
+class ViterbiDecoder:
+    """Layer wrapper (reference viterbi_decode.py:25)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

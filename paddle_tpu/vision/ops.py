@@ -1,0 +1,208 @@
+"""paddle_tpu.vision.ops — detection primitives.
+
+TPU-native re-design of the reference vision op set (reference:
+python/paddle/vision/ops.py — nms:1663, roi_align:1302, roi_pool:1175,
+box_coder; CUDA kernels paddle/phi/kernels/gpu/nms_kernel.cu,
+roi_align_kernel.cu).
+
+TPU-first shapes: NMS runs as a fixed-iteration `lax.scan` over a
+static `top_k` budget (data-dependent output counts don't jit;
+suppressed slots are marked −1, matching padded-detection pipelines);
+roi_align is bilinear gather + mean — pure vectorized XLA.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou",
+           "RoIAlign", "RoIPool"]
+
+
+def box_area(boxes):
+    def jfn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    return apply_jfn("box_area", jfn, boxes)
+
+
+def _iou_matrix(b):
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    def jfn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+    return apply_jfn("box_iou", jfn, boxes1, ensure_tensor(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS (reference ops.py:1663). Returns kept indices by
+    descending score. Static-shape inner loop; host-side trim of the
+    −1 padding at the boundary (eager op, like the reference's)."""
+    b = ensure_tensor(boxes)
+    n = int(value_of(b).shape[0])
+    if n == 0:
+        return Tensor(jnp.zeros((0,), jnp.int64))
+    if scores is None:
+        scores_v = jnp.arange(n, 0, -1, dtype=jnp.float32)
+    else:
+        scores_v = value_of(ensure_tensor(scores))
+    k = n if top_k is None else min(int(top_k), n)
+
+    def jfn(bv):
+        iou = _iou_matrix(bv)
+        if category_idxs is not None:
+            # class-aware: boxes of different categories never suppress
+            cats = value_of(ensure_tensor(category_idxs))
+            iou = jnp.where(cats[:, None] == cats[None, :], iou, 0.0)
+        order = jnp.argsort(-scores_v)
+
+        def body(alive, i):
+            idx = order[i]
+            keep_this = alive[idx]
+            # suppress everything this (kept) box overlaps
+            sup = (iou[idx] > iou_threshold) & alive
+            alive2 = jnp.where(keep_this, alive & ~sup | (
+                jnp.arange(n) == idx), alive)
+            return alive2, jnp.where(keep_this, idx, -1)
+
+        _, kept = lax.scan(body, jnp.ones((n,), bool), jnp.arange(n))
+        return kept
+
+    kept = np.asarray(value_of(apply_jfn("nms", jfn, b)))
+    kept = kept[kept >= 0][:k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Bilinear ROI align (reference ops.py:1302). x: [N, C, H, W];
+    boxes: [R, 4] (x1, y1, x2, y2); boxes_num: rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+    bn = np.asarray(value_of(ensure_tensor(boxes_num)))
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def jfn(xv, bv):
+        off = 0.5 if aligned else 0.0
+        imgs = jnp.asarray(img_of_roi)
+
+        def one_roi(img_idx, box):
+            x1, y1, x2, y2 = (box * spatial_scale) - off
+            rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+            rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+            bin_h, bin_w = rh / ph, rw / pw
+            # sr×sr sample grid per bin
+            iy = (jnp.arange(ph)[:, None] * bin_h + y1
+                  + (jnp.arange(sr) + 0.5)[None, :] * bin_h / sr)
+            ix = (jnp.arange(pw)[:, None] * bin_w + x1
+                  + (jnp.arange(sr) + 0.5)[None, :] * bin_w / sr)
+            ys = iy.reshape(-1)  # [ph*sr]
+            xs = ix.reshape(-1)  # [pw*sr]
+            feat = xv[img_idx]  # [C, H, W]
+            H, W = feat.shape[1], feat.shape[2]
+
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(ys, 0, H - 1) - y0
+            wx = jnp.clip(xs, 0, W - 1) - x0
+
+            def g(yy, xx):
+                return feat[:, yy.astype(jnp.int32)][
+                    :, :, xx.astype(jnp.int32)]  # [C, len(ys), len(xs)]
+
+            val = (g(y0, x0) * (1 - wy)[None, :, None]
+                   * (1 - wx)[None, None, :]
+                   + g(y1i, x0) * wy[None, :, None]
+                   * (1 - wx)[None, None, :]
+                   + g(y0, x1i) * (1 - wy)[None, :, None]
+                   * wx[None, None, :]
+                   + g(y1i, x1i) * wy[None, :, None] * wx[None, None, :])
+            val = val.reshape(feat.shape[0], ph, sr, pw, sr)
+            return val.mean(axis=(2, 4))  # [C, ph, pw]
+
+        return jax.vmap(one_roi)(imgs, bv)
+
+    return apply_jfn("roi_align", jfn, x, ensure_tensor(boxes))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool ROI pooling (reference ops.py:1175) — roi_align grid
+    with max instead of mean, nearest sampling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(value_of(ensure_tensor(boxes_num)))
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def jfn(xv, bv):
+        imgs = jnp.asarray(img_of_roi)
+
+        def one_roi(img_idx, box):
+            x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+            feat = xv[img_idx]
+            H, W = feat.shape[1], feat.shape[2]
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            # 4 nearest samples per bin, max-reduced
+            sr = 4
+            iy = jnp.clip(y1 + (jnp.arange(ph)[:, None] + (
+                jnp.arange(sr) + 0.5)[None, :] / sr) * rh / ph, 0, H - 1)
+            ix = jnp.clip(x1 + (jnp.arange(pw)[:, None] + (
+                jnp.arange(sr) + 0.5)[None, :] / sr) * rw / pw, 0, W - 1)
+            ys = iy.reshape(-1).astype(jnp.int32)
+            xs = ix.reshape(-1).astype(jnp.int32)
+            val = feat[:, ys][:, :, xs]
+            val = val.reshape(feat.shape[0], ph, sr, pw, sr)
+            return val.max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(imgs, bv)
+
+    return apply_jfn("roi_pool", jfn, x, ensure_tensor(boxes))
+
+
+class RoIAlign:
+    """Layer wrapper (reference ops.py:1450)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper (reference ops.py:1285)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

@@ -1,0 +1,129 @@
+"""geometric message-passing + vision detection op tests (reference:
+python/paddle/geometric/, python/paddle/vision/ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+from paddle_tpu.vision import ops as V
+
+rng = np.random.default_rng(0)
+
+
+# ------------------------------------------------------------- geometric
+
+def test_segment_reductions():
+    x = paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]],
+                                  np.float32))
+    ids = np.array([0, 0, 1, 1])
+    np.testing.assert_allclose(
+        G.segment_sum(x, ids).numpy(), [[4, 6], [12, 14]])
+    np.testing.assert_allclose(
+        G.segment_mean(x, ids).numpy(), [[2, 3], [6, 7]])
+    np.testing.assert_allclose(
+        G.segment_max(x, ids).numpy(), [[3, 4], [7, 8]])
+    np.testing.assert_allclose(
+        G.segment_min(x, ids).numpy(), [[1, 2], [5, 6]])
+    # static out_size pads with the monoid identity
+    s = G.segment_sum(x, ids, out_size=3).numpy()
+    assert s.shape == (3, 2) and (s[2] == 0).all()
+
+
+def test_send_u_recv_and_grads():
+    # graph: 0→1, 1→2, 2→1
+    feats = paddle.to_tensor(
+        np.array([[1.0], [10.0], [100.0]], np.float32),
+        stop_gradient=False)
+    src = np.array([0, 1, 2])
+    dst = np.array([1, 2, 1])
+    out = G.send_u_recv(feats, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[0], [101], [10]])
+    out.sum().backward()
+    # node 0 feeds 1 edge, node 1 one, node 2 one
+    np.testing.assert_allclose(feats.grad.numpy(), [[1], [1], [1]])
+    out2 = G.send_u_recv(feats, src, dst, reduce_op="mean")
+    np.testing.assert_allclose(out2.numpy(), [[0], [50.5], [10]])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    e = paddle.to_tensor(np.array([[0.5], [0.5], [0.5]], np.float32))
+    src = np.array([0, 1, 2])
+    dst = np.array([1, 2, 0])
+    out = G.send_ue_recv(x, e, src, dst, message_op="mul",
+                         reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.5], [0.5], [1.0]])
+    uv = G.send_uv(x, x, src, dst, message_op="add")
+    np.testing.assert_allclose(uv.numpy(), [[3.0], [5.0], [4.0]])
+
+
+def test_graph_reindex():
+    x = np.array([10, 20, 30])
+    neighbors = np.array([20, 99, 10, 30])
+    reindexed, nodes, cnt = G.graph_reindex(x, neighbors,
+                                            np.array([2, 1, 1]))
+    np.testing.assert_array_equal(reindexed.numpy(), [1, 3, 0, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 99])
+
+
+# ------------------------------------------------------------ vision ops
+
+def test_box_iou_and_area():
+    a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+    b = paddle.to_tensor(np.array([[1, 1, 3, 3], [4, 4, 5, 5]],
+                                  np.float32))
+    iou = V.box_iou(a, b).numpy()
+    np.testing.assert_allclose(iou, [[1 / 7, 0.0]], rtol=1e-5)
+    np.testing.assert_allclose(V.box_area(b).numpy(), [4.0, 1.0])
+
+
+def test_nms_greedy_and_class_aware():
+    boxes = np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],    # big overlap with 0
+        [20, 20, 30, 30],
+        [21, 21, 29, 29],  # big overlap with 2
+    ], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    kept = V.nms(paddle.to_tensor(boxes), 0.5,
+                 scores=paddle.to_tensor(scores)).numpy()
+    np.testing.assert_array_equal(sorted(kept), [0, 3])
+    # class-aware: overlapping boxes in DIFFERENT classes both survive
+    cats = np.array([0, 1, 0, 1])
+    kept2 = V.nms(paddle.to_tensor(boxes), 0.5,
+                  scores=paddle.to_tensor(scores),
+                  category_idxs=paddle.to_tensor(cats)).numpy()
+    assert set(kept2) == {0, 1, 2, 3}
+    # top_k budget
+    kept3 = V.nms(paddle.to_tensor(boxes), 0.5,
+                  scores=paddle.to_tensor(scores), top_k=1).numpy()
+    np.testing.assert_array_equal(kept3, [3])
+
+
+def test_roi_align_constant_map():
+    # constant feature map → every aligned value equals the constant
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]],
+                                      np.float32))
+    out = V.roi_align(x, boxes, np.array([1]), output_size=4)
+    assert out.shape == [1, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 8, 8)).astype(
+        np.float32), stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = V.roi_align(x, boxes, np.array([1]), output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    fm = np.zeros((1, 1, 8, 8), np.float32)
+    fm[0, 0, 3, 3] = 9.0
+    out = V.roi_pool(paddle.to_tensor(fm),
+                     paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]],
+                                               np.float32)),
+                     np.array([1]), output_size=2)
+    assert float(out.numpy().max()) == 9.0

@@ -57,6 +57,15 @@ def test_qat_trains_and_freezes():
     assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.1
 
 
+def test_freeze_without_calibration_raises():
+    import pytest as _pytest
+
+    model = nn.Sequential(nn.Linear(4, 4))
+    Q.ImperativeQuantAware().quantize(model)
+    with _pytest.raises(RuntimeError, match="calibration"):
+        model[0].freeze()
+
+
 def test_ptq_int8_matches_fp32_model():
     paddle.seed(1)
     model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))

@@ -97,6 +97,32 @@ def test_masked_matmul_sddmm():
                                [full[0, 3], full[2, 1]], rtol=1e-5)
 
 
+def test_mismatched_pattern_add_keeps_gradients():
+    a = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                 np.array([1.0, 2.0], np.float32), [2, 2],
+                                 stop_gradient=False)
+    b = sparse.sparse_coo_tensor(np.array([[0], [0]]),
+                                 np.array([5.0], np.float32), [2, 2])
+    out = sparse.add(a, b)
+    out.values().sum().backward()
+    assert a.values().grad is not None
+    np.testing.assert_allclose(a.values().grad.numpy(), [1.0, 1.0])
+
+
+def test_divide_requires_matching_pattern():
+    a = sparse.sparse_coo_tensor(np.array([[0], [0]]),
+                                 np.array([2.0], np.float32), [2, 2])
+    b = sparse.sparse_coo_tensor(np.array([[1], [1]]),
+                                 np.array([4.0], np.float32), [2, 2])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="matching"):
+        sparse.divide(a, b)
+    same = sparse.divide(a, sparse.sparse_coo_tensor(
+        np.array([[0], [0]]), np.array([4.0], np.float32), [2, 2]))
+    np.testing.assert_allclose(same.values().numpy(), [0.5])
+
+
 def test_coalesce_merges_duplicates():
     s = sparse.sparse_coo_tensor(np.array([[0, 0], [1, 1]]),
                                  np.array([2.0, 3.0], np.float32), [2, 2])

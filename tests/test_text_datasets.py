@@ -1,0 +1,132 @@
+"""text datasets + viterbi decode tests (reference: python/paddle/text/)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (Imdb, Imikolov, UCIHousing, ViterbiDecoder,
+                             viterbi_decode)
+
+
+# ------------------------------------------------------------- viterbi
+
+def _brute_force_viterbi(emis, trans, length, bos_eos=True):
+    C = emis.shape[1]
+    import itertools
+
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(C), repeat=length):
+        # reference convention: trans[-1] = start row, trans[-2] = stop
+        s = emis[0, path[0]] + (trans[-1, path[0]] if bos_eos else 0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+        s += trans[-2, path[-1]] if bos_eos else 0
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.default_rng(0)
+    B, L, C = 3, 5, 4
+    emis = rng.standard_normal((B, L, C)).astype(np.float32)
+    trans = rng.standard_normal((C, C)).astype(np.float32)
+    lengths = np.array([5, 3, 4])
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+    for b in range(B):
+        ref_s, ref_p = _brute_force_viterbi(emis[b], trans,
+                                            int(lengths[b]), bos_eos)
+        np.testing.assert_allclose(float(scores.numpy()[b]), ref_s,
+                                   rtol=1e-5)
+        assert paths.numpy()[b, : lengths[b]].tolist() == ref_p
+
+
+def test_viterbi_decoder_layer():
+    trans = np.zeros((4, 4), np.float32)
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=True)
+    emis = np.zeros((1, 3, 4), np.float32)
+    emis[0, :, 2] = 5.0  # tag 2 dominates everywhere
+    scores, path = dec(paddle.to_tensor(emis),
+                       paddle.to_tensor(np.array([3])))
+    assert path.numpy()[0].tolist() == [2, 2, 2]
+
+
+# ------------------------------------------------------------- datasets
+
+def _make_imdb_tar(path):
+    with tarfile.open(path, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"great movie great fun",
+            "aclImdb/train/neg/0.txt": b"bad movie bad plot",
+            "aclImdb/test/pos/0.txt": b"great fun",
+            "aclImdb/test/neg/0.txt": b"bad plot",
+        }
+        for name, data in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_imdb_local_archive(tmp_path):
+    tarp = str(tmp_path / "aclImdb_v1.tar.gz")
+    _make_imdb_tar(tarp)
+    ds = Imdb(data_file=tarp, mode="train", cutoff=0)
+    assert len(ds) == 2
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    labels = sorted(int(ds[i][1]) for i in range(2))
+    assert labels == [0, 1]  # one pos, one neg
+    # unknown words in test map to <unk>
+    ds_t = Imdb(data_file=tarp, mode="test", cutoff=0)
+    assert len(ds_t) == 2
+    with pytest.raises(ValueError, match="data_file"):
+        Imdb(data_file=None)
+
+
+def _make_ptb_tar(path):
+    train = b"the cat sat\nthe dog sat\nthe cat ran\n" * 20
+    valid = b"the cat sat\n"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in (
+                ("./simple-examples/data/ptb.train.txt", train),
+                ("./simple-examples/data/ptb.valid.txt", valid)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    tarp = str(tmp_path / "simple-examples.tgz")
+    _make_ptb_tar(tarp)
+    ds = Imikolov(data_file=tarp, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 2 and all(isinstance(int(g), int) for g in gram)
+    seq = Imikolov(data_file=tarp, data_type="SEQ", mode="test",
+                   min_word_freq=1)
+    src, tgt = seq[0]
+    np.testing.assert_array_equal(src[1:], tgt[:-1])
+
+
+def test_uci_housing_local(tmp_path):
+    rng = np.random.default_rng(0)
+    raw = np.concatenate(
+        [rng.uniform(0, 100, (50, 13)), rng.uniform(5, 50, (50, 1))],
+        axis=1)
+    f = str(tmp_path / "housing.data")
+    np.savetxt(f, raw)
+    tr = UCIHousing(data_file=f, mode="train")
+    te = UCIHousing(data_file=f, mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # normalized features are bounded
+    allx = np.stack([tr[i][0] for i in range(len(tr))])
+    assert np.abs(allx).max() <= 1.0 + 1e-5
