@@ -1,7 +1,8 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/ — optimizer/
 lookahead.py LookAhead:28, modelaverage.py ModelAverage:31; nn fused
 layers; distributed/models/moe lives in paddle_tpu.distributed.moe)."""
+from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 
-__all__ = ["optimizer", "nn"]
+__all__ = ["optimizer", "nn", "asp"]

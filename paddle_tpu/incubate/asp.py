@@ -1,0 +1,106 @@
+"""ASP — automatic n:m structured sparsity (reference:
+python/paddle/incubate/asp/ — prune_model supported_layers, decorate;
+utils.py get_mask_1d/compute_valid_2d_patterns).
+
+TPU note: XLA has no sparse-MXU path, so n:m sparsity here is a
+MODEL-compression feature (the masks persist through fine-tuning via the
+decorated optimizer), with dense compute — the same training-side
+semantics as the reference's ASPHelper.
+
+Masks are held in a weak-keyed registry (parameter → mask): pruned
+models are garbage-collectable, and a decorated optimizer re-masks ONLY
+its own parameters.
+"""
+import weakref
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["prune_model", "decorate", "calculate_density", "get_mask_1d",
+           "reset_asp_state"]
+
+# id(param) -> (weakref, mask): id-keyed because Tensor.__eq__ is
+# elementwise (WeakKeyDictionary would compare referents with it); the
+# weakref callback evicts entries when a pruned model is collected
+_masks = {}
+
+
+def _register_mask(p, mask):
+    key = id(p)
+    _masks[key] = (weakref.ref(p, lambda _r, k=key: _masks.pop(k, None)),
+                   mask)
+
+
+def _mask_of(p):
+    ent = _masks.get(id(p))
+    if ent is None or ent[0]() is not p:
+        return None
+    return ent[1]
+
+
+def reset_asp_state():
+    _masks.clear()
+
+
+def calculate_density(x):
+    v = np.asarray(x._value if hasattr(x, "_value") else x)
+    return float((v != 0).sum()) / v.size
+
+
+def get_mask_1d(weight, n=2, m=4):
+    """Keep the n largest-|w| entries in every group of m along the last
+    axis (reference utils.get_mask_1d)."""
+    w = np.asarray(weight)
+    if w.shape[-1] % m != 0:
+        raise ValueError(
+            f"last axis ({w.shape[-1]}) must be divisible by m={m}")
+    # last axis divisible by m ⇒ flat groups never span rows
+    flat = w.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(w.shape)
+
+
+def _eligible(layer, name, p, m):
+    return (isinstance(layer, nn.Linear) and name.endswith("weight")
+            and p._value.ndim == 2 and p._value.shape[-1] % m == 0)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to supported weights and remember them so
+    `decorate`d optimizers keep the pattern (reference asp.prune_model)."""
+    pruned = []
+    for layer in model.sublayers(include_self=True):
+        for name, p in layer.named_parameters(include_sublayers=False):
+            if not _eligible(layer, name, p, m):
+                continue
+            mask = jnp.asarray(get_mask_1d(np.asarray(p._value), n, m),
+                               p._value.dtype)
+            p._value = p._value * mask
+            if with_mask:
+                _register_mask(p, mask)
+            pruned.append(p.name)
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-mask pruned weights after each update
+    (reference ASPHelper.decorate → OptimizerWithSparsityGuarantee).
+    Only the optimizer's OWN parameters are re-masked."""
+    inner_step = optimizer.step
+    own = list(optimizer._parameter_list)
+
+    def step_with_masks(*a, **k):
+        out = inner_step(*a, **k)
+        for p in own:
+            mask = _mask_of(p)
+            if mask is not None:
+                p._value = p._value * mask
+        return out
+
+    optimizer.step = step_with_masks
+    return optimizer

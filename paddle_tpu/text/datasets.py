@@ -16,7 +16,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT16", "Movielens"]
 
 
 def _require(data_file, name, url_hint):
@@ -183,3 +183,165 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, i):
         return self.data[i, :13], self.data[i, 13:]
+
+
+class WMT16(Dataset):
+    """EN↔DE translation (reference wmt16.py): tar with tab-separated
+    parallel lines at wmt16/{train,val,test}. Vocab = <s>, <e>, <unk>
+    then words by descending train-split frequency, truncated to
+    dict_size (reference _build_dict order)."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        assert mode in ("train", "val", "test")
+        assert src_dict_size > 3 and trg_dict_size > 3, \
+            "dict sizes must exceed the 3 special tokens (<s>/<e>/<unk>)"
+        self.data_file = _require(data_file, "WMT16", "wmt16.tar.gz")
+        self.mode = mode
+        self.lang = lang
+        # ONE decompression pass over train: counts for BOTH languages
+        train_pairs = list(self._pairs("train"))
+        en_dict = self._build_dict(train_pairs, 0, src_dict_size
+                                   if lang == "en" else trg_dict_size)
+        de_dict = self._build_dict(train_pairs, 1, trg_dict_size
+                                   if lang == "en" else src_dict_size)
+        self.src_dict = en_dict if lang == "en" else de_dict
+        self.trg_dict = de_dict if lang == "en" else en_dict
+        self._load_data(train_pairs if mode == "train"
+                        else list(self._pairs(mode)))
+        del train_pairs
+
+    def _pairs(self, split):
+        with tarfile.open(self.data_file) as tf:
+            for ln in _io.TextIOWrapper(
+                    tf.extractfile(f"wmt16/{split}"), encoding="utf-8"):
+                parts = ln.strip().split("\t")
+                if len(parts) == 2:
+                    yield parts
+
+    def _build_dict(self, train_pairs, col, dict_size):
+        freq = {}
+        for parts in train_pairs:
+            for w in parts[col].split():
+                freq[w] = freq.get(w, 0) + 1
+        # specials are unconditional; only the WORD list is truncated
+        words = [w for w, _ in sorted(freq.items(), key=lambda t: -t[1])]
+        vocab = [self.START, self.END, self.UNK] + words[:dict_size - 3]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load_data(self, pairs):
+        s, e = self.src_dict[self.START], self.src_dict[self.END]
+        unk_s = self.src_dict[self.UNK]
+        unk_t = self.trg_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for parts in pairs:
+            src = [s] + [self.src_dict.get(w, unk_s)
+                         for w in parts[src_col].split()] + [e]
+            trg_raw = [self.trg_dict.get(w, unk_t)
+                       for w in parts[1 - src_col].split()]
+            self.src_ids.append(np.array(src, np.int64))
+            self.trg_ids.append(np.array([s] + trg_raw, np.int64))
+            self.trg_ids_next.append(np.array(trg_raw + [e], np.int64))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, i):
+        return self.src_ids[i], self.trg_ids[i], self.trg_ids_next[i]
+
+
+class Movielens(Dataset):
+    """ML-1M ratings (reference movielens.py): '::'-delimited .dat files
+    inside the archive; samples are (user_id, gender_id, age_id, job_id,
+    movie_id, category multi-hot, title word-ids, rating)."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        assert mode in ("train", "test")
+        self.data_file = _require(data_file, "Movielens", "ml-1m.zip")
+        self.mode = mode
+        # ONE archive open (zip — the reference's format — or tar)
+        files = self._read_archive(
+            ("movies.dat", "users.dat", "ratings.dat"))
+        self._load_meta(files)
+        self._load_ratings(files, test_ratio, rand_seed)
+        del files
+
+    def _read_archive(self, suffixes):
+        import zipfile
+
+        out = {}
+        if zipfile.is_zipfile(self.data_file):
+            with zipfile.ZipFile(self.data_file) as zf:
+                for name in zf.namelist():
+                    for suf in suffixes:
+                        if name.endswith(suf):
+                            out[suf] = zf.read(name).decode(
+                                "latin1").splitlines()
+        else:
+            with tarfile.open(self.data_file) as tf:
+                for m in tf.getmembers():
+                    for suf in suffixes:
+                        if m.name.endswith(suf):
+                            out[suf] = tf.extractfile(m).read().decode(
+                                "latin1").splitlines()
+        missing = [s for s in suffixes if s not in out]
+        if missing:
+            raise FileNotFoundError(
+                f"archive is missing {missing} (expected the ml-1m "
+                "layout)")
+        return out
+
+    def _load_meta(self, files):
+        cats, words = {}, {}
+        self.movies = {}
+        self.users = {}
+        for ln in files["movies.dat"]:
+            mid, title, genres = ln.strip().split("::")
+            for g in genres.split("|"):
+                cats.setdefault(g, len(cats))
+            for w in title.lower().split():
+                words.setdefault(w, len(words))
+            self.movies[int(mid)] = (title.lower().split(),
+                                     genres.split("|"))
+        for ln in files["users.dat"]:
+            uid, gender, age, job, _zip = ln.strip().split("::")
+            self.users[int(uid)] = (
+                0 if gender == "M" else 1,
+                self.AGES.index(int(age)) if int(age) in self.AGES
+                else 0,
+                int(job))
+        self.categories_dict = cats
+        self.movie_title_dict = words
+
+    def _load_ratings(self, files, test_ratio, seed):
+        rng = np.random.default_rng(seed)
+        self.data = []
+        for ln in files["ratings.dat"]:
+            uid, mid, rating, _ts = ln.strip().split("::")
+            is_test = rng.random() < test_ratio
+            if (self.mode == "test") != is_test:
+                continue
+            uid, mid = int(uid), int(mid)
+            title, genres = self.movies[mid]
+            g, a, j = self.users[uid]
+            cat_vec = np.zeros(len(self.categories_dict), np.int64)
+            for c in genres:
+                cat_vec[self.categories_dict[c]] = 1
+            self.data.append((
+                np.int64(uid), np.int64(g), np.int64(a), np.int64(j),
+                np.int64(mid), cat_vec,
+                np.array([self.movie_title_dict[w] for w in title],
+                         np.int64),
+                np.float32(rating)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]

@@ -236,3 +236,59 @@ def test_fault_tolerance_gives_up_after_max_restarts(tmp_path):
 
     with pytest.raises(RuntimeError, match="boom"):
         run_with_fault_tolerance(always_fails, cp, max_restarts=2)
+
+
+def test_asp_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+
+    asp.reset_asp_state()
+    paddle.seed(10)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    pruned = asp.prune_model(m)
+    assert len(pruned) == 2
+    w = m[0].weight.numpy()
+    # every group of 4 along the last axis has at most 2 nonzeros
+    groups = w.reshape(-1, 4)
+    assert ((groups != 0).sum(axis=1) <= 2).all()
+    assert abs(asp.calculate_density(m[0].weight) - 0.5) < 0.05
+
+    opt = asp.decorate(
+        paddle.optimizer.AdamW(1e-2, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 4, (8,)))
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives optimizer updates
+    w2 = m[0].weight.numpy().reshape(-1, 4)
+    assert ((w2 != 0).sum(axis=1) <= 2).all()
+    asp.reset_asp_state()
+
+
+def test_asp_m_parameter_and_isolation():
+    from paddle_tpu.incubate import asp
+
+    asp.reset_asp_state()
+    # m=8: only weights whose last axis divides 8 are eligible
+    m8 = nn.Sequential(nn.Linear(4, 16), nn.Linear(3, 4))
+    pruned = asp.prune_model(m8, n=2, m=8)
+    assert len(pruned) == 1  # the (3,4) weight is skipped, no crash
+    g = m8[0].weight.numpy().reshape(-1, 8)
+    assert ((g != 0).sum(axis=1) <= 2).all()
+
+    # a decorated optimizer only re-masks its OWN params
+    other = nn.Linear(4, 8)
+    asp.prune_model(other)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        0.1, parameters=m8.parameters()))
+    before = other.weight.numpy().copy()
+    other.weight._value = other.weight._value + 1.0  # densify
+    x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    loss = (m8[0](x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    # other's weight untouched by this optimizer's re-masking
+    np.testing.assert_allclose(other.weight.numpy(), before + 1.0)
+    asp.reset_asp_state()

@@ -130,3 +130,94 @@ def test_uci_housing_local(tmp_path):
     # normalized features are bounded
     allx = np.stack([tr[i][0] for i in range(len(tr))])
     assert np.abs(allx).max() <= 1.0 + 1e-5
+
+def _make_wmt16_tar(path):
+    train = ("the cat\tdie katze\n" * 10 + "a dog\tein hund\n" * 5
+             ).encode()
+    val = b"the dog\tder hund\n"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in (("wmt16/train", train), ("wmt16/val", val),
+                           ("wmt16/test", val)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_wmt16(tmp_path):
+    from paddle_tpu.text import WMT16
+
+    tarp = str(tmp_path / "wmt16.tar.gz")
+    _make_wmt16_tar(tarp)
+    ds = WMT16(data_file=tarp, mode="train", src_dict_size=10,
+               trg_dict_size=10)
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+    assert ds.src_dict["<unk>"] == 2
+    # most frequent train word right after the specials
+    assert ds.src_dict["the"] == 3
+    src, trg, trg_next = ds[0]
+    assert src[0] == 0 and src[-1] == 1          # <s> ... <e>
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    assert trg_next[-1] == 1
+    # val split + unknown words map to <unk>
+    dv = WMT16(data_file=tarp, mode="val", src_dict_size=4,
+               trg_dict_size=4)
+    assert len(dv) == 1
+
+
+def _make_ml_tar(path):
+    movies = b"1::Toy Story (1995)::Animation|Comedy\n2::Heat (1995)::Action\n"
+    users = b"1::M::25::4::00000\n2::F::35::7::11111\n"
+    ratings = (b"1::1::5::978300760\n1::2::3::978302109\n"
+               b"2::1::4::978301968\n2::2::2::978300275\n")
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in (("ml-1m/movies.dat", movies),
+                           ("ml-1m/users.dat", users),
+                           ("ml-1m/ratings.dat", ratings)):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_movielens(tmp_path):
+    from paddle_tpu.text import Movielens
+
+    tarp = str(tmp_path / "ml-1m.tar.gz")
+    _make_ml_tar(tarp)
+    tr = Movielens(data_file=tarp, mode="train", test_ratio=0.25,
+                   rand_seed=0)
+    te = Movielens(data_file=tarp, mode="test", test_ratio=0.25,
+                   rand_seed=0)
+    assert len(tr) + len(te) == 4 and len(tr) > 0
+    uid, g, a, j, mid, cats, title, rating = tr[0]
+    assert cats.shape == (3,)  # Animation, Comedy, Action
+    assert 1.0 <= float(rating) <= 5.0
+    assert title.dtype == np.int64
+
+
+def test_movielens_zip_archive(tmp_path):
+    import zipfile
+
+    from paddle_tpu.text import Movielens
+
+    zp = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(zp, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Comedy\n")
+        zf.writestr("ml-1m/users.dat", "1::M::25::4::00000\n")
+        zf.writestr("ml-1m/ratings.dat", "1::1::5::978300760\n")
+    ds = Movielens(data_file=zp, mode="train", test_ratio=0.0)
+    assert len(ds) == 1
+    assert float(ds[0][-1]) == 5.0
+
+
+def test_wmt16_small_dict_keeps_specials(tmp_path):
+    from paddle_tpu.text import WMT16
+
+    tarp = str(tmp_path / "wmt16.tar.gz")
+    _make_wmt16_tar(tarp)
+    ds = WMT16(data_file=tarp, mode="train", src_dict_size=4,
+               trg_dict_size=4)
+    assert ds.src_dict["<unk>"] == 2 and len(ds.src_dict) == 4
+    with pytest.raises(AssertionError):
+        WMT16(data_file=tarp, mode="train", src_dict_size=2,
+              trg_dict_size=2)
