@@ -15,6 +15,8 @@ from ...ops._helpers import apply_jfn, ensure_tensor
 
 __all__ = [
     "linear",
+    "pairwise_distance",
+    "fold",
     "dropout",
     "dropout2d",
     "dropout3d",
@@ -399,3 +401,58 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return jnp.moveaxis(out, -1, 1)
 
     return apply_jfn("grid_sample", jfn, x, grid)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm distance over the last dim (reference:
+    python/paddle/nn/functional/distance.py; p=inf → Chebyshev,
+    p=0 → nonzero count, matching p_norm's ord rules)."""
+
+    def jfn(a, b):
+        d = jnp.abs(a - b) + epsilon
+        if p == float("inf"):
+            out = d.max(axis=-1)
+        elif p == float("-inf"):
+            out = d.min(axis=-1)
+        elif p == 0:
+            out = (d != 0).astype(d.dtype).sum(axis=-1)
+        else:
+            out = (d ** p).sum(axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+
+    return apply_jfn("pairwise_distance", jfn, ensure_tensor(x),
+                     ensure_tensor(y))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im: combine sliding blocks [N, C·kh·kw, L] → [N, C, H, W],
+    summing overlaps (reference: python/paddle/nn/functional/common.py
+    fold; inverse of unfold). Static kernel loops → XLA scatter-adds."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    H, W = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def jfn(v):
+        N = v.shape[0]
+        C = v.shape[1] // (kh * kw)
+        blocks = v.reshape(N, C, kh, kw, oh, ow)
+        out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[
+                    :, :, hi:hi + sh * oh:sh, wj:wj + sw * ow:sw
+                ].add(blocks[:, :, i, j])
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return apply_jfn("fold", jfn, ensure_tensor(x))

@@ -8,10 +8,14 @@ vocab-parallel variant lives in ops/pallas_kernels.)
 import jax
 import jax.numpy as jnp
 
-from ...ops._helpers import apply_jfn, ensure_tensor
+from ...ops._helpers import apply_jfn, ensure_tensor, value_of
 
 __all__ = [
     "cross_entropy",
+    "ctc_loss",
+    "huber_loss",
+    "poisson_nll_loss",
+    "multi_label_soft_margin_loss",
     "softmax_with_cross_entropy",
     "nll_loss",
     "mse_loss",
@@ -287,3 +291,131 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
         return _reduce(out, reduction)
 
     return apply_jfn("sigmoid_focal_loss", jfn, *tensors)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """(reference: python/paddle/nn/functional/loss.py huber_loss)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def jfn(x, y):
+        r = x - y
+        a = jnp.abs(r)
+        return _reduce(jnp.where(a <= delta, 0.5 * r * r,
+                                 delta * (a - 0.5 * delta)), reduction)
+
+    return apply_jfn("huber_loss", jfn, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """(reference loss.py poisson_nll_loss; optional Stirling term)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def jfn(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            stir = (y * jnp.log(y + epsilon) - y
+                    + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon)))
+            out = out + jnp.where(y > 1, stir, 0.0)
+        return _reduce(out, reduction)
+
+    return apply_jfn("poisson_nll_loss", jfn, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """(reference loss.py multi_label_soft_margin_loss): mean over
+    classes of BCE-with-logits against ±1-style multi-hot labels."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    tensors = [input, label]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+
+    def jfn(x, y, *w):
+        term = (y * jax.nn.log_sigmoid(x)
+                + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            term = term * w[0]
+        return _reduce(-term.mean(axis=-1), reduction)
+
+    return apply_jfn("multi_label_soft_margin", jfn, *tensors)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: python/paddle/nn/functional/loss.py ctc_loss
+    → warpctc op paddle/fluid/operators/warpctc_op.cc).
+
+    log_probs: [T, B, C] UNNORMALIZED logits (log_softmax applied here,
+    as warpctc does); labels [B, L]; lengths per batch. TPU-first: the
+    alpha recursion is one lax.scan over time in the log semiring,
+    vectorized over batch and extended-label position — no per-sample
+    loops, static shapes."""
+    lp_t = ensure_tensor(log_probs)
+    lab_t = ensure_tensor(labels)
+    il = jnp.asarray(value_of(ensure_tensor(input_lengths)))
+    ll = jnp.asarray(value_of(ensure_tensor(label_lengths)))
+
+    def jfn(logits, lab):
+        T, B, C = logits.shape
+        L = lab.shape[1]
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        S = 2 * L + 1
+        # extended labels: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        NEG = jnp.float32(-1e30)
+        pos = jnp.arange(S)[None, :]
+
+        # allowed skip (s-2 → s): only onto a label that differs from
+        # the label two back
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # [B, S]
+        alpha0 = jnp.where(pos < 2, emit0, NEG)
+
+        def step(alpha, t):
+            prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                            constant_values=NEG)[:, :S]
+            prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                            constant_values=NEG)[:, :S]
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = merged + emit
+            # sequences already past their input length keep alpha
+            active = (t < il)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # ends: positions 2*ll and 2*ll-1 of the extended sequence
+        end_blank = jnp.take_along_axis(alpha, (2 * ll)[:, None], 1)[:, 0]
+        end_label = jnp.take_along_axis(
+            alpha, jnp.maximum(2 * ll - 1, 0)[:, None], 1)[:, 0]
+        # empty target: the only end state is the blank at position 0
+        # (the clamped 2·ll−1 read would double-count it)
+        nll = jnp.where(ll > 0, -jnp.logaddexp(end_blank, end_label),
+                        -end_blank)
+        if norm_by_times:
+            # warpctc's norm_by_times: scale by the input length
+            nll = nll / jnp.maximum(il, 1).astype(nll.dtype)
+        return nll
+
+    loss = apply_jfn("ctc_loss", jfn, lp_t, lab_t)
+    if reduction == "mean":
+        from ...ops.math import mean as t_mean
+
+        return t_mean(loss / ensure_tensor(
+            jnp.maximum(ll, 1).astype(jnp.float32)))
+    if reduction == "sum":
+        from ...ops.math import sum as t_sum
+
+        return t_sum(loss)
+    return loss

@@ -4,6 +4,8 @@ from .. import initializer as I
 from .layers import Layer
 
 __all__ = [
+    "PairwiseDistance",
+    "Fold",
     "Linear",
     "Identity",
     "Dropout",
@@ -281,3 +283,34 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PairwiseDistance(Layer):
+    """(reference: python/paddle/nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                   keepdim=self.keepdim)
+
+
+class Fold(Layer):
+    """(reference: python/paddle/nn/layer/common.py Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)

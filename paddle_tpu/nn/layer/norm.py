@@ -6,11 +6,14 @@ buffer state explicitly.
 """
 import numpy as np
 
+import jax.numpy as jnp
+
 from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
 
 __all__ = [
+    "SpectralNorm",
     "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
     "SyncBatchNorm", "LayerNorm", "GroupNorm",
     "InstanceNorm1D", "InstanceNorm2D", "InstanceNorm3D",
@@ -219,3 +222,61 @@ class LocalResponseNorm(Layer):
     def forward(self, input):
         return F.local_response_norm(input, self.size, self.alpha, self.beta,
                                      self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a WEIGHT tensor via power iteration
+    (reference: python/paddle/nn/layer/norm.py SpectralNorm; phi kernel
+    spectral_norm_kernel). forward(weight) -> weight / sigma, with
+    persistent u/v direction buffers updated per call."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        import numpy as _np
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        self._h, self._w = h, w
+        from ...core import dtype as _dtype_mod
+        from ...tensor_core import Tensor as _T
+
+        dt = _dtype_mod.convert_dtype(dtype)
+        rng = _np.random.default_rng(0)
+        u = rng.standard_normal(h).astype(_np.float32)
+        v = rng.standard_normal(w).astype(_np.float32)
+        self.register_buffer(
+            "weight_u",
+            _T(jnp.asarray(u / (_np.linalg.norm(u) + eps), dt)))
+        self.register_buffer(
+            "weight_v",
+            _T(jnp.asarray(v / (_np.linalg.norm(v) + eps), dt)))
+
+    def forward(self, weight):
+        from ...ops._helpers import apply_jfn, ensure_tensor, value_of
+
+        weight = ensure_tensor(weight)
+        dim, h, w, eps = self._dim, self._h, self._w, self._eps
+        u0 = value_of(self.weight_u)
+        v0 = value_of(self.weight_v)
+        iters = self._power_iters
+
+        def jfn(wt):
+            perm = (dim,) + tuple(i for i in range(wt.ndim) if i != dim)
+            m = jnp.transpose(wt, perm).reshape(h, w)
+            u, v = u0, v0
+            for _ in range(iters):
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ m @ v
+            return wt / sigma, u, v
+
+        out, u_new, v_new = apply_jfn("spectral_norm", jfn, weight)
+        self.weight_u._value = value_of(u_new)
+        self.weight_v._value = value_of(v_new)
+        return out

@@ -196,8 +196,11 @@ class WMT16(Dataset):
     def __init__(self, data_file=None, mode="train", src_dict_size=-1,
                  trg_dict_size=-1, lang="en", download=False):
         assert mode in ("train", "val", "test")
-        assert src_dict_size > 3 and trg_dict_size > 3, \
-            "dict sizes must exceed the 3 special tokens (<s>/<e>/<unk>)"
+        # reference semantics: -1 (or any <=0) keeps the FULL vocabulary
+        assert src_dict_size <= 0 or src_dict_size > 3, \
+            "positive dict sizes must exceed the 3 specials (<s>/<e>/<unk>)"
+        assert trg_dict_size <= 0 or trg_dict_size > 3, \
+            "positive dict sizes must exceed the 3 specials (<s>/<e>/<unk>)"
         self.data_file = _require(data_file, "WMT16", "wmt16.tar.gz")
         self.mode = mode
         self.lang = lang
@@ -228,7 +231,9 @@ class WMT16(Dataset):
                 freq[w] = freq.get(w, 0) + 1
         # specials are unconditional; only the WORD list is truncated
         words = [w for w, _ in sorted(freq.items(), key=lambda t: -t[1])]
-        vocab = [self.START, self.END, self.UNK] + words[:dict_size - 3]
+        if dict_size > 0:
+            words = words[:dict_size - 3]
+        vocab = [self.START, self.END, self.UNK] + words
         return {w: i for i, w in enumerate(vocab)}
 
     def _load_data(self, pairs):

@@ -1,0 +1,141 @@
+"""New nn coverage: Huber/Poisson/MultiLabel/CTC losses, PairwiseDistance,
+Fold, SpectralNorm (reference: python/paddle/nn/layer/{loss,common,norm}.py,
+functional/loss.py ctc_loss → warpctc)."""
+import itertools
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+F = nn.functional
+
+
+def test_huber_and_layer():
+    x = paddle.to_tensor(np.array([0.2, 2.0]))
+    y = paddle.to_tensor(np.array([0.0, 0.0]))
+    np.testing.assert_allclose(
+        F.huber_loss(x, y, delta=1.0, reduction="none").numpy(),
+        [0.02, 1.5], rtol=1e-6)
+    layer = nn.HuberLoss(delta=1.0)
+    np.testing.assert_allclose(float(layer(x, y).numpy()), 0.76, rtol=1e-6)
+
+
+def test_poisson_nll():
+    inp = paddle.to_tensor(np.array([0.5]))
+    lab = paddle.to_tensor(np.array([2.0]))
+    np.testing.assert_allclose(
+        F.poisson_nll_loss(inp, lab, reduction="none").numpy(),
+        np.exp(0.5) - 1.0, rtol=1e-6)
+    nolog = F.poisson_nll_loss(inp, lab, log_input=False,
+                               reduction="none").numpy()
+    np.testing.assert_allclose(nolog, 0.5 - 2.0 * np.log(0.5 + 1e-8),
+                               rtol=1e-6)
+
+
+def test_multilabel_soft_margin():
+    logits = paddle.to_tensor(np.array([[1.0, -1.0]]))
+    labs = paddle.to_tensor(np.array([[1.0, 0.0]]))
+    sig = 1 / (1 + np.exp(-1.0))
+    ref = -np.mean([np.log(sig), np.log(sig)])
+    np.testing.assert_allclose(
+        float(F.multi_label_soft_margin_loss(logits, labs).numpy()), ref,
+        rtol=1e-6)
+
+
+def test_pairwise_distance_and_fold():
+    a = paddle.to_tensor(np.array([[0.0, 3.0]]))
+    b = paddle.to_tensor(np.array([[4.0, 0.0]]))
+    got = nn.PairwiseDistance()(a, b).numpy()
+    np.testing.assert_allclose(got, [5.0], rtol=1e-3)
+    # fold inverts non-overlapping unfold, sums overlaps
+    img = np.arange(16.0).reshape(1, 1, 4, 4).astype(np.float32)
+    blocks = np.zeros((1, 4, 4), np.float32)
+    k = 0
+    for i in range(0, 4, 2):
+        for j in range(0, 4, 2):
+            blocks[0, :, k] = img[0, 0, i:i + 2, j:j + 2].reshape(-1)
+            k += 1
+    back = nn.Fold((4, 4), (2, 2), strides=2)(
+        paddle.to_tensor(blocks)).numpy()
+    np.testing.assert_allclose(back[0, 0], img[0, 0])
+    # overlapping stride-1 fold: ones everywhere counts the coverage
+    ones = paddle.to_tensor(np.ones((1, 4, 9), np.float32))
+    cov = F.fold(ones, (4, 4), (2, 2), strides=1).numpy()[0, 0]
+    assert cov[0, 0] == 1 and cov[1, 1] == 4  # corner 1x, center 4x
+
+
+def test_spectral_norm_unit_sigma():
+    sn = nn.SpectralNorm([8, 6], power_iters=20)
+    W = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((8, 6)).astype(
+            np.float32))
+    Wn = sn(W)
+    s = np.linalg.svd(Wn.numpy(), compute_uv=False)
+    assert abs(s[0] - 1.0) < 1e-2
+
+
+def _brute_ctc(lp, labels):
+    T, C = lp.shape
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        col = []
+        for p in path:
+            if not col or col[-1] != p:
+                col.append(p)
+        col = [c for c in col if c != 0]
+        if col == list(labels):
+            s = sum(lp[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def test_ctc_matches_brute_force():
+    rng = np.random.default_rng(1)
+    T, B, C = 5, 2, 4
+    logits = rng.standard_normal((T, B, C)).astype(np.float32)
+    labels = np.array([[1, 2], [3, 3]])
+    il = np.array([5, 4])
+    ll = np.array([2, 2])
+    loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(il), paddle.to_tensor(ll),
+                      reduction="none").numpy()
+    for b in range(B):
+        lp = jax.nn.log_softmax(logits[:il[b], b], axis=-1)
+        ref = _brute_ctc(np.asarray(lp), labels[b][:ll[b]].tolist())
+        np.testing.assert_allclose(loss[b], ref, rtol=1e-4)
+    # grads flow and a CTC layer trains
+    lt = paddle.to_tensor(logits, stop_gradient=False)
+    out = nn.CTCLoss()(lt, paddle.to_tensor(labels), paddle.to_tensor(il),
+                       paddle.to_tensor(ll))
+    out.backward()
+    assert lt.grad is not None and np.isfinite(lt.grad.numpy()).all()
+
+
+def test_ctc_empty_target_and_norm_by_times():
+    rng = np.random.default_rng(2)
+    T, C = 4, 3
+    logits = rng.standard_normal((T, 1, C)).astype(np.float32)
+    labels = np.zeros((1, 2), np.int64)
+    loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(np.array([T])),
+                      paddle.to_tensor(np.array([0])),
+                      reduction="none").numpy()
+    # empty target: loss = -log P(all blanks)
+    lp = jax.nn.log_softmax(logits[:, 0], axis=-1)
+    ref = -float(np.sum(np.asarray(lp)[:, 0]))
+    np.testing.assert_allclose(loss[0], ref, rtol=1e-5)
+    normed = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                        paddle.to_tensor(np.array([T])),
+                        paddle.to_tensor(np.array([0])),
+                        reduction="none", norm_by_times=True).numpy()
+    np.testing.assert_allclose(normed[0], ref / T, rtol=1e-5)
+
+
+def test_pairwise_distance_inf_norm():
+    a = paddle.to_tensor(np.array([[0.0, 3.0]]))
+    b = paddle.to_tensor(np.array([[4.0, 0.0]]))
+    got = F.pairwise_distance(a, b, p=float("inf")).numpy()
+    np.testing.assert_allclose(got, [4.0], rtol=1e-3)

@@ -221,3 +221,13 @@ def test_wmt16_small_dict_keeps_specials(tmp_path):
     with pytest.raises(AssertionError):
         WMT16(data_file=tarp, mode="train", src_dict_size=2,
               trg_dict_size=2)
+
+
+def test_wmt16_full_vocab_default(tmp_path):
+    from paddle_tpu.text import WMT16
+
+    tarp = str(tmp_path / "wmt16.tar.gz")
+    _make_wmt16_tar(tarp)
+    ds = WMT16(data_file=tarp, mode="train")  # -1 = full vocab
+    assert ds.src_dict["<s>"] == 0 and "the" in ds.src_dict
+    assert len(ds) == 15
