@@ -7,9 +7,9 @@ are in-tree because they are the benchmark/parallelism drivers.)
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from .datasets import (  # noqa: F401
-    Imdb, Imikolov, Movielens, UCIHousing, WMT16)
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = ["models", "datasets", "Imdb", "Imikolov", "UCIHousing",
-           "WMT16", "Movielens",
+           "WMT16", "Movielens", "WMT14", "Conll05st",
            "ViterbiDecoder", "viterbi_decode"]

@@ -16,7 +16,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT16", "Movielens"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT16", "Movielens",
+           "WMT14", "Conll05st"]
 
 
 def _require(data_file, name, url_hint):
@@ -350,3 +351,200 @@ class Movielens(Dataset):
 
     def __getitem__(self, i):
         return self.data[i]
+
+
+class WMT14(Dataset):
+    """FR→EN translation (reference wmt14.py): tar containing src.dict /
+    trg.dict (one token per line; rows 0-2 are <s>, <e>, <unk>) and
+    tab-separated parallel files whose names end with the split name."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        assert mode in ("train", "test", "gen")
+        assert dict_size > 0, "dict_size should be a positive number"
+        self.data_file = _require(data_file, "WMT14",
+                                  "wmt14 tarball with src/trg dicts")
+        self.mode = mode
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        unk = 2  # reference UNK_IDX
+        split_name = {"train": "train", "test": "test", "gen": "gen"}[
+            self.mode]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            def to_dict(member):
+                d = {}
+                for i, ln in enumerate(_io.TextIOWrapper(
+                        tf.extractfile(member), encoding="utf-8")):
+                    if i == self.dict_size:
+                        break
+                    d[ln.strip()] = i
+                return d
+
+            def find(suffix):
+                for m in tf.getmembers():
+                    if m.isfile() and m.name.endswith(suffix):
+                        return m
+                raise FileNotFoundError(
+                    f"archive has no file ending with {suffix!r} "
+                    "(expected the wmt14 layout)")
+
+            self.src_dict = to_dict(find("src.dict"))
+            self.trg_dict = to_dict(find("trg.dict"))
+            for m in tf.getmembers():
+                # directories named like the split must not match
+                if not m.isfile() or not m.name.endswith(split_name):
+                    continue
+                for ln in _io.TextIOWrapper(tf.extractfile(m),
+                                            encoding="utf-8"):
+                    parts = ln.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, unk)
+                           for w in parts[0].split()]
+                    trg = [self.trg_dict.get(w, unk)
+                           for w in parts[1].split()]
+                    self.src_ids.append(np.array(src + [1], np.int64))
+                    self.trg_ids.append(np.array([0] + trg, np.int64))
+                    self.trg_ids_next.append(np.array(trg + [1], np.int64))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, i):
+        return self.src_ids[i], self.trg_ids[i], self.trg_ids_next[i]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test set (reference conll05.py): words.gz +
+    props.gz columns inside the tarball; bracketed proposition spans are
+    converted to per-predicate BIO sequences, and each sample carries
+    the reference's context-window features:
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id, mark,
+    label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 download=False):
+        import gzip
+
+        self.data_file = _require(data_file, "Conll05st",
+                                  "conll05st-tests.tar.gz")
+        with tarfile.open(self.data_file) as tf:
+            wf = pf = None
+            for m in tf.getmembers():
+                # pin the wsj corpus (the official archive also carries
+                # test.brown; mixing corpora would zip mismatched files)
+                if not m.isfile():
+                    continue
+                if m.name.endswith("test.wsj.words.gz"):
+                    wf = gzip.decompress(tf.extractfile(m).read())
+                elif m.name.endswith("test.wsj.props.gz"):
+                    pf = gzip.decompress(tf.extractfile(m).read())
+        if wf is None or pf is None:
+            raise FileNotFoundError(
+                "test.wsj.words.gz / test.wsj.props.gz not in archive "
+                "(expected the conll05st-release layout)")
+        self._parse(wf.decode("latin1"), pf.decode("latin1"))
+        self.word_dict = self._dict_from(word_dict_file, (
+            w for s in self.sentences for w in s), extra=("bos", "eos"))
+        self.predicate_dict = self._dict_from(verb_dict_file,
+                                              self.predicates)
+        self.label_dict = self._dict_from(target_dict_file, (
+            l for seq in self.labels for l in seq))
+        # precompute encoded samples once (pattern of the sibling
+        # datasets — __getitem__ must not re-encode every epoch)
+        self._samples = [self._encode(i) for i in range(len(self.sentences))]
+
+    @staticmethod
+    def _dict_from(dict_file, items, extra=()):
+        if dict_file is not None:
+            with open(dict_file) as f:
+                d = {ln.strip(): i for i, ln in enumerate(f)}
+        else:
+            d = {}
+            for it in items:
+                d.setdefault(it, len(d))
+        # __getitem__ indexes these unconditionally — guarantee them
+        # even for externally supplied dict files
+        for e in (*extra, "<unk>"):
+            d.setdefault(e, len(d))
+        return d
+
+    def _parse(self, words_text, props_text):
+        self.sentences, self.predicates, self.labels = [], [], []
+        sentence, one_seg = [], []
+        for wline, pline in zip(words_text.splitlines(),
+                                props_text.splitlines()):
+            word = wline.strip()
+            cols = pline.strip().split()
+            if not cols:  # end of sentence
+                self._emit(sentence, one_seg)
+                sentence, one_seg = [], []
+            else:
+                sentence.append(word)
+                one_seg.append(cols)
+        self._emit(sentence, one_seg)
+
+    def _emit(self, sentence, one_seg):
+        if not one_seg:
+            return
+        ncols = len(one_seg[0])
+        columns = [[row[i] for row in one_seg] for i in range(ncols)]
+        verbs = [v for v in columns[0] if v != "-"]
+        for i, col in enumerate(columns[1:]):
+            cur, inside, seq = "O", False, []
+            for l in col:
+                if l == "*":
+                    seq.append("I-" + cur if inside else "O")
+                elif l == "*)":
+                    seq.append("I-" + cur)
+                    inside = False
+                elif "(" in l and ")" in l:
+                    cur = l[1:l.find("*")]
+                    seq.append("B-" + cur)
+                    inside = False
+                elif "(" in l:
+                    cur = l[1:l.find("*")]
+                    seq.append("B-" + cur)
+                    inside = True
+                else:
+                    raise RuntimeError(f"unexpected label {l!r}")
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[i])
+            self.labels.append(seq)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+    def _encode(self, idx):
+        sent = self.sentences[idx]
+        labels = self.labels[idx]
+        unk = self.word_dict["<unk>"]
+        vi = labels.index("B-V")
+        mark = np.zeros(len(labels), np.int64)
+        ctx = []
+        for off in (-2, -1, 0, 1, 2):
+            j = vi + off
+            if 0 <= j < len(sent):
+                if off != 0:
+                    mark[j] = 1
+                ctx.append(self.word_dict.get(sent[j], unk))
+            else:
+                ctx.append(self.word_dict["bos" if off < 0 else "eos"])
+        mark[vi] = 1
+        word_idx = np.array([self.word_dict.get(w, unk) for w in sent],
+                            np.int64)
+        lab_idx = np.array(
+            [self.label_dict.get(l, len(self.label_dict) - 1)
+             for l in labels], np.int64)
+        pred = np.int64(self.predicate_dict.get(
+            self.predicates[idx], len(self.predicate_dict) - 1))
+        return (word_idx, np.int64(ctx[0]), np.int64(ctx[1]),
+                np.int64(ctx[2]), np.int64(ctx[3]), np.int64(ctx[4]),
+                pred, mark, lab_idx)

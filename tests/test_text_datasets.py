@@ -231,3 +231,64 @@ def test_wmt16_full_vocab_default(tmp_path):
     ds = WMT16(data_file=tarp, mode="train")  # -1 = full vocab
     assert ds.src_dict["<s>"] == 0 and "the" in ds.src_dict
     assert len(ds) == 15
+
+
+def test_wmt14(tmp_path):
+    from paddle_tpu.text import WMT14
+
+    tarp = str(tmp_path / "wmt14.tgz")
+    src_dict = "<s>\n<e>\n<unk>\nle\nchat\n"
+    trg_dict = "<s>\n<e>\n<unk>\nthe\ncat\n"
+    train = "le chat\tthe cat\nle chien\tthe dog\n"
+    with tarfile.open(tarp, "w:gz") as tf:
+        for name, data in (("wmt14/src.dict", src_dict),
+                           ("wmt14/trg.dict", trg_dict),
+                           ("wmt14/train/train", train)):
+            b = data.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(b)
+            tf.addfile(info, io.BytesIO(b))
+    ds = WMT14(data_file=tarp, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    np.testing.assert_array_equal(src, [3, 4, 1])      # le chat <e>
+    np.testing.assert_array_equal(trg, [0, 3, 4])      # <s> the cat
+    np.testing.assert_array_equal(trg_next, [3, 4, 1])
+    # OOV maps to unk (id 2)
+    assert ds[1][0][1] == 2  # "chien" not in the 5-word dict
+
+
+def _make_conll_tar(path):
+    import gzip
+
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    # sentence 1: predicate 'sat' with an A0 span over 'The cat';
+    # columns whitespace-separated (verb column + one proposition column)
+    props = ("-  (A0*\n-  *)\nsat  (V*)\n\n"
+             "bark  (V*)\n-  *\n\n")
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 words),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 props)):
+            data = gzip.compress(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_conll05st(tmp_path):
+    from paddle_tpu.text import Conll05st
+
+    tarp = str(tmp_path / "conll05st-tests.tar.gz")
+    _make_conll_tar(tarp)
+    ds = Conll05st(data_file=tarp)
+    assert len(ds) == 2
+    word_idx, n2, n1, c0, p1, p2, pred, mark, labels = ds[0]
+    # sentence 1: labels B-A0 I-A0 B-V
+    inv_label = {v: k for k, v in ds.label_dict.items()}
+    assert [inv_label[i] for i in labels.tolist()] == \
+        ["B-A0", "I-A0", "B-V"]
+    assert mark.tolist() == [1, 1, 1]  # ±2 window covers all 3 words
+    assert word_idx.shape == (3,)
