@@ -132,7 +132,169 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+# ------------------------------------------------------------ generation
+
+def _cached_attention(q, k_new, v_new, cache_k, cache_v, index):
+    """Write k/v into the static cache at `index` and attend q against
+    the valid prefix (TPU decode pattern: fixed-size buffers +
+    dynamic_update_slice, no shape changes step to step)."""
+    import math as _math
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ...ops._helpers import apply_jfn
+
+    def jfn(qv, kn, vn, ck, cv, idx):
+        idx = idx.astype(jnp.int32)
+        zero = jnp.asarray(0, idx.dtype)  # all start indices same dtype
+        starts = (zero, idx, zero, zero)
+        ck = lax.dynamic_update_slice(ck, kn.astype(ck.dtype), starts)
+        cv = lax.dynamic_update_slice(cv, vn.astype(cv.dtype), starts)
+        qt = jnp.swapaxes(qv, 1, 2)
+        kt = jnp.swapaxes(ck, 1, 2)
+        vt = jnp.swapaxes(cv, 1, 2)
+        d = qv.shape[-1]
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / _math.sqrt(d)
+        s_new, L = qv.shape[1], ck.shape[1]
+        allowed = (jnp.arange(L)[None, :]
+                   <= (idx + jnp.arange(s_new))[:, None])
+        sc = jnp.where(allowed[None, None], sc, jnp.float32(-1e30))
+        w = jax.nn.softmax(sc, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, vt).astype(qv.dtype)
+        return jnp.swapaxes(out, 1, 2), ck, cv
+
+    return apply_jfn("cached_attention", jfn, q, k_new, v_new, cache_k,
+                     cache_v, index)
+
+
+def _layer_forward_cached(layer, x, cache, index):
+    """Functional: returns (x_out, new_cache) — no mutation, so the whole
+    decode step can be captured by to_static and dispatched as ONE
+    compiled program per token."""
+    b, s = x.shape[0], x.shape[1]
+    h = layer.ln1(x)
+    qkv = layer.qkv(h)
+    q, k, v = split_fused_qkv(qkv, b, s, layer.nh, layer.hd)
+    attn, ck, cv = _cached_attention(q, k, v, cache["k"], cache["v"],
+                                     index)
+    attn = manip.reshape(attn, [b, s, layer.nh * layer.hd])
+    x = x + layer.proj(attn)
+    h = layer.ln2(x)
+    return x + layer.fc2(F.gelu(layer.fc1(h))), {"k": ck, "v": cv}
+
+
+class GPTGenerationMixin:
+    """Greedy / temperature / top-k decoding with a static KV cache
+    (reference capability: PaddleNLP generate() on GPT; here designed
+    for XLA — fixed-length cache buffers, dynamic_update_slice writes,
+    every step the same compiled shape)."""
+
+    def _forward_cached(self, input_ids, caches, index):
+        from ...ops.creation import arange
+
+        model = self.gpt
+        s = input_ids.shape[1]
+        pos = arange(0, s, dtype="int64") + index
+        x = model.wte(input_ids) + model.wpe(pos)
+        new_caches = []
+        for layer, cache in zip(model.layers, caches):
+            x, nc = _layer_forward_cached(layer, x, cache, index)
+            new_caches.append(nc)
+        x = model.ln_f(x)
+        if self.lm_head is not None:
+            return self.lm_head(x), new_caches
+        w = self.gpt.wte.weight
+        return F.linear(x, manip.transpose(w, [1, 0])), new_caches
+
+    def _decode_step_impl(self, tok, idx, *kv):
+        L = self.config.num_layers
+        caches = [{"k": kv[2 * i], "v": kv[2 * i + 1]} for i in range(L)]
+        logits, new = self._forward_cached(tok, caches, idx)
+        flat = []
+        for c in new:
+            flat += [c["k"], c["v"]]
+        return (logits, *flat)
+
+    def _make_step(self):
+        """ONE to_static-wrapped step per CLASS (bound per instance):
+        the trace cache persists across generate() calls, and because it
+        is invoked as a bound Layer method the weights are threaded as
+        jit ARGUMENTS, not baked into each executable as constants."""
+        cls = type(self)
+        if "_decode_step_static" not in cls.__dict__:
+            from ... import jit as jit_mod
+
+            cls._decode_step_static = jit_mod.to_static(
+                cls._decode_step_impl)
+        return cls.__dict__["_decode_step_static"].__get__(self, cls)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, do_sample=False):
+        """input_ids [b, prompt] → [b, min(prompt + max_new_tokens,
+        max_seq_len)]."""
+        import jax
+        import jax.numpy as jnp
+
+        from ... import to_tensor
+        from ...autograd import no_grad
+        from ...core import rng as rng_mod
+        from ...tensor_core import Tensor
+
+        cfg = self.config
+        b, prompt = int(input_ids.shape[0]), int(input_ids.shape[1])
+        if prompt > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {prompt} exceeds max_seq_len "
+                f"{cfg.max_seq_len}")
+        total = min(prompt + max_new_tokens, cfg.max_seq_len)
+        if total <= prompt:  # no budget: nothing to generate
+            return Tensor(input_ids._value.astype(jnp.int64),
+                          stop_gradient=True)
+        # bucket the cache length so different max_new_tokens reuse the
+        # SAME compiled decode program (each distinct shape is a fresh
+        # XLA compile)
+        cache_len = min(-(-total // 128) * 128, cfg.max_seq_len)
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+        def pick(logits_row):
+            lv = logits_row._value[:, -1, :].astype(jnp.float32)
+            if not do_sample or temperature == 0:
+                return jnp.argmax(lv, axis=-1)
+            lv = lv / max(temperature, 1e-6)
+            if top_k is not None:
+                k_eff = min(int(top_k), lv.shape[-1])
+                kth = jnp.sort(lv, axis=-1)[:, -k_eff][:, None]
+                lv = jnp.where(lv < kth, -1e30, lv)
+            return jax.random.categorical(rng_mod.next_key(), lv, axis=-1)
+
+        with no_grad():
+            flat_kv = []
+            for _ in range(cfg.num_layers):
+                flat_kv += [
+                    to_tensor(jnp.zeros((b, cache_len, nh, hd),
+                                        jnp.float32)),
+                    to_tensor(jnp.zeros((b, cache_len, nh, hd),
+                                        jnp.float32))]
+            step = self._make_step()
+            idx0 = to_tensor(jnp.asarray(0, jnp.int32))
+            logits, *flat_kv = step(input_ids, idx0, *flat_kv)
+            out = [input_ids._value.astype(jnp.int64)]
+            tok = pick(logits)
+            out.append(tok[:, None].astype(jnp.int64))
+            for t in range(1, total - prompt):
+                step_idx = to_tensor(jnp.asarray(prompt + t - 1, jnp.int32))
+                logits, *flat_kv = step(
+                    Tensor(tok[:, None], stop_gradient=True), step_idx,
+                    *flat_kv)
+                tok = pick(logits)
+                out.append(tok[:, None].astype(jnp.int64))
+        return Tensor(jnp.concatenate(out, axis=1), stop_gradient=True)
+
+
+
+class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
     """LM head tied to the (vocab-sharded) embedding by default."""
 
     def __init__(self, config):
@@ -170,3 +332,5 @@ class GPTPretrainingCriterion(nn.Layer):
         shift_labels = manip.slice(labels, [1], [1], [labels.shape[1]])
         loss = self.ce(shift_logits, shift_labels)
         return mean(loss)
+
+

@@ -212,3 +212,78 @@ class TestBert:
         for _ in range(10):
             l = float(step(ids, y).numpy())
         assert l < l0
+
+
+class TestGeneration:
+    def test_greedy_matches_full_forward(self):
+        mesh_mod.reset_mesh()
+        paddle.seed(20)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.default_rng(9)
+        prompt = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 8)))
+        out = model.generate(prompt, max_new_tokens=6).numpy()
+        assert out.shape == (2, 14)
+        np.testing.assert_array_equal(out[:, :8], prompt.numpy())
+        # KV-cache greedy decode == argmax over the FULL forward each step
+        ref = prompt.numpy()
+        for _ in range(6):
+            logits = model(paddle.to_tensor(ref)).numpy()
+            nxt = logits[:, -1].argmax(-1)
+            ref = np.concatenate([ref, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_sampling_modes(self):
+        paddle.seed(21)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        prompt = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (1, 4)))
+        s1 = model.generate(prompt, max_new_tokens=8, do_sample=True,
+                            temperature=1.0, top_k=5).numpy()
+        assert s1.shape == (1, 12)
+        assert ((0 <= s1) & (s1 < cfg.vocab_size)).all()
+        # respects max_seq_len cap
+        long_prompt = paddle.to_tensor(np.zeros(
+            (1, cfg.max_seq_len - 2), np.int64))
+        capped = model.generate(long_prompt, max_new_tokens=50).numpy()
+        assert capped.shape[1] == cfg.max_seq_len
+
+    def test_generate_edge_cases(self):
+        paddle.seed(22)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        prompt = paddle.to_tensor(np.zeros((1, 4), np.int64))
+        # zero budget → prompt unchanged
+        assert model.generate(prompt, max_new_tokens=0).shape == [1, 4]
+        # prompt at the cap → nothing to generate
+        full = paddle.to_tensor(np.zeros((1, cfg.max_seq_len), np.int64))
+        assert model.generate(full, max_new_tokens=5).shape == \
+            [1, cfg.max_seq_len]
+        # over-long prompt raises instead of silently clamping
+        import pytest as _pytest
+
+        over = paddle.to_tensor(np.zeros((1, cfg.max_seq_len + 1),
+                                         np.int64))
+        with _pytest.raises(ValueError, match="max_seq_len"):
+            model.generate(over)
+        # top_k > vocab clamps instead of crashing
+        out = model.generate(prompt, max_new_tokens=3, do_sample=True,
+                             top_k=10 ** 6)
+        assert out.shape == [1, 7]
+
+    def test_generate_reuses_compiled_step(self):
+        paddle.seed(23)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        prompt = paddle.to_tensor(np.zeros((1, 4), np.int64))
+        model.generate(prompt, max_new_tokens=4)
+        step_static = type(model).__dict__["_decode_step_static"]
+        n_after_first = len(step_static._cache)
+        model.generate(prompt, max_new_tokens=8)  # same 128 bucket
+        assert len(step_static._cache) == n_after_first, \
+            "second generate() re-traced despite identical shapes"
