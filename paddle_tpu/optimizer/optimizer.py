@@ -23,6 +23,16 @@ __all__ = [
 ]
 
 
+def _acc_zeros(p):
+    """Accumulator buffer for one param. Low-precision (bf16/fp16) params
+    get FLOAT32 accumulators — the mixed-precision recipe: (1-beta2)*g^2
+    underflows in bf16 and small updates round away; params stay in
+    their own dtype (the update math promotes to f32 and casts back)."""
+    v = p._value
+    dt = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
+    return jnp.zeros(v.shape, dt)
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -269,7 +279,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _init_state(self, p):
-        return {"velocity": jnp.zeros_like(p._value)}
+        return {"velocity": _acc_zeros(p)}
 
     def _update(self, pv, gv, state, lr, wd=0.0, param=None):
         v = self._momentum * state["velocity"] + gv
@@ -292,8 +302,8 @@ class Adam(Optimizer):
 
     def _init_state(self, p):
         return {
-            "moment1": jnp.zeros_like(p._value),
-            "moment2": jnp.zeros_like(p._value),
+            "moment1": _acc_zeros(p),
+            "moment2": _acc_zeros(p),
             "beta1_pow": jnp.ones([], jnp.float32),
             "beta2_pow": jnp.ones([], jnp.float32),
         }
@@ -343,8 +353,8 @@ class Adamax(Optimizer):
 
     def _init_state(self, p):
         return {
-            "moment": jnp.zeros_like(p._value),
-            "inf_norm": jnp.zeros_like(p._value),
+            "moment": _acc_zeros(p),
+            "inf_norm": _acc_zeros(p),
             "beta1_pow": jnp.ones([], jnp.float32),
         }
 
@@ -383,8 +393,8 @@ class Adadelta(Optimizer):
 
     def _init_state(self, p):
         return {
-            "avg_squared_grad": jnp.zeros_like(p._value),
-            "avg_squared_update": jnp.zeros_like(p._value),
+            "avg_squared_grad": _acc_zeros(p),
+            "avg_squared_update": _acc_zeros(p),
         }
 
     def _update(self, pv, gv, state, lr, wd=0.0, param=None):
@@ -407,11 +417,11 @@ class RMSProp(Optimizer):
 
     def _init_state(self, p):
         s = {
-            "mean_square": jnp.zeros_like(p._value),
-            "momentum": jnp.zeros_like(p._value),
+            "mean_square": _acc_zeros(p),
+            "momentum": _acc_zeros(p),
         }
         if self._centered:
-            s["mean_grad"] = jnp.zeros_like(p._value)
+            s["mean_grad"] = _acc_zeros(p)
         return s
 
     def _update(self, pv, gv, state, lr, wd=0.0, param=None):
@@ -440,8 +450,8 @@ class Lamb(Optimizer):
 
     def _init_state(self, p):
         return {
-            "moment1": jnp.zeros_like(p._value),
-            "moment2": jnp.zeros_like(p._value),
+            "moment1": _acc_zeros(p),
+            "moment2": _acc_zeros(p),
             "beta1_pow": jnp.ones([], jnp.float32),
             "beta2_pow": jnp.ones([], jnp.float32),
         }

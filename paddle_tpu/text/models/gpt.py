@@ -161,7 +161,9 @@ def _cached_attention(q, k_new, v_new, cache_k, cache_v, index):
         allowed = (jnp.arange(L)[None, :]
                    <= (idx + jnp.arange(s_new))[:, None])
         sc = jnp.where(allowed[None, None], sc, jnp.float32(-1e30))
-        w = jax.nn.softmax(sc, axis=-1)
+        # softmax statistics in f32 even for bf16 caches
+        w = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(
+            vt.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", w, vt).astype(qv.dtype)
         return jnp.swapaxes(out, 1, 2), ck, cv
 
@@ -203,10 +205,7 @@ class GPTGenerationMixin:
             x, nc = _layer_forward_cached(layer, x, cache, index)
             new_caches.append(nc)
         x = model.ln_f(x)
-        if self.lm_head is not None:
-            return self.lm_head(x), new_caches
-        w = self.gpt.wte.weight
-        return F.linear(x, manip.transpose(w, [1, 0])), new_caches
+        return self._logits_from_hidden(x, shard=False), new_caches
 
     def _decode_step_impl(self, tok, idx, *kv):
         L = self.config.num_layers
@@ -218,17 +217,19 @@ class GPTGenerationMixin:
         return (logits, *flat)
 
     def _make_step(self):
-        """ONE to_static-wrapped step per CLASS (bound per instance):
-        the trace cache persists across generate() calls, and because it
-        is invoked as a bound Layer method the weights are threaded as
-        jit ARGUMENTS, not baked into each executable as constants."""
-        cls = type(self)
-        if "_decode_step_static" not in cls.__dict__:
+        """ONE to_static-wrapped step per INSTANCE: the trace cache
+        persists across generate() calls but dies with the model (a
+        class-level cache would pin every instance's weights forever —
+        the traced closures capture them). Invoked as a bound Layer
+        method, so weights are threaded as jit ARGUMENTS, not baked
+        into each executable as constants."""
+        if "_decode_step_static" not in self.__dict__:
             from ... import jit as jit_mod
 
-            cls._decode_step_static = jit_mod.to_static(
-                cls._decode_step_impl)
-        return cls.__dict__["_decode_step_static"].__get__(self, cls)
+            self.__dict__["_decode_step_static"] = jit_mod.to_static(
+                type(self)._decode_step_impl)
+        return self.__dict__["_decode_step_static"].__get__(
+            self, type(self))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, do_sample=False):
@@ -270,13 +271,16 @@ class GPTGenerationMixin:
             return jax.random.categorical(rng_mod.next_key(), lv, axis=-1)
 
         with no_grad():
+            # cache in the model's compute dtype: decode is HBM-bound,
+            # an fp32 cache for a bf16 model doubles the traffic
+            cache_dt = self.gpt.wte.weight._value.dtype
             flat_kv = []
             for _ in range(cfg.num_layers):
                 flat_kv += [
                     to_tensor(jnp.zeros((b, cache_len, nh, hd),
-                                        jnp.float32)),
+                                        cache_dt)),
                     to_tensor(jnp.zeros((b, cache_len, nh, hd),
-                                        jnp.float32))]
+                                        cache_dt))]
             step = self._make_step()
             idx0 = to_tensor(jnp.asarray(0, jnp.int32))
             logits, *flat_kv = step(input_ids, idx0, *flat_kv)
@@ -308,13 +312,19 @@ class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
                 config.hidden_size, config.vocab_size, has_bias=False,
                 gather_output=False)
 
-    def forward(self, input_ids):
-        x = self.gpt(input_ids)
+    def _logits_from_hidden(self, x, shard=True):
+        """ONE head projection shared by training forward and cached
+        decode (shard hints only matter on a mesh)."""
         if self.lm_head is not None:
             return self.lm_head(x)
         w = self.gpt.wte.weight  # [vocab, d], mp-sharded on vocab
         logits = F.linear(x, manip.transpose(w, [1, 0]))
-        return shard_activation(logits, "dp", "sp", "mp")
+        if shard:
+            logits = shard_activation(logits, "dp", "sp", "mp")
+        return logits
+
+    def forward(self, input_ids):
+        return self._logits_from_hidden(self.gpt(input_ids))
 
 
 class GPTPretrainingCriterion(nn.Layer):

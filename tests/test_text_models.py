@@ -282,8 +282,11 @@ class TestGeneration:
         model.eval()
         prompt = paddle.to_tensor(np.zeros((1, 4), np.int64))
         model.generate(prompt, max_new_tokens=4)
-        step_static = type(model).__dict__["_decode_step_static"]
+        step_static = model.__dict__["_decode_step_static"]
         n_after_first = len(step_static._cache)
         model.generate(prompt, max_new_tokens=8)  # same 128 bucket
         assert len(step_static._cache) == n_after_first, \
             "second generate() re-traced despite identical shapes"
+        # the compiled step is instance-owned: a dropped model must not
+        # stay pinned by a class-level cache
+        assert "_decode_step_static" not in type(model).__dict__
