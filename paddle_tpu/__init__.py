@@ -81,6 +81,8 @@ for _sub in (
     "sparse",
     "quantization",
     "geometric",
+    "fft",
+    "signal",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

@@ -23,14 +23,26 @@ __all__ = [
 ]
 
 
+def _acc_dtype(v):
+    return jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) \
+        else v.dtype
+
+
 def _acc_zeros(p):
     """Accumulator buffer for one param. Low-precision (bf16/fp16) params
     get FLOAT32 accumulators — the mixed-precision recipe: (1-beta2)*g^2
     underflows in bf16 and small updates round away; params stay in
     their own dtype (the update math promotes to f32 and casts back)."""
     v = p._value
-    dt = jnp.float32 if v.dtype in (jnp.bfloat16, jnp.float16) else v.dtype
-    return jnp.zeros(v.shape, dt)
+    return jnp.zeros(v.shape, _acc_dtype(v))
+
+
+def _upcast_grad(pv, gv):
+    """Gradients of low-precision params are upcast BEFORE the moment
+    math: g*g and (1-beta)*g must be computed in the accumulator dtype,
+    not quantized/underflowed in bf16 first."""
+    dt = _acc_dtype(pv)
+    return gv if gv.dtype == dt else gv.astype(dt)
 
 
 class Optimizer:
@@ -136,7 +148,7 @@ class Optimizer:
                     else:
                         gv = g._value
                     new_p, new_state = self._update(
-                        p._value, gv, state, plr,
+                        p._value, _upcast_grad(p._value, gv), state, plr,
                         wd=wd if self._decoupled_wd() else 0.0, param=p)
                     p._value = new_p.astype(p._value.dtype)
                     self._states[p.name] = new_state
@@ -217,6 +229,7 @@ class Optimizer:
             plr = (lr if glr is None else glr) * lr_scale
             if wd and not self._decoupled_wd():
                 gv = gv + wd * pv
+            gv = _upcast_grad(pv, gv)
             np_, ns_ = self._update(pv, gv, sv, plr,
                                     wd=wd if self._decoupled_wd() else 0.0)
             new_p.append(np_.astype(pv.dtype))
@@ -376,7 +389,8 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _init_state(self, p):
-        return {"moment": jnp.full_like(p._value, self._init_acc)}
+        return {"moment": jnp.full(p._value.shape, self._init_acc,
+                                   _acc_dtype(p._value))}
 
     def _update(self, pv, gv, state, lr, wd=0.0, param=None):
         m = state["moment"] + gv * gv

@@ -1,0 +1,147 @@
+"""paddle_tpu.signal — stft / istft / frame / overlap_add
+(reference: python/paddle/signal.py — frame:33, overlap_add:131,
+stft:243, istft:401).
+
+Framing is a static gather, the FFT is jnp.fft — both jit-safe; istft
+reconstructs by overlap-add with the standard squared-window
+normalization (COLA)."""
+import jax.numpy as jnp
+
+from .ops._helpers import apply_jfn, ensure_tensor, value_of
+from .tensor_core import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slide windows of `frame_length` every `hop_length`
+    (reference signal.py:33). axis=-1: data on the last axis, output
+    [..., frame_length, num_frames]; axis=0: data on the first axis,
+    output [num_frames, frame_length, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("frame supports axis -1 or 0 (reference API)")
+
+    def jfn(v):
+        vm = v if axis == -1 else jnp.moveaxis(v, 0, -1)
+        n = 1 + (vm.shape[-1] - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+        out = vm[..., idx]  # [..., frame_length, n]
+        if axis == 0:
+            # → [n, frame_length, ...]
+            out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+        return out
+
+    return apply_jfn("frame", jfn, ensure_tensor(x))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py:131). axis=-1 input
+    [..., frame_length, num_frames]; axis=0 input
+    [num_frames, frame_length, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add supports axis -1 or 0")
+
+    def jfn(v):
+        if axis == 0:  # → [..., frame_length, n]
+            v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+        fl, n = v.shape[-2], v.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+        # one scatter-add: duplicate flat indices accumulate
+        idx2d = (jnp.arange(n)[None, :] * hop_length
+                 + jnp.arange(fl)[:, None])  # [fl, n]
+        out = out.at[..., idx2d].add(v)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply_jfn("overlap_add", jfn, ensure_tensor(x))
+
+
+def _window_of(window, win_length, n_fft, dtype=jnp.float32):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = jnp.asarray(value_of(ensure_tensor(window)), dtype)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """[B, T] (or [T]) → complex [B, n_bins, n_frames]
+    (reference signal.py:243)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_of(window, win_length, n_fft)
+
+    def jfn(v):
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n = 1 + (v.shape[-1] - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[:, idx] * w  # [B, n_frames, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)  # [B, bins, frames]
+        return spec[0] if squeeze else spec
+
+    return apply_jfn("stft", jfn, ensure_tensor(x))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """complex [B, n_bins, n_frames] → [B, T]
+    (reference signal.py:401; COLA squared-window normalization)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_of(window, win_length, n_fft)
+
+    def jfn(v):
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.swapaxes(v, -1, -2)  # [B, frames, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        n = frames.shape[1]
+        out_len = (n - 1) * hop_length + n_fft
+        sig = jnp.zeros((frames.shape[0], out_len), frames.dtype)
+        den = jnp.zeros((out_len,), jnp.float32)
+        # single scatter-add over the [n, n_fft] index grid
+        idx2 = (jnp.arange(n)[:, None] * hop_length
+                + jnp.arange(n_fft)[None, :])
+        sig = sig.at[:, idx2].add(frames)
+        den = den.at[idx2].add(jnp.broadcast_to(w * w, idx2.shape))
+        sig = sig / jnp.maximum(den, 1e-11)
+        if center:
+            sig = sig[:, n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            if sig.shape[-1] < length:  # reference pads short results
+                sig = jnp.pad(sig, ((0, 0),
+                                    (0, length - sig.shape[-1])))
+            sig = sig[:, :length]
+        return sig[0] if squeeze else sig
+
+    return apply_jfn("istft", jfn, ensure_tensor(x))

@@ -1,0 +1,73 @@
+"""paddle.fft + paddle.signal tests (reference: python/paddle/fft.py,
+signal.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+rng = np.random.default_rng(0)
+
+
+def test_fft_roundtrips_and_norms():
+    x = rng.standard_normal(16)
+    X = fft.fft(paddle.to_tensor(x))
+    np.testing.assert_allclose(X.numpy(), np.fft.fft(x), rtol=1e-6)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-6)
+    Xo = fft.fft(paddle.to_tensor(x), norm="ortho")
+    np.testing.assert_allclose(Xo.numpy(), np.fft.fft(x, norm="ortho"),
+                               rtol=1e-6)
+    r = fft.rfft(paddle.to_tensor(x))
+    assert r.shape == [9]
+    np.testing.assert_allclose(
+        fft.irfft(r, n=16).numpy(), x, atol=1e-6)
+    m = rng.standard_normal((4, 8))
+    np.testing.assert_allclose(
+        fft.fft2(paddle.to_tensor(m)).numpy(), np.fft.fft2(m), rtol=1e-6)
+    np.testing.assert_allclose(
+        fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+    np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5))
+
+
+def test_fft_gradients():
+    x = paddle.to_tensor(rng.standard_normal(8), stop_gradient=False)
+    y = fft.rfft(x)
+    (y.abs() ** 2).sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_frame_overlap_add_inverse():
+    x = rng.standard_normal(32).astype(np.float32)
+    fr = signal.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)
+    assert fr.shape == [8, 4]
+    back = signal.overlap_add(fr, hop_length=8)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+def test_stft_istft_reconstruction():
+    from paddle_tpu.audio.functional import get_window
+
+    sr = 1024
+    t = np.arange(2048) / sr
+    x = (np.sin(2 * np.pi * 60 * t)
+         + 0.5 * np.sin(2 * np.pi * 120 * t)).astype(np.float32)
+    n_fft, hop = 256, 64
+    w = paddle.to_tensor(np.asarray(get_window("hann", n_fft)))
+    spec = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                       window=w)
+    assert spec.shape[0] == n_fft // 2 + 1
+    back = signal.istft(spec, n_fft, hop_length=hop, window=w,
+                        length=len(x))
+    # COLA reconstruction (edges excluded)
+    np.testing.assert_allclose(back.numpy()[n_fft:-n_fft],
+                               x[n_fft:-n_fft], atol=1e-4)
+
+
+def test_stft_batched_matches_numpy_frames():
+    x = rng.standard_normal((2, 512)).astype(np.float32)
+    spec = signal.stft(paddle.to_tensor(x), 128, hop_length=128,
+                       center=False).numpy()
+    # frame 0 of batch 1 == rfft of its first 128 samples (boxcar)
+    np.testing.assert_allclose(spec[1, :, 0], np.fft.rfft(x[1, :128]),
+                               rtol=1e-4, atol=1e-4)

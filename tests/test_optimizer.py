@@ -268,3 +268,36 @@ class TestReviewRegressions:
         opt.step()
         np.testing.assert_allclose(a.weight.numpy(), wa, atol=1e-7)
         assert not np.allclose(b.weight.numpy(), wb)
+
+
+def test_bf16_params_get_fp32_accumulators():
+    # moments of a bf16 param are held AND computed in fp32: after many
+    # steps they match an fp32-param run to fp32 precision (bf16 moments
+    # would carry ~0.4% quantization per step)
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.tensor_core import Parameter
+
+    rng = np.random.default_rng(0)
+    grads = [rng.standard_normal(8).astype(np.float32) * 0.1
+             for _ in range(10)]
+
+    def run(dtype):
+        p = Parameter(jnp.ones((8,), dtype))
+        opt = paddle.optimizer.Adam(1e-3, parameters=[p])
+        for g in grads:
+            p.grad = paddle.to_tensor(jnp.asarray(g, dtype))
+            opt.step()
+        return p, opt._states[p.name]
+
+    p16, s16 = run(jnp.bfloat16)
+    _, s32 = run(jnp.float32)
+    assert s16["moment2"].dtype == jnp.float32
+    assert p16._value.dtype == jnp.bfloat16  # param dtype preserved
+    # grads themselves were bf16-quantized (~0.4%), so allow that; bf16
+    # MOMENT STORAGE would compound to far larger drift
+    np.testing.assert_allclose(np.asarray(s16["moment2"]),
+                               np.asarray(s32["moment2"]), rtol=2e-2)
+    rel = np.abs(np.asarray(s16["moment2"]) - np.asarray(s32["moment2"]))
+    assert (rel / (np.abs(np.asarray(s32["moment2"])) + 1e-12)).max() < 0.02
