@@ -83,6 +83,7 @@ for _sub in (
     "geometric",
     "fft",
     "signal",
+    "utils",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

@@ -403,13 +403,12 @@ def _addindent(s, n):
     return lines[0] + "\n" + "\n".join(" " * n + l for l in lines[1:])
 
 
-_name_counts = collections.defaultdict(int)
-
-
 def _unique_name(base):
-    c = _name_counts[base]
-    _name_counts[base] += 1
-    return f"{base}_{c}"
+    # ONE counter owns naming: utils.unique_name.guard()/switch() must
+    # scope layer names too (reference fluid/unique_name.py)
+    from ...utils import unique_name as _un
+
+    return _un.generate(base)
 
 
 def _camel_to_snake(name):

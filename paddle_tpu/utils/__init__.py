@@ -1,0 +1,61 @@
+"""paddle_tpu.utils (reference: python/paddle/utils/ — cpp_extension,
+dlpack, unique_name, deprecated, install_check)."""
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["cpp_extension", "dlpack", "unique_name", "deprecated",
+           "run_check", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator (reference utils/deprecated.py:36)."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+                   f"since {since}" + (f", use '{update_to}' instead"
+                                       if update_to else "")
+                   + (f". Reason: {reason}" if reason else ""))
+            if level >= 2:  # reference: 0/1 warn, 2 raises
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning)
+            return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """Smoke-check the install (reference utils/install_check.py:137):
+    a tiny train step on the default device."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    dev = paddle.device.get_device()
+    print(f"paddle_tpu is installed successfully! device: {dev}")
+
+
+def try_import(module_name, err_msg=None):
+    """reference utils/lazy_import.py."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or str(e)) from e

@@ -1,0 +1,126 @@
+"""Custom C++ op loading (reference: python/paddle/utils/cpp_extension/
+cpp_extension.py — setup:51, CppExtension:100, load:739; the C++ side
+registers via PD_BUILD_OP custom_operator.cc).
+
+The reference JIT-builds a pybind module that registers ops into its
+C++ registry. Here a custom op is a C ABI shared library: `load()`
+compiles the sources with the system toolchain into a cached .so and
+returns a ctypes CDLL; `register_op_from_library` wraps an exported
+symbol as a framework op (host computation via jax.pure_callback, so it
+composes with jit — the TPU analog of a custom CPU kernel)."""
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["CppExtension", "CUDAExtension", "setup", "load",
+           "get_build_directory", "register_op_from_library"]
+
+
+def get_build_directory(verbose=False):
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Source bundle descriptor (reference cpp_extension.py:100)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension has no TPU analog — device kernels are Pallas "
+        "(see ops/pallas_kernels); host-side custom ops use CppExtension")
+
+
+def setup(**attr):
+    """Eager build entry (reference setup:51): builds every extension
+    immediately and returns the library paths."""
+    name = attr.get("name", "custom_ops")
+    exts = attr.get("ext_modules", [])
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    return [load(f"{name}_{i}", ext.sources,
+                 extra_cxx_cflags=ext.extra_compile_args)
+            for i, ext in enumerate(exts)]
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """JIT-compile `sources` into a cached shared library and return the
+    ctypes CDLL (reference load:739). Cache key = source contents +
+    flags, so edits rebuild and repeats are instant."""
+    build_dir = build_directory or get_build_directory()
+    flags = list(extra_cxx_cflags or [])
+    h = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    so_path = os.path.join(build_dir, f"{name}_{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        # per-process temp name: concurrent builders race on a shared
+        # cache dir; os.replace makes whoever finishes last win atomically
+        tmp = f"{so_path}.tmp{os.getpid()}"
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + flags + ["-o", tmp] + list(sources))
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"building custom op {name!r} failed:\n{e.stderr}") from e
+        os.replace(tmp, so_path)
+    return ctypes.CDLL(so_path)
+
+
+def register_op_from_library(lib, symbol, op_name, out_like=0,
+                             n_inputs=1):
+    """Wrap an exported C function as a framework op.
+
+    The C ABI contract: void symbol(const float** ins, const long* sizes,
+    int n_ins, float* out). The op runs on the HOST via jax.pure_callback
+    (jit-safe; the reference's custom CPU kernels have the same
+    placement), output shaped like input `out_like`."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops._helpers import apply_jfn, register_op
+
+    cfn = getattr(lib, symbol)
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.POINTER(ctypes.c_long), ctypes.c_int,
+                    ctypes.c_void_p]
+
+    def host_impl(*arrs):
+        arrs = [np.ascontiguousarray(a, np.float32) for a in arrs]
+        out = np.empty_like(arrs[out_like])
+        ptrs = (ctypes.c_void_p * len(arrs))(
+            *[a.ctypes.data for a in arrs])
+        sizes = (ctypes.c_long * len(arrs))(*[a.size for a in arrs])
+        cfn(ptrs, sizes, len(arrs), out.ctypes.data)
+        return out
+
+    def op(*tensors):
+        from ..ops._helpers import ensure_tensor, value_of
+
+        ts = [ensure_tensor(t) for t in tensors[:n_inputs]]
+        like = value_of(ts[out_like])
+        shape_dtype = jax.ShapeDtypeStruct(like.shape, jnp.float32)
+
+        def jfn(*vals):
+            return jax.pure_callback(host_impl, shape_dtype, *vals)
+
+        return apply_jfn(op_name, jfn, *ts)
+
+    op.__name__ = op_name
+    register_op(op_name, op)
+    return op

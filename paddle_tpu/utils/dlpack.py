@@ -1,0 +1,47 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py
+to_dlpack:24 / from_dlpack:56; C++ framework/dlpack_tensor.cc)."""
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor → DLPack capsule (consumable by torch.from_dlpack etc.;
+    zero-copy where the backend allows)."""
+    return value_of(ensure_tensor(x)).__dlpack__()
+
+
+class _CapsuleHolder:
+    """Adapter: modern consumers (jax/numpy) want the __dlpack__
+    PROTOCOL, the reference API traffics in raw capsules. One-shot."""
+
+    def __init__(self, capsule, device):
+        self._capsule = capsule
+        self._device = device
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return self._device
+
+
+def from_dlpack(obj):
+    """DLPack-protocol object (torch/numpy/jax arrays) OR a raw capsule
+    → Tensor. A capsule carries no device info, so the capsule path is
+    host-memory only — pass the source ARRAY (protocol object) for
+    device-resident data."""
+    if hasattr(obj, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(obj), stop_gradient=True)
+    if jax.default_backend() != "cpu":
+        raise ValueError(
+            "raw DLPack capsules are imported as host (CPU) memory, but "
+            "the default backend is "
+            f"{jax.default_backend()!r} — pass the source array object "
+            "(which carries __dlpack_device__) instead of a capsule")
+    return Tensor(jnp.from_dlpack(_CapsuleHolder(obj, (1, 0))),
+                  stop_gradient=True)

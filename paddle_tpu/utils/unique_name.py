@@ -1,0 +1,43 @@
+"""Unique name generation (reference: python/paddle/utils/unique_name.py
+→ fluid/unique_name.py generate:22, guard:72, switch:45)."""
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class _Generator:
+    def __init__(self):
+        self._ids = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key):
+        with self._lock:
+            n = self._ids.get(key, 0)
+            self._ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the generator, returning the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _generator
+        _generator = old
