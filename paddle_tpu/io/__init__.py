@@ -20,6 +20,7 @@ __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
     "ComposeDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "SubsetRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader",
     "default_collate_fn", "get_worker_info",
 ]
@@ -176,6 +177,23 @@ class WeightedRandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Shuffled draw from a fixed index subset (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        order = _np_rng(self.generator).permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class BatchSampler(Sampler):

@@ -12,7 +12,14 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "flowers_synth"]
+from .flowers_voc import VOC2012, Flowers  # noqa: E402,F401
+from .folder import (  # noqa: E402,F401
+    DatasetFolder,
+    ImageFolder,
+)
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "flowers_synth",
+           "Flowers", "VOC2012", "DatasetFolder", "ImageFolder"]
 
 
 def _synthetic_images(n, shape, num_classes, seed):

@@ -1,0 +1,141 @@
+"""Folder/Flowers/VOC2012 datasets + SubsetRandomSampler (reference:
+python/paddle/vision/datasets/folder.py, flowers.py, voc2012.py;
+io/sampler.py)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers,
+                                        ImageFolder, VOC2012)
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _write_img(path, value, size=(8, 8)):
+    from PIL import Image
+
+    arr = np.full(size + (3,), value, np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+def test_dataset_folder(tmp_path):
+    for cls, val in (("cat", 10), ("dog", 200)):
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            _write_img(str(tmp_path / cls / f"{i}.png"), val)
+        (tmp_path / cls / "notes.txt").write_text("skip me")
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    img5, label5 = ds[5]
+    assert label5 == 1 and img5[0, 0, 0] == 200
+    # transform applied
+    ds_t = DatasetFolder(str(tmp_path),
+                         transform=lambda a: a.astype(np.float32) / 255)
+    assert ds_t[0][0].dtype == np.float32
+
+
+def test_image_folder(tmp_path):
+    os.makedirs(tmp_path / "sub")
+    _write_img(str(tmp_path / "a.png"), 1)
+    _write_img(str(tmp_path / "sub" / "b.png"), 2)
+    ds = ImageFolder(str(tmp_path))
+    assert len(ds) == 2
+    (sample,) = ds[0]
+    assert sample.shape == (8, 8, 3)
+    with pytest.raises(RuntimeError, match="no valid files"):
+        empty = tmp_path / "empty"
+        os.makedirs(empty)
+        ImageFolder(str(empty))
+
+
+def test_flowers(tmp_path):
+    import scipy.io
+
+    n = 6
+    with tarfile.open(tmp_path / "102flowers.tgz", "w:gz") as tf:
+        for i in range(1, n + 1):
+            data = _jpg_bytes(np.full((10, 10, 3), i * 20, np.uint8))
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    scipy.io.savemat(tmp_path / "imagelabels.mat",
+                     {"labels": np.arange(1, n + 1)[None, :]})
+    scipy.io.savemat(tmp_path / "setid.mat",
+                     {"trnid": np.array([[1, 2, 3, 4]]),
+                      "valid": np.array([[5]]),
+                      "tstid": np.array([[6]])})
+    tr = Flowers(data_file=str(tmp_path / "102flowers.tgz"),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(tr) == 4
+    img, label = tr[0]
+    assert img.shape == (10, 10, 3) and 0 <= int(label) < n
+    te = Flowers(data_file=str(tmp_path / "102flowers.tgz"),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="test")
+    assert len(te) == 1 and int(te[0][1]) == 5  # image 6 → label 5
+
+
+def test_voc2012(tmp_path):
+    names = ["2007_000001", "2007_000002"]
+    with tarfile.open(tmp_path / "voc.tar", "w") as tf:
+        lst = ("\n".join(names) + "\n").encode()
+        info = tarfile.TarInfo(
+            "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt")
+        info.size = len(lst)
+        tf.addfile(info, io.BytesIO(lst))
+        for k, nme in enumerate(names):
+            jpg = _jpg_bytes(np.full((6, 6, 3), 50 * (k + 1), np.uint8))
+            i1 = tarfile.TarInfo(f"VOCdevkit/VOC2012/JPEGImages/{nme}.jpg")
+            i1.size = len(jpg)
+            tf.addfile(i1, io.BytesIO(jpg))
+            png = _png_bytes(np.full((6, 6), k, np.uint8))
+            i2 = tarfile.TarInfo(
+                f"VOCdevkit/VOC2012/SegmentationClass/{nme}.png")
+            i2.size = len(png)
+            tf.addfile(i2, io.BytesIO(png))
+    ds = VOC2012(data_file=str(tmp_path / "voc.tar"), mode="train")
+    assert len(ds) == 2
+    img, mask = ds[1]
+    assert img.shape == (6, 6, 3) and mask.shape == (6, 6)
+    assert (mask == 1).all()
+
+
+def test_subset_random_sampler():
+    s = paddle.io.SubsetRandomSampler([3, 7, 11])
+    drawn = list(s)
+    assert sorted(drawn) == [3, 7, 11] and len(s) == 3
+    # composes with BatchSampler → DataLoader
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    bs = paddle.io.BatchSampler(sampler=paddle.io.SubsetRandomSampler(
+        range(0, 16, 2)), batch_size=4)
+    batches = list(paddle.io.DataLoader(DS(), batch_sampler=bs))
+    vals = np.concatenate([b.numpy() for b in batches])
+    assert sorted(vals.tolist()) == list(range(0, 16, 2))
