@@ -14,13 +14,14 @@ def _make_divisible(v, divisor=8, min_value=None):
 
 
 class ConvBNReLU(nn.Sequential):
-    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1,
+                 activation=nn.ReLU6):
         super().__init__(
             nn.Conv2D(in_c, out_c, kernel, stride,
                       padding=(kernel - 1) // 2, groups=groups,
                       bias_attr=False),
             nn.BatchNorm2D(out_c),
-            nn.ReLU6(),
+            activation(),
         )
 
 

@@ -2,7 +2,9 @@
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152"]
+           "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
 
 class BasicBlock(nn.Layer):
@@ -146,3 +148,49 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 32)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 50, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 64)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 50, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 32)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 101, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 64)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 101, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 32)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs.setdefault("groups", 64)
+    kwargs.setdefault("width", 4)
+    return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    kwargs.setdefault("width", 128)
+    return _resnet(BottleneckBlock, 50, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs.setdefault("width", 128)
+    return _resnet(BottleneckBlock, 101, **kwargs)
