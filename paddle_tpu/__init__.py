@@ -30,11 +30,23 @@ from .core.place import (  # noqa: F401
     CPUPlace,
     CUDAPinnedPlace,
     CUDAPlace,
+    CustomPlace,
+    IPUPlace,
+    MLUPlace,
+    NPUPlace,
     TPUPlace,
+    XPUPlace,
     device_count,
+    get_cudnn_version,
     get_device,
+    is_compiled_with_cinn,
     is_compiled_with_cuda,
+    is_compiled_with_ipu,
+    is_compiled_with_mlu,
+    is_compiled_with_npu,
+    is_compiled_with_rocm,
     is_compiled_with_tpu,
+    is_compiled_with_xpu,
     set_device,
 )
 from .core.flags import get_flags, set_flags  # noqa: F401
@@ -96,6 +108,43 @@ if "nn" in globals():
     ParamAttr = globals()["nn"].ParamAttr
 if "hapi" in globals() and hasattr(globals()["hapi"], "model"):
     from .hapi.model import Model  # noqa: F401
+    from .hapi import callbacks  # noqa: F401
+    from .hapi.dynamic_flops import flops  # noqa: F401
+if "distributed" in globals():
+    DataParallel = globals()["distributed"].DataParallel
+from . import hub  # noqa: F401
+
+# paddle.dtype: the concrete dtype class (jnp dtypes are numpy dtypes), so
+# `isinstance(x.dtype, paddle.dtype)` works as in the reference.
+dtype = type(float32)
+
+
+class LazyGuard:
+    """Parameter-init laziness guard (reference: fluid/lazy_init.py).
+    Host-side init on jax is cheap and functional; the guard is a no-op
+    context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader batching decorator (reference: python/paddle/batch.py)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
 
 # paddle.disable_static / enable_static are no-ops: eager IS the default and
 # static capture happens through paddle_tpu.jit.to_static (jax.jit).

@@ -64,6 +64,34 @@ class CUDAPinnedPlace(CPUPlace):
     pass
 
 
+class XPUPlace(TPUPlace):
+    """Accepted for API compatibility; maps to the default accelerator."""
+
+
+class NPUPlace(TPUPlace):
+    """Accepted for API compatibility; maps to the default accelerator."""
+
+
+class MLUPlace(TPUPlace):
+    """Accepted for API compatibility; maps to the default accelerator."""
+
+
+class IPUPlace(TPUPlace):
+    """Accepted for API compatibility; maps to the default accelerator."""
+
+
+class CustomPlace(Place):
+    """Custom-device place (reference: phi::CustomPlace). Accepts a device
+    type string; any PJRT-visible platform matches, else default backend."""
+
+    def __init__(self, device_type, device_id=0):
+        super().__init__(device_id)
+        self.kind = str(device_type)
+
+    def _platform(self):
+        return self.kind
+
+
 _current_place = None
 
 
@@ -116,6 +144,34 @@ def is_compiled_with_cuda():
 
 def is_compiled_with_tpu():
     return True
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def get_cudnn_version():
+    return None
 
 
 def device_count():

@@ -1,0 +1,68 @@
+"""paddle.hub — load models from a hubconf.py entrypoint file.
+
+Reference: python/paddle/hapi/hub.py (github/gitee/local sources). This
+environment has no network egress, so only `source='local'` is supported;
+remote sources raise with a clear message rather than hanging.
+"""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules.pop("paddle_tpu_hubconf", None)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise ValueError(
+            f"hub source {source!r} unavailable: this build has no network "
+            "egress; use source='local' with a checked-out repo directory")
+
+
+def _entrypoints(module):
+    deps = getattr(module, "dependencies", [])
+    for dep in deps:
+        if importlib.util.find_spec(dep) is None:
+            raise RuntimeError(f"hubconf dependency {dep!r} not installed")
+    return {
+        name: fn for name, fn in vars(module).items()
+        if callable(fn) and not name.startswith("_")
+    }
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    return sorted(_entrypoints(_load_hubconf(repo_dir)))
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise ValueError(f"unknown hub entrypoint {model!r}; "
+                         f"available: {sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call the entrypoint, returning the constructed model."""
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise ValueError(f"unknown hub entrypoint {model!r}; "
+                         f"available: {sorted(eps)}")
+    return eps[model](**kwargs)

@@ -63,6 +63,7 @@ from .manipulation import (  # noqa: F401
 from .math import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .api_misc import *  # noqa: F401,F403
 
 
 def _install_tensor_methods():
@@ -121,7 +122,8 @@ def _install_tensor_methods():
 
     for nm in ("add", "subtract", "multiply", "scale", "clip", "floor",
                "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round",
-               "tanh", "squeeze", "unsqueeze", "flatten"):
+               "tanh", "squeeze", "unsqueeze", "flatten", "scatter",
+               "remainder", "index_add"):
         setattr(Tensor, nm + "_", _inplace(nm))
 
     # operator overloads

@@ -1,0 +1,318 @@
+"""Top-level API parity gap-closers.
+
+Small ops and utility symbols the reference exports from `paddle.*`
+(reference: python/paddle/__init__.py; op sources
+python/paddle/tensor/{math,manipulation,creation,search}.py,
+python/paddle/framework/dtype.py iinfo, fluid/framework.py create_parameter).
+Each funnels through the autograd tape where a gradient makes sense.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core import dtype as dtype_mod
+from ..tensor_core import Parameter, Tensor
+from ._helpers import apply_jfn, defop, ensure_tensor, value_of
+
+__all__ = [
+    "add_n", "logit", "multiplex", "complex", "crop", "shard_index",
+    "tril_indices", "triu_indices", "randint_like", "reverse",
+    "broadcast_shape", "is_tensor", "is_complex", "is_floating_point",
+    "is_integer", "is_empty", "rank", "shape", "tolist", "iinfo",
+    "set_printoptions", "create_parameter", "set_grad_enabled",
+    "disable_signal_handler", "get_cuda_rng_state", "set_cuda_rng_state",
+    "squeeze_", "unsqueeze_", "tanh_", "scatter_", "remainder_",
+    "index_add_", "check_shape",
+]
+
+
+@defop("add_n")
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of same-shaped tensors."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = tuple(ensure_tensor(t) for t in inputs)
+    return engine.apply("add_n", lambda *vs: sum(vs[1:], vs[0]), ts)
+
+
+@defop("logit")
+def logit(x, eps=None, name=None):
+    def jfn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v) - jnp.log1p(-v)
+
+    return apply_jfn("logit", jfn, x)
+
+
+@defop("multiplex")
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i]
+    (reference: python/paddle/tensor/math.py multiplex)."""
+    ts = tuple(ensure_tensor(t) for t in inputs)
+    idx = value_of(ensure_tensor(index)).reshape(-1)
+
+    def jfn(*vs):
+        stacked = jnp.stack(vs)  # [n_candidates, rows, ...]
+        return jnp.take_along_axis(
+            stacked,
+            idx.reshape((1, -1) + (1,) * (stacked.ndim - 2)).astype(jnp.int32),
+            axis=0,
+        )[0]
+
+    return engine.apply("multiplex", jfn, ts)
+
+
+def complex(real, imag, name=None):
+    """Build a complex tensor from real and imaginary parts."""
+    return engine.apply(
+        "complex", lambda r, i: jnp.asarray(r) + 1j * jnp.asarray(i),
+        (ensure_tensor(real), ensure_tensor(imag)))
+
+
+@defop("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop `x` to `shape` starting at `offsets` (-1 in shape = keep rest,
+    None offsets = 0s). Reference: python/paddle/tensor/creation.py crop."""
+    xt = ensure_tensor(x)
+    nd = len(xt.shape)
+    full = list(xt.shape)
+    if shape is None:
+        shape = full
+    shape = [int(value_of(ensure_tensor(s)).item()) if isinstance(s, Tensor)
+             else int(s) for s in (shape.tolist() if isinstance(shape, Tensor)
+                                   else list(shape))]
+    if offsets is None:
+        offsets = [0] * nd
+    offsets = [int(value_of(ensure_tensor(o)).item())
+               if isinstance(o, Tensor) else int(o)
+               for o in (offsets.tolist() if isinstance(offsets, Tensor)
+                         else list(offsets))]
+    shape = [full[i] - offsets[i] if shape[i] == -1 else shape[i]
+             for i in range(nd)]
+
+    def jfn(v):
+        idx = tuple(builtins_slice(offsets[i], offsets[i] + shape[i])
+                    for i in range(nd))
+        return v[idx]
+
+    return apply_jfn("crop", jfn, xt)
+
+
+builtins_slice = slice  # ops.manipulation exports a `slice` op; keep py slice
+
+
+@defop("shard_index")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recompute a global index to a shard-local index
+    (reference: python/paddle/tensor/manipulation.py shard_index)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def jfn(v):
+        in_shard = v // shard_size == shard_id
+        return jnp.where(in_shard, v % shard_size, ignore_value)
+
+    return apply_jfn("shard_index", jfn, input)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    d = dtype_mod.convert_dtype(dtype)
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), d), stop_gradient=True)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    d = dtype_mod.convert_dtype(dtype)
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), d), stop_gradient=True)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """Like randint but shaped/typed after `x`; float dtypes get integral
+    values cast to float (reference: tensor/random.py randint_like)."""
+    import jax
+
+    from ..core import rng
+
+    xt = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype) if dtype else xt._value.dtype
+    if high is None:
+        low, high = 0, low
+    ints = jax.random.randint(rng.next_key(), tuple(xt.shape), low, high,
+                              jnp.int32)
+    return Tensor(ints.astype(d), stop_gradient=True)
+
+
+def reverse(x, axis, name=None):
+    """Deprecated alias of flip (reference keeps both)."""
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ----------------------------------------------------------- predicates
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return dtype_mod.is_complex(
+        x._value.dtype if isinstance(x, Tensor) else x)
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating_point(
+        x._value.dtype if isinstance(x, Tensor) else x)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(
+        x._value.dtype if isinstance(x, Tensor) else x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(value_of(ensure_tensor(x)).size == 0),
+                  stop_gradient=True)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(value_of(ensure_tensor(input)).ndim),
+                  stop_gradient=True)
+
+
+def shape(input, name=None):
+    """Shape as a 1-D int32 tensor (reference returns a tensor, not a list)."""
+    return Tensor(
+        jnp.asarray(value_of(ensure_tensor(input)).shape, jnp.int32),
+        stop_gradient=True)
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: tensor/random.py check_shape)."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, Tensor) and int(s) < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+# ----------------------------------------------------------- utilities
+
+class iinfo:
+    """Integer dtype limits (reference: python/paddle/framework/dtype.py)."""
+
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(str(dtype_mod.convert_dtype(dtype))))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor.__repr__ prints via numpy; route the knobs there."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone Parameter factory (reference:
+    python/paddle/fluid/layers/tensor.py create_parameter)."""
+    from ..nn import ParamAttr
+    from ..nn import initializer as init_mod
+
+    attr = ParamAttr._to_attr(attr)
+    d = dtype_mod.convert_dtype(dtype or "float32")
+    initializer = attr.initializer or default_initializer
+    if initializer is None:
+        initializer = (init_mod.Constant(0.0) if is_bias
+                       else init_mod.XavierUniform())
+    value = initializer._init(tuple(int(s) for s in shape), d)
+    p = Parameter(value, trainable=attr.trainable, name=attr.name or name)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
+def set_grad_enabled(mode):
+    """Context manager enabling/disabling autograd recording."""
+    return engine.enable_grad_guard() if mode else engine.no_grad_guard()
+
+
+def disable_signal_handler():
+    """No-op: the XLA runtime installs no catchable signal handlers here."""
+
+
+def get_cuda_rng_state():
+    """Alias onto the global RNG state (no CUDA; kept for API parity)."""
+    from ..core.rng import _default_generator
+
+    return [_default_generator.get_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from ..core.rng import _default_generator
+
+    if state_list:
+        _default_generator.set_state(state_list[0])
+
+
+# ------------------------------------------------- top-level inplace ops
+
+def squeeze_(x, axis=None, name=None):
+    return ensure_tensor(x).squeeze_(axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return ensure_tensor(x).unsqueeze_(axis)
+
+
+def tanh_(x, name=None):
+    return ensure_tensor(x).tanh_()
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return ensure_tensor(x).scatter_(index, updates, overwrite)
+
+
+def remainder_(x, y, name=None):
+    return ensure_tensor(x).remainder_(y)
+
+
+def index_add_(x, index, axis, value, name=None):
+    return ensure_tensor(x).index_add_(index, axis, value)

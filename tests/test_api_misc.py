@@ -1,0 +1,195 @@
+"""Top-level API parity symbols (reference: python/paddle/__init__.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_add_n_and_grad():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    out = paddle.add_n([a, b, a])
+    np.testing.assert_allclose(out.numpy(), [5.0, 8.0])
+    out.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(b.grad.numpy(), [1.0, 1.0])
+
+
+def test_logit_roundtrip():
+    p = paddle.to_tensor([0.1, 0.5, 0.9])
+    back = paddle.nn.functional.sigmoid(paddle.logit(p))
+    np.testing.assert_allclose(back.numpy(), p.numpy(), rtol=1e-6)
+
+
+def test_multiplex():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    b = paddle.to_tensor([[5.0, 6.0], [7.0, 8.0]])
+    idx = paddle.to_tensor(np.array([[1], [0]]))
+    out = paddle.multiplex([a, b], idx)
+    np.testing.assert_allclose(out.numpy(), [[5.0, 6.0], [3.0, 4.0]])
+
+
+def test_complex_build():
+    c = paddle.complex(paddle.to_tensor([1.0]), paddle.to_tensor([2.0]))
+    assert paddle.is_complex(c)
+    np.testing.assert_allclose(c.numpy(), [1 + 2j])
+
+
+def test_crop():
+    x = paddle.to_tensor(np.arange(12.0).reshape(3, 4))
+    out = paddle.crop(x, shape=[2, -1], offsets=[1, 1])
+    np.testing.assert_allclose(out.numpy(), [[5, 6, 7], [9, 10, 11]])
+
+
+def test_shard_index():
+    x = paddle.to_tensor(np.array([1, 5, 9]))
+    out = paddle.shard_index(x, index_num=12, nshards=3, shard_id=1)
+    np.testing.assert_array_equal(out.numpy(), [-1, 1, -1])
+    with pytest.raises(ValueError):
+        paddle.shard_index(x, 12, 3, 5)
+
+
+def test_tril_triu_indices():
+    t = paddle.tril_indices(3, 3).numpy()
+    ref_r, ref_c = np.tril_indices(3)
+    np.testing.assert_array_equal(t, np.stack([ref_r, ref_c]))
+    u = paddle.triu_indices(2, 4, offset=1).numpy()
+    ref_r, ref_c = np.triu_indices(2, 1, 4)
+    np.testing.assert_array_equal(u, np.stack([ref_r, ref_c]))
+
+
+def test_predicates():
+    x = paddle.to_tensor([1.0])
+    i = paddle.to_tensor(np.array([1]))
+    assert paddle.is_tensor(x) and not paddle.is_tensor(np.array([1]))
+    assert paddle.is_floating_point(x) and not paddle.is_floating_point(i)
+    assert paddle.is_integer(i) and not paddle.is_integer(x)
+    assert not bool(paddle.is_empty(x).numpy())
+    assert int(paddle.rank(paddle.zeros([2, 3, 4])).numpy()) == 3
+    np.testing.assert_array_equal(
+        paddle.shape(paddle.zeros([2, 3])).numpy(), [2, 3])
+
+
+def test_randint_like_reverse_broadcast_shape():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    r = paddle.randint_like(x, 0, 5)
+    assert r.shape == [2, 3] and str(r.numpy().dtype) == "float32"
+    assert (r.numpy() >= 0).all() and (r.numpy() < 5).all()
+    y = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(
+        paddle.reverse(y, [0]).numpy(), [[3.0, 4.0], [1.0, 2.0]])
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_iinfo():
+    info = paddle.iinfo(paddle.int8)
+    assert (info.min, info.max, info.bits) == (-128, 127, 8)
+
+
+def test_set_grad_enabled():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.set_grad_enabled(False):
+        y = x * 2
+    assert y.stop_gradient
+    with paddle.set_grad_enabled(True):
+        z = x * 2
+    assert not z.stop_gradient
+
+
+def test_create_parameter():
+    p = paddle.create_parameter([4, 5], "float32")
+    assert isinstance(p, paddle.Parameter) and p.shape == [4, 5]
+    b = paddle.create_parameter([4], "float32", is_bias=True)
+    np.testing.assert_allclose(b.numpy(), np.zeros(4))
+
+
+def test_cuda_rng_state_roundtrip():
+    st = paddle.get_cuda_rng_state()
+    a = paddle.randn([3])
+    paddle.set_cuda_rng_state(st)
+    b = paddle.randn([3])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_top_level_inplace():
+    r = paddle.to_tensor([5.0, 7.0])
+    out = paddle.remainder_(r, paddle.to_tensor([2.0, 4.0]))
+    assert out is r
+    np.testing.assert_allclose(r.numpy(), [1.0, 3.0])
+    s = paddle.to_tensor([[1.0, 2.0]])
+    paddle.squeeze_(s, 0)
+    assert s.shape == [2]
+    t = paddle.to_tensor([0.0])
+    paddle.tanh_(t)
+    np.testing.assert_allclose(t.numpy(), [0.0])
+    x = paddle.to_tensor([[1.0, 1.0], [2.0, 2.0]])
+    paddle.scatter_(x, paddle.to_tensor(np.array([1])),
+                    paddle.to_tensor([[9.0, 9.0]]))
+    np.testing.assert_allclose(x.numpy()[1], [9.0, 9.0])
+    y = paddle.to_tensor([[1.0, 1.0], [2.0, 2.0]])
+    paddle.index_add_(y, paddle.to_tensor(np.array([0])), 0,
+                      paddle.to_tensor([[5.0, 5.0]]))
+    np.testing.assert_allclose(y.numpy()[0], [6.0, 6.0])
+
+
+def test_places_and_compiled_flags():
+    assert paddle.is_compiled_with_tpu()
+    for flag in ("cinn", "rocm", "xpu", "npu", "mlu", "ipu", "cuda"):
+        assert getattr(paddle, f"is_compiled_with_{flag}")() is False
+    paddle.XPUPlace(0), paddle.NPUPlace(0), paddle.IPUPlace(0)
+    cp = paddle.CustomPlace("fancy_npu", 0)
+    assert cp.kind == "fancy_npu"
+    assert paddle.get_cudnn_version() is None
+
+
+def test_lazy_guard_and_batch():
+    with paddle.LazyGuard():
+        layer = paddle.nn.Linear(2, 2)
+    assert layer.weight.shape == [2, 2]
+    batches = list(paddle.batch(lambda: iter(range(5)), 2)())
+    assert batches == [[0, 1], [2, 3], [4]]
+    assert list(paddle.batch(lambda: iter(range(5)), 2, drop_last=True)()) \
+        == [[0, 1], [2, 3]]
+
+
+def test_hub(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny(n=2):\n"
+        "    'tiny linear model'\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(n, n)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+    assert "tiny linear" in paddle.hub.help(str(tmp_path), "tiny")
+    m = paddle.hub.load(str(tmp_path), "tiny", n=3)
+    assert m.weight.shape == [3, 3]
+    with pytest.raises(ValueError):
+        paddle.hub.load("user/repo", "tiny", source="github")
+
+
+def test_flops():
+    from paddle_tpu.vision.models import LeNet
+
+    n = paddle.flops(LeNet(), [1, 1, 28, 28])
+    assert n > 100_000  # conv-dominated; exact value pinned by impl
+    # linear-only sanity: 10*20 MACs + 20 bias
+    lin = paddle.nn.Linear(10, 20)
+    assert paddle.flops(lin, [1, 10]) == 10 * 20 + 20
+
+
+def test_set_printoptions():
+    paddle.set_printoptions(precision=2)
+    s = repr(paddle.to_tensor([1.23456]))
+    assert "1.23" in s and "1.2345" not in s
+    paddle.set_printoptions(precision=8)
+
+
+def test_dataparallel_alias():
+    model = paddle.nn.Linear(2, 2)
+    wrapped = paddle.DataParallel(model)
+    assert wrapped is not None
+
+
+def test_dtype_class():
+    x = paddle.to_tensor([1.0])
+    assert isinstance(x.dtype, paddle.dtype)
