@@ -226,3 +226,43 @@ class Pad(BaseTransform):
         l, t, r, b = (self.padding if len(self.padding) == 4
                       else self.padding * 2)
         return np.pad(img, ((t, b), (l, r), (0, 0)), constant_values=self.fill)
+
+
+# Color / geometry transforms and their functional ops (separate modules;
+# imported last so they can subclass BaseTransform).
+from . import functional  # noqa: E402,F401
+from .functional import (  # noqa: E402,F401
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    perspective,
+    rotate,
+    to_grayscale,
+)
+from .color_geometry import (  # noqa: E402,F401
+    BrightnessTransform,
+    ColorJitter,
+    ContrastTransform,
+    Grayscale,
+    HueTransform,
+    RandomAffine,
+    RandomErasing,
+    RandomPerspective,
+    RandomResizedCrop,
+    RandomRotation,
+    SaturationTransform,
+)
+
+__all__ += [
+    "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "ColorJitter", "Grayscale",
+    "RandomRotation", "RandomAffine", "RandomPerspective", "RandomErasing",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation",
+    "adjust_hue", "rotate", "affine", "perspective", "erase", "crop",
+    "center_crop", "to_grayscale", "functional",
+]

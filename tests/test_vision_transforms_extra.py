@@ -1,0 +1,110 @@
+"""Color/geometry transforms (reference: vision/transforms/transforms.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.tensor_core import Tensor
+from paddle_tpu.vision import transforms as T
+
+IMG = (np.random.default_rng(0).random((32, 48, 3)) * 255).astype(np.uint8)
+
+
+def test_adjust_brightness():
+    out = T.adjust_brightness(IMG, 2.0)
+    assert out.dtype == np.uint8
+    assert out.astype(int).mean() >= IMG.astype(int).mean()
+    np.testing.assert_array_equal(T.adjust_brightness(IMG, 1.0), IMG)
+    assert (T.adjust_brightness(IMG, 0.0) == 0).all()
+
+
+def test_adjust_contrast_saturation():
+    lo = T.adjust_contrast(IMG, 0.0)
+    assert lo.std() < IMG.std()  # collapses to mean gray
+    np.testing.assert_array_equal(T.adjust_contrast(IMG, 1.0), IMG)
+    gray = T.adjust_saturation(IMG, 0.0)
+    # fully desaturated: all channels equal
+    assert (gray[..., 0] == gray[..., 1]).all()
+    np.testing.assert_array_equal(T.adjust_saturation(IMG, 1.0), IMG)
+
+
+def test_adjust_hue():
+    np.testing.assert_array_equal(T.adjust_hue(IMG, 0.0), IMG)
+    shifted = T.adjust_hue(IMG, 0.5)
+    assert shifted.shape == IMG.shape and shifted.dtype == np.uint8
+    assert not np.array_equal(shifted, IMG)
+    with pytest.raises(ValueError):
+        T.adjust_hue(IMG, 0.7)
+
+
+def test_to_grayscale():
+    g1 = T.to_grayscale(IMG)
+    assert g1.shape == (32, 48, 1)
+    g3 = T.to_grayscale(IMG, num_output_channels=3)
+    assert (g3[..., 0] == g3[..., 2]).all()
+
+
+def test_rotate():
+    np.testing.assert_array_equal(T.rotate(IMG, 0), IMG)
+    r = T.rotate(IMG, 90, expand=True)
+    assert r.shape == (48, 32, 3)
+    # 4 x 90-degree rotations (expand) come back to the original
+    r4 = IMG
+    for _ in range(4):
+        r4 = T.rotate(r4, 90, expand=True)
+    assert r4.shape == IMG.shape
+
+
+def test_affine_translate_semantics():
+    a = T.affine(IMG, angle=0, translate=(5, 3))
+    np.testing.assert_array_equal(a[10, 10], IMG[7, 5])
+    s = T.affine(IMG, angle=0, scale=1.0)
+    np.testing.assert_array_equal(s, IMG)
+
+
+def test_perspective_identity():
+    corners = [[0, 0], [47, 0], [47, 31], [0, 31]]
+    np.testing.assert_array_equal(
+        T.perspective(IMG, corners, corners), IMG)
+
+
+def test_erase():
+    e = T.erase(IMG, 2, 3, 4, 5, 0)
+    assert (e[2:6, 3:8] == 0).all()
+    assert np.array_equal(e[10:, 10:], IMG[10:, 10:])
+    t = Tensor(IMG.transpose(2, 0, 1).astype("float32"))
+    et = T.erase(t, 1, 1, 2, 2, 0.0)
+    assert (et.numpy()[:, 1:3, 1:3] == 0).all()
+
+
+def test_random_transforms_shapes():
+    assert T.RandomResizedCrop(16)(IMG).shape == (16, 16, 3)
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.1)(IMG).shape == IMG.shape
+    assert T.Grayscale(3)(IMG).shape == IMG.shape
+    assert T.RandomRotation(15)(IMG).shape == IMG.shape
+    assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                          shear=5)(IMG).shape == IMG.shape
+    assert T.RandomPerspective(prob=1.0)(IMG).shape == IMG.shape
+
+
+def test_random_erasing():
+    out = T.RandomErasing(prob=1.0, value=0)(IMG.astype("float32"))
+    assert out.shape == IMG.shape
+    assert (out == 0).any()
+    same = T.RandomErasing(prob=0.0)(IMG)
+    np.testing.assert_array_equal(same, IMG)
+
+
+def test_jitter_identity_is_noop():
+    bt = T.BrightnessTransform(0)
+    np.testing.assert_array_equal(bt(IMG), IMG)
+    ht = T.HueTransform(0)
+    np.testing.assert_array_equal(ht(IMG), IMG)
+
+
+def test_compose_pipeline():
+    c = T.Compose([
+        T.RandomResizedCrop(16),
+        T.ColorJitter(0.4, 0.4, 0.4, 0.1),
+        T.ToTensor(),
+    ])
+    out = c(IMG)
+    assert out.shape == [3, 16, 16]
