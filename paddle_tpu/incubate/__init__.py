@@ -2,7 +2,8 @@
 lookahead.py LookAhead:28, modelaverage.py ModelAverage:31; nn fused
 layers; distributed/models/moe lives in paddle_tpu.distributed.moe)."""
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 
-__all__ = ["optimizer", "nn", "asp"]
+__all__ = ["optimizer", "nn", "asp", "autograd"]

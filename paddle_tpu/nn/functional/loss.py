@@ -5,6 +5,8 @@ python/paddle/nn/functional/loss.py. The softmax-CE here is the
 log-sum-exp formulation XLA fuses into one kernel; the Pallas fused
 vocab-parallel variant lives in ops/pallas_kernels.)
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -42,6 +44,46 @@ def _reduce(out, reduction):
     return out
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _softmax_ce_core(logits, labels):
+    """Per-position softmax CE over the last axis: lse(logits) - logits[label].
+
+    Memory-lean custom VJP: the fp32 upcast is consumed only by reduces and a
+    gather, so XLA fuses the convert into the reduction loops — no fp32
+    [..., vocab] array is ever written to HBM, and the backward recomputes
+    softmax from the (bf16) logits instead of saving fp32 log-probs. This is
+    the fused-CE capability of the reference's
+    c_softmax_with_cross_entropy / cross_entropy_kernel.cu, TPU-style.
+    """
+    out, _ = _softmax_ce_fwd(logits, labels)
+    return out
+
+
+def _softmax_ce_fwd(logits, labels):
+    lf = logits.astype(jnp.float32)
+    m = jnp.max(lf, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1))
+    picked = jnp.take_along_axis(
+        lf, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return lse - picked, (logits, labels, lse)
+
+
+def _softmax_ce_bwd(res, g):
+    logits, labels, lse = res
+    lf = logits.astype(jnp.float32)
+    p = jnp.exp(lf - lse[..., None])
+    # one-hot as a fused iota compare (a jax.nn.one_hot array would be a
+    # full [..., vocab] fp32 materialization — the thing we're avoiding)
+    hit = jax.lax.broadcasted_iota(
+        jnp.int32, lf.shape, lf.ndim - 1) == labels[..., None].astype(
+            jnp.int32)
+    d = (p - hit.astype(jnp.float32)) * g[..., None]
+    return d.astype(logits.dtype), None
+
+
+_softmax_ce_core.defvjp(_softmax_ce_fwd, _softmax_ce_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, name=None):
     input = ensure_tensor(input)
@@ -49,10 +91,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     tensors = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
 
     def jfn(logits, lbl, *rest):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
+        fused = (not soft_label) and use_softmax and (
+            axis == -1 or axis == logits.ndim - 1)
+        if fused:
+            logp = logits  # placeholder for ndim only
+        elif use_softmax:
+            # fp32 here regardless of AMP: cross_entropy is off the AMP
+            # black list (the fused path handles its own precision)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:
-            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-15, 1.0))
         if soft_label:
             if rest:
                 # class weights apply to soft labels too (reference
@@ -78,12 +126,18 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             lbl_i = jnp.squeeze(lbl_i, axis=squeeze_axis)
         valid = lbl_i != ignore_index
         safe = jnp.where(valid, lbl_i, 0)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe, squeeze_axis), axis=squeeze_axis
-        ).squeeze(squeeze_axis)
-        loss = jnp.where(valid, -picked, 0.0)
+        if fused:
+            # fused memory-lean path: no fp32 [..., vocab] materialization
+            loss = jnp.where(valid, _softmax_ce_core(logits, safe), 0.0)
+        else:
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, squeeze_axis), axis=squeeze_axis
+            ).squeeze(squeeze_axis)
+            loss = jnp.where(valid, -picked, 0.0)
         if rest:
-            w = rest[0][safe] * valid.astype(logp.dtype)
+            # accumulate the weight-sum denominator in the loss dtype
+            # (f32 on both paths), never in the bf16 logits dtype
+            w = rest[0][safe] * valid.astype(loss.dtype)
             loss = loss * rest[0][safe]
             if reduction == "mean":
                 return loss.sum() / jnp.maximum(w.sum(), 1e-12)

@@ -15,8 +15,10 @@ with preferred_element_type=f32 so bf16 inputs still accumulate in f32 on
 the MXU.
 
 Backward: forward returns the per-row logsumexp; the registered custom VJP
-recomputes scores blockwise from (q, k, v, lse) with plain XLA ops (the
-remat-style backward — no O(seq²) residuals saved from the forward).
+recomputes scores blockwise from (q, k, v, lse) in two Pallas kernels (a dq
+pass and a dk/dv pass, FlashAttention-2 style) — no O(seq²) tensor ever
+reaches HBM in either direction. Tests check both directions against a
+dense jnp attention in interpret mode (tests/test_pallas_kernels.py).
 """
 import functools
 import math
@@ -143,27 +145,179 @@ def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
     return out, lse[..., 0]
 
 
-def _attn_bwd_dense(q, k, v, out, lse, g, causal):
-    """Remat backward from saved logsumexp (plain XLA; O(seq²) transient
-    but nothing saved from forward). All math in f32."""
-    d = q.shape[-1]
-    scale = 1.0 / math.sqrt(d)
-    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
-    gf, of = g.astype(jnp.float32), out.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                      dq_ref, acc_ref, *, causal, scale, block_q, block_k,
+                      kv_blocks, seq_q, seq_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])  # [b, q, k] == softmax(s)
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
-    # d(softmax): rowwise dot(p, dp) term — equals sum(g*out) per row
-    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [b, q, 1]
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]          # input dtype: bf16 inputs stay on the MXU
+        lse = lse_ref[0]      # [block_q, 1] f32
+        delta = delta_ref[0]  # [block_q, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1) + ki * block_k
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + qi * block_q
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if seq_k % block_k != 0:
+            # padded kv tail: p→0 and k/v pad rows zeroed so 0·NaN never
+            # forms in dp or the final ds·k product
+            p = jnp.where(cols < seq_k, p, 0.0)
+            kvrows = jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0) + ki * block_k
+            v = jnp.where(kvrows < seq_k, v, jnp.zeros_like(v))
+            k = jnp.where(kvrows < seq_k, k, jnp.zeros_like(k))
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale,
+                       block_q, block_k, q_blocks, seq_q, seq_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]          # input dtype: bf16 inputs stay on the MXU
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) + qi * block_q
+        if causal:
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if seq_q % block_q != 0:
+            # padded q tail: those rows carry garbage lse/delta/g/q — zero
+            # their weight so they contribute nothing to dk/dv (and no
+            # 0·NaN forms in the ds^T·q product)
+            p = jnp.where(rows < seq_q, p, 0.0)
+            grows = jax.lax.broadcasted_iota(
+                jnp.int32, g.shape, 0) + qi * block_q
+            g = jnp.where(grows < seq_q, g, jnp.zeros_like(g))
+            q = jnp.where(grows < seq_q, q, jnp.zeros_like(q))
+        if seq_k % block_k != 0:
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + ki * block_k
+            p = jnp.where(cols < seq_k, p, 0.0)
+            vrows = jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0) + ki * block_k
+            v = jnp.where(vrows < seq_k, v, jnp.zeros_like(v))
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        if seq_q % block_q != 0:
+            # delta/lse are garbage on padded q rows, so 0·NaN leaked into
+            # ds despite p being zeroed there — mask ds itself
+            ds = jnp.where(rows < seq_q, ds, 0.0)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:]).astype(dk_ref.dtype)
+        dv_ref[0] = (dv_acc[:]).astype(dv_ref.dtype)
+
+
+def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
+                     interpret):
+    """Flash backward: dq pass + dk/dv pass, each O(seq·d) HBM traffic."""
+    bh, seq, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq_k)
+    scale = 1.0 / math.sqrt(d)
+    q_blocks = pl.cdiv(seq, block_q)
+    kv_blocks = pl.cdiv(seq_k, block_k)
+    gf = g.astype(q.dtype)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[..., None]  # [bh, seq, 1]
+    lse3 = lse[..., None]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, kv_blocks=kv_blocks, seq_q=seq, seq_k=seq_k),
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, gf, lse3, delta)
+
+    # dkv pass: grid transposed so the q dimension is innermost
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, q_blocks=q_blocks, seq_q=seq, seq_k=seq_k),
+        grid=(bh, kv_blocks, q_blocks),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, gf, lse3, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -179,7 +333,8 @@ def _fa_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
 
 def _fa_bwd_rule(causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    return _attn_bwd_dense(q, k, v, out, lse, g, causal)
+    return _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
+                            interpret)
 
 
 _flash_attention_bhd.defvjp(_fa_fwd_rule, _fa_bwd_rule)
@@ -190,8 +345,10 @@ def flash_attention_bshd(q, k, v, causal=False,
                          interpret=False):
     """Fused attention on [batch, seq, heads, head_dim] (paddle layout).
 
-    Differentiable; forward is the Pallas kernel, backward is the
-    lse-remat formulation. `interpret=True` runs the kernel in the Pallas
+    Differentiable; forward and backward are Pallas kernels over the
+    [batch·heads, seq, d] layout (Mosaic requires the tiled last-two dims,
+    so a head-sliced 4-D blocking is not expressible — the wrapper pays
+    one transpose each way instead). `interpret=True` runs in the Pallas
     interpreter (CPU test tier).
     """
     b, s, h, d = q.shape

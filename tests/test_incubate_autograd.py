@@ -1,0 +1,94 @@
+"""paddle.incubate.autograd functional transforms
+(reference: python/paddle/incubate/autograd/functional.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as ia
+
+
+def test_vjp_default_cotangent():
+    x = paddle.ones([2, 2])
+    y, g = ia.vjp(lambda x: paddle.matmul(x, x), x)
+    np.testing.assert_allclose(g.numpy(), np.full((2, 2), 4.0))
+    np.testing.assert_allclose(y.numpy(), np.full((2, 2), 2.0))
+
+
+def test_vjp_explicit_cotangent():
+    x = paddle.ones([2, 2])
+    v = paddle.to_tensor([[1.0, 0.0], [0.0, 0.0]])
+    _, g = ia.vjp(lambda x: paddle.matmul(x, x), x, v)
+    np.testing.assert_allclose(g.numpy(), [[2.0, 1.0], [1.0, 0.0]])
+
+
+def test_vjp_multi_input():
+    a = paddle.to_tensor([2.0])
+    b = paddle.to_tensor([3.0])
+    ys, gs = ia.vjp(lambda a, b: a * b, [a, b])
+    np.testing.assert_allclose(ys.numpy(), [6.0])
+    np.testing.assert_allclose(gs[0].numpy(), [3.0])
+    np.testing.assert_allclose(gs[1].numpy(), [2.0])
+
+
+def test_jvp():
+    x = paddle.ones([2, 2])
+    _, j = ia.jvp(lambda x: paddle.matmul(x, x), x)
+    np.testing.assert_allclose(j.numpy(), np.full((2, 2), 4.0))
+    v = paddle.zeros([2, 2])
+    _, j0 = ia.jvp(lambda x: paddle.matmul(x, x), x, v)
+    np.testing.assert_allclose(j0.numpy(), np.zeros((2, 2)))
+
+
+def test_jacobian_dense():
+    w = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    J = ia.Jacobian(lambda x: paddle.matmul(x, w), x)
+    assert J.shape == [2, 2]
+    np.testing.assert_allclose(J[:].numpy(), [[1.0, 3.0], [2.0, 4.0]])
+    # single-entry indexing
+    assert float(J[0, 1].numpy()) == 3.0
+
+
+def test_jacobian_batched():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    J = ia.Jacobian(lambda x: x * x, x, is_batched=True)
+    assert J.shape == [2, 2, 2]
+    np.testing.assert_allclose(J[0].numpy(), np.diag([2.0, 4.0]))
+    np.testing.assert_allclose(J[1].numpy(), np.diag([6.0, 8.0]))
+
+
+def test_jacobian_multi_input():
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0], np.float32))
+    J = ia.Jacobian(lambda a, b: a * b, [a, b])
+    # y = [a0*b, a1*b]; inputs flattened [a0, a1, b] -> J is [2, 3]
+    assert J.shape == [2, 3]
+    np.testing.assert_allclose(J[:].numpy(),
+                               [[3.0, 0.0, 1.0], [0.0, 3.0, 2.0]])
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    H = ia.Hessian(lambda x: (x * x * x).sum(), x)
+    np.testing.assert_allclose(H[:].numpy(), np.diag([6.0, 12.0]))
+
+
+def test_hessian_batched():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    H = ia.Hessian(lambda x: (x * x).sum(axis=-1, keepdim=True), x,
+                   is_batched=True)
+    assert H.shape == [2, 1, 1]
+    np.testing.assert_allclose(H[:].numpy(), [[[2.0]], [[2.0]]])
+
+
+def test_prim_shims_and_grad():
+    assert ia.prim_enabled() is True
+    ia.enable_prim(), ia.disable_prim()
+    assert ia.prim2orig() is None
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    g = ia.grad(y, x)
+    g0 = g[0] if isinstance(g, (list, tuple)) else g
+    np.testing.assert_allclose(g0.numpy(), [6.0])
+    with pytest.raises(NotImplementedError):
+        ia.forward_grad(y, x)
