@@ -1,0 +1,36 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py).
+
+Pure re-export layer: every op lives in ops/linalg.py or ops/extras.py
+(XLA lowerings funneled through the autograd tape); this module pins the
+reference's exact export list, including the `cond` and `inv` names that
+clash with control-flow `cond` / are named `inverse` in the tensor API.
+"""
+from .ops.extras import eig, eigvals, inv, lu, lu_unpack  # noqa: F401
+from .ops.linalg import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    corrcoef,
+    cov,
+    det,
+    eigh,
+    eigvalsh,
+    lstsq,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+from .ops.linalg import cond_number as cond  # noqa: F401
+
+__all__ = [
+    "cholesky", "norm", "eig", "cov", "corrcoef", "cond", "matrix_power",
+    "solve", "cholesky_solve", "inv", "eigvals", "multi_dot", "matrix_rank",
+    "svd", "eigvalsh", "qr", "lu", "lu_unpack", "eigh", "det", "slogdet",
+    "pinv", "triangular_solve", "lstsq",
+]

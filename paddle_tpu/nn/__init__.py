@@ -19,3 +19,8 @@ from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 
 from . import layer  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
+from .utils import spectral_norm  # noqa: F401
+from .layer import activation as _act_mod
+from .layer import loss  # noqa: F401  (paddle.nn.loss legacy namespace)
+from . import quant  # noqa: F401

@@ -1,0 +1,170 @@
+"""Beam-search decoding (reference: python/paddle/nn/decode.py
+BeamSearchDecoder + dynamic_decode).
+
+Design: the decode loop is host-driven (eager) — each step's cell call runs
+as the usual tape ops, the beam bookkeeping is jnp on the side. This is the
+idiomatic TPU split for autoregressive search: dynamic stopping lives on the
+host, per-step math is compiled. (The KV-cache greedy path in
+text/models/gpt.py is the fully-compiled alternative for generation.)
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor_core import Tensor
+from ..ops._helpers import ensure_tensor, value_of
+from . import functional as F
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decode contract: initialize() -> (inputs, states, finished);
+    step() -> (outputs, states, inputs, finished); finalize() optional."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a step cell (reference decode.py:BeamSearchDecoder).
+
+    cell: callable (inputs, states) -> (outputs, next_states); logits come
+    from output_fn(outputs) (or outputs directly). Tokens are embedded with
+    embedding_fn (or passed through).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # ---- layout helpers (reference: _expand_to_beam_size etc.) ----
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] by tiling each sample."""
+        x = ensure_tensor(x)
+        v = value_of(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]),
+                      stop_gradient=x.stop_gradient)
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree.map(
+            lambda t: self._merge(jnp.repeat(
+                value_of(ensure_tensor(t))[:, None], self.beam_size, 1)),
+            initial_cell_states)
+        batch = jax.tree.leaves(states)[0].shape[0] // self.beam_size
+        # beam 0 live, the rest dead at start so step 0 picks distinct tokens
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        tokens = jnp.full((batch * self.beam_size,), self.start_token,
+                          jnp.int64)
+        inputs = Tensor(tokens, stop_gradient=True)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        return inputs, (states, log_probs, finished), \
+            Tensor(finished, stop_gradient=True)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states, log_probs, finished = states
+        wrapped = jax.tree.map(
+            lambda v: Tensor(v, stop_gradient=True), cell_states)
+        outputs, next_cell = self.cell(inputs, wrapped)
+        logits = self.output_fn(outputs) if self.output_fn else outputs
+        lv = value_of(ensure_tensor(logits)).astype(jnp.float32)
+        vocab = lv.shape[-1]
+        batch = lv.shape[0] // self.beam_size
+        step_lp = jax.nn.log_softmax(lv, -1).reshape(
+            (batch, self.beam_size, vocab))
+        # finished beams emit only end_token, at no extra cost
+        noend = jnp.full((vocab,), -1e9, jnp.float32).at[
+            self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], noend, step_lp)
+        total = log_probs[..., None] + step_lp           # [b, beam, V]
+        flat = total.reshape((batch, self.beam_size * vocab))
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int64)    # [b, beam]
+        token = (top_idx % vocab).astype(jnp.int64)
+        binc = jnp.arange(batch)[:, None]
+        next_finished = finished[binc, parent] | (token == self.end_token)
+        next_cell_v = jax.tree.map(
+            lambda t: self._merge(self._split(
+                value_of(ensure_tensor(t)))[binc, parent]), next_cell)
+        next_inputs = Tensor(token.reshape(-1), stop_gradient=True)
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        step_outputs = (top_scores, token, parent)
+        return (step_outputs, (next_cell_v, top_scores, next_finished),
+                next_inputs, Tensor(next_finished, stop_gradient=True))
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        scores, predicted_ids, parent_ids = outputs
+        # [T, batch, beam] backtrace (reference calls gather_tree too)
+        seqs = F.gather_tree(Tensor(predicted_ids, stop_gradient=True),
+                             Tensor(parent_ids, stop_gradient=True))
+        return seqs, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run decoder.step until every sequence finishes or max_step_num
+    (reference: decode.py dynamic_decode)."""
+    inputs, states, finished = decoder.initialize(inits)
+    fin = value_of(ensure_tensor(finished))
+    seq_len = jnp.zeros(fin.shape, jnp.int64)
+    scores_acc, ids_acc, parents_acc = [], [], []
+    time = 0
+    while True:
+        if max_step_num is not None and time >= max_step_num:
+            break
+        step_out, states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        scores, token, parent = step_out
+        scores_acc.append(scores)
+        ids_acc.append(token)
+        parents_acc.append(parent)
+        prev_fin = fin
+        fin = value_of(ensure_tensor(finished))
+        seq_len = seq_len + (~prev_fin).astype(jnp.int64)
+        time += 1
+        if bool(np.asarray(fin).all()):
+            break
+    outputs = (jnp.stack(scores_acc), jnp.stack(ids_acc),
+               jnp.stack(parents_acc))
+    try:
+        final, final_states = decoder.finalize(outputs, states, seq_len)
+    except NotImplementedError:
+        final, final_states = (
+            Tensor(outputs[1], stop_gradient=True), states)
+    if not output_time_major and isinstance(final, Tensor):
+        final = Tensor(jnp.moveaxis(value_of(final), 0, 1),
+                       stop_gradient=True)
+    rets = (final, final_states)
+    if return_length:
+        rets = rets + (Tensor(seq_len, stop_gradient=True),)
+    return rets

@@ -38,6 +38,7 @@ from ...ops.math import tanh  # noqa: F401
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403

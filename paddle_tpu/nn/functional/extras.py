@@ -1,0 +1,219 @@
+"""Remaining nn.functional surface: masks, video shift, beam backtrace,
+padding, PartialFC sampling, block-sparse attention.
+
+Reference: python/paddle/nn/functional/{common,extension,input}.py and the
+matching phi kernels (sequence_mask, temporal_shift_op, gather_tree_op,
+class_center_sample_op, sparse_attention_op).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd import engine
+from ...ops._helpers import apply_jfn, ensure_tensor, value_of
+from ...tensor_core import Tensor
+
+__all__ = [
+    "sequence_mask", "temporal_shift", "gather_tree", "zeropad2d",
+    "class_center_sample", "sparse_attention", "relu_", "elu_", "tanh_",
+    "softmax_",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[..., maxlen] mask with 1 where position < length
+    (reference: nn/functional/extension.py sequence_mask)."""
+    from ...core import dtype as dtype_mod
+
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype)
+    if maxlen is None:
+        maxlen = int(np.asarray(value_of(x)).max())
+
+    def jfn(lengths):
+        pos = jnp.arange(int(maxlen))
+        return (pos < lengths[..., None]).astype(d)
+
+    return apply_jfn("sequence_mask", jfn, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift across the time axis (reference:
+    nn/functional/extension.py temporal_shift → temporal_shift_op): the
+    first shift_ratio channels move one step back in time, the next
+    shift_ratio one step forward, the rest stay."""
+    x = ensure_tensor(x)
+
+    def jfn(xv):
+        v = jnp.moveaxis(xv, -1, 1) if data_format == "NHWC" else xv
+        nt, c = v.shape[0], v.shape[1]
+        n = nt // seg_num
+        v5 = v.reshape((n, seg_num) + v.shape[1:])
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        # slide the time axis with zero fill at the boundary
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(v.shape)
+        return jnp.moveaxis(out, 1, -1) if data_format == "NHWC" else out
+
+    return apply_jfn("temporal_shift", jfn, x)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: nn/functional/extension.py
+    gather_tree → gather_tree_op): walk parent pointers from the last
+    step so each beam holds its full ancestry path.
+
+    ids/parents: [max_time, batch, beam]."""
+    ids = ensure_tensor(ids)
+    parents = ensure_tensor(parents)
+
+    def jfn(idv, parv):
+        t, batch, beam = idv.shape
+        binc = jnp.arange(batch)[:, None]
+
+        def step(beam_sel, xs):
+            id_t, par_t = xs  # [batch, beam]
+            # current selection points into this step's beams
+            out = jnp.take_along_axis(id_t, beam_sel, axis=1)
+            nxt = jnp.take_along_axis(par_t, beam_sel, axis=1)
+            return nxt, out
+
+        init = jnp.tile(jnp.arange(beam)[None, :], (batch, 1))
+        _, outs = jax.lax.scan(step, init, (idv[::-1], parv[::-1]))
+        del binc
+        return outs[::-1]
+
+    return apply_jfn("gather_tree", jfn, ids, parents)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (reference: nn/functional/common.py zeropad2d);
+    padding = [left, right, top, bottom]."""
+    x = ensure_tensor(x)
+    left, right, top, bottom = (int(p) for p in padding)
+
+    def jfn(xv):
+        if data_format == "NHWC":
+            cfg = [(0, 0), (top, bottom), (left, right), (0, 0)]
+        else:
+            cfg = [(0, 0), (0, 0), (top, bottom), (left, right)]
+        return jnp.pad(xv, cfg)
+
+    return apply_jfn("zeropad2d", jfn, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference:
+    nn/functional/common.py class_center_sample → class_center_sample_op):
+    keep every positive class, pad with uniformly sampled negatives up to
+    num_samples, and remap labels into the sampled index space. Host-side
+    (eager-only) — sampling is data-dependent by design."""
+    from ...core import rng
+
+    label = ensure_tensor(label)
+    lbl = np.asarray(value_of(label)).reshape(-1).astype(np.int64)
+    pos = np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        seed = int(
+            jax.random.randint(rng.next_key(), (), 0, 2**31 - 1))
+        gen = np.random.default_rng(seed)
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                                assume_unique=True)
+        extra = gen.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = np.full(num_classes, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lbl].reshape(np.asarray(value_of(label)).shape)
+    return (Tensor(jnp.asarray(remapped), stop_gradient=True),
+            Tensor(jnp.asarray(sampled), stop_gradient=True))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR-described pattern (reference:
+    nn/functional/common.py sparse_attention → sparse_attention CUDA op).
+    TPU lowering: the CSR pattern becomes a dense additive mask and XLA
+    fuses the masked softmax — numerically identical, O(M·N) transient.
+
+    q/k/v: [batch, heads, seq, head_dim]; offset: [batch, heads, seq+1];
+    columns: [batch, heads, nnz]."""
+    query = ensure_tensor(query)
+    key = ensure_tensor(key)
+    value = ensure_tensor(value)
+    offset = ensure_tensor(sparse_csr_offset)
+    columns = ensure_tensor(sparse_csr_columns)
+
+    def jfn(q, k, v, off, cols):
+        b, h, m, d = q.shape
+        nnz = cols.shape[-1]
+        # row id of each nnz entry: #offsets <= j, minus the leading 0
+        ar = jnp.arange(nnz)
+        rows = (jax.vmap(jax.vmap(
+            lambda o: jnp.searchsorted(o, ar, side="right") - 1))(
+                off.astype(jnp.int32)))
+        # scatter allowed (row, col) pairs into a dense mask
+        mask = jnp.zeros((b, h, m, m), bool)
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        mask = mask.at[bidx, hidx, rows, cols.astype(jnp.int32)].set(True)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        w = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(v.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    return apply_jfn("sparse_attention", jfn, query, key, value, offset,
+                     columns)
+
+
+# ---- in-place functional aliases (reference exports them from
+# nn/functional: relu_, elu_, tanh_, softmax_) ----
+
+def _assign_inplace(x, opname, fn):
+    """Same tape discipline as Tensor's installed `*_` methods: the
+    recorded node's input must be a PRE-mutation snapshot, never x
+    itself (see ops/__init__._snapshot_for_inplace)."""
+    from ...ops import _snapshot_for_inplace
+
+    x = ensure_tensor(x)
+    old = _snapshot_for_inplace(x, opname)
+    out = fn(old)
+    x._inplace_version += 1
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu_(x, name=None):
+    from ...ops.activation import relu
+
+    return _assign_inplace(x, "relu", relu)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...ops.activation import elu
+
+    return _assign_inplace(x, "elu", lambda t: elu(t, alpha))
+
+
+def tanh_(x, name=None):
+    return ensure_tensor(x).tanh_()
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...ops.activation import softmax
+
+    return _assign_inplace(x, "softmax", lambda t: softmax(t, axis=axis))

@@ -10,6 +10,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ...autograd import engine
 from ...ops._helpers import apply_jfn, ensure_tensor, value_of
 
 __all__ = [
@@ -33,6 +34,12 @@ __all__ = [
     "log_loss",
     "square_error_cost",
     "sigmoid_focal_loss",
+    "dice_loss",
+    "npair_loss",
+    "soft_margin_loss",
+    "triplet_margin_with_distance_loss",
+    "hsigmoid_loss",
+    "margin_cross_entropy",
 ]
 
 
@@ -473,3 +480,180 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
         return t_sum(loss)
     return loss
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss over the last (class) axis
+    (reference: python/paddle/nn/functional/loss.py dice_loss)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def jfn(x, lbl):
+        lbl_i = lbl.astype(jnp.int32)
+        if lbl_i.ndim == x.ndim:
+            lbl_i = jnp.squeeze(lbl_i, -1)
+        onehot = jax.nn.one_hot(lbl_i, x.shape[-1], dtype=x.dtype)
+        reduce_axes = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * onehot, axis=reduce_axes)
+        denom = jnp.sum(x, axis=reduce_axes) + jnp.sum(onehot,
+                                                       axis=reduce_axes)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+
+    return apply_jfn("dice_loss", jfn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference: loss.py npair_loss): row-softmax CE over the
+    anchor·positiveᵀ similarity with label-equality soft targets, plus an
+    L2 pull on the embeddings."""
+    anchor = ensure_tensor(anchor)
+    positive = ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    def jfn(a, p, lbl):
+        lbl = lbl.reshape(-1).astype(jnp.float32)
+        batch = a.shape[0]
+        eq = (lbl[:, None] == lbl[None, :]).astype(a.dtype)
+        targets = eq / jnp.maximum(eq.sum(-1, keepdims=True), 1e-12)
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim.astype(jnp.float32), -1)
+        ce = jnp.mean(jnp.sum(-targets * logp, axis=-1))
+        l2 = (jnp.sum(a * a) + jnp.sum(p * p)) / batch * l2_reg * 0.25
+        return ce + l2
+
+    return apply_jfn("npair_loss", jfn, anchor, positive, labels)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label·input)) with labels in {-1, 1}
+    (reference: loss.py soft_margin_loss)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+
+    def jfn(x, y):
+        out = jnp.log1p(jnp.exp(-y.astype(x.dtype) * x))
+        return _reduce(out, reduction)
+
+    return apply_jfn("soft_margin_loss", jfn, input, label)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """max(0, d(a,p) - d(a,n) + margin) with a pluggable distance
+    (reference: loss.py triplet_margin_with_distance_loss)."""
+    from .common import pairwise_distance
+
+    input = ensure_tensor(input)
+    positive = ensure_tensor(positive)
+    negative = ensure_tensor(negative)
+    dist = distance_function or pairwise_distance
+    dp = ensure_tensor(dist(input, positive))
+    dn = ensure_tensor(dist(input, negative))
+    if swap:
+        dpn = ensure_tensor(dist(positive, negative))
+        tensors = (dp, dn, dpn)
+    else:
+        tensors = (dp, dn)
+
+    def jfn(dpv, dnv, *rest):
+        if rest:
+            dnv = jnp.minimum(dnv, rest[0])
+        out = jnp.maximum(dpv - dnv + margin, 0.0)
+        return _reduce(out, reduction)
+
+    return engine.apply("triplet_margin_with_distance_loss", jfn, tensors)
+
+
+def _hsigmoid_default_paths(num_classes):
+    """Per-class (node_index, bit) tables for the complete-binary-tree code
+    (reference: paddle/fluid/operators/math/matrix_bit_code.h SimpleCode:
+    c = label + num_classes, index(bit) = (c >> (bit+1)) - 1,
+    bit(bit) = (c >> bit) & 1, length = findLastSet(c) - 1)."""
+    import numpy as np
+
+    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    table = np.full((num_classes, max_len), -1, np.int32)
+    code = np.zeros((num_classes, max_len), np.float32)
+    for cls in range(num_classes):
+        c = cls + num_classes
+        length = int(np.floor(np.log2(c)))
+        for bit in range(length):
+            table[cls, bit] = (c >> (bit + 1)) - 1
+            code[cls, bit] = float((c >> bit) & 1)
+    return table, code
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: loss.py hsigmoid_loss →
+    phi hsigmoid_loss kernel). Default path uses the complete-binary-tree
+    code; custom trees pass path_table/path_code ([N, L], -1-padded)."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    tensors = [input, label, weight]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    custom = path_table is not None
+    if custom:
+        tensors.append(ensure_tensor(path_table))
+        tensors.append(ensure_tensor(path_code))
+    else:
+        import numpy as np
+
+        table_np, code_np = _hsigmoid_default_paths(int(num_classes))
+        table_c, code_c = jnp.asarray(table_np), jnp.asarray(code_np)
+
+    def jfn(x, lbl, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        if custom:
+            tbl = rest.pop(0).astype(jnp.int32)      # [N, L]
+            bits = rest.pop(0).astype(jnp.float32)   # [N, L]
+        else:
+            lbl_i = lbl.reshape(-1).astype(jnp.int32)
+            tbl = table_c[lbl_i]
+            bits = code_c[lbl_i]
+        valid = (tbl >= 0)
+        safe = jnp.where(valid, tbl, 0)
+        w_path = w[safe]                      # [N, L, D]
+        z = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                       w_path.astype(jnp.float32))
+        if b is not None:
+            z = z + b.reshape(-1)[safe]
+        # softplus(z) - bit*z == -log sigmoid BCE on the path decision
+        per_node = jnp.where(valid, jax.nn.softplus(z) - bits * z, 0.0)
+        return jnp.mean(jnp.sum(per_node, axis=-1, keepdims=True))
+
+    return apply_jfn("hsigmoid_loss", jfn, *tensors)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-style margin softmax CE: the target-class cosine
+    becomes cos(m1·θ + m2) - m3 before scaling (reference:
+    python/paddle/nn/functional/loss.py margin_cross_entropy →
+    margin_cross_entropy op). Logits must be cosine similarities."""
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+
+    def jfn(cos, lbl):
+        lbl_i = lbl.reshape(-1).astype(jnp.int32)
+        cf = cos.astype(jnp.float32)
+        hit = jax.lax.broadcasted_iota(
+            jnp.int32, cf.shape, cf.ndim - 1) == lbl_i[:, None]
+        theta = jnp.arccos(jnp.clip(cf, -1.0 + 1e-7, 1.0 - 1e-7))
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        z = jnp.where(hit, modified, cf) * scale
+        logp = jax.nn.log_softmax(z, -1)
+        loss = -jnp.take_along_axis(logp, lbl_i[:, None], -1)
+        out = _reduce(loss, reduction)
+        if return_softmax:
+            return out, jnp.exp(logp)
+        return out
+
+    return apply_jfn("margin_cross_entropy", jfn, logits, label)

@@ -15,6 +15,9 @@ __all__ = [
     "max_pool1d",
     "max_pool2d",
     "max_pool3d",
+    "max_unpool1d",
+    "max_unpool2d",
+    "max_unpool3d",
     "avg_pool1d",
     "avg_pool2d",
     "avg_pool3d",
@@ -44,7 +47,7 @@ def _concrete_init(init, dtype):
 
 
 def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
-          channel_last):
+          channel_last, return_mask=False):
     kernel = _norm(kernel, n)
     stride = _norm(stride, n) or kernel
     if isinstance(padding, str):
@@ -73,6 +76,53 @@ def _pool(x, n, kernel, stride, padding, mode, ceil_mode, exclusive,
 
     if mode == "max":
         init, op = -jnp.inf, lax.max
+
+        if return_mask:
+            spatial_axes = (
+                tuple(range(x._value.ndim - n - 1, x._value.ndim - 1))
+                if channel_last else
+                tuple(range(x._value.ndim - n, x._value.ndim))
+            )
+
+            def jfn_mask(xv):
+                p = pads
+                if isinstance(pad, str):
+                    raise ValueError(
+                        "return_mask with string padding is unsupported")
+                if ceil_mode:
+                    p = _grow_for_ceil(xv.shape, dims, strides, pads)
+                # flat spatial index per element (paddle mask semantics:
+                # position within the per-channel spatial plane)
+                idx = jnp.zeros(xv.shape, jnp.int32)
+                mult = 1
+                for ax in reversed(spatial_axes):
+                    idx = idx + lax.broadcasted_iota(
+                        jnp.int32, xv.shape, ax) * mult
+                    mult *= xv.shape[ax]
+
+                def red(a, b):
+                    av, ai = a
+                    bv, bi = b
+                    # lowest index wins ties (paddle keeps the first max)
+                    take_b = (bv > av) | ((bv == av) & (bi < ai))
+                    return (jnp.where(take_b, bv, av),
+                            jnp.where(take_b, bi, ai))
+
+                # the value output goes through the DIFFERENTIABLE monoid
+                # reduce; the index comes from a stop-gradient variadic
+                # reduce (its transpose rule doesn't exist, and ints don't
+                # need one)
+                out = lax.reduce_window(
+                    xv, _concrete_init(init, xv.dtype), lax.max, dims,
+                    strides, p)
+                _, ind = lax.reduce_window(
+                    (lax.stop_gradient(xv), idx),
+                    (_concrete_init(init, xv.dtype),
+                     _concrete_init(jnp.iinfo(jnp.int32).max, jnp.int32)),
+                    red, dims, strides, p)
+                return out, ind
+
+            return apply_jfn(f"max_pool{n}d_with_mask", jfn_mask, x)
 
         def jfn(xv):
             p = pads
@@ -124,19 +174,81 @@ def _reduce_window_str(xv, init, op, dims, strides, pad_str):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     return _pool(x, 1, kernel_size, stride, padding, "max", ceil_mode, True,
-                 data_format in ("NLC",))
+                 data_format in ("NLC",), return_mask)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     return _pool(x, 2, kernel_size, stride, padding, "max", ceil_mode, True,
-                 data_format == "NHWC")
+                 data_format == "NHWC", return_mask)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     return _pool(x, 3, kernel_size, stride, padding, "max", ceil_mode, True,
-                 data_format == "NDHWC")
+                 data_format == "NDHWC", return_mask)
+
+
+def _max_unpool(x, indices, n, kernel, stride, padding, output_size,
+                channel_last):
+    """Scatter pooled values back to their argmax positions
+    (reference: phi/kernels/cpu/unpool_kernel.cc; indices are flat
+    positions within the per-channel spatial plane, as produced by
+    max_pool(return_mask=True))."""
+    kernel = _norm(kernel, n)
+    stride = _norm(stride, n) or kernel
+    p = _norm(padding, n)
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+
+    in_spatial = (tuple(x.shape[-n - 1:-1]) if channel_last
+                  else tuple(x.shape[-n:]))
+    if output_size is None:
+        out_spatial = tuple(
+            (in_spatial[i] - 1) * stride[i] - 2 * p[i] + kernel[i]
+            for i in range(n))
+    else:
+        out_spatial = tuple(int(s) for s in output_size)[-n:]
+
+    def jfn(xv, iv):
+        if channel_last:
+            xv = jnp.moveaxis(xv, -1, 1)
+            iv = jnp.moveaxis(iv, -1, 1)
+        nb, c = xv.shape[0], xv.shape[1]
+        lin = int(np.prod(xv.shape[2:]))
+        lout = int(np.prod(out_spatial))
+        xf = xv.reshape(nb, c, lin)
+        idx = iv.reshape(nb, c, lin).astype(jnp.int32)
+        out = jnp.zeros((nb, c, lout), xv.dtype)
+        out = out.at[
+            jnp.arange(nb)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx,
+        ].set(xf, mode="drop")
+        out = out.reshape((nb, c) + out_spatial)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_jfn(f"max_unpool{n}d", jfn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format == "NLC")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format == "NHWC")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format == "NDHWC")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

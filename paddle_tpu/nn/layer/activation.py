@@ -6,6 +6,7 @@ __all__ = [
     "CELU", "ELU", "GELU", "GLU", "Hardshrink", "Hardsigmoid", "Hardswish",
     "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax", "Maxout", "Mish",
     "PReLU", "ReLU", "ReLU6", "RReLU", "SELU", "Sigmoid", "Silu", "Softmax",
+    "Softmax2D",
     "Softplus", "Softshrink", "Softsign", "Swish", "Tanh", "Tanhshrink",
     "ThresholdedReLU",
 ]
@@ -193,3 +194,17 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self._threshold)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs
+    (reference: python/paddle/nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3D/4D input, got {x.ndim}D")
+        return F.softmax(x, axis=-3)

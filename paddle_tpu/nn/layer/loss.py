@@ -7,6 +7,7 @@ __all__ = [
     "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "MarginRankingLoss",
     "CosineEmbeddingLoss", "TripletMarginLoss", "HingeEmbeddingLoss",
     "HuberLoss", "PoissonNLLLoss", "MultiLabelSoftMarginLoss", "CTCLoss",
+    "SoftMarginLoss", "TripletMarginWithDistanceLoss", "HSigmoidLoss",
 ]
 
 
@@ -200,3 +201,53 @@ class CTCLoss(Layer):
                           label_lengths, blank=self.blank,
                           reduction=self.reduction,
                           norm_by_times=norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid with owned tree parameters
+    (reference: python/paddle/nn/layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        num_nodes = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [num_nodes, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_nodes, 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and path_table is None:
+            raise ValueError("custom tree needs path_table/path_code")
+        return F.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias,
+            path_table=path_table, path_code=path_code)

@@ -4,6 +4,7 @@ from .layers import Layer
 
 __all__ = [
     "MaxPool1D", "MaxPool2D", "MaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
     "AvgPool1D", "AvgPool2D", "AvgPool3D",
     "AdaptiveAvgPool1D", "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
     "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
@@ -28,20 +29,24 @@ class _Pool(Layer):
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, name=None):
-        super().__init__(kernel_size, stride, padding, ceil_mode, "NCL")
+        super().__init__(kernel_size, stride, padding, ceil_mode, "NCL",
+                         return_mask=return_mask)
 
     def forward(self, x):
         return F.max_pool1d(x, self.ksize, self.stride, self.padding,
+                            return_mask=self.kw["return_mask"],
                             ceil_mode=self.ceil_mode)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCHW", name=None):
-        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format,
+                         return_mask=return_mask)
 
     def forward(self, x):
         return F.max_pool2d(x, self.ksize, self.stride, self.padding,
+                            return_mask=self.kw["return_mask"],
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
@@ -49,10 +54,12 @@ class MaxPool2D(_Pool):
 class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCDHW", name=None):
-        super().__init__(kernel_size, stride, padding, ceil_mode, data_format)
+        super().__init__(kernel_size, stride, padding, ceil_mode, data_format,
+                         return_mask=return_mask)
 
     def forward(self, x):
         return F.max_pool3d(x, self.ksize, self.stride, self.padding,
+                            return_mask=self.kw["return_mask"],
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
@@ -147,3 +154,54 @@ class AdaptiveMaxPool3D(_AdaptivePool):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self._output_size)
+
+
+class MaxUnPool1D(Layer):
+    """Inverse of MaxPool1D given the pooling mask
+    (reference: python/paddle/nn/layer/pooling.py MaxUnPool1D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.ksize = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.ksize, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.ksize = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.ksize, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.ksize = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.ksize, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)

@@ -16,7 +16,7 @@ from .. import initializer as I
 from .layers import Layer, ParamAttr
 
 __all__ = [
-    "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
     "SimpleRNN", "LSTM", "GRU",
 ]
 

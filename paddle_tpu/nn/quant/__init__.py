@@ -1,0 +1,11 @@
+"""paddle.nn.quant namespace (reference: python/paddle/nn/quant/) — the
+quantization machinery lives in paddle_tpu/quantization; this re-exports
+the layer-facing pieces under the reference's path."""
+from ...quantization import (  # noqa: F401
+    ImperativeQuantAware,
+    PostTrainingQuantization,
+    QuantizedLinear,
+)
+
+__all__ = ["ImperativeQuantAware", "PostTrainingQuantization",
+           "QuantizedLinear"]

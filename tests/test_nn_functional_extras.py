@@ -1,0 +1,243 @@
+"""nn.functional + nn layer parity additions
+(reference: python/paddle/nn/functional/{loss,extension,common}.py,
+nn/layer/{loss,pooling,activation}.py, nn/decode.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def test_max_pool_return_mask_and_unpool():
+    x = paddle.to_tensor(
+        np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4),
+        stop_gradient=False)
+    out, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+    np.testing.assert_array_equal(out.numpy().reshape(-1), [5, 7, 13, 15])
+    np.testing.assert_array_equal(mask.numpy().reshape(-1), [5, 7, 13, 15])
+    un = F.max_unpool2d(out, mask, 2, stride=2)
+    ref = np.zeros((1, 1, 4, 4), np.float32)
+    for v in (5, 7, 13, 15):
+        ref[0, 0, v // 4, v % 4] = v
+    np.testing.assert_allclose(un.numpy(), ref)
+    (un * un).sum().backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_max_pool_mask_tie_breaks_first():
+    t = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+    _, m = F.max_pool2d(t, 2, return_mask=True)
+    assert int(m.numpy().reshape(-1)[0]) == 0
+
+
+def test_max_unpool1d_3d():
+    x1 = paddle.to_tensor(np.arange(8.0, dtype=np.float32).reshape(1, 1, 8))
+    o, m = F.max_pool1d(x1, 2, return_mask=True)
+    u = F.max_unpool1d(o, m, 2)
+    assert u.shape == [1, 1, 8]
+    np.testing.assert_allclose(u.numpy().reshape(-1)[1::2], [1, 3, 5, 7])
+    x3 = paddle.to_tensor(
+        np.random.default_rng(0).random((1, 1, 2, 2, 2)).astype(np.float32))
+    o3, m3 = F.max_pool3d(x3, 2, return_mask=True)
+    u3 = F.max_unpool3d(o3, m3, 2)
+    assert u3.shape == [1, 1, 2, 2, 2]
+
+
+def test_dice_loss():
+    probs = F.softmax(paddle.to_tensor(
+        np.random.default_rng(0).random((4, 3)).astype(np.float32)))
+    lbl = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    d = float(F.dice_loss(probs, lbl.unsqueeze(-1)).numpy())
+    assert 0.0 < d < 1.0
+    # perfect prediction -> loss ~ 0
+    perfect = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    d0 = float(F.dice_loss(
+        perfect, paddle.to_tensor(np.array([0, 1, 2]))[..., None]).numpy())
+    assert d0 < 1e-4
+
+
+def test_soft_margin_loss():
+    x = paddle.to_tensor(np.array([0.5, -0.3, 2.0, 0.1], np.float32))
+    y = paddle.to_tensor(np.array([1.0, -1.0, 1.0, -1.0], np.float32))
+    got = float(F.soft_margin_loss(x, y).numpy())
+    ref = np.log1p(np.exp(-y.numpy() * x.numpy())).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    out = F.soft_margin_loss(x, y, reduction="none")
+    assert out.shape == [4]
+
+
+def test_npair_and_triplet_with_distance():
+    rng = np.random.default_rng(1)
+    a = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    p = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    n = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([0, 1, 1, 2]))
+    assert np.isfinite(float(F.npair_loss(a, p, lbl).numpy()))
+    t = float(F.triplet_margin_with_distance_loss(a, p, n).numpy())
+    ts = float(F.triplet_margin_with_distance_loss(a, p, n, swap=True).numpy())
+    assert t >= 0 and ts >= 0
+    # custom distance function
+    l1 = lambda u, v: (u - v).abs().sum(-1)
+    tc = F.triplet_margin_with_distance_loss(a, p, n, distance_function=l1)
+    assert np.isfinite(float(tc.numpy()))
+
+
+def test_hsigmoid_loss_and_layer():
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.standard_normal((9, 8)).astype(np.float32) * .1)
+    lbl = paddle.to_tensor(np.array([0, 3, 7, 9]))
+    loss = F.hsigmoid_loss(x, lbl, 10, w)
+    loss.backward()
+    assert float(loss.numpy()) > 0 and x.grad is not None
+    layer = nn.HSigmoidLoss(8, 6)
+    out = layer(paddle.randn([3, 8]), paddle.to_tensor(np.array([0, 2, 5])))
+    assert np.isfinite(float(out.numpy()))
+
+
+def test_margin_cross_entropy_reduces_to_ce():
+    rng = np.random.default_rng(3)
+    cos = paddle.to_tensor(
+        ((rng.random((4, 5)) * 2 - 1) * 0.9).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    mce = F.margin_cross_entropy(cos, lbl, margin1=1.0, margin2=0.0,
+                                 margin3=0.0, scale=10.0)
+    ref = F.cross_entropy(cos * 10.0, lbl)
+    np.testing.assert_allclose(float(mce.numpy()), float(ref.numpy()),
+                               rtol=1e-5)
+    # with a margin the target-class loss must not decrease
+    m2 = F.margin_cross_entropy(cos, lbl, margin2=0.3, scale=10.0)
+    assert float(m2.numpy()) >= float(mce.numpy())
+    loss, sm = F.margin_cross_entropy(cos, lbl, return_softmax=True,
+                                      reduction="none")
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(4), rtol=1e-5)
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3])), maxlen=4)
+    np.testing.assert_array_equal(m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+    m2 = F.sequence_mask(paddle.to_tensor(np.array([2])), dtype="float32")
+    assert m2.numpy().shape == (1, 2)
+
+
+def test_temporal_shift():
+    # N=1, T=2, C=4: first C/4 channels shift back, next C/4 forward
+    x = paddle.to_tensor(
+        np.arange(8.0, dtype=np.float32).reshape(2, 4, 1, 1))
+    out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25).numpy().reshape(
+        2, 4)
+    # t=0 channel0 <- t=1 channel0 (backward shift)
+    assert out[0, 0] == 4.0 and out[1, 0] == 0.0
+    # t=1 channel1 <- t=0 channel1 (forward shift)
+    assert out[1, 1] == 1.0 and out[0, 1] == 0.0
+    # untouched channels
+    np.testing.assert_array_equal(out[:, 2:], [[2, 3], [6, 7]])
+
+
+def test_gather_tree_reference_example():
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]]))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]]))
+    out = F.gather_tree(ids, parents).numpy().tolist()
+    assert out == [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]]
+
+
+def test_zeropad2d():
+    z = F.zeropad2d(paddle.ones([1, 1, 2, 2]), [1, 0, 2, 1])
+    assert z.shape == [1, 1, 5, 3]
+    assert float(z.numpy().sum()) == 4.0
+
+
+def test_class_center_sample():
+    lbl = paddle.to_tensor(np.array([2, 8, 2]))
+    remapped, sampled = F.class_center_sample(lbl, 10, 4)
+    s = sampled.numpy()
+    assert len(s) == 4 and 2 in s and 8 in s
+    r = remapped.numpy()
+    assert s[r[0]] == 2 and s[r[1]] == 8 and r[0] == r[2]
+
+
+def test_sparse_attention_full_pattern_matches_dense():
+    rng = np.random.default_rng(7)
+    b, h, m, d = 1, 2, 4, 8
+    q, k, v = (paddle.to_tensor(
+        rng.standard_normal((b, h, m, d)).astype(np.float32))
+        for _ in range(3))
+    off = paddle.to_tensor(np.tile(np.array([0, 4, 8, 12, 16]),
+                                   (b, h, 1)))
+    cols = paddle.to_tensor(np.tile(np.tile(np.arange(4), 4), (b, h, 1)))
+    out = F.sparse_attention(q, k, v, off, cols).numpy()
+    for hi in range(h):
+        qt, kt, vt = (t.numpy()[0, hi] for t in (q, k, v))
+        sc = qt @ kt.T / np.sqrt(d)
+        w = np.exp(sc - sc.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out[0, hi], w @ vt, rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_sparse_attention_banded_pattern():
+    # diagonal-only pattern -> output == value rows
+    rng = np.random.default_rng(8)
+    b, h, m, d = 1, 1, 4, 8
+    q, k, v = (paddle.to_tensor(
+        rng.standard_normal((b, h, m, d)).astype(np.float32))
+        for _ in range(3))
+    off = paddle.to_tensor(np.array([[[0, 1, 2, 3, 4]]]))
+    cols = paddle.to_tensor(np.array([[[0, 1, 2, 3]]]))
+    out = F.sparse_attention(q, k, v, off, cols)
+    np.testing.assert_allclose(out.numpy(), v.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_functional_inplace():
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    assert F.relu_(x) is x
+    np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+    F.softmax_(x)
+    np.testing.assert_allclose(float(x.numpy().sum()), 1.0, rtol=1e-6)
+    y = paddle.to_tensor(np.array([-1.0, 0.5], np.float32))
+    F.elu_(y)
+    assert y.numpy()[0] < 0 and y.numpy()[1] == 0.5
+    F.tanh_(y)
+    assert np.all(np.abs(y.numpy()) < 1)
+
+
+def test_beam_search_decoder():
+    paddle.seed(0)
+    V, D, H, B, beam = 7, 8, 8, 2, 3
+    emb = nn.Embedding(V, D)
+    cell = nn.GRUCell(D, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                               beam_size=beam, embedding_fn=emb,
+                               output_fn=proj)
+    out, states, lens = nn.dynamic_decode(
+        dec, inits=paddle.zeros([B, H]), max_step_num=6, return_length=True)
+    assert out.shape[0] == B and out.shape[2] == beam
+    assert out.shape[1] <= 6
+    assert (lens.numpy() <= 6).all()
+    # tile_beam_merge_with_batch helper
+    t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(
+        paddle.to_tensor(np.array([[1.0], [2.0]], np.float32)), beam)
+    assert t.shape == [2 * beam, 1]
+
+
+def test_new_loss_layers():
+    assert float(nn.SoftMarginLoss()(
+        paddle.randn([4]),
+        paddle.to_tensor(np.array([1., -1., 1., -1.], np.float32))
+    ).numpy()) > 0
+    a, p, n = (paddle.randn([4, 8]) for _ in range(3))
+    assert float(nn.TripletMarginWithDistanceLoss(margin=0.5)(
+        a, p, n).numpy()) >= 0
+
+
+def test_softmax2d_layer():
+    out = nn.Softmax2D()(paddle.ones([1, 3, 2, 2]))
+    np.testing.assert_allclose(out.numpy().sum(axis=1),
+                               np.ones((1, 2, 2)), rtol=1e-6)
+    with pytest.raises(ValueError):
+        nn.Softmax2D()(paddle.ones([2, 2]))
