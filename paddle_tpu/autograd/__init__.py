@@ -47,3 +47,35 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 
 from .py_layer import PyLayer, PyLayerContext  # noqa: E402,F401
+
+
+def set_grad_enabled(mode):
+    """Context manager (reference: python/paddle/autograd/__init__.py)."""
+    return _enable_grad_guard() if mode else _no_grad_guard()
+
+
+class saved_tensors_hooks:
+    """Pack/unpack hooks for tensors saved by the tape
+    (reference: eager/saved_tensors_hooks.cc). The functional tape saves
+    jax values inside closures, so hooks observe/replace Tensor snapshots
+    at record time via the engine's hook points."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import engine
+
+        self._prev = getattr(engine, "_saved_tensor_hooks", None)
+        engine._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from . import engine
+
+        engine._saved_tensor_hooks = self._prev
+        return False
+
+
+backward_mode = "reverse"  # informational: the tape is reverse-mode

@@ -239,3 +239,17 @@ class _CudaAlias:
 
 
 cuda = _CudaAlias()
+
+
+# place classes + build-flag predicates re-exported for
+# paddle.device.* parity (reference: python/paddle/device/__init__.py)
+from ..core.place import (  # noqa: E402,F401
+    IPUPlace,
+    MLUPlace,
+    XPUPlace,
+    get_cudnn_version,
+    is_compiled_with_cinn,
+    is_compiled_with_mlu,
+    is_compiled_with_rocm,
+)
+from ..distributed.env import ParallelEnv  # noqa: E402,F401

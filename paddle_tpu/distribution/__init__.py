@@ -439,3 +439,35 @@ def _kl_dirichlet(p, q):
     t += (gammaln(c2) - gammaln(c1)).sum(-1)
     t += ((c1 - c2) * (digamma(c1) - digamma(s1)[..., None])).sum(-1)
     return _t(t)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    python/paddle/distribution/exponential_family.py): entropy via the
+    Bregman identity over natural parameters when not overridden."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+
+        nat = [jnp.asarray(_val(p)) for p in self._natural_parameters]
+        lg_normal, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)), argnums=0)(
+                tuple(nat))
+        ent = -self._mean_carrier_measure + lg_normal
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _t(ent)
+
+
+from . import transform  # noqa: E402,F401

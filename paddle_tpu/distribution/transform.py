@@ -1,0 +1,12 @@
+"""paddle.distribution.transform namespace (reference:
+python/paddle/distribution/transform.py). The Transform classes live in
+the package __init__; this module pins the reference import path."""
+from . import (  # noqa: F401
+    AffineTransform,
+    ExpTransform,
+    SigmoidTransform,
+    Transform,
+)
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform"]

@@ -94,3 +94,53 @@ def ifftshift(x, axes=None, name=None):
     return apply_jfn("ifftshift",
                      lambda v: jnp.fft.ifftshift(v, axes=axes),
                      ensure_tensor(x))
+
+
+def _split_axes(x, s, axes):
+    nd = (x.numpy().ndim if hasattr(x, "numpy") else jnp.asarray(x).ndim)
+    if axes is None:
+        axes = tuple(range(nd)) if s is None else tuple(
+            range(nd - len(s), nd))
+    axes = tuple(a if a >= 0 else nd + a for a in axes)
+    if s is None:
+        s = [None] * len(axes)
+    return list(s), list(axes)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian-input nD FFT (reference: python/paddle/fft.py hfftn):
+    c2c transforms over the leading axes, Hermitian c2r over the last."""
+    s_, axes_ = _split_axes(ensure_tensor(x), s, axes)
+    out = ensure_tensor(x)
+    if len(axes_) > 1:
+        lead_s = [v for v in s_[:-1]]
+        out = fftn(out, s=None if all(v is None for v in lead_s)
+                   else [o or out.shape[a] for o, a in
+                         zip(lead_s, axes_[:-1])],
+                   axes=axes_[:-1], norm=norm)
+    return hfft(out, n=s_[-1], axis=axes_[-1], norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse Hermitian nD FFT: r2c over the last axis, c2c inverses over
+    the rest (reference: python/paddle/fft.py ihfftn)."""
+    s_, axes_ = _split_axes(ensure_tensor(x), s, axes)
+    out = ihfft(ensure_tensor(x), n=s_[-1], axis=axes_[-1], norm=norm)
+    if len(axes_) > 1:
+        lead_s = [v for v in s_[:-1]]
+        out = ifftn(out, s=None if all(v is None for v in lead_s)
+                    else [o or out.shape[a] for o, a in
+                          zip(lead_s, axes_[:-1])],
+                    axes=axes_[:-1], norm=norm)
+    return out
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+__all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]

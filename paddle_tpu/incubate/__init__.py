@@ -1,9 +1,155 @@
-"""paddle_tpu.incubate (reference: python/paddle/incubate/ — optimizer/
-lookahead.py LookAhead:28, modelaverage.py ModelAverage:31; nn fused
-layers; distributed/models/moe lives in paddle_tpu.distributed.moe)."""
+"""paddle.incubate namespace (reference: python/paddle/incubate/__init__.py)."""
+import jax
+import jax.numpy as jnp
+
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import auto_checkpoint  # noqa: F401
+from .optimizer import DistributedFusedLamb  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..geometric import (  # noqa: F401
+    graph_reindex,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from .. import sparse  # noqa: F401
+from ..distributed import fleet  # noqa: F401
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
 
-__all__ = ["optimizer", "nn", "asp", "autograd"]
+__all__ = ["optimizer", "nn", "asp", "autograd", "LookAhead", "DistributedFusedLamb", "checkpoint", "auto_checkpoint",
+           "ModelAverage", "segment_sum", "segment_mean", "segment_max",
+           "segment_min", "graph_send_recv", "graph_reindex",
+           "graph_khop_sampler", "graph_sample_neighbors",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "identity_loss", "autotune", "sparse", "fleet"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference: incubate/operators/
+    softmax_mask_fuse.py → fused CUDA op; XLA fuses the add into the
+    softmax on TPU)."""
+    return apply_jfn(
+        "softmax_mask_fuse",
+        lambda v, m: jax.nn.softmax(v + m.astype(v.dtype), axis=-1),
+        ensure_tensor(x), ensure_tensor(mask))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal), fused
+    (reference: incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+
+    def jfn(v):
+        s, k = v.shape[-2], v.shape[-1]
+        mask = jnp.tril(jnp.ones((s, k), bool), k=k - s)
+        return jax.nn.softmax(
+            jnp.where(mask, v, jnp.asarray(-1e4, v.dtype)), axis=-1)
+
+    return apply_jfn("softmax_mask_fuse_upper_triangle", jfn,
+                     ensure_tensor(x))
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss for IPU-style identity backward (reference:
+    incubate/nn/functional/identity_loss → identity_loss op). On this
+    stack it is the requested reduction with unit gradient."""
+    x = ensure_tensor(x)
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return apply_jfn("identity_loss", jnp.mean, x)
+    if red == "sum":
+        return apply_jfn("identity_loss", jnp.sum, x)
+    return x
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling over a CSC graph (reference:
+    incubate/graph_khop_sampler.py). Host-side (data-dependent shapes)."""
+    import numpy as np
+
+    rows = np.asarray(value_of(ensure_tensor(row)))
+    ptr = np.asarray(value_of(ensure_tensor(colptr)))
+    seeds = np.asarray(value_of(ensure_tensor(input_nodes))).reshape(-1)
+    rng = np.random.default_rng(0)
+    cur = seeds
+    edge_src, edge_dst = [], []
+    for size in sample_sizes:
+        nxt = []
+        for v in cur:
+            beg, end = int(ptr[v]), int(ptr[v + 1])
+            neigh = rows[beg:end]
+            if size >= 0 and len(neigh) > size:
+                neigh = rng.choice(neigh, size=size, replace=False)
+            for u in neigh:
+                edge_src.append(int(u))
+                edge_dst.append(int(v))
+            nxt.extend(int(u) for u in neigh)
+        cur = np.unique(np.asarray(nxt, np.int64)) if nxt else np.asarray(
+            [], np.int64)
+    nodes, remap = np.unique(
+        np.concatenate([seeds, np.asarray(edge_src, np.int64),
+                        np.asarray(edge_dst, np.int64)]),
+        return_inverse=False), None
+    # local reindex (reference returns reindexed edges + unique nodes)
+    lookup = {int(n): i for i, n in enumerate(nodes)}
+    src_l = np.asarray([lookup[s] for s in edge_src], np.int64)
+    dst_l = np.asarray([lookup[d] for d in edge_dst], np.int64)
+    out = (Tensor(jnp.asarray(src_l), stop_gradient=True),
+           Tensor(jnp.asarray(dst_l), stop_gradient=True),
+           Tensor(jnp.asarray(nodes), stop_gradient=True))
+    if return_eids:
+        out = out + (Tensor(jnp.zeros((len(src_l),), jnp.int64),
+                            stop_gradient=True),)
+    return out
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """One-hop neighbor sampling (reference:
+    incubate/graph_sample_neighbors.py). Host-side."""
+    import numpy as np
+
+    rows = np.asarray(value_of(ensure_tensor(row)))
+    ptr = np.asarray(value_of(ensure_tensor(colptr)))
+    seeds = np.asarray(value_of(ensure_tensor(input_nodes))).reshape(-1)
+    rng = np.random.default_rng(0)
+    out_neigh, counts = [], []
+    for v in seeds:
+        beg, end = int(ptr[v]), int(ptr[v + 1])
+        neigh = rows[beg:end]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neigh.extend(int(u) for u in neigh)
+        counts.append(len(neigh))
+    res = (Tensor(jnp.asarray(np.asarray(out_neigh, np.int64)),
+                  stop_gradient=True),
+           Tensor(jnp.asarray(np.asarray(counts, np.int64)),
+                  stop_gradient=True))
+    if return_eids:
+        res = res + (Tensor(jnp.zeros((len(out_neigh),), jnp.int64),
+                            stop_gradient=True),)
+    return res
+
+
+class _Autotune:
+    """Kernel/layout autotune config facade (reference:
+    python/paddle/incubate/autotune.py set_config). XLA autotunes
+    convolution/matmul algorithms itself; this records the request."""
+
+    def __init__(self):
+        self.config = {}
+
+    def set_config(self, config=None):
+        self.config = dict(config or {})
+
+
+autotune = _Autotune()

@@ -1,0 +1,5 @@
+"""paddle.incubate.checkpoint (reference:
+python/paddle/incubate/checkpoint/auto_checkpoint.py)."""
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = ["auto_checkpoint"]

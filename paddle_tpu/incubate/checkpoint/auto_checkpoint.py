@@ -1,0 +1,43 @@
+"""Epoch-level auto checkpointing (reference:
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py — HDFS-scoped
+job snapshots with train-range resume). TPU build: snapshots go through
+distributed.checkpoint's sharded save under a job-id-scoped local dir
+(point it at a mounted share for the multi-host case); `train_epoch_range`
+yields only the epochs that still need running after a restart."""
+import json
+import os
+
+__all__ = ["train_epoch_range"]
+
+def _status_path():
+    # env read at call time so tests/jobs can redirect per-run
+    root = os.environ.get("PADDLE_AUTO_CHECKPOINT_DIR", "./auto_checkpoint")
+    job = os.environ.get("PADDLE_JOB_ID", "job_default")
+    return os.path.join(root, job, "range_status.json")
+
+
+def _load_status():
+    try:
+        with open(_status_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_status(status):
+    os.makedirs(os.path.dirname(_status_path()), exist_ok=True)
+    tmp = _status_path() + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(status, f)
+    os.replace(tmp, _status_path())
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None):
+    """Generator over epochs that resumes after the last completed one
+    (reference auto_checkpoint.py:train_epoch_range)."""
+    status = _load_status()
+    start = int(status.get("last_completed", -1)) + 1
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        status["last_completed"] = epoch
+        _save_status(status)

@@ -92,3 +92,31 @@ class ModelAverage:
         for p, v in zip(self._params, self._backup):
             p._value = v
         self._backup = None
+
+
+class DistributedFusedLamb:
+    """LAMB with fused/sharded apply (reference:
+    incubate/optimizer/distributed_fused_lamb.py). On this stack the
+    compiled train step already fuses the update across the param pytree
+    and ZeRO sharding comes from DistributedTrainStep, so this wraps the
+    stock Lamb with the same constructor surface."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, use_master_param_norm=True, name=None):
+        from ..optimizer import Lamb
+
+        self._inner = Lamb(
+            learning_rate=learning_rate,
+            lamb_weight_decay=lamb_weight_decay,
+            beta1=beta1, beta2=beta2, epsilon=epsilon,
+            parameters=parameters, grad_clip=grad_clip,
+            exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+__all__.append("DistributedFusedLamb")

@@ -463,3 +463,82 @@ class TrainStep:
             p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
         return Tensor(loss)
+
+
+class ProgramTranslator:
+    """Global dy2static switch (reference:
+    fluid/dygraph/dygraph_to_static/program_translator.py). Trace capture
+    replaces AST rewriting here; the switch gates whether to_static
+    functions trace or fall through to eager."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static):
+        self.enable_to_static = bool(enable_to_static)
+        enable_to_static_fn = globals().get("enable_to_static")
+        if enable_to_static_fn is not None:
+            enable_to_static_fn(bool(enable_to_static))
+
+
+class TracedLayer:
+    """dygraph→traced executable wrapper (reference:
+    fluid/dygraph/jit.py TracedLayer). On this stack trace() is just
+    to_static capture; save_inference_model delegates to jit.save."""
+
+    def __init__(self, static_fn, layer):
+        self._fn = static_fn
+        self._layer = layer
+
+    @staticmethod
+    def trace(layer, inputs):
+        fn = to_static(layer.forward)
+        outs = fn(*inputs)
+        return outs, TracedLayer(fn, layer)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **configs):
+        return save(self._layer, path, **configs)
+
+
+_log_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static debug verbosity (reference: jit/api set_verbosity).
+    Tracing has no transform pipeline to log; the level is recorded and
+    exposed for tooling."""
+    global _log_verbosity
+    _log_verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """(reference: jit/api set_code_level) — records the requested level;
+    there is no transformed source to print under trace capture."""
+    global _code_level
+    _code_level = int(level)
+
+
+class _Dy2StaticNamespace:
+    """paddle.jit.dy2static compatibility surface."""
+
+    ProgramTranslator = ProgramTranslator
+    set_verbosity = staticmethod(set_verbosity)
+    set_code_level = staticmethod(set_code_level)
+
+
+dy2static = _Dy2StaticNamespace()
+
+__all__ += ["ProgramTranslator", "TracedLayer", "set_verbosity",
+            "set_code_level", "dy2static"]

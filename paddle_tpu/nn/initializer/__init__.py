@@ -240,3 +240,29 @@ class Dirac(Initializer):
             for i in range(min(per_group, in_c)):
                 arr[(g * per_group + i, i) + centers] = 1.0
         return jnp.asarray(arr, dtype_mod.convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs
+    (reference: python/paddle/nn/initializer/Bilinear ←
+    fluid/initializer.py BilinearInitializer): each output channel gets
+    the separable triangle filter that linearly interpolates."""
+
+    def _init(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear init expects a 4-D conv kernel")
+        kh, kw = int(shape[2]), int(shape[3])
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy = 1 - np.abs(np.arange(kh) / fh - ch)
+        xx = 1 - np.abs(np.arange(kw) / fw - cw)
+        filt = np.outer(yy, xx).astype("float32")
+        weight = np.zeros(shape, "float32")
+        for o in range(shape[0]):
+            for i in range(shape[1]):
+                weight[o, i] = filt
+        return jnp.asarray(weight, dtype_mod.convert_dtype(dtype))
+
+
+__all__.append("Bilinear")

@@ -238,3 +238,76 @@ class Profiler:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class SortedKeys:
+    """Sort orders for summary tables (reference:
+    python/paddle/profiler/profiler.py SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary table views (reference: profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class TracerEventType:
+    """Event categories (reference: profiler/profiler_statistic.py)."""
+
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    CudaRuntime = 3
+    Kernel = 4
+    Memcpy = 5
+    Memset = 6
+    UserDefined = 7
+    OperatorInner = 8
+    Forward = 9
+    Backward = 10
+    Optimization = 11
+    Communication = 12
+    PythonOp = 13
+    PythonUserDefined = 14
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """on_trace_ready exporter writing the raw xplane protobuf dump
+    (jax's profiler already persists .xplane.pb under the log dir)."""
+
+    def handler(prof):
+        return dir_name
+
+    return handler
+
+
+def load_profiler_result(file_name):
+    """Load an exported trace for postprocessing. The jax/xprof trace is
+    the artifact; return the path handle (statistics tables are produced
+    by xprof tooling, not re-parsed here)."""
+    import os
+
+    if not os.path.exists(file_name):
+        raise FileNotFoundError(file_name)
+    return file_name
+
+
+__all__ += ["SortedKeys", "SummaryView", "TracerEventType",
+            "export_protobuf", "load_profiler_result"]

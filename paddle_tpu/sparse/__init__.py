@@ -302,3 +302,39 @@ def masked_matmul(x, y, mask, name=None):
 
     vals = apply_jfn("sparse_masked_matmul", jfn, x, y)
     return SparseCooTensor(mask.indices_, vals, mask.shape)
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored values of a 2-D sparse matrix
+    (reference: incubate/sparse/nn/functional/activation.py softmax —
+    only the nnz entries participate, matching the CSR kernel)."""
+    import jax
+
+    if axis not in (-1, 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    rows = value_of(x.indices_)[0].astype(jnp.int32)
+    n_rows = int(x.shape[0])
+
+    def jfn(v):
+        rowmax = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        rowmax = jnp.where(jnp.isfinite(rowmax), rowmax, 0.0)
+        e = jnp.exp(v - rowmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / denom[rows]
+
+    out_vals = apply_jfn("sparse_softmax", jfn, x.values_)
+    return SparseCooTensor(x.indices_, out_vals, x.shape, x._coalesced)
+
+
+def is_same_shape(x, y):
+    """Shape equality across sparse/dense operands
+    (reference: incubate/sparse/binary.py is_same_shape)."""
+    return list(x.shape) == list(y.shape)
+
+
+from . import nn  # noqa: E402,F401
+
+__all__ += ["relu", "softmax", "is_same_shape", "nn"]

@@ -1,0 +1,29 @@
+"""paddle.sparse.nn namespace (reference: python/paddle/incubate/sparse/nn):
+activation layers operating on sparse tensors (values-wise)."""
+from ..nn.layer.layers import Layer
+from . import relu as _relu_fn
+from . import SparseCooTensor
+
+__all__ = ["ReLU", "Softmax"]
+
+
+class ReLU(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return _relu_fn(x)
+
+
+class Softmax(Layer):
+    """Softmax over the last dense axis of a sparse CSR/COO matrix's rows
+    (reference: incubate/sparse/nn/layer/activation.py Softmax): computed
+    over the stored values per row."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from . import softmax as _softmax_fn
+
+        return _softmax_fn(x)

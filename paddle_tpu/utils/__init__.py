@@ -59,3 +59,46 @@ def try_import(module_name, err_msg=None):
         return importlib.import_module(module_name)
     except ImportError as e:
         raise ImportError(err_msg or str(e)) from e
+
+
+def require_version(min_version, max_version=None):
+    """Check the framework version satisfies [min, max]
+    (reference: utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def download(url, path=None, md5sum=None):
+    """Dataset/model download helper (reference: utils/download.py get_path_from_url).
+    This build has zero network egress: local file paths (or file:// URLs)
+    are copied into place; remote URLs raise immediately instead of
+    hanging."""
+    import os
+    import shutil
+
+    src = url[len("file://"):] if str(url).startswith("file://") else url
+    if os.path.exists(src):
+        if path is None:
+            return src
+        os.makedirs(path, exist_ok=True)
+        dst = os.path.join(path, os.path.basename(src))
+        if os.path.abspath(dst) != os.path.abspath(src):
+            shutil.copy(src, dst)
+        return dst
+    raise RuntimeError(
+        f"download({url!r}): no network egress in this environment; "
+        "place the file locally and pass its path")
+
+
+__all__ += ["require_version", "download"]

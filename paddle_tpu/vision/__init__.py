@@ -1,3 +1,38 @@
-"""paddle.vision parity (reference: python/paddle/vision/)."""
+"""paddle.vision parity (reference: python/paddle/vision/__init__.py —
+which flat re-exports the models, transforms and dataset classes)."""
 from . import datasets, models, ops, transforms  # noqa: F401
-from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
+from .datasets import *  # noqa: F401,F403
+from .models import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend):
+    """Reference supports pil/cv2; this build decodes via numpy."""
+    global _image_backend
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as an HWC numpy array. PNG/PPM/BMP via pure
+    numpy paths; JPEG requires an installed decoder and raises otherwise
+    (no PIL/cv2 in this environment — reference: vision/image.py)."""
+    import numpy as np
+
+    try:
+        from PIL import Image  # pragma: no cover - not in this image
+
+        return np.asarray(Image.open(path))
+    except ImportError:
+        pass
+    raise RuntimeError(
+        "image_load requires an image decoding backend (PIL/cv2), which "
+        "this environment does not provide; datasets in "
+        "paddle_tpu.vision.datasets decode their own formats")

@@ -266,3 +266,24 @@ __all__ += [
     "adjust_hue", "rotate", "affine", "perspective", "erase", "crop",
     "center_crop", "to_grayscale", "functional",
 ]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Functional pad (reference: vision/transforms/functional.py pad)."""
+    import numpy as np
+
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    cfg = ((t, b), (l, r), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(img, cfg, constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, cfg, mode=mode)
+
+
+__all__.append("pad")

@@ -1,0 +1,161 @@
+"""Namespace-parity additions: fft hermitian nd, autograd extras,
+distribution transform/ExponentialFamily, sparse nn/softmax, incubate
+graph+fused ops, jit dy2static shims, vision flat exports
+(reference: the matching python/paddle/* __init__ export lists)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_hermitian_fft_roundtrips():
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 6)).astype(np.float64))
+    back = paddle.fft.hfftn(paddle.fft.ihfftn(x), s=[4, 6])
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-8,
+                               atol=1e-9)
+    back2 = paddle.fft.hfft2(paddle.fft.ihfft2(x), s=[4, 6])
+    np.testing.assert_allclose(back2.numpy(), x.numpy(), rtol=1e-8,
+                               atol=1e-9)
+
+
+def test_autograd_set_grad_enabled_and_hooks():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    with paddle.autograd.set_grad_enabled(False):
+        y = x * 3
+    assert y.stop_gradient
+    with paddle.autograd.saved_tensors_hooks(lambda t: t, lambda t: t):
+        pass
+    assert paddle.autograd.backward_mode == "reverse"
+
+
+def test_distribution_transform_namespace():
+    t = paddle.distribution.transform.ExpTransform()
+    assert t is not None
+    assert issubclass(paddle.distribution.ExponentialFamily,
+                      paddle.distribution.Distribution)
+
+
+def test_sparse_relu_softmax():
+    from paddle_tpu import sparse
+
+    x = sparse.sparse_coo_tensor(
+        np.array([[0, 0, 1], [0, 1, 1]]),
+        np.array([-1.0, 2.0, 3.0]), shape=[2, 2])
+    np.testing.assert_allclose(sparse.relu(x).values_.numpy(), [0, 2, 3])
+    sm = sparse.softmax(x)
+    vals = sm.values_.numpy()
+    np.testing.assert_allclose(vals[0] + vals[1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(vals[2], 1.0, rtol=1e-6)
+    assert sparse.is_same_shape(x, x)
+    layer = sparse.nn.ReLU()
+    np.testing.assert_allclose(layer(x).values_.numpy(), [0, 2, 3])
+
+
+def test_incubate_fused_softmax_ops():
+    from paddle_tpu import incubate as inc
+
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((1, 1, 3, 3)).astype(
+            np.float32))
+    m = paddle.zeros([1, 1, 3, 3])
+    out = inc.softmax_mask_fuse(x, m)
+    np.testing.assert_allclose(out.numpy().sum(-1), np.ones((1, 1, 3)),
+                               rtol=1e-5)
+    tri = inc.softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+    assert tri[0, 1] < 1e-4 and tri[0, 0] == pytest.approx(1.0, rel=1e-5)
+
+
+def test_incubate_graph_sampling():
+    from paddle_tpu import incubate as inc
+
+    # 3-node ring (CSC): neighbors of 0 are {1,2}, of 1 {0,2}, of 2 {0,1}
+    row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1]))
+    colptr = paddle.to_tensor(np.array([0, 2, 4, 6]))
+    n, c = inc.graph_sample_neighbors(
+        row, colptr, paddle.to_tensor(np.array([0, 1])), sample_size=1)
+    assert c.numpy().tolist() == [1, 1] and len(n.numpy()) == 2
+    src, dst, nodes = inc.graph_khop_sampler(
+        row, colptr, paddle.to_tensor(np.array([0])), [2])
+    assert 0 in nodes.numpy()
+    assert (dst.numpy() < len(nodes.numpy())).all()
+
+
+def test_incubate_identity_loss_and_lamb():
+    from paddle_tpu import incubate as inc
+
+    x = paddle.to_tensor([1.0, 3.0])
+    assert float(inc.identity_loss(x, "mean").numpy()) == 2.0
+    assert float(inc.identity_loss(x, 0).numpy()) == 4.0
+    m = paddle.nn.Linear(2, 2)
+    opt = inc.DistributedFusedLamb(parameters=m.parameters())
+    assert type(opt._inner).__name__ == "Lamb"
+    inc.autotune.set_config({"kernel": {"enable": True}})
+    assert inc.autotune.config["kernel"]["enable"]
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_AUTO_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "t1")
+    from paddle_tpu.incubate import auto_checkpoint as ac
+
+    done = []
+    for epoch in ac.train_epoch_range(3):
+        done.append(epoch)
+        if epoch == 1:
+            break  # simulated crash DURING epoch 1 (only 0 completed)
+    # resume re-runs the interrupted epoch 1, then 2
+    rest = list(ac.train_epoch_range(3))
+    assert done == [0, 1] and rest == [1, 2]
+
+
+def test_jit_dy2static_shims():
+    pt = paddle.jit.ProgramTranslator.get_instance()
+    pt.enable(True)
+    paddle.jit.set_verbosity(3)
+    paddle.jit.set_code_level(50)
+    layer = paddle.nn.Linear(2, 2)
+    x = paddle.ones([1, 2])
+    out, traced = paddle.jit.TracedLayer.trace(layer, [x])
+    np.testing.assert_allclose(traced(x).numpy(), out.numpy(), rtol=1e-6)
+
+
+def test_vision_flat_exports():
+    assert paddle.vision.MobileNetV1 is not None
+    assert paddle.vision.ColorJitter is not None
+    assert paddle.vision.resnet18 is not None
+    paddle.vision.set_image_backend("numpy")
+    assert paddle.vision.get_image_backend() == "numpy"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("bogus")
+    img = np.ones((2, 2, 3), np.uint8)
+    assert paddle.vision.transforms.pad(img, 1).shape == (4, 4, 3)
+    assert paddle.vision.transforms.pad(
+        img, (1, 0), padding_mode="edge").shape == (2, 4, 3)
+
+
+def test_initializer_bilinear():
+    w = paddle.nn.initializer.Bilinear()._init((2, 1, 4, 4), "float32")
+    w = np.asarray(w)
+    assert w.shape == (2, 1, 4, 4)
+    np.testing.assert_allclose(w[0, 0], w[1, 0])
+    # symmetric triangle filter
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1])
+
+
+def test_device_profiler_utils_exports():
+    assert paddle.device.ParallelEnv is not None
+    assert paddle.device.get_cudnn_version() is None
+    assert paddle.profiler.SortedKeys.CPUTotal == 0
+    assert paddle.profiler.TracerEventType.Kernel == 4
+    handler = paddle.profiler.export_protobuf("/tmp/x")
+    assert callable(handler)
+    with pytest.raises(FileNotFoundError):
+        paddle.profiler.load_profiler_result("/nonexistent/file")
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0.0")
+    with pytest.raises(RuntimeError):
+        paddle.utils.download("http://example.com/x.bin")
