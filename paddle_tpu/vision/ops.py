@@ -206,3 +206,554 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output into boxes+scores (reference:
+    python/paddle/vision/ops.py yolo_box → yolo_box_op).
+
+    x: [N, na*(5+class_num), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, na*H*W, 4] xyxy in image pixels,
+             scores [N, na*H*W, class_num])."""
+    x = ensure_tensor(x)
+    img_size = ensure_tensor(img_size)
+    na = len(anchors) // 2
+    anchor_wh = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def jfn(v, isz):
+        n, c, h, w = v.shape
+        attrs = 5 + class_num + (1 if iou_aware else 0)
+        if iou_aware:
+            # layout: [na*iou, na*(5+cls)] — iou logits first
+            iou_p = jax.nn.sigmoid(
+                v[:, :na].reshape(n, na, 1, h, w))
+            v = v[:, na:]
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        tx, ty, tw, th = v[:, :, 0], v[:, :, 1], v[:, :, 2], v[:, :, 3]
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        cls = jax.nn.sigmoid(v[:, :, 5:])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                iou_p[:, :, 0] ** iou_aware_factor
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * scale_x_y - bias + gx) / w
+        cy = (jax.nn.sigmoid(ty) * scale_x_y - bias + gy) / h
+        aw = anchor_wh[:, 0][None, :, None, None]
+        ah = anchor_wh[:, 1][None, :, None, None]
+        bw = jnp.exp(tw) * aw / (downsample_ratio * w)
+        bh = jnp.exp(th) * ah / (downsample_ratio * h)
+        im_h = isz[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = isz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (cx - bw / 2) * im_w
+        y0 = (cy - bh / 2) * im_h
+        x1 = (cx + bw / 2) * im_w
+        y1 = (cy + bh / 2) * im_h
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0.0, im_w - 1)
+            y0 = jnp.clip(y0, 0.0, im_h - 1)
+            x1 = jnp.clip(x1, 0.0, im_w - 1)
+            y1 = jnp.clip(y1, 0.0, im_h - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(n, -1, 4)
+        keep = (conf > conf_thresh).astype(cls.dtype)
+        scores = (conf[:, :, None] * cls * keep[:, :, None]).transpose(
+            0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        return boxes, scores
+
+    return apply_jfn("yolo_box", jfn, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: vision/ops.py yolo_loss →
+    yolov3_loss op): xy/wh regression on responsible anchors, objectness
+    with an IoU-ignore band, and per-class BCE.
+
+    x: [N, na*(5+cls), H, W]; gt_box: [N, B, 4] (cx, cy, w, h in image
+    units); gt_label: [N, B]. Returns per-image loss [N]."""
+    x = ensure_tensor(x)
+    gt_box = ensure_tensor(gt_box)
+    gt_label = ensure_tensor(gt_label)
+    tensors = [x, gt_box, gt_label]
+    if gt_score is not None:
+        tensors.append(ensure_tensor(gt_score))
+    full_wh = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_wh = full_wh[np.asarray(anchor_mask)]
+    na = len(anchor_mask)
+
+    def bce(pred_logit, target):
+        return jax.nn.softplus(pred_logit) - target * pred_logit
+
+    def jfn(v, gtb, gtl, *rest):
+        n, c, h, w = v.shape
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        input_size = downsample_ratio * h  # square net input assumption
+        gscore = (rest[0] if rest else
+                  jnp.ones(gtb.shape[:2], jnp.float32))
+        # normalize gt to [0,1] grid space
+        gx = gtb[..., 0] / input_size
+        gy = gtb[..., 1] / input_size
+        gw = gtb[..., 2] / input_size
+        gh = gtb[..., 3] / input_size
+        valid = (gw > 0) & (gh > 0)                       # [N, B]
+        # best anchor per gt by wh IoU against ALL anchors
+        fa = full_wh / input_size                         # [A, 2]
+        inter = jnp.minimum(gw[..., None], fa[:, 0]) * \
+            jnp.minimum(gh[..., None], fa[:, 1])
+        union = gw[..., None] * gh[..., None] + \
+            fa[:, 0] * fa[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N, B]
+        # responsible only if the best anchor belongs to this head's mask
+        mask_arr = jnp.asarray(np.asarray(anchor_mask))
+        in_mask = (best[..., None] == mask_arr).any(-1) & valid
+        local_a = jnp.argmax(
+            (best[..., None] == mask_arr).astype(jnp.int32), -1)
+        ci = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        # scatter gt into [N, na, h, w] target planes
+        bidx = jnp.arange(n)[:, None]
+        tgt_shape = (n, na, h, w)
+        sel = (bidx, local_a, cj, ci)
+
+        def scat(vals, base=0.0):
+            t = jnp.full(tgt_shape, base, jnp.float32)
+            return t.at[sel].set(jnp.where(in_mask, vals, base),
+                                 mode="drop")
+
+        obj_t = scat(jnp.where(in_mask, 1.0, 0.0))
+        tscore = scat(gscore)
+        tx_t = scat(gx * w - ci)
+        ty_t = scat(gy * h - cj)
+        ma = mask_wh / input_size
+        aw_sel = ma[:, 0][local_a]
+        ah_sel = ma[:, 1][local_a]
+        tw_t = scat(jnp.log(jnp.maximum(gw / jnp.maximum(aw_sel, 1e-10),
+                                        1e-10)))
+        th_t = scat(jnp.log(jnp.maximum(gh / jnp.maximum(ah_sel, 1e-10),
+                                        1e-10)))
+        tcls = jnp.zeros((n, na, h, w, class_num), jnp.float32)
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
+        onehot = onehot * (1.0 - 2 * smooth) + smooth
+        tcls = tcls.at[sel].set(
+            jnp.where(in_mask[..., None], onehot, 0.0), mode="drop")
+
+        # box size weight: bigger loss weight for small boxes
+        wgt = scat(2.0 - gw * gh) * tscore
+
+        px, py = v[:, :, 0], v[:, :, 1]
+        pw, ph = v[:, :, 2], v[:, :, 3]
+        pobj, pcls = v[:, :, 4], v[:, :, 5:].transpose(0, 1, 3, 4, 2)
+        loss_xy = (bce(px, tx_t) + bce(py, ty_t)) * wgt
+        loss_wh = (jnp.abs(pw - tw_t) + jnp.abs(ph - th_t)) * wgt
+        loss_cls = (bce(pcls, tcls).sum(-1)) * obj_t * tscore
+
+        # objectness: ignore predictions overlapping any gt > thresh
+        gxp = (jax.nn.sigmoid(px) + jnp.arange(w, dtype=jnp.float32)) / w
+        gyp = (jax.nn.sigmoid(py) +
+               jnp.arange(h, dtype=jnp.float32)[:, None]) / h
+        bwp = jnp.exp(pw) * (ma[:, 0][None, :, None, None])
+        bhp = jnp.exp(ph) * (ma[:, 1][None, :, None, None])
+        p0x, p0y = gxp - bwp / 2, gyp - bhp / 2
+        p1x, p1y = gxp + bwp / 2, gyp + bhp / 2
+        g0x, g0y = gx - gw / 2, gy - gh / 2
+        g1x, g1y = gx + gw / 2, gy + gh / 2
+        ix = jnp.maximum(
+            jnp.minimum(p1x[..., None], g1x[:, None, None, None]) -
+            jnp.maximum(p0x[..., None], g0x[:, None, None, None]), 0.0)
+        iy = jnp.maximum(
+            jnp.minimum(p1y[..., None], g1y[:, None, None, None]) -
+            jnp.maximum(p0y[..., None], g0y[:, None, None, None]), 0.0)
+        inter_p = ix * iy
+        union_p = (bwp * bhp)[..., None] + (gw * gh)[:, None, None, None] \
+            - inter_p
+        iou_p = inter_p / jnp.maximum(union_p, 1e-10)
+        iou_p = jnp.where(valid[:, None, None, None], iou_p, 0.0)
+        ignore = (iou_p.max(-1) > ignore_thresh) & (obj_t == 0)
+        loss_obj = jnp.where(
+            ignore, 0.0,
+            bce(pobj, obj_t) * jnp.where(obj_t > 0, tscore, 1.0))
+        per_img = (loss_xy + loss_wh + loss_obj).sum((1, 2, 3)) + \
+            loss_cls.sum((1, 2, 3))
+        return per_img
+
+    return apply_jfn("yolo_loss", jfn, *tensors)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference: vision/ops.py matrix_nms →
+    matrix_nms_op): scores decay by pairwise IoU instead of hard
+    suppression. Host-driven output assembly (dynamic counts)."""
+    bb = np.asarray(value_of(ensure_tensor(bboxes)), np.float32)
+    sc = np.asarray(value_of(ensure_tensor(scores)), np.float32)
+    n, m = sc.shape[0], sc.shape[2]
+    outs, indices, counts = [], [], []
+    offset = 0.0 if normalized else 1.0
+    for b in range(n):
+        dets_b = []
+        idx_b = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[b, order]
+            s_c = s[order].copy()
+            # pairwise IoU (upper triangle: j suppressed by higher i)
+            x0, y0, x1, y1 = boxes_c.T
+            area = (x1 - x0 + offset) * (y1 - y0 + offset)
+            ix0 = np.maximum(x0[:, None], x0[None, :])
+            iy0 = np.maximum(y0[:, None], y0[None, :])
+            ix1 = np.minimum(x1[:, None], x1[None, :])
+            iy1 = np.minimum(y1[:, None], y1[None, :])
+            iw = np.maximum(ix1 - ix0 + offset, 0)
+            ih = np.maximum(iy1 - iy0 + offset, 0)
+            iou = iw * ih / np.maximum(
+                area[:, None] + area[None, :] - iw * ih, 1e-10)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :],
+                                                1e-10)).min(0)
+            s_dec = s_c * decay
+            for j in range(len(order)):
+                if s_dec[j] > post_threshold:
+                    dets_b.append([c, s_dec[j], *boxes_c[j]])
+                    idx_b.append(b * m + order[j])
+        if dets_b:
+            dets_b = np.asarray(dets_b, np.float32)
+            idx_b = np.asarray(idx_b, np.int64)
+            top = np.argsort(-dets_b[:, 1])[:keep_top_k]
+            dets_b, idx_b = dets_b[top], idx_b[top]
+            outs.append(dets_b)
+            indices.append(idx_b)
+            counts.append(len(dets_b))
+        else:
+            counts.append(0)
+    out = (np.concatenate(outs) if outs
+           else np.zeros((0, 6), np.float32))
+    index = (np.concatenate(indices) if indices
+             else np.zeros((0,), np.int64))
+    rets = [Tensor(jnp.asarray(out), stop_gradient=True)]
+    if return_index:
+        rets.append(Tensor(jnp.asarray(index), stop_gradient=True))
+    if return_rois_num:
+        rets.append(Tensor(jnp.asarray(np.asarray(counts, np.int32)),
+                           stop_gradient=True))
+    return tuple(rets) if len(rets) > 1 else rets[0]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool → psroi_pool_op): input channels C = out_c·ph·pw; output
+    bin (i, j) average-pools its own channel group inside that bin."""
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(value_of(ensure_tensor(boxes_num)))
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def jfn(xv, bv):
+        n, c, hh, ww = xv.shape
+        out_c = c // (ph * pw)
+        rois = bv * spatial_scale
+        nb = bv.shape[0]
+        bi = jnp.asarray(batch_idx, jnp.int32)
+        x0, y0, x1, y1 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
+        rh = jnp.maximum(y1 - y0, 0.1) / ph
+        rw = jnp.maximum(x1 - x0, 0.1) / pw
+        feats = xv.reshape(n, out_c, ph * pw, hh, ww)
+
+        # integral-image average per bin: cumulative sum trick over H, W
+        csum = jnp.cumsum(jnp.cumsum(feats, -1), -2)
+        csum = jnp.pad(csum, ((0, 0), (0, 0), (0, 0), (1, 0), (1, 0)))
+
+        def bin_mean(r):  # r: roi index
+            outs = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = jnp.floor(y0[r] + i * rh[r]).astype(jnp.int32)
+                    he = jnp.ceil(y0[r] + (i + 1) * rh[r]).astype(jnp.int32)
+                    ws = jnp.floor(x0[r] + j * rw[r]).astype(jnp.int32)
+                    we = jnp.ceil(x0[r] + (j + 1) * rw[r]).astype(jnp.int32)
+                    hs = jnp.clip(hs, 0, hh)
+                    he = jnp.clip(he, 0, hh)
+                    ws = jnp.clip(ws, 0, ww)
+                    we = jnp.clip(we, 0, ww)
+                    plane = csum[bi[r], :, i * pw + j]
+                    total = (plane[:, he, we] - plane[:, hs, we]
+                             - plane[:, he, ws] + plane[:, hs, ws])
+                    cnt = jnp.maximum((he - hs) * (we - ws), 1)
+                    outs.append(total / cnt)
+            return jnp.stack(outs, -1).reshape(-1, ph, pw)
+
+        return jax.vmap(bin_mean)(jnp.arange(nb))
+
+    return apply_jfn("psroi_pool", jfn, x, boxes)
+
+
+class PSRoIPool:
+    """Layer wrapper (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: vision/ops.py
+    deform_conv2d → deformable_conv op): bilinear-sample the input at
+    offset positions per kernel tap, then contract with the weight.
+
+    offset: [N, 2·dg·kh·kw, H_out, W_out]; mask (v2): [N, dg·kh·kw, ...]."""
+    x = ensure_tensor(x)
+    offset = ensure_tensor(offset)
+    weight = ensure_tensor(weight)
+    tensors = [x, offset, weight]
+    if mask is not None:
+        tensors.append(ensure_tensor(mask))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def jfn(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        n, c, h, w = xv.shape
+        out_c, cpg, kh, kw = wv.shape
+        ho = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        wo = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        dg = deformable_groups
+        cpg_d = c // dg
+        ov = ov.reshape(n, dg, kh * kw, 2, ho, wo)
+        xg = xv.reshape(n, dg, cpg_d, h, w)
+        base_y = (jnp.arange(ho) * s[0] - p[0])[:, None]
+        base_x = (jnp.arange(wo) * s[1] - p[1])[None, :]
+        i_n = jnp.arange(n)[:, None, None, None]
+        i_g = jnp.arange(dg)[None, :, None, None]
+        taps = []
+        for ki in range(kh):
+            for kj in range(kw):
+                tap = ki * kw + kj
+                py = base_y + ki * d[0] + ov[:, :, tap, 0]  # [n,dg,ho,wo]
+                px = base_x + kj * d[1] + ov[:, :, tap, 1]
+                y0 = jnp.floor(py)
+                x0f = jnp.floor(px)
+                wy = py - y0
+                wx = px - x0f
+                vals = jnp.zeros((n, dg, cpg_d, ho, wo), xv.dtype)
+                for dy in (0, 1):
+                    for dx in (0, 1):
+                        yy = (y0 + dy).astype(jnp.int32)
+                        xx = (x0f + dx).astype(jnp.int32)
+                        ok = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                        yy = jnp.clip(yy, 0, h - 1)
+                        xx = jnp.clip(xx, 0, w - 1)
+                        wgt = (jnp.where(dy == 1, wy, 1 - wy)
+                               * jnp.where(dx == 1, wx, 1 - wx)
+                               * ok).astype(xv.dtype)
+                        # advanced idx around the ':' puts the broadcast
+                        # dims first: [n, dg, ho, wo, cpg_d]
+                        gathered = xg[i_n, i_g, :, yy, xx]
+                        vals = vals + jnp.moveaxis(gathered, -1, 2) \
+                            * wgt[:, :, None]
+                if mv is not None:
+                    m_t = mv.reshape(n, dg, kh * kw, ho, wo)[:, :, tap]
+                    vals = vals * m_t[:, :, None]
+                taps.append(vals.reshape(n, c, ho, wo))
+        patches = jnp.stack(taps, 2)  # [n, c, kh*kw, ho, wo]
+        patches = patches.reshape(n, groups, c // groups, kh * kw, ho, wo)
+        wv2 = wv.reshape(groups, out_c // groups, cpg, kh, kw)
+        wv2 = wv2.reshape(groups, out_c // groups, cpg * kh * kw)
+        pat = patches.reshape(n, groups, (c // groups) * kh * kw, ho * wo)
+        out = jnp.einsum("goc,ngcl->ngol", wv2, pat)
+        out = out.reshape(n, out_c, ho, wo)
+        if bv is not None:
+            out = out + bv.reshape(1, -1, 1, 1)
+        return out
+
+    return apply_jfn("deform_conv2d", jfn, *tensors)
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 1-D tensor (reference: vision/ops.py
+    read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data), stop_gradient=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference: vision/ops.py decode_jpeg → nvjpeg). No
+    JPEG decoder ships in this environment; raises with guidance rather
+    than silently producing wrong pixels."""
+    raise RuntimeError(
+        "decode_jpeg requires an image codec (nvjpeg/PIL), none of which "
+        "exist in this environment; decode on the host data pipeline "
+        "before feeding tensors")
+
+
+__all__ += ["yolo_box", "yolo_loss", "matrix_nms", "psroi_pool",
+            "PSRoIPool", "deform_conv2d", "read_file", "decode_jpeg"]
+
+
+class DeformConv2D:
+    """Deformable conv layer owning weight/bias (reference: vision/ops.py
+    DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        helper = nn.Layer()
+        self.weight = helper.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], weight_attr)
+        self.bias = (None if bias_attr is False else helper.create_parameter(
+            [out_channels], bias_attr, is_bias=True))
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals → distribute_fpn_proposals_op). Host-side
+    (dynamic per-level counts)."""
+    rois = np.asarray(value_of(ensure_tensor(fpn_rois)), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = np.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+    h = np.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    multi_rois, restore_parts, nums = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx]),
+                                 stop_gradient=True))
+        restore_parts.append(idx)
+        if rois_num is not None:
+            bn = np.asarray(value_of(ensure_tensor(rois_num)))
+            owner = np.repeat(np.arange(len(bn)), bn)
+            nums.append(Tensor(jnp.asarray(np.bincount(
+                owner[idx], minlength=len(bn)).astype(np.int32)),
+                stop_gradient=True))
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_t = Tensor(jnp.asarray(restore.reshape(-1, 1)),
+                       stop_gradient=True)
+    if rois_num is not None:
+        return multi_rois, restore_t, nums
+    return multi_rois, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals → generate_proposals_v2 op): decode deltas against
+    anchors, clip to the image, drop tiny boxes, top-k + NMS. Host-side
+    (dynamic counts), math on device arrays.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors [H, W, A, 4];
+    variances [H, W, A, 4]; img_size [N, 2] (h, w)."""
+    sc = np.asarray(value_of(ensure_tensor(scores)), np.float32)
+    dl = np.asarray(value_of(ensure_tensor(bbox_deltas)), np.float32)
+    an = np.asarray(value_of(ensure_tensor(anchors)), np.float32)
+    va = np.asarray(value_of(ensure_tensor(variances)), np.float32)
+    isz = np.asarray(value_of(ensure_tensor(img_size)), np.float32)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_scores, nums = [], [], []
+    anc = an.reshape(-1, 4)
+    var = va.reshape(-1, 4)
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = dl[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_b, d_b, an_b, va_b = s[order], d[order], anc[order], var[order]
+        aw = an_b[:, 2] - an_b[:, 0] + off
+        ah = an_b[:, 3] - an_b[:, 1] + off
+        acx = an_b[:, 0] + aw * 0.5
+        acy = an_b[:, 1] + ah * 0.5
+        cx = va_b[:, 0] * d_b[:, 0] * aw + acx
+        cy = va_b[:, 1] * d_b[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(va_b[:, 2] * d_b[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(va_b[:, 3] * d_b[:, 3], 10.0))
+        x0 = cx - bw * 0.5
+        y0 = cy - bh * 0.5
+        x1 = cx + bw * 0.5 - off
+        y1 = cy + bh * 0.5 - off
+        imh, imw = isz[b]
+        x0 = np.clip(x0, 0, imw - off)
+        y0 = np.clip(y0, 0, imh - off)
+        x1 = np.clip(x1, 0, imw - off)
+        y1 = np.clip(y1, 0, imh - off)
+        keep = ((x1 - x0 + off) >= min_size) & ((y1 - y0 + off) >= min_size)
+        boxes_b = np.stack([x0, y0, x1, y1], -1)[keep]
+        s_b = s_b[keep]
+        if len(boxes_b):
+            kept = np.asarray(value_of(nms(
+                Tensor(jnp.asarray(boxes_b)), nms_thresh,
+                scores=Tensor(jnp.asarray(s_b)))))[:post_nms_top_n]
+            boxes_b, s_b = boxes_b[kept], s_b[kept]
+        all_rois.append(boxes_b)
+        all_scores.append(s_b)
+        nums.append(len(boxes_b))
+    rois = np.concatenate(all_rois) if all_rois else np.zeros((0, 4))
+    rscores = np.concatenate(all_scores) if all_scores else np.zeros((0,))
+    rets = (Tensor(jnp.asarray(rois.astype(np.float32)),
+                   stop_gradient=True),
+            Tensor(jnp.asarray(rscores.astype(np.float32)),
+                   stop_gradient=True))
+    if return_rois_num:
+        rets = rets + (Tensor(jnp.asarray(np.asarray(nums, np.int32)),
+                              stop_gradient=True),)
+    return rets
+
+
+__all__ += ["DeformConv2D", "distribute_fpn_proposals",
+            "generate_proposals"]

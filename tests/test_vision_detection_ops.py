@@ -1,0 +1,179 @@
+"""Detection op additions (reference: python/paddle/vision/ops.py
+yolo_box, yolo_loss, matrix_nms, psroi_pool, deform_conv2d,
+distribute_fpn_proposals, generate_proposals)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 4, 6, 6)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((5, 4, 3, 3)).astype(np.float32))
+    off = paddle.zeros([2, 18, 6, 6])
+    out = V.deform_conv2d(x, off, w, padding=1)
+    ref = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # v2 with all-ones mask identical; 0.5 mask halves the output
+    m1 = V.deform_conv2d(x, off, w, padding=1, mask=paddle.ones([2, 9, 6, 6]))
+    np.testing.assert_allclose(m1.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+    mh = V.deform_conv2d(x, off, w, padding=1,
+                         mask=paddle.full([2, 9, 6, 6], 0.5))
+    np.testing.assert_allclose(mh.numpy(), ref.numpy() * 0.5, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_integer_shift():
+    # offset of exactly (0, +1) shifts the sampling one pixel right
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((1, 1, 5, 5)).astype(np.float32))
+    w = paddle.to_tensor(np.ones((1, 1, 1, 1), np.float32))
+    off = np.zeros((1, 2, 5, 5), np.float32)
+    off[:, 1] = 1.0  # x-offset
+    out = V.deform_conv2d(x, paddle.to_tensor(off), w)
+    ref = np.zeros((1, 1, 5, 5), np.float32)
+    ref[..., :, :-1] = x.numpy()[..., :, 1:]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_deform_conv2d_grads_and_layer():
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 4, 4)).astype(np.float32),
+                         stop_gradient=False)
+    off = paddle.to_tensor(np.zeros((1, 8, 3, 3), np.float32),
+                           stop_gradient=False)
+    w = paddle.to_tensor(rng.standard_normal((2, 2, 2, 2)).astype(np.float32),
+                         stop_gradient=False)
+    V.deform_conv2d(x, off, w).sum().backward()
+    assert x.grad is not None and off.grad is not None and w.grad is not None
+    layer = V.DeformConv2D(2, 3, 3, padding=1)
+    out = layer(paddle.randn([1, 2, 4, 4]), paddle.zeros([1, 18, 4, 4]))
+    assert out.shape == [1, 3, 4, 4]
+
+
+def test_psroi_pool_constant_groups():
+    xx = np.zeros((1, 4, 8, 8), np.float32)
+    for g in range(4):
+        xx[0, g] = g + 1.0
+    out = V.psroi_pool(
+        paddle.to_tensor(xx),
+        paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)),
+        paddle.to_tensor(np.array([1])), 2)
+    np.testing.assert_allclose(out.numpy().reshape(2, 2),
+                               [[1.0, 2.0], [3.0, 4.0]])
+    pool = V.PSRoIPool(2)
+    out2 = pool(paddle.to_tensor(xx),
+                paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]],
+                                          np.float32)),
+                paddle.to_tensor(np.array([1])))
+    np.testing.assert_allclose(out2.numpy(), out.numpy())
+
+
+def test_yolo_box_decode():
+    na, cls = 2, 3
+    xv = np.zeros((1, na * (5 + cls), 2, 2), np.float32)
+    boxes, scores = V.yolo_box(
+        paddle.to_tensor(xv), paddle.to_tensor(np.array([[64, 64]])),
+        [10, 13, 16, 30], cls, 0.01, 32)
+    assert boxes.shape == [1, 8, 4] and scores.shape == [1, 8, 3]
+    # zero logits: conf = 0.5, per-class score = 0.25
+    np.testing.assert_allclose(scores.numpy(), np.full((1, 8, 3), 0.25),
+                               rtol=1e-5)
+    # first cell center at sigmoid(0)=0.5 -> cx = 0.25 of 64px image
+    b0 = boxes.numpy()[0, 0]
+    cx = (b0[0] + b0[2]) / 2
+    np.testing.assert_allclose(cx, 16.0, atol=1e-4)
+    # conf below threshold zeroes scores
+    _, s2 = V.yolo_box(paddle.to_tensor(xv),
+                       paddle.to_tensor(np.array([[64, 64]])),
+                       [10, 13, 16, 30], cls, 0.6, 32)
+    assert (s2.numpy() == 0).all()
+
+
+def test_yolo_loss_signal():
+    rng = np.random.default_rng(3)
+    na, cls, h = 3, 2, 4
+    x = paddle.to_tensor(
+        rng.standard_normal((2, na * (5 + cls), h, h)).astype(np.float32),
+        stop_gradient=False)
+    gt = np.zeros((2, 3, 4), np.float32)
+    gt[0, 0] = [64, 64, 40, 40]   # one box in image 0 (input size 128)
+    lbl = np.zeros((2, 3), np.int64)
+    loss = V.yolo_loss(x, paddle.to_tensor(gt), paddle.to_tensor(lbl),
+                       [10, 13, 16, 30, 33, 23], [0, 1, 2], cls, 0.7, 32)
+    assert loss.shape == [2]
+    loss.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(loss.numpy()).all()
+
+
+def test_matrix_nms_decay():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 9.5, 10], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.85, 0.6], [0.0, 0.0, 0.0]]], np.float32)
+    out, num = V.matrix_nms(paddle.to_tensor(boxes),
+                            paddle.to_tensor(scores), 0.1, 0.0, 10, 10,
+                            background_label=1)
+    assert num.numpy().tolist() == [3]
+    o = out.numpy()
+    # top box keeps its score; the overlapping one decays; far box intact
+    assert o[0, 1] == pytest.approx(0.9, rel=1e-5)
+    decayed = o[np.argsort(o[:, 1])][0]
+    assert decayed[1] < 0.85  # heavy overlap got decayed
+    # gaussian mode also runs
+    out_g = V.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                         0.1, 0.0, 10, 10, use_gaussian=True,
+                         background_label=1, return_rois_num=False)
+    assert out_g.shape[1] == 6
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 64, 64], [0, 0, 224, 224],
+                     [0, 0, 500, 500]], np.float32)
+    multi, restore = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    counts = [m.shape[0] for m in multi]
+    assert sum(counts) == 4 and counts[0] >= 1  # small boxes at min level
+    order = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    restored = order[restore.numpy().reshape(-1)]
+    np.testing.assert_allclose(restored, rois)
+    # per-image counts
+    _, _, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([2, 2])))
+    total = np.stack([x.numpy() for x in nums]).sum(0)
+    np.testing.assert_array_equal(total, [2, 2])
+
+
+def test_generate_proposals():
+    H, W, A = 4, 4, 2
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 16, i * 8 + 16]
+            anchors[i, j, 1] = [j * 8, i * 8, j * 8 + 32, i * 8 + 32]
+    var = np.ones((H, W, A, 4), np.float32)
+    scores = np.random.default_rng(0).random((1, A, H, W)).astype(np.float32)
+    deltas = np.zeros((1, 4 * A, H, W), np.float32)
+    rois, sc, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32.0, 32.0]])),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        pre_nms_top_n=20, post_nms_top_n=5, return_rois_num=True)
+    assert rois.shape[0] == num.numpy().sum() <= 5
+    r = rois.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()  # clipped
+    assert (np.diff(sc.numpy()) <= 1e-6).all()  # sorted by score
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(bytes([1, 2, 3, 255]))
+    t = V.read_file(str(f))
+    np.testing.assert_array_equal(t.numpy(), [1, 2, 3, 255])
+    with pytest.raises(RuntimeError):
+        V.decode_jpeg(t)
