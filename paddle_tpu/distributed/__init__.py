@@ -34,12 +34,37 @@ from .collective import (  # noqa: F401
     stream,
     wait,
 )
+from .collective import (  # noqa: F401
+    P2POp,
+    batch_isend_irecv,
+    irecv,
+    isend,
+    recv,
+    send,
+)
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
 from .mesh import init_mesh, global_mesh  # noqa: F401
 from .parallel_step import DistributedTrainStep  # noqa: F401
 from .sequence_parallel import ring_attention, ulysses_attention  # noqa: F401
+from .auto_parallel import shard_op, shard_tensor  # noqa: F401
+from .api_extra import (  # noqa: F401
+    BoxPSDataset,
+    CountFilterEntry,
+    InMemoryDataset,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    destroy_process_group,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    spawn,
+    split,
+)
 
 from . import fleet  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def DataParallel(layers, **kwargs):

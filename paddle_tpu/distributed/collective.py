@@ -24,7 +24,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..ops._helpers import apply_jfn, ensure_tensor
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
 from ..tensor_core import Tensor
 from . import env as env_mod
 from . import mesh as mesh_mod
@@ -440,18 +440,82 @@ def _shift(v, axes, offset):
     return lax.ppermute(v, axes, perm)
 
 
+class _P2PTask:
+    """Completed-on-return task handle (reference ProcessGroup::Task)."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """P2P send. In the SPMD design p2p is a ppermute ring shift; use
-    p2p_shift for the pipeline pattern (reference send_v2/recv_v2 ops)."""
-    raise RuntimeError(
-        "point-to-point send/recv are expressed as p2p_shift inside SPMD "
-        "programs on TPU; see paddle_tpu.distributed.p2p_shift"
-    )
+    """P2P send (reference: collective.py:1434 → ProcessGroup::Send).
+
+    Eager multi-process mode rides the coordination-service KV store
+    (the jax.distributed service IS the reference's TCPStore). Inside
+    SPMD programs use p2p_shift — compiled ppermute over ICI."""
+    from . import xproc
+
+    if _in_spmd():
+        raise RuntimeError(
+            "inside an SPMD program p2p is a compiled collective: use "
+            "paddle_tpu.distributed.p2p_shift")
+    t = ensure_tensor(tensor)
+    xproc.send_np(np.asarray(value_of(t)), int(dst))
+    return _P2PTask()
 
 
-recv = send
-isend = send
-irecv = send
+def recv(tensor, src=0, group=None, sync_op=True):
+    """P2P recv filling `tensor` in place (reference: collective.py:1500)."""
+    from . import xproc
+
+    if _in_spmd():
+        raise RuntimeError(
+            "inside an SPMD program p2p is a compiled collective: use "
+            "paddle_tpu.distributed.p2p_shift")
+    t = ensure_tensor(tensor)
+    arr = xproc.recv_np(int(src))
+    t._value = jnp.asarray(arr, value_of(t).dtype)
+    return _P2PTask()
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send facade (completes eagerly; reference collective.py:1583)."""
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    """One op of a batched p2p round (reference: collective.py batch_isend_irecv
+    P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of sends/recvs (reference: collective.py:1716).
+    Sends go first (KV puts are non-blocking) so mutual exchanges can't
+    deadlock regardless of list order."""
+    tasks = []
+    ordered = sorted(p2p_op_list,
+                     key=lambda o: 0 if o.op in (isend, send) else 1)
+    for op in ordered:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
 
 
 def p2p_shift(tensor, group=None, offset=1):

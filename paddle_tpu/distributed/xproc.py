@@ -112,3 +112,62 @@ def all_gather_bytes(payload: bytes, max_len=1 << 20):
     buf[:n] = np.frombuffer(payload, np.uint8)
     mat = all_gather_np(buf)
     return [mat[i, : int(lens[i])].tobytes() for i in range(len(lens))]
+
+
+# ---- point-to-point over the coordination-service KV store ----
+# (reference: ProcessGroup::Send/Recv, store/tcp_store.h; here the
+# jax.distributed coordination service IS the TCP store)
+
+_p2p_send_seq = {}
+_p2p_recv_seq = {}
+
+
+def _kv_client():
+    from jax._src.distributed import global_state
+
+    client = getattr(global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "p2p send/recv needs the multi-process runtime: start workers "
+            "via paddle_tpu.distributed.launch / spawn (jax.distributed)")
+    return client
+
+
+def send_bytes(data: bytes, dst: int, tag: int = 0):
+    import base64
+
+    me = jax.process_index()
+    seq = _p2p_send_seq.get((me, dst, tag), 0)
+    _p2p_send_seq[(me, dst, tag)] = seq + 1
+    _kv_client().key_value_set(
+        f"pt_p2p/{me}/{dst}/{tag}/{seq}",
+        base64.b64encode(data).decode("ascii"))
+
+
+def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 60_000) -> bytes:
+    import base64
+
+    me = jax.process_index()
+    seq = _p2p_recv_seq.get((src, me, tag), 0)
+    _p2p_recv_seq[(src, me, tag)] = seq + 1
+    val = _kv_client().blocking_key_value_get(
+        f"pt_p2p/{src}/{me}/{tag}/{seq}", timeout_ms)
+    return base64.b64decode(val)
+
+
+def send_np(arr, dst: int, tag: int = 0):
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    send_bytes(buf.getvalue(), dst, tag)
+
+
+def recv_np(src: int, tag: int = 0, timeout_ms: int = 60_000):
+    import io
+
+    return np.load(io.BytesIO(recv_bytes(src, tag, timeout_ms)),
+                   allow_pickle=False)
+
+
+__all__ += ["send_bytes", "recv_bytes", "send_np", "recv_np"]

@@ -30,6 +30,15 @@ def main():
     dist.all_gather(gathered, paddle.to_tensor(
         np.full((1, 2), float(rank), np.float32)))
 
+    # p2p exchange over the coordination-service KV store: 0 <-> 1
+    peer = 1 - rank
+    mine = paddle.to_tensor(np.full((3,), float(rank + 100), np.float32))
+    theirs = paddle.zeros([3])
+    ops = [dist.P2POp(dist.isend, mine, peer),
+           dist.P2POp(dist.irecv, theirs, peer)]
+    for task in dist.batch_isend_irecv(ops):
+        task.wait()
+
     dist.barrier()
     with open(os.path.join(out_dir, f"out_{rank}.json"), "w") as f:
         json.dump({
@@ -39,6 +48,7 @@ def main():
             "objs": objs,
             "bcast": b.numpy().tolist(),
             "gathered": [g.numpy().tolist() for g in gathered],
+            "p2p": theirs.numpy().tolist(),
         }, f)
 
 

@@ -1,0 +1,102 @@
+"""paddle.distributed surface additions (reference:
+python/paddle/distributed/{spawn,parallel,entry_attr,fleet/dataset}).
+The real 2-process p2p exchange is covered by tests/test_launch.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+def test_parallel_mode_and_symbols():
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+    for name in ("P2POp", "batch_isend_irecv", "spawn", "split",
+                 "destroy_process_group", "shard_tensor", "shard_op",
+                 "launch"):
+        assert hasattr(dist, name)
+
+
+def test_p2p_single_process_raises_cleanly():
+    t = paddle.ones([2])
+    with pytest.raises(RuntimeError):
+        dist.send(t, dst=0)  # no multi-process runtime here
+
+
+def test_p2pop_validates_op():
+    with pytest.raises(ValueError):
+        dist.P2POp(dist.all_reduce, paddle.ones([1]), 0)
+
+
+def test_split_linear_and_embedding():
+    mesh_mod.init_mesh(mp=2, dp=4)
+    try:
+        x = paddle.randn([4, 8])
+        out = dist.split(x, (8, 6), operation="linear", axis=1)
+        assert out.shape == [4, 6]
+        out_r = dist.split(x, (8, 6), operation="linear", axis=0)
+        assert out_r.shape == [4, 6]
+        emb = dist.split(paddle.to_tensor(np.array([[1, 2], [3, 0]])),
+                         (10, 4), operation="embedding")
+        assert emb.shape == [2, 2, 4]
+        with pytest.raises(ValueError):
+            dist.split(x, (8, 6), operation="conv")
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_inmemory_dataset(tmp_path):
+    fp = tmp_path / "part-0"
+    fp.write_text("1 2 3\n4 5 6\n7 8 9\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2, parse_fn=lambda ln: [int(t) for t in ln.split()])
+    ds.set_filelist([str(fp)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds)
+    assert batches[0] == [[1, 2, 3], [4, 5, 6]] and batches[1] == [[7, 8, 9]]
+    ds.local_shuffle()
+    assert ds.get_shuffle_data_size() == 3
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_queue_and_boxps_datasets(tmp_path):
+    fp = tmp_path / "part-0"
+    fp.write_text("a b\nc d\n")
+    qd = dist.QueueDataset()
+    qd.init(batch_size=2)
+    qd.set_filelist([str(fp)])
+    assert list(qd) == [[["a", "b"], ["c", "d"]]]
+    bp = dist.BoxPSDataset()
+    bp.init(batch_size=1)
+    bp.set_filelist([str(fp)])
+    bp.begin_pass()
+    bp.preload_into_memory()
+    bp.wait_preload_done()
+    assert bp.get_memory_data_size() == 2
+    bp.end_pass()
+
+
+def test_sparse_entries():
+    assert dist.ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+    assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+    assert dist.ShowClickEntry("s", "c")._to_attr() == "show_click_entry:s:c"
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(0.0)
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(-1)
+
+
+def test_destroy_process_group():
+    g = dist.new_group(axes=("dp",))
+    assert dist.get_group(g.id) is g
+    dist.destroy_process_group(g)
+    assert dist.get_group(g.id) is None
+    dist.destroy_process_group()  # full clear is a no-op-safe call
+
+
+def test_gloo_facade():
+    dist.gloo_barrier()  # single-process: no-op
+    dist.gloo_release()

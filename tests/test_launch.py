@@ -46,6 +46,8 @@ def test_two_process_collectives(tmp_path):
         assert res["bcast"] == [17.0] * 4
         # all_gather: rank-ordered rows
         assert res["gathered"] == [[[0.0, 0.0]], [[1.0, 1.0]]]
+        # p2p exchange: each rank received the peer's 100+peer vector
+        assert res["p2p"] == [float(100 + (1 - rank))] * 3
     assert results[0]["rank"] == 0 and results[1]["rank"] == 1
 
 
