@@ -144,3 +144,11 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 
 __all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]
+
+
+# predicate re-exports (the reference's fft module namespace carries them)
+from .ops.api_misc import (  # noqa: E402,F401
+    is_complex,
+    is_floating_point,
+    is_integer,
+)

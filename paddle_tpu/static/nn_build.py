@@ -1,0 +1,541 @@
+"""static.nn layer-builder surface (reference: python/paddle/static/nn/
+— fc, conv2d, batch_norm, embedding, nce, ... over LayerHelper).
+
+TPU-native: each builder creates the matching eager layer ONCE (owning
+its parameters) and applies it — under `paddle.jit.to_static`/`Program`
+tracing this is exactly the reference's build-then-run split, without a
+protobuf program in between. Ops take/return Tensors.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = [
+    "fc", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "batch_norm", "instance_norm", "layer_norm", "group_norm", "data_norm",
+    "embedding", "sparse_embedding", "prelu", "spectral_norm",
+    "deform_conv2d", "bilinear_tensor_product", "nce", "row_conv",
+    "crf_decoding", "py_func", "create_parameter", "multi_box_head",
+    "continuous_value_model", "StaticRNN",
+]
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    from ..nn import functional as F
+
+    return getattr(F, act)(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """(reference static/nn/common.py fc)."""
+    from .. import nn
+    from ..ops.manipulation import reshape
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        xi = ensure_tensor(xi)
+        lead = xi.shape[:num_flatten_dims]
+        flat_in = int(np.prod(xi.shape[num_flatten_dims:]))
+        layer = nn.Linear(flat_in, size, weight_attr=weight_attr,
+                          bias_attr=bias_attr)
+        flat = reshape(xi, list(lead) + [flat_in])
+        outs.append(layer(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    return _act(out, activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    in_c = input.shape[1 if data_format == "NCHW" else -1]
+    layer = nn.Conv2D(in_c, num_filters, filter_size, stride, padding,
+                      dilation, groups, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    in_c = input.shape[1 if data_format == "NCDHW" else -1]
+    layer = nn.Conv3D(in_c, num_filters, filter_size, stride, padding,
+                      dilation, groups, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    in_c = input.shape[1 if data_format == "NCHW" else -1]
+    layer = nn.Conv2DTranspose(in_c, num_filters, filter_size, stride,
+                               padding, weight_attr=param_attr,
+                               bias_attr=bias_attr, dilation=dilation,
+                               groups=groups, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    in_c = input.shape[1 if data_format == "NCDHW" else -1]
+    layer = nn.Conv3DTranspose(in_c, num_filters, filter_size, stride,
+                               padding, weight_attr=param_attr,
+                               bias_attr=bias_attr, dilation=dilation,
+                               groups=groups, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from .. import nn
+
+    c = input.shape[1 if data_layout == "NCHW" else -1]
+    layer = nn.BatchNorm(c, momentum=momentum, epsilon=epsilon,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    if is_test or use_global_stats:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+
+    layer = nn.InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                              weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+
+    shape = list(input.shape[begin_norm_axis:])
+    layer = nn.LayerNorm(shape, epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+
+    layer = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Batch-stat normalization without learned affine by default
+    (reference static/nn/common.py data_norm — CTR models)."""
+    x = ensure_tensor(input)
+
+    def jfn(v):
+        mean = v.mean(0, keepdims=True)
+        var = v.var(0, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon)
+
+    return _act(apply_jfn("data_norm", jfn, x), act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-backed sparse embedding (reference static/nn/common.py
+    sparse_embedding → distributed_lookup_table). Dense fallback when no
+    PS runtime is active; the PS path lives in distributed/ps.py."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1 if data_format == "NCHW" else -1]
+    else:  # element
+        num = int(np.prod(x.shape[1:]))
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight tensor (reference
+    static/nn/common.py spectral_norm): returns weight / sigma_max,
+    sigma estimated by power iteration."""
+    w = ensure_tensor(weight)
+
+    def jfn(wv):
+        mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), wv.dtype) / np.sqrt(mat.shape[0])
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        return wv / jnp.maximum(sigma, eps)
+
+    return apply_jfn("spectral_norm", jfn, w)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size, stride,
+                         padding, dilation, deformable_groups, groups,
+                         weight_attr, bias_attr)
+    return layer(x, offset, mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static/nn/common.py
+    nce → nce_op): logistic discrimination of the true class against
+    sampled noise classes."""
+    from .. import nn
+    from ..core import rng
+    import jax
+
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    d = input.shape[-1]
+    helper = nn.Layer()
+    weight = helper.create_parameter([num_total_classes, d], param_attr)
+    bias = (None if bias_attr is False else helper.create_parameter(
+        [num_total_classes], bias_attr, is_bias=True))
+    key = rng.next_key()
+    n = input.shape[0]
+    neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                             num_total_classes)
+
+    def jfn(x, lbl, w, *rest):
+        b = rest[0] if rest else None
+        lbl_i = lbl.reshape(-1).astype(jnp.int32)
+        pos_w = w[lbl_i]
+        pos_logit = jnp.sum(x * pos_w, -1)
+        neg_w = w[neg]
+        neg_logit = jnp.einsum("nd,nkd->nk", x, neg_w)
+        if b is not None:
+            pos_logit = pos_logit + b[lbl_i]
+            neg_logit = neg_logit + b[neg]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss)[:, None]
+
+    from ..autograd import engine
+
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return engine.apply("nce", jfn, args)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference static/nn/common.py row_conv
+    → row_conv_op, DeepSpeech2): out[t] = sum_k w[k] * x[t+k]."""
+    from .. import nn
+
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+    k = future_context_size + 1
+    helper = nn.Layer()
+    weight = helper.create_parameter([k, d], param_attr)
+
+    def jfn(v, w):
+        # v: [batch, time, d] (or LoD flat [T, d] treated as one batch)
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        t = v.shape[1]
+        out = jnp.zeros_like(v)
+        for i in range(k):
+            shifted = jnp.pad(v[:, i:], ((0, 0), (0, i), (0, 0)))
+            out = out + shifted * w[i]
+        return out[0] if squeeze else out
+
+    from ..autograd import engine
+
+    out = engine.apply("row_conv", jfn, (x, weight))
+    return _act(out, act)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode with CRF transitions (reference static/nn/common.py
+    crf_decoding → crf_decoding_op); delegates to text.viterbi_decode."""
+    from ..text.viterbi_decode import viterbi_decode
+
+    x = ensure_tensor(input)
+    if transition is None:
+        raise ValueError(
+            "pass transition= (the linear_chain_crf parameter); the "
+            "static-graph param_attr lookup has no scope here")
+    if x.ndim == 2:
+        x = Tensor(x._value[None], stop_gradient=x.stop_gradient)
+    if length is None:
+        length = Tensor(jnp.asarray([x.shape[1]] * x.shape[0]),
+                        stop_gradient=True)
+    scores, path = viterbi_decode(x, transition, length)
+    return path
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference static/nn/common.py py_func → py_func_op).
+    Runs eagerly on host values; gradient support requires backward_func
+    (wrapped as a custom VJP through pure_callback in utils.cpp_extension
+    style); forward-only here matches the common usage."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    vals = [np.asarray(value_of(ensure_tensor(t))) for t in xs]
+    res = func(*vals)
+    if isinstance(res, (list, tuple)):
+        return [Tensor(jnp.asarray(np.asarray(r)), stop_gradient=True)
+                for r in res]
+    return Tensor(jnp.asarray(np.asarray(res)), stop_gradient=True)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.api_misc import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference static/nn/common.py multi_box_head):
+    per-feature-map box/score convs + prior boxes."""
+    from .. import nn
+    from ..ops.manipulation import concat, reshape, transpose
+
+    n_inputs = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        step = int((max_ratio - min_ratio) / max(n_inputs - 2, 1))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_inputs - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_inputs - 1]
+    locs, confs, priors, pvars = [], [], [], []
+    im_h, im_w = int(image.shape[2]), int(image.shape[3])
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        n_prior = (len(ar) * (2 if flip else 1) + 1 +
+                   (1 if max_sizes else 0))
+        c = feat.shape[1]
+        loc_conv = nn.Conv2D(c, n_prior * 4, kernel_size, stride, pad)
+        conf_conv = nn.Conv2D(c, n_prior * num_classes, kernel_size,
+                              stride, pad)
+        loc = transpose(loc_conv(feat), [0, 2, 3, 1])
+        conf = transpose(conf_conv(feat), [0, 2, 3, 1])
+        locs.append(reshape(loc, [feat.shape[0], -1, 4]))
+        confs.append(reshape(conf, [feat.shape[0], -1, num_classes]))
+        # prior boxes for this map
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        sw = steps[i] if steps else im_w / fw
+        sh = steps[i] if steps else im_h / fh
+        boxes = []
+        for y in range(fh):
+            for x_ in range(fw):
+                cx = (x_ + offset) * sw
+                cy = (y + offset) * sh
+                sizes = [(min_sizes[i], min_sizes[i])]
+                if max_sizes:
+                    s = float(np.sqrt(min_sizes[i] * max_sizes[i]))
+                    sizes.append((s, s))
+                for a in ar:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    w_a = min_sizes[i] * float(np.sqrt(a))
+                    h_a = min_sizes[i] / float(np.sqrt(a))
+                    sizes.append((w_a, h_a))
+                    if flip:
+                        sizes.append((h_a, w_a))
+                for (bw, bh) in sizes:
+                    box = [(cx - bw / 2) / im_w, (cy - bh / 2) / im_h,
+                           (cx + bw / 2) / im_w, (cy + bh / 2) / im_h]
+                    if clip:
+                        box = [min(max(v, 0.0), 1.0) for v in box]
+                    boxes.append(box)
+        pb = np.asarray(boxes, np.float32)
+        priors.append(Tensor(jnp.asarray(pb), stop_gradient=True))
+        pvars.append(Tensor(jnp.asarray(
+            np.tile(np.asarray(variance, np.float32), (len(pb), 1))),
+            stop_gradient=True))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    box = concat(priors, axis=0)
+    var = concat(pvars, axis=0)
+    return mbox_locs, mbox_confs, box, var
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CTR show/click feature handling (reference static/nn/common.py
+    continuous_value_model → cvm_op): keep or strip the leading
+    show/click pair of each embedding."""
+    x = ensure_tensor(input)
+
+    def jfn(v, c):
+        if use_cvm:
+            return jnp.concatenate([c.astype(v.dtype), v[:, 2:]], -1)
+        return v[:, 2:]
+
+    from ..autograd import engine
+
+    return engine.apply("cvm", jfn, (x, ensure_tensor(cvm)))
+
+
+class StaticRNN:
+    """Static-unroll RNN builder (reference: static/nn/control_flow.py
+    StaticRNN — step block recorded once, executed per time step).
+
+    Trace-capture design: the `with rnn.step():` body runs ONCE eagerly
+    on the t=0 slice; the ops it performs are recorded on the autograd
+    tape (step inputs/memories are marked grad-tracked to force node
+    recording). `rnn()` then REPLAYS the recorded op graph T times with
+    each step's slice and the carried memories substituted — the tape is
+    the sub-block program, no AST or protobuf rewriting."""
+
+    def __init__(self, name=None):
+        self._seq = []        # (full_sequence_tensor, t0_slice_tensor)
+        self._memories = []   # {"pre": Tensor, "next": Tensor}
+        self._outputs = []
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self
+
+        return ctx()
+
+    def step_input(self, x):
+        x = ensure_tensor(x)
+        sl = Tensor(x._value[0], stop_gradient=False)
+        self._seq.append((x, sl))
+        return sl
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            batch = batch_ref.shape[ref_batch_dim_idx]
+            init = Tensor(jnp.full((int(batch),) + tuple(shape),
+                                   np.float32(init_value)))
+        init = ensure_tensor(init)
+        pre = Tensor(init._value, stop_gradient=False)
+        self._memories.append({"init": init, "pre": pre, "next": None})
+        return pre
+
+    def update_memory(self, mem_var, new_var):
+        for mem in self._memories:
+            if mem["pre"] is mem_var:
+                mem["next"] = ensure_tensor(new_var)
+                return
+        raise ValueError("update_memory: unknown memory var")
+
+    def step_output(self, o):
+        self._outputs.append(ensure_tensor(o))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _replay(self, targets, subs):
+        memo = dict(subs)
+
+        def ev(t):
+            if id(t) in memo:
+                return memo[id(t)]
+            node = t._grad_node
+            if node is None or node.jfn is None:
+                return t._value
+            out = node.jfn(*[ev(i) for i in node.inputs])
+            res = out[t._out_index] if isinstance(out, (tuple, list)) \
+                else out
+            memo[id(t)] = res
+            return res
+
+        return [ev(t) for t in targets]
+
+    def __call__(self):
+        if not self._seq:
+            raise ValueError("StaticRNN has no step_input")
+        T = int(self._seq[0][0].shape[0])
+        mem_vals = [m["init"]._value for m in self._memories]
+        collected = [[] for _ in self._outputs]
+        for t in range(T):
+            subs = {}
+            for full, sl in self._seq:
+                subs[id(sl)] = full._value[t]
+            for m, v in zip(self._memories, mem_vals):
+                subs[id(m["pre"])] = v
+            targets = list(self._outputs) + [
+                m["next"] for m in self._memories if m["next"] is not None]
+            vals = self._replay(targets, subs)
+            for i in range(len(self._outputs)):
+                collected[i].append(vals[i])
+            mem_vals = vals[len(self._outputs):]
+        outs = [Tensor(jnp.stack(c), stop_gradient=True)
+                for c in collected]
+        return outs[0] if len(outs) == 1 else tuple(outs)

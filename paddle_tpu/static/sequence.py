@@ -1,0 +1,374 @@
+"""Sequence (LoD) op family.
+
+Reference: paddle/fluid/operators/sequence_ops/ + the LoDTensor model
+(paddle/fluid/framework/lod_tensor.h): variable-length sequences stored
+flat with level-of-detail offsets. TPU-native design: a `LoDTensor`
+subclass carries the offsets; each op is segment math over the flat
+[total_tokens, ...] array (gather/segment_sum — XLA-friendly, no ragged
+shapes inside jit).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import apply_jfn, ensure_tensor, value_of
+from ..tensor_core import Tensor
+
+__all__ = [
+    "LoDTensor", "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_pool",
+    "sequence_reshape", "sequence_reverse", "sequence_scatter",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+]
+
+
+class LoDTensor(Tensor):
+    """Flat sequence batch + offsets (reference lod_tensor.h: one LoD
+    level; offsets[i]..offsets[i+1] are sequence i's rows)."""
+
+    __slots__ = ("lod",)
+
+    def __init__(self, value, lod, stop_gradient=True, name=None):
+        super().__init__(value, stop_gradient=stop_gradient, name=name)
+        self.lod = [int(v) for v in lod]
+
+    @property
+    def seq_lengths(self):
+        return [self.lod[i + 1] - self.lod[i]
+                for i in range(len(self.lod) - 1)]
+
+
+def _as_lod(x, lod=None):
+    if isinstance(x, LoDTensor):
+        return x
+    if lod is None:
+        raise ValueError("sequence op needs a LoDTensor (or explicit lod)")
+    t = ensure_tensor(x)
+    return LoDTensor(t._value, lod, stop_gradient=t.stop_gradient)
+
+
+def _wrap(x, out, lod):
+    o = LoDTensor(out._value, lod, stop_gradient=out.stop_gradient)
+    o._grad_node = out._grad_node
+    o._out_index = out._out_index
+    return o
+
+
+def _seg_ids(lod):
+    n = len(lod) - 1
+    return np.repeat(np.arange(n), np.diff(np.asarray(lod)))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    """Per-sequence reduction (reference sequence_ops/sequence_pool_op.cc):
+    sum/average/sqrt/max/min/first/last."""
+    x = _as_lod(input)
+    lod = x.lod
+    seg = jnp.asarray(_seg_ids(lod))
+    n = len(lod) - 1
+    lens = jnp.asarray(np.maximum(np.diff(np.asarray(lod)), 1))
+    pool_type = pool_type.lower()
+
+    def jfn(v):
+        if pool_type == "sum":
+            return jax.ops.segment_sum(v, seg, num_segments=n)
+        if pool_type == "average":
+            s = jax.ops.segment_sum(v, seg, num_segments=n)
+            return s / lens.reshape((-1,) + (1,) * (v.ndim - 1))
+        if pool_type == "sqrt":
+            s = jax.ops.segment_sum(v, seg, num_segments=n)
+            return s / jnp.sqrt(lens.astype(v.dtype)).reshape(
+                (-1,) + (1,) * (v.ndim - 1))
+        if pool_type == "max":
+            return jax.ops.segment_max(v, seg, num_segments=n)
+        if pool_type == "min":
+            return jax.ops.segment_min(v, seg, num_segments=n)
+        if pool_type == "first":
+            return v[jnp.asarray(lod[:-1])]
+        if pool_type == "last":
+            return v[jnp.asarray(np.maximum(np.asarray(lod[1:]) - 1, 0))]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    out = apply_jfn("sequence_pool", jfn, x)
+    # empty sequences produce pad_value (reference semantics)
+    if any(l == 0 for l in x.seq_lengths):
+        empt = jnp.asarray(np.asarray(x.seq_lengths) == 0)
+        out = apply_jfn(
+            "sequence_pool_pad",
+            lambda v: jnp.where(
+                empt.reshape((-1,) + (1,) * (v.ndim - 1)), pad_value, v),
+            out)
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    """Softmax within each sequence over the flat rows
+    (reference sequence_softmax_op)."""
+    x = _as_lod(input)
+    seg = jnp.asarray(_seg_ids(x.lod))
+    n = len(x.lod) - 1
+
+    def jfn(v):
+        flat = v.reshape(-1)
+        mx = jax.ops.segment_max(flat, seg, num_segments=n)
+        e = jnp.exp(flat - mx[seg])
+        s = jax.ops.segment_sum(e, seg, num_segments=n)
+        return (e / s[seg]).reshape(v.shape)
+
+    return _wrap(x, apply_jfn("sequence_softmax", jfn, x), x.lod)
+
+
+def sequence_reverse(x, name=None):
+    """Reverse rows within each sequence (reference sequence_reverse_op)."""
+    t = _as_lod(x)
+    idx = []
+    for i in range(len(t.lod) - 1):
+        idx.extend(range(t.lod[i + 1] - 1, t.lod[i] - 1, -1))
+    gather = jnp.asarray(np.asarray(idx, np.int32))
+    out = apply_jfn("sequence_reverse", lambda v: v[gather], t)
+    return _wrap(t, out, t.lod)
+
+
+def sequence_concat(input, name=None):
+    """Concat same-count LoD batches sequence-wise
+    (reference sequence_concat_op)."""
+    xs = [_as_lod(x) for x in input]
+    n = len(xs[0].lod) - 1
+    order = []
+    offset_base = [0]
+    for x in xs:
+        offset_base.append(offset_base[-1] + x.lod[-1])
+    new_lod = [0]
+    for i in range(n):
+        total = 0
+        for xi, x in enumerate(xs):
+            for r in range(x.lod[i], x.lod[i + 1]):
+                order.append(offset_base[xi] + r)
+            total += x.lod[i + 1] - x.lod[i]
+        new_lod.append(new_lod[-1] + total)
+    gather = jnp.asarray(np.asarray(order, np.int32))
+    from ..autograd import engine
+
+    out = engine.apply(
+        "sequence_concat",
+        lambda *vs: jnp.concatenate(vs, 0)[gather], tuple(xs))
+    return _wrap(xs[0], out, new_lod)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """LoD → (padded [N, L, ...], lengths) (reference sequence_pad_op)."""
+    t = _as_lod(x)
+    lens = np.asarray(t.seq_lengths)
+    L = int(maxlen) if maxlen is not None else int(lens.max() if
+                                                  len(lens) else 0)
+    n = len(lens)
+    # gather index per (seq, slot); padded slots read row 0 then get masked
+    gidx = np.zeros((n, L), np.int32)
+    mask = np.zeros((n, L), bool)
+    for i in range(n):
+        ln = min(int(lens[i]), L)
+        gidx[i, :ln] = np.arange(t.lod[i], t.lod[i] + ln)
+        mask[i, :ln] = True
+    g = jnp.asarray(gidx)
+    m = jnp.asarray(mask)
+    pv = ensure_tensor(pad_value)
+
+    def jfn(v, pvv):
+        padded = v[g.reshape(-1)].reshape((n, L) + v.shape[1:])
+        return jnp.where(m.reshape((n, L) + (1,) * (v.ndim - 1)), padded,
+                         pvv.astype(v.dtype))
+
+    from ..autograd import engine
+
+    padded = engine.apply("sequence_pad", jfn, (t, pv))
+    return padded, Tensor(jnp.asarray(lens.astype(np.int64)),
+                          stop_gradient=True)
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded, lengths) → flat LoD rows (reference sequence_unpad_op)."""
+    t = ensure_tensor(x)
+    lens = np.asarray(value_of(ensure_tensor(length))).astype(np.int64)
+    n, L = t.shape[0], t.shape[1]
+    rows = []
+    lod = [0]
+    for i in range(n):
+        ln = int(min(lens[i], L))
+        rows.extend(i * L + j for j in range(ln))
+        lod.append(lod[-1] + ln)
+    g = jnp.asarray(np.asarray(rows, np.int32))
+
+    def jfn(v):
+        flat = v.reshape((n * L,) + v.shape[2:])
+        return flat[g]
+
+    return _wrap(t, apply_jfn("sequence_unpad", jfn, t), lod)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat x's sequences per y's LoD (reference sequence_expand_op):
+    sequence i of x is tiled y_len_i times."""
+    xt = _as_lod(x) if isinstance(x, LoDTensor) else _as_lod(
+        x, [0, int(ensure_tensor(x).shape[0])])
+    yt = _as_lod(y)
+    reps = yt.seq_lengths
+    order = []
+    new_lod = [0]
+    for i in range(len(xt.lod) - 1):
+        seq = list(range(xt.lod[i], xt.lod[i + 1]))
+        r = reps[i] if i < len(reps) else 1
+        for _ in range(max(r, 0)):
+            order.extend(seq)
+        new_lod.append(len(order))
+    g = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_jfn("sequence_expand", lambda v: v[g], xt)
+    return _wrap(xt, out, new_lod)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Expand each row of x to match y's sequence lengths
+    (reference sequence_expand_as_op)."""
+    xt = ensure_tensor(x)
+    yt = _as_lod(y)
+    reps = yt.seq_lengths
+    order = []
+    new_lod = [0]
+    for i, r in enumerate(reps):
+        order.extend([i] * r)
+        new_lod.append(len(order))
+    g = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_jfn("sequence_expand_as", lambda v: v[g], xt)
+    return _wrap(xt, out, new_lod)
+
+
+def sequence_reshape(input, new_dim):
+    """Re-chunk each sequence's flattened payload to rows of new_dim
+    (reference sequence_reshape_op)."""
+    t = _as_lod(input)
+    d = int(t.shape[-1])
+    new_lod = [0]
+    for ln in t.seq_lengths:
+        total = ln * d
+        if total % new_dim != 0:
+            raise ValueError("sequence payload not divisible by new_dim")
+        new_lod.append(new_lod[-1] + total // new_dim)
+
+    out = apply_jfn("sequence_reshape",
+                    lambda v: v.reshape(-1, new_dim), t)
+    return _wrap(t, out, new_lod)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence slice (reference sequence_slice_op)."""
+    t = _as_lod(input)
+    off = np.asarray(value_of(ensure_tensor(offset))).reshape(-1)
+    ln = np.asarray(value_of(ensure_tensor(length))).reshape(-1)
+    order = []
+    new_lod = [0]
+    for i in range(len(t.lod) - 1):
+        start = t.lod[i] + int(off[i])
+        order.extend(range(start, start + int(ln[i])))
+        new_lod.append(len(order))
+    g = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_jfn("sequence_slice", lambda v: v[g], t)
+    return _wrap(t, out, new_lod)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter-add updates into input at per-sequence positions
+    (reference sequence_scatter_op): index is a LoD tensor of positions
+    into each corresponding row of input."""
+    t = ensure_tensor(input)
+    idx = _as_lod(index)
+    upd = ensure_tensor(updates)
+    seg = _seg_ids(idx.lod)
+    pos = np.asarray(value_of(idx)).reshape(-1)
+    rows = jnp.asarray(seg.astype(np.int32))
+    cols = jnp.asarray(pos.astype(np.int32))
+
+    def jfn(v, u):
+        return v.at[rows, cols].add(u.reshape(-1).astype(v.dtype))
+
+    from ..autograd import engine
+
+    return engine.apply("sequence_scatter", jfn, (t, upd))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding-window id enumeration per sequence
+    (reference sequence_enumerate_op)."""
+    t = _as_lod(input)
+    vals = np.asarray(value_of(t)).reshape(-1)
+    out = np.full((len(vals), win_size), pad_value,
+                  vals.dtype if vals.dtype.kind == "i" else np.int64)
+    for i in range(len(t.lod) - 1):
+        for r in range(t.lod[i], t.lod[i + 1]):
+            for w in range(win_size):
+                if r + w < t.lod[i + 1]:
+                    out[r, w] = vals[r + w]
+    o = LoDTensor(jnp.asarray(out), t.lod)
+    return o
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Sequence (context-window) convolution (reference
+    sequence_conv_op): each output row contracts a window of
+    filter_size rows; windows never cross sequence boundaries
+    (out-of-sequence taps read zeros)."""
+    from .. import nn
+
+    t = _as_lod(input)
+    d = int(t.shape[-1])
+    helper = nn.Layer()
+    weight = helper.create_parameter([filter_size * d, num_filters],
+                                     param_attr)
+    bias = (None if bias_attr is False else helper.create_parameter(
+        [num_filters], bias_attr, is_bias=True))
+    start = (padding_start if padding_start is not None
+             else -(filter_size // 2))
+    total = t.lod[-1]
+    # precompute per-row, per-tap gather index (-1 = zero pad)
+    gather = np.full((total, filter_size), -1, np.int32)
+    for i in range(len(t.lod) - 1):
+        lo, hi = t.lod[i], t.lod[i + 1]
+        for r in range(lo, hi):
+            for k in range(filter_size):
+                srcr = r + start + k
+                if lo <= srcr < hi:
+                    gather[r, k] = srcr
+    g = jnp.asarray(gather)
+    ok = jnp.asarray(gather >= 0)
+
+    def jfn(v, w, *rest):
+        win = jnp.where(ok[..., None], v[jnp.clip(g, 0)], 0.0)
+        flat = win.reshape(total, filter_size * d)
+        out = flat @ w
+        if rest:
+            out = out + rest[0]
+        return out
+
+    from ..autograd import engine
+
+    args = (t, weight) + ((bias,) if bias is not None else ())
+    out = engine.apply("sequence_conv", jfn, args)
+    if act == "relu":
+        from ..ops.activation import relu as _relu
+
+        out = _relu(out)
+    elif act == "tanh":
+        from ..ops.math import tanh as _tanh
+
+        out = _tanh(out)
+    return _wrap(t, out, t.lod)

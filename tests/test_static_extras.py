@@ -1,0 +1,196 @@
+"""static + static.nn legacy surface (reference: python/paddle/static/,
+static/nn/, fluid sequence_ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import nn as snn
+from paddle_tpu.static.nn import LoDTensor
+
+
+X = lambda: LoDTensor(np.arange(10.0, dtype=np.float32).reshape(5, 2),
+                      [0, 2, 5])
+
+
+def test_sequence_pool_modes():
+    x = X()
+    np.testing.assert_allclose(
+        snn.sequence_pool(x, "sum").numpy(), [[2, 4], [18, 21]])
+    np.testing.assert_allclose(
+        snn.sequence_pool(x, "average").numpy(), [[1, 2], [6, 7]])
+    np.testing.assert_allclose(
+        snn.sequence_pool(x, "max").numpy(), [[2, 3], [8, 9]])
+    np.testing.assert_allclose(
+        snn.sequence_first_step(x).numpy(), [[0, 1], [4, 5]])
+    np.testing.assert_allclose(
+        snn.sequence_last_step(x).numpy(), [[2, 3], [8, 9]])
+
+
+def test_sequence_softmax_normalizes_per_sequence():
+    x = LoDTensor(np.array([1, 1, 2, 2, 2], np.float32).reshape(5, 1),
+                  [0, 2, 5])
+    out = snn.sequence_softmax(x).numpy().reshape(-1)
+    np.testing.assert_allclose(out[:2].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[2:].sum(), 1.0, rtol=1e-6)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = X()
+    padded, lens = snn.sequence_pad(x, 0.0)
+    assert padded.shape == [2, 3, 2]
+    assert lens.numpy().tolist() == [2, 3]
+    assert padded.numpy()[0, 2].tolist() == [0, 0]  # padded slot
+    unp = snn.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(unp.numpy(), x.numpy())
+    assert unp.lod == [0, 2, 5]
+
+
+def test_sequence_reverse_concat_expand():
+    x = X()
+    np.testing.assert_allclose(
+        snn.sequence_reverse(x).numpy()[:, 0], [2, 0, 8, 6, 4])
+    cat = snn.sequence_concat([x, x])
+    assert cat.lod == [0, 4, 10]
+    np.testing.assert_allclose(cat.numpy()[:4, 0], [0, 2, 0, 2])
+    y = LoDTensor(np.zeros((5, 1), np.float32), [0, 2, 5])
+    ex = snn.sequence_expand_as(
+        paddle.to_tensor(np.array([[1.0], [2.0]], np.float32)), y)
+    np.testing.assert_allclose(ex.numpy()[:, 0], [1, 1, 2, 2, 2])
+
+
+def test_sequence_reshape_slice_enumerate_scatter():
+    x = X()
+    r = snn.sequence_reshape(x, 1)
+    assert r.lod == [0, 4, 10] and r.shape == [10, 1]
+    sl = snn.sequence_slice(x, paddle.to_tensor(np.array([0, 1])),
+                            paddle.to_tensor(np.array([1, 2])))
+    np.testing.assert_allclose(sl.numpy()[:, 0], [0, 6, 8])
+    en = snn.sequence_enumerate(
+        LoDTensor(np.array([1, 2, 3, 4, 5]), [0, 2, 5]), 2)
+    assert en.numpy().tolist() == [[1, 2], [2, 0], [3, 4], [4, 5], [5, 0]]
+    base = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    idx = LoDTensor(np.array([0, 2, 1]), [0, 2, 3])
+    upd = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = snn.sequence_scatter(base, idx, upd)
+    np.testing.assert_allclose(out.numpy(),
+                               [[1, 0, 2, 0], [0, 3, 0, 0]])
+
+
+def test_sequence_conv_respects_boundaries():
+    x = X()
+    out = snn.sequence_conv(x, 4, filter_size=3)
+    assert out.shape == [5, 4] and out.lod == x.lod
+
+
+def test_builders():
+    assert snn.fc(paddle.randn([2, 3, 4]), 5).shape == [2, 5]
+    assert snn.batch_norm(paddle.randn([2, 3, 4, 4])).shape == [2, 3, 4, 4]
+    assert snn.layer_norm(paddle.randn([2, 6])).shape == [2, 6]
+    assert snn.group_norm(paddle.randn([2, 4, 3, 3]), 2).shape \
+        == [2, 4, 3, 3]
+    assert snn.embedding(paddle.to_tensor(np.array([[1, 2]])),
+                         (10, 4)).shape == [1, 2, 4]
+    assert snn.prelu(paddle.randn([2, 3, 4, 4]), "channel").shape \
+        == [2, 3, 4, 4]
+    assert snn.bilinear_tensor_product(
+        paddle.randn([3, 4]), paddle.randn([3, 5]), 7).shape == [3, 7]
+    assert snn.row_conv(paddle.randn([2, 5, 4]), 2).shape == [2, 5, 4]
+    out = snn.nce(paddle.randn([4, 8]),
+                  paddle.to_tensor(np.array([1, 2, 3, 0])), 10)
+    assert out.shape == [4, 1] and (out.numpy() > 0).all()
+    cvm = snn.continuous_value_model(paddle.randn([4, 8]),
+                                     paddle.randn([4, 2]), True)
+    assert cvm.shape == [4, 8]
+    assert snn.data_norm(paddle.randn([6, 3])).shape == [6, 3]
+    w = snn.spectral_norm(paddle.randn([4, 6]))
+    s = np.linalg.svd(w.numpy(), compute_uv=False)
+    assert s[0] <= 1.5  # roughly unit spectral norm after 1 iter
+
+
+def test_py_func():
+    out = snn.py_func(lambda a: a * 2 + 1, paddle.to_tensor([1.0, 2.0]),
+                      None)
+    np.testing.assert_allclose(out.numpy(), [3.0, 5.0])
+
+
+def test_static_rnn_replay():
+    rnn = snn.StaticRNN()
+    seq = paddle.to_tensor(
+        np.arange(12.0, dtype=np.float32).reshape(3, 2, 2))
+    with rnn.step():
+        xt = rnn.step_input(seq)
+        h = rnn.memory(shape=[2], batch_ref=seq)
+        nh = (h + xt) * 0.5
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    out = rnn()
+    xs = seq.numpy()
+    hh = np.zeros((2, 2), np.float32)
+    ref = []
+    for t in range(3):
+        hh = (hh + xs[t]) * 0.5
+        ref.append(hh.copy())
+    np.testing.assert_allclose(out.numpy(), np.stack(ref), rtol=1e-5)
+
+
+def test_static_facades():
+    bs = static.BuildStrategy()
+    cp = static.CompiledProgram(static.Program(), bs).with_data_parallel()
+    assert cp.build_strategy is bs
+    assert static.ParallelExecutor is static.CompiledProgram
+    assert static.Scope().local_scope() is not None
+    with static.ipu_shard_guard():
+        pass
+    with pytest.raises(RuntimeError):
+        static.IpuCompiledProgram()
+    assert len(static.cuda_places()) >= 1
+    gv = static.create_global_var([2], 1.5, "float32")
+    np.testing.assert_allclose(gv.numpy(), [1.5, 1.5])
+    p = static.create_parameter([2, 3], "float32")
+    assert p.shape == [2, 3]
+
+
+def test_static_metrics():
+    acc = static.accuracy(
+        paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)),
+        paddle.to_tensor(np.array([[1], [1]])))
+    assert float(np.asarray(acc.numpy())) == pytest.approx(0.5)
+    a, b, _ = static.auc(
+        paddle.to_tensor(np.array([[0.3, 0.7], [0.6, 0.4]], np.float32)),
+        paddle.to_tensor(np.array([1, 0])))
+    assert float(a.numpy()) == pytest.approx(1.0)
+    mets = static.ctr_metric_bundle(
+        paddle.to_tensor(np.array([0.5, 0.8], np.float32)),
+        paddle.to_tensor(np.array([0.0, 1.0], np.float32)))
+    assert len(mets) == 6
+
+
+def test_ema_apply_restore():
+    ema = static.ExponentialMovingAverage(0.5)
+    p = paddle.create_parameter([2], "float32")
+    p._value = p._value * 0 + 4.0
+    ema.update([p])
+    p._value = p._value * 0 + 8.0
+    ema.update([p])
+    with ema.apply():
+        assert float(p.numpy()[0]) < 8.0
+    assert float(p.numpy()[0]) == 8.0
+
+
+def test_serialize_and_file_io(tmp_path):
+    data = static.serialize_program([], [])
+    assert isinstance(static.deserialize_program(data), static.Program)
+    fp = tmp_path / "blob"
+    static.save_to_file(str(fp), b"abc")
+    assert static.load_from_file(str(fp)) == b"abc"
+    lr = static.exponential_decay(0.1, 100, 0.9)
+    assert lr is not None
+    assert static.sparsity is not None
+
+
+def test_print_passthrough(capsys):
+    x = paddle.to_tensor([1.0, 2.0])
+    out = static.Print(x, message="dbg")
+    assert out is x
+    assert "dbg" in capsys.readouterr().out
