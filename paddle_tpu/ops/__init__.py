@@ -70,6 +70,7 @@ def _install_tensor_methods():
     from ..tensor_core import Tensor
 
     from . import activation as _act
+    from . import api_misc as _misc
     from . import creation as _cre
     from . import extras as _ext
     from . import linalg as _lin
@@ -77,13 +78,17 @@ def _install_tensor_methods():
     from . import math as _math
 
     method_sources = {}
-    for m in (_math, _man, _lin, _act, _ext):
+    for m in (_math, _man, _lin, _act, _ext, _misc):
         for name in dir(m):
             fn = getattr(m, name)
             if callable(fn) and not name.startswith("_"):
                 method_sources.setdefault(name, fn)
 
-    skip = {"to_tensor", "meshgrid", "broadcast_tensors", "einsum", "multi_dot"}
+    skip = {"to_tensor", "meshgrid", "einsum", "iinfo",
+            "set_printoptions", "create_parameter", "set_grad_enabled",
+            "disable_signal_handler", "get_cuda_rng_state",
+            "set_cuda_rng_state", "check_shape", "tril_indices",
+            "triu_indices"}
     for name, fn in method_sources.items():
         if name in skip or hasattr(Tensor, name):
             continue
@@ -123,8 +128,43 @@ def _install_tensor_methods():
     for nm in ("add", "subtract", "multiply", "scale", "clip", "floor",
                "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round",
                "tanh", "squeeze", "unsqueeze", "flatten", "scatter",
-               "remainder", "index_add"):
+               "remainder", "index_add", "erfinv", "lerp",
+               "put_along_axis"):
         setattr(Tensor, nm + "_", _inplace(nm))
+
+    # Tensor.cond is the linalg condition number (the registry name `cond`
+    # belongs to control flow)
+    Tensor.cond = _lin.cond_number
+
+    # in-place RANDOM fills: fresh draws, shape/dtype from self — no
+    # dependence on prior value, so no tape node (matches reference:
+    # uniform_/exponential_ are VarBase mutations without grad)
+    def _uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+        import jax as _jax
+
+        from ..core import rng as _rng
+
+        self._inplace_version += 1
+        self._value = _jax.random.uniform(
+            _rng.next_key(), tuple(self.shape), self._value.dtype,
+            minval=min, maxval=max)
+        return self
+
+    def _exponential_(self, lam=1.0, name=None):
+        import jax as _jax
+
+        from ..core import rng as _rng
+
+        self._inplace_version += 1
+        import jax.numpy as _jnp
+
+        u = _jax.random.uniform(_rng.next_key(), tuple(self.shape),
+                                self._value.dtype, minval=1e-12, maxval=1.0)
+        self._value = -(1.0 / lam) * _jnp.log(u)
+        return self
+
+    Tensor.uniform_ = _uniform_
+    Tensor.exponential_ = _exponential_
 
     # operator overloads
     Tensor.__add__ = lambda s, o: _math.add(s, o)

@@ -193,3 +193,25 @@ def test_dataparallel_alias():
 def test_dtype_class():
     x = paddle.to_tensor([1.0])
     assert isinstance(x.dtype, paddle.dtype)
+
+
+def test_tensor_method_parity_additions():
+    x = paddle.to_tensor(np.zeros((3,), np.float32))
+    x.uniform_(0.0, 1.0)
+    assert (x.numpy() >= 0).all() and (x.numpy() <= 1).all()
+    x.exponential_(2.0)
+    assert (x.numpy() > 0).all()
+    z = paddle.to_tensor(np.array([0.0], np.float32))
+    z.lerp_(paddle.to_tensor([10.0]), 0.5)
+    np.testing.assert_allclose(z.numpy(), [5.0])
+    e = paddle.to_tensor(np.array([0.5], np.float32))
+    e.erfinv_()
+    assert np.isfinite(e.numpy()).all()
+    w = paddle.to_tensor(np.array([[2.0, 1.0], [1.0, 3.0]], np.float32))
+    assert float(w.cond().numpy()) > 1.0
+    assert int(w.rank().numpy()) == 2
+    assert w.is_tensor()
+    p = paddle.to_tensor(np.array([[1.0, 1.0]], np.float32))
+    p.put_along_axis_(paddle.to_tensor(np.array([[1]])),
+                      paddle.to_tensor(np.array([[9.0]], np.float32)), 1)
+    np.testing.assert_allclose(p.numpy(), [[1.0, 9.0]])
