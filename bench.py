@@ -47,7 +47,7 @@ def bench_gpt():
 
     paddle.seed(0)
     cfg = gpt_small()
-    batch, seq = 8, 1024
+    batch, seq = 16, 1024  # b16 won the on-chip sweep (0.369 vs 0.360 MFU)
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
     # O1: fp32 params cast to bf16 at the matmuls. (O2 bf16 params were

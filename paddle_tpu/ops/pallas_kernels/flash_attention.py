@@ -101,7 +101,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # fully-masked rows (can't happen under causal) would have l == 0
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, :1] + jnp.log(safe_l)
+        # lse laid out [bh, 1, seq]: the row vector lives on the LANE dim,
+        # so the tile pads 8x (sublane), not 128x — a [bh, seq, 1] layout
+        # padded each per-layer residual from 1.5M to 192M
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
 
 
 def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
@@ -128,12 +131,11 @@ def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            # trailing singleton keeps the (block_q, 1) tile legal on TPU
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),    # acc
@@ -142,7 +144,7 @@ def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse[..., 0]
+    return out, lse  # lse: [bh, 1, seq]
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
@@ -164,9 +166,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        g = g_ref[0]          # input dtype: bf16 inputs stay on the MXU
-        lse = lse_ref[0]      # [block_q, 1] f32
-        delta = delta_ref[0]  # [block_q, 1] f32
+        g = g_ref[0]                    # bf16 inputs stay on the MXU
+        lse = lse_ref[0, 0][:, None]    # [block_q, 1] f32 (lane-major row)
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -218,9 +220,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        g = g_ref[0]          # input dtype: bf16 inputs stay on the MXU
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        g = g_ref[0]                    # bf16 inputs stay on the MXU
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -280,12 +282,12 @@ def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     kv_blocks = pl.cdiv(seq_k, block_k)
     gf = g.astype(q.dtype)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)[..., None]  # [bh, seq, 1]
-    lse3 = lse[..., None]
+                    axis=-1)[:, None, :]  # [bh, 1, seq] (lane-major)
+    lse3 = lse  # already [bh, 1, seq]
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -302,7 +304,7 @@ def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     # dkv pass: grid transposed so the q dimension is innermost
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(
             _fa_bwd_dkv_kernel, causal=causal, scale=scale, block_q=block_q,
