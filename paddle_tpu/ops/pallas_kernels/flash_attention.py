@@ -108,7 +108,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
-    """q,k,v: [bh, seq, d] → (out [bh, seq, d], lse [bh, seq])."""
+    """q,k,v: [bh, seq, d] → (out [bh, seq, d], lse [bh, 1, seq])."""
     bh, seq, d = q.shape
     seq_k = k.shape[1]
     block_q = min(block_q, seq)

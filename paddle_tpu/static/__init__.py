@@ -351,7 +351,10 @@ def Print(input, first_n=-1, message=None, summarize=20,
             head += f"name={t.name} "
         if print_tensor_shape:
             head += f"shape={tuple(t.shape)} "
-        print(head + str(np.asarray(t._value).reshape(-1)[:summarize]))
+        flat = np.asarray(t._value).reshape(-1)
+        if summarize is not None and summarize >= 0:
+            flat = flat[:summarize]  # -1 = print everything (reference)
+        print(head + str(flat))
     return input
 
 
@@ -518,13 +521,12 @@ class ExponentialMovingAverage:
         self._backup = {}
 
 
-_all_params_registry = []
-
-
 def _collect_all_parameters():
-    # EMA without explicit parameters needs a registry; layers register
-    # through nn.Layer.create_parameter only when asked (static mode)
-    return _all_params_registry
+    """Every live Parameter (tensor_core keeps a weakref registry)."""
+    from ..tensor_core import _parameter_registry
+
+    return [p for p in (r() for r in _parameter_registry)
+            if p is not None]
 
 
 def normalize_program(program, feed_vars, fetch_vars):

@@ -77,12 +77,39 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
     return _act(layer(input), act)
 
 
+def _transpose_kernel_from_output(input, output_size, stride, padding,
+                                  dilation, n, data_format):
+    """Derive filter_size from the requested output size (reference
+    conv2d_transpose supports either one): k = out - (in-1)·s + 2·p."""
+    spatial = (input.shape[2:2 + n] if data_format.startswith("NC")
+               else input.shape[1:1 + n])
+    out = ([output_size] * n if isinstance(output_size, int)
+           else list(output_size))
+    s = [stride] * n if isinstance(stride, int) else list(stride)
+    p = [padding] * n if isinstance(padding, int) else list(padding)
+    d = [dilation] * n if isinstance(dilation, int) else list(dilation)
+    k = []
+    for i in range(n):
+        eff = out[i] - (int(spatial[i]) - 1) * s[i] + 2 * p[i]
+        if eff < 1 or (eff - 1) % d[i] != 0:
+            raise ValueError(
+                f"output_size {out[i]} unreachable from input "
+                f"{spatial[i]} with stride {s[i]} padding {p[i]}")
+        k.append((eff - 1) // d[i] + 1)
+    return k
+
+
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, use_cudnn=True,
                      act=None, name=None, data_format="NCHW"):
     from .. import nn
 
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("pass filter_size or output_size")
+        filter_size = _transpose_kernel_from_output(
+            input, output_size, stride, padding, dilation, 2, data_format)
     in_c = input.shape[1 if data_format == "NCHW" else -1]
     layer = nn.Conv2DTranspose(in_c, num_filters, filter_size, stride,
                                padding, weight_attr=param_attr,
@@ -97,6 +124,11 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      act=None, name=None, data_format="NCDHW"):
     from .. import nn
 
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("pass filter_size or output_size")
+        filter_size = _transpose_kernel_from_output(
+            input, output_size, stride, padding, dilation, 3, data_format)
     in_c = input.shape[1 if data_format == "NCDHW" else -1]
     layer = nn.Conv3DTranspose(in_c, num_filters, filter_size, stride,
                                padding, weight_attr=param_attr,
@@ -501,7 +533,8 @@ class StaticRNN:
         for o in outputs:
             self.step_output(o)
 
-    def _replay(self, targets, subs):
+    @staticmethod
+    def _replay(targets, subs):
         memo = dict(subs)
 
         def ev(t):
@@ -519,23 +552,60 @@ class StaticRNN:
         return [ev(t) for t in targets]
 
     def __call__(self):
+        from ..autograd import engine
+
         if not self._seq:
             raise ValueError("StaticRNN has no step_input")
         T = int(self._seq[0][0].shape[0])
-        mem_vals = [m["init"]._value for m in self._memories]
-        collected = [[] for _ in self._outputs]
-        for t in range(T):
-            subs = {}
-            for full, sl in self._seq:
-                subs[id(sl)] = full._value[t]
-            for m, v in zip(self._memories, mem_vals):
-                subs[id(m["pre"])] = v
-            targets = list(self._outputs) + [
-                m["next"] for m in self._memories if m["next"] is not None]
-            vals = self._replay(targets, subs)
-            for i in range(len(self._outputs)):
-                collected[i].append(vals[i])
-            mem_vals = vals[len(self._outputs):]
-        outs = [Tensor(jnp.stack(c), stop_gradient=True)
-                for c in collected]
-        return outs[0] if len(outs) == 1 else tuple(outs)
+        targets = list(self._outputs) + [
+            m["next"] for m in self._memories if m["next"] is not None]
+        # leaves of the recorded step graph: the placeholders we substitute
+        # per step, plus every OTHER tensor (parameters, constants). The
+        # unroll runs as ONE tape op over those leaves, so gradients flow
+        # into the step body's parameters (reference StaticRNN backward).
+        placeholder_ids = ({id(sl) for _, sl in self._seq}
+                           | {id(m["pre"]) for m in self._memories})
+        leaves, seen = [], set()
+
+        def collect(t):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            node = t._grad_node
+            if node is None or node.jfn is None or id(t) in placeholder_ids:
+                if id(t) not in placeholder_ids:
+                    leaves.append(t)
+                return
+            for i in node.inputs:
+                collect(i)
+
+        for t in targets:
+            collect(t)
+        n_seq, n_mem, n_out = (len(self._seq), len(self._memories),
+                               len(self._outputs))
+        seq_tensors = [full for full, _ in self._seq]
+        mem_tensors = [m["init"] for m in self._memories]
+
+        def unroll_jfn(*vals):
+            seqs = vals[:n_seq]
+            mems = list(vals[n_seq:n_seq + n_mem])
+            base = {id(t): v for t, v in
+                    zip(leaves, vals[n_seq + n_mem:])}
+            acc = [[] for _ in range(n_out)]
+            for step_i in range(T):
+                subs = dict(base)
+                for (full, sl), sv in zip(self._seq, seqs):
+                    subs[id(sl)] = sv[step_i]
+                for m, mv in zip(self._memories, mems):
+                    subs[id(m["pre"])] = mv
+                vals_t = self._replay(targets, subs)
+                for i in range(n_out):
+                    acc[i].append(vals_t[i])
+                mems = vals_t[n_out:]
+            stacked = tuple(jnp.stack(a) for a in acc)
+            return stacked if n_out > 1 else stacked[0]
+
+        out = engine.apply(
+            "static_rnn", unroll_jfn,
+            tuple(seq_tensors) + tuple(mem_tensors) + tuple(leaves))
+        return out

@@ -218,8 +218,13 @@ def sequence_unpad(x, length, name=None):
 def sequence_expand(x, y, ref_level=-1, name=None):
     """Repeat x's sequences per y's LoD (reference sequence_expand_op):
     sequence i of x is tiled y_len_i times."""
-    xt = _as_lod(x) if isinstance(x, LoDTensor) else _as_lod(
-        x, [0, int(ensure_tensor(x).shape[0])])
+    if isinstance(x, LoDTensor):
+        xt = x
+    else:
+        # non-LoD x: each ROW is one length-1 sequence (reference
+        # sequence_expand_op semantics), not one big sequence
+        n_rows = int(ensure_tensor(x).shape[0])
+        xt = _as_lod(x, list(range(n_rows + 1)))
     yt = _as_lod(y)
     reps = yt.seq_lengths
     order = []

@@ -310,6 +310,9 @@ class Tensor:
 engine.register_tensor_class(Tensor)
 
 
+_parameter_registry = []  # weakrefs; static.ExponentialMovingAverage reads it
+
+
 class Parameter(Tensor):
     """Trainable parameter (reference: python/paddle/fluid/framework.py
     `Parameter`/`ParamBase`)."""
@@ -324,6 +327,12 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        import weakref
+
+        _parameter_registry.append(weakref.ref(self))
+        if len(_parameter_registry) % 4096 == 0:  # drop dead refs
+            _parameter_registry[:] = [r for r in _parameter_registry
+                                      if r() is not None]
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

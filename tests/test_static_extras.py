@@ -194,3 +194,49 @@ def test_print_passthrough(capsys):
     out = static.Print(x, message="dbg")
     assert out is x
     assert "dbg" in capsys.readouterr().out
+
+
+def test_static_rnn_gradients_flow():
+    rnn = snn.StaticRNN()
+    seq = paddle.to_tensor(
+        np.arange(12.0, dtype=np.float32).reshape(3, 2, 2))
+    w = paddle.create_parameter([2], "float32")
+    w._value = w._value * 0 + 0.5
+    w.stop_gradient = False
+    with rnn.step():
+        xt = rnn.step_input(seq)
+        h = rnn.memory(shape=[2], batch_ref=seq)
+        nh = (h + xt) * w
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    out = rnn()
+    out.sum().backward()
+    assert w.grad is not None and np.abs(w.grad.numpy()).sum() > 0
+
+
+def test_sequence_expand_dense_x_row_semantics():
+    y = LoDTensor(np.zeros((5, 1), np.float32), [0, 2, 2, 5])
+    ex = snn.sequence_expand(
+        paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32)), y)
+    assert ex.numpy()[:, 0].tolist() == [1, 1, 3, 3, 3]
+
+
+def test_conv_transpose_output_size():
+    out = snn.conv2d_transpose(paddle.randn([1, 3, 8, 8]), 6,
+                               output_size=[16, 16], stride=2)
+    assert out.shape == [1, 6, 16, 16]
+    with pytest.raises(ValueError):
+        snn.conv2d_transpose(paddle.randn([1, 3, 8, 8]), 6)
+
+
+def test_ema_default_registry():
+    ema = static.ExponentialMovingAverage(0.5)
+    p = paddle.create_parameter([2], "float32")
+    ema.update()  # no explicit list: live-Parameter registry supplies it
+    assert any(not isinstance(k, str) for k in ema._ema)
+
+
+def test_print_summarize_all(capsys):
+    static.Print(paddle.to_tensor([1.0, 2.0, 3.0, 4.0]), summarize=-1)
+    out = capsys.readouterr().out
+    assert "4." in out
