@@ -96,6 +96,7 @@ for _sub in (
     "fft",
     "signal",
     "utils",
+    "onnx",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

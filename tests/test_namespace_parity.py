@@ -159,3 +159,17 @@ def test_device_profiler_utils_exports():
         paddle.utils.require_version("99.0.0")
     with pytest.raises(RuntimeError):
         paddle.utils.download("http://example.com/x.bin")
+
+
+def test_onnx_export(tmp_path):
+    m = paddle.nn.Linear(4, 2)
+    prefix = str(tmp_path / "model")
+    out = paddle.onnx.export(
+        m, prefix, input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+    assert out == prefix
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert any("stablehlo" in f for f in files)
+    with pytest.raises(RuntimeError):
+        paddle.onnx.export(
+            m, prefix, input_spec=[paddle.jit.InputSpec([1, 4], "float32")],
+            require_onnx_binary=True)
