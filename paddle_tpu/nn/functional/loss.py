@@ -620,8 +620,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         valid = (tbl >= 0)
         safe = jnp.where(valid, tbl, 0)
         w_path = w[safe]                      # [N, L, D]
-        z = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
-                       w_path.astype(jnp.float32))
+        ct = jnp.promote_types(x.dtype, jnp.float32)
+        z = jnp.einsum("nd,nld->nl", x.astype(ct), w_path.astype(ct))
         if b is not None:
             z = z + b.reshape(-1)[safe]
         # softplus(z) - bit*z == -log sigmoid BCE on the path decision
@@ -643,7 +643,7 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 
     def jfn(cos, lbl):
         lbl_i = lbl.reshape(-1).astype(jnp.int32)
-        cf = cos.astype(jnp.float32)
+        cf = cos.astype(jnp.promote_types(cos.dtype, jnp.float32))
         hit = jax.lax.broadcasted_iota(
             jnp.int32, cf.shape, cf.ndim - 1) == lbl_i[:, None]
         theta = jnp.arccos(jnp.clip(cf, -1.0 + 1e-7, 1.0 - 1e-7))

@@ -241,3 +241,32 @@ def test_softmax2d_layer():
                                np.ones((1, 2, 2)), rtol=1e-6)
     with pytest.raises(ValueError):
         nn.Softmax2D()(paddle.ones([2, 2]))
+
+
+def test_new_loss_finite_difference_grads():
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from grad_check import fd_grad_check
+
+    rng = np.random.default_rng(11)
+    lbl = paddle.to_tensor(np.array([0, 2, 1]))
+    probs_raw = rng.random((3, 3)) + 0.1
+
+    fd_grad_check(
+        lambda p: F.dice_loss(F.softmax(p), lbl.unsqueeze(-1)),
+        [probs_raw], wrt=[0])
+    y = np.array([1.0, -1.0, 1.0])
+    fd_grad_check(
+        lambda x: F.soft_margin_loss(x, paddle.to_tensor(y)),
+        [rng.standard_normal(3)], wrt=[0])
+    w = rng.standard_normal((5, 4)) * 0.2
+    fd_grad_check(
+        lambda x: F.hsigmoid_loss(x, paddle.to_tensor(np.array([0, 4, 2])),
+                                  6, paddle.to_tensor(w)),
+        [rng.standard_normal((3, 4))], wrt=[0])
+    cosv = (rng.random((3, 4)) * 2 - 1) * 0.8
+    fd_grad_check(
+        lambda c: F.margin_cross_entropy(
+            c, paddle.to_tensor(np.array([0, 1, 2])), margin2=0.2,
+            scale=8.0),
+        [cosv], wrt=[0])
