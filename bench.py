@@ -4,26 +4,74 @@ Measures the flagship GPT-small compiled train step (paddle_tpu.jit.TrainStep:
 loss + backward + AdamW in ONE XLA program) on the real chip, bf16 compute
 via amp O1. Reports MFU against the TPU v5e nominal bf16 peak.
 
+Hardened capture path (round-3):
+  * The top-level process is a small supervisor; each model runs in its OWN
+    subprocess so a wedged/unavailable TPU backend can be killed and retried
+    without poisoning jax's cached backend-init failure, and so the chip is
+    released the moment the worker exits.
+  * Backend-init failures (``UNAVAILABLE`` / "Unable to initialize backend")
+    are retried with exponential backoff (up to 6 worker runs, ~6 min of
+    sleeps between them) under an overall wall-clock budget: if no GPT
+    result exists after GPT_DEADLINE_S, the fallback JSON line is emitted
+    rather than letting an external capture window expire with nothing on
+    stdout. An init attempt can also HANG (observed ~25 min before
+    raising) — the per-attempt subprocess timeout converts that into a
+    kill + retry.
+  * The persistent XLA compilation cache (``JAX_COMPILATION_CACHE_DIR``) is
+    enabled, so a retry after a partial run skips the ~50-80 s per-model
+    compiles that made the round-2 capture window overrun (BENCH_r02 rc=124).
+  * The headline JSON line is emitted the moment the GPT result exists;
+    resnet50/bert run afterwards as best-effort and report to stderr only.
+
 vs_baseline: the reference repo publishes no numbers (BASELINE.md); the
 north-star is ≥0.8× GPU-reference throughput. A well-tuned GPU LLM trainer
 of the reference's era runs ≈0.35 MFU, so the comparable bar is
 0.8 × 0.35 = 0.28 MFU and vs_baseline = mfu / 0.28.
-
-Extra per-model results go to stderr; stdout carries exactly one JSON line.
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
-
 V5E_PEAK_BF16 = 197e12  # nominal chip peak, FLOP/s
 BASELINE_MFU = 0.28     # 0.8 × (typical 0.35 GPU-trainer MFU): see docstring
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+
+# Exit code a worker uses to signal "backend unavailable, retry me".
+RC_BACKEND_UNAVAILABLE = 3
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# Worker side: runs ONE model benchmark in its own process.
+# --------------------------------------------------------------------------
+
+def _worker_bootstrap():
+    """Configure jax for a bench worker; exit RC_BACKEND_UNAVAILABLE if the
+    TPU backend cannot come up (the supervisor retries with backoff)."""
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    # Cache every compile, however small: retries must be near-free.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # knob not present in this jax — default is fine
+    try:
+        devs = jax.devices()
+        log(f"[bench] backend up: {[d.platform for d in devs]}")
+    except RuntimeError as e:
+        log(f"[bench] backend init failed: {e!r}")
+        sys.exit(RC_BACKEND_UNAVAILABLE)
+    return jax
 
 
 def gpt_flops_per_step(cfg, batch, seq):
@@ -40,8 +88,9 @@ def gpt_flops_per_step(cfg, batch, seq):
 
 
 def bench_gpt():
+    import numpy as np
     import paddle_tpu as paddle
-    from paddle_tpu import amp, nn
+    from paddle_tpu import amp
     from paddle_tpu.text.models import (
         GPTForCausalLM, GPTPretrainingCriterion, gpt_small)
 
@@ -92,6 +141,7 @@ def bench_gpt():
 
 
 def bench_resnet():
+    import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import amp, nn
     from paddle_tpu.vision.models import resnet50
@@ -130,6 +180,7 @@ def bench_resnet():
 
 def bench_bert():
     """ERNIE-3.0/BERT-base MLM pretraining step (BASELINE.md config 3)."""
+    import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import amp
     from paddle_tpu.text.models import (
@@ -184,36 +235,109 @@ def bench_bert():
             "mfu": round(mfu, 4)}
 
 
-def main():
-    results = {}
-    try:
-        results["gpt"] = bench_gpt()
-    except Exception as e:  # keep the contract: always print one line
-        log(f"[bench] gpt failed: {e!r}")
-    try:
-        results["resnet"] = bench_resnet()
-    except Exception as e:
-        log(f"[bench] resnet failed: {e!r}")
-    try:
-        results["bert"] = bench_bert()
-    except Exception as e:
-        log(f"[bench] bert failed: {e!r}")
+_WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert}
 
-    if "gpt" in results:
-        mfu = results["gpt"]["mfu"]
+
+def worker_main(which):
+    _worker_bootstrap()
+    result = _WORKERS[which]()
+    # Machine-readable result on stdout (supervisor parses; user sees stderr).
+    print(json.dumps({"worker": which, "result": result}), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Supervisor side.
+# --------------------------------------------------------------------------
+
+def _run_worker(which, timeout_s):
+    """Run one model bench in a subprocess. Returns (status, result_dict).
+
+    status ∈ {"ok", "unavailable", "error", "timeout"}. The subprocess owns
+    the chip only while alive, so killing it on timeout releases the TPU for
+    the next attempt (the round-2 failure mode was a held chip).
+    """
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", which]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True, cwd=os.path.dirname(
+                                os.path.abspath(__file__)))
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return "timeout", None
+    if proc.returncode == RC_BACKEND_UNAVAILABLE:
+        return "unavailable", None
+    if proc.returncode != 0:
+        return "error", None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+                if payload.get("worker") == which:
+                    return "ok", payload["result"]
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return "error", None
+
+
+GPT_DEADLINE_S = 40 * 60   # overall budget for the headline result
+
+
+def main():
+    # Headline: GPT. Retry backend-unavailable with exponential backoff
+    # (15+30+60+120+120 s of sleeps); a timeout also earns a retry — the
+    # kill released the chip, the compile cache makes the rerun cheap.
+    # The whole loop is bounded by GPT_DEADLINE_S of wall clock so a
+    # persistently-down backend still yields a JSON line on stdout.
+    backoffs = [15, 30, 60, 120, 120]
+    t_start = time.monotonic()
+    gpt = None
+    for attempt in range(len(backoffs) + 1):
+        remaining = GPT_DEADLINE_S - (time.monotonic() - t_start)
+        if remaining < 60:
+            log("[bench] gpt deadline exhausted")
+            break
+        status, gpt = _run_worker("gpt", timeout_s=min(900, remaining))
+        if status == "ok":
+            break
+        log(f"[bench] gpt attempt {attempt + 1} -> {status}")
+        if attempt < len(backoffs):
+            time.sleep(backoffs[attempt])
+
+    detail = {}
+    if gpt is not None:
+        detail["gpt"] = gpt
+        mfu = gpt["mfu"]
         line = {
             "metric": "gpt_small_train_mfu",
             "value": mfu,
             "unit": "fraction_of_v5e_bf16_peak",
             "vs_baseline": round(mfu / BASELINE_MFU, 4),
-            "detail": results,
+            "detail": detail,
         }
     else:
         line = {"metric": "gpt_small_train_mfu", "value": 0.0,
                 "unit": "fraction_of_v5e_bf16_peak", "vs_baseline": 0.0,
-                "detail": results}
+                "detail": detail}
+    # Emit the headline NOW: nothing after this point can zero the result.
     print(json.dumps(line), flush=True)
+
+    # Best-effort extras — stderr only, one attempt each, bounded. If even
+    # the headline failed, the backend is down: don't burn more window.
+    if gpt is None:
+        return
+    for which in ("resnet", "bert"):
+        status, res = _run_worker(which, timeout_s=420)
+        if status == "ok":
+            log(f"[bench] {which} result: {json.dumps(res)}")
+        else:
+            log(f"[bench] {which} skipped ({status})")
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        worker_main(sys.argv[2])
+    else:
+        main()

@@ -11,6 +11,7 @@ from .pipeline_1f1b import (  # noqa: F401
     interleaved_stacking_order,
     pipeline_1f1b,
     pipeline_forward_loss,
+    schedule_ticks,
 )
 from .pipeline_parallel import PipelineParallel, spmd_pipeline  # noqa: F401
 from .pp_layers import (  # noqa: F401

@@ -39,7 +39,8 @@ from jax.sharding import PartitionSpec as P
 from ... import mesh as mesh_mod
 
 __all__ = ["pipeline_1f1b", "pipeline_forward_loss",
-           "interleaved_pipeline_loss", "interleaved_stacking_order"]
+           "interleaved_pipeline_loss", "interleaved_stacking_order",
+           "schedule_ticks"]
 
 
 def _tree_zeros(tree):
@@ -51,63 +52,164 @@ def _tree_add_masked(acc, new, valid):
         lambda a, n: a + jnp.where(valid, n, jnp.zeros_like(n)), acc, new)
 
 
+def schedule_ticks(M, pp, num_virtual=1):
+    """Scan length of the (interleaved) 1F1B lockstep schedule.
+
+    For M divisible by pp this is M·V + (V+1)·pp − 2 — the PROVABLE minimum
+    for a barrier-synchronous schedule where every tick runs one forward and
+    one backward chunk-step per device: the last work unit's forward cannot
+    start before tick M·V−1 (M·V units enter stage 0 one per tick), finishes
+    on the last stage at M·V+pp−2, and its cotangent then has to traverse
+    all V·pp logical stages, one hop per tick. At V=1 this is the classic
+    M + 2(pp−1). (The reference's asynchronous interleave —
+    pipeline_parallel.py:488 — quotes a bubble of 2(pp−1)/V in *half*-slot
+    units; that relies on per-device free-running progress, which a
+    ppermute-synchronized SPMD program cannot express without making every
+    slot cost max(fwd, bwd). The lockstep optimum realized here cuts the
+    1F1B bubble from 2V(pp−1) — V serial fill-drain passes — to
+    (V+1)·pp − 2, and keeps activation memory O(V·pp), independent of M.)
+    """
+    V = num_virtual
+    qh, rh = divmod(M - 1, pp)
+    return qh * V * pp + (V - 1) * pp + rh + (V + 1) * pp - 1
+
+
 def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
-                  y_micro, pp, remat):
-    """Inside shard_map over 'pp'. Returns (loss_sum, param_grads[1,...],
-    post_grads, dx_micro)."""
-    params = stacked_params  # leaves [L/pp, ...]: this stage's slice
+                  y_micro, pp, remat, num_virtual=1):
+    """Inside shard_map over 'pp'. Returns (loss_sum, param_grads,
+    post_grads, dx_micro).
+
+    Generalized tick-interleaved schedule (reference:
+    fleet/meta_parallel/pipeline_parallel.py:416
+    PipelineParallelWithInterleave / interleave_pipeline:488). With V
+    virtual chunks per stage, micro-batch m = q·pp + r traverses logical
+    stage v·pp + s (chunk v on device s) as work unit
+
+        u(m, v) = q·V·pp + v·pp + r          forward at tick u + s .
+
+    Consecutive chunks of a micro are exactly pp units apart, so chunk v+1
+    on device 0 consumes the ring value device pp−1 produced for chunk v
+    one tick earlier — the SAME single ppermute ring as V=1. Backward
+    reverses chunk order within each pp-micro group,
+
+        β(m, v) = q·V·pp + (V−1−v)·pp + r    backward at tick
+                                             (V·pp−1) + β + (pp−1) − s ,
+
+    which makes the cotangent of (m, v) arrive on device pp−1 exactly one
+    tick after device 0 finishes (m, v+1) — again the unmodified reverse
+    ring. Every formula reduces to the V=1 1F1B schedule (fwd t = m + s,
+    bwd t = m + 2(pp−1) − s) when V == 1.
+
+    Params: for V == 1 `stacked_params` is this stage's chunk pytree as
+    before; for V > 1 its leaves carry a leading [V] axis (chunk v of this
+    stage at index v — rows of the global [pp·V] stack ordered by
+    `interleaved_stacking_order`), selected per tick with a dynamic slice.
+
+    The head/loss vjp runs under `lax.cond`, only on the device/tick pairs
+    that actually need it (last stage, last chunk) — on every other stage
+    it previously burned a full head vjp per tick (vocab-sized matmuls).
+    """
+    V = num_virtual
+    params = stacked_params
     stage = lax.axis_index("pp")
     M = x_micro.shape[0]
-    T = M + 2 * (pp - 1)
-    S = 2 * pp - 1  # max in-flight micros per stage (ring-buffer slots)
+    Vpp = V * pp
+    qh, rh = divmod(M - 1, pp)
+    u_max = qh * Vpp + (V - 1) * pp + rh   # last valid work unit / β index
+    T = schedule_ticks(M, pp, V)
+    # Slots: in-flight units at one device span a u-window < 2·V·pp − 1
+    # (forward is u-ordered, backward β-ordered with |u − β| ≤ (V−1)·pp),
+    # so slot = u mod S never collides. V=1 → the familiar 2·pp − 1.
+    S = 2 * Vpp - 1
 
     blk = jax.checkpoint(block_fn) if remat else block_fn
     micro_shape = x_micro.shape[1:]
+
+    def chunk_params(v):
+        if V == 1:
+            return params
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            params)
+
+    def decode(idx):
+        """idx (clipped to [0, u_max]) → (q, v_or_vr, r)."""
+        q, rem = idx // Vpp, idx % Vpp
+        return q, rem // pp, rem % pp
 
     def tick(carry, t):
         saved, pgrads, hgrads, dxs, loss_sum, fwd_recv, bwd_recv = carry
 
         # ---------------- forward micro-step ----------------
-        mf = t - stage
-        fwd_valid = (mf >= 0) & (mf < M)
+        u = t - stage
+        u_c = jnp.clip(u, 0, u_max)
+        qf, vf, rf = decode(u_c)
+        mf = qf * pp + rf
+        fwd_valid = (u >= 0) & (u <= u_max) & (mf < M)
         mf_c = jnp.clip(mf, 0, M - 1)
-        x_in = jnp.where(stage == 0, x_micro[mf_c], fwd_recv)
-        out = blk(params, x_in)
-        # only save valid micros: cooldown ticks clip mf to M-1, which
-        # would overwrite a slot whose micro is still awaiting backward
+        x_in = jnp.where((stage == 0) & (vf == 0), x_micro[mf_c], fwd_recv)
+        out = blk(chunk_params(vf), x_in)
+        # only save valid units: clipped ticks must not overwrite a slot
+        # whose unit is still awaiting backward
         saved = lax.cond(
             fwd_valid,
-            lambda b: lax.dynamic_update_index_in_dim(b, x_in, mf_c % S, 0),
+            lambda b: lax.dynamic_update_index_in_dim(b, x_in, u_c % S, 0),
             lambda b: b,
             saved,
         )
 
         # ---------------- backward micro-step ----------------
-        mb = t - 2 * (pp - 1) + stage
-        bwd_valid = (mb >= 0) & (mb < M)
+        b = t + stage - Vpp - pp + 2
+        b_c = jnp.clip(b, 0, u_max)
+        qb, vrb, rb = decode(b_c)
+        vb = (V - 1) - vrb
+        mb = qb * pp + rb
+        bwd_valid = (b >= 0) & (b <= u_max) & (mb < M)
         mb_c = jnp.clip(mb, 0, M - 1)
-        x_saved = saved[mb_c % S]
+        u_b = qb * Vpp + vb * pp + rb       # forward index of this unit
+        x_saved = saved[u_b % S]
         y_mb = y_micro[mb_c]
 
         # ONE re-linearization of the block per tick; the last stage's
-        # boundary cotangent comes from a (cheap) vjp of just the head+loss
-        # on the block output, interior stages use the received cotangent
-        out_b, vjp_blk = jax.vjp(blk, params, x_saved)
-        loss_val, vjp_head = jax.vjp(
-            lambda o, hp: loss_fn(o, y_mb, hp), out_b, post_params)
-        d_out, dh_l = vjp_head(jnp.ones_like(loss_val))
-        is_last = stage == pp - 1
-        cot = jnp.where(is_last, d_out, bwd_recv)
+        # boundary cotangent comes from a vjp of just the head+loss on the
+        # block output (gated: other stages/chunks skip it entirely),
+        # interior logical stages use the received cotangent.
+        params_b = chunk_params(vb)
+        out_b, vjp_blk = jax.vjp(blk, params_b, x_saved)
+        is_head = (stage == pp - 1) & (vb == V - 1) & bwd_valid
+
+        def head_branch(ob, y):
+            loss_val, vjp_head = jax.vjp(
+                lambda o, hp: loss_fn(o, y, hp), ob, post_params)
+            d_out, dh_l = vjp_head(jnp.ones_like(loss_val))
+            return loss_val.astype(jnp.float32), d_out, dh_l
+
+        def skip_branch(ob, y):
+            return (jnp.zeros([], jnp.float32), jnp.zeros_like(ob),
+                    _tree_zeros(post_params))
+
+        loss_val, d_out, dh_l = lax.cond(
+            is_head, head_branch, skip_branch, out_b, y_mb)
+        cot = jnp.where(is_head, d_out, bwd_recv)
         dparams, dx = vjp_blk(cot)
 
-        pgrads = _tree_add_masked(pgrads, dparams, bwd_valid)
-        hgrads = _tree_add_masked(hgrads, dh_l, bwd_valid & is_last)
-        loss_sum = loss_sum + jnp.where(
-            bwd_valid & is_last, loss_val, 0.0).astype(jnp.float32)
+        if V == 1:
+            pgrads = _tree_add_masked(pgrads, dparams, bwd_valid)
+        else:
+            g_old = jax.tree_util.tree_map(
+                lambda g: lax.dynamic_index_in_dim(g, vb, 0,
+                                                   keepdims=False), pgrads)
+            g_new = _tree_add_masked(g_old, dparams, bwd_valid)
+            pgrads = jax.tree_util.tree_map(
+                lambda g, n: lax.dynamic_update_index_in_dim(g, n, vb, 0),
+                pgrads, g_new)
+        # loss_val / dh_l are exactly zero off the head ticks (cond)
+        hgrads = jax.tree_util.tree_map(lambda a, d: a + d, hgrads, dh_l)
+        loss_sum = loss_sum + loss_val
         dxs = lax.cond(
-            bwd_valid & (stage == 0),
-            lambda b: lax.dynamic_update_index_in_dim(b, dx, mb_c, 0),
-            lambda b: b,
+            bwd_valid & (stage == 0) & (vb == 0),
+            lambda bf: lax.dynamic_update_index_in_dim(bf, dx, mb_c, 0),
+            lambda bf: bf,
             dxs,
         )
 
@@ -195,9 +297,9 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
     return run(stacked_params, post_params, x_micro, y_micro)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5, 6))
 def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat=True):
+                  remat=True, num_virtual=1):
     """Differentiable 1F1B pipeline loss.
 
     block_fn(stage_params, x) -> y   one stage's pure forward; stage_params
@@ -217,20 +319,30 @@ def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
     caller composes through outer AD).
     """
     loss, _, _, _ = _pipeline_call(block_fn, loss_fn, stacked_params,
-                                   post_params, batch, remat)
+                                   post_params, batch, remat, num_virtual)
     return loss
 
 
 def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
-                   remat):
+                   remat, num_virtual=1):
     mesh = mesh_mod.global_mesh()
     pp = mesh.shape["pp"]
+    V = num_virtual
     x_micro, y_micro = batch
     if pp == 1:
         # degenerate: straight-line execution, still micro-batched
+        def apply_chunks(sp, x):
+            if V == 1:
+                return block_fn(sp, x)
+            for v in range(V):
+                x = block_fn(
+                    jax.tree_util.tree_map(lambda a, _v=v: a[_v], sp), x)
+            return x
+
         def full(sp, hp, xm):
             losses = jax.vmap(
-                lambda x, y: loss_fn(block_fn(sp, x), y, hp))(xm, y_micro)
+                lambda x, y: loss_fn(apply_chunks(sp, x), y, hp))(
+                xm, y_micro)
             return jnp.mean(losses)
 
         loss, vjp = jax.vjp(full, stacked_params, post_params, x_micro)
@@ -242,9 +354,12 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
     rep = lambda t: jax.tree_util.tree_map(
         lambda a: P(*([None] * a.ndim)), t)
 
+    # For V > 1 the stage's shard of the [pp·V] stack is its V chunks in
+    # order (rows [s·V, (s+1)·V), see interleaved_stacking_order) — exactly
+    # the leading-[V] layout _run_schedule selects from per tick.
     run = jax.shard_map(
         functools.partial(_run_schedule, block_fn, loss_fn, pp=pp,
-                          remat=remat),
+                          remat=remat, num_virtual=V),
         mesh=mesh,
         in_specs=(stack_spec, rep(post_params), P(*([None] * x_micro.ndim)),
                   P(*([None] * y_micro.ndim))),
@@ -256,13 +371,14 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
 
 
 def _pipeline_fwd(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat):
+                  remat, num_virtual=1):
     loss, pg, hg, dx = _pipeline_call(block_fn, loss_fn, stacked_params,
-                                      post_params, batch, remat)
+                                      post_params, batch, remat,
+                                      num_virtual)
     return loss, (pg, hg, dx, batch[1])
 
 
-def _pipeline_bwd(block_fn, loss_fn, remat, res, g):
+def _pipeline_bwd(block_fn, loss_fn, remat, num_virtual, res, g):
     pg, hg, dx, y = res
     scale = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
     return (scale(pg), scale(hg),
@@ -293,38 +409,30 @@ def interleaved_stacking_order(pp, num_virtual):
 def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
                               post_params, batch, num_virtual=1,
                               remat=True):
-    """Virtual-stage pipeline loss (reference:
+    """Tick-interleaved virtual-stage 1F1B loss (reference:
     fleet/meta_parallel/pipeline_parallel.py:416
     PipelineParallelWithInterleave, parallel_layers/pp_layers.py:198).
 
     Each device owns `num_virtual` NON-contiguous model chunks
-    (round-robin layer placement — the interleave memory/balance
-    property). stacked_params leaves are [pp·V, ...] sharded P('pp'),
-    rows ordered by `interleaved_stacking_order` so stage s's shard is
-    its V chunks. The forward chains V fill-drain passes over the 'pp'
-    axis; autodiff runs through the scans (activation memory O(M) per
-    stage — the reference's tick-interleaved 1F1B schedule that also
-    shrinks the bubble V× is a scheduling refinement on top of this
-    placement).
+    (round-robin layer placement). stacked_params leaves are [pp·V, ...]
+    sharded P('pp'), rows ordered by `interleaved_stacking_order` so stage
+    s's shard is its V chunks. All V·pp logical stages run in ONE scan —
+    per-tick chunk selection on the unified 1F1B schedule (see
+    `_run_schedule` / `schedule_ticks`): `schedule_ticks(M, pp, V)` ≈
+    M·V + (V+1)·pp − 2 ticks instead of the V·(M + 2(pp−1)) of V serial
+    fill-drain passes, with activation memory O(V·pp) per stage
+    (independent of M — the 1F1B property).
 
     Returns mean micro-loss; differentiable w.r.t. params/post/x_micro.
+    NOTE: like `pipeline_1f1b`, the custom_vjp treats labels (y_micro) as
+    non-differentiable — their cotangent is zero. Losses that need label
+    gradients (e.g. soft-label distillation) must route the differentiable
+    part through x_micro or post_params instead.
     """
-    from .pipeline_parallel import spmd_pipeline
-
-    mesh = mesh_mod.global_mesh()
-    pp = mesh.shape["pp"]
-    x_micro, y_micro = batch
-    V = num_virtual
-
-    # [pp·V, ...] → [pp, V, ...]: chunk v of every stage is [:, v]
-    def split_chunks(a):
-        return a.reshape((pp, V) + a.shape[1:])
-
-    chunked = jax.tree_util.tree_map(split_chunks, stacked_params)
-    x = x_micro
-    for v in range(V):
-        params_v = jax.tree_util.tree_map(lambda a, _v=v: a[:, _v],
-                                          chunked)
-        x = spmd_pipeline(block_fn, params_v, x, remat=remat)
-    losses = jax.vmap(lambda o, y: loss_fn(o, y, post_params))(x, y_micro)
-    return jnp.mean(losses)
+    pp = mesh_mod.global_mesh().shape["pp"]
+    lead = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if lead != pp * num_virtual:
+        raise ValueError(
+            f"stacked_params leading dim {lead} != pp*V = {pp}*{num_virtual}")
+    return pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params,
+                         batch, remat, num_virtual)
