@@ -30,6 +30,7 @@ from .collective import (  # noqa: F401
     reduce,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     spmd,
     stream,
     wait,

@@ -32,7 +32,8 @@ from . import mesh as mesh_mod
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "init_parallel_env",
     "is_initialized", "all_reduce", "all_gather", "all_gather_object",
-    "broadcast", "reduce", "scatter", "alltoall", "alltoall_single",
+    "broadcast", "reduce", "scatter", "scatter_object_list", "alltoall",
+    "alltoall_single",
     "send", "recv", "isend", "irecv", "barrier", "reduce_scatter",
     "split_group_axes", "spmd", "get_rank", "get_world_size", "wait",
     "stream",
@@ -75,6 +76,8 @@ class Group:
 
     @property
     def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
         return self._static_size()
 
     def _static_size(self):
@@ -99,10 +102,19 @@ class Group:
         return "_".join(self.axes)
 
     def get_group_rank(self, rank):
-        return rank
+        """Global→group rank (reference collective.py Group.get_group_rank:
+        index into the ranks list; -1 when not a member)."""
+        if self.ranks is None:
+            return rank if 0 <= rank < self._static_size() else -1
+        try:
+            return list(self.ranks).index(rank)
+        except ValueError:
+            return -1
 
     def __repr__(self):
-        return f"Group(axes={self.axes}, nranks={self._static_size()})"
+        return (f"Group(axes={self.axes}, nranks={self.nranks}"
+                + (f", ranks={list(self.ranks)}" if self.ranks is not None
+                   else "") + ")")
 
 
 _groups = {}
@@ -141,8 +153,12 @@ def _ensure_default():
 
 def new_group(ranks=None, backend=None, timeout=None, axes=None):
     """(reference collective.py:396). TPU-native: a group IS a mesh-axis
-    selection; `axes` names them. `ranks` is kept for API compat and
-    attached for bookkeeping."""
+    selection; `axes` names them. `ranks` additionally restricts the
+    group to an arbitrary SUBSET of positions along those axes
+    (flattened, row-major in axis order): inside SPMD regions the
+    collectives become MASKED — members exchange, non-members keep
+    their own tensors untouched, exactly the reference subgroup
+    semantics without needing a separate communicator."""
     g = Group(axes if axes is not None else ("dp",), ranks=ranks)
     _groups[g.id] = g
     return g
@@ -207,6 +223,45 @@ def _axes_of(group):
 
 
 # --------------------------------------------------------------- collectives
+_OP_IDENTITY = {
+    "sum": 0.0, "avg": 0.0, "max": -jnp.inf, "min": jnp.inf, "prod": 1.0,
+}
+
+
+def _group_pos(g):
+    """Traced flattened position of this device along the group's axes."""
+    idx = 0
+    for a in g.axes:
+        idx = idx * mesh_mod.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def _member_mask(g):
+    """(member?, group position) for a ranks-subset group; member is None
+    for whole-axis groups."""
+    idx = _group_pos(g)
+    if g.ranks is None:
+        return None, idx
+    return jnp.isin(idx, jnp.asarray(np.asarray(g.ranks))), idx
+
+
+def _masked_reduce(v, op, g):
+    """Reduce over a ranks-subset: members see the member-only reduction,
+    non-members keep their own value (reference subgroup communicator
+    semantics, collective.py:396 new_group + :751 all_reduce)."""
+    member, _ = _member_mask(g)
+    axes = g.axes if len(g.axes) > 1 else g.axes[0]
+    if member is None:
+        return _reduce_val(v, op, axes)
+    ident = jnp.asarray(_OP_IDENTITY[op], v.dtype)
+    contrib = jnp.where(member, v, ident)
+    if op in (ReduceOp.AVG, "avg"):
+        red = lax.psum(contrib, axes) / len(g.ranks)
+    else:
+        red = _reduce_val(contrib, op, axes)
+    return jnp.where(member, red, v)
+
+
 def _reduce_val(v, op, axes):
     if op in (ReduceOp.SUM, "sum"):
         return lax.psum(v, axes)
@@ -245,8 +300,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             "eager all_reduce across a >1-size axis must run inside an SPMD "
             "region (paddle_tpu.distributed.spmd / parallelized train step)"
         )
-    axes = _axes_of(group)
-    out = apply_jfn("c_allreduce", lambda v: _reduce_val(v, op, axes), t)
+    g = group or _ensure_default()
+    out = apply_jfn("c_allreduce", lambda v: _masked_reduce(v, op, g), t)
     if isinstance(tensor, Tensor):
         tensor._value = out._value
         tensor._grad_node = out._grad_node
@@ -257,13 +312,54 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    """Reduce-to-root. DEGRADED vs reference (collective.py:845): every
-    rank receives the reduced value, not only `dst` — in one compiled
-    SPMD program the root distinction buys nothing (XLA would all-reduce
-    anyway), and ranks other than dst are free to ignore the result.
-    Code that relies on non-dst ranks keeping their ORIGINAL tensor must
-    save it before calling."""
-    return all_reduce(tensor, op=op, group=group)
+    """Reduce-to-root (reference collective.py:849): only rank `dst`
+    receives the reduced value; every other rank keeps its ORIGINAL
+    tensor. Inside SPMD the reduction is an all-reduce that non-dst
+    ranks mask back to their input (XLA would emit the all-reduce
+    anyway — the masking costs one select); in the eager
+    multi-controller path non-dst processes simply restore their local
+    value after the wire all-reduce. `dst` is the position along the
+    group's axes (== the group rank for whole-axis groups; for
+    ranks-subset groups it is the GROUP rank within `ranks`)."""
+    t = ensure_tensor(tensor)
+    if not _in_spmd():
+        g = group or _ensure_default()
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            _check_xproc_group(group)
+            original = np.asarray(t._value)
+            red = xproc.all_reduce_np(original, op=op)
+            me = env_mod.get_rank()
+            chosen = red if me == dst else original
+            if isinstance(tensor, Tensor):
+                tensor._value = jnp.asarray(chosen)
+                return tensor
+            return Tensor(jnp.asarray(chosen), stop_gradient=True)
+        if g._static_size() == 1:
+            return tensor
+        raise RuntimeError(
+            "eager reduce across a >1-size axis must run inside an SPMD "
+            "region (paddle_tpu.distributed.spmd / parallelized step)")
+    g = group or _ensure_default()
+
+    def jfn(v):
+        member, idx = _member_mask(g)
+        red = _masked_reduce(v, op, g)
+        if g.ranks is not None:
+            dst_pos = list(g.ranks)[dst]  # dst = group rank
+        else:
+            dst_pos = dst
+        return jnp.where(idx == dst_pos, red, v)
+
+    out = apply_jfn("c_reduce", jfn, t)
+    if isinstance(tensor, Tensor):
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    return out
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -340,45 +436,136 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         if g._static_size() == 1:
             return tensor
         raise RuntimeError("broadcast across >1 ranks requires SPMD region")
+    g = group or _ensure_default()
     axes = _axes_of(group)
 
     def jfn(v):
-        # take the value living on rank `src` of the axis
+        # take the value living on rank `src` of the axis; for a
+        # ranks-subset group src is the GROUP rank and non-members keep
+        # their own value
+        member, idx = _member_mask(g)
+        src_pos = list(g.ranks)[src] if g.ranks is not None else src
         gathered = lax.all_gather(v, axes, axis=0)
-        return gathered[src]
+        picked = gathered[src_pos]
+        return picked if member is None else jnp.where(member, picked, v)
 
     out = apply_jfn("c_broadcast", jfn, t)
     if isinstance(tensor, Tensor):
         tensor._value = out._value
         tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
         tensor.stop_gradient = out.stop_gradient
         return tensor
     return out
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    """Scatter slices of the src-rank tensor. DEGRADED vs reference
-    (collective.py:1120): inside one SPMD program every rank executes
-    the same code on a replicated input, so `src` is vacuous — each rank
-    slices its own chunk of the (identical) full tensor. If callers feed
-    rank-DIVERGENT inputs, the result follows each rank's own input, not
-    src's; broadcast first in that case."""
+    """Scatter slices of the src-rank tensor (reference collective.py:1140).
+
+    Eager multi-controller: src broadcasts the stacked parts over the
+    wire; each process keeps its own slice — true src semantics. Inside
+    SPMD: the input is first broadcast from `src` (one all_gather pick,
+    free when the input is already replicated), then every rank slices
+    its chunk — so rank-divergent inputs follow src, as the reference
+    does."""
+    src_parts = tensor_list if isinstance(tensor_list, (list, tuple)) \
+        else None
     t = ensure_tensor(tensor_list if isinstance(tensor_list, Tensor)
                       else tensor)
     if not _in_spmd():
         g = group or _ensure_default()
+        from . import xproc
+
+        if xproc.is_multiprocess():
+            _check_xproc_group(group)
+            me = env_mod.get_rank()
+            if src_parts is not None and me == src:
+                stacked = np.stack([np.asarray(value_of(ensure_tensor(p)))
+                                    for p in src_parts])
+            else:
+                one = np.asarray(value_of(t))
+                stacked = np.stack(
+                    [np.zeros_like(one)] * env_mod.get_world_size())
+            stacked = xproc.broadcast_np(stacked, src=src)
+            mine = stacked[me]
+            if isinstance(tensor, Tensor):
+                tensor._value = jnp.asarray(mine)
+                return tensor
+            return Tensor(jnp.asarray(mine), stop_gradient=True)
         if g._static_size() == 1:
+            if src_parts is not None:
+                out = ensure_tensor(src_parts[0])
+                if isinstance(tensor, Tensor):
+                    tensor._value = out._value
+                    return tensor
+                return out
             return tensor
         raise RuntimeError("scatter across >1 ranks requires SPMD region")
+    if src_parts is not None:
+        from ..ops.manipulation import concat as t_concat
+
+        t = ensure_tensor(t_concat([ensure_tensor(p) for p in src_parts],
+                                   axis=0))
+    g2 = group or _ensure_default()
     axes = _axes_of(group)
 
     def jfn(full):
-        n = mesh_mod.axis_size(axes if isinstance(axes, str) else axes[0])
-        idx = lax.axis_index(axes)
-        chunk = full.shape[0] // n
-        return lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)
+        # src semantics for rank-divergent inputs: use src's full tensor;
+        # for ranks-subset groups src/dst are GROUP ranks, chunks are
+        # dealt only to members, and non-members get zeros (they are not
+        # part of the collective — there is no same-shape "untouched"
+        # value, the output shape is the chunk shape)
+        member, idx = _member_mask(g2)
+        gathered = lax.all_gather(full, axes, axis=0)
+        if g2.ranks is not None:
+            ranks_arr = jnp.asarray(np.asarray(g2.ranks))
+            src_full = gathered[list(g2.ranks)[src]]
+            n = len(g2.ranks)
+            grp_rank = jnp.argmax(ranks_arr == idx)  # 0 for non-members
+        else:
+            src_full = gathered[src]
+            n = mesh_mod.axis_size(
+                axes if isinstance(axes, str) else axes[0])
+            grp_rank = idx
+        chunk = src_full.shape[0] // n
+        piece = lax.dynamic_slice_in_dim(src_full, grp_rank * chunk,
+                                         chunk, axis=0)
+        if member is not None:
+            piece = jnp.where(member, piece, jnp.zeros_like(piece))
+        return piece
 
-    return apply_jfn("c_scatter", jfn, t)
+    out = apply_jfn("c_scatter", jfn, t)
+    if isinstance(tensor, Tensor) and not isinstance(tensor_list, Tensor):
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    return out
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter a list of picklable objects from src (reference
+    collective.py scatter_object_list). Eager multi-controller only
+    (objects can't live inside a compiled program); single-process:
+    identity on element 0."""
+    from . import xproc
+
+    if xproc.is_multiprocess():
+        import pickle
+
+        _check_xproc_group(group)
+        me = env_mod.get_rank()
+        payload = pickle.dumps(in_object_list if me == src else None)
+        blobs = xproc.all_gather_bytes(payload)
+        objs = pickle.loads(blobs[src])
+        if objs is None:
+            raise ValueError("scatter_object_list: src provided no objects")
+        out_object_list.append(objs[me])
+        return out_object_list
+    out_object_list.append(in_object_list[0])
+    return out_object_list
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):

@@ -35,7 +35,8 @@ from ..tensor_core import Tensor
 from . import mesh as mesh_mod
 
 __all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
-           "make_sparse_table", "SparseEmbedding", "ShardedEmbedding"]
+           "ShardedSparseTable", "make_sparse_table", "SparseEmbedding",
+           "ShardedEmbedding"]
 
 
 # ------------------------------------------------------ optimizer rules
@@ -120,6 +121,16 @@ class MemorySparseTable:
         self._init = initializer or (
             lambda n: (self._rng.standard_normal((n, self.dim)) /
                        np.sqrt(self.dim)).astype(np.float32))
+        # id-aware initializers (f(n, ids)) make row values a pure
+        # function of the id — required for shard-count-independent
+        # initialization (a sharded table must equal the 1-process one)
+        import inspect
+
+        try:
+            self._init_takes_ids = (
+                len(inspect.signature(self._init).parameters) >= 2)
+        except (TypeError, ValueError):
+            self._init_takes_ids = False
         self._rows = {}   # id -> row index in the arrays below
         self._data = np.zeros((0, self.dim), np.float32)
         self._slots = self.rule.init_slots(0, self.dim)
@@ -135,8 +146,9 @@ class MemorySparseTable:
             base = len(self._rows)
             for k, i in enumerate(missing):
                 self._rows[i] = base + k
-            self._data = np.concatenate(
-                [self._data, self._init(len(missing))])
+            new = (self._init(len(missing), np.asarray(missing, np.int64))
+                   if self._init_takes_ids else self._init(len(missing)))
+            self._data = np.concatenate([self._data, new])
             self._slots = np.concatenate(
                 [self._slots, self.rule.init_slots(len(missing), self.dim)])
 
@@ -182,6 +194,134 @@ class MemorySparseTable:
         self._slots = np.asarray(
             sd["slots"]._value if isinstance(sd["slots"], Tensor)
             else sd["slots"], np.float32)
+
+
+# ------------------------------------------------- multi-host sharding
+
+class ShardedSparseTable:
+    """Multi-process id-routed sparse table.
+
+    The reference shards ids across PS server processes (`id % server_num`)
+    with async trainer-side push queues (reference:
+    ps/table/memory_sparse_table.h:39 shard layout,
+    ps/service/brpc_ps_client.h:195 id-routed pull/push RPC,
+    ps/service/communicator/communicator.h:427 AsyncCommunicator bounded
+    push queues). TPU-native redesign: there are no separate server
+    processes — every trainer process owns the shard `id % world == rank`
+    of the table in host RAM next to its chip, and pull/push are EAGER
+    COLLECTIVES over the jax.distributed gloo/CPU mesh (`xproc`), so the
+    transport is the same compiled-collective machinery as everything
+    else (no brpc analog needed).
+
+    Contract: pull/flush are collective — every process must call them
+    the same number of times. SPMD data-parallel training guarantees this
+    (DistributedBatchSampler pads every rank to the same batch count).
+
+    Push is ASYNC with bounded staleness (AsyncCommunicator semantics):
+    `push` only queues gradients locally; the queue is flushed — one
+    routing collective applying grads on their owner shards — every
+    `staleness`-th push call (and on `flush()`). With staleness=1 pushes
+    are synchronous and a sharded run is bit-identical to a 1-process
+    table (asserted by tests/test_ps_deepfm.py).
+    """
+
+    def __init__(self, embedding_dim, rule=None, initializer=None, seed=0,
+                 staleness=1, backend="auto", world=None, rank=None):
+        from . import xproc
+
+        if world is None:
+            world = jax.process_count() if xproc.is_multiprocess() else 1
+        if rank is None:
+            rank = jax.process_index() if world > 1 else 0
+        self.world, self.rank = world, rank
+        self.dim = embedding_dim
+        self.staleness = max(1, int(staleness))
+        self.local = make_sparse_table(embedding_dim, rule=rule,
+                                       initializer=initializer, seed=seed,
+                                       backend=backend)
+        self._pending_ids = []
+        self._pending_grads = []
+        self._push_calls = 0
+
+    def __len__(self):
+        return len(self.local)
+
+    def _gather_obj(self, obj):
+        import pickle
+
+        from . import xproc
+
+        blobs = xproc.all_gather_bytes(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            max_len=1 << 27)
+        return [pickle.loads(b) for b in blobs]
+
+    def pull(self, ids):
+        """Route each id to its owner shard, gather the rows back.
+        Two collective rounds: requests, then served rows."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        if self.world == 1:
+            return self.local.pull(ids)
+        uniq = np.unique(ids)
+        requests = self._gather_obj(uniq)          # round 1: who needs what
+        served = {}
+        for requester, want in enumerate(requests):
+            mine = want[want % self.world == self.rank]
+            if len(mine):
+                served[requester] = (mine, self.local.pull(mine))
+        responses = self._gather_obj(served)       # round 2: serve rows
+        rowmap = {}
+        for resp in responses:
+            if self.rank in resp:
+                sids, srows = resp[self.rank]
+                for i, row in zip(sids, srows):
+                    rowmap[int(i)] = row
+        return np.stack([rowmap[int(i)] for i in ids]) if len(ids) else \
+            np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids, grads):
+        """Queue gradients; flush every `staleness`-th call."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        self._pending_ids.append(ids)
+        self._pending_grads.append(grads)
+        self._push_calls += 1
+        if self._push_calls % self.staleness == 0:
+            self.flush()
+
+    def flush(self):
+        """Collective: route queued grads to owner shards and apply the
+        optimizer rule there (server-side optimize, as in the reference)."""
+        if self.world == 1:
+            for i, g in zip(self._pending_ids, self._pending_grads):
+                self.local.push(i, g)
+            self._pending_ids, self._pending_grads = [], []
+            return
+        if self._pending_ids:
+            ids = np.concatenate(self._pending_ids)
+            grads = np.concatenate(self._pending_grads)
+        else:
+            ids = np.zeros((0,), np.int64)
+            grads = np.zeros((0, self.dim), np.float32)
+        self._pending_ids, self._pending_grads = [], []
+        incoming = self._gather_obj((ids, grads))  # one routing round
+        all_ids = [i for i, _ in incoming]
+        all_grads = [g for _, g in incoming]
+        cat_ids = np.concatenate(all_ids)
+        cat_grads = np.concatenate(all_grads)
+        mask = cat_ids % self.world == self.rank
+        if mask.any():
+            # MemorySparseTable.push dedup-accumulates repeated ids, so
+            # grads for the same id from several trainers sum correctly
+            self.local.push(cat_ids[mask], cat_grads[mask])
+
+    # checkpoint: each rank persists its own shard (pairs with the
+    # per-rank sharded checkpoint layout in distributed/checkpoint.py)
+    def state_dict(self):
+        return self.local.state_dict()
+
+    def set_state_dict(self, sd):
+        self.local.set_state_dict(sd)
 
 
 # --------------------------------------------------------- layer shims

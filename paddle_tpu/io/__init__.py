@@ -3,11 +3,17 @@
 TPU-native re-design of the reference's dataloader stack
 (reference: python/paddle/fluid/dataloader/dataloader_iter.py:148 single-proc
 and :342 multi-proc over shared-mem mmap + worker processes). On TPU the
-bottleneck is keeping the host→HBM feed ahead of the step, so the design is:
-numpy batches assembled by a background worker pool (threads — collate is
-numpy/C so the GIL releases), plus a prefetch queue depth (`prefetch_factor`)
-that double-buffers ahead of consumption. Worker processes are unnecessary:
-there is no CUDA-context fork problem on TPU hosts.
+bottleneck is keeping the host→HBM feed ahead of the step. Two prefetch
+backends, both with a bounded queue (`prefetch_factor`) and deterministic
+batch order:
+
+* `num_workers > 0` (default path): forked worker PROCESSES with
+  shared-memory batch transport (`io/multiprocess.py`) — Python-heavy
+  transforms hold the GIL, so threads cannot scale ImageNet-style
+  augmentation; this mirrors the reference's `_DataLoaderIterMultiProcess`.
+* `use_shared_memory=False`: in-process thread pool — zero fork cost,
+  right for collate-only pipelines (numpy/C releases the GIL) and for
+  datasets that cannot survive a fork (open device handles etc.).
 """
 import itertools
 import math
@@ -322,7 +328,7 @@ class _IterState:
     finalizer flips `stop` when the consumer goes away."""
 
     __slots__ = ("queue", "work_q", "stop", "done_lock", "done_workers",
-                 "n_workers", "dataset", "collate")
+                 "n_workers", "dataset", "collate", "worker_init_fn")
 
 
 _SENTINEL = object()
@@ -360,6 +366,11 @@ def _put_stoppable(state, item):
 
 def _prefetch_work(state, wid):
     _worker_info.info = _WorkerInfo(wid, state.n_workers, state.dataset)
+    if state.worker_init_fn is not None:
+        try:
+            state.worker_init_fn(wid)
+        except Exception as e:
+            _put_stoppable(state, (-1, None, e))
     while not state.stop.is_set():
         item = state.work_q.get()
         if item is None:
@@ -395,6 +406,7 @@ class _PrefetchIter:
         state.done_workers = 0
         state.dataset = loader.dataset
         state.collate = loader.collate_fn
+        state.worker_init_fn = getattr(loader, "worker_init_fn", None)
         self._state = state
         self._reorder = {}
         self._next_emit = 0
@@ -423,6 +435,9 @@ class _PrefetchIter:
             if item is _SENTINEL:
                 self._sentinel_seen = True
                 continue
+            if item[0] == -1:  # worker_init_fn failure: fail fast
+                self._state.stop.set()
+                raise item[2]
             self._reorder[item[0]] = item
 
     def __iter__(self):
@@ -442,6 +457,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -474,6 +492,10 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        from .multiprocess import MPPrefetchIter, can_fork
+
+        if self.use_shared_memory and can_fork():
+            return MPPrefetchIter(self, iter(self.batch_sampler))
         return _PrefetchIter(self, iter(self.batch_sampler))
 
     def _iter_sync(self):

@@ -38,10 +38,11 @@ class _DenseBackend:
 
 
 class _SparseBackend:
-    def __init__(self, dim, rule=None):
+    def __init__(self, dim, rule=None, table_fn=None):
         from ..distributed.ps import SparseEmbedding
 
-        self.emb = SparseEmbedding(dim, rule=rule)
+        table = table_fn(dim) if table_fn is not None else None
+        self.emb = SparseEmbedding(dim, table=table, rule=rule)
 
     def __call__(self, ids):
         return self.emb(ids)
@@ -55,11 +56,16 @@ class FM(nn.Layer):
     ids: (B, F) int64 globally-offset feature ids."""
 
     def __init__(self, vocab_size=None, embed_dim=8, sparse=False,
-                 sparse_rule=None):
+                 sparse_rule=None, sparse_table_fn=None):
         super().__init__()
         if sparse:
-            self._first = _SparseBackend(1, rule=sparse_rule)
-            self._embed = _SparseBackend(embed_dim, rule=sparse_rule)
+            # sparse_table_fn(dim) -> table: inject e.g. a multi-host
+            # ShardedSparseTable (distributed/ps.py) instead of the
+            # default per-process table
+            self._first = _SparseBackend(1, rule=sparse_rule,
+                                         table_fn=sparse_table_fn)
+            self._embed = _SparseBackend(embed_dim, rule=sparse_rule,
+                                         table_fn=sparse_table_fn)
         else:
             assert vocab_size is not None, "dense FM needs vocab_size"
             self._first = _DenseBackend(vocab_size, 1)
@@ -87,10 +93,12 @@ class DeepFM(nn.Layer):
     sharing the same embedding table."""
 
     def __init__(self, num_fields, vocab_size=None, embed_dim=8,
-                 hidden=(64, 32), sparse=False, sparse_rule=None):
+                 hidden=(64, 32), sparse=False, sparse_rule=None,
+                 sparse_table_fn=None):
         super().__init__()
         self.fm = FM(vocab_size=vocab_size, embed_dim=embed_dim,
-                     sparse=sparse, sparse_rule=sparse_rule)
+                     sparse=sparse, sparse_rule=sparse_rule,
+                     sparse_table_fn=sparse_table_fn)
         dims = [num_fields * embed_dim] + list(hidden)
         layers = []
         for i in range(len(hidden)):

@@ -5,6 +5,9 @@ Must run before jax is imported anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # axon env presets JAX_PLATFORMS=axon
+# silence XLA:CPU AOT cache-load feature-mismatch E-spam (pseudo-features
+# like +prefer-no-scatter are never reported by the host probe; same box)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +24,14 @@ import jax
 # The axon sitecustomize imports jax at interpreter start with
 # JAX_PLATFORMS=axon, so the env var alone is too late — force via config.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: test wall time is compile-dominated, and the
+# cache (keyed by HLO hash) makes warm reruns several× faster.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 # Numeric-parity tests compare against float64 numpy; keep CPU matmuls exact.
 # (On TPU the framework default stays bf16-on-MXU.)

@@ -1,0 +1,101 @@
+"""Worker for test_ps_deepfm.py multi-host PS tests (run via
+paddle_tpu.distributed.launch, 2 processes).
+
+Phase A: scripted pull/push rounds against a ShardedSparseTable —
+the test replays the identical op sequence on a single-process
+MemorySparseTable and compares probe rows exactly (id routing must be
+invisible).
+
+Phase B: data-parallel DeepFM-sparse training with sum-reduction loss,
+SGD everywhere, and summed dense-grad allreduce — mathematically
+identical to ONE process training on the concatenated batch, so the
+global loss curve must match the single-table run the test computes
+in-process.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.ps import (  # noqa: E402
+    ShardedSparseTable, SparseSGDRule)
+
+
+def make_init(dim):
+    """Row values a pure function of the id — shard-count independent."""
+    def f(n, ids):
+        return (np.sin(np.outer(ids + 1.0, np.arange(1, dim + 1)))
+                / np.sqrt(dim)).astype(np.float32)
+
+    return f
+
+
+def phase_a(rank, world):
+    dim = 4
+    t = ShardedSparseTable(dim, rule=SparseSGDRule(0.1),
+                           initializer=make_init(dim), staleness=1)
+    for k in range(5):
+        r = np.random.default_rng(100 * k + rank)
+        ids = r.integers(0, 40, (12,))
+        t.pull(ids)
+        grads = np.outer(np.cos(ids + k), np.ones(dim)).astype(np.float32)
+        t.push(ids, grads)
+    t.flush()
+    probe = np.arange(40)
+    rows = t.pull(probe)
+    return rows.tolist()
+
+
+def phase_b(rank, world, steps=12):
+    dim, fields, vocab = 8, 4, 50
+    paddle.seed(0)
+    m = paddle.rec.DeepFM(
+        num_fields=fields, embed_dim=dim, sparse=True,
+        sparse_table_fn=lambda d: ShardedSparseTable(
+            d, rule=SparseSGDRule(0.05), initializer=make_init(d),
+            staleness=1))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    losses = []
+    for step in range(steps):
+        r = np.random.default_rng(step)  # FULL batch, identical all ranks
+        ids_full = r.integers(0, vocab, (16, fields))
+        y_full = ((ids_full.sum(axis=1) % 2) == 0).astype(np.float32)
+        ids = paddle.to_tensor(ids_full[rank::world])
+        y = paddle.to_tensor(y_full[rank::world])
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            m(ids), y, reduction="sum")
+        loss.backward()  # sparse pushes happen in grad hooks (collective)
+        # dense side: SUM grads across ranks == full-batch sum-loss grads
+        for p in m.parameters():
+            if p.grad is not None:
+                p.grad._value = paddle.to_tensor(
+                    xproc.all_reduce_np(np.asarray(p.grad._value)))._value
+        opt.step()
+        opt.clear_grad()
+        g_loss = float(xproc.all_reduce_np(
+            np.asarray(loss.numpy(), np.float32).reshape(1)))
+        losses.append(g_loss)
+    return losses
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    rows = phase_a(rank, world)
+    losses = phase_b(rank, world)
+    with open(os.path.join(out_dir, f"ps_out_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "world": world, "rows": rows,
+                   "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()

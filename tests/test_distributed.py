@@ -109,6 +109,129 @@ class TestCollectives:
         out = dist.all_reduce(t)
         np.testing.assert_allclose(out.numpy(), np.ones(3))
 
+    def test_reduce_to_dst_masks_non_roots(self):
+        # reference collective.py:849: ONLY dst receives the reduction,
+        # every other rank keeps its original tensor
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(axes=("dp",))
+
+        def fn(x):
+            return dist.reduce(paddle.Tensor(x), dst=2, group=g)._value
+
+        f = dist.spmd(fn, in_specs=P("dp"), out_specs=P("dp"),
+                      group_axes=("dp",))
+        out = np.asarray(f(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[2] = 28.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_rank_subset_group_allreduce(self):
+        # new_group(ranks=[1,3,5]): members reduce among themselves,
+        # non-members untouched (reference subgroup semantics)
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(ranks=[1, 3, 5], axes=("dp",))
+        assert g.nranks == 3
+        assert g.get_group_rank(3) == 1 and g.get_group_rank(2) == -1
+
+        def fn(x):
+            return dist.all_reduce(paddle.Tensor(x), group=g)._value
+
+        f = dist.spmd(fn, in_specs=P("dp"), out_specs=P("dp"),
+                      group_axes=("dp",))
+        out = np.asarray(f(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[[1, 3, 5]] = 1.0 + 3.0 + 5.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_rank_subset_group_max_and_avg(self):
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(ranks=[0, 4, 6], axes=("dp",))
+
+        def fmax(x):
+            return dist.all_reduce(paddle.Tensor(x), op=dist.ReduceOp.MAX,
+                                   group=g)._value
+
+        out = np.asarray(dist.spmd(fmax, in_specs=P("dp"),
+                                   out_specs=P("dp"),
+                                   group_axes=("dp",))(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[[0, 4, 6]] = 6.0
+        np.testing.assert_allclose(out, expect)
+
+        def favg(x):
+            return dist.all_reduce(paddle.Tensor(x), op=dist.ReduceOp.AVG,
+                                   group=g)._value
+
+        out = np.asarray(dist.spmd(favg, in_specs=P("dp"),
+                                   out_specs=P("dp"),
+                                   group_axes=("dp",))(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[[0, 4, 6]] = (0.0 + 4.0 + 6.0) / 3
+        np.testing.assert_allclose(out, expect)
+
+    def test_rank_subset_group_broadcast_and_reduce(self):
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(ranks=[2, 5, 7], axes=("dp",))
+
+        def fb(x):  # src=1 is GROUP rank -> global rank 5
+            return dist.broadcast(paddle.Tensor(x), src=1, group=g)._value
+
+        out = np.asarray(dist.spmd(fb, in_specs=P("dp"),
+                                   out_specs=P("dp"),
+                                   group_axes=("dp",))(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[[2, 5, 7]] = 5.0
+        np.testing.assert_allclose(out, expect)
+
+        def fr(x):  # dst=2 is GROUP rank -> global rank 7
+            return dist.reduce(paddle.Tensor(x), dst=2, group=g)._value
+
+        out = np.asarray(dist.spmd(fr, in_specs=P("dp"),
+                                   out_specs=P("dp"),
+                                   group_axes=("dp",))(jnp.arange(8.0)))
+        expect = np.arange(8.0)
+        expect[7] = 2.0 + 5.0 + 7.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_scatter_rank_subset_group(self):
+        # subgroup scatter: src is a GROUP rank, chunks deal only to
+        # members (len(ranks) chunks), non-members receive zeros
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(ranks=[1, 4, 6], axes=("dp",))
+
+        def fn(x):
+            return dist.scatter(paddle.Tensor(x[0]), src=0, group=g)._value
+
+        f = dist.spmd(fn, in_specs=P("dp", None), out_specs=P("dp"),
+                      group_axes=("dp",))
+        full = np.tile(np.arange(6.0)[None, :], (8, 1))
+        full += 1000.0 * np.arange(8.0)[:, None]  # rank-divergent
+        out = np.asarray(f(jnp.asarray(full))).reshape(8, 2)
+        # src group-rank 0 = global rank 1; its vector is arange(6)+1000
+        expect = np.zeros((8, 2))
+        expect[1] = [1000.0, 1001.0]
+        expect[4] = [1002.0, 1003.0]
+        expect[6] = [1004.0, 1005.0]
+        np.testing.assert_allclose(out, expect)
+
+    def test_scatter_follows_src(self):
+        # rank-divergent inputs: every rank must get a slice of SRC's
+        # tensor (reference collective.py:1140), not of its own
+        mesh_mod.init_mesh(dp=8)
+        g = dist.new_group(axes=("dp",))
+
+        def fn(x):
+            # x: (1, 8) shard -> this rank's own full vector
+            return dist.scatter(paddle.Tensor(x[0]), src=3, group=g)._value
+
+        f = dist.spmd(fn, in_specs=P("dp", None), out_specs=P("dp"),
+                      group_axes=("dp",))
+        # per-rank input row r: full vector = arange(8) + 100*r
+        full = np.arange(8.0)[None, :] + 100.0 * np.arange(8.0)[:, None]
+        out = np.asarray(f(jnp.asarray(full)))
+        # src=3's tensor is arange(8)+300; rank r receives element r
+        np.testing.assert_allclose(out.ravel(), np.arange(8.0) + 300.0)
+
 
 def _copy_net(dst, src):
     dst.set_state_dict({k: v.numpy() for k, v in src.state_dict().items()})
@@ -272,6 +395,7 @@ class TestZeroSharding:
 
 
 class TestRingAttention:
+    @pytest.mark.slow
     def test_ring_matches_dense(self):
         mesh_mod.init_mesh(sp=8)
         b, s, h, d = 2, 32, 4, 8

@@ -1,0 +1,198 @@
+"""Multiprocess DataLoader: fork workers + shared-memory transport
+(reference: python/paddle/fluid/dataloader/dataloader_iter.py:342
+_DataLoaderIterMultiProcess, worker.py _worker_loop)."""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import io
+from paddle_tpu.io.multiprocess import MPPrefetchIter, can_fork
+
+pytestmark = pytest.mark.skipif(not can_fork(), reason="needs fork")
+
+
+class _ArrDataset(io.Dataset):
+    def __init__(self, n=64, dim=8):
+        self.n, self.dim = n, dim
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((self.dim,), i, np.float32), np.int64(i)
+
+
+class _SlowPython(io.Dataset):
+    """GIL-bound pure-python transform — the case thread pools cannot
+    scale and process workers must."""
+
+    def __init__(self, n=32, work=60000):
+        self.n, self.work = n, work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(self.work):  # pure-python loop: holds the GIL
+            acc = (acc + k * k) % 1000003
+        return np.array([i, acc % 7], np.float32)
+
+
+class TestMPDataLoader:
+    def test_uses_process_backend(self):
+        dl = io.DataLoader(_ArrDataset(16), batch_size=4, num_workers=2)
+        assert isinstance(iter(dl), MPPrefetchIter)
+        dl2 = io.DataLoader(_ArrDataset(16), batch_size=4, num_workers=2,
+                            use_shared_memory=False)
+        it2 = iter(dl2)
+        assert not isinstance(it2, MPPrefetchIter)
+        assert len(list(it2)) == 4  # thread backend actually delivers
+
+    def test_order_and_values_preserved(self):
+        n, bs = 64, 4
+        dl = io.DataLoader(_ArrDataset(n), batch_size=bs, num_workers=4)
+        seen = []
+        for xb, yb in dl:
+            x, y = xb.numpy(), yb.numpy()
+            np.testing.assert_allclose(x[:, 0], y)  # rows intact
+            seen.extend(y.tolist())
+        assert seen == list(range(n))  # deterministic order across workers
+
+    def test_multiple_epochs(self):
+        dl = io.DataLoader(_ArrDataset(20), batch_size=5, num_workers=2)
+        for _ in range(3):
+            ys = [int(y.numpy()[0]) for _, y in dl]
+            assert ys == [0, 5, 10, 15]
+
+    def test_structures_survive_transport(self):
+        class D(io.Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return {"x": np.ones((3,), np.float32) * i,
+                        "meta": (np.int32(i), "tag-%d" % i)}
+
+        def collate(samples):
+            return {"x": np.stack([s["x"] for s in samples]),
+                    "meta": [s["meta"] for s in samples]}
+
+        dl = io.DataLoader(D(), batch_size=3, num_workers=2,
+                           collate_fn=collate)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert batches[0]["x"].shape == (3, 3)
+        assert batches[0]["meta"][1][1] == "tag-1"
+
+    def test_worker_exception_propagates_and_pool_stops(self):
+        class Bad(io.Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                if i == 7:
+                    raise ValueError("poison sample")
+                return np.zeros((2,), np.float32)
+
+        dl = io.DataLoader(Bad(), batch_size=2, num_workers=3)
+        with pytest.raises(ValueError, match="poison sample"):
+            for _ in dl:
+                pass
+
+    def test_worker_init_fn_runs_and_failure_propagates(self):
+        calls = []
+
+        def init_ok(wid):
+            calls.append(wid)
+
+        dl = io.DataLoader(_ArrDataset(8), batch_size=4, num_workers=2,
+                           worker_init_fn=init_ok)
+        list(dl)
+        # init runs in the CHILD, so parent-side `calls` stays empty —
+        # assert via a side effect the worker can report: failure mode
+        def init_bad(wid):
+            raise RuntimeError("init exploded")
+
+        dl = io.DataLoader(_ArrDataset(8), batch_size=4, num_workers=2,
+                           worker_init_fn=init_bad)
+        with pytest.raises(RuntimeError, match="init exploded"):
+            list(dl)
+
+    def test_get_worker_info_in_worker(self):
+        class D(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                info = io.get_worker_info()
+                assert info is not None and 0 <= info.id < 2
+                return np.array([i, info.id], np.int64)
+
+        dl = io.DataLoader(D(), batch_size=2, num_workers=2)
+        wids = set()
+        for b in dl:
+            wids.update(b.numpy()[:, 1].tolist())
+        assert wids <= {0, 1} and len(wids) >= 1
+
+    def test_abandoned_iterator_tears_down(self):
+        dl = io.DataLoader(_ArrDataset(64), batch_size=4, num_workers=2)
+        it = iter(dl)
+        next(it)
+        state = it._state
+        del it
+        gc.collect()
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                p.is_alive() for p in state.procs):
+            time.sleep(0.1)
+        assert not any(p.is_alive() for p in state.procs)
+
+    def test_per_worker_numpy_streams_differ(self):
+        class R(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.random.randint(0, 1 << 30, size=(1,))
+
+        dl = io.DataLoader(R(), batch_size=1, num_workers=4)
+        vals = [int(b.numpy()[0, 0]) for b in dl]
+        assert len(set(vals)) > 4  # forked workers must not clone the RNG
+
+    def test_timeout_raises(self):
+        class Hang(io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                time.sleep(30)
+                return np.zeros((1,))
+
+        dl = io.DataLoader(Hang(), batch_size=2, num_workers=1, timeout=1)
+        with pytest.raises(RuntimeError, match="timed out"):
+            next(iter(dl))
+
+    @pytest.mark.skipif(
+        len(__import__("os").sched_getaffinity(0)) < 4,
+        reason="speedup needs >=4 CPUs (TPU hosts have 100+; this CI "
+               "container exposes %d)" % len(
+                   __import__("os").sched_getaffinity(0)))
+    def test_gil_bound_transform_speedup(self):
+        """The scaling gate: num_workers=4 must be ≥2× faster than 0 on a
+        transform-heavy (pure-python, GIL-bound) dataset. Only meaningful
+        with real cores to scale onto."""
+        ds = _SlowPython()
+        t0 = time.perf_counter()
+        for _ in io.DataLoader(ds, batch_size=4, num_workers=0):
+            pass
+        serial = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in io.DataLoader(ds, batch_size=4, num_workers=4):
+            pass
+        par = time.perf_counter() - t0
+        assert par * 2 <= serial, (
+            f"expected >=2x speedup: serial {serial:.2f}s vs mp {par:.2f}s")

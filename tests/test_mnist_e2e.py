@@ -1,6 +1,7 @@
 """MNIST LeNet end-to-end milestone (SURVEY.md §7 build step 3:
 'the ONE model milestone' — BASELINE.json config 1)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import io, metric, nn
@@ -8,6 +9,7 @@ from paddle_tpu.vision.datasets import MNIST
 from paddle_tpu.vision.models import LeNet
 
 
+@pytest.mark.slow
 def test_mnist_lenet_trains_and_evaluates(tmp_path):
     paddle.seed(42)
     train_ds = MNIST(mode="train")
@@ -54,6 +56,7 @@ def test_mnist_lenet_trains_and_evaluates(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_resnet18_forward_backward():
     m = paddle.vision.models.resnet18(num_classes=10)
     m.train()
@@ -64,6 +67,7 @@ def test_resnet18_forward_backward():
     assert m.conv1.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_mobilenet_forward():
     m = paddle.vision.models.mobilenet_v2(num_classes=7)
     m.eval()

@@ -277,6 +277,7 @@ class TestLayers:
         out_t, h_t = tl(torch.tensor(x))
         assert_close(out_p.numpy(), t2n(out_t), 1e-4)
 
+    @pytest.mark.slow
     def test_mha_self_attention_shapes_and_grad(self):
         mha = nn.MultiHeadAttention(16, 4)
         x = paddle.randn([2, 6, 16])
@@ -286,6 +287,7 @@ class TestLayers:
         out.sum().backward()
         assert mha.q_proj.weight.grad is not None
 
+    @pytest.mark.slow
     def test_transformer_full(self):
         model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
                                num_decoder_layers=2, dim_feedforward=32)
@@ -319,6 +321,7 @@ class TestLayers:
 
 
 class TestReviewRegressions:
+    @pytest.mark.slow
     def test_sdpa_dropout_on_probs(self):
         # with full dropout on attention probs, output must be all zeros
         q = paddle.randn([1, 4, 2, 8])

@@ -4,6 +4,7 @@ functional/loss.py ctc_loss → warpctc)."""
 import itertools
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -92,6 +93,7 @@ def _brute_ctc(lp, labels):
     return -total
 
 
+@pytest.mark.slow
 def test_ctc_matches_brute_force():
     rng = np.random.default_rng(1)
     T, B, C = 5, 2, 4

@@ -205,6 +205,7 @@ def test_functional_inplace():
     assert np.all(np.abs(y.numpy()) < 1)
 
 
+@pytest.mark.slow
 def test_beam_search_decoder():
     paddle.seed(0)
     V, D, H, B, beam = 7, 8, 8, 2, 3
@@ -243,6 +244,7 @@ def test_softmax2d_layer():
         nn.Softmax2D()(paddle.ones([2, 2]))
 
 
+@pytest.mark.slow
 def test_new_loss_finite_difference_grads():
     import sys, os
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

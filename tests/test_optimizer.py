@@ -210,6 +210,7 @@ class TestLRSchedules:
 
 
 class TestReviewRegressions:
+    @pytest.mark.slow
     def test_deepcopy_params_get_unique_state(self):
         # TransformerEncoder deep-copies its prototype layer; optimizer
         # state must not alias across copies

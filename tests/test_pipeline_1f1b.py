@@ -84,6 +84,7 @@ class Test1F1B:
                                    rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestPipelinedGPT:
     def _model(self, n_micro=4):
         from paddle_tpu.text.models.gpt import GPTConfig
@@ -191,11 +192,13 @@ class TestInterleaved:
                                    rtol=1e-4, atol=1e-5)
         mesh_mod.reset_mesh()
 
+    @pytest.mark.slow
     def test_interleaved_micro_not_divisible_by_pp(self):
         # M=6 with pp=4: the last unit group is partial — schedule holes
         # must stay masked bubbles, not corrupt grads.
         self._parity_case(pp=4, V=2, M=6)
 
+    @pytest.mark.slow
     def test_interleaved_deep_virtual_no_remat(self):
         self._parity_case(pp=2, V=4, M=4, remat=False)
 

@@ -83,6 +83,7 @@ def test_sequence_conv_respects_boundaries():
     assert out.shape == [5, 4] and out.lod == x.lod
 
 
+@pytest.mark.slow
 def test_builders():
     assert snn.fc(paddle.randn([2, 3, 4]), 5).shape == [2, 5]
     assert snn.batch_norm(paddle.randn([2, 3, 4, 4])).shape == [2, 3, 4, 4]

@@ -1,6 +1,7 @@
 """GPT flagship model: eager/compiled parity and TP parity on the 8-device
 mesh (SURVEY.md §4 implication (c))."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -19,6 +20,7 @@ def _batch(cfg, b=2, s=64, seed=0):
 
 
 class TestGPT:
+    @pytest.mark.slow
     def test_forward_shapes_and_grads(self):
         mesh_mod.reset_mesh()
         paddle.seed(0)
@@ -111,6 +113,7 @@ class TestBert:
         return (paddle.to_tensor(masked), paddle.to_tensor(labels),
                 paddle.to_tensor(nsp))
 
+    @pytest.mark.slow
     def test_forward_shapes_and_grads(self):
         from paddle_tpu.text.models import (
             BertForPretraining, BertPretrainingCriterion, bert_tiny)
@@ -129,6 +132,7 @@ class TestBert:
         assert model.bert.embeddings.word.weight.grad is not None
         assert model.bert.layers[-1].fc2.weight.grad is not None
 
+    @pytest.mark.slow
     def test_attention_mask_blocks_padding(self):
         from paddle_tpu.text.models import BertModel, bert_tiny
 
@@ -193,6 +197,7 @@ class TestBert:
         mesh_mod.reset_mesh()
         assert l < l0
 
+    @pytest.mark.slow
     def test_sequence_classification_finetune(self):
         from paddle_tpu.text.models import (
             BertForSequenceClassification, bert_tiny)
@@ -215,6 +220,7 @@ class TestBert:
 
 
 class TestGeneration:
+    @pytest.mark.slow
     def test_greedy_matches_full_forward(self):
         mesh_mod.reset_mesh()
         paddle.seed(20)

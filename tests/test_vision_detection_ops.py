@@ -40,6 +40,7 @@ def test_deform_conv2d_integer_shift():
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_deform_conv2d_grads_and_layer():
     rng = np.random.default_rng(2)
     x = paddle.to_tensor(rng.standard_normal((1, 2, 4, 4)).astype(np.float32),
@@ -94,6 +95,7 @@ def test_yolo_box_decode():
     assert (s2.numpy() == 0).all()
 
 
+@pytest.mark.slow
 def test_yolo_loss_signal():
     rng = np.random.default_rng(3)
     na, cls, h = 3, 2, 4

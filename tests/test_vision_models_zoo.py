@@ -11,6 +11,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
+pytestmark = pytest.mark.slow  # model-zoo/subprocess tier
+
 
 def _check(model, size=64, num_classes=10, batch=1):
     model.eval()
