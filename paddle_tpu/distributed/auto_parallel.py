@@ -32,7 +32,7 @@ from . import mesh as mesh_mod
 from .parallel_step import DistributedTrainStep
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Strategy",
-           "Engine", "plan_tp"]
+           "Engine", "plan_tp", "complete_annotations", "reshard"]
 
 
 class ProcessMesh:
@@ -81,6 +81,96 @@ def shard_op(op, process_mesh=None, in_shard_specs=None,
         return out
 
     return wrapped
+
+
+def reshard(x, shard_spec=None, process_mesh=None):
+    """Re-distribute a tensor to a new sharding (reference
+    auto_parallel/reshard.py Resharder). Eager tensors move via
+    device_put; values inside a trace get a with_sharding_constraint, so
+    XLA's SPMD partitioner emits the actual collective
+    (all-gather / all-to-all / slice) over ICI — the TPU-native form of
+    the reference's inserted reshard ops."""
+    spec = P(*shard_spec) if shard_spec is not None else P()
+    val = x._value if isinstance(x, Tensor) else x
+    if isinstance(val, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(
+            val, mesh_mod.named_sharding(*spec))
+    else:
+        out = jax.device_put(
+            val, mesh_mod.named_sharding(*spec))
+    if isinstance(x, Tensor):
+        x._value = out
+        x._pspec = spec
+        return x
+    return out
+
+
+def _axis_of_entry(entry):
+    if isinstance(entry, (tuple, list)):
+        return entry[0] if entry else None
+    return entry
+
+
+def complete_annotations(model, verbose=False):
+    """Dist-attr completion (reference:
+    auto_parallel/completion.py:140 Completer,
+    complete_forward_annotation:756).
+
+    The reference walks the serial graph propagating TensorDistAttr from
+    the user's partial `shard_tensor` annotations to every unannotated
+    tensor, then Resharder inserts comms where producer/consumer specs
+    disagree. Under GSPMD the second half is the XLA partitioner's job
+    (activation shardings and collective insertion are compile-time
+    propagation), so completion here = propagating PARAM placements:
+    walk the layer graph in declaration order tracking the mesh axis the
+    flowing activation's feature dim is sharded on, and fill in
+    unannotated weights with the placement that continues the pattern —
+    an annotated column-parallel Linear [.., P(None, a)] makes the next
+    unannotated Linear row-parallel [P(a, None)] (consuming the sharded
+    activation with no all-gather, Megatron pairing), its bias stays
+    replicated, a column weight's bias follows P(a). Embedding hidden
+    sharding P(None, a) seeds the same flow. Returns a list of
+    (param_name, completed_spec) decisions."""
+    decisions = []
+    act_axis = None
+    named = {id(p): n for n, p in model.named_parameters()}
+    for layer in model.sublayers(include_self=True):
+        kind = type(layer).__name__
+        w = getattr(layer, "weight", None)
+        b = getattr(layer, "bias", None)
+        if w is None or getattr(w, "_value", None) is None \
+                or w._value.ndim != 2:
+            continue
+        if kind == "Embedding":
+            if w._pspec is not None:
+                ax = _axis_of_entry(tuple(w._pspec)[1]
+                                    if len(tuple(w._pspec)) > 1 else None)
+                act_axis = ax  # hidden-dim sharding flows into the MLP
+            continue
+        if kind != "Linear":
+            continue
+        din, dout = int(w._value.shape[0]), int(w._value.shape[1])
+        if w._pspec is not None:
+            spec = tuple(w._pspec) + (None,) * (2 - len(tuple(w._pspec)))
+            col_ax = _axis_of_entry(spec[1])
+            row_ax = _axis_of_entry(spec[0])
+            if col_ax is not None:          # column-parallel
+                if b is not None and b._pspec is None:
+                    b._pspec = P(col_ax)
+                    decisions.append((named.get(id(b), "bias"), b._pspec))
+                act_axis = col_ax
+            elif row_ax is not None:        # row-parallel
+                act_axis = None
+            continue
+        # unannotated Linear: continue the flow
+        if act_axis is not None and din % mesh_mod.axis_size(act_axis) == 0:
+            w._pspec = P(act_axis, None)    # row-parallel completion
+            decisions.append((named.get(id(w), "weight"), w._pspec))
+            act_axis = None
+    if verbose:
+        for name, spec in decisions:
+            print(f"[completion] {name} -> {spec}")
+    return decisions
 
 
 def plan_tp(model, axis="mp"):
@@ -152,6 +242,9 @@ class Engine:
         st = self.strategy
         if st.tensor_parallel.enable:
             plan_tp(self.model)
+        # propagate the user's partial shard_tensor annotations
+        # (reference Completer — runs in every mode)
+        complete_annotations(self.model)
         loss = self.loss
 
         def loss_fn(m, *batch):

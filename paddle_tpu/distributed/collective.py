@@ -223,9 +223,16 @@ def _axes_of(group):
 
 
 # --------------------------------------------------------------- collectives
-_OP_IDENTITY = {
-    "sum": 0.0, "avg": 0.0, "max": -jnp.inf, "min": jnp.inf, "prod": 1.0,
-}
+def _op_identity(op, dtype):
+    """Reduction identity, dtype-aware (±inf has no int representation)."""
+    if op in ("sum", "avg"):
+        return jnp.asarray(0, dtype)
+    if op == "prod":
+        return jnp.asarray(1, dtype)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.min if op == "max" else info.max, dtype)
+    return jnp.asarray(-jnp.inf if op == "max" else jnp.inf, dtype)
 
 
 def _group_pos(g):
@@ -253,8 +260,7 @@ def _masked_reduce(v, op, g):
     axes = g.axes if len(g.axes) > 1 else g.axes[0]
     if member is None:
         return _reduce_val(v, op, axes)
-    ident = jnp.asarray(_OP_IDENTITY[op], v.dtype)
-    contrib = jnp.where(member, v, ident)
+    contrib = jnp.where(member, v, _op_identity(op, v.dtype))
     if op in (ReduceOp.AVG, "avg"):
         red = lax.psum(contrib, axes) / len(g.ranks)
     else:

@@ -44,7 +44,50 @@ def ensure_multihost_initialized():
         msg = str(e).lower()
         if "once" not in msg and "already" not in msg:
             raise
+    _start_heartbeat()
     return True
+
+
+_hb_thread = None
+
+
+def _start_heartbeat():
+    """Touch PADDLE_HEARTBEAT_DIR/hb_<rank> every second so the launcher
+    (and ElasticManager peers) can tell a HUNG worker from a live one —
+    process liveness alone misses wedged collectives (reference:
+    elastic/manager.py etcd heartbeat with TTL, master.py:234)."""
+    global _hb_thread
+    hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if not hb_dir or _hb_thread is not None:
+        return
+    import threading
+    import time
+
+    path = os.path.join(hb_dir, f"hb_{get_rank()}")
+
+    # a worker that exits CLEANLY must not look like a wedged one: remove
+    # the beat file so monitors (launcher, ElasticManager) stop tracking it
+    import atexit
+
+    def _tombstone():
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    atexit.register(_tombstone)
+
+    def beat():
+        while True:
+            try:
+                with open(path, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            time.sleep(1.0)
+
+    _hb_thread = threading.Thread(target=beat, daemon=True)
+    _hb_thread.start()
 
 
 def get_rank(group=None):

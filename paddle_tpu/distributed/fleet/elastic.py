@@ -32,18 +32,55 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    """API-shaped shim: membership is the mesh (static per pod slice);
-    `watch()` reports restart/exit from the supervised loop's results."""
+    """Pod-membership watcher over the launcher's heartbeat directory
+    (reference elastic/manager.py:127 — etcd node registry + TTL
+    heartbeats; here the jax.distributed KV/launcher heartbeat files
+    play that role: every worker touches hb_<rank> each second via
+    distributed/env.py:_start_heartbeat, the launcher restarts/shrinks
+    the pod on staleness, and this manager lets training code observe
+    the same signal in-process)."""
 
     def __init__(self, args=None, etcd_client=None):
-        self.enabled = bool(getattr(args, "elastic_level", 0))
-        self._status = ElasticStatus.HOLD
+        import os
+
+        self.enabled = bool(getattr(args, "elastic_level", 0)
+                            or os.environ.get("PADDLE_HEARTBEAT_DIR"))
+        self.hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
+        self.timeout = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT",
+                                            "30"))
+        self._status = None
 
     def pre_hook(self):
         pass
 
+    def peers(self):
+        """(rank, seconds-since-last-beat) for every registered worker."""
+        import os
+
+        if not self.hb_dir or not os.path.isdir(self.hb_dir):
+            return []
+        now = time.time()
+        out = []
+        for f in sorted(os.listdir(self.hb_dir)):
+            if not f.startswith("hb_"):
+                continue
+            try:
+                age = now - os.path.getmtime(os.path.join(self.hb_dir, f))
+            except OSError:
+                continue
+            out.append((int(f[3:]), age))
+        return out
+
     def watch(self):
-        return self._status
+        """HOLD while every registered peer beats within the timeout;
+        RESTART when one goes stale (the launcher will re-form the pod);
+        COMPLETED/ERROR after exit()."""
+        if self._status is not None:
+            return self._status
+        for _, age in self.peers():
+            if age > self.timeout:
+                return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
 
     def exit(self, completed=True):
         self._status = (ElasticStatus.COMPLETED if completed

@@ -61,14 +61,22 @@ def parse_args(argv=None):
                    help="restrict visible devices (sets TPU_VISIBLE_DEVICES)")
     p.add_argument("--max_restart", type=int, default=0,
                    help="restart the pod up to N times on failure")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: restart-only; >=1: after 2 consecutive failed "
+                        "attempts, re-form the pod over the surviving "
+                        "slots (shrink nproc by one, contiguous rank "
+                        "remap) — reference elastic/manager.py scale-in")
+    p.add_argument("--elastic_timeout", type=float, default=30.0,
+                   help="seconds without a worker heartbeat before the "
+                        "pod is declared hung and restarted")
     p.add_argument("--log_level", default="INFO")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank, master):
-    nproc = args.nproc_per_node
+def _worker_env(args, local_rank, master, nproc=None):
+    nproc = nproc if nproc is not None else args.nproc_per_node
     world = args.nnodes * nproc
     rank = args.rank * nproc + local_rank
     env = dict(os.environ)
@@ -80,19 +88,29 @@ def _worker_env(args, local_rank, master):
         "PADDLE_LOCAL_SIZE": str(nproc),
         "PADDLE_NNODES": str(args.nnodes),
         "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_HEARTBEAT_DIR": os.path.join(args.log_dir, "hb"),
+        "PADDLE_ELASTIC_TIMEOUT": str(args.elastic_timeout),
     })
     if args.devices is not None:
         env["TPU_VISIBLE_DEVICES"] = args.devices
     return env
 
 
-def _spawn_pod(args, master):
-    """Start nproc_per_node workers; local rank 0 inherits the console."""
+def _spawn_pod(args, master, nproc=None):
+    """Start nproc workers; local rank 0 inherits the console."""
+    nproc = nproc if nproc is not None else args.nproc_per_node
     os.makedirs(args.log_dir, exist_ok=True)
+    hb_dir = os.path.join(args.log_dir, "hb")
+    os.makedirs(hb_dir, exist_ok=True)
+    for f in os.listdir(hb_dir):  # stale beats from a previous attempt
+        try:
+            os.unlink(os.path.join(hb_dir, f))
+        except OSError:
+            pass
     procs = []
     cmd = [sys.executable, args.training_script] + args.training_script_args
-    for lr in range(args.nproc_per_node):
-        env = _worker_env(args, lr, master)
+    for lr in range(nproc):
+        env = _worker_env(args, lr, master, nproc)
         rank = env["PADDLE_TRAINER_ID"]
         if lr == 0:
             out = None  # inherit
@@ -106,8 +124,16 @@ def _spawn_pod(args, master):
     return procs
 
 
-def _wait_pod(procs, poll_s=0.2):
-    """Block until all exit ok or one fails (then kill the rest)."""
+def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
+              rank_base=0):
+    """Block until all exit ok or one fails (then kill the rest).
+
+    With a heartbeat dir, a worker whose beat file goes stale for longer
+    than hb_timeout is declared HUNG and fails the pod — liveness alone
+    misses a worker wedged in a dead collective (reference: etcd
+    heartbeat TTL, elastic/manager.py:234). Only workers that have
+    beaten at least once are monitored, so non-paddle scripts that never
+    call init_parallel_env are unaffected."""
     alive = {i: p for i, (p, _) in enumerate(procs)}
     failed_rc = 0
     while alive and not failed_rc:
@@ -119,6 +145,32 @@ def _wait_pod(procs, poll_s=0.2):
             del alive[i]
             if rc != 0:
                 failed_rc = rc
+            elif hb_dir:
+                # clean exit: drop the worker's beat so the staleness
+                # monitor doesn't mistake "finished" for "wedged" (the
+                # worker's own atexit does this too; SIGKILL'd-after-done
+                # edge cases land here)
+                try:
+                    os.unlink(os.path.join(hb_dir, f"hb_{rank_base + i}"))
+                except OSError:
+                    pass
+        if not failed_rc and hb_dir and hb_timeout > 0:
+            now = time.time()
+            try:
+                beats = os.listdir(hb_dir)
+            except OSError:
+                beats = []
+            for f in beats:
+                try:
+                    age = now - os.path.getmtime(os.path.join(hb_dir, f))
+                except OSError:
+                    continue
+                if age > hb_timeout:
+                    print(f"[launch] worker {f} heartbeat stale "
+                          f"({age:.0f}s > {hb_timeout:.0f}s): pod hung",
+                          file=sys.stderr, flush=True)
+                    failed_rc = 98  # synthetic "hung" exit code
+                    break
     for p in alive.values():
         p.send_signal(signal.SIGTERM)
     deadline = time.time() + 10
@@ -143,12 +195,28 @@ def launch(argv=None):
             sys.exit("--master is required when --nnodes > 1")
         master = f"127.0.0.1:{_free_port()}"
     attempts = args.max_restart + 1
+    nproc = args.nproc_per_node
+    hb_dir = os.path.join(args.log_dir, "hb")
+    consecutive = 0
     for attempt in range(attempts):
         if attempt:
-            print(f"[launch] pod failed; restart {attempt}/{args.max_restart}",
-                  file=sys.stderr, flush=True)
-        procs = _spawn_pod(args, master)
-        rc = _wait_pod(procs)
+            print(f"[launch] pod failed; restart {attempt}/{args.max_restart}"
+                  f" (nproc={nproc})", file=sys.stderr, flush=True)
+        procs = _spawn_pod(args, master, nproc)
+        rc = _wait_pod(procs, hb_dir=hb_dir,
+                       hb_timeout=args.elastic_timeout
+                       if args.elastic_timeout > 0 else 0.0,
+                       rank_base=args.rank * nproc)
         if rc == 0:
             return 0
+        consecutive += 1
+        # elastic scale-in: the pod keeps dying at this size — re-form it
+        # over the surviving slots with a contiguous rank remap
+        # (reference elastic/manager.py:127 rank-map regeneration)
+        if args.elastic_level >= 1 and consecutive >= 2 and nproc > 1:
+            nproc -= 1
+            consecutive = 0
+            print(f"[launch] elastic scale-in: re-forming pod with "
+                  f"{nproc} workers (ranks remapped 0..{nproc - 1})",
+                  file=sys.stderr, flush=True)
     sys.exit(rc)

@@ -57,6 +57,13 @@ def _zero_spec(pv, level, base_pspec):
             new = list(base)
             new[d] = "sharding"
             return P(*new)
+    import warnings
+
+    warnings.warn(
+        f"ZeRO ({level}): no dim of shape {tuple(pv.shape)} is divisible "
+        f"by the sharding axis ({n}) — this leaf stays REPLICATED and "
+        "saves no memory; pad the dim or change the axis size",
+        RuntimeWarning, stacklevel=2)
     return P(*base) if any(base) else P()
 
 

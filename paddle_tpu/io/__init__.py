@@ -371,6 +371,11 @@ def _prefetch_work(state, wid):
             state.worker_init_fn(wid)
         except Exception as e:
             _put_stoppable(state, (-1, None, e))
+            with state.done_lock:
+                state.done_workers += 1
+                if state.done_workers == state.n_workers:
+                    _put_stoppable(state, _SENTINEL)
+            return  # no batches from an uninitialized worker
     while not state.stop.is_set():
         item = state.work_q.get()
         if item is None:

@@ -350,8 +350,11 @@ class MPPrefetchIter:
                     self._fail(
                         f"DataLoader timed out after {self._timeout}s "
                         f"waiting for batch {self._next_emit}")
-                if not any(p.is_alive() for p in state.procs) and \
-                        not state.feeder.is_alive():
+                if not any(p.is_alive() for p in state.procs):
+                    # every worker is gone without a full set of _DONEs
+                    # (e.g. OOM-killer SIGKILLs): fail rather than poll
+                    # forever — the feeder may still be spinning on a
+                    # full work_q, so its liveness proves nothing
                     self._fail("DataLoader workers died unexpectedly")
                 continue
             if msg[0] == _DONE:

@@ -462,6 +462,13 @@ class TrainStep:
         for p, t in zip(self._param_objs, self._trainable):
             p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
+        from ..profiler import benchmark
+
+        bm = benchmark()
+        if bm.enabled:  # armed ips meter (reference profiler/timer.py)
+            n = batch_vals[0].shape[0] if batch_vals and \
+                getattr(batch_vals[0], "ndim", 0) else None
+            bm.auto_step(num_samples=n)
         return Tensor(loss)
 
 

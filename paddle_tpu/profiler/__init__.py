@@ -107,15 +107,47 @@ class RecordEvent:
 
 
 class _StepTimer:
-    """reader/batch cost + ips tracker (reference profiler/timer.py)."""
+    """reader/batch cost + ips tracker (reference profiler/timer.py).
+    `enable()` arms the global meter; an armed meter is fed by
+    jit.TrainStep automatically (one tick + sample count per compiled
+    step), so `benchmark().summary()` gives ips with zero changes to the
+    training loop."""
 
     def __init__(self):
+        self.enabled = False
         self.reset()
 
     def reset(self):
         self.step_times = []
         self.reader_costs = []
+        self.samples = 0
         self._t_last = None
+
+    def enable(self):
+        self.enabled = True
+        self.reset()
+
+    def disable(self):
+        self.enabled = False
+
+    def auto_step(self, num_samples=None):
+        """Tick from an instrumented step (TrainStep). Steps chain
+        through donated buffers, so wall deltas converge to true step
+        time once the dispatch pipeline fills."""
+        self.step()
+        if num_samples:
+            self.samples += int(num_samples)
+
+    def summary(self):
+        s = self.stats()
+        if not s:
+            return "no steps recorded"
+        line = (f"avg batch cost {s['avg_batch_cost_s'] * 1e3:.2f} ms, "
+                f"{s['steps_per_sec']:.2f} steps/s")
+        if self.samples and self.step_times:
+            ips = self.samples / sum(self.step_times)
+            line += f", {ips:,.1f} ips"
+        return line
 
     def before_reader(self):
         self._t_reader = time.perf_counter()
@@ -227,10 +259,40 @@ class Profiler:
                 f"steps/s: {s['steps_per_sec']:.2f}"
                 + (f" ips: {ips:.1f}" if ips else ""))
 
-    def summary(self, **kwargs):
+    def statistic_data(self):
+        """Parsed per-op statistics from the captured trace (reference
+        profiler_statistic.py StatisticData), or None when no trace was
+        recorded (timer_only / nothing captured yet)."""
+        if self.timer_only:
+            return None
+        from . import statistic
+
+        collected = statistic.collect(self._trace_dir)
+        if collected is None:
+            return None
+        return statistic.build_tables(collected)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None, max_rows=30):
+        """Print the step timer line plus the reference-style per-op
+        time/count tables parsed from the trace (reference:
+        profiler_statistic.py op summary; device lanes carry executed
+        HLO ops, host lanes carry python/runtime + RecordEvent spans)."""
         print(self.step_info())
-        if not self.timer_only:
-            print(f"trace artifacts (xprof/perfetto): {self._trace_dir}")
+        data = self.statistic_data()
+        if data is None:
+            if not self.timer_only:
+                print(f"trace artifacts (xprof/perfetto): "
+                      f"{self._trace_dir} (no parsed trace found)")
+            return None
+        from . import statistic
+
+        order = {None: "total", SortedKeys.OpTotal: "total",
+                 SortedKeys.OpAvg: "avg", SortedKeys.OpMax: "max",
+                 "total": "total", "avg": "avg", "max": "max",
+                 "calls": "calls"}.get(sorted_by, "total")
+        print(statistic.render(data, sorted_by=order, max_rows=max_rows))
+        return data
 
     def __enter__(self):
         self.start()
@@ -252,6 +314,10 @@ class SortedKeys:
     GPUAvg = 5
     GPUMax = 6
     GPUMin = 7
+    # aliases used by summary(): device==accelerator lanes, host==CPU
+    OpTotal = 0
+    OpAvg = 1
+    OpMax = 2
 
 
 class SummaryView:

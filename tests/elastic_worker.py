@@ -1,0 +1,71 @@
+"""Worker for the elastic-recovery test (test_elastic.py).
+
+Trains a small model for N deterministic steps with per-step
+checkpointing. On the FIRST attempt, rank 1 SIGKILLs itself mid-training
+(consuming a marker file so the restarted pod runs clean); the relaunched
+pod must auto-resume from the latest complete checkpoint and finish with
+the exact loss sequence of an uninterrupted run.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.checkpoint import Checkpointer  # noqa: E402
+
+STEPS = 8
+KILL_AT = 4  # rank 1 dies right after completing step KILL_AT-1
+
+
+def main():
+    out_dir = sys.argv[1]
+    kill_marker = os.path.join(out_dir, "kill_marker")
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    # ONE shared checkpoint root: the Checkpointer is multi-controller —
+    # each rank writes only its addressable shards + a meta fragment,
+    # rank 0 merges and atomically commits, so a pod that dies mid-save
+    # leaves only an invisible .tmp (the resume-to-uninterrupted
+    # guarantee rides that atomicity)
+    ckpt = Checkpointer(os.path.join(out_dir, "ckpt"), model=m,
+                        optimizer=opt, keep=3)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16,)).astype(np.float32))
+
+    latest = ckpt.load_latest()
+    start = 0 if latest is None else latest + 1
+    losses = []
+    for step in range(start, STEPS):
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        ckpt.save(step)
+        xproc.barrier()  # lockstep: both ranks completed `step`
+        if rank == 1 and step == KILL_AT - 1 and os.path.exists(kill_marker):
+            os.unlink(kill_marker)  # next attempt runs clean
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # final losses: only the steps THIS attempt ran; the test asserts the
+    # last value matches the uninterrupted run's last value
+    with open(os.path.join(out_dir, f"elastic_out_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "start": start, "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,17 @@ class TestCollectives:
         expect[[0, 4, 6]] = 6.0
         np.testing.assert_allclose(out, expect)
 
+        def fimax(x):  # integer max: identity must be iinfo.min, not -inf
+            return dist.all_reduce(paddle.Tensor(x), op=dist.ReduceOp.MAX,
+                                   group=g)._value
+
+        out = np.asarray(dist.spmd(fimax, in_specs=P("dp"),
+                                   out_specs=P("dp"), group_axes=("dp",))(
+            jnp.arange(8, dtype=jnp.int32)))
+        expect_i = np.arange(8)
+        expect_i[[0, 4, 6]] = 6
+        np.testing.assert_array_equal(out, expect_i)
+
         def favg(x):
             return dist.all_reduce(paddle.Tensor(x), op=dist.ReduceOp.AVG,
                                    group=g)._value

@@ -1,0 +1,116 @@
+"""Elastic recovery (reference: elastic/manager.py:127 etcd membership +
+restart; launch/controllers heartbeat watch): worker death mid-training →
+pod restart → auto-resume from the latest complete checkpoint; repeated
+failure → scale-in with contiguous rank remap."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess pods
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _launch(tmp_path, script, nproc, extra=(), timeout=420):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           f"--nproc_per_node={nproc}", f"--log_dir={tmp_path}/log",
+           *extra, os.path.join(ROOT, "tests", script), str(tmp_path)]
+    return subprocess.run(cmd, env=_env(), cwd=ROOT, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_sigkill_worker_resumes_to_uninterrupted_loss(tmp_path):
+    """The VERDICT done-criterion: SIGKILL 1 of 2 workers mid-training;
+    the relaunched pod must resume from the latest complete checkpoint
+    and end at the uninterrupted run's loss."""
+    # interrupted run: marker armed -> rank 1 dies after step 3
+    (tmp_path / "kill_marker").write_text("armed")
+    r = _launch(tmp_path, "elastic_worker.py", 2,
+                extra=("--max_restart=2",))
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "restart 1/2" in r.stderr  # the pod actually died and re-formed
+    out = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"elastic_out_{rank}.json") as f:
+            out[rank] = json.load(f)
+    # the resumed attempt started from the checkpointed step, not 0
+    assert out[0]["start"] > 0 and out[1]["start"] > 0
+
+    # uninterrupted reference run in a fresh dir (no marker)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    r2 = _launch(ref_dir, "elastic_worker.py", 2)
+    assert r2.returncode == 0, f"stdout:{r2.stdout}\nstderr:{r2.stderr}"
+    with open(ref_dir / "elastic_out_0.json") as f:
+        ref = json.load(f)
+    assert ref["start"] == 0
+    np.testing.assert_allclose(out[0]["losses"][-1], ref["losses"][-1],
+                               rtol=1e-6)
+    # resumed tail must equal the uninterrupted tail step-for-step
+    tail = ref["losses"][out[0]["start"]:]
+    np.testing.assert_allclose(out[0]["losses"], tail, rtol=1e-6)
+
+
+def test_elastic_scale_in_remaps_ranks(tmp_path):
+    """A persistently-broken slot: with --elastic_level=1 the launcher
+    re-forms the pod over the survivors (nproc-1, contiguous ranks)
+    instead of burning every restart at the dead size."""
+    bad = tmp_path / "worker.py"
+    bad.write_text(
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "if world == '2' and rank == '1':\n"
+        "    sys.exit(7)  # slot 1 is broken at pod size 2\n"
+        "json.dump({'rank': rank, 'world': world},\n"
+        "          open(os.path.join(out, f'out_{rank}.json'), 'w'))\n")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=2", "--max_restart=3", "--elastic_level=1",
+           f"--log_dir={tmp_path}/log", str(bad), str(tmp_path)]
+    r = subprocess.run(cmd, env=_env(), cwd=ROOT, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "elastic scale-in" in r.stderr
+    with open(tmp_path / "out_0.json") as f:
+        res = json.load(f)
+    assert res["world"] == "1"  # re-formed pod: 1 survivor, rank 0
+
+
+def test_heartbeat_detects_hung_worker(tmp_path):
+    """A worker wedged in an infinite loop (process alive, no beats)
+    must fail the pod via heartbeat staleness, not hang the launcher."""
+    hung = tmp_path / "worker.py"
+    hung.write_text(
+        "import os, signal, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "time.sleep(2)  # let a first beat land\n"
+        "if rank == '1':\n"
+        "    # a wedged worker: alive but frozen (beat thread included)\n"
+        "    os.kill(os.getpid(), signal.SIGSTOP)\n"
+        "time.sleep(120)\n" % ROOT)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=2", "--elastic_timeout=4",
+           f"--log_dir={tmp_path}/log", str(hung), str(tmp_path)]
+    t0 = time.time()
+    r = subprocess.run(cmd, env=_env(), cwd=ROOT, capture_output=True,
+                       text=True, timeout=240)
+    assert r.returncode != 0
+    assert "heartbeat stale" in r.stderr
+    assert time.time() - t0 < 200  # detected, not timed out

@@ -43,6 +43,45 @@ def test_profiler_trace_and_timer(tmp_path):
     assert stats["steps"] == 4 and stats["ips"] > 0
 
 
+def test_summary_statistics_tables(tmp_path, capsys):
+    """reference profiler_statistic.py: summary() renders per-op
+    time/count tables parsed from the captured trace."""
+    prof = profiler.Profiler(
+        on_trace_ready=profiler.export_chrome_tracing(str(tmp_path / "t")))
+    prof.start()
+    _train_some(3, prof)
+    prof.stop()
+    data = prof.summary()
+    out = capsys.readouterr().out
+    assert data is not None, "no statistics parsed from the trace"
+    assert "Overview Summary" in out and "Op Summary" in out
+    # per-op rows: some op executed more than once with positive time
+    rows = []
+    for cat in data.op_table:
+        rows.extend(data.rows(category=cat))
+    assert rows
+    assert any(r["calls"] >= 1 and r["total_us"] > 0 for r in rows)
+    # sort orders work
+    by_calls = data.rows(category=list(data.op_table)[0],
+                         sorted_by="calls")
+    assert by_calls == sorted(by_calls, key=lambda r: -r["calls"])
+
+
+def test_benchmark_meter_hooks_train_step():
+    """reference profiler/timer.py benchmark(): an armed global meter is
+    fed by TrainStep automatically and reports ips."""
+    bm = profiler.benchmark()
+    bm.enable()
+    try:
+        _train_some(4)
+        s = bm.stats()
+        assert s["steps"] >= 3  # first tick arms the interval
+        assert bm.samples == 16
+        assert "ips" in bm.summary()
+    finally:
+        bm.disable()
+
+
 def test_profiler_timer_only():
     prof = profiler.Profiler(timer_only=True)
     with prof:
