@@ -203,7 +203,45 @@ class InMemoryDataset(_SlotDataset):
     def local_shuffle(self):
         np.random.default_rng().shuffle(self._samples)
 
+    _shuffle_calls = 0
+
     def global_shuffle(self, fleet=None, thread_num=12):
+        """Cross-trainer shuffle (reference: data_set.cc distributed
+        shuffle — samples are re-partitioned across all trainers by
+        random owner, then shuffled locally). Buckets travel POINT TO
+        POINT over the coordination-service KV store (each pair
+        exchanges only its bucket — O(N) total, not an O(N·world)
+        padded all-gather). The owner draw mixes in a per-call counter
+        so each epoch re-draws the partition. Single-process: local."""
+        import pickle
+
+        from . import xproc
+
+        self._shuffle_calls += 1
+        if not xproc.is_multiprocess():
+            self.local_shuffle()
+            return
+        import jax
+
+        world = jax.process_count()
+        me = jax.process_index()
+        rng = np.random.default_rng([me, self._shuffle_calls])
+        owners = rng.integers(0, world, len(self._samples))
+        outgoing = [[] for _ in range(world)]
+        for s, o in zip(self._samples, owners):
+            outgoing[int(o)].append(s)
+        mine = list(outgoing[me])
+        tag = 7000 + (self._shuffle_calls % 1000)
+        for peer in range(world):
+            if peer != me:
+                xproc.send_bytes(pickle.dumps(
+                    outgoing[peer], protocol=pickle.HIGHEST_PROTOCOL),
+                    dst=peer, tag=tag)
+        for peer in range(world):
+            if peer != me:
+                mine.extend(pickle.loads(
+                    xproc.recv_bytes(src=peer, tag=tag)))
+        self._samples = mine
         self.local_shuffle()
 
     def release_memory(self):

@@ -11,6 +11,7 @@ from .. import env as env_mod
 from .. import mesh as mesh_mod
 from ..parallel_step import DistributedTrainStep, shard_params_and_opt
 from . import elastic  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 from . import topology as topo_mod
 from .topology import CommunicateTopology, HybridCommunicateGroup
 

@@ -1,0 +1,156 @@
+"""Fleet strategy meta-optimizers: LARS, LocalSGD, DGC.
+
+TPU-native re-designs of the reference's static-graph meta-optimizer
+passes (reference: fleet/meta_optimizers/lars_optimizer.py,
+localsgd_optimizer.py (+AdaptiveLocalSGD), dgc_optimizer.py; C++ DGC
+momentum op operators/optimizers/dgc_momentum_op and the sparse
+all-reduce handle details/sparse_all_reduce_op_handle.cc).
+
+The reference rewrites the static program; here each strategy is a small
+runtime object over the same two primitives everything else uses —
+per-parameter pure updates (optimizer protocol) and eager
+multi-controller collectives (`xproc`, which on CPU hosts is gloo and on
+pods rides the same compiled-collective machinery as the in-graph path):
+
+* `lars(...)` — returns the core `optimizer.LarsMomentum` (the trust-
+  ratio math lives in the optimizer protocol, so it composes with
+  TrainStep / DistributedTrainStep like any optimizer).
+* `LocalSGD` — workers step LOCALLY (no per-step gradient sync);
+  every `k_steps` calls the parameters are averaged across trainer
+  processes. Cuts DP sync frequency k× at the cost of staleness —
+  exactly the reference LocalSGDOptimizer contract.
+* `DGCMomentum` — deep gradient compression: error-feedback top-k
+  sparsified gradient exchange with momentum correction; only
+  (index, value) pairs travel, cutting DP gradient traffic to
+  sparsity·world of dense.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Momentum, _acc_zeros
+from .. import xproc
+
+__all__ = ["lars", "LocalSGD", "DGCMomentum"]
+
+
+def lars(learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+         lars_weight_decay=0.0005, parameters=None, **kw):
+    """Strategy entry (reference LarsOptimizer meta pass): the LARS
+    update itself is `paddle_tpu.optimizer.LarsMomentum`."""
+    from ...optimizer import LarsMomentum
+
+    return LarsMomentum(learning_rate, momentum, lars_coeff,
+                        lars_weight_decay, parameters=parameters, **kw)
+
+
+class LocalSGD:
+    """Periodic parameter averaging across trainer processes
+    (reference: fleet/meta_optimizers/localsgd_optimizer.py — workers
+    run k local steps, then c_allreduce the parameters).
+
+    Usage:
+        sync = LocalSGD(model, k_steps=4)
+        for batch in loader:
+            train_step(batch)          # any local step (TrainStep etc.)
+            sync.step()                # averages params every k-th call
+
+    Single-process jobs: step() is a no-op (serial == local). The
+    `adaptive` mode grows k when the post-sync parameter drift is small
+    (reference AdaptiveLocalSGDOptimizer's step-resolution controller).
+    """
+
+    def __init__(self, model, k_steps=1, adaptive=False, min_k=1,
+                 max_k=16, drift_threshold=1e-3):
+        self.model = model
+        self.k_steps = max(1, int(k_steps))
+        self.adaptive = adaptive
+        self.min_k, self.max_k = min_k, max_k
+        self.drift_threshold = drift_threshold
+        self._calls = 0
+        self.syncs = 0
+
+    def step(self):
+        self._calls += 1
+        if self._calls % self.k_steps:
+            return False
+        if not xproc.is_multiprocess():
+            return False
+        drift = 0.0
+        for _, p in self.model.named_parameters():
+            local = np.asarray(p._value)
+            avg = xproc.all_reduce_np(local, op="avg")
+            if self.adaptive:
+                d = float(np.max(np.abs(avg - local)))
+                drift = max(drift, d)
+            p._value = jnp.asarray(avg)
+        self.syncs += 1
+        if self.adaptive:
+            # every rank must adapt from the SAME drift or their sync
+            # schedules desynchronize and collectives cross-pair
+            drift = float(xproc.all_reduce_np(
+                np.array([drift], np.float32), op="max")[0])
+            # small drift → sync less often; large drift → more often
+            if drift < self.drift_threshold and self.k_steps < self.max_k:
+                self.k_steps *= 2
+            elif drift > 10 * self.drift_threshold and \
+                    self.k_steps > self.min_k:
+                self.k_steps = max(self.min_k, self.k_steps // 2)
+        return True
+
+
+class DGCMomentum(Momentum):
+    """Deep-gradient-compression momentum (reference:
+    fleet/meta_optimizers/dgc_optimizer.py, dgc_momentum_op,
+    sparse_all_reduce_op_handle.cc; Lin et al., DGC).
+
+    Per parameter: velocity-accumulate the raw gradient (momentum
+    correction u ← m·u + g, error accumulator v ← v + u), take the
+    top-`rampup`-fraction entries of |v| as this step's sparse update,
+    zero them in v (error feedback keeps the rest for later), and — in
+    multi-process jobs — exchange only the (index, value) pairs,
+    scatter-summing every worker's selection into the dense update.
+    The momentum is thereby applied BEFORE compression, exactly the DGC
+    momentum-correction ordering. With sparsity=1.0 this degrades to
+    plain distributed momentum."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, sparsity=0.01,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         grad_clip=grad_clip)
+        self.sparsity = float(sparsity)
+
+    def _init_state(self, p):
+        return {"u": _acc_zeros(p), "v": _acc_zeros(p)}
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        if wd:
+            gv = gv + wd * pv
+        u = self._momentum * state["u"] + gv
+        v = state["v"] + u
+        flat = v.reshape(-1)
+        k = max(1, int(np.ceil(self.sparsity * flat.shape[0])))
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        vals = flat[idx]
+        if xproc.is_multiprocess():
+            # sparse exchange: k (idx, val) pairs per worker, summed.
+            # indices travel TYPED (int32) — a float transport silently
+            # corrupts offsets past 2^24 under float32 canonicalization
+            if flat.shape[0] >= 2 ** 31:
+                raise NotImplementedError(
+                    "DGC index transport is int32; parameter has "
+                    f"{flat.shape[0]} elements")
+            g_idx = xproc.all_gather_np(np.asarray(idx, np.int32))
+            g_val = xproc.all_gather_np(np.asarray(vals, np.float32))
+            dense = np.zeros(flat.shape[0], np.float64)
+            world = g_idx.shape[0]
+            for r in range(world):
+                np.add.at(dense, g_idx[r].astype(np.int64),
+                          g_val[r].astype(np.float64))
+            update = jnp.asarray(dense / world, flat.dtype)
+        else:
+            update = jnp.zeros_like(flat).at[idx].set(vals)
+        new_flat = flat.at[idx].set(0.0)  # error feedback: keep the rest
+        new_p = pv - lr * update.reshape(pv.shape)
+        return new_p, {"u": u, "v": new_flat.reshape(v.shape)}

@@ -247,14 +247,9 @@ class ShardedSparseTable:
         return len(self.local)
 
     def _gather_obj(self, obj):
-        import pickle
-
         from . import xproc
 
-        blobs = xproc.all_gather_bytes(
-            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-            max_len=1 << 27)
-        return [pickle.loads(b) for b in blobs]
+        return xproc.all_gather_obj(obj, max_len=1 << 27)
 
     def pull(self, ids):
         """Route each id to its owner shard, gather the rows back.
@@ -329,27 +324,90 @@ class ShardedSparseTable:
 class SparseEmbedding:
     """PS-backed embedding lookup (reference static.nn.sparse_embedding /
     _pull_sparse ops). Pull unique rows → dense device lookup
-    (differentiable) → push row grads on backward via hook."""
+    (differentiable) → push row grads on backward via hook.
+
+    Overlap: `prefetch(next_ids)` starts the host-KV pull for the NEXT
+    batch on a background thread while the chip computes the current
+    step (the reference's AsyncCommunicator pull pipeline,
+    communicator.h:427); the matching `__call__` consumes the prefetched
+    rows without blocking on the table."""
 
     def __init__(self, embedding_dim, table=None, rule=None, name=None,
                  backend="auto"):
+        import threading
+
         self.table = table if table is not None else make_sparse_table(
             embedding_dim, rule=rule, backend=backend)
         self.dim = embedding_dim
+        self._pool = None
+        self._pending = None  # (key, uniq, inv, shape, future)
+        # serializes background pulls against backward-hook pushes: the
+        # table's row map/arrays are not safe under concurrent mutation
+        self._table_lock = threading.Lock()
+
+    def _decompose(self, ids):
+        ids_np = np.asarray(
+            ids._value if isinstance(ids, Tensor) else ids).astype(np.int64)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        return ids_np, uniq, inv
+
+    @staticmethod
+    def _key(ids_np):
+        return (ids_np.shape, ids_np.tobytes())
+
+    def prefetch(self, ids):
+        """Start pulling `ids`'s rows in the background. The pull holds
+        the table lock, so it serializes against the backward-hook push
+        (bounded staleness: a prefetch reads the table state when the
+        lock is acquired, as in the reference async PS). Collective
+        tables (multi-host ShardedSparseTable) pull in the FOREGROUND —
+        collectives issued from a side thread would interleave with the
+        main thread's flush collectives and deadlock ranks."""
+        import concurrent.futures
+
+        ids_np, uniq, inv = self._decompose(ids)
+
+        def locked_pull():
+            with self._table_lock:
+                return self.table.pull(uniq)
+
+        if getattr(self.table, "world", 1) > 1:
+            fut = concurrent.futures.Future()
+            fut.set_result(locked_pull())
+        else:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ps-prefetch")
+            fut = self._pool.submit(locked_pull)
+        self._pending = (self._key(ids_np), uniq, inv, ids_np.shape, fut)
+        return fut
 
     def __call__(self, ids):
         from ..ops._helpers import apply_jfn
 
-        ids_np = np.asarray(
-            ids._value if isinstance(ids, Tensor) else ids).astype(np.int64)
-        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
-        rows = Tensor(jnp.asarray(self.table.pull(uniq)),
-                      stop_gradient=False)
+        ids_np, uniq, inv = None, None, None
+        rows_np = None
+        if self._pending is not None:
+            key, p_uniq, p_inv, p_shape, fut = self._pending
+            probe = np.asarray(
+                ids._value if isinstance(ids, Tensor) else ids).astype(
+                np.int64)
+            if self._key(probe) == key:
+                ids_np, uniq, inv = probe, p_uniq, p_inv
+                rows_np = fut.result()
+                self._pending = None
+        if rows_np is None:
+            ids_np, uniq, inv = self._decompose(ids)
+            with self._table_lock:
+                rows_np = self.table.pull(uniq)
+        rows = Tensor(jnp.asarray(rows_np), stop_gradient=False)
         table = self.table
+        lock = self._table_lock
 
         def _push(g):
-            table.push(uniq, np.asarray(g._value if isinstance(g, Tensor)
-                                        else g))
+            with lock:
+                table.push(uniq, np.asarray(
+                    g._value if isinstance(g, Tensor) else g))
             return g
 
         rows.register_hook(_push)

@@ -19,7 +19,8 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["is_multiprocess", "all_reduce_np", "all_gather_np",
-           "broadcast_np", "barrier", "all_gather_bytes"]
+           "broadcast_np", "barrier", "all_gather_bytes",
+           "all_gather_obj"]
 
 _REDUCERS = {
     "sum": lambda x, ax: lax.psum(x, ax),
@@ -96,6 +97,18 @@ def broadcast_np(nparr, src=0):
 def barrier():
     """Completion of a psum across all processes is a barrier."""
     all_reduce_np(np.zeros((1,), np.float32))
+
+
+def all_gather_obj(obj, max_len=1 << 27):
+    """Gather one picklable object per process (pickle + padded byte
+    gather) — the shared idiom under ShardedSparseTable routing,
+    global_shuffle, and friends."""
+    import pickle
+
+    blobs = all_gather_bytes(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+        max_len=max_len)
+    return [pickle.loads(b) for b in blobs]
 
 
 def all_gather_bytes(payload: bytes, max_len=1 << 20):

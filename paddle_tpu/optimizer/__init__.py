@@ -8,6 +8,7 @@ from .optimizer import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    LarsMomentum,
     Momentum,
     Optimizer,
     RMSProp,

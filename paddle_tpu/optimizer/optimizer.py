@@ -224,14 +224,18 @@ class Optimizer:
             )
             metas = [(None, 1.0, wd_global)] * len(flat_p)
         new_p, new_s = [], []
-        for pv, gv, sv, (glr, lr_scale, wd) in zip(flat_p, flat_g, flat_s,
-                                                   metas):
+        pobjs = param_objs if param_objs is not None else [None] * len(flat_p)
+        for pv, gv, sv, (glr, lr_scale, wd), pobj in zip(
+                flat_p, flat_g, flat_s, metas, pobjs):
             plr = (lr if glr is None else glr) * lr_scale
             if wd and not self._decoupled_wd():
                 gv = gv + wd * pv
             gv = _upcast_grad(pv, gv)
+            # pass the Parameter for python-level metadata checks (name
+            # exclusions in Lamb/LarsMomentum) — jit-safe, never traced
             np_, ns_ = self._update(pv, gv, sv, plr,
-                                    wd=wd if self._decoupled_wd() else 0.0)
+                                    wd=wd if self._decoupled_wd() else 0.0,
+                                    param=pobj)
             new_p.append(np_.astype(pv.dtype))
             new_s.append(ns_)
         return jax.tree_util.tree_unflatten(treedef, new_p), new_s
@@ -490,3 +494,49 @@ class Lamb(Optimizer):
         return pv - lr * ratio * upd, {
             "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p
         }
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive rate scaling over momentum (reference:
+    fluid LarsMomentumOptimizer / fleet/meta_optimizers/lars_optimizer.py;
+    operators/optimizers/lars_momentum_op). Per-layer trust ratio
+    local_lr = lr·coeff·‖p‖ / (‖g‖ + wd·‖p‖ + eps) keeps huge-batch
+    ResNet training stable.
+
+    Weight decay: `lars_weight_decay` is the op's own decay term; a
+    per-parameter regularizer additionally folds into the gradient
+    BEFORE the op (matching fluid's append_regularization_ops running
+    ahead of lars_momentum_op) — configure one or the other, not both.
+    `exclude_from_weight_decay` name-tags work in both eager and jit
+    paths (the Parameter is threaded through apply_gradients_tree)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state(self, p):
+        return {"velocity": _acc_zeros(p)}
+
+    def _update(self, pv, gv, state, lr, wd=0.0, param=None):
+        lars_wd = self._lars_wd
+        if param is not None and any(
+                tag in (param.name or "") for tag in self._exclude):
+            lars_wd = 0.0
+        p32 = pv.astype(jnp.float32)
+        g32 = gv.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._coeff * p_norm
+            / (g_norm + lars_wd * p_norm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] + local_lr * (
+            g32 + lars_wd * p32)
+        return (p32 - v).astype(pv.dtype), {"velocity": v}

@@ -1,0 +1,180 @@
+"""Strategy meta-optimizers: LARS, DGC, LocalSGD (reference:
+fleet/meta_optimizers/{lars,dgc,localsgd}_optimizer.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentum, LocalSGD, lars)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy(seed=0):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((32,)).astype(np.float32))
+    return m, x, y
+
+
+def _train(m, opt, x, y, steps=15):
+    losses = []
+    for _ in range(steps):
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return losses
+
+
+class TestLars:
+    def test_trains_and_trust_ratio_scales(self):
+        m, x, y = _toy()
+        opt = lars(0.5, momentum=0.9, parameters=m.parameters())
+        losses = _train(m, opt, x, y)
+        assert losses[-1] < losses[0]
+
+    def test_under_trainstep_jit(self):
+        m, x, y = _toy()
+        opt = paddle.optimizer.LarsMomentum(
+            0.5, parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda mm, a, b: nn.functional.mse_loss(
+                mm(a).squeeze(-1), b), opt)
+        l0 = float(step(x, y).numpy())
+        for _ in range(10):
+            l = float(step(x, y).numpy())
+        assert l < l0
+
+
+class TestDGC:
+    def test_full_sparsity_equals_momentum(self):
+        # sparsity=1.0 selects everything each step: DGC's momentum
+        # correction then reduces exactly to plain Momentum
+        m1, x, y = _toy(seed=1)
+        m2, _, _ = _toy(seed=1)
+        o1 = DGCMomentum(0.05, momentum=0.9, sparsity=1.0,
+                         parameters=m1.parameters())
+        o2 = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                       parameters=m2.parameters())
+        l1 = _train(m1, o1, x, y, steps=8)
+        l2 = _train(m2, o2, x, y, steps=8)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_sparse_error_feedback_converges(self):
+        m, x, y = _toy(seed=2)
+        opt = DGCMomentum(0.05, momentum=0.9, sparsity=0.05,
+                          parameters=m.parameters())
+        losses = _train(m, opt, x, y, steps=40)
+        assert losses[-1] < losses[0] * 0.7
+        # unsent mass is retained, not dropped: accumulators are nonzero
+        v_mass = sum(float(np.abs(np.asarray(st["v"])).sum())
+                     for st in opt._states.values())
+        assert v_mass > 0
+
+
+class TestLocalSGD:
+    def test_single_process_noop(self):
+        m, x, y = _toy()
+        sync = LocalSGD(m, k_steps=2)
+        assert sync.step() is False
+        assert sync.step() is False  # k-th call, but world==1
+        assert sync.syncs == 0
+
+    @pytest.mark.slow
+    def test_two_process_periodic_averaging(self, tmp_path):
+        worker = tmp_path / "w.py"
+        worker.write_text(
+            "import json, os, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "import paddle_tpu.distributed as dist\n"
+            "from paddle_tpu import nn\n"
+            "from paddle_tpu.distributed.fleet.meta_optimizers import "
+            "LocalSGD\n"
+            "dist.init_parallel_env()\n"
+            "rank = dist.get_rank()\n"
+            "paddle.seed(0)\n"
+            "m = nn.Linear(4, 1)\n"
+            "opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())\n"
+            "sync = LocalSGD(m, k_steps=3)\n"
+            "rng = np.random.default_rng(rank)  # DIFFERENT data per rank\n"
+            "x = paddle.to_tensor(rng.standard_normal((8, 4))"
+            ".astype(np.float32))\n"
+            "y = paddle.to_tensor(rng.standard_normal((8,))"
+            ".astype(np.float32))\n"
+            "for s in range(6):\n"
+            "    loss = nn.functional.mse_loss(m(x).squeeze(-1), y)\n"
+            "    loss.backward(); opt.step(); opt.clear_grad()\n"
+            "    sync.step()\n"
+            "out = {'rank': rank, 'syncs': sync.syncs,\n"
+            "       'w': m.weight.numpy().tolist()}\n"
+            "json.dump(out, open(os.path.join(sys.argv[1],\n"
+            "          f'ls_{rank}.json'), 'w'))\n" % ROOT)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ""
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+             str(worker), str(tmp_path)],
+            env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+        import json
+
+        w0 = json.load(open(tmp_path / "ls_0.json"))
+        w1 = json.load(open(tmp_path / "ls_1.json"))
+        assert w0["syncs"] == w1["syncs"] == 2  # steps 3 and 6
+        # last step (6) was a sync step: params ended averaged == equal
+        np.testing.assert_allclose(w0["w"], w1["w"], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_global_shuffle_repartitions(tmp_path):
+    """data_set.cc distributed shuffle: 2 trainers exchange samples —
+    the union is preserved, the partition re-drawn."""
+    data = tmp_path / "d.txt"
+    data.write_text("".join(f"s{i}\n" for i in range(40)))
+    worker = tmp_path / "w.py"
+    worker.write_text(
+        "import json, os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "rank = dist.get_rank()\n"
+        "ds = dist.InMemoryDataset()\n"
+        "ds.init(batch_size=4)\n"
+        "ds.set_filelist([sys.argv[2]])\n"
+        "ds.load_into_memory()\n"
+        "half = ds._samples[rank::2]  # disjoint per-rank halves\n"
+        "ds._samples = half\n"
+        "ds.global_shuffle()\n"
+        "json.dump(sorted(s[0] for s in ds._samples),\n"
+        "          open(os.path.join(sys.argv[1],\n"
+        "               f'gs_{rank}.json'), 'w'))\n" % ROOT)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+         str(worker), str(tmp_path), str(data)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    import json
+
+    a = json.load(open(tmp_path / "gs_0.json"))
+    b = json.load(open(tmp_path / "gs_1.json"))
+    assert sorted(a + b) == sorted(f"s{i}" for i in range(40))
+    assert not (set(a) & set(b))  # disjoint partition

@@ -297,3 +297,28 @@ def test_table_checkpoint_roundtrip(tmp_path):
     t2.set_state_dict(back["table"])
     np.testing.assert_allclose(t2.pull(np.array([3, 99, 7])),
                                t.pull(np.array([3, 99, 7])))
+
+
+def test_sparse_embedding_prefetch_overlap():
+    """AsyncCommunicator-style pull overlap: a prefetched batch must give
+    identical results to a synchronous pull, and a non-matching prefetch
+    must be ignored safely."""
+    dim = 4
+    t = MemorySparseTable(dim, rule=SparseSGDRule(0.1))
+    semb = SparseEmbedding(dim, table=t)
+    ids = paddle.to_tensor(np.array([[3, 7], [7, 9]]))
+    sync_out = semb(ids).numpy()
+
+    semb.prefetch(ids)
+    pre_out = semb(ids).numpy()
+    np.testing.assert_array_equal(sync_out, pre_out)
+    assert semb._pending is None  # consumed
+
+    # stale prefetch for a different batch is ignored, not misused
+    other = paddle.to_tensor(np.array([[1, 2], [2, 5]]))
+    semb.prefetch(ids)
+    out_other = semb(other).numpy()
+    ref = t.pull(np.array([1, 2, 5]))
+    np.testing.assert_array_equal(out_other[0, 0], ref[0])
+    # prefetch still pending for `ids`; consuming it now works
+    np.testing.assert_array_equal(semb(ids).numpy(), sync_out)
