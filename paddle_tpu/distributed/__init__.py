@@ -46,7 +46,11 @@ from .collective import (  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
 from .mesh import init_mesh, global_mesh  # noqa: F401
 from .parallel_step import DistributedTrainStep  # noqa: F401
-from .sequence_parallel import ring_attention, ulysses_attention  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention,
+    ring_flash_attention,
+    ulysses_attention,
+)
 from .auto_parallel import shard_op, shard_tensor  # noqa: F401
 from .api_extra import (  # noqa: F401
     BoxPSDataset,

@@ -48,6 +48,17 @@ class DistributedStrategy:
                                  "micro_batch_size": 1}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
+        # strategy meta-optimizers (reference meta_optimizers/*)
+        self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "exclude_from_weight_decay": []}
+        self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.01]}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1}
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid={self.hybrid_configs})"
@@ -102,9 +113,58 @@ class _Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """(reference fleet.py:996.) Sharding level from strategy sets the
-        ZeRO placement applied by DistributedTrainStep."""
-        optimizer._fleet_strategy = strategy or self._strategy
+        """(reference fleet.py:996 — runs the meta-optimizer stack.)
+        Sharding level from strategy sets the ZeRO placement applied by
+        DistributedTrainStep; lars/dgc strategy toggles REPLACE the inner
+        optimizer with the corresponding strategy optimizer (the
+        reference's LarsOptimizer/DGCOptimizer meta passes), reusing its
+        lr and parameter list."""
+        st = strategy or self._strategy
+        if st is not None and getattr(st, "lars", False):
+            from ...optimizer import LarsMomentum
+
+            cfg = st.lars_configs
+            optimizer = LarsMomentum(
+                optimizer._learning_rate,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", []),
+                grad_clip=optimizer._grad_clip,
+                parameters=optimizer._parameter_list)
+        elif st is not None and getattr(st, "dgc", False):
+            from .meta_optimizers import DGCMomentum
+
+            sp = st.dgc_configs.get("sparsity", [0.999])
+            optimizer = DGCMomentum(
+                optimizer._learning_rate,
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                sparsity=sp[0] if isinstance(sp, (list, tuple)) else sp,
+                grad_clip=optimizer._grad_clip,
+                parameters=optimizer._parameter_list)
+        if st is not None and (getattr(st, "localsgd", False)
+                               or getattr(st, "adaptive_localsgd", False)):
+            from .meta_optimizers import LocalSGD
+
+            adaptive = getattr(st, "adaptive_localsgd", False)
+            cfg = (st.adaptive_localsgd_configs if adaptive
+                   else st.localsgd_configs)
+            sync = LocalSGD(
+                optimizer._parameter_list,
+                k_steps=cfg.get("init_k_steps" if adaptive else "k_steps",
+                                1),
+                adaptive=adaptive)
+            optimizer._localsgd = sync
+            inner_step = optimizer.step
+
+            def step_with_sync():
+                out = inner_step()
+                sync.step()
+                return out
+
+            optimizer.step = step_with_sync
+        optimizer._fleet_strategy = st
         return optimizer
 
     @property

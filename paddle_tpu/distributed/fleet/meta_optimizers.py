@@ -63,7 +63,7 @@ class LocalSGD:
 
     def __init__(self, model, k_steps=1, adaptive=False, min_k=1,
                  max_k=16, drift_threshold=1e-3):
-        self.model = model
+        self.model = model  # an nn.Layer OR a plain parameter list
         self.k_steps = max(1, int(k_steps))
         self.adaptive = adaptive
         self.min_k, self.max_k = min_k, max_k
@@ -77,8 +77,10 @@ class LocalSGD:
             return False
         if not xproc.is_multiprocess():
             return False
+        params = (self.model if isinstance(self.model, (list, tuple))
+                  else [p for _, p in self.model.named_parameters()])
         drift = 0.0
-        for _, p in self.model.named_parameters():
+        for p in params:
             local = np.asarray(p._value)
             avg = xproc.all_reduce_np(local, op="avg")
             if self.adaptive:
@@ -93,7 +95,7 @@ class LocalSGD:
                 np.array([drift], np.float32), op="max")[0])
             # small drift → sync less often; large drift → more often
             if drift < self.drift_threshold and self.k_steps < self.max_k:
-                self.k_steps *= 2
+                self.k_steps = min(self.max_k, self.k_steps * 2)
             elif drift > 10 * self.drift_threshold and \
                     self.k_steps > self.min_k:
                 self.k_steps = max(self.min_k, self.k_steps // 2)
@@ -107,18 +109,26 @@ class DGCMomentum(Momentum):
 
     Per parameter: velocity-accumulate the raw gradient (momentum
     correction u ← m·u + g, error accumulator v ← v + u), take the
-    top-`rampup`-fraction entries of |v| as this step's sparse update,
-    zero them in v (error feedback keeps the rest for later), and — in
-    multi-process jobs — exchange only the (index, value) pairs,
-    scatter-summing every worker's selection into the dense update.
-    The momentum is thereby applied BEFORE compression, exactly the DGC
-    momentum-correction ordering. With sparsity=1.0 this degrades to
-    plain distributed momentum."""
+    top-(1−sparsity) entries of |v| as this step's sparse update, zero
+    them in BOTH v (error feedback keeps the rest for later) and u
+    (the paper's momentum-factor masking — stale momentum must not
+    re-enter future accumulations), and — in multi-process jobs —
+    exchange only the (index, value) pairs, scatter-summing every
+    worker's selection into the dense update.
 
-    def __init__(self, learning_rate=0.001, momentum=0.9, sparsity=0.01,
+    `sparsity` follows the REFERENCE convention (dgc_configs sparsity =
+    fraction of entries DROPPED; the reference default 0.999 keeps
+    0.1%). With sparsity=0.0 every entry is sent each step and — with
+    u fully masked each step — the update degenerates to plain SGD, the
+    paper's dense limit."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, sparsity=0.999,
                  parameters=None, grad_clip=None, name=None):
         super().__init__(learning_rate, momentum, parameters,
                          grad_clip=grad_clip)
+        if not 0.0 <= float(sparsity) < 1.0:
+            raise ValueError(f"sparsity (fraction dropped) must be in "
+                             f"[0, 1), got {sparsity}")
         self.sparsity = float(sparsity)
 
     def _init_state(self, p):
@@ -130,7 +140,7 @@ class DGCMomentum(Momentum):
         u = self._momentum * state["u"] + gv
         v = state["v"] + u
         flat = v.reshape(-1)
-        k = max(1, int(np.ceil(self.sparsity * flat.shape[0])))
+        k = max(1, int(np.ceil((1.0 - self.sparsity) * flat.shape[0])))
         _, idx = jax.lax.top_k(jnp.abs(flat), k)
         vals = flat[idx]
         if xproc.is_multiprocess():
@@ -152,5 +162,9 @@ class DGCMomentum(Momentum):
         else:
             update = jnp.zeros_like(flat).at[idx].set(vals)
         new_flat = flat.at[idx].set(0.0)  # error feedback: keep the rest
+        # momentum factor masking (Lin et al. §3.2): selected coords drop
+        # their momentum history too
+        u_flat = u.reshape(-1).at[idx].set(0.0)
         new_p = pv - lr * update.reshape(pv.shape)
-        return new_p, {"u": u, "v": new_flat.reshape(v.shape)}
+        return new_p, {"u": u_flat.reshape(u.shape),
+                       "v": new_flat.reshape(v.shape)}

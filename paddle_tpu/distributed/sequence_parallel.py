@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "ulysses_attention", "RingAttention"]
+__all__ = ["ring_attention", "ring_flash_attention", "ulysses_attention",
+           "RingAttention"]
 
 
 def _online_merge(acc, m, l, scores, v_blk):
@@ -82,6 +83,83 @@ def ring_attention(q, k, v, causal=False, axis_name="sp"):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, causal=False, axis_name="sp",
+                         block_q=None, block_k=None, interpret=False):
+    """Ring attention with the Pallas flash kernel per K/V block.
+
+    Unlike `ring_attention` (dense per-block scores in HBM, all blocks
+    computed then masked), each ring step runs the flash kernel on the
+    resident K/V shard — scores never touch HBM — and returns
+    (out, lse); blocks are merged by streaming-softmax over lse. Under
+    causal masking, blocks strictly above the diagonal are SKIPPED via
+    lax.cond (the dense version burned ~half the FLOPs computing them):
+    src == idx runs the kernel causal, src < idx runs it full, src > idx
+    contributes nothing. Differentiable end-to-end: the kernel's lse
+    output carries a custom-vjp cotangent (flash_attention_lse_bhd), the
+    merge is plain jnp.
+
+    q, k, v: [batch, seq_local, heads, head_dim]. Same contract as
+    ring_attention.
+    """
+    from ..ops.pallas_kernels.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention_lse_bhd)
+
+    import jax
+
+    block_q = block_q or DEFAULT_BLOCK_Q
+    block_k = block_k or DEFAULT_BLOCK_K
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True  # CPU test tier runs the Pallas interpreter
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+
+    def to_bhd(t):
+        return jnp.swapaxes(t, 1, 2).reshape(b * h, s_loc, d)
+
+    qb = to_bhd(q)
+    k_blk, v_blk = to_bhd(k), to_bhd(v)
+    m = jnp.full((b * h, s_loc), -1e30, jnp.float32)   # running lse max
+    num = jnp.zeros((b * h, s_loc, d), jnp.float32)
+    den = jnp.zeros((b * h, s_loc), jnp.float32)
+
+    def _blk(is_causal):
+        def run(qq, kk, vv):
+            o, l = flash_attention_lse_bhd(qq, kk, vv, is_causal,
+                                           block_q, block_k, interpret)
+            return o.astype(jnp.float32), l[:, 0, :]
+
+        return run
+
+    def _skip(qq, kk, vv):
+        return (jnp.zeros((b * h, s_loc, d), jnp.float32),
+                jnp.full((b * h, s_loc), -1e30, jnp.float32))
+
+    for r in range(sp):
+        src = (idx - r) % sp   # whose K/V block we currently hold
+        if causal:
+            o_blk, lse_blk = lax.cond(
+                src == idx, _blk(True),
+                lambda qq, kk, vv: lax.cond(
+                    src < idx, _blk(False), _skip, qq, kk, vv),
+                qb, k_blk, v_blk)
+        else:
+            o_blk, lse_blk = _blk(False)(qb, k_blk, v_blk)
+        m_new = jnp.maximum(m, lse_blk)
+        scale_old = jnp.exp(m - m_new)
+        scale_blk = jnp.exp(lse_blk - m_new)
+        num = num * scale_old[..., None] + o_blk * scale_blk[..., None]
+        den = den * scale_old + scale_blk
+        m = m_new
+        if r != sp - 1:
+            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return jnp.swapaxes(out.reshape(b, h, s_loc, d), 1, 2).astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, causal=False, axis_name="sp"):
     """DeepSpeed-Ulysses style: all-to-all so each device holds ALL the
     sequence for heads/sp heads, runs dense attention, then scatters back.
@@ -122,5 +200,7 @@ class RingAttention:
         self.axis_name = axis_name
 
     def __call__(self, q, k, v):
-        fn = ring_attention if self.mode == "ring" else ulysses_attention
+        fn = {"ring": ring_attention,
+              "ring_flash": ring_flash_attention,
+              "ulysses": ulysses_attention}[self.mode]
         return fn(q, k, v, causal=self.causal, axis_name=self.axis_name)

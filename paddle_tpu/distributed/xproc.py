@@ -163,8 +163,15 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 60_000) -> bytes:
     me = jax.process_index()
     seq = _p2p_recv_seq.get((src, me, tag), 0)
     _p2p_recv_seq[(src, me, tag)] = seq + 1
-    val = _kv_client().blocking_key_value_get(
-        f"pt_p2p/{src}/{me}/{tag}/{seq}", timeout_ms)
+    key = f"pt_p2p/{src}/{me}/{tag}/{seq}"
+    client = _kv_client()
+    val = client.blocking_key_value_get(key, timeout_ms)
+    # consumed: delete the entry, or bulk transfers (global_shuffle ships
+    # whole dataset buckets) grow the coordinator without bound
+    try:
+        client.key_value_delete(key)
+    except Exception:
+        pass
     return base64.b64decode(val)
 
 

@@ -271,8 +271,14 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
-                     interpret):
-    """Flash backward: dq pass + dk/dv pass, each O(seq·d) HBM traffic."""
+                     interpret, g_lse=None):
+    """Flash backward: dq pass + dk/dv pass, each O(seq·d) HBM traffic.
+
+    g_lse: optional cotangent of the lse output (ring attention's
+    streaming merge differentiates through lse). Math: the score grad is
+    ds = p∘(dp − delta) with delta = rowsum(do·o); an lse cotangent adds
+    +p·g_lse (d lse/d s = p), i.e. delta_eff = delta − g_lse — one
+    subtraction, the kernels are unchanged."""
     bh, seq, d = q.shape
     seq_k = k.shape[1]
     block_q = min(block_q, seq)
@@ -283,6 +289,8 @@ def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
     gf = g.astype(q.dtype)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]  # [bh, 1, seq] (lane-major)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     lse3 = lse  # already [bh, 1, seq]
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
@@ -340,6 +348,32 @@ def _fa_bwd_rule(causal, block_q, block_k, interpret, res, g):
 
 
 _flash_attention_bhd.defvjp(_fa_fwd_rule, _fa_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse_bhd(q, k, v, causal=False,
+                            block_q=DEFAULT_BLOCK_Q,
+                            block_k=DEFAULT_BLOCK_K, interpret=False):
+    """(out [bh,s,d], lse [bh,1,s]) with BOTH outputs differentiable —
+    the building block for cross-device streaming merges (ring
+    attention): the caller combines per-block results by lse and AD
+    composes through the merge."""
+    return _fa_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fa_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fa_forward(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fa_lse_bwd(causal, block_q, block_k, interpret, res, cts):
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    return _attn_bwd_pallas(q, k, v, out, lse, g_out, causal, block_q,
+                            block_k, interpret, g_lse=g_lse)
+
+
+flash_attention_lse_bhd.defvjp(_fa_lse_fwd, _fa_lse_bwd)
 
 
 def flash_attention_bshd(q, k, v, causal=False,

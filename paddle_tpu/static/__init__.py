@@ -176,6 +176,61 @@ class Executor:
                            isinstance(out, Tensor) else out)
         return results
 
+    def _run_dataset(self, program, dataset, fetch_list, fetch_info,
+                     print_period, debug):
+        """Shared engine of train/infer_from_dataset (reference:
+        executor.py train_from_dataset → MultiTrainer + hogwild_worker
+        thread-per-scope loops over data_feed.cc). TPU-native: the
+        dataset facade (paddle_tpu.distributed.InMemoryDataset /
+        QueueDataset) streams parsed slot batches on the host; each
+        batch is fed as one `run` of the program — the per-op thread
+        scheduling the reference needs for CPU PS workloads is replaced
+        by the compiled program (and the dataset's own parse
+        parallelism)."""
+        use_var = getattr(dataset, "_use_var", []) or []
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in use_var]
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [
+            getattr(f, "name", str(f)) for f in fetch_list]
+        results = None
+        for i, batch in enumerate(dataset):
+            cols = list(zip(*batch))
+            if names and len(cols) != len(names):
+                raise ValueError(
+                    f"dataset yields {len(cols)} slots but use_vars "
+                    f"names {len(names)}: {names}")
+            feed = {n: np.asarray(c) for n, c in zip(names, cols)}
+            results = self.run(program, feed=feed, fetch_list=fetch_list)
+            if print_period and (i + 1) % print_period == 0 and \
+                    (fetch_list or debug):
+                msg = ", ".join(
+                    f"{info}: {np.asarray(r).reshape(-1)[:4]}"
+                    for info, r in zip(fetch_info, results))
+                print(f"[dataset] batch {i + 1}: {msg}", flush=True)
+        return results
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """(reference executor.py:train_from_dataset). Streams the slot
+        dataset through the program once (one pass == one epoch)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        return self._run_dataset(program, dataset, fetch_list, fetch_info,
+                                 print_period, debug)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """(reference executor.py:infer_from_dataset) — identical loop;
+        the program itself decides train vs infer (as in the reference,
+        where the infer variant merely skips gradient ops)."""
+        if dataset is None:
+            raise ValueError("infer_from_dataset needs a dataset")
+        return self._run_dataset(program, dataset, fetch_list, fetch_info,
+                                 print_period, debug)
+
     def close(self):
         pass
 

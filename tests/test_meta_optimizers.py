@@ -56,22 +56,23 @@ class TestLars:
 
 
 class TestDGC:
-    def test_full_sparsity_equals_momentum(self):
-        # sparsity=1.0 selects everything each step: DGC's momentum
-        # correction then reduces exactly to plain Momentum
+    def test_dense_limit_equals_sgd(self):
+        # sparsity=0.0 (reference convention: fraction DROPPED) sends
+        # everything each step; with momentum-factor masking zeroing the
+        # whole accumulator, the update degenerates to plain SGD — the
+        # paper's dense limit
         m1, x, y = _toy(seed=1)
         m2, _, _ = _toy(seed=1)
-        o1 = DGCMomentum(0.05, momentum=0.9, sparsity=1.0,
+        o1 = DGCMomentum(0.05, momentum=0.9, sparsity=0.0,
                          parameters=m1.parameters())
-        o2 = paddle.optimizer.Momentum(0.05, momentum=0.9,
-                                       parameters=m2.parameters())
+        o2 = paddle.optimizer.SGD(0.05, parameters=m2.parameters())
         l1 = _train(m1, o1, x, y, steps=8)
         l2 = _train(m2, o2, x, y, steps=8)
         np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
     def test_sparse_error_feedback_converges(self):
         m, x, y = _toy(seed=2)
-        opt = DGCMomentum(0.05, momentum=0.9, sparsity=0.05,
+        opt = DGCMomentum(0.05, momentum=0.9, sparsity=0.95,
                           parameters=m.parameters())
         losses = _train(m, opt, x, y, steps=40)
         assert losses[-1] < losses[0] * 0.7
@@ -79,6 +80,19 @@ class TestDGC:
         v_mass = sum(float(np.abs(np.asarray(st["v"])).sum())
                      for st in opt._states.values())
         assert v_mass > 0
+
+    def test_reference_sparsity_convention(self):
+        # sparsity=0.999 must KEEP ~0.1%, not 99.9%
+        import jax.numpy as jnp
+
+        m, _, _ = _toy()
+        opt = DGCMomentum(0.05, sparsity=0.999,
+                          parameters=m.parameters())
+        flat_n = 10_000
+        k = max(1, int(np.ceil((1.0 - opt.sparsity) * flat_n)))
+        assert k <= 11  # ~0.1% kept (+1 for fp rounding), not 99.9%
+        with pytest.raises(ValueError, match="sparsity"):
+            DGCMomentum(0.05, sparsity=1.0, parameters=m.parameters())
 
 
 class TestLocalSGD:
@@ -178,3 +192,29 @@ def test_global_shuffle_repartitions(tmp_path):
     b = json.load(open(tmp_path / "gs_1.json"))
     assert sorted(a + b) == sorted(f"s{i}" for i in range(40))
     assert not (set(a) & set(b))  # disjoint partition
+
+
+def test_fleet_strategy_meta_optimizer_swap():
+    """fleet.distributed_optimizer honors strategy.lars/dgc toggles
+    (reference fleet.py:996 meta-optimizer stack)."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    m, _, _ = _toy()
+    st = fleet.DistributedStrategy()
+    st.lars = True
+    st.hybrid_configs["dp_degree"] = 8  # test env: 8 virtual devices
+    fleet.fleet.init(strategy=st)
+    try:
+        opt = paddle.optimizer.Momentum(0.1, parameters=m.parameters())
+        wrapped = fleet.fleet.distributed_optimizer(opt)
+        assert type(wrapped).__name__ == "LarsMomentum"
+        assert wrapped.get_lr() == 0.1
+
+        st2 = fleet.DistributedStrategy()
+        st2.dgc = True
+        opt2 = paddle.optimizer.Momentum(0.1, parameters=m.parameters())
+        w2 = fleet.fleet.distributed_optimizer(opt2, strategy=st2)
+        assert type(w2).__name__ == "DGCMomentum"
+    finally:
+        mesh_mod.reset_mesh()

@@ -1,0 +1,118 @@
+"""On-chip MFU sweep for the flagship GPT train step.
+
+Run on the real TPU (NOT under the CPU test env):
+
+    python tools/mfu_sweep.py [--quick]
+
+Sweeps, one dimension at a time around the bench configuration
+(b16·s1024 GPT-small, amp O1, AdamW):
+
+  * global batch (HBM util / pipeline depth),
+  * flash-attention block_q/block_k (MXU tiling vs VMEM pressure),
+  * default matmul precision,
+
+printing a table of ms/step and MFU so the best point can be promoted
+into bench.py. Each config runs in-process (one backend init); the
+persistent compile cache keeps reruns cheap. IMPORTANT: exits cleanly —
+never leave this holding the chip (the round-2 capture died behind a
+stale sweep process).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+
+
+def measure(batch, seq, block_q, block_k, iters=8):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+    from paddle_tpu.text.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_small)
+    from bench import V5E_PEAK_BF16, gpt_flops_per_step
+
+    old_q, old_k = fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K
+    fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = block_q, block_k
+    try:
+        paddle.seed(0)
+        cfg = gpt_small()
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+        def loss_fn(m, ids):
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                return crit(m(ids), ids)
+
+        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+        t0 = time.perf_counter()
+        float(step(ids).numpy())
+        compile_s = time.perf_counter() - t0
+        for _ in range(2):
+            step(ids)
+        float(step(ids).numpy())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            last = step(ids)
+        float(last.numpy())
+        dt = (time.perf_counter() - t0) / iters
+        mfu = gpt_flops_per_step(cfg, batch, seq) / dt / V5E_PEAK_BF16
+        return dt * 1e3, mfu, compile_s
+    finally:
+        fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = old_q, old_k
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="batch sweep only")
+    args = ap.parse_args()
+
+    os.makedirs(CACHE, exist_ok=True)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    print(f"devices: {jax.devices()}", flush=True)
+
+    seq = 1024
+    configs = [("batch", b, seq, 512, 512) for b in (8, 16, 24, 32)]
+    if not args.quick:
+        configs += [("blocks", 16, seq, bq, bk)
+                    for bq in (256, 512, 1024)
+                    for bk in (256, 512, 1024)
+                    if (bq, bk) != (512, 512)]
+    best = None
+    print(f"{'kind':<8}{'batch':>6}{'bq':>6}{'bk':>6}{'ms':>10}"
+          f"{'MFU':>8}{'compile_s':>10}")
+    for kind, b, s, bq, bk in configs:
+        try:
+            ms, mfu, comp = measure(b, s, bq, bk)
+        except Exception as e:
+            print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}      FAIL  {e!r}",
+                  flush=True)
+            continue
+        print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}{ms:>10.1f}{mfu:>8.3f}"
+              f"{comp:>10.1f}", flush=True)
+        if best is None or mfu > best[0]:
+            best = (mfu, kind, b, bq, bk, ms)
+    if best:
+        mfu, kind, b, bq, bk, ms = best
+        print(f"\nBEST: batch={b} block_q={bq} block_k={bk} "
+              f"-> {ms:.1f} ms, MFU {mfu:.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
