@@ -57,13 +57,17 @@ def _zero_spec(pv, level, base_pspec):
             new = list(base)
             new[d] = "sharding"
             return P(*new)
-    import warnings
+    if any(e is None for e in base):
+        # a free dim existed but none was divisible — the user CAN fix
+        # this (pad the dim / change the axis size). Leaves whose dims
+        # are all taken by TP axes are expected to replicate: no warning.
+        import warnings
 
-    warnings.warn(
-        f"ZeRO ({level}): no dim of shape {tuple(pv.shape)} is divisible "
-        f"by the sharding axis ({n}) — this leaf stays REPLICATED and "
-        "saves no memory; pad the dim or change the axis size",
-        RuntimeWarning, stacklevel=2)
+        warnings.warn(
+            f"ZeRO ({level}): no free dim of shape {tuple(pv.shape)} is "
+            f"divisible by the sharding axis ({n}) — this leaf stays "
+            "REPLICATED and saves no memory; pad the dim or change the "
+            "axis size", RuntimeWarning, stacklevel=2)
     return P(*base) if any(base) else P()
 
 

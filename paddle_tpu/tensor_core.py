@@ -212,6 +212,41 @@ class Tensor:
     def zero_(self):
         return self.fill_(0)
 
+    def fill_diagonal_(self, value, offset=0, wrap=False, name=None):
+        """In-place diagonal fill (reference: phi fill_diagonal kernel /
+        Tensor.fill_diagonal_). 2-D: fill the offset diagonal (`wrap`
+        restarts the diagonal past each N×N block of a tall matrix, the
+        reference/torch tall-matrix semantics). N-D (all dims equal):
+        fill the main hyper-diagonal."""
+        self._check_mutation("fill_diagonal_")
+        v = self._value
+        if v.ndim < 2:
+            raise ValueError("fill_diagonal_ needs at least 2 dims")
+        if v.ndim == 2:
+            import numpy as _np
+
+            rows, cols = int(v.shape[0]), int(v.shape[1])
+            if offset >= cols or -offset >= rows:
+                return self  # diagonal entirely outside the matrix
+            start = offset if offset >= 0 else -offset * cols
+            flat = _np.arange(start, rows * cols, cols + 1)
+            r, c = flat // cols, flat % cols
+            if not wrap and len(c) > 1:
+                # stop at the first wrap-around (col resets)
+                brk = _np.where(_np.diff(c) < 0)[0]
+                if brk.size:
+                    r, c = r[: brk[0] + 1], c[: brk[0] + 1]
+            new = v.at[r, c].set(value)
+        else:
+            if len(set(v.shape)) != 1:
+                raise ValueError(
+                    "N-D fill_diagonal_ needs all dims equal")
+            idx = jnp.arange(v.shape[0])
+            new = v.at[tuple([idx] * v.ndim)].set(value)
+        self._value = new
+        self._grad_node = None
+        return self
+
     # scale_ is installed by ops._install_tensor_methods as a
     # tape-recording in-place op (no graph severing) — not defined here
 

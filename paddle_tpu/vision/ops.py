@@ -757,3 +757,186 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 
 __all__ += ["DeformConv2D", "distribute_fpn_proposals",
             "generate_proposals"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes for one feature map (reference:
+    phi prior_box kernel / fluid.layers.detection.prior_box).
+
+    input: [N, C, H, W] feature map; image: [N, C, Him, Wim].
+    Returns (boxes [H, W, P, 4] in normalized xmin/ymin/xmax/ymax,
+    variances [H, W, P, 4]).
+    """
+    from ..ops._helpers import ensure_tensor
+
+    input = ensure_tensor(input)
+    image = ensure_tensor(image)
+    H, W = int(input.shape[2]), int(input.shape[3])
+    Him, Wim = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] if steps and steps[0] > 0 else Wim / W
+    step_h = steps[1] if len(steps) > 1 and steps[1] > 0 else Him / H
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    # per-cell prior (w, h) list, matching the reference kernel's order:
+    # default [min@ar1, other ars..., sqrt(min·max)];
+    # min_max_aspect_ratios_order=True puts the max prior right after min
+    whs = []
+    for idx, ms in enumerate(min_sizes):
+        ms = float(ms)
+
+        def _max_prior():
+            mx = float(max_sizes[idx])
+            s = float(np.sqrt(ms * mx))
+            whs.append((s, s))
+
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                _max_prior()
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                _max_prior()
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+    P = whs.shape[0]
+
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    half_w = whs[None, None, :, 0] / 2.0
+    half_h = whs[None, None, :, 1] / 2.0
+    boxes = np.stack([
+        (cxg - half_w) / Wim, (cyg - half_h) / Him,
+        (cxg + half_w) / Wim, (cyg + half_h) / Him,
+    ], axis=-1).astype(np.float32)  # [H, W, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(
+        np.asarray(variance, np.float32), (H, W, P, 4)).copy()
+    return (Tensor(jnp.asarray(boxes), stop_gradient=True),
+            Tensor(jnp.asarray(vars_), stop_gradient=True))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference: phi box_coder
+    kernel). encode: target [N,4] vs priors [M,4] → [N,M,4] deltas;
+    decode: target [N,M,4] (or [N,4] broadcast by axis) → boxes."""
+    from ..ops._helpers import ensure_tensor, value_of
+
+    pb = value_of(ensure_tensor(prior_box)).astype(jnp.float32)
+    tb = value_of(ensure_tensor(target_box)).astype(jnp.float32)
+    if prior_box_var is None:
+        pbv = jnp.ones_like(pb)
+    elif isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.broadcast_to(
+            jnp.asarray(prior_box_var, jnp.float32), pb.shape)
+    else:
+        pbv = value_of(ensure_tensor(prior_box_var)).astype(jnp.float32)
+
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2.0
+    pcy = pb[:, 1] + ph / 2.0
+
+    def _code():
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2.0
+            tcy = tb[:, 1] + th / 2.0
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)  # [N, M, 4]
+            return out / pbv[None, :, :]
+        # decode_center_size
+        t = tb if tb.ndim == 3 else tb[:, None, :]
+        if axis == 0:
+            pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+            pw_b, ph_b = pw[None, :], ph[None, :]
+            v = pbv[None, :, :]
+        else:
+            pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+            pw_b, ph_b = pw[:, None], ph[:, None]
+            v = pbv[:, None, :]
+        d = t * v
+        ocx = pcx_b + d[..., 0] * pw_b
+        ocy = pcy_b + d[..., 1] * ph_b
+        ow = jnp.exp(d[..., 2]) * pw_b
+        oh = jnp.exp(d[..., 3]) * ph_b
+        return jnp.stack([ocx - ow / 2.0, ocy - oh / 2.0,
+                          ocx + ow / 2.0 - norm,
+                          ocy + oh / 2.0 - norm], axis=-1)
+
+    return Tensor(_code(), stop_gradient=True)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between token sequences (reference: phi
+    edit_distance kernel / fluid.layers.edit_distance). Host metric op
+    (the reference kernel is a CPU DP loop too). input/label:
+    [B, T] int tensors (padded); *_length: [B] valid lengths.
+    Returns (distance [B, 1] float32, sequence_num [1] int64)."""
+    from ..ops._helpers import ensure_tensor, value_of
+
+    a = np.asarray(value_of(ensure_tensor(input)))
+    b = np.asarray(value_of(ensure_tensor(label)))
+    B = a.shape[0]
+    a_len = (np.asarray(value_of(ensure_tensor(input_length))).reshape(-1)
+             if input_length is not None
+             else np.full(B, a.shape[1], np.int64))
+    b_len = (np.asarray(value_of(ensure_tensor(label_length))).reshape(-1)
+             if label_length is not None
+             else np.full(B, b.shape[1], np.int64))
+    ignored = set(int(t) for t in (ignored_tokens or []))
+
+    out = np.zeros((B, 1), np.float32)
+    for i in range(B):
+        s1 = [int(t) for t in a[i, : int(a_len[i])]
+              if int(t) not in ignored]
+        s2 = [int(t) for t in b[i, : int(b_len[i])]
+              if int(t) not in ignored]
+        n, m = len(s1), len(s2)
+        dp = np.arange(m + 1, dtype=np.int64)
+        for r in range(1, n + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, m + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        dist = float(dp[m])
+        if normalized:
+            if m == 0:
+                raise ValueError(
+                    "edit_distance(normalized=True): reference string "
+                    f"(label row {i}) is empty after filtering — the "
+                    "normalized error rate is undefined")
+            dist = dist / m
+        out[i, 0] = dist
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray(np.asarray([B], np.int64)),
+                   stop_gradient=True))
+
+
+__all__ += ["prior_box", "box_coder", "edit_distance"]

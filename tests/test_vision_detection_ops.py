@@ -179,3 +179,123 @@ def test_read_file_decode_jpeg(tmp_path):
     np.testing.assert_array_equal(t.numpy(), [1, 2, 3, 255])
     with pytest.raises(RuntimeError):
         V.decode_jpeg(t)
+
+
+def test_prior_box_ssd_shapes_and_values():
+    """reference: phi prior_box kernel (SSD anchors)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import prior_box
+
+    feat = paddle.zeros([1, 8, 4, 4])
+    img = paddle.zeros([1, 3, 32, 32])
+    boxes, vars_ = prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[2.0], flip=True, clip=True)
+    # ars: 1, 2, 1/2 -> 3 priors + max prior = 4
+    assert list(boxes.shape) == [4, 4, 4, 4]
+    assert list(vars_.shape) == [4, 4, 4, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()  # clipped
+    # cell (0,0): center at offset 0.5 * step 8 = (4, 4); min prior 8x8
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 0.25, 0.25],
+                               atol=1e-6)
+    v = vars_.numpy()
+    np.testing.assert_allclose(v[2, 3, 1], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    """reference: phi box_coder kernel — decode(encode(x)) == x."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import box_coder
+
+    rng = np.random.default_rng(0)
+    priors = np.abs(rng.standard_normal((5, 4))).astype(np.float32)
+    priors[:, 2:] = priors[:, :2] + 0.5 + np.abs(priors[:, 2:])
+    targets = np.abs(rng.standard_normal((3, 4))).astype(np.float32)
+    targets[:, 2:] = targets[:, :2] + 0.5 + np.abs(targets[:, 2:])
+    pvar = [0.1, 0.1, 0.2, 0.2]
+
+    enc = box_coder(paddle.to_tensor(priors), pvar,
+                    paddle.to_tensor(targets),
+                    code_type="encode_center_size")
+    assert list(enc.shape) == [3, 5, 4]
+    dec = box_coder(paddle.to_tensor(priors), pvar, enc,
+                    code_type="decode_center_size", axis=0)
+    # every (target, prior) decode recovers the target box
+    for m in range(5):
+        np.testing.assert_allclose(dec.numpy()[:, m], targets, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_edit_distance_known_values():
+    """reference: phi edit_distance kernel (Levenshtein)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import edit_distance
+
+    # kitten -> sitting = 3 edits
+    a = paddle.to_tensor(np.array([[1, 2, 3, 3, 4, 5, 0]]))   # kitten pad
+    b = paddle.to_tensor(np.array([[6, 2, 3, 3, 2, 5, 7]]))   # sitting
+    d, n = edit_distance(a, b, normalized=False,
+                         input_length=paddle.to_tensor(np.array([6])),
+                         label_length=paddle.to_tensor(np.array([7])))
+    assert float(d.numpy()[0, 0]) == 3.0
+    assert int(n.numpy()[0]) == 1
+    dn, _ = edit_distance(a, b, normalized=True,
+                          input_length=paddle.to_tensor(np.array([6])),
+                          label_length=paddle.to_tensor(np.array([7])))
+    np.testing.assert_allclose(float(dn.numpy()[0, 0]), 3.0 / 7, rtol=1e-6)
+    # ignored tokens drop from both sequences
+    d2, _ = edit_distance(a, b, normalized=False, ignored_tokens=[0, 6, 7],
+                          input_length=paddle.to_tensor(np.array([6])),
+                          label_length=paddle.to_tensor(np.array([7])))
+    # kitten(12334 5) vs itti(2332 5): [1,2,3,3,4,5] vs [2,3,3,2,5] = 2
+    assert float(d2.numpy()[0, 0]) == 2.0
+
+
+def test_fill_diagonal_inplace():
+    import paddle_tpu as paddle
+
+    t = paddle.zeros([3, 3])
+    t.fill_diagonal_(5.0)
+    np.testing.assert_allclose(t.numpy(), np.eye(3) * 5.0)
+
+    t = paddle.zeros([4, 3])
+    t.fill_diagonal_(1.0, wrap=False)
+    ref = np.zeros((4, 3)); ref[0, 0] = ref[1, 1] = ref[2, 2] = 1
+    np.testing.assert_allclose(t.numpy(), ref)
+
+    t = paddle.zeros([7, 3])
+    t.fill_diagonal_(1.0, wrap=True)
+    ref = np.zeros(21); ref[0::4] = 1
+    np.testing.assert_allclose(t.numpy().ravel(), ref)
+
+    t = paddle.zeros([3, 4])
+    t.fill_diagonal_(2.0, offset=1)
+    ref = np.zeros((3, 4)); ref[0, 1] = ref[1, 2] = ref[2, 3] = 2
+    np.testing.assert_allclose(t.numpy(), ref)
+
+    t = paddle.zeros([2, 2, 2])
+    t.fill_diagonal_(3.0)
+    assert t.numpy()[0, 0, 0] == 3.0 and t.numpy()[1, 1, 1] == 3.0
+    assert t.numpy()[0, 1, 1] == 0.0
+
+
+def test_fill_diagonal_offset_out_of_range_noop():
+    import paddle_tpu as paddle
+
+    t = paddle.zeros([3, 4])
+    t.fill_diagonal_(9.0, offset=4)   # diagonal fully outside
+    assert float(t.numpy().sum()) == 0.0
+    t.fill_diagonal_(9.0, offset=-3)
+    assert float(t.numpy().sum()) == 0.0
+
+
+def test_edit_distance_empty_label_normalized_raises():
+    import pytest as _pytest
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import edit_distance
+
+    a = paddle.to_tensor(np.array([[1, 2, 3]]))
+    b = paddle.to_tensor(np.array([[0]]))
+    with _pytest.raises(ValueError, match="empty"):
+        edit_distance(a, b, normalized=True, ignored_tokens=[0])
