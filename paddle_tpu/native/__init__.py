@@ -18,7 +18,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["sparse_table.cc", "batch_assemble.cc"]
+_SOURCES = ["sparse_table.cc", "batch_assemble.cc", "slot_parser.cc"]
 
 _lib = None
 _tried = False
@@ -77,6 +77,10 @@ def _load():
         lib.pt_assemble_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int]
+        lib.pt_parse_slots.restype = ctypes.c_int64
+        lib.pt_parse_slots.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -213,3 +217,45 @@ def assemble_batch(samples, out=None, n_threads=0):
     lib.pt_assemble_batch(ptrs, n, samples[0].nbytes, out.ctypes.data,
                           n_threads)
     return out
+
+
+def parse_slots(text, n_slots):
+    """Parse numeric slot lines to a [rows, n_slots] float32 matrix
+    (reference: data_feed.cc MultiSlotDataFeed). `text`: str or bytes;
+    raises ValueError naming the first malformed line. Falls back to a
+    python parse when the native library is unavailable."""
+    import numpy as np
+
+    if isinstance(text, str):
+        text = text.encode()
+    n_slots = int(n_slots)
+    lib = get_lib()
+    if lib is None:
+        # pure-python fallback with the SAME error contract: row index
+        # counts parsed (non-blank) rows, like the native path
+        rows = []
+        for line in text.decode().splitlines():
+            if not line.strip():
+                continue
+            toks = line.split()
+            r = len(rows)
+            if len(toks) != n_slots:
+                raise ValueError(
+                    f"slot parse error on line {r}: wrong slot count or "
+                    "non-numeric token")
+            try:
+                rows.append([float(t) for t in toks])
+            except ValueError:
+                raise ValueError(
+                    f"slot parse error on line {r}: wrong slot count or "
+                    "non-numeric token") from None
+        return np.asarray(rows, np.float32).reshape(-1, n_slots)
+    max_rows = text.count(b"\n") + 1
+    out = np.empty((max_rows, int(n_slots)), np.float32)
+    n = lib.pt_parse_slots(text + b"\0", int(n_slots), out.ctypes.data,
+                           max_rows)
+    if n < 0:
+        raise ValueError(
+            f"slot parse error on line {-n - 1}: wrong slot count or "
+            "non-numeric token")
+    return out[:n].copy()

@@ -1,0 +1,44 @@
+// Slot-line parser — the host ingest hot loop for PS/CTR workloads.
+//
+// TPU-native counterpart of the reference's C++ data feed
+// (reference: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed —
+// thread-pooled line parsing feeding trainer scopes). Here the parse is
+// a single tight strtof loop over a buffer (called with the GIL
+// released via ctypes), producing one dense [rows, n_slots] float32
+// matrix the Python dataset facade slices into samples.
+
+#include <cstdlib>
+
+extern "C" {
+
+// Parse whitespace-separated numeric slot lines; one sample per line.
+// buf MUST be NUL-terminated (the Python wrapper appends one). CRLF and
+// whitespace-only lines are handled (blank lines are skipped). Returns
+// the number of rows parsed, or -(row_index+1) on a malformed row
+// (short line / extra slots / non-numeric token), where row_index
+// counts parsed (non-blank) rows.
+long long pt_parse_slots(const char* buf, long long n_slots, float* out,
+                         long long max_rows) {
+  const char* p = buf;
+  long long rows = 0;
+  while (*p && rows < max_rows) {
+    // skip blank / whitespace-only lines (also leading spaces of a row)
+    while (*p == '\n' || *p == '\r' || *p == ' ' || *p == '\t') ++p;
+    if (!*p) break;
+    for (long long s = 0; s < n_slots; ++s) {
+      if (!*p || *p == '\n' || *p == '\r') return -(rows + 1);  // short
+      char* q;
+      float v = strtof(p, &q);
+      if (q == p) return -(rows + 1);  // non-numeric token
+      out[rows * n_slots + s] = v;
+      p = q;
+      while (*p == ' ' || *p == '\t') ++p;
+    }
+    while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+    if (*p && *p != '\n') return -(rows + 1);  // extra slots
+    ++rows;
+  }
+  return rows;
+}
+
+}  // extern "C"

@@ -140,3 +140,73 @@ def test_sparse_embedding_native_backend_trains():
     out = emb(ids)
     out.sum().backward()  # push via hook must not error
     assert len(emb.table) == 3
+
+
+class TestSlotParser:
+    """Native line parser (reference: data_feed.cc MultiSlotDataFeed)."""
+
+    def test_parses_matrix(self):
+        from paddle_tpu import native
+
+        m = native.parse_slots("1 2 3\n4 5.5 -6\n7 8 9e2\n", 3)
+        np.testing.assert_allclose(
+            m, [[1, 2, 3], [4, 5.5, -6], [7, 8, 900]])
+
+    def test_malformed_line_reports_index(self):
+        from paddle_tpu import native
+
+        with pytest.raises(ValueError, match="line 1"):
+            native.parse_slots("1 2 3\n4 oops 6\n", 3)
+        with pytest.raises(ValueError):
+            native.parse_slots("1 2 3 4\n", 3)  # extra slot
+
+    def test_dataset_numeric_fast_path(self, tmp_path):
+        import paddle_tpu.distributed as dist
+
+        f = tmp_path / "d.txt"
+        f.write_text("".join(f"{i} {i * 0.5} {i % 2}\n" for i in range(9)))
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=3, use_var=["a", "b", "y"], parse_fn="numeric")
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 9
+        batches = list(ds)
+        assert len(batches) == 3
+        np.testing.assert_allclose(batches[0][1], [1.0, 0.5, 1.0])
+
+    def test_crlf_and_whitespace_lines(self):
+        from paddle_tpu import native
+
+        # CRLF endings parse identically to LF; whitespace-only lines skip
+        m = native.parse_slots("1 2 3\r\n4 5 6\r\n   \r\n7 8 9\r\n", 3)
+        np.testing.assert_allclose(m, [[1, 2, 3], [4, 5, 6], [7, 8, 9]])
+        # a SHORT CRLF line must error, not merge with the next line
+        with pytest.raises(ValueError, match="line 0"):
+            native.parse_slots("1 2\r\n3\r\n", 3)
+
+    def test_fallback_matches_native_error_contract(self):
+        from paddle_tpu import native
+
+        # force the python fallback and check identical behavior
+        lib = native._lib
+        native._lib = None
+        native._tried = True
+        try:
+            m = native.parse_slots("1 2 3\n\n4 5 6\n", 3)
+            np.testing.assert_allclose(m, [[1, 2, 3], [4, 5, 6]])
+            with pytest.raises(ValueError, match="line 1"):
+                native.parse_slots("1 2 3\n4 oops 6\n", 3)
+        finally:
+            native._lib = lib
+
+    def test_numeric_path_streams_chunks(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import api_extra
+
+        f = tmp_path / "big.txt"
+        f.write_text("\n" + "".join(f"{i} {i + 1}\n" for i in range(100)))
+        ds = dist.QueueDataset()
+        ds.init(batch_size=10, parse_fn="numeric")  # slots inferred
+        ds.set_filelist([str(f)])
+        total = sum(len(b) for b in ds)
+        assert total == 100
