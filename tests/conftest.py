@@ -6,8 +6,11 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # axon env presets JAX_PLATFORMS=axon
 # silence XLA:CPU AOT cache-load feature-mismatch E-spam (pseudo-features
-# like +prefer-no-scatter are never reported by the host probe; same box)
-os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# like +prefer-no-scatter are never reported by the host probe; same box).
+# Hard-set because the container PRESETS this var (so setdefault loses);
+# override for debugging via PADDLE_TPU_TEST_LOG_LEVEL.
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = os.environ.get(
+    "PADDLE_TPU_TEST_LOG_LEVEL", "3")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
