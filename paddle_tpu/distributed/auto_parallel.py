@@ -214,10 +214,10 @@ class ClusterSpec:
     the analytic model needs."""
 
     def __init__(self, peak_flops=197e12, ici_bandwidth=4.5e10,
-                 hbm_bandwidth=8.1e11, collective_latency=1e-6):
+                 hbm_capacity=16e9, collective_latency=1e-6):
         self.peak_flops = peak_flops
         self.ici_bandwidth = ici_bandwidth   # bytes/s per link direction
-        self.hbm_bandwidth = hbm_bandwidth
+        self.hbm_capacity = hbm_capacity     # bytes per chip
         # fixed cost per collective launch/ring-hop setup: what makes
         # MANY small all-reduces (TP on tiny layers) lose to ONE fused
         # gradient all-reduce even when the byte counts say otherwise
@@ -309,13 +309,15 @@ class CostModel:
         return bytes_
 
     def plan(self, model, batch_size, n_devices=None, tokens_per_sample=1,
-             candidates=None, hbm_capacity=16e9):
+             candidates=None, hbm_capacity=None):
         """Pick the cheapest FEASIBLE placement (reference planner.py /
         tuner): candidates whose param+grad+opt-state bytes exceed
         hbm_capacity are priced inf — that is how ZeRO placements win
         (they trade the all-gather time step_cost charges for fitting
         at all). Returns (best_name, {name: seconds})."""
         n = n_devices or len(jax.devices())
+        if hbm_capacity is None:
+            hbm_capacity = self.cluster.hbm_capacity
         if candidates is None:
             candidates = [("dp", n, 1, False), ("dp_zero", n, 1, True)]
             for mp in (2, 4, 8):
@@ -331,6 +333,12 @@ class CostModel:
                 model, batch_size, dp=dp, mp=mp, zero=zero,
                 tokens_per_sample=tokens_per_sample)
         best = min(costs, key=costs.get)
+        if costs[best] == float("inf"):
+            raise RuntimeError(
+                f"no candidate placement fits hbm_capacity="
+                f"{hbm_capacity:.2e} bytes/device (tried "
+                f"{sorted(costs)}); add devices, enable ZeRO/mp "
+                "candidates, or raise the capacity")
         return best, costs
 
 

@@ -1,0 +1,232 @@
+"""fleet.utils — filesystem clients + recompute alias.
+
+TPU-native counterparts of the reference helpers (reference:
+python/paddle/distributed/fleet/utils/{fs.py,__init__.py,ps_util.py}).
+Checkpoint/export paths take these FS objects so jobs can target local
+disk or an HDFS-compatible store with one interface; `recompute` is the
+stable alias of the activation-recompute API.
+"""
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "recompute", "DistributedInfer",
+           "ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
+           "FSTimeOut"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class LocalFS:
+    """Local filesystem under the reference FS contract (reference
+    fs.py:120 LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) — the reference's two-list shape."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            os.utime(fs_path, None)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.unlink(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(dst_path)
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def upload(self, local_path, fs_path):
+        self._copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._copy(fs_path, local_path)
+
+    @staticmethod
+    def _copy(src, dst):
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, dst)
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """HDFS access via the `hadoop fs` CLI (reference fs.py:428 drives
+    the same binary through a shell). Raises ExecuteError with the
+    command output on failure; needs a hadoop installation on the host
+    (TPU pods typically use GCS instead — mount or use LocalFS over a
+    FUSE path)."""
+
+    def __init__(self, hadoop_home=None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._cfg = []
+        for k, v in (configs or {}).items():
+            self._cfg += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0  # reference API: milliseconds
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"] + self._cfg + list(args)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=self._timeout)
+        except FileNotFoundError:
+            raise ExecuteError(
+                "hadoop binary not found — HDFSClient needs a hadoop "
+                "install (set hadoop_home); on TPU pods prefer GCS")
+        except subprocess.TimeoutExpired:
+            raise FSTimeOut(" ".join(cmd))
+        if r.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)}: {r.stderr}")
+        return r.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        # same exception contract as LocalFS.mv — callers handle ONE
+        # set of FS errors regardless of backend
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if self.is_exist(fs_dst_path):
+            if not overwrite:
+                raise FSFileExistsError(fs_dst_path)
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return True
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)
+
+
+def recompute(function, *args, **kwargs):
+    """Stable alias (reference fleet/utils/__init__.py:34 — deprecated
+    alias of fleet.recompute)."""
+    from ..recompute import recompute as _rc
+
+    return _rc(function, *args, **kwargs)
+
+
+class DistributedInfer:
+    """PS inference helper facade (reference ps_util.py DistributedInfer:
+    swaps distributed lookup tables for local ones at inference). In
+    this design PS tables already live host-side (`distributed/ps.py`),
+    so inference just reads them: init is a no-op and `get_dist_infer_program`
+    returns the program unchanged."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main

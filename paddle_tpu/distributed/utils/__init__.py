@@ -1,0 +1,132 @@
+"""paddle_tpu.distributed.utils — MoE dispatch API + launch/log helpers.
+
+TPU-native counterparts of the reference's utils package (reference:
+python/paddle/distributed/utils/{moe_utils,log_utils,launch_utils}.py).
+The launch machinery itself lives in `paddle_tpu.distributed.launch`;
+this module keeps the small public helpers scripts import directly.
+"""
+import logging
+import socket
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor_core import Tensor
+from ...ops._helpers import ensure_tensor, value_of
+
+__all__ = ["global_scatter", "global_gather", "get_logger",
+           "get_host_name_ip", "find_free_ports"]
+
+
+def _counts(t):
+    return np.asarray(value_of(ensure_tensor(t))).reshape(-1).astype(
+        np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """MoE dispatch (reference moe_utils.py:21 global_scatter over the
+    global_scatter CUDA op): reorder the local rows of `x` into
+    per-(rank, expert) send buckets. In the TPU design the cross-device
+    leg is the capacity-bucketed `lax.all_to_all` inside
+    `distributed.moe.MoELayer` (ragged all-to-all has no efficient ICI
+    lowering); this eager API implements the reference semantics for the
+    single-process world — rows grouped by destination expert in
+    (rank-major, expert-minor) order — and directs multi-process users
+    to MoELayer.
+
+    x: [n_tokens, d]; local_count[i]: rows going to expert i % n_expert
+    of rank i // n_expert (rows of x are already sorted by destination,
+    as the reference op requires). Returns the send-ordered rows.
+    """
+    return _global_scatter_impl(x, local_count, global_count, group)
+
+
+def _world_size(group):
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _global_scatter_impl(x, local_count, global_count, group):
+    if _world_size(group) > 1:
+        raise NotImplementedError(
+            "multi-process global_scatter: ragged all-to-all has no "
+            "efficient ICI lowering — use "
+            "paddle_tpu.distributed.moe.MoELayer (capacity-bucketed "
+            "all_to_all dispatch)")
+    xv = value_of(ensure_tensor(x))
+    lc = _counts(local_count)
+    gc = _counts(global_count)
+    # single world: the send order IS the row order grouped by expert —
+    # x is required pre-sorted by destination, so this is the identity
+    # on rows with the dispatch metadata validated
+    if int(lc.sum()) != int(xv.shape[0]):
+        raise ValueError(
+            f"local_count sums to {int(lc.sum())} but x has "
+            f"{int(xv.shape[0])} rows")
+    if int(gc.sum()) != int(lc.sum()):
+        raise ValueError(
+            f"global_count sums to {int(gc.sum())} != local_count sum "
+            f"{int(lc.sum())} — inconsistent dispatch metadata "
+            "(single-process world sends exactly what it receives)")
+    return Tensor(jnp.asarray(xv))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (reference moe_utils.py global_gather):
+    return expert outputs to their source ranks. Single-process world:
+    identity on the validated buckets; multi-process: see MoELayer."""
+    if _world_size(group) > 1:
+        raise NotImplementedError(
+            "multi-process global_gather: use "
+            "paddle_tpu.distributed.moe.MoELayer (capacity-bucketed "
+            "all_to_all combine)")
+    xv = value_of(ensure_tensor(x))
+    gc = np.asarray(value_of(ensure_tensor(global_count))).reshape(-1)
+    if int(gc.sum()) != int(xv.shape[0]):
+        raise ValueError(
+            f"global_count sums to {int(gc.sum())} but x has "
+            f"{int(xv.shape[0])} rows")
+    return Tensor(jnp.asarray(xv))
+
+
+def get_logger(log_level, name="root"):
+    """(reference log_utils.py:18)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def get_host_name_ip():
+    """(reference launch_utils.py:334)."""
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """(reference launch_utils.py:359)."""
+    ports = set()
+    socks = []
+    try:
+        while len(ports) < num:
+            s = socket.socket()
+            s.bind(("", 0))
+            socks.append(s)
+            ports.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports

@@ -100,3 +100,81 @@ def test_destroy_process_group():
 def test_gloo_facade():
     dist.gloo_barrier()  # single-process: no-op
     dist.gloo_release()
+
+
+def test_distributed_utils_module():
+    """reference python/paddle/distributed/utils package surface."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import utils as dutils
+
+    x = paddle.to_tensor(np.ones((5, 3), np.float32))
+    lc = paddle.to_tensor(np.array([2, 3]))
+    out = dutils.global_scatter(x, lc, lc)
+    np.testing.assert_allclose(out.numpy(), np.ones((5, 3)))
+    out2 = dutils.global_gather(x, lc, lc)
+    np.testing.assert_allclose(out2.numpy(), np.ones((5, 3)))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="sums to"):
+        dutils.global_scatter(x, paddle.to_tensor(np.array([1, 1])), lc)
+
+    logger = dutils.get_logger(20, "pt-test")
+    logger.info("logger ok")
+    ports = dutils.find_free_ports(3)
+    assert len(ports) == 3
+
+
+def test_fleet_utils_localfs(tmp_path):
+    """reference fleet/utils/fs.py LocalFS contract."""
+    from paddle_tpu.distributed.fleet.utils import (
+        FSFileExistsError, FSFileNotExistsError, LocalFS)
+
+    fs = LocalFS()
+    d = tmp_path / "a"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+    f = d / "x.txt"
+    f.write_text("hello")
+    assert fs.is_file(str(f))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["a"] and files == []
+    assert fs.cat(str(f)) == "hello"
+    fs.touch(str(d / "y.txt"))
+    fs.mv(str(d / "y.txt"), str(d / "z.txt"))
+    assert fs.is_file(str(d / "z.txt"))
+    import pytest as _pytest
+
+    with _pytest.raises(FSFileNotExistsError):
+        fs.mv(str(d / "nope"), str(d / "w"))
+    with _pytest.raises(FSFileExistsError):
+        fs.mv(str(f), str(d / "z.txt"))
+    fs.upload(str(f), str(tmp_path / "up.txt"))
+    assert fs.cat(str(tmp_path / "up.txt")) == "hello"
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert not fs.need_upload_download()
+
+
+def test_fleet_utils_recompute_alias():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = recompute(lin, x)  # pass the Layer so params thread the tape
+    out.sum().backward()
+    assert lin.weight.grad is not None
+
+
+def test_hdfs_client_without_hadoop_errors_cleanly():
+    from paddle_tpu.distributed.fleet.utils import ExecuteError, HDFSClient
+    import pytest as _pytest
+
+    c = HDFSClient(hadoop_home="/nonexistent")
+    with _pytest.raises(ExecuteError, match="hadoop"):
+        c.mkdirs("/tmp/x")
