@@ -10,6 +10,8 @@ from .. import collective as coll
 from .. import env as env_mod
 from .. import mesh as mesh_mod
 from ..parallel_step import DistributedTrainStep, shard_params_and_opt
+from . import data_generator  # noqa: F401
+from . import dataset  # noqa: F401
 from . import elastic  # noqa: F401
 from . import meta_optimizers  # noqa: F401
 from . import topology as topo_mod

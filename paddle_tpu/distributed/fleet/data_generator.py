@@ -1,0 +1,203 @@
+"""fleet.data_generator — user-defined sample generators for PS/CTR ingest.
+
+Reference surface: python/paddle/distributed/fleet/data_generator/
+data_generator.py:21 (`DataGenerator`), :239 (`MultiSlotStringDataGenerator`),
+:284 (`MultiSlotDataGenerator`). In the reference these run inside a
+`pipe_command` subprocess whose stdout is parsed by the C++ MultiSlotDataFeed
+(paddle/fluid/framework/data_feed.cc). Here the same wire protocol is kept —
+one line per sample, ``<n> v1 .. vn`` per slot — and the consumer side is
+`parse_multi_slot` (python) or, for the dense numeric case, the native C
+parser (`paddle_tpu.native.parse_slots`). A generator can therefore still be
+used as a shell pipe (`run_from_stdin`) or in-process (`run_from_memory`).
+"""
+import sys
+
+__all__ = [
+    "DataGenerator", "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator", "parse_multi_slot",
+]
+
+
+class DataGenerator:
+    """Base generator. Subclasses implement `generate_sample(line)`
+    returning a generator that yields samples shaped
+    ``[(slot_name, [values...]), ...]``; optionally `generate_batch`
+    to re-group buffered samples (reference data_generator.py:194)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks -------------------------------------------------------
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[('words', [1, 2, 3]), ('label', [0])]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- drivers ----------------------------------------------------------
+    def _run(self, lines, out):
+        batch_samples = []
+        for line in lines:
+            for user_parsed_line in self.generate_sample(line)():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(sample))
+
+    def run_from_memory(self, out=None):
+        """Drive `generate_sample(None)` once (memory-resident generators,
+        reference data_generator.py:61)."""
+        self._run([None], out or sys.stdout)
+
+    def run_from_stdin(self, inp=None, out=None):
+        """Read one raw input line at a time and emit wire-format samples
+        (reference data_generator.py:96)."""
+        self._run(inp or sys.stdin, out or sys.stdout)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "Please inherit MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator to use this function")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-token wire format: ``<n> tok1 .. tokn`` per slot
+    (reference data_generator.py:239). Fastest path: no type checks."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type; "
+                "Example: [('words', ['1926', '08', '17']), ('label', ['0'])]")
+        output = ""
+        for name, elements in line:
+            if output:
+                output += " "
+            out_str = [str(len(elements))]
+            out_str.extend(str(e) for e in elements)
+            output += " ".join(out_str)
+        return output + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Typed numeric wire format with a slot schema: each slot's dtype is
+    pinned on first sample (uint64 for all-int values, float otherwise)
+    and later samples must agree on slot names/order and count
+    (reference data_generator.py:284 `_gen_str` + proto_info upgrade)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type; "
+                "Example: [('words', [1926, 8, 17]), ('label', [1])]")
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(f"name must be str, got {type(name)}")
+                if not isinstance(elements, list):
+                    raise ValueError(
+                        f"elements must be list, got {type(elements)}")
+                if not elements:
+                    raise ValueError(
+                        f"the elements of each field ({name}) can not be empty")
+                self._proto_info.append((name, "uint64"))
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for elem in elements:
+                    if isinstance(elem, float):
+                        self._proto_info[-1] = (name, "float")
+                    elif not isinstance(elem, int):
+                        raise ValueError(
+                            f"the type of element ({type(elem)}) must be int "
+                            "or float")
+                    output += " " + str(elem)
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two samples are different: "
+                    f"{len(line)} vs {len(self._proto_info)}")
+            for index, item in enumerate(line):
+                name, elements = item
+                if name != self._proto_info[index][0]:
+                    raise ValueError(
+                        f"the field name of two samples are different: "
+                        f"{name} vs {self._proto_info[index][0]}")
+                if not elements:
+                    raise ValueError(
+                        f"the elements of each field ({name}) can not be empty")
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for elem in elements:
+                    if self._proto_info[index][1] != "float":
+                        if isinstance(elem, float):
+                            self._proto_info[index] = (name, "float")
+                        elif not isinstance(elem, int):
+                            raise ValueError(
+                                f"the type of element ({type(elem)}) must be "
+                                "int or float")
+                    output += " " + str(elem)
+        return output + "\n"
+
+
+def _num(v):
+    """int when exact, else float — floats without '.', nan and inf
+    (all emitted by MultiSlotDataGenerator) must round-trip."""
+    try:
+        return int(v)
+    except ValueError:
+        return float(v)
+
+
+def parse_multi_slot(text, n_slots, string=False):
+    """Decode the multi-slot wire format back into per-row ragged slots:
+    returns ``[[slot0_values, slot1_values, ...], ...]`` (one inner list per
+    line). The consumer-side analog of data_feed.cc's MultiSlotDataFeed
+    deserializer; `string=True` keeps raw tokens."""
+    rows = []
+    for lineno, line in enumerate(text.splitlines()):
+        toks = line.split()
+        if not toks:
+            continue
+        slots, i = [], 0
+        try:
+            for _ in range(n_slots):
+                n = int(toks[i])
+                vals = toks[i + 1: i + 1 + n]
+                if len(vals) != n:
+                    raise IndexError
+                if not string:
+                    vals = [_num(v) for v in vals]
+                slots.append(vals)
+                i += 1 + n
+        except (IndexError, ValueError):
+            raise ValueError(
+                f"multi-slot parse error on line {lineno}: truncated or "
+                "non-numeric slot") from None
+        if i != len(toks):
+            raise ValueError(
+                f"multi-slot parse error on line {lineno}: trailing tokens")
+        rows.append(slots)
+    return rows

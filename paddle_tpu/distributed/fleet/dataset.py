@@ -1,0 +1,261 @@
+"""fleet.dataset — PS dataset facades + tree index for TDM-style retrieval.
+
+Reference surface: python/paddle/distributed/fleet/dataset/dataset.py
+(InMemoryDataset/QueueDataset — re-exported here from
+`distributed.api_extra`) and dataset/index_dataset.py:25 (`TreeIndex` over
+the C++ index wrapper paddle/fluid/distributed/index_dataset/
+index_wrapper.h, layerwise negative sampler index_sampler.h:55
+`LayerWiseSampler`).
+
+TPU-native redesign: the reference stores an arbitrary tree in a protobuf
+sidecar and walks it with C++ node pointers. Here the tree is a COMPLETE
+``branch``-ary array tree in code space — children of code ``c`` are
+``c*branch + 1 .. c*branch + branch`` — so every structural query
+(ancestor, layer membership, travel path) is O(1) integer arithmetic on
+numpy arrays and the layerwise sampler draws distinct negatives per layer
+with no pointer chasing. Node embedding ids ARE codes — one consistent id
+space for internal nodes and leaves — and ``emb_size()`` is the dense
+code-space bound (codes of a complete tree, including unused tail codes),
+so the node-embedding table shape is static for XLA regardless of how
+many leaves are live. Leaves additionally carry their original
+``item_id`` for mapping retrieval scores back to items.
+"""
+import numpy as np
+
+from ..api_extra import BoxPSDataset, InMemoryDataset, QueueDataset
+
+__all__ = ["InMemoryDataset", "QueueDataset", "BoxPSDataset",
+           "Index", "TreeIndex", "IndexNode"]
+
+
+class Index:
+    def __init__(self, name):
+        self._name = name
+
+
+class IndexNode:
+    """Lightweight node record (reference: proto IndexNode with
+    id/is_leaf/probability). ``id == code`` for every node (the one
+    embedding-id space); leaves also carry ``item_id``."""
+
+    __slots__ = ("id", "code", "is_leaf", "item_id", "probability")
+
+    def __init__(self, code, is_leaf, item_id=-1, probability=1.0):
+        self.id = int(code)
+        self.code = int(code)
+        self.is_leaf = bool(is_leaf)
+        self.item_id = int(item_id)
+        self.probability = float(probability)
+
+    def __repr__(self):
+        return (f"IndexNode(code={self.code}, is_leaf={self.is_leaf}, "
+                f"item_id={self.item_id})")
+
+
+class TreeIndex(Index):
+    """Complete branch-ary retrieval tree (reference index_dataset.py:25).
+
+    Construct with `TreeIndex(name, path)` where `path` is an ``.npz``
+    written by `save()`, or build directly from item ids with
+    `TreeIndex.from_items(name, ids, branch=2)`. Leaf order is the order
+    of `ids`. Every node's embedding id is its code (`emb_size()` bounds
+    them densely); map a scored leaf back to its item via
+    `IndexNode.item_id` or `leaf_item_ids()`.
+    """
+
+    def __init__(self, name, path=None):
+        super().__init__(name)
+        self._layerwise_conf = None
+        if path is not None:
+            data = np.load(path, allow_pickle=False)
+            self._init_from(data["ids"], int(data["branch"]))
+
+    @classmethod
+    def from_items(cls, name, ids, branch=2):
+        t = cls(name)
+        t._init_from(np.asarray(ids, np.int64), int(branch))
+        return t
+
+    def _init_from(self, ids, branch):
+        if branch < 2:
+            raise ValueError("branch must be >= 2")
+        ids = np.asarray(ids, np.int64)
+        n = len(ids)
+        if n == 0:
+            raise ValueError("TreeIndex needs at least one item")
+        # height = number of levels; leaves live on level height-1
+        h = 1
+        while branch ** (h - 1) < n:
+            h += 1
+        self._branch = branch
+        self._height = h
+        self._leaf_ids = ids
+        # code arithmetic: first code of level l
+        self._level_first = np.array(
+            [(branch ** l - 1) // (branch - 1) for l in range(h + 1)],
+            np.int64)
+        self._leaf_codes = self._level_first[h - 1] + np.arange(n)
+        self._id_to_code = dict(zip(ids.tolist(), self._leaf_codes.tolist()))
+        # a code exists iff it is an ancestor-or-self of some leaf
+        live = set()
+        for c in self._leaf_codes.tolist():
+            while c not in live:
+                live.add(c)
+                if c == 0:
+                    break
+                c = (c - 1) // branch
+        self._live = live
+        self._total = len(live)
+
+    # -- structural queries (reference index_dataset.py:38-77) ------------
+    def height(self):
+        return self._height
+
+    def branch(self):
+        return self._branch
+
+    def total_node_nums(self):
+        return self._total
+
+    def emb_size(self):
+        """Dense embedding-table bound: one row per code of the complete
+        tree (live-node ids never reach this, unused tail rows are the
+        price of a static table shape)."""
+        return int(self._level_first[self._height])
+
+    def leaf_item_ids(self):
+        """code -> item id for every leaf, in leaf order."""
+        return dict(zip(self._leaf_codes.tolist(), self._leaf_ids.tolist()))
+
+    def _level_of(self, code):
+        lvl = int(np.searchsorted(self._level_first, code, side="right")) - 1
+        return lvl
+
+    def _node(self, code):
+        lvl = self._level_of(code)
+        if lvl == self._height - 1:
+            idx = code - int(self._level_first[self._height - 1])
+            return IndexNode(code, True, item_id=self._leaf_ids[idx])
+        return IndexNode(code, False)
+
+    def get_all_leafs(self):
+        return [self._node(int(c)) for c in self._leaf_codes]
+
+    def get_nodes(self, codes):
+        return [self._node(int(c)) for c in codes]
+
+    def get_layer_codes(self, level):
+        lo, hi = int(self._level_first[level]), int(self._level_first[level + 1])
+        return [c for c in range(lo, hi) if c in self._live]
+
+    def get_travel_codes(self, id, start_level=0):
+        """Leaf-to-`start_level` ancestor chain, leaf first (reference
+        TreeIndex::GetTravelCodes)."""
+        try:
+            c = self._id_to_code[int(id)]
+        except KeyError:
+            raise ValueError(
+                f"unknown item id {id}: not in the tree's leaf set") from None
+        res = []
+        lvl = self._height - 1
+        while lvl >= start_level:
+            res.append(c)
+            c = (c - 1) // self._branch
+            lvl -= 1
+        return res
+
+    def get_ancestor_codes(self, ids, level):
+        out = []
+        for i in ids:
+            try:
+                c = self._id_to_code[int(i)]
+            except KeyError:
+                raise ValueError(
+                    f"unknown item id {i}: get_ancestor_codes (and "
+                    "layerwise_sample with_hierarchy=True) take ITEM ids "
+                    "from the tree's leaf set") from None
+            for _ in range(self._height - 1 - level):
+                c = (c - 1) // self._branch
+            out.append(c)
+        return out
+
+    def get_children_codes(self, ancestor, level):
+        """Descendant codes of `ancestor` at `level` (levels deeper than
+        the ancestor's own)."""
+        lvl = self._level_of(ancestor)
+        lo, hi = ancestor, ancestor
+        for _ in range(level - lvl):
+            lo = lo * self._branch + 1
+            hi = hi * self._branch + self._branch
+        return [c for c in range(lo, hi + 1) if c in self._live]
+
+    def get_travel_path(self, child, ancestor):
+        res = []
+        while child > ancestor:
+            res.append(child)
+            child = (child - 1) // self._branch
+        return res
+
+    def get_pi_relation(self, ids, level):
+        return dict(zip(ids, self.get_ancestor_codes(ids, level)))
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path):
+        np.savez(path, ids=self._leaf_ids, branch=np.int64(self._branch))
+
+    # -- layerwise negative sampling (index_sampler.h:55) -----------------
+    def init_layerwise_sampler(self, layer_sample_counts,
+                               start_sample_layer=1, seed=0):
+        if self._layerwise_conf is not None:
+            raise AssertionError("layerwise sampler already initialized")
+        if not (0 < start_sample_layer < self._height):
+            raise ValueError(
+                f"start_sample_layer must be in (0, {self._height})")
+        counts, i, cur = [], 0, start_sample_layer
+        while cur < self._height:
+            counts.append(layer_sample_counts[i]
+                          if i < len(layer_sample_counts) else 1)
+            cur += 1
+            i += 1
+        layer_nodes = [np.array(self.get_layer_codes(l), np.int64)
+                       for l in range(start_sample_layer, self._height)]
+        self._layerwise_conf = (counts, start_sample_layer, layer_nodes,
+                                np.random.default_rng(seed))
+
+    def layerwise_sample(self, user_input, index_input, with_hierarchy=False):
+        """For each (user features, target item): one positive row per layer
+        (the target's ancestor, label 1) + `layer_sample_counts[l]` uniform
+        negatives from the same layer (label 0). `with_hierarchy` maps the
+        user's item-id features to their ancestors at each layer too.
+        Returns rows shaped ``user_feats + [node_id, label]``."""
+        if self._layerwise_conf is None:
+            raise ValueError("please init layerwise_sampler first.")
+        counts, start, layer_nodes, rng = self._layerwise_conf
+        out = []
+        for feats, target in zip(user_input, index_input):
+            travel = self.get_travel_codes(int(target), start)
+            if with_hierarchy:
+                # one leaf-to-start walk per feature, indexed per layer
+                # (at the leaf layer the "ancestor" is the leaf code
+                # itself — node ids are codes in EVERY row this emits)
+                feat_travel = [self.get_travel_codes(int(f), start)
+                               for f in feats]
+            # travel is leaf-first; walk top-down over sample layers
+            for li, lvl in enumerate(range(start, self._height)):
+                pos_code = travel[self._height - 1 - lvl]
+                nodes = layer_nodes[li]
+                u = feats
+                if with_hierarchy:
+                    u = [ft[self._height - 1 - lvl] for ft in feat_travel]
+                out.append(list(u) + [pos_code, 1])
+                k = counts[li]
+                if len(nodes) > 1 and k > 0:
+                    # distinct negatives; a thin layer yields fewer than k
+                    # rather than duplicating (index_sampler.h draws with
+                    # replacement — distinct is strictly better here)
+                    cand = nodes[nodes != pos_code]
+                    neg = (cand if len(cand) <= k
+                           else rng.choice(cand, size=k, replace=False))
+                    for nc in np.atleast_1d(neg):
+                        out.append(list(u) + [int(nc), 0])
+        return out

@@ -10,7 +10,8 @@ import os
 import shutil
 import subprocess
 
-__all__ = ["LocalFS", "HDFSClient", "recompute", "DistributedInfer",
+__all__ = ["HybridParallelInferenceHelper",
+           "LocalFS", "HDFSClient", "recompute", "DistributedInfer",
            "ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
            "FSTimeOut"]
 
@@ -230,3 +231,6 @@ class DistributedInfer:
 
     def get_dist_infer_program(self):
         return self._main
+
+
+from .hybrid_parallel_inference import HybridParallelInferenceHelper  # noqa: E402,F401

@@ -1,0 +1,194 @@
+"""fleet.data_generator wire protocol, TreeIndex structure + layerwise
+sampling, and the hybrid-parallel inference helper (single-`pp` path here;
+the multi-stage path runs in the dryrun's virtual mesh)."""
+import io
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.data_generator import (
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator, parse_multi_slot)
+from paddle_tpu.distributed.fleet.dataset import TreeIndex
+
+
+class _CtrGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def local_iter():
+            toks = line.split()
+            yield [("words", [int(t) for t in toks[:-1]]),
+                   ("label", [int(toks[-1])])]
+        return local_iter
+
+
+def test_multislot_wire_roundtrip():
+    gen = _CtrGen()
+    gen.set_batch(2)
+    out = io.StringIO()
+    gen.run_from_stdin(inp=["11 22 33 1", "44 55 0"], out=out)
+    text = out.getvalue()
+    assert text.splitlines() == ["3 11 22 33 1 1", "2 44 55 1 0"]
+    rows = parse_multi_slot(text, 2)
+    assert rows == [[[11, 22, 33], [1]], [[44, 55], [0]]]
+    assert gen._proto_info == [("words", "uint64"), ("label", "uint64")]
+
+
+def test_multislot_type_upgrade_and_errors():
+    gen = MultiSlotDataGenerator()
+    gen._gen_str([("a", [1]), ("b", [2])])
+    # float upgrades the pinned slot type
+    gen._gen_str([("a", [1.5]), ("b", [3])])
+    assert gen._proto_info[0] == ("a", "float")
+    with pytest.raises(ValueError):  # name mismatch
+        gen._gen_str([("x", [1]), ("b", [2])])
+    with pytest.raises(ValueError):  # arity mismatch
+        gen._gen_str([("a", [1])])
+    with pytest.raises(ValueError):  # empty slot
+        gen._gen_str([("a", []), ("b", [1])])
+
+
+def test_string_generator_and_parse_errors():
+    gen = MultiSlotStringDataGenerator()
+    assert gen._gen_str([("q", ["ab", "cd"]), ("l", ["1"])]) == "2 ab cd 1 1\n"
+    with pytest.raises(ValueError):
+        parse_multi_slot("3 1 2\n", 1)  # truncated
+    with pytest.raises(ValueError):
+        parse_multi_slot("1 5 1 7\n", 1)  # trailing tokens
+
+
+def test_tree_index_structure():
+    ids = [100, 101, 102, 103, 104]
+    t = TreeIndex.from_items("tdm", ids, branch=2)
+    assert t.height() == 4  # 2^3 = 8 >= 5 leaves
+    # emb_size is the dense code-space bound: >= live nodes, and every
+    # node id (== code) indexes inside it
+    assert t.emb_size() >= t.total_node_nums()
+    leafs = t.get_all_leafs()
+    assert [n.item_id for n in leafs] == ids
+    assert all(n.is_leaf and n.id == n.code < t.emb_size() for n in leafs)
+    assert t.leaf_item_ids()[leafs[0].code] == 100
+    # root is code 0 and an ancestor of everything
+    assert t.get_ancestor_codes([104], 0) == [0]
+    travel = t.get_travel_codes(100)
+    assert len(travel) == t.height() and travel[-1] == 0
+    # parent arithmetic consistent with travel path
+    leaf_code = travel[0]
+    assert t.get_travel_path(leaf_code, 0) == travel[:-1]
+    # layer codes partition the live nodes
+    total = sum(len(t.get_layer_codes(l)) for l in range(t.height()))
+    assert total == t.total_node_nums()
+    # children_codes inverts ancestor relation
+    kids = t.get_children_codes(0, t.height() - 1)
+    assert sorted(kids) == sorted(t.get_travel_codes(i)[0] for i in ids)
+
+
+def test_tree_index_save_load(tmp_path):
+    t = TreeIndex.from_items("x", [7, 8, 9], branch=3)
+    p = str(tmp_path / "tree.npz")
+    t.save(p)
+    t2 = TreeIndex("x", p)
+    assert t2.height() == t.height()
+    assert [n.item_id for n in t2.get_all_leafs()] == [7, 8, 9]
+
+
+def test_layerwise_sample_labels_and_layers():
+    ids = list(range(200, 216))  # 16 leaves, branch 2 -> height 5
+    t = TreeIndex.from_items("tdm", ids, branch=2)
+    t.init_layerwise_sampler([2, 2, 2, 2], start_sample_layer=1, seed=3)
+    rows = t.layerwise_sample([[1, 2]], [207], with_hierarchy=False)
+    # per layer: 1 positive + <=2 negatives over layers 1..4
+    pos = [r for r in rows if r[-1] == 1]
+    neg = [r for r in rows if r[-1] == 0]
+    assert len(pos) == t.height() - 1
+    assert all(r[:2] == [1, 2] for r in rows)
+    # leaf-layer positive is the target item's leaf node (id == code)
+    assert pos[-1][2] == t.get_travel_codes(207)[0]
+    assert len(neg) > 0
+    # all emitted node ids index inside the dense embedding table
+    assert all(0 <= r[2] < t.emb_size() for r in rows)
+    # distinct negatives per layer, never colliding with that layer's
+    # positive, never exceeding the configured count
+    for lvl in range(1, t.height()):
+        layer = set(t.get_layer_codes(lvl))
+        lneg = [r[2] for r in neg if r[2] in layer]
+        lpos = [r[2] for r in pos if r[2] in layer]
+        assert len(lneg) == len(set(lneg)) <= 2
+        assert not set(lneg) & set(lpos)
+    with pytest.raises(ValueError):
+        TreeIndex.from_items("y", [1, 2]).layerwise_sample([[1]], [1])
+
+
+def test_layerwise_thin_layer_takes_all_distinct():
+    # 2 leaves, branch 2: every layer has exactly 2 nodes -> 1 candidate
+    # negative; asking for 5 must yield exactly 1, not duplicates
+    t = TreeIndex.from_items("thin", [10, 11], branch=2)
+    t.init_layerwise_sampler([5], start_sample_layer=1, seed=0)
+    rows = t.layerwise_sample([[0]], [10])
+    neg = [r for r in rows if r[-1] == 0]
+    assert len(neg) == 1
+
+
+def test_parse_multi_slot_nan_inf_roundtrip():
+    gen = MultiSlotDataGenerator()
+    line = gen._gen_str([("s", [float("nan"), float("inf"), 2.0e5])])
+    rows = parse_multi_slot(line, 1)
+    vals = rows[0][0]
+    assert np.isnan(vals[0]) and np.isinf(vals[1]) and vals[2] == 2.0e5
+
+
+def test_hybrid_parallel_inference_single_stage():
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.fleet.utils import (
+        HybridParallelInferenceHelper)
+
+    w = jnp.eye(4) * 2.0
+    helper = HybridParallelInferenceHelper(
+        block_fn=lambda p, x: x @ p, stacked_params=w,
+        head_fn=lambda x, post: x + post, post_params=jnp.ones(4),
+        micro_batches=2)
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    out = np.asarray(helper.forward(x))
+    np.testing.assert_allclose(out, x @ np.eye(4) * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_hybrid_parallel_inference_pipelined_parity():
+    """4-stage pipelined forward == serial stage composition."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.utils import (
+        HybridParallelInferenceHelper)
+
+    mesh_mod.init_mesh(pp=4, dp=2)
+    try:
+        rng = np.random.default_rng(0)
+        stacked = jnp.asarray(rng.normal(size=(4, 6, 6)).astype(np.float32))
+        post = jnp.asarray(rng.normal(size=(6,)).astype(np.float32))
+        # block_fn sees this stage's slice WITH the leading layer axis
+        # (1 layer per stage here), same contract as pipeline_1f1b
+        block = lambda p, x: jnp.tanh(x @ p[0])
+        helper = HybridParallelInferenceHelper(
+            block_fn=block, stacked_params=stacked,
+            head_fn=lambda x, p: x * p, post_params=post, micro_batches=4)
+        x = rng.normal(size=(8, 6)).astype(np.float32)
+        got = np.asarray(helper.forward(x))
+        ref = x.astype(np.float64)
+        for s in range(4):
+            ref = np.tanh(ref @ np.asarray(stacked[s], np.float64))
+        ref = ref * np.asarray(post)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    finally:
+        mesh_mod.init_mesh(dp=8)
+
+
+def test_layerwise_with_hierarchy_stays_in_code_space():
+    ids = list(range(200, 216))
+    t = TreeIndex.from_items("tdm", ids, branch=2)
+    t.init_layerwise_sampler([1] * 4, start_sample_layer=1, seed=0)
+    rows = t.layerwise_sample([[200, 201]], [207], with_hierarchy=True)
+    # EVERY column of every row (user feats + node) must be a code inside
+    # the dense embedding table — including the leaf layer, where the
+    # "ancestor" of a user item is its own leaf code, never the item id
+    for r in rows:
+        assert all(0 <= c < t.emb_size() for c in r[:-1]), r
+    leaf_codes = {n.code for n in t.get_all_leafs()}
+    leaf_rows = [r for r in rows if r[-2] in leaf_codes]
+    assert leaf_rows and all(r[0] in leaf_codes for r in leaf_rows)
