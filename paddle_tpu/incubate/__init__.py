@@ -7,6 +7,7 @@ from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from .checkpoint import auto_checkpoint  # noqa: F401
 from .optimizer import DistributedFusedLamb  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,132 @@
+"""incubate.multiprocessing — pass Tensors through multiprocessing zero-pickle.
+
+Reference: python/paddle/incubate/multiprocessing/__init__.py +
+reductions.py:183 (`init_reductions` registers ForkingPickler reducers so
+tensors travel through mp queues as CUDA-IPC handles / mmap'd files
+instead of pickled byte copies).
+
+TPU-native redesign: device memory on TPU is not host-mappable, so there
+is no IPC-handle analog — the host-side value is the unit of sharing.
+`reduce` stages the tensor's host array into a POSIX shared-memory
+segment (`multiprocessing.shared_memory`, the file_system strategy the
+reference supports) and ships only ``(name, shape, dtype)``; `rebuild`
+maps the segment in the consumer. This feeds the same worker-pool design
+as `io/multiprocess.py`'s DataLoader transport but for arbitrary user
+Tensors through `Queue`/`Pipe`.
+
+Segment lifetime — ownership transfer, single consumer:
+- the producer copies, CLOSES its mapping, and unregisters the segment
+  from its resource tracker (it no longer owns cleanup; pattern from
+  io/multiprocess.py `_ship`). Producer-side Tensor lifetime is
+  irrelevant — ``q.put(to_tensor(x))`` with a temporary is safe.
+- the FIRST consumer to rebuild owns the segment and unlinks it when the
+  rebuilt Tensor is garbage-collected.
+- segments never consumed are reclaimed by the producer's atexit sweep.
+  The sweep cannot tell "never consumed" from "consumer not yet mapped",
+  so a consumer that first maps AFTER the producer process exited loses
+  the data; set ``PADDLE_TPU_MP_PERSIST=1`` in the producer to skip the
+  sweep for such decoupled pipelines (segments then outlive the job
+  unless the consumer maps and unlinks them).
+"""
+import atexit
+import multiprocessing
+import os
+import weakref
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+__all__ = list(getattr(multiprocessing, "__all__", [])) + [
+    "init_reductions"]
+
+_shipped_names = set()  # names this process created and has not swept
+
+
+def _cleanup_shipped_segments():
+    if os.environ.get("PADDLE_TPU_MP_PERSIST"):
+        return
+    for name in list(_shipped_names):
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass  # consumed — the consumer unlinked it
+    _shipped_names.clear()
+
+
+atexit.register(_cleanup_shipped_segments)
+
+
+def _dtype_name(dtype):
+    # np.dtype.str is lossy for ml_dtypes (bfloat16 -> '<V2'); the NAME
+    # round-trips through _lookup_dtype
+    return dtype.name
+
+
+def _lookup_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _consumer_release(shm):
+    try:
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _rebuild_tensor(name, shape, dtype_name, stop_gradient):
+    from ... import to_tensor
+
+    shm = shared_memory.SharedMemory(name=name)
+    arr = np.ndarray(shape, dtype=_lookup_dtype(dtype_name), buffer=shm.buf)
+    t = to_tensor(arr, stop_gradient=stop_gradient)
+    del arr  # view over shm.buf must die before the segment can close
+    # the consumer now owns the segment: close + unlink when its Tensor
+    # dies (unlink with another process's mapping still open is fine —
+    # POSIX keeps the memory until the last fd closes)
+    weakref.finalize(t, _consumer_release, shm)
+    return t
+
+
+def _reduce_tensor(tensor):
+    arr = np.ascontiguousarray(tensor.numpy())
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+    dst[...] = arr
+    del dst
+    name = shm.name
+    shm.close()  # producer holds no mapping — no memory pinned here
+    try:
+        from multiprocessing import resource_tracker
+
+        # cleanup responsibility moves to the consumer / atexit sweep
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+    _shipped_names.add(name)
+    return (_rebuild_tensor,
+            (name, arr.shape, _dtype_name(arr.dtype),
+             bool(getattr(tensor, "stop_gradient", True))))
+
+
+def init_reductions():
+    """Register the Tensor reducer on ForkingPickler (reference
+    reductions.py:183). Idempotent."""
+    from ...tensor_core import Tensor
+
+    ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+try:
+    init_reductions()
+except ImportError:  # pragma: no cover — partial-package import orders
+    pass

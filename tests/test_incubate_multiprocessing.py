@@ -1,0 +1,103 @@
+"""incubate.multiprocessing: Tensors cross process boundaries via shared
+memory, not pickled copies (reference incubate/multiprocessing)."""
+import multiprocessing as std_mp
+
+import numpy as np
+import pytest
+
+
+def _child(q_in, q_out):
+    # child re-registers reductions on import
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.incubate import multiprocessing as pmp  # noqa: F401
+
+    t = q_in.get(timeout=60)
+    q_out.put(float(np.asarray(t.numpy()).sum()))
+
+
+@pytest.mark.slow
+def test_tensor_through_queue_roundtrip():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate import multiprocessing as pmp  # noqa: F401
+
+    ctx = std_mp.get_context("spawn")
+    q_in, q_out = ctx.Queue(), ctx.Queue()
+    p = ctx.Process(target=_child, args=(q_in, q_out), daemon=True)
+    p.start()
+    try:
+        arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+        t = paddle.to_tensor(arr)
+        q_in.put(t)
+        got = q_out.get(timeout=120)
+        assert got == float(arr.sum())
+    finally:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+
+
+def test_reduce_rebuild_in_process():
+    """The reducer round-trips in-process too (same-interpreter rebuild)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.multiprocessing import (
+        _rebuild_tensor, _reduce_tensor)
+
+    arr = np.linspace(0, 1, 12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor(arr)
+    fn, args = _reduce_tensor(t)
+    assert fn is _rebuild_tensor
+    t2 = fn(*args)
+    np.testing.assert_array_equal(np.asarray(t2.numpy()), arr)
+    name = args[0]
+    # producer dropping ITS tensor must not kill the segment (sent
+    # temporaries die before the consumer maps)
+    import gc
+
+    del t
+    gc.collect()
+    from multiprocessing import shared_memory
+
+    seg = shared_memory.SharedMemory(name=name)  # still alive
+    seg.close()
+    # consumer GC owns the unlink
+    del t2
+    gc.collect()
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=name)
+
+
+def test_bfloat16_roundtrip():
+    """bf16 is the flagship dtype on TPU — dtype must survive the wire
+    (np.dtype.str collapses ml_dtypes to raw void)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.multiprocessing import (
+        _rebuild_tensor, _reduce_tensor)
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         dtype="bfloat16")
+    fn, args = _reduce_tensor(t)
+    t2 = fn(*args)
+    assert str(t2.numpy().dtype) == "bfloat16"
+    np.testing.assert_array_equal(t2.numpy().astype(np.float32),
+                                  t.numpy().astype(np.float32))
+
+
+def test_unconsumed_segments_swept():
+    import gc
+
+    import paddle_tpu as paddle
+    from multiprocessing import shared_memory
+    from paddle_tpu.incubate.multiprocessing import (
+        _cleanup_shipped_segments, _reduce_tensor, _shipped_names)
+
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    _, args = _reduce_tensor(t)  # shipped, never consumed
+    name = args[0]
+    assert name in _shipped_names
+    _cleanup_shipped_segments()
+    gc.collect()
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=name)
+    assert name not in _shipped_names
