@@ -6,6 +6,7 @@ names mesh axes; parallelism = placement (see SURVEY.md §7 design map).
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import collective  # noqa: F401
+from . import coordinator  # noqa: F401
 from . import env  # noqa: F401
 from . import mesh  # noqa: F401
 from . import moe  # noqa: F401

@@ -1,0 +1,200 @@
+"""FL-PS coordinator — federated client selection over the coordination KV.
+
+Reference: python/paddle/distributed/ps/coordinator.py (ClientInfoAttr:35,
+FLStrategy:42, ClientSelector:78, FLClient:188, Coordinator:334) — there a
+brpc `FLCommunicator` carries protobuf FLClientInfo/FLStrategy messages
+between trainers and a coordinator process.
+
+TPU-native redesign: the transport is the job's existing coordination
+service (`jax.distributed` KV, the same store `xproc.py` p2p rides), so
+no brpc service or proto schema — client info and strategies are JSON
+values under round-scoped keys:
+
+    pt_fl/info/<round>/<rank>       client -> coordinator
+    pt_fl/strategy/<round>/<rank>   coordinator -> client
+
+Both sides advance rounds in lockstep; blocking gets give the barrier
+semantics the reference gets from its `query_fl_clients_info` block. The
+reference's selector is an unimplemented stub ("... to implement ...",
+coordinator.py:89) that always emits JOIN — here selection is real:
+bandwidth/sample-weighted sampling of a configurable fraction per round.
+"""
+import json
+import random
+
+import jax
+
+__all__ = ["ClientInfoAttr", "FLStrategy", "ClientSelectorBase",
+           "ClientSelector", "FLClient", "Coordinator"]
+
+
+class ClientInfoAttr:
+    CLIENT_ID = "client_id"
+    DEVICE_TYPE = "device_type"
+    COMPUTE_CAPACITY = "compute_capacity"
+    BANDWIDTH = "bandwidth"
+    SAMPLE_NUM = "sample_num"
+
+
+class FLStrategy:
+    JOIN = "JOIN"
+    WAIT = "WAIT"
+    FINISH = "FINISH"
+
+
+def _kv():
+    from .xproc import _kv_client
+
+    return _kv_client()
+
+
+class ClientSelectorBase:
+    def __init__(self, clients_info):
+        self.clients_info = clients_info
+        self.fl_strategy = {}
+
+    def select(self):
+        raise NotImplementedError
+
+
+class ClientSelector(ClientSelectorBase):
+    """Pick `fraction` of reporting clients per round, weighted by
+    sample count (FedAvg-style client sampling); everyone else WAITs."""
+
+    def __init__(self, clients_info, fraction=1.0, min_clients=1, seed=0,
+                 rng=None):
+        super().__init__(clients_info)
+        self.fraction = fraction
+        self.min_clients = min_clients
+        # pass a shared `rng` when constructing a selector per round —
+        # a fresh Random(seed) every round picks the SAME subset forever
+        self._rng = rng if rng is not None else random.Random(seed)
+
+    def select(self):
+        ids = sorted(self.clients_info)
+        k = max(self.min_clients, int(round(len(ids) * self.fraction)))
+        k = min(k, len(ids))
+        weights = [max(float(self.clients_info[i].get(
+            ClientInfoAttr.SAMPLE_NUM, 1)), 1e-9) for i in ids]
+        chosen = set()
+        pool, w = list(ids), list(weights)
+        for _ in range(k):
+            pick = self._rng.choices(range(len(pool)), weights=w)[0]
+            chosen.add(pool.pop(pick))
+            w.pop(pick)
+        self.fl_strategy = {
+            i: {"next_state": FLStrategy.JOIN if i in chosen
+                else FLStrategy.WAIT}
+            for i in ids}
+        return self.fl_strategy
+
+
+class Coordinator:
+    """Round-loop driver on one process (reference Coordinator:334)."""
+
+    def __init__(self, trainer_ranks, selector=None, seed=0):
+        self.trainer_ranks = list(trainer_ranks)
+        self._rng = random.Random(seed)  # ONE stream across all rounds
+        self.selector_factory = selector or (
+            lambda info: ClientSelector(info, rng=self._rng))
+        self._round = 0
+
+    def start_coordinator(self):
+        pass  # transport is the already-running coordination service
+
+    def query_fl_clients_info(self, timeout_ms=120_000):
+        """Block until every trainer has reported this round's info."""
+        kv = _kv()
+        infos = {}
+        for r in self.trainer_ranks:
+            key = f"pt_fl/info/{self._round}/{r}"
+            infos[r] = json.loads(kv.blocking_key_value_get(key, timeout_ms))
+            # consumed — delete or an unbounded round loop grows the
+            # coordination store without limit (xproc.py pt_p2p pattern)
+            try:
+                kv.key_value_delete(key)
+            except Exception:
+                pass
+        return infos
+
+    def save_fl_strategy(self, fl_strategy):
+        kv = _kv()
+        for r in self.trainer_ranks:
+            kv.key_value_set(
+                f"pt_fl/strategy/{self._round}/{r}",
+                json.dumps(fl_strategy.get(
+                    r, {"next_state": FLStrategy.WAIT})))
+        self._round += 1
+
+    def make_fl_strategy(self, max_rounds=None):
+        """The reference loops forever (coordinator.py:344); bounded here
+        so jobs can finish — emits FINISH to every client on the last
+        round."""
+        n = 0
+        while max_rounds is None or n < max_rounds:
+            infos = self.query_fl_clients_info()
+            sel = self.selector_factory(infos)
+            strategy = sel.select()
+            self.save_fl_strategy(strategy)
+            n += 1
+        # consume (and delete) the final round's reports — pure barrier +
+        # store cleanup; FINISH goes to everyone regardless
+        self.query_fl_clients_info()
+        self.save_fl_strategy(
+            {r: {"next_state": FLStrategy.FINISH}
+             for r in self.trainer_ranks})
+
+
+class FLClient:
+    """Trainer-side FL loop (reference FLClient:188): push state, pull
+    strategy, dispatch the registered handler for the strategy type."""
+
+    def __init__(self, rank=None):
+        self.rank = jax.process_index() if rank is None else rank
+        self._round = 0
+        self._handlers = {}
+        self.strategy_handlers = self._handlers  # reference attr name
+
+    # -- wire ------------------------------------------------------------
+    def push_fl_client_info_sync(self, state_info):
+        info = {ClientInfoAttr.CLIENT_ID: self.rank}
+        info.update(state_info or {})
+        _kv().key_value_set(
+            f"pt_fl/info/{self._round}/{self.rank}", json.dumps(info))
+
+    def pull_fl_strategy(self, timeout_ms=120_000):
+        kv = _kv()
+        key = f"pt_fl/strategy/{self._round}/{self.rank}"
+        raw = kv.blocking_key_value_get(key, timeout_ms)
+        try:
+            kv.key_value_delete(key)
+        except Exception:
+            pass
+        self._round += 1
+        return json.loads(raw)
+
+    # -- handlers (reference register_handlers:258) -----------------------
+    def register_handlers(self, strategy_type, callback_func):
+        self._handlers[strategy_type] = callback_func
+
+    def register_default_handlers(self):
+        self._handlers.setdefault(FLStrategy.JOIN, lambda s: None)
+        self._handlers.setdefault(FLStrategy.WAIT, lambda s: None)
+        self._handlers.setdefault(FLStrategy.FINISH, lambda s: None)
+
+    def run(self, state_fn=None, max_rounds=None):
+        """Reference FLClient.run:208 — the push/pull/dispatch loop.
+        `state_fn(round) -> dict` supplies per-round client info."""
+        self.register_default_handlers()
+        n = 0
+        while max_rounds is None or n <= max_rounds:
+            self.push_fl_client_info_sync(
+                state_fn(self._round) if state_fn else {})
+            strategy = self.pull_fl_strategy()
+            state = strategy.get("next_state", FLStrategy.WAIT)
+            handler = self._handlers.get(state)
+            if handler is not None:
+                handler(strategy)
+            if state == FLStrategy.FINISH:
+                return
+            n += 1

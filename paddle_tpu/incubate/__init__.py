@@ -7,7 +7,10 @@ from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
-from . import multiprocessing  # noqa: F401
+# NOTE: incubate.multiprocessing is intentionally NOT imported eagerly —
+# importing it registers shm reducers on ForkingPickler, changing Tensor
+# pickling semantics process-wide (single-consumer ownership transfer).
+# Like the reference, users opt in: `import paddle.incubate.multiprocessing`.
 from .checkpoint import auto_checkpoint  # noqa: F401
 from .optimizer import DistributedFusedLamb  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

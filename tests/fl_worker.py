@@ -1,0 +1,54 @@
+"""Worker for test_fl_coordinator.py: rank 0 = coordinator, rest = clients."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.coordinator import (  # noqa: E402
+    ClientInfoAttr, ClientSelector, Coordinator, FLClient, FLStrategy)
+
+
+def main():
+    out_dir = sys.argv[1]
+    rounds = int(sys.argv[2])
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    trainer_ranks = list(range(1, world))
+
+    if rank == 0:
+        import random
+
+        rng = random.Random(3)  # ONE stream shared by per-round selectors
+        coord = Coordinator(
+            trainer_ranks,
+            selector=lambda info: ClientSelector(
+                info, fraction=0.5, min_clients=1, rng=rng))
+        coord.start_coordinator()
+        coord.make_fl_strategy(max_rounds=rounds)
+        record = {"role": "coordinator", "rounds": rounds}
+    else:
+        client = FLClient()
+        log = {"join": 0, "wait": 0, "finished": False}
+        client.register_handlers(
+            FLStrategy.JOIN,
+            lambda s: log.__setitem__("join", log["join"] + 1))
+        client.register_handlers(
+            FLStrategy.WAIT,
+            lambda s: log.__setitem__("wait", log["wait"] + 1))
+        client.register_handlers(
+            FLStrategy.FINISH,
+            lambda s: log.__setitem__("finished", True))
+        client.run(state_fn=lambda r: {
+            ClientInfoAttr.SAMPLE_NUM: 100 * rank,
+            ClientInfoAttr.DEVICE_TYPE: "tpu"})
+        record = {"role": "client", **log}
+
+    with open(os.path.join(out_dir, f"fl_{rank}.json"), "w") as f:
+        json.dump(record, f)
+
+
+if __name__ == "__main__":
+    main()
