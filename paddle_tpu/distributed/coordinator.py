@@ -92,18 +92,24 @@ class ClientSelector(ClientSelectorBase):
 class Coordinator:
     """Round-loop driver on one process (reference Coordinator:334)."""
 
-    def __init__(self, trainer_ranks, selector=None, seed=0):
+    def __init__(self, trainer_ranks, selector=None, seed=0,
+                 timeout_ms=600_000):
         self.trainer_ranks = list(trainer_ranks)
         self._rng = random.Random(seed)  # ONE stream across all rounds
         self.selector_factory = selector or (
             lambda info: ClientSelector(info, rng=self._rng))
         self._round = 0
+        # bound on ONE training round (clients report between rounds) —
+        # must exceed the slowest client's round time or the blocking
+        # get raises and kills the coordinator
+        self.timeout_ms = timeout_ms
 
     def start_coordinator(self):
         pass  # transport is the already-running coordination service
 
-    def query_fl_clients_info(self, timeout_ms=120_000):
+    def query_fl_clients_info(self, timeout_ms=None):
         """Block until every trainer has reported this round's info."""
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
         kv = _kv()
         infos = {}
         for r in self.trainer_ranks:
@@ -149,11 +155,13 @@ class FLClient:
     """Trainer-side FL loop (reference FLClient:188): push state, pull
     strategy, dispatch the registered handler for the strategy type."""
 
-    def __init__(self, rank=None):
+    def __init__(self, rank=None, timeout_ms=600_000):
         self.rank = jax.process_index() if rank is None else rank
         self._round = 0
         self._handlers = {}
         self.strategy_handlers = self._handlers  # reference attr name
+        # how long to wait for the coordinator's strategy each round
+        self.timeout_ms = timeout_ms
 
     # -- wire ------------------------------------------------------------
     def push_fl_client_info_sync(self, state_info):
@@ -162,7 +170,8 @@ class FLClient:
         _kv().key_value_set(
             f"pt_fl/info/{self._round}/{self.rank}", json.dumps(info))
 
-    def pull_fl_strategy(self, timeout_ms=120_000):
+    def pull_fl_strategy(self, timeout_ms=None):
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
         kv = _kv()
         key = f"pt_fl/strategy/{self._round}/{self.rank}"
         raw = kv.blocking_key_value_get(key, timeout_ms)
