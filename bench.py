@@ -312,8 +312,10 @@ def main():
         status, _ = _run_worker("probe", timeout_s=min(150, remaining))
         if status == "ok":
             remaining = GPT_DEADLINE_S - (time.monotonic() - t_start)
-            status, gpt = _run_worker(
-                "gpt", timeout_s=max(60, min(900, remaining)))
+            if remaining < 60:  # probe ate the window — keep the bound
+                log("[bench] gpt deadline exhausted")
+                break
+            status, gpt = _run_worker("gpt", timeout_s=min(900, remaining))
             if status == "ok":
                 break
             log(f"[bench] gpt attempt {attempt} -> {status}")

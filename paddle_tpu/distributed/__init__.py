@@ -7,6 +7,7 @@ from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import collective  # noqa: F401
 from . import coordinator  # noqa: F401
+from . import metric  # noqa: F401
 from . import env  # noqa: F401
 from . import mesh  # noqa: F401
 from . import moe  # noqa: F401

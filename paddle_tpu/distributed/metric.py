@@ -1,0 +1,104 @@
+"""distributed.metric — globally-reduced training metrics (AUC family).
+
+Reference: python/paddle/distributed/metric/metrics.py (init_metric:26 /
+print_metric:102 / print_auc:120 — a YAML-configured driver over the C++
+fleet metric calculators, framework/fleet/metrics.cc, whose global AUC
+all-reduces per-bucket positive/negative histograms over gloo).
+
+TPU-native redesign: the calculator is `metric.Auc`'s bucket estimator
+(identical math to the C++ one); globalization is one `all_reduce` of the
+two histograms over the trainer processes (`xproc.all_reduce_np` — the
+gloo-analog eager path), so the YAML "monitors" config reduces to
+constructing DistributedAuc instances. Single-process jobs work too: the
+all-reduce degrades to identity.
+"""
+import numpy as np
+
+from ..metric import Auc
+from . import xproc
+
+__all__ = ["DistributedAuc", "init_metric", "print_metric", "print_auc"]
+
+
+class DistributedAuc(Auc):
+    """Bucketed AUC whose accumulate() folds in every trainer's buckets
+    (reference metrics.cc GlobalAuc). Carries the monitor `phase`
+    (JOINING/UPDATING) from the YAML config for phase-filtered printing."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 phase="all"):
+        super().__init__(curve=curve, num_thresholds=num_thresholds,
+                         name=name)
+        self.phase = phase
+
+    def accumulate(self):
+        if xproc.is_multiprocess():
+            # host-side exact merge: bucket counts are integers and a
+            # device all-reduce would canonicalize float64→float32 with
+            # x64 off, rounding counts past 2^24 on long CTR runs
+            shards = xproc.all_gather_obj(
+                (self._stat_pos.astype(np.int64),
+                 self._stat_neg.astype(np.int64)))
+            saved = self._stat_pos, self._stat_neg
+            self._stat_pos = np.sum([p for p, _ in shards], axis=0,
+                                    dtype=np.float64)
+            self._stat_neg = np.sum([n for _, n in shards], axis=0,
+                                    dtype=np.float64)
+            try:
+                return super().accumulate()
+            finally:
+                self._stat_pos, self._stat_neg = saved
+        return super().accumulate()
+
+
+_METRICS = {}
+
+
+def init_metric(metric_ptr=None, metric_yaml_path=None, bucket_size=4095,
+                **_compat):
+    """Build the monitor registry from a YAML config of the reference
+    shape (``monitors: [{name, method, label, target, phase}, ...]``,
+    reference metrics.py:26). `metric_ptr` (the C++ handle) has no TPU
+    analog and is ignored; calculators land in a module registry keyed
+    by name for `print_metric`/`print_auc`."""
+    import yaml
+
+    with open(metric_yaml_path) as f:
+        content = yaml.safe_load(f)
+    monitors = content.get("monitors") or []
+    for runner in monitors:  # validate everything BEFORE registering any
+        method = runner.get("method", "AucCalculator")
+        if method not in ("AucCalculator", "MultiTaskAucCalculator",
+                          "CmatchRankAucCalculator", "MaskAucCalculator"):
+            raise ValueError(f"unsupported metric method {method}")
+    _METRICS.clear()  # a new config replaces the registry, never mixes
+    for runner in monitors:
+        name = runner["name"]
+        _METRICS[name] = DistributedAuc(num_thresholds=bucket_size,
+                                        name=name,
+                                        phase=runner.get("phase", "all"))
+    return _METRICS
+
+
+def get_metric(name):
+    return _METRICS[name]
+
+
+def print_metric(metric_ptr_or_name, name=None):
+    """Reference metrics.py:102 — format one metric's current value."""
+    name = metric_ptr_or_name if name is None else name
+    m = _METRICS[name]
+    msg = f"{name}: AUC={m.accumulate():.6f}"
+    print(msg)
+    return msg
+
+
+def print_auc(metric_ptr_or_is_day=None, is_day=False, phase="all"):
+    """Reference metrics.py:120 — print the registered AUC monitors,
+    filtered to `phase` ('JOINING'/'UPDATING'; 'all' prints everything)."""
+    out = []
+    for name in sorted(_METRICS):
+        if phase != "all" and _METRICS[name].phase != phase:
+            continue
+        out.append(print_metric(name))
+    return "\n".join(out)

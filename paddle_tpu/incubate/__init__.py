@@ -7,6 +7,7 @@ from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import distributed  # noqa: F401
 # NOTE: incubate.multiprocessing is intentionally NOT imported eagerly —
 # importing it registers shm reducers on ForkingPickler, changing Tensor
 # pickling semantics process-wide (single-consumer ownership transfer).

@@ -1,0 +1,13 @@
+"""incubate.distributed — reference namespace home of MoE models + old fleet
+(reference: python/paddle/incubate/distributed/{models/moe,fleet}). The
+implementations live in `paddle_tpu.distributed` (moe.py, fleet/); these
+modules re-export them at the reference paths.
+"""
+import sys
+
+from . import models  # noqa: F401
+from ...distributed import fleet  # noqa: F401
+
+# make `import paddle_tpu.incubate.distributed.fleet` (the reference path)
+# resolve — attribute aliasing alone doesn't register a module
+sys.modules[__name__ + ".fleet"] = fleet

@@ -1,0 +1,10 @@
+"""Reference path for the MoE layer family (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py:244 MoELayer,
+gate/{naive,gshard,switch}_gate.py). Canonical implementation:
+paddle_tpu/distributed/moe.py (experts sharded over the 'ep' mesh axis,
+capacity-bucketed all_to_all dispatch)."""
+from ....distributed.moe import (  # noqa: F401
+    GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch_combine)
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "moe_dispatch_combine"]

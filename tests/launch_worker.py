@@ -39,6 +39,18 @@ def main():
     for task in dist.batch_isend_irecv(ops):
         task.wait()
 
+    # globally-reduced AUC: each rank sees DISJOINT half of one dataset;
+    # the distributed accumulate must equal the serial whole-set AUC
+    from paddle_tpu.distributed.metric import DistributedAuc
+
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, 400)
+    s = np.clip(y * 0.4 + rng.random(400) * 0.6, 0, 1).astype(np.float32)
+    auc = DistributedAuc()
+    half = slice(rank * 200, (rank + 1) * 200)
+    auc.update(s[half], y[half])
+    global_auc = auc.accumulate()
+
     dist.barrier()
     with open(os.path.join(out_dir, f"out_{rank}.json"), "w") as f:
         json.dump({
@@ -49,6 +61,7 @@ def main():
             "bcast": b.numpy().tolist(),
             "gathered": [g.numpy().tolist() for g in gathered],
             "p2p": theirs.numpy().tolist(),
+            "global_auc": global_auc,
         }, f)
 
 

@@ -214,3 +214,46 @@ class TestReviewRegressions:
         assert out.shape == (32, 32, 3)
         out2 = T.RandomCrop(40, pad_if_needed=True)(img)
         assert out2.shape == (40, 40, 3)
+
+
+def test_distributed_metric_yaml_registry(tmp_path):
+    """init_metric builds DistributedAuc monitors from the reference YAML
+    shape; print_metric/print_auc format them (distributed/metric.py)."""
+    import numpy as np
+
+    from paddle_tpu.distributed import metric as dmetric
+
+    cfg = tmp_path / "metrics.yaml"
+    cfg.write_text(
+        "monitors:\n"
+        "  - {name: join_auc, method: AucCalculator, label: l, target: t,\n"
+        "     phase: JOINING}\n"
+        "  - {name: update_auc, method: AucCalculator, label: l, target: t,\n"
+        "     phase: UPDATING}\n")
+    reg = dmetric.init_metric(metric_yaml_path=str(cfg))
+    assert set(reg) == {"join_auc", "update_auc"}
+    m = dmetric.get_metric("join_auc")
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 500)
+    s = np.clip(y * 0.5 + rng.random(500) * 0.5, 0, 1).astype(np.float32)
+    m.update(s, y)
+    assert 0.5 < m.accumulate() <= 1.0
+    out = dmetric.print_auc()
+    assert "join_auc" in out and "update_auc" in out
+    # phase filtering (reference prints per-phase)
+    joining = dmetric.print_auc(phase="JOINING")
+    assert "join_auc" in joining and "update_auc" not in joining
+    # a config with ANY bad monitor registers NOTHING (validate-first),
+    # and the previous registry is preserved
+    import pytest as _pytest
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("monitors:\n  - {name: ok_one, method: AucCalculator}\n"
+                   "  - {name: x, method: Bogus}\n")
+    with _pytest.raises(ValueError):
+        dmetric.init_metric(metric_yaml_path=str(bad))
+    assert set(dmetric._METRICS) == {"join_auc", "update_auc"}
+    # a fresh valid config REPLACES the registry
+    cfg2 = tmp_path / "m2.yaml"
+    cfg2.write_text("monitors:\n  - {name: solo, method: AucCalculator}\n")
+    assert set(dmetric.init_metric(metric_yaml_path=str(cfg2))) == {"solo"}

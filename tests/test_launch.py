@@ -53,6 +53,20 @@ def test_two_process_collectives(tmp_path):
         # p2p exchange: each rank received the peer's 100+peer vector
         assert res["p2p"] == [float(100 + (1 - rank))] * 3
     assert results[0]["rank"] == 0 and results[1]["rank"] == 1
+    # DistributedAuc over disjoint halves == serial AUC of the union
+    import numpy as np
+
+    from paddle_tpu.distributed.metric import DistributedAuc
+
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, 400)
+    s = np.clip(y * 0.4 + rng.random(400) * 0.6, 0, 1).astype(np.float32)
+    serial = DistributedAuc()
+    serial.update(s, y)
+    want = serial.accumulate()
+    for rank in (0, 1):
+        assert abs(results[rank]["global_auc"] - want) < 1e-9, \
+            (results[rank]["global_auc"], want)
 
 
 def test_launch_failure_propagates(tmp_path):
