@@ -122,7 +122,11 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
     # so slot = u mod S never collides. V=1 → the familiar 2·pp − 1.
     S = 2 * Vpp - 1
 
-    blk = jax.checkpoint(block_fn) if remat else block_fn
+    # remat: False -> off, True -> keep nothing, str/callable -> policy
+    from ..recompute import checkpoint_policy
+
+    blk = (jax.checkpoint(block_fn, policy=checkpoint_policy(remat))
+           if remat else block_fn)
     micro_shape = x_micro.shape[1:]
 
     def chunk_params(v):

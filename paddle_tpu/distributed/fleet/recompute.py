@@ -13,7 +13,37 @@ import jax
 from ...ops._helpers import apply_jfn, ensure_tensor
 from ...tensor_core import Tensor
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "checkpoint_policy"]
+
+
+def checkpoint_policy(name):
+    """Map a policy name to a `jax.checkpoint` rematerialization policy.
+
+    Policies trade recompute FLOPs against saved-activation HBM — on TPU
+    `dots_saveable` keeps MXU matmul outputs and recomputes the cheap
+    elementwise ops, usually the best step-time/memory point (the knob
+    the reference lacks; its recompute is all-or-nothing per block)."""
+    import jax.ad_checkpoint as adc
+
+    if callable(name):  # a jax policy callable passes straight through
+        return name
+    policies = {
+        None: None,  # also True/False from bool `remat` knobs
+        True: None,
+        False: None,
+        "everything_saveable": adc.checkpoint_policies.everything_saveable,
+        "nothing_saveable": adc.checkpoint_policies.nothing_saveable,
+        "dots_saveable": adc.checkpoint_policies.dots_saveable,
+        "dots_with_no_batch_dims_saveable":
+            adc.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    try:
+        return policies[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown checkpoint policy {name!r}; "
+            f"one of {sorted(k for k in policies if isinstance(k, str))}"
+        ) from None
 
 
 def recompute(function, *args, **kwargs):
@@ -23,9 +53,15 @@ def recompute(function, *args, **kwargs):
     parameters are threaded through the tape as explicit inputs — the
     reference's PyLayer saves them implicitly via autograd; here the tape op
     must see them to produce `.grad` (grads only flow to declared inputs).
+
+    `policy=` selects what the backward may keep instead of recomputing:
+    a name from `checkpoint_policy` or a raw jax policy callable (e.g.
+    `jax.checkpoint_policies.save_only_these_names(...)`); default None =
+    keep nothing, the reference's semantics.
     """
     preserve = kwargs.pop("preserve_rng_state", True)
     use_reentrant = kwargs.pop("use_reentrant", True)
+    policy = checkpoint_policy(kwargs.pop("policy", None))
     tensors = []
     specs = []
     for a in args:
@@ -60,7 +96,7 @@ def recompute(function, *args, **kwargs):
             return tuple(o._value for o in out)
         return out._value
 
-    ck = jax.checkpoint(jfn)
+    ck = jax.checkpoint(jfn, policy=policy)
     return apply_jfn("recompute", ck, *tensors)
 
 

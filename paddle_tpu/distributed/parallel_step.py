@@ -160,7 +160,13 @@ class DistributedTrainStep:
                     p._value = v
             return loss._value, new_frozen
 
-        loss_f = jax.checkpoint(pure_loss) if self.remat else pure_loss
+        # remat: False -> off, True -> keep nothing, str/callable ->
+        # policy ('dots_saveable' keeps MXU outputs; see fleet.recompute)
+        from .fleet.recompute import checkpoint_policy
+
+        loss_f = (jax.checkpoint(pure_loss,
+                                 policy=checkpoint_policy(self.remat))
+                  if self.remat else pure_loss)
 
         train_objs = [p for p, t in zip(param_objs, trainable) if t]
         frozen_objs = [p for p, t in zip(param_objs, trainable) if not t]

@@ -642,3 +642,24 @@ class TestRecompute:
         net(x).sum().backward()
         np.testing.assert_allclose(g_rc, net[0].weight.grad.numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_recompute_policy_grads_match(self):
+        """Every named policy changes only WHAT the backward saves —
+        gradients must be identical."""
+        import pytest
+
+        from paddle_tpu.distributed import recompute
+        from paddle_tpu.distributed.fleet.recompute import checkpoint_policy
+
+        net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+        x = paddle.randn([4, 8])
+        net(x).sum().backward()
+        want = net[0].weight.grad.numpy().copy()
+        for pol in ("dots_saveable", "nothing_saveable",
+                    "everything_saveable"):
+            net[0].weight.grad = None
+            recompute(net, x, policy=pol).sum().backward()
+            np.testing.assert_allclose(
+                net[0].weight.grad.numpy(), want, rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError):
+            checkpoint_policy("bogus")

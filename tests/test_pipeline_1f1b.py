@@ -202,6 +202,10 @@ class TestInterleaved:
     def test_interleaved_deep_virtual_no_remat(self):
         self._parity_case(pp=2, V=4, M=4, remat=False)
 
+    def test_remat_policy_parity(self):
+        # named policy changes only what backward saves, never gradients
+        self._parity_case(pp=2, V=2, M=4, remat="dots_saveable")
+
     def test_stacking_order_roundrobin(self):
         from paddle_tpu.distributed.fleet.meta_parallel import (
             interleaved_stacking_order)
