@@ -1,0 +1,46 @@
+"""DeepFM CTR training over a host-side parameter-server embedding
+(BASELINE config 5): the dense net trains on-device while the sparse
+table lives in the C++ host KV with server-side AdaGrad.
+
+Run: JAX_PLATFORMS=cpu python examples/train_deepfm_ps.py
+Multi-host: launch N processes via `python -m paddle_tpu.distributed.launch`
+and the table shards ids across them (`id % world`).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def main():
+    num_fields, vocab = 8, 100  # small vocab: ids recur, so the table actually learns
+    model = paddle.rec.DeepFM(num_fields=num_fields, embed_dim=8,
+                              sparse=True, sparse_rule="adagrad")
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+
+    rng = np.random.default_rng(0)
+    for step in range(30):
+        ids = rng.integers(0, vocab, (256, num_fields))
+        # synthetic click rule so the loss visibly falls
+        y = (ids.sum(1) % 7 < 3).astype(np.float32)
+        logits = model(paddle.to_tensor(ids))
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            logits, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss.numpy()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

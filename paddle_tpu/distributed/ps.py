@@ -76,6 +76,22 @@ class SparseAdaGradRule:
         return rows - scale[:, None] * grads, g2[:, None]
 
 
+def resolve_rule(rule):
+    """Accept a rule object or its reference config name ('sgd'/'naive',
+    'adagrad'; reference sparse_sgd_rule.cc registers rules by name)."""
+    if rule is None or not isinstance(rule, str):
+        return rule
+    names = {"sgd": SparseSGDRule, "naive": SparseSGDRule,
+             "adagrad": SparseAdaGradRule,
+             "std_adagrad": SparseAdaGradRule}
+    try:
+        return names[rule]()
+    except KeyError:
+        raise ValueError(
+            f"unknown sparse rule {rule!r}; one of {sorted(names)}"
+        ) from None
+
+
 # --------------------------------------------------------------- table
 
 def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
@@ -86,6 +102,7 @@ def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
     SGD/AdaGrad with no custom initializer; otherwise (or with
     backend="python") the numpy MemorySparseTable. Both expose the same
     pull/push/len/state_dict contract."""
+    rule = resolve_rule(rule)
     if backend in ("auto", "native"):
         from .. import native
 
@@ -116,7 +133,7 @@ class MemorySparseTable:
 
     def __init__(self, embedding_dim, rule=None, initializer=None, seed=0):
         self.dim = embedding_dim
-        self.rule = rule or SparseAdaGradRule()
+        self.rule = resolve_rule(rule) or SparseAdaGradRule()
         self._rng = np.random.default_rng(seed)
         self._init = initializer or (
             lambda n: (self._rng.standard_normal((n, self.dim)) /

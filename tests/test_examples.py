@@ -1,0 +1,44 @@
+"""The examples/ scripts must keep running (docs/MIGRATION.md points
+users at them)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # subprocess tier
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+
+
+def test_mnist_example():
+    r = _run("train_mnist.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "eval:" in r.stdout
+
+
+def test_gpt_hybrid_example():
+    r = _run("train_gpt_hybrid.py",
+             {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("step")]
+    assert len(lines) == 5
+    first = float(lines[0].rsplit()[-1])
+    last = float(lines[-1].rsplit()[-1])
+    assert last < first  # loss falls
+
+
+def test_deepfm_ps_example():
+    r = _run("train_deepfm_ps.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("step")]
+    assert float(lines[-1].rsplit()[-1]) < float(lines[0].rsplit()[-1])
