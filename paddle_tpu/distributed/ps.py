@@ -35,8 +35,8 @@ from ..tensor_core import Tensor
 from . import mesh as mesh_mod
 
 __all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
-           "ShardedSparseTable", "make_sparse_table", "SparseEmbedding",
-           "ShardedEmbedding"]
+           "SSDSparseTable", "ShardedSparseTable", "make_sparse_table",
+           "resolve_rule", "SparseEmbedding", "ShardedEmbedding"]
 
 
 # ------------------------------------------------------ optimizer rules
@@ -95,14 +95,20 @@ def resolve_rule(rule):
 # --------------------------------------------------------------- table
 
 def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
-                      backend="auto"):
+                      backend="auto", path=None):
     """Table factory. backend="auto"/"native" uses the C++ core
     (paddle_tpu.native NativeSparseTable, mirroring the reference's C++
     memory_sparse_table) when available and the rule is a stock
-    SGD/AdaGrad with no custom initializer; otherwise (or with
-    backend="python") the numpy MemorySparseTable. Both expose the same
-    pull/push/len/state_dict contract."""
+    SGD/AdaGrad with no custom initializer; backend="ssd" (requires
+    `path`) memmaps rows to disk (reference ssd_sparse_table.h);
+    otherwise (or with backend="python") the numpy MemorySparseTable.
+    All expose the same pull/push/len/state_dict contract."""
     rule = resolve_rule(rule)
+    if backend == "ssd":
+        if path is None:
+            raise ValueError('backend="ssd" needs a directory `path`')
+        return SSDSparseTable(embedding_dim, path, rule=rule,
+                              initializer=initializer, seed=seed)
     if backend in ("auto", "native"):
         from .. import native
 
@@ -165,9 +171,20 @@ class MemorySparseTable:
                 self._rows[i] = base + k
             new = (self._init(len(missing), np.asarray(missing, np.int64))
                    if self._init_takes_ids else self._init(len(missing)))
-            self._data = np.concatenate([self._data, new])
-            self._slots = np.concatenate(
-                [self._slots, self.rule.init_slots(len(missing), self.dim)])
+            self._append_rows(new,
+                              self.rule.init_slots(len(missing), self.dim))
+
+    def _append_rows(self, new_rows, new_slots):
+        """Storage hook: append freshly-initialized rows (overridden by
+        SSDSparseTable to write into the memmap)."""
+        self._data = np.concatenate([self._data, new_rows])
+        self._slots = np.concatenate([self._slots, new_slots])
+
+    def _ordered_ids(self):
+        """ids sorted by their row index (the on-disk/state-dict order)."""
+        ids = np.fromiter(self._rows.keys(), np.int64, len(self._rows))
+        order = np.argsort([self._rows[int(i)] for i in ids])
+        return ids[order]
 
     def pull(self, ids):
         """ids: 1-D int array → (n, dim) float32 rows (reference
@@ -196,9 +213,7 @@ class MemorySparseTable:
 
     # -- checkpoint integration (paddle_tpu.distributed.checkpoint) --
     def state_dict(self):
-        ids = np.fromiter(self._rows.keys(), np.int64, len(self._rows))
-        order = np.argsort([self._rows[int(i)] for i in ids])
-        return {"ids": ids[order], "data": self._data,
+        return {"ids": self._ordered_ids(), "data": self._data,
                 "slots": self._slots}
 
     def set_state_dict(self, sd):
@@ -211,6 +226,128 @@ class MemorySparseTable:
         self._slots = np.asarray(
             sd["slots"]._value if isinstance(sd["slots"], Tensor)
             else sd["slots"], np.float32)
+
+
+class SSDSparseTable(MemorySparseTable):
+    """Disk-backed sparse table: row values and optimizer slots live in
+    memmap'd files under `path`, only the id→row index stays in RAM
+    (reference: ps/table/ssd_sparse_table.h:39, which spills cold rows to
+    RocksDB). The OS page cache plays the hot-row cache — recently
+    touched pages stay resident, cold pages are evicted under memory
+    pressure — so billion-row tables train on hosts whose RAM holds only
+    the index. Same pull/push/state_dict contract as MemorySparseTable;
+    call `flush()` (or rely on `save` in checkpointing) to persist, and
+    reopening the same `path` restores the table.
+    """
+
+    _DATA, _SLOTS, _IDS, _META = "rows.f32", "slots.f32", "ids.npy", \
+        "meta.json"
+
+    def __init__(self, embedding_dim, path, rule=None, initializer=None,
+                 seed=0, capacity=4096):
+        import json
+        import os
+
+        super().__init__(embedding_dim, rule=rule, initializer=initializer,
+                         seed=seed)
+        self._path = path
+        os.makedirs(path, exist_ok=True)
+        self._slot_dim = self.rule.slot_dim
+        ids_f = os.path.join(path, self._IDS)
+        if os.path.exists(ids_f):
+            # the flat files carry no shape info — validate against the
+            # persisted meta or a dim typo reinterprets every row
+            with open(self._file(self._META)) as f:
+                meta = json.load(f)
+            if (meta["dim"] != self.dim
+                    or meta["slot_dim"] != self._slot_dim):
+                raise ValueError(
+                    f"SSD table at {path} was written with dim="
+                    f"{meta['dim']}/slot_dim={meta['slot_dim']}, "
+                    f"reopened with dim={self.dim}/slot_dim="
+                    f"{self._slot_dim}")
+            ids = np.load(ids_f)
+            self._rows = {int(i): k for k, i in enumerate(ids)}
+            self._cap = max(capacity, 1, len(ids))
+            self._map(create=False)
+        else:
+            self._cap = max(capacity, 1)
+            self._map(create=True)
+        self._refresh_views(len(self._rows))
+
+    # -- storage primitives ------------------------------------------------
+    def _file(self, name):
+        import os
+
+        return os.path.join(self._path, name)
+
+    def _map(self, create):
+        mode = "w+" if create else "r+"
+        self._data_mm = np.memmap(self._file(self._DATA), np.float32,
+                                  mode=mode, shape=(self._cap, self.dim))
+        if self._slot_dim:
+            self._slots_mm = np.memmap(
+                self._file(self._SLOTS), np.float32, mode=mode,
+                shape=(self._cap, self._slot_dim))
+
+    def _refresh_views(self, n):
+        self._n = n
+        self._data = self._data_mm[:n]
+        self._slots = (self._slots_mm[:n] if self._slot_dim
+                       else np.zeros((n, 0), np.float32))
+
+    def _grow_to(self, need):
+        cap = self._cap
+        while cap < need:
+            cap *= 2
+        if cap == self._cap:
+            return
+        self._data_mm.flush()
+        row_bytes = self.dim * 4
+        with open(self._file(self._DATA), "r+b") as f:
+            f.truncate(cap * row_bytes)
+        if self._slot_dim:
+            self._slots_mm.flush()
+            with open(self._file(self._SLOTS), "r+b") as f:
+                f.truncate(cap * self._slot_dim * 4)
+        self._cap = cap
+        self._map(create=False)
+
+    # -- overridden storage hook ------------------------------------------
+    def _append_rows(self, new_rows, new_slots):
+        base = self._n
+        need = base + len(new_rows)
+        self._grow_to(need)
+        self._data_mm[base:need] = new_rows
+        if self._slot_dim:
+            self._slots_mm[base:need] = new_slots
+        self._refresh_views(need)
+
+    # -- persistence -------------------------------------------------------
+    def flush(self):
+        import json
+
+        np.save(self._file(self._IDS), self._ordered_ids())
+        with open(self._file(self._META), "w") as f:
+            json.dump({"dim": self.dim, "slot_dim": self._slot_dim}, f)
+        self._data_mm.flush()
+        if self._slot_dim:
+            self._slots_mm.flush()
+
+    def set_state_dict(self, sd):
+        def _np_of(v):
+            return np.asarray(v._value if isinstance(v, Tensor) else v)
+
+        ids = _np_of(sd["ids"]).reshape(-1)
+        data = _np_of(sd["data"]).astype(np.float32)
+        self._grow_to(max(len(ids), 1))
+        self._rows = {int(i): k for k, i in enumerate(ids)}
+        self._data_mm[:len(ids)] = data
+        if self._slot_dim:
+            self._slots_mm[:len(ids)] = _np_of(
+                sd["slots"]).astype(np.float32)
+        self._refresh_views(len(ids))
+        self.flush()
 
 
 # ------------------------------------------------- multi-host sharding
@@ -243,7 +380,8 @@ class ShardedSparseTable:
     """
 
     def __init__(self, embedding_dim, rule=None, initializer=None, seed=0,
-                 staleness=1, backend="auto", world=None, rank=None):
+                 staleness=1, backend="auto", world=None, rank=None,
+                 path=None):
         from . import xproc
 
         if world is None:
@@ -255,7 +393,7 @@ class ShardedSparseTable:
         self.staleness = max(1, int(staleness))
         self.local = make_sparse_table(embedding_dim, rule=rule,
                                        initializer=initializer, seed=seed,
-                                       backend=backend)
+                                       backend=backend, path=path)
         self._pending_ids = []
         self._pending_grads = []
         self._push_calls = 0
@@ -350,11 +488,11 @@ class SparseEmbedding:
     rows without blocking on the table."""
 
     def __init__(self, embedding_dim, table=None, rule=None, name=None,
-                 backend="auto"):
+                 backend="auto", path=None):
         import threading
 
         self.table = table if table is not None else make_sparse_table(
-            embedding_dim, rule=rule, backend=backend)
+            embedding_dim, rule=rule, backend=backend, path=path)
         self.dim = embedding_dim
         self._pool = None
         self._pending = None  # (key, uniq, inv, shape, future)

@@ -322,3 +322,90 @@ def test_sparse_embedding_prefetch_overlap():
     np.testing.assert_array_equal(out_other[0, 0], ref[0])
     # prefetch still pending for `ids`; consuming it now works
     np.testing.assert_array_equal(semb(ids).numpy(), sync_out)
+
+
+class TestSSDSparseTable:
+    """Disk-backed table (reference ssd_sparse_table.h): same contract
+    and numerics as the RAM table, persistent across reopen."""
+
+    def _train(self, table, steps=6, seed=0):
+        rng = np.random.default_rng(seed)
+        for _ in range(steps):
+            ids = rng.integers(0, 500, 64)
+            rows = table.pull(ids)
+            table.push(ids, 0.1 * rows + 0.01)
+        return table
+
+    def test_parity_with_memory_table(self, tmp_path):
+        from paddle_tpu.distributed.ps import (
+            MemorySparseTable, SSDSparseTable)
+
+        ram = self._train(MemorySparseTable(8, seed=3))
+        ssd = self._train(SSDSparseTable(8, str(tmp_path / "t"), seed=3,
+                                         capacity=16))  # forces growth
+        ids = np.arange(0, 500, 7)
+        np.testing.assert_allclose(ram.pull(ids), ssd.pull(ids),
+                                   rtol=1e-6, atol=1e-7)
+        assert len(ram) == len(ssd)
+
+    def test_reopen_restores(self, tmp_path):
+        from paddle_tpu.distributed.ps import SSDSparseTable
+
+        p = str(tmp_path / "t")
+        t1 = self._train(SSDSparseTable(8, p, seed=1, capacity=8))
+        want = t1.pull(np.arange(20))
+        n = len(t1)
+        t1.flush()
+        t2 = SSDSparseTable(8, p, seed=999)  # different seed: rows must
+        assert len(t2) == n                  # come from disk, not init
+        np.testing.assert_array_equal(t2.pull(np.arange(20)), want)
+
+    def test_sgd_rule_no_slots(self, tmp_path):
+        from paddle_tpu.distributed.ps import SSDSparseTable
+
+        t = SSDSparseTable(4, str(tmp_path / "s"), rule="sgd", capacity=2)
+        ids = np.arange(100)  # 50x the initial capacity
+        r0 = t.pull(ids).copy()
+        t.push(ids, np.ones((100, 4), np.float32))
+        np.testing.assert_allclose(t.pull(ids), r0 - 0.01, rtol=1e-6)
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import SSDSparseTable
+
+        t1 = self._train(SSDSparseTable(8, str(tmp_path / "a"), seed=5))
+        sd = t1.state_dict()
+        t2 = SSDSparseTable(8, str(tmp_path / "b"), seed=7)
+        t2.set_state_dict(sd)
+        ids = np.asarray(sd["ids"])[::3]  # ids the table actually holds
+        np.testing.assert_array_equal(t1.pull(ids), t2.pull(ids))
+
+    def test_factory(self, tmp_path):
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.ps import (SSDSparseTable,
+                                               make_sparse_table)
+
+        t = make_sparse_table(8, backend="ssd", path=str(tmp_path / "f"))
+        assert isinstance(t, SSDSparseTable)
+        with _pytest.raises(ValueError):
+            make_sparse_table(8, backend="ssd")
+
+    def test_dim_mismatch_reopen_rejected(self, tmp_path):
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.ps import SSDSparseTable
+
+        p = str(tmp_path / "m")
+        t = SSDSparseTable(8, p)
+        t.pull(np.arange(5))
+        t.flush()
+        with _pytest.raises(ValueError, match="dim"):
+            SSDSparseTable(4, p)
+        with _pytest.raises(ValueError, match="slot_dim"):
+            SSDSparseTable(8, p, rule="sgd")
+
+    def test_path_plumbs_through_embedding(self, tmp_path):
+        from paddle_tpu.distributed.ps import SparseEmbedding, SSDSparseTable
+
+        emb = SparseEmbedding(8, backend="ssd", path=str(tmp_path / "e"))
+        assert isinstance(emb.table, SSDSparseTable)
