@@ -104,6 +104,12 @@ def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
     otherwise (or with backend="python") the numpy MemorySparseTable.
     All expose the same pull/push/len/state_dict contract."""
     rule = resolve_rule(rule)
+    if path is not None and backend == "auto":
+        backend = "ssd"  # an explicit path is a request for persistence
+    if path is not None and backend not in ("ssd",):
+        raise ValueError(
+            f'`path` given but backend={backend!r} does not persist — '
+            'use backend="ssd" (or "auto")')
     if backend == "ssd":
         if path is None:
             raise ValueError('backend="ssd" needs a directory `path`')
@@ -254,6 +260,12 @@ class SSDSparseTable(MemorySparseTable):
         os.makedirs(path, exist_ok=True)
         self._slot_dim = self.rule.slot_dim
         ids_f = os.path.join(path, self._IDS)
+        if (not os.path.exists(ids_f)
+                and os.path.exists(self._file(self._DATA))):
+            raise ValueError(
+                f"SSD table dir {path} has row data but no {self._IDS} "
+                "(crash before flush?) — recover or clear the directory; "
+                'refusing the destructive "w+" re-create')
         if os.path.exists(ids_f):
             # the flat files carry no shape info — validate against the
             # persisted meta or a dim typo reinterprets every row
@@ -391,6 +403,12 @@ class ShardedSparseTable:
         self.world, self.rank = world, rank
         self.dim = embedding_dim
         self.staleness = max(1, int(staleness))
+        if path is not None:
+            # each shard owns its OWN directory — ranks sharing one
+            # memmap file would overwrite each other's row layouts
+            import os
+
+            path = os.path.join(path, f"rank{rank}")
         self.local = make_sparse_table(embedding_dim, rule=rule,
                                        initializer=initializer, seed=seed,
                                        backend=backend, path=path)

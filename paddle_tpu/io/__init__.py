@@ -282,25 +282,93 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
 
+class PendingTensor:
+    """Numpy batch that BECOMES a Tensor on the consumer side of the
+    multiprocess transport. Worker processes must never create jax
+    arrays: array creation initializes a jax backend, and a fresh
+    (forkserver/spawn) worker would initialize the TPU backend — one
+    device client per worker, or a multi-minute hang when the chip is
+    unreachable. The shm transport decodes this marker to a real Tensor
+    in the consumer process."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = np.ascontiguousarray(arr)
+
+    # minimal numpy-facing surface so custom collate_fns that wrap
+    # default_collate_fn keep working in workers: np ops see the array
+    # via __array__, and the common Tensor-ish accessors delegate.
+    # Arithmetic intentionally returns PLAIN numpy — worker code is
+    # numpy land, and _encode ships ndarrays fine (they surface as
+    # ndarrays, matching what a custom collate returns on the thread
+    # path if it post-processed to numpy).
+    def __array__(self, dtype=None, copy=None):
+        a = self.arr
+        return a.astype(dtype) if dtype is not None else a
+
+    def numpy(self):
+        return self.arr
+
+    def astype(self, dt):
+        return self.arr.astype(dt)
+
+    def __getitem__(self, k):
+        return self.arr[k]
+
+    def __len__(self):
+        return len(self.arr)
+
+    @property
+    def shape(self):
+        return self.arr.shape
+
+    @property
+    def dtype(self):
+        return self.arr.dtype
+
+    def __add__(self, o):
+        return self.arr + o
+
+    def __radd__(self, o):
+        return o + self.arr
+
+    def __mul__(self, o):
+        return self.arr * o
+
+    def __rmul__(self, o):
+        return o * self.arr
+
+
+_worker_numpy_collate = False  # set True inside dataloader worker processes
+
+
 def default_collate_fn(batch):
     """Stack samples into batch arrays → Tensors (reference:
-    python/paddle/fluid/dataloader/collate.py default_collate_fn)."""
+    python/paddle/fluid/dataloader/collate.py default_collate_fn).
+    Inside worker processes the stack stays numpy (see PendingTensor)."""
     from ..tensor_core import Tensor
 
     sample = batch[0]
-    if isinstance(sample, Tensor):
-        import jax.numpy as jnp
-
-        return Tensor(jnp.stack([s._value for s in batch]))
+    out = None
     if isinstance(sample, np.ndarray):
         # native assembler: GIL-released parallel memcpy (falls back to
         # np.stack when the C++ library is unavailable) — the reference
         # does batch assembly in C++ too (framework/data_feed.cc)
         from .. import native
 
-        return Tensor(native.assemble_batch(batch))
-    if isinstance(sample, (int, float, np.floating, np.integer)):
-        return Tensor(np.asarray(batch))
+        out = native.assemble_batch(batch)
+    elif isinstance(sample, (int, float, np.floating, np.integer)):
+        out = np.asarray(batch)
+    elif isinstance(sample, Tensor):
+        if _worker_numpy_collate:  # dataset built Tensors in a worker
+            out = np.stack([np.asarray(s._value) for s in batch])
+        else:
+            import jax.numpy as jnp
+
+            return Tensor(jnp.stack([s._value for s in batch]))
+    if out is not None:
+        return PendingTensor(out) if _worker_numpy_collate else Tensor(out)
     if isinstance(sample, (list, tuple)):
         return tuple(default_collate_fn(list(col)) for col in zip(*batch))
     if isinstance(sample, dict):

@@ -8,10 +8,14 @@ transforms hold the GIL, so thread prefetch starves the chip on
 ImageNet-style augmentation pipelines; real worker PROCESSES are the fix,
 exactly as in the reference. Differences from the reference, by design:
 
-* fork start method (dataset/collate inherited, nothing pickled); workers
-  touch ONLY numpy — jax state inherited from the parent is never used in
-  the child, so there is no device-context fork hazard (the TPU analog of
-  the reference's CUDA-context rule that data workers stay off-device).
+* start method: FORKSERVER by default when the dataset/collate/init_fn
+  pickle (the server process is created by fork+exec, so workers inherit
+  no locks from the parent's XLA/grpc threads — a plain fork() taken
+  while one of those ~20 threads holds a mutex deadlocks the child in
+  futex_wait, observed intermittently under the test suite). Falls back
+  to plain fork for unpicklable datasets (closures/lambdas), where the
+  child inherits everything and touches ONLY numpy; override with
+  PADDLE_TPU_MP_START=fork|forkserver|spawn.
 * batches travel through `multiprocessing.shared_memory` segments, one per
   batch, bounded by the prefetch depth (a ring of in-flight slots with
   per-batch sizing); only tiny metadata goes through the result queue.
@@ -29,6 +33,37 @@ import numpy as np
 
 __all__ = ["MPPrefetchIter", "can_fork"]
 
+
+def _picklable(*objs):
+    import pickle
+
+    try:
+        for o in objs:
+            pickle.dumps(o)
+        return True
+    except Exception:
+        return False
+
+
+def _start_method(loader):
+    """forkserver when worker inputs pickle (lock-inheritance safe),
+    else fork; PADDLE_TPU_MP_START overrides. Memoized on the loader —
+    the pickle probe serializes the whole dataset, too expensive to
+    repeat every epoch."""
+    m = os.environ.get("PADDLE_TPU_MP_START")
+    if m:
+        return m
+    cached = getattr(loader, "_mp_start_method", None)
+    if cached is None:
+        cached = ("forkserver" if _picklable(
+            loader.dataset, loader.collate_fn,
+            getattr(loader, "worker_init_fn", None)) else "fork")
+        try:
+            loader._mp_start_method = cached
+        except AttributeError:
+            pass
+    return cached
+
 _DONE = "__worker_done__"
 _WORKER_FAIL = "__worker_fail__"
 
@@ -43,8 +78,12 @@ def can_fork():
 # --------------------------------------------------------------------------
 
 def _encode(obj, leaves):
+    from . import PendingTensor
     from ..tensor_core import Tensor
 
+    if isinstance(obj, PendingTensor):  # worker-side "Tensor to be"
+        leaves.append(obj.arr)
+        return ("T", len(leaves) - 1)
     if isinstance(obj, Tensor):
         leaves.append(np.ascontiguousarray(np.asarray(obj._value)))
         return ("T", len(leaves) - 1)
@@ -159,6 +198,12 @@ def _worker_loop(wid, n_workers, dataset, collate, work_q, result_q, stop,
     # per-worker numpy stream: forked children otherwise share the parent's
     # global RNG state and produce identical augmentations
     np.random.seed((base_seed + wid) & 0x7FFFFFFF)
+    import paddle_tpu.io as _io_mod
+
+    # workers stay numpy-only: default_collate must not create jax
+    # arrays here (fresh forkserver/spawn workers would each initialize
+    # a TPU backend client — see PendingTensor)
+    _io_mod._worker_numpy_collate = True
     from . import _WorkerInfo, _worker_info
 
     _worker_info.info = _WorkerInfo(wid, n_workers, dataset)
@@ -272,7 +317,7 @@ class MPPrefetchIter:
     transport, sequence-number reordering, bounded in-flight depth."""
 
     def __init__(self, loader, index_iter):
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context(_start_method(loader))
         n = loader.num_workers
         depth = max(2, loader.prefetch_factor * n)
         state = _MPState()

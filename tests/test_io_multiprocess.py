@@ -196,3 +196,30 @@ class TestMPDataLoader:
         par = time.perf_counter() - t0
         assert par * 2 <= serial, (
             f"expected >=2x speedup: serial {serial:.2f}s vs mp {par:.2f}s")
+
+
+def _backend_probe_collate(samples):
+    """Collate that also reports whether THIS process has initialized any
+    jax backend — the worker invariant behind PendingTensor."""
+    from paddle_tpu.io import default_collate_fn
+
+    out = default_collate_fn(samples)
+    import jax._src.xla_bridge as xb
+
+    return (out, np.array([float(bool(xb._backends))], np.float32))
+
+
+class TestWorkerStaysOffDevice:
+    def test_worker_initializes_no_jax_backend(self):
+        """Workers must collate in pure numpy: a fresh (forkserver) worker
+        that creates a jax array initializes its own device backend — one
+        client per worker on real TPU, or a hang when the chip is
+        unreachable (the round-3 suite deadlock)."""
+        dl = io.DataLoader(_ArrDataset(32), batch_size=8, num_workers=2,
+                           collate_fn=_backend_probe_collate)
+        seen = 0
+        for batch, backend_flag in dl:
+            assert float(np.asarray(backend_flag)[0]) == 0.0, \
+                "worker process initialized a jax backend"
+            seen += 1
+        assert seen == 4

@@ -409,3 +409,38 @@ class TestSSDSparseTable:
 
         emb = SparseEmbedding(8, backend="ssd", path=str(tmp_path / "e"))
         assert isinstance(emb.table, SSDSparseTable)
+
+    def test_path_auto_selects_ssd_and_rank_subdirs(self, tmp_path):
+        import os
+
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.ps import (
+            ShardedSparseTable, SSDSparseTable, make_sparse_table)
+
+        # explicit path == request for persistence
+        t = make_sparse_table(8, path=str(tmp_path / "auto"))
+        assert isinstance(t, SSDSparseTable)
+        with _pytest.raises(ValueError, match="persist"):
+            make_sparse_table(8, backend="python", path=str(tmp_path))
+        # sharded: each rank gets its own directory
+        s = ShardedSparseTable(8, world=1, rank=0, backend="ssd",
+                               path=str(tmp_path / "sh"))
+        s.pull(np.arange(3)); s.local.flush()
+        assert os.path.isdir(tmp_path / "sh" / "rank0")
+
+    def test_dataless_crash_dir_refused(self, tmp_path):
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.ps import SSDSparseTable
+
+        p = str(tmp_path / "c")
+        t = SSDSparseTable(8, p)
+        t.pull(np.arange(4))  # rows written, flush never called
+        del t
+        import os
+
+        os.remove(os.path.join(p, "ids.npy")) if os.path.exists(
+            os.path.join(p, "ids.npy")) else None
+        with _pytest.raises(ValueError, match="crash before flush"):
+            SSDSparseTable(8, p)
