@@ -114,6 +114,10 @@ if "hapi" in globals() and hasattr(globals()["hapi"], "model"):
 if "distributed" in globals():
     DataParallel = globals()["distributed"].DataParallel
 from . import hub  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
 
 # paddle.dtype: the concrete dtype class (jnp dtypes are numpy dtypes), so
 # `isinstance(x.dtype, paddle.dtype)` works as in the reference.

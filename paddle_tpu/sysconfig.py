@@ -1,0 +1,18 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py:20,37) —
+include/lib dirs for building extensions against the framework. Here the
+native pieces are the ctypes-built C++ cores in `paddle_tpu/native/`."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers/sources for custom native extensions."""
+    return os.path.join(_ROOT, "native")
+
+
+def get_lib():
+    """Directory containing the compiled native shared library."""
+    return os.path.join(_ROOT, "native")

@@ -1,0 +1,35 @@
+"""paddle.version (reference: generated python/paddle/version.py) —
+version metadata + `show()`."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "unknown"
+with_gpu = "OFF"   # TPU build: XLA/PJRT owns the device
+cuda_version = "False"
+cudnn_version = "False"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show",
+           "istaged", "commit"]
+
+
+def show():
+    """Print the version breakdown (reference version.py show())."""
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    else:
+        print("commit:", commit)
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

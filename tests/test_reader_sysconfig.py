@@ -1,0 +1,132 @@
+"""paddle.reader decorators, sysconfig, version, cost_model surfaces."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(n):
+    def rd():
+        yield from range(n)
+    return rd
+
+
+def test_cache_map_chain_firstn_compose():
+    cached = reader.cache(_r(4))
+    assert list(cached()) == [0, 1, 2, 3] == list(cached())
+    m = reader.map_readers(lambda a, b: a + b, _r(3), _r(3))
+    assert list(m()) == [0, 2, 4]
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    assert list(reader.firstn(_r(10), 3)()) == [0, 1, 2]
+    c = reader.compose(_r(3), _r(3))
+    assert list(c()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(_r(2), _r(4))())
+    # misaligned but unchecked: truncates to the shortest
+    assert list(reader.compose(_r(2), _r(4), check_alignment=False)()) == \
+        [(0, 0), (1, 1)]
+
+
+def test_shuffle_and_buffered_preserve_multiset():
+    out = list(reader.shuffle(_r(20), 7)())
+    assert sorted(out) == list(range(20))
+    assert list(reader.buffered(_r(50), 8)()) == list(range(50))
+
+
+@pytest.mark.parametrize("order", [False, True])
+def test_xmap_readers(order):
+    xr = reader.xmap_readers(lambda x: x * x, _r(12), 3, 4, order=order)
+    out = list(xr())
+    if order:
+        assert out == [i * i for i in range(12)]
+    else:
+        assert sorted(out) == sorted(i * i for i in range(12))
+
+
+@pytest.mark.slow
+def test_multiprocess_reader():
+    out = list(reader.multiprocess_reader([_r(5), _r(7)])())
+    assert sorted(out) == sorted(list(range(5)) + list(range(7)))
+
+
+def test_sysconfig_and_version():
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+    assert paddle.version.full_version == paddle.__version__
+    paddle.version.show()  # prints, must not raise
+
+
+def test_cost_model_measures():
+    cm = paddle.cost_model.CostModel()
+    res = cm.profile_measure(fn=lambda a, b: a @ b,
+                             args=(np.eye(64, dtype=np.float32),) * 2,
+                             iters=3)
+    assert res["time"] > 0
+    t = cm.get_static_op_time("matmul")
+    assert float(t["op_time"]) > 0
+    with pytest.raises(KeyError):
+        cm.get_static_op_time("nonexistent_op")
+
+
+@pytest.mark.slow
+def test_cost_model_static_program_path():
+    cm = paddle.cost_model.CostModel()
+    startup, main = cm.build_program()
+    res = cm.profile_measure(startup, main, iters=2)
+    assert res["time"] > 0
+
+
+def test_reader_errors_propagate_not_hang():
+    def bad():
+        yield 1
+        raise IOError("source died")
+
+    with pytest.raises(IOError, match="source died"):
+        list(reader.buffered(bad, 4)())
+
+    def bad_map(x):
+        if x == 5:
+            raise ValueError("corrupt sample")
+        return x
+
+    with pytest.raises(ValueError, match="corrupt sample"):
+        list(reader.xmap_readers(bad_map, _r(10), 2, 4)())
+    with pytest.raises(ValueError, match="corrupt sample"):
+        list(reader.xmap_readers(bad_map, _r(10), 2, 4, order=True)())
+
+
+@pytest.mark.slow
+def test_multiprocess_reader_none_samples_and_errors():
+    def with_none():
+        yield None
+        yield 3
+
+    out = list(reader.multiprocess_reader([with_none])())
+    assert out == [None, 3]  # None is a sample, not the end sentinel
+
+    def boom():
+        yield 1
+        raise RuntimeError("child blew up")
+
+    with pytest.raises(RuntimeError, match="child failed"):
+        list(reader.multiprocess_reader([boom])())
+
+
+def test_cost_model_path_errors_and_reload(tmp_path):
+    import json as _json
+
+    cm = paddle.cost_model.CostModel()
+    with pytest.raises(FileNotFoundError):
+        cm.static_cost_data(path=str(tmp_path / "nope.json"))
+    p = tmp_path / "bench.json"
+    p.write_text(_json.dumps({"matmul": {"op_time": "1.5"}}))
+    assert cm.static_cost_data(path=str(p))["matmul"]["op_time"] == "1.5"
+    # a later explicit path REPLACES any cached table
+    p2 = tmp_path / "bench2.json"
+    p2.write_text(_json.dumps({"matmul": {"op_time": "2.5"}}))
+    assert cm.get_static_op_time("matmul")["op_time"] == "1.5"
+    cm.static_cost_data(path=str(p2))
+    assert cm.get_static_op_time("matmul")["op_time"] == "2.5"
