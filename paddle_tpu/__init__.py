@@ -115,6 +115,7 @@ if "distributed" in globals():
     DataParallel = globals()["distributed"].DataParallel
 from . import hub  # noqa: F401
 from . import cost_model  # noqa: F401
+from . import dataset  # noqa: F401
 from . import reader  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import version  # noqa: F401

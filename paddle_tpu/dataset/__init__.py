@@ -1,0 +1,22 @@
+"""paddle.dataset — legacy reader-style dataset loaders.
+
+Reference: python/paddle/dataset/ (mnist.py, cifar.py, uci_housing.py,
+imdb.py, imikolov.py, movielens.py, conll05.py, wmt14.py, wmt16.py,
+flowers.py, voc2012.py, image.py, common.py). Each module exposes
+reader CREATORS (`train()`, `test()`, ...) returning zero-arg callables
+that yield reference-shaped sample tuples — the composition layer
+`paddle.reader` consumes them.
+
+TPU-native design: these are thin adapters over the map-style Dataset
+classes in `paddle_tpu.vision.datasets` / `paddle_tpu.text.datasets`
+(single source of truth for parsing + normalization). Vision loaders run
+hermetically (synthetic fallback when no archive is given); text loaders
+need a local archive via `data_file=` — automatic download is
+unavailable in this environment.
+"""
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov,  # noqa: F401
+               mnist, movielens, uci_housing, voc2012, wmt14, wmt16)
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "wmt16", "flowers",
+           "voc2012", "image", "common"]

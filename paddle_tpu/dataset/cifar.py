@@ -1,0 +1,35 @@
+"""dataset.cifar (reference: python/paddle/dataset/cifar.py) — readers
+yield (flat 3072 float32 in [0, 1], int label)."""
+import numpy as np
+
+from .common import reader_from_dataset
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _map(sample):
+    img, label = sample
+    return np.asarray(img, np.float32).reshape(-1), int(label)
+
+
+def _make(cls_name, mode, data_file):
+    from ..vision import datasets as vd
+
+    ds = getattr(vd, cls_name)(data_file=data_file, mode=mode)
+    return reader_from_dataset(ds, _map)
+
+
+def train10(data_file=None):
+    return _make("Cifar10", "train", data_file)
+
+
+def test10(data_file=None):
+    return _make("Cifar10", "test", data_file)
+
+
+def train100(data_file=None):
+    return _make("Cifar100", "train", data_file)
+
+
+def test100(data_file=None):
+    return _make("Cifar100", "test", data_file)

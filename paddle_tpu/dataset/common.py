@@ -1,0 +1,91 @@
+"""dataset.common (reference: python/paddle/dataset/common.py) — shared
+helpers for the legacy loaders. `download` is a local-file check here
+(zero-egress environment): it validates the given path (and md5 when
+provided) instead of fetching."""
+import hashlib
+import os
+import pickle
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+__all__ = ["DATA_HOME", "md5file", "download", "split",
+           "cluster_files_reader", "reader_from_dataset"]
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Reference common.py `download` fetches over HTTP. No egress here:
+    `url` must be a LOCAL path (or the file must already sit under
+    DATA_HOME/module_name); md5 is verified when given."""
+    candidates = [url] if url and os.path.exists(url) else []
+    if save_name:
+        candidates.append(os.path.join(DATA_HOME, module_name, save_name))
+    for path in candidates:
+        if os.path.exists(path):
+            if md5sum and md5file(path) != md5sum:
+                raise IOError(f"{path}: md5 mismatch")
+            return path
+    raise IOError(
+        f"dataset file for {module_name} not found — downloads are "
+        f"unavailable; place the archive locally and pass its path")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into pickled chunk files of `line_count`
+    samples (reference common.py split)."""
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    buf, idx, files = [], 0, []
+
+    def _flush():
+        nonlocal buf, idx
+        if not buf:
+            return
+        name = suffix % idx
+        with open(name, "wb") as f:
+            dumper(buf, f)
+        files.append(name)
+        buf, idx = [], idx + 1
+
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            _flush()
+    _flush()
+    return files
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Read this trainer's round-robin share of pickled chunk files
+    (reference common.py cluster_files_reader)."""
+    import glob
+
+    loader = loader or (lambda f: pickle.load(f))
+
+    def reader():
+        files = sorted(glob.glob(files_pattern))
+        for i, name in enumerate(files):
+            if i % trainer_count == trainer_id:
+                with open(name, "rb") as f:
+                    yield from loader(f)
+
+    return reader
+
+
+def reader_from_dataset(ds, map_fn=None):
+    """Adapter: map-style Dataset -> legacy reader creator."""
+
+    def reader():
+        for i in range(len(ds)):
+            s = ds[i]
+            yield map_fn(s) if map_fn else s
+
+    return reader

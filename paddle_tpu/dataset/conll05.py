@@ -1,0 +1,20 @@
+"""dataset.conll05 (reference: python/paddle/dataset/conll05.py) — SRL
+test reader + dicts."""
+from .common import reader_from_dataset
+
+__all__ = ["test", "get_dict"]
+
+
+def _ds(data_file, **kw):
+    from ..text.datasets import Conll05st
+
+    return Conll05st(data_file=data_file, **kw)
+
+
+def get_dict(data_file=None, **kw):
+    ds = _ds(data_file, **kw)
+    return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+
+def test(data_file=None, **kw):
+    return reader_from_dataset(_ds(data_file, **kw))

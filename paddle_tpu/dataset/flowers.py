@@ -1,0 +1,33 @@
+"""dataset.flowers (reference: python/paddle/dataset/flowers.py) —
+readers yield (image CHW float32 [0,1], int label)."""
+import numpy as np
+
+from .common import reader_from_dataset
+
+__all__ = ["train", "test", "valid"]
+
+
+def _map(sample):
+    img, label = sample
+    img = np.asarray(img, np.float32)
+    if img.ndim == 3 and img.shape[-1] in (1, 3):  # HWC -> CHW
+        img = img.transpose(2, 0, 1)
+    return img / 255.0, int(label)
+
+
+def _make(mode, kw):
+    from ..vision.datasets import Flowers
+
+    return reader_from_dataset(Flowers(mode=mode, **kw), _map)
+
+
+def train(**kw):
+    return _make("train", kw)
+
+
+def test(**kw):
+    return _make("test", kw)
+
+
+def valid(**kw):
+    return _make("valid", kw)

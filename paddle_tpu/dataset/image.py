@@ -1,0 +1,70 @@
+"""dataset.image (reference: python/paddle/dataset/image.py) — numpy
+image helpers used by the legacy pipelines. The reference uses cv2;
+PIL + numpy serve here (same outputs for these ops)."""
+import numpy as np
+
+__all__ = ["load_image", "resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform",
+           "load_and_transform"]
+
+
+def load_image(path, is_color=True):
+    from PIL import Image
+
+    img = Image.open(path)
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.asarray(img)
+    return arr if is_color else arr[..., None]
+
+
+def resize_short(im, size):
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    pim = Image.fromarray(im.squeeze() if im.shape[-1] == 1 else im)
+    out = np.asarray(pim.resize((nw, nh), Image.BILINEAR))
+    return out if out.ndim == 3 else out[..., None]
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    hs, ws = (h - size) // 2, (w - size) // 2
+    return im[hs:hs + size, ws:ws + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    hs = np.random.randint(0, h - size + 1)
+    ws = np.random.randint(0, w - size + 1)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2):
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,29 @@
+"""dataset.imdb (reference: python/paddle/dataset/imdb.py) — readers
+yield (word-id list, 0/1 label). The vocabulary is built by the backing
+`text.datasets.Imdb` (cutoff-frequency dict, same rule as the
+reference); pass its `word_dict()` result around for embedding sizes."""
+from .common import reader_from_dataset
+
+__all__ = ["word_dict", "train", "test"]
+
+
+def word_dict(data_file=None, cutoff=150):
+    from ..text.datasets import Imdb
+
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+def _make(mode, data_file, cutoff):
+    from ..text.datasets import Imdb
+
+    ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+    return reader_from_dataset(
+        ds, lambda s: (s[0].tolist(), int(s[1])))
+
+
+def train(word_idx=None, data_file=None, cutoff=150):
+    return _make("train", data_file, cutoff)
+
+
+def test(word_idx=None, data_file=None, cutoff=150):
+    return _make("test", data_file, cutoff)

@@ -1,0 +1,33 @@
+"""dataset.imikolov (reference: python/paddle/dataset/imikolov.py) —
+PTB language-model readers: NGRAM yields n-tuples of word ids, SEQ
+yields id sequences."""
+from .common import reader_from_dataset
+
+__all__ = ["build_dict", "train", "test"]
+
+
+def build_dict(data_file=None, min_word_freq=50):
+    from ..text.datasets import Imikolov
+
+    return Imikolov(data_file=data_file, data_type="SEQ", mode="train",
+                    min_word_freq=min_word_freq).word_idx
+
+
+def _make(mode, n, data_type, data_file, min_word_freq):
+    from ..text.datasets import Imikolov
+
+    ds = Imikolov(data_file=data_file, data_type=data_type,
+                  window_size=n, mode=mode, min_word_freq=min_word_freq)
+    return reader_from_dataset(ds, lambda s: tuple(
+        v.tolist() if hasattr(v, "tolist") else v for v in s)
+        if isinstance(s, tuple) else s)
+
+
+def train(word_idx=None, n=5, data_type="NGRAM", data_file=None,
+          min_word_freq=50):
+    return _make("train", n, data_type, data_file, min_word_freq)
+
+
+def test(word_idx=None, n=5, data_type="NGRAM", data_file=None,
+         min_word_freq=50):
+    return _make("test", n, data_type, data_file, min_word_freq)

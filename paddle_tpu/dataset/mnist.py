@@ -1,0 +1,31 @@
+"""dataset.mnist (reference: python/paddle/dataset/mnist.py) — readers
+yield (flat 784 float32 in [-1, 1], int label), the reference's wire
+shape. Backed by vision.datasets.MNIST (synthetic fallback without
+archives)."""
+import numpy as np
+
+from .common import reader_from_dataset
+
+__all__ = ["train", "test"]
+
+
+def _map(sample):
+    img, label = sample
+    flat = np.asarray(img, np.float32).reshape(-1)
+    return flat * 2.0 - 1.0, int(label)  # dataset gives [0,1]; ref [-1,1]
+
+
+def _make(mode, image_path, label_path):
+    from ..vision.datasets import MNIST
+
+    return reader_from_dataset(
+        MNIST(image_path=image_path, label_path=label_path, mode=mode),
+        _map)
+
+
+def train(image_path=None, label_path=None):
+    return _make("train", image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    return _make("test", image_path, label_path)

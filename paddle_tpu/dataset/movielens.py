@@ -1,0 +1,42 @@
+"""dataset.movielens (reference: python/paddle/dataset/movielens.py) —
+rating tuples for recommender baselines."""
+from .common import reader_from_dataset
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "age_table"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def _ds(mode, data_file):
+    from ..text.datasets import Movielens
+
+    return Movielens(data_file=data_file, mode=mode)
+
+
+def train(data_file=None):
+    return reader_from_dataset(_ds("train", data_file))
+
+
+def test(data_file=None):
+    return reader_from_dataset(_ds("test", data_file))
+
+
+def get_movie_title_dict(data_file=None):
+    ds = _ds("train", data_file)
+    return getattr(ds, "movie_title_dict", {})
+
+
+def max_movie_id(data_file=None):
+    ds = _ds("train", data_file)
+    return int(getattr(ds, "max_movie_id", 0))
+
+
+def max_user_id(data_file=None):
+    ds = _ds("train", data_file)
+    return int(getattr(ds, "max_user_id", 0))
+
+
+def max_job_id(data_file=None):
+    ds = _ds("train", data_file)
+    return int(getattr(ds, "max_job_id", 0))

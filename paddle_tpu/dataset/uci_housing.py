@@ -1,0 +1,22 @@
+"""dataset.uci_housing (reference: python/paddle/dataset/uci_housing.py)
+— readers yield (13 float32 features, [price])."""
+from .common import reader_from_dataset
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _make(mode, data_file):
+    from ..text.datasets import UCIHousing
+
+    return reader_from_dataset(UCIHousing(data_file=data_file, mode=mode))
+
+
+def train(data_file=None):
+    return _make("train", data_file)
+
+
+def test(data_file=None):
+    return _make("test", data_file)

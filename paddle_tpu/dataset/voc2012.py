@@ -1,0 +1,33 @@
+"""dataset.voc2012 (reference: python/paddle/dataset/voc2012.py) —
+readers yield (image CHW float32, segmentation mask HW int64)."""
+import numpy as np
+
+from .common import reader_from_dataset
+
+__all__ = ["train", "test", "valid"]
+
+
+def _map(sample):
+    img, mask = sample
+    img = np.asarray(img, np.float32)
+    if img.ndim == 3 and img.shape[-1] in (1, 3):
+        img = img.transpose(2, 0, 1)
+    return img, np.asarray(mask, np.int64)
+
+
+def _make(mode, kw):
+    from ..vision.datasets import VOC2012
+
+    return reader_from_dataset(VOC2012(mode=mode, **kw), _map)
+
+
+def train(**kw):
+    return _make("train", kw)
+
+
+def test(**kw):
+    return _make("test", kw)
+
+
+def valid(**kw):
+    return _make("valid", kw)

@@ -1,0 +1,21 @@
+"""dataset.wmt14 (reference: python/paddle/dataset/wmt14.py) —
+translation readers yielding (src ids, trg ids, trg-next ids)."""
+from .common import reader_from_dataset
+
+__all__ = ["train", "test"]
+
+
+def _make(mode, dict_size, data_file):
+    from ..text.datasets import WMT14
+
+    ds = WMT14(data_file=data_file, mode=mode, dict_size=dict_size)
+    return reader_from_dataset(ds, lambda s: tuple(
+        v.tolist() if hasattr(v, "tolist") else v for v in s))
+
+
+def train(dict_size=30000, data_file=None):
+    return _make("train", dict_size, data_file)
+
+
+def test(dict_size=30000, data_file=None):
+    return _make("test", dict_size, data_file)

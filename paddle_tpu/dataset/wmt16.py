@@ -1,0 +1,28 @@
+"""dataset.wmt16 (reference: python/paddle/dataset/wmt16.py) —
+translation readers yielding (src ids, trg ids, trg-next ids)."""
+from .common import reader_from_dataset
+
+__all__ = ["train", "test", "validation"]
+
+
+def _make(mode, src_dict_size, trg_dict_size, data_file, lang):
+    from ..text.datasets import WMT16
+
+    ds = WMT16(data_file=data_file, mode=mode,
+               src_dict_size=src_dict_size, trg_dict_size=trg_dict_size,
+               lang=lang)
+    return reader_from_dataset(ds, lambda s: tuple(
+        v.tolist() if hasattr(v, "tolist") else v for v in s))
+
+
+def train(src_dict_size=-1, trg_dict_size=-1, data_file=None, lang="en"):
+    return _make("train", src_dict_size, trg_dict_size, data_file, lang)
+
+
+def test(src_dict_size=-1, trg_dict_size=-1, data_file=None, lang="en"):
+    return _make("test", src_dict_size, trg_dict_size, data_file, lang)
+
+
+def validation(src_dict_size=-1, trg_dict_size=-1, data_file=None,
+               lang="en"):
+    return _make("val", src_dict_size, trg_dict_size, data_file, lang)

@@ -1,0 +1,79 @@
+"""paddle.dataset legacy reader adapters (hermetic paths: mnist/cifar
+run on the synthetic fallback; image helpers on generated arrays)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.dataset import cifar, common, image, mnist
+
+
+def test_mnist_reader_shapes_and_range():
+    r = mnist.train()
+    first = next(iter(r()))
+    img, label = first
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label < 10
+    n_test = sum(1 for _ in mnist.test()())
+    assert n_test == 1000
+
+
+def test_cifar_reader():
+    img, label = next(iter(cifar.train10()()))
+    assert img.shape == (3072,) and 0 <= label < 10
+    img, label = next(iter(cifar.test100()()))
+    assert 0 <= label < 100
+
+
+def test_reader_composition_with_legacy_decorators():
+    from paddle_tpu import reader as rdr
+
+    batch = list(rdr.firstn(rdr.shuffle(mnist.train(), 64), 10)())
+    assert len(batch) == 10
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    r = common.reader_from_dataset(
+        [(i, i * i) for i in range(10)])
+    files = common.split(r, 3, suffix=str(tmp_path / "chunk-%05d.pickle"))
+    assert len(files) == 4
+    got0 = list(common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), 2, 0)())
+    got1 = list(common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), 2, 1)())
+    assert sorted(got0 + got1) == [(i, i * i) for i in range(10)]
+    assert got0 != got1
+
+
+def test_common_download_is_local_check(tmp_path):
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"hello")
+    assert common.download(str(f), "m") == str(f)
+    assert common.download(str(f), "m", md5sum=common.md5file(str(f)))
+    with pytest.raises(IOError):
+        common.download(str(f), "m", md5sum="0" * 32)
+    with pytest.raises(IOError):
+        common.download(str(tmp_path / "missing"), "m")
+
+
+def test_image_helpers():
+    im = (np.random.default_rng(0).integers(0, 255, (40, 60, 3))
+          .astype(np.uint8))
+    small = image.resize_short(im, 32)
+    assert min(small.shape[:2]) == 32
+    crop = image.center_crop(small, 24)
+    assert crop.shape[:2] == (24, 24)
+    chw = image.simple_transform(im, 32, 24, is_train=True,
+                                 mean=[1.0, 2.0, 3.0])
+    assert chw.shape == (3, 24, 24) and chw.dtype == np.float32
+    flipped = image.left_right_flip(crop)
+    np.testing.assert_array_equal(flipped[:, 0], crop[:, -1])
+
+
+def test_text_adapters_require_local_archives():
+    from paddle_tpu.dataset import imdb, wmt16
+
+    with pytest.raises(ValueError, match="data_file"):
+        next(iter(imdb.train()()))
+    with pytest.raises(ValueError, match="data_file"):
+        next(iter(wmt16.train()()))
