@@ -114,6 +114,7 @@ if "hapi" in globals() and hasattr(globals()["hapi"], "model"):
 if "distributed" in globals():
     DataParallel = globals()["distributed"].DataParallel
 from . import hub  # noqa: F401
+from . import compat  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import dataset  # noqa: F401
 from . import reader  # noqa: F401

@@ -1,0 +1,72 @@
+"""paddle.compat (reference: python/paddle/compat.py) — py2/py3 text
+helpers still imported by legacy user code."""
+import math
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+
+def _map_structure(obj, leaf, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_map_structure(o, leaf, inplace) for o in obj]
+            return obj
+        return [_map_structure(o, leaf, inplace) for o in obj]
+    if isinstance(obj, tuple):  # immutable: never in place
+        return tuple(_map_structure(o, leaf, False) for o in obj)
+    if isinstance(obj, set):
+        vals = {_map_structure(o, leaf, False) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return vals
+    if isinstance(obj, dict):
+        items = {_map_structure(k, leaf, False): _map_structure(
+            v, leaf, False) for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(items)
+            return obj
+        return items
+    return leaf(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes → str recursively through lists/sets/dicts (reference
+    compat.py:25)."""
+    if obj is None:
+        return obj
+    return _map_structure(
+        obj, lambda o: o.decode(encoding) if isinstance(o, bytes) else o,
+        inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str → bytes recursively (reference compat.py:121)."""
+    if obj is None:
+        return obj
+    return _map_structure(
+        obj, lambda o: o.encode(encoding) if isinstance(o, str) else o,
+        inplace)
+
+
+def round(x, d=0):
+    """Python-2-style half-away-from-zero rounding (reference
+    compat.py:206 — py3 builtin round is banker's)."""
+    if x == 0.0 or math.isinf(x) or math.isnan(x):
+        return x
+    p = 10 ** d
+    shifted = (x * p) + math.copysign(0.5, x)
+    # floor toward -inf only works for positives; negatives need ceil or
+    # every non-half value rounds an extra step away from zero
+    toward_zero = math.floor(shifted) if x > 0 else math.ceil(shifted)
+    return float(toward_zero) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

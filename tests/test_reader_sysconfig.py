@@ -130,3 +130,21 @@ def test_cost_model_path_errors_and_reload(tmp_path):
     assert cm.get_static_op_time("matmul")["op_time"] == "1.5"
     cm.static_cost_data(path=str(p2))
     assert cm.get_static_op_time("matmul")["op_time"] == "2.5"
+
+
+def test_compat_helpers():
+    from paddle_tpu import compat
+
+    assert compat.to_text([b"a", {b"k": b"v"}]) == ["a", {"k": "v"}]
+    assert compat.to_bytes(("x",)) == (b"x",)
+    lst = [b"m"]
+    assert compat.to_text(lst, inplace=True) is lst and lst == ["m"]
+    # half-away-from-zero, unlike py3 banker's rounding
+    assert compat.round(0.5) == 1.0 and compat.round(-0.5) == -1.0
+    assert compat.round(1.25, 1) == 1.3  # banker rounds to 1.2
+    # negatives round half away from zero, NOT an extra step away
+    assert compat.round(-0.3) == 0.0
+    assert compat.round(-0.6) == -1.0
+    assert compat.round(-1.2) == -1.0
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
