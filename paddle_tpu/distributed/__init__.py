@@ -6,7 +6,13 @@ names mesh axes; parallelism = placement (see SURVEY.md §7 design map).
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import collective  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from . import communication  # noqa: F401
 from . import coordinator  # noqa: F401
+from . import entry_attr  # noqa: F401
+from . import models  # noqa: F401
+from . import parallel_with_gloo  # noqa: F401
+from .communication import stream  # noqa: F401
 from . import metric  # noqa: F401
 from . import env  # noqa: F401
 from . import mesh  # noqa: F401
@@ -34,7 +40,6 @@ from .collective import (  # noqa: F401
     scatter,
     scatter_object_list,
     spmd,
-    stream,
     wait,
 )
 from .collective import (  # noqa: F401

@@ -737,16 +737,5 @@ def wait(tensor, group=None, use_calc_stream=True):
     return tensor
 
 
-class _StreamFacade:
-    """paddle.distributed.communication.stream parity (async variants are
-    identical under XLA: the compiler schedules collectives)."""
-
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    reduce_scatter = staticmethod(reduce_scatter)
-    alltoall = staticmethod(alltoall)
-    broadcast = staticmethod(broadcast)
-    reduce = staticmethod(reduce)
-
-
-stream = _StreamFacade()
+# paddle.distributed.stream is the communication.stream module (aliased
+# in distributed/__init__) — one implementation, reference-shaped

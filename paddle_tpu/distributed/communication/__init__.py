@@ -1,0 +1,19 @@
+"""distributed.communication — new-style collective wrappers.
+
+Reference: python/paddle/distributed/communication/ (thin new-namespace
+re-exports of the collective API plus `stream` variants). The canonical
+implementations live in `distributed.collective`; this package keeps the
+reference import paths working.
+"""
+# import from .collective directly: this package loads DURING
+# distributed/__init__, before the parent re-exports exist
+from ..collective import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, batch_isend_irecv, broadcast, reduce, reduce_scatter,
+    scatter, scatter_object_list)
+from . import stream  # noqa: F401
+
+__all__ = ["ReduceOp", "stream", "all_reduce", "all_gather",
+           "all_gather_object", "broadcast", "reduce", "scatter",
+           "scatter_object_list", "alltoall", "alltoall_single",
+           "reduce_scatter", "batch_isend_irecv", "P2POp"]

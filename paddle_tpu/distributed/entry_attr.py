@@ -1,0 +1,7 @@
+"""distributed.entry_attr (reference:
+python/paddle/distributed/entry_attr.py) — sparse-table entry filter
+configs; canonical classes live in api_extra."""
+from .api_extra import (  # noqa: F401
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry)
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]

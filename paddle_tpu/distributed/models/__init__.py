@@ -1,0 +1,3 @@
+"""distributed.models (reference: python/paddle/distributed/models/moe) —
+MoE helper namespace; canonical implementation in distributed/moe.py."""
+from . import moe  # noqa: F401

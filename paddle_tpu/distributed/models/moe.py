@@ -1,0 +1,7 @@
+"""distributed.models.moe (reference:
+python/paddle/distributed/models/moe/) — grad-clip and utils for MoE."""
+from ..moe import (  # noqa: F401
+    GShardGate, MoELayer, NaiveGate, SwitchGate, moe_dispatch_combine)
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "moe_dispatch_combine"]

@@ -1,0 +1,8 @@
+"""distributed.parallel_with_gloo (reference:
+python/paddle/distributed/parallel_with_gloo.py:40) — CPU-only
+process-group bring-up. One implementation: re-exported from api_extra
+(the coordination service plays gloo's role)."""
+from .api_extra import (  # noqa: F401
+    gloo_barrier, gloo_init_parallel_env, gloo_release)
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]

@@ -178,3 +178,36 @@ def test_hdfs_client_without_hadoop_errors_cleanly():
     c = HDFSClient(hadoop_home="/nonexistent")
     with _pytest.raises(ExecuteError, match="hadoop"):
         c.mkdirs("/tmp/x")
+
+
+class TestNewNamespaceModules:
+    def test_communication_stream_variants(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.communication import stream
+
+        t = paddle.to_tensor(np.full(3, 2.0, np.float32))
+        stream.all_reduce(t, use_calc_stream=True)  # 1-proc: identity
+        np.testing.assert_array_equal(t.numpy(), [2.0, 2.0, 2.0])
+        out = []
+        stream.all_gather(out, t, sync_op=False)
+        assert len(out) == 1
+
+    def test_entry_attr_and_models_aliases(self):
+        from paddle_tpu.distributed import entry_attr, models
+        from paddle_tpu.distributed.moe import MoELayer
+
+        e = entry_attr.CountFilterEntry(5)
+        assert e is not None
+        assert models.moe.MoELayer is MoELayer
+
+    def test_cloud_utils_env_contract(self, monkeypatch):
+        from paddle_tpu.distributed import cloud_utils
+
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "10.0.0.1:8000,10.0.0.2:8000")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        ips, cur, eps = cloud_utils.get_cloud_cluster()
+        assert ips == ["10.0.0.1", "10.0.0.2"] and len(eps) == 2
+        assert cloud_utils.get_trainers_num() == 2
