@@ -1,14 +1,105 @@
 """incubate.nn fused layers (reference: python/paddle/incubate/nn/layer/
-fused_transformer.py FusedMultiHeadAttention:30,
-FusedFeedForward:290, FusedTransformerEncoderLayer:450).
+fused_transformer.py FusedMultiHeadAttention:30, FusedFeedForward:437,
+FusedTransformerEncoderLayer:~640, FusedMultiTransformer:914).
 
 On TPU "fused" means: expressed so XLA/Pallas fuse it — the standard
 nn.TransformerEncoderLayer already routes attention through the Pallas
-flash-attention kernel when eligible, so these classes alias the dense
-implementations and exist for source compatibility."""
+flash-attention kernel when eligible, so the attention/encoder classes
+alias the dense implementations; FusedFeedForward and
+FusedMultiTransformer are thin real layers over the same fusing
+primitives (one XLA fusion cluster per block after jit)."""
+from .. import nn
 from ..nn.layer.transformer import (  # noqa: F401
     MultiHeadAttention as FusedMultiHeadAttention,
     TransformerEncoderLayer as FusedTransformerEncoderLayer,
 )
 
-__all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer"]
+__all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer",
+           "FusedFeedForward", "FusedMultiTransformer"]
+
+
+class FusedFeedForward(nn.Layer):
+    """Reference fused_transformer.py:437 — LN + linear/act/dropout/
+    linear with pre- or post-norm placement. `ln1_*` attrs configure the
+    pre-norm, `ln2_*` the post-norm (whichever placement is active)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(
+            d_model, dim_feedforward, weight_attr=linear1_weight_attr,
+            bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(
+            dim_feedforward, d_model, weight_attr=linear2_weight_attr,
+            bias_attr=linear2_bias_attr)
+        scale_attr = ln1_scale_attr if normalize_before else ln2_scale_attr
+        bias_attr = ln1_bias_attr if normalize_before else ln2_bias_attr
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon,
+                                 weight_attr=scale_attr,
+                                 bias_attr=bias_attr)
+        self.act = getattr(nn.functional, activation)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = self.linear2(self.act_dropout(self.act(self.linear1(x))))
+        x = residual + self.dropout(x)
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Reference fused_transformer.py:914 — a stack of pre-norm decoder
+    blocks run as ONE program. Full-sequence forward; the reference's
+    incremental decode path (cache_kvs/pre_caches/time_step/rotary)
+    belongs to `text.models.GPTForCausalLM.generate`, which carries a
+    static KV cache — those arguments are rejected loudly rather than
+    silently ignored. Output is the raw residual stream (no extra final
+    norm — the surrounding model normalizes, as in the reference)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, epsilon=1e-5,
+                 name=None):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError(
+                "FusedMultiTransformer is pre-norm only (the reference "
+                "fused_multi_transformer is pre-norm only as well)")
+        if epsilon != 1e-5:
+            raise NotImplementedError(
+                "per-layer norm epsilon is fixed at 1e-5 here "
+                "(TransformerEncoderLayer default)")
+        self.layers = nn.LayerList([
+            nn.TransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout=dropout_rate, activation=activation,
+                normalize_before=True)
+            for _ in range(num_layers)])
+
+    _DECODE_ARGS = ("caches", "pre_caches", "rotary_embs", "seq_lens",
+                    "time_step")
+
+    def forward(self, x, attn_mask=None, **kwargs):
+        for arg in self._DECODE_ARGS:
+            if kwargs.pop(arg, None) is not None:
+                raise NotImplementedError(
+                    f"{arg}: incremental/rotary decode is served by "
+                    "text.models.GPTForCausalLM.generate (static KV "
+                    "cache) — this layer runs full sequences")
+        if kwargs:
+            raise TypeError(f"unexpected arguments {sorted(kwargs)}")
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x

@@ -1,0 +1,70 @@
+"""incubate.nn fused transformer layers (reference incubate/nn/layer/fused_transformer.py)."""
+class TestIncubateFusedLayers:
+    def test_fused_feedforward_pre_and_post_norm(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedFeedForward
+
+        paddle.seed(0)
+        x = paddle.randn([2, 5, 16])
+        for pre in (True, False):
+            ffn = FusedFeedForward(16, 32, dropout_rate=0.0,
+                                   normalize_before=pre,
+                                   activation="gelu")
+            out = ffn(x)
+            assert out.shape == [2, 5, 16]
+            assert np.isfinite(out.numpy()).all()
+            # residual path: output differs from plain FFN of x
+            assert not np.allclose(out.numpy(), x.numpy())
+        # gradients flow to both linears
+        out = ffn(x)
+        out.sum().backward()
+        assert ffn.linear1.weight.grad is not None
+        assert ffn.linear2.weight.grad is not None
+
+    def test_fused_multi_transformer_stack(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        paddle.seed(0)
+        m = FusedMultiTransformer(16, 4, 32, num_layers=3)
+        x = paddle.randn([2, 6, 16])
+        out = m(x)
+        assert out.shape == [2, 6, 16]
+        assert np.isfinite(out.numpy()).all()
+        with _pytest.raises(NotImplementedError):
+            m(x, caches=[])
+        with _pytest.raises(ValueError):
+            FusedMultiTransformer(16, 4, 32, normalize_before=False)
+
+    def test_reference_decode_args_rejected_and_attrs_honored(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                            FusedMultiTransformer)
+
+        m = FusedMultiTransformer(16, 4, 32, num_layers=1)
+        x = paddle.randn([1, 4, 16])
+        with _pytest.raises(NotImplementedError, match="rotary"):
+            m(x, rotary_embs=x)
+        with _pytest.raises(TypeError, match="unexpected"):
+            m(x, bogus_arg=1)
+        with _pytest.raises(NotImplementedError, match="epsilon"):
+            FusedMultiTransformer(16, 4, 32, epsilon=1e-6)
+        # ln attrs reach the norm parameters
+        ffn = FusedFeedForward(
+            8, 16, normalize_before=True,
+            ln1_scale_attr=nn.ParamAttr(
+                initializer=nn.initializer.Constant(0.25)))
+        np.testing.assert_allclose(ffn.norm.weight.numpy(), 0.25)
+        # instances pickle (module-level classes, not factory locals)
+        import pickle
+
+        assert pickle.dumps(FusedFeedForward) is not None

@@ -141,3 +141,4 @@ def test_pairwise_distance_inf_norm():
     b = paddle.to_tensor(np.array([[4.0, 0.0]]))
     got = F.pairwise_distance(a, b, p=float("inf")).numpy()
     np.testing.assert_allclose(got, [4.0], rtol=1e-3)
+
