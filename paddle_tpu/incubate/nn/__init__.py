@@ -8,8 +8,8 @@ flash-attention kernel when eligible, so the attention/encoder classes
 alias the dense implementations; FusedFeedForward and
 FusedMultiTransformer are thin real layers over the same fusing
 primitives (one XLA fusion cluster per block after jit)."""
-from .. import nn
-from ..nn.layer.transformer import (  # noqa: F401
+from ... import nn
+from ...nn.layer.transformer import (  # noqa: F401
     MultiHeadAttention as FusedMultiHeadAttention,
     TransformerEncoderLayer as FusedTransformerEncoderLayer,
 )
@@ -103,3 +103,6 @@ class FusedMultiTransformer(nn.Layer):
         for layer in self.layers:
             x = layer(x, src_mask=attn_mask)
         return x
+
+
+from . import functional  # noqa: E402,F401

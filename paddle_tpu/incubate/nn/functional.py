@@ -1,0 +1,191 @@
+"""incubate.nn.functional — fused-op functional forms.
+
+Reference: python/paddle/incubate/nn/functional/fused_transformer.py
+(fused_feedforward:31, fused_bias_dropout_residual_layer_norm:225,
+fused_multi_head_attention:371, fused_multi_transformer:661) and
+fused_matmul_bias.py (:21, fused_linear:80). There each is ONE CUDA
+kernel; here each is a composition of tape ops that XLA fuses after jit
+— same signatures, same pseudo-code semantics (the reference documents
+its pseudo-code; these implement it literally). `ring_id` (tensor-model
+parallel over NCCL rings) has no analog — TP here is sharding on the
+mesh — and is accepted but must stay -1.
+"""
+from ...nn import functional as F
+
+__all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_multi_head_attention", "fused_multi_transformer"]
+
+
+def _check_ring(ring_id):
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "ring_id tensor parallelism is NCCL-specific; use mesh "
+            "sharding (fleet.meta_parallel mp layers) instead")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """(reference fused_matmul_bias.py:21) matmul + bias add."""
+    import paddle_tpu as paddle
+
+    out = paddle.matmul(x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """(reference fused_matmul_bias.py:80)."""
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """(reference fused_transformer.py:31; pseudo-code implemented
+    literally)."""
+    _check_ring(ring_id)
+    d_model = x.shape[-1]
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = F.layer_norm(out, d_model, ln1_scale, ln1_bias, ln1_epsilon)
+    out = fused_matmul_bias(out, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = fused_matmul_bias(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d_model, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """(reference fused_transformer.py:225):
+    layer_norm(residual + dropout(x + bias))."""
+    out = x if bias is None else x + bias
+    out = residual + F.dropout(out, dropout_rate, training=training,
+                               mode=mode)
+    return F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, name=None):
+    """(reference fused_transformer.py:371) self-attention with fused
+    qkv projection. `qkv_weight`: [3, num_heads, head_dim, d_model];
+    `qkv_bias`: [3, num_heads, head_dim]."""
+    import paddle_tpu as paddle
+
+    _check_ring(ring_id)
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv incremental decode: use "
+            "text.models.GPTForCausalLM.generate")
+    _, n_heads, head_dim, d_model = qkv_weight.shape
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = F.layer_norm(out, d_model, pre_ln_scale, pre_ln_bias,
+                           pre_ln_epsilon)
+    # [b, s, d] @ [d, 3*h*hd] -> [b, s, 3, h, hd]
+    b, s = out.shape[0], out.shape[1]
+    w = paddle.transpose(paddle.reshape(
+        qkv_weight, [3 * n_heads * head_dim, d_model]), [1, 0])
+    qkv = paddle.matmul(out, w)
+    if qkv_bias is not None:
+        qkv = qkv + paddle.reshape(qkv_bias, [3 * n_heads * head_dim])
+    qkv = paddle.reshape(qkv, [b, s, 3, n_heads, head_dim])
+    qkv = paddle.transpose(qkv, [2, 0, 3, 1, 4])  # 3, b, h, s, hd
+    q = qkv[0] * (head_dim ** -0.5)
+    k, v = qkv[1], qkv[2]
+    scores = paddle.matmul(q, k, transpose_y=True)  # b, h, s, s
+    if attn_mask is not None:
+        scores = scores + attn_mask
+    probs = F.softmax(scores, axis=-1)
+    probs = F.dropout(probs, attn_dropout_rate, training=training,
+                      mode=mode)
+    ctx = paddle.matmul(probs, v)  # b, h, s, hd
+    ctx = paddle.reshape(paddle.transpose(ctx, [0, 2, 1, 3]),
+                         [b, s, n_heads * head_dim])
+    out = fused_matmul_bias(ctx, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d_model, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """(reference fused_transformer.py:661) pre-norm decoder stack as a
+    python loop over the per-layer fused ops (XLA fuses per block)."""
+    _check_ring(ring_id)
+    for arg, label in ((cache_kvs, "cache_kvs"), (pre_caches,
+                       "pre_caches"), (rotary_embs, "rotary_embs"),
+                      (time_step, "time_step")):
+        if arg is not None:
+            raise NotImplementedError(
+                f"{label}: incremental decode is served by "
+                "text.models.GPTForCausalLM.generate")
+    if not pre_layer_norm:
+        raise NotImplementedError("reference op is pre-norm only")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "trans_qkvw=False weight layout is not supported")
+    # bias/affine lists are Optional in the reference — normalize None
+    # to per-layer Nones (the per-layer ops run bias-free then)
+    L = len(qkv_weights)
+    none_l = [None] * L
+    qkv_biases = qkv_biases if qkv_biases is not None else none_l
+    linear_biases = linear_biases if linear_biases is not None else none_l
+    ffn1_biases = ffn1_biases if ffn1_biases is not None else none_l
+    ffn2_biases = ffn2_biases if ffn2_biases is not None else none_l
+    ln_biases = ln_biases if ln_biases is not None else none_l
+    ffn_ln_biases = ffn_ln_biases if ffn_ln_biases is not None else none_l
+    out = x
+    for i in range(L):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
+            pre_ln_epsilon=epsilon, qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], attn_mask=attn_mask,
+            dropout_rate=dropout_rate, attn_dropout_rate=dropout_rate,
+            training=training, mode=mode)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i], ffn1_biases[i],
+            ffn2_biases[i], ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i], dropout1_rate=dropout_rate,
+            dropout2_rate=dropout_rate, activation=activation,
+            ln1_epsilon=epsilon, pre_layer_norm=True, training=training,
+            mode=mode)
+    return out

@@ -68,3 +68,140 @@ class TestIncubateFusedLayers:
         import pickle
 
         assert pickle.dumps(FusedFeedForward) is not None
+
+
+class TestFusedFunctional:
+    def test_fused_linear_and_matmul_bias(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        x = paddle.randn([3, 8])
+        w = paddle.randn([8, 4])
+        b = paddle.randn([4])
+        out = IF.fused_linear(x, w, b)
+        np.testing.assert_allclose(
+            out.numpy(), x.numpy() @ w.numpy() + b.numpy(), rtol=1e-5)
+        out_t = IF.fused_linear(x, paddle.transpose(w, [1, 0]),
+                                transpose_weight=True)
+        np.testing.assert_allclose(out_t.numpy(), x.numpy() @ w.numpy(),
+                                   rtol=1e-5)
+
+    def test_fused_feedforward_matches_pseudocode(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.nn import functional as F
+
+        paddle.seed(0)
+        x = paddle.randn([2, 3, 8])
+        w1, w2 = paddle.randn([8, 16]), paddle.randn([16, 8])
+        out = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, pre_layer_norm=True,
+                                   activation="gelu")
+        want = x.numpy() + (F.gelu(
+            paddle.to_tensor(F.layer_norm(x, 8).numpy() @ w1.numpy()))
+            .numpy() @ w2.numpy())
+        np.testing.assert_allclose(out.numpy(), want, rtol=2e-5, atol=2e-5)
+        # gradient flows through the fused path
+        out2 = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                    dropout2_rate=0.0)
+        assert np.isfinite(out2.numpy()).all()
+
+    def test_fused_mha_matches_manual_attention(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(1)
+        b, s, h, hd = 2, 5, 2, 4
+        d = h * hd
+        x = paddle.randn([b, s, d])
+        qkv_w = paddle.randn([3, h, hd, d]) * 0.3
+        lin_w = paddle.randn([d, d]) * 0.3
+        out = IF.fused_multi_head_attention(
+            x, qkv_w, lin_w, pre_layer_norm=True, dropout_rate=0.0,
+            attn_dropout_rate=0.0)
+        # manual replay of the reference pseudo-code in numpy
+        from paddle_tpu.nn import functional as F
+
+        xn = F.layer_norm(x, d).numpy()
+        wq = qkv_w.numpy().reshape(3 * h * hd, d).T
+        qkv = (xn @ wq).reshape(b, s, 3, h, hd).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0] / np.sqrt(hd), qkv[1], qkv[2]
+        sc = q @ k.transpose(0, 1, 3, 2)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = (p @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+        want = x.numpy() + ctx @ lin_w.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=2e-4, atol=2e-4)
+
+    def test_fused_multi_transformer_stack_and_guards(self):
+        import numpy as np
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(2)
+        b, s, h, hd, L = 1, 4, 2, 4, 2
+        d, ffn = h * hd, 16
+        mk = lambda *shape: paddle.randn(list(shape)) * 0.2
+        args = dict(
+            ln_scales=[paddle.ones([d])] * L,
+            ln_biases=[paddle.zeros([d])] * L,
+            qkv_weights=[mk(3, h, hd, d) for _ in range(L)],
+            qkv_biases=[paddle.zeros([3, h, hd])] * L,
+            linear_weights=[mk(d, d) for _ in range(L)],
+            linear_biases=[paddle.zeros([d])] * L,
+            ffn_ln_scales=[paddle.ones([d])] * L,
+            ffn_ln_biases=[paddle.zeros([d])] * L,
+            ffn1_weights=[mk(d, ffn) for _ in range(L)],
+            ffn1_biases=[paddle.zeros([ffn])] * L,
+            ffn2_weights=[mk(ffn, d) for _ in range(L)],
+            ffn2_biases=[paddle.zeros([d])] * L)
+        x = paddle.randn([b, s, d])
+        out = IF.fused_multi_transformer(x, **args)
+        assert out.shape == [b, s, d]
+        assert np.isfinite(out.numpy()).all()
+        with _pytest.raises(NotImplementedError, match="time_step"):
+            IF.fused_multi_transformer(x, time_step=1, **args)
+        with _pytest.raises(NotImplementedError, match="ring_id"):
+            IF.fused_multi_transformer(x, ring_id=3, **args)
+
+    def test_fused_multi_transformer_biases_and_scales_wired(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(3)
+        b, s, h, hd, L = 1, 3, 2, 4, 1
+        d, ffn = h * hd, 8
+        mk = lambda *shape: paddle.randn(list(shape)) * 0.2
+        base = dict(
+            ln_scales=[paddle.ones([d])], ln_biases=None,
+            qkv_weights=[mk(3, h, hd, d)], qkv_biases=None,
+            linear_weights=[mk(d, d)], linear_biases=None,
+            ffn_ln_scales=[paddle.ones([d])], ffn_ln_biases=None,
+            ffn1_weights=[mk(d, ffn)], ffn1_biases=None,
+            ffn2_weights=[mk(ffn, d)], ffn2_biases=None)
+        x = paddle.randn([b, s, d])
+        out_nobias = IF.fused_multi_transformer(x, **base)  # None lists OK
+        # every bias/affine argument must CHANGE the output when nonzero
+        for key, shape in (("qkv_biases", [3, h, hd]),
+                           ("linear_biases", [d]),
+                           ("ffn1_biases", [ffn]), ("ffn2_biases", [d]),
+                           ("ln_biases", [d]), ("ffn_ln_biases", [d])):
+            mod = dict(base)
+            mod[key] = [mk(*shape) + 0.5]
+            out = IF.fused_multi_transformer(x, **mod)
+            assert not np.allclose(out.numpy(), out_nobias.numpy()), key
+        mod = dict(base)
+        mod["ffn_ln_scales"] = [paddle.ones([d]) * 3.0]
+        assert not np.allclose(
+            IF.fused_multi_transformer(x, **mod).numpy(),
+            out_nobias.numpy())
