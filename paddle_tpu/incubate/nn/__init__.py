@@ -106,3 +106,16 @@ class FusedMultiTransformer(nn.Layer):
 
 
 from . import functional  # noqa: E402,F401
+
+# reference layer-module path: incubate.nn.layer.fused_transformer
+import sys as _sys
+import types as _types
+
+layer = _types.ModuleType(__name__ + ".layer")
+fused_transformer = _types.ModuleType(__name__ + ".layer.fused_transformer")
+for _cls in (FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+             FusedFeedForward, FusedMultiTransformer):
+    setattr(fused_transformer, _cls.__name__, _cls)
+layer.fused_transformer = fused_transformer
+_sys.modules[layer.__name__] = layer
+_sys.modules[fused_transformer.__name__] = fused_transformer
