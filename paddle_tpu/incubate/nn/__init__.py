@@ -15,7 +15,8 @@ from ...nn.layer.transformer import (  # noqa: F401
 )
 
 __all__ = ["FusedMultiHeadAttention", "FusedTransformerEncoderLayer",
-           "FusedFeedForward", "FusedMultiTransformer"]
+           "FusedFeedForward", "FusedMultiTransformer", "FusedLinear",
+           "FusedBiasDropoutResidualLayerNorm"]
 
 
 class FusedFeedForward(nn.Layer):
@@ -107,15 +108,69 @@ class FusedMultiTransformer(nn.Layer):
 
 from . import functional  # noqa: E402,F401
 
+class FusedLinear(nn.Linear):
+    """Reference incubate/nn/layer/fused_linear.py — linear whose matmul
+    and bias-add fuse into one kernel (XLA does this for any Linear; the
+    subclass exists for source compatibility; `transpose_weight` stores
+    the weight transposed)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        if transpose_weight:
+            raise NotImplementedError(
+                "transpose_weight storage layout is a cublasLt detail; "
+                "store weights [in, out] as nn.Linear does")
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+        self.transpose_weight = transpose_weight
+        self.name = name
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """Reference fused_transformer.py:109 —
+    layer_norm(residual + dropout(x + bias)) as one fusion cluster.
+    Parameter names match the reference state-dict keys
+    (linear_bias / ln_scale / ln_bias) so checkpoints port."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0
+        self.embed_dim = embed_dim
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.name = name
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
 # reference layer-module path: incubate.nn.layer.fused_transformer
 import sys as _sys
 import types as _types
 
 layer = _types.ModuleType(__name__ + ".layer")
 fused_transformer = _types.ModuleType(__name__ + ".layer.fused_transformer")
+fused_linear_mod = _types.ModuleType(__name__ + ".layer.fused_linear")
+fused_linear_mod.FusedLinear = FusedLinear
+layer.fused_linear = fused_linear_mod
 for _cls in (FusedMultiHeadAttention, FusedTransformerEncoderLayer,
-             FusedFeedForward, FusedMultiTransformer):
+             FusedFeedForward, FusedMultiTransformer, FusedLinear,
+             FusedBiasDropoutResidualLayerNorm):
     setattr(fused_transformer, _cls.__name__, _cls)
 layer.fused_transformer = fused_transformer
 _sys.modules[layer.__name__] = layer
 _sys.modules[fused_transformer.__name__] = fused_transformer
+_sys.modules[fused_linear_mod.__name__] = fused_linear_mod

@@ -205,3 +205,38 @@ class TestFusedFunctional:
         assert not np.allclose(
             IF.fused_multi_transformer(x, **mod).numpy(),
             out_nobias.numpy())
+
+
+def test_fused_linear_layer_and_bias_dropout_residual_ln():
+    import numpy as np
+    import pytest as _pytest
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import (FusedBiasDropoutResidualLayerNorm,
+                                        FusedLinear)
+
+    paddle.seed(0)
+    lin = FusedLinear(8, 4)
+    x = paddle.randn([3, 8])
+    np.testing.assert_allclose(
+        lin(x).numpy(),
+        x.numpy() @ lin.weight.numpy() + lin.bias.numpy(), rtol=1e-5)
+    with _pytest.raises(NotImplementedError):
+        FusedLinear(8, 4, transpose_weight=True)
+
+    m = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    # reference state-dict keys so checkpoints port
+    assert sorted(m.state_dict()) == ["linear_bias", "ln_bias", "ln_scale"]
+    res = paddle.randn([3, 8])
+    out = m(x * 0 + 1.0, res)  # x+bias deterministic
+    want = (res.numpy() + 1.0 + m.linear_bias.numpy())
+    want = (want - want.mean(-1, keepdims=True)) / np.sqrt(
+        want.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), want, rtol=2e-5, atol=2e-5)
+    out.sum().backward()
+    assert m.linear_bias.grad is not None
+    # reference import path for FusedLinear
+    from paddle_tpu.incubate.nn.layer.fused_linear import (
+        FusedLinear as FL2)
+
+    assert FL2 is FusedLinear

@@ -243,6 +243,11 @@ _REF_INTERNAL = {
     ("text", "text/__init__.py"),
     ("autograd", "autograd/__init__.py"),
     ("onnx", "onnx/__init__.py"),
+    ("reader", "reader/__init__.py"),
+    ("dataset", "dataset/__init__.py"),
+    ("sysconfig", "sysconfig.py"),
+    ("incubate.nn", "incubate/nn/__init__.py"),
+    ("distributed.communication", "distributed/communication/__init__.py"),
 ])
 def test_export_parity_with_reference(name, relpath):
     """Every public symbol the reference exports from paddle.<name> must
