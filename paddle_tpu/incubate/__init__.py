@@ -8,6 +8,13 @@ from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
+from . import optimizer_functional as _optimizer_functional
+import sys as _sys
+
+# reference module paths: incubate.optimizer.functional (minimize_bfgs /
+# minimize_lbfgs), incubate.tensor, incubate.operators
+optimizer.functional = _optimizer_functional
+_sys.modules[__name__ + ".optimizer.functional"] = _optimizer_functional
 # NOTE: incubate.multiprocessing is intentionally NOT imported eagerly —
 # importing it registers shm reducers on ForkingPickler, changing Tensor
 # pickling semantics process-wide (single-consumer ownership transfer).
@@ -170,3 +177,7 @@ class _XPUNamespace:
 
 
 xpu = _XPUNamespace()
+
+
+from . import operators  # noqa: E402,F401
+from . import tensor  # noqa: E402,F401

@@ -1,0 +1,10 @@
+"""incubate.operators (reference:
+python/paddle/incubate/operators/__init__.py) — fused/graph op
+namespace; canonical implementations in incubate/__init__."""
+from . import (  # noqa: F401
+    graph_khop_sampler, graph_sample_neighbors, graph_send_recv,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv", "graph_khop_sampler",
+           "graph_sample_neighbors"]

@@ -1,0 +1,14 @@
+"""incubate.tensor (reference: python/paddle/incubate/tensor/__init__.py
++ math.py) — segment reduction op namespace; canonical implementations
+in incubate/__init__ (jax.ops.segment_* backed)."""
+import sys as _sys
+import types as _types
+
+from . import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+math = _types.ModuleType(__name__ + ".math")
+for _name in __all__:
+    setattr(math, _name, globals()[_name])
+_sys.modules[math.__name__] = math

@@ -1,0 +1,74 @@
+"""incubate.optimizer.functional minimize_bfgs / minimize_lbfgs
+(reference incubate/optimizer/functional/{bfgs,lbfgs}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.optimizer.functional import (minimize_bfgs,
+                                                      minimize_lbfgs)
+
+
+def _rosen(x):
+    v = x._value if hasattr(x, "_value") else x
+    return (1 - v[0]) ** 2 + 100 * (v[1] - v[0] ** 2) ** 2
+
+
+def _quad(x):
+    import jax.numpy as jnp
+
+    v = x._value if hasattr(x, "_value") else x
+    A = jnp.asarray([[3.0, 0.5], [0.5, 1.0]])
+    return 0.5 * v @ A @ v - v.sum()
+
+
+@pytest.mark.parametrize("minimize", [minimize_bfgs, minimize_lbfgs])
+def test_rosenbrock_reaches_minimum(minimize):
+    out = minimize(_rosen, np.array([-1.2, 1.0], np.float32),
+                   max_iters=300)
+    pos, val = np.asarray(out[2].numpy()), float(out[3].numpy())
+    np.testing.assert_allclose(pos, [1.0, 1.0], atol=1e-3)
+    assert val < 1e-6
+    assert int(out[1].numpy()) > 0  # func-call counter advanced
+
+
+@pytest.mark.parametrize("minimize", [minimize_bfgs, minimize_lbfgs])
+def test_quadratic_exact_solution(minimize):
+    out = minimize(_quad, np.array([5.0, -3.0], np.float32),
+                   max_iters=100)
+    # argmin solves A x = [1, 1]
+    want = np.linalg.solve([[3.0, 0.5], [0.5, 1.0]], [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(out[2].numpy()), want,
+                               rtol=1e-4, atol=1e-4)
+    # gradient at the optimum vanishes
+    assert np.abs(np.asarray(out[4].numpy())).max() < 1e-3
+
+
+def test_bfgs_returns_inverse_hessian_and_tensor_inputs():
+    out = minimize_bfgs(_quad, paddle.to_tensor([4.0, 4.0]),
+                        max_iters=100)
+    assert len(out) == 6
+    Hinv = np.asarray(out[5].numpy())
+    want = np.linalg.inv([[3.0, 0.5], [0.5, 1.0]])
+    np.testing.assert_allclose(Hinv, want, atol=0.05)
+
+
+def test_converged_at_start():
+    out = minimize_lbfgs(
+        lambda x: ((x._value if hasattr(x, "_value") else x) ** 2).sum(),
+        np.zeros(3, np.float32))
+    assert bool(np.asarray(out[0].numpy()))  # already at the minimum
+
+
+def test_dtype_and_line_search_validation():
+    with pytest.raises(ValueError, match="line_search_fn"):
+        minimize_bfgs(_quad, np.zeros(2, np.float32),
+                      line_search_fn="hager_zhang")
+    with pytest.raises(ValueError, match="dtype"):
+        minimize_bfgs(_quad, np.zeros(2, np.float32), dtype="float16")
+    # x64 is enabled in the test env: float64 must run in float64
+    out = minimize_bfgs(_quad, np.array([5.0, -3.0]), dtype="float64",
+                        max_iters=100, tolerance_grad=1e-12)
+    assert out[2].numpy().dtype == np.float64
+    want = np.linalg.solve([[3.0, 0.5], [0.5, 1.0]], [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(out[2].numpy()), want,
+                               rtol=1e-6)  # beyond float32 resolution
