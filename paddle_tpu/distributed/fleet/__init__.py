@@ -14,6 +14,7 @@ from . import data_generator  # noqa: F401
 from . import dataset  # noqa: F401
 from . import elastic  # noqa: F401
 from . import meta_optimizers  # noqa: F401
+from . import utils  # noqa: F401
 from . import topology as topo_mod
 from .topology import CommunicateTopology, HybridCommunicateGroup
 

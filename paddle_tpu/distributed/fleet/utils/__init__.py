@@ -234,3 +234,5 @@ class DistributedInfer:
 
 
 from .hybrid_parallel_inference import HybridParallelInferenceHelper  # noqa: E402,F401
+from . import hybrid_parallel_util  # noqa: E402,F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: E402,F401

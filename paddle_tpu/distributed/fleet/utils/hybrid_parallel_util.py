@@ -1,0 +1,108 @@
+"""fleet.utils.hybrid_parallel_util (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+fused param broadcast / gradient allreduce helpers used by the hybrid
+wrappers).
+
+TPU-native: inside `DistributedTrainStep` gradient sync is
+compiler-emitted from shardings and these helpers are unnecessary; they
+serve the EAGER multi-process path (xproc collectives), where fusing
+many small grads into one flat buffer saves per-call latency exactly as
+the reference's coalesced allreduce does. The eager path implements only
+the WORLD group (xproc contract) — hybrid topologies whose target group
+is a strict subset of the processes must use the compiled SPMD path, and
+these helpers raise rather than silently reducing over the wrong ranks.
+"""
+import numpy as np
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters"]
+
+
+def _group_is_world(hcg, axis):
+    """True when the hcg's `axis` group spans every process (the only
+    group the eager xproc path implements)."""
+    if hcg is None:
+        return True
+    sizes = {
+        "dp": hcg.get_data_parallel_world_size(),
+        "mp": hcg.get_model_parallel_world_size(),
+        "pp": hcg.get_pipe_parallel_world_size(),
+    }
+    others = [v for k, v in sizes.items() if k != axis]
+    return all(v in (None, 1) for v in others)
+
+
+def _grad_tensors(parameters):
+    return [p for p in parameters
+            if getattr(p, "grad", None) is not None]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """All-reduce every parameter's `.grad` in ONE flat buffer per dtype
+    (reference hybrid_parallel_util.py fused_allreduce_gradients, which
+    coalesces per-dtype groups the same way)."""
+    from ...xproc import all_reduce_np, is_multiprocess
+
+    if not is_multiprocess():
+        return  # single process: the reduce is the identity
+    if not _group_is_world(hcg, "dp"):
+        raise NotImplementedError(
+            "eager fused allreduce only supports a dp group spanning all "
+            "processes; hybrid dp×mp/pp jobs sync grads inside the "
+            "compiled SPMD step (DistributedTrainStep)")
+    params = _grad_tensors(parameter_list)
+    if not params:
+        return
+    import jax.numpy as jnp
+
+    from ....tensor_core import Tensor
+
+    by_dtype = {}
+    for p in params:
+        g = np.asarray(p.grad._value if hasattr(p.grad, "_value")
+                       else p.grad.numpy())
+        by_dtype.setdefault(g.dtype.str, []).append((p, g))
+    for _, group in sorted(by_dtype.items()):
+        flat = np.concatenate([g.reshape(-1) for _, g in group])
+        flat = np.asarray(all_reduce_np(flat))
+        off = 0
+        for p, g in group:
+            p.grad = Tensor(jnp.asarray(
+                flat[off:off + g.size].reshape(g.shape)),
+                stop_gradient=True)
+            off += g.size
+
+
+def _broadcast_params(parameters, src=0):
+    from ...xproc import broadcast_np, is_multiprocess
+
+    if not is_multiprocess():
+        return
+    import jax.numpy as jnp
+
+    for p in parameters:
+        arr = np.asarray(p._value)
+        p._value = jnp.asarray(np.asarray(broadcast_np(arr, src=src)))
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    """Sync params across the mp group (reference
+    broadcast_mp_parameters syncs the NON-sliced ones; in this design
+    sliced params never exist as divergent eager copies — TP slicing is
+    a sharding over the mesh — so every eager param is shared)."""
+    if not _group_is_world(hcg, "mp"):
+        raise NotImplementedError(
+            "eager mp broadcast only supports an mp group spanning all "
+            "processes; hybrid topologies hold TP shards as mesh "
+            "placements, which need no eager sync")
+    _broadcast_params(list(model.parameters()))
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    """Sync params across the dp group at start-up (reference
+    broadcast_dp_parameters)."""
+    if not _group_is_world(hcg, "dp"):
+        raise NotImplementedError(
+            "eager dp broadcast only supports a dp group spanning all "
+            "processes; use the compiled SPMD path for hybrid meshes")
+    _broadcast_params(list(model.parameters()))

@@ -51,6 +51,19 @@ def main():
     auc.update(s[half], y[half])
     global_auc = auc.accumulate()
 
+    # fused grad allreduce: flat-buffer sum across ranks
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.utils import fused_allreduce_gradients
+
+    net = nn.Linear(3, 2)
+    net.weight._value = paddle.to_tensor(
+        np.zeros((3, 2), np.float32))._value
+    out = net(paddle.to_tensor(np.full((1, 3), float(rank + 1),
+                                       np.float32)))
+    out.sum().backward()
+    fused_allreduce_gradients(list(net.parameters()))
+    fused_grad = net.weight.grad.numpy().tolist()
+
     dist.barrier()
     with open(os.path.join(out_dir, f"out_{rank}.json"), "w") as f:
         json.dump({
@@ -62,6 +75,7 @@ def main():
             "gathered": [g.numpy().tolist() for g in gathered],
             "p2p": theirs.numpy().tolist(),
             "global_auc": global_auc,
+            "fused_grad": fused_grad,
         }, f)
 
 

@@ -211,3 +211,51 @@ class TestNewNamespaceModules:
         ips, cur, eps = cloud_utils.get_cloud_cluster()
         assert ips == ["10.0.0.1", "10.0.0.2"] and len(eps) == 2
         assert cloud_utils.get_trainers_num() == 2
+
+    def test_hybrid_parallel_util_guards(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+            broadcast_dp_parameters, fused_allreduce_gradients)
+
+        # single-process: identity, and grad objects untouched
+        net = nn.Linear(4, 2)
+        net(paddle.randn([2, 4])).sum().backward()
+        before = net.weight.grad
+        fused_allreduce_gradients(list(net.parameters()))
+        assert net.weight.grad is before  # early return, no round trip
+        broadcast_dp_parameters(net)
+
+    def test_hybrid_parallel_util_subgroup_rejected(self):
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.utils import hybrid_parallel_util
+
+        class FakeHCG:  # dp group is a strict subset (mp=2)
+            def get_data_parallel_world_size(self):
+                return 2
+
+            def get_model_parallel_world_size(self):
+                return 2
+
+            def get_pipe_parallel_world_size(self):
+                return 1
+
+        # guard must fire BEFORE any collective, even single-process
+        hybrid_parallel_util._group_is_world(FakeHCG(), "dp") is False
+        net = nn.Linear(2, 2)
+        net(paddle.randn([1, 2])).sum().backward()
+        import paddle_tpu.distributed.xproc as xproc
+
+        orig = xproc.is_multiprocess
+        xproc.is_multiprocess = lambda: True
+        try:
+            with _pytest.raises(NotImplementedError, match="SPMD"):
+                hybrid_parallel_util.fused_allreduce_gradients(
+                    list(net.parameters()), hcg=FakeHCG())
+        finally:
+            xproc.is_multiprocess = orig

@@ -67,6 +67,9 @@ def test_two_process_collectives(tmp_path):
     for rank in (0, 1):
         assert abs(results[rank]["global_auc"] - want) < 1e-9, \
             (results[rank]["global_auc"], want)
+    # fused flat-buffer grad allreduce: sum of per-rank grads (1x + 2x)
+    for rank in (0, 1):
+        assert results[rank]["fused_grad"] == [[3.0, 3.0]] * 3
 
 
 def test_launch_failure_propagates(tmp_path):
