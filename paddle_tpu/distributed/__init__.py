@@ -12,6 +12,7 @@ from . import coordinator  # noqa: F401
 from . import entry_attr  # noqa: F401
 from . import models  # noqa: F401
 from . import parallel_with_gloo  # noqa: F401
+from . import passes  # noqa: F401
 from .communication import stream  # noqa: F401
 from . import metric  # noqa: F401
 from . import env  # noqa: F401

@@ -1,0 +1,252 @@
+"""distributed.passes — program-level distributed optimization passes.
+
+Reference: python/paddle/distributed/passes/ (pass_base.py registry +
+auto_parallel_{amp,fp16,recompute,...}.py — protobuf-program rewriters
+applied by fleet/auto-parallel before execution).
+
+TPU-native: a "pass" transforms the static facade's Program by WRAPPING
+its stage closures — the rewrite happens at trace time, and XLA compiles
+the wrapped computation. Implemented passes do real work:
+
+- ``auto_parallel_amp`` / ``auto_parallel_fp16``: stages run under
+  `amp.auto_cast` (bf16 / fp16), same cast-list semantics as eager O1.
+- ``auto_parallel_recompute``: stages run under `jax.checkpoint`
+  (optionally a named policy via the `policy` attr).
+- ``fuse_all_reduce`` / ``auto_parallel_sharding`` /
+  ``auto_parallel_gradient_merge``: REGISTERED but apply() raises
+  NotImplementedError naming the mechanism that replaces them
+  (XLA collective fusion; DistributedTrainStep zero_level /
+  gradient-merge config). Registering-then-raising keeps the
+  reference's discovery surface without pretending a no-op did work.
+"""
+
+__all__ = ["PassContext", "PassBase", "PassManager", "new_pass",
+           "register_pass"]
+
+_MISSING = object()
+
+_PASS_REGISTRY = {}
+
+
+class PassContext:
+    """(reference pass_base.py:21)."""
+
+    def __init__(self):
+        self._attrs = {}
+        self._passes = []
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    @property
+    def passes(self):
+        return list(self._passes)
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class PassBase:
+    """(reference pass_base.py:52). Subclasses implement
+    `_apply_single_impl(main_program, startup_program, context)`."""
+
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        context = context or PassContext()
+        if not isinstance(main_programs, (list, tuple)):
+            main_programs = [main_programs]
+        if startup_programs is None:
+            startup_programs = [None] * len(main_programs)
+        elif not isinstance(startup_programs, (list, tuple)):
+            startup_programs = [startup_programs]
+        if len(startup_programs) != len(main_programs):
+            raise ValueError(
+                f"{len(main_programs)} main programs but "
+                f"{len(startup_programs)} startup programs — zip would "
+                "silently skip the excess")
+        for mp, sp in zip(main_programs, startup_programs):
+            self._apply_single_impl(mp, sp, context)
+        context._passes.append(self)
+        return context
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError
+
+
+def _wrap_stages(program, wrapper):
+    program.stages[:] = [wrapper(stage) for stage in program.stages]
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Stages execute under amp.auto_cast (reference
+    auto_parallel_amp.py rewrites cast ops into the program)."""
+
+    dtype = "bfloat16"
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from .. import amp
+
+        level = self.get_attr("level", "O1")
+        dtype = self.get_attr("dtype", self.dtype)
+
+        def wrap(stage):
+            def amped(env):
+                with amp.auto_cast(level=level, dtype=dtype):
+                    return stage(env)
+
+            return amped
+
+        _wrap_stages(main_program, wrap)
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    """(reference auto_parallel_fp16.py)."""
+
+    dtype = "float16"
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Stages recompute activations in backward (reference
+    auto_parallel_recompute.py inserts the recompute subgraphs)."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from .fleet.recompute import recompute
+        from ..tensor_core import Tensor
+
+        policy = self.get_attr("policy")
+        # trainable params used INSIDE stages must be declared so the
+        # checkpoint tape threads them as inputs (grads only flow to
+        # declared inputs — fleet.recompute contract); recompute() picks
+        # them up through the `parameters` attribute set below
+        params = list(self.get_attr("parameters") or [])
+
+        def wrap(stage):
+            # stages communicate by MUTATING the env dict; recompute
+            # needs a tensors-in/tensors-out function, so snapshot the
+            # env's tensors as inputs, run the stage on a copy, and
+            # merge the produced values back (deterministic key order)
+            def rc(env):
+                keys_in = sorted(k for k, v in env.items()
+                                 if isinstance(v, Tensor))
+                out_keys = []
+                side = {}     # non-Tensor writes (trace-time effects)
+                removed = []  # keys the stage deleted
+
+                def fn(*vals):
+                    local = dict(env)
+                    inserted = dict(zip(keys_in, vals))
+                    local.update(inserted)
+                    stage(local)
+                    # produced = keys the stage (re)assigned — compare
+                    # against the wrapper we inserted, NOT env's (inputs
+                    # arrive as fresh wrappers, identity vs env is
+                    # always False)
+                    produced = sorted(
+                        k for k, v in local.items()
+                        if isinstance(v, Tensor)
+                        and v is not inserted.get(k, env.get(k)))
+                    out_keys[:] = produced
+                    side.clear()
+                    side.update({k: v for k, v in local.items()
+                                 if not isinstance(v, Tensor)
+                                 and env.get(k, _MISSING) is not v})
+                    removed[:] = [k for k in env if k not in local]
+                    return tuple(local[k] for k in produced)
+
+                fn.parameters = lambda: params
+                kwargs = {"policy": policy} if policy else {}
+                outs = recompute(fn, *[env[k] for k in keys_in], **kwargs)
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                env.update(zip(out_keys, outs))
+                env.update(side)
+                for k in removed:
+                    env.pop(k, None)
+
+            return rc
+
+        _wrap_stages(main_program, wrap)
+
+
+class _ReplacedByMechanism(PassBase):
+    mechanism = ""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError(
+            f"pass {self.name!r} has no program rewrite on this stack — "
+            f"{self.mechanism}")
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(_ReplacedByMechanism):
+    mechanism = ("XLA fuses/coalesces collectives during compilation; "
+                 "eager-path fusion lives in "
+                 "fleet.utils.fused_allreduce_gradients")
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(_ReplacedByMechanism):
+    mechanism = ("use DistributedTrainStep(zero_level=...) — ZeRO "
+                 "placements are PartitionSpecs, not program rewrites")
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(_ReplacedByMechanism):
+    mechanism = ("use DistributedStrategy.gradient_merge / micro-batch "
+                 "accumulation in the compiled step")
+
+
+def new_pass(name, pass_attrs=None):
+    """(reference pass_base.py new_pass)."""
+    try:
+        cls = _PASS_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown pass {name!r}; registered: "
+            f"{sorted(_PASS_REGISTRY)}") from None
+    p = cls()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """(reference pass_base.py PassManager) — apply a pass list in
+    order."""
+
+    def __init__(self, passes):
+        self._passes = [new_pass(p) if isinstance(p, str) else p
+                        for p in passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        context = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, context)
+        return context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

@@ -1,0 +1,113 @@
+"""distributed.passes — program-level pass registry over the static
+facade (reference distributed/passes/pass_base.py + auto_parallel_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed.passes import (PassContext, PassManager,
+                                           new_pass)
+
+
+def _program():
+    main = static.Program()
+    w = paddle.to_tensor(np.eye(4, dtype=np.float32) * 2.0)
+    with static.program_guard(main):
+        static.data("x", [None, 4], "float32")
+
+        def stage(env):
+            # matmul: the op class O1 auto_cast targets
+            h = paddle.matmul(env["x"], w) + 1.0
+            env["h"] = h
+            env["loss"] = (h * h).mean()
+
+        main.stages.append(stage)
+    return main
+
+
+def _run(main, x):
+    exe = static.Executor()
+    return exe.run(main, feed={"x": x}, fetch_list=["h", "loss"])
+
+
+def test_amp_pass_changes_compute_dtype():
+    x = np.ones((2, 4), np.float32)
+    main = _program()
+    h0, loss0 = _run(main, x)
+    assert str(np.asarray(h0).dtype) == "float32"
+    ctx = new_pass("auto_parallel_amp",
+                   {"level": "O1", "dtype": "bfloat16"}).apply(main)
+    assert isinstance(ctx, PassContext) and len(ctx.passes) == 1
+    h1, loss1 = _run(main, x)
+    assert "bfloat16" in str(np.asarray(h1).dtype)
+    np.testing.assert_allclose(np.asarray(loss1, np.float32),
+                               np.asarray(loss0), rtol=2e-2)
+
+
+def test_recompute_pass_preserves_numerics():
+    x = np.linspace(0, 1, 8, dtype=np.float32).reshape(2, 4)
+    main = _program()
+    h0, loss0 = _run(main, x)
+    new_pass("auto_parallel_recompute").apply(main)
+    h1, loss1 = _run(main, x)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(loss1), np.asarray(loss0),
+                               rtol=1e-6)
+
+
+def test_mechanism_passes_raise_with_pointer():
+    main = _program()
+    for name, hint in (("fuse_all_reduce", "XLA"),
+                       ("auto_parallel_sharding", "zero_level"),
+                       ("auto_parallel_gradient_merge", "gradient_merge")):
+        with pytest.raises(NotImplementedError, match=hint):
+            new_pass(name).apply(main)
+
+
+def test_pass_manager_and_unknown_pass():
+    main = _program()
+    pm = PassManager(["auto_parallel_recompute",
+                      new_pass("auto_parallel_amp", {"dtype": "bfloat16"})])
+    assert pm.names == ["auto_parallel_recompute", "auto_parallel_amp"]
+    pm.apply(main)
+    h, _ = _run(main, np.ones((1, 4), np.float32))
+    assert "bfloat16" in str(np.asarray(h).dtype)
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("nonexistent_pass")
+
+
+def test_recompute_pass_threads_parameters_and_side_effects():
+    from paddle_tpu import nn
+
+    main = static.Program()
+    lin = nn.Linear(4, 4)
+    with static.program_guard(main):
+        static.data("x", [None, 4], "float32")
+
+        def stage(env):
+            env["h"] = lin(env["x"])
+            env["loss"] = env["h"].mean()
+            env["step_tag"] = "ran"       # non-Tensor write must survive
+
+        main.stages.append(stage)
+    new_pass("auto_parallel_recompute",
+             {"parameters": list(lin.parameters())}).apply(main)
+    exe = static.Executor()
+    env_feed = np.ones((2, 4), np.float32)
+    res = exe.run(main, feed={"x": env_feed}, fetch_list=["loss"])
+    assert np.isfinite(np.asarray(res[0])).all()
+    # declared params receive gradients through the recompute tape
+    loss = None
+    # re-run eagerly via the wrapped stage to check grads flow
+    env = {"x": paddle.to_tensor(env_feed)}
+    main.stages[0](env)
+    env["loss"].backward()
+    assert lin.weight.grad is not None
+    assert env["step_tag"] == "ran"
+
+
+def test_apply_length_mismatch_rejected():
+    main1, main2 = _program(), _program()
+    with pytest.raises(ValueError, match="startup"):
+        new_pass("auto_parallel_recompute").apply(
+            [main1, main2], startup_programs=static.Program())
