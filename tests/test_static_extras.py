@@ -85,6 +85,9 @@ def test_sequence_conv_respects_boundaries():
 
 @pytest.mark.slow
 def test_builders():
+    # the spectral_norm one-iteration bound below is seed-sensitive —
+    # pin the stream so suite-order changes can't flake it
+    paddle.seed(7)
     assert snn.fc(paddle.randn([2, 3, 4]), 5).shape == [2, 5]
     assert snn.batch_norm(paddle.randn([2, 3, 4, 4])).shape == [2, 3, 4, 4]
     assert snn.layer_norm(paddle.randn([2, 6])).shape == [2, 6]
