@@ -170,6 +170,72 @@ class _Fleet:
         optimizer._fleet_strategy = st
         return optimizer
 
+    # -- PS-mode lifecycle (reference fleet.py init_server:~1210,
+    # init_worker, run_server, stop_worker; the_one_ps.py runtime). In
+    # this design trainers HOST their table shards (id-routed
+    # ShardedSparseTable) — there are no separate server processes, so
+    # server bring-up reduces to optional checkpoint restore and
+    # shutdown to flushing every live table.
+    def init_server(self, dirname=None, **kwargs):
+        if dirname is not None:
+            self.load_model(dirname)
+
+    def run_server(self):
+        """No separate server processes: trainers host their shards.
+        Kept callable so reference PS scripts run unmodified."""
+
+    def init_worker(self):
+        pass  # pull prefetch threads start lazily on first use
+
+    def stop_worker(self):
+        from ..ps import live_tables
+
+        for _, t in live_tables():
+            if hasattr(t, "flush"):
+                t.flush()
+
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        """Save every live PS table's state, keyed by table NAME and
+        rank — each rank of a ShardedSparseTable owns a disjoint shard,
+        so files must be per-rank or shards clobber each other on a
+        shared filesystem (reference fleet.save_persistables →
+        server-side per-shard table save)."""
+        import os
+
+        import numpy as np
+
+        from .. import env as _env
+        from ..ps import live_tables
+
+        if dirname is None:
+            raise ValueError(
+                "save_persistables needs dirname= (the checkpoint "
+                "directory)")
+        os.makedirs(dirname, exist_ok=True)
+        rank = _env.get_rank()
+        for name, t in live_tables():
+            if hasattr(t, "flush"):
+                t.flush()  # queued async pushes must reach the rows
+            sd = t.state_dict()
+            np.savez(os.path.join(dirname, f"{name}.rank{rank}.npz"),
+                     **{k: np.asarray(v) for k, v in sd.items()})
+
+    def load_model(self, dirname, mode=0):
+        import os
+
+        import numpy as np
+
+        from .. import env as _env
+        from ..ps import live_tables
+
+        rank = _env.get_rank()
+        for name, t in live_tables():
+            f = os.path.join(dirname, f"{name}.rank{rank}.npz")
+            if os.path.exists(f):
+                data = np.load(f)
+                t.set_state_dict({k: data[k] for k in data.files})
+
     @property
     def strategy(self):
         return self._strategy
@@ -185,6 +251,12 @@ worker_num = fleet.worker_num
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+init_server = fleet.init_server
+init_worker = fleet.init_worker
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+save_persistables = fleet.save_persistables
+load_model = fleet.load_model
 
 
 class TensorParallel:

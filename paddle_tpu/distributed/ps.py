@@ -36,7 +36,37 @@ from . import mesh as mesh_mod
 
 __all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
            "SSDSparseTable", "ShardedSparseTable", "make_sparse_table",
-           "resolve_rule", "SparseEmbedding", "ShardedEmbedding"]
+           "resolve_rule", "SparseEmbedding", "ShardedEmbedding",
+           "live_tables"]
+
+# every SparseEmbedding registers here so fleet.stop_worker()/
+# save_persistables can flush/save all live PS tables (the reference's
+# server-side table registry, the_one_ps.py _get_tables). Weak refs:
+# the registry must not keep dead embeddings' tables alive.
+import weakref as _weakref
+
+_LIVE_TABLES = []  # (name, weakref) pairs
+
+
+def _register_table(table, name=None):
+    for _, ref in _LIVE_TABLES:
+        if ref() is table:
+            return  # one table shared by several embeddings: register once
+    name = name or f"sparse_table_{len(_LIVE_TABLES)}"
+    _LIVE_TABLES.append((name, _weakref.ref(table)))
+
+
+def live_tables():
+    """(name, table) for every live registered table; dead refs pruned."""
+    out = []
+    alive = []
+    for name, ref in _LIVE_TABLES:
+        t = ref()
+        if t is not None:
+            out.append((name, t))
+            alive.append((name, ref))
+    _LIVE_TABLES[:] = alive
+    return out
 
 
 # ------------------------------------------------------ optimizer rules
@@ -511,6 +541,7 @@ class SparseEmbedding:
 
         self.table = table if table is not None else make_sparse_table(
             embedding_dim, rule=rule, backend=backend, path=path)
+        _register_table(self.table, name)
         self.dim = embedding_dim
         self._pool = None
         self._pending = None  # (key, uniq, inv, shape, future)

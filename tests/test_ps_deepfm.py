@@ -444,3 +444,59 @@ class TestSSDSparseTable:
             os.path.join(p, "ids.npy")) else None
         with _pytest.raises(ValueError, match="crash before flush"):
             SSDSparseTable(8, p)
+
+
+def test_fleet_ps_lifecycle(tmp_path):
+    """fleet PS-mode API: init_server/run_server/init_worker/stop_worker
+    + table save/restore (reference fleet.py PS lifecycle; here trainers
+    host their shards, so the lifecycle manages the live tables)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import live_tables
+
+    import pytest as _pytest
+
+    m = paddle.rec.DeepFM(num_fields=3, embed_dim=4, sparse=True)
+    assert len(live_tables()) >= 1
+    ids = np.arange(60).reshape(20, 3)
+    logits = m(paddle.to_tensor(ids))
+    logits.sum().backward()
+    fleet.init_worker()
+    fleet.run_server()  # callable no-op: trainers host their shards
+    with _pytest.raises(ValueError, match="dirname"):
+        fleet.save_persistables()
+    fleet.save_persistables(dirname=str(tmp_path / "ps"))
+    name, table = live_tables()[-1]
+    # files are per-name, per-rank (shards must not clobber on shared FS)
+    import os
+
+    assert os.path.exists(tmp_path / "ps" / f"{name}.rank0.npz")
+    want = table.pull(np.arange(10)).copy()
+    # clobber then restore
+    table.push(np.arange(10), np.ones((10, 4), np.float32))
+    fleet.init_server(str(tmp_path / "ps"))
+    np.testing.assert_allclose(table.pull(np.arange(10)), want,
+                               rtol=1e-6)
+    fleet.stop_worker()
+    # GC'd tables leave the registry (weakrefs, pruned on access)
+    import gc
+
+    from paddle_tpu.distributed.ps import SparseEmbedding
+
+    n_live = len(live_tables())
+
+    def scratch():
+        emb = SparseEmbedding(4, name="gc_probe")
+        emb(paddle.to_tensor(np.array([[1, 2]])))
+        assert len(live_tables()) == n_live + 1
+
+    scratch()
+    gc.collect()
+    assert len(live_tables()) == n_live
+    # sharing one table across two embeddings registers it ONCE
+    from paddle_tpu.distributed.ps import MemorySparseTable
+
+    shared = MemorySparseTable(4)
+    SparseEmbedding(4, table=shared)
+    SparseEmbedding(4, table=shared)
+    assert sum(1 for _, t in live_tables() if t is shared) == 1
