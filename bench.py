@@ -104,8 +104,15 @@ def bench_gpt():
     # in fp32 — the moments, not the params, were the traffic saved.)
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
 
+    # BENCH_GPT_FUSED_HEAD=1: head matmul + softmax-CE fused so the
+    # [b, s, vocab] logits never hit HBM (docs/PERF_NOTES.md hyp. 1).
+    # Off by default until tools/mfu_sweep.py measures it on-chip.
+    fused_head = os.environ.get("BENCH_GPT_FUSED_HEAD", "0") == "1"
+
     def loss_fn(m, ids):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
+            if fused_head:
+                return m.fused_head_loss(ids)
             return crit(m(ids), ids)
 
     step = paddle.jit.TrainStep(model, loss_fn, opt)

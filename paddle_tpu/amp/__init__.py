@@ -25,6 +25,11 @@ WHITE_LIST = {
     "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
     "conv2d_transpose", "conv3d_transpose", "bmm", "mm", "mv",
     "scaled_dot_product_attention", "flash_attention", "einsum",
+    # the fused head-CE does its own fp32 accumulation internally
+    # (preferred_element_type on the block matmuls); its x/w inputs must
+    # cast to bf16 like any other matmul or the whole point — bf16 MXU +
+    # halved weight streaming — is lost (int labels skip the cast)
+    "fused_linear_cross_entropy",
 }
 # numerically sensitive ops forced to fp32
 BLACK_LIST = {

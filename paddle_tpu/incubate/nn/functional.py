@@ -14,7 +14,12 @@ from ...nn import functional as F
 
 __all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
            "fused_bias_dropout_residual_layer_norm",
-           "fused_multi_head_attention", "fused_multi_transformer"]
+           "fused_multi_head_attention", "fused_multi_transformer",
+           "fused_linear_cross_entropy"]
+
+# head projection + softmax-CE without materializing [N, vocab] logits
+# (new capability, no reference analog; see nn/functional/loss.py)
+fused_linear_cross_entropy = F.fused_linear_cross_entropy
 
 
 def _check_ring(ring_id):

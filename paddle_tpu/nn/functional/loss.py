@@ -40,6 +40,7 @@ __all__ = [
     "triplet_margin_with_distance_loss",
     "hsigmoid_loss",
     "margin_cross_entropy",
+    "fused_linear_cross_entropy",
 ]
 
 
@@ -89,6 +90,135 @@ def _softmax_ce_bwd(res, g):
 
 
 _softmax_ce_core.defvjp(_softmax_ce_fwd, _softmax_ce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused LM-head projection + softmax CE, logits never materialized.
+#
+# The vocab head of a GPT-style model turns a [N, d] hidden block into
+# [N, V] logits (V ~ 50k) only to immediately reduce them to N scalars.
+# At b16·s1024 that intermediate is ~1.6 GB of bf16 HBM traffic in the
+# forward and again in the backward — the single largest slab of the
+# step (docs/PERF_NOTES.md hypothesis 1). This kernel scans over token
+# blocks: each block's logits live only inside one scan iteration
+# (XLA keeps them in registers/VMEM-sized tiles), the forward saves just
+# the per-token LSE [N], and the backward recomputes each block's logits
+# from (x, w) instead of loading them. FLOPs go up by the head fwd
+# matmul (~+50% of head cost); HBM traffic for the [N, V] slab goes to
+# zero. Same trade the reference's fused kernels make
+# (paddle/fluid/operators/fused/fused_attention_op.cu recomputes rather
+# than stores), applied to the head.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _linear_ce_core(x, w, bias, labels, block):
+    out, _ = _linear_ce_fwd(x, w, bias, labels, block)
+    return out
+
+
+def _block_logits(xi, w, bias):
+    # bf16 MXU matmul, fp32 accumulation/output: LSE stays accurate
+    # without an fp32 [block, V] weight copy.
+    logits = jnp.dot(xi, w, preferred_element_type=jnp.float32)
+    return logits + bias.astype(jnp.float32)
+
+
+def _linear_ce_fwd(x, w, bias, labels, block):
+    n = x.shape[0]
+    nb = n // block
+    xb = x.reshape(nb, block, x.shape[1])
+    lb = labels.reshape(nb, block)
+
+    def body(_, xl):
+        xi, li = xl
+        logits = _block_logits(xi, w, bias)
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        picked = jnp.take_along_axis(logits, li[:, None], axis=-1)[:, 0]
+        return None, (lse - picked, lse)
+
+    _, (loss, lse) = jax.lax.scan(body, None, (xb, lb))
+    return loss.reshape(n), (x, w, bias, labels, lse.reshape(n))
+
+
+def _linear_ce_bwd(block, res, g):
+    x, w, bias, labels, lse = res
+    n = x.shape[0]
+    nb = n // block
+    xb = x.reshape(nb, block, x.shape[1])
+    lb = labels.reshape(nb, block)
+    lseb = lse.reshape(nb, block)
+    gb = g.reshape(nb, block)
+
+    def body(carry, inp):
+        dw, db = carry
+        xi, li, lsei, gi = inp
+        logits = _block_logits(xi, w, bias)
+        p = jnp.exp(logits - lsei[:, None])
+        hit = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) == li[:, None]
+        d = (p - hit.astype(jnp.float32)) * gi[:, None]
+        dl = d.astype(w.dtype)  # bf16 operand for both MXU matmuls
+        dxi = jnp.dot(dl, w.T)
+        dw = dw + jnp.dot(xi.T, dl, preferred_element_type=jnp.float32)
+        db = db + jnp.sum(d, axis=0)
+        return (dw, db), dxi
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    db0 = jnp.zeros(bias.shape, jnp.float32)
+    (dw, db), dx = jax.lax.scan(body, (dw0, db0), (xb, lb, lseb, gb))
+    return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
+            db.astype(bias.dtype), None)
+
+
+_linear_ce_core.defvjp(_linear_ce_fwd, _linear_ce_bwd)
+
+
+def fused_linear_cross_entropy(x, weight, label, bias=None,
+                               transpose_weight=False, ignore_index=-100,
+                               reduction="mean", block_size=2048, name=None):
+    """Softmax CE of ``x @ weight (+ bias)`` without materializing logits.
+
+    ``x``: [..., d] hidden states; ``weight``: [d, V] (or [V, d] with
+    ``transpose_weight=True`` — the tied-embedding layout); ``label``:
+    [...] int class ids. Scans over ``block_size``-token blocks so the
+    [tokens, V] logits exist only tile-at-a-time; backward recomputes
+    them per block. See the design note above `_linear_ce_core`.
+    """
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    label = ensure_tensor(label)
+    tensors = [x, weight, label]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+
+    def jfn(xv, wv, lblv, *rest):
+        d = xv.shape[-1]
+        xf = xv.reshape(-1, d)
+        n = xf.shape[0]
+        wf = wv.T if transpose_weight else wv
+        vocab = wf.shape[1]
+        bv = rest[0] if rest else jnp.zeros((vocab,), xv.dtype)
+        lf = lblv.reshape(-1).astype(jnp.int32)
+        valid = lf != ignore_index
+        safe = jnp.where(valid, lf, 0)
+        # pad to a block multiple (shifted sequences make n = b*(s-1),
+        # rarely divisible); grad-of-slice zeros the pad rows' cotangent
+        block = min(block_size, max(n, 1))
+        npad = (-n) % block
+        if npad:
+            xf = jnp.pad(xf, ((0, npad), (0, 0)))
+            safe = jnp.pad(safe, (0, npad))
+        loss = _linear_ce_core(xf, wf, bv, safe, block)[:n]
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(valid.sum(), 1).astype(loss.dtype)
+            return loss.sum() / denom
+        if reduction == "sum":
+            return loss.sum()
+        return loss.reshape(lblv.shape)
+
+    return apply_jfn("fused_linear_cross_entropy", jfn, *tensors)
 
 
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",

@@ -326,6 +326,34 @@ class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
     def forward(self, input_ids):
         return self._logits_from_hidden(self.gpt(input_ids))
 
+    def fused_head_loss(self, input_ids, labels=None):
+        """Shifted next-token loss with the head projection and softmax-CE
+        fused (F.fused_linear_cross_entropy): the [b, s, vocab] logits are
+        never materialized in HBM — the dominant activation slab of the
+        step (docs/PERF_NOTES.md hypothesis 1). Single-chip / dp / sp
+        path; vocab-sharded TP training should keep forward() +
+        ParallelCrossEntropy (the vocab-parallel reduction lives there).
+        """
+        from ...distributed import mesh as mesh_mod
+
+        if mesh_mod.has_mesh() and mesh_mod.axis_size("mp") > 1:
+            raise ValueError(
+                "fused_head_loss computes softmax over the FULL vocab; "
+                "with mp>1 the tied head weight is vocab-sharded and the "
+                "result would be silently wrong. Use forward() + "
+                "GPTPretrainingCriterion (ParallelCrossEntropy) under TP.")
+        if labels is None:
+            labels = input_ids
+        x = self.gpt(input_ids)  # [b, s, d]
+        shift_x = manip.slice(x, [1], [0], [x.shape[1] - 1])
+        shift_labels = manip.slice(labels, [1], [1], [labels.shape[1]])
+        if self.lm_head is not None:
+            return F.fused_linear_cross_entropy(
+                shift_x, self.lm_head.weight, shift_labels)
+        return F.fused_linear_cross_entropy(
+            shift_x, self.gpt.wte.weight, shift_labels,
+            transpose_weight=True)
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """Shifted next-token vocab-parallel cross entropy."""

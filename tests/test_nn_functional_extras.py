@@ -272,3 +272,68 @@ def test_new_loss_finite_difference_grads():
             c, paddle.to_tensor(np.array([0, 1, 2])), margin2=0.2,
             scale=8.0),
         [cosv], wrt=[0])
+
+
+def test_fused_linear_cross_entropy_parity():
+    """Loss + grads (x, w, bias) match the materialized-logits path,
+    including non-block-divisible n, ignore_index, and both weight
+    layouts."""
+    rng = np.random.default_rng(7)
+    n, d, v = 37, 8, 11  # n prime-ish: exercises the pad path (block>n)
+    xv = rng.standard_normal((n, d)).astype(np.float32) * 0.3
+    wv = rng.standard_normal((d, v)).astype(np.float32) * 0.3
+    bv = rng.standard_normal(v).astype(np.float32) * 0.1
+    lbl = rng.integers(0, v, n)
+    lbl[::5] = -100  # ignore_index holes
+
+    def run(fused):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        w = paddle.to_tensor(wv, stop_gradient=False)
+        b = paddle.to_tensor(bv, stop_gradient=False)
+        y = paddle.to_tensor(lbl.astype(np.int64))
+        if fused:
+            loss = F.fused_linear_cross_entropy(x, w, y, bias=b,
+                                                block_size=16)
+        else:
+            logits = paddle.matmul(x, w) + b
+            loss = F.cross_entropy(logits, y)
+        loss.backward()
+        return (loss.numpy(), x.grad.numpy(), w.grad.numpy(),
+                b.grad.numpy())
+
+    got, ref = run(True), run(False)
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    # transposed (tied-embedding) layout, no bias, sum reduction
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    wt = paddle.to_tensor(wv.T.copy(), stop_gradient=False)
+    y = paddle.to_tensor(np.where(lbl < 0, 0, lbl).astype(np.int64))
+    loss = F.fused_linear_cross_entropy(x, wt, y, transpose_weight=True,
+                                        reduction="sum", block_size=8)
+    loss.backward()
+    x2 = paddle.to_tensor(xv, stop_gradient=False)
+    w2 = paddle.to_tensor(wv, stop_gradient=False)
+    ref2 = F.cross_entropy(paddle.matmul(x2, w2), y, reduction="sum")
+    ref2.backward()
+    np.testing.assert_allclose(loss.numpy(), ref2.numpy(), rtol=2e-5)
+    np.testing.assert_allclose(wt.grad.numpy(), w2.grad.numpy().T,
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpt_fused_head_loss_matches_criterion():
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        GPTPretrainingCriterion)
+
+    paddle.seed(11)
+    from paddle_tpu.text.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 64, (2, 9)).astype(np.int32))
+    ref = crit(model(ids), ids)
+    got = model.fused_head_loss(ids)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)

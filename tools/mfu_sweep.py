@@ -28,7 +28,7 @@ CACHE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
 
 
-def measure(batch, seq, block_q, block_k, iters=8):
+def measure(batch, seq, block_q, block_k, iters=8, fused_head=False):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -49,6 +49,10 @@ def measure(batch, seq, block_q, block_k, iters=8):
 
         def loss_fn(m, ids):
             with amp.auto_cast(level="O1", dtype="bfloat16"):
+                if fused_head:
+                    # head matmul + softmax-CE fused, [b,s,vocab] logits
+                    # never hit HBM (PERF_NOTES hypothesis 1)
+                    return m.fused_head_loss(ids)
                 return crit(m(ids), ids)
 
         step = paddle.jit.TrainStep(model, loss_fn, opt)
@@ -88,18 +92,21 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
 
     seq = 1024
-    configs = [("batch", b, seq, 512, 512) for b in (8, 16, 24, 32)]
+    configs = [("batch", b, seq, 512, 512, False) for b in (8, 16, 24, 32)]
+    # fused-head arm at the two batch front-runners: decides whether
+    # bench.py should flip BENCH_GPT_FUSED_HEAD on by default
+    configs += [("fusedce", b, seq, 512, 512, True) for b in (16, 24)]
     if not args.quick:
-        configs += [("blocks", 16, seq, bq, bk)
+        configs += [("blocks", 16, seq, bq, bk, False)
                     for bq in (256, 512, 1024)
                     for bk in (256, 512, 1024)
                     if (bq, bk) != (512, 512)]
     best = None
     print(f"{'kind':<8}{'batch':>6}{'bq':>6}{'bk':>6}{'ms':>10}"
           f"{'MFU':>8}{'compile_s':>10}")
-    for kind, b, s, bq, bk in configs:
+    for kind, b, s, bq, bk, fused in configs:
         try:
-            ms, mfu, comp = measure(b, s, bq, bk)
+            ms, mfu, comp = measure(b, s, bq, bk, fused_head=fused)
         except Exception as e:
             print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}      FAIL  {e!r}",
                   flush=True)
@@ -110,7 +117,7 @@ def main():
             best = (mfu, kind, b, bq, bk, ms)
     if best:
         mfu, kind, b, bq, bk, ms = best
-        print(f"\nBEST: batch={b} block_q={bq} block_k={bk} "
+        print(f"\nBEST: {kind} batch={b} block_q={bq} block_k={bk} "
               f"-> {ms:.1f} ms, MFU {mfu:.3f}", flush=True)
 
 
