@@ -200,8 +200,13 @@ def bench_bert():
     crit = BertPretrainingCriterion()
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
 
+    # see BENCH_GPT_FUSED_HEAD — same fused-vocab-head trade for MLM
+    fused_head = os.environ.get("BENCH_BERT_FUSED_HEAD", "0") == "1"
+
     def loss_fn(m, ids, labels, nsp):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
+            if fused_head:
+                return m.fused_mlm_loss(ids, labels, nsp_labels=nsp)
             mlm, nsp_logits = m(ids)
             return crit(mlm, labels, nsp_logits, nsp)
 

@@ -169,6 +169,32 @@ class BertForPretraining(nn.Layer):
         mlm_logits = shard_activation(mlm_logits, "dp", "sp", "mp")
         return mlm_logits, self.nsp(pooled)
 
+    def fused_mlm_loss(self, input_ids, mlm_labels, token_type_ids=None,
+                       attention_mask=None, nsp_labels=None):
+        """MLM (+optional NSP) loss with the vocab decoder and softmax-CE
+        fused (F.fused_linear_cross_entropy): the [b, s, vocab] logits —
+        the largest activation of the MLM step — never reach HBM.
+        Single-chip / dp / sp path; vocab-sharded TP keeps forward() +
+        BertPretrainingCriterion (the vocab-parallel reduction is there).
+        """
+        from ...distributed import mesh as mesh_mod
+        from ...ops.math import mean
+
+        if mesh_mod.has_mesh() and mesh_mod.axis_size("mp") > 1:
+            raise ValueError(
+                "fused_mlm_loss computes softmax over the FULL vocab; "
+                "with mp>1 the tied decoder weight is vocab-sharded and "
+                "the result would be silently wrong. Use forward() + "
+                "BertPretrainingCriterion (ParallelCrossEntropy) under TP.")
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_ln(F.gelu(self.mlm_transform(seq)))
+        w = self.bert.embeddings.word.weight  # [vocab, d]
+        loss = F.fused_linear_cross_entropy(
+            h, w, mlm_labels, transpose_weight=True, ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + mean(F.cross_entropy(self.nsp(pooled), nsp_labels))
+        return loss
+
 
 class BertPretrainingCriterion(nn.Layer):
     """Masked-LM vocab-parallel CE (ignore_index −100) + NSP CE."""

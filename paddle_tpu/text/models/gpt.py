@@ -347,12 +347,20 @@ class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
         x = self.gpt(input_ids)  # [b, s, d]
         shift_x = manip.slice(x, [1], [0], [x.shape[1] - 1])
         shift_labels = manip.slice(labels, [1], [1], [labels.shape[1]])
+        # sum/total-count, NOT mean-over-valid: GPTPretrainingCriterion
+        # means over ALL positions (ignored ones contribute 0), and the
+        # two paths must stay loss- and grad-scale identical for the
+        # BENCH_GPT_FUSED_HEAD A/B to be meaningful
+        total = shift_labels.shape[0] * shift_labels.shape[1]
         if self.lm_head is not None:
-            return F.fused_linear_cross_entropy(
-                shift_x, self.lm_head.weight, shift_labels)
-        return F.fused_linear_cross_entropy(
-            shift_x, self.gpt.wte.weight, shift_labels,
-            transpose_weight=True)
+            s = F.fused_linear_cross_entropy(
+                shift_x, self.lm_head.weight, shift_labels,
+                reduction="sum")
+        else:
+            s = F.fused_linear_cross_entropy(
+                shift_x, self.gpt.wte.weight, shift_labels,
+                transpose_weight=True, reduction="sum")
+        return s / float(total)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -337,3 +337,36 @@ def test_gpt_fused_head_loss_matches_criterion():
     got = model.fused_head_loss(ids)
     np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_gpt_fused_head_loss_untied_and_ignore_index():
+    """Untied lm_head branch + ignore_index labels: loss AND grad scale
+    must match the criterion path (mean over ALL positions)."""
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        GPTPretrainingCriterion)
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    for tied in (True, False):
+        paddle.seed(13)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                        num_heads=2, max_seq_len=32, tie_embeddings=tied)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        rng = np.random.default_rng(4)
+        ids = paddle.to_tensor(rng.integers(0, 64, (2, 9)).astype(np.int32))
+        lab = rng.integers(0, 64, (2, 9))
+        lab[:, -3:] = -100  # padded tail
+        labels = paddle.to_tensor(lab.astype(np.int64))
+
+        ref = crit(model(ids), labels)
+        ref.backward()
+        ref_grad = model.gpt.wte.weight.grad.numpy().copy()
+        for prm in model.parameters():
+            prm.clear_grad()
+        got = model.fused_head_loss(ids, labels)
+        got.backward()
+        got_grad = model.gpt.wte.weight.grad.numpy()
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-4,
+                                   atol=1e-6)

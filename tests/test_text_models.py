@@ -296,3 +296,30 @@ class TestGeneration:
         # the compiled step is instance-owned: a dropped model must not
         # stay pinned by a class-level cache
         assert "_decode_step_static" not in type(model).__dict__
+
+
+def test_bert_fused_mlm_loss_matches_criterion():
+    import numpy as np
+
+    from paddle_tpu.text.models import (BertForPretraining,
+                                        BertPretrainingCriterion)
+    from paddle_tpu.text.models.bert import BertConfig
+
+    paddle.seed(5)
+    cfg = BertConfig(vocab_size=96, hidden_size=16, num_layers=1,
+                     num_heads=2, intermediate_size=32, max_position=32)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion()
+    rng = np.random.default_rng(3)
+    ids = paddle.to_tensor(rng.integers(0, 96, (2, 11)).astype(np.int32))
+    labels = np.full((2, 11), -100, np.int64)
+    m = rng.random((2, 11)) < 0.3
+    labels[m] = rng.integers(0, 96, m.sum())
+    labels_t = paddle.to_tensor(labels)
+    nsp = paddle.to_tensor(rng.integers(0, 2, (2,)))
+
+    mlm, nsp_logits = model(ids)
+    ref = crit(mlm, labels_t, nsp_logits, nsp)
+    got = model.fused_mlm_loss(ids, labels_t, nsp_labels=nsp)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
