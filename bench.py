@@ -108,11 +108,12 @@ def bench_gpt():
     # [b, s, vocab] logits never hit HBM (docs/PERF_NOTES.md hyp. 1).
     # Off by default until tools/mfu_sweep.py measures it on-chip.
     fused_head = os.environ.get("BENCH_GPT_FUSED_HEAD", "0") == "1"
+    fused_block = int(os.environ.get("BENCH_FUSED_BLOCK", "4096"))
 
     def loss_fn(m, ids):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
             if fused_head:
-                return m.fused_head_loss(ids)
+                return m.fused_head_loss(ids, block_size=fused_block)
             return crit(m(ids), ids)
 
     step = paddle.jit.TrainStep(model, loss_fn, opt)
@@ -202,11 +203,13 @@ def bench_bert():
 
     # see BENCH_GPT_FUSED_HEAD — same fused-vocab-head trade for MLM
     fused_head = os.environ.get("BENCH_BERT_FUSED_HEAD", "0") == "1"
+    fused_block = int(os.environ.get("BENCH_FUSED_BLOCK", "4096"))
 
     def loss_fn(m, ids, labels, nsp):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
             if fused_head:
-                return m.fused_mlm_loss(ids, labels, nsp_labels=nsp)
+                return m.fused_mlm_loss(ids, labels, nsp_labels=nsp,
+                                        block_size=fused_block)
             mlm, nsp_logits = m(ids)
             return crit(mlm, labels, nsp_logits, nsp)
 
@@ -247,12 +250,107 @@ def bench_bert():
             "mfu": round(mfu, 4)}
 
 
+def bench_deepfm():
+    """DeepFM CTR step over the host-PS sparse embedding with prefetch
+    overlap (BASELINE.md config 5; reference async-PS training shape,
+    ps/service/communicator/communicator.h:427)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    num_fields, vocab, batch = 26, 1_000_000, 4096
+    model = paddle.rec.DeepFM(num_fields=num_fields, embed_dim=16,
+                              hidden=(400, 400, 400), sparse=True,
+                              sparse_rule="adagrad")
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    nb = 12
+    batches = [rng.integers(0, vocab, (batch, num_fields)) for _ in range(nb)]
+    ys = [paddle.to_tensor((b.sum(1) % 7 < 3).astype(np.float32))
+          for b in batches]
+
+    def prefetch(i):
+        model.fm._first.emb.prefetch(batches[i % nb])
+        model.fm._embed.emb.prefetch(batches[i % nb])
+
+    def step(i):
+        logits = model(paddle.to_tensor(batches[i % nb]))
+        prefetch(i + 1)  # pull the NEXT batch's rows during backward/opt
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            logits, ys[i % nb])
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    prefetch(0)
+    t0 = time.perf_counter()
+    l0 = float(step(0).numpy())
+    log(f"[bench] deepfm compile+step0 {time.perf_counter()-t0:.1f}s "
+        f"loss {l0:.3f}")
+    for i in range(1, 3):
+        step(i)
+    iters = 10
+    t0 = time.perf_counter()
+    for i in range(3, 3 + iters):
+        last = step(i)
+    lN = float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    eps = batch / dt
+    log(f"[bench] deepfm: {dt*1e3:.1f} ms/step, {eps:,.0f} examples/s, "
+        f"loss→{lN:.3f}")
+    return {"model": "deepfm-ctr-ps", "ms_per_step": round(dt * 1e3, 2),
+            "examples_per_sec": round(eps)}
+
+
+def bench_mnist():
+    """LeNet eager single-device steps/sec (BASELINE.md config 1) — the
+    per-op eager-dispatch overhead metric; everything else here is jitted."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Momentum(0.01, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((128, 1, 28, 28),
+                                             ).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (128,)).astype(np.int64))
+
+    def step():
+        loss = nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    t0 = time.perf_counter()
+    float(step().numpy())
+    log(f"[bench] mnist warmup {time.perf_counter()-t0:.1f}s")
+    for _ in range(3):
+        step()
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step()
+    float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    log(f"[bench] mnist-lenet eager: {dt*1e3:.1f} ms/step, "
+        f"{1/dt:.1f} steps/s")
+    return {"model": "mnist-lenet-eager", "ms_per_step": round(dt * 1e3, 2),
+            "steps_per_sec": round(1 / dt, 1)}
+
+
 def bench_probe():
     """No-op body: `_worker_bootstrap` already proved the backend is up."""
     return {"probe": "ok"}
 
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
+            "deepfm": bench_deepfm, "mnist": bench_mnist,
             "probe": bench_probe}
 
 
@@ -360,7 +458,7 @@ def main():
     # the headline failed, the backend is down: don't burn more window.
     if gpt is None:
         return
-    for which in ("resnet", "bert"):
+    for which in ("resnet", "bert", "deepfm", "mnist"):
         status, res = _run_worker(which, timeout_s=420)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")

@@ -164,9 +164,21 @@ def _linear_ce_bwd(block, res, g):
         db = db + jnp.sum(d, axis=0)
         return (dw, db), dxi
 
-    dw0 = jnp.zeros(w.shape, jnp.float32)
+    # The dw carry is read+written once per scan block — at GPT-small
+    # bench shape that traffic rivals the logits slab this kernel
+    # avoids. Carry in w's own dtype (bf16 under AMP: halves it, and
+    # the result is cast there anyway; per-block products still
+    # accumulate in fp32 via preferred_element_type) and keep the block
+    # count small (block_size default 4096 → 4 carry round-trips).
+    acc_t = w.dtype if w.dtype == jnp.bfloat16 else jnp.float32
+
+    def body_cast(carry, inp):
+        (dw, db), dxi = body(carry, inp)
+        return (dw.astype(acc_t), db), dxi
+
+    dw0 = jnp.zeros(w.shape, acc_t)
     db0 = jnp.zeros(bias.shape, jnp.float32)
-    (dw, db), dx = jax.lax.scan(body, (dw0, db0), (xb, lb, lseb, gb))
+    (dw, db), dx = jax.lax.scan(body_cast, (dw0, db0), (xb, lb, lseb, gb))
     return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
             db.astype(bias.dtype), None)
 
@@ -176,7 +188,7 @@ _linear_ce_core.defvjp(_linear_ce_fwd, _linear_ce_bwd)
 
 def fused_linear_cross_entropy(x, weight, label, bias=None,
                                transpose_weight=False, ignore_index=-100,
-                               reduction="mean", block_size=2048, name=None):
+                               reduction="mean", block_size=4096, name=None):
     """Softmax CE of ``x @ weight (+ bias)`` without materializing logits.
 
     ``x``: [..., d] hidden states; ``weight``: [d, V] (or [V, d] with

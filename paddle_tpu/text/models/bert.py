@@ -170,7 +170,8 @@ class BertForPretraining(nn.Layer):
         return mlm_logits, self.nsp(pooled)
 
     def fused_mlm_loss(self, input_ids, mlm_labels, token_type_ids=None,
-                       attention_mask=None, nsp_labels=None):
+                       attention_mask=None, nsp_labels=None,
+                       block_size=4096):
         """MLM (+optional NSP) loss with the vocab decoder and softmax-CE
         fused (F.fused_linear_cross_entropy): the [b, s, vocab] logits —
         the largest activation of the MLM step — never reach HBM.
@@ -190,7 +191,8 @@ class BertForPretraining(nn.Layer):
         h = self.mlm_ln(F.gelu(self.mlm_transform(seq)))
         w = self.bert.embeddings.word.weight  # [vocab, d]
         loss = F.fused_linear_cross_entropy(
-            h, w, mlm_labels, transpose_weight=True, ignore_index=-100)
+            h, w, mlm_labels, transpose_weight=True, ignore_index=-100,
+            block_size=block_size)
         if nsp_labels is not None:
             loss = loss + mean(F.cross_entropy(self.nsp(pooled), nsp_labels))
         return loss

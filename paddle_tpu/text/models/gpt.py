@@ -326,7 +326,7 @@ class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
     def forward(self, input_ids):
         return self._logits_from_hidden(self.gpt(input_ids))
 
-    def fused_head_loss(self, input_ids, labels=None):
+    def fused_head_loss(self, input_ids, labels=None, block_size=4096):
         """Shifted next-token loss with the head projection and softmax-CE
         fused (F.fused_linear_cross_entropy): the [b, s, vocab] logits are
         never materialized in HBM — the dominant activation slab of the
@@ -355,11 +355,12 @@ class GPTForCausalLM(GPTGenerationMixin, nn.Layer):
         if self.lm_head is not None:
             s = F.fused_linear_cross_entropy(
                 shift_x, self.lm_head.weight, shift_labels,
-                reduction="sum")
+                reduction="sum", block_size=block_size)
         else:
             s = F.fused_linear_cross_entropy(
                 shift_x, self.gpt.wte.weight, shift_labels,
-                transpose_weight=True, reduction="sum")
+                transpose_weight=True, reduction="sum",
+                block_size=block_size)
         return s / float(total)
 
 

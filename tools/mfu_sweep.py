@@ -28,7 +28,8 @@ CACHE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".jax_cache")
 
 
-def measure(batch, seq, block_q, block_k, iters=8, fused_head=False):
+def measure(batch, seq, block_q, block_k, iters=8, fused_head=False,
+            fused_block=4096):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -51,8 +52,9 @@ def measure(batch, seq, block_q, block_k, iters=8, fused_head=False):
             with amp.auto_cast(level="O1", dtype="bfloat16"):
                 if fused_head:
                     # head matmul + softmax-CE fused, [b,s,vocab] logits
-                    # never hit HBM (PERF_NOTES hypothesis 1)
-                    return m.fused_head_loss(ids)
+                    # never hit HBM (PERF_NOTES hypothesis 1); block size
+                    # trades logits-tile size vs dw-carry round-trips
+                    return m.fused_head_loss(ids, block_size=fused_block)
                 return crit(m(ids), ids)
 
         step = paddle.jit.TrainStep(model, loss_fn, opt)
@@ -92,33 +94,38 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
 
     seq = 1024
-    configs = [("batch", b, seq, 512, 512, False) for b in (8, 16, 24, 32)]
-    # fused-head arm at the two batch front-runners: decides whether
-    # bench.py should flip BENCH_GPT_FUSED_HEAD on by default
-    configs += [("fusedce", b, seq, 512, 512, True) for b in (16, 24)]
+    configs = [("batch", b, seq, 512, 512, 0) for b in (8, 16, 24, 32)]
+    # fused-head arms (fb = fused CE token-block size; 0 = materialized
+    # baseline): decides whether bench.py should flip
+    # BENCH_GPT_FUSED_HEAD on by default, and at which block size
+    # (small fb = small logits tiles but more dw-carry round-trips)
+    configs += [("fusedce", 16, seq, 512, 512, fb)
+                for fb in (2048, 4096, 8192)]
     if not args.quick:
-        configs += [("blocks", 16, seq, bq, bk, False)
+        configs += [("fusedce", 24, seq, 512, 512, 4096)]
+        configs += [("blocks", 16, seq, bq, bk, 0)
                     for bq in (256, 512, 1024)
                     for bk in (256, 512, 1024)
                     if (bq, bk) != (512, 512)]
     best = None
-    print(f"{'kind':<8}{'batch':>6}{'bq':>6}{'bk':>6}{'ms':>10}"
+    print(f"{'kind':<8}{'batch':>6}{'bq':>6}{'bk':>6}{'fb':>6}{'ms':>10}"
           f"{'MFU':>8}{'compile_s':>10}")
-    for kind, b, s, bq, bk, fused in configs:
+    for kind, b, s, bq, bk, fb in configs:
         try:
-            ms, mfu, comp = measure(b, s, bq, bk, fused_head=fused)
+            ms, mfu, comp = measure(b, s, bq, bk, fused_head=fb > 0,
+                                    fused_block=fb or 4096)
         except Exception as e:
-            print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}      FAIL  {e!r}",
+            print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}{fb:>6}      FAIL  {e!r}",
                   flush=True)
             continue
-        print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}{ms:>10.1f}{mfu:>8.3f}"
+        print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}{fb:>6}{ms:>10.1f}{mfu:>8.3f}"
               f"{comp:>10.1f}", flush=True)
         if best is None or mfu > best[0]:
-            best = (mfu, kind, b, bq, bk, ms)
+            best = (mfu, kind, b, bq, bk, fb, ms)
     if best:
-        mfu, kind, b, bq, bk, ms = best
+        mfu, kind, b, bq, bk, fb, ms = best
         print(f"\nBEST: {kind} batch={b} block_q={bq} block_k={bk} "
-              f"-> {ms:.1f} ms, MFU {mfu:.3f}", flush=True)
+              f"fused_block={fb} -> {ms:.1f} ms, MFU {mfu:.3f}", flush=True)
 
 
 if __name__ == "__main__":

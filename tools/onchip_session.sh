@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# One-shot on-chip measurement session for when the TPU backend recovers
+# from an outage (it has been down since round 2's BENCH_r02 window).
+#
+#   bash tools/onchip_session.sh [--full]
+#
+# Order (docs/PERF_NOTES.md "next session" plan):
+#   1. cheap probe (150 s cap, killable subprocess — a hung init must not
+#      block the shell for 25 min),
+#   2. mfu_sweep --quick (batch grid + fused-head arms, ~10 min warm),
+#   3. one bench.py capture for the record (headline JSON on stdout).
+# Results land in tools/onchip_out/ with timestamps; nothing is left
+# holding the chip afterwards (each stage is its own process).
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/onchip_out
+mkdir -p "$OUT"
+TS=$(date +%Y%m%d_%H%M%S)
+
+echo "[onchip] probing backend (150 s cap)..."
+if ! timeout 150 python -c "import jax; print(jax.devices())" \
+    >"$OUT/probe_$TS.log" 2>&1; then
+  echo "[onchip] backend still DOWN (probe hung/failed); see $OUT/probe_$TS.log"
+  exit 1
+fi
+echo "[onchip] backend UP: $(cat "$OUT/probe_$TS.log")"
+
+SWEEP_ARGS="--quick"
+[ "${1:-}" = "--full" ] && SWEEP_ARGS=""
+echo "[onchip] mfu_sweep $SWEEP_ARGS ..."
+timeout 2400 python tools/mfu_sweep.py $SWEEP_ARGS \
+    2>&1 | tee "$OUT/sweep_$TS.log"
+
+echo "[onchip] bench.py capture ..."
+timeout 4200 python bench.py >"$OUT/bench_$TS.json" \
+    2>"$OUT/bench_$TS.stderr"
+echo "[onchip] bench result:"
+cat "$OUT/bench_$TS.json"
+echo "[onchip] done; promote winners into bench.py defaults + PERF_NOTES."
