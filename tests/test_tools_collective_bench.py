@@ -1,0 +1,30 @@
+"""tools/collective_bench.py correctness on the virtual CPU mesh
+(BASELINE.md config 6 — the all-reduce bus-bandwidth microbench; real
+numbers need real ICI, this pins that the tool runs and reports)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_collective_bench_runs_on_virtual_mesh():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "collective_bench.py"),
+         "--sizes", "0.25", "--iters", "2", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    ops = {r["op"] for r in rows}
+    assert ops == {"all_reduce", "all_gather", "reduce_scatter"}
+    for r in rows:
+        assert r["devices"] == 8 and r["busbw_GBps"] > 0
