@@ -109,6 +109,13 @@ def bench_gpt():
     # Off by default until tools/mfu_sweep.py measures it on-chip.
     fused_head = os.environ.get("BENCH_GPT_FUSED_HEAD", "0") == "1"
     fused_block = int(os.environ.get("BENCH_FUSED_BLOCK", "4096"))
+    # BENCH_GPT_REMAT=dots_saveable|full: rematerialization policy for
+    # the whole step (PERF_NOTES hypothesis 3; off by default)
+    remat = os.environ.get("BENCH_GPT_REMAT", "").strip().lower()
+    if remat in ("", "0", "off", "false"):
+        remat = False
+    elif remat in ("1", "full", "true"):
+        remat = True  # keep-nothing policy
 
     def loss_fn(m, ids):
         with amp.auto_cast(level="O1", dtype="bfloat16"):
@@ -116,7 +123,7 @@ def bench_gpt():
                 return m.fused_head_loss(ids, block_size=fused_block)
             return crit(m(ids), ids)
 
-    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    step = paddle.jit.TrainStep(model, loss_fn, opt, remat=remat)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))

@@ -385,10 +385,17 @@ class TrainStep:
     loss_fn(model, *batch_tensors) -> scalar loss Tensor.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate_params=True):
+    def __init__(self, model, loss_fn, optimizer, donate_params=True,
+                 remat=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # remat: False -> off, True -> keep nothing, str/callable ->
+        # jax.checkpoint policy name ('dots_saveable' keeps MXU outputs;
+        # see fleet.recompute.checkpoint_policy) — same knob as
+        # DistributedTrainStep, usable single-chip where the step is
+        # HBM-bound (docs/PERF_NOTES.md hypothesis 3)
+        self.remat = remat
         self._names = list(model.state_dict().keys())
         self._param_objs = [model.state_dict()[n] for n in self._names]
         self._trainable = [not p.stop_gradient for p in self._param_objs]
@@ -426,6 +433,12 @@ class TrainStep:
                 for p, v in zip(param_objs, originals):
                     p._value = v
             return loss._value, new_frozen
+
+        if self.remat:
+            from ..distributed.fleet.recompute import checkpoint_policy
+
+            pure_loss = jax.checkpoint(
+                pure_loss, policy=checkpoint_policy(self.remat))
 
         def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
                  step_idx):

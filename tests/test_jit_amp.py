@@ -346,3 +346,33 @@ class TestGradScalerUnscaleGuard:
         loss = net(paddle.ones([2, 4])).sum()
         scaler.scale(loss).backward()
         scaler.unscale_(opt)
+
+
+def test_trainstep_remat_policy_parity():
+    """TrainStep(remat='dots_saveable') must be numerically identical to
+    the unremated step (PERF_NOTES hypothesis 3 knob)."""
+    import numpy as np
+
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        GPTPretrainingCriterion)
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32)
+    crit = GPTPretrainingCriterion()
+
+    def loss_fn(m, ids):
+        return crit(m(ids), ids)
+
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 64, (2, 9)).astype(np.int32))
+    losses = {}
+    for remat in (False, "dots_saveable", True):
+        paddle.seed(3)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, loss_fn, opt, remat=remat)
+        losses[remat] = [float(step(ids).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(losses[False], losses["dots_saveable"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)

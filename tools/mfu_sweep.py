@@ -29,7 +29,7 @@ CACHE = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def measure(batch, seq, block_q, block_k, iters=8, fused_head=False,
-            fused_block=4096):
+            fused_block=4096, remat=False):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -57,7 +57,7 @@ def measure(batch, seq, block_q, block_k, iters=8, fused_head=False,
                     return m.fused_head_loss(ids, block_size=fused_block)
                 return crit(m(ids), ids)
 
-        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        step = paddle.jit.TrainStep(model, loss_fn, opt, remat=remat)
         rng = np.random.default_rng(0)
         ids = paddle.to_tensor(
             rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
@@ -94,26 +94,31 @@ def main():
     print(f"devices: {jax.devices()}", flush=True)
 
     seq = 1024
-    configs = [("batch", b, seq, 512, 512, 0) for b in (8, 16, 24, 32)]
-    # fused-head arms (fb = fused CE token-block size; 0 = materialized
-    # baseline): decides whether bench.py should flip
+    # config tuple: (kind, batch, seq, block_q, block_k, fused_block,
+    # remat) — fused_block 0 = materialized-logits baseline
+    configs = [("batch", b, seq, 512, 512, 0, False)
+               for b in (8, 16, 24, 32)]
+    # fused-head arms: decide whether bench.py should flip
     # BENCH_GPT_FUSED_HEAD on by default, and at which block size
     # (small fb = small logits tiles but more dw-carry round-trips)
-    configs += [("fusedce", 16, seq, 512, 512, fb)
+    configs += [("fusedce", 16, seq, 512, 512, fb, False)
                 for fb in (2048, 4096, 8192)]
+    # remat arm: 'dots_saveable' trades elementwise HBM writes for
+    # recompute (PERF_NOTES hypothesis 3)
+    configs += [("remat", 16, seq, 512, 512, 0, "dots_saveable")]
     if not args.quick:
-        configs += [("fusedce", 24, seq, 512, 512, 4096)]
-        configs += [("blocks", 16, seq, bq, bk, 0)
+        configs += [("fusedce", 24, seq, 512, 512, 4096, False)]
+        configs += [("blocks", 16, seq, bq, bk, 0, False)
                     for bq in (256, 512, 1024)
                     for bk in (256, 512, 1024)
                     if (bq, bk) != (512, 512)]
     best = None
     print(f"{'kind':<8}{'batch':>6}{'bq':>6}{'bk':>6}{'fb':>6}{'ms':>10}"
           f"{'MFU':>8}{'compile_s':>10}")
-    for kind, b, s, bq, bk, fb in configs:
+    for kind, b, s, bq, bk, fb, remat in configs:
         try:
             ms, mfu, comp = measure(b, s, bq, bk, fused_head=fb > 0,
-                                    fused_block=fb or 4096)
+                                    fused_block=fb or 4096, remat=remat)
         except Exception as e:
             print(f"{kind:<8}{b:>6}{bq:>6}{bk:>6}{fb:>6}      FAIL  {e!r}",
                   flush=True)
