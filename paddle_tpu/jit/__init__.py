@@ -390,6 +390,7 @@ class TrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.donate_params = donate_params
         # remat: False -> off, True -> keep nothing, str/callable ->
         # jax.checkpoint policy name ('dots_saveable' keeps MXU outputs;
         # see fleet.recompute.checkpoint_policy) — same knob as
@@ -451,8 +452,10 @@ class TrainStep:
             return loss, new_vals, new_states, new_frozen
 
         # donate param + optimizer-state + buffer arrays so XLA updates in
-        # place (no HBM copy per step)
-        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+        # place (no HBM copy per step); donate_params=False keeps the
+        # pre-step arrays readable (e.g. for step-over-step diffing)
+        donate = (0, 1, 2) if self.donate_params else ()
+        self._compiled = jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
         if self._compiled is None:
