@@ -186,6 +186,24 @@ def _linear_ce_bwd(block, res, g):
 _linear_ce_core.defvjp(_linear_ce_fwd, _linear_ce_bwd)
 
 
+def linear_ce_raw(x2d, w, labels, block_size=4096, bias=None):
+    """Raw-array (jnp in / jnp out) form of the fused linear+CE: per-row
+    losses for ``x2d @ w (+ bias)`` vs int ``labels``, logits never
+    materialized. Handles pad-to-block internally; vjp-compatible, so it
+    drops into shard_map'd pipeline loss fns (gpt_pipeline._loss_fn)."""
+    n = x2d.shape[0]
+    vocab = w.shape[1]
+    if bias is None:
+        bias = jnp.zeros((vocab,), x2d.dtype)
+    labels = labels.astype(jnp.int32)
+    block = min(block_size, max(n, 1))
+    npad = (-n) % block
+    if npad:
+        x2d = jnp.pad(x2d, ((0, npad), (0, 0)))
+        labels = jnp.pad(labels, (0, npad))
+    return _linear_ce_core(x2d, w, bias, labels, block)[:n]
+
+
 def fused_linear_cross_entropy(x, weight, label, bias=None,
                                transpose_weight=False, ignore_index=-100,
                                reduction="mean", block_size=4096, name=None):
@@ -207,21 +225,15 @@ def fused_linear_cross_entropy(x, weight, label, bias=None,
     def jfn(xv, wv, lblv, *rest):
         d = xv.shape[-1]
         xf = xv.reshape(-1, d)
-        n = xf.shape[0]
         wf = wv.T if transpose_weight else wv
-        vocab = wf.shape[1]
-        bv = rest[0] if rest else jnp.zeros((vocab,), xv.dtype)
+        bv = rest[0] if rest else None  # linear_ce_raw owns the default
         lf = lblv.reshape(-1).astype(jnp.int32)
         valid = lf != ignore_index
         safe = jnp.where(valid, lf, 0)
-        # pad to a block multiple (shifted sequences make n = b*(s-1),
-        # rarely divisible); grad-of-slice zeros the pad rows' cotangent
-        block = min(block_size, max(n, 1))
-        npad = (-n) % block
-        if npad:
-            xf = jnp.pad(xf, ((0, npad), (0, 0)))
-            safe = jnp.pad(safe, (0, npad))
-        loss = _linear_ce_core(xf, wf, bv, safe, block)[:n]
+        # linear_ce_raw pads to a block multiple internally (shifted
+        # sequences make n = b*(s-1), rarely divisible); grad-of-slice
+        # zeros the pad rows' cotangent
+        loss = linear_ce_raw(xf, wf, safe, block_size=block_size, bias=bv)
         loss = jnp.where(valid, loss, 0.0)
         if reduction == "mean":
             denom = jnp.maximum(valid.sum(), 1).astype(loss.dtype)

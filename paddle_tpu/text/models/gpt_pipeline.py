@@ -112,13 +112,16 @@ class PipelinedGPTForCausalLM(nn.Layer):
         return out
 
     def _loss_fn(self, y_pred, labels, post):
+        # fused blocked head CE (nn/functional/loss.py linear_ce_raw):
+        # the last pipeline stage never materializes [micro, s, vocab]
+        # logits or fp32 log-probs — the head vjp inside the 1F1B
+        # head-tick cond stays memory-lean
+        from ...nn.functional.loss import linear_ce_raw
+
         h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
-        logits = h @ post["wte"].T
-        shift_logits = logits[:, :-1]
-        shift_labels = labels[:, 1:]
-        lp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), -1)
-        return -jnp.mean(
-            jnp.take_along_axis(lp, shift_labels[..., None], -1))
+        sh = h[:, :-1].reshape(-1, h.shape[-1])
+        sl = labels[:, 1:].reshape(-1)
+        return jnp.mean(linear_ce_raw(sh, post["wte"].T, sl))
 
     def _param_tensors(self):
         stk = [getattr(self, "stk_" + n) for n in self._stack_names]
