@@ -8,8 +8,10 @@ Sweeps, one dimension at a time around the bench configuration
 (b16·s1024 GPT-small, amp O1, AdamW):
 
   * global batch (HBM util / pipeline depth),
-  * flash-attention block_q/block_k (MXU tiling vs VMEM pressure),
-  * default matmul precision,
+  * fused-head CE block size (PERF_NOTES hypothesis 1),
+  * remat policy dots_saveable (hypothesis 3),
+  * flash-attention block_q/block_k (MXU tiling vs VMEM pressure,
+    hypothesis 2; full sweep only),
 
 printing a table of ms/step and MFU so the best point can be promoted
 into bench.py. Each config runs in-process (one backend init); the
