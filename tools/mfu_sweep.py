@@ -83,7 +83,7 @@ def measure(batch, seq, block_q, block_k, iters=8, fused_head=False,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
-                    help="batch sweep only")
+                    help="skip the 3x3 flash-block grid (runs batch + fusedce + remat arms)")
     args = ap.parse_args()
 
     os.makedirs(CACHE, exist_ok=True)
