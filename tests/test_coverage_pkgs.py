@@ -72,7 +72,7 @@ def test_categorical_uniform_beta_dirichlet():
     ref = (math.lgamma(5) - math.lgamma(2) - math.lgamma(3)
            + np.log(0.5) + 2 * np.log(0.5))
     np.testing.assert_allclose(
-        float(b.log_prob(paddle.to_tensor([0.5])).numpy()), ref,
+        b.log_prob(paddle.to_tensor([0.5])).numpy().item(), ref,
         rtol=1e-5)
 
     d = D.Dirichlet(paddle.to_tensor([1.0, 1.0, 1.0]))
@@ -92,7 +92,7 @@ def test_transformed_and_independent():
     # lognormal pdf at x: N(log x)/x
     ref = (-0.5 * np.log(1.5) ** 2 - 0.5 * np.log(2 * np.pi)
            - np.log(1.5))
-    np.testing.assert_allclose(float(logn.log_prob(x).numpy()), ref,
+    np.testing.assert_allclose(logn.log_prob(x).numpy().item(), ref,
                                rtol=1e-5)
     ind = D.Independent(D.Normal(jnp.zeros(3), jnp.ones(3)), 1)
     lp = ind.log_prob(paddle.to_tensor([0.0, 0.0, 0.0]))
