@@ -60,9 +60,9 @@ def test_categorical_uniform_beta_dirichlet():
                                rtol=1e-5)
 
     u = D.Uniform(0.0, 2.0)
-    assert float(u.log_prob(paddle.to_tensor([1.0])).numpy()) == \
+    assert u.log_prob(paddle.to_tensor([1.0])).numpy().item() == \
         pytest.approx(-np.log(2.0))
-    assert np.isneginf(float(u.log_prob(paddle.to_tensor([3.0])).numpy()))
+    assert np.isneginf(u.log_prob(paddle.to_tensor([3.0])).numpy().item())
 
     b = D.Beta(2.0, 3.0)
     np.testing.assert_allclose(float(b.mean.numpy()), 0.4, rtol=1e-6)
