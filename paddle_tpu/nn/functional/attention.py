@@ -49,11 +49,20 @@ def dense_attention_bshd(q, k, v, is_causal=False, attn_mask=None,
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
-    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+                                 training=True, kv_lens=None, name=None):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention).
+
+    kv_lens: optional [batch] int per-example valid key length — the
+    prefix key-padding mask (padded BERT/ERNIE batches). Unlike a dense
+    `attn_mask` (whose values are unknown at trace time, forcing the jnp
+    path), a lengths vector states its structure up front, so it rides
+    the Pallas flash kernel. Mutually exclusive with attn_mask.
+    """
     query = ensure_tensor(query)
     key = ensure_tensor(key)
     value = ensure_tensor(value)
+    if kv_lens is not None and attn_mask is not None:
+        raise ValueError("pass either attn_mask or kv_lens, not both")
     tensors = [query, key, value]
     if attn_mask is not None:
         tensors.append(ensure_tensor(attn_mask))
@@ -61,6 +70,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     use_pallas = _pallas_eligible(query, key)
     if use_pallas and attn_mask is None and dropout_p == 0.0:
         from ...ops.pallas_kernels import flash_attention
+
+        if kv_lens is not None:
+            lens_t = ensure_tensor(kv_lens)
+
+            def jfn_lens(q, k, v, lens):
+                return flash_attention.flash_attention_bshd(
+                    q, k, v, causal=is_causal, kv_lens=lens)
+
+            return apply_jfn("flash_attention", jfn_lens, query, key,
+                             value, lens_t)
 
         def jfn(q, k, v):
             return flash_attention.flash_attention_bshd(q, k, v, causal=is_causal)
@@ -72,6 +91,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...core import rng
 
         drop_key = rng.next_key()
+
+    if kv_lens is not None:
+        lens_t = ensure_tensor(kv_lens)
+
+        def jfn_lens(q, k, v, lens):
+            lens = lens.astype(jnp.int32)
+            # zero-length rows: mask against max(len, 1) (a fully-masked
+            # softmax row is NaN and the NaN survives where-grads), then
+            # zero those rows — matching the Pallas kernel's safe_l
+            # zeros so CPU and TPU agree
+            keep = (jnp.arange(k.shape[1])[None, :]
+                    < jnp.maximum(lens, 1)[:, None])[:, None, None, :]
+            out = dense_attention_bshd(
+                q, k, v, is_causal=is_causal, attn_mask=keep,
+                drop_key=drop_key, dropout_p=dropout_p)
+            return jnp.where((lens > 0)[:, None, None, None], out, 0.0)
+
+        return apply_jfn("scaled_dot_product_attention", jfn_lens, query,
+                         key, value, lens_t)
 
     def jfn(q, k, v, *rest):
         return dense_attention_bshd(

@@ -39,9 +39,9 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fa_kernel(q_ref, k_ref, v_ref, lens_ref, o_ref, lse_ref,
                acc_ref, m_ref, l_ref, *, causal, scale, block_q, block_k,
-               kv_blocks, seq_k):
+               kv_blocks, seq_k, use_lens):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -55,6 +55,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     run = True
     if causal:
         run = ki * block_k <= qi * block_q + block_q - 1
+    if use_lens:
+        # per-batch valid kv length (key-padding mask): whole blocks past
+        # the valid prefix are skipped dynamically
+        kl = lens_ref[0, 0, 0]
+        run = jnp.logical_and(run, ki * block_k < kl)
 
     @pl.when(run)
     def _compute():
@@ -71,7 +76,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + qi * block_q
             s = jnp.where(rows >= cols, s, NEG_INF)
-        if seq_k % block_k != 0:
+        if use_lens:
+            # kl <= seq_k, so this also covers the padded buffer tail
+            s = jnp.where(cols < kl, s, NEG_INF)
+            vrows = jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0) + ki * block_k
+            v = jnp.where(vrows < kl, v, jnp.zeros_like(v))
+        elif seq_k % block_k != 0:
             # mask the padded tail of the last kv block; without this the
             # padding columns inflate the softmax sum — and zero padded v
             # rows, since even 0-weight × garbage (NaN) rows would poison
@@ -107,8 +118,18 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
 
 
-def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
-    """q,k,v: [bh, seq, d] → (out [bh, seq, d], lse [bh, 1, seq])."""
+def _lens_operand(lens, bh, seq_k):
+    """[bh] int32 lengths → a [bh, 1, 128] VMEM-tileable operand (the
+    kernel reads lane 0); full-length dummy when lens is None."""
+    if lens is None:
+        return jnp.full((bh, 1, 128), seq_k, jnp.int32)
+    return jnp.broadcast_to(
+        lens.astype(jnp.int32)[:, None, None], (bh, 1, 128))
+
+
+def _fa_forward(q, k, v, causal, block_q, block_k, interpret, lens=None):
+    """q,k,v: [bh, seq, d] → (out [bh, seq, d], lse [bh, 1, seq]).
+    lens: optional [bh] int32 per-row valid kv length (key padding)."""
     bh, seq, d = q.shape
     seq_k = k.shape[1]
     block_q = min(block_q, seq)
@@ -117,18 +138,30 @@ def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
     q_blocks = pl.cdiv(seq, block_q)
     kv_blocks = pl.cdiv(seq_k, block_k)
 
+    use_lens = lens is not None
     kernel = functools.partial(
         _fa_kernel, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, kv_blocks=kv_blocks, seq_k=seq_k)
+        block_k=block_k, kv_blocks=kv_blocks, seq_k=seq_k,
+        use_lens=use_lens)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if use_lens:
+        in_specs.append(pl.BlockSpec((1, 1, 128), lambda b, i, j: (b, 0, 0)))
+        operands.append(_lens_operand(lens, bh, seq_k))
+    else:
+        # keep the hot path free of a dummy operand: adapt the kernel's
+        # lens_ref slot away (it is only read under use_lens)
+        body = kernel
+        kernel = lambda qr, kr, vr, *rest: body(qr, kr, vr, None, *rest)
 
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, q_blocks, kv_blocks),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -143,13 +176,13 @@ def _fa_forward(q, k, v, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse  # lse: [bh, 1, seq]
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                      dq_ref, acc_ref, *, causal, scale, block_q, block_k,
-                      kv_blocks, seq_q, seq_k):
+                      lens_ref, dq_ref, acc_ref, *, causal, scale, block_q,
+                      block_k, kv_blocks, seq_q, seq_k, use_lens):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -160,6 +193,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = ki * block_k <= qi * block_q + block_q - 1
+    if use_lens:
+        kl = lens_ref[0, 0, 0]
+        run = jnp.logical_and(run, ki * block_k < kl)
 
     @pl.when(run)
     def _compute():
@@ -179,7 +215,14 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0) + qi * block_q
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
-        if seq_k % block_k != 0:
+        if use_lens:
+            # key-padding columns contribute nothing to dq
+            p = jnp.where(cols < kl, p, 0.0)
+            kvrows = jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0) + ki * block_k
+            v = jnp.where(kvrows < kl, v, jnp.zeros_like(v))
+            k = jnp.where(kvrows < kl, k, jnp.zeros_like(k))
+        elif seq_k % block_k != 0:
             # padded kv tail: p→0 and k/v pad rows zeroed so 0·NaN never
             # forms in dp or the final ds·k product
             p = jnp.where(cols < seq_k, p, 0.0)
@@ -201,8 +244,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale,
-                       block_q, block_k, q_blocks, seq_q, seq_k):
+                       lens_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                       scale, block_q, block_k, q_blocks, seq_q, seq_k,
+                       use_lens):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -214,6 +258,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = qi * block_q + block_q - 1 >= ki * block_k
+    if use_lens:
+        # kv blocks entirely past the valid prefix get zero dk/dv: skip
+        kl = lens_ref[0, 0, 0]
+        run = jnp.logical_and(run, ki * block_k < kl)
 
     @pl.when(run)
     def _compute():
@@ -233,6 +281,12 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1) + ki * block_k
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
+        if use_lens:
+            # key-padding columns: p→0 so padded k/v rows accumulate
+            # exactly zero gradient (ds = p·(dp−delta) follows)
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + ki * block_k
+            p = jnp.where(cols < kl, p, 0.0)
         if seq_q % block_q != 0:
             # padded q tail: those rows carry garbage lse/delta/g/q — zero
             # their weight so they contribute nothing to dk/dv (and no
@@ -271,7 +325,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
-                     interpret, g_lse=None):
+                     interpret, g_lse=None, lens=None):
     """Flash backward: dq pass + dk/dv pass, each O(seq·d) HBM traffic.
 
     g_lse: optional cotangent of the lse output (ring attention's
@@ -293,58 +347,89 @@ def _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
         delta = delta - g_lse.astype(jnp.float32)
     lse3 = lse  # already [bh, 1, seq]
 
+    use_lens = lens is not None
+
+    def with_lens_slot(body):
+        # no-lens path: adapt the kernel's lens_ref slot away so the hot
+        # path carries no dummy operand (lens_ref only read under
+        # use_lens)
+        if use_lens:
+            return body
+        return lambda qr, kr, vr, gr, lr, dr, *rest: body(
+            qr, kr, vr, gr, lr, dr, None, *rest)
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+    operands = [q, k, v, gf, lse3, delta]
+    if use_lens:
+        in_specs.append(pl.BlockSpec((1, 1, 128), lambda b, i, j: (b, 0, 0)))
+        operands.append(_lens_operand(lens, bh, seq_k))
 
     dq = pl.pallas_call(
-        functools.partial(
+        with_lens_slot(functools.partial(
             _fa_bwd_dq_kernel, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k, kv_blocks=kv_blocks, seq_q=seq, seq_k=seq_k),
+            block_k=block_k, kv_blocks=kv_blocks, seq_q=seq, seq_k=seq_k,
+            use_lens=use_lens)),
         grid=(bh, q_blocks, kv_blocks),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, gf, lse3, delta)
+    )(*operands)
 
     # dkv pass: grid transposed so the q dimension is innermost
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     row_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    in_specs2 = [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
+    operands2 = [q, k, v, gf, lse3, delta]
+    if use_lens:
+        in_specs2.append(
+            pl.BlockSpec((1, 1, 128), lambda b, j, i: (b, 0, 0)))
+        operands2.append(_lens_operand(lens, bh, seq_k))
     dk, dv = pl.pallas_call(
-        functools.partial(
+        with_lens_slot(functools.partial(
             _fa_bwd_dkv_kernel, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k, q_blocks=q_blocks, seq_q=seq, seq_k=seq_k),
+            block_k=block_k, q_blocks=q_blocks, seq_q=seq, seq_k=seq_k,
+            use_lens=use_lens)),
         grid=(bh, kv_blocks, q_blocks),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
-                  row_spec2],
+        in_specs=in_specs2,
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, gf, lse3, delta)
+    )(*operands2)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhd(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _fa_forward(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_bhd(q, k, v, lens, causal, block_q, block_k,
+                         interpret):
+    out, _ = _fa_forward(q, k, v, causal, block_q, block_k, interpret,
+                         lens=lens)
     return out
 
 
-def _fa_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _fa_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _fa_fwd_rule(q, k, v, lens, causal, block_q, block_k, interpret):
+    out, lse = _fa_forward(q, k, v, causal, block_q, block_k, interpret,
+                           lens=lens)
+    return out, (q, k, v, lens, out, lse)
 
 
 def _fa_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q, block_k,
-                            interpret)
+    import numpy as np
+
+    q, k, v, lens, out, lse = res
+    dq, dk, dv = _attn_bwd_pallas(q, k, v, out, lse, g, causal, block_q,
+                                  block_k, interpret, lens=lens)
+    d_lens = (None if lens is None
+              else np.zeros(lens.shape, jax.dtypes.float0))
+    return dq, dk, dv, d_lens
 
 
 _flash_attention_bhd.defvjp(_fa_fwd_rule, _fa_bwd_rule)
@@ -378,7 +463,7 @@ flash_attention_lse_bhd.defvjp(_fa_lse_fwd, _fa_lse_bwd)
 
 def flash_attention_bshd(q, k, v, causal=False,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         interpret=False):
+                         interpret=False, kv_lens=None):
     """Fused attention on [batch, seq, heads, head_dim] (paddle layout).
 
     Differentiable; forward and backward are Pallas kernels over the
@@ -386,6 +471,11 @@ def flash_attention_bshd(q, k, v, causal=False,
     so a head-sliced 4-D blocking is not expressible — the wrapper pays
     one transpose each way instead). `interpret=True` runs in the Pallas
     interpreter (CPU test tier).
+
+    kv_lens: optional [batch] int per-example valid key length (prefix
+    key-padding mask, the BERT/ERNIE padded-batch case): columns >= len
+    get zero attention weight and their k/v rows zero gradient; whole kv
+    blocks past the valid prefix are skipped. Composes with `causal`.
     """
     b, s, h, d = q.shape
     sk = k.shape[1]
@@ -402,6 +492,14 @@ def flash_attention_bshd(q, k, v, causal=False,
     qf = to_bhd(q, s)
     kf = to_bhd(k, sk)
     vf = to_bhd(v, sk)
-    out = _flash_attention_bhd(qf, kf, vf, bool(causal), int(block_q),
+    lens = None
+    if kv_lens is not None:
+        # [b] -> [b*h]: bh layout is batch-major then head. Clamp to
+        # seq_k: the kernels' `cols < kl` masking subsumes the buffer
+        # tail mask ONLY when kl <= seq_k — an oversized length would
+        # let uninitialized block padding into the softmax.
+        lens = jnp.repeat(
+            jnp.minimum(jnp.asarray(kv_lens, jnp.int32), sk), h)
+    out = _flash_attention_bhd(qf, kf, vf, lens, bool(causal), int(block_q),
                                int(block_k), bool(interpret))
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

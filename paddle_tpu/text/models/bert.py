@@ -108,12 +108,13 @@ class BertEncoderLayer(nn.Layer):
         self.ln2 = nn.LayerNorm(d)
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, kv_lens=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv(x)
         q, k, v = split_fused_qkv(qkv, b, s, self.nh, self.hd)
         attn = F.scaled_dot_product_attention(q, k, v,
-                                              attn_mask=attn_mask)
+                                              attn_mask=attn_mask,
+                                              kv_lens=kv_lens)
         attn = manip.reshape(attn, [b, s, self.nh * self.hd])
         x = self.ln1(x + self.dropout(self.proj(attn)))
         h = self.fc2(F.gelu(self.fc1(x)))
@@ -132,8 +133,19 @@ class BertModel(nn.Layer):
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        mask = None
-        if attention_mask is not None:
+        mask, kv_lens = None, None
+        if attention_mask is not None and len(attention_mask.shape) == 1:
+            # [b] int lengths (prefix key padding): stays eligible for
+            # the Pallas flash kernel — a dense mask's values are unknown
+            # at trace time, a lengths vector declares its structure
+            if "int" not in str(attention_mask.dtype):
+                raise ValueError(
+                    "a rank-1 attention_mask is interpreted as per-example "
+                    "valid LENGTHS and must be integer; got "
+                    f"{attention_mask.dtype} (a squeezed [s] keep-mask is "
+                    "not supported — pass the [b, s] form)")
+            kv_lens = attention_mask
+        elif attention_mask is not None:
             # [b, s] 1/0 keep-mask → additive [b, 1, 1, s]
             m = manip.reshape(
                 attention_mask.astype("float32"),
@@ -142,7 +154,7 @@ class BertModel(nn.Layer):
         x = self.embeddings(input_ids, token_type_ids)
         x = shard_activation(x, "dp", "sp", None)
         for layer in self.layers:
-            x = layer(x, attn_mask=mask)
+            x = layer(x, attn_mask=mask, kv_lens=kv_lens)
         pooled = F.tanh(self.pooler(
             manip.squeeze(manip.slice(x, [1], [0], [1]), [1])))
         return x, pooled

@@ -146,3 +146,127 @@ class TestFlashAttention:
         _, k, v = _rand_qkv(b=1, s=256, h=1, d=64, seed=1)
         with pytest.raises(ValueError):
             flash_attention_bshd(q, k, v, causal=True, interpret=True)
+
+
+def dense_attention_lens(q, k, v, kv_lens, causal=False):
+    """Dense reference with per-batch key-padding lengths."""
+    d = q.shape[-1]
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    sk = s.shape[-1]
+    keep = (jnp.arange(sk)[None, :]
+            < jnp.asarray(kv_lens)[:, None])[:, None, None, :]
+    s = jnp.where(keep, s, -jnp.inf)
+    if causal:
+        sq = s.shape[-2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", w, vt), 1, 2)
+
+
+class TestFlashAttentionKVLens:
+    """Per-batch key-padding lengths (the padded BERT/ERNIE batch case)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dense(self, causal):
+        q, k, v = _rand_qkv(b=3, s=256, h=2, d=64, seed=21)
+        lens = jnp.asarray([256, 130, 77])
+        out = flash_attention_bshd(q, k, v, causal=causal, block_q=128,
+                                   block_k=128, interpret=True,
+                                   kv_lens=lens)
+        ref = dense_attention_lens(q, k, v, lens, causal=causal)
+        # rows can only attend to the valid kv prefix, so compare there
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_dense_and_zero_on_pad(self):
+        q, k, v = _rand_qkv(b=2, s=256, h=2, d=64, seed=22)
+        lens = jnp.asarray([200, 64])
+
+        def loss_fa(q, k, v):
+            o = flash_attention_bshd(q, k, v, block_q=128, block_k=128,
+                                     interpret=True, kv_lens=lens)
+            return jnp.sum(o * jnp.cos(o))
+
+        def loss_ref(q, k, v):
+            o = dense_attention_lens(q, k, v, lens)
+            return jnp.sum(o * jnp.cos(o))
+
+        g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+        # padded k/v rows must get exactly zero gradient
+        dk, dv = np.asarray(g_fa[1]), np.asarray(g_fa[2])
+        assert np.all(dk[0, 200:] == 0) and np.all(dk[1, 64:] == 0)
+        assert np.all(dv[0, 200:] == 0) and np.all(dv[1, 64:] == 0)
+
+    def test_full_lens_equals_no_lens(self):
+        q, k, v = _rand_qkv(b=2, s=256, h=1, d=64, seed=23)
+        full = flash_attention_bshd(q, k, v, block_q=128, block_k=128,
+                                    interpret=True,
+                                    kv_lens=jnp.asarray([256, 256]))
+        plain = flash_attention_bshd(q, k, v, block_q=128, block_k=128,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(plain),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_sdpa_kv_lens_dispatches_to_flash(monkeypatch):
+    """When the kernel is eligible, SDPA with kv_lens must route to the
+    flash kernel and pass the lengths through (spied; the kernel itself
+    is exercised in interpret mode above)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import attention as attn_mod
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+
+    calls = {}
+
+    def spy(q, k, v, causal=False, kv_lens=None, **kw):
+        calls["kv_lens"] = kv_lens
+        calls["causal"] = causal
+        return jnp.zeros(q.shape, q.dtype)
+
+    monkeypatch.setattr(attn_mod, "_pallas_eligible", lambda q, k: True)
+    monkeypatch.setattr(fa, "flash_attention_bshd", spy)
+    q = paddle.to_tensor(np.zeros((2, 128, 2, 64), np.float32))
+    lens = paddle.to_tensor(np.array([128, 60]))
+    F.scaled_dot_product_attention(q, q, q, kv_lens=lens)
+    assert calls["kv_lens"] is not None
+    np.testing.assert_array_equal(np.asarray(calls["kv_lens"]), [128, 60])
+
+
+def test_kv_lens_oversized_clamped_and_zero_row():
+    """Oversized lengths clamp to seq_k (no uninitialized-tail leak even
+    with a ragged buffer) and zero-length rows return exact zeros."""
+    q, k, v = _rand_qkv(b=2, s=384, h=1, d=64, seed=24)  # 384 % 256 != 0
+    out = flash_attention_bshd(q, k, v, block_q=128, block_k=256,
+                               interpret=True,
+                               kv_lens=jnp.asarray([999, 0]))
+    ref = dense_attention(q, k, v)  # batch 0: full attention
+    np.testing.assert_allclose(np.asarray(out)[0], np.asarray(ref)[0],
+                               rtol=2e-5, atol=2e-5)
+    assert np.all(np.asarray(out)[1] == 0.0)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_sdpa_dense_fallback_zero_length_row_no_nan():
+    """The jnp kv_lens fallback must match the kernel's zero-output
+    convention for all-pad rows instead of producing NaN."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 8, 2, 16)
+                                                 ).astype(np.float32),
+        stop_gradient=False)
+    lens = paddle.to_tensor(np.array([8, 0]))
+    out = F.scaled_dot_product_attention(x, x, x, kv_lens=lens)
+    o = out.numpy()
+    assert np.all(np.isfinite(o))
+    assert np.all(o[1] == 0.0)
+    out.sum().backward()
+    assert np.all(np.isfinite(x.grad.numpy()))

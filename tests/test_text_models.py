@@ -323,3 +323,32 @@ def test_bert_fused_mlm_loss_matches_criterion():
     got = model.fused_mlm_loss(ids, labels_t, nsp_labels=nsp)
     np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_bert_length_mask_matches_dense_mask():
+    """A 1-D attention_mask (per-example valid lengths — the flash-eligible
+    form) must produce the same outputs as the equivalent [b, s] keep
+    mask on the valid positions."""
+    import numpy as np
+
+    from paddle_tpu.text.models import BertModel
+    from paddle_tpu.text.models.bert import BertConfig
+
+    paddle.seed(9)
+    cfg = BertConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                     num_heads=2, intermediate_size=32, max_position=32)
+    model = BertModel(cfg)
+    rng = np.random.default_rng(6)
+    ids = paddle.to_tensor(rng.integers(0, 64, (3, 12)).astype(np.int32))
+    lens = np.array([12, 7, 3])
+    keep = (np.arange(12)[None, :] < lens[:, None]).astype(np.float32)
+
+    seq_l, pooled_l = model(ids, attention_mask=paddle.to_tensor(lens))
+    seq_m, pooled_m = model(ids, attention_mask=paddle.to_tensor(keep))
+    # compare only valid positions: pad rows are garbage either way
+    for b, n in enumerate(lens):
+        np.testing.assert_allclose(seq_l.numpy()[b, :n],
+                                   seq_m.numpy()[b, :n],
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pooled_l.numpy(), pooled_m.numpy(),
+                               rtol=1e-5, atol=1e-5)
