@@ -405,6 +405,19 @@ def _run_worker(which, timeout_s):
     return "error", None
 
 
+def _write_detail(detail):
+    """Durable per-arm record (the driver captures stdout only; the
+    headline line must stay the sole stdout JSON). Written on EVERY
+    path — an outage truncates the file instead of leaving a stale
+    success record from a previous run."""
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_detail.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+    except OSError as e:
+        log(f"[bench] detail record failed: {e!r}")
+
+
 # Overall budget for the headline result (env override for smoke tests).
 GPT_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", 40 * 60))
 
@@ -460,6 +473,7 @@ def main():
                 "detail": detail}
     # Emit the headline NOW: nothing after this point can zero the result.
     print(json.dumps(line), flush=True)
+    _write_detail(detail)
 
     # Best-effort extras — stderr only, one attempt each, bounded. If even
     # the headline failed, the backend is down: don't burn more window.
@@ -469,8 +483,10 @@ def main():
         status, res = _run_worker(which, timeout_s=420)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")
+            detail[which] = res
         else:
             log(f"[bench] {which} skipped ({status})")
+    _write_detail(detail)
 
 
 if __name__ == "__main__":

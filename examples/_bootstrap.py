@@ -1,0 +1,20 @@
+"""Shared example bootstrap: honor JAX_PLATFORMS=cpu under the axon
+container (whose sitecustomize imports jax with the TPU platform preset)
+and, for the mesh examples, self-provision the virtual 8-device CPU mesh.
+Call before any other jax use; same guard idiom as tests/conftest.py and
+__graft_entry__.py."""
+import os
+
+
+def force_cpu_if_requested(virtual_devices=0):
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    if virtual_devices and ("xla_force_host_platform_device_count"
+                            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={virtual_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

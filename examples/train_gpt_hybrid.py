@@ -9,16 +9,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # self-provision the virtual 8-device mesh if not already forced
-    # (before any jax import/backend use, like __graft_entry__)
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8").strip()
-    import jax
+from _bootstrap import force_cpu_if_requested
 
-    jax.config.update("jax_platforms", "cpu")
+force_cpu_if_requested(virtual_devices=8)
 
 import numpy as np
 
