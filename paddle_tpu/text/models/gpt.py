@@ -134,10 +134,15 @@ class GPTModel(nn.Layer):
 
 # ------------------------------------------------------------ generation
 
-def _cached_attention(q, k_new, v_new, cache_k, cache_v, index):
+def _cached_attention(q, k_new, v_new, cache_k, cache_v, index,
+                      pad_lens=None):
     """Write k/v into the static cache at `index` and attend q against
     the valid prefix (TPU decode pattern: fixed-size buffers +
-    dynamic_update_slice, no shape changes step to step)."""
+    dynamic_update_slice, no shape changes step to step).
+
+    pad_lens: optional [b] int32 LEFT-pad counts per example (ragged
+    prompts padded on the left so every row's generation frontier is
+    aligned); columns < pad_lens[b] are masked out."""
     import math as _math
 
     import jax
@@ -146,7 +151,7 @@ def _cached_attention(q, k_new, v_new, cache_k, cache_v, index):
 
     from ...ops._helpers import apply_jfn
 
-    def jfn(qv, kn, vn, ck, cv, idx):
+    def jfn(qv, kn, vn, ck, cv, idx, *rest):
         idx = idx.astype(jnp.int32)
         zero = jnp.asarray(0, idx.dtype)  # all start indices same dtype
         starts = (zero, idx, zero, zero)
@@ -159,19 +164,27 @@ def _cached_attention(q, k_new, v_new, cache_k, cache_v, index):
         sc = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / _math.sqrt(d)
         s_new, L = qv.shape[1], ck.shape[1]
         allowed = (jnp.arange(L)[None, :]
-                   <= (idx + jnp.arange(s_new))[:, None])
-        sc = jnp.where(allowed[None, None], sc, jnp.float32(-1e30))
+                   <= (idx + jnp.arange(s_new))[:, None])[None, None]
+        if rest:  # left-pad mask: [b,1,1,L] AND the causal window
+            pads = rest[0].astype(jnp.int32)
+            allowed = jnp.logical_and(
+                allowed,
+                (jnp.arange(L)[None, :]
+                 >= pads[:, None])[:, None, None, :])
+        sc = jnp.where(allowed, sc, jnp.float32(-1e30))
         # softmax statistics in f32 even for bf16 caches
         w = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(
             vt.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", w, vt).astype(qv.dtype)
         return jnp.swapaxes(out, 1, 2), ck, cv
 
-    return apply_jfn("cached_attention", jfn, q, k_new, v_new, cache_k,
-                     cache_v, index)
+    tensors = [q, k_new, v_new, cache_k, cache_v, index]
+    if pad_lens is not None:
+        tensors.append(pad_lens)
+    return apply_jfn("cached_attention", jfn, *tensors)
 
 
-def _layer_forward_cached(layer, x, cache, index):
+def _layer_forward_cached(layer, x, cache, index, pad_lens=None):
     """Functional: returns (x_out, new_cache) — no mutation, so the whole
     decode step can be captured by to_static and dispatched as ONE
     compiled program per token."""
@@ -180,7 +193,7 @@ def _layer_forward_cached(layer, x, cache, index):
     qkv = layer.qkv(h)
     q, k, v = split_fused_qkv(qkv, b, s, layer.nh, layer.hd)
     attn, ck, cv = _cached_attention(q, k, v, cache["k"], cache["v"],
-                                     index)
+                                     index, pad_lens=pad_lens)
     attn = manip.reshape(attn, [b, s, layer.nh * layer.hd])
     x = x + layer.proj(attn)
     h = layer.ln2(x)
@@ -193,50 +206,76 @@ class GPTGenerationMixin:
     for XLA — fixed-length cache buffers, dynamic_update_slice writes,
     every step the same compiled shape)."""
 
-    def _forward_cached(self, input_ids, caches, index):
+    def _forward_cached(self, input_ids, caches, index, pad_lens=None):
         from ...ops.creation import arange
 
         model = self.gpt
         s = input_ids.shape[1]
         pos = arange(0, s, dtype="int64") + index
+        if pad_lens is not None:
+            # left-padded rows start their position ids AFTER the pads
+            # (clamped at 0 for the pad slots themselves, which attention
+            # masks out anyway)
+            pos = (pos.unsqueeze(0) - pad_lens.unsqueeze(1)).clip(
+                0, self.config.max_seq_len - 1)
         x = model.wte(input_ids) + model.wpe(pos)
         new_caches = []
         for layer, cache in zip(model.layers, caches):
-            x, nc = _layer_forward_cached(layer, x, cache, index)
+            x, nc = _layer_forward_cached(layer, x, cache, index,
+                                          pad_lens=pad_lens)
             new_caches.append(nc)
         x = model.ln_f(x)
         return self._logits_from_hidden(x, shard=False), new_caches
 
-    def _decode_step_impl(self, tok, idx, *kv):
+    def _decode_core(self, tok, idx, pad_lens, kv):
         L = self.config.num_layers
         caches = [{"k": kv[2 * i], "v": kv[2 * i + 1]} for i in range(L)]
-        logits, new = self._forward_cached(tok, caches, idx)
+        logits, new = self._forward_cached(tok, caches, idx,
+                                           pad_lens=pad_lens)
         flat = []
         for c in new:
             flat += [c["k"], c["v"]]
         return (logits, *flat)
 
-    def _make_step(self):
+    # two impls so to_static sees two distinct signatures (the padded
+    # step threads pad_lens as a traced argument)
+    def _decode_step_impl(self, tok, idx, *kv):
+        return self._decode_core(tok, idx, None, kv)
+
+    def _decode_step_padded_impl(self, tok, idx, pad_lens, *kv):
+        return self._decode_core(tok, idx, pad_lens, kv)
+
+    def _make_step(self, padded=False):
         """ONE to_static-wrapped step per INSTANCE: the trace cache
         persists across generate() calls but dies with the model (a
         class-level cache would pin every instance's weights forever —
         the traced closures capture them). Invoked as a bound Layer
         method, so weights are threaded as jit ARGUMENTS, not baked
         into each executable as constants."""
-        if "_decode_step_static" not in self.__dict__:
+        key = "_decode_step_static_padded" if padded else \
+            "_decode_step_static"
+        if key not in self.__dict__:
             from ... import jit as jit_mod
 
-            self.__dict__["_decode_step_static"] = jit_mod.to_static(
-                type(self)._decode_step_impl)
-        return self.__dict__["_decode_step_static"].__get__(
-            self, type(self))
+            impl = (type(self)._decode_step_padded_impl if padded
+                    else type(self)._decode_step_impl)
+            self.__dict__[key] = jit_mod.to_static(impl)
+        return self.__dict__[key].__get__(self, type(self))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, do_sample=False):
+                 top_k=None, do_sample=False, attention_mask=None):
         """input_ids [b, prompt] → [b, min(prompt + max_new_tokens,
-        max_seq_len)]."""
+        max_seq_len)].
+
+        attention_mask: optional [b, prompt] keep-mask for RAGGED
+        prompts, LEFT-padded (zeros first — every row's last prompt
+        token sits at the same column, so one uniform decode loop
+        serves the whole batch); pad columns are masked out of
+        attention and position ids start after the pads.
+        """
         import jax
         import jax.numpy as jnp
+        import numpy as np
 
         from ... import to_tensor
         from ...autograd import no_grad
@@ -245,6 +284,28 @@ class GPTGenerationMixin:
 
         cfg = self.config
         b, prompt = int(input_ids.shape[0]), int(input_ids.shape[1])
+        pad_lens = None
+        if attention_mask is not None:
+            mask_np = np.asarray(attention_mask._value if isinstance(
+                attention_mask, Tensor) else attention_mask)
+            if mask_np.shape != (b, prompt):
+                raise ValueError(
+                    f"attention_mask shape {mask_np.shape} != "
+                    f"{(b, prompt)}")
+            pads_np = (mask_np == 0).sum(axis=1)
+            # generate() is a host loop, so left-contiguity is checkable
+            # eagerly — reject ambiguous (non-left-padded) masks
+            expect = (np.arange(prompt)[None, :] >= pads_np[:, None])
+            if not np.array_equal(mask_np != 0, expect):
+                raise ValueError(
+                    "generate() requires LEFT-padded prompts: "
+                    "attention_mask must be 0s followed by 1s per row")
+            if (pads_np >= prompt).any():
+                raise ValueError(
+                    "attention_mask has an all-zero row (empty prompt): "
+                    "every example needs at least one real token")
+            if pads_np.any():
+                pad_lens = to_tensor(pads_np.astype(np.int32))
         if prompt > cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {prompt} exceeds max_seq_len "
@@ -281,17 +342,23 @@ class GPTGenerationMixin:
                                         cache_dt)),
                     to_tensor(jnp.zeros((b, cache_len, nh, hd),
                                         cache_dt))]
-            step = self._make_step()
+            step = self._make_step(padded=pad_lens is not None)
+
+            def run_step(tok_t, idx_t, kv):
+                if pad_lens is not None:
+                    return step(tok_t, idx_t, pad_lens, *kv)
+                return step(tok_t, idx_t, *kv)
+
             idx0 = to_tensor(jnp.asarray(0, jnp.int32))
-            logits, *flat_kv = step(input_ids, idx0, *flat_kv)
+            logits, *flat_kv = run_step(input_ids, idx0, flat_kv)
             out = [input_ids._value.astype(jnp.int64)]
             tok = pick(logits)
             out.append(tok[:, None].astype(jnp.int64))
             for t in range(1, total - prompt):
                 step_idx = to_tensor(jnp.asarray(prompt + t - 1, jnp.int32))
-                logits, *flat_kv = step(
+                logits, *flat_kv = run_step(
                     Tensor(tok[:, None], stop_gradient=True), step_idx,
-                    *flat_kv)
+                    flat_kv)
                 tok = pick(logits)
                 out.append(tok[:, None].astype(jnp.int64))
         return Tensor(jnp.concatenate(out, axis=1), stop_gradient=True)
