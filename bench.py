@@ -351,6 +351,40 @@ def bench_mnist():
             "steps_per_sec": round(1 / dt, 1)}
 
 
+def bench_generate():
+    """GPT-small KV-cache greedy decode throughput (serving-side metric;
+    static cache + one compiled step per token — text/models/gpt.py)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTForCausalLM, gpt_small
+
+    paddle.seed(0)
+    cfg = gpt_small()
+    model = GPTForCausalLM(cfg)
+    batch, prompt, gen = 8, 128, 128
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+
+    t0 = time.perf_counter()
+    # compile prompt+decode steps; sync so leftover device work can't
+    # bleed into the timed window (the decode loop is fully
+    # async-dispatchable — tokens never reach the host)
+    model.generate(ids, max_new_tokens=8).numpy()
+    log(f"[bench] generate compile {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=gen)
+    out.numpy()  # block: dt must cover execution, not dispatch
+    dt = time.perf_counter() - t0
+    n_new = int(out.shape[1]) - prompt
+    tps = batch * n_new / dt
+    log(f"[bench] generate: {dt:.2f}s for {batch}x{n_new} new tokens, "
+        f"{tps:,.0f} tok/s, {dt / n_new * 1e3:.2f} ms/token-step")
+    return {"model": "gpt-small-decode", "tokens_per_sec": round(tps),
+            "ms_per_token_step": round(dt / n_new * 1e3, 2),
+            "batch": batch}
+
+
 def bench_probe():
     """No-op body: `_worker_bootstrap` already proved the backend is up."""
     return {"probe": "ok"}
@@ -358,7 +392,7 @@ def bench_probe():
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
-            "probe": bench_probe}
+            "generate": bench_generate, "probe": bench_probe}
 
 
 def worker_main(which):
@@ -479,7 +513,7 @@ def main():
     # the headline failed, the backend is down: don't burn more window.
     if gpt is None:
         return
-    for which in ("resnet", "bert", "deepfm", "mnist"):
+    for which in ("resnet", "bert", "deepfm", "mnist", "generate"):
         status, res = _run_worker(which, timeout_s=420)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")
