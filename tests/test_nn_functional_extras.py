@@ -370,3 +370,40 @@ def test_gpt_fused_head_loss_untied_and_ignore_index():
                                    atol=1e-6)
         np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-4,
                                    atol=1e-6)
+
+
+def test_fused_linear_ce_xla_temp_memory_is_smaller():
+    """Mechanized memory proof (no TPU needed): XLA's own memory
+    analysis must show the fused blocked head CE using well under half
+    the temp bytes of the materialized-logits formulation — the [N, V]
+    slabs are the thing being eliminated (docs/PERF_NOTES.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.functional.loss import linear_ce_raw
+
+    n, d, v = 1024, 256, 50304
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((d, v)).astype(np.float32) * 0.02)
+    lbl = jnp.asarray(rng.integers(0, v, n))
+
+    def naive(x, w):
+        logits = x @ w
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lbl[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - picked)
+
+    def fused(x, w):
+        return jnp.mean(linear_ce_raw(x, w, lbl, block_size=256))
+
+    def temp_bytes(fn):
+        c = jax.jit(jax.grad(fn, argnums=(0, 1))).lower(x, w).compile()
+        ma = c.memory_analysis()
+        if ma is None:  # backend without the analysis API
+            pytest.skip("memory_analysis unavailable on this backend")
+        return ma.temp_size_in_bytes
+
+    t_naive, t_fused = temp_bytes(naive), temp_bytes(fused)
+    # builder-measured on CPU XLA: 824 MB vs 259 MB at n=2048, d=768
+    assert t_fused < 0.5 * t_naive, (t_naive, t_fused)
