@@ -40,7 +40,7 @@ __all__ = [
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_size=None, max_seq_len=1024,
-                 dropout=0.0, tie_embeddings=True):
+                 dropout=0.0, tie_embeddings=True, recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -49,6 +49,10 @@ class GPTConfig:
         self.max_seq_len = max_seq_len
         self.dropout = dropout
         self.tie_embeddings = tie_embeddings
+        # per-LAYER activation recompute for the serial/dp path (the
+        # big-model-on-few-chips lever; PP has its own ring-buffer remat).
+        # False | True (keep nothing) | policy name ('dots_saveable', ...)
+        self.recompute = recompute
 
 
 def gpt_tiny(**kw):
@@ -127,8 +131,16 @@ class GPTModel(nn.Layer):
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         x = shard_activation(x, "dp", "sp", None)
-        for layer in self.layers:
-            x = layer(x)
+        rc = self.config.recompute
+        if rc:
+            from ...distributed.fleet.recompute import recompute as _rc
+
+            # checkpoint_policy() normalizes True -> keep-nothing
+            for layer in self.layers:
+                x = _rc(layer, x, policy=rc)
+        else:
+            for layer in self.layers:
+                x = layer(x)
         return self.ln_f(x)
 
 

@@ -398,3 +398,28 @@ def test_generate_ragged_left_padded_matches_per_example():
     with _pytest.raises(ValueError):
         model.generate(paddle.to_tensor(ids), max_new_tokens=2,
                        attention_mask=paddle.to_tensor(empty))
+
+
+def test_gpt_config_recompute_loss_parity():
+    """GPTConfig(recompute=...) — per-layer activation recompute on the
+    serial path — must not change the math (loss sequence identical)."""
+    import numpy as np
+
+    from paddle_tpu.text.models import GPTForCausalLM, GPTPretrainingCriterion
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    crit = GPTPretrainingCriterion()
+    ids = paddle.to_tensor(
+        np.random.default_rng(1).integers(0, 64, (2, 9)).astype(np.int32))
+    losses = {}
+    for rc in (False, True, "dots_saveable"):
+        paddle.seed(23)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_heads=2, max_seq_len=32, recompute=rc)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, lambda m, i: crit(m(i), i), opt)
+        losses[rc] = [float(step(ids).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
+    np.testing.assert_allclose(losses[False], losses["dots_saveable"],
+                               rtol=1e-5)
