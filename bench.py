@@ -351,6 +351,55 @@ def bench_mnist():
             "steps_per_sec": round(1 / dt, 1)}
 
 
+def bench_gpt1p3b():
+    """GPT-1.3B on ONE chip (manual arm — NOT in the best-effort loop:
+    first compile is heavy). Exact recipe from docs/PERF_NOTES.md: O2
+    bf16 params (resident 13.16 GB measured — O1 would not fit), fused
+    vocab head, per-layer recompute. BASELINE.md config 4's single-chip
+    fallback number: tokens/sec/chip + MFU."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_1p3b
+
+    paddle.seed(0)
+    cfg = gpt_1p3b(recompute=True)
+    batch, seq = 1, 2048
+    model = GPTForCausalLM(cfg)
+    model = amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            return m.fused_head_loss(ids, block_size=2048)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    t0 = time.perf_counter()
+    l0 = float(step(ids).numpy())
+    log(f"[bench] gpt-1.3b compile+step0 {time.perf_counter()-t0:.1f}s "
+        f"loss {l0:.3f}")
+    for _ in range(2):
+        step(ids)
+    float(step(ids).numpy())
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step(ids)
+    float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    flops = gpt_flops_per_step(cfg, batch, seq)
+    mfu = flops / dt / V5E_PEAK_BF16
+    tps = batch * seq / dt
+    log(f"[bench] gpt-1.3b: {dt*1e3:.1f} ms/step, {tps:,.0f} tok/s, "
+        f"mfu {mfu:.3f}")
+    return {"model": "gpt-1.3b-single-chip", "ms_per_step": round(dt * 1e3, 2),
+            "tokens_per_sec": round(tps), "mfu": round(mfu, 4)}
+
+
 def bench_generate():
     """GPT-small KV-cache greedy decode throughput (serving-side metric;
     static cache + one compiled step per token — text/models/gpt.py)."""
@@ -392,7 +441,8 @@ def bench_probe():
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
-            "generate": bench_generate, "probe": bench_probe}
+            "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
+            "probe": bench_probe}
 
 
 def worker_main(which):

@@ -36,4 +36,9 @@ timeout 4200 python bench.py >"$OUT/bench_$TS.json" \
     2>"$OUT/bench_$TS.stderr"
 echo "[onchip] bench result:"
 cat "$OUT/bench_$TS.json"
+if [ "${1:-}" = "--full" ]; then
+  echo "[onchip] gpt-1.3b single-chip arm (PERF_NOTES recipe) ..."
+  timeout 1800 python bench.py --worker gpt1p3b \
+      2>&1 | tee "$OUT/gpt1p3b_$TS.log"
+fi
 echo "[onchip] done; promote winners into bench.py defaults + PERF_NOTES."
