@@ -56,7 +56,8 @@ def missing_paths(md_name):
 
 def main():
     bad = {}
-    for md in ("COVERAGE.md", "BASELINE.md", "docs/PERF_NOTES.md"):
+    for md in ("COVERAGE.md", "BASELINE.md", "docs/PERF_NOTES.md",
+               "docs/ARCHITECTURE.md"):
         m = missing_paths(md)
         if m:
             bad[md] = m
