@@ -457,13 +457,33 @@ class TrainStep:
         donate = (0, 1, 2) if self.donate_params else ()
         self._compiled = jax.jit(step, donate_argnums=donate)
 
-    def __call__(self, *batch):
-        if self._compiled is None:
-            self._build()
+    def _split_vals(self):
         train_vals = [p._value for p, t in zip(self._param_objs,
                                                self._trainable) if t]
         frozen_vals = [p._value for p, t in zip(self._param_objs,
                                                 self._trainable) if not t]
+        return train_vals, frozen_vals
+
+    def lower(self, *batch):
+        """Lower the compiled step WITHOUT executing it — for compile-time
+        inspection (cost/memory analysis: `.compile().memory_analysis()`
+        is how tools/membudget.py measures HBM budgets off-hardware)."""
+        if self._compiled is None:
+            self._build()
+        train_vals, frozen_vals = self._split_vals()
+        states = (self._opt_states if self._opt_states is not None
+                  else self.optimizer.init_states_tree(train_vals))
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        return self._compiled.lower(
+            train_vals, frozen_vals, states, self.optimizer.get_lr(),
+            batch_vals, jnp.asarray(self.optimizer._step_count,
+                                    jnp.uint32))
+
+    def __call__(self, *batch):
+        if self._compiled is None:
+            self._build()
+        train_vals, frozen_vals = self._split_vals()
         if self._opt_states is None:
             self._opt_states = self.optimizer.init_states_tree(train_vals)
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
