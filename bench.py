@@ -281,15 +281,36 @@ def bench_deepfm():
         model.fm._first.emb.prefetch(batches[i % nb])
         model.fm._embed.emb.prefetch(batches[i % nb])
 
-    def step(i):
-        logits = model(paddle.to_tensor(batches[i % nb]))
-        prefetch(i + 1)  # pull the NEXT batch's rows during backward/opt
-        loss = nn.functional.binary_cross_entropy_with_logits(
-            logits, ys[i % nb])
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    # default: SparseTrainStep (host pulls + ONE compiled program + host
+    # pushes; eager-parity pinned by tests). BENCH_DEEPFM_EAGER=1 falls
+    # back to the per-op eager loop for an A/B.
+    compiled = os.environ.get("BENCH_DEEPFM_EAGER", "0") != "1"
+    if compiled:
+        from paddle_tpu.distributed.ps import SparseTrainStep
+
+        def loss_fn(m, ids, y):
+            return nn.functional.binary_cross_entropy_with_logits(
+                m(ids), y)
+
+        sts = SparseTrainStep(model, loss_fn, opt)
+
+        def step(i):
+            # prefetch AFTER the step: the single pending slot must not
+            # be overwritten before sts consumes it (a pre-step prefetch
+            # would key-miss every _acquire — 0 hits, doubled pulls)
+            out = sts(paddle.to_tensor(batches[i % nb]), ys[i % nb])
+            prefetch(i + 1)
+            return out
+    else:
+        def step(i):
+            logits = model(paddle.to_tensor(batches[i % nb]))
+            prefetch(i + 1)  # pull NEXT batch's rows during backward/opt
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                logits, ys[i % nb])
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
 
     prefetch(0)
     t0 = time.perf_counter()

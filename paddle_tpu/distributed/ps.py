@@ -545,6 +545,7 @@ class SparseEmbedding:
         self.dim = embedding_dim
         self._pool = None
         self._pending = None  # (key, uniq, inv, shape, future)
+        self._bound = None    # SparseTrainStep trace mode (rows, inv)
         # serializes background pulls against backward-hook pushes: the
         # table's row map/arrays are not safe under concurrent mutation
         self._table_lock = threading.Lock()
@@ -586,24 +587,33 @@ class SparseEmbedding:
         self._pending = (self._key(ids_np), uniq, inv, ids_np.shape, fut)
         return fut
 
-    def __call__(self, ids):
-        from ..ops._helpers import apply_jfn
-
-        ids_np, uniq, inv = None, None, None
-        rows_np = None
+    def _acquire(self, ids):
+        """Pull-or-consume-prefetch: returns (ids_np, uniq, inv, rows_np).
+        Shared by the eager __call__ and SparseTrainStep's host stage."""
         if self._pending is not None:
             key, p_uniq, p_inv, p_shape, fut = self._pending
             probe = np.asarray(
                 ids._value if isinstance(ids, Tensor) else ids).astype(
                 np.int64)
             if self._key(probe) == key:
-                ids_np, uniq, inv = probe, p_uniq, p_inv
-                rows_np = fut.result()
                 self._pending = None
-        if rows_np is None:
-            ids_np, uniq, inv = self._decompose(ids)
-            with self._table_lock:
-                rows_np = self.table.pull(uniq)
+                return probe, p_uniq, p_inv, fut.result()
+        ids_np, uniq, inv = self._decompose(ids)
+        with self._table_lock:
+            rows_np = self.table.pull(uniq)
+        return ids_np, uniq, inv, rows_np
+
+    def __call__(self, ids):
+        from ..ops._helpers import apply_jfn
+
+        if self._bound is not None:
+            # SparseTrainStep trace mode: rows/inv are jit ARGUMENTS —
+            # no host pull, no hook (the step returns row grads to push)
+            rows_b, inv_b = self._bound
+            return apply_jfn(
+                "sparse_embedding_lookup",
+                lambda w, i: jnp.take(w, i, axis=0), rows_b, inv_b)
+        ids_np, uniq, inv, rows_np = self._acquire(ids)
         rows = Tensor(jnp.asarray(rows_np), stop_gradient=False)
         table = self.table
         lock = self._table_lock
@@ -623,6 +633,164 @@ class SparseEmbedding:
 
     def parameters(self):
         return []  # rows live in the table, optimized server-side
+
+
+from ..jit import TrainStep as _TrainStepBase
+
+
+def find_sparse_embeddings(obj, _seen=None):
+    """Walk an object graph for SparseEmbedding instances (they are not
+    Layers, so Layer traversal misses them)."""
+    if _seen is None:
+        _seen = set()
+    if id(obj) in _seen:
+        return []
+    _seen.add(id(obj))
+    if isinstance(obj, SparseEmbedding):
+        return [obj]
+    out = []
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        for v in d.values():
+            out += find_sparse_embeddings(v, _seen)
+    if isinstance(obj, dict):  # Layer._sub_layers etc.
+        for v in obj.values():
+            out += find_sparse_embeddings(v, _seen)
+    if isinstance(obj, (list, tuple)):
+        for v in obj:
+            out += find_sparse_embeddings(v, _seen)
+    return out
+
+
+class SparseTrainStep(_TrainStepBase):
+    """Compiled PS training step (the throughput fix for eager PS
+    models): per step, the HOST pulls each table's unique rows, then ONE
+    donated XLA program runs forward + backward + the dense-param
+    optimizer update AND returns the row gradients, which the host
+    pushes back to the tables (server-side optimizer rules apply them).
+    The eager per-op dispatch loop — reference async-PS's trainer shape,
+    and this module's default — becomes three stages that pipeline with
+    `prefetch` (issue it AFTER the step so the pending slot survives
+    until the next step's pull).
+
+    Subclasses jit.TrainStep: param/optimizer bookkeeping, donation, and
+    the armed-profiler ips hook are shared; _build/__call__ differ
+    because rows/inv are extra traced inputs and row grads an extra
+    output. Unique-row counts vary per batch, so rows/inv are PADDED to
+    a fixed capacity (ids.size worst case): one compile, stable shapes;
+    padded rows are never referenced by inv and get exactly zero
+    gradient.
+
+    Constraints: every SparseEmbedding must key off the SAME ids tensor
+    (`batch[ids_index]`, the single-table CTR layout); loss_fn must be
+    jit-traceable (pure jnp/tape ops).
+    """
+
+    def __init__(self, model, loss_fn, optimizer, ids_index=0,
+                 donate_params=True):
+        self.embs = find_sparse_embeddings(model)
+        if not self.embs:
+            raise ValueError("model has no SparseEmbedding tables; use "
+                             "jit.TrainStep for dense models")
+        super().__init__(model, loss_fn, optimizer,
+                         donate_params=donate_params)
+        self.ids_index = ids_index
+
+    def lower(self, *batch):
+        raise NotImplementedError(
+            "SparseTrainStep's compiled signature carries per-step "
+            "rows/inv operands; lower a dense TrainStep for memory "
+            "analysis instead")
+
+    def _build(self):
+        import jax
+
+        from ..core import rng as rng_mod
+
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        param_objs, trainable, embs = (self._param_objs, self._trainable,
+                                       self.embs)
+        train_objs = [p for p, t in zip(param_objs, trainable) if t]
+        base_key = rng_mod.next_key()  # per-step dropout keys, as TrainStep
+
+        def pure_loss(train_vals, rows_vals, frozen_vals, inv_vals,
+                      batch_vals, step_key):
+            originals = [p._value for p in param_objs]
+            it_t, it_f = iter(train_vals), iter(frozen_vals)
+            for p, tr in zip(param_objs, trainable):
+                p._value = next(it_t) if tr else next(it_f)
+            try:
+                for emb, rv, iv in zip(embs, rows_vals, inv_vals):
+                    emb._bound = (Tensor(rv, stop_gradient=False),
+                                  Tensor(iv, stop_gradient=True))
+                batch = [Tensor(v, stop_gradient=True)
+                         for v in batch_vals]
+                with rng_mod.trace_key_scope(step_key):
+                    loss = loss_fn(model, *batch)
+                new_frozen = [p._value for p, tr in zip(param_objs,
+                                                        trainable)
+                              if not tr]
+            finally:
+                for emb in embs:
+                    emb._bound = None
+                for p, v in zip(param_objs, originals):
+                    p._value = v
+            return loss._value, new_frozen
+
+        def step(train_vals, frozen_vals, opt_states, lr, rows_vals,
+                 inv_vals, batch_vals, step_idx):
+            step_key = jax.random.fold_in(base_key, step_idx)
+            (loss, new_frozen), (dgrads, rgrads) = jax.value_and_grad(
+                pure_loss, argnums=(0, 1), has_aux=True)(
+                train_vals, rows_vals, frozen_vals, inv_vals, batch_vals,
+                step_key)
+            new_vals, new_states = opt.apply_gradients_tree(
+                train_vals, dgrads, opt_states, lr, param_objs=train_objs)
+            return loss, new_vals, new_states, new_frozen, rgrads
+
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def __call__(self, *batch):
+        if self._compiled is None:
+            self._build()
+        ids = batch[self.ids_index]
+        cap = int(np.prod(np.asarray(
+            ids._value if isinstance(ids, Tensor) else ids).shape))
+        rows_vals, inv_vals, uniqs, counts = [], [], [], []
+        for emb in self.embs:
+            ids_np, uniq, inv, rows_np = emb._acquire(ids)
+            u = len(uniq)
+            pad = np.zeros((cap - u, rows_np.shape[1]), rows_np.dtype)
+            rows_vals.append(jnp.asarray(np.concatenate([rows_np, pad])))
+            inv_vals.append(jnp.asarray(inv.reshape(ids_np.shape)))
+            uniqs.append(uniq)
+            counts.append(u)
+        train_vals, frozen_vals = self._split_vals()
+        if self._opt_states is None:
+            self._opt_states = self.optimizer.init_states_tree(train_vals)
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        loss, new_vals, self._opt_states, new_frozen, rgrads = \
+            self._compiled(train_vals, frozen_vals, self._opt_states,
+                           self.optimizer.get_lr(), rows_vals, inv_vals,
+                           batch_vals,
+                           jnp.asarray(self.optimizer._step_count,
+                                       jnp.uint32))
+        it, it_f = iter(new_vals), iter(new_frozen)
+        for p, t in zip(self._param_objs, self._trainable):
+            p._value = next(it) if t else next(it_f)
+        self.optimizer._step_count += 1
+        for emb, uniq, u, g in zip(self.embs, uniqs, counts, rgrads):
+            with emb._table_lock:
+                emb.table.push(uniq, np.asarray(g)[:u])
+        from ..profiler import benchmark
+
+        bm = benchmark()
+        if bm.enabled:  # armed ips meter, as jit.TrainStep
+            n = batch_vals[0].shape[0] if batch_vals and \
+                getattr(batch_vals[0], "ndim", 0) else None
+            bm.auto_step(num_samples=n)
+        return Tensor(loss, stop_gradient=True)
 
 
 def ShardedEmbedding(num_embeddings, embedding_dim, axis="mp", **kwargs):
