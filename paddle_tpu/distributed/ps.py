@@ -683,7 +683,11 @@ class SparseTrainStep(_TrainStepBase):
 
     Constraints: every SparseEmbedding must key off the SAME ids tensor
     (`batch[ids_index]`, the single-table CTR layout); loss_fn must be
-    jit-traceable (pure jnp/tape ops).
+    jit-traceable (pure jnp/tape ops). Single-PROCESS: the dense update
+    runs inside the compiled step with local grads, so multi-host
+    data-parallel PS training keeps the eager loop (whose hook pushes
+    and explicit dense all-reduce are collective-safe —
+    tests/ps_worker.py phase B is the pattern).
     """
 
     def __init__(self, model, loss_fn, optimizer, ids_index=0,
