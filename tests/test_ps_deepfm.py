@@ -559,3 +559,28 @@ def test_sparse_train_step_matches_eager_loop():
             m3.fm._first.emb.prefetch(batches[i + 1][0])
             m3.fm._embed.emb.prefetch(batches[i + 1][0])
     np.testing.assert_allclose(got3, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sparse_train_step_rejects_dense_models():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.ps import SparseTrainStep
+
+    m = nn.Linear(4, 2)
+    o = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    with pytest.raises(ValueError, match="no SparseEmbedding"):
+        SparseTrainStep(m, lambda mo, x: mo(x).sum(), o)
+
+
+def test_sparse_train_step_lower_unsupported():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.ps import SparseTrainStep
+
+    model = paddle.rec.DeepFM(num_fields=4, embed_dim=4, sparse=True)
+    o = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    step = SparseTrainStep(
+        model, lambda m, i, y: nn.functional.binary_cross_entropy_with_logits(
+            m(i), y), o)
+    with pytest.raises(NotImplementedError):
+        step.lower(None)
