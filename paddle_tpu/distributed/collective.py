@@ -317,6 +317,26 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+def _resolve_member_rank(g, rank, what):
+    """Validate src/dst against the group and return its position along
+    the group axes. src/dst use the same numbering as `new_group(ranks=…)`
+    — positions along the group's axes, which for a whole-mesh group IS
+    the global rank. Mirrors reference collective.py broadcast →
+    group.get_group_rank(src): a rank outside a ranks-subset group is an
+    error, not a silent index into the members list."""
+    if g.ranks is not None:
+        if g.get_group_rank(rank) == -1:
+            raise ValueError(
+                f"{what}={rank} is not a member of {g!r}; src/dst use "
+                "the same numbering as new_group(ranks=...) (reference "
+                "get_group_rank semantics)")
+        return rank
+    size = g._static_size()
+    if not 0 <= rank < size:
+        raise ValueError(f"{what}={rank} out of range for {g!r}")
+    return rank
+
+
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce-to-root (reference collective.py:849): only rank `dst`
     receives the reduced value; every other rank keeps its ORIGINAL
@@ -324,9 +344,11 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     ranks mask back to their input (XLA would emit the all-reduce
     anyway — the masking costs one select); in the eager
     multi-controller path non-dst processes simply restore their local
-    value after the wire all-reduce. `dst` is the position along the
-    group's axes (== the group rank for whole-axis groups; for
-    ranks-subset groups it is the GROUP rank within `ranks`)."""
+    value after the wire all-reduce. `dst` uses the same numbering as
+    `new_group(ranks=...)` — the position along the group's axes (the
+    global rank, for a whole-mesh group); for ranks-subset groups it
+    must be a member (reference converts via Group.get_group_rank and
+    errors on non-members)."""
     t = ensure_tensor(tensor)
     if not _in_spmd():
         g = group or _ensure_default()
@@ -349,13 +371,11 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
             "region (paddle_tpu.distributed.spmd / parallelized step)")
     g = group or _ensure_default()
 
+    dst_pos = _resolve_member_rank(g, dst, "dst")
+
     def jfn(v):
         member, idx = _member_mask(g)
         red = _masked_reduce(v, op, g)
-        if g.ranks is not None:
-            dst_pos = list(g.ranks)[dst]  # dst = group rank
-        else:
-            dst_pos = dst
         return jnp.where(idx == dst_pos, red, v)
 
     out = apply_jfn("c_reduce", jfn, t)
@@ -445,12 +465,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     g = group or _ensure_default()
     axes = _axes_of(group)
 
+    src_pos = _resolve_member_rank(g, src, "src")
+
     def jfn(v):
-        # take the value living on rank `src` of the axis; for a
-        # ranks-subset group src is the GROUP rank and non-members keep
-        # their own value
+        # take the value living at axis position `src`; for a
+        # ranks-subset group non-members keep their own value
         member, idx = _member_mask(g)
-        src_pos = list(g.ranks)[src] if g.ranks is not None else src
         gathered = lax.all_gather(v, axes, axis=0)
         picked = gathered[src_pos]
         return picked if member is None else jnp.where(member, picked, v)
@@ -515,21 +535,22 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g2 = group or _ensure_default()
     axes = _axes_of(group)
 
+    src_pos = _resolve_member_rank(g2, src, "src")
+
     def jfn(full):
         # src semantics for rank-divergent inputs: use src's full tensor;
-        # for ranks-subset groups src/dst are GROUP ranks, chunks are
-        # dealt only to members, and non-members get zeros (they are not
-        # part of the collective — there is no same-shape "untouched"
-        # value, the output shape is the chunk shape)
+        # src is the axis position (reference get_group_rank conversion);
+        # chunks are dealt only to members, and non-members get zeros
+        # (they are not part of the collective — there is no same-shape
+        # "untouched" value, the output shape is the chunk shape)
         member, idx = _member_mask(g2)
         gathered = lax.all_gather(full, axes, axis=0)
+        src_full = gathered[src_pos]
         if g2.ranks is not None:
             ranks_arr = jnp.asarray(np.asarray(g2.ranks))
-            src_full = gathered[list(g2.ranks)[src]]
             n = len(g2.ranks)
             grp_rank = jnp.argmax(ranks_arr == idx)  # 0 for non-members
         else:
-            src_full = gathered[src]
             n = mesh_mod.axis_size(
                 axes if isinstance(axes, str) else axes[0])
             grp_rank = idx

@@ -164,21 +164,16 @@ def _linear_ce_bwd(block, res, g):
         db = db + jnp.sum(d, axis=0)
         return (dw, db), dxi
 
-    # The dw carry is read+written once per scan block — at GPT-small
-    # bench shape that traffic rivals the logits slab this kernel
-    # avoids. Carry in w's own dtype (bf16 under AMP: halves it, and
-    # the result is cast there anyway; per-block products still
-    # accumulate in fp32 via preferred_element_type) and keep the block
-    # count small (block_size default 4096 → 4 carry round-trips).
-    acc_t = w.dtype if w.dtype == jnp.bfloat16 else jnp.float32
-
-    def body_cast(carry, inp):
-        (dw, db), dxi = body(carry, inp)
-        return (dw.astype(acc_t), db), dxi
-
-    dw0 = jnp.zeros(w.shape, acc_t)
+    # The dw carry stays fp32 regardless of w's dtype: a bf16 carry
+    # rounds the running sum to an 8-bit mantissa every block, losing
+    # small per-block contributions as the block count grows (long
+    # sequences / small block_size) — a silent gradient-quality
+    # regression under AMP. The HBM cost is one fp32 [h, V] carry
+    # round-trip per block; keep block_size large (default 4096 → ~4
+    # round-trips) rather than narrowing the accumulator.
+    dw0 = jnp.zeros(w.shape, jnp.float32)
     db0 = jnp.zeros(bias.shape, jnp.float32)
-    (dw, db), dx = jax.lax.scan(body_cast, (dw0, db0), (xb, lb, lseb, gb))
+    (dw, db), dx = jax.lax.scan(body, (dw0, db0), (xb, lb, lseb, gb))
     return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
             db.astype(bias.dtype), None)
 

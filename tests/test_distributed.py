@@ -184,8 +184,8 @@ class TestCollectives:
         mesh_mod.init_mesh(dp=8)
         g = dist.new_group(ranks=[2, 5, 7], axes=("dp",))
 
-        def fb(x):  # src=1 is GROUP rank -> global rank 5
-            return dist.broadcast(paddle.Tensor(x), src=1, group=g)._value
+        def fb(x):  # src=5 is a GLOBAL rank (reference get_group_rank)
+            return dist.broadcast(paddle.Tensor(x), src=5, group=g)._value
 
         out = np.asarray(dist.spmd(fb, in_specs=P("dp"),
                                    out_specs=P("dp"),
@@ -194,8 +194,16 @@ class TestCollectives:
         expect[[2, 5, 7]] = 5.0
         np.testing.assert_allclose(out, expect)
 
-        def fr(x):  # dst=2 is GROUP rank -> global rank 7
-            return dist.reduce(paddle.Tensor(x), dst=2, group=g)._value
+        # a non-member src is an error, not a silent reinterpretation
+        with pytest.raises(ValueError, match="not a member"):
+            dist.spmd(
+                lambda x: dist.broadcast(
+                    paddle.Tensor(x), src=3, group=g)._value,
+                in_specs=P("dp"), out_specs=P("dp"),
+                group_axes=("dp",))(jnp.arange(8.0))
+
+        def fr(x):  # dst=7 is a GLOBAL rank
+            return dist.reduce(paddle.Tensor(x), dst=7, group=g)._value
 
         out = np.asarray(dist.spmd(fr, in_specs=P("dp"),
                                    out_specs=P("dp"),
@@ -205,20 +213,20 @@ class TestCollectives:
         np.testing.assert_allclose(out, expect)
 
     def test_scatter_rank_subset_group(self):
-        # subgroup scatter: src is a GROUP rank, chunks deal only to
+        # subgroup scatter: src is a GLOBAL rank, chunks deal only to
         # members (len(ranks) chunks), non-members receive zeros
         mesh_mod.init_mesh(dp=8)
         g = dist.new_group(ranks=[1, 4, 6], axes=("dp",))
 
         def fn(x):
-            return dist.scatter(paddle.Tensor(x[0]), src=0, group=g)._value
+            return dist.scatter(paddle.Tensor(x[0]), src=1, group=g)._value
 
         f = dist.spmd(fn, in_specs=P("dp", None), out_specs=P("dp"),
                       group_axes=("dp",))
         full = np.tile(np.arange(6.0)[None, :], (8, 1))
         full += 1000.0 * np.arange(8.0)[:, None]  # rank-divergent
         out = np.asarray(f(jnp.asarray(full))).reshape(8, 2)
-        # src group-rank 0 = global rank 1; its vector is arange(6)+1000
+        # src = global rank 1 (group rank 0); its vector is arange(6)+1000
         expect = np.zeros((8, 2))
         expect[1] = [1000.0, 1001.0]
         expect[4] = [1002.0, 1003.0]
