@@ -421,6 +421,62 @@ def bench_gpt1p3b():
             "tokens_per_sec": round(tps), "mfu": round(mfu, 4)}
 
 
+def bench_gpt1p3b_pp():
+    """GPT-1.3B through the HYBRID pipeline path (pipeline_1f1b with
+    Megatron mp inside stages + vocab-parallel head — the reference's
+    headline TP+PP+DP call stack). On one chip the (dp, pp, mp) mesh is
+    degenerate and the same code runs serially with per-layer remat; on
+    an n-chip slice set BENCH_PP/BENCH_MP/BENCH_DP — zero new code.
+    Manual arm like gpt1p3b (heavy first compile)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.text.models.gpt import gpt_1p3b
+    from paddle_tpu.text.models.gpt_pipeline import PipelinedGPTForCausalLM
+
+    n = len(jax.devices())
+    pp = int(os.environ.get("BENCH_PP", 2 if n % 2 == 0 and n > 1 else 1))
+    mp = int(os.environ.get("BENCH_MP", 2 if n % (2 * pp) == 0 else 1))
+    dp = int(os.environ.get("BENCH_DP", n // (pp * mp)))
+    mesh_mod.init_mesh(dp=dp, pp=pp, mp=mp)
+    log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp}")
+
+    paddle.seed(0)
+    cfg = gpt_1p3b()
+    batch, seq, n_micro = 2 * max(dp, 1), 2048, 2
+    model = PipelinedGPTForCausalLM(cfg, n_micro=n_micro, remat="layer")
+    model = amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda m, i: m.loss(i), opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    t0 = time.perf_counter()
+    l0 = float(step(ids).numpy())
+    log(f"[bench] gpt-1.3b-pp compile+step0 {time.perf_counter()-t0:.1f}s "
+        f"loss {l0:.3f}")
+    for _ in range(2):
+        step(ids)
+    float(step(ids).numpy())
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        last = step(ids)
+    float(last.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    flops = gpt_flops_per_step(cfg, batch, seq)
+    mfu = flops / dt / (V5E_PEAK_BF16 * n)
+    tps = batch * seq / dt
+    log(f"[bench] gpt-1.3b-pp: {dt*1e3:.1f} ms/step, {tps:,.0f} tok/s, "
+        f"mfu {mfu:.3f} (of {n}-chip peak)")
+    return {"model": "gpt-1.3b-hybrid-pipeline",
+            "mesh": {"dp": dp, "pp": pp, "mp": mp},
+            "ms_per_step": round(dt * 1e3, 2),
+            "tokens_per_sec": round(tps), "mfu": round(mfu, 4)}
+
+
 def bench_generate():
     """GPT-small KV-cache greedy decode throughput (serving-side metric;
     static cache + one compiled step per token — text/models/gpt.py)."""
@@ -463,7 +519,7 @@ def bench_probe():
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
             "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
-            "probe": bench_probe}
+            "gpt1p3b_pp": bench_gpt1p3b_pp, "probe": bench_probe}
 
 
 def worker_main(which):

@@ -6,7 +6,9 @@ from .mp_layers import (  # noqa: F401
     mark_sharding,
     shard_activation,
 )
+from .mp_ops import allreduce_mp, copy_to_mp  # noqa: F401
 from .pipeline_1f1b import (  # noqa: F401
+    PipelineSpecs,
     interleaved_pipeline_loss,
     interleaved_stacking_order,
     pipeline_1f1b,

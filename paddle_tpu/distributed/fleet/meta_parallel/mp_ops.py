@@ -1,0 +1,69 @@
+"""Tensor-parallel communication primitives for explicit-SPMD regions.
+
+TPU-native counterpart of the reference mpu comm ops
+(reference: python/paddle/distributed/fleet/layers/mpu/mp_ops.py —
+`_c_identity`: identity forward / allreduce backward, and
+`_mp_allreduce`: allreduce forward / identity backward). The GSPMD layers
+in mp_layers.py don't need these — the partitioner inserts collectives
+from sharding annotations. Inside a `shard_map` (the 1F1B pipeline body,
+ring attention, …) collectives are explicit, and the VJP pairing matters:
+
+  copy_to_mp(x)      enters an mp-parallel region. Forward is identity
+                     (x is replicated over 'mp'); backward psums the
+                     per-shard partial cotangents so dx is replicated
+                     again. Place on the INPUT of a column-parallel
+                     matmul.
+  allreduce_mp(x)    leaves an mp-parallel region. Forward psums the
+                     partial products of a row-parallel matmul; backward
+                     is identity — every shard's downstream computation
+                     of the cotangent is replicated, so the cotangent is
+                     already the right per-shard value. Place on the
+                     OUTPUT of a row-parallel matmul.
+
+Relying on jax's default transpose of `lax.psum` under
+`check_vma=False` instead of this explicit pairing silently multiplies
+gradients by the axis size (psum transposes to psum); the custom_vjp
+forms below pin the Megatron-correct semantics.
+"""
+import functools
+
+import jax
+from jax import lax
+
+__all__ = ["copy_to_mp", "allreduce_mp"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_mp(x, axis="mp"):
+    """Identity forward, psum-over-`axis` backward
+    (reference mp_ops.py `_c_identity`)."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_mp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def allreduce_mp(x, axis="mp"):
+    """psum-over-`axis` forward, identity backward
+    (reference mp_ops.py `_mp_allreduce`)."""
+    return lax.psum(x, axis)
+
+
+def _ar_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _ar_bwd(axis, _, g):
+    return (g,)
+
+
+allreduce_mp.defvjp(_ar_fwd, _ar_bwd)

@@ -30,6 +30,7 @@ allreduce (reference pp_utils/utils.py FusedAllReduceBuffer): both paths'
 grads meet in the outer AD sum.
 """
 import functools
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +41,51 @@ from ... import mesh as mesh_mod
 
 __all__ = ["pipeline_1f1b", "pipeline_forward_loss",
            "interleaved_pipeline_loss", "interleaved_stacking_order",
-           "schedule_ticks"]
+           "schedule_ticks", "PipelineSpecs"]
+
+
+class PipelineSpecs(NamedTuple):
+    """Per-leaf PartitionSpecs for a hybrid (pp × mp × dp) pipeline run.
+
+    Hashable (tuples of PartitionSpec) so it can ride custom_vjp
+    nondiff_argnums without retracing. `stacked`/`post` are the specs of
+    `tree_leaves(stacked_params)` / `tree_leaves(post_params)` IN LEAF
+    ORDER (every stacked spec must lead with 'pp'); `x`/`y` shard the
+    micro-batched inputs (e.g. P(None, 'dp', None, None) to data-shard
+    the within-micro batch dim); `dp_axis` names the mesh axis to
+    pmean losses/grads over (the reference's DP allreduce —
+    fleet/meta_parallel/.../pipeline_parallel.py composes pp with the
+    dp communicator the same way).
+    """
+    stacked: Optional[Tuple] = None
+    post: Optional[Tuple] = None
+    x: Optional[P] = None
+    y: Optional[P] = None
+    dp_axis: Optional[str] = None
+
+
+def _unflatten_like(tree, leaf_specs, default_fn, require_pp=False):
+    """Spec pytree matching `tree`: from `leaf_specs` (tuple in leaf
+    order) or `default_fn(leaf)` when leaf_specs is None. With
+    `require_pp`, every spec must lead with 'pp' (stage-stacked leaves) —
+    checked on BOTH the training and forward-only entry points, since a
+    missing 'pp' silently mis-shards instead of erroring."""
+    if leaf_specs is None:
+        tree = jax.tree_util.tree_map(default_fn, tree)
+    else:
+        treedef = jax.tree_util.tree_structure(tree)
+        if treedef.num_leaves != len(leaf_specs):
+            raise ValueError(
+                f"PipelineSpecs has {len(leaf_specs)} leaf specs, params "
+                f"have {treedef.num_leaves} leaves")
+        tree = jax.tree_util.tree_unflatten(treedef, list(leaf_specs))
+    if require_pp:
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda s: isinstance(s, P)):
+            if len(leaf) == 0 or leaf[0] != "pp":
+                raise ValueError(
+                    f"stacked spec {leaf} must lead with the 'pp' axis")
+    return tree
 
 
 def _tree_zeros(tree):
@@ -75,7 +120,7 @@ def schedule_ticks(M, pp, num_virtual=1):
 
 
 def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
-                  y_micro, pp, remat, num_virtual=1):
+                  y_micro, pp, remat, num_virtual=1, dp_axis=None):
     """Inside shard_map over 'pp'. Returns (loss_sum, param_grads,
     post_grads, dx_micro).
 
@@ -247,13 +292,28 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
     hgrads = jax.tree_util.tree_map(
         lambda g: lax.psum(g, "pp") * inv_m, hgrads)
     dxs = lax.psum(dxs, "pp") * inv_m
+    if dp_axis is not None:
+        # data parallel composed into the SAME program: each dp shard ran
+        # the schedule on its slice of every micro-batch, so the global
+        # loss is the mean over shards and param grads are pmean'd (the
+        # reference's DP allreduce, fused here by XLA with the schedule).
+        # dx stays dp-sharded — each shard owns its slice's cotangent of
+        # the GLOBAL mean loss, hence the 1/dp factor.
+        inv_dp = 1.0 / mesh_mod.axis_size(dp_axis)
+        loss = lax.pmean(loss, dp_axis)
+        pgrads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, dp_axis), pgrads)
+        hgrads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, dp_axis), hgrads)
+        dxs = dxs * inv_dp
     return loss, pgrads, hgrads, dxs
 
 
 def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
-                          batch):
+                          batch, specs=None):
     """Forward-only fill-drain pipeline loss (eval path — no gradient
-    machinery, M + pp − 1 ticks instead of the 1F1B schedule's fwd+bwd)."""
+    machinery, M + pp − 1 ticks instead of the 1F1B schedule's fwd+bwd).
+    `specs` composes mp/dp exactly as in `pipeline_1f1b`."""
     mesh = mesh_mod.global_mesh()
     pp = mesh.shape["pp"]
     x_micro, y_micro = batch
@@ -263,6 +323,7 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
             lambda x, y: loss_fn(block_fn(stacked_params, x), y,
                                  post_params))(x_micro, y_micro)
         return jnp.mean(losses)
+    sp = specs if specs is not None else PipelineSpecs()
 
     def per_stage(params, post_params, xs, ys):
         stage = lax.axis_index("pp")
@@ -285,25 +346,30 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
         (loss_sum, _), _ = lax.scan(
             tick, (jnp.zeros([], jnp.float32),
                    jnp.zeros(xs.shape[1:], xs.dtype)), jnp.arange(T))
-        return lax.psum(loss_sum, "pp") / M
+        loss = lax.psum(loss_sum, "pp") / M
+        if sp.dp_axis is not None:
+            loss = lax.pmean(loss, sp.dp_axis)
+        return loss
 
-    stack_spec = jax.tree_util.tree_map(
-        lambda a: P(*(["pp"] + [None] * (a.ndim - 1))), stacked_params)
-    rep = lambda t: jax.tree_util.tree_map(
-        lambda a: P(*([None] * a.ndim)), t)
+    stack_spec = _unflatten_like(
+        stacked_params, sp.stacked,
+        lambda a: P(*(["pp"] + [None] * (a.ndim - 1))), require_pp=True)
+    post_spec = _unflatten_like(
+        post_params, sp.post, lambda a: P(*([None] * a.ndim)))
+    x_spec = sp.x if sp.x is not None else P(*([None] * x_micro.ndim))
+    y_spec = sp.y if sp.y is not None else P(*([None] * y_micro.ndim))
     run = jax.shard_map(
         per_stage, mesh=mesh,
-        in_specs=(stack_spec, rep(post_params),
-                  P(*([None] * x_micro.ndim)), P(*([None] * y_micro.ndim))),
+        in_specs=(stack_spec, post_spec, x_spec, y_spec),
         out_specs=P(),
         check_vma=False,
     )
     return run(stacked_params, post_params, x_micro, y_micro)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5, 6, 7))
 def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat=True, num_virtual=1):
+                  remat=True, num_virtual=1, specs=None):
     """Differentiable 1F1B pipeline loss.
 
     block_fn(stage_params, x) -> y   one stage's pure forward; stage_params
@@ -317,29 +383,44 @@ def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
         OUTER function for tying).
     batch: (x_micro [M, ...], y_micro [M, ...]) — micro-batched input
         activations and labels.
+    specs: optional PipelineSpecs composing tensor parallelism INSIDE the
+        stage blocks (mp-sharded weight leaves; block_fn/loss_fn use the
+        mp_ops collectives) and data parallelism across the within-micro
+        batch dim — the reference's hybrid TP+PP+DP flagship
+        (fleet/meta_parallel/pipeline_parallel.py:105 with mp_layers
+        ColumnParallel/RowParallel inside each stage) as ONE SPMD program.
 
     Returns the mean micro-batch loss. Differentiable w.r.t.
     stacked_params, post_params and x_micro (so an embedding stage in the
     caller composes through outer AD).
     """
     loss, _, _, _ = _pipeline_call(block_fn, loss_fn, stacked_params,
-                                   post_params, batch, remat, num_virtual)
+                                   post_params, batch, remat, num_virtual,
+                                   specs)
     return loss
 
 
 def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
-                   remat, num_virtual=1):
+                   remat, num_virtual=1, specs=None):
     mesh = mesh_mod.global_mesh()
     pp = mesh.shape["pp"]
     V = num_virtual
     x_micro, y_micro = batch
     if pp == 1:
-        # degenerate: straight-line execution, still micro-batched
+        # degenerate: straight-line execution, still micro-batched.
+        # remat is honored here too — a 1-chip run of a large model
+        # (the gpt1p3b bench arm) needs the same activation economy as
+        # the pipelined path.
+        from ..recompute import checkpoint_policy
+
+        blk1 = (jax.checkpoint(block_fn, policy=checkpoint_policy(remat))
+                if remat else block_fn)
+
         def apply_chunks(sp, x):
             if V == 1:
-                return block_fn(sp, x)
+                return blk1(sp, x)
             for v in range(V):
-                x = block_fn(
+                x = blk1(
                     jax.tree_util.tree_map(lambda a, _v=v: a[_v], sp), x)
             return x
 
@@ -353,36 +434,38 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
         pg, hg, dx = vjp(jnp.ones_like(loss))
         return loss, pg, hg, dx
 
-    stack_spec = jax.tree_util.tree_map(
-        lambda a: P(*(["pp"] + [None] * (a.ndim - 1))), stacked_params)
-    rep = lambda t: jax.tree_util.tree_map(
-        lambda a: P(*([None] * a.ndim)), t)
+    sp = specs if specs is not None else PipelineSpecs()
+    stack_spec = _unflatten_like(
+        stacked_params, sp.stacked,
+        lambda a: P(*(["pp"] + [None] * (a.ndim - 1))), require_pp=True)
+    post_spec = _unflatten_like(
+        post_params, sp.post, lambda a: P(*([None] * a.ndim)))
+    x_spec = sp.x if sp.x is not None else P(*([None] * x_micro.ndim))
+    y_spec = sp.y if sp.y is not None else P(*([None] * y_micro.ndim))
 
     # For V > 1 the stage's shard of the [pp·V] stack is its V chunks in
     # order (rows [s·V, (s+1)·V), see interleaved_stacking_order) — exactly
     # the leading-[V] layout _run_schedule selects from per tick.
     run = jax.shard_map(
         functools.partial(_run_schedule, block_fn, loss_fn, pp=pp,
-                          remat=remat, num_virtual=V),
+                          remat=remat, num_virtual=V, dp_axis=sp.dp_axis),
         mesh=mesh,
-        in_specs=(stack_spec, rep(post_params), P(*([None] * x_micro.ndim)),
-                  P(*([None] * y_micro.ndim))),
-        out_specs=(P(), stack_spec, rep(post_params),
-                   P(*([None] * x_micro.ndim))),
+        in_specs=(stack_spec, post_spec, x_spec, y_spec),
+        out_specs=(P(), stack_spec, post_spec, x_spec),
         check_vma=False,
     )
     return run(stacked_params, post_params, x_micro, y_micro)
 
 
 def _pipeline_fwd(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat, num_virtual=1):
+                  remat, num_virtual=1, specs=None):
     loss, pg, hg, dx = _pipeline_call(block_fn, loss_fn, stacked_params,
                                       post_params, batch, remat,
-                                      num_virtual)
+                                      num_virtual, specs)
     return loss, (pg, hg, dx, batch[1])
 
 
-def _pipeline_bwd(block_fn, loss_fn, remat, num_virtual, res, g):
+def _pipeline_bwd(block_fn, loss_fn, remat, num_virtual, specs, res, g):
     pg, hg, dx, y = res
     scale = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
     return (scale(pg), scale(hg),
@@ -412,7 +495,7 @@ def interleaved_stacking_order(pp, num_virtual):
 
 def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
                               post_params, batch, num_virtual=1,
-                              remat=True):
+                              remat=True, specs=None):
     """Tick-interleaved virtual-stage 1F1B loss (reference:
     fleet/meta_parallel/pipeline_parallel.py:416
     PipelineParallelWithInterleave, parallel_layers/pp_layers.py:198).
@@ -439,4 +522,4 @@ def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
         raise ValueError(
             f"stacked_params leading dim {lead} != pp*V = {pp}*{num_virtual}")
     return pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params,
-                         batch, remat, num_virtual)
+                         batch, remat, num_virtual, specs)

@@ -1,23 +1,43 @@
 """Pipeline-parallel GPT: heterogeneous embedding/head stages + uniform
-decoder stack on the 1F1B SPMD schedule.
+decoder stack on the 1F1B SPMD schedule, with Megatron tensor parallelism
+COMPOSED INSIDE each stage (the reference's hybrid TP+PP+DP flagship).
 
 (reference: fleet/meta_parallel/parallel_layers/pp_layers.py — GPT built as
 PipelineLayer([SharedLayerDesc(embedding), LayerDesc(decoder)×L,
-SharedLayerDesc(head)]) and run by pipeline_parallel.py's 1F1B. Here the
-same decomposition maps onto pipeline_1f1b: embedding runs in the outer
-program (its grad arrives through the pipeline's input cotangents), the L
-decoder layers live as STACKED parameters [L, ...] sharded over 'pp', and
-the tied head + final LN ride as post_params into the last stage's loss —
-tying needs no shared-weight allreduce, the two grad paths meet in autodiff.)
-"""
-import math
+SharedLayerDesc(head)]) and run by pipeline_parallel.py:105's 1F1B with
+ColumnParallel/RowParallel mpu layers inside each LayerDesc
+(fleet/layers/mpu/mp_layers.py:155/:293) and ParallelCrossEntropy
+(mp_layers.py:438) on the last stage. Here the same decomposition maps onto
+pipeline_1f1b: embedding runs in the outer program (its grad arrives
+through the pipeline's input cotangents), the L decoder layers live as
+STACKED parameters [L, ...] sharded over 'pp' — and, per-leaf, over 'mp'
+in the Megatron column/row pattern — and the tied head + final LN ride as
+post_params (head weight vocab-sharded over 'mp') into the last stage's
+loss. Weight tying needs no shared-weight allreduce: the two grad paths
+meet in outer autodiff. Tensor-parallel collectives inside the stage body
+are the explicit custom_vjp pairs from mp_ops.py (identity/allreduce —
+reference mpu/mp_ops.py `_c_identity`/`_mp_allreduce`); data parallelism
+shards the within-micro batch dim and pmeans grads — all in ONE compiled
+SPMD program over the (dp, pp, mp) mesh.)
 
+QKV weight layout is HEAD-MAJOR: the fused qkv matmul's output columns are
+ordered [head, (q|k|v), head_dim] so a contiguous 'mp' shard of the column
+dim is a whole number of heads with their q, k AND v — the same per-head
+partitioning Megatron uses. ([q-block, k-block, v-block] column order would
+make an mp shard slice across the q/k/v boundary.)
+"""
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from ... import nn
 from ...ops._helpers import apply_jfn
-from ...distributed.fleet.meta_parallel.pipeline_1f1b import pipeline_1f1b
+from ...distributed import mesh as mesh_mod
+from ...distributed.fleet.meta_parallel.pipeline_1f1b import (
+    PipelineSpecs, pipeline_1f1b)
+from ...distributed.fleet.meta_parallel.mp_ops import (
+    allreduce_mp, copy_to_mp)
 from .gpt import GPTConfig
 
 __all__ = ["PipelinedGPTForCausalLM"]
@@ -43,58 +63,113 @@ def _attention(q, k, v):
     return dense_attention_bshd(q, k, v, is_causal=True)
 
 
-def _decoder_fwd(p, x, nh):
-    """One pre-LN decoder block as a pure function of its param dict."""
+def _decoder_fwd(p, x, nh, mp=1):
+    """One pre-LN decoder block as a pure function of its param dict.
+
+    With mp > 1 the dict's leaves are the LOCAL Megatron shards (qkv/fc1
+    column-sharded, proj/fc2 row-sharded, LN + output biases replicated)
+    and the body brackets each parallel pair with the explicit
+    identity/allreduce custom_vjp collectives. At mp == 1 the collectives
+    are no-ops over a size-1 axis (outside shard_map they must not run at
+    all, so the mp==1 call skips them entirely — same math).
+    """
     b, s, d = x.shape
+    nh_loc = nh // mp
     hd = d // nh
+    ident = (lambda t: t) if mp == 1 else copy_to_mp
+    reduce_ = (lambda t: t) if mp == 1 else allreduce_mp
+
     h = _layernorm(x, p["ln1_w"], p["ln1_b"])
-    qkv = h @ p["qkv_w"] + p["qkv_b"]
-    qkv = qkv.reshape(b, s, 3, nh, hd)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = _attention(q, k, v).reshape(b, s, d)
-    x = x + attn @ p["proj_w"] + p["proj_b"]
+    qkv = ident(h) @ p["qkv_w"] + p["qkv_b"]       # [b, s, 3·d/mp]
+    qkv = qkv.reshape(b, s, nh_loc, 3, hd)          # head-major layout
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    attn = _attention(q, k, v).reshape(b, s, nh_loc * hd)
+    x = x + reduce_(attn @ p["proj_w"]) + p["proj_b"]
     h = _layernorm(x, p["ln2_w"], p["ln2_b"])
-    x = x + jax.nn.gelu(h @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"] \
-        + p["fc2_b"]
-    return x
+    part = jax.nn.gelu(ident(h) @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"]
+    return x + reduce_(part) + p["fc2_b"]
+
+
+def _vocab_parallel_ce(sh, wte_loc, sl, mp):
+    """Per-token CE over a vocab-sharded head: [N, d] @ [d, V/mp] local
+    logits, LSE reduced across 'mp' (reference mp_layers.py:438
+    ParallelCrossEntropy → c_softmax_with_cross_entropy_op: per-rank max /
+    masked pick / two allreduces — same algorithm, psum via the explicit
+    vjp pairs)."""
+    logits = jnp.dot(copy_to_mp(sh), wte_loc.T,
+                     preferred_element_type=jnp.float32)   # [N, V/mp]
+    m = lax.pmax(lax.stop_gradient(jnp.max(logits, -1)), "mp")
+    ssum = allreduce_mp(jnp.sum(jnp.exp(logits - m[:, None]), -1))
+    lse = m + jnp.log(ssum)
+    v_loc = logits.shape[-1]
+    li = sl - lax.axis_index("mp") * v_loc
+    hit = (li >= 0) & (li < v_loc)
+    li_c = jnp.clip(li, 0, v_loc - 1)
+    picked_loc = jnp.where(
+        hit, jnp.take_along_axis(logits, li_c[:, None], -1)[:, 0], 0.0)
+    return lse - allreduce_mp(picked_loc)
 
 
 class PipelinedGPTForCausalLM(nn.Layer):
     """GPT whose decoder parameters are stacked [num_layers, ...] and
-    sharded over the 'pp' mesh axis. `forward` runs the serial scan (eval /
-    single device); `loss(ids)` runs the 1F1B pipeline schedule."""
+    sharded over the 'pp' mesh axis, with per-leaf 'mp' sharding in the
+    Megatron pattern and optional dp sharding of the micro-batch.
+    `forward` runs the serial scan (eval / single device); `loss(ids)`
+    runs the 1F1B pipeline schedule over whatever (dp, pp, mp) mesh is
+    active."""
 
-    def __init__(self, config: GPTConfig, n_micro=4):
+    def __init__(self, config: GPTConfig, n_micro=4, remat="stage"):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
+        # remat: "stage" = 1F1B ring buffer keeps only stage INPUTS and
+        # re-linearizes the whole stage per backward tick (default);
+        # "layer" = jax.checkpoint around every decoder layer inside the
+        # stage scan (the reference's per-layer recompute —
+        # distributed/fleet/utils/recompute.py); False = keep everything.
+        if remat is True:
+            remat = "stage"
+        if remat not in ("stage", "layer", False):
+            raise ValueError(
+                f"remat={remat!r}: expected 'stage', 'layer', or False")
+        self.remat = remat
         d, L, ffn = config.hidden_size, config.num_layers, config.ffn_size
         mk = self.create_parameter
         normal = nn.initializer.Normal(0.0, 0.02)
         self.wte = mk([config.vocab_size, d], default_initializer=normal)
         self.wpe = mk([config.max_seq_len, d], default_initializer=normal)
-        # stacked decoder params, leading dim = num_layers (sharded 'pp')
         from ...distributed.fleet.meta_parallel.mp_layers import (
             mark_sharding)
 
+        mark_sharding(self.wte, "mp", None)   # vocab-sharded head/embed
+        # stacked decoder params, leading dim = num_layers (sharded 'pp');
+        # Megatron 'mp' sharding per leaf: qkv/fc1 column (last dim),
+        # proj/fc2 row (middle dim), LN + output biases replicated.
         self._stack_names = []
+        self._stack_specs = {}
         ones = nn.initializer.Constant(1.0)
 
-        def stacked(name, shape, is_bias=False, init=None):
+        def stacked(name, shape, is_bias=False, init=None, mp_dim=None):
             p = mk([L] + shape, is_bias=is_bias,
                    default_initializer=init or (
                        nn.initializer.Constant(0.0) if is_bias else normal))
-            mark_sharding(p, "pp", *([None] * len(shape)))
+            spec = ["pp"] + [None] * len(shape)
+            if mp_dim is not None:
+                spec[1 + mp_dim] = "mp"
+            mark_sharding(p, *spec)
+            self._stack_specs[name] = P(*spec)
             setattr(self, "stk_" + name, p)
             self._stack_names.append(name)
             return p
 
         stacked("ln1_w", [d], init=ones); stacked("ln1_b", [d], True)
-        stacked("qkv_w", [d, 3 * d]); stacked("qkv_b", [3 * d], True)
-        stacked("proj_w", [d, d]); stacked("proj_b", [d], True)
+        stacked("qkv_w", [d, 3 * d], mp_dim=1)
+        stacked("qkv_b", [3 * d], True, mp_dim=0)
+        stacked("proj_w", [d, d], mp_dim=0); stacked("proj_b", [d], True)
         stacked("ln2_w", [d], init=ones); stacked("ln2_b", [d], True)
-        stacked("fc1_w", [d, ffn]); stacked("fc1_b", [ffn], True)
-        stacked("fc2_w", [ffn, d]); stacked("fc2_b", [d], True)
+        stacked("fc1_w", [d, ffn], mp_dim=1)
+        stacked("fc1_b", [ffn], True, mp_dim=0)
+        stacked("fc2_w", [ffn, d], mp_dim=0); stacked("fc2_b", [d], True)
         self.lnf_w = mk([d], default_initializer=ones)
         self.lnf_b = mk([d], is_bias=True)
 
@@ -102,30 +177,70 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def _embed(self, wte, wpe, ids):
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
 
-    def _block_fn(self, stage_params, x):
+    def _block_fn(self, mp):
         nh = self.config.num_heads
+        layer = lambda p, x: _decoder_fwd(p, x, nh, mp)
+        if self.remat == "layer":
+            layer = jax.checkpoint(layer)
 
-        def body(x, p):
-            return _decoder_fwd(p, x, nh), None
+        def block(stage_params, x):
+            def body(x, p):
+                return layer(p, x), None
 
-        out, _ = jax.lax.scan(body, x, stage_params)
-        return out
+            out, _ = jax.lax.scan(body, x, stage_params)
+            return out
 
-    def _loss_fn(self, y_pred, labels, post):
-        # fused blocked head CE (nn/functional/loss.py linear_ce_raw):
-        # the last pipeline stage never materializes [micro, s, vocab]
-        # logits or fp32 log-probs — the head vjp inside the 1F1B
-        # head-tick cond stays memory-lean
-        from ...nn.functional.loss import linear_ce_raw
+        return block
 
-        h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
-        sh = h[:, :-1].reshape(-1, h.shape[-1])
-        sl = labels[:, 1:].reshape(-1)
-        return jnp.mean(linear_ce_raw(sh, post["wte"].T, sl))
+    def _loss_fn(self, mp):
+        def loss_fn(y_pred, labels, post):
+            h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
+            sh = h[:, :-1].reshape(-1, h.shape[-1])
+            sl = labels[:, 1:].reshape(-1)
+            if mp == 1:
+                # fused blocked head CE (nn/functional/loss.py
+                # linear_ce_raw): never materializes [micro·s, vocab]
+                # logits — the head vjp inside the 1F1B head-tick cond
+                # stays memory-lean
+                from ...nn.functional.loss import linear_ce_raw
+
+                return jnp.mean(linear_ce_raw(sh, post["wte"].T, sl))
+            return jnp.mean(_vocab_parallel_ce(sh, post["wte"], sl, mp))
+
+        return loss_fn
 
     def _param_tensors(self):
         stk = [getattr(self, "stk_" + n) for n in self._stack_names]
         return [self.wte, self.wpe, self.lnf_w, self.lnf_b] + stk
+
+    def _hybrid_specs(self, mp, dp, micro_bsz):
+        """PipelineSpecs for the active mesh (None when pure pp×replica)."""
+        if mp == 1 and dp == 1:
+            return None
+        names = self._stack_names
+        stacked_tree = {n: self._stack_specs[n] for n in names}
+        stacked = tuple(
+            jax.tree_util.tree_leaves(
+                stacked_tree, is_leaf=lambda s: isinstance(s, P)))
+        post = {"lnf_b": P(None), "lnf_w": P(None),
+                "wte": P("mp", None) if mp > 1 else P(None, None)}
+        post = tuple(jax.tree_util.tree_leaves(
+            post, is_leaf=lambda s: isinstance(s, P)))
+        dp_axis = None
+        x_spec = y_spec = None
+        if dp > 1:
+            if micro_bsz % dp:
+                # silent replication would burn dp× the FLOPs — match the
+                # mp divisibility errors instead
+                raise ValueError(
+                    f"per-micro batch {micro_bsz} not divisible by "
+                    f"dp={dp}; pick batch/n_micro so each dp shard gets "
+                    "an equal slice")
+            dp_axis = "dp"
+            x_spec = P(None, "dp", None, None)
+            y_spec = P(None, "dp", None)
+        return PipelineSpecs(stacked=stacked, post=post, x=x_spec,
+                             y=y_spec, dp_axis=dp_axis)
 
     # ---- API ----
     def forward(self, input_ids):
@@ -152,17 +267,33 @@ class PipelinedGPTForCausalLM(nn.Layer):
         """Mean LM loss via the 1F1B pipeline schedule (forward-only
         fill-drain when grad is disabled — eval loops skip the backward
         machinery). The global batch is split into `n_micro` micro-batches
-        on axis 0."""
+        on axis 0; with an active 'mp'/'dp' mesh axis, tensor parallelism
+        runs inside every stage and the within-micro batch dim is
+        data-sharded — the hybrid TP+PP+DP program."""
         from ...autograd import engine
         from ...distributed.fleet.meta_parallel.pipeline_1f1b import (
             pipeline_forward_loss)
 
+        mesh = mesh_mod.global_mesh()
+        pp, mp, dp = (mesh.shape["pp"], mesh.shape["mp"],
+                      mesh.shape["dp"])
+        if pp == 1:
+            mp = 1   # degenerate path runs outside shard_map: GSPMD
+            dp = 1   # annotations (mark_sharding) cover mp/dp instead
+        cfg = self.config
+        if mp > 1:
+            for dim, what in ((cfg.num_heads, "num_heads"),
+                              (cfg.ffn_size, "ffn_size"),
+                              (cfg.vocab_size, "vocab_size")):
+                if dim % mp:
+                    raise ValueError(
+                        f"{what}={dim} not divisible by mp={mp}")
         labels = input_ids if labels is None else labels
         tensors = self._param_tensors()
         names = self._stack_names
         M = self.n_micro
-        block_fn = self._block_fn
-        loss_fn = self._loss_fn
+        block_fn = self._block_fn(mp)
+        loss_fn = self._loss_fn(mp)
         fwd_only = not engine.is_grad_enabled()
 
         def jfn(wte, wpe, lnf_w, lnf_b, *stk):
@@ -170,6 +301,7 @@ class PipelinedGPTForCausalLM(nn.Layer):
             lbl = labels._value
             B = ids.shape[0]
             assert B % M == 0, f"batch {B} not divisible by n_micro {M}"
+            specs = self._hybrid_specs(mp, dp, B // M)
             ids_m = ids.reshape(M, B // M, ids.shape[1])
             lbl_m = lbl.reshape(M, B // M, lbl.shape[1])
             x_m = self._embed(wte, wpe, ids_m)
@@ -177,8 +309,12 @@ class PipelinedGPTForCausalLM(nn.Layer):
             post = {"wte": wte, "lnf_w": lnf_w, "lnf_b": lnf_b}
             if fwd_only:
                 return pipeline_forward_loss(block_fn, loss_fn, stacked,
-                                             post, (x_m, lbl_m))
+                                             post, (x_m, lbl_m),
+                                             specs=specs)
+            # "layer" remat lives inside block_fn already — the schedule
+            # must not double-checkpoint the stage
+            remat = self.remat == "stage"
             return pipeline_1f1b(block_fn, loss_fn, stacked, post,
-                                 (x_m, lbl_m))
+                                 (x_m, lbl_m), remat=remat, specs=specs)
 
         return apply_jfn("pipelined_gpt_loss", jfn, *tensors)
