@@ -34,10 +34,10 @@ import jax.numpy as jnp
 from ..tensor_core import Tensor
 from . import mesh as mesh_mod
 
-__all__ = ["SparseSGDRule", "SparseAdaGradRule", "MemorySparseTable",
-           "SSDSparseTable", "ShardedSparseTable", "make_sparse_table",
-           "resolve_rule", "SparseEmbedding", "ShardedEmbedding",
-           "live_tables"]
+__all__ = ["SparseSGDRule", "SparseAdaGradRule", "SparseAdamRule",
+           "MemorySparseTable", "SSDSparseTable", "ShardedSparseTable",
+           "make_sparse_table", "resolve_rule", "SparseEmbedding",
+           "ShardedEmbedding", "live_tables"]
 
 # every SparseEmbedding registers here so fleet.stop_worker()/
 # save_persistables can flush/save all live PS tables (the reference's
@@ -79,6 +79,9 @@ class SparseSGDRule:
     def __init__(self, learning_rate=0.01):
         self.lr = learning_rate
 
+    def slots_width(self, dim):
+        return self.slot_dim
+
     def init_slots(self, n, dim):
         return np.zeros((n, 0), np.float32)
 
@@ -97,6 +100,9 @@ class SparseAdaGradRule:
         self.g0 = initial_g2sum
         self.eps = eps
 
+    def slots_width(self, dim):
+        return self.slot_dim
+
     def init_slots(self, n, dim):
         return np.full((n, 1), self.g0, np.float32)
 
@@ -106,14 +112,46 @@ class SparseAdaGradRule:
         return rows - scale[:, None] * grads, g2[:, None]
 
 
+class SparseAdamRule:
+    """reference: sparse_sgd_rule.cc SparseAdamSGDRule — per-element
+    m/v moments plus a per-row step count, applied server-side on push.
+    Slot layout [m(dim), v(dim), t] matches the native C++ core."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 eps=1e-8):
+        self.lr = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.eps = eps
+
+    def slots_width(self, dim):
+        return 2 * dim + 1
+
+    def init_slots(self, n, dim):
+        return np.zeros((n, 2 * dim + 1), np.float32)
+
+    def apply(self, rows, slots, grads):
+        dim = rows.shape[1]
+        m, v, t = slots[:, :dim], slots[:, dim:2 * dim], slots[:, -1]
+        t = t + 1.0
+        m = self.beta1 * m + (1 - self.beta1) * grads
+        v = self.beta2 * v + (1 - self.beta2) * grads * grads
+        mhat = m / (1 - self.beta1 ** t[:, None])
+        vhat = v / (1 - self.beta2 ** t[:, None])
+        new_rows = rows - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return new_rows, np.concatenate([m, v, t[:, None]], axis=1)
+
+
 def resolve_rule(rule):
     """Accept a rule object or its reference config name ('sgd'/'naive',
-    'adagrad'; reference sparse_sgd_rule.cc registers rules by name)."""
+    'adagrad', 'adam'; reference sparse_sgd_rule.cc registers rules by
+    name)."""
     if rule is None or not isinstance(rule, str):
         return rule
     names = {"sgd": SparseSGDRule, "naive": SparseSGDRule,
              "adagrad": SparseAdaGradRule,
-             "std_adagrad": SparseAdaGradRule}
+             "std_adagrad": SparseAdaGradRule,
+             "adam": SparseAdamRule}
     try:
         return names[rule]()
     except KeyError:
@@ -125,13 +163,15 @@ def resolve_rule(rule):
 # --------------------------------------------------------------- table
 
 def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
-                      backend="auto", path=None):
+                      backend="auto", path=None, accessor=None):
     """Table factory. backend="auto"/"native" uses the C++ core
     (paddle_tpu.native NativeSparseTable, mirroring the reference's C++
     memory_sparse_table) when available and the rule is a stock
-    SGD/AdaGrad with no custom initializer; backend="ssd" (requires
+    SGD/AdaGrad/Adam with no custom initializer; backend="ssd" (requires
     `path`) memmaps rows to disk (reference ssd_sparse_table.h);
     otherwise (or with backend="python") the numpy MemorySparseTable.
+    accessor="ctr" tracks per-row show/click with decay-scored eviction
+    (reference ctr_accessor.cc; memory/native engines only).
     All expose the same pull/push/len/state_dict contract."""
     rule = resolve_rule(rule)
     if path is not None and backend == "auto":
@@ -143,6 +183,10 @@ def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
     if backend == "ssd":
         if path is None:
             raise ValueError('backend="ssd" needs a directory `path`')
+        if accessor is not None:
+            raise ValueError(
+                "accessor='ctr' is not supported on the SSD backend yet "
+                "(show/click meta is not memmapped) — use memory/native")
         return SSDSparseTable(embedding_dim, path, rule=rule,
                               initializer=initializer, seed=seed)
     if backend in ("auto", "native"):
@@ -151,29 +195,42 @@ def make_sparse_table(embedding_dim, rule=None, initializer=None, seed=0,
         kind = None
         if rule is None or isinstance(rule, SparseAdaGradRule):
             kind = "adagrad"
+        elif isinstance(rule, SparseAdamRule):
+            kind = "adam"
         elif isinstance(rule, SparseSGDRule):
             kind = "sgd"
         usable = (kind is not None and initializer is None
                   and native.is_available())
         if usable:
             r = rule or SparseAdaGradRule()
-            kw = dict(lr=r.lr, seed=seed)
+            kw = dict(lr=r.lr, seed=seed, accessor=accessor)
             if kind == "adagrad":
                 kw.update(g0=r.g0, eps=r.eps)
+            elif kind == "adam":
+                kw.update(beta1=r.beta1, beta2=r.beta2, eps=r.eps)
             return native.NativeSparseTable(embedding_dim, rule=kind, **kw)
         if backend == "native":
             raise RuntimeError(
                 "native backend requested but unavailable (no g++) "
                 "or incompatible with a custom rule/initializer")
     return MemorySparseTable(embedding_dim, rule=rule,
-                             initializer=initializer, seed=seed)
+                             initializer=initializer, seed=seed,
+                             accessor=accessor)
 
 
 class MemorySparseTable:
     """Host-RAM KV table with create-on-first-touch rows (pure-python
-    engine; see make_sparse_table for the native C++ alternative)."""
+    engine; see make_sparse_table for the native C++ alternative).
+    accessor="ctr" tracks per-row (show, click, unseen) with
+    `update_show_click` and decay-scored eviction via `shrink`
+    (reference ps/table/ctr_accessor.cc)."""
 
-    def __init__(self, embedding_dim, rule=None, initializer=None, seed=0):
+    def __init__(self, embedding_dim, rule=None, initializer=None, seed=0,
+                 accessor=None):
+        if accessor not in (None, "ctr"):
+            raise ValueError(f"accessor={accessor!r}: expected None/'ctr'")
+        self.accessor = accessor
+        self._meta = np.zeros((0, 3), np.float32)  # show, click, unseen
         self.dim = embedding_dim
         self.rule = resolve_rule(rule) or SparseAdaGradRule()
         self._rng = np.random.default_rng(seed)
@@ -209,6 +266,9 @@ class MemorySparseTable:
                    if self._init_takes_ids else self._init(len(missing)))
             self._append_rows(new,
                               self.rule.init_slots(len(missing), self.dim))
+            if self.accessor:
+                self._meta = np.concatenate(
+                    [self._meta, np.zeros((len(missing), 3), np.float32)])
 
     def _append_rows(self, new_rows, new_slots):
         """Storage hook: append freshly-initialized rows (overridden by
@@ -229,6 +289,8 @@ class MemorySparseTable:
         self._ensure(ids)
         idx = np.fromiter((self._rows[int(i)] for i in ids), np.int64,
                           len(ids))
+        if self.accessor:
+            self._meta[idx, 2] = 0.0
         return self._data[idx]
 
     def push(self, ids, grads):
@@ -246,11 +308,59 @@ class MemorySparseTable:
             self._data[idx], self._slots[idx], acc)
         self._data[idx] = new_rows
         self._slots[idx] = new_slots
+        if self.accessor:
+            self._meta[idx, 2] = 0.0
+
+    # -- CTR accessor (reference ctr_accessor.cc) --
+    def update_show_click(self, ids, shows, clicks):
+        """Accumulate per-row show/click event counts."""
+        if not self.accessor:
+            raise RuntimeError("table created without accessor='ctr'")
+        ids = np.asarray(ids).reshape(-1)
+        shows = np.asarray(shows, np.float32).reshape(-1)
+        clicks = np.asarray(clicks, np.float32).reshape(-1)
+        if not len(ids) == len(shows) == len(clicks):
+            raise ValueError("ids/shows/clicks length mismatch")
+        self._ensure(ids)
+        idx = np.fromiter((self._rows[int(i)] for i in ids), np.int64,
+                          len(ids))
+        np.add.at(self._meta[:, 0], idx, shows)
+        np.add.at(self._meta[:, 1], idx, clicks)
+        self._meta[idx, 2] = 0.0
+
+    def shrink(self, decay=0.98, nonclk_coeff=0.1, delete_threshold=0.8,
+               delete_after_unseen=7):
+        """One maintenance round: decay show/click, age rows one round,
+        evict rows scoring click + nonclk_coeff·(show − click) below
+        delete_threshold once unseen longer than delete_after_unseen
+        (reference Table::Shrink + ctr_accessor ShowClickScore).
+        Returns the evicted row count."""
+        if not self.accessor:
+            raise RuntimeError("table created without accessor='ctr'")
+        self._meta[:, 0] *= decay
+        self._meta[:, 1] *= decay
+        self._meta[:, 2] += 1.0
+        show, click, unseen = (self._meta[:, 0], self._meta[:, 1],
+                               self._meta[:, 2])
+        score = click + nonclk_coeff * (show - click)
+        drop = (score < delete_threshold) & (unseen > delete_after_unseen)
+        if not drop.any():
+            return 0
+        keep = ~drop
+        kept_ids = self._ordered_ids()[keep]  # row-index order
+        self._data = self._data[keep]
+        self._slots = self._slots[keep]
+        self._meta = self._meta[keep]
+        self._rows = {int(i): k for k, i in enumerate(kept_ids)}
+        return int(drop.sum())
 
     # -- checkpoint integration (paddle_tpu.distributed.checkpoint) --
     def state_dict(self):
-        return {"ids": self._ordered_ids(), "data": self._data,
-                "slots": self._slots}
+        sd = {"ids": self._ordered_ids(), "data": self._data,
+              "slots": self._slots}
+        if self.accessor:
+            sd["meta"] = self._meta
+        return sd
 
     def set_state_dict(self, sd):
         ids = np.asarray(sd["ids"]._value if isinstance(sd["ids"], Tensor)
@@ -262,6 +372,11 @@ class MemorySparseTable:
         self._slots = np.asarray(
             sd["slots"]._value if isinstance(sd["slots"], Tensor)
             else sd["slots"], np.float32)
+        if self.accessor:
+            self._meta = (np.asarray(
+                sd["meta"]._value if isinstance(sd.get("meta"), Tensor)
+                else sd["meta"], np.float32) if "meta" in sd
+                else np.zeros((len(ids), 3), np.float32))
 
 
 class SSDSparseTable(MemorySparseTable):
@@ -288,7 +403,11 @@ class SSDSparseTable(MemorySparseTable):
                          seed=seed)
         self._path = path
         os.makedirs(path, exist_ok=True)
-        self._slot_dim = self.rule.slot_dim
+        # slots_width(dim): Adam's slot width depends on dim; plain
+        # slot_dim attr kept as the fallback for custom rules
+        self._slot_dim = (self.rule.slots_width(self.dim)
+                          if hasattr(self.rule, "slots_width")
+                          else self.rule.slot_dim)
         ids_f = os.path.join(path, self._IDS)
         if (not os.path.exists(ids_f)
                 and os.path.exists(self._file(self._DATA))):
@@ -404,10 +523,17 @@ class ShardedSparseTable:
     ps/service/communicator/communicator.h:427 AsyncCommunicator bounded
     push queues). TPU-native redesign: there are no separate server
     processes — every trainer process owns the shard `id % world == rank`
-    of the table in host RAM next to its chip, and pull/push are EAGER
-    COLLECTIVES over the jax.distributed gloo/CPU mesh (`xproc`), so the
-    transport is the same compiled-collective machinery as everything
-    else (no brpc analog needed).
+    of the table in host RAM next to its chip.
+
+    Transport (reference brpc_ps_client.h:195's point-to-point RPC):
+    requests and rows move PEER-TO-PEER over the jax.distributed
+    coordination KV (`xproc.send_np/recv_np`) — each rank sends every
+    owner exactly its own request ids and receives exactly its own rows,
+    so wire traffic is O(batch·dim) per rank, independent of world size.
+    (transport="gather" keeps the old object-all-gather path — O(world·
+    batch) received per rank — for A/B and debugging.) Row assembly is
+    vectorized: responses preserve request order, so per-owner rows
+    scatter straight into the unique-row matrix, no python dict loop.
 
     Contract: pull/flush are collective — every process must call them
     the same number of times. SPMD data-parallel training guarantees this
@@ -415,15 +541,20 @@ class ShardedSparseTable:
 
     Push is ASYNC with bounded staleness (AsyncCommunicator semantics):
     `push` only queues gradients locally; the queue is flushed — one
-    routing collective applying grads on their owner shards — every
+    routing round applying grads on their owner shards — every
     `staleness`-th push call (and on `flush()`). With staleness=1 pushes
     are synchronous and a sharded run is bit-identical to a 1-process
     table (asserted by tests/test_ps_deepfm.py).
     """
 
+    _TAG_PULL_REQ, _TAG_PULL_ROWS = 151, 152
+    _TAG_PUSH_IDS, _TAG_PUSH_GRADS = 153, 154
+    _TAG_SC_IDS, _TAG_SC_CNT = 155, 156
+
     def __init__(self, embedding_dim, rule=None, initializer=None, seed=0,
                  staleness=1, backend="auto", world=None, rank=None,
-                 path=None):
+                 path=None, transport="p2p", timeout_ms=600_000,
+                 accessor=None):
         from . import xproc
 
         if world is None:
@@ -433,6 +564,12 @@ class ShardedSparseTable:
         self.world, self.rank = world, rank
         self.dim = embedding_dim
         self.staleness = max(1, int(staleness))
+        if transport not in ("p2p", "gather"):
+            raise ValueError(f"transport={transport!r}: p2p or gather")
+        self.transport = transport
+        # p2p recv deadline: must cover peer rank skew (first-step XLA
+        # compiles, data stalls) — 10 min default, not xproc's 60 s
+        self.timeout_ms = int(timeout_ms)
         if path is not None:
             # each shard owns its OWN directory — ranks sharing one
             # memmap file would overwrite each other's row layouts
@@ -441,7 +578,8 @@ class ShardedSparseTable:
             path = os.path.join(path, f"rank{rank}")
         self.local = make_sparse_table(embedding_dim, rule=rule,
                                        initializer=initializer, seed=seed,
-                                       backend=backend, path=path)
+                                       backend=backend, path=path,
+                                       accessor=accessor)
         self._pending_ids = []
         self._pending_grads = []
         self._push_calls = 0
@@ -454,13 +592,61 @@ class ShardedSparseTable:
 
         return xproc.all_gather_obj(obj, max_len=1 << 27)
 
+    def _peers(self):
+        return [r for r in range(self.world) if r != self.rank]
+
+    def _exchange_by_owner(self, owner, arrays, tags):
+        """Scatter row-aligned `arrays` (leading dim = rows, e.g. ids +
+        their grads) to the rank owning each row, and return this rank's
+        concatenated incoming set (own slice + one recv per peer). One
+        tag per array; all sends are posted before any blocking recv.
+        The shared spine of the p2p flush / update_show_click routing."""
+        from . import xproc
+
+        for r in self._peers():
+            sel = owner == r
+            for arr, tag in zip(arrays, tags):
+                xproc.send_np(arr[sel], r, tag)
+        parts = [[arr[owner == self.rank]] for arr in arrays]
+        for r in self._peers():
+            for k, tag in enumerate(tags):
+                parts[k].append(
+                    xproc.recv_np(r, tag, timeout_ms=self.timeout_ms))
+        return [np.concatenate(p) for p in parts]
+
     def pull(self, ids):
-        """Route each id to its owner shard, gather the rows back.
-        Two collective rounds: requests, then served rows."""
+        """Route each id to its owner shard, receive the rows back."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         if self.world == 1:
             return self.local.pull(ids)
-        uniq = np.unique(ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if self.transport == "gather":
+            return self._pull_gather(ids, uniq, inv)
+        from . import xproc
+
+        owner = uniq % self.world
+        rows = np.empty((len(uniq), self.dim), np.float32)
+        # 1) every rank posts its request to each owner (non-blocking)
+        for r in self._peers():
+            xproc.send_np(uniq[owner == r], r, self._TAG_PULL_REQ)
+        mine = owner == self.rank
+        rows[mine] = self.local.pull(uniq[mine]) if mine.any() else 0
+        # 2) serve each peer's request from the local shard
+        for r in self._peers():
+            want = xproc.recv_np(r, self._TAG_PULL_REQ,
+                                 timeout_ms=self.timeout_ms)
+            served = (self.local.pull(want) if len(want)
+                      else np.zeros((0, self.dim), np.float32))
+            xproc.send_np(served, r, self._TAG_PULL_ROWS)
+        # 3) responses preserve request order: scatter by owner mask
+        for r in self._peers():
+            rows[owner == r] = xproc.recv_np(r, self._TAG_PULL_ROWS,
+                                             timeout_ms=self.timeout_ms)
+        return rows[inv] if len(ids) else \
+            np.zeros((0, self.dim), np.float32)
+
+    def _pull_gather(self, ids, uniq, inv):
+        """Legacy all-gather transport (every rank sees every request)."""
         requests = self._gather_obj(uniq)          # round 1: who needs what
         served = {}
         for requester, want in enumerate(requests):
@@ -468,13 +654,13 @@ class ShardedSparseTable:
             if len(mine):
                 served[requester] = (mine, self.local.pull(mine))
         responses = self._gather_obj(served)       # round 2: serve rows
-        rowmap = {}
-        for resp in responses:
+        rows = np.empty((len(uniq), self.dim), np.float32)
+        for owner_rank, resp in enumerate(responses):
             if self.rank in resp:
                 sids, srows = resp[self.rank]
-                for i, row in zip(sids, srows):
-                    rowmap[int(i)] = row
-        return np.stack([rowmap[int(i)] for i in ids]) if len(ids) else \
+                # sids ⊂ uniq and both sorted: vectorized placement
+                rows[np.searchsorted(uniq, sids)] = srows
+        return rows[inv] if len(ids) else \
             np.zeros((0, self.dim), np.float32)
 
     def push(self, ids, grads):
@@ -486,6 +672,33 @@ class ShardedSparseTable:
         self._push_calls += 1
         if self._push_calls % self.staleness == 0:
             self.flush()
+
+    def update_show_click(self, ids, shows, clicks):
+        """Route show/click event counts to owner shards (collective,
+        like flush; reference ctr_accessor statistics live server-side)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        shows = np.asarray(shows, np.float32).reshape(-1)
+        clicks = np.asarray(clicks, np.float32).reshape(-1)
+        if not len(ids) == len(shows) == len(clicks):
+            # validate BEFORE any send: a partial exchange would leave a
+            # tag stream with an orphaned message and silently mis-pair
+            # every later batch
+            raise ValueError("ids/shows/clicks length mismatch")
+        counts = np.stack([shows, clicks], axis=1)  # (n, 2) row-aligned
+        if self.world == 1:
+            self.local.update_show_click(ids, counts[:, 0], counts[:, 1])
+            return
+        cat_ids, cat_cnt = self._exchange_by_owner(
+            ids % self.world, (ids, counts),
+            (self._TAG_SC_IDS, self._TAG_SC_CNT))
+        if len(cat_ids):
+            self.local.update_show_click(cat_ids, cat_cnt[:, 0],
+                                         cat_cnt[:, 1])
+
+    def shrink(self, **kw):
+        """Per-shard eviction round (collective: call on every rank).
+        Returns this rank's evicted count."""
+        return self.local.shrink(**kw)
 
     def flush(self):
         """Collective: route queued grads to owner shards and apply the
@@ -502,16 +715,22 @@ class ShardedSparseTable:
             ids = np.zeros((0,), np.int64)
             grads = np.zeros((0, self.dim), np.float32)
         self._pending_ids, self._pending_grads = [], []
-        incoming = self._gather_obj((ids, grads))  # one routing round
-        all_ids = [i for i, _ in incoming]
-        all_grads = [g for _, g in incoming]
-        cat_ids = np.concatenate(all_ids)
-        cat_grads = np.concatenate(all_grads)
-        mask = cat_ids % self.world == self.rank
-        if mask.any():
-            # MemorySparseTable.push dedup-accumulates repeated ids, so
-            # grads for the same id from several trainers sum correctly
-            self.local.push(cat_ids[mask], cat_grads[mask])
+        if self.transport == "gather":
+            incoming = self._gather_obj((ids, grads))  # one routing round
+            cat_ids = np.concatenate([i for i, _ in incoming])
+            cat_grads = np.concatenate([g for _, g in incoming])
+            mask = cat_ids % self.world == self.rank
+            if mask.any():
+                # local push dedup-accumulates repeated ids, so grads for
+                # the same id from several trainers sum correctly
+                self.local.push(cat_ids[mask], cat_grads[mask])
+            return
+        cat_ids, cat_grads = self._exchange_by_owner(
+            ids % self.world, (ids, grads),
+            (self._TAG_PUSH_IDS, self._TAG_PUSH_GRADS))
+        if len(cat_ids):
+            # ONE rule application per flush: dedup happens inside push
+            self.local.push(cat_ids, cat_grads)
 
     # checkpoint: each rank persists its own shard (pairs with the
     # per-rank sharded checkpoint layout in distributed/checkpoint.py)

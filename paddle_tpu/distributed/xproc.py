@@ -118,6 +118,7 @@ def all_gather_bytes(payload: bytes, max_len=1 << 20):
     n = len(payload)
     lens = all_gather_np(np.array([n], np.int32))[:, 0]
     width = int(lens.max())
+    stats["gather_bytes"] += width * len(lens)
     if width > max_len:
         # raise on ALL ranks (post-gather) so no peer is left blocking
         raise ValueError(f"object too large to gather ({width} > {max_len})")
@@ -133,6 +134,11 @@ def all_gather_bytes(payload: bytes, max_len=1 << 20):
 
 _p2p_send_seq = {}
 _p2p_recv_seq = {}
+
+# traffic accounting (tests assert PS routing is O(batch), not
+# O(world·batch); all_gather_bytes counts the full gathered matrix —
+# what every rank actually receives)
+stats = {"p2p_bytes": 0, "gather_bytes": 0}
 
 
 def _kv_client():
@@ -152,6 +158,7 @@ def send_bytes(data: bytes, dst: int, tag: int = 0):
     me = jax.process_index()
     seq = _p2p_send_seq.get((me, dst, tag), 0)
     _p2p_send_seq[(me, dst, tag)] = seq + 1
+    stats["p2p_bytes"] += len(data)
     _kv_client().key_value_set(
         f"pt_p2p/{me}/{dst}/{tag}/{seq}",
         base64.b64encode(data).decode("ascii"))

@@ -58,7 +58,8 @@ def _load():
         lib.pt_table_create.restype = ctypes.c_void_p
         lib.pt_table_create.argtypes = [
             ctypes.c_int64, ctypes.c_int, ctypes.c_float, ctypes.c_float,
-            ctypes.c_float, ctypes.c_float, ctypes.c_uint64]
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int, ctypes.c_uint64]
         lib.pt_table_destroy.argtypes = [ctypes.c_void_p]
         lib.pt_table_size.restype = ctypes.c_int64
         lib.pt_table_size.argtypes = [ctypes.c_void_p]
@@ -68,12 +69,19 @@ def _load():
         lib.pt_table_push.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p]
-        lib.pt_table_export.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p]
-        lib.pt_table_import.argtypes = [
+        lib.pt_table_update_show_click.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p]
+        lib.pt_table_shrink.restype = ctypes.c_int64
+        lib.pt_table_shrink.argtypes = [
+            ctypes.c_void_p, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float]
+        lib.pt_table_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.pt_table_import.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.pt_assemble_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int]
@@ -99,12 +107,16 @@ def is_available():
 class NativeSparseTable:
     """ctypes wrapper over the C++ table (same contract as the python
     MemorySparseTable storage engine: pull creates rows, push applies
-    the optimizer rule with dedup)."""
+    the optimizer rule with dedup). rule ∈ {sgd, adagrad, adam}
+    (reference sparse_sgd_rule.cc's naive/adagrad/adam); accessor="ctr"
+    tracks per-row show/click with `update_show_click` and decay-scored
+    eviction via `shrink` (reference ctr_accessor.cc)."""
 
-    RULES = {"sgd": 0, "adagrad": 1}
+    RULES = {"sgd": 0, "adagrad": 1, "adam": 2}
 
     def __init__(self, dim, rule="adagrad", lr=0.05, init_scale=None,
-                 g0=0.0, eps=1e-8, seed=0):
+                 g0=0.0, eps=1e-8, beta1=0.9, beta2=0.999, accessor=None,
+                 seed=0):
         import numpy as np
 
         lib = get_lib()
@@ -113,11 +125,19 @@ class NativeSparseTable:
         self._lib = lib
         self.dim = int(dim)
         self.rule = rule
+        self.accessor = accessor
+        if accessor not in (None, "ctr"):
+            raise ValueError(f"accessor={accessor!r}: expected None/'ctr'")
         if init_scale is None:
             init_scale = 1.0 / float(np.sqrt(dim))
         self._h = ctypes.c_void_p(lib.pt_table_create(
             self.dim, self.RULES[rule], float(lr), float(init_scale),
-            float(g0), float(eps), int(seed)))
+            float(g0), float(eps), float(beta1), float(beta2),
+            1 if accessor == "ctr" else 0, int(seed)))
+
+    @property
+    def slot_dim(self):
+        return {"sgd": 0, "adagrad": 1, "adam": 2 * self.dim + 1}[self.rule]
 
     def __len__(self):
         return int(self._lib.pt_table_size(self._h))
@@ -148,18 +168,53 @@ class NativeSparseTable:
         self._lib.pt_table_push(self._h, ids.ctypes.data, len(ids),
                                 grads.ctypes.data)
 
+    def update_show_click(self, ids, shows, clicks):
+        """Accumulate per-row show/click event counts (reference
+        CtrCommonAccessor::UpdateStatAfterSave path feeding shrink)."""
+        import numpy as np
+
+        if self.accessor != "ctr":
+            raise RuntimeError("table created without accessor='ctr'")
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        shows = np.ascontiguousarray(
+            np.asarray(shows, np.float32).reshape(-1))
+        clicks = np.ascontiguousarray(
+            np.asarray(clicks, np.float32).reshape(-1))
+        if not len(ids) == len(shows) == len(clicks):
+            raise ValueError("ids/shows/clicks length mismatch")
+        self._lib.pt_table_update_show_click(
+            self._h, ids.ctypes.data, len(ids), shows.ctypes.data,
+            clicks.ctypes.data)
+
+    def shrink(self, decay=0.98, nonclk_coeff=0.1, delete_threshold=0.8,
+               delete_after_unseen=7):
+        """One maintenance round: decay show/click, age rows, evict
+        low-score long-unseen rows (reference Table::Shrink +
+        ctr_accessor.cc ShowClickScore). Returns evicted row count."""
+        if self.accessor != "ctr":
+            raise RuntimeError("table created without accessor='ctr'")
+        return int(self._lib.pt_table_shrink(
+            self._h, float(decay), float(nonclk_coeff),
+            float(delete_threshold), float(delete_after_unseen)))
+
     def state_dict(self):
         import numpy as np
 
         n = len(self)
         ids = np.empty((n,), np.int64)
         data = np.empty((n, self.dim), np.float32)
-        slot_dim = 1 if self.rule == "adagrad" else 0
-        slots = np.empty((n, slot_dim), np.float32)
+        slots = np.empty((n, self.slot_dim), np.float32)
+        meta = (np.empty((n, 3), np.float32)
+                if self.accessor == "ctr" else None)
         if n:
-            self._lib.pt_table_export(self._h, ids.ctypes.data,
-                                      data.ctypes.data, slots.ctypes.data)
-        return {"ids": ids, "data": data, "slots": slots}
+            self._lib.pt_table_export(
+                self._h, ids.ctypes.data, data.ctypes.data,
+                slots.ctypes.data,
+                meta.ctypes.data if meta is not None else None)
+        sd = {"ids": ids, "data": data, "slots": slots}
+        if self.accessor == "ctr":
+            sd["meta"] = meta
+        return sd
 
     def set_state_dict(self, sd):
         import numpy as np
@@ -174,14 +229,21 @@ class NativeSparseTable:
             raise ValueError(
                 f"table state 'data' has shape {data.shape}, expected "
                 f"({n}, {self.dim}) — checkpoint from a different table?")
-        slot_dim = 1 if self.rule == "adagrad" else 0
-        if slot_dim and slots.shape != (n, slot_dim):
+        if self.slot_dim and slots.shape != (n, self.slot_dim):
             raise ValueError(
                 f"table state 'slots' has shape {slots.shape}, expected "
-                f"({n}, {slot_dim})")
+                f"({n}, {self.slot_dim})")
+        meta = None
+        if self.accessor == "ctr" and "meta" in sd:
+            meta = np.ascontiguousarray(_np_of(sd["meta"]), np.float32)
+            if meta.shape != (n, 3):
+                raise ValueError(
+                    f"table state 'meta' has shape {meta.shape}, "
+                    f"expected ({n}, 3)")
         self._lib.pt_table_import(
             self._h, ids.ctypes.data, n, data.ctypes.data,
-            slots.ctypes.data if slots.size else None)
+            slots.ctypes.data if slots.size else None,
+            meta.ctypes.data if meta is not None else None)
 
 
 def _np_of(x):

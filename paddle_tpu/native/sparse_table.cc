@@ -3,59 +3,150 @@
 //
 // TPU-native counterpart of the reference PS table runtime
 // (reference: paddle/fluid/distributed/ps/table/memory_sparse_table.h:39
-// hash-grown rows; ps/table/sparse_sgd_rule.cc server-side optimizer
-// rules). The reference runs this inside brpc PS server processes; on
-// TPU hosts it runs in-process beside the device runtime, feeding
-// batched pulls to HBM. Exposed as a plain C ABI for ctypes (no
-// pybind11 in the image).
+// — SHARD-partitioned hash maps with per-shard locks and a thread pool;
+// ps/table/sparse_sgd_rule.cc server-side optimizer rules: naive SGD,
+// AdaGrad, Adam; ps/table/ctr_accessor.cc show/click feature management
+// with time-decay scoring and eviction via Table::Shrink). The reference
+// runs this inside brpc PS server processes; on TPU hosts it runs
+// in-process beside the device runtime, feeding batched pulls to HBM.
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
 //
-// Concurrency: a shared mutex around the id->row map; pull/push copy
-// row data outside Python (callers pass numpy buffers), so the GIL is
-// released for the whole operation.
+// Concurrency: the id space is split over NB = 64 bucket shards, each
+// with its own mutex + hash map + row storage (the reference's
+// shard-locked layout). pull/push release the GIL at the ctypes
+// boundary; large batches additionally fan out across a std::thread
+// pool — pull splits the output range (row writes are disjoint),
+// push pre-deduplicates then splits the unique range; every row touch
+// takes only its bucket's lock, so concurrent callers on different
+// buckets do not serialize.
 
+#include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
-#include <cmath>
+#include <functional>
 #include <mutex>
 #include <random>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
+constexpr int kBuckets = 64;
+constexpr int64_t kMtThreshold = 4096;  // batch size that buys threads
+
+struct Bucket {
+  std::mutex mu;
+  std::unordered_map<int64_t, int64_t> rows;  // id -> local row index
+  std::vector<float> data;    // (n, dim)
+  std::vector<float> slots;   // (n, slot_dim)
+  std::vector<float> meta;    // (n, 3): show, click, unseen  (accessor)
+  std::vector<int64_t> ids;   // (n,) reverse map for export/shrink
+};
+
 struct Table {
   int64_t dim;
-  int rule;          // 0 = sgd, 1 = adagrad
+  int rule;          // 0 = sgd, 1 = adagrad, 2 = adam
   float lr;
   float init_scale;  // rows init ~ N(0, init_scale)
   float g0;          // adagrad initial accumulator
   float eps;
-  std::unordered_map<int64_t, int64_t> rows;
-  std::vector<float> data;   // (nrows, dim)
-  std::vector<float> slots;  // (nrows, slot_dim)
-  std::mt19937_64 rng;
-  std::mutex mu;
+  float beta1, beta2;  // adam
+  int accessor;        // 1 = CTR show/click meta tracked per row
+  uint64_t seed;
+  Bucket buckets[kBuckets];
 
-  int64_t slot_dim() const { return rule == 1 ? 1 : 0; }
+  int64_t slot_dim() const {
+    if (rule == 1) return 1;
+    if (rule == 2) return 2 * dim + 1;  // m[dim], v[dim], t
+    return 0;
+  }
 
-  int64_t ensure(int64_t id) {
-    auto it = rows.find(id);
-    if (it != rows.end()) return it->second;
-    int64_t r = static_cast<int64_t>(rows.size());
-    rows.emplace(id, r);
+  static int bucket_of(int64_t id) {
+    // golden-ratio mix: consecutive ids spread across buckets
+    uint64_t h = static_cast<uint64_t>(id) * 0x9e3779b97f4a7c15ull;
+    return static_cast<int>(h >> 58) & (kBuckets - 1);
+  }
+
+  // caller holds b.mu
+  int64_t ensure(Bucket& b, int64_t id) {
+    auto it = b.rows.find(id);
+    if (it != b.rows.end()) return it->second;
+    int64_t r = static_cast<int64_t>(b.rows.size());
+    b.rows.emplace(id, r);
+    b.ids.push_back(id);
+    // per-id deterministic init (seed ^ id): identical across shard
+    // counts and insertion orders, like the python id-aware initializer
+    std::mt19937_64 rng(seed ^ static_cast<uint64_t>(id));
     std::normal_distribution<float> nd(0.f, init_scale);
-    for (int64_t j = 0; j < dim; ++j) data.push_back(nd(rng));
-    for (int64_t j = 0; j < slot_dim(); ++j) slots.push_back(g0);
+    for (int64_t j = 0; j < dim; ++j) b.data.push_back(nd(rng));
+    int64_t sd = slot_dim();
+    for (int64_t j = 0; j < sd; ++j) b.slots.push_back(rule == 1 ? g0 : 0.f);
+    if (accessor) {
+      b.meta.push_back(0.f);  // show
+      b.meta.push_back(0.f);  // click
+      b.meta.push_back(0.f);  // unseen rounds
+    }
     return r;
   }
+
+  // caller holds b.mu; applies ONE accumulated gradient to one row
+  void apply(Bucket& b, int64_t r, const float* gacc) {
+    float* row = b.data.data() + r * dim;
+    if (rule == 2) {  // adam (reference SparseAdamSGDRule)
+      float* m = b.slots.data() + r * slot_dim();
+      float* v = m + dim;
+      float* t = v + dim;
+      *t += 1.f;
+      float b1t = 1.f - std::pow(beta1, *t);
+      float b2t = 1.f - std::pow(beta2, *t);
+      for (int64_t j = 0; j < dim; ++j) {
+        m[j] = beta1 * m[j] + (1.f - beta1) * gacc[j];
+        v[j] = beta2 * v[j] + (1.f - beta2) * gacc[j] * gacc[j];
+        row[j] -= lr * (m[j] / b1t) / (std::sqrt(v[j] / b2t) + eps);
+      }
+    } else if (rule == 1) {  // adagrad: per-row mean-squared accumulator
+      float g2 = 0.f;
+      for (int64_t j = 0; j < dim; ++j) g2 += gacc[j] * gacc[j];
+      g2 /= static_cast<float>(dim);
+      float* slot = b.slots.data() + r * 1;
+      *slot += g2;
+      float scale = lr / (std::sqrt(*slot) + eps);
+      for (int64_t j = 0; j < dim; ++j) row[j] -= scale * gacc[j];
+    } else {  // sgd
+      for (int64_t j = 0; j < dim; ++j) row[j] -= lr * gacc[j];
+    }
+    if (accessor) b.meta[r * 3 + 2] = 0.f;  // touched: reset unseen
+  }
 };
+
+void parallel_for(int64_t n, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nt = static_cast<int64_t>(hw ? (hw > 8 ? 8 : hw) : 1);
+  if (n < grain || nt <= 1) {
+    fn(0, n);
+    return;
+  }
+  if (nt > n) nt = n;
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int64_t t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& th : ts) th.join();
+}
 
 }  // namespace
 
 extern "C" {
 
 void* pt_table_create(int64_t dim, int rule, float lr, float init_scale,
-                      float g0, float eps, uint64_t seed) {
+                      float g0, float eps, float beta1, float beta2,
+                      int accessor, uint64_t seed) {
   auto* t = new Table();
   t->dim = dim;
   t->rule = rule;
@@ -63,7 +154,10 @@ void* pt_table_create(int64_t dim, int rule, float lr, float init_scale,
   t->init_scale = init_scale;
   t->g0 = g0;
   t->eps = eps;
-  t->rng.seed(seed);
+  t->beta1 = beta1;
+  t->beta2 = beta2;
+  t->accessor = accessor;
+  t->seed = seed;
   return t;
 }
 
@@ -71,79 +165,189 @@ void pt_table_destroy(void* h) { delete static_cast<Table*>(h); }
 
 int64_t pt_table_size(void* h) {
   auto* t = static_cast<Table*>(h);
-  std::lock_guard<std::mutex> g(t->mu);
-  return static_cast<int64_t>(t->rows.size());
+  int64_t n = 0;
+  for (auto& b : t->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    n += static_cast<int64_t>(b.rows.size());
+  }
+  return n;
 }
 
-// out: (n, dim) float32, caller-allocated
+// out: (n, dim) float32, caller-allocated. Threaded over the id range —
+// each out row is written by exactly one index; row creation/read takes
+// the row's bucket lock only.
 void pt_table_pull(void* h, const int64_t* ids, int64_t n, float* out) {
   auto* t = static_cast<Table*>(h);
-  std::lock_guard<std::mutex> g(t->mu);
-  for (int64_t i = 0; i < n; ++i) {
-    int64_t r = t->ensure(ids[i]);
-    std::memcpy(out + i * t->dim, t->data.data() + r * t->dim,
-                sizeof(float) * t->dim);
-  }
+  parallel_for(n, kMtThreshold, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      Bucket& b = t->buckets[Table::bucket_of(ids[i])];
+      std::lock_guard<std::mutex> g(b.mu);
+      int64_t r = t->ensure(b, ids[i]);
+      std::memcpy(out + i * t->dim, b.data.data() + r * t->dim,
+                  sizeof(float) * t->dim);
+      if (t->accessor) b.meta[r * 3 + 2] = 0.f;
+    }
+  });
 }
 
 // grads: (n, dim). Duplicate ids are accumulated before ONE rule
-// application (reference push-dedup semantics).
+// application (reference push-dedup semantics); the unique set is then
+// applied in parallel under bucket locks.
 void pt_table_push(void* h, const int64_t* ids, int64_t n,
                    const float* grads) {
   auto* t = static_cast<Table*>(h);
-  std::lock_guard<std::mutex> g(t->mu);
-  std::unordered_map<int64_t, std::vector<float>> acc;
-  acc.reserve(n);
+  std::unordered_map<int64_t, int64_t> first;  // id -> slot in acc
+  first.reserve(n);
+  std::vector<int64_t> uniq;
+  std::vector<float> acc;
   for (int64_t i = 0; i < n; ++i) {
-    auto& buf = acc[ids[i]];
-    if (buf.empty()) buf.assign(t->dim, 0.f);
+    auto ins = first.emplace(ids[i], static_cast<int64_t>(uniq.size()));
     const float* gi = grads + i * t->dim;
-    for (int64_t j = 0; j < t->dim; ++j) buf[j] += gi[j];
-  }
-  for (auto& kv : acc) {
-    int64_t r = t->ensure(kv.first);
-    float* row = t->data.data() + r * t->dim;
-    const float* gacc = kv.second.data();
-    if (t->rule == 1) {  // adagrad: per-row mean-squared accumulator
-      float g2 = 0.f;
-      for (int64_t j = 0; j < t->dim; ++j) g2 += gacc[j] * gacc[j];
-      g2 /= static_cast<float>(t->dim);
-      float* slot = t->slots.data() + r;  // slot_dim == 1
-      *slot += g2;
-      float scale = t->lr / (std::sqrt(*slot) + t->eps);
-      for (int64_t j = 0; j < t->dim; ++j) row[j] -= scale * gacc[j];
-    } else {  // sgd
-      for (int64_t j = 0; j < t->dim; ++j) row[j] -= t->lr * gacc[j];
+    if (ins.second) {
+      uniq.push_back(ids[i]);
+      acc.insert(acc.end(), gi, gi + t->dim);
+    } else {
+      float* buf = acc.data() + ins.first->second * t->dim;
+      for (int64_t j = 0; j < t->dim; ++j) buf[j] += gi[j];
     }
+  }
+  int64_t u = static_cast<int64_t>(uniq.size());
+  parallel_for(u, kMtThreshold, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      Bucket& b = t->buckets[Table::bucket_of(uniq[i])];
+      std::lock_guard<std::mutex> g(b.mu);
+      int64_t r = t->ensure(b, uniq[i]);
+      t->apply(b, r, acc.data() + i * t->dim);
+    }
+  });
+}
+
+// --- CTR accessor (reference ctr_accessor.cc) ------------------------
+
+// shows/clicks: (n,) float32 event counts for each id (a batch's label
+// statistics). Creates rows on first touch, resets unseen.
+void pt_table_update_show_click(void* h, const int64_t* ids, int64_t n,
+                                const float* shows, const float* clicks) {
+  auto* t = static_cast<Table*>(h);
+  if (!t->accessor) return;
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = t->buckets[Table::bucket_of(ids[i])];
+    std::lock_guard<std::mutex> g(b.mu);
+    int64_t r = t->ensure(b, ids[i]);
+    b.meta[r * 3 + 0] += shows[i];
+    b.meta[r * 3 + 1] += clicks[i];
+    b.meta[r * 3 + 2] = 0.f;
   }
 }
 
-// Checkpoint export: ids (size,), data (size*dim), slots (size*slot_dim)
-void pt_table_export(void* h, int64_t* ids_out, float* data_out,
-                     float* slots_out) {
+// One maintenance round (reference Table::Shrink via CtrCommonAccessor
+// ::Shrink + ::Save filtering): decay show/click, age every row one
+// round, then evict rows whose score = click + nonclk_coeff·(show −
+// click) falls below delete_threshold AND whose unseen age exceeds
+// delete_after_unseen rounds. Buckets compact independently (parallel).
+// Returns the number of evicted rows.
+int64_t pt_table_shrink(void* h, float decay, float nonclk_coeff,
+                        float delete_threshold,
+                        float delete_after_unseen) {
   auto* t = static_cast<Table*>(h);
-  std::lock_guard<std::mutex> g(t->mu);
-  for (const auto& kv : t->rows) {
-    ids_out[kv.second] = kv.first;
+  if (!t->accessor) return 0;
+  std::atomic<int64_t> evicted{0};
+  int64_t sd = t->slot_dim();
+  parallel_for(kBuckets, kBuckets,  // always single-thread per bucket
+               [&](int64_t lo, int64_t hi) {
+    for (int64_t bi = lo; bi < hi; ++bi) {
+      Bucket& b = t->buckets[bi];
+      std::lock_guard<std::mutex> g(b.mu);
+      int64_t n = static_cast<int64_t>(b.ids.size());
+      Bucket keep;
+      keep.rows.reserve(n);
+      for (int64_t r = 0; r < n; ++r) {
+        float show = b.meta[r * 3 + 0] * decay;
+        float click = b.meta[r * 3 + 1] * decay;
+        float unseen = b.meta[r * 3 + 2] + 1.f;
+        float score = click + nonclk_coeff * (show - click);
+        if (score < delete_threshold && unseen > delete_after_unseen) {
+          evicted.fetch_add(1, std::memory_order_relaxed);
+          continue;
+        }
+        int64_t nr = static_cast<int64_t>(keep.ids.size());
+        keep.rows.emplace(b.ids[r], nr);
+        keep.ids.push_back(b.ids[r]);
+        keep.data.insert(keep.data.end(), b.data.begin() + r * t->dim,
+                         b.data.begin() + (r + 1) * t->dim);
+        if (sd)
+          keep.slots.insert(keep.slots.end(), b.slots.begin() + r * sd,
+                            b.slots.begin() + (r + 1) * sd);
+        keep.meta.push_back(show);
+        keep.meta.push_back(click);
+        keep.meta.push_back(unseen);
+      }
+      b.rows.swap(keep.rows);
+      b.ids.swap(keep.ids);
+      b.data.swap(keep.data);
+      b.slots.swap(keep.slots);
+      b.meta.swap(keep.meta);
+    }
+  });
+  return evicted.load();
+}
+
+// --- checkpoint ------------------------------------------------------
+
+// Export order: bucket-major, insertion order within bucket. meta_out
+// may be null when the accessor is off.
+void pt_table_export(void* h, int64_t* ids_out, float* data_out,
+                     float* slots_out, float* meta_out) {
+  auto* t = static_cast<Table*>(h);
+  int64_t base = 0;
+  int64_t sd = t->slot_dim();
+  for (auto& b : t->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    int64_t n = static_cast<int64_t>(b.ids.size());
+    if (!n) continue;
+    std::memcpy(ids_out + base, b.ids.data(), sizeof(int64_t) * n);
+    std::memcpy(data_out + base * t->dim, b.data.data(),
+                sizeof(float) * n * t->dim);
+    if (sd)
+      std::memcpy(slots_out + base * sd, b.slots.data(),
+                  sizeof(float) * n * sd);
+    if (t->accessor && meta_out)
+      std::memcpy(meta_out + base * 3, b.meta.data(),
+                  sizeof(float) * n * 3);
+    base += n;
   }
-  std::memcpy(data_out, t->data.data(), sizeof(float) * t->data.size());
-  if (t->slot_dim() > 0 && !t->slots.empty())
-    std::memcpy(slots_out, t->slots.data(),
-                sizeof(float) * t->slots.size());
 }
 
 void pt_table_import(void* h, const int64_t* ids, int64_t n,
-                     const float* data, const float* slots) {
+                     const float* data, const float* slots,
+                     const float* meta) {
   auto* t = static_cast<Table*>(h);
-  std::lock_guard<std::mutex> g(t->mu);
-  t->rows.clear();
-  t->rows.reserve(n);
-  t->data.assign(data, data + n * t->dim);
-  if (t->slot_dim() > 0 && slots)
-    t->slots.assign(slots, slots + n * t->slot_dim());
-  else
-    t->slots.clear();
-  for (int64_t i = 0; i < n; ++i) t->rows.emplace(ids[i], i);
+  int64_t sd = t->slot_dim();
+  for (auto& b : t->buckets) {
+    std::lock_guard<std::mutex> g(b.mu);
+    b.rows.clear();
+    b.ids.clear();
+    b.data.clear();
+    b.slots.clear();
+    b.meta.clear();
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    Bucket& b = t->buckets[Table::bucket_of(ids[i])];
+    std::lock_guard<std::mutex> g(b.mu);
+    int64_t r = static_cast<int64_t>(b.ids.size());
+    b.rows.emplace(ids[i], r);
+    b.ids.push_back(ids[i]);
+    b.data.insert(b.data.end(), data + i * t->dim,
+                  data + (i + 1) * t->dim);
+    if (sd && slots)
+      b.slots.insert(b.slots.end(), slots + i * sd, slots + (i + 1) * sd);
+    if (t->accessor) {
+      if (meta)
+        b.meta.insert(b.meta.end(), meta + i * 3, meta + (i + 1) * 3);
+      else
+        b.meta.insert(b.meta.end(), {0.f, 0.f, 0.f});
+    }
+  }
 }
 
 }  // extern "C"

@@ -1,0 +1,70 @@
+"""Worker for test_ps_deepfm.py traffic test (run via
+paddle_tpu.distributed.launch, 4 processes).
+
+Runs the SAME scripted pull/push sequence over both ShardedSparseTable
+transports and records xproc byte counters plus probe rows: the p2p
+transport (reference brpc_ps_client.h:195 point-to-point RPC analog)
+must move O(batch) bytes per rank where the legacy object-all-gather
+moves O(world·batch) — and both must produce identical table state.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.ps import (  # noqa: E402
+    ShardedSparseTable, SparseSGDRule)
+
+
+def make_init(dim):
+    def f(n, ids):
+        return (np.sin(np.outer(ids + 1.0, np.arange(1, dim + 1)))
+                / np.sqrt(dim)).astype(np.float32)
+
+    return f
+
+
+def run(rank, world):
+    dim, vocab, batch = 8, 400, 96
+    out = {}
+    for transport in ("p2p", "gather"):
+        t = ShardedSparseTable(dim, rule=SparseSGDRule(0.1),
+                               initializer=make_init(dim), staleness=1,
+                               transport=transport)
+        xproc.stats["p2p_bytes"] = 0
+        xproc.stats["gather_bytes"] = 0
+        for k in range(3):
+            r = np.random.default_rng(1000 * k + rank)
+            ids = r.integers(0, vocab, (batch,))
+            t.pull(ids)
+            grads = np.outer(np.cos(ids + k),
+                             np.ones(dim)).astype(np.float32)
+            t.push(ids, grads)
+        t.flush()
+        probe = t.pull(np.arange(0, vocab, 13))
+        out[transport] = {
+            "rows": probe.tolist(),
+            "p2p_bytes": xproc.stats["p2p_bytes"],
+            "gather_bytes": xproc.stats["gather_bytes"],
+        }
+    return out
+
+
+def main():
+    import paddle_tpu.distributed as dist
+
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    out = run(rank, world)
+    with open(os.path.join(out_dir, f"traffic_out_{rank}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()
