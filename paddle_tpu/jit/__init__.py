@@ -175,8 +175,14 @@ class StaticFunction:
         return _tree_rebuild(out_spec_holder[0], list(flat_out))
 
     def _trace(self, bound_self, spec, arg_tensors, params):
+        from . import autograph
+
         n_args = len(arg_tensors)
-        fn = self._fn
+        # AutoGraph (reference dygraph_to_static convert_operators.py):
+        # tensor-dependent if/while/for compile to lax control flow;
+        # python-valued control flow keeps python semantics; conversion
+        # failure falls back to the untransformed function with a warning
+        fn = autograph.maybe_convert(self._fn)
         out_spec_holder = [None]
         sg_flags = [t.stop_gradient for t in arg_tensors] + [
             p.stop_gradient for p in params

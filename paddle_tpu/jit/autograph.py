@@ -1,0 +1,754 @@
+"""Minimal AutoGraph: tensor-dependent python control flow under
+`@to_static`.
+
+TPU-native counterpart of the reference dygraph→static AST suite
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py:1 `convert_ifelse`/`convert_while_loop`,
+ifelse_transformer.py:1, loop_transformer.py:1, return_transformer.py).
+The reference rewrites python `if`/`while`/`for` into `cond`/`while_loop`
+ops in its static Program; here the same AST rewrite targets
+`lax.cond` / `lax.while_loop` / `lax.scan` inside the to_static jax
+trace. Dispatch is at RUNTIME: a python-bool condition runs the original
+python semantics, a traced-Tensor condition becomes compiled control
+flow — so one converted function serves both.
+
+What converts:
+  * `if`/`elif`/`else` whose test is a traced Tensor — branch-local
+    assignments are threaded through `lax.cond` (a variable must leave
+    both branches with a matching structure).
+  * guard-clause early `return` inside such an `if` — the return
+    transformer moves the fall-through code into the other arm first,
+    so every converted `if` either assigns (non-terminal) or returns
+    from both arms (terminal).
+  * `while` with a traced test — assigned names become the
+    `lax.while_loop` carry (not reverse-differentiable, as in jax).
+  * `for i in range(n)` with traced `n` — counter `while_loop`.
+  * `for x in tensor` — `lax.scan` over the leading axis (static
+    length, reverse-differentiable).
+
+What does NOT convert (left as original python, or the whole function
+falls back unconverted with a warning): `break`/`continue` in a loop
+whose test is traced, `return` inside a loop body, `global`/`nonlocal`
+in a converted branch, `try`/`with` containing `return`. Error
+locations map back to the user's source file/line (the transformed
+code compiles against the original filename and line offsets).
+"""
+import ast
+import copy
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["maybe_convert", "convert"]
+
+
+# --------------------------------------------------------------- runtime
+
+class _Undef:
+    """Placeholder for a variable not yet bound on the current path."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<autograph: unbound variable>"
+
+    def _raise(self, name="a variable"):
+        raise NameError(
+            f"to_static autograph: {name} is used before assignment on "
+            "this path")
+
+    def __getattr__(self, k):
+        self._raise()
+
+    def __bool__(self):
+        self._raise()
+
+
+UNDEF = _Undef()
+
+
+def _tensor_cls():
+    from ..tensor_core import Tensor
+
+    return Tensor
+
+
+def capture(*thunks):
+    """Current values of the threaded variables; UNDEF when unbound."""
+    out = []
+    for th in thunks:
+        try:
+            out.append(th())
+        except NameError:
+            out.append(UNDEF)
+    return tuple(out)
+
+
+def _raw(v):
+    return v._value if isinstance(v, _tensor_cls()) else v
+
+
+def _is_traced(v):
+    return isinstance(_raw(v), jax.core.Tracer)
+
+
+def _as_pred(pv, where):
+    pv = jnp.asarray(pv)
+    if pv.ndim != 0:
+        raise ValueError(
+            f"to_static autograph: condition in {where} has shape "
+            f"{pv.shape}; a tensor condition must be a scalar")
+    return pv if pv.dtype == jnp.bool_ else pv != 0
+
+
+def _leafp(x):
+    return isinstance(x, _tensor_cls())
+
+
+class _Dyn:
+    def __init__(self, sg):
+        self.sg = sg
+
+
+_DYNRAW = object()
+
+
+def _split_leaves(out):
+    """(treedef, static_sig, dyn_leaves): Tensors/jax arrays are dynamic,
+    everything else is trace-time static."""
+    Tensor = _tensor_cls()
+    leaves, treedef = jax.tree_util.tree_flatten(out, is_leaf=_leafp)
+    sig, dyn = [], []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            dyn.append(l._value)
+            sig.append(_Dyn(l.stop_gradient))
+        elif isinstance(l, (jax.Array, jax.core.Tracer)):
+            dyn.append(l)
+            sig.append(_DYNRAW)
+        else:
+            sig.append(l)
+    return treedef, sig, dyn
+
+
+def _join_leaves(treedef, sig, dyn):
+    Tensor = _tensor_cls()
+    it = iter(dyn)
+    leaves = []
+    for s in sig:
+        if isinstance(s, _Dyn):
+            leaves.append(Tensor(next(it), stop_gradient=s.sg))
+        elif s is _DYNRAW:
+            leaves.append(next(it))
+        else:
+            leaves.append(s)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _static_eq(a, b):
+    if a is b:
+        return True
+    if isinstance(a, _Dyn) and isinstance(b, _Dyn):
+        return True  # stop_gradient may differ; grads are jax-level here
+    if type(a) is not type(b):
+        return False
+    try:
+        if isinstance(a, np.ndarray):
+            return np.array_equal(a, b)
+        return bool(a == b)
+    except Exception:
+        return False
+
+
+def _dispatch_if(pred, true_fn, false_fn, vals, where):
+    pv = _raw(pred)
+    if not isinstance(pv, jax.core.Tracer):
+        taken = bool(np.asarray(pv)) if not isinstance(pv, bool) else pv
+        return true_fn(*vals) if taken else false_fn(*vals)
+    holders = [None, None]
+    Tensor = _tensor_cls()
+    dyn_idx = [i for i, v in enumerate(vals) if isinstance(v, Tensor)]
+    sg = [vals[i].stop_gradient for i in dyn_idx]
+
+    def mk(branch, slot):
+        def pure(operand):
+            local = list(vals)
+            for k, i in enumerate(dyn_idx):
+                local[i] = Tensor(operand[k], stop_gradient=sg[k])
+            treedef, sig, dyn = _split_leaves(branch(*local))
+            holders[slot] = (treedef, sig)
+            return tuple(dyn)
+
+        return pure
+
+    operand = tuple(vals[i]._value for i in dyn_idx)
+    res = lax.cond(_as_pred(pv, where), mk(true_fn, 0), mk(false_fn, 1),
+                   operand)
+    (td_t, sig_t), (td_f, sig_f) = holders
+    if td_t != td_f or len(sig_t) != len(sig_f) or not all(
+            _static_eq(a, b) for a, b in zip(sig_t, sig_f)):
+        raise ValueError(
+            f"to_static autograph: the two branches of the tensor `if` "
+            f"in {where} produce different structures/python values — "
+            "every variable assigned under a tensor condition must "
+            "leave both branches with the same type and structure")
+    if not isinstance(res, tuple):
+        res = (res,)
+    return _join_leaves(td_t, sig_t, list(res))
+
+
+def run_ifelse(pred, true_fn, false_fn, vals, names, where="<if>"):
+    """Non-terminal if: branch fns take and return the assigned-name
+    tuple."""
+    return _dispatch_if(pred, true_fn, false_fn, vals, where)
+
+
+def run_terminal_if(pred, true_fn, false_fn, vals=(), where="<if>"):
+    """Terminal if: both arms end in `return`; result is the value.
+    `vals` threads the names assigned in either arm (as parameters, so
+    fall-through code moved into an arm can rebind them)."""
+    return _dispatch_if(pred, true_fn, false_fn, vals, where)
+
+
+def run_while(test_fn, body_fn, vals, names, where="<while>"):
+    t0 = test_fn(*vals)
+    if not _is_traced(t0):
+        while bool(np.asarray(_raw(test_fn(*vals)))):
+            vals = body_fn(*vals)
+        return vals
+    treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
+
+    def rebuild(carry):
+        return _join_leaves(treedef0, sig0, list(carry))
+
+    def cond(carry):
+        return _as_pred(_raw(test_fn(*rebuild(carry))), where)
+
+    def body(carry):
+        out = body_fn(*rebuild(carry))
+        treedef, sig, dyn = _split_leaves(tuple(out))
+        if treedef != treedef0 or not all(
+                _static_eq(a, b) for a, b in zip(sig, sig0)):
+            raise ValueError(
+                f"to_static autograph: a loop variable in {where} "
+                "changed type/structure across iterations (e.g. a "
+                "python value became a Tensor) — initialize it as a "
+                "tensor of the final dtype before the loop")
+        return tuple(dyn)
+
+    res = lax.while_loop(cond, body, tuple(dyn0))
+    return rebuild(res)
+
+
+def run_for_range(range_args, body_fn, vals, names, where="<for>"):
+    raws = [_raw(a) for a in range_args]
+    if not any(isinstance(r, jax.core.Tracer) for r in raws):
+        for i in range(*(int(np.asarray(r)) for r in raws)):
+            vals = body_fn(i, *vals)
+        return vals
+    if len(raws) == 1:
+        start, stop, step = 0, raws[0], 1
+    elif len(raws) == 2:
+        start, stop, step = raws[0], raws[1], 1
+    else:
+        start, stop, step = raws
+    if isinstance(step, jax.core.Tracer):
+        raise ValueError(
+            f"to_static autograph: range() step in {where} must be a "
+            "python int when start/stop are tensors")
+    step = int(step)
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    Tensor = _tensor_cls()
+    treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
+
+    def rebuild(carry):
+        return _join_leaves(treedef0, sig0, list(carry))
+
+    def cond(state):
+        i = state[0]
+        return i < stop if step > 0 else i > stop
+
+    def body(state):
+        i = state[0]
+        out = body_fn(Tensor(i, stop_gradient=True), *rebuild(state[1]))
+        treedef, sig, dyn = _split_leaves(tuple(out))
+        if treedef != treedef0 or not all(
+                _static_eq(a, b) for a, b in zip(sig, sig0)):
+            raise ValueError(
+                f"to_static autograph: a loop variable in {where} "
+                "changed type/structure across iterations")
+        return (i + step, tuple(dyn))
+
+    _, res = lax.while_loop(cond, body, (jnp.asarray(start), tuple(dyn0)))
+    return rebuild(res)
+
+
+def run_for_iter(it, body_fn, vals, names, where="<for>"):
+    Tensor = _tensor_cls()
+    if not (isinstance(it, Tensor) and _is_traced(it)):
+        if isinstance(it, Tensor):          # concrete tensor: row iter
+            it = [it[k] for k in range(it.shape[0])]
+        for x in it:
+            vals = body_fn(x, *vals)
+        return vals
+    treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
+
+    def rebuild(carry):
+        return _join_leaves(treedef0, sig0, list(carry))
+
+    def step(carry, row):
+        out = body_fn(Tensor(row, stop_gradient=it.stop_gradient),
+                      *rebuild(carry))
+        treedef, sig, dyn = _split_leaves(tuple(out))
+        if treedef != treedef0 or not all(
+                _static_eq(a, b) for a, b in zip(sig, sig0)):
+            raise ValueError(
+                f"to_static autograph: a loop variable in {where} "
+                "changed type/structure across iterations")
+        return tuple(dyn), None
+
+    # scan (not while_loop): static trip count -> reverse-differentiable
+    res, _ = lax.scan(step, tuple(dyn0), it._value)
+    return rebuild(res)
+
+
+# ----------------------------------------------------------- AST analysis
+
+class _Unsupported(Exception):
+    pass
+
+
+class _NameCollector(ast.NodeVisitor):
+    """Names assigned by a statement list. def/class names are NOT
+    collected: threading function objects through lax.cond is
+    impossible (never equal across branches), and the generated
+    __ag_* scaffolding itself must stay out of the enclosing
+    analysis — so a def inside a converted tensor branch is
+    branch-local by design."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_FunctionDef(self, node):
+        pass  # name deliberately not threaded; skip body
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_Global(self, node):
+        raise _Unsupported("global statement in a converted block")
+
+    def visit_Nonlocal(self, node):
+        raise _Unsupported("nonlocal statement in a converted block")
+
+
+def _assigned_names(stmts):
+    c = _NameCollector()
+    for s in stmts:
+        c.visit(s)
+    return sorted(c.names)
+
+
+class _StmtFinder(ast.NodeVisitor):
+    def __init__(self, kinds):
+        self.kinds = kinds
+        self.found = False
+
+    def generic_visit(self, node):
+        if isinstance(node, self.kinds):
+            self.found = True
+        super().generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _contains(node_or_list, kinds):
+    f = _StmtFinder(kinds)
+    for n in (node_or_list if isinstance(node_or_list, list)
+              else [node_or_list]):
+        f.visit(n)
+    return f.found
+
+
+def _contains_return(node_or_list):
+    return _contains(node_or_list, ast.Return)
+
+
+def _contains_raise(node_or_list):
+    return _contains(node_or_list, ast.Raise)
+
+
+class _BreakFinder(ast.NodeVisitor):
+    """break/continue bound to the CURRENT loop (not nested loops)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_While(self, node):
+        pass
+
+    def visit_For(self, node):
+        pass
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _has_own_break(body):
+    f = _BreakFinder()
+    for s in body:
+        f.visit(s)
+    return f.found
+
+
+def _terminates(stmts):
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+def _normalize_returns(block):
+    """Guard-clause normalization (reference return_transformer.py): any
+    `if` containing a `return` absorbs the statements after it into its
+    non-returning arm, so converted ifs are either return-free or
+    terminal (both arms end in return). Returns inside loops / with /
+    try are unsupported (the caller falls back). Each arm is normalized
+    EXACTLY ONCE, with its continuation already appended — normalizing
+    an arm twice would re-move trailing statements into nested arms and
+    duplicate side effects."""
+    out = []
+    i = 0
+    while i < len(block):
+        st = block[i]
+        if isinstance(st, (ast.While, ast.For, ast.With, ast.Try)):
+            if _contains_return(st):
+                raise _Unsupported(
+                    "return inside a loop/with/try under to_static "
+                    "autograph — restructure to return after the block")
+            out.append(st)
+            i += 1
+            continue
+        if isinstance(st, ast.If) and _contains_return(st):
+            rest = block[i + 1:]
+            # raw (pre-normalization) _terminates is conservative-safe:
+            # True only for tail returns, which stay terminating
+            body_src = (st.body if _terminates(st.body)
+                        else st.body + copy.deepcopy(rest))
+            else_src = (st.orelse if _terminates(st.orelse)
+                        else st.orelse + rest)
+            st.body = _normalize_returns(body_src)
+            st.orelse = _normalize_returns(else_src)
+            out.append(st)
+            return out  # everything after is inside the if now
+        out.append(st)
+        i += 1
+    return out
+
+
+# -------------------------------------------------------- AST transforms
+
+def _names_tuple_src(names):
+    return "(" + ", ".join(names) + ("," if len(names) == 1 else "") + ")"
+
+
+def _capture_src(names):
+    return "__paddle_tpu_autograph__.capture(" + ", ".join(
+        f"(lambda: {n})" for n in names) + ")"
+
+
+class _CFTransformer(ast.NodeTransformer):
+    def __init__(self, where):
+        self._n = 0
+        self._where = where
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    def visit_FunctionDef(self, node):
+        return node  # nested defs keep python semantics
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _scaffold(self, src, loc):
+        mod = ast.parse(textwrap.dedent(src))
+        for n in ast.walk(mod):
+            ast.copy_location(n, loc)
+        return mod.body
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains_raise(node):
+            # lax.cond traces BOTH arms: a raise in either would fire at
+            # trace time regardless of the predicate. Leave python
+            # semantics (a tensor test then gets jax's tracer-bool
+            # error, which names the offending line).
+            return node
+        uid = self._uid()
+        where = f"{self._where}:{node.lineno}"
+        try:
+            names = _assigned_names(node.body + node.orelse)
+        except _Unsupported:
+            return node  # global/nonlocal: leave this if as python
+        if _contains_return(node):
+            # terminal: both arms end in return (normalization ensured).
+            # Assigned names are threaded as PARAMETERS so fall-through
+            # code moved into an arm can reassign variables bound before
+            # the if (a bare nested def would make them locals and raise
+            # UnboundLocalError on first read).
+            params = ", ".join(names)
+            stmts = self._scaffold(f"""
+def __ag_t{uid}({params}):
+    pass
+def __ag_f{uid}({params}):
+    pass
+return __paddle_tpu_autograph__.run_terminal_if(__AG_TEST__, __ag_t{uid}, __ag_f{uid},
+                              {_capture_src(names)}, {where!r})
+""", node)
+            stmts[0].body = node.body
+            stmts[1].body = node.orelse or [ast.copy_location(
+                ast.Return(value=ast.Constant(value=None)), node)]
+            stmts[2].value.args[0] = node.test
+            return stmts
+        if not names:
+            # pure side-effect-free branch? keep original python `if`
+            # (a tensor test on it will raise jax's tracer-bool error)
+            return node
+        params = ", ".join(names)
+        ret = _names_tuple_src(names)
+        stmts = self._scaffold(f"""
+def __ag_t{uid}({params}):
+    return {ret}
+def __ag_f{uid}({params}):
+    return {ret}
+{ret} = __paddle_tpu_autograph__.run_ifelse(__AG_TEST__, __ag_t{uid}, __ag_f{uid},
+                          {_capture_src(names)}, {names!r}, {where!r})
+""", node)
+        stmts[0].body = node.body + [stmts[0].body[-1]]
+        stmts[1].body = (node.orelse or []) + [stmts[1].body[-1]]
+        stmts[2].value.args[0] = node.test
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_own_break(node.body) or \
+                _contains_return(node.body) or \
+                _contains_raise(node.body):
+            return node
+        try:
+            names = _assigned_names(node.body)
+        except _Unsupported:
+            return node
+        if not names:
+            return node
+        uid = self._uid()
+        where = f"{self._where}:{node.lineno}"
+        params = ", ".join(names)
+        ret = _names_tuple_src(names)
+        stmts = self._scaffold(f"""
+def __ag_c{uid}({params}):
+    return __AG_TEST__
+def __ag_b{uid}({params}):
+    return {ret}
+{ret} = __paddle_tpu_autograph__.run_while(__ag_c{uid}, __ag_b{uid},
+                         {_capture_src(names)}, {names!r}, {where!r})
+""", node)
+        stmts[0].body[0].value = node.test
+        stmts[1].body = node.body + [stmts[1].body[-1]]
+        return stmts
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_own_break(node.body) or \
+                _contains_return(node.body) or \
+                _contains_raise(node.body) or \
+                not isinstance(node.target, ast.Name):
+            return node
+        try:
+            names = _assigned_names(node.body)
+        except _Unsupported:
+            return node
+        names = sorted(set(names) - {node.target.id})
+        if not names:
+            # side-effect-only body (e.g. list.append): a scan carry of
+            # () would leak loop tracers into the appended objects —
+            # keep python iteration
+            return node
+        tgt = node.target.id
+        uid = self._uid()
+        where = f"{self._where}:{node.lineno}"
+        params = ", ".join([tgt] + names) if names else tgt
+        ret = _names_tuple_src(names)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in node.iter.args))
+        runner = "run_for_range" if is_range else "run_for_iter"
+        assign = (f"{ret} = " if names else "")  # `() = …` is a syntax
+        stmts = self._scaffold(f"""
+def __ag_b{uid}({params}):
+    return {ret}
+{assign}__paddle_tpu_autograph__.{runner}(__AG_ITER__, __ag_b{uid},
+                        {_capture_src(names)}, {names!r}, {where!r})
+""", node)
+        stmts[0].body = node.body + [stmts[0].body[-1]]
+        call = stmts[1].value
+        if is_range:
+            call.args[0] = ast.copy_location(
+                ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                node)
+        else:
+            call.args[0] = node.iter
+        return stmts
+
+
+# ------------------------------------------------------------ conversion
+
+# weak keys: functions and code objects are weakref-able, and the cached
+# converted function must not pin dead closures (or their captured
+# Layers/Parameters) for the life of the process
+import weakref
+
+_CACHE = weakref.WeakKeyDictionary()
+_FAILED = weakref.WeakSet()
+
+
+def convert(fn):
+    """AST-convert `fn`; raises on unsupported constructs."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise _Unsupported(f"source unavailable: {e}")
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise _Unsupported("not a plain function")
+    fdef.decorator_list = []
+    if not _terminates(fdef.body):
+        fdef.body.append(ast.Return(value=ast.Constant(value=None)))
+    fdef.body = _normalize_returns(fdef.body)
+    where = f"{fn.__module__}.{fn.__qualname__}"
+    tf = _CFTransformer(where)
+    fdef.body = [tf.visit(s) for s in fdef.body]
+    fdef.body = [s for sub in fdef.body
+                 for s in (sub if isinstance(sub, list) else [sub])]
+    ast.fix_missing_locations(tree)
+    ast.increment_lineno(tree, fn.__code__.co_firstlineno - 1)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        outer = ast.FunctionDef(
+            name="__ag_outer__",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                                  ctx=ast.Load()))],
+            decorator_list=[])
+        tree.body = [outer]
+        ast.fix_missing_locations(tree)
+        ast.increment_lineno(tree, 0)
+    code = compile(tree, filename=fn.__code__.co_filename, mode="exec")
+    globalns = fn.__globals__
+    # collision-proof runtime binding: always overwrite — a user
+    # variable of this (mangled) name would otherwise shadow the
+    # runtime and break every converted function in the module
+    globalns["__paddle_tpu_autograph__"] = _runtime_module()
+    localns = {}
+    exec(code, globalns, localns)
+    if freevars:
+        cells = [c.cell_contents for c in fn.__closure__]
+        new_fn = localns["__ag_outer__"](*cells)
+    else:
+        new_fn = localns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn)
+    # update_wrapper pins the original via __wrapped__ — drop it so the
+    # weak conversion cache can collect dead closures
+    del new_fn.__wrapped__
+    return new_fn
+
+
+def _runtime_module():
+    import sys
+
+    return sys.modules[__name__]
+
+
+def maybe_convert(fn):
+    """Convert-with-fallback, weakly cached per function object."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    # closures bake cell CONTENTS at conversion time — key per function
+    # object, not per code object, so distinct closures convert apart
+    key = (fn if getattr(fn, "__closure__", None)
+           else getattr(fn, "__code__", fn))
+    try:
+        if key in _FAILED:
+            return fn
+        cached = _CACHE.get(key)
+    except TypeError:  # non-weakref-able callable: convert uncached
+        cached = None
+    if cached is not None:
+        return cached
+    try:
+        conv = convert(fn)
+    except Exception as e:
+        warnings.warn(
+            f"to_static autograph: leaving {getattr(fn, '__name__', fn)} "
+            f"unconverted ({e}); tensor-dependent python control flow "
+            "in it will not compile", stacklevel=3)
+        try:
+            _FAILED.add(key)
+        except TypeError:
+            pass
+        return fn
+    try:
+        _CACHE[key] = conv
+    except TypeError:
+        pass
+    return conv

@@ -1,0 +1,316 @@
+"""AutoGraph: tensor-dependent control flow under @to_static
+(reference: dygraph_to_static/convert_operators.py, ifelse_transformer,
+loop_transformer, return_transformer — the representative test patterns
+from the reference's dygraph_to_static suite, unmodified user code)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_if_else_on_tensor_assignment():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    np.testing.assert_allclose(f(t([1.0, 2.0])).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(t([-1.0, -2.0])).numpy(), [-2.0, -3.0])
+
+
+def test_elif_chain():
+    @to_static
+    def f(x):
+        s = x.sum()
+        if s > 10:
+            out = x * 0
+        elif s > 0:
+            out = x + 100
+        else:
+            out = -x
+        return out
+
+    np.testing.assert_allclose(f(t([20.0])).numpy(), [0.0])
+    np.testing.assert_allclose(f(t([1.0])).numpy(), [101.0])
+    np.testing.assert_allclose(f(t([-3.0])).numpy(), [3.0])
+
+
+def test_early_return_guard_clause():
+    @to_static
+    def f(x):
+        if x.sum() < 0:
+            return x * 0
+        y = x + 1
+        return y * y
+
+    np.testing.assert_allclose(f(t([-5.0])).numpy(), [0.0])
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [9.0])
+
+
+def test_both_arms_return():
+    @to_static
+    def f(x):
+        if x.mean() > 1:
+            return x - 1
+        else:
+            return x + 1
+
+    np.testing.assert_allclose(f(t([4.0])).numpy(), [3.0])
+    np.testing.assert_allclose(f(t([0.0])).numpy(), [1.0])
+
+
+def test_nested_if():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            if x.max() > 10:
+                y = x / 10
+            else:
+                y = x
+        else:
+            y = x * 0
+        return y
+
+    np.testing.assert_allclose(f(t([20.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(t([5.0])).numpy(), [5.0])
+    np.testing.assert_allclose(f(t([-5.0])).numpy(), [-0.0])
+
+
+def test_while_accumulation():
+    @to_static
+    def f(x):
+        s = x * 0
+        i = paddle.to_tensor(np.int32(0))
+        while i < 5:
+            s = s + x
+            i = i + 1
+        return s
+
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [10.0])
+
+
+def test_while_tensor_condition_on_value():
+    # loop until the running value crosses a threshold — the classic
+    # tensor-dependent trip count
+    @to_static
+    def f(x):
+        while x.sum() < 100:
+            x = x * 2
+        return x
+
+    np.testing.assert_allclose(f(t([3.0])).numpy(), [192.0])
+
+
+def test_python_control_flow_untouched():
+    # python-bool conditions / python range keep python semantics
+    # (reference convert_ifelse dispatches on variable type)
+    @to_static
+    def f(x, flag, n):
+        if flag:            # python bool
+            x = x + 1
+        for _ in range(n):  # python int
+            x = x * 2
+        return x
+
+    np.testing.assert_allclose(f(t([1.0]), True, 3).numpy(), [16.0])
+    np.testing.assert_allclose(f(t([1.0]), False, 2).numpy(), [4.0])
+
+
+def test_for_over_tensor_rows():
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row * row
+        return acc
+
+    xs = t([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(f(xs).numpy(), [10.0, 20.0])
+
+
+def test_for_range_tensor_stop():
+    @to_static
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + x + i.astype("float32")
+        return s
+
+    n = paddle.to_tensor(np.int32(4))
+    np.testing.assert_allclose(f(t([1.0]), n).numpy(), [10.0])
+
+
+def test_grad_flows_through_converted_if():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 3
+        else:
+            y = x * 5
+        return y.sum()
+
+    x = t([2.0, 1.0])
+    x.stop_gradient = False
+    f(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    x2 = t([-2.0, -1.0])
+    x2.stop_gradient = False
+    f(x2).backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [5.0, 5.0])
+
+
+def test_grad_flows_through_tensor_for():
+    # lax.scan path is reverse-differentiable
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row * row
+        return acc.sum()
+
+    xs = t([[1.0, 2.0], [3.0, 4.0]])
+    xs.stop_gradient = False
+    f(xs).backward()
+    np.testing.assert_allclose(xs.grad.numpy(),
+                               [[2.0, 4.0], [6.0, 8.0]])
+
+
+def test_mixed_python_and_tensor_state_in_while():
+    # python counter + tensor accumulator: the python value must stay
+    # constant across traced iterations or raise clearly — here it is
+    # only read, which is fine
+    @to_static
+    def f(x, scale):
+        s = x * 0
+        i = paddle.to_tensor(np.int32(0))
+        while i < 3:
+            s = s + x * scale  # scale: python float, loop-invariant
+            i = i + 1
+        return s
+
+    np.testing.assert_allclose(f(t([1.0]), 2.0).numpy(), [6.0])
+
+
+def test_branch_structure_mismatch_raises():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            y = (x, x)      # tuple in one arm
+        else:
+            y = x           # tensor in the other
+        return y
+
+    with pytest.raises(Exception, match="branch|structure"):
+        f(t([1.0]))
+
+
+def test_inplace_aug_assign_in_branch():
+    @to_static
+    def f(x):
+        y = x * 1
+        if x.sum() > 0:
+            y = y + 10
+        return y
+
+    np.testing.assert_allclose(f(t([1.0])).numpy(), [11.0])
+    np.testing.assert_allclose(f(t([-1.0])).numpy(), [-1.0])
+
+
+def test_layer_forward_with_tensor_if():
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                return h * 2
+            return h
+
+    net = Net()
+    paddle.seed(0)
+    st = to_static(Net())
+    x = t(np.random.default_rng(0).standard_normal((2, 4)))
+    out = st(x)
+    assert out.shape == [2, 4]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_unsupported_falls_back_with_warning():
+    # return inside a loop: unsupported -> warn + run original python
+    with pytest.warns(UserWarning, match="unconverted"):
+        @to_static
+        def f(x, n):
+            for i in range(n):
+                if i == 2:
+                    return x * i
+            return x
+
+        # python path still works after fallback
+        assert float(f(t([3.0]), 5).numpy()[0]) == 6.0
+
+
+def test_guard_return_then_reassign_fallthrough():
+    # the fall-through moved into the false arm reassigns a variable
+    # bound before the if — must not raise UnboundLocalError
+    @to_static
+    def f(x):
+        y = x * 1
+        if x.sum() < 0:
+            return y * 0
+        y = y + 1
+        return y
+
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [3.0])
+    np.testing.assert_allclose(f(t([-2.0])).numpy(), [-0.0])
+
+
+def test_raise_arm_not_traced():
+    # lax.cond traces both arms — an if with a raising arm must stay
+    # python (and therefore error clearly on a tensor predicate), never
+    # fire the raise when the python predicate does not select it
+    @to_static
+    def f(x, strict):
+        if strict:          # python bool
+            if x.shape[0] > 100:
+                raise ValueError("too long")
+        return x * 2
+
+    np.testing.assert_allclose(f(t([1.0]), True).numpy(), [2.0])
+
+
+def test_nested_guard_side_effect_runs_once():
+    calls = []
+
+    @to_static
+    def f(x, c1, c2):
+        if c1:              # python
+            if c2:          # python
+                return x * 0
+            calls.append(1)
+        return x * 2
+
+    out = f(t([3.0]), True, False)
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    assert len(calls) == 1, calls
+
+
+def test_append_only_for_stays_python():
+    @to_static
+    def f(xs):
+        outs = []
+        for row in xs:
+            outs.append(row * 2)
+        return outs[0] + outs[1]
+
+    xs = t([[1.0], [4.0]])
+    np.testing.assert_allclose(f(xs).numpy(), [10.0])
