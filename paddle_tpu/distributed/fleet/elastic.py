@@ -18,7 +18,7 @@ it (enabled / exit codes / watch loop hooks)."""
 import time
 
 __all__ = ["ElasticStatus", "ElasticManager", "run_with_fault_tolerance",
-           "ELASTIC_EXIT_CODE"]
+           "request_scale_out", "ELASTIC_EXIT_CODE"]
 
 ELASTIC_EXIT_CODE = 101  # reference manager.py ELASTIC_EXIT_CODE
 
@@ -85,6 +85,63 @@ class ElasticManager:
     def exit(self, completed=True):
         self._status = (ElasticStatus.COMPLETED if completed
                         else ElasticStatus.ERROR)
+
+    def pending_joins(self):
+        """Join requests awaiting the launcher (reference ETCDMaster
+        node-arrival watch)."""
+        return len(pending_join_files(self.hb_dir))
+
+
+# the join-request file protocol, shared by request_scale_out (writer),
+# ElasticManager.pending_joins and the launcher's watch (readers)
+JOIN_PREFIX = "join_"
+
+
+def pending_join_files(hb_dir):
+    """Absolute paths of join_* request files in the heartbeat dir."""
+    import os
+
+    if not hb_dir or not os.path.isdir(hb_dir):
+        return []
+    return sorted(
+        os.path.join(hb_dir, f) for f in os.listdir(hb_dir)
+        if f.startswith(JOIN_PREFIX))
+
+
+def request_scale_out(n=1, hb_dir=None):
+    """Ask the launcher to admit `n` joining worker(s): drops join_*
+    request files in the heartbeat directory. A launcher running with
+    --elastic_level>=1 tears the pod down (RC_SCALE_OUT) and re-forms it
+    with nproc+n contiguous ranks; workers resume from the latest
+    complete checkpoint and re-shard DistributedBatchSampler at the new
+    world size (reference: elastic/manager.py:127 ETCDMaster re-ranks on
+    peer ARRIVAL; launch/controllers/master.py:175).
+
+    Call from an operator process or any worker (typically rank 0 when
+    new capacity is detected). SINGLE-NODE pods only: with --nnodes>1
+    each launcher watches only its local heartbeat dir, so a join there
+    would desynchronize PADDLE_TRAINERS_NUM across nodes (the launcher
+    refuses --elastic_level>=1 with --nnodes>1 for the same reason).
+    Returns the number of request files written."""
+    import os
+    import uuid
+
+    hb_dir = hb_dir or os.environ.get("PADDLE_HEARTBEAT_DIR")
+    if not hb_dir:
+        raise RuntimeError(
+            "request_scale_out needs the launcher heartbeat dir "
+            "(PADDLE_HEARTBEAT_DIR) — start the job via "
+            "paddle_tpu.distributed.launch")
+    if int(os.environ.get("PADDLE_NNODES", "1")) > 1:
+        raise RuntimeError(
+            "request_scale_out is single-node-pod scoped; multi-node "
+            "scale-out needs a shared membership service")
+    os.makedirs(hb_dir, exist_ok=True)
+    for _ in range(n):
+        path = os.path.join(hb_dir, JOIN_PREFIX + uuid.uuid4().hex[:8])
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+    return n
 
 
 def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,

@@ -62,10 +62,15 @@ def parse_args(argv=None):
     p.add_argument("--max_restart", type=int, default=0,
                    help="restart the pod up to N times on failure")
     p.add_argument("--elastic_level", type=int, default=0,
-                   help="0: restart-only; >=1: after 2 consecutive failed "
-                        "attempts, re-form the pod over the surviving "
-                        "slots (shrink nproc by one, contiguous rank "
-                        "remap) — reference elastic/manager.py scale-in")
+                   help="0: restart-only; >=1: elastic membership — "
+                        "scale-IN (after 2 consecutive failed attempts, "
+                        "re-form the pod over the surviving slots with "
+                        "contiguous rank remap) AND scale-OUT (a "
+                        "fleet.elastic.request_scale_out join request "
+                        "tears the pod down and re-forms it with the "
+                        "joiners admitted; workers resume from the "
+                        "latest checkpoint) — reference "
+                        "elastic/manager.py. Single-node pods only.")
     p.add_argument("--elastic_timeout", type=float, default=30.0,
                    help="seconds without a worker heartbeat before the "
                         "pod is declared hung and restarted")
@@ -102,11 +107,16 @@ def _spawn_pod(args, master, nproc=None):
     os.makedirs(args.log_dir, exist_ok=True)
     hb_dir = os.path.join(args.log_dir, "hb")
     os.makedirs(hb_dir, exist_ok=True)
-    for f in os.listdir(hb_dir):  # stale beats from a previous attempt
-        try:
-            os.unlink(os.path.join(hb_dir, f))
-        except OSError:
-            pass
+    # clear stale beats from a previous attempt. join_* requests are NOT
+    # touched: they are consumed only by launch() after counting, so a
+    # request landing during a teardown window is admitted next round
+    # instead of silently dropped.
+    for f in os.listdir(hb_dir):
+        if f.startswith("hb_"):
+            try:
+                os.unlink(os.path.join(hb_dir, f))
+            except OSError:
+                pass
     procs = []
     cmd = [sys.executable, args.training_script] + args.training_script_args
     for lr in range(nproc):
@@ -124,8 +134,21 @@ def _spawn_pod(args, master, nproc=None):
     return procs
 
 
+RC_SCALE_OUT = 97  # synthetic: pod torn down to admit joining workers
+
+
+def _pending_joins(hb_dir):
+    """join_* request files dropped by elastic.request_scale_out
+    (reference: elastic/manager.py:127 — ETCDMaster re-ranks on node
+    ARRIVAL; the heartbeat dir plays the etcd registry). Shared
+    protocol lives in fleet/elastic.py."""
+    from ..fleet.elastic import pending_join_files
+
+    return pending_join_files(hb_dir)
+
+
 def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
-              rank_base=0):
+              rank_base=0, watch_joins=False):
     """Block until all exit ok or one fails (then kill the rest).
 
     With a heartbeat dir, a worker whose beat file goes stale for longer
@@ -133,11 +156,16 @@ def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
     misses a worker wedged in a dead collective (reference: etcd
     heartbeat TTL, elastic/manager.py:234). Only workers that have
     beaten at least once are monitored, so non-paddle scripts that never
-    call init_parallel_env are unaffected."""
+    call init_parallel_env are unaffected. With watch_joins, a join_*
+    request file tears the pod down with RC_SCALE_OUT so the caller can
+    re-form it at the larger size (reference scale-out on node join)."""
     alive = {i: p for i, (p, _) in enumerate(procs)}
     failed_rc = 0
     while alive and not failed_rc:
         time.sleep(poll_s)
+        if watch_joins and hb_dir and _pending_joins(hb_dir):
+            failed_rc = RC_SCALE_OUT
+            break
         for i, p in list(alive.items()):
             rc = p.poll()
             if rc is None:
@@ -161,6 +189,8 @@ def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
             except OSError:
                 beats = []
             for f in beats:
+                if not f.startswith("hb_"):
+                    continue  # join_* requests are not heartbeats
                 try:
                     age = now - os.path.getmtime(os.path.join(hb_dir, f))
                 except OSError:
@@ -194,21 +224,63 @@ def launch(argv=None):
         if args.nnodes > 1:
             sys.exit("--master is required when --nnodes > 1")
         master = f"127.0.0.1:{_free_port()}"
-    attempts = args.max_restart + 1
+    if args.elastic_level >= 1 and args.nnodes > 1:
+        # each launcher watches only its LOCAL heartbeat dir; scaling one
+        # node's pod would desynchronize PADDLE_TRAINERS_NUM across nodes
+        sys.exit("--elastic_level>=1 is single-node-pod scoped "
+                 "(multi-node elastics need a shared membership service)")
     nproc = args.nproc_per_node
     hb_dir = os.path.join(args.log_dir, "hb")
+    # join requests are only meaningful within ONE launch invocation —
+    # a leftover from a previous job must not instantly tear down this
+    # pod
+    for path in _pending_joins(hb_dir):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
     consecutive = 0
-    for attempt in range(attempts):
-        if attempt:
-            print(f"[launch] pod failed; restart {attempt}/{args.max_restart}"
-                  f" (nproc={nproc})", file=sys.stderr, flush=True)
+    attempt = 0
+    rc = 1
+    while True:
         procs = _spawn_pod(args, master, nproc)
         rc = _wait_pod(procs, hb_dir=hb_dir,
                        hb_timeout=args.elastic_timeout
                        if args.elastic_timeout > 0 else 0.0,
-                       rank_base=args.rank * nproc)
+                       rank_base=args.rank * nproc,
+                       watch_joins=args.elastic_level >= 1)
         if rc == 0:
             return 0
+        join_files = (_pending_joins(hb_dir)
+                      if args.elastic_level >= 1 else [])
+        if rc == RC_SCALE_OUT and join_files:
+            # node join (reference ETCDMaster re-rank on peer arrival):
+            # admit the joiners, re-form the pod at the larger size with
+            # contiguous ranks; workers resume from the latest complete
+            # checkpoint and re-shard their samplers at the new world
+            # size. Not a failure: does not consume --max_restart.
+            # Consume EXACTLY the counted request files — one that lands
+            # between the count and the respawn survives for the next
+            # watch round instead of being silently dropped.
+            for path in join_files:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            nproc += len(join_files)
+            consecutive = 0
+            print(f"[launch] elastic scale-out: {len(join_files)} "
+                  f"worker(s) joining; re-forming pod with {nproc} "
+                  f"workers (ranks remapped 0..{nproc - 1})",
+                  file=sys.stderr, flush=True)
+            continue
+        # a worker that genuinely exits 97 (without any join request)
+        # falls through to the normal failure/restart path
+        attempt += 1
+        if attempt > args.max_restart:
+            break
+        print(f"[launch] pod failed; restart {attempt}/{args.max_restart}"
+              f" (nproc={nproc})", file=sys.stderr, flush=True)
         consecutive += 1
         # elastic scale-in: the pod keeps dying at this size — re-form it
         # over the surviving slots with a contiguous rank remap

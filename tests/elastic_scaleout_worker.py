@@ -1,0 +1,104 @@
+"""Worker for the elastic scale-out test (test_elastic.py).
+
+Data-parallel training with a DistributedBatchSampler sharded at the
+CURRENT world size, per-step checkpointing. On the first (2-worker)
+attempt, rank 0 snapshots the checkpoint dir and requests a scale-out
+at step JOIN_AT, then blocks; the launcher tears the pod down and
+re-forms it with 3 workers, which resume from the latest checkpoint
+with re-sharded samplers. The test compares the resumed 3-worker loss
+curve against a FRESH 3-worker launch resuming from the snapshot —
+they must match exactly.
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import io, nn  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.checkpoint import Checkpointer  # noqa: E402
+from paddle_tpu.distributed.fleet import elastic  # noqa: E402
+
+STEPS = 8
+JOIN_AT = 3  # request the third worker after completing this step
+
+
+class _ToyDataset(io.Dataset):
+    def __init__(self, n=24, dim=8):
+        rng = np.random.default_rng(7)
+        self.x = rng.standard_normal((n, dim)).astype(np.float32)
+        self.y = rng.standard_normal((n,)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def main():
+    out_dir = sys.argv[1]
+    ckpt_root = sys.argv[2] if len(sys.argv) > 2 else \
+        os.path.join(out_dir, "ckpt")
+    join_marker = os.path.join(out_dir, "join_marker")
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    ckpt = Checkpointer(ckpt_root, model=m, optimizer=opt, keep=10)
+
+    ds = _ToyDataset()
+    # re-sharded at every pod formation: num_replicas = CURRENT world
+    sampler = io.DistributedBatchSampler(
+        ds, batch_size=4, num_replicas=world, rank=rank, shuffle=False)
+
+    latest = ckpt.load_latest()
+    start = 0 if latest is None else latest + 1
+    losses = []
+    for step in range(start, STEPS):
+        # deterministic batch choice per step: walk the sampler cyclically
+        batches = list(sampler)
+        idx = batches[step % len(batches)]
+        x = paddle.to_tensor(np.stack([ds.x[i] for i in idx]))
+        y = paddle.to_tensor(np.stack([ds.y[i] for i in idx]))
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y,
+                                      reduction="sum")
+        loss.backward()
+        for p in m.parameters():  # SUM-reduce == full-batch sum loss
+            if p.grad is not None:
+                p.grad._value = paddle.to_tensor(
+                    xproc.all_reduce_np(np.asarray(p.grad._value)))._value
+        opt.step()
+        opt.clear_grad()
+        g_loss = float(xproc.all_reduce_np(
+            np.asarray(loss.numpy(), np.float32).reshape(1)))
+        losses.append(g_loss)
+        ckpt.save(step)
+        xproc.barrier()  # every rank completed `step`
+        if (rank == 0 and world == 2 and step == JOIN_AT
+                and os.path.exists(join_marker)):
+            os.unlink(join_marker)
+            # snapshot the checkpoint state the joiners will resume from
+            shutil.copytree(ckpt_root,
+                            os.path.join(out_dir, "ckpt_at_join"))
+            elastic.request_scale_out(1)
+            time.sleep(600)  # block: the launcher tears the pod down
+
+    with open(os.path.join(out_dir,
+                           f"scaleout_out_w{world}_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "world": world, "start": start,
+                   "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()
