@@ -33,7 +33,7 @@ from .parallel_step import DistributedTrainStep
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Strategy",
            "Engine", "plan_tp", "complete_annotations", "reshard",
-           "CostModel", "ClusterSpec"]
+           "CostModel", "ClusterSpec", "Planner", "Plan"]
 
 
 class ProcessMesh:
@@ -342,6 +342,270 @@ class CostModel:
         return best, costs
 
 
+class Plan:
+    """A searched placement: mesh factorization + per-param specs + ZeRO
+    flag, with its estimated step cost (reference planner.py output —
+    the dist_context the Engine parallelizes with)."""
+
+    def __init__(self, mesh, param_specs, zero, cost, per_device_bytes):
+        self.mesh = mesh                    # {"dp": d, "mp": m}
+        self.param_specs = param_specs      # {param_name: PartitionSpec}
+        self.zero = zero                    # None | "os_g"
+        self.cost = cost                    # est. seconds / step
+        self.per_device_bytes = per_device_bytes
+
+    def __repr__(self):
+        return (f"Plan(mesh={self.mesh}, zero={self.zero}, "
+                f"cost={self.cost:.3e}s, "
+                f"mem={self.per_device_bytes/1e9:.2f}GB, "
+                f"{len(self.param_specs)} sharded params)")
+
+
+class Planner:
+    """Search-based placement planner (reference:
+    auto_parallel/planner.py:1 PlanSpace — enumerate per-op dist attrs —
+    and auto_parallel/tuner/ profile-or-cost-guided selection).
+
+    Two nested searches, both exact:
+      * outer: enumerate (dp, mp) factorizations of the device count,
+        with and without ZeRO os_g;
+      * inner: per-layer sharding choices composed by dynamic
+        programming over the ACTIVATION sharding state. A Linear may be
+        column-parallel (activation leaves mp-sharded), row-parallel
+        (consumes an mp-sharded activation, one psum), or replicated
+        (duplicated compute on every mp rank); an Embedding may be
+        vocab-sharded (one psum) or replicated. Transition costs charge
+        the all-gather needed when a choice wants a different input
+        layout than the state carries — exactly the reshard the
+        reference Resharder would insert. The DP is Viterbi over the
+        2-state activation layout, so the per-layer search is exact,
+        not greedy.
+    Feasibility: candidates whose per-device bytes exceed hbm_capacity
+    are discarded — how a vocab-sharded embedding or ZeRO wins even
+    when slower on paper."""
+
+    def __init__(self, cost_model=None, axis="mp"):
+        self.cm = cost_model or CostModel()
+        self.axis = axis
+
+    # ---- model walk -----------------------------------------------------
+    def _layer_list(self, model):
+        named = {id(p): n for n, p in model.named_parameters()}
+        out = []
+        for layer in model.sublayers(include_self=True):
+            kind = type(layer).__name__
+            w = getattr(layer, "weight", None)
+            if w is None or getattr(w, "_value", None) is None \
+                    or w._value.ndim != 2:
+                continue
+            if kind not in ("Linear", "Embedding"):
+                continue
+            b = getattr(layer, "bias", None)
+            out.append({
+                "kind": kind,
+                "shape": tuple(int(s) for s in w._value.shape),
+                "w_name": named.get(id(w)),
+                "b_name": named.get(id(b)) if b is not None and
+                getattr(b, "_value", None) is not None else None,
+            })
+        return out
+
+    def _other_param_units(self, model, layers):
+        seen = {l["w_name"] for l in layers} | {
+            l["b_name"] for l in layers if l["b_name"]}
+        total = 0
+        for n, p in model.named_parameters():
+            if n not in seen:
+                total += int(np.prod(p._value.shape))
+        return total
+
+    # ---- inner DP -------------------------------------------------------
+    def _search_layers(self, layers, dp, mp, B):
+        """Viterbi over activation layout state ∈ {None, axis}, keeping
+        a PARETO FRONTIER of (cost, memory) per state — a purely
+        cost-greedy search would never surface the memory-cheaper
+        choices (vocab-sharded embedding) the outer feasibility filter
+        needs. Returns a list of (cost_seconds_excluding_dp_grads,
+        specs, per_device_param_UNITS) candidates."""
+        c = self.cm.cluster
+        ax = self.axis
+
+        def gather_cost(units):
+            # all-gather of a [B-shard, width] activation over mp
+            return (units * self.cm.cbytes * (mp - 1) / mp
+                    / c.ici_bandwidth + c.collective_latency)
+
+        MAX_FRONT = 32
+
+        def prune(cands):
+            """Drop (cost, specs, mem) entries dominated on both axes."""
+            cands = sorted(cands, key=lambda t: (t[0], t[2]))
+            out = []
+            best_mem = float("inf")
+            for c in cands:
+                if c[2] < best_mem - 1e-9:
+                    out.append(c)
+                    best_mem = c[2]
+            return out[:MAX_FRONT]
+
+        # state -> [(cost, specs_dict, per_device_units), ...] frontier
+        states = {None: [(0.0, {}, 0)]}
+        for l in layers:
+            din, dout = l["shape"]
+            act_in = (B / dp) * din
+            act_out = (B / dp) * dout
+            w_units = din * dout
+            nxt = {}
+
+            def consider(state, cost, specs, mem):
+                nxt.setdefault(state, []).append((cost, specs, mem))
+
+            for state, frontier in states.items():
+              for cost, specs, mem in frontier:
+                  flops = 6.0 * (B / dp) * w_units * dp  # per-step global
+                  comp_rep = flops / dp / c.peak_flops   # duplicated on mp
+                  comp_shard = flops / (dp * mp) / c.peak_flops
+                  if l["kind"] == "Embedding":
+                      # lookup FLOPs are negligible; choices differ in
+                      # memory and the psum after a sharded gather
+                      base = cost + (gather_cost(act_in) if state else 0)
+                      consider(None, base, specs, mem + w_units)  # repl.
+                      if mp > 1 and din % mp == 0:  # vocab must split
+                          sh = dict(specs)
+                          sh[l["w_name"]] = P(ax, None)
+                          # masked-gather psum (fwd) + scatter (bwd)
+                          comm = 2 * (act_out * self.cm.cbytes
+                                      * (mp - 1) / mp / c.ici_bandwidth
+                                      + c.collective_latency)
+                          consider(None, base + comm, sh,
+                                   mem + w_units / mp)
+                      continue
+                  # Linear — replicated weight (needs replicated input)
+                  base = cost + (gather_cost(act_in) if state else 0)
+                  consider(None, base + comp_rep, specs, mem + w_units)
+                  if mp > 1 and dout % mp == 0:
+                      # column-parallel: replicated in, sharded out
+                      sh = dict(specs)
+                      sh[l["w_name"]] = P(None, ax)
+                      if l["b_name"]:
+                          sh[l["b_name"]] = P(ax)
+                      consider(ax, base + comp_shard, sh,
+                               mem + w_units / mp)
+                  if mp > 1 and din % mp == 0 and state == ax:
+                      # row-parallel: consumes the sharded activation,
+                      # one psum fwd + one bwd
+                      sh = dict(specs)
+                      sh[l["w_name"]] = P(ax, None)
+                      comm = 2 * (act_out * self.cm.cbytes
+                                  * (mp - 1) / mp / c.ici_bandwidth
+                                  + c.collective_latency)
+                      consider(None, cost + comp_shard + comm, sh,
+                               mem + w_units / mp)
+            states = {st: prune(cands) for st, cands in nxt.items()}
+        # the loss wants a replicated activation: close sharded states
+        finals = []
+        for state, frontier in states.items():
+            for cost, specs, mem in frontier:
+                if state is not None:
+                    last_dout = layers[-1]["shape"][1]
+                    cost = cost + gather_cost((B / dp) * last_dout)
+                finals.append((cost, specs, mem))
+        return prune(finals)
+
+    # ---- outer search ---------------------------------------------------
+    def plan(self, model, batch_size, n_devices=None, tokens_per_sample=1,
+             hbm_capacity=None, verbose=False, force_mesh=None,
+             allow_zero=True):
+        """`force_mesh={"dp": d, "mp": m}` restricts the outer search to
+        one factorization (an already-initialized global mesh) while the
+        per-layer DP still searches freely; pass allow_zero=False when
+        the live mesh has no usable 'sharding' axis."""
+        n = n_devices or len(jax.devices())
+        cap = hbm_capacity if hbm_capacity is not None else \
+            self.cm.cluster.hbm_capacity
+        layers = self._layer_list(model)
+        if not layers:
+            return Plan({"dp": n, "mp": 1}, {}, None, 0.0, 0)
+        other_units = self._other_param_units(model, layers)
+        B = batch_size * tokens_per_sample
+        c = self.cm.cluster
+        best = None
+        scoreboard = {}
+        if force_mesh is not None:
+            pairs = [(force_mesh.get("dp", 1), force_mesh.get("mp", 1))]
+        else:
+            # dp must divide the per-step batch or the compiled step's
+            # batch sharding fails at the first fit() call
+            pairs = [(n // m, m) for m in (1, 2, 4, 8)
+                     if n % m == 0 and m <= n
+                     and batch_size % (n // m) == 0]
+            if not pairs:
+                pairs = [(1, n)] if n in (1, 2, 4, 8) else [(1, 1)]
+        cb, gb, ob = self.cm.cbytes, self.cm.gbytes, 8.0
+        for dp, mp in pairs:
+            for ci, (cost0, specs, units0) in enumerate(
+                    self._search_layers(layers, dp, mp, B)):
+                if mp > 1 and not specs and force_mesh is None:
+                    # degenerate: an mp axis nothing is sharded over is
+                    # pure replication — identical work to (dp, 1) on
+                    # fewer effective devices; never a distinct plan
+                    # (kept when the user pinned the mesh)
+                    continue
+                units = units0 + other_units
+                cost = cost0
+                # dp gradient all-reduce (sharded params reduce slices)
+                if dp > 1:
+                    cost += (2.0 * units * gb * (dp - 1) / dp
+                             / c.ici_bandwidth + c.collective_latency)
+                for zero in ((False, True) if dp > 1 and allow_zero
+                             else (False,)):
+                    # ZeRO os_g (stage 2): grads + optimizer state
+                    # shard over dp; PARAMS stay replicated (stage 3
+                    # shards those) — don't overstate the saving
+                    mem_z = (units * (cb + (gb + ob) / dp) if zero
+                             else units * (cb + gb + ob))
+                    cost_z = cost
+                    if zero:  # reduce-scatter/gather traffic premium
+                        cost_z += (units * cb * (dp - 1) / dp
+                                   / c.ici_bandwidth
+                                   + c.collective_latency)
+                    name = (f"dp{dp}_mp{mp}"
+                            + (f"_c{ci}" if ci else "")
+                            + ("_zero" if zero else ""))
+                    scoreboard[name] = (cost_z, mem_z)
+                    if mem_z > cap:
+                        continue
+                    if best is None or cost_z < best[0]:
+                        # ZeRO lives on the 'sharding' mesh axis (the
+                        # batch rides ('dp','sharding') jointly), so a
+                        # zero plan puts its dp degree THERE — otherwise
+                        # stage-2 on a sharding=1 axis is a silent no-op
+                        mesh = ({"dp": 1, "sharding": dp, "mp": mp}
+                                if zero else {"dp": dp, "mp": mp})
+                        best = (cost_z, mem_z, mesh,
+                                specs, "os_g" if zero else None)
+        if best is None:
+            raise RuntimeError(
+                f"no placement fits hbm_capacity={cap:.2e} bytes/device "
+                f"(candidates: { {k: f'{v[1]:.2e}B' for k, v in scoreboard.items()} })")
+        if verbose:
+            for k, (cst, m) in sorted(scoreboard.items(),
+                                      key=lambda kv: kv[1][0]):
+                print(f"[planner] {k}: {cst:.3e}s {m/1e9:.2f}GB")
+        cost_z, mem_z, mesh, specs, zero = best
+        return Plan(mesh, specs, zero, cost_z, mem_z)
+
+    def apply(self, plan, model):
+        """Stamp the plan's specs onto the model's parameters (the
+        Engine then builds its step from them, exactly as for manual
+        shard_tensor annotations)."""
+        for name, p in model.named_parameters():
+            spec = plan.param_specs.get(name)
+            if spec is not None:
+                shard_tensor(p, shard_spec=list(spec))
+        return model
+
+
 class Strategy:
     """Parallelization knobs (reference auto_parallel/strategy.py)."""
 
@@ -373,12 +637,35 @@ class Engine:
         self.metrics = metrics or []
         self.strategy = strategy or Strategy()
         self._step = None
+        self.plan = None  # populated by auto_mode="full" (Planner)
 
-    def _build(self):
+    def _build(self, batch_size=1):
         if self._step is not None:
             return
         st = self.strategy
-        if st.tensor_parallel.enable:
+        if st.auto_mode == "full":
+            # fully-automatic: search per-layer shardings with the
+            # cost-model planner (reference planner_v2 full-auto mode)
+            planner = Planner()
+            force = None
+            allow_zero = True
+            if mesh_mod.has_mesh():
+                m = mesh_mod.global_mesh()
+                force = {"dp": m.shape["dp"] * m.shape["sharding"],
+                         "mp": m.shape["mp"]}
+                # ZeRO lives on the 'sharding' axis: on a live mesh
+                # without one, a zero plan would be a silent no-op
+                allow_zero = m.shape["sharding"] > 1
+            self.plan = planner.plan(self.model, batch_size,
+                                     force_mesh=force,
+                                     allow_zero=allow_zero)
+            if not mesh_mod.has_mesh():
+                mesh_mod.init_mesh(**self.plan.mesh)
+            planner.apply(self.plan, self.model)
+            if self.plan.zero and not st.sharding.enable:
+                st.sharding.enable = True
+                st.sharding.stage = 2
+        elif st.tensor_parallel.enable:
             plan_tp(self.model)
         # propagate the user's partial shard_tensor annotations
         # (reference Completer — runs in every mode)
@@ -408,7 +695,7 @@ class Engine:
         """train_data: Dataset or DataLoader."""
         from ..io import DataLoader, Dataset
 
-        self._build()
+        self._build(batch_size=batch_size)
         loader = (train_data if not isinstance(train_data, Dataset)
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=True, drop_last=True))

@@ -192,8 +192,13 @@ class DistributedTrainStep:
         if self.batch_specs is not None:
             b_sh = [NamedSharding(mesh, s) for s in self.batch_specs]
         else:
+            # batch rides BOTH data-parallel axes: in real ZeRO the
+            # sharding world IS a data-parallel world (each 'sharding'
+            # rank sees different data and owns a slice of grads/opt
+            # state) — with sharding=1 this reduces to plain P('dp')
             b_sh = [
-                NamedSharding(mesh, P(*(["dp"] + [None] * (np.ndim(v) - 1))))
+                NamedSharding(mesh, P(*([("dp", "sharding")]
+                                        + [None] * (np.ndim(v) - 1))))
                 for v in batch_vals
             ]
         self._opt_states = jax.device_put(states, s_sh)
