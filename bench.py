@@ -511,6 +511,55 @@ def bench_generate():
             "batch": batch}
 
 
+def bench_serving():
+    """Dynamic-batching inference server requests/s (the serving-side
+    metric for the analysis_predictor/serving analog): concurrent
+    clients submit single ResNet-ish MLP requests; the server buckets,
+    pads, and runs one compiled program per bucket."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(256, 1024), nn.ReLU(),
+                          nn.Linear(1024, 1024), nn.ReLU(),
+                          nn.Linear(1024, 64))
+    model.eval()
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((512, 256)).astype(np.float32)
+    server = inference.InferenceServer(
+        model, inference.BatchingConfig(max_batch_size=64,
+                                        max_delay_ms=2.0))
+    n_clients, per_client = 8, 64
+
+    def client(k, out):
+        futs = [server.submit(xs[(k * per_client + i) % 512])
+                for i in range(per_client)]
+        out.extend(f.result(timeout=120) for f in futs)
+
+    with server:
+        server.infer(xs[0])  # warm bucket 1; others compile on first hit
+        t0 = time.perf_counter()
+        threads, sink = [], []
+        for k in range(n_clients):
+            out = []
+            sink.append(out)
+            threads.append(threading.Thread(target=client, args=(k, out)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    total = n_clients * per_client
+    rps = total / dt
+    log(f"[bench] serving: {total} requests in {dt:.2f}s = {rps:,.0f} "
+        f"req/s, mean batch {server.mean_batch_size:.1f}")
+    return {"model": "mlp-serving", "requests_per_sec": round(rps),
+            "mean_batch_size": round(server.mean_batch_size, 1)}
+
+
 def bench_probe():
     """No-op body: `_worker_bootstrap` already proved the backend is up."""
     return {"probe": "ok"}
@@ -519,7 +568,8 @@ def bench_probe():
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
             "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
-            "gpt1p3b_pp": bench_gpt1p3b_pp, "probe": bench_probe}
+            "gpt1p3b_pp": bench_gpt1p3b_pp, "serving": bench_serving,
+            "probe": bench_probe}
 
 
 def worker_main(which):
@@ -640,7 +690,8 @@ def main():
     # the headline failed, the backend is down: don't burn more window.
     if gpt is None:
         return
-    for which in ("resnet", "bert", "deepfm", "mnist", "generate"):
+    for which in ("resnet", "bert", "deepfm", "mnist", "generate",
+                  "serving"):
         status, res = _run_worker(which, timeout_s=420)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")

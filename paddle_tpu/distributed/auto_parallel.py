@@ -534,13 +534,23 @@ class Planner:
         if force_mesh is not None:
             pairs = [(force_mesh.get("dp", 1), force_mesh.get("mp", 1))]
         else:
-            # dp must divide the per-step batch or the compiled step's
-            # batch sharding fails at the first fit() call
-            pairs = [(n // m, m) for m in (1, 2, 4, 8)
-                     if n % m == 0 and m <= n
-                     and batch_size % (n // m) == 0]
+            # mp candidates: every power of two dividing the device
+            # count. dp must divide the per-step batch or the compiled
+            # step's batch sharding fails at the first fit() call.
+            mp_opts = []
+            m = 1
+            while m <= n:
+                if n % m == 0:
+                    mp_opts.append(m)
+                m *= 2
+            pairs = [(n // m, m) for m in mp_opts
+                     if batch_size % (n // m) == 0]
             if not pairs:
-                pairs = [(1, n)] if n in (1, 2, 4, 8) else [(1, 1)]
+                raise RuntimeError(
+                    f"no (dp, mp) factorization of {n} devices has dp "
+                    f"dividing batch_size={batch_size}; choose a batch "
+                    f"size divisible by one of "
+                    f"{sorted(n // m for m in mp_opts)}")
         cb, gb, ob = self.cm.cbytes, self.cm.gbytes, 8.0
         for dp, mp in pairs:
             for ci, (cost0, specs, units0) in enumerate(
@@ -557,16 +567,22 @@ class Planner:
                 if dp > 1:
                     cost += (2.0 * units * gb * (dp - 1) / dp
                              / c.ici_bandwidth + c.collective_latency)
-                for zero in ((False, True) if dp > 1 and allow_zero
-                             else (False,)):
+                # the degree ZeRO actually shards over: the planned dp
+                # (it moves to the 'sharding' axis), or — under a LIVE
+                # forced mesh — that mesh's existing sharding axis
+                zdeg = dp
+                if force_mesh is not None:
+                    zdeg = force_mesh.get("sharding", 1)
+                for zero in ((False, True)
+                             if zdeg > 1 and allow_zero else (False,)):
                     # ZeRO os_g (stage 2): grads + optimizer state
-                    # shard over dp; PARAMS stay replicated (stage 3
+                    # shard over zdeg; PARAMS stay replicated (stage 3
                     # shards those) — don't overstate the saving
-                    mem_z = (units * (cb + (gb + ob) / dp) if zero
+                    mem_z = (units * (cb + (gb + ob) / zdeg) if zero
                              else units * (cb + gb + ob))
                     cost_z = cost
                     if zero:  # reduce-scatter/gather traffic premium
-                        cost_z += (units * cb * (dp - 1) / dp
+                        cost_z += (units * cb * (zdeg - 1) / zdeg
                                    / c.ici_bandwidth
                                    + c.collective_latency)
                     name = (f"dp{dp}_mp{mp}"
@@ -579,9 +595,19 @@ class Planner:
                         # ZeRO lives on the 'sharding' mesh axis (the
                         # batch rides ('dp','sharding') jointly), so a
                         # zero plan puts its dp degree THERE — otherwise
-                        # stage-2 on a sharding=1 axis is a silent no-op
-                        mesh = ({"dp": 1, "sharding": dp, "mp": mp}
-                                if zero else {"dp": dp, "mp": mp})
+                        # stage-2 on a sharding=1 axis is a silent
+                        # no-op. Under a forced (live) mesh the plan
+                        # reports that mesh unchanged.
+                        if force_mesh is not None:
+                            # report the LIVE mesh (dp here is the
+                            # combined dp·sharding data-parallel degree)
+                            sh_live = force_mesh.get("sharding", 1)
+                            mesh = {"dp": dp // sh_live,
+                                    "sharding": sh_live, "mp": mp}
+                        elif zero:
+                            mesh = {"dp": 1, "sharding": dp, "mp": mp}
+                        else:
+                            mesh = {"dp": dp, "mp": mp}
                         best = (cost_z, mem_z, mesh,
                                 specs, "os_g" if zero else None)
         if best is None:
@@ -652,7 +678,8 @@ class Engine:
             if mesh_mod.has_mesh():
                 m = mesh_mod.global_mesh()
                 force = {"dp": m.shape["dp"] * m.shape["sharding"],
-                         "mp": m.shape["mp"]}
+                         "mp": m.shape["mp"],
+                         "sharding": m.shape["sharding"]}
                 # ZeRO lives on the 'sharding' axis: on a live mesh
                 # without one, a zero plan would be a silent no-op
                 allow_zero = m.shape["sharding"] > 1

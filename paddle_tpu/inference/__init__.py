@@ -24,8 +24,10 @@ __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorPool",
     "InferTensor", "DataType", "PlaceType", "PrecisionType",
     "get_version", "get_num_bytes_of_data_type",
-    "convert_to_mixed_precision",
+    "convert_to_mixed_precision", "InferenceServer", "BatchingConfig",
 ]
+
+from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
 
 
 class DataType:

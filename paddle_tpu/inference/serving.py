@@ -1,0 +1,257 @@
+"""Batch-serving inference — the TPU-idiomatic serving analog.
+
+TPU-native counterpart of the reference serving surface
+(reference: paddle/fluid/inference/api/analysis_predictor.cc — the
+AnalysisPredictor the Paddle Serving server wraps; its zero-copy
+request path + the server's dynamic request batching). The reference
+optimizes a graph with IR passes and serves requests one
+predictor-thread at a time; on TPU the win is the opposite shape: ONE
+compiled program per PADDED BUCKET size, a dynamic batcher that groups
+concurrent single requests into a bucket-sized batch (big batches keep
+the MXU busy), and futures handing results back to the callers.
+
+    server = InferenceServer(model)           # nn.Layer (fp32 or the
+    with server:                              # int8 PTQ output), or a
+        fut = server.submit(x_single)         # loaded Predictor
+        y = fut.result()
+        y2 = server.infer(x2)                 # submit + wait
+
+Requests are SINGLE examples (no batch dim); the batcher stacks up to
+`max_batch_size` of them (waiting at most `max_delay_ms` for
+stragglers), pads the stack to the next configured bucket — one XLA
+executable per bucket, not per observed batch size — runs one device
+step, and scatters the rows back to the per-request futures. `stats`
+reports requests/batches served and the mean occupancy.
+"""
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BatchingConfig", "InferenceServer"]
+
+
+class BatchingConfig:
+    """Dynamic-batching policy: requests queue until `max_batch_size`
+    are waiting or the oldest has waited `max_delay_ms`; the batch is
+    padded up to the smallest bucket that fits (buckets default to
+    powers of two up to max_batch_size — each bucket is one compiled
+    executable, so shape churn never recompiles)."""
+
+    def __init__(self, max_batch_size=32, max_delay_ms=2.0, buckets=None):
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_ms = float(max_delay_ms)
+        if buckets is None:
+            buckets = []
+            b = 1
+            while b < self.max_batch_size:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_batch_size)
+        self.buckets = sorted(set(int(b) for b in buckets))
+        if self.buckets[-1] < self.max_batch_size:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch_size "
+                f"{self.max_batch_size}")
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+
+def _layer_runner(layer):
+    """(jitted_fn, param_vals) for an nn.Layer — one pure jax callable,
+    jit-cached per input shape bucket."""
+    from ..jit import _resolve_forward
+
+    pure_fn, _names, param_vals = _resolve_forward(layer, None)
+    jfn = jax.jit(pure_fn)
+
+    def run(arrs):
+        out = jfn(param_vals, *arrs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return [np.asarray(o.astype(jnp.float32)
+                           if o.dtype == jnp.bfloat16 else o)
+                for o in outs]
+
+    return run
+
+
+def _predictor_runner(predictor):
+    """Serve through a loaded Predictor artifact. Exported StableHLO is
+    shape-specialized: the ONLY legal bucket is the exported batch size,
+    so the server pads every batch to it."""
+    fixed = None
+    layer = predictor._layer
+    avals = getattr(getattr(layer, "_exported", None), "in_avals", None)
+    if avals is not None:
+        n_params = len(layer._param_vals)
+        input_avals = avals[n_params:]
+        if input_avals:
+            fixed = int(input_avals[0].shape[0])
+
+    def run(arrs):
+        return predictor.run(list(arrs))
+
+    return run, fixed
+
+
+class InferenceServer:
+    """Dynamic-batching server over a model or Predictor (see module
+    docstring). Thread-safe `submit`/`infer` from any number of client
+    threads; one background batcher thread owns the device."""
+
+    def __init__(self, source, batching=None):
+        # private copy: a Predictor source rewrites the bucket list, and
+        # a caller-shared config must not be mutated under another server
+        src_cfg = batching or BatchingConfig()
+        self.batching = BatchingConfig(
+            max_batch_size=src_cfg.max_batch_size,
+            max_delay_ms=src_cfg.max_delay_ms,
+            buckets=list(src_cfg.buckets))
+        self._fixed_bucket = None
+        from ..nn import Layer
+
+        if isinstance(source, Layer):
+            source.eval()
+            self._run = _layer_runner(source)
+        elif hasattr(source, "_layer") and hasattr(source, "run"):
+            self._run, self._fixed_bucket = _predictor_runner(source)
+            if self._fixed_bucket is not None:
+                self.batching.buckets = [self._fixed_bucket]
+                self.batching.max_batch_size = min(
+                    self.batching.max_batch_size, self._fixed_bucket)
+        elif callable(source):
+            self._run = lambda arrs: [
+                np.asarray(o) for o in (
+                    lambda out: out if isinstance(out, (list, tuple))
+                    else (out,))(source(*arrs))]
+        else:
+            raise TypeError(
+                f"InferenceServer source must be an nn.Layer, a "
+                f"Predictor, or a callable; got {type(source)!r}")
+        self._q = queue.Queue()
+        self._thread = None
+        self._running = False
+        self._state_lock = threading.Lock()
+        self.stats = {"requests": 0, "batches": 0, "rows_padded": 0}
+
+    # -- lifecycle --
+    def start(self):
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="infer-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._state_lock:
+            self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client API --
+    def submit(self, *example):
+        """Enqueue ONE example (arrays without the batch dim). Returns a
+        Future resolving to the list of output rows for this example."""
+        fut = Future()
+        payload = (tuple(np.asarray(x) for x in example), fut)
+        # check+put under the lock: a put racing stop() would otherwise
+        # land in a queue the batcher has already drained, leaving the
+        # future unresolved forever
+        with self._state_lock:
+            if not self._running:
+                raise RuntimeError(
+                    "server not started (use `with server:`)")
+            self._q.put(payload)
+        return fut
+
+    def infer(self, *example):
+        return self.submit(*example).result()
+
+    @property
+    def mean_batch_size(self):
+        b = self.stats["batches"]
+        return self.stats["requests"] / b if b else 0.0
+
+    # -- batcher --
+    def _collect(self):
+        """Block for the first request, then sweep stragglers until the
+        delay window closes or the batch is full."""
+        try:
+            first = self._q.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + self.batching.max_delay_ms / 1e3
+        while len(batch) < self.batching.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    @staticmethod
+    def _sig(example):
+        return tuple((a.shape, str(a.dtype)) for a in example)
+
+    def _loop(self):
+        while self._running or not self._q.empty():
+            collected = self._collect()
+            if not collected:
+                continue
+            # group by input signature: requests with different shapes
+            # (or one malformed request) must neither stack together
+            # nor poison each other's futures
+            groups = {}
+            for ex, f in collected:
+                groups.setdefault(self._sig(ex), []).append((ex, f))
+            for batch in groups.values():
+                try:
+                    self._run_batch(batch)
+                except Exception as e:  # defensive: never die silently
+                    for _, f in batch:
+                        if not f.done():
+                            f.set_exception(e)
+
+    def _run_batch(self, batch):
+        examples = [ex for ex, _ in batch]
+        futs = [f for _, f in batch]
+        n = len(batch)
+        bucket = self.batching.bucket_for(n)
+        try:
+            arrs = []
+            for pos in range(len(examples[0])):
+                rows = [ex[pos] for ex in examples]
+                rows += [rows[0]] * (bucket - n)  # pad w/ row 0
+                arrs.append(np.stack(rows))
+            outs = self._run(arrs)
+        except Exception as e:
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        self.stats["requests"] += n
+        self.stats["batches"] += 1
+        self.stats["rows_padded"] += bucket - n
+        for i, f in enumerate(futs):
+            f.set_result([o[i] for o in outs])

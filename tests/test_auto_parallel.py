@@ -347,5 +347,6 @@ def test_engine_full_auto_consumes_plan():
                       strategy=st)
     hist = eng.fit(_DS(), epochs=2, batch_size=16, steps_per_epoch=4)
     assert eng.plan is not None
-    assert eng.plan.mesh == {"dp": 4, "mp": 2}  # honors the live mesh
+    # honors the live mesh (reported with its sharding axis)
+    assert eng.plan.mesh == {"dp": 4, "sharding": 1, "mp": 2}
     assert hist[-1] < hist[0]

@@ -95,3 +95,112 @@ def test_convert_to_mixed_precision_roundtrip(tmp_path):
     (out,) = pred.run([x])
     ref = model(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)
+
+# --------------------------------------------------------------------
+# round-4: batch-serving surface (reference analysis_predictor.cc +
+# the serving server's dynamic request batching)
+# --------------------------------------------------------------------
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_serving_concurrent_correctness_and_batching():
+    import threading
+    import time
+
+    model = _mlp()
+    model.eval()
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((60, 8)).astype(np.float32)
+    with paddle.no_grad():
+        ref = model(paddle.to_tensor(xs)).numpy()
+
+    server = inference.InferenceServer(
+        model, inference.BatchingConfig(max_batch_size=16,
+                                        max_delay_ms=10.0))
+    results = {}
+    lock = threading.Lock()
+
+    def client(lo, hi):
+        futs = [(i, server.submit(xs[i])) for i in range(lo, hi)]
+        for i, f in futs:
+            out = f.result(timeout=60)[0]
+            with lock:
+                results[i] = out
+
+    with server:
+        threads = [threading.Thread(target=client,
+                                    args=(k * 20, (k + 1) * 20))
+                   for k in range(3)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    for i in range(60):
+        np.testing.assert_allclose(results[i], ref[i], rtol=1e-5,
+                                   atol=1e-6)
+    # concurrent submits must actually have been batched
+    assert server.stats["requests"] == 60
+    assert server.mean_batch_size > 1.5, server.stats
+    assert dt > 0 and 60 / dt > 0  # requests/s well-defined
+
+
+def test_serving_int8_ptq_source():
+    from paddle_tpu.quantization import PostTrainingQuantization
+
+    model = _mlp()
+    rng = np.random.default_rng(1)
+    calib = [paddle.to_tensor(
+        rng.standard_normal((8, 8)).astype(np.float32))
+        for _ in range(4)]
+    ptq = PostTrainingQuantization(model).calibrate(calib)
+    qmodel = ptq.quantize()
+    x = rng.standard_normal((8,)).astype(np.float32)
+    with paddle.no_grad():
+        ref = qmodel(paddle.to_tensor(x[None])).numpy()[0]
+    with inference.InferenceServer(qmodel) as server:
+        out = server.infer(x)[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_serving_over_predictor_artifact(tmp_path):
+    # exported StableHLO is shape-specialized: the server must pad every
+    # batch to the exported batch size and still return per-request rows
+    model, path = _save_model(tmp_path)
+    cfg = inference.Config(path)
+    cfg.disable_gpu()
+    pred = inference.create_predictor(cfg)
+    rng = np.random.default_rng(2)
+    xs = rng.standard_normal((3, 8)).astype(np.float32)
+    with paddle.no_grad():
+        ref = model(paddle.to_tensor(xs)).numpy()
+    with inference.InferenceServer(pred) as server:
+        assert server.batching.buckets == [2]  # exported batch size
+        futs = [server.submit(xs[i]) for i in range(3)]
+        outs = [f.result(timeout=60)[0] for f in futs]
+    for i in range(3):
+        np.testing.assert_allclose(outs[i], ref[i], rtol=1e-5, atol=1e-6)
+
+
+def test_serving_error_propagates_to_future():
+    model = _mlp()
+    with inference.InferenceServer(model) as server:
+        bad = server.submit(np.zeros((3,), np.float32))  # wrong feature dim
+        ok = server.submit(np.zeros((8,), np.float32))
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            bad.result(timeout=60)
+        assert len(ok.result(timeout=60)[0]) == 4  # server stays alive
+
+
+def test_serving_requires_start():
+    import pytest as _pytest
+
+    server = inference.InferenceServer(_mlp())
+    with _pytest.raises(RuntimeError, match="not started"):
+        server.submit(np.zeros((8,), np.float32))
