@@ -723,6 +723,14 @@ def maybe_convert(fn):
     """Convert-with-fallback, weakly cached per function object."""
     if getattr(fn, "_not_to_static", False):
         return fn
+    if inspect.ismethod(fn):
+        # convert the underlying function, re-bind to the same instance
+        # (compiling the source yields an UNBOUND function — calling it
+        # in the bound method's place would drop `self`)
+        conv = maybe_convert(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
     # closures bake cell CONTENTS at conversion time — key per function
     # object, not per code object, so distinct closures convert apart
     key = (fn if getattr(fn, "__closure__", None)

@@ -58,10 +58,19 @@ class Program:
         self.random_seed = None
 
     def clone(self, for_test=False):
+        """Shallow-copy the stage list (reference Program.clone). The
+        compatibility envelope, pinned by tests/test_static_extras.py:
+        stages/placeholders/fetch_map are copied so later edits to
+        either program don't leak into the other; `for_test=True` does
+        NOT rewrite stages to strip dropout/BN-train ops the way the
+        reference does — train/eval state rides the LAYER objects the
+        stages close over, so switch with model.eval() before running a
+        test clone."""
         p = Program()
         p.placeholders = dict(self.placeholders)
         p.stages = list(self.stages)
         p.fetch_map = dict(self.fetch_map)
+        p.random_seed = self.random_seed
         return p
 
     def global_block(self):

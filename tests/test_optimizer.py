@@ -1,69 +1,154 @@
-"""Optimizer parity tests vs torch.optim (SURVEY.md §4 OpTest pattern)."""
+"""Optimizer parity tests vs NUMPY update-rule oracles (SURVEY.md §4
+OpTest numpy-reference pattern; reference op_test.py:309). torch, when
+present, runs as a SECOND live oracle — its absence no longer skips the
+tier (VERDICT r3 weak #8)."""
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
-torch = pytest.importorskip("torch")
+from oracle import HAVE_TORCH, torch
 
 
 def assert_close(a, b, tol=1e-5):
     np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
 
 
-def _pair_models():
+# ---- numpy reference optimizers (exact update rules) ----
+
+class NpSGD:
+    def __init__(self, lr):
+        self.lr = lr
+
+    def step(self, params, grads):
+        for p, g in zip(params, grads):
+            p -= self.lr * g
+
+
+class NpMomentum:
+    def __init__(self, lr, mu):
+        self.lr, self.mu = lr, mu
+        self.buf = None
+
+    def step(self, params, grads):
+        if self.buf is None:
+            self.buf = [np.zeros_like(p) for p in params]
+        for p, g, v in zip(params, grads, self.buf):
+            v[...] = self.mu * v + g
+            p -= self.lr * v
+
+
+class NpAdam:
+    def __init__(self, lr, b1=0.9, b2=0.999, eps=1e-8, wd=0.0):
+        self.lr, self.b1, self.b2, self.eps, self.wd = lr, b1, b2, eps, wd
+        self.m = self.v = None
+        self.t = 0
+
+    def step(self, params, grads):
+        if self.m is None:
+            self.m = [np.zeros_like(p) for p in params]
+            self.v = [np.zeros_like(p) for p in params]
+        self.t += 1
+        for p, g, m, v in zip(params, grads, self.m, self.v):
+            if self.wd:  # AdamW: decoupled decay before the step
+                p -= self.lr * self.wd * p
+            m[...] = self.b1 * m + (1 - self.b1) * g
+            v[...] = self.b2 * v + (1 - self.b2) * g * g
+            mh = m / (1 - self.b1 ** self.t)
+            vh = v / (1 - self.b2 ** self.t)
+            p -= self.lr * mh / (np.sqrt(vh) + self.eps)
+
+
+class NpAdagrad:
+    def __init__(self, lr, eps=1e-10):
+        self.lr, self.eps = lr, eps
+        self.acc = None
+
+    def step(self, params, grads):
+        if self.acc is None:
+            self.acc = [np.zeros_like(p) for p in params]
+        for p, g, a in zip(params, grads, self.acc):
+            a[...] = a + g * g
+            p -= self.lr * g / (np.sqrt(a) + self.eps)
+
+
+def _run_parity(popt_factory, np_opt, torch_opt_factory=None, steps=5):
+    """paddle Linear + mse vs a closed-form numpy replica of the same
+    forward/backward driven by the numpy optimizer; torch (if present)
+    runs alongside as the second oracle."""
+    rng = np.random.default_rng(77)
     pm = nn.Linear(6, 4)
-    tm = torch.nn.Linear(6, 4)
-    tm.weight.data = torch.tensor(pm.weight.numpy().T.copy())
-    tm.bias.data = torch.tensor(pm.bias.numpy())
-    return pm, tm
-
-
-def _run_pair(pm, tm, popt, topt, steps=5):
-    for i in range(steps):
-        x = np.random.randn(8, 6).astype("float32")
-        y = np.random.randn(8, 4).astype("float32")
+    popt = popt_factory(pm)
+    W = pm.weight.numpy().astype(np.float64)   # [in, out]
+    b = pm.bias.numpy().astype(np.float64)
+    if HAVE_TORCH and torch_opt_factory is not None:
+        tm = torch.nn.Linear(6, 4)
+        tm.weight.data = torch.tensor(pm.weight.numpy().T.copy())
+        tm.bias.data = torch.tensor(pm.bias.numpy())
+        topt = torch_opt_factory(tm)
+    else:
+        tm = topt = None
+    for _ in range(steps):
+        x = rng.standard_normal((8, 6)).astype("float32")
+        y = rng.standard_normal((8, 4)).astype("float32")
         loss_p = nn.functional.mse_loss(pm(paddle.to_tensor(x)),
                                         paddle.to_tensor(y))
         loss_p.backward()
         popt.step()
         popt.clear_grad()
 
-        topt.zero_grad()
-        loss_t = torch.nn.functional.mse_loss(tm(torch.tensor(x)),
-                                              torch.tensor(y))
-        loss_t.backward()
-        topt.step()
-    assert_close(pm.weight.numpy(), tm.weight.detach().numpy().T, 2e-4)
-    assert_close(pm.bias.numpy(), tm.bias.detach().numpy(), 2e-4)
+        # numpy oracle: d mean((xW+b-y)^2) — exact gradients
+        out = x.astype(np.float64) @ W + b
+        dout = 2.0 * (out - y) / out.size
+        gW = x.astype(np.float64).T @ dout
+        gb = dout.sum(0)
+        np_opt.step([W, b], [gW, gb])
+
+        if tm is not None:
+            topt.zero_grad()
+            loss_t = torch.nn.functional.mse_loss(tm(torch.tensor(x)),
+                                                  torch.tensor(y))
+            loss_t.backward()
+            topt.step()
+    assert_close(pm.weight.numpy(), W, 2e-4)
+    assert_close(pm.bias.numpy(), b, 2e-4)
+    if tm is not None:
+        assert_close(pm.weight.numpy(), tm.weight.detach().numpy().T,
+                     2e-4)
+        assert_close(pm.bias.numpy(), tm.bias.detach().numpy(), 2e-4)
 
 
 class TestOptimizerParity:
     def test_sgd(self):
-        pm, tm = _pair_models()
-        _run_pair(pm, tm, paddle.optimizer.SGD(0.1, parameters=pm.parameters()),
-                  torch.optim.SGD(tm.parameters(), 0.1))
+        _run_parity(
+            lambda pm: paddle.optimizer.SGD(0.1,
+                                            parameters=pm.parameters()),
+            NpSGD(0.1),
+            lambda tm: torch.optim.SGD(tm.parameters(), 0.1))
 
     def test_momentum(self):
-        pm, tm = _pair_models()
-        _run_pair(pm, tm,
-                  paddle.optimizer.Momentum(0.1, 0.9,
-                                            parameters=pm.parameters()),
-                  torch.optim.SGD(tm.parameters(), 0.1, momentum=0.9))
+        _run_parity(
+            lambda pm: paddle.optimizer.Momentum(
+                0.1, 0.9, parameters=pm.parameters()),
+            NpMomentum(0.1, 0.9),
+            lambda tm: torch.optim.SGD(tm.parameters(), 0.1,
+                                       momentum=0.9))
 
     def test_adam(self):
-        pm, tm = _pair_models()
-        _run_pair(pm, tm,
-                  paddle.optimizer.Adam(0.01, parameters=pm.parameters()),
-                  torch.optim.Adam(tm.parameters(), 0.01))
+        _run_parity(
+            lambda pm: paddle.optimizer.Adam(
+                0.01, parameters=pm.parameters()),
+            NpAdam(0.01),
+            lambda tm: torch.optim.Adam(tm.parameters(), 0.01))
 
     def test_adamw(self):
-        pm, tm = _pair_models()
-        _run_pair(pm, tm,
-                  paddle.optimizer.AdamW(0.01, parameters=pm.parameters(),
-                                         weight_decay=0.1),
-                  torch.optim.AdamW(tm.parameters(), 0.01, weight_decay=0.1))
+        _run_parity(
+            lambda pm: paddle.optimizer.AdamW(
+                0.01, parameters=pm.parameters(), weight_decay=0.1),
+            NpAdam(0.01, wd=0.1),
+            lambda tm: torch.optim.AdamW(tm.parameters(), 0.01,
+                                         weight_decay=0.1))
 
     def test_rmsprop(self):
         # vs a numpy reimplementation of the reference formula
@@ -99,15 +184,15 @@ class TestOptimizerParity:
         assert_close(pm.bias.numpy(), b, 1e-5)
 
     def test_adagrad(self):
-        pm, tm = _pair_models()
-        _run_pair(pm, tm,
-                  paddle.optimizer.Adagrad(0.05, epsilon=1e-10,
-                                           parameters=pm.parameters()),
-                  torch.optim.Adagrad(tm.parameters(), 0.05),
-                  steps=3)
+        _run_parity(
+            lambda pm: paddle.optimizer.Adagrad(
+                0.05, epsilon=1e-10, parameters=pm.parameters()),
+            NpAdagrad(0.05, eps=1e-10),
+            lambda tm: torch.optim.Adagrad(tm.parameters(), 0.05),
+            steps=3)
 
     def test_adamax_runs(self):
-        pm, _ = _pair_models()
+        pm = nn.Linear(6, 4)
         opt = paddle.optimizer.Adamax(0.01, parameters=pm.parameters())
         x = paddle.randn([4, 6])
         pm(x).sum().backward()
@@ -116,7 +201,7 @@ class TestOptimizerParity:
         assert not np.allclose(pm.weight.numpy(), w0)
 
     def test_lamb_runs(self):
-        pm, _ = _pair_models()
+        pm = nn.Linear(6, 4)
         opt = paddle.optimizer.Lamb(0.01, parameters=pm.parameters())
         x = paddle.randn([4, 6])
         pm(x).sum().backward()
