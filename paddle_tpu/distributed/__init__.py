@@ -17,6 +17,7 @@ from .communication import stream  # noqa: F401
 from . import metric  # noqa: F401
 from . import env  # noqa: F401
 from . import mesh  # noqa: F401
+from . import graph_table  # noqa: F401
 from . import moe  # noqa: F401
 from . import ps  # noqa: F401
 from . import sequence_parallel  # noqa: F401

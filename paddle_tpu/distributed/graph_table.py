@@ -1,0 +1,352 @@
+"""Distributed graph store with neighbor/walk sampling — the GNN
+data-engine analog.
+
+TPU-native re-design of the reference graph-PS
+(reference: paddle/fluid/distributed/ps/table/common_graph_table.h:476
+GraphTable — shard-partitioned adjacency with `random_sample_neighbors`
+:515, `random_sample_nodes`:523, `get_node_feat`:620, `pull_graph_list`
+:506, weighted samplers built per shard; served over brpc to trainers).
+Graph storage stays on the HOST (adjacency is pointer-chasing work the
+MXU can't help with); sampling is vectorized numpy over CSR, and the
+multi-process table routes id-keyed requests PEER-TO-PEER over the
+jax.distributed KV — the same transport spine as ShardedSparseTable.
+The sampled neighborhoods (padded [n, k] int arrays) then feed the
+on-device message-passing ops in `paddle_tpu.geometric`.
+
+    t = GraphTable()
+    t.add_edges(src, dst, weights=None)
+    t.set_node_feat("feat", ids, values)
+    nbrs, counts = t.random_sample_neighbors(ids, k)      # padded [n,k]
+    walks = t.random_walk(start_ids, walk_len)            # [n, L+1]
+
+`ShardedGraphTable` shards nodes by `owner = id % world`; every rank
+holds its shard's out-edges and features, and sampling/walk steps route
+each id to its owner (walks re-route at every hop, as the reference's
+distributed walk engine does).
+"""
+import numpy as np
+
+import jax
+
+__all__ = ["GraphTable", "ShardedGraphTable"]
+
+
+def _walk(table, start_ids, walk_len):
+    """Shared walk schedule: one sampled hop per step; sinks stay put."""
+    cur = np.asarray(start_ids, np.int64).reshape(-1)
+    walks = [cur]
+    for _ in range(walk_len):
+        step, counts = table.random_sample_neighbors(cur, 1)
+        nxt = np.where(counts > 0, step[:, 0], cur)
+        walks.append(nxt)
+        cur = nxt
+    return np.stack(walks, axis=1)
+
+
+class GraphTable:
+    """Single-process graph shard (reference common_graph_table.h:476;
+    GraphShard:54's bucket layout collapses into one CSR here — the
+    bucketing existed for C++ lock striping the numpy store does not
+    need)."""
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+        self._src = []
+        self._dst = []
+        self._w = []
+        self._weighted = False
+        self._csr = None      # (ids_sorted, indptr, nbrs, weights)
+        self._feats = {}      # name -> {id: np row}
+
+    # -- construction --
+    def add_edges(self, src, dst, weights=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if len(src) != len(dst):
+            raise ValueError("src/dst length mismatch")
+        self._src.append(src)
+        self._dst.append(dst)
+        if weights is not None:
+            if self._src[:-1] and not self._weighted:
+                raise ValueError(
+                    "mixing weighted and unweighted add_edges")
+            w = np.asarray(weights, np.float64).reshape(-1)
+            if len(w) != len(src):
+                raise ValueError("weights length mismatch")
+            self._w.append(w)
+            self._weighted = True
+        elif self._weighted:
+            raise ValueError("mixing weighted and unweighted add_edges")
+        self._csr = None
+        return self
+
+    def set_node_feat(self, name, ids, values):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        values = np.asarray(values)
+        table = self._feats.setdefault(name, {})
+        for i, v in zip(ids, values):
+            table[int(i)] = np.asarray(v)
+        return self
+
+    def _build(self):
+        if self._csr is not None:
+            return self._csr
+        if self._src:
+            src = np.concatenate(self._src)
+            dst = np.concatenate(self._dst)
+            w = np.concatenate(self._w) if self._weighted else None
+        else:
+            src = dst = np.zeros((0,), np.int64)
+            w = None
+        order = np.argsort(src, kind="stable")
+        src, dst = src[order], dst[order]
+        if w is not None:
+            w = w[order]
+        ids, starts = np.unique(src, return_index=True)
+        indptr = np.concatenate([starts, [len(src)]])
+        self._csr = (ids, indptr, dst, w)
+        return self._csr
+
+    # -- reference query surface --
+    def __len__(self):
+        return len(self._build()[0])
+
+    def pull_graph_list(self, start, size):
+        """Node-id enumeration window (reference pull_graph_list:506)."""
+        ids = self._build()[0]
+        return ids[start:start + size].copy()
+
+    def random_sample_nodes(self, n):
+        ids = self._build()[0]
+        if len(ids) == 0:
+            return np.zeros((0,), np.int64)
+        return self._rng.choice(ids, size=min(n, len(ids)), replace=False)
+
+    def get_node_feat(self, ids, feat_name, default=0.0):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        table = self._feats.get(feat_name, {})
+        rows = []
+        width = None
+        for i in ids:
+            v = table.get(int(i))
+            if v is not None:
+                width = np.shape(v)
+            rows.append(v)
+        if width is None:
+            width = ()
+        out = np.zeros((len(ids),) + tuple(width), np.float32) + default
+        for k, v in enumerate(rows):
+            if v is not None:
+                out[k] = v
+        return out
+
+    def degree(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        ids_s, indptr, _, _ = self._build()
+        if len(ids_s) == 0:
+            return np.zeros((len(ids),), np.int64)
+        pos = np.searchsorted(ids_s, ids)
+        pos_c = np.clip(pos, 0, len(ids_s) - 1)
+        hit = ids_s[pos_c] == ids
+        deg = np.where(hit, indptr[pos_c + 1] - indptr[pos_c], 0)
+        return deg.astype(np.int64)
+
+    def random_sample_neighbors(self, ids, sample_size, pad=-1):
+        """[n, sample_size] padded neighbor samples + true counts
+        (reference random_sample_neighbors:515: with replacement when
+        degree > sample_size? the reference samples WITHOUT replacement
+        per request via shuffle; matched here; weighted graphs sample
+        by edge weight WITH replacement, its weighted_sampler path)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        ids_s, indptr, nbrs, w = self._build()
+        out = np.full((len(ids), sample_size), pad, np.int64)
+        counts = np.zeros((len(ids),), np.int64)
+        if len(ids_s) == 0 or len(ids) == 0:
+            return out, counts
+        pos = np.searchsorted(ids_s, ids)
+        pos_c = np.clip(pos, 0, len(ids_s) - 1)
+        hit = ids_s[pos_c] == ids
+        lo = indptr[pos_c]
+        deg = np.where(hit, indptr[pos_c + 1] - lo, 0)
+        if w is None:
+            # fully vectorized uniform sampling without replacement:
+            # random keys per edge, lexsort within each request's
+            # segment, take the first k of every segment
+            rows = np.nonzero(deg > 0)[0]
+            if len(rows):
+                d = deg[rows]
+                total = int(d.sum())
+                flat = np.concatenate(
+                    [nbrs[lo[r]:lo[r] + deg[r]] for r in rows])
+                seg = np.repeat(np.arange(len(rows)), d)
+                order = np.lexsort((self._rng.random(total), seg))
+                flat = flat[order]
+                starts = np.concatenate([[0], np.cumsum(d)[:-1]])
+                take = starts[:, None] + np.arange(sample_size)[None]
+                valid = np.arange(sample_size)[None] < d[:, None]
+                picked = np.where(
+                    valid, flat[np.minimum(take, total - 1)], pad)
+                out[rows] = picked
+                counts[rows] = np.minimum(d, sample_size)
+            return out, counts
+        # weighted: per-row choice with replacement (reference
+        # weighted_sampler path; rare enough that the loop is fine)
+        for k in range(len(ids)):
+            if deg[k] == 0:
+                continue
+            sl = slice(lo[k], lo[k] + deg[k])
+            p = w[sl] / w[sl].sum()
+            out[k] = self._rng.choice(nbrs[sl], size=sample_size, p=p)
+            counts[k] = sample_size
+        return out, counts
+
+    def random_walk(self, start_ids, walk_len):
+        """[n, walk_len+1] uniform random walks; a walk that hits a
+        sink node stays there (self-loop padding, the deepwalk
+        convention)."""
+        return _walk(self, start_ids, walk_len)
+
+    # -- checkpoint --
+    def state_dict(self):
+        ids_s, indptr, nbrs, w = self._build()
+        sd = {"ids": ids_s, "indptr": indptr, "nbrs": nbrs}
+        if w is not None:
+            sd["weights"] = w
+        for name, table in self._feats.items():
+            fids = np.fromiter(table.keys(), np.int64, len(table))
+            sd[f"feat_{name}_ids"] = fids
+            sd[f"feat_{name}_vals"] = np.stack(
+                [table[int(i)] for i in fids]) if len(fids) else \
+                np.zeros((0,))
+        return sd
+
+    def set_state_dict(self, sd):
+        ids_s = np.asarray(sd["ids"], np.int64)
+        indptr = np.asarray(sd["indptr"], np.int64)
+        nbrs = np.asarray(sd["nbrs"], np.int64)
+        src = np.repeat(ids_s, np.diff(indptr))
+        self._src, self._dst = [src], [nbrs]
+        if "weights" in sd:
+            self._w = [np.asarray(sd["weights"], np.float64)]
+            self._weighted = True
+        else:
+            self._w, self._weighted = [], False
+        self._csr = None
+        self._feats = {}
+        for k in sd:
+            if k.startswith("feat_") and k.endswith("_ids"):
+                name = k[len("feat_"):-len("_ids")]
+                self.set_node_feat(name, sd[k], sd[f"feat_{name}_vals"])
+        return self
+
+
+class ShardedGraphTable:
+    """Multi-process graph store: node `i` (its out-edges + features)
+    lives on rank `i % world`; queries route ids point-to-point over the
+    jax.distributed KV like ShardedSparseTable (reference: GraphTable
+    shards served over brpc, ps/service/graph_brpc_client.h). All query
+    methods are COLLECTIVE — every rank must call them the same number
+    of times (SPMD trainers do).
+    """
+
+    _TAG_REQ, _TAG_RES = 171, 172
+
+    def __init__(self, seed=0, world=None, rank=None, timeout_ms=600_000):
+        from . import xproc
+
+        if world is None:
+            world = jax.process_count() if xproc.is_multiprocess() else 1
+        if rank is None:
+            rank = jax.process_index() if world > 1 else 0
+        self.world, self.rank = world, rank
+        self.timeout_ms = timeout_ms
+        self.local = GraphTable(seed=seed + rank)
+
+    def add_edges(self, src, dst, weights=None):
+        """Keep only the edges whose SOURCE this rank owns (callers
+        feed every rank the full edge list, or pre-route themselves)."""
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        sel = src % self.world == self.rank
+        w = None if weights is None else \
+            np.asarray(weights, np.float64).reshape(-1)[sel]
+        self.local.add_edges(src[sel], dst[sel], w)
+        return self
+
+    def set_node_feat(self, name, ids, values):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        sel = ids % self.world == self.rank
+        self.local.set_node_feat(name, ids[sel],
+                                 np.asarray(values)[sel])
+        return self
+
+    def _route(self, ids, serve):
+        """Route `ids` to owners, apply `serve(local_ids) -> array`
+        there, return results aligned with `ids`. serve's result rows
+        must align with its input ids."""
+        from . import xproc
+
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if self.world == 1:
+            return serve(ids)
+        owner = ids % self.world
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            xproc.send_np(ids[owner == r], r, self._TAG_REQ)
+        mine = serve(ids[owner == self.rank])
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            want = xproc.recv_np(r, self._TAG_REQ,
+                                 timeout_ms=self.timeout_ms)
+            xproc.send_np(np.asarray(serve(want)), r, self._TAG_RES)
+        parts = {self.rank: mine}
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            parts[r] = xproc.recv_np(r, self._TAG_RES,
+                                     timeout_ms=self.timeout_ms)
+        # trailing shape from the first NON-EMPTY part (an empty
+        # get_node_feat response is (0,), which must not narrow a
+        # (n, D) result); all-empty falls back to any part's shape so
+        # shape-carrying empties like (0, k+1) survive
+        plist = list(parts.values())
+        ref_p = next((p for p in plist if len(p)), plist[0])
+        out = np.zeros((len(ids),) + ref_p.shape[1:], ref_p.dtype)
+        for r, p in parts.items():
+            if len(p):
+                out[owner == r] = p
+        return out
+
+    def random_sample_neighbors(self, ids, sample_size, pad=-1):
+        def serve(want):
+            if not len(want):
+                return np.zeros((0, sample_size + 1), np.int64)
+            nb, ct = self.local.random_sample_neighbors(want, sample_size,
+                                                        pad)
+            return np.concatenate([nb, ct[:, None]], axis=1)
+
+        packed = self._route(ids, serve)
+        return packed[:, :sample_size], packed[:, sample_size]
+
+    def get_node_feat(self, ids, feat_name, default=0.0):
+        return self._route(
+            ids, lambda want: self.local.get_node_feat(
+                want, feat_name, default))
+
+    def degree(self, ids):
+        return self._route(ids, self.local.degree)
+
+    def random_walk(self, start_ids, walk_len):
+        """Distributed walk: every hop re-routes the frontier to the
+        owners of the current nodes (reference distributed walk
+        engine). Same schedule as GraphTable.random_walk — only the
+        sampler differs."""
+        return _walk(self, start_ids, walk_len)
+
+    def state_dict(self):
+        return self.local.state_dict()
+
+    def set_state_dict(self, sd):
+        self.local.set_state_dict(sd)

@@ -1,0 +1,51 @@
+"""Worker for the 2-process ShardedGraphTable test (test_graph_table.py).
+
+Builds the SAME deterministic graph on both ranks (each keeps its owned
+shard), then runs collective neighbor sampling / feature pulls / a
+distributed random walk and writes per-rank results; the test checks
+cross-rank agreement and validity against the full graph.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.graph_table import ShardedGraphTable  # noqa: E402
+
+
+def build_edges():
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 40, 300)
+    dst = rng.integers(0, 40, 300)
+    return src, dst
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    src, dst = build_edges()
+    t = ShardedGraphTable(seed=9)
+    t.add_edges(src, dst)
+    ids = np.arange(40)
+    t.set_node_feat("emb", ids, np.outer(ids, np.ones(3)))
+
+    nbrs, counts = t.random_sample_neighbors(np.arange(40), 5)
+    feats = t.get_node_feat(np.arange(40), "emb")
+    deg = t.degree(np.arange(40))
+    walks = t.random_walk(np.arange(0, 40, 4), walk_len=6)
+
+    with open(os.path.join(out_dir, f"graph_out_{rank}.json"), "w") as f:
+        json.dump({"rank": rank,
+                   "nbrs": nbrs.tolist(), "counts": counts.tolist(),
+                   "feats": feats.tolist(), "deg": deg.tolist(),
+                   "walks": walks.tolist()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Distributed graph store / walk sampling (reference:
+ps/table/common_graph_table.h GraphTable + graph_brpc service)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.graph_table import GraphTable, ShardedGraphTable
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy():
+    t = GraphTable(seed=3)
+    #   0 -> 1,2,3   1 -> 2   2 -> (none)   3 -> 0
+    t.add_edges([0, 0, 0, 1, 3], [1, 2, 3, 2, 0])
+    return t
+
+
+def test_build_degree_and_enumeration():
+    t = _toy()
+    assert len(t) == 3  # nodes WITH out-edges
+    np.testing.assert_array_equal(t.degree([0, 1, 2, 3, 99]),
+                                  [3, 1, 0, 1, 0])
+    assert set(t.pull_graph_list(0, 10).tolist()) == {0, 1, 3}
+    s = t.random_sample_nodes(2)
+    assert len(s) == 2 and set(s.tolist()) <= {0, 1, 3}
+
+
+def test_sample_neighbors_without_replacement():
+    t = _toy()
+    nbrs, counts = t.random_sample_neighbors([0, 2, 1], 2)
+    assert counts.tolist() == [2, 0, 1]
+    assert set(nbrs[0].tolist()) <= {1, 2, 3}
+    assert len(set(nbrs[0].tolist())) == 2  # no replacement
+    assert nbrs[1].tolist() == [-1, -1]     # isolated: all padding
+    assert nbrs[2].tolist()[0] == 2
+
+    # degree <= k: every neighbor returned
+    nb_all, ct = t.random_sample_neighbors([0], 8)
+    assert ct[0] == 3 and set(nb_all[0][:3].tolist()) == {1, 2, 3}
+
+
+def test_weighted_sampling_follows_weights():
+    t = GraphTable(seed=0)
+    t.add_edges([7, 7], [1, 2], weights=[0.99, 0.01])
+    nbrs, counts = t.random_sample_neighbors([7] * 200, 1)
+    frac1 = (nbrs[:, 0] == 1).mean()
+    assert frac1 > 0.9  # heavy edge dominates
+    assert counts.min() == 1
+
+
+def test_node_features_and_defaults():
+    t = _toy()
+    t.set_node_feat("emb", [0, 1], [[1.0, 2.0], [3.0, 4.0]])
+    f = t.get_node_feat([1, 0, 5], "emb")
+    np.testing.assert_allclose(f[:2], [[3, 4], [1, 2]])
+    np.testing.assert_allclose(f[2], [0, 0])  # missing -> default
+
+
+def test_random_walk_follows_edges_and_sinks_stay():
+    t = _toy()
+    walks = t.random_walk([0, 2], walk_len=4)
+    assert walks.shape == (2, 5)
+    # node 2 is a sink: walk stays put
+    assert walks[1].tolist() == [2] * 5
+    # every hop from a non-sink is a real edge (or a sink self-loop)
+    edges = {(0, 1), (0, 2), (0, 3), (1, 2), (3, 0)}
+    for a, b in zip(walks[0][:-1], walks[0][1:]):
+        assert (int(a), int(b)) in edges or (a == b and t.degree([a])[0]
+                                             == 0)
+
+
+def test_state_dict_roundtrip_with_weights_and_feats():
+    t = GraphTable(seed=1)
+    t.add_edges([0, 0, 4], [1, 2, 0], weights=[1.0, 2.0, 3.0])
+    t.set_node_feat("x", [0, 4], [[1.0], [2.0]])
+    t2 = GraphTable(seed=1).set_state_dict(t.state_dict())
+    np.testing.assert_array_equal(t2.degree([0, 4]), [2, 1])
+    np.testing.assert_allclose(t2.get_node_feat([4], "x"), [[2.0]])
+    nb, ct = t2.random_sample_neighbors([0], 2)
+    assert ct[0] == 2  # weighted path survived the roundtrip
+
+
+def test_sharded_world1_matches_local():
+    src = [0, 0, 1, 5]
+    dst = [1, 2, 3, 0]
+    sh = ShardedGraphTable(seed=3, world=1, rank=0)
+    sh.add_edges(src, dst)
+    np.testing.assert_array_equal(sh.degree([0, 1, 5, 9]), [2, 1, 1, 0])
+    walks = sh.random_walk([0], 3)
+    assert walks.shape == (1, 4)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_graph(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+         os.path.join(ROOT, "tests", "graph_worker.py"), str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    out = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"graph_out_{rank}.json") as f:
+            out[rank] = json.load(f)
+
+    # the full graph, for validity checks
+    from graph_worker import build_edges
+
+    src, dst = build_edges()
+    full = GraphTable()
+    full.add_edges(src, dst)
+    true_deg = full.degree(np.arange(40))
+
+    adj = {}
+    for s, d in zip(src, dst):
+        adj.setdefault(int(s), set()).add(int(d))
+
+    for rank in (0, 1):
+        o = out[rank]
+        # degrees routed across shards must equal the full graph's
+        np.testing.assert_array_equal(o["deg"], true_deg)
+        # features routed from both shards: row i == i * ones(3)
+        np.testing.assert_allclose(
+            o["feats"], np.outer(np.arange(40), np.ones(3)))
+        # every sampled neighbor is a REAL edge of the full graph
+        for i, row in enumerate(o["nbrs"]):
+            for v in row[:o["counts"][i]]:
+                assert v in adj.get(i, set()), (i, v)
+        # every walk hop is a real edge or a sink self-loop
+        for walk in o["walks"]:
+            for a, b in zip(walk[:-1], walk[1:]):
+                assert b in adj.get(a, set()) or (
+                    a == b and true_deg[a] == 0), (a, b)
