@@ -444,8 +444,16 @@ def bench_gpt1p3b_pp():
     log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp}")
 
     paddle.seed(0)
-    cfg = gpt_1p3b()
-    batch, seq, n_micro = 2 * max(dp, 1), 2048, 2
+    smoke = os.environ.get("BENCH_PP_SMOKE", "0") == "1"
+    if smoke:   # tiny-config machinery check, NOT a benchmark
+        from paddle_tpu.text.models.gpt import GPTConfig
+
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=128)
+        batch, seq, n_micro = 2 * max(dp, 1), 128, 2
+    else:
+        cfg = gpt_1p3b()
+        batch, seq, n_micro = 2 * max(dp, 1), 2048, 2
     model = PipelinedGPTForCausalLM(cfg, n_micro=n_micro, remat="layer")
     model = amp.decorate(model, level="O2", dtype="bfloat16")
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
@@ -471,7 +479,8 @@ def bench_gpt1p3b_pp():
     tps = batch * seq / dt
     log(f"[bench] gpt-1.3b-pp: {dt*1e3:.1f} ms/step, {tps:,.0f} tok/s, "
         f"mfu {mfu:.3f} (of {n}-chip peak)")
-    return {"model": "gpt-1.3b-hybrid-pipeline",
+    return {"model": ("gpt-tiny-hybrid-pipeline-SMOKE" if smoke
+                      else "gpt-1.3b-hybrid-pipeline"),
             "mesh": {"dp": dp, "pp": pp, "mp": mp},
             "ms_per_step": round(dt * 1e3, 2),
             "tokens_per_sec": round(tps), "mfu": round(mfu, 4)}
