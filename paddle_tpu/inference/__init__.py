@@ -77,6 +77,18 @@ class Config:
         self._device_id = 0
         self._precision = PrecisionType.Float32
         self._profile = False
+        self._compiler_options = {}
+
+    # -- XLA compile hooks (the analysis-pass-pipeline analog:
+    # reference analysis_predictor.cc registers IR passes per config;
+    # here the per-predictor optimization surface is XLA compiler
+    # option overrides applied at (re)compile) --
+    def set_xla_compile_option(self, key, value):
+        self._compiler_options[str(key)] = value
+        return self
+
+    def xla_compile_options(self):
+        return dict(self._compiler_options)
 
     # -- device selection --
     def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
@@ -154,6 +166,8 @@ class Predictor:
         self._outputs = {}
         self._device = self._pick_device()
         self._place_params()
+        if getattr(config, "_compiler_options", None):
+            self._layer.set_compiler_options(config._compiler_options)
 
     def _pick_device(self):
         devs = jax.devices()

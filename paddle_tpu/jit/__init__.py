@@ -343,19 +343,41 @@ def save(layer, path, input_spec=None, **configs):
 
 class TranslatedLayer:
     """Inference-only loaded program (reference: paddle.jit.load →
-    TranslatedLayer, C++ twin paddle/fluid/jit/layer.cc)."""
+    TranslatedLayer, C++ twin paddle/fluid/jit/layer.cc). Execution is
+    jitted ONCE per input signature (exported.call re-staged through a
+    cached executable, optionally AOT-compiled with XLA compiler
+    options — the TPU-native analog of the reference inference pass
+    pipeline's per-predictor optimization config)."""
 
     def __init__(self, exported, names, param_vals, n_inputs=None):
         self._exported = exported
         self._names = names
         self._param_vals = param_vals
         self._n_inputs = n_inputs
+        self._compiler_options = None
+        self._jitted = jax.jit(self._call_fn)
         self.training = False
+
+    def set_compiler_options(self, options):
+        """XLA compiler options applied to every (re)compile — the
+        AnalysisConfig pass-pipeline hook (reference
+        analysis_predictor.cc pass registry; here: XLA flag overrides,
+        e.g. {"xla_cpu_enable_fast_math": True}). jit's own dispatch
+        cache handles per-signature executable reuse."""
+        self._compiler_options = dict(options) if options else None
+        self._jitted = jax.jit(
+            self._call_fn,
+            **({"compiler_options": self._compiler_options}
+               if self._compiler_options else {}))
+        return self
+
+    def _call_fn(self, params, *vals):
+        return self._exported.call(params, *vals)
 
     def __call__(self, *inputs):
         vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
                 for x in inputs]
-        out = self._exported.call(self._param_vals, *vals)
+        out = self._jitted(self._param_vals, *vals)
         if isinstance(out, (list, tuple)):
             outs = [Tensor(o) for o in out]
             return outs if len(outs) > 1 else outs[0]

@@ -204,3 +204,23 @@ def test_serving_requires_start():
     server = inference.InferenceServer(_mlp())
     with _pytest.raises(RuntimeError, match="not started"):
         server.submit(np.zeros((8,), np.float32))
+
+
+def test_compiler_option_hooks(tmp_path):
+    """XLA compile-option overrides — the analysis-pass-pipeline analog
+    (reference analysis_predictor.cc per-config IR pass registry)."""
+    model, path = _save_model(tmp_path)
+    cfg = inference.Config(path)
+    cfg.disable_gpu()
+    cfg.set_xla_compile_option("xla_cpu_enable_fast_math", True)
+    assert cfg.xla_compile_options() == {"xla_cpu_enable_fast_math": True}
+    pred = inference.create_predictor(cfg)
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype("f")
+    out = pred.run([x])[0]
+    with paddle.no_grad():
+        ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+    # repeated runs go through the same jitted callable (jit's own
+    # dispatch cache handles per-signature reuse)
+    out2 = pred.run([x])[0]
+    np.testing.assert_allclose(out2, out)
