@@ -40,5 +40,9 @@ if [ "${1:-}" = "--full" ]; then
   echo "[onchip] gpt-1.3b single-chip arm (PERF_NOTES recipe) ..."
   timeout 1800 python bench.py --worker gpt1p3b \
       2>&1 | tee "$OUT/gpt1p3b_$TS.log"
+  echo "[onchip] gpt-1.3b HYBRID-PIPELINE arm (degenerate 1-chip mesh;"
+  echo "         schedule-overhead vs the dense arm above) ..."
+  timeout 1800 python bench.py --worker gpt1p3b_pp \
+      2>&1 | tee "$OUT/gpt1p3b_pp_$TS.log"
 fi
 echo "[onchip] done; promote winners into bench.py defaults + PERF_NOTES."
