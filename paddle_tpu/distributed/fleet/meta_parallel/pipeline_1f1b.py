@@ -62,6 +62,13 @@ class PipelineSpecs(NamedTuple):
     x: Optional[P] = None
     y: Optional[P] = None
     dp_axis: Optional[str] = None
+    # axes over which the per-shard loss is a PARTIAL SUM of the global
+    # loss (e.g. 'sp': each sequence shard computes masked_sum/global_N):
+    # loss and param grads are psum'd; input cotangents need NO scaling
+    # (the block's own collective transposes already deliver cross-shard
+    # contributions) — contrast dp_axis, whose shards each compute a
+    # full mean and therefore pmean + 1/dp-scale.
+    sum_axes: Optional[Tuple[str, ...]] = None
 
 
 def _unflatten_like(tree, leaf_specs, default_fn, require_pp=False):
@@ -120,7 +127,8 @@ def schedule_ticks(M, pp, num_virtual=1):
 
 
 def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
-                  y_micro, pp, remat, num_virtual=1, dp_axis=None):
+                  y_micro, pp, remat, num_virtual=1, dp_axis=None,
+                  sum_axes=None):
     """Inside shard_map over 'pp'. Returns (loss_sum, param_grads,
     post_grads, dx_micro).
 
@@ -292,6 +300,18 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
     hgrads = jax.tree_util.tree_map(
         lambda g: lax.psum(g, "pp") * inv_m, hgrads)
     dxs = lax.psum(dxs, "pp") * inv_m
+    if sum_axes:
+        # partial-sum shards (sequence parallelism): the global loss is
+        # the SUM over shards; grads likewise (standard SPMD AD — each
+        # shard holds a partial of dθ). dx needs no touch-up: the
+        # block's ring-collective transposes already routed cross-shard
+        # cotangent contributions.
+        for ax in sum_axes:
+            loss = lax.psum(loss, ax)
+            pgrads = jax.tree_util.tree_map(
+                lambda g, _ax=ax: lax.psum(g, _ax), pgrads)
+            hgrads = jax.tree_util.tree_map(
+                lambda g, _ax=ax: lax.psum(g, _ax), hgrads)
     if dp_axis is not None:
         # data parallel composed into the SAME program: each dp shard ran
         # the schedule on its slice of every micro-batch, so the global
@@ -347,6 +367,8 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
             tick, (jnp.zeros([], jnp.float32),
                    jnp.zeros(xs.shape[1:], xs.dtype)), jnp.arange(T))
         loss = lax.psum(loss_sum, "pp") / M
+        for ax in (sp.sum_axes or ()):
+            loss = lax.psum(loss, ax)
         if sp.dp_axis is not None:
             loss = lax.pmean(loss, sp.dp_axis)
         return loss
@@ -448,7 +470,8 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
     # the leading-[V] layout _run_schedule selects from per tick.
     run = jax.shard_map(
         functools.partial(_run_schedule, block_fn, loss_fn, pp=pp,
-                          remat=remat, num_virtual=V, dp_axis=sp.dp_axis),
+                          remat=remat, num_virtual=V, dp_axis=sp.dp_axis,
+                          sum_axes=sp.sum_axes),
         mesh=mesh,
         in_specs=(stack_spec, post_spec, x_spec, y_spec),
         out_specs=(P(), stack_spec, post_spec, x_spec),

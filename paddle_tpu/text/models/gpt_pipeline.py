@@ -63,7 +63,7 @@ def _attention(q, k, v):
     return dense_attention_bshd(q, k, v, is_causal=True)
 
 
-def _decoder_fwd(p, x, nh, mp=1):
+def _decoder_fwd(p, x, nh, mp=1, sp=1):
     """One pre-LN decoder block as a pure function of its param dict.
 
     With mp > 1 the dict's leaves are the LOCAL Megatron shards (qkv/fc1
@@ -71,7 +71,10 @@ def _decoder_fwd(p, x, nh, mp=1):
     and the body brackets each parallel pair with the explicit
     identity/allreduce custom_vjp collectives. At mp == 1 the collectives
     are no-ops over a size-1 axis (outside shard_map they must not run at
-    all, so the mp==1 call skips them entirely — same math).
+    all, so the mp==1 call skips them entirely — same math). With sp > 1
+    the SEQUENCE dim is sharded over 'sp' and attention runs as a
+    causal RING over the K/V shards (sequence_parallel.ring_attention);
+    LN and the MLP are per-token, so only attention needs the ring.
     """
     b, s, d = x.shape
     nh_loc = nh // mp
@@ -83,7 +86,21 @@ def _decoder_fwd(p, x, nh, mp=1):
     qkv = ident(h) @ p["qkv_w"] + p["qkv_b"]       # [b, s, 3·d/mp]
     qkv = qkv.reshape(b, s, nh_loc, 3, hd)          # head-major layout
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-    attn = _attention(q, k, v).reshape(b, s, nh_loc * hd)
+    if sp > 1:
+        from ...nn.functional.attention import _pallas_eligible
+        from ...distributed.sequence_parallel import (
+            ring_attention, ring_flash_attention)
+
+        if _pallas_eligible(q, k):
+            # flash kernel per K/V shard + causal block skip (TPU);
+            # the dense ring stays the CPU/test path
+            attn = ring_flash_attention(q, k, v, causal=True,
+                                        axis_name="sp")
+        else:
+            attn = ring_attention(q, k, v, causal=True, axis_name="sp")
+    else:
+        attn = _attention(q, k, v)
+    attn = attn.reshape(b, s, nh_loc * hd)
     x = x + reduce_(attn @ p["proj_w"]) + p["proj_b"]
     h = _layernorm(x, p["ln2_w"], p["ln2_b"])
     part = jax.nn.gelu(ident(h) @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"]
@@ -177,9 +194,9 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def _embed(self, wte, wpe, ids):
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
 
-    def _block_fn(self, mp):
+    def _block_fn(self, mp, sp=1):
         nh = self.config.num_heads
-        layer = lambda p, x: _decoder_fwd(p, x, nh, mp)
+        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp)
         if self.remat == "layer":
             layer = jax.checkpoint(layer)
 
@@ -192,11 +209,8 @@ class PipelinedGPTForCausalLM(nn.Layer):
 
         return block
 
-    def _loss_fn(self, mp):
-        def loss_fn(y_pred, labels, post):
-            h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
-            sh = h[:, :-1].reshape(-1, h.shape[-1])
-            sl = labels[:, 1:].reshape(-1)
+    def _loss_fn(self, mp, sp=1):
+        def per_token(sh, sl, post):
             if mp == 1:
                 # fused blocked head CE (nn/functional/loss.py
                 # linear_ce_raw): never materializes [micro·s, vocab]
@@ -204,8 +218,28 @@ class PipelinedGPTForCausalLM(nn.Layer):
                 # stays memory-lean
                 from ...nn.functional.loss import linear_ce_raw
 
-                return jnp.mean(linear_ce_raw(sh, post["wte"].T, sl))
-            return jnp.mean(_vocab_parallel_ce(sh, post["wte"], sl, mp))
+                return linear_ce_raw(sh, post["wte"].T, sl)
+            return _vocab_parallel_ce(sh, post["wte"], sl, mp)
+
+        def loss_fn(y_pred, labels, post):
+            h = _layernorm(y_pred, post["lnf_w"], post["lnf_b"])
+            if sp == 1:
+                sh = h[:, :-1].reshape(-1, h.shape[-1])
+                sl = labels[:, 1:].reshape(-1)
+                return jnp.mean(per_token(sh, sl, post))
+            # sequence-sharded: labels arrive PRE-SHIFTED by the outer
+            # program (position t carries token t+1; the globally-last
+            # position carries -1). No collective here — a ppermute in
+            # this head-gated branch would deadlock the other stages'
+            # devices, which never enter it. Each shard returns a
+            # PARTIAL of the global mean (masked_sum / global_valid),
+            # summed by the pipeline's sum_axes=('sp',) psum.
+            b, s_loc = labels.shape
+            valid = (labels >= 0).astype(jnp.float32).reshape(-1)
+            sl = jnp.clip(labels, 0, None).reshape(-1)
+            tok = per_token(h.reshape(-1, h.shape[-1]), sl, post)
+            n_valid_global = b * (s_loc * sp - 1)
+            return jnp.sum(tok * valid) / n_valid_global
 
         return loss_fn
 
@@ -213,9 +247,9 @@ class PipelinedGPTForCausalLM(nn.Layer):
         stk = [getattr(self, "stk_" + n) for n in self._stack_names]
         return [self.wte, self.wpe, self.lnf_w, self.lnf_b] + stk
 
-    def _hybrid_specs(self, mp, dp, micro_bsz):
+    def _hybrid_specs(self, mp, dp, micro_bsz, sp=1):
         """PipelineSpecs for the active mesh (None when pure pp×replica)."""
-        if mp == 1 and dp == 1:
+        if mp == 1 and dp == 1 and sp == 1:
             return None
         names = self._stack_names
         stacked_tree = {n: self._stack_specs[n] for n in names}
@@ -227,7 +261,9 @@ class PipelinedGPTForCausalLM(nn.Layer):
         post = tuple(jax.tree_util.tree_leaves(
             post, is_leaf=lambda s: isinstance(s, P)))
         dp_axis = None
-        x_spec = y_spec = None
+        seq = "sp" if sp > 1 else None
+        x_spec = P(None, None, seq, None) if sp > 1 else None
+        y_spec = P(None, None, seq) if sp > 1 else None
         if dp > 1:
             if micro_bsz % dp:
                 # silent replication would burn dp× the FLOPs — match the
@@ -237,10 +273,11 @@ class PipelinedGPTForCausalLM(nn.Layer):
                     f"dp={dp}; pick batch/n_micro so each dp shard gets "
                     "an equal slice")
             dp_axis = "dp"
-            x_spec = P(None, "dp", None, None)
-            y_spec = P(None, "dp", None)
+            x_spec = P(None, "dp", seq, None)
+            y_spec = P(None, "dp", seq)
         return PipelineSpecs(stacked=stacked, post=post, x=x_spec,
-                             y=y_spec, dp_axis=dp_axis)
+                             y=y_spec, dp_axis=dp_axis,
+                             sum_axes=("sp",) if sp > 1 else None)
 
     # ---- API ----
     def forward(self, input_ids):
@@ -275,9 +312,17 @@ class PipelinedGPTForCausalLM(nn.Layer):
             pipeline_forward_loss)
 
         mesh = mesh_mod.global_mesh()
-        pp, mp, dp = (mesh.shape["pp"], mesh.shape["mp"],
-                      mesh.shape["dp"])
+        pp, mp, dp, sp = (mesh.shape["pp"], mesh.shape["mp"],
+                          mesh.shape["dp"], mesh.shape["sp"])
         if pp == 1:
+            if sp > 1:
+                # mp/dp fall back to GSPMD annotations on the degenerate
+                # path, but nothing annotates the sequence dim — silent
+                # sp-fold replication would burn sp× the FLOPs
+                raise ValueError(
+                    "sequence parallelism in PipelinedGPTForCausalLM "
+                    "needs pp > 1 (use DistributedTrainStep with a "
+                    "seq-sharded batch_specs for GSPMD-only sp)")
             mp = 1   # degenerate path runs outside shard_map: GSPMD
             dp = 1   # annotations (mark_sharding) cover mp/dp instead
         cfg = self.config
@@ -289,19 +334,32 @@ class PipelinedGPTForCausalLM(nn.Layer):
                     raise ValueError(
                         f"{what}={dim} not divisible by mp={mp}")
         labels = input_ids if labels is None else labels
+        if sp > 1 and input_ids.shape[1] % sp:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} not divisible by "
+                f"sp={sp}")
         tensors = self._param_tensors()
         names = self._stack_names
         M = self.n_micro
-        block_fn = self._block_fn(mp)
-        loss_fn = self._loss_fn(mp)
+        block_fn = self._block_fn(mp, sp)
+        loss_fn = self._loss_fn(mp, sp)
         fwd_only = not engine.is_grad_enabled()
 
         def jfn(wte, wpe, lnf_w, lnf_b, *stk):
             ids = input_ids._value
             lbl = labels._value
+            if sp > 1:
+                # pre-shift for the sequence-sharded loss: position t
+                # carries token t+1, the last position carries -1
+                # (masked). Done HERE, where the full sequence is in
+                # one piece — inside the pipeline the shift would need
+                # a cross-shard collective in a stage-gated branch.
+                lbl = jnp.concatenate(
+                    [lbl[:, 1:],
+                     jnp.full((lbl.shape[0], 1), -1, lbl.dtype)], axis=1)
             B = ids.shape[0]
             assert B % M == 0, f"batch {B} not divisible by n_micro {M}"
-            specs = self._hybrid_specs(mp, dp, B // M)
+            specs = self._hybrid_specs(mp, dp, B // M, sp)
             ids_m = ids.reshape(M, B // M, ids.shape[1])
             lbl_m = lbl.reshape(M, B // M, lbl.shape[1])
             x_m = self._embed(wte, wpe, ids_m)
