@@ -51,3 +51,9 @@ def test_long_context_sp_example():
              {"XLA_FLAGS": ""})  # blank: must self-provision the mesh
     # meaningful descent: target is realizable, so the gap must close
     _assert_steps_fall(r, n=8, margin=0.05)
+
+
+def test_gpt_4d_parallel_example():
+    r = _run("train_gpt_4d_parallel.py",
+             {"XLA_FLAGS": ""})  # blank: must self-provision the mesh
+    _assert_steps_fall(r, n=5)
