@@ -190,6 +190,35 @@ class PipelinedGPTForCausalLM(nn.Layer):
         self.lnf_w = mk([d], default_initializer=ones)
         self.lnf_b = mk([d], is_bias=True)
 
+    def shard_storage(self):
+        """ZeRO-style parameter storage sharding composed with the
+        pipeline (reference: GroupSharded stage-3 param sharding,
+        fleet/meta_parallel/sharding/group_sharded_stage3.py — composed
+        with pp the way the reference composes sharding+pp in its
+        hybrid configs). Each stacked weight (and the tied embedding)
+        gains the `axis` mesh axis on a free divisible dim; the 1F1B
+        shard_map's in_specs don't mention `axis`, so XLA all-gathers
+        params at the boundary and reduce-scatters grads back — the
+        optimizer then updates SHARDED storage (params + moments /axis).
+        The axis is the mesh's 'sharding' axis (the shared
+        `_zero_spec` policy). Call after construction, before the
+        first step."""
+        from ...distributed.fleet.meta_parallel.mp_layers import (
+            mark_sharding)
+        from ...distributed.parallel_step import _zero_spec
+
+        if mesh_mod.axis_size("sharding") <= 1:
+            return self
+        # the ONE ZeRO placement policy (largest divisible free dim,
+        # warning on forced replication) — shared with
+        # DistributedTrainStep/shard_params_and_opt; each param's
+        # existing _pspec (set by __init__'s mark_sharding) is the base
+        for p in self._param_tensors():
+            spec = _zero_spec(p._value, "p_g_os",
+                              getattr(p, "_pspec", None))
+            mark_sharding(p, *spec)
+        return self
+
     # ---- pure pieces ----
     def _embed(self, wte, wpe, ids):
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
