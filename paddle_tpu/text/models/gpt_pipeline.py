@@ -135,10 +135,18 @@ class PipelinedGPTForCausalLM(nn.Layer):
     runs the 1F1B pipeline schedule over whatever (dp, pp, mp) mesh is
     active."""
 
-    def __init__(self, config: GPTConfig, n_micro=4, remat="stage"):
+    def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
+                 n_virtual=1):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
+        # n_virtual > 1: tick-interleaved virtual stages — each device
+        # owns n_virtual NON-contiguous chunks of the layer stack
+        # (round-robin placement, reference PipelineParallelWithInterleave)
+        if not isinstance(n_virtual, int) or n_virtual < 1:
+            raise ValueError(
+                f"n_virtual={n_virtual!r}: expected an int >= 1")
+        self.n_virtual = n_virtual
         # remat: "stage" = 1F1B ring buffer keeps only stage INPUTS and
         # re-linearizes the whole stage per backward tick (default);
         # "layer" = jax.checkpoint around every decoder layer inside the
@@ -374,6 +382,12 @@ class PipelinedGPTForCausalLM(nn.Layer):
         loss_fn = self._loss_fn(mp, sp)
         fwd_only = not engine.is_grad_enabled()
 
+        V = self.n_virtual if pp > 1 else 1
+        if V > 1 and self.config.num_layers % (pp * V):
+            raise ValueError(
+                f"num_layers={self.config.num_layers} not divisible by "
+                f"pp*n_virtual={pp}*{V}")
+
         def jfn(wte, wpe, lnf_w, lnf_b, *stk):
             ids = input_ids._value
             lbl = labels._value
@@ -394,14 +408,37 @@ class PipelinedGPTForCausalLM(nn.Layer):
             x_m = self._embed(wte, wpe, ids_m)
             stacked = dict(zip(names, stk))
             post = {"wte": wte, "lnf_w": lnf_w, "lnf_b": lnf_b}
-            if fwd_only:
+            if V > 1:
+                # round-robin chunking: [L, ...] → [pp·V, L/(pp·V), ...]
+                # rows reordered so each stage's shard is its V chunks
+                # (interleaved_stacking_order); grads flow back through
+                # the gather+reshape via outer AD. Specs gain the chunk
+                # dim after 'pp'.
+                from ...distributed.fleet.meta_parallel.pipeline_1f1b \
+                    import interleaved_stacking_order
+
+                L = self.config.num_layers
+                order = jnp.asarray(
+                    interleaved_stacking_order(pp, V))
+                stacked = {
+                    n: a.reshape((pp * V, L // (pp * V)) + a.shape[1:])[
+                        order]
+                    for n, a in stacked.items()}
+                if specs is not None:
+                    specs = specs._replace(stacked=tuple(
+                        P(*((s[0], None) + tuple(s[1:])))
+                        for s in specs.stacked))
+            if fwd_only and V == 1:
                 return pipeline_forward_loss(block_fn, loss_fn, stacked,
                                              post, (x_m, lbl_m),
                                              specs=specs)
             # "layer" remat lives inside block_fn already — the schedule
-            # must not double-checkpoint the stage
+            # must not double-checkpoint the stage (fwd_only with V > 1
+            # also lands here: the fill-drain path has no virtual-stage
+            # schedule, and the 1F1B loss is identical, just costlier)
             remat = self.remat == "stage"
             return pipeline_1f1b(block_fn, loss_fn, stacked, post,
-                                 (x_m, lbl_m), remat=remat, specs=specs)
+                                 (x_m, lbl_m), remat=remat,
+                                 num_virtual=V, specs=specs)
 
         return apply_jfn("pipelined_gpt_loss", jfn, *tensors)

@@ -33,14 +33,14 @@ CFG = GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
                 num_heads=4, max_seq_len=32)
 
 
-def _train_losses(mesh_kw, ids_np, steps=3):
+def _train_losses(mesh_kw, ids_np, steps=3, cfg=CFG, n_virtual=1):
     mesh_mod.reset_mesh()
     if mesh_kw is None:
         mesh_mod.init_mesh(devices=jax.devices()[:1])
     else:
         mesh_mod.init_mesh(**mesh_kw)
     paddle.seed(0)
-    m = PipelinedGPTForCausalLM(CFG, n_micro=4)
+    m = PipelinedGPTForCausalLM(cfg, n_micro=4, n_virtual=n_virtual)
     ids = paddle.to_tensor(ids_np)
     opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
     step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
@@ -257,3 +257,37 @@ def test_zero_storage_sharding_composes_with_pipeline():
     # storage really is sharded over 'sharding'
     assert "sharding" in tuple(m.stk_qkv_w._value.sharding.spec)
     assert "sharding" in tuple(m.wte._value.sharding.spec)
+
+
+def test_model_interleaved_virtual_stages_trajectory():
+    # n_virtual=2 over pp=4 (8 layers -> 1-layer chunks): round-robin
+    # chunk placement through the unified tick-interleaved schedule,
+    # straight from the MODEL surface
+    cfg8 = GPTConfig(vocab_size=256, hidden_size=32, num_layers=8,
+                     num_heads=4, max_seq_len=32)
+    rng = np.random.default_rng(11)
+    ids_np = rng.integers(0, 256, (8, 16))
+    serial = _train_losses(None, ids_np, cfg=cfg8)
+    v2 = _train_losses({"pp": 4, "dp": 2}, ids_np, cfg=cfg8, n_virtual=2)
+    np.testing.assert_allclose(serial, v2, rtol=2e-4)
+
+
+def test_model_interleaved_composes_with_mp_and_sp():
+    rng = np.random.default_rng(12)
+    ids_np = rng.integers(0, 256, (8, 16))
+    serial = _train_losses(None, ids_np)
+    v2mp = _train_losses({"pp": 2, "mp": 2, "dp": 2}, ids_np,
+                         n_virtual=2)
+    v2sp = _train_losses({"pp": 2, "sp": 2, "dp": 2}, ids_np,
+                         n_virtual=2)
+    np.testing.assert_allclose(serial, v2mp, rtol=2e-4)
+    np.testing.assert_allclose(serial, v2sp, rtol=2e-4)
+
+
+def test_model_interleaved_indivisible_raises():
+    mesh_mod.init_mesh(pp=2, dp=4)
+    paddle.seed(0)
+    m = PipelinedGPTForCausalLM(CFG, n_micro=4, n_virtual=3)  # 4 % 6
+    ids = paddle.to_tensor(np.zeros((8, 16), np.int64))
+    with pytest.raises(ValueError, match="num_layers"):
+        m.loss(ids)
