@@ -440,8 +440,9 @@ def bench_gpt1p3b_pp():
     pp = int(os.environ.get("BENCH_PP", 2 if n % 2 == 0 and n > 1 else 1))
     mp = int(os.environ.get("BENCH_MP", 2 if n % (2 * pp) == 0 else 1))
     dp = int(os.environ.get("BENCH_DP", n // (pp * mp)))
+    vp = int(os.environ.get("BENCH_VP", 1))  # interleaved virtual stages
     mesh_mod.init_mesh(dp=dp, pp=pp, mp=mp)
-    log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp}")
+    log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp} V={vp}")
 
     paddle.seed(0)
     smoke = os.environ.get("BENCH_PP_SMOKE", "0") == "1"
@@ -454,7 +455,8 @@ def bench_gpt1p3b_pp():
     else:
         cfg = gpt_1p3b()
         batch, seq, n_micro = 2 * max(dp, 1), 2048, 2
-    model = PipelinedGPTForCausalLM(cfg, n_micro=n_micro, remat="layer")
+    model = PipelinedGPTForCausalLM(cfg, n_micro=n_micro, remat="layer",
+                                    n_virtual=vp)
     model = amp.decorate(model, level="O2", dtype="bfloat16")
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
     step = paddle.jit.TrainStep(model, lambda m, i: m.loss(i), opt)
