@@ -63,7 +63,7 @@ def _attention(q, k, v):
     return dense_attention_bshd(q, k, v, is_causal=True)
 
 
-def _decoder_fwd(p, x, nh, mp=1, sp=1):
+def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1):
     """One pre-LN decoder block as a pure function of its param dict.
 
     With mp > 1 the dict's leaves are the LOCAL Megatron shards (qkv/fc1
@@ -103,8 +103,65 @@ def _decoder_fwd(p, x, nh, mp=1, sp=1):
     attn = attn.reshape(b, s, nh_loc * hd)
     x = x + reduce_(attn @ p["proj_w"]) + p["proj_b"]
     h = _layernorm(x, p["ln2_w"], p["ln2_b"])
+    if "gate_w" in p:   # MoE FFN (experts sharded over 'ep')
+        return x + _moe_ffn(p, h, p["gate_w"].shape[-1], ep)
     part = jax.nn.gelu(ident(h) @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"]
     return x + reduce_(part) + p["fc2_b"]
+
+
+def _moe_ffn(p, h, n_experts, ep, cf=1.25):
+    """Switch (top-1) MoE feed-forward with experts sharded over 'ep'
+    (reference incubate moe_layer.py:244 + GShard dispatch). Tokens are
+    REPLICATED across the ep axis inside the pipeline (they shard over
+    dp/sp instead), so no all-to-all is needed: every rank routes all
+    tokens, processes only its E/ep resident experts, and the partial
+    combines psum over 'ep' (identity-backward pair, like the Megatron
+    row-parallel output). The load-balancing aux term is NOT surfaced
+    (the 1F1B block has no aux channel) — serial and pipelined paths
+    drop it consistently.
+
+    Capacity note: dispatch (cumsum positions + capacity) is computed
+    over the tokens THIS rank holds. With dp/sp sharding the token set
+    per rank shrinks, so overflow-dropping decisions differ from the
+    full-batch computation — per-shard dispatch is itself a standard
+    MoE formulation, but exact-parity tests vs serial must use ep (and
+    sharding) axes only.
+    """
+    b, s, d = h.shape
+    x = h.reshape(b * s, d)
+    tokens = x.shape[0]
+    logits = x @ p["gate_w"]                      # gate replicated
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    top_idx = jnp.argmax(probs, -1)
+    top_p = jnp.take_along_axis(probs, top_idx[:, None], -1)[:, 0]
+    onehot = jax.nn.one_hot(top_idx, n_experts)   # [t, E]
+    import math
+    capacity = max(1, int(math.ceil(tokens / n_experts * cf)))
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (pos < capacity) & (onehot > 0)
+    pos_idx = pos.sum(-1).astype(jnp.int32)
+    if ep > 1:
+        # slice the per-expert mask BEFORE building the dispatch tensor
+        # — [t, E/ep, cap] instead of every rank materializing the full
+        # [t, E, cap] (~quadratic in local tokens) and slicing after
+        e_loc = n_experts // ep
+        r = lax.axis_index("ep")
+        keep = lax.dynamic_slice_in_dim(keep, r * e_loc, e_loc, axis=1)
+        xin = copy_to_mp(x, "ep")   # identity fwd, psum dh bwd
+    else:
+        xin = x
+    disp = (jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)[:, None, :]
+            * keep[:, :, None])                   # [t, E_loc, cap]
+    disp = jnp.swapaxes(disp, 0, 1)               # [E_loc, t, cap]
+    expert_in = jnp.einsum("etc,td->ecd", disp, xin)
+    hmid = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", expert_in, p["moe_w1"]) + p["moe_b1"])
+    expert_out = jnp.einsum("ech,ehd->ecd", hmid, p["moe_w2"]) + p["moe_b2"]
+    partial = jnp.einsum("etc,ecd->td", disp, expert_out)
+    if ep > 1:
+        partial = allreduce_mp(partial, "ep")     # psum fwd, ident bwd
+    out = partial * top_p[:, None].astype(x.dtype)
+    return out.reshape(b, s, d)
 
 
 def _vocab_parallel_ce(sh, wte_loc, sl, mp):
@@ -136,10 +193,14 @@ class PipelinedGPTForCausalLM(nn.Layer):
     active."""
 
     def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
-                 n_virtual=1):
+                 n_virtual=1, moe_experts=0, moe_hidden=None):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
+        # moe_experts > 0: the dense FFN becomes a switch (top-1) MoE
+        # with experts sharded over the 'ep' mesh axis (see _moe_ffn)
+        self.moe_experts = int(moe_experts)
+        self.moe_hidden = moe_hidden or config.ffn_size
         # n_virtual > 1: tick-interleaved virtual stages — each device
         # owns n_virtual NON-contiguous chunks of the layer stack
         # (round-robin placement, reference PipelineParallelWithInterleave)
@@ -174,13 +235,16 @@ class PipelinedGPTForCausalLM(nn.Layer):
         self._stack_specs = {}
         ones = nn.initializer.Constant(1.0)
 
-        def stacked(name, shape, is_bias=False, init=None, mp_dim=None):
+        def stacked(name, shape, is_bias=False, init=None, mp_dim=None,
+                    ep_dim=None):
             p = mk([L] + shape, is_bias=is_bias,
                    default_initializer=init or (
                        nn.initializer.Constant(0.0) if is_bias else normal))
             spec = ["pp"] + [None] * len(shape)
             if mp_dim is not None:
                 spec[1 + mp_dim] = "mp"
+            if ep_dim is not None:
+                spec[1 + ep_dim] = "ep"
             mark_sharding(p, *spec)
             self._stack_specs[name] = P(*spec)
             setattr(self, "stk_" + name, p)
@@ -192,9 +256,18 @@ class PipelinedGPTForCausalLM(nn.Layer):
         stacked("qkv_b", [3 * d], True, mp_dim=0)
         stacked("proj_w", [d, d], mp_dim=0); stacked("proj_b", [d], True)
         stacked("ln2_w", [d], init=ones); stacked("ln2_b", [d], True)
-        stacked("fc1_w", [d, ffn], mp_dim=1)
-        stacked("fc1_b", [ffn], True, mp_dim=0)
-        stacked("fc2_w", [ffn, d], mp_dim=0); stacked("fc2_b", [d], True)
+        if self.moe_experts:
+            E, dh = self.moe_experts, self.moe_hidden
+            stacked("gate_w", [d, E])
+            stacked("moe_w1", [E, d, dh], ep_dim=0)
+            stacked("moe_b1", [E, 1, dh], True, ep_dim=0)
+            stacked("moe_w2", [E, dh, d], ep_dim=0)
+            stacked("moe_b2", [E, 1, d], True, ep_dim=0)
+        else:
+            stacked("fc1_w", [d, ffn], mp_dim=1)
+            stacked("fc1_b", [ffn], True, mp_dim=0)
+            stacked("fc2_w", [ffn, d], mp_dim=0)
+            stacked("fc2_b", [d], True)
         self.lnf_w = mk([d], default_initializer=ones)
         self.lnf_b = mk([d], is_bias=True)
 
@@ -231,9 +304,9 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def _embed(self, wte, wpe, ids):
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
 
-    def _block_fn(self, mp, sp=1):
+    def _block_fn(self, mp, sp=1, ep=1):
         nh = self.config.num_heads
-        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp)
+        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp, ep)
         if self.remat == "layer":
             layer = jax.checkpoint(layer)
 
@@ -284,9 +357,13 @@ class PipelinedGPTForCausalLM(nn.Layer):
         stk = [getattr(self, "stk_" + n) for n in self._stack_names]
         return [self.wte, self.wpe, self.lnf_w, self.lnf_b] + stk
 
-    def _hybrid_specs(self, mp, dp, micro_bsz, sp=1):
-        """PipelineSpecs for the active mesh (None when pure pp×replica)."""
-        if mp == 1 and dp == 1 and sp == 1:
+    def _hybrid_specs(self, mp, dp, micro_bsz, sp=1, ep=1):
+        """PipelineSpecs for the active mesh (None when pure pp×replica).
+        ep MUST be included: expert leaves carry 'ep' in their stored
+        specs, and replicating them while _moe_ffn slices per rank
+        would silently einsum-broadcast the size-1 expert dim into
+        wrong math (caught by the MoE parity tests)."""
+        if mp == 1 and dp == 1 and sp == 1 and ep == 1:
             return None
         names = self._stack_names
         stacked_tree = {n: self._stack_specs[n] for n in names}
@@ -351,6 +428,7 @@ class PipelinedGPTForCausalLM(nn.Layer):
         mesh = mesh_mod.global_mesh()
         pp, mp, dp, sp = (mesh.shape["pp"], mesh.shape["mp"],
                           mesh.shape["dp"], mesh.shape["sp"])
+        ep = mesh.shape["ep"] if self.moe_experts else 1
         if pp == 1:
             if sp > 1:
                 # mp/dp fall back to GSPMD annotations on the degenerate
@@ -362,15 +440,23 @@ class PipelinedGPTForCausalLM(nn.Layer):
                     "seq-sharded batch_specs for GSPMD-only sp)")
             mp = 1   # degenerate path runs outside shard_map: GSPMD
             dp = 1   # annotations (mark_sharding) cover mp/dp instead
+            ep = 1
         cfg = self.config
         if mp > 1:
-            for dim, what in ((cfg.num_heads, "num_heads"),
-                              (cfg.ffn_size, "ffn_size"),
-                              (cfg.vocab_size, "vocab_size")):
+            dims = [(cfg.num_heads, "num_heads"),
+                    (cfg.vocab_size, "vocab_size")]
+            if not self.moe_experts:
+                # the dense fc pair is mp-sharded; MoE experts are not
+                dims.append((cfg.ffn_size, "ffn_size"))
+            for dim, what in dims:
                 if dim % mp:
                     raise ValueError(
                         f"{what}={dim} not divisible by mp={mp}")
         labels = input_ids if labels is None else labels
+        if ep > 1 and self.moe_experts % ep:
+            raise ValueError(
+                f"moe_experts={self.moe_experts} not divisible by "
+                f"ep={ep}")
         if sp > 1 and input_ids.shape[1] % sp:
             raise ValueError(
                 f"sequence length {input_ids.shape[1]} not divisible by "
@@ -378,7 +464,7 @@ class PipelinedGPTForCausalLM(nn.Layer):
         tensors = self._param_tensors()
         names = self._stack_names
         M = self.n_micro
-        block_fn = self._block_fn(mp, sp)
+        block_fn = self._block_fn(mp, sp, ep)
         loss_fn = self._loss_fn(mp, sp)
         fwd_only = not engine.is_grad_enabled()
 
@@ -402,7 +488,7 @@ class PipelinedGPTForCausalLM(nn.Layer):
                      jnp.full((lbl.shape[0], 1), -1, lbl.dtype)], axis=1)
             B = ids.shape[0]
             assert B % M == 0, f"batch {B} not divisible by n_micro {M}"
-            specs = self._hybrid_specs(mp, dp, B // M, sp)
+            specs = self._hybrid_specs(mp, dp, B // M, sp, ep)
             ids_m = ids.reshape(M, B // M, ids.shape[1])
             lbl_m = lbl.reshape(M, B // M, lbl.shape[1])
             x_m = self._embed(wte, wpe, ids_m)

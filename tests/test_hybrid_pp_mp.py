@@ -291,3 +291,68 @@ def test_model_interleaved_indivisible_raises():
     ids = paddle.to_tensor(np.zeros((8, 16), np.int64))
     with pytest.raises(ValueError, match="num_layers"):
         m.loss(ids)
+
+
+# --------------------------------------------------------------------
+# expert parallelism INSIDE pipeline stages (pp × ep): switch-MoE FFN
+# with experts sharded over 'ep', partial combines psum'd
+# --------------------------------------------------------------------
+
+def _moe_losses(mesh_kw, ids_np, steps=3):
+    mesh_mod.reset_mesh()
+    if mesh_kw is None:
+        mesh_mod.init_mesh(devices=jax.devices()[:1])
+    else:
+        mesh_mod.init_mesh(**mesh_kw)
+    paddle.seed(0)
+    m = PipelinedGPTForCausalLM(CFG, n_micro=4, moe_experts=4,
+                                moe_hidden=64)
+    ids = paddle.to_tensor(ids_np)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
+    return [float(step(ids).numpy()) for _ in range(steps)]
+
+
+def test_moe_in_pipeline_trajectory_matches_serial():
+    # ep shards experts only (tokens replicated across ep), so parity
+    # vs serial is EXACT — see _moe_ffn's capacity note for why dp/sp
+    # composition changes dispatch semantics instead
+    rng = np.random.default_rng(13)
+    ids_np = rng.integers(0, 256, (8, 16))
+    serial = _moe_losses(None, ids_np)
+    ep4 = _moe_losses({"pp": 2, "ep": 4}, ids_np)
+    zshard = _moe_losses({"pp": 2, "ep": 2, "sharding": 2}, ids_np)
+    np.testing.assert_allclose(serial, ep4, rtol=2e-5)
+    np.testing.assert_allclose(serial, zshard, rtol=2e-5)
+    assert serial[-1] < serial[0]
+
+
+def test_moe_with_dp_trains():
+    # per-shard dispatch (capacity over local tokens): not bit-parity
+    # with serial, but a valid MoE that must train
+    rng = np.random.default_rng(14)
+    ids_np = rng.integers(0, 256, (8, 16))
+    losses = _moe_losses({"pp": 2, "dp": 2, "ep": 2}, ids_np)
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_moe_expert_divisibility_raises():
+    mesh_mod.init_mesh(pp=2, ep=4)
+    paddle.seed(0)
+    m = PipelinedGPTForCausalLM(CFG, n_micro=4, moe_experts=6)
+    ids = paddle.to_tensor(np.zeros((8, 16), np.int64))
+    with pytest.raises(ValueError, match="moe_experts"):
+        m.loss(ids)
+
+
+def test_moe_with_sp_and_with_mp_train():
+    # sp x ep: expert/gate grads are sp-partials summed via sum_axes;
+    # mp x ep: attention mp-sharded alongside replicated-across-mp MoE
+    rng = np.random.default_rng(15)
+    ids_np = rng.integers(0, 256, (8, 16))
+    for mesh_kw in ({"pp": 2, "sp": 2, "ep": 2},
+                    {"pp": 2, "mp": 2, "ep": 2}):
+        losses = _moe_losses(mesh_kw, ids_np)
+        assert losses[-1] < losses[0], (mesh_kw, losses)
+        assert np.isfinite(losses).all()
