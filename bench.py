@@ -441,8 +441,11 @@ def bench_gpt1p3b_pp():
     mp = int(os.environ.get("BENCH_MP", 2 if n % (2 * pp) == 0 else 1))
     dp = int(os.environ.get("BENCH_DP", n // (pp * mp)))
     vp = int(os.environ.get("BENCH_VP", 1))  # interleaved virtual stages
-    mesh_mod.init_mesh(dp=dp, pp=pp, mp=mp)
-    log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp} V={vp}")
+    ep = int(os.environ.get("BENCH_EP", 1))  # MoE expert parallelism
+    moe = int(os.environ.get("BENCH_MOE_EXPERTS", 0))
+    mesh_mod.init_mesh(dp=dp, pp=pp, mp=mp, ep=ep)
+    log(f"[bench] gpt-1.3b-pp mesh dp={dp} pp={pp} mp={mp} ep={ep} "
+        f"V={vp} moe={moe}")
 
     paddle.seed(0)
     smoke = os.environ.get("BENCH_PP_SMOKE", "0") == "1"
@@ -456,7 +459,7 @@ def bench_gpt1p3b_pp():
         cfg = gpt_1p3b()
         batch, seq, n_micro = 2 * max(dp, 1), 2048, 2
     model = PipelinedGPTForCausalLM(cfg, n_micro=n_micro, remat="layer",
-                                    n_virtual=vp)
+                                    n_virtual=vp, moe_experts=moe)
     model = amp.decorate(model, level="O2", dtype="bfloat16")
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
     step = paddle.jit.TrainStep(model, lambda m, i: m.loss(i), opt)
@@ -483,7 +486,8 @@ def bench_gpt1p3b_pp():
         f"mfu {mfu:.3f} (of {n}-chip peak)")
     return {"model": ("gpt-tiny-hybrid-pipeline-SMOKE" if smoke
                       else "gpt-1.3b-hybrid-pipeline"),
-            "mesh": {"dp": dp, "pp": pp, "mp": mp},
+            "mesh": {"dp": dp, "pp": pp, "mp": mp, "ep": ep,
+                     "n_virtual": vp, "moe_experts": moe},
             "ms_per_step": round(dt * 1e3, 2),
             "tokens_per_sec": round(tps), "mfu": round(mfu, 4)}
 
