@@ -57,3 +57,8 @@ def test_gpt_4d_parallel_example():
     r = _run("train_gpt_4d_parallel.py",
              {"XLA_FLAGS": ""})  # blank: must self-provision the mesh
     _assert_steps_fall(r, n=5)
+
+
+def test_gpt_moe_pipeline_example():
+    r = _run("train_gpt_moe_pipeline.py", {"XLA_FLAGS": ""})
+    _assert_steps_fall(r, n=5)
