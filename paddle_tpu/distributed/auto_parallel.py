@@ -390,13 +390,50 @@ class Planner:
 
     # ---- model walk -----------------------------------------------------
     def _layer_list(self, model):
+        """Units the DP plans over: Linear, Embedding, and WHOLE
+        MultiHeadAttention blocks. An attention block is one unit — its
+        q/k/v projections are parallel branches off one replicated
+        input and its out-projection is the row-parallel closer, so
+        pricing the four inner Linears as a sequential chain (the
+        pre-round-5 behavior) both mis-prices the transitions and can
+        never express the Megatron head-parallel pattern (reference
+        auto_parallel/planner.py walks the op graph for the same
+        reason)."""
         named = {id(p): n for n, p in model.named_parameters()}
         out = []
+        claimed = set()   # params owned by an attention unit
         for layer in model.sublayers(include_self=True):
             kind = type(layer).__name__
+            if kind == "MultiHeadAttention":
+                projs = [layer.q_proj, layer.k_proj, layer.v_proj]
+                names = []
+                w_units = 0
+                for lin in projs + [layer.out_proj]:
+                    claimed.add(id(lin.weight))
+                    w_units += int(np.prod(lin.weight._value.shape))
+                    names.append(named.get(id(lin.weight)))
+                    if getattr(lin, "bias", None) is not None and \
+                            getattr(lin.bias, "_value", None) is not None:
+                        claimed.add(id(lin.bias))
+                        names.append(named.get(id(lin.bias)))
+                d = int(layer.embed_dim)
+                out.append({
+                    "kind": "Attention",
+                    "shape": (d, d),
+                    "heads": int(layer.num_heads),
+                    "w_units": w_units,
+                    # column-parallel leaves: q/k/v weights (+biases);
+                    # row-parallel leaf: out_proj weight
+                    "col_w": [named.get(id(p.weight)) for p in projs],
+                    "col_b": [named.get(id(p.bias)) for p in projs
+                              if getattr(p, "bias", None) is not None],
+                    "row_w": named.get(id(layer.out_proj.weight)),
+                    "names": [n for n in names if n],
+                })
+                continue
             w = getattr(layer, "weight", None)
             if w is None or getattr(w, "_value", None) is None \
-                    or w._value.ndim != 2:
+                    or w._value.ndim != 2 or id(w) in claimed:
                 continue
             if kind not in ("Linear", "Embedding"):
                 continue
@@ -410,17 +447,43 @@ class Planner:
             })
         return out
 
+    @staticmethod
+    def _unit_names(l):
+        if l["kind"] == "Attention":
+            return set(l["names"])
+        return {n for n in (l["w_name"], l["b_name"]) if n}
+
     def _other_param_units(self, model, layers):
-        seen = {l["w_name"] for l in layers} | {
-            l["b_name"] for l in layers if l["b_name"]}
+        seen = set()
+        for l in layers:
+            seen |= self._unit_names(l)
         total = 0
         for n, p in model.named_parameters():
             if n not in seen:
                 total += int(np.prod(p._value.shape))
         return total
 
+    @staticmethod
+    def _tied_head(model, layers):
+        """(vocab, d, emb_w_name) when the model declares embedding/LM
+        -head weight tying (`tie_embeddings`, the GPTConfig convention):
+        the head matmul [B, d]·[d, vocab] reuses the first Embedding's
+        storage, so the DP must price the head's compute/comm but not
+        its memory, and a vocab-sharded embedding unlocks the
+        vocab-parallel head+CE (reference mp_layers.py:438)."""
+        cfg = getattr(model, "config", None)
+        tied = bool(getattr(model, "tie_embeddings",
+                            getattr(cfg, "tie_embeddings", False)))
+        if not tied:
+            return None
+        for l in layers:
+            if l["kind"] == "Embedding":
+                v, d = l["shape"]
+                return (v, d, l["w_name"])
+        return None
+
     # ---- inner DP -------------------------------------------------------
-    def _search_layers(self, layers, dp, mp, B):
+    def _search_layers(self, layers, dp, mp, B, tied=None):
         """Viterbi over activation layout state ∈ {None, axis}, keeping
         a PARETO FRONTIER of (cost, memory) per state — a purely
         cost-greedy search would never surface the memory-cheaper
@@ -454,7 +517,7 @@ class Planner:
             din, dout = l["shape"]
             act_in = (B / dp) * din
             act_out = (B / dp) * dout
-            w_units = din * dout
+            w_units = l.get("w_units", din * dout)
             nxt = {}
 
             def consider(state, cost, specs, mem):
@@ -465,10 +528,34 @@ class Planner:
                   flops = 6.0 * (B / dp) * w_units * dp  # per-step global
                   comp_rep = flops / dp / c.peak_flops   # duplicated on mp
                   comp_shard = flops / (dp * mp) / c.peak_flops
+                  if l["kind"] == "Attention":
+                      # one unit: q/k/v are parallel branches off a
+                      # REPLICATED input, out-proj closes the block.
+                      # Megatron head-parallel = qkv column + out row,
+                      # zero intra-block reshards, one psum fwd/bwd.
+                      base = cost + (gather_cost(act_in) if state else 0)
+                      consider(None, base + comp_rep, specs,
+                               mem + w_units)   # replicated
+                      if mp > 1 and l["heads"] % mp == 0:
+                          sh = dict(specs)
+                          for n in l["col_w"]:
+                              sh[n] = P(None, ax)
+                          for n in l["col_b"]:
+                              sh[n] = P(ax)
+                          sh[l["row_w"]] = P(ax, None)
+                          comm = 2 * (act_out * self.cm.cbytes
+                                      * (mp - 1) / mp / c.ici_bandwidth
+                                      + c.collective_latency)
+                          consider(None, base + comp_shard + comm, sh,
+                                   mem + w_units / mp)
+                      continue
                   if l["kind"] == "Embedding":
                       # lookup FLOPs are negligible; choices differ in
-                      # memory and the psum after a sharded gather
-                      base = cost + (gather_cost(act_in) if state else 0)
+                      # memory and the psum after a sharded gather. An
+                      # embedding consumes INTEGER IDS (B/dp scalars),
+                      # not a vocab-width activation — a sharded
+                      # incoming state costs only the id-vector gather
+                      base = cost + (gather_cost(B / dp) if state else 0)
                       consider(None, base, specs, mem + w_units)  # repl.
                       if mp > 1 and din % mp == 0:  # vocab must split
                           sh = dict(specs)
@@ -510,6 +597,27 @@ class Planner:
                     last_dout = layers[-1]["shape"][1]
                     cost = cost + gather_cost((B / dp) * last_dout)
                 finals.append((cost, specs, mem))
+        if tied is not None:
+            # tied LM head: the [B, d]·[d, vocab] logits matmul reuses
+            # the embedding's storage (no memory), but its compute and
+            # comm depend on how the embedding was sharded — a
+            # vocab-sharded embedding runs the vocab-parallel head+CE
+            # (per-rank max / two psums, reference mp_layers.py:438), a
+            # replicated one runs the full matmul on every mp rank.
+            vocab, d, emb_w = tied
+            head_flops = 6.0 * (B / dp) * d * vocab
+            closed = []
+            for cost, specs, mem in finals:
+                if specs.get(emb_w) == P(ax, None):
+                    comm = 2 * ((B / dp) * self.cm.cbytes * (mp - 1)
+                                / mp / c.ici_bandwidth
+                                + c.collective_latency)
+                    closed.append((cost + head_flops / (dp * mp)
+                                   / c.peak_flops + comm, specs, mem))
+                else:
+                    closed.append((cost + head_flops / dp
+                                   / c.peak_flops, specs, mem))
+            finals = closed
         return prune(finals)
 
     # ---- outer search ---------------------------------------------------
@@ -552,9 +660,10 @@ class Planner:
                     f"size divisible by one of "
                     f"{sorted(n // m for m in mp_opts)}")
         cb, gb, ob = self.cm.cbytes, self.cm.gbytes, 8.0
+        tied = self._tied_head(model, layers)
         for dp, mp in pairs:
             for ci, (cost0, specs, units0) in enumerate(
-                    self._search_layers(layers, dp, mp, B)):
+                    self._search_layers(layers, dp, mp, B, tied=tied)):
                 if mp > 1 and not specs and force_mesh is None:
                     # degenerate: an mp axis nothing is sharded over is
                     # pure replication — identical work to (dp, 1) on

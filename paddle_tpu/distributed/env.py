@@ -52,38 +52,66 @@ _hb_thread = None
 
 
 def _start_heartbeat():
-    """Touch PADDLE_HEARTBEAT_DIR/hb_<rank> every second so the launcher
-    (and ElasticManager peers) can tell a HUNG worker from a live one —
-    process liveness alone misses wedged collectives (reference:
-    elastic/manager.py etcd heartbeat with TTL, master.py:234)."""
+    """Beat every second so the launcher (and ElasticManager peers) can
+    tell a HUNG worker from a live one — process liveness alone misses
+    wedged collectives (reference: elastic/manager.py etcd heartbeat
+    with TTL, master.py:234).
+
+    Two transports: with PADDLE_ELASTIC_MASTER set, beats go to the
+    launcher's cross-host membership registry (launch/master.py — the
+    reference's ETCDMaster role, no shared filesystem needed); otherwise
+    the single-host fallback touches PADDLE_HEARTBEAT_DIR/hb_<rank>."""
     global _hb_thread
+    master_ep = os.environ.get("PADDLE_ELASTIC_MASTER")
     hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
-    if not hb_dir or _hb_thread is not None:
+    if (not master_ep and not hb_dir) or _hb_thread is not None:
         return
     import threading
     import time
 
-    path = os.path.join(hb_dir, f"hb_{get_rank()}")
+    rank = get_rank()
+    client = None
+    if master_ep:
+        from .launch.master import MembershipClient
 
-    # a worker that exits CLEANLY must not look like a wedged one: remove
-    # the beat file so monitors (launcher, ElasticManager) stop tracking it
+        client = MembershipClient(master_ep)
+    # master mode is EXCLUSIVE: beats go only to the registry, proving
+    # the path needs no shared filesystem (the dir protocol remains the
+    # standalone/legacy fallback)
+    path = (os.path.join(hb_dir, f"hb_{rank}")
+            if hb_dir and client is None else None)
+
+    # a worker that exits CLEANLY must not look like a wedged one:
+    # deregister / remove the beat so monitors stop tracking it
     import atexit
 
     def _tombstone():
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        if client is not None:
+            try:
+                client.clear(rank)
+            except OSError:
+                pass
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     atexit.register(_tombstone)
 
     def beat():
         while True:
-            try:
-                with open(path, "w") as f:
-                    f.write(str(time.time()))
-            except OSError:
-                pass
+            if client is not None:
+                try:
+                    client.beat(rank)
+                except OSError:
+                    pass
+            if path:
+                try:
+                    with open(path, "w") as f:
+                        f.write(str(time.time()))
+                except OSError:
+                    pass
             time.sleep(1.0)
 
     _hb_thread = threading.Thread(target=beat, daemon=True)

@@ -43,7 +43,9 @@ class ElasticManager:
     def __init__(self, args=None, etcd_client=None):
         import os
 
+        self.master_ep = os.environ.get("PADDLE_ELASTIC_MASTER")
         self.enabled = bool(getattr(args, "elastic_level", 0)
+                            or self.master_ep
                             or os.environ.get("PADDLE_HEARTBEAT_DIR"))
         self.hb_dir = os.environ.get("PADDLE_HEARTBEAT_DIR")
         self.timeout = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT",
@@ -53,10 +55,23 @@ class ElasticManager:
     def pre_hook(self):
         pass
 
+    def _client(self):
+        from ..launch.master import MembershipClient
+
+        return MembershipClient(self.master_ep)
+
     def peers(self):
-        """(rank, seconds-since-last-beat) for every registered worker."""
+        """(rank, seconds-since-last-beat) for every registered worker.
+        Prefers the cross-host membership master (launch/master.py —
+        the reference's etcd registry); falls back to the single-host
+        heartbeat directory."""
         import os
 
+        if self.master_ep:
+            try:
+                return self._client().peers()
+            except OSError:
+                return []
         if not self.hb_dir or not os.path.isdir(self.hb_dir):
             return []
         now = time.time()
@@ -89,6 +104,11 @@ class ElasticManager:
     def pending_joins(self):
         """Join requests awaiting the launcher (reference ETCDMaster
         node-arrival watch)."""
+        if self.master_ep:
+            try:
+                return self._client().pending_joins()
+            except OSError:
+                return 0
         return len(pending_join_files(self.hb_dir))
 
 
@@ -108,34 +128,42 @@ def pending_join_files(hb_dir):
         if f.startswith(JOIN_PREFIX))
 
 
-def request_scale_out(n=1, hb_dir=None):
-    """Ask the launcher to admit `n` joining worker(s): drops join_*
-    request files in the heartbeat directory. A launcher running with
-    --elastic_level>=1 tears the pod down (RC_SCALE_OUT) and re-forms it
-    with nproc+n contiguous ranks; workers resume from the latest
-    complete checkpoint and re-shard DistributedBatchSampler at the new
-    world size (reference: elastic/manager.py:127 ETCDMaster re-ranks on
-    peer ARRIVAL; launch/controllers/master.py:175).
+def request_scale_out(n=1, hb_dir=None, master=None):
+    """Ask the launcher to admit `n` joining worker(s). A launcher
+    running with --elastic_level>=1 tears the pod down (RC_SCALE_OUT)
+    and re-forms it with nproc+n contiguous ranks; workers resume from
+    the latest complete checkpoint and re-shard
+    DistributedBatchSampler at the new world size (reference:
+    elastic/manager.py:127 ETCDMaster re-ranks on peer ARRIVAL;
+    launch/controllers/master.py:175).
 
-    Call from an operator process or any worker (typically rank 0 when
-    new capacity is detected). SINGLE-NODE pods only: with --nnodes>1
-    each launcher watches only its local heartbeat dir, so a join there
-    would desynchronize PADDLE_TRAINERS_NUM across nodes (the launcher
-    refuses --elastic_level>=1 with --nnodes>1 for the same reason).
-    Returns the number of request files written."""
+    Transport: with a membership master active (PADDLE_ELASTIC_MASTER,
+    or the `master` endpoint argument — e.g. an operator box or second
+    "node" that shares NOTHING but the endpoint with the pod), the
+    request is one RPC to the launcher's registry. Fallback: join_*
+    request files in the shared heartbeat directory (single host).
+    Returns n."""
     import os
     import uuid
 
+    master = master or os.environ.get("PADDLE_ELASTIC_MASTER")
+    if master:
+        from ..launch.master import MembershipClient
+
+        MembershipClient(master).join(n)
+        return n
     hb_dir = hb_dir or os.environ.get("PADDLE_HEARTBEAT_DIR")
     if not hb_dir:
         raise RuntimeError(
-            "request_scale_out needs the launcher heartbeat dir "
+            "request_scale_out needs a membership master "
+            "(PADDLE_ELASTIC_MASTER) or the launcher heartbeat dir "
             "(PADDLE_HEARTBEAT_DIR) — start the job via "
             "paddle_tpu.distributed.launch")
     if int(os.environ.get("PADDLE_NNODES", "1")) > 1:
         raise RuntimeError(
-            "request_scale_out is single-node-pod scoped; multi-node "
-            "scale-out needs a shared membership service")
+            "file-based request_scale_out is single-node-pod scoped; "
+            "multi-node scale-out goes through the membership master "
+            "(PADDLE_ELASTIC_MASTER)")
     os.makedirs(hb_dir, exist_ok=True)
     for _ in range(n):
         path = os.path.join(hb_dir, JOIN_PREFIX + uuid.uuid4().hex[:8])

@@ -128,9 +128,19 @@ def schedule_ticks(M, pp, num_virtual=1):
 
 def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
                   y_micro, pp, remat, num_virtual=1, dp_axis=None,
-                  sum_axes=None):
-    """Inside shard_map over 'pp'. Returns (loss_sum, param_grads,
+                  sum_axes=None, aux_weight=None):
+    """Inside shard_map over 'pp'. Returns (loss, aux, param_grads,
     post_grads, dx_micro).
+
+    aux_weight: when not None, block_fn returns (y, aux_scalar) — an
+    auxiliary loss produced INSIDE the stage body (e.g. the MoE
+    load-balancing term, reference moe_layer.py gates) — and the total
+    loss becomes mean_loss + aux_weight·mean_aux. The aux accumulator
+    rides the same carry as loss_sum; its gradient is seeded into each
+    backward tick's block vjp (cotangent aux_weight per valid unit), so
+    aux grads flow through the identical psum/pmean reductions as the
+    loss grads. The aux value follows the loss's partial-sum convention
+    under sum_axes (blocks must pre-scale, as loss_fn does).
 
     Generalized tick-interleaved schedule (reference:
     fleet/meta_parallel/pipeline_parallel.py:416
@@ -178,8 +188,25 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
     # remat: False -> off, True -> keep nothing, str/callable -> policy
     from ..recompute import checkpoint_policy
 
-    blk = (jax.checkpoint(block_fn, policy=checkpoint_policy(remat))
-           if remat else block_fn)
+    has_aux = aux_weight is not None
+    aw = float(aux_weight) if has_aux else 0.0
+    # The block's aux is GLOBAL (its statistics are reduced over dp and
+    # the sum_axes; value pre-scaled 1/prod(sum_axes)), so each rank's
+    # vjp yields only its PARTIAL of d(aux)/dθ on the pre-scaled output.
+    # The grads then ride the loss reductions (psum over sum_axes, pmean
+    # over dp, ×1/dp on dx) — seeding the cotangent with
+    # aw·|sum_axes|·|dp| makes those reductions reassemble exactly
+    # aw·d(aux_global).
+    aux_seed = aw
+    if has_aux:
+        if dp_axis is not None:
+            aux_seed *= mesh_mod.axis_size(dp_axis)
+        for ax in (sum_axes or ()):
+            aux_seed *= mesh_mod.axis_size(ax)
+    blk0 = (block_fn if has_aux
+            else (lambda p, x: (block_fn(p, x), jnp.zeros([], jnp.float32))))
+    blk = (jax.checkpoint(blk0, policy=checkpoint_policy(remat))
+           if remat else blk0)
     micro_shape = x_micro.shape[1:]
 
     def chunk_params(v):
@@ -195,7 +222,8 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         return q, rem // pp, rem % pp
 
     def tick(carry, t):
-        saved, pgrads, hgrads, dxs, loss_sum, fwd_recv, bwd_recv = carry
+        (saved, pgrads, hgrads, dxs, loss_sum, aux_sum, fwd_recv,
+         bwd_recv) = carry
 
         # ---------------- forward micro-step ----------------
         u = t - stage
@@ -205,7 +233,9 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         fwd_valid = (u >= 0) & (u <= u_max) & (mf < M)
         mf_c = jnp.clip(mf, 0, M - 1)
         x_in = jnp.where((stage == 0) & (vf == 0), x_micro[mf_c], fwd_recv)
-        out = blk(chunk_params(vf), x_in)
+        out, aux_f = blk(chunk_params(vf), x_in)
+        aux_sum = aux_sum + jnp.where(fwd_valid, aux_f,
+                                      0.0).astype(jnp.float32)
         # only save valid units: clipped ticks must not overwrite a slot
         # whose unit is still awaiting backward
         saved = lax.cond(
@@ -232,7 +262,7 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         # block output (gated: other stages/chunks skip it entirely),
         # interior logical stages use the received cotangent.
         params_b = chunk_params(vb)
-        out_b, vjp_blk = jax.vjp(blk, params_b, x_saved)
+        (out_b, _aux_b), vjp_blk = jax.vjp(blk, params_b, x_saved)
         is_head = (stage == pp - 1) & (vb == V - 1) & bwd_valid
 
         def head_branch(ob, y):
@@ -248,7 +278,12 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         loss_val, d_out, dh_l = lax.cond(
             is_head, head_branch, skip_branch, out_b, y_mb)
         cot = jnp.where(is_head, d_out, bwd_recv)
-        dparams, dx = vjp_blk(cot)
+        # aux cotangent per valid backward unit — aux grads accumulate
+        # into pgrads/dx on exactly the loss grads' reduction path (see
+        # aux_seed above for the dp/sum_axes scaling)
+        aux_cot = jnp.where(bwd_valid, jnp.float32(aux_seed),
+                            jnp.float32(0.0))
+        dparams, dx = vjp_blk((cot, aux_cot))
 
         if V == 1:
             pgrads = _tree_add_masked(pgrads, dparams, bwd_valid)
@@ -275,7 +310,7 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
             out, "pp", [(i, (i + 1) % pp) for i in range(pp)])
         bwd_recv = lax.ppermute(
             dx, "pp", [(i, (i - 1) % pp) for i in range(pp)])
-        return (saved, pgrads, hgrads, dxs, loss_sum, fwd_recv,
+        return (saved, pgrads, hgrads, dxs, loss_sum, aux_sum, fwd_recv,
                 bwd_recv), None
 
     init = (
@@ -284,17 +319,20 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         _tree_zeros(post_params),                           # head grads
         jnp.zeros_like(x_micro),                            # input cotangents
         jnp.zeros([], jnp.float32),                         # loss sum
+        jnp.zeros([], jnp.float32),                         # aux sum
         jnp.zeros(micro_shape, x_micro.dtype),              # fwd ring reg
         jnp.zeros(micro_shape, x_micro.dtype),              # bwd ring reg
     )
-    (saved, pgrads, hgrads, dxs, loss_sum, _, _), _ = lax.scan(
+    (saved, pgrads, hgrads, dxs, loss_sum, aux_sum, _, _), _ = lax.scan(
         tick, init, jnp.arange(T))
 
     # replicate stage-local results: loss/head-grads live on the last
     # stage, dx on stage 0 — psum of the masked values broadcasts them.
     # Each micro was seeded with cotangent 1.0, so grads of the MEAN loss
-    # need the 1/M factor.
+    # need the 1/M factor. Each stage accumulated ITS chunks' aux, so the
+    # pp-psum assembles aux across the whole layer stack.
     loss = lax.psum(loss_sum, "pp") / M
+    aux = lax.psum(aux_sum, "pp") / M
     inv_m = 1.0 / M
     pgrads = jax.tree_util.tree_map(lambda g: g * inv_m, pgrads)
     hgrads = jax.tree_util.tree_map(
@@ -308,6 +346,7 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         # cotangent contributions.
         for ax in sum_axes:
             loss = lax.psum(loss, ax)
+            aux = lax.psum(aux, ax)
             pgrads = jax.tree_util.tree_map(
                 lambda g, _ax=ax: lax.psum(g, _ax), pgrads)
             hgrads = jax.tree_util.tree_map(
@@ -321,28 +360,39 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         # the GLOBAL mean loss, hence the 1/dp factor.
         inv_dp = 1.0 / mesh_mod.axis_size(dp_axis)
         loss = lax.pmean(loss, dp_axis)
+        aux = lax.pmean(aux, dp_axis)
         pgrads = jax.tree_util.tree_map(
             lambda g: lax.pmean(g, dp_axis), pgrads)
         hgrads = jax.tree_util.tree_map(
             lambda g: lax.pmean(g, dp_axis), hgrads)
         dxs = dxs * inv_dp
-    return loss, pgrads, hgrads, dxs
+    return loss + aw * aux, aux, pgrads, hgrads, dxs
 
 
 def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
-                          batch, specs=None):
+                          batch, specs=None, aux_weight=None):
     """Forward-only fill-drain pipeline loss (eval path — no gradient
     machinery, M + pp − 1 ticks instead of the 1F1B schedule's fwd+bwd).
-    `specs` composes mp/dp exactly as in `pipeline_1f1b`."""
+    `specs` composes mp/dp exactly as in `pipeline_1f1b`. With
+    `aux_weight`, block_fn returns (y, aux) and the result is the pair
+    (loss + aux_weight·mean_aux, mean_aux)."""
     mesh = mesh_mod.global_mesh()
     pp = mesh.shape["pp"]
+    has_aux = aux_weight is not None
+    aw = float(aux_weight) if has_aux else 0.0
+    blk = (block_fn if has_aux else
+           (lambda p, x: (block_fn(p, x), jnp.zeros([], jnp.float32))))
     x_micro, y_micro = batch
     M = x_micro.shape[0]
     if pp == 1:
-        losses = jax.vmap(
-            lambda x, y: loss_fn(block_fn(stacked_params, x), y,
-                                 post_params))(x_micro, y_micro)
-        return jnp.mean(losses)
+        def one(x, y):
+            out, a = blk(stacked_params, x)
+            return loss_fn(out, y, post_params), a
+
+        losses, auxs = jax.vmap(one)(x_micro, y_micro)
+        aux = jnp.mean(auxs)
+        loss = jnp.mean(losses) + aw * aux
+        return (loss, aux) if has_aux else loss
     sp = specs if specs is not None else PipelineSpecs()
 
     def per_stage(params, post_params, xs, ys):
@@ -350,28 +400,33 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
         T = M + pp - 1
 
         def tick(carry, t):
-            loss_sum, fwd_recv = carry
+            loss_sum, aux_sum, fwd_recv = carry
             mf = t - stage
             valid = (mf >= 0) & (mf < M)
             mf_c = jnp.clip(mf, 0, M - 1)
             x_in = jnp.where(stage == 0, xs[mf_c], fwd_recv)
-            out = block_fn(params, x_in)
+            out, aux_f = blk(params, x_in)
             lv = loss_fn(out, ys[mf_c], post_params)
             loss_sum = loss_sum + jnp.where(
                 valid & (stage == pp - 1), lv, 0.0).astype(jnp.float32)
+            aux_sum = aux_sum + jnp.where(valid, aux_f,
+                                          0.0).astype(jnp.float32)
             fwd_recv = lax.ppermute(
                 out, "pp", [(i, (i + 1) % pp) for i in range(pp)])
-            return (loss_sum, fwd_recv), None
+            return (loss_sum, aux_sum, fwd_recv), None
 
-        (loss_sum, _), _ = lax.scan(
-            tick, (jnp.zeros([], jnp.float32),
+        (loss_sum, aux_sum, _), _ = lax.scan(
+            tick, (jnp.zeros([], jnp.float32), jnp.zeros([], jnp.float32),
                    jnp.zeros(xs.shape[1:], xs.dtype)), jnp.arange(T))
         loss = lax.psum(loss_sum, "pp") / M
+        aux = lax.psum(aux_sum, "pp") / M
         for ax in (sp.sum_axes or ()):
             loss = lax.psum(loss, ax)
+            aux = lax.psum(aux, ax)
         if sp.dp_axis is not None:
             loss = lax.pmean(loss, sp.dp_axis)
-        return loss
+            aux = lax.pmean(aux, sp.dp_axis)
+        return loss + aw * aux, aux
 
     stack_spec = _unflatten_like(
         stacked_params, sp.stacked,
@@ -383,15 +438,16 @@ def pipeline_forward_loss(block_fn, loss_fn, stacked_params, post_params,
     run = jax.shard_map(
         per_stage, mesh=mesh,
         in_specs=(stack_spec, post_spec, x_spec, y_spec),
-        out_specs=P(),
+        out_specs=(P(), P()),
         check_vma=False,
     )
-    return run(stacked_params, post_params, x_micro, y_micro)
+    loss, aux = run(stacked_params, post_params, x_micro, y_micro)
+    return (loss, aux) if has_aux else loss
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 5, 6, 7, 8))
 def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat=True, num_virtual=1, specs=None):
+                  remat=True, num_virtual=1, specs=None, aux_weight=None):
     """Differentiable 1F1B pipeline loss.
 
     block_fn(stage_params, x) -> y   one stage's pure forward; stage_params
@@ -415,18 +471,25 @@ def pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params, batch,
     Returns the mean micro-batch loss. Differentiable w.r.t.
     stacked_params, post_params and x_micro (so an embedding stage in the
     caller composes through outer AD).
+
+    aux_weight: when not None, block_fn must return (y, aux) and the
+    result is the PAIR (loss + aux_weight·mean_aux, mean_aux). The
+    second element is a DETACHED metric — its gradient contribution is
+    already inside the first element; differentiate the first only.
     """
-    loss, _, _, _ = _pipeline_call(block_fn, loss_fn, stacked_params,
-                                   post_params, batch, remat, num_virtual,
-                                   specs)
-    return loss
+    loss, aux, _, _, _ = _pipeline_call(block_fn, loss_fn, stacked_params,
+                                        post_params, batch, remat,
+                                        num_virtual, specs, aux_weight)
+    return loss if aux_weight is None else (loss, aux)
 
 
 def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
-                   remat, num_virtual=1, specs=None):
+                   remat, num_virtual=1, specs=None, aux_weight=None):
     mesh = mesh_mod.global_mesh()
     pp = mesh.shape["pp"]
     V = num_virtual
+    has_aux = aux_weight is not None
+    aw = float(aux_weight) if has_aux else 0.0
     x_micro, y_micro = batch
     if pp == 1:
         # degenerate: straight-line execution, still micro-batched.
@@ -435,26 +498,36 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
         # the pipelined path.
         from ..recompute import checkpoint_policy
 
-        blk1 = (jax.checkpoint(block_fn, policy=checkpoint_policy(remat))
-                if remat else block_fn)
+        blk0 = (block_fn if has_aux else
+                (lambda p, x: (block_fn(p, x),
+                               jnp.zeros([], jnp.float32))))
+        blk1 = (jax.checkpoint(blk0, policy=checkpoint_policy(remat))
+                if remat else blk0)
 
         def apply_chunks(sp, x):
+            aux = jnp.zeros([], jnp.float32)
             if V == 1:
-                return blk1(sp, x)
+                x, aux = blk1(sp, x)
+                return x, aux
             for v in range(V):
-                x = blk1(
-                    jax.tree_util.tree_map(lambda a, _v=v: a[_v], sp), x)
-            return x
+                x, a = blk1(
+                    jax.tree_util.tree_map(lambda a_, _v=v: a_[_v], sp), x)
+                aux = aux + a
+            return x, aux
 
         def full(sp, hp, xm):
-            losses = jax.vmap(
-                lambda x, y: loss_fn(apply_chunks(sp, x), y, hp))(
-                xm, y_micro)
-            return jnp.mean(losses)
+            def one(x, y):
+                out, a = apply_chunks(sp, x)
+                return loss_fn(out, y, hp), a
 
-        loss, vjp = jax.vjp(full, stacked_params, post_params, x_micro)
-        pg, hg, dx = vjp(jnp.ones_like(loss))
-        return loss, pg, hg, dx
+            losses, auxs = jax.vmap(one)(xm, y_micro)
+            aux = jnp.mean(auxs)
+            return jnp.mean(losses) + aw * aux, aux
+
+        (loss, aux), vjp = jax.vjp(full, stacked_params, post_params,
+                                   x_micro)
+        pg, hg, dx = vjp((jnp.ones_like(loss), jnp.zeros_like(aux)))
+        return loss, aux, pg, hg, dx
 
     sp = specs if specs is not None else PipelineSpecs()
     stack_spec = _unflatten_like(
@@ -471,25 +544,31 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
     run = jax.shard_map(
         functools.partial(_run_schedule, block_fn, loss_fn, pp=pp,
                           remat=remat, num_virtual=V, dp_axis=sp.dp_axis,
-                          sum_axes=sp.sum_axes),
+                          sum_axes=sp.sum_axes, aux_weight=aux_weight),
         mesh=mesh,
         in_specs=(stack_spec, post_spec, x_spec, y_spec),
-        out_specs=(P(), stack_spec, post_spec, x_spec),
+        out_specs=(P(), P(), stack_spec, post_spec, x_spec),
         check_vma=False,
     )
     return run(stacked_params, post_params, x_micro, y_micro)
 
 
 def _pipeline_fwd(block_fn, loss_fn, stacked_params, post_params, batch,
-                  remat, num_virtual=1, specs=None):
-    loss, pg, hg, dx = _pipeline_call(block_fn, loss_fn, stacked_params,
-                                      post_params, batch, remat,
-                                      num_virtual, specs)
-    return loss, (pg, hg, dx, batch[1])
+                  remat, num_virtual=1, specs=None, aux_weight=None):
+    loss, aux, pg, hg, dx = _pipeline_call(
+        block_fn, loss_fn, stacked_params, post_params, batch, remat,
+        num_virtual, specs, aux_weight)
+    out = loss if aux_weight is None else (loss, aux)
+    return out, (pg, hg, dx, batch[1])
 
 
-def _pipeline_bwd(block_fn, loss_fn, remat, num_virtual, specs, res, g):
+def _pipeline_bwd(block_fn, loss_fn, remat, num_virtual, specs, aux_weight,
+                  res, g):
     pg, hg, dx, y = res
+    if aux_weight is not None:
+        # second output is a detached metric: its cotangent is dropped
+        # (the aux gradient is already inside the total-loss grads)
+        g, _ = g
     scale = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
     return (scale(pg), scale(hg),
             (scale(dx), jax.tree_util.tree_map(jnp.zeros_like, y)))
@@ -518,7 +597,7 @@ def interleaved_stacking_order(pp, num_virtual):
 
 def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
                               post_params, batch, num_virtual=1,
-                              remat=True, specs=None):
+                              remat=True, specs=None, aux_weight=None):
     """Tick-interleaved virtual-stage 1F1B loss (reference:
     fleet/meta_parallel/pipeline_parallel.py:416
     PipelineParallelWithInterleave, parallel_layers/pp_layers.py:198).
@@ -534,6 +613,9 @@ def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
     (independent of M — the 1F1B property).
 
     Returns mean micro-loss; differentiable w.r.t. params/post/x_micro.
+    With `aux_weight`, block_fn returns (y, aux) and the result is the
+    (loss + aux_weight·mean_aux, detached mean_aux) pair — same contract
+    as `pipeline_1f1b`.
     NOTE: like `pipeline_1f1b`, the custom_vjp treats labels (y_micro) as
     non-differentiable — their cotangent is zero. Losses that need label
     gradients (e.g. soft-label distillation) must route the differentiable
@@ -545,4 +627,4 @@ def interleaved_pipeline_loss(block_fn, loss_fn, stacked_params,
         raise ValueError(
             f"stacked_params leading dim {lead} != pp*V = {pp}*{num_virtual}")
     return pipeline_1f1b(block_fn, loss_fn, stacked_params, post_params,
-                         batch, remat, num_virtual, specs)
+                         batch, remat, num_virtual, specs, aux_weight)

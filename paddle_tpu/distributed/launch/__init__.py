@@ -80,7 +80,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank, master, nproc=None):
+def _worker_env(args, local_rank, master, nproc=None, mm_endpoint=None):
     nproc = nproc if nproc is not None else args.nproc_per_node
     world = args.nnodes * nproc
     rank = args.rank * nproc + local_rank
@@ -96,12 +96,14 @@ def _worker_env(args, local_rank, master, nproc=None):
         "PADDLE_HEARTBEAT_DIR": os.path.join(args.log_dir, "hb"),
         "PADDLE_ELASTIC_TIMEOUT": str(args.elastic_timeout),
     })
+    if mm_endpoint:
+        env["PADDLE_ELASTIC_MASTER"] = mm_endpoint
     if args.devices is not None:
         env["TPU_VISIBLE_DEVICES"] = args.devices
     return env
 
 
-def _spawn_pod(args, master, nproc=None):
+def _spawn_pod(args, master, nproc=None, mm=None):
     """Start nproc workers; local rank 0 inherits the console."""
     nproc = nproc if nproc is not None else args.nproc_per_node
     os.makedirs(args.log_dir, exist_ok=True)
@@ -111,6 +113,8 @@ def _spawn_pod(args, master, nproc=None):
     # touched: they are consumed only by launch() after counting, so a
     # request landing during a teardown window is admitted next round
     # instead of silently dropped.
+    if mm is not None:
+        mm.reset_beats()
     for f in os.listdir(hb_dir):
         if f.startswith("hb_"):
             try:
@@ -120,7 +124,8 @@ def _spawn_pod(args, master, nproc=None):
     procs = []
     cmd = [sys.executable, args.training_script] + args.training_script_args
     for lr in range(nproc):
-        env = _worker_env(args, lr, master, nproc)
+        env = _worker_env(args, lr, master, nproc,
+                          mm_endpoint=mm.endpoint if mm else None)
         rank = env["PADDLE_TRAINER_ID"]
         if lr == 0:
             out = None  # inherit
@@ -147,23 +152,52 @@ def _pending_joins(hb_dir):
     return pending_join_files(hb_dir)
 
 
+def _stale_beats(mm, hb_dir, hb_timeout):
+    """(name, age) of workers whose heartbeat exceeds hb_timeout — from
+    the membership master when one is active (cross-host, no shared
+    FS), else from the heartbeat directory's file mtimes."""
+    if mm is not None:
+        return [(f"rank {r}", age) for r, age in mm.peers()
+                if age > hb_timeout]
+    out = []
+    now = time.time()
+    try:
+        beats = os.listdir(hb_dir)
+    except OSError:
+        beats = []
+    for f in beats:
+        if not f.startswith("hb_"):
+            continue  # join_* requests are not heartbeats
+        try:
+            age = now - os.path.getmtime(os.path.join(hb_dir, f))
+        except OSError:
+            continue
+        if age > hb_timeout:
+            out.append((f, age))
+    return out
+
+
 def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
-              rank_base=0, watch_joins=False):
+              rank_base=0, watch_joins=False, mm=None):
     """Block until all exit ok or one fails (then kill the rest).
 
-    With a heartbeat dir, a worker whose beat file goes stale for longer
-    than hb_timeout is declared HUNG and fails the pod — liveness alone
-    misses a worker wedged in a dead collective (reference: etcd
-    heartbeat TTL, elastic/manager.py:234). Only workers that have
-    beaten at least once are monitored, so non-paddle scripts that never
-    call init_parallel_env are unaffected. With watch_joins, a join_*
-    request file tears the pod down with RC_SCALE_OUT so the caller can
-    re-form it at the larger size (reference scale-out on node join)."""
+    A worker whose heartbeat goes stale for longer than hb_timeout is
+    declared HUNG and fails the pod — liveness alone misses a worker
+    wedged in a dead collective (reference: etcd heartbeat TTL,
+    elastic/manager.py:234). Beats come from the membership master
+    (`mm`, launch/master.py — cross-host) or the heartbeat dir
+    fallback. Only workers that have beaten at least once are
+    monitored, so non-paddle scripts that never call init_parallel_env
+    are unaffected. With watch_joins, a pending join request tears the
+    pod down with RC_SCALE_OUT so the caller can re-form it at the
+    larger size (reference scale-out on node join)."""
     alive = {i: p for i, (p, _) in enumerate(procs)}
     failed_rc = 0
     while alive and not failed_rc:
         time.sleep(poll_s)
-        if watch_joins and hb_dir and _pending_joins(hb_dir):
+        if watch_joins and (
+                (mm is not None and mm.pending_joins())
+                or (hb_dir and _pending_joins(hb_dir))):
             failed_rc = RC_SCALE_OUT
             break
         for i, p in list(alive.items()):
@@ -173,34 +207,26 @@ def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
             del alive[i]
             if rc != 0:
                 failed_rc = rc
-            elif hb_dir:
+            else:
                 # clean exit: drop the worker's beat so the staleness
                 # monitor doesn't mistake "finished" for "wedged" (the
                 # worker's own atexit does this too; SIGKILL'd-after-done
                 # edge cases land here)
-                try:
-                    os.unlink(os.path.join(hb_dir, f"hb_{rank_base + i}"))
-                except OSError:
-                    pass
-        if not failed_rc and hb_dir and hb_timeout > 0:
-            now = time.time()
-            try:
-                beats = os.listdir(hb_dir)
-            except OSError:
-                beats = []
-            for f in beats:
-                if not f.startswith("hb_"):
-                    continue  # join_* requests are not heartbeats
-                try:
-                    age = now - os.path.getmtime(os.path.join(hb_dir, f))
-                except OSError:
-                    continue
-                if age > hb_timeout:
-                    print(f"[launch] worker {f} heartbeat stale "
-                          f"({age:.0f}s > {hb_timeout:.0f}s): pod hung",
-                          file=sys.stderr, flush=True)
-                    failed_rc = 98  # synthetic "hung" exit code
-                    break
+                if mm is not None:
+                    mm.clear_rank(rank_base + i)
+                if hb_dir:
+                    try:
+                        os.unlink(os.path.join(hb_dir,
+                                               f"hb_{rank_base + i}"))
+                    except OSError:
+                        pass
+        if not failed_rc and hb_timeout > 0 and (mm is not None or hb_dir):
+            for name, age in _stale_beats(mm, hb_dir, hb_timeout):
+                print(f"[launch] worker {name} heartbeat stale "
+                      f"({age:.0f}s > {hb_timeout:.0f}s): pod hung",
+                      file=sys.stderr, flush=True)
+                failed_rc = 98  # synthetic "hung" exit code
+                break
     for p in alive.values():
         p.send_signal(signal.SIGTERM)
     deadline = time.time() + 10
@@ -225,12 +251,27 @@ def launch(argv=None):
             sys.exit("--master is required when --nnodes > 1")
         master = f"127.0.0.1:{_free_port()}"
     if args.elastic_level >= 1 and args.nnodes > 1:
-        # each launcher watches only its LOCAL heartbeat dir; scaling one
-        # node's pod would desynchronize PADDLE_TRAINERS_NUM across nodes
+        # membership (heartbeats/joins) is cross-host via the
+        # MembershipMaster, but pod RE-FORMING at a new size is still
+        # coordinated per launcher invocation — multi-node re-forms
+        # would need the launchers themselves to rendezvous
         sys.exit("--elastic_level>=1 is single-node-pod scoped "
-                 "(multi-node elastics need a shared membership service)")
+                 "(cross-host membership is available via "
+                 "PADDLE_ELASTIC_MASTER, but pod re-forming is not "
+                 "multi-node yet)")
     nproc = args.nproc_per_node
     hb_dir = os.path.join(args.log_dir, "hb")
+    # Cross-host membership registry (reference ETCDMaster role): beats
+    # and join requests flow through it, so elastic monitoring needs no
+    # shared filesystem. PADDLE_TPU_MEMBERSHIP=dir forces the legacy
+    # heartbeat-directory protocol.
+    from .master import MembershipMaster
+
+    # advertise an address routed toward the job coordinator so the
+    # endpoint is reachable from other hosts (loopback when single-node)
+    mm = (None if os.environ.get("PADDLE_TPU_MEMBERSHIP") == "dir"
+          else MembershipMaster(
+              route_via=master if args.nnodes > 1 else None))
     # join requests are only meaningful within ONE launch invocation —
     # a leftover from a previous job must not instantly tear down this
     # pod
@@ -243,23 +284,27 @@ def launch(argv=None):
     attempt = 0
     rc = 1
     while True:
-        procs = _spawn_pod(args, master, nproc)
+        procs = _spawn_pod(args, master, nproc, mm=mm)
         rc = _wait_pod(procs, hb_dir=hb_dir,
                        hb_timeout=args.elastic_timeout
                        if args.elastic_timeout > 0 else 0.0,
                        rank_base=args.rank * nproc,
-                       watch_joins=args.elastic_level >= 1)
+                       watch_joins=args.elastic_level >= 1, mm=mm)
         if rc == 0:
             return 0
-        join_files = (_pending_joins(hb_dir)
-                      if args.elastic_level >= 1 else [])
-        if rc == RC_SCALE_OUT and join_files:
+        n_joins = 0
+        if args.elastic_level >= 1:
+            join_files = _pending_joins(hb_dir)
+            n_joins = len(join_files)
+            if mm is not None:
+                n_joins += mm.pending_joins()
+        if rc == RC_SCALE_OUT and n_joins:
             # node join (reference ETCDMaster re-rank on peer arrival):
             # admit the joiners, re-form the pod at the larger size with
             # contiguous ranks; workers resume from the latest complete
             # checkpoint and re-shard their samplers at the new world
             # size. Not a failure: does not consume --max_restart.
-            # Consume EXACTLY the counted request files — one that lands
+            # Consume EXACTLY the counted requests — one that lands
             # between the count and the respawn survives for the next
             # watch round instead of being silently dropped.
             for path in join_files:
@@ -267,9 +312,11 @@ def launch(argv=None):
                     os.unlink(path)
                 except OSError:
                     pass
-            nproc += len(join_files)
+            if mm is not None:
+                mm.consume_joins(n_joins - len(join_files))
+            nproc += n_joins
             consecutive = 0
-            print(f"[launch] elastic scale-out: {len(join_files)} "
+            print(f"[launch] elastic scale-out: {n_joins} "
                   f"worker(s) joining; re-forming pod with {nproc} "
                   f"workers (ranks remapped 0..{nproc - 1})",
                   file=sys.stderr, flush=True)

@@ -3,12 +3,29 @@
 TPU-native re-design of the reference MoE
 (reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:244
 MoELayer with MoEScatter:88/MoEGather:135 PyLayers over the CUDA
-global_scatter/global_gather ops; gates in moe/gate/). Design: experts are
-one stacked weight tensor sharded over the 'ep' mesh axis; token dispatch
-is a capacity-bucketed einsum + `lax.all_to_all` (inside SPMD) instead of
-the reference's variable-length global_scatter — static shapes keep XLA
-fast (dropped tokens follow the standard Switch capacity-factor recipe).
+global_scatter/global_gather ops; gates in moe/gate/). Two formulations:
+
+* `MoELayer` (outside shard_map): experts are one stacked weight tensor
+  sharded over the 'ep' mesh axis via GSPMD annotations; dispatch is a
+  capacity-bucketed einsum over the full (replicated) token set and the
+  partitioner inserts the collectives. Simple, but every rank routes all
+  tokens — replication, not true EP.
+
+* `moe_a2a_dispatch_combine` (inside shard_map, e.g. the 1F1B pipeline
+  body): TRUE expert parallelism with token exchange. Each 'ep' rank
+  takes a 1/ep slice of the tokens, capacity-buckets them locally
+  (GShard grouped capacity: C = ceil(t_loc·cf/E) per group), exchanges
+  buckets with `lax.all_to_all` (dispatch AND combine, explicit
+  custom_vjp pairs like mp_ops.py), runs only its E/ep resident experts,
+  and all-gathers the combined outputs. Per-rank dispatch traffic and
+  routing FLOPs are O(tokens/ep), matching the reference's
+  global_scatter/global_gather (paddle/fluid/operators/collective/
+  global_scatter_op.cc:1, global_gather_op.cc:1) — static shapes keep
+  XLA fast (dropped tokens follow the standard Switch capacity recipe).
+  Gate statistics for the load-balancing aux loss are psum'd over 'ep'
+  so the aux term matches the full-batch (serial) computation exactly.
 """
+import functools
 import math
 
 import numpy as np
@@ -22,7 +39,178 @@ from ..ops._helpers import apply_jfn, ensure_tensor
 from ..tensor_core import Tensor
 
 __all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate",
-           "moe_dispatch_combine"]
+           "moe_dispatch_combine", "moe_a2a_dispatch_combine",
+           "ep_scatter_tokens", "ep_gather_tokens", "ep_all_to_all",
+           "moe_a2a_capacity", "switch_dispatch"]
+
+
+# ---------------------------------------------------------------------
+# explicit-SPMD collectives for token-sharded MoE (inside shard_map).
+# VJP pairing follows mp_ops.py: the cotangent of a value REPLICATED
+# over 'ep' is itself replicated, so gather/slice transpose to each
+# other with NO psum (a default psum transpose would overcount by ep).
+# ---------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def ep_scatter_tokens(x, ep, axis="ep"):
+    """Slice this rank's tokens/ep chunk of a replicated [t, ...] batch.
+    Forward: dynamic slice; backward: all_gather of the per-rank chunk
+    cotangents (dx must be replicated again)."""
+    t_loc = x.shape[0] // ep
+    r = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(x, r * t_loc, t_loc, axis=0)
+
+
+def _scatter_fwd(x, ep, axis):
+    return ep_scatter_tokens(x, ep, axis), None
+
+
+def _scatter_bwd(ep, axis, _, g):
+    return (lax.all_gather(g, axis, axis=0, tiled=True),)
+
+
+ep_scatter_tokens.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ep_gather_tokens(x_loc, axis="ep"):
+    """All-gather per-rank [t/ep, ...] chunks into the replicated [t, ...]
+    batch. Forward: tiled all_gather; backward: slice this rank's chunk
+    of the (replicated) cotangent."""
+    return lax.all_gather(x_loc, axis, axis=0, tiled=True)
+
+
+def _gather_fwd(x_loc, axis):
+    return ep_gather_tokens(x_loc, axis), x_loc.shape[0]
+
+
+def _gather_bwd(axis, t_loc, g):
+    r = lax.axis_index(axis)
+    return (lax.dynamic_slice_in_dim(g, r * t_loc, t_loc, axis=0),)
+
+
+ep_gather_tokens.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def ep_all_to_all(x, axis="ep"):
+    """All-to-all over leading dim [ep, ...]: rank r's chunk j goes to
+    rank j's slot r (the reference's global_scatter/global_gather wire
+    format, static-shape). Self-adjoint: the transpose of an all-to-all
+    is the same all-to-all (cotangent of my slot r flows back to rank
+    r's chunk for me)."""
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+
+
+def _a2a_fwd(x, axis):
+    return ep_all_to_all(x, axis), None
+
+
+def _a2a_bwd(axis, _, g):
+    return (ep_all_to_all(g, axis),)
+
+
+ep_all_to_all.defvjp(_a2a_fwd, _a2a_bwd)
+
+
+def switch_dispatch(probs, num_experts, capacity, dtype):
+    """Shared top-1 (switch) dispatch recipe: argmax routing, per-expert
+    cumsum positions, capacity overflow-drop, one-hot dispatch tensor.
+    Returns (disp [E, t, C], top_p [t], onehot [t, E]) — the ONE place
+    the capacity/keep logic lives (a2a path, in-pipeline dense path)."""
+    top_idx = jnp.argmax(probs, axis=-1)
+    top_p = jnp.take_along_axis(probs, top_idx[:, None], -1)[:, 0]
+    onehot = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (pos < capacity) & (onehot > 0)
+    pos_idx = pos.sum(-1).astype(jnp.int32)
+    disp = (jax.nn.one_hot(pos_idx, capacity, dtype=dtype)[:, None, :]
+            * keep[:, :, None])                   # [t, E, C]
+    return jnp.swapaxes(disp, 0, 1), top_p, onehot
+
+
+def moe_a2a_capacity(tokens, ep, num_experts, capacity_factor):
+    """Per-group (per-ep-rank) expert capacity: ceil(t_loc·cf/E) —
+    GShard's grouped formulation, giving O(tokens/ep) per-rank buffers."""
+    t_loc = tokens // ep
+    return max(1, int(math.ceil(t_loc * capacity_factor / num_experts)))
+
+
+def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
+                             capacity_factor=1.25, axis="ep",
+                             stat_axes=None, n_stat_shards=None):
+    """Token-sharded switch (top-1) routing with all-to-all exchange.
+
+    Must run inside shard_map with `axis` in scope. `x` [tokens, d] is
+    REPLICATED over `axis`; `gate_w` [d, E] replicated; `expert_fn`
+    consumes [E/ep, ep·C, d] (this rank's resident experts applied to
+    the tokens every rank dispatched to them) using the rank's LOCAL
+    expert-weight shards. Returns (out [tokens, d] replicated,
+    aux scalar) where aux is the GShard load-balancing term
+    E·Σ_e mean_prob_e·frac_tokens_e over the FULL token set (gate
+    statistics psum'd over `axis` — matches the serial computation
+    exactly).
+
+    Capacity/overflow is per GROUP (each rank's tokens/ep slice,
+    C = ceil(t_loc·cf/E)): the standard GShard/Switch grouped recipe.
+    With cf high enough that no token overflows, the output is exactly
+    the serial full-batch routing (positions differ, kept set doesn't).
+
+    stat_axes/n_stat_shards: mesh axes the aux gate statistics are
+    psum'd over and the number of token shards they span — pass ALL
+    token-sharding axes (e.g. ('ep','dp','sp')) to make aux the exact
+    GLOBAL-batch value on every rank. Defaults to ((axis,), ep).
+
+    (reference: moe_layer.py:88 MoEScatter / :135 MoEGather over
+    global_scatter_op.cc / global_gather_op.cc — variable-length brpc
+    exchange; here fixed-capacity buckets over one XLA all-to-all.)
+    """
+    t, d = x.shape
+    if t % ep:
+        raise ValueError(
+            f"token count {t} not divisible by ep={ep}; pick "
+            "batch/micro/sequence sharding so each ep group is equal")
+    if num_experts % ep:
+        raise ValueError(
+            f"num_experts={num_experts} not divisible by ep={ep}")
+    from .fleet.meta_parallel.mp_ops import allreduce_mp, copy_to_mp
+
+    t_loc = t // ep
+    e_loc = num_experts // ep
+    C = moe_a2a_capacity(t, ep, num_experts, capacity_factor)
+
+    x_loc = ep_scatter_tokens(x, ep, axis)            # [t_loc, d]
+    # each rank computes a DIFFERENT token slice, so the replicated
+    # gate weight accumulates partial grads per rank — the psum-backward
+    # bracket (mp_ops copy_to_mp) restores the full-batch gate gradient
+    logits = x_loc @ copy_to_mp(gate_w, axis)         # [t_loc, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    disp, top_p, onehot = switch_dispatch(probs, num_experts, C, x.dtype)
+
+    # aux over the FULL batch: per-rank means psum'd over the token
+    # groups (slices partition the tokens, so mean = psum(mean)/n).
+    # allreduce_mp = psum forward / identity backward: each rank's
+    # cotangent on its LOCAL probs is exactly ∂aux/∂probs_local (me/ce
+    # are replicated values), a raw psum would n×-overcount.
+    s_axes = tuple(stat_axes) if stat_axes else (axis,)
+    n_sh = n_stat_shards if n_stat_shards is not None else ep
+    me = allreduce_mp(probs.mean(axis=0), s_axes) / n_sh
+    ce = allreduce_mp(onehot.mean(axis=0), s_axes) / n_sh
+    aux = num_experts * jnp.sum(me * ce)
+
+    send = jnp.einsum("etc,td->ecd", disp, x_loc)     # [E, C, d]
+    # group experts by owner (contiguous E/ep blocks — matches the 'ep'
+    # sharding of the stacked expert weights) and exchange
+    recv = ep_all_to_all(send.reshape(ep, e_loc, C, d), axis)
+    expert_in = jnp.transpose(recv, (1, 0, 2, 3)).reshape(
+        e_loc, ep * C, d)
+    expert_out = expert_fn(expert_in)                 # [e_loc, ep·C, d]
+    back = jnp.transpose(
+        expert_out.reshape(e_loc, ep, C, d), (1, 0, 2, 3))
+    ret = ep_all_to_all(back, axis).reshape(num_experts, C, d)
+    out_loc = jnp.einsum("etc,ecd->td", disp, ret)
+    out_loc = out_loc * top_p[:, None].astype(x.dtype)
+    return ep_gather_tokens(out_loc, axis), aux
 
 
 class NaiveGate(nn.Layer):

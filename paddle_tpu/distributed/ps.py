@@ -583,6 +583,22 @@ class ShardedSparseTable:
         self._pending_ids = []
         self._pending_grads = []
         self._push_calls = 0
+        import threading
+
+        self._local_lock = threading.Lock()
+        self._io_pool = None   # lazy persistent executor (pull hot path)
+
+    def _io_executor(self):
+        """Long-lived thread pool for per-peer serve/recv concurrency —
+        spawning 2·world threads on every pull would rival the latency
+        the concurrency hides."""
+        if self._io_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._io_pool = ThreadPoolExecutor(
+                max_workers=max(2, 2 * (self.world - 1)),
+                thread_name_prefix="ps-io")
+        return self._io_pool
 
     def __len__(self):
         return len(self.local)
@@ -608,10 +624,18 @@ class ShardedSparseTable:
             for arr, tag in zip(arrays, tags):
                 xproc.send_np(arr[sel], r, tag)
         parts = [[arr[owner == self.rank]] for arr in arrays]
-        for r in self._peers():
-            for k, tag in enumerate(tags):
-                parts[k].append(
-                    xproc.recv_np(r, tag, timeout_ms=self.timeout_ms))
+        peers = self._peers()
+        if peers:
+            # per-peer recvs run CONCURRENTLY (arrival order across peers
+            # is arbitrary; a sequential loop made latency linear in
+            # world size — round-4 weak spot)
+            def _recv_peer(r):
+                return [xproc.recv_np(r, tag, timeout_ms=self.timeout_ms)
+                        for tag in tags]
+
+            for got in self._io_executor().map(_recv_peer, peers):
+                for k, arr in enumerate(got):
+                    parts[k].append(arr)
         return [np.concatenate(p) for p in parts]
 
     def pull(self, ids):
@@ -630,18 +654,46 @@ class ShardedSparseTable:
         for r in self._peers():
             xproc.send_np(uniq[owner == r], r, self._TAG_PULL_REQ)
         mine = owner == self.rank
-        rows[mine] = self.local.pull(uniq[mine]) if mine.any() else 0
-        # 2) serve each peer's request from the local shard
-        for r in self._peers():
-            want = xproc.recv_np(r, self._TAG_PULL_REQ,
-                                 timeout_ms=self.timeout_ms)
-            served = (self.local.pull(want) if len(want)
-                      else np.zeros((0, self.dim), np.float32))
-            xproc.send_np(served, r, self._TAG_PULL_ROWS)
-        # 3) responses preserve request order: scatter by owner mask
-        for r in self._peers():
-            rows[owner == r] = xproc.recv_np(r, self._TAG_PULL_ROWS,
-                                             timeout_ms=self.timeout_ms)
+        with self._local_lock:
+            rows[mine] = self.local.pull(uniq[mine]) if mine.any() else 0
+        # 2+3) serve each peer's request from the local shard AND collect
+        # responses, all peers CONCURRENTLY — a slow peer no longer
+        # stalls serving (or receiving from) the others; local table
+        # access stays serialized under a lock (create-on-touch mutates)
+        peers = self._peers()
+        if peers:
+            local_lock = self._local_lock
+
+            def _serve(r):
+                want = xproc.recv_np(r, self._TAG_PULL_REQ,
+                                     timeout_ms=self.timeout_ms)
+                with local_lock:
+                    served = (self.local.pull(want) if len(want)
+                              else np.zeros((0, self.dim), np.float32))
+                xproc.send_np(served, r, self._TAG_PULL_ROWS)
+
+            def _recv(r):
+                return xproc.recv_np(r, self._TAG_PULL_ROWS,
+                                     timeout_ms=self.timeout_ms)
+
+            ex = self._io_executor()
+            serve_futs = [ex.submit(_serve, r) for r in peers]
+            recv_futs = [ex.submit(_recv, r) for r in peers]
+            try:
+                resp = [f.result() for f in recv_futs]
+                for f in serve_futs:
+                    f.result()
+            except Exception:
+                # a dead peer must not leak queued work into the
+                # fixed-size pool: cancel whatever hasn't started
+                # (threads already blocked in recv will expire on their
+                # own timeout)
+                for f in serve_futs + recv_futs:
+                    f.cancel()
+                raise
+            # responses preserve request order: scatter by owner mask
+            for r, got in zip(peers, resp):
+                rows[owner == r] = got
         return rows[inv] if len(ids) else \
             np.zeros((0, self.dim), np.float32)
 

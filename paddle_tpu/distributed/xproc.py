@@ -128,17 +128,29 @@ def all_gather_bytes(payload: bytes, max_len=1 << 20):
     return [mat[i, : int(lens[i])].tobytes() for i in range(len(lens))]
 
 
-# ---- point-to-point over the coordination-service KV store ----
-# (reference: ProcessGroup::Send/Recv, store/tcp_store.h; here the
-# jax.distributed coordination service IS the TCP store)
+# ---- point-to-point byte transport ----
+# (reference: brpc_ps_client.h:195 — true p2p RPC between trainers; the
+# TCPStore (store/tcp_store.h:120) is RENDEZVOUS ONLY. Same split here:
+# the jax.distributed coordination KV carries one host:port endpoint per
+# rank, then bulk payloads move over direct TCP sockets as raw bytes.
+# Fallback: PADDLE_TPU_P2P_TRANSPORT=kv routes payloads through the
+# coordination KV (base64, +33%, every byte transits the coordinator —
+# the pre-round-5 star topology, kept for debugging).)
+
+import os as _os
+import socket as _socket
+import struct as _struct
+import threading as _threading
 
 _p2p_send_seq = {}
 _p2p_recv_seq = {}
 
 # traffic accounting (tests assert PS routing is O(batch), not
-# O(world·batch); all_gather_bytes counts the full gathered matrix —
-# what every rank actually receives)
-stats = {"p2p_bytes": 0, "gather_bytes": 0}
+# O(world·batch), and that the coordinator KV carries ~0 bulk bytes
+# under the socket transport; all_gather_bytes counts the full gathered
+# matrix — what every rank actually receives)
+stats = {"p2p_bytes": 0, "gather_bytes": 0, "kv_bulk_bytes": 0,
+         "socket_bytes": 0}
 
 
 def _kv_client():
@@ -152,24 +164,197 @@ def _kv_client():
     return client
 
 
-def send_bytes(data: bytes, dst: int, tag: int = 0):
+_HDR = _struct.Struct("<iiqq")   # src, tag, seq, payload length
+
+
+class _SocketTransport:
+    """Per-process TCP transport. One listener; lazy one-way connections;
+    frames land in an inbox keyed (src, tag, seq) so out-of-order arrival
+    from different peers never blocks an unrelated recv."""
+
+    def __init__(self):
+        me = jax.process_index()
+        self._lsock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._lsock.setsockopt(_socket.SOL_SOCKET,
+                               _socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("0.0.0.0", 0))
+        self._lsock.listen(64)
+        port = self._lsock.getsockname()[1]
+        host = _os.environ.get("PADDLE_TPU_P2P_HOST") or _local_ip()
+        _kv_client().key_value_set(f"pt_p2p_ep/{me}", f"{host}:{port}")
+        self._inbox = {}
+        self._cv = _threading.Condition()
+        self._conns = {}
+        self._conn_lock = _threading.Lock()   # guards the dict only
+        t = _threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            _threading.Thread(target=self._reader, args=(conn,),
+                              daemon=True).start()
+
+    def _reader(self, conn):
+        try:
+            while True:
+                hdr = self._read_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                src, tag, seq, ln = _HDR.unpack(hdr)
+                data = self._read_exact(conn, ln)
+                if data is None:
+                    return
+                with self._cv:
+                    self._inbox[(src, tag, seq)] = data
+                    self._cv.notify_all()
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                return None
+            buf += chunk
+        return bytes(buf)
+
+    def _conn_to(self, dst, timeout_ms):
+        # per-destination slot: the global lock covers only the dict
+        # lookup; the blocking endpoint-wait + connect happen under the
+        # DESTINATION's lock, so a slow peer never stalls sends to
+        # ready peers (and concurrent first-sends to one peer connect
+        # exactly once)
+        with self._conn_lock:
+            slot = self._conns.setdefault(
+                dst, {"lock": _threading.Lock(), "sock": None})
+        with slot["lock"]:
+            if slot["sock"] is None:
+                # a peer publishes its endpoint on ITS first p2p use —
+                # honor the caller's deadline (PS budgets minutes for
+                # first-step XLA-compile rank skew)
+                ep = _kv_client().blocking_key_value_get(
+                    f"pt_p2p_ep/{dst}", timeout_ms)
+                host, port = ep.rsplit(":", 1)
+                s = _socket.create_connection(
+                    (host, int(port)), timeout=max(1, timeout_ms / 1000))
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                slot["sock"] = s
+        return slot
+
+    def send(self, data, dst, tag, seq, timeout_ms):
+        me = jax.process_index()
+        slot = self._conn_to(dst, timeout_ms)
+        with _stats_lock:
+            stats["socket_bytes"] += len(data)
+        with slot["lock"]:
+            sock = slot["sock"]
+            # a wedged peer that stops draining its socket must not
+            # block this thread forever (it holds the slot lock and an
+            # io-pool worker) — honor the caller's deadline on sends too
+            sock.settimeout(max(1.0, timeout_ms / 1000))
+            try:
+                sock.sendall(_HDR.pack(me, tag, seq, len(data)))
+                sock.sendall(data)
+            except _socket.timeout:
+                raise TimeoutError(
+                    f"p2p send timed out: dst={dst} tag={tag} seq={seq} "
+                    f"({len(data)} bytes; peer not draining)")
+            finally:
+                sock.settimeout(None)
+
+    def recv(self, src, tag, seq, timeout_ms):
+        key = (src, tag, seq)
+        deadline = timeout_ms / 1000.0
+        with self._cv:
+            if not self._cv.wait_for(lambda: key in self._inbox,
+                                     timeout=deadline):
+                raise TimeoutError(
+                    f"p2p recv timed out: src={src} tag={tag} seq={seq}")
+            return self._inbox.pop(key)
+
+
+def _local_ip():
+    """Reachable address for THIS host: route toward the job coordinator
+    (PADDLE_MASTER — the address every rank provably reaches, see
+    env.py's jax.distributed.initialize contract) and read the socket's
+    own name; works without DNS and on isolated clusters. Falls back to
+    a public-address probe, then loopback (single-host tests)."""
+    master = _os.environ.get("PADDLE_MASTER", "").rsplit(":", 1)
+    targets = []
+    if master and master[0] and master[0] not in ("127.0.0.1",
+                                                  "localhost"):
+        targets.append((master[0],
+                        int(master[1]) if len(master) > 1 and
+                        master[1].isdigit() else 80))
+    targets.append(("8.8.8.8", 80))
+    for target in targets:
+        try:
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            try:
+                s.connect(target)
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            continue
+    return "127.0.0.1"
+
+
+_transport = None
+_transport_lock = _threading.Lock()
+
+
+def _socket_transport():
+    global _transport
+    if _transport is None:
+        with _transport_lock:
+            if _transport is None:
+                _transport = _SocketTransport()
+    return _transport
+
+
+def _use_kv_transport():
+    return _os.environ.get("PADDLE_TPU_P2P_TRANSPORT", "socket") == "kv"
+
+
+_stats_lock = _threading.Lock()
+
+
+def send_bytes(data: bytes, dst: int, tag: int = 0,
+               timeout_ms: int = 600_000):
+    me = jax.process_index()
+    with _stats_lock:
+        seq = _p2p_send_seq.get((me, dst, tag), 0)
+        _p2p_send_seq[(me, dst, tag)] = seq + 1
+        stats["p2p_bytes"] += len(data)
+    if not _use_kv_transport():
+        _socket_transport().send(data, dst, tag, seq, timeout_ms)
+        return
     import base64
 
+    payload = base64.b64encode(data).decode("ascii")
+    with _stats_lock:
+        stats["kv_bulk_bytes"] += len(payload)
+    _kv_client().key_value_set(f"pt_p2p/{me}/{dst}/{tag}/{seq}", payload)
+
+
+def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
     me = jax.process_index()
-    seq = _p2p_send_seq.get((me, dst, tag), 0)
-    _p2p_send_seq[(me, dst, tag)] = seq + 1
-    stats["p2p_bytes"] += len(data)
-    _kv_client().key_value_set(
-        f"pt_p2p/{me}/{dst}/{tag}/{seq}",
-        base64.b64encode(data).decode("ascii"))
-
-
-def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 60_000) -> bytes:
+    with _stats_lock:
+        seq = _p2p_recv_seq.get((src, me, tag), 0)
+        _p2p_recv_seq[(src, me, tag)] = seq + 1
+    if not _use_kv_transport():
+        return _socket_transport().recv(src, tag, seq, timeout_ms)
     import base64
 
-    me = jax.process_index()
-    seq = _p2p_recv_seq.get((src, me, tag), 0)
-    _p2p_recv_seq[(src, me, tag)] = seq + 1
     key = f"pt_p2p/{src}/{me}/{tag}/{seq}"
     client = _kv_client()
     val = client.blocking_key_value_get(key, timeout_ms)
@@ -182,15 +367,15 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 60_000) -> bytes:
     return base64.b64decode(val)
 
 
-def send_np(arr, dst: int, tag: int = 0):
+def send_np(arr, dst: int, tag: int = 0, timeout_ms: int = 600_000):
     import io
 
     buf = io.BytesIO()
     np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
-    send_bytes(buf.getvalue(), dst, tag)
+    send_bytes(buf.getvalue(), dst, tag, timeout_ms)
 
 
-def recv_np(src: int, tag: int = 0, timeout_ms: int = 60_000):
+def recv_np(src: int, tag: int = 0, timeout_ms: int = 600_000):
     import io
 
     return np.load(io.BytesIO(recv_bytes(src, tag, timeout_ms)),

@@ -147,6 +147,13 @@ class InferenceServer:
     def start(self):
         if self._running:
             return self
+        if self._thread is not None and self._thread.is_alive():
+            # a previous stop() timed out (e.g. batcher stuck in a long
+            # first compile): restarting would spawn a SECOND batcher
+            # consuming the same queue with the revived _running flag
+            raise RuntimeError(
+                "previous batcher thread is still shutting down; "
+                "retry start() after it exits")
         self._running = True
         self._thread = threading.Thread(target=self._loop,
                                         name="infer-batcher", daemon=True)
@@ -158,7 +165,10 @@ class InferenceServer:
             self._running = False
         if self._thread is not None:
             self._thread.join(timeout=30)
-            self._thread = None
+            # only forget the thread once it actually exited — a live
+            # thread must block the next start() (see above)
+            if not self._thread.is_alive():
+                self._thread = None
 
     def __enter__(self):
         return self.start()

@@ -220,8 +220,12 @@ def run_terminal_if(pred, true_fn, false_fn, vals=(), where="<if>"):
 def run_while(test_fn, body_fn, vals, names, where="<while>"):
     t0 = test_fn(*vals)
     if not _is_traced(t0):
-        while bool(np.asarray(_raw(test_fn(*vals)))):
+        # reuse t0 for the first decision: re-evaluating the test would
+        # run its side effects one extra time vs the original loop
+        t = t0
+        while bool(np.asarray(_raw(t))):
             vals = body_fn(*vals)
+            t = test_fn(*vals)
         return vals
     treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
 
@@ -678,32 +682,33 @@ def convert(fn):
     ast.increment_lineno(tree, fn.__code__.co_firstlineno - 1)
 
     freevars = fn.__code__.co_freevars
-    if freevars:
-        outer = ast.FunctionDef(
-            name="__ag_outer__",
-            args=ast.arguments(
-                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
-                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
-                defaults=[]),
-            body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
-                                                  ctx=ast.Load()))],
-            decorator_list=[])
-        tree.body = [outer]
-        ast.fix_missing_locations(tree)
-        ast.increment_lineno(tree, 0)
+    # The runtime is injected as a CLOSURE CELL, not a global: the
+    # converted body is always nested in an __ag_outer__ whose params
+    # are the original free variables plus __paddle_tpu_autograph__, so
+    # exec runs against the user's REAL module globals untouched —
+    # `global x` writes keep mutating the module (STORE_GLOBAL bypasses
+    # dict-subclass overrides, so a chained-dict shim cannot provide
+    # that), and converting a function never adds a binding to it.
+    outer = ast.FunctionDef(
+        name="__ag_outer__",
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in freevars]
+            + [ast.arg(arg="__paddle_tpu_autograph__")],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                              ctx=ast.Load()))],
+        decorator_list=[])
+    tree.body = [outer]
+    ast.fix_missing_locations(tree)
+    ast.increment_lineno(tree, 0)
     code = compile(tree, filename=fn.__code__.co_filename, mode="exec")
-    globalns = fn.__globals__
-    # collision-proof runtime binding: always overwrite — a user
-    # variable of this (mangled) name would otherwise shadow the
-    # runtime and break every converted function in the module
-    globalns["__paddle_tpu_autograph__"] = _runtime_module()
     localns = {}
-    exec(code, globalns, localns)
-    if freevars:
-        cells = [c.cell_contents for c in fn.__closure__]
-        new_fn = localns["__ag_outer__"](*cells)
-    else:
-        new_fn = localns[fdef.name]
+    exec(code, fn.__globals__, localns)
+    cells = ([c.cell_contents for c in fn.__closure__]
+             if freevars else [])
+    new_fn = localns["__ag_outer__"](*cells, _runtime_module())
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__
     functools.update_wrapper(new_fn, fn)

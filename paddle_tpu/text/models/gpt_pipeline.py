@@ -63,8 +63,11 @@ def _attention(q, k, v):
     return dense_attention_bshd(q, k, v, is_causal=True)
 
 
-def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1):
+def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1, moe_cf=1.25, dp=1):
     """One pre-LN decoder block as a pure function of its param dict.
+    Returns (x, aux) — aux is the MoE load-balancing term (0.0 for the
+    dense FFN), pre-scaled by 1/sp so the pipeline's sum_axes psum
+    yields the mean over sequence shards.
 
     With mp > 1 the dict's leaves are the LOCAL Megatron shards (qkv/fc1
     column-sharded, proj/fc2 row-sharded, LN + output biases replicated)
@@ -104,64 +107,78 @@ def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1):
     x = x + reduce_(attn @ p["proj_w"]) + p["proj_b"]
     h = _layernorm(x, p["ln2_w"], p["ln2_b"])
     if "gate_w" in p:   # MoE FFN (experts sharded over 'ep')
-        return x + _moe_ffn(p, h, p["gate_w"].shape[-1], ep)
+        out, aux = _moe_ffn(p, h, p["gate_w"].shape[-1], ep, moe_cf,
+                            dp=dp, sp=sp)
+        # aux is the GLOBAL-batch value on every rank; 1/sp makes the
+        # pipeline's sum_axes psum recover it (the pmean over dp is a
+        # no-op on a replicated value)
+        return x + out, aux / sp
     part = jax.nn.gelu(ident(h) @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"]
-    return x + reduce_(part) + p["fc2_b"]
+    return x + reduce_(part) + p["fc2_b"], jnp.zeros([], jnp.float32)
 
 
-def _moe_ffn(p, h, n_experts, ep, cf=1.25):
-    """Switch (top-1) MoE feed-forward with experts sharded over 'ep'
-    (reference incubate moe_layer.py:244 + GShard dispatch). Tokens are
-    REPLICATED across the ep axis inside the pipeline (they shard over
-    dp/sp instead), so no all-to-all is needed: every rank routes all
-    tokens, processes only its E/ep resident experts, and the partial
-    combines psum over 'ep' (identity-backward pair, like the Megatron
-    row-parallel output). The load-balancing aux term is NOT surfaced
-    (the 1F1B block has no aux channel) — serial and pipelined paths
-    drop it consistently.
+def _moe_ffn(p, h, n_experts, ep, cf=1.25, dp=1, sp=1):
+    """Switch (top-1) MoE feed-forward with experts sharded over 'ep' and
+    TOKEN-SHARDED all-to-all dispatch (reference incubate
+    moe_layer.py:244 MoEScatter/MoEGather over global_scatter_op.cc /
+    global_gather_op.cc). Each ep rank takes a 1/ep slice of this
+    shard's tokens, capacity-buckets them locally (GShard grouped
+    capacity), exchanges buckets with `lax.all_to_all`, runs only its
+    E/ep resident experts, and all-gathers the combined outputs —
+    per-rank dispatch traffic and routing FLOPs are O(tokens/ep). Gate
+    statistics for the returned load-balancing aux term are psum'd over
+    'ep', so aux matches the full-local-batch (serial) value exactly.
 
-    Capacity note: dispatch (cumsum positions + capacity) is computed
-    over the tokens THIS rank holds. With dp/sp sharding the token set
-    per rank shrinks, so overflow-dropping decisions differ from the
-    full-batch computation — per-shard dispatch is itself a standard
-    MoE formulation, but exact-parity tests vs serial must use ep (and
-    sharding) axes only.
+    Returns (out [b, s, d], aux scalar).
+
+    Capacity note: overflow-dropping is per GROUP — each (dp, sp, ep)
+    shard's local token slice (the GShard formulation). With dp/sp/ep
+    sharding the groups shrink vs the serial full-batch cumsum, so drop
+    decisions can differ from serial once an expert overflows; with
+    capacity_factor high enough that nothing drops, parity is exact.
     """
     b, s, d = h.shape
     x = h.reshape(b * s, d)
-    tokens = x.shape[0]
-    logits = x @ p["gate_w"]                      # gate replicated
+    # gate statistics reduce over ALL token-sharding axes so the aux
+    # term is the exact global-batch value (serial parity under ep×dp)
+    stat_axes = tuple(n for n, sz in (("dp", dp), ("sp", sp), ("ep", ep))
+                      if sz > 1)
+    n_shards = dp * sp * ep
+
+    def expert_fn(expert_in):   # [E_loc, ·, d], local expert shards
+        hmid = jax.nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in, p["moe_w1"])
+            + p["moe_b1"])
+        return jnp.einsum("ech,ehd->ecd", hmid, p["moe_w2"]) + p["moe_b2"]
+
+    if ep > 1:
+        from ...distributed.moe import moe_a2a_dispatch_combine
+
+        out, aux = moe_a2a_dispatch_combine(
+            x, p["gate_w"], expert_fn, n_experts, ep,
+            capacity_factor=cf, axis="ep", stat_axes=stat_axes,
+            n_stat_shards=n_shards)
+        return out.reshape(b, s, d), aux
+
+    # ep == 1: dense local dispatch over this shard's whole token set
+    from ...distributed.moe import moe_a2a_capacity, switch_dispatch
+
+    logits = x @ p["gate_w"]
     probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-    top_idx = jnp.argmax(probs, -1)
-    top_p = jnp.take_along_axis(probs, top_idx[:, None], -1)[:, 0]
-    onehot = jax.nn.one_hot(top_idx, n_experts)   # [t, E]
-    import math
-    capacity = max(1, int(math.ceil(tokens / n_experts * cf)))
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
-    keep = (pos < capacity) & (onehot > 0)
-    pos_idx = pos.sum(-1).astype(jnp.int32)
-    if ep > 1:
-        # slice the per-expert mask BEFORE building the dispatch tensor
-        # — [t, E/ep, cap] instead of every rank materializing the full
-        # [t, E, cap] (~quadratic in local tokens) and slicing after
-        e_loc = n_experts // ep
-        r = lax.axis_index("ep")
-        keep = lax.dynamic_slice_in_dim(keep, r * e_loc, e_loc, axis=1)
-        xin = copy_to_mp(x, "ep")   # identity fwd, psum dh bwd
-    else:
-        xin = x
-    disp = (jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)[:, None, :]
-            * keep[:, :, None])                   # [t, E_loc, cap]
-    disp = jnp.swapaxes(disp, 0, 1)               # [E_loc, t, cap]
-    expert_in = jnp.einsum("etc,td->ecd", disp, xin)
-    hmid = jax.nn.gelu(
-        jnp.einsum("ecd,edh->ech", expert_in, p["moe_w1"]) + p["moe_b1"])
-    expert_out = jnp.einsum("ech,ehd->ecd", hmid, p["moe_w2"]) + p["moe_b2"]
+    capacity = moe_a2a_capacity(x.shape[0], 1, n_experts, cf)
+    disp, top_p, onehot = switch_dispatch(probs, n_experts, capacity,
+                                          x.dtype)
+    me = probs.mean(axis=0)
+    ce = onehot.mean(axis=0)
+    if stat_axes:
+        me = allreduce_mp(me, stat_axes) / n_shards
+        ce = allreduce_mp(ce, stat_axes) / n_shards
+    aux = n_experts * jnp.sum(me * ce)
+    expert_in = jnp.einsum("etc,td->ecd", disp, x)
+    expert_out = expert_fn(expert_in)
     partial = jnp.einsum("etc,ecd->td", disp, expert_out)
-    if ep > 1:
-        partial = allreduce_mp(partial, "ep")     # psum fwd, ident bwd
     out = partial * top_p[:, None].astype(x.dtype)
-    return out.reshape(b, s, d)
+    return out.reshape(b, s, d), aux
 
 
 def _vocab_parallel_ce(sh, wte_loc, sl, mp):
@@ -190,17 +207,40 @@ class PipelinedGPTForCausalLM(nn.Layer):
     Megatron pattern and optional dp sharding of the micro-batch.
     `forward` runs the serial scan (eval / single device); `loss(ids)`
     runs the 1F1B pipeline schedule over whatever (dp, pp, mp) mesh is
-    active."""
+    active.
+
+    MoE (`moe_experts > 0`): switch FFN with token-sharded all-to-all
+    dispatch over 'ep'; `loss()` returns loss + moe_aux_weight·aux and
+    stores the aux metric in `self.aux_loss`. Overflow-dropping is per
+    (dp, sp, ep) token group: with the default moe_capacity_factor the
+    dropped set depends on the mesh (standard GShard semantics); set
+    moe_capacity_factor ≥ num_experts for lossless dispatch and exact
+    serial parity. The aux term itself is always the global-batch value
+    (gate statistics psum'd over every token-sharding axis)."""
 
     def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
-                 n_virtual=1, moe_experts=0, moe_hidden=None):
+                 n_virtual=1, moe_experts=0, moe_hidden=None,
+                 moe_aux_weight=0.01, moe_capacity_factor=1.25):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
         # moe_experts > 0: the dense FFN becomes a switch (top-1) MoE
-        # with experts sharded over the 'ep' mesh axis (see _moe_ffn)
+        # with experts sharded over the 'ep' mesh axis and token-sharded
+        # all-to-all dispatch (see _moe_ffn). The load-balancing aux
+        # term rides the 1F1B aux channel: loss() returns
+        # loss + moe_aux_weight·aux and stores the aux value in
+        # self.aux_loss (reference moe gates always train with it).
+        # Overflow-dropping is per (dp, sp, ep) token group —
+        # capacity_factor ≥ num_experts makes dispatch lossless.
         self.moe_experts = int(moe_experts)
         self.moe_hidden = moe_hidden or config.ffn_size
+        self.moe_aux_weight = float(moe_aux_weight)
+        self.moe_capacity_factor = float(moe_capacity_factor)
+        # aux metric rides a persistable buffer so the jitted TrainStep
+        # surfaces it through the frozen-value channel (the same path BN
+        # running stats take) — readable after each step as a concrete
+        # value, never a leaked tracer
+        self.register_buffer("aux_loss", jnp.zeros([], jnp.float32))
         # n_virtual > 1: tick-interleaved virtual stages — each device
         # owns n_virtual NON-contiguous chunks of the layer stack
         # (round-robin placement, reference PipelineParallelWithInterleave)
@@ -304,17 +344,24 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def _embed(self, wte, wpe, ids):
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
 
-    def _block_fn(self, mp, sp=1, ep=1):
+    def _block_fn(self, mp, sp=1, ep=1, dp=1):
         nh = self.config.num_heads
-        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp, ep)
+        cf = self.moe_capacity_factor
+        has_aux = bool(self.moe_experts)
+        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp, ep, cf, dp)
         if self.remat == "layer":
             layer = jax.checkpoint(layer)
 
         def block(stage_params, x):
             def body(x, p):
-                return layer(p, x), None
+                x2, aux = layer(p, x)
+                return x2, aux
 
-            out, _ = jax.lax.scan(body, x, stage_params)
+            out, auxs = jax.lax.scan(body, x, stage_params)
+            if has_aux:
+                # per-stage sum over this stage's layers; the pipeline's
+                # pp-psum assembles the whole stack's aux
+                return out, jnp.sum(auxs).astype(jnp.float32)
             return out
 
         return block
@@ -406,7 +453,9 @@ class PipelinedGPTForCausalLM(nn.Layer):
             p = dict(zip(names, stk))
 
             def body(x, pl):
-                return _decoder_fwd(pl, x, nh), None
+                x2, _aux = _decoder_fwd(pl, x, nh,
+                                        moe_cf=self.moe_capacity_factor)
+                return x2, None
 
             x, _ = jax.lax.scan(body, x, p)
             h = _layernorm(x, lnf_w, lnf_b)
@@ -461,10 +510,19 @@ class PipelinedGPTForCausalLM(nn.Layer):
             raise ValueError(
                 f"sequence length {input_ids.shape[1]} not divisible by "
                 f"sp={sp}")
+        if ep > 1:
+            # the a2a dispatch slices each shard's tokens into ep groups
+            b_sh = input_ids.shape[0] // self.n_micro // max(dp, 1)
+            toks = b_sh * (input_ids.shape[1] // max(sp, 1))
+            if toks % ep:
+                raise ValueError(
+                    f"tokens per shard {toks} not divisible by ep={ep} "
+                    "(adjust batch/n_micro/dp/sp so each ep group is "
+                    "equal)")
         tensors = self._param_tensors()
         names = self._stack_names
         M = self.n_micro
-        block_fn = self._block_fn(mp, sp, ep)
+        block_fn = self._block_fn(mp, sp, ep, dp)
         loss_fn = self._loss_fn(mp, sp)
         fwd_only = not engine.is_grad_enabled()
 
@@ -514,10 +572,12 @@ class PipelinedGPTForCausalLM(nn.Layer):
                     specs = specs._replace(stacked=tuple(
                         P(*((s[0], None) + tuple(s[1:])))
                         for s in specs.stacked))
+            aux_w = self.moe_aux_weight if self.moe_experts else None
             if fwd_only and V == 1:
                 return pipeline_forward_loss(block_fn, loss_fn, stacked,
                                              post, (x_m, lbl_m),
-                                             specs=specs)
+                                             specs=specs,
+                                             aux_weight=aux_w)
             # "layer" remat lives inside block_fn already — the schedule
             # must not double-checkpoint the stage (fwd_only with V > 1
             # also lands here: the fill-drain path has no virtual-stage
@@ -525,6 +585,15 @@ class PipelinedGPTForCausalLM(nn.Layer):
             remat = self.remat == "stage"
             return pipeline_1f1b(block_fn, loss_fn, stacked, post,
                                  (x_m, lbl_m), remat=remat,
-                                 num_virtual=V, specs=specs)
+                                 num_virtual=V, specs=specs,
+                                 aux_weight=aux_w)
 
-        return apply_jfn("pipelined_gpt_loss", jfn, *tensors)
+        if not self.moe_experts:
+            return apply_jfn("pipelined_gpt_loss", jfn, *tensors)
+        # MoE: the pipeline returns (loss + aux_weight·aux, aux); the
+        # aux value is a detached metric surfaced as self.aux_loss
+        # (reference MoELayer stores the gate's balance loss the same
+        # way — moe_layer.py gates)
+        total, aux = apply_jfn("pipelined_gpt_loss", jfn, *tensors)
+        self.aux_loss._value = lax.stop_gradient(aux._value)
+        return total

@@ -156,3 +156,74 @@ def test_elastic_scale_out_node_join(tmp_path):
     assert ref["start"] == out[0]["start"]
     np.testing.assert_allclose(out[0]["losses"], ref["losses"],
                                rtol=1e-6)
+
+
+def test_scale_out_via_master_rpc_no_shared_fs(tmp_path):
+    """Round-5 membership: heartbeats and join requests flow through the
+    launcher's MembershipMaster TCP registry (reference ETCDMaster,
+    launch/controllers/master.py:175) — no shared filesystem. The
+    "second node" here is an operator process sharing NOTHING with the
+    pod but the master's host:port string: its RPC join must tear the
+    pod down and re-form it at nproc=3."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import json, os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.init_parallel_env()\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "out = sys.argv[1]\n"
+        "json.dump({'world': world},\n"
+        "          open(os.path.join(out, 'nsfs_%%d_%%d.json'\n"
+        "                            %% (world, rank)), 'w'))\n"
+        "if rank == 0:\n"
+        "    with open(os.path.join(out, 'ep_tmp'), 'w') as f:\n"
+        "        f.write(os.environ['PADDLE_ELASTIC_MASTER'])\n"
+        "    os.replace(os.path.join(out, 'ep_tmp'),\n"
+        "               os.path.join(out, 'ep_w%%d' %% world))\n"
+        "if world == 2:\n"
+        "    time.sleep(120)  # wait for the join-triggered teardown\n"
+        % ROOT)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=2", "--elastic_level=1",
+           "--elastic_timeout=0", f"--log_dir={tmp_path}/log",
+           str(worker), str(tmp_path)]
+    pod = subprocess.Popen(cmd, env=_env(), cwd=ROOT,
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.PIPE, text=True)
+    try:
+        ep_file = tmp_path / "ep_w2"
+        deadline = time.time() + 120
+        while not ep_file.exists():
+            assert time.time() < deadline, "pod never published endpoint"
+            assert pod.poll() is None, pod.communicate()
+            time.sleep(0.3)
+        endpoint = ep_file.read_text().strip()
+        # the "joining node": a clean process with no pod env, no pod
+        # filesystem — only the endpoint string
+        join_env = {k: v for k, v in _env().items()
+                    if not k.startswith("PADDLE")}
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, %r); "
+             "from paddle_tpu.distributed.fleet.elastic import "
+             "request_scale_out; request_scale_out(1, master=%r)"
+             % (ROOT, endpoint)],
+            env=join_env, capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        out, err = pod.communicate(timeout=180)
+    finally:
+        if pod.poll() is None:
+            pod.kill()
+    assert pod.returncode == 0, f"stdout:{out}\nstderr:{err}"
+    assert "elastic scale-out: 1 worker(s) joining" in err
+    for rank in range(3):
+        with open(tmp_path / f"nsfs_3_{rank}.json") as f:
+            assert json.load(f)["world"] == 3
+    # membership flowed over RPC: the heartbeat dir saw neither beats
+    # nor join files
+    hb = tmp_path / "log" / "hb"
+    leftovers = [f for f in os.listdir(hb)] if hb.is_dir() else []
+    assert not any(f.startswith(("hb_", "join_")) for f in leftovers), \
+        leftovers

@@ -295,10 +295,11 @@ def test_model_interleaved_indivisible_raises():
 
 # --------------------------------------------------------------------
 # expert parallelism INSIDE pipeline stages (pp × ep): switch-MoE FFN
-# with experts sharded over 'ep', partial combines psum'd
+# with token-sharded lax.all_to_all dispatch/combine (the reference's
+# global_scatter/global_gather), aux loss through the 1F1B aux channel
 # --------------------------------------------------------------------
 
-def _moe_losses(mesh_kw, ids_np, steps=3):
+def _moe_losses(mesh_kw, ids_np, steps=3, cf=1.25, with_aux=False):
     mesh_mod.reset_mesh()
     if mesh_kw is None:
         mesh_mod.init_mesh(devices=jax.devices()[:1])
@@ -306,35 +307,55 @@ def _moe_losses(mesh_kw, ids_np, steps=3):
         mesh_mod.init_mesh(**mesh_kw)
     paddle.seed(0)
     m = PipelinedGPTForCausalLM(CFG, n_micro=4, moe_experts=4,
-                                moe_hidden=64)
+                                moe_hidden=64, moe_capacity_factor=cf)
     ids = paddle.to_tensor(ids_np)
     opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
     step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
-    return [float(step(ids).numpy()) for _ in range(steps)]
+    losses, auxs = [], []
+    for _ in range(steps):
+        losses.append(float(step(ids).numpy()))
+        auxs.append(float(m.aux_loss.numpy()))
+    return (losses, auxs) if with_aux else losses
 
 
 def test_moe_in_pipeline_trajectory_matches_serial():
-    # ep shards experts only (tokens replicated across ep), so parity
-    # vs serial is EXACT — see _moe_ffn's capacity note for why dp/sp
-    # composition changes dispatch semantics instead
+    # lossless capacity (cf = E ⇒ C ≥ tokens/group): the a2a grouped
+    # dispatch keeps exactly the serial full-batch token set, and gate
+    # statistics are psum'd over every token-sharding axis — so the
+    # TOTAL loss (incl. aux_weight·aux) and the aux metric are EXACT
+    # parity vs serial, even composed with dp and ZeRO storage.
     rng = np.random.default_rng(13)
     ids_np = rng.integers(0, 256, (8, 16))
-    serial = _moe_losses(None, ids_np)
-    ep4 = _moe_losses({"pp": 2, "ep": 4}, ids_np)
-    zshard = _moe_losses({"pp": 2, "ep": 2, "sharding": 2}, ids_np)
+    serial, s_aux = _moe_losses(None, ids_np, cf=4.0, with_aux=True)
+    ep4, a4 = _moe_losses({"pp": 2, "ep": 4}, ids_np, cf=4.0,
+                          with_aux=True)
+    ep2dp2, a22 = _moe_losses({"pp": 2, "dp": 2, "ep": 2}, ids_np,
+                              cf=4.0, with_aux=True)
+    zshard = _moe_losses({"pp": 2, "ep": 2, "sharding": 2}, ids_np,
+                         cf=4.0)
     np.testing.assert_allclose(serial, ep4, rtol=2e-5)
+    np.testing.assert_allclose(serial, ep2dp2, rtol=2e-5)
     np.testing.assert_allclose(serial, zshard, rtol=2e-5)
+    np.testing.assert_allclose(s_aux, a4, rtol=2e-4)
+    np.testing.assert_allclose(s_aux, a22, rtol=2e-4)
     assert serial[-1] < serial[0]
+    # the aux channel is live: a switch gate at init is near-balanced,
+    # so per-layer aux ≈ 1.0 (= E·E·(1/E)·(1/E)) and the stack's sum is
+    # ≈ num_layers; exploded/vanished values would mean the psum'd
+    # statistics path is wrong
+    assert 2.0 < s_aux[0] < 16.0
 
 
-def test_moe_with_dp_trains():
-    # per-shard dispatch (capacity over local tokens): not bit-parity
-    # with serial, but a valid MoE that must train
+def test_moe_default_capacity_trains():
+    # default cf=1.25: grouped overflow-drops differ from serial (the
+    # standard GShard formulation) — must still train on every mesh
     rng = np.random.default_rng(14)
     ids_np = rng.integers(0, 256, (8, 16))
-    losses = _moe_losses({"pp": 2, "dp": 2, "ep": 2}, ids_np)
-    assert losses[-1] < losses[0]
-    assert np.isfinite(losses).all()
+    for mesh_kw in (None, {"pp": 2, "ep": 4},
+                    {"pp": 2, "dp": 2, "ep": 2}):
+        losses = _moe_losses(mesh_kw, ids_np)
+        assert losses[-1] < losses[0], (mesh_kw, losses)
+        assert np.isfinite(losses).all()
 
 
 def test_moe_expert_divisibility_raises():
@@ -356,3 +377,29 @@ def test_moe_with_sp_and_with_mp_train():
         losses = _moe_losses(mesh_kw, ids_np)
         assert losses[-1] < losses[0], (mesh_kw, losses)
         assert np.isfinite(losses).all()
+
+
+def test_moe_dispatch_is_all_to_all_and_o_tokens_over_ep():
+    # the EP defining mechanism (reference global_scatter_op.cc): the
+    # compiled pipeline program contains a real all-to-all collective,
+    # and the per-rank dispatch buffer is O(tokens/ep) — capacity
+    # scales inversely with ep
+    from paddle_tpu.distributed.moe import moe_a2a_capacity
+
+    t, E, cf = 512, 8, 1.25
+    c1 = moe_a2a_capacity(t, 1, E, cf)
+    c2 = moe_a2a_capacity(t, 2, E, cf)
+    c8 = moe_a2a_capacity(t, 8, E, cf)
+    assert c2 <= c1 / 2 + 1 and c8 <= c1 / 8 + 1
+    # per-rank a2a bytes = E·C·d: halving with ep proves O(tokens/ep)
+    assert E * c8 * 4 <= (E * c1 * 4) / 4
+
+    mesh_mod.init_mesh(pp=2, ep=2, devices=jax.devices()[:4])
+    paddle.seed(0)
+    m = PipelinedGPTForCausalLM(CFG, n_micro=4, moe_experts=4,
+                                moe_hidden=64)
+    ids = paddle.to_tensor(np.zeros((8, 16), np.int64))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
+    hlo = step.lower(ids).compile().as_text()
+    assert "all-to-all" in hlo, "MoE dispatch must lower to all-to-all"

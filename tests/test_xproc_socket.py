@@ -1,0 +1,65 @@
+"""Direct-socket p2p bulk transport (reference:
+paddle/fluid/distributed/ps/service/brpc_ps_client.h:195 — true p2p RPC
+between trainers; paddle/fluid/distributed/store/tcp_store.h:120 — the
+store is rendezvous-only). Round 5 moved xproc bulk payloads off the
+coordination-service KV (a star through one coordinator, base64 +33%)
+onto raw TCP sockets; the KV now carries one host:port endpoint per rank.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.slow
+def test_socket_transport_8proc_kv_carries_no_bulk_bytes(tmp_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TPU_P2P_TRANSPORT", None)   # default = socket
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=8", f"--log_dir={tmp_path}/log",
+         os.path.join(root, "tests", "xproc_socket_worker.py"),
+         str(tmp_path)],
+        env=env, cwd=root, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    for rank in range(8):
+        with open(tmp_path / f"xps_out_{rank}.json") as f:
+            out = json.load(f)
+        assert out["ok"], f"rank {rank} payload parity failed"
+        # every bulk byte moved over sockets; the coordination KV carried
+        # endpoints only
+        assert out["kv_bulk_bytes"] == 0, out
+        assert out["socket_bytes"] >= out["p2p_bytes"] > 0, out
+
+
+@pytest.mark.slow
+def test_kv_fallback_transport_still_works(tmp_path):
+    # PADDLE_TPU_P2P_TRANSPORT=kv keeps the coordinator-KV path alive
+    # (debugging / environments without direct connectivity)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_TPU_P2P_TRANSPORT"] = "kv"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+         os.path.join(root, "tests", "xproc_socket_worker.py"),
+         str(tmp_path)],
+        env=env, cwd=root, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    for rank in range(2):
+        with open(tmp_path / f"xps_out_{rank}.json") as f:
+            out = json.load(f)
+        assert out["ok"]
+        assert out["socket_bytes"] == 0
+        # base64 inflation: KV bulk bytes ≈ 4/3 · payload bytes
+        assert out["kv_bulk_bytes"] >= (4 * out["p2p_bytes"]) // 3

@@ -1,0 +1,87 @@
+"""Worker for test_xproc_socket.py (run via paddle_tpu.distributed.launch,
+8 processes).
+
+Exercises the direct-socket p2p transport (reference split:
+brpc_ps_client.h:195 p2p RPC vs store/tcp_store.h:120 rendezvous-only
+store): every rank exchanges distinctive payloads with every peer, runs a
+ShardedSparseTable pull/push round over the same transport, then reports
+traffic counters. The test asserts payloads round-tripped exactly AND
+that the coordination-service KV carried ZERO bulk bytes — endpoints are
+the only thing it stores.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.ps import (  # noqa: E402
+    ShardedSparseTable, SparseSGDRule)
+
+
+def make_init(dim):
+    def f(n, ids):
+        return (np.sin(np.outer(ids + 1.0, np.arange(1, dim + 1)))
+                / np.sqrt(dim)).astype(np.float32)
+
+    return f
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    dist.init_parallel_env()
+
+    # ---- pairwise payload parity: rank r sends f(r, peer) to peer ----
+    def payload(src, dst):
+        rr = np.random.default_rng(1000 * src + dst)
+        return rr.standard_normal((src + 2, 5)).astype(np.float32)
+
+    for dst in range(world):
+        if dst != rank:
+            xproc.send_np(payload(rank, dst), dst, tag=7)
+    ok = True
+    for src in range(world):
+        if src != rank:
+            got = xproc.recv_np(src, tag=7, timeout_ms=120_000)
+            ok = ok and np.array_equal(got, payload(src, rank))
+
+    # a large frame (1 MB) — multi-chunk socket reads
+    big = np.arange(rank, rank + 262144, dtype=np.float32)
+    xproc.send_np(big, (rank + 1) % world, tag=8)
+    got_big = xproc.recv_np((rank - 1) % world, tag=8, timeout_ms=120_000)
+    ok = ok and np.array_equal(
+        got_big, np.arange((rank - 1) % world,
+                           (rank - 1) % world + 262144, dtype=np.float32))
+
+    # ---- PS routing over the same transport ----
+    dim = 4
+    t = ShardedSparseTable(dim, rule=SparseSGDRule(0.1),
+                           initializer=make_init(dim), staleness=1,
+                           timeout_ms=120_000)
+    rr = np.random.default_rng(7 + rank)
+    ids = rr.integers(0, 64, (16,))
+    rows = t.pull(ids)
+    # untouched rows must equal the pure-function initializer via routing
+    ref = make_init(dim)(len(ids), ids)
+    ok = ok and np.allclose(rows, ref, atol=1e-6)
+    t.push(ids, np.ones((16, dim), np.float32))
+    t.flush()
+    xproc.barrier()
+
+    out = {
+        "ok": bool(ok),
+        "p2p_bytes": xproc.stats["p2p_bytes"],
+        "socket_bytes": xproc.stats["socket_bytes"],
+        "kv_bulk_bytes": xproc.stats["kv_bulk_bytes"],
+    }
+    with open(os.path.join(sys.argv[1], f"xps_out_{rank}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()
