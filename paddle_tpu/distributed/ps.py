@@ -36,8 +36,8 @@ from . import mesh as mesh_mod
 
 __all__ = ["SparseSGDRule", "SparseAdaGradRule", "SparseAdamRule",
            "MemorySparseTable", "SSDSparseTable", "ShardedSparseTable",
-           "make_sparse_table", "resolve_rule", "SparseEmbedding",
-           "ShardedEmbedding", "live_tables"]
+           "GeoSparseTable", "make_sparse_table", "resolve_rule",
+           "SparseEmbedding", "ShardedEmbedding", "live_tables"]
 
 # every SparseEmbedding registers here so fleet.stop_worker()/
 # save_persistables can flush/save all live PS tables (the reference's
@@ -310,6 +310,17 @@ class MemorySparseTable:
         self._slots[idx] = new_slots
         if self.accessor:
             self._meta[idx, 2] = 0.0
+
+    def set_rows(self, ids, rows):
+        """Overwrite row VALUES directly (no optimizer rule) — the geo
+        trainer's base refresh and bulk loading path (reference
+        memory_sparse_geo_table.h direct value install)."""
+        ids = np.asarray(ids).reshape(-1)
+        rows = np.asarray(rows, np.float32).reshape(len(ids), self.dim)
+        self._ensure(ids)
+        idx = np.fromiter((self._rows[int(i)] for i in ids), np.int64,
+                          len(ids))
+        self._data[idx] = rows
 
     # -- CTR accessor (reference ctr_accessor.cc) --
     def update_show_click(self, ids, shows, clicks):
@@ -1066,6 +1077,156 @@ class SparseTrainStep(_TrainStepBase):
                 getattr(batch_vals[0], "ndim", 0) else None
             bm.auto_step(num_samples=n)
         return Tensor(loss, stop_gradient=True)
+
+
+class GeoSparseTable:
+    """Geo-async trainer-side sparse table (reference: GeoCommunicator,
+    ps/service/communicator/communicator.h:598 — delta-accumulating
+    trainer sync; ps/table/memory_sparse_geo_table.h:1 — the server
+    merges pushed deltas into the authoritative rows).
+
+    Semantics: every trainer owns a LOCAL working copy trained with the
+    optimizer rule IMMEDIATELY (zero per-step routing for known ids).
+    Every `sync_every`-th push runs one geo round:
+
+      1. delta = local_row − base_row for every locally-dirty id,
+      2. deltas route to their owner shard (id % world) and MERGE by
+         summation into the authoritative table,
+      3. the trainer refreshes: merged rows are pulled back, installed
+         as the new local values AND the new base.
+
+    Staleness is bounded by `sync_every` pushes; with sync_every=1 and
+    one trainer this degenerates to a plain local table. pull()s of ids
+    this trainer has never seen fetch the authoritative base first (one
+    collective round per step, empty-request safe — the reference's
+    sparse init pull). All pull/push calls are COLLECTIVE, like
+    ShardedSparseTable: data-parallel lockstep guarantees matching call
+    counts.
+    """
+
+    def __init__(self, embedding_dim, rule=None, initializer=None,
+                 seed=0, sync_every=8, world=None, rank=None,
+                 timeout_ms=600_000, refresh_chunk=4096):
+        from . import xproc
+
+        if world is None:
+            world = jax.process_count() if xproc.is_multiprocess() else 1
+        if rank is None:
+            rank = jax.process_index() if world > 1 else 0
+        self.world, self.rank = world, rank
+        self.dim = embedding_dim
+        self.sync_every = max(1, int(sync_every))
+        # the geo delta algebra needs local create-on-touch to agree
+        # with the authority's initial value WITHOUT a network round:
+        # the initializer must be a pure function of the id (the
+        # reference geo tables initialize deterministically too)
+        if initializer is None:
+            raise ValueError(
+                "GeoSparseTable needs an id-deterministic initializer "
+                "(rows are created locally AND on the authority shard; "
+                "order-dependent random init would corrupt deltas)")
+        self._init_fn = initializer
+        self.refresh_chunk = max(1, int(refresh_chunk))
+        self.local = MemorySparseTable(embedding_dim, rule=rule,
+                                       initializer=initializer, seed=seed)
+        # authoritative store: delta MERGE is row += delta, expressed as
+        # the SGD rule at lr=1 applied to −delta (no second rule state)
+        self._authority = ShardedSparseTable(
+            embedding_dim, rule=SparseSGDRule(1.0),
+            initializer=initializer, seed=seed, staleness=1,
+            world=world, rank=rank, timeout_ms=timeout_ms)
+        self._base = {}       # id -> row value at last sync
+        self._refresh_cursor = 0
+        self._dirty = set()
+        self._push_count = 0
+
+    def __len__(self):
+        return len(self.local)
+
+    def pull(self, ids):
+        """Local rows; unseen ids fetch their authoritative base first
+        (collective — every rank participates, possibly with an empty
+        request)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        uniq = np.unique(ids)
+        new = np.array([i for i in uniq if int(i) not in self._base],
+                       np.int64)
+        if self.world > 1 or len(new):
+            rows = self._authority.pull(new)
+            if len(new):
+                self.local.set_rows(new, rows)
+                for i, r in zip(new, rows):
+                    self._base[int(i)] = r.copy()
+        return self.local.pull(ids)
+
+    def push(self, ids, grads):
+        """Apply immediately to the local copy; every sync_every-th call
+        runs the collective geo round."""
+        ids_flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        # push-only ids (never pulled): their base is the deterministic
+        # initializer value — record it BEFORE the rule mutates the row,
+        # no network round needed (see __init__'s initializer contract)
+        new = np.array([i for i in np.unique(ids_flat)
+                        if int(i) not in self._base], np.int64)
+        if len(new):
+            for i, r in zip(new, self._init_fn(len(new), new)):
+                self._base[int(i)] = np.asarray(r, np.float32).copy()
+        self.local.push(ids, grads)
+        self._dirty.update(int(i) for i in ids_flat)
+        self._push_count += 1
+        if self._push_count % self.sync_every == 0:
+            self.sync()
+
+    def sync(self):
+        """One geo round (collective): push local deltas for DIRTY ids,
+        merge on owners, then refresh base/local for the dirty ids PLUS
+        a rotating window of known ids — the recv half picks up other
+        trainers' merged updates (reference GeoCommunicator send+recv
+        per round) without pulling the whole touched vocabulary every
+        round (refresh cost is bounded by dirty + refresh_chunk)."""
+        dirty = np.array(sorted(self._dirty), np.int64)
+        self._dirty.clear()
+        if len(dirty):
+            local_rows = self.local.pull(dirty)
+            base_rows = np.stack([self._base[int(i)] for i in dirty])
+            delta = local_rows - base_rows
+        else:
+            delta = np.zeros((0, self.dim), np.float32)
+        # merge: authority_row += delta (SGD lr=1 on −delta), summed
+        # over all trainers pushing the same id this round. The
+        # authority runs at staleness=1, so push() flushes — no second
+        # exchange round needed.
+        self._authority.push(dirty, -delta)
+        known_all = np.array(sorted(self._base), np.int64)
+        lo = self._refresh_cursor
+        window = known_all[lo:lo + self.refresh_chunk]
+        self._refresh_cursor = (0 if lo + self.refresh_chunk
+                                >= len(known_all)
+                                else lo + self.refresh_chunk)
+        refresh = np.unique(np.concatenate([dirty, window])) \
+            if len(dirty) or len(window) else dirty
+        merged = self._authority.pull(refresh)
+        if len(refresh):
+            self.local.set_rows(refresh, merged)
+            for i, r in zip(refresh, merged):
+                self._base[int(i)] = r.copy()
+
+    def flush(self):
+        self.sync()
+
+    def state_dict(self):
+        return self._authority.state_dict()
+
+    def set_state_dict(self, sd):
+        self._authority.set_state_dict(sd)
+        # restored authority invalidates everything trainer-side: a
+        # stale local/base pair would hide the load AND corrupt the
+        # next merge with deltas against pre-restore values
+        self.local = MemorySparseTable(self.dim, rule=self.local.rule,
+                                       initializer=self._init_fn)
+        self._base.clear()
+        self._dirty.clear()
+        self._refresh_cursor = 0
 
 
 def ShardedEmbedding(num_embeddings, embedding_dim, axis="mp", **kwargs):

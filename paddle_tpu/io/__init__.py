@@ -27,8 +27,9 @@ __all__ = [
     "ComposeDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "SubsetRandomSampler",
-    "BatchSampler", "DistributedBatchSampler", "DataLoader",
-    "default_collate_fn", "get_worker_info",
+    "BatchSampler", "DistributedBatchSampler", "BucketedBatchSampler",
+    "DataLoader", "default_collate_fn", "pad_to_bucket_collate",
+    "get_worker_info",
 ]
 
 
@@ -227,6 +228,147 @@ class BatchSampler(Sampler):
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+
+class BucketedBatchSampler(BatchSampler):
+    """Length-bucketed batching for variable-length training data.
+
+    The reference feeds ragged batches natively as LoDTensors
+    (paddle/fluid/framework/lod_tensor.h:1); under XLA every distinct
+    padded shape is a separate compiled program, so the TPU-native
+    policy is the one the serving path already uses
+    (inference/serving.py BatchingConfig): group samples into LENGTH
+    BUCKETS and pad each batch to its bucket — the whole training run
+    compiles at most `len(buckets)` programs instead of one per unique
+    length. Pair with `pad_to_bucket_collate` in the DataLoader.
+
+    lengths: per-sample lengths — a sequence, or a callable applied to
+        each dataset element. buckets: ascending length boundaries
+        (default: powers of two from 8 up to the max length). Samples
+        longer than the largest bucket go into it anyway (the collate
+        then pads TO THE SAMPLE, i.e. truncation is never silent).
+    shuffle: shuffles within buckets and the batch order each epoch
+        (seeded by set_epoch, reproducible like
+        DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, lengths=None, buckets=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        if lengths is None:
+            lengths = [len(dataset[i]) for i in range(len(dataset))]
+        elif callable(lengths):
+            lengths = [lengths(dataset[i]) for i in range(len(dataset))]
+        self.lengths = [int(x) for x in lengths]
+        if buckets is None:
+            top = max(self.lengths) if self.lengths else 8
+            buckets, b = [], 8
+            while b < top:
+                buckets.append(b)
+                b *= 2
+            buckets.append(max(b, top))
+        self.buckets = sorted(set(int(b) for b in buckets))
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.epoch)
+        by_bucket = {}
+        for idx, n in enumerate(self.lengths):
+            by_bucket.setdefault(self.bucket_for(n), []).append(idx)
+        batches = []
+        for b in self.buckets:
+            idxs = by_bucket.get(b, [])
+            if self.shuffle:
+                idxs = [idxs[i] for i in rng.permutation(len(idxs))]
+            for k in range(0, len(idxs), self.batch_size):
+                chunk = idxs[k:k + self.batch_size]
+                if len(chunk) < self.batch_size and self.drop_last:
+                    continue
+                batches.append(chunk)
+        if self.shuffle:
+            batches = [batches[i] for i in rng.permutation(len(batches))]
+        return iter(batches)
+
+    def __len__(self):
+        by_bucket = {}
+        for n in self.lengths:
+            by_bucket[self.bucket_for(n)] = \
+                by_bucket.get(self.bucket_for(n), 0) + 1
+        total = 0
+        for c in by_bucket.values():
+            total += (c // self.batch_size if self.drop_last
+                      else (c + self.batch_size - 1) // self.batch_size)
+        return total
+
+
+def pad_to_bucket_collate(buckets, pad_value=0, with_length=True):
+    """Collate-fn factory for ragged samples: every numpy/list field
+    whose leading dim varies is padded with `pad_value` to the smallest
+    bucket ≥ the batch's longest sample (pairs with
+    BucketedBatchSampler so each bucket is ONE compiled program).
+    Samples may be arrays or tuples of (array-like, scalar-label, ...)
+    fields. With `with_length` the collated batch gains a trailing
+    int32 lengths array — the mask the loss needs (the reference's LoD
+    boundaries, lod_tensor.h)."""
+    buckets = sorted(set(int(b) for b in buckets))
+
+    def bucket_for(n):
+        for b in buckets:
+            if b >= n:
+                return b
+        return n   # longer than every bucket: pad to the sample
+
+    def collate(batch):
+        from ..tensor_core import Tensor
+
+        first = batch[0]
+        tuple_mode = isinstance(first, (tuple, list))
+        fields = (list(zip(*batch)) if tuple_mode
+                  else [list(batch)])
+        out = []
+        lengths = None
+        for col in fields:
+            col = [np.asarray(getattr(x, "numpy", lambda: x)())
+                   for x in col]
+            if col[0].ndim:
+                # array field: ALWAYS pad to the bucket — identical
+                # shapes per bucket is the whole point (one program)
+                lens = [c.shape[0] for c in col]
+                width = bucket_for(max(lens))
+                padded = np.full((len(col), width) + col[0].shape[1:],
+                                 pad_value, col[0].dtype)
+                for i, c in enumerate(col):
+                    padded[i, : c.shape[0]] = c
+                out.append(Tensor(jnp_asarray(padded)))
+                if lengths is None:
+                    lengths = np.asarray(lens, np.int32)
+            else:
+                out.append(Tensor(jnp_asarray(np.stack(col))))
+        if with_length:
+            if lengths is None:
+                lengths = np.zeros((len(batch),), np.int32)
+            out.append(Tensor(jnp_asarray(lengths)))
+        return out[0] if (not tuple_mode and not with_length) \
+            else tuple(out)
+
+    return collate
+
+
+def jnp_asarray(a):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)
 
 
 class DistributedBatchSampler(BatchSampler):

@@ -430,6 +430,16 @@ class TrainStep:
         self._trainable = [not p.stop_gradient for p in self._param_objs]
         self._opt_states = None
         self._compiled = None
+        # shape-churn accounting (see __call__'s recompile guard)
+        self._batch_signatures = set()
+        self._sig_warned = False
+        self.max_batch_signatures = 8
+
+    @property
+    def num_batch_signatures(self):
+        """Distinct batch (shape, dtype) signatures seen — each one is
+        a separate compiled program."""
+        return len(self._batch_signatures)
 
     def _build(self):
         from ..core import rng as rng_mod
@@ -516,6 +526,25 @@ class TrainStep:
             self._opt_states = self.optimizer.init_states_tree(train_vals)
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
+        # recompile guard: every distinct batch signature is a separate
+        # XLA compile. Ragged text pipelines that skip bucketing
+        # (io.BucketedBatchSampler + pad_to_bucket_collate) would
+        # silently compile per unique length — warn once past the
+        # threshold (reference LoD workloads, SURVEY hard part 3).
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
+        self._batch_signatures.add(sig)
+        if (len(self._batch_signatures) == self.max_batch_signatures + 1
+                and not self._sig_warned):
+            self._sig_warned = True
+            import warnings
+
+            warnings.warn(
+                f"TrainStep has now seen {len(self._batch_signatures)} "
+                "distinct batch shapes — each one triggers a fresh XLA "
+                "compile. Variable-length data should be bucketed: "
+                "io.BucketedBatchSampler + io.pad_to_bucket_collate "
+                "compile at most one program per bucket.",
+                RuntimeWarning, stacklevel=2)
         lr = self.optimizer.get_lr()
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
         loss, new_vals, self._opt_states, new_frozen = self._compiled(

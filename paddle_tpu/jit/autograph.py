@@ -26,12 +26,21 @@ What converts:
   * `for x in tensor` — `lax.scan` over the leading axis (static
     length, reverse-differentiable).
 
+  * `break`/`continue` in a converted loop, and `return` inside a loop
+    body — rewritten into boolean control flags threaded through the
+    loop carry (reference break_continue_transformer.py:1 /
+    return_transformer.py:1), with guarded statement tails and a
+    short-circuit loop condition; the return-value slot starts UNDEF
+    and is promoted to the bound arm's aval at dispatch time.
+
 What does NOT convert (left as original python, or the whole function
-falls back unconverted with a warning): `break`/`continue` in a loop
-whose test is traced, `return` inside a loop body, `global`/`nonlocal`
-in a converted branch, `try`/`with` containing `return`. Error
-locations map back to the user's source file/line (the transformed
-code compiles against the original filename and line offsets).
+falls back unconverted with a warning): `break`/`continue`/`return`
+under `with`/`try` inside a loop, loops with an `else` clause,
+`for` over a non-range iterable with break/continue (consuming a
+generator to exhaustion would change semantics), `global`/`nonlocal`
+in a converted branch. Error locations map back to the user's source
+file/line (the transformed code compiles against the original filename
+and line offsets).
 """
 import ast
 import copy
@@ -106,6 +115,51 @@ def _as_pred(pv, where):
             f"to_static autograph: condition in {where} has shape "
             f"{pv.shape}; a tensor condition must be a scalar")
     return pv if pv.dtype == jnp.bool_ else pv != 0
+
+
+# -- control-flag runtime for rewritten break/continue/return ----------
+# (reference: dygraph_to_static/break_continue_transformer.py:1 and
+# return_transformer.py:1 rewrite loop control into boolean variables;
+# here the flags are jax booleans so they thread through lax carries)
+
+def false_():
+    # np scalar, NOT jnp: under jit every jnp op stages to a tracer,
+    # which would force every rewritten loop onto the traced path and
+    # destroy python-mode break semantics. Concrete flags stay python
+    # until a traced branch promotes them (see _dispatch_if_promote).
+    return np.bool_(False)
+
+
+def true_():
+    return np.bool_(True)
+
+
+def no_flag(*flags):
+    """True when NO control flag is set — the guard predicate wrapped
+    around statements that follow a rewritten break/continue/return.
+    numpy on concrete flags, jnp once any flag is traced."""
+    raws = [_raw(f) for f in flags]
+    if any(isinstance(r, jax.core.Tracer) for r in raws):
+        out = None
+        for r in raws:
+            r = jnp.asarray(r)
+            out = r if out is None else jnp.logical_or(out, r)
+        return jnp.logical_not(out)
+    return np.bool_(not any(bool(np.asarray(r)) for r in raws))
+
+
+def loop_and(ok, test_thunk):
+    """Short-circuit `ok and test()` for rewritten loop conditions:
+    python-lazy when `ok` is concrete (a set break flag must not
+    re-evaluate a side-effecting test — exact python `break`
+    semantics), logical_and under trace."""
+    if not _is_traced(ok):
+        if not bool(np.asarray(_raw(ok))):
+            return np.bool_(False)
+        return test_thunk()
+    t = test_thunk()
+    return jnp.logical_and(jnp.asarray(_raw(ok)),
+                           _as_pred(_raw(t), "<loop condition>"))
 
 
 def _leafp(x):
@@ -189,19 +243,141 @@ def _dispatch_if(pred, true_fn, false_fn, vals, where):
         return pure
 
     operand = tuple(vals[i]._value for i in dyn_idx)
-    res = lax.cond(_as_pred(pv, where), mk(true_fn, 0), mk(false_fn, 1),
-                   operand)
-    (td_t, sig_t), (td_f, sig_f) = holders
-    if td_t != td_f or len(sig_t) != len(sig_f) or not all(
-            _static_eq(a, b) for a, b in zip(sig_t, sig_f)):
-        raise ValueError(
-            f"to_static autograph: the two branches of the tensor `if` "
-            f"in {where} produce different structures/python values — "
-            "every variable assigned under a tensor condition must "
-            "leave both branches with the same type and structure")
+    orig_err = None
+    try:
+        res = lax.cond(_as_pred(pv, where), mk(true_fn, 0),
+                       mk(false_fn, 1), operand)
+        (td_t, sig_t), (td_f, sig_f) = holders
+        mismatch = (td_t != td_f or len(sig_t) != len(sig_f) or not all(
+            _static_eq(a, b) for a, b in zip(sig_t, sig_f)))
+    except TypeError as e:
+        # lax.cond rejects branches whose output avals differ (e.g. one
+        # arm binds a value the other leaves UNDEF — the return-value
+        # slot of a rewritten return-in-loop). Retry with promotion.
+        # A genuine user TypeError re-raises from the promotion's
+        # abstract re-trace (eval_shape exceptions propagate).
+        mismatch = True
+        res = None
+        orig_err = e
+    if mismatch:
+        promoted = _dispatch_if_promote(pv, true_fn, false_fn, vals,
+                                        dyn_idx, sg, where)
+        if promoted is None:
+            raise ValueError(
+                f"to_static autograph: the two branches of the tensor "
+                f"`if` in {where} produce different structures/python "
+                "values — every variable assigned under a tensor "
+                "condition must leave both branches with the same type "
+                "and structure") from orig_err
+        return promoted
     if not isinstance(res, tuple):
         res = (res,)
     return _join_leaves(td_t, sig_t, list(res))
+
+
+def _dispatch_if_promote(pv, true_fn, false_fn, vals, dyn_idx, sg, where):
+    """Unify branches that differ ONLY by UNDEF leaves: a leaf one arm
+    binds to an array while the other leaves unbound is promoted to a
+    dynamic leaf, with zeros of the bound arm's aval standing in on the
+    unbound side (never observed: the flag guards of the loop-control
+    rewrite gate every read). Returns None when the branches genuinely
+    mismatch. Branch side effects run once extra (abstract eval) — same
+    caveat the reference's UndefinedVar machinery carries
+    (return_transformer.py RETURN_NO_VALUE placeholder)."""
+    Tensor = _tensor_cls()
+    hold = [None, None]
+
+    def absrun(branch, slot):
+        def f(operand):
+            local = list(vals)
+            for k, i in enumerate(dyn_idx):
+                local[i] = Tensor(operand[k], stop_gradient=sg[k])
+            treedef, sig, dyn = _split_leaves(branch(*local))
+            hold[slot] = (treedef, sig)
+            return tuple(dyn)
+
+        return f
+
+    operand = tuple(vals[i]._value for i in dyn_idx)
+    # NO try/except here: a user bug inside a branch (str + int, bad
+    # shapes, ...) must surface as ITSELF, not as a misleading
+    # structure-mismatch report
+    av_t = jax.eval_shape(absrun(true_fn, 0), operand)
+    av_f = jax.eval_shape(absrun(false_fn, 1), operand)
+    (td_t, sig_t), (td_f, sig_f) = hold
+    if td_t != td_f or len(sig_t) != len(sig_f):
+        return None
+    av_t, av_f = list(av_t), list(av_f)
+    # per-leaf unified signature + the aval backing each dynamic leaf
+    uni, avals = [], []
+    kt = kf = 0
+    for s_t, s_f in zip(sig_t, sig_f):
+        a_t = av_t[kt] if (isinstance(s_t, _Dyn) or s_t is _DYNRAW) \
+            else None
+        a_f = av_f[kf] if (isinstance(s_f, _Dyn) or s_f is _DYNRAW) \
+            else None
+        kt += a_t is not None
+        kf += a_f is not None
+        if a_t is not None and a_f is not None:
+            if not _static_eq(s_t, s_f) and not (
+                    isinstance(s_t, _Dyn) or isinstance(s_f, _Dyn)):
+                return None
+            uni.append(s_t)
+            avals.append(a_t)
+        elif a_t is not None and s_f is UNDEF:
+            uni.append(s_t)
+            avals.append(a_t)
+        elif a_f is not None and s_t is UNDEF:
+            uni.append(s_f)
+            avals.append(a_f)
+        elif a_t is not None and _promotable_static(s_f):
+            uni.append(s_t)
+            avals.append(a_t)
+        elif a_f is not None and _promotable_static(s_t):
+            uni.append(s_f)
+            avals.append(a_f)
+        elif a_t is None and a_f is None and _static_eq(s_t, s_f):
+            uni.append(s_t)
+            avals.append(None)
+        elif (a_t is None and a_f is None and _promotable_static(s_t)
+              and _promotable_static(s_f)):
+            # e.g. a control flag: True in one arm, False in the other
+            # — promote to a dynamic boolean/number carry
+            uni.append(_DYNRAW)
+            avals.append(jax.ShapeDtypeStruct(
+                np.shape(s_t), jnp.asarray(s_t).dtype))
+        else:
+            return None
+
+    def mk_uni(branch, branch_sig):
+        def pure(operand):
+            local = list(vals)
+            for k, i in enumerate(dyn_idx):
+                local[i] = Tensor(operand[k], stop_gradient=sg[k])
+            _, sig, dyn = _split_leaves(branch(*local))
+            out = []
+            it = iter(dyn)
+            for s, u, av in zip(sig, uni, avals):
+                own_dyn = isinstance(s, _Dyn) or s is _DYNRAW
+                uni_dyn = isinstance(u, _Dyn) or u is _DYNRAW
+                if own_dyn:
+                    v = next(it)
+                    if uni_dyn:
+                        out.append(v)
+                elif uni_dyn:
+                    if s is UNDEF:
+                        out.append(jnp.zeros(av.shape, av.dtype))
+                    else:   # promoted static value (flag/number)
+                        out.append(jnp.asarray(s, av.dtype))
+            return tuple(out)
+
+        return pure
+
+    res = lax.cond(_as_pred(pv, where), mk_uni(true_fn, sig_t),
+                   mk_uni(false_fn, sig_f), operand)
+    if not isinstance(res, tuple):
+        res = (res,)
+    return _join_leaves(td_t, uni, list(res))
 
 
 def run_ifelse(pred, true_fn, false_fn, vals, names, where="<if>"):
@@ -217,6 +393,72 @@ def run_terminal_if(pred, true_fn, false_fn, vals=(), where="<if>"):
     return _dispatch_if(pred, true_fn, false_fn, vals, where)
 
 
+def _promotable_static(s):
+    """Static leaves a traced branch/loop may legally turn dynamic:
+    UNDEF (the return-value slot) and plain python/numpy scalars
+    (control flags, loop counters)."""
+    return s is UNDEF or isinstance(
+        s, (bool, int, float, np.bool_, np.integer, np.floating))
+
+
+def _stabilize_carry(body_fn, vals, where, rounds=3):
+    """Make the loop carry's structure a fixpoint of the body: probe
+    the body abstractly (jax.eval_shape — no FLOPs), and wherever the
+    body turns a static leaf dynamic, promote the INIT leaf too —
+    UNDEF becomes zeros of the discovered aval (the return-value slot,
+    never observed: flag-guarded), a python/numpy scalar becomes
+    jnp.asarray of its value (control flags, counters). Reference:
+    loop_transformer.py promotes loop vars into Variables the same
+    way. Leaves anything it can't promote for the standard structure
+    error to report."""
+    Tensor = _tensor_cls()
+    for _ in range(rounds):
+        treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
+        hold = {}
+
+        def probe(dyn):
+            out = body_fn(*_join_leaves(treedef0, sig0, list(dyn)))
+            td1, sig1, dyn1 = _split_leaves(tuple(out))
+            hold["s"] = (td1, sig1)
+            return tuple(dyn1)
+
+        try:
+            avals = list(jax.eval_shape(probe, tuple(dyn0)))
+        except Exception:
+            return vals   # let the standard structure error fire
+        td1, sig1 = hold["s"]
+        if td1 != treedef0 or len(sig1) != len(sig0):
+            return vals
+        leaves0, td = jax.tree_util.tree_flatten(tuple(vals),
+                                                 is_leaf=_leafp)
+        new_leaves = []
+        changed = False
+        k1 = 0
+        for leaf, s0, s1 in zip(leaves0, sig0, sig1):
+            dyn1 = isinstance(s1, _Dyn) or s1 is _DYNRAW
+            av = avals[k1] if dyn1 else None
+            k1 += dyn1
+            dyn0_leaf = isinstance(s0, _Dyn) or s0 is _DYNRAW
+            if dyn1 and not dyn0_leaf and _promotable_static(s0):
+                v = (jnp.zeros(av.shape, av.dtype) if s0 is UNDEF
+                     else jnp.asarray(s0, av.dtype))
+                new_leaves.append(Tensor(v, stop_gradient=s1.sg)
+                                  if isinstance(s1, _Dyn) else v)
+                changed = True
+            elif (not dyn1 and s0 is UNDEF
+                  and _promotable_static(s1) and s1 is not UNDEF):
+                # body leaves the slot a CONSTANT (e.g. a continue flag
+                # reset at body top): settle the unbound init on it
+                new_leaves.append(s1)
+                changed = True
+            else:
+                new_leaves.append(leaf)
+        if not changed:
+            return vals
+        vals = tuple(jax.tree_util.tree_unflatten(td, new_leaves))
+    return vals
+
+
 def run_while(test_fn, body_fn, vals, names, where="<while>"):
     t0 = test_fn(*vals)
     if not _is_traced(t0):
@@ -227,6 +469,7 @@ def run_while(test_fn, body_fn, vals, names, where="<while>"):
             vals = body_fn(*vals)
             t = test_fn(*vals)
         return vals
+    vals = _stabilize_carry(body_fn, vals, where)
     treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
 
     def rebuild(carry):
@@ -251,11 +494,32 @@ def run_while(test_fn, body_fn, vals, names, where="<while>"):
     return rebuild(res)
 
 
+def _exit_flag_idx(names):
+    """Positions of rewritten break/return flags in the carry — the
+    concrete (python-mode) loop paths honor them for EARLY EXIT, so a
+    rewritten `for ...: break` over a concrete range keeps python's
+    stop-now semantics instead of no-opping the remaining iterations."""
+    return [k for k, n in enumerate(names)
+            if n.startswith("__ag_brk") or n == "__ag_ret"]
+
+
+def _exit_requested(vals, exit_idx):
+    for k in exit_idx:
+        v = _raw(vals[k])
+        if not isinstance(v, jax.core.Tracer) and v is not UNDEF \
+                and bool(np.asarray(v)):
+            return True
+    return False
+
+
 def run_for_range(range_args, body_fn, vals, names, where="<for>"):
     raws = [_raw(a) for a in range_args]
     if not any(isinstance(r, jax.core.Tracer) for r in raws):
+        exit_idx = _exit_flag_idx(names)
         for i in range(*(int(np.asarray(r)) for r in raws)):
             vals = body_fn(i, *vals)
+            if exit_idx and _exit_requested(vals, exit_idx):
+                break
         return vals
     if len(raws) == 1:
         start, stop, step = 0, raws[0], 1
@@ -271,6 +535,8 @@ def run_for_range(range_args, body_fn, vals, names, where="<for>"):
     if step == 0:
         raise ValueError("range() arg 3 must not be zero")
     Tensor = _tensor_cls()
+    i0 = Tensor(jnp.asarray(start), stop_gradient=True)
+    vals = _stabilize_carry(lambda *vs: body_fn(i0, *vs), vals, where)
     treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
 
     def rebuild(carry):
@@ -300,9 +566,16 @@ def run_for_iter(it, body_fn, vals, names, where="<for>"):
     if not (isinstance(it, Tensor) and _is_traced(it)):
         if isinstance(it, Tensor):          # concrete tensor: row iter
             it = [it[k] for k in range(it.shape[0])]
+        exit_idx = _exit_flag_idx(names)
         for x in it:
             vals = body_fn(x, *vals)
+            if exit_idx and _exit_requested(vals, exit_idx):
+                break
         return vals
+    if it.shape[0] > 0:   # a 0-length scan has no row to probe with
+        row0 = Tensor(it._value[0], stop_gradient=it.stop_gradient)
+        vals = _stabilize_carry(lambda *vs: body_fn(row0, *vs), vals,
+                                where)
     treedef0, sig0, dyn0 = _split_leaves(tuple(vals))
 
     def rebuild(carry):
@@ -483,6 +756,170 @@ def _normalize_returns(block):
         out.append(st)
         i += 1
     return out
+
+
+# ---------------------------------------------- loop-control rewrite
+
+def _stmt_ast(src, loc):
+    mod = ast.parse(textwrap.dedent(src))
+    for n in ast.walk(mod):
+        ast.copy_location(n, loc)
+    return mod.body
+
+
+def _expr_ast(src, loc):
+    return _stmt_ast(src, loc)[0].value
+
+
+def _bc_under_with_try(body):
+    """break/continue/return nested under With/Try inside this loop —
+    kept as python (the rewrite can't guard across those scopes)."""
+    for st in body:
+        for n in ast.walk(st):
+            if isinstance(n, (ast.With, ast.AsyncWith, ast.Try)):
+                for m in ast.walk(n):
+                    if isinstance(m, (ast.Break, ast.Continue,
+                                      ast.Return)):
+                        return True
+    return False
+
+
+class _LoopControlTransformer(ast.NodeTransformer):
+    """Rewrite `break`/`continue`/`return` INSIDE loops into boolean
+    control flags threaded through the loop carry (reference:
+    dygraph_to_static/break_continue_transformer.py:1,
+    return_transformer.py:1 — the same predicate-rewriting recipe
+    targeting lax carries instead of static-graph Variables):
+
+      break    → __ag_brkN = true()      continue → __ag_cntN = true()
+      return e → __ag_ret = true(); __ag_rv = e
+
+    every statement after a (possibly nested-in-`if`) flag set is
+    guarded by `if no_flag(...)`; a while-test becomes
+    `loop_and(no_flag(brk, ret), lambda: test)` (short-circuit — a set
+    break flag never re-evaluates a side-effecting test); a loop that
+    rewrote a return is followed by `if __ag_ret: return __ag_rv`,
+    which the return normalizer + lax.cond machinery then convert. The
+    return-value slot starts UNDEF; the runtime promotes it to zeros of
+    the bound arm's aval (see _dispatch_if_promote /
+    _discover_undef_init). `for` loops are rewritten only over range()
+    (a generator iterated to exhaustion would change consumption
+    semantics); break/continue under With/Try stay python."""
+
+    def __init__(self):
+        self._n = 0
+        self.uses_ret = False
+
+    def visit_FunctionDef(self, node):
+        return node   # nested defs keep python semantics
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._rewrite(node, is_for=False)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range")
+        if not is_range:
+            return node
+        return self._rewrite(node, is_for=True)
+
+    def _rewrite(self, node, is_for):
+        sets_ret_inner = any(getattr(n, "_ag_sets_ret", False)
+                             for n in ast.walk(node))
+        has_bc = _has_own_break(node.body)
+        has_ret = _contains_return(node.body)
+        if not (has_bc or has_ret or sets_ret_inner):
+            return node
+        if node.orelse or _bc_under_with_try(node.body):
+            return node   # python fallback (honest warning downstream)
+        self._n += 1
+        uid = self._n
+        brk, cnt = f"__ag_brk{uid}", f"__ag_cnt{uid}"
+        loop_ret = {"used": has_ret or sets_ret_inner}
+        new_body, _ = self._rw_body(node.body, brk, cnt, loop_ret, node)
+        rt = "__paddle_tpu_autograph__"
+        exit_flags = [brk] + (["__ag_ret"] if loop_ret["used"] else [])
+        if is_for:
+            # a `for` has no condition to stop it: once break/return
+            # fires, every REMAINING iteration's whole body must no-op
+            wrap = _stmt_ast(
+                f"if {rt}.no_flag({', '.join(exit_flags)}):\n    pass",
+                node)[0]
+            wrap.body = new_body
+            new_body = [wrap]
+        new_body = _stmt_ast(f"{cnt} = {rt}.false_()", node) + new_body
+        node.body = new_body
+        if not is_for:
+            test_holder = _expr_ast(
+                f"{rt}.loop_and({rt}.no_flag({', '.join(exit_flags)}), "
+                f"lambda: None)", node)
+            test_holder.args[1].body = node.test
+            node.test = test_holder
+        out = _stmt_ast(
+            f"{brk} = {rt}.false_()\n{cnt} = {rt}.false_()", node)
+        out.append(node)
+        if loop_ret["used"]:
+            self.uses_ret = True
+            node._ag_sets_ret = True
+            post = _stmt_ast("if __ag_ret:\n    return __ag_rv", node)
+            out.extend(post)
+        return out
+
+    def _rw_body(self, stmts, brk, cnt, loop_ret, loc):
+        """Returns (rewritten statements, any-flag-setter)."""
+        rt = "__paddle_tpu_autograph__"
+        out = []
+        any_setter = False
+        for i, st in enumerate(stmts):
+            new, setter = self._rw_stmt(st, brk, cnt, loop_ret, loc)
+            out.extend(new)
+            any_setter = any_setter or setter
+            if setter and i + 1 < len(stmts):
+                rest, _ = self._rw_body(stmts[i + 1:], brk, cnt,
+                                        loop_ret, loc)
+                flags = [brk, cnt] + (["__ag_ret"] if loop_ret["used"]
+                                      else [])
+                guard = _stmt_ast(
+                    f"if {rt}.no_flag({', '.join(flags)}):\n    pass",
+                    loc)[0]
+                guard.body = rest
+                out.append(guard)
+                return out, True
+        return out, any_setter
+
+    def _rw_stmt(self, st, brk, cnt, loop_ret, loc):
+        rt = "__paddle_tpu_autograph__"
+        if isinstance(st, ast.Break):
+            return _stmt_ast(f"{brk} = {rt}.true_()", st), True
+        if isinstance(st, ast.Continue):
+            return _stmt_ast(f"{cnt} = {rt}.true_()", st), True
+        if isinstance(st, ast.Return):
+            loop_ret["used"] = True
+            stmts = _stmt_ast(
+                f"__ag_ret = {rt}.true_()\n__ag_rv = None", st)
+            if st.value is not None:
+                stmts[1].value = st.value
+            return stmts, True
+        if isinstance(st, ast.If):
+            body, s1 = self._rw_body(st.body, brk, cnt, loop_ret, loc)
+            orelse, s2 = self._rw_body(st.orelse, brk, cnt, loop_ret,
+                                       loc)
+            st.body = body
+            st.orelse = orelse
+            return [st], s1 or s2
+        if isinstance(st, (ast.While, ast.For)):
+            # inner loop (already rewritten): it re-raises only the
+            # function-level return flag
+            return [st], getattr(st, "_ag_sets_ret", False)
+        return [st], False
 
 
 # -------------------------------------------------------- AST transforms
@@ -672,6 +1109,19 @@ def convert(fn):
     fdef.decorator_list = []
     if not _terminates(fdef.body):
         fdef.body.append(ast.Return(value=ast.Constant(value=None)))
+    # loop-control pre-pass: break/continue/return inside loops become
+    # carried flags BEFORE return normalization (which otherwise
+    # rejects return-in-loop) and before the cond/while conversion
+    lct = _LoopControlTransformer()
+    body = []
+    for s in fdef.body:
+        r = lct.visit(s)
+        body.extend(r if isinstance(r, list) else [r])
+    if lct.uses_ret:
+        body = _stmt_ast(
+            "__ag_ret = __paddle_tpu_autograph__.false_()\n"
+            "__ag_rv = __paddle_tpu_autograph__.UNDEF", fdef) + body
+    fdef.body = body
     fdef.body = _normalize_returns(fdef.body)
     where = f"{fn.__module__}.{fn.__qualname__}"
     tf = _CFTransformer(where)

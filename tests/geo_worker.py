@@ -1,0 +1,84 @@
+"""Worker for the geo-async PS test (run via launch, 4 processes).
+
+Trains the same tiny CTR model (sparse embedding sum → logistic loss)
+twice over identical data streams: once with the synchronous
+ShardedSparseTable (staleness=1) and once with GeoSparseTable
+(sync_every=4, reference GeoCommunicator bounded staleness). Reports
+both loss curves; the test asserts the geo run's quality stays within
+tolerance of sync — the bounded-staleness contract
+(communicator.h:598, memory_sparse_geo_table.h:1).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.ps import (  # noqa: E402
+    GeoSparseTable, ShardedSparseTable, SparseSGDRule)
+
+DIM, VOCAB, FIELDS, STEPS, LR = 8, 64, 4, 24, 0.1
+
+
+def make_init(dim):
+    def f(n, ids):
+        return (np.sin(np.outer(ids + 1.0, np.arange(1, dim + 1)))
+                / np.sqrt(dim)).astype(np.float32)
+
+    return f
+
+
+def train(table, rank, world):
+    """Sparse logistic regression: p = sigmoid(sum_fields emb(id)·w)."""
+    w = np.ones(DIM, np.float32)   # fixed dense head: isolates PS
+    losses = []
+    for step in range(STEPS):
+        r = np.random.default_rng(step)
+        ids_full = r.integers(0, VOCAB, (16, FIELDS))
+        # additively-representable target (threshold of the id sum) —
+        # each id's embedding can learn a monotone contribution
+        y_full = (ids_full.sum(axis=1)
+                  > VOCAB * FIELDS / 2).astype(np.float32)
+        ids = ids_full[rank::world]
+        y = y_full[rank::world]
+        flat = ids.reshape(-1)
+        rows = table.pull(flat).reshape(len(ids), FIELDS, DIM)
+        logit = rows.sum(axis=1) @ w
+        p = 1.0 / (1.0 + np.exp(-logit))
+        # sum-reduction BCE grads, identical formulation both modes:
+        # dL/drow = (p - y) · w for every field's row of the sample
+        drow = (p - y)[:, None] * w[None, :]
+        grads = np.repeat(drow[:, None, :], FIELDS,
+                          axis=1).reshape(-1, DIM)
+        table.push(flat, grads)
+        loss = -(y * np.log(p + 1e-7)
+                 + (1 - y) * np.log(1 - p + 1e-7)).sum()
+        losses.append(float(xproc.all_reduce_np(
+            np.asarray([loss], np.float32))[0]))
+    table.flush()
+    return losses
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+
+    sync_t = ShardedSparseTable(DIM, rule=SparseSGDRule(LR),
+                                initializer=make_init(DIM), staleness=1)
+    sync_losses = train(sync_t, rank, world)
+
+    geo_t = GeoSparseTable(DIM, rule=SparseSGDRule(LR),
+                           initializer=make_init(DIM), sync_every=4)
+    geo_losses = train(geo_t, rank, world)
+
+    with open(os.path.join(out_dir, f"geo_out_{rank}.json"), "w") as f:
+        json.dump({"sync": sync_losses, "geo": geo_losses}, f)
+
+
+if __name__ == "__main__":
+    main()

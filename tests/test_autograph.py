@@ -245,9 +245,14 @@ def test_layer_forward_with_tensor_if():
     assert np.isfinite(out.numpy()).all()
 
 
-def test_unsupported_falls_back_with_warning():
-    # return inside a loop: unsupported -> warn + run original python
-    with pytest.warns(UserWarning, match="unconverted"):
+def test_return_in_loop_now_converts_python_mode():
+    # round-5: return-in-loop is converted (flag rewrite) — python-mode
+    # concrete bounds still produce the plain-python result, no warning
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+
         @to_static
         def f(x, n):
             for i in range(n):
@@ -255,7 +260,21 @@ def test_unsupported_falls_back_with_warning():
                     return x * i
             return x
 
-        # python path still works after fallback
+        assert float(f(t([3.0]), 5).numpy()[0]) == 6.0
+
+
+def test_unsupported_falls_back_with_warning():
+    # return under `with` inside a loop: the flag rewrite cannot guard
+    # across that scope -> warn + run original python
+    with pytest.warns(UserWarning, match="unconverted"):
+        @to_static
+        def f(x, n):
+            for i in range(n):
+                with memoryview(b"x"):   # any context manager
+                    if i == 2:
+                        return x * i
+            return x
+
         assert float(f(t([3.0]), 5).numpy()[0]) == 6.0
 
 
@@ -314,3 +333,153 @@ def test_append_only_for_stays_python():
 
     xs = t([[1.0], [4.0]])
     np.testing.assert_allclose(f(xs).numpy(), [10.0])
+
+
+# --------------------------------------------------------------------
+# round-5: break/continue/return-in-loop conversion (reference
+# break_continue_transformer.py / return_transformer.py patterns)
+# --------------------------------------------------------------------
+
+def test_while_break_on_tensor_condition():
+    # reference test_break_continue.py::test_break_in_while pattern
+    @to_static
+    def f(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 10:
+            i = i + 1
+            if (i > x.sum()):
+                break
+            x = x + 0.5
+        return x, i
+
+    x, i = f(t([3.0]))
+    # iterations: i=1,2,3 add 0.5 until i exceeds sum (which grows)
+    assert float(i.numpy()) <= 10.0
+    ref_x, ref_i = np.float32(3.0), 0.0
+    while ref_i < 10:
+        ref_i += 1
+        if ref_i > ref_x:
+            break
+        ref_x = ref_x + 0.5
+    np.testing.assert_allclose(x.numpy(), [ref_x], rtol=1e-6)
+    assert float(i.numpy()) == ref_i
+
+
+def test_while_continue_on_tensor_condition():
+    # reference test_break_continue.py::test_continue_in_while pattern
+    @to_static
+    def f(n):
+        i = paddle.to_tensor(np.float32(0.0))
+        s = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            i = i + 1
+            if i.sum() % 2 == 0:
+                continue
+            s = s + i
+        return s
+
+    # 1+3+5+7+9 = 25
+    np.testing.assert_allclose(f(t(10.0)).numpy(), 25.0, rtol=1e-6)
+
+
+def test_for_range_break_traced_bound():
+    # reference test_break_continue.py::test_break_in_for pattern
+    @to_static
+    def f(x):
+        s = paddle.to_tensor(np.float32(0.0))
+        n = paddle.to_tensor(10)
+        for i in range(n):
+            if s > x.sum():
+                break
+            s = s + 2.0
+        return s
+
+    np.testing.assert_allclose(f(t([5.0])).numpy(), 6.0, rtol=1e-6)
+
+
+def test_for_range_continue():
+    @to_static
+    def f(n):
+        s = paddle.to_tensor(np.float32(0.0))
+        for i in range(n):
+            if (i % 2 == 0).sum() if hasattr(i % 2 == 0, "sum") else (
+                    i % 2 == 0):
+                continue
+            s = s + 1.0
+        return s
+
+    np.testing.assert_allclose(f(paddle.to_tensor(10)).numpy(), 5.0)
+
+
+def test_return_inside_while_traced():
+    # reference return_transformer.py: return inside a traced loop
+    @to_static
+    def f(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 100:
+            i = i + 1
+            if i > x.sum():
+                return i * 10
+            x = x + 0.0
+        return i
+
+    np.testing.assert_allclose(f(t([4.0])).numpy(), 50.0, rtol=1e-6)
+
+
+def test_return_inside_for_range_traced():
+    @to_static
+    def f(x):
+        n = paddle.to_tensor(8)
+        acc = x * 0
+        for i in range(n):
+            acc = acc + 1.0
+            if acc.sum() > 3.0:
+                return acc * 2
+        return acc
+
+    np.testing.assert_allclose(f(t([0.0])).numpy(), [8.0], rtol=1e-6)
+
+
+def test_break_python_mode_semantics_preserved():
+    # concrete loop bounds: the rewritten form must match plain python
+    # exactly, including NOT re-evaluating a side-effecting test after
+    # break
+    calls = []
+
+    @to_static
+    def f(x):
+        i = 0.0
+        out = x
+        while probe(i):
+            i = i + 1.0
+            if i > 2.5:
+                break
+            out = out + 1.0
+        return out
+
+    def probe(i):
+        calls.append(1)
+        return i < 10
+
+    globals()["probe"] = probe
+    np.testing.assert_allclose(f(t([0.0])).numpy(), [2.0])
+    assert len(calls) == 3   # i=0,1,2 checks; break skips the 4th
+
+
+def test_nested_loop_break_binds_to_inner():
+    @to_static
+    def f(n):
+        total = paddle.to_tensor(np.float32(0.0))
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            i = i + 1
+            j = paddle.to_tensor(np.float32(0.0))
+            while j < 5:
+                j = j + 1
+                if j > 2:
+                    break
+                total = total + 1.0
+        return total
+
+    # inner contributes 2 per outer iteration, 3 outer iterations
+    np.testing.assert_allclose(f(t(3.0)).numpy(), 6.0, rtol=1e-6)

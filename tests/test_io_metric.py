@@ -2,6 +2,7 @@
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import io, metric, nn
@@ -257,3 +258,95 @@ def test_distributed_metric_yaml_registry(tmp_path):
     cfg2 = tmp_path / "m2.yaml"
     cfg2.write_text("monitors:\n  - {name: solo, method: AucCalculator}\n")
     assert set(dmetric.init_metric(metric_yaml_path=str(cfg2))) == {"solo"}
+
+
+# --------------------------------------------------------------------
+# round-5: ragged-batch training ingest (reference LoD workloads,
+# paddle/fluid/framework/lod_tensor.h:1; SURVEY hard part 3)
+# --------------------------------------------------------------------
+
+class _RaggedText(io.Dataset):
+    """Variable-length token sequences + a scalar label."""
+
+    def __init__(self, n=64, vocab=50, seed=3):
+        rng = np.random.default_rng(seed)
+        self.rows = [rng.integers(1, vocab, (int(L),)).astype(np.int64)
+                     for L in rng.integers(3, 40, (n,))]
+        self.labels = [np.float32(len(r) % 2) for r in self.rows]
+
+    def __getitem__(self, i):
+        return self.rows[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+def test_bucketed_sampler_groups_by_length():
+    ds = _RaggedText()
+    bs = io.BucketedBatchSampler(ds, batch_size=8,
+                                 lengths=lambda s: len(s[0]),
+                                 buckets=[8, 16, 40], shuffle=True)
+    seen = 0
+    for batch in bs:
+        lens = [len(ds[i][0]) for i in batch]
+        b = bs.bucket_for(max(lens))
+        assert all(bs.bucket_for(l) == b for l in lens), lens
+        seen += len(batch)
+    assert seen == len(ds)
+    assert len(bs) >= 3
+
+
+def test_ragged_training_compiles_at_most_one_program_per_bucket():
+    """Variable-length text + bucketing: the WHOLE training epoch
+    compiles ≤ len(buckets) programs (TrainStep.num_batch_signatures);
+    without bucketing the recompile guard warns."""
+    buckets = [8, 16, 40]
+    ds = _RaggedText()
+    loader = io.DataLoader(
+        ds,
+        batch_sampler=io.BucketedBatchSampler(
+            ds, batch_size=8, lengths=lambda s: len(s[0]),
+            buckets=buckets, shuffle=True, drop_last=False),
+        collate_fn=io.pad_to_bucket_collate(buckets, pad_value=0))
+
+    paddle.seed(0)
+    emb = nn.Embedding(50, 16, padding_idx=0)
+    head = nn.Linear(16, 1)
+    model = nn.Sequential()   # container for TrainStep param walk
+    model.add_sublayer("emb", emb)
+    model.add_sublayer("head", head)
+
+    def loss_fn(m, ids, y, lens):
+        h = m._sub_layers["emb"](ids)          # [b, L, d], pads -> idx 0
+        mask = (ids != 0).astype("float32").unsqueeze(-1)
+        pooled = (h * mask).sum(axis=1) / paddle.clip(
+            mask.sum(axis=1), min=1.0)
+        logit = m._sub_layers["head"](pooled)[:, 0]
+        return nn.functional.binary_cross_entropy_with_logits(logit, y)
+
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    n_batches = 0
+    for ids, y, lens in loader:
+        step(ids, y, lens)
+        n_batches += 1
+    assert n_batches >= 4
+    # one compiled program per (bucket, tail-batch-size) at most; the
+    # batch dim adds at most one extra signature per bucket (tail)
+    assert step.num_batch_signatures <= 2 * len(buckets), \
+        step.num_batch_signatures
+
+    # the anti-pattern: unbucketed ragged batches warn past the cap
+    paddle.seed(0)
+    m2 = nn.Sequential()
+    m2.add_sublayer("emb", nn.Embedding(50, 16, padding_idx=0))
+    m2.add_sublayer("head", nn.Linear(16, 1))
+    opt2 = paddle.optimizer.Adam(1e-2, parameters=m2.parameters())
+    step2 = paddle.jit.TrainStep(m2, loss_fn, opt2)
+    with pytest.warns(RuntimeWarning, match="distinct batch shapes"):
+        for k in range(step2.max_batch_signatures + 1):
+            ids = paddle.to_tensor(
+                np.ones((4, 3 + k), np.int64))   # a new length each step
+            y = paddle.to_tensor(np.zeros((4,), np.float32))
+            lens = paddle.to_tensor(np.full((4,), 3 + k, np.int32))
+            step2(ids, y, lens)
