@@ -24,7 +24,7 @@ from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec)
 from ..tensor_core import Tensor
 
 __all__ = [
-    "Program", "program_guard", "default_main_program",
+    "Program", "ProgramIR", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "InputSpec", "name_scope",
     "save", "load", "save_inference_model", "load_inference_model",
     "gradients", "append_backward", "cpu_places", "device_guard", "scope_guard",
@@ -80,6 +80,150 @@ class Program:
     @property
     def ops(self):
         return self.stages
+
+    def freeze(self, fetch_list, feed_specs=None, batch_size=1):
+        """Trace the staged computation ONCE into a real, inspectable
+        IR (reference: ProgramDesc, framework.proto:236 — op list,
+        prunable, printable). The TPU-native IR is a JAXPR: `ops`
+        lists primitive names (the OpDesc view), `prune()` is jaxpr
+        dead-code elimination to a fetch subset (reference
+        Program._prune), `as_text()` is the printable desc
+        (Program.to_string), and `run()` executes the frozen program
+        as one jitted XLA computation.
+
+        Placeholders with None/-1 dims are traced at `batch_size`
+        (override per-name via feed_specs={name: (shape, dtype)}).
+        Stages must be traceable — python side effects run once at
+        freeze time, and value-dependent host reads (`.numpy()` on a
+        data-dependent tensor) raise jax's tracer error."""
+        names = list(self.placeholders)
+        specs = {}
+        for n, v in self.placeholders.items():
+            shape = tuple(batch_size if (s is None or s == -1) else int(s)
+                          for s in v.shape)
+            specs[n] = (shape, v.dtype)
+        for n, sd in (feed_specs or {}).items():
+            if n not in specs:
+                raise KeyError(
+                    f"feed_specs name {n!r} is not a declared "
+                    f"placeholder (have: {sorted(specs)})")
+            specs[n] = (tuple(sd[0]), sd[1])
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+
+        def run_fn(*feed_vals):
+            import jax.numpy as jnp
+
+            env = {n: Tensor(v) for n, v in zip(names, feed_vals)}
+            for stage in self.stages:
+                stage(env)
+            outs = []
+            for f in fetch_names:
+                if f not in env:
+                    raise KeyError(f"fetch target {f!r} not produced")
+                o = env[f]
+                outs.append(o._value if isinstance(o, Tensor)
+                            else jnp.asarray(o))
+            return tuple(outs)
+
+        avals = [jax.ShapeDtypeStruct(specs[n][0], np.dtype(specs[n][1]))
+                 for n in names]
+        closed = jax.make_jaxpr(run_fn)(*avals)
+        from jax._src.interpreters import partial_eval as pe
+
+        jaxpr_c = pe.convert_constvars_jaxpr(closed.jaxpr)
+        return ProgramIR(jaxpr_c, list(closed.consts), names, fetch_names)
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        """Printable program summary (reference Program.to_string):
+        placeholders + stage count; freeze() gives the full op-level
+        text."""
+        lines = [f"Program(stages={len(self.stages)})"]
+        for n, v in self.placeholders.items():
+            lines.append(f"  data {n}: shape={v.shape} dtype={v.dtype}")
+        return "\n".join(lines)
+
+
+class ProgramIR:
+    """Frozen jaxpr-backed program (the TPU-native ProgramDesc — see
+    Program.freeze). Constants are held as leading args of a
+    constvar-free jaxpr so pruning can drop them with ordinary DCE."""
+
+    def __init__(self, jaxpr, consts, feed_names, fetch_names):
+        self._jaxpr = jaxpr            # invars = consts ++ feeds
+        self._consts = consts
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self._compiled = None
+
+    # -- the OpDesc view ------------------------------------------------
+    @property
+    def ops(self):
+        """Primitive names in execution order (reference block.ops)."""
+        return [eq.primitive.name for eq in self._jaxpr.eqns]
+
+    def op_histogram(self):
+        import collections
+
+        return collections.Counter(self.ops)
+
+    def as_text(self):
+        """The printable IR (reference Program.to_string — full desc)."""
+        return str(self._jaxpr)
+
+    # -- passes ---------------------------------------------------------
+    def prune(self, fetch_list):
+        """Dead-code-eliminate to a fetch subset (reference
+        Program._prune): ops, constants AND feeds that the kept
+        fetches don't reach are dropped from the program."""
+        targets = [f.name if isinstance(f, Variable) else str(f)
+                   for f in fetch_list]
+        missing = [t for t in targets if t not in self.fetch_names]
+        if missing:
+            raise KeyError(f"prune targets not in fetch set: {missing}")
+        used_out = [n in set(targets) for n in self.fetch_names]
+        from jax._src.interpreters import partial_eval as pe
+
+        new_jaxpr, used_in = pe.dce_jaxpr(self._jaxpr, used_out)
+        nc = len(self._consts)
+        consts = [c for c, u in zip(self._consts, used_in[:nc]) if u]
+        feeds = [n for n, u in zip(self.feed_names, used_in[nc:]) if u]
+        return ProgramIR(new_jaxpr, consts,
+                         feeds, [n for n in self.fetch_names
+                                 if n in set(targets)])
+
+    # -- execution ------------------------------------------------------
+    def run(self, feed, return_numpy=True):
+        """Execute the frozen program as ONE jitted XLA computation —
+        the reference Executor-over-ProgramDesc path, minus the
+        interpreter (SURVEY §7: the op-by-op InterpreterCore collapses
+        into a compiled jaxpr)."""
+        if self._compiled is None:
+            jaxpr = self._jaxpr
+
+            def call(consts_and_feeds):
+                return jax.core.eval_jaxpr(jaxpr, (), *consts_and_feeds)
+
+            self._compiled = jax.jit(call)
+        nc = len(self._consts)
+        feed_vals = []
+        for n, var in zip(self.feed_names, self._jaxpr.invars[nc:]):
+            v = np.asarray(feed[n])
+            aval = var.aval
+            if tuple(v.shape) != tuple(aval.shape) or \
+                    np.dtype(v.dtype) != np.dtype(aval.dtype):
+                # shape-derived python scalars were BAKED IN at freeze
+                # time — re-running at another shape would be silently
+                # wrong, not just slow (re-freeze for a new signature)
+                raise ValueError(
+                    f"feed {n!r} has shape {v.shape}/{v.dtype} but the "
+                    f"program was frozen at {tuple(aval.shape)}/"
+                    f"{aval.dtype}; freeze() again for a new signature")
+            feed_vals.append(v)
+        outs = self._compiled(list(self._consts) + feed_vals)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return dict(zip(self.fetch_names, outs))
 
 
 _default_main = Program()

@@ -384,3 +384,73 @@ def test_fetch_subset_and_unproduced_fetch_raises():
     assert ran == ["a", "b"]
     with pytest.raises(KeyError, match="not produced"):
         exe.run(main, feed=_feed_x(), fetch_list=["nope"])
+
+
+# --------------------------------------------------------------------
+# round-5: jaxpr-backed Program IR (reference ProgramDesc /
+# Program._prune / Program.to_string — SURVEY §2.2's "static-graph
+# core" made real: the IR is a jaxpr, passes are jaxpr transforms)
+# --------------------------------------------------------------------
+
+def _build_ir_program():
+    main = static.Program()
+    with static.program_guard(main):
+        static.data(name="x", shape=[None, 4], dtype="float32")
+        static.data(name="k", shape=[None, 4], dtype="float32")
+    main.stages.append(lambda env: env.__setitem__("y", env["x"] * 2.0))
+    main.stages.append(lambda env: env.__setitem__("z", env["y"] + 3.0))
+    # w depends on k ONLY — pruning to z must drop k from the feeds
+    main.stages.append(lambda env: env.__setitem__(
+        "w", (env["k"] * env["k"]).sum()))
+    return main
+
+
+def test_program_freeze_exposes_real_ops():
+    ir = _build_ir_program().freeze(fetch_list=["z", "w"], batch_size=2)
+    assert "mul" in ir.ops and "add" in ir.ops, ir.ops
+    assert ir.op_histogram()["mul"] >= 2
+    assert "mul" in ir.as_text()
+    out = ir.run({"x": np.ones((2, 4), np.float32),
+                  "k": np.full((2, 4), 2.0, np.float32)})
+    np.testing.assert_allclose(out["z"], np.full((2, 4), 5.0))
+    np.testing.assert_allclose(out["w"], 32.0)
+
+
+def test_program_ir_prune_drops_ops_and_feeds():
+    ir = _build_ir_program().freeze(fetch_list=["z", "w"], batch_size=2)
+    pruned = ir.prune(["z"])
+    # the k-branch (square + sum) is gone...
+    assert len(pruned.ops) < len(ir.ops)
+    assert "reduce_sum" not in pruned.ops, pruned.ops
+    # ...and so is its feed
+    assert pruned.feed_names == ["x"], pruned.feed_names
+    out = pruned.run({"x": np.ones((2, 4), np.float32)})
+    np.testing.assert_allclose(out["z"], np.full((2, 4), 5.0))
+    assert set(out) == {"z"}
+    with pytest.raises(KeyError):
+        ir.prune(["nope"])
+
+
+def test_program_ir_matches_executor_and_is_one_program():
+    main = _build_ir_program()
+    exe = static.Executor()
+    feed = {"x": np.random.default_rng(0).normal(
+        size=(2, 4)).astype(np.float32),
+        "k": np.ones((2, 4), np.float32)}
+    z_eager, w_eager = exe.run(main, feed=feed, fetch_list=["z", "w"])
+    ir = main.freeze(fetch_list=["z", "w"], batch_size=2)
+    out = ir.run(feed)
+    np.testing.assert_allclose(out["z"], z_eager, rtol=1e-6)
+    np.testing.assert_allclose(out["w"], w_eager, rtol=1e-6)
+    # to_string facade summary still works pre-freeze
+    assert "Program(stages=3)" in main.to_string()
+
+
+def test_program_ir_guards_signature_and_spec_typos():
+    ir = _build_ir_program().freeze(fetch_list=["z"], batch_size=2)
+    with pytest.raises(ValueError, match="frozen at"):
+        ir.run({"x": np.ones((5, 4), np.float32),
+                "k": np.ones((2, 4), np.float32)})
+    with pytest.raises(KeyError, match="placeholder"):
+        _build_ir_program().freeze(fetch_list=["z"],
+                                   feed_specs={"X": ((2, 4), "float32")})
