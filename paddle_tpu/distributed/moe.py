@@ -41,7 +41,7 @@ from ..tensor_core import Tensor
 __all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate",
            "moe_dispatch_combine", "moe_a2a_dispatch_combine",
            "ep_scatter_tokens", "ep_gather_tokens", "ep_all_to_all",
-           "moe_a2a_capacity", "switch_dispatch"]
+           "moe_a2a_capacity", "switch_dispatch", "topk_rounds"]
 
 
 # ---------------------------------------------------------------------
@@ -117,7 +117,9 @@ def switch_dispatch(probs, num_experts, capacity, dtype):
     """Shared top-1 (switch) dispatch recipe: argmax routing, per-expert
     cumsum positions, capacity overflow-drop, one-hot dispatch tensor.
     Returns (disp [E, t, C], top_p [t], onehot [t, E]) — the ONE place
-    the capacity/keep logic lives (a2a path, in-pipeline dense path)."""
+    the capacity/keep logic lives (a2a path, in-pipeline dense path).
+    For top-k, call per round on probs with previous winners zeroed
+    (see topk_rounds)."""
     top_idx = jnp.argmax(probs, axis=-1)
     top_p = jnp.take_along_axis(probs, top_idx[:, None], -1)[:, 0]
     onehot = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)
@@ -129,6 +131,18 @@ def switch_dispatch(probs, num_experts, capacity, dtype):
     return jnp.swapaxes(disp, 0, 1), top_p, onehot
 
 
+def topk_rounds(probs, topk):
+    """Iterator of per-round routing probabilities for top-k gating:
+    round k sees probs with rounds <k's winners zeroed (the reference
+    NaiveGate/GShardGate top-k recipe as k argmax rounds)."""
+    work = probs
+    for _ in range(topk):
+        yield work
+        top_idx = jnp.argmax(work, axis=-1)
+        work = work * (1.0 - jax.nn.one_hot(top_idx, work.shape[-1],
+                                            dtype=work.dtype))
+
+
 def moe_a2a_capacity(tokens, ep, num_experts, capacity_factor):
     """Per-group (per-ep-rank) expert capacity: ceil(t_loc·cf/E) —
     GShard's grouped formulation, giving O(tokens/ep) per-rank buffers."""
@@ -138,8 +152,13 @@ def moe_a2a_capacity(tokens, ep, num_experts, capacity_factor):
 
 def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
                              capacity_factor=1.25, axis="ep",
-                             stat_axes=None, n_stat_shards=None):
-    """Token-sharded switch (top-1) routing with all-to-all exchange.
+                             stat_axes=None, n_stat_shards=None,
+                             topk=1):
+    """Token-sharded top-k routing with all-to-all exchange (topk=1 is
+    the switch formulation; topk=2 the GShard/reference default —
+    moe_layer.py gates). Each of the k rounds runs its own
+    dispatch→a2a→experts→a2a→combine pass, outputs summed with the
+    round's gate probability.
 
     Must run inside shard_map with `axis` in scope. `x` [tokens, d] is
     REPLICATED over `axis`; `gate_w` [d, E] replicated; `expert_fn`
@@ -177,7 +196,7 @@ def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
 
     t_loc = t // ep
     e_loc = num_experts // ep
-    C = moe_a2a_capacity(t, ep, num_experts, capacity_factor)
+    C = moe_a2a_capacity(t, ep, num_experts, capacity_factor * topk)
 
     x_loc = ep_scatter_tokens(x, ep, axis)            # [t_loc, d]
     # each rank computes a DIFFERENT token slice, so the replicated
@@ -185,7 +204,6 @@ def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
     # bracket (mp_ops copy_to_mp) restores the full-batch gate gradient
     logits = x_loc @ copy_to_mp(gate_w, axis)         # [t_loc, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    disp, top_p, onehot = switch_dispatch(probs, num_experts, C, x.dtype)
 
     # aux over the FULL batch: per-rank means psum'd over the token
     # groups (slices partition the tokens, so mean = psum(mean)/n).
@@ -195,21 +213,31 @@ def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
     s_axes = tuple(stat_axes) if stat_axes else (axis,)
     n_sh = n_stat_shards if n_stat_shards is not None else ep
     me = allreduce_mp(probs.mean(axis=0), s_axes) / n_sh
-    ce = allreduce_mp(onehot.mean(axis=0), s_axes) / n_sh
-    aux = num_experts * jnp.sum(me * ce)
 
-    send = jnp.einsum("etc,td->ecd", disp, x_loc)     # [E, C, d]
-    # group experts by owner (contiguous E/ep blocks — matches the 'ep'
-    # sharding of the stacked expert weights) and exchange
-    recv = ep_all_to_all(send.reshape(ep, e_loc, C, d), axis)
-    expert_in = jnp.transpose(recv, (1, 0, 2, 3)).reshape(
-        e_loc, ep * C, d)
-    expert_out = expert_fn(expert_in)                 # [e_loc, ep·C, d]
-    back = jnp.transpose(
-        expert_out.reshape(e_loc, ep, C, d), (1, 0, 2, 3))
-    ret = ep_all_to_all(back, axis).reshape(num_experts, C, d)
-    out_loc = jnp.einsum("etc,ecd->td", disp, ret)
-    out_loc = out_loc * top_p[:, None].astype(x.dtype)
+    def one_round(round_probs):
+        disp, top_p, onehot = switch_dispatch(round_probs, num_experts,
+                                              C, x.dtype)
+        ce = allreduce_mp(onehot.mean(axis=0), s_axes) / n_sh
+        round_aux = num_experts * jnp.sum(me * ce)
+        send = jnp.einsum("etc,td->ecd", disp, x_loc)  # [E, C, d]
+        # group experts by owner (contiguous E/ep blocks — matches the
+        # 'ep' sharding of the stacked expert weights) and exchange
+        recv = ep_all_to_all(send.reshape(ep, e_loc, C, d), axis)
+        expert_in = jnp.transpose(recv, (1, 0, 2, 3)).reshape(
+            e_loc, ep * C, d)
+        expert_out = expert_fn(expert_in)             # [e_loc, ep·C, d]
+        back = jnp.transpose(
+            expert_out.reshape(e_loc, ep, C, d), (1, 0, 2, 3))
+        ret = ep_all_to_all(back, axis).reshape(num_experts, C, d)
+        combined = jnp.einsum("etc,ecd->td", disp, ret)
+        return combined * top_p[:, None].astype(x.dtype), round_aux
+
+    out_loc = jnp.zeros_like(x_loc)
+    aux = jnp.zeros([], jnp.float32)
+    for round_probs in topk_rounds(probs, topk):
+        o, a = one_round(round_probs)
+        out_loc = out_loc + o
+        aux = aux + a
     return ep_gather_tokens(out_loc, axis), aux
 
 
@@ -251,27 +279,16 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, num_experts,
     out = jnp.zeros_like(x)
     aux = 0.0
     me = probs.mean(axis=0)
-    for k in range(topk):
-        top_idx = jnp.argmax(probs, axis=-1)  # [tokens]
-        top_p = jnp.take_along_axis(probs, top_idx[:, None], -1)[:, 0]
-        probs = probs * (1.0 - jax.nn.one_hot(top_idx, num_experts))
-        onehot = jax.nn.one_hot(top_idx, num_experts)  # [tokens, E]
-        # position of each token within its expert's queue
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [tokens, E]
-        keep = (pos < capacity) & (onehot > 0)
-        # dispatch tensor [E, capacity, tokens]
-        pos_idx = pos.sum(-1).astype(jnp.int32)
-        disp = (
-            jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)[:, None, :]
-            * keep[:, :, None]
-        )  # [tokens, E, capacity]
-        disp = jnp.swapaxes(disp, 0, 1)  # [E, tokens, capacity]
+    for round_probs in topk_rounds(probs, topk):
+        # shared routing recipe (switch_dispatch is the one home of the
+        # capacity/keep logic — same as the a2a and pipeline paths)
+        disp, top_p, onehot = switch_dispatch(round_probs, num_experts,
+                                              capacity, x.dtype)
         expert_in = jnp.einsum("etc,td->ecd", disp, x)
         expert_out = expert_fn(expert_in)  # [E, capacity, d]
         combined = jnp.einsum("etc,ecd->td", disp, expert_out)
         out = out + combined * top_p[:, None].astype(x.dtype)
-        ce = onehot.mean(axis=0)
-        aux = aux + num_experts * jnp.sum(me * ce)
+        aux = aux + num_experts * jnp.sum(me * onehot.mean(axis=0))
     return out, aux
 
 

@@ -63,7 +63,8 @@ def _attention(q, k, v):
     return dense_attention_bshd(q, k, v, is_causal=True)
 
 
-def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1, moe_cf=1.25, dp=1):
+def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1, moe_cf=1.25, dp=1,
+                 moe_topk=1):
     """One pre-LN decoder block as a pure function of its param dict.
     Returns (x, aux) — aux is the MoE load-balancing term (0.0 for the
     dense FFN), pre-scaled by 1/sp so the pipeline's sum_axes psum
@@ -108,7 +109,7 @@ def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1, moe_cf=1.25, dp=1):
     h = _layernorm(x, p["ln2_w"], p["ln2_b"])
     if "gate_w" in p:   # MoE FFN (experts sharded over 'ep')
         out, aux = _moe_ffn(p, h, p["gate_w"].shape[-1], ep, moe_cf,
-                            dp=dp, sp=sp)
+                            dp=dp, sp=sp, topk=moe_topk)
         # aux is the GLOBAL-batch value on every rank; 1/sp makes the
         # pipeline's sum_axes psum recover it (the pmean over dp is a
         # no-op on a replicated value)
@@ -117,8 +118,9 @@ def _decoder_fwd(p, x, nh, mp=1, sp=1, ep=1, moe_cf=1.25, dp=1):
     return x + reduce_(part) + p["fc2_b"], jnp.zeros([], jnp.float32)
 
 
-def _moe_ffn(p, h, n_experts, ep, cf=1.25, dp=1, sp=1):
-    """Switch (top-1) MoE feed-forward with experts sharded over 'ep' and
+def _moe_ffn(p, h, n_experts, ep, cf=1.25, dp=1, sp=1, topk=1):
+    """Top-k MoE feed-forward (topk=1 switch, topk=2 the reference
+    GShardGate default) with experts sharded over 'ep' and
     TOKEN-SHARDED all-to-all dispatch (reference incubate
     moe_layer.py:244 MoEScatter/MoEGather over global_scatter_op.cc /
     global_gather_op.cc). Each ep rank takes a 1/ep slice of this
@@ -157,27 +159,32 @@ def _moe_ffn(p, h, n_experts, ep, cf=1.25, dp=1, sp=1):
         out, aux = moe_a2a_dispatch_combine(
             x, p["gate_w"], expert_fn, n_experts, ep,
             capacity_factor=cf, axis="ep", stat_axes=stat_axes,
-            n_stat_shards=n_shards)
+            n_stat_shards=n_shards, topk=topk)
         return out.reshape(b, s, d), aux
 
     # ep == 1: dense local dispatch over this shard's whole token set
-    from ...distributed.moe import moe_a2a_capacity, switch_dispatch
+    from ...distributed.moe import (moe_a2a_capacity, switch_dispatch,
+                                    topk_rounds)
 
     logits = x @ p["gate_w"]
     probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-    capacity = moe_a2a_capacity(x.shape[0], 1, n_experts, cf)
-    disp, top_p, onehot = switch_dispatch(probs, n_experts, capacity,
-                                          x.dtype)
+    capacity = moe_a2a_capacity(x.shape[0], 1, n_experts, cf * topk)
     me = probs.mean(axis=0)
-    ce = onehot.mean(axis=0)
     if stat_axes:
         me = allreduce_mp(me, stat_axes) / n_shards
-        ce = allreduce_mp(ce, stat_axes) / n_shards
-    aux = n_experts * jnp.sum(me * ce)
-    expert_in = jnp.einsum("etc,td->ecd", disp, x)
-    expert_out = expert_fn(expert_in)
-    partial = jnp.einsum("etc,ecd->td", disp, expert_out)
-    out = partial * top_p[:, None].astype(x.dtype)
+    out = jnp.zeros_like(x)
+    aux = jnp.zeros([], jnp.float32)
+    for round_probs in topk_rounds(probs, topk):
+        disp, top_p, onehot = switch_dispatch(round_probs, n_experts,
+                                              capacity, x.dtype)
+        ce = onehot.mean(axis=0)
+        if stat_axes:
+            ce = allreduce_mp(ce, stat_axes) / n_shards
+        aux = aux + n_experts * jnp.sum(me * ce)
+        expert_in = jnp.einsum("etc,td->ecd", disp, x)
+        expert_out = expert_fn(expert_in)
+        partial = jnp.einsum("etc,ecd->td", disp, expert_out)
+        out = out + partial * top_p[:, None].astype(x.dtype)
     return out.reshape(b, s, d), aux
 
 
@@ -220,7 +227,8 @@ class PipelinedGPTForCausalLM(nn.Layer):
 
     def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
                  n_virtual=1, moe_experts=0, moe_hidden=None,
-                 moe_aux_weight=0.01, moe_capacity_factor=1.25):
+                 moe_aux_weight=0.01, moe_capacity_factor=1.25,
+                 moe_topk=1):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
@@ -236,6 +244,8 @@ class PipelinedGPTForCausalLM(nn.Layer):
         self.moe_hidden = moe_hidden or config.ffn_size
         self.moe_aux_weight = float(moe_aux_weight)
         self.moe_capacity_factor = float(moe_capacity_factor)
+        # moe_topk=2 is the reference GShardGate default; 1 = switch
+        self.moe_topk = int(moe_topk)
         # aux metric rides a persistable buffer so the jitted TrainStep
         # surfaces it through the frozen-value channel (the same path BN
         # running stats take) — readable after each step as a concrete
@@ -347,8 +357,10 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def _block_fn(self, mp, sp=1, ep=1, dp=1):
         nh = self.config.num_heads
         cf = self.moe_capacity_factor
+        tk = self.moe_topk
         has_aux = bool(self.moe_experts)
-        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp, ep, cf, dp)
+        layer = lambda p, x: _decoder_fwd(p, x, nh, mp, sp, ep, cf, dp,
+                                          tk)
         if self.remat == "layer":
             layer = jax.checkpoint(layer)
 
@@ -454,7 +466,8 @@ class PipelinedGPTForCausalLM(nn.Layer):
 
             def body(x, pl):
                 x2, _aux = _decoder_fwd(pl, x, nh,
-                                        moe_cf=self.moe_capacity_factor)
+                                        moe_cf=self.moe_capacity_factor,
+                                        moe_topk=self.moe_topk)
                 return x2, None
 
             x, _ = jax.lax.scan(body, x, p)
