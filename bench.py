@@ -576,8 +576,19 @@ def bench_serving():
 
 
 def bench_probe():
-    """No-op body: `_worker_bootstrap` already proved the backend is up."""
-    return {"probe": "ok"}
+    """Prove the backend can COMPUTE, not just enumerate devices.
+
+    The 2026-08-02 session showed a wedged tunnel where ``jax.devices()``
+    answers in 2 s but the first transfer/execute hangs forever — a
+    devices()-only probe would green-light a 900 s worker attempt that
+    is doomed. A 128×128 matmul round-trip (transfer + compile + execute
+    + fetch) exercises the whole path in <10 s on a healthy backend and
+    hangs (probe subprocess killed at its 150 s cap) on a wedged one."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    return {"probe": "ok", "compute": float(jnp.asarray(y)[0, 0])}
 
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,

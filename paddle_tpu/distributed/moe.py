@@ -150,6 +150,44 @@ def moe_a2a_capacity(tokens, ep, num_experts, capacity_factor):
     return max(1, int(math.ceil(t_loc * capacity_factor / num_experts)))
 
 
+def topk_pack_dispatch(probs, num_experts, capacity, dtype, topk,
+                       stat_reduce=None):
+    """Shared top-k routing: k switch rounds PACKED along the capacity
+    dim into one dispatch/combine tensor pair — the ONE home of the
+    routing loop for the dense, a2a and in-pipeline paths.
+
+    Per-round capacity is `capacity` (= cf·t/E slots), so the total
+    buffer across rounds is k·cf·t/E — GShard's top-k total — while
+    expert FLOPs and exchange bytes stay LINEAR in k (a per-round
+    dispatch at cf·k capacity run k times would cost k² and 2k
+    collectives on the a2a path).
+
+    Returns (disp [E, t, k·C], comb [E, t, k·C], aux). `comb` folds each
+    round's gate probability into the combine side, so
+    ``out = einsum('etc,ecd->td', comb, expert_out)``. `stat_reduce`
+    (identity when None) reduces the gate statistics (me/ce vectors)
+    over token-sharding axes for the GShard load-balancing aux term.
+    """
+    me = probs.mean(axis=0)
+    if stat_reduce is not None:
+        me = stat_reduce(me)
+    disps, combs = [], []
+    aux = jnp.zeros([], jnp.float32)
+    for round_probs in topk_rounds(probs, topk):
+        disp, top_p, onehot = switch_dispatch(round_probs, num_experts,
+                                              capacity, dtype)
+        ce = onehot.mean(axis=0)
+        if stat_reduce is not None:
+            ce = stat_reduce(ce)
+        aux = aux + num_experts * jnp.sum(me * ce)
+        disps.append(disp)
+        combs.append(disp * top_p[None, :, None].astype(dtype))
+    if topk == 1:
+        return disps[0], combs[0], aux
+    return (jnp.concatenate(disps, axis=2),
+            jnp.concatenate(combs, axis=2), aux)
+
+
 def moe_a2a_dispatch_combine(x, gate_w, expert_fn, num_experts, ep,
                              capacity_factor=1.25, axis="ep",
                              stat_axes=None, n_stat_shards=None,
@@ -274,22 +312,14 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, num_experts,
     """
     tokens, d = x.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    capacity = int(math.ceil(tokens / num_experts * capacity_factor * topk))
+    capacity = moe_a2a_capacity(tokens, 1, num_experts, capacity_factor)
 
-    out = jnp.zeros_like(x)
-    aux = 0.0
-    me = probs.mean(axis=0)
-    for round_probs in topk_rounds(probs, topk):
-        # shared routing recipe (switch_dispatch is the one home of the
-        # capacity/keep logic — same as the a2a and pipeline paths)
-        disp, top_p, onehot = switch_dispatch(round_probs, num_experts,
-                                              capacity, x.dtype)
-        expert_in = jnp.einsum("etc,td->ecd", disp, x)
-        expert_out = expert_fn(expert_in)  # [E, capacity, d]
-        combined = jnp.einsum("etc,ecd->td", disp, expert_out)
-        out = out + combined * top_p[:, None].astype(x.dtype)
-        aux = aux + num_experts * jnp.sum(me * onehot.mean(axis=0))
-    return out, aux
+    disp, comb, aux = topk_pack_dispatch(probs, num_experts, capacity,
+                                         x.dtype, topk,
+                                         stat_reduce=stat_reduce)
+    expert_in = jnp.einsum("etc,td->ecd", disp, x)   # [E, k·C, d]
+    expert_out = expert_fn(expert_in)
+    return jnp.einsum("etc,ecd->td", comb, expert_out), aux
 
 
 class MoELayer(nn.Layer):

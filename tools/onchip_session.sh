@@ -18,7 +18,11 @@ mkdir -p "$OUT"
 TS=$(date +%Y%m%d_%H%M%S)
 
 echo "[onchip] probing backend (150 s cap)..."
-if ! timeout 150 python -c "import jax; print(jax.devices())" \
+# compute probe, not devices(): a wedged tunnel can enumerate devices in
+# 2 s yet hang the first transfer/execute forever (2026-08-02 session)
+if ! timeout 150 python -c "import jax, jax.numpy as jnp;
+print(jax.devices());
+print((jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()[0,0])" \
     >"$OUT/probe_$TS.log" 2>&1; then
   echo "[onchip] backend still DOWN (probe hung/failed); see $OUT/probe_$TS.log"
   exit 1
