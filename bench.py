@@ -694,6 +694,10 @@ def main():
         backoff = min(backoff * 2, 120)
 
     detail = {}
+    # A run under fault injection (distributed/chaos.py) measures
+    # resilience, not speed — stamp the record so chaos runs never
+    # pollute the BENCH_*.json trend series.
+    chaos_active = bool(os.environ.get("PT_CHAOS_PLAN"))
     if gpt is not None:
         detail["gpt"] = gpt
         mfu = gpt["mfu"]
@@ -702,12 +706,13 @@ def main():
             "value": mfu,
             "unit": "fraction_of_v5e_bf16_peak",
             "vs_baseline": round(mfu / BASELINE_MFU, 4),
+            "chaos_plan_active": chaos_active,
             "detail": detail,
         }
     else:
         line = {"metric": "gpt_small_train_mfu", "value": 0.0,
                 "unit": "fraction_of_v5e_bf16_peak", "vs_baseline": 0.0,
-                "detail": detail}
+                "chaos_plan_active": chaos_active, "detail": detail}
     # Emit the headline NOW: nothing after this point can zero the result.
     print(json.dumps(line), flush=True)
     _write_detail(detail)

@@ -1,0 +1,74 @@
+"""jax version compatibility shims.
+
+The codebase targets the modern jax surface (``jax.shard_map`` with the
+``check_vma`` kwarg).  Older jax (< 0.6, e.g. the 0.4.x line) keeps
+shard_map at ``jax.experimental.shard_map.shard_map`` with the kwarg
+spelled ``check_rep``.  This module bridges the gap once, at package
+import time, so every call site can use the modern spelling:
+
+- exports :func:`shard_map` with the modern signature, and
+- installs it as ``jax.shard_map`` when the attribute is missing, so
+  existing ``jax.shard_map(...)`` / ``from jax import shard_map`` call
+  sites work unchanged on old jax, and
+- installs ``jax.lax.axis_size`` (added in jax 0.6) as the classic
+  ``psum(1, axis_name)`` idiom, which old jax constant-folds to the
+  static mesh-axis size under shard_map.
+"""
+import contextlib
+import inspect
+
+import jax
+
+__all__ = ["shard_map", "no_persistent_cache"]
+
+
+@contextlib.contextmanager
+def no_persistent_cache():
+    """Compile WITHOUT the persistent (on-disk) compilation cache.
+
+    On jax 0.4.x CPU a DONATING executable loaded from the persistent
+    cache can carry a mismatched input/output aliasing map and silently
+    corrupt its donated outputs (observed as flaky ~1e-2 divergence on
+    the first update after a checkpoint restore). Train-step compiles
+    wrap themselves in this guard; everything else keeps the cache."""
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", old)
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+# jax.export (stable since 0.4.30-ish, but absent from this jaxlib
+# build): the implementation module ships as jax._src.export._export
+# with the identical export()/deserialize()/Exported.call surface —
+# alias it so jit.save / inference.Predictor work unchanged.
+if not hasattr(jax, "export"):
+    try:
+        from jax._src.export import _export as _export_mod
+
+        jax.export = _export_mod
+    except ImportError:
+        pass
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_vma" if _HAS_CHECK_VMA else "check_rep"] = (
+                check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map

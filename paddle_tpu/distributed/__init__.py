@@ -4,7 +4,9 @@ Collectives = XLA programs over one jax.sharding.Mesh; fleet topology
 names mesh axes; parallelism = placement (see SURVEY.md §7 design map).
 """
 from . import auto_parallel  # noqa: F401
+from . import chaos  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
 from . import collective  # noqa: F401
 from . import cloud_utils  # noqa: F401
 from . import communication  # noqa: F401
@@ -53,6 +55,13 @@ from .collective import (  # noqa: F401
     send,
 )
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .resilience import (  # noqa: F401
+    RetryPolicy,
+    StepAbort,
+    StepGuard,
+    install_preemption_handler,
+)
+from .chaos import FaultPlan  # noqa: F401
 from .mesh import init_mesh, global_mesh  # noqa: F401
 from .parallel_step import DistributedTrainStep  # noqa: F401
 from .sequence_parallel import (  # noqa: F401

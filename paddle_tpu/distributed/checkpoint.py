@@ -40,10 +40,41 @@ import jax
 import jax.numpy as jnp
 
 from ..tensor_core import Tensor
+from . import chaos
+from .resilience import RetryPolicy, record
 
-__all__ = ["save_state_dict", "load_state_dict", "Checkpointer"]
+__all__ = ["save_state_dict", "load_state_dict", "Checkpointer",
+           "verify_integrity", "TornCheckpointError"]
+
+
+class TornCheckpointError(ValueError):
+    """A checkpoint failed its meta.json integrity check (truncated or
+    missing shards behind a committed meta). Distinct from the plain
+    ValueError a model/optimizer structure mismatch raises, so
+    load_latest's older-checkpoint fallback can never swallow the
+    latter and silently restart a run from step 0."""
+
 
 _META = "meta.json"
+
+# Durability: fsync shard files, meta.json and the directories before the
+# .tmp rename — without it a host crash right AFTER the rename can still
+# lose the commit record (data in the page cache, rename journaled
+# first). PT_CKPT_FSYNC=0 opts out (e.g. throwaway tmpfs test runs).
+_FSYNC = os.environ.get("PT_CKPT_FSYNC", "1") != "0"
+
+
+def _fsync_dir(path):
+    if not _FSYNC:
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 # ---------------------------------------------------------------- flatten
@@ -215,13 +246,23 @@ def save_state_dict(state, path, async_save=False):
     def _write():
         for fpath, host_arr in pending:
             storage, _ = _to_storage(host_arr)
-            np.save(fpath, storage)
+            with open(fpath, "wb") as f:
+                np.save(f, storage)
+                if _FSYNC:
+                    f.flush()
+                    os.fsync(f.fileno())
+        # THE torn-commit window: shards are on disk, the commit record
+        # is not — a kill here must leave only an invisible .tmp
+        chaos.fire("ckpt.kill_window")
         frag = {"leaves": leaves, "scalars": scalars,
                 "lists": sorted(list_paths), "bytes": bytes_paths,
                 "empties": empties}
         if nproc > 1:
             with open(os.path.join(tmp, f"meta.rank{rank}.json"), "w") as f:
                 json.dump(frag, f)
+                if _FSYNC:
+                    f.flush()
+                    os.fsync(f.fileno())
             from . import xproc
 
             xproc.barrier()  # all fragments + shards on disk
@@ -257,14 +298,77 @@ def save_state_dict(state, path, async_save=False):
 
 def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=(),
             empties=None):
+    # integrity record: leaf count + per-shard byte size, so load can
+    # reject a torn checkpoint (shard truncated/missing despite a
+    # committed meta.json) instead of half-loading it
+    shard_sizes = {}
+    for e in leaves:
+        for srec in e["shards"]:
+            shard_sizes[srec["file"]] = os.path.getsize(
+                os.path.join(tmp, "shards", srec["file"]))
     with open(os.path.join(tmp, _META), "w") as f:
         json.dump({"leaves": leaves, "scalars": scalars,
                    "lists": list(list_paths),
                    "bytes": list(bytes_paths),
-                   "empties": empties or {}}, f)
+                   "empties": empties or {},
+                   "integrity": {"leaf_count": len(leaves),
+                                 "shards": shard_sizes}}, f)
+        if _FSYNC:
+            f.flush()
+            os.fsync(f.fileno())
+    # directory entries (shard files + meta.json) durable BEFORE the
+    # rename publishes them
+    _fsync_dir(os.path.join(tmp, "shards"))
+    _fsync_dir(tmp)
     if os.path.isdir(path):
         shutil.rmtree(path)
     os.replace(tmp, path)
+    # the rename itself durable: fsync the parent directory
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def verify_integrity(path):
+    """Validate a checkpoint directory against its meta.json integrity
+    record (leaf count + per-shard byte sizes). Raises
+    TornCheckpointError on a torn checkpoint; checkpoints written before
+    the integrity record pass (nothing to check). Returns the parsed
+    meta."""
+    try:
+        with open(os.path.join(path, _META)) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        # a truncated/garbled meta.json (host crash with PT_CKPT_FSYNC=0,
+        # or a pre-fsync checkpoint) is a torn checkpoint, not a caller
+        # bug — classify it so load_latest falls back to the next-older
+        # complete checkpoint instead of crashing the resume
+        raise TornCheckpointError(
+            f"torn checkpoint {path}: unreadable {_META}: {e}") from e
+    integ = meta.get("integrity")
+    if integ is None:
+        return meta
+    if len(meta["leaves"]) != integ["leaf_count"]:
+        raise TornCheckpointError(
+            f"torn checkpoint {path}: meta lists {len(meta['leaves'])} "
+            f"leaves, integrity record expects {integ['leaf_count']}")
+    sizes = integ["shards"]
+    for e in meta["leaves"]:
+        for srec in e["shards"]:
+            fname = srec["file"]
+            if fname not in sizes:
+                raise TornCheckpointError(
+                    f"torn checkpoint {path}: shard {fname} missing "
+                    "from integrity record")
+            fpath = os.path.join(path, "shards", fname)
+            try:
+                actual = os.path.getsize(fpath)
+            except OSError:
+                raise TornCheckpointError(
+                    f"torn checkpoint {path}: shard {fname} missing")
+            if actual != sizes[fname]:
+                raise TornCheckpointError(
+                    f"torn checkpoint {path}: shard {fname} is {actual} "
+                    f"bytes, committed as {sizes[fname]}")
+    return meta
 
 
 class _AsyncHandle(threading.Thread):
@@ -302,9 +406,12 @@ def load_state_dict(path, shardings=None, return_numpy=False):
     leaf path ("a/b/c") → jax.sharding.Sharding to place a leaf sharded
     (only the locally-needed regions are copied to each device; shard
     files are memory-mapped, so an N-way-sharded leaf never materializes
-    fully per-host)."""
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    fully per-host).
+
+    The meta.json integrity record (leaf count + per-shard byte sizes)
+    is verified first: a torn checkpoint is rejected with ValueError,
+    never half-loaded."""
+    meta = verify_integrity(path)
     flat = []
     for e in meta["leaves"]:
         shape = tuple(e["shape"])
@@ -367,7 +474,7 @@ class Checkpointer:
     None if no complete checkpoint exists)."""
 
     def __init__(self, root, model=None, optimizer=None, train_step=None,
-                 keep=3, async_save=False):
+                 keep=3, async_save=False, retry=None):
         self.root = root
         self.model = model
         self.train_step = train_step
@@ -376,6 +483,18 @@ class Checkpointer:
         self.keep = keep
         self.async_save = async_save
         self._last = None
+        # transient-FS hardening (flaky NFS/GCS-fuse mounts): loads are
+        # always retried; saves only single-process + synchronous, where
+        # re-running is idempotent (the multi-controller path has merge
+        # barriers inside — a partial re-run would desync the pod, so it
+        # relies on the elastic restart layer instead)
+        # give_up_on FileNotFoundError: a missing shard behind a
+        # committed meta is a TORN checkpoint (load_latest's fallback
+        # signal), never a transient — don't burn backoff sleeps on it
+        self.retry = retry or RetryPolicy(
+            max_attempts=3, base_s=0.2, max_backoff_s=2.0,
+            retry_on=(OSError,), give_up_on=(FileNotFoundError,),
+            name="ckpt.io")
 
     def _dir(self, step):
         return os.path.join(self.root, f"ckpt-{step:08d}")
@@ -420,8 +539,14 @@ class Checkpointer:
             opt_sd = _train_step_opt_states(self.train_step)
             if opt_sd:
                 state["train_step_opt"] = opt_sd
-        self._last = save_state_dict(state, self._dir(step),
-                                     async_save=self.async_save)
+        _, nproc = _proc_index()
+        if nproc == 1 and not self.async_save:
+            self._last = self.retry.run(
+                save_state_dict, state, self._dir(step),
+                name=f"ckpt.save:{step}")
+        else:
+            self._last = save_state_dict(state, self._dir(step),
+                                         async_save=self.async_save)
         self._prune()
         return self._last
 
@@ -454,17 +579,47 @@ class Checkpointer:
         return sorted(out)
 
     def load_latest(self):
-        steps = self.steps()
-        if not steps:
-            return None
-        return self.load(steps[-1])
+        """Restore from the newest COMPLETE checkpoint. A checkpoint
+        that fails its integrity check (torn shards despite a committed
+        meta.json — pre-fsync checkpoints could do this after a host
+        crash) is journaled and skipped, falling back to the next-older
+        one instead of half-loading. ONLY torn-checkpoint shapes
+        (TornCheckpointError, missing shard files) fall back — a
+        transient I/O failure that survives the retry budget, or a
+        model/optimizer structure mismatch, propagates, so neither a
+        flaky filesystem nor a changed model can masquerade as "no
+        checkpoints" and silently restart a long run from step 0."""
+        from .resilience import RetryError
+
+        for step in reversed(self.steps()):
+            try:
+                return self.load(step)
+            except TornCheckpointError as e:
+                record("ckpt_rejected", step=step, error=str(e))
+                continue
+            except RetryError as e:
+                if isinstance(e.last, FileNotFoundError):
+                    record("ckpt_rejected", step=step, error=str(e))
+                    continue
+                raise
+        return None
 
     def load(self, step):
-        # place param leaves straight onto their current shardings (ZeRO/TP)
+        # Place param leaves straight onto their current shardings
+        # (ZeRO/TP) — but ONLY for leaves whose live array is committed.
+        # make_array_from_callback yields committed arrays, and a
+        # committed leaf where the live one was uncommitted lowers the
+        # compiled TrainStep differently; with the persistent compile
+        # cache the two variants collide on one cache entry and the
+        # mismatched donation/aliasing map silently reverts the first
+        # post-restore update (flaky resume-divergence, see
+        # test_train_kill_resume_matches_uninterrupted). Committed live
+        # arrays (device_put with an explicit NamedSharding — the real
+        # ZeRO/TP case) keep the shard-for-shard mmap load.
         shardings = {}
         if self.model is not None:
             for name, p in self.model.state_dict().items():
-                if isinstance(p._value, jax.Array):
+                if isinstance(p._value, jax.Array) and p._value.committed:
                     shardings[f"model/{name}"] = p._value.sharding
         ts = self.train_step
         if ts is not None and getattr(ts, "_opt_states", None):
@@ -473,9 +628,20 @@ class Checkpointer:
             # them fully per host)
             for n, st in zip(_train_names(ts), ts._opt_states):
                 for k, v in st.items():
-                    if isinstance(v, jax.Array):
+                    if isinstance(v, jax.Array) and v.committed:
                         shardings[f"train_step_opt/{n}/{k}"] = v.sharding
-        state = load_state_dict(self._dir(step), shardings=shardings)
+        # sharded restore compiles reshard programs (make_array_from_
+        # callback / device_put onto NamedShardings) — keep those out of
+        # the persistent compile cache too: a cache-served reshard can
+        # hand back subtly-wrong restored state on this jax build (same
+        # aliasing hazard as the donating step executables, see
+        # core.jax_compat.no_persistent_cache)
+        from ..core.jax_compat import no_persistent_cache
+
+        with no_persistent_cache():
+            state = self.retry.run(load_state_dict, self._dir(step),
+                                   shardings=shardings,
+                                   name=f"ckpt.load:{step}")
         if self.model is not None and "model" in state:
             sd = self.model.state_dict()
             missing = [n for n in sd if n not in state["model"]]

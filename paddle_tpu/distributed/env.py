@@ -99,11 +99,18 @@ def _start_heartbeat():
 
     atexit.register(_tombstone)
 
+    from . import resilience
+
     def beat():
         while True:
             if client is not None:
                 try:
-                    client.beat(rank)
+                    # degraded-vs-dead: carry retry telemetry so the
+                    # launcher can tell a retry-storming (but alive)
+                    # rank from a wedged one (launch/master.py health)
+                    n_recent = resilience.recent_failures(30.0)
+                    client.beat(rank, degraded=n_recent > 0,
+                                retries=n_recent)
                 except OSError:
                     pass
             if path:

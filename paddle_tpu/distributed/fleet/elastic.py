@@ -101,6 +101,18 @@ class ElasticManager:
         self._status = (ElasticStatus.COMPLETED if completed
                         else ElasticStatus.ERROR)
 
+    def health(self):
+        """rank -> {age, degraded, retries}: the degraded-vs-dead view
+        the membership master aggregates from heartbeat retry telemetry
+        (resilience.recent_failures). Empty without a master endpoint —
+        the directory fallback carries liveness only."""
+        if self.master_ep:
+            try:
+                return self._client().health()
+            except OSError:
+                return {}
+        return {}
+
     def pending_joins(self):
         """Join requests awaiting the launcher (reference ETCDMaster
         node-arrival watch)."""
@@ -173,7 +185,7 @@ def request_scale_out(n=1, hb_dir=None, master=None):
 
 
 def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
-                             backoff_s=0.0, on_restart=None):
+                             backoff_s=0.0, on_restart=None, retry=None):
     """Run `train_fn(start_step) -> last_step`, restoring from
     `checkpointer` (paddle_tpu.distributed.checkpoint.Checkpointer) and
     retrying on failure.
@@ -181,17 +193,28 @@ def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
     train_fn must checkpoint through `checkpointer` as it goes; on an
     exception the latest COMPLETE checkpoint is loaded (half-written
     ones are invisible by construction) and train_fn is re-entered at
-    the restored step. Raises the last error after max_restarts."""
+    the restored step. Raises the last error after max_restarts.
+
+    `retry` (a resilience.RetryPolicy) supplies exponential backoff +
+    jitter between attempts; the legacy fixed `backoff_s` applies when
+    no policy is given. Every restart is journaled to the per-rank
+    anomaly log (resilience.record)."""
+    from ..resilience import record
+
     attempt = 0
     while True:
         start = checkpointer.load_latest() or 0
         try:
             return train_fn(start)
-        except Exception:
+        except Exception as e:
             attempt += 1
+            record("train_restart", attempt=attempt, start_step=start,
+                   error=repr(e))
             if attempt > max_restarts:
                 raise
             if on_restart is not None:
                 on_restart(attempt)
-            if backoff_s:
-                time.sleep(backoff_s)
+            delay = (retry.backoff(attempt - 1) if retry is not None
+                     else backoff_s)
+            if delay:
+                time.sleep(delay)

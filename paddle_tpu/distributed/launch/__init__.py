@@ -80,7 +80,8 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank, master, nproc=None, mm_endpoint=None):
+def _worker_env(args, local_rank, master, nproc=None, mm_endpoint=None,
+                attempt=0):
     nproc = nproc if nproc is not None else args.nproc_per_node
     world = args.nnodes * nproc
     rank = args.rank * nproc + local_rank
@@ -95,6 +96,11 @@ def _worker_env(args, local_rank, master, nproc=None, mm_endpoint=None):
         "PADDLE_JOB_ID": args.job_id,
         "PADDLE_HEARTBEAT_DIR": os.path.join(args.log_dir, "hb"),
         "PADDLE_ELASTIC_TIMEOUT": str(args.elastic_timeout),
+        # per-rank anomaly journal (resilience.py) lands next to the logs
+        "PADDLE_LOG_DIR": args.log_dir,
+        # pod incarnation: namespaces KV-collective keys so a restarted
+        # pod can never collide with a previous incarnation's leftovers
+        "PADDLE_POD_ATTEMPT": str(attempt),
     })
     if mm_endpoint:
         env["PADDLE_ELASTIC_MASTER"] = mm_endpoint
@@ -103,7 +109,7 @@ def _worker_env(args, local_rank, master, nproc=None, mm_endpoint=None):
     return env
 
 
-def _spawn_pod(args, master, nproc=None, mm=None):
+def _spawn_pod(args, master, nproc=None, mm=None, attempt=0):
     """Start nproc workers; local rank 0 inherits the console."""
     nproc = nproc if nproc is not None else args.nproc_per_node
     os.makedirs(args.log_dir, exist_ok=True)
@@ -125,7 +131,8 @@ def _spawn_pod(args, master, nproc=None, mm=None):
     cmd = [sys.executable, args.training_script] + args.training_script_args
     for lr in range(nproc):
         env = _worker_env(args, lr, master, nproc,
-                          mm_endpoint=mm.endpoint if mm else None)
+                          mm_endpoint=mm.endpoint if mm else None,
+                          attempt=attempt)
         rank = env["PADDLE_TRAINER_ID"]
         if lr == 0:
             out = None  # inherit
@@ -193,8 +200,25 @@ def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
     larger size (reference scale-out on node join)."""
     alive = {i: p for i, (p, _) in enumerate(procs)}
     failed_rc = 0
+    degraded = set()   # ranks currently marked degraded (log transitions)
     while alive and not failed_rc:
         time.sleep(poll_s)
+        if mm is not None:
+            # degraded-vs-dead: a rank that beats but reports retry
+            # storms is logged, not failed — only beat STALENESS (below)
+            # kills the pod
+            for r, h in mm.health().items():
+                if h["degraded"] and r not in degraded:
+                    degraded.add(r)
+                    print(f"[launch] worker rank {r} DEGRADED "
+                          f"({h['retries']} recent retries; still "
+                          "beating — not restarting)",
+                          file=sys.stderr, flush=True)
+                elif not h["degraded"] and r in degraded:
+                    degraded.discard(r)
+                    print(f"[launch] worker rank {r} recovered "
+                          "(retries subsided)",
+                          file=sys.stderr, flush=True)
         if watch_joins and (
                 (mm is not None and mm.pending_joins())
                 or (hb_dir and _pending_joins(hb_dir))):
@@ -282,9 +306,16 @@ def launch(argv=None):
             pass
     consecutive = 0
     attempt = 0
+    # pod incarnation counter: bumped on EVERY re-form (failure restart,
+    # scale-in, scale-out) — unlike `attempt`, which only counts failures
+    # toward --max_restart. It feeds PADDLE_POD_ATTEMPT, the epoch that
+    # namespaces KV-collective keys, so no incarnation can ever read a
+    # previous incarnation's leftover keys.
+    pod_gen = -1
     rc = 1
     while True:
-        procs = _spawn_pod(args, master, nproc, mm=mm)
+        pod_gen += 1
+        procs = _spawn_pod(args, master, nproc, mm=mm, attempt=pod_gen)
         rc = _wait_pod(procs, hb_dir=hb_dir,
                        hb_timeout=args.elastic_timeout
                        if args.elastic_timeout > 0 else 0.0,

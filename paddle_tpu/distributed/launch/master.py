@@ -71,6 +71,7 @@ class MembershipMaster:
 
     def __init__(self, host="0.0.0.0", advertise=None, route_via=None):
         self._beats = {}          # rank -> last beat time
+        self._health = {}         # rank -> {"degraded": bool, "retries": n}
         self._joins = 0
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -113,10 +114,19 @@ class MembershipMaster:
         op = req.get("op")
         with self._lock:
             if op == "beat":
-                self._beats[int(req["rank"])] = time.time()
+                r = int(req["rank"])
+                self._beats[r] = time.time()
+                # degraded-vs-dead: a beat can carry retry telemetry
+                # (resilience.recent_failures) — the rank is alive but
+                # retry-storming; monitors log it instead of failing
+                # the pod
+                self._health[r] = {
+                    "degraded": bool(req.get("degraded", False)),
+                    "retries": int(req.get("retries", 0))}
                 return {"ok": True}
             if op == "clear":
                 self._beats.pop(int(req["rank"]), None)
+                self._health.pop(int(req["rank"]), None)
                 return {"ok": True}
             if op == "join":
                 self._joins += int(req.get("n", 1))
@@ -125,6 +135,9 @@ class MembershipMaster:
                 now = time.time()
                 return {"peers": {str(r): now - t
                                   for r, t in self._beats.items()}}
+            if op == "health":
+                return {"health": {str(r): h
+                                   for r, h in self._health_view().items()}}
             if op == "joins":
                 return {"count": self._joins}
             if op == "consume_joins":
@@ -142,6 +155,19 @@ class MembershipMaster:
         with self._lock:
             return [(r, now - t) for r, t in sorted(self._beats.items())]
 
+    def _health_view(self):
+        """rank -> {age, degraded, retries}. Caller holds self._lock."""
+        now = time.time()
+        return {r: {"age": now - t,
+                    **self._health.get(r, {"degraded": False,
+                                           "retries": 0})}
+                for r, t in sorted(self._beats.items())}
+
+    def health(self):
+        """rank -> {age, degraded, retries} local view (launcher-side)."""
+        with self._lock:
+            return self._health_view()
+
     def pending_joins(self):
         with self._lock:
             return self._joins
@@ -156,10 +182,12 @@ class MembershipMaster:
         """Deregister a cleanly-exited worker (launcher-side)."""
         with self._lock:
             self._beats.pop(int(rank), None)
+            self._health.pop(int(rank), None)
 
     def reset_beats(self):
         with self._lock:
             self._beats.clear()
+            self._health.clear()
 
     def close(self):
         try:
@@ -185,8 +213,20 @@ class MembershipClient:
                 line = f.readline()
         return json.loads(line) if line else {}
 
-    def beat(self, rank):
-        return self._rpc({"op": "beat", "rank": int(rank)})
+    def beat(self, rank, degraded=False, retries=0):
+        """Heartbeat, optionally carrying retry telemetry: degraded=True
+        marks the rank as alive-but-retry-storming (distinct from dead —
+        the launcher logs it rather than failing the pod)."""
+        req = {"op": "beat", "rank": int(rank)}
+        if degraded or retries:
+            req["degraded"] = bool(degraded)
+            req["retries"] = int(retries)
+        return self._rpc(req)
+
+    def health(self):
+        """rank -> {age, degraded, retries} for every beating worker."""
+        got = self._rpc({"op": "health"}).get("health", {})
+        return {int(r): h for r, h in got.items()}
 
     def clear(self, rank):
         return self._rpc({"op": "clear", "rank": int(rank)})

@@ -314,6 +314,10 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, num_experts,
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     capacity = moe_a2a_capacity(tokens, 1, num_experts, capacity_factor)
 
+    # under spmd the gate statistics (me/ce) must average over the
+    # token-sharding axis or the GShard aux term sees per-shard loads
+    stat_reduce = (None if axis_name is None
+                   else (lambda v: jax.lax.pmean(v, axis_name)))
     disp, comb, aux = topk_pack_dispatch(probs, num_experts, capacity,
                                          x.dtype, topk,
                                          stat_reduce=stat_reduce)

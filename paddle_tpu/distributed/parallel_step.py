@@ -142,7 +142,11 @@ class DistributedTrainStep:
         opt = self.optimizer
         param_objs = self._param_objs
         trainable = self._trainable
-        base_key = rng_mod.next_key()
+        # runtime argument, not a closure constant — a baked key makes
+        # each instance a distinct HLO, and the jax 0.4.x persistent
+        # compile cache can serve one instance's donating executable for
+        # another with a mismatched aliasing map (see jit.TrainStep)
+        self._base_key = rng_mod.next_key()
 
         def pure_loss(train_vals, frozen_vals, batch_vals, step_key):
             originals = [p._value for p in param_objs]
@@ -172,7 +176,7 @@ class DistributedTrainStep:
         frozen_objs = [p for p, t in zip(param_objs, trainable) if not t]
 
         def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
-                 step_idx):
+                 step_idx, base_key):
             step_key = jax.random.fold_in(base_key, step_idx)
             (loss, new_frozen), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(
@@ -185,7 +189,8 @@ class DistributedTrainStep:
         states = self.optimizer.init_states_tree(
             [p._value for p in train_objs])
         s_sh = self._state_shardings(train_objs, states)
-        if self._opt_states is not None:
+        restored = self._opt_states is not None
+        if restored:
             # restored from a checkpoint before the first step — keep the
             # values, (re)place them on the computed shardings
             states = self._opt_states
@@ -203,12 +208,45 @@ class DistributedTrainStep:
             ]
         self._opt_states = jax.device_put(states, s_sh)
         self._batch_shardings = b_sh
-        self._compiled = jax.jit(
+        jitted = jax.jit(
             step,
-            in_shardings=(t_sh, f_sh, s_sh, None, b_sh, None),
+            in_shardings=(t_sh, f_sh, s_sh, None, b_sh, None, None),
             out_shardings=(NamedSharding(mesh, P()), t_sh, s_sh, f_sh),
             donate_argnums=(0, 1, 2),
         )
+        if restored:
+            # checkpoint-restored before the first step: AOT-compile
+            # OUTSIDE the persistent compilation cache — a donating
+            # sharded executable served from that cache can corrupt the
+            # first post-restore update on jax 0.4.x CPU (see
+            # core.jax_compat.no_persistent_cache). The normal path
+            # keeps the cache: identical-structure steps share entries
+            # (the rng base key is an argument, not a baked constant).
+            from ..core.jax_compat import no_persistent_cache
+
+            with no_persistent_cache():
+                compiled = jitted.lower(
+                    [p._value for p in train_objs],
+                    [p._value for p in frozen_objs],
+                    self._opt_states, self.optimizer.get_lr(),
+                    batch_vals,
+                    jnp.asarray(self.optimizer._step_count, jnp.uint32),
+                    self._base_key).compile()
+
+            def call(*args, _c=compiled, _j=jitted):
+                try:
+                    return _c(*args)
+                except (TypeError, ValueError):
+                    # batch shape changed after restore (e.g. a ragged
+                    # final batch): the AOT executable is shape-frozen —
+                    # fall back to the retracing jit wrapper, still
+                    # compiling outside the persistent cache
+                    with no_persistent_cache():
+                        return _j(*args)
+
+            self._compiled = call
+        else:
+            self._compiled = jitted
 
     def __call__(self, *batch):
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
@@ -223,7 +261,7 @@ class DistributedTrainStep:
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
         loss, new_vals, self._opt_states, new_frozen = self._compiled(
             train_vals, frozen_vals, self._opt_states, lr, batch_vals,
-            step_idx)
+            step_idx, self._base_key)
         it = iter(new_vals)
         it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):

@@ -997,7 +997,11 @@ class SparseTrainStep(_TrainStepBase):
         param_objs, trainable, embs = (self._param_objs, self._trainable,
                                        self.embs)
         train_objs = [p for p, t in zip(param_objs, trainable) if t]
-        base_key = rng_mod.next_key()  # per-step dropout keys, as TrainStep
+        # per-step dropout keys, as TrainStep — and like there, a runtime
+        # ARGUMENT, not a closure constant: baked keys make per-instance
+        # HLOs, which the jax 0.4.x persistent compile cache can serve
+        # across instances with a mismatched donation aliasing map
+        self._base_key = rng_mod.next_key()
 
         def pure_loss(train_vals, rows_vals, frozen_vals, inv_vals,
                       batch_vals, step_key):
@@ -1024,7 +1028,7 @@ class SparseTrainStep(_TrainStepBase):
             return loss._value, new_frozen
 
         def step(train_vals, frozen_vals, opt_states, lr, rows_vals,
-                 inv_vals, batch_vals, step_idx):
+                 inv_vals, batch_vals, step_idx, base_key):
             step_key = jax.random.fold_in(base_key, step_idx)
             (loss, new_frozen), (dgrads, rgrads) = jax.value_and_grad(
                 pure_loss, argnums=(0, 1), has_aux=True)(
@@ -1061,7 +1065,7 @@ class SparseTrainStep(_TrainStepBase):
                            self.optimizer.get_lr(), rows_vals, inv_vals,
                            batch_vals,
                            jnp.asarray(self.optimizer._step_count,
-                                       jnp.uint32))
+                                       jnp.uint32), self._base_key)
         it, it_f = iter(new_vals), iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):
             p._value = next(it) if t else next(it_f)

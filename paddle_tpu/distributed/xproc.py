@@ -77,21 +77,134 @@ def _run(kind, nparr, op="sum", src=0):
     return _cache[key](garr)
 
 
+# XLA's CPU backend cannot compile multi-process collectives (the
+# compiled path raises "Multiprocess computations aren't implemented");
+# TPU/GPU backends always can. Fallback: ride the coordination KV as a
+# per-generation all-gather of the local payload, reduced locally —
+# slower, but correct, and it inherits the KV path's RetryPolicy +
+# chaos hooks, so CPU-host pods (and every subprocess test in this
+# repo) keep real multi-controller semantics.
+_kv_coll = {"fallback": False, "gen": 0,
+            # broadcast-key GC bookkeeping: a bcast key may only be
+            # deleted once a LATER all-gather generation completed on
+            # this rank (completing all-gather gen a requires reading
+            # every peer's gen-a key, and a peer publishes gen a only
+            # after finishing all gens < a — so the all-gather is a
+            # barrier proving every peer consumed the older bcast)
+            "ag_done": -1, "bcast_pending": []}
+
+
+def _kv_allgather_np(nparr):
+    import base64
+
+    me = jax.process_index()
+    gen = _kv_coll["gen"]
+    _kv_coll["gen"] = gen + 1
+    # the pod-incarnation epoch (launcher env) namespaces the keys: a
+    # restarted pod's generation counter restarts at 0, and against a
+    # still-alive coordinator its keys must never alias a previous
+    # incarnation's undeleted leftovers
+    epoch = _os.environ.get("PADDLE_POD_ATTEMPT", "0")
+    pfx = f"pt_coll/{epoch}/{gen}"
+    _kv_set(f"{pfx}/{me}",
+            base64.b64encode(nparr.tobytes()).decode("ascii"))
+    parts = []
+    for r in range(jax.process_count()):
+        if r == me:
+            parts.append(nparr)
+            continue
+        raw = base64.b64decode(_kv_get(f"{pfx}/{r}", 600_000))
+        parts.append(np.frombuffer(raw, nparr.dtype).reshape(nparr.shape))
+    # hygiene: a rank reaching `gen` has consumed generation gen-2 on
+    # every peer (each read those keys before publishing its gen-1
+    # entry), so deleting our own old key can strand nobody
+    if gen >= 2:
+        try:
+            _kv_client().key_value_delete(
+                f"pt_coll/{epoch}/{gen - 2}/{me}")
+        except Exception:
+            pass
+    _kv_coll["ag_done"] = gen
+    return np.stack(parts)
+
+
+def _kv_broadcast_np(nparr, src):
+    """KV-fallback broadcast: ONLY src publishes; peers read src's key —
+    W·N coordinator bytes instead of the all-gather's W²·N."""
+    import base64
+
+    me = jax.process_index()
+    gen = _kv_coll["gen"]
+    _kv_coll["gen"] = gen + 1
+    epoch = _os.environ.get("PADDLE_POD_ATTEMPT", "0")
+    key = f"pt_coll/{epoch}/{gen}/bcast"
+    if me != src:
+        raw = base64.b64decode(_kv_get(key, 600_000))
+        return np.frombuffer(raw, nparr.dtype).reshape(nparr.shape)
+    # GC older bcast keys proven consumed by a completed all-gather
+    # barrier generation (see _kv_coll); consecutive broadcasts with no
+    # intervening all-gather stay pending — bounded by the payload bytes
+    # between barriers, and the epoch namespace isolates restarts
+    still = []
+    for g, k in _kv_coll["bcast_pending"]:
+        if g < _kv_coll["ag_done"]:
+            try:
+                _kv_client().key_value_delete(k)
+            except Exception:
+                still.append((g, k))
+        else:
+            still.append((g, k))
+    _kv_coll["bcast_pending"] = still + [(gen, key)]
+    _kv_set(key, base64.b64encode(nparr.tobytes()).decode("ascii"))
+    return nparr
+
+
+_NP_REDUCERS = {"sum": lambda m: m.sum(axis=0),
+                "avg": lambda m: m.mean(axis=0),
+                "max": lambda m: m.max(axis=0),
+                "min": lambda m: m.min(axis=0),
+                "prod": lambda m: m.prod(axis=0)}
+
+
+def _collective_np(kind, nparr, op="sum", src=0):
+    """Compiled XLA collective, with transparent KV fallback where the
+    backend has none. Returns the gathered (world, ...) matrix for
+    'all_gather', the reduced/selected local value otherwise."""
+    nparr = np.ascontiguousarray(nparr)
+    if not _kv_coll["fallback"]:
+        try:
+            out = _run(kind, nparr, op=op, src=src)
+            a = np.asarray(out.addressable_data(0))
+            return a if kind == "all_gather" else a[0]
+        except Exception as e:
+            if not (is_multiprocess()
+                    and "Multiprocess computations aren't implemented"
+                    in str(e)):
+                raise
+            _kv_coll["fallback"] = True
+            from .resilience import record
+
+            record("kv_collective_fallback", error=repr(e))
+    if kind == "broadcast":
+        return _kv_broadcast_np(nparr, src)
+    mat = _kv_allgather_np(nparr)
+    if kind == "all_gather":
+        return mat
+    return _NP_REDUCERS[op](mat)
+
+
 def all_reduce_np(nparr, op="sum"):
     """nparr (local value) -> reduced np.ndarray, same shape."""
-    out = _run("all_reduce", np.ascontiguousarray(nparr), op=op)
-    return np.asarray(out.addressable_data(0))[0]
+    return _collective_np("all_reduce", nparr, op=op)
 
 
 def all_gather_np(nparr):
     """nparr (local value) -> stacked (world,)+shape np.ndarray."""
-    out = _run("all_gather", np.ascontiguousarray(nparr))
-    return np.asarray(out.addressable_data(0))
+    return _collective_np("all_gather", nparr)
 
 
 def broadcast_np(nparr, src=0):
-    out = _run("broadcast", np.ascontiguousarray(nparr), src=src)
-    return np.asarray(out.addressable_data(0))[0]
+    return _collective_np("broadcast", nparr, src=src)
 
 
 def barrier():
@@ -141,6 +254,10 @@ import os as _os
 import socket as _socket
 import struct as _struct
 import threading as _threading
+import time as _time
+
+from . import chaos
+from .resilience import RetryError, RetryPolicy
 
 _p2p_send_seq = {}
 _p2p_recv_seq = {}
@@ -148,9 +265,12 @@ _p2p_recv_seq = {}
 # traffic accounting (tests assert PS routing is O(batch), not
 # O(world·batch), and that the coordinator KV carries ~0 bulk bytes
 # under the socket transport; all_gather_bytes counts the full gathered
-# matrix — what every rank actually receives)
+# matrix — what every rank actually receives) plus retry telemetry
+# (resilience.RetryPolicy hardening: chaos tests assert injected faults
+# surface here instead of failing the collective)
 stats = {"p2p_bytes": 0, "gather_bytes": 0, "kv_bulk_bytes": 0,
-         "socket_bytes": 0}
+         "socket_bytes": 0, "kv_retries": 0, "connect_retries": 0,
+         "send_retries": 0}
 
 
 def _kv_client():
@@ -162,6 +282,56 @@ def _kv_client():
             "p2p send/recv needs the multi-process runtime: start workers "
             "via paddle_tpu.distributed.launch / spawn (jax.distributed)")
     return client
+
+
+# KV faults are transient by nature (coordinator restart windows, pod
+# re-forms); RuntimeError covers the jax client's error shape. The
+# caller's timeout is the real budget: deadline-bounded, attempts are
+# only a runaway cap.
+_KV_RETRY = RetryPolicy(max_attempts=8, base_s=0.05, max_backoff_s=1.0,
+                        retry_on=(OSError, RuntimeError), name="kv.get")
+# A peer that is mid-restart (exactly the elastic scenario) refuses
+# connections for seconds — retry until the caller's deadline, not a
+# fixed attempt count.
+_CONNECT_RETRY = RetryPolicy(max_attempts=None, base_s=0.1,
+                             max_backoff_s=2.0, name="sock.connect")
+_SEND_RETRY = RetryPolicy(max_attempts=5, base_s=0.05, max_backoff_s=1.0,
+                          name="sock.send")
+
+
+def _count_retry(key):
+    def note(attempt, exc):
+        with _stats_lock:
+            stats[key] += 1
+    return note
+
+
+def _kv_get(key, timeout_ms):
+    """Coordination-KV blocking get, chaos-injectable and retried under
+    the caller's deadline."""
+    client = _kv_client()
+    deadline = _time.monotonic() + timeout_ms / 1000.0
+
+    def attempt():
+        chaos.fire("kv.get")
+        remaining_ms = max(1, int((deadline - _time.monotonic()) * 1000))
+        return client.blocking_key_value_get(key, remaining_ms)
+
+    return _KV_RETRY.run(attempt, deadline_s=timeout_ms / 1000.0,
+                         name=f"kv.get:{key}",
+                         on_retry=_count_retry("kv_retries"))
+
+
+def _kv_set(key, value):
+    """Coordination-KV set, chaos-injectable and retried."""
+    client = _kv_client()
+
+    def attempt():
+        chaos.fire("kv.set")
+        client.key_value_set(key, value)
+
+    _KV_RETRY.run(attempt, deadline_s=30.0, name=f"kv.set:{key}",
+                  on_retry=_count_retry("kv_retries"))
 
 
 _HDR = _struct.Struct("<iiqq")   # src, tag, seq, payload length
@@ -181,8 +351,9 @@ class _SocketTransport:
         self._lsock.listen(64)
         port = self._lsock.getsockname()[1]
         host = _os.environ.get("PADDLE_TPU_P2P_HOST") or _local_ip()
-        _kv_client().key_value_set(f"pt_p2p_ep/{me}", f"{host}:{port}")
+        _kv_set(f"pt_p2p_ep/{me}", f"{host}:{port}")
         self._inbox = {}
+        self._consumed = {}   # (src, tag) -> highest seq popped by recv
         self._cv = _threading.Condition()
         self._conns = {}
         self._conn_lock = _threading.Lock()   # guards the dict only
@@ -210,8 +381,13 @@ class _SocketTransport:
                 if data is None:
                     return
                 with self._cv:
-                    self._inbox[(src, tag, seq)] = data
-                    self._cv.notify_all()
+                    # a send retry can resend a frame the kernel already
+                    # delivered; once recv consumed that seq, re-inserting
+                    # the duplicate would leak an inbox entry forever
+                    # (seqs are monotonic per (src, tag))
+                    if seq > self._consumed.get((src, tag), -1):
+                        self._inbox[(src, tag, seq)] = data
+                        self._cv.notify_all()
         finally:
             conn.close()
 
@@ -236,41 +412,95 @@ class _SocketTransport:
                 dst, {"lock": _threading.Lock(), "sock": None})
         with slot["lock"]:
             if slot["sock"] is None:
+                # ONE deadline covers the endpoint wait AND the connect:
                 # a peer publishes its endpoint on ITS first p2p use —
                 # honor the caller's deadline (PS budgets minutes for
-                # first-step XLA-compile rank skew)
-                ep = _kv_client().blocking_key_value_get(
-                    f"pt_p2p_ep/{dst}", timeout_ms)
+                # first-step XLA-compile rank skew) without granting the
+                # connect phase a fresh budget on top
+                deadline = _time.monotonic() + timeout_ms / 1000.0
+                ep = _kv_get(f"pt_p2p_ep/{dst}", timeout_ms)
                 host, port = ep.rsplit(":", 1)
-                s = _socket.create_connection(
-                    (host, int(port)), timeout=max(1, timeout_ms / 1000))
-                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-                s.settimeout(None)
-                slot["sock"] = s
+
+                def _connect():
+                    # a peer MID-RESTART refuses connections until its
+                    # listener is back up — retry under the deadline
+                    # instead of failing the whole collective
+                    chaos.fire("sock.connect")
+                    s = _socket.create_connection(
+                        (host, int(port)),
+                        timeout=max(1.0, deadline - _time.monotonic()))
+                    s.setsockopt(_socket.IPPROTO_TCP,
+                                 _socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    return s
+
+                slot["sock"] = _CONNECT_RETRY.run(
+                    _connect,
+                    deadline_s=max(0.001,
+                                   deadline - _time.monotonic()),
+                    name=f"sock.connect:{dst}",
+                    on_retry=_count_retry("connect_retries"))
         return slot
+
+    def _drop_conn(self, slot):
+        """Close a (possibly half-written) connection so the next send
+        reconnects. Safe: the peer's reader discards incomplete frames
+        at EOF, so a full resend over a fresh connection never corrupts
+        the framing (a duplicate complete frame carries identical bytes
+        and lands idempotently in the (src, tag, seq) inbox)."""
+        with slot["lock"]:
+            if slot["sock"] is not None:
+                try:
+                    slot["sock"].close()
+                except OSError:
+                    pass
+                slot["sock"] = None
 
     def send(self, data, dst, tag, seq, timeout_ms):
         me = jax.process_index()
-        slot = self._conn_to(dst, timeout_ms)
         with _stats_lock:
             stats["socket_bytes"] += len(data)
-        with slot["lock"]:
-            sock = slot["sock"]
-            # a wedged peer that stops draining its socket must not
-            # block this thread forever (it holds the slot lock and an
-            # io-pool worker) — honor the caller's deadline on sends too
-            sock.settimeout(max(1.0, timeout_ms / 1000))
-            try:
-                sock.sendall(_HDR.pack(me, tag, seq, len(data)))
-                sock.sendall(data)
-            except _socket.timeout:
-                raise TimeoutError(
-                    f"p2p send timed out: dst={dst} tag={tag} seq={seq} "
-                    f"({len(data)} bytes; peer not draining)")
-            finally:
-                sock.settimeout(None)
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        last_slot = {"slot": None}
+
+        def _attempt():
+            remaining_ms = max(1, int((deadline - _time.monotonic())
+                                      * 1000))
+            slot = last_slot["slot"] = self._conn_to(dst, remaining_ms)
+            chaos.fire("sock.send")         # stall or pre-write drop
+            with slot["lock"]:
+                sock = slot["sock"]
+                if sock is None:
+                    # a concurrent sender's _drop_conn beat us here —
+                    # retryable: the next attempt reconnects
+                    raise OSError("connection dropped concurrently")
+                # a wedged peer that stops draining its socket must not
+                # block this thread forever (it holds the slot lock and
+                # an io-pool worker) — honor the caller's deadline on
+                # sends too
+                sock.settimeout(max(1.0, deadline - _time.monotonic()))
+                try:
+                    sock.sendall(_HDR.pack(me, tag, seq, len(data)))
+                    sock.sendall(data)
+                finally:
+                    sock.settimeout(None)
+
+        def _on_retry(attempt, exc):        # timeouts are OSError too
+            if last_slot["slot"] is not None:
+                self._drop_conn(last_slot["slot"])
+            with _stats_lock:
+                stats["send_retries"] += 1
+
+        try:
+            _SEND_RETRY.run(_attempt, deadline_s=timeout_ms / 1000.0,
+                            name=f"sock.send:{dst}", on_retry=_on_retry)
+        except RetryError as e:
+            raise TimeoutError(
+                f"p2p send failed: dst={dst} tag={tag} seq={seq} "
+                f"({len(data)} bytes): {e.last!r}") from e
 
     def recv(self, src, tag, seq, timeout_ms):
+        chaos.fire("sock.recv")             # stall injection
         key = (src, tag, seq)
         deadline = timeout_ms / 1000.0
         with self._cv:
@@ -278,6 +508,8 @@ class _SocketTransport:
                                      timeout=deadline):
                 raise TimeoutError(
                     f"p2p recv timed out: src={src} tag={tag} seq={seq}")
+            ck = (src, tag)
+            self._consumed[ck] = max(seq, self._consumed.get(ck, -1))
             return self._inbox.pop(key)
 
 
@@ -343,7 +575,7 @@ def send_bytes(data: bytes, dst: int, tag: int = 0,
     payload = base64.b64encode(data).decode("ascii")
     with _stats_lock:
         stats["kv_bulk_bytes"] += len(payload)
-    _kv_client().key_value_set(f"pt_p2p/{me}/{dst}/{tag}/{seq}", payload)
+    _kv_set(f"pt_p2p/{me}/{dst}/{tag}/{seq}", payload)
 
 
 def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
@@ -356,12 +588,11 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
     import base64
 
     key = f"pt_p2p/{src}/{me}/{tag}/{seq}"
-    client = _kv_client()
-    val = client.blocking_key_value_get(key, timeout_ms)
+    val = _kv_get(key, timeout_ms)
     # consumed: delete the entry, or bulk transfers (global_shuffle ships
     # whole dataset buckets) grow the coordinator without bound
     try:
-        client.key_value_delete(key)
+        _kv_client().key_value_delete(key)
     except Exception:
         pass
     return base64.b64decode(val)

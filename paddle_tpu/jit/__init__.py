@@ -452,8 +452,14 @@ class TrainStep:
         train_objs = [p for p, t in zip(param_objs, trainable) if t]
         # per-step dropout keys: fold the step index into this base key
         # inside the compiled program (constant-baked keys would replay the
-        # same mask every step)
-        base_key = rng_mod.next_key()
+        # same mask every step). The key is a RUNTIME ARGUMENT, not a
+        # closure constant: a baked key makes every TrainStep instance a
+        # distinct HLO, and on jax 0.4.x the persistent compile cache can
+        # serve one instance's donating executable for another's — with a
+        # mismatched input/output aliasing map that silently corrupts the
+        # step (flaky checkpoint-resume divergence). As an argument, all
+        # structurally-equal steps share one (correct) cache entry.
+        self._base_key = rng_mod.next_key()
 
         def pure_loss(train_vals, frozen_vals, batch_vals, step_key):
             originals = [p._value for p in param_objs]
@@ -480,7 +486,7 @@ class TrainStep:
                 pure_loss, policy=checkpoint_policy(self.remat))
 
         def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
-                 step_idx):
+                 step_idx, base_key):
             step_key = jax.random.fold_in(base_key, step_idx)
             (loss, new_frozen), grads = jax.value_and_grad(
                 pure_loss, has_aux=True)(
@@ -516,7 +522,7 @@ class TrainStep:
         return self._compiled.lower(
             train_vals, frozen_vals, states, self.optimizer.get_lr(),
             batch_vals, jnp.asarray(self.optimizer._step_count,
-                                    jnp.uint32))
+                                    jnp.uint32), self._base_key)
 
     def __call__(self, *batch):
         if self._compiled is None:
@@ -549,7 +555,7 @@ class TrainStep:
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
         loss, new_vals, self._opt_states, new_frozen = self._compiled(
             train_vals, frozen_vals, self._opt_states, lr, batch_vals,
-            step_idx)
+            step_idx, self._base_key)
         it = iter(new_vals)
         it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):

@@ -1,0 +1,82 @@
+"""Worker for the chaos end-to-end test (test_chaos.py).
+
+Trains a small model for N deterministic steps with per-step
+checkpointing, a StepGuard around the update, and a per-step p2p loss
+exchange (so the socket transport and coordination KV are on the hot
+path). The test launches it twice: once under a seeded PT_CHAOS_PLAN
+injecting KV failures, a connect refusal, a socket stall, one checkpoint
+kill-window crash (rank 1) and one NaN step (rank 0) — and once clean.
+The faulted pod must finish with the identical loss sequence: retries
+absorb the transport faults, the StepGuard retries the poisoned step,
+and the kill-window crash costs one pod restart that resumes from the
+latest complete checkpoint.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed import resilience, xproc  # noqa: E402
+from paddle_tpu.distributed.checkpoint import Checkpointer  # noqa: E402
+
+STEPS = 8
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    ckpt = Checkpointer(os.path.join(out_dir, "ckpt"), model=m,
+                        optimizer=opt, keep=4)
+    guard = resilience.StepGuard(max_consecutive_skips=3)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16,)).astype(np.float32))
+
+    latest = ckpt.load_latest()
+    start = 0 if latest is None else latest + 1
+    losses = []
+    step = start
+    while step < STEPS:
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y)
+        if not guard.check(loss, step=step):
+            continue    # transient (injected) NaN: retry the same step
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        # p2p ring exchange AFTER the guard commits the step, so both
+        # ranks send exactly once per step (keeps seq numbers aligned
+        # across NaN retries) — this is what drags the socket transport
+        # and its KV endpoint fetch onto the chaos-injected path
+        xproc.send_bytes(json.dumps(losses[-1]).encode(),
+                         (rank + 1) % world, tag=7)
+        peer = json.loads(xproc.recv_bytes(
+            (rank - 1) % world, tag=7).decode())
+        ckpt.save(step)
+        xproc.barrier()     # lockstep: both ranks completed `step`
+        step += 1
+
+    with xproc._stats_lock:
+        stats = {k: xproc.stats[k] for k in
+                 ("kv_retries", "connect_retries", "send_retries")}
+    with open(os.path.join(out_dir, f"chaos_out_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "start": start, "losses": losses,
+                   "peer_last": peer, "skipped": guard.skipped,
+                   "stats": stats}, f)
+
+
+if __name__ == "__main__":
+    main()

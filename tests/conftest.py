@@ -35,6 +35,10 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
 os.makedirs(_cache_dir, exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# NOTE: on this jax (0.4.37 CPU) a cache-loaded DONATING executable can
+# silently corrupt its outputs via a mismatched aliasing map; the
+# checkpoint-restore paths guard themselves (see
+# core.jax_compat.no_persistent_cache and docs/RESILIENCE.md).
 
 # Numeric-parity tests compare against float64 numpy; keep CPU matmuls exact.
 # (On TPU the framework default stays bf16-on-MXU.)

@@ -1,0 +1,395 @@
+"""Deterministic fault injection (distributed/chaos.py) and the
+hardening it exercises (distributed/resilience.py): RetryPolicy on the
+coordination KV and p2p transport, StepGuard NaN skipping, preemption
+drain, anomaly journal, degraded-vs-dead heartbeat telemetry.
+
+Fast tests here are tier-1; the subprocess pod tests carry `slow` too.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import chaos, resilience, xproc
+from paddle_tpu.distributed import checkpoint as ckpt_mod
+from paddle_tpu.distributed.checkpoint import Checkpointer
+from paddle_tpu.distributed.launch.master import (MembershipClient,
+                                                  MembershipMaster)
+
+pytestmark = pytest.mark.chaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_PLAN, raising=False)
+    monkeypatch.delenv(chaos.ENV_STATE, raising=False)
+    chaos.clear()
+    resilience.reset()
+    yield
+    chaos.clear()
+    resilience.reset()
+
+
+# ------------------------------------------------------------- FaultPlan
+
+def test_same_seed_yields_identical_fault_schedule():
+    spec = json.dumps({"seed": 7, "injectors": [
+        {"scope": "kv.get", "kind": "error", "p": 0.3}]})
+    s1 = chaos.FaultPlan.from_json(spec).schedule("kv.get", 300, rank=0)
+    s2 = chaos.FaultPlan.from_json(spec).schedule("kv.get", 300, rank=0)
+    assert s1 == s2 and len(s1) > 0
+    # and the schedule is actually seed-dependent
+    other = json.dumps({"seed": 8, "injectors": [
+        {"scope": "kv.get", "kind": "error", "p": 0.3}]})
+    assert chaos.FaultPlan.from_json(other).schedule(
+        "kv.get", 300, rank=0) != s1
+
+
+def test_env_plan_determinism_across_activations(monkeypatch):
+    """The PT_CHAOS_PLAN seed yields the identical fault schedule twice
+    (fresh env read each time — the subprocess-inheritance shape)."""
+    spec = json.dumps({"seed": 42, "injectors": [
+        {"scope": "sock.send", "kind": "error", "p": 0.25}]})
+    monkeypatch.setenv(chaos.ENV_PLAN, spec)
+    chaos.clear()
+    s1 = chaos.get_plan().schedule("sock.send", 200)
+    chaos.clear()
+    s2 = chaos.get_plan().schedule("sock.send", 200)
+    assert s1 == s2 and len(s1) > 0
+
+
+def test_at_indices_ranks_and_kinds():
+    plan = chaos.install({"injectors": [
+        {"scope": "kv.get", "kind": "error", "at": [2]}]})
+    plan.fire("kv.get")
+    plan.fire("kv.get")
+    with pytest.raises(chaos.InjectedFault):
+        plan.fire("kv.get")
+    plan.fire("kv.get")     # past the index: silent again
+    assert plan.injected["kv.get"] == 1
+
+    # rank-scoped injector never fires on the wrong rank
+    plan = chaos.install({"injectors": [
+        {"scope": "kv.get", "kind": "error", "at": [0], "ranks": [1]}]})
+    plan.fire("kv.get")     # this process is rank 0 → no fire
+    assert not plan.injected
+
+    # delay kind stalls instead of raising
+    plan = chaos.install({"injectors": [
+        {"scope": "sock.recv", "kind": "delay", "at": [0],
+         "delay_s": 0.15}]})
+    t0 = time.monotonic()
+    plan.fire("sock.recv")
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_zero_overhead_and_injection_when_off():
+    assert not chaos.active()
+    assert chaos.fire("kv.get") is None
+    assert chaos.poison(1.25) == 1.25
+
+
+# ----------------------------------------------------------- RetryPolicy
+
+def test_retry_policy_recovers_and_counts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return 41
+
+    pol = resilience.RetryPolicy(max_attempts=5, base_s=0.001,
+                                 name="flaky")
+    assert pol.run(flaky) == 41
+    assert calls["n"] == 3
+    assert resilience.stats["retries"]["flaky"] == 2
+    assert resilience.recent_failures(30.0) >= 2
+    assert [e for e in resilience.events("retry") if e["op"] == "flaky"]
+
+
+def test_retry_policy_exhaustion_and_deadline():
+    def always():
+        raise OSError("nope")
+
+    pol = resilience.RetryPolicy(max_attempts=3, base_s=0.001, name="x")
+    with pytest.raises(resilience.RetryError) as ei:
+        pol.run(always)
+    assert isinstance(ei.value.last, OSError)
+    assert resilience.stats["giveups"]["x"] == 1
+    # deadline cuts an unlimited-attempt policy short
+    pol2 = resilience.RetryPolicy(max_attempts=None, base_s=0.01,
+                                  name="y")
+    t0 = time.monotonic()
+    with pytest.raises(resilience.RetryError):
+        pol2.run(always, deadline_s=0.1)
+    assert time.monotonic() - t0 < 5.0
+
+
+class _FakeKV:
+    """Coordination-KV stand-in (key_value_set / blocking_key_value_get)."""
+
+    def __init__(self):
+        self.store = {}
+        self.cv = threading.Condition()
+
+    def key_value_set(self, k, v):
+        with self.cv:
+            self.store[k] = v
+            self.cv.notify_all()
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        with self.cv:
+            if not self.cv.wait_for(lambda: k in self.store,
+                                    timeout=timeout_ms / 1000.0):
+                raise RuntimeError(f"kv get timeout: {k}")
+            return self.store[k]
+
+    def key_value_delete(self, k):
+        with self.cv:
+            self.store.pop(k, None)
+
+
+def test_kv_get_retries_through_injected_failures(monkeypatch):
+    fake = _FakeKV()
+    fake.key_value_set("k", "v")
+    monkeypatch.setattr(xproc, "_kv_client", lambda: fake)
+    chaos.install({"injectors": [
+        {"scope": "kv.get", "kind": "error", "at": [0, 1]}]})
+    before = xproc.stats["kv_retries"]
+    assert xproc._kv_get("k", 5000) == "v"
+    assert xproc.stats["kv_retries"] - before >= 2
+
+
+def test_conn_to_retries_until_peer_listens(monkeypatch):
+    """A peer mid-restart refuses connections; _conn_to must retry under
+    the caller's deadline instead of failing the collective."""
+    fake = _FakeKV()
+    monkeypatch.setattr(xproc, "_kv_client", lambda: fake)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))      # bound but NOT listening → refused
+    port = srv.getsockname()[1]
+    fake.key_value_set("pt_p2p_ep/1", f"127.0.0.1:{port}")
+    threading.Timer(0.5, srv.listen, args=(1,)).start()
+    tr = xproc._SocketTransport()
+    try:
+        before = xproc.stats["connect_retries"]
+        slot = tr._conn_to(1, 10_000)
+        assert slot["sock"] is not None
+        assert xproc.stats["connect_retries"] - before >= 1
+    finally:
+        if tr._conns.get(1, {}).get("sock"):
+            tr._conns[1]["sock"].close()
+        tr._lsock.close()
+        srv.close()
+
+
+# ------------------------------------------------------------- StepGuard
+
+def test_step_guard_skips_nan_and_aborts_after_bound():
+    guard = resilience.StepGuard(max_consecutive_skips=2)
+    assert guard.check(1.5, step=0)
+    assert not guard.check(float("nan"), step=1)
+    assert not guard.check(float("inf"), step=1)
+    assert guard.check(0.5, step=1)          # finite resets the streak
+    assert guard.skipped == 2 and guard.ok == 2
+    assert len(resilience.events("nan_step")) == 2
+    with pytest.raises(resilience.StepAbort):
+        for _ in range(3):
+            guard.check(float("nan"), step=2)
+
+
+def test_step_guard_chaos_poison_exercises_detection():
+    chaos.install({"injectors": [
+        {"scope": "step.nan", "kind": "nan", "at": [1]}]})
+    guard = resilience.StepGuard()
+    assert guard.check(1.0, step=0)
+    assert not guard.check(1.0, step=1)      # poisoned → skipped
+    assert guard.check(1.0, step=2)
+    assert guard.skipped == 1
+
+
+def test_step_guard_accepts_tensor_losses():
+    guard = resilience.StepGuard()
+    assert guard.check(paddle.to_tensor(np.float32(0.25)))
+    assert not guard.check(paddle.to_tensor(np.float32("nan")))
+
+
+# ------------------------------------------------- preemption + journal
+
+def test_preemption_handler_drains_to_final_checkpoint(tmp_path):
+    h = resilience.install_preemption_handler()
+    try:
+        assert not h.triggered()
+        signal.raise_signal(signal.SIGTERM)
+        assert h.triggered()
+        cp = Checkpointer(str(tmp_path / "run"))
+        h.drain(cp, step=5)
+        assert cp.steps() == [5]
+        assert resilience.events("preempt_signal")
+        assert resilience.events("preempt_drain")
+    finally:
+        h.restore()
+
+
+def test_anomaly_journal_writes_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_ANOMALY_DIR", str(tmp_path))
+    resilience.reset()
+    resilience.record("test_event", detail=3)
+    path = tmp_path / "anomalies.rank0.jsonl"
+    assert path.is_file()
+    (entry,) = [json.loads(line) for line in path.read_text().splitlines()]
+    assert entry["kind"] == "test_event" and entry["detail"] == 3
+
+
+# ------------------------------------------- degraded-vs-dead heartbeat
+
+def test_membership_master_health_telemetry():
+    mm = MembershipMaster()
+    try:
+        client = MembershipClient(mm.endpoint)
+        client.beat(0)
+        client.beat(1, degraded=True, retries=5)
+        health = client.health()
+        assert health[0]["degraded"] is False
+        assert health[1]["degraded"] is True and health[1]["retries"] == 5
+        assert mm.health()[1]["degraded"] is True
+        client.clear(1)
+        assert 1 not in client.health()
+    finally:
+        mm.close()
+
+
+# -------------------------------------------------- subprocess pod tests
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+_KILL_WINDOW_SCRIPT = """
+import os, sys
+sys.path.insert(0, {root!r})
+import numpy as np
+from paddle_tpu.distributed import checkpoint as ckpt
+root = sys.argv[1]
+ckpt.save_state_dict({{"w": np.arange(4.0), "step": 1}},
+                     os.path.join(root, "ckpt-00000001"))
+ckpt.save_state_dict({{"w": np.arange(4.0) + 1, "step": 2}},
+                     os.path.join(root, "ckpt-00000002"))
+print("BOTH_SAVED")
+"""
+
+
+@pytest.mark.slow
+def test_chaos_kill_window_crash_then_relaunch(tmp_path):
+    """A real SIGKILL between shard write and meta commit must leave the
+    previous checkpoint as the only visible one; the relaunch (same
+    plan, `once` marker consumed) completes the save."""
+    plan = json.dumps({"seed": 1, "state_dir": str(tmp_path / "state"),
+                       "injectors": [
+                           {"scope": "ckpt.kill_window", "kind": "crash",
+                            "at": [1], "once": True}]})
+    script = _KILL_WINDOW_SCRIPT.format(root=ROOT)
+    cmd = [sys.executable, "-c", script, str(tmp_path)]
+    r = subprocess.run(cmd, env=_env({chaos.ENV_PLAN: plan}),
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode != 0                  # SIGKILLed mid-commit
+    assert "BOTH_SAVED" not in r.stdout
+    assert ckpt_mod.is_complete(str(tmp_path / "ckpt-00000001"))
+    assert not os.path.exists(tmp_path / "ckpt-00000002")
+    assert os.path.isdir(tmp_path / "ckpt-00000002.tmp")  # invisible
+    cp = Checkpointer(str(tmp_path))
+    assert cp.steps() == [1]                  # load_latest sees step 1 only
+
+    r2 = subprocess.run(cmd, env=_env({chaos.ENV_PLAN: plan}),
+                        capture_output=True, text=True, timeout=180)
+    assert r2.returncode == 0, r2.stderr      # marker: fires at most once
+    assert "BOTH_SAVED" in r2.stdout
+    back = ckpt_mod.load_state_dict(str(tmp_path / "ckpt-00000002"))
+    assert back["step"] == 2
+
+
+@pytest.mark.slow
+def test_chaos_e2e_2proc_same_final_loss(tmp_path):
+    """The acceptance scenario: a seeded plan injecting KV failures, a
+    connect refusal, a socket stall, one checkpoint kill-window crash
+    and one NaN step into a 2-process run — the job must complete with
+    the identical loss sequence as the fault-free run, retries visible
+    in xproc.stats, the skipped step journaled, no torn checkpoint."""
+    plan = json.dumps({"seed": 1234, "state_dir": str(tmp_path / "state"),
+                       "injectors": [
+                           {"scope": "kv.get", "kind": "error", "at": [0]},
+                           {"scope": "sock.connect", "kind": "error",
+                            "at": [0]},
+                           {"scope": "sock.send", "kind": "delay",
+                            "at": [1], "delay_s": 0.2},
+                           {"scope": "ckpt.kill_window", "kind": "crash",
+                            "ranks": [1], "at": [2], "once": True},
+                           {"scope": "step.nan", "kind": "nan",
+                            "ranks": [0], "at": [1]}]})
+
+    def launch(out_dir, extra_env):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node=2", "--max_restart=2",
+               f"--log_dir={out_dir}/log",
+               os.path.join(ROOT, "tests", "chaos_worker.py"),
+               str(out_dir)]
+        return subprocess.run(cmd, env=_env(extra_env), cwd=ROOT,
+                              capture_output=True, text=True, timeout=420)
+
+    r = launch(tmp_path, {chaos.ENV_PLAN: plan})
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "restart 1/2" in r.stderr          # the kill-window fired
+    out = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"chaos_out_{rank}.json") as f:
+            out[rank] = json.load(f)
+    # pod resumed from the latest complete checkpoint, not from scratch
+    assert out[0]["start"] > 0 and out[1]["start"] > 0
+    # transport faults were absorbed by retries, and are visible
+    total = {k: out[0]["stats"][k] + out[1]["stats"][k]
+             for k in out[0]["stats"]}
+    assert total["kv_retries"] >= 1
+    assert total["connect_retries"] >= 1
+    # the NaN step was skipped-and-journaled on rank 0
+    assert out[0]["skipped"] >= 1
+    journal = tmp_path / "log" / "anomalies.rank0.jsonl"
+    assert journal.is_file()
+    kinds = [json.loads(line)["kind"]
+             for line in journal.read_text().splitlines()]
+    assert "nan_step" in kinds and "chaos_injected" in kinds
+    # no torn checkpoint: the final checkpoint loads clean
+    cp = Checkpointer(str(tmp_path / "ckpt"))
+    assert ckpt_mod.verify_integrity(
+        os.path.join(str(tmp_path / "ckpt"),
+                     f"ckpt-{cp.steps()[-1]:08d}"))
+
+    # fault-free reference run
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    r2 = launch(ref_dir, {})
+    assert r2.returncode == 0, f"stdout:{r2.stdout}\nstderr:{r2.stderr}"
+    with open(ref_dir / "chaos_out_0.json") as f:
+        ref = json.load(f)
+    assert ref["start"] == 0
+    np.testing.assert_allclose(out[0]["losses"][-1], ref["losses"][-1],
+                               rtol=1e-6)
+    tail = ref["losses"][out[0]["start"]:]
+    np.testing.assert_allclose(out[0]["losses"], tail, rtol=1e-6)
