@@ -25,9 +25,12 @@ __all__ = [
     "InferTensor", "DataType", "PlaceType", "PrecisionType",
     "get_version", "get_num_bytes_of_data_type",
     "convert_to_mixed_precision", "InferenceServer", "BatchingConfig",
+    "LLMEngine", "LLMEngineConfig", "LLMServer", "PagePool",
 ]
 
 from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
+from .llm_engine import (  # noqa: E402,F401
+    LLMEngine, LLMEngineConfig, LLMServer, PagePool)
 
 
 class DataType:

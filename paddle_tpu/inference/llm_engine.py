@@ -1,0 +1,584 @@
+"""Continuous-batching LLM serving engine with a paged KV cache.
+
+The serving half of the framework the way `jit.TrainStep` is the
+training half. The static-batch path (`GPTGenerationMixin.generate` +
+the shape-bucketed `InferenceServer`) cannot admit a new request into a
+running decode batch, so every mixed-length workload pays worst-case
+padding and head-of-line blocking. This engine fixes both, TPU-style
+(PAPERS.md "Ragged Paged Attention"; the capability the reference ships
+as its analysis_predictor/serving stack):
+
+* **Paged KV cache** — the cache is a pool of fixed-size pages
+  [num_pages, page_size, heads, head_dim] per layer with per-sequence
+  page tables. Pages are allocated as a sequence grows and freed the
+  step it finishes, so HBM scales with LIVE TOKENS instead of
+  batch × max_seq_len (padding-waste model: docs/PERF_NOTES.md
+  "Serving"). Physical page 0 is a reserved trash page: padding-token
+  writes land there and are never attended.
+
+* **Continuous scheduler** — every step admits queued prompts into free
+  decode slots, chunks their prefill into the running batch (a FLAT
+  token budget: each step carries one decode token per running sequence
+  plus as many prefill tokens as fit), samples at each sequence
+  frontier, and evicts on EOS or token budget. When the pool runs dry
+  the youngest sequence is preempted back to the queue (pages freed;
+  greedy decode makes the re-run deterministic).
+
+* **ONE compiled decode executable** — every scheduler tick calls the
+  same fixed-shape program (`_CompiledPagedStep` over
+  `GPTGenerationMixin._paged_decode_core`: token_budget flat tokens,
+  num_slots page tables, the pools), so steady-state serving never
+  recompiles. Built the `jit.TrainStep` way: weights thread through as
+  jit ARGUMENTS (not baked constants — persistent-cache friendly) and
+  the KV pools are DONATED, so the page writes are in-place HBM updates
+  instead of per-step pool copies. The attention inside is
+  `F.paged_attention` — jnp reference on CPU, the Pallas ragged kernel
+  on real TPU.
+
+Surface:
+
+    server = inference.LLMServer(model)        # GPTForCausalLM
+    with server:
+        fut = server.submit(prompt_ids, max_new_tokens=64,
+                            eos_token_id=50256)
+        tokens = fut.result()   # np.int64 [prompt + generated]
+
+Greedy decode is token-for-token identical to `generate()` (pinned by
+tests/test_llm_engine.py); eos semantics follow the shared contract
+(the emitted eos is kept, nothing after it).
+"""
+import collections
+import itertools
+import queue
+from concurrent.futures import Future
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .serving import _FutureQueueServer
+
+__all__ = ["PagePool", "PoolExhausted", "LLMEngineConfig", "LLMEngine",
+           "LLMServer"]
+
+
+class PoolExhausted(RuntimeError):
+    """No free KV pages (the scheduler preempts and retries on this)."""
+
+
+class PagePool:
+    """Fixed-size KV-page allocator. Physical page 0 is reserved as the
+    trash page (padding-token writes), so pages 1..num_pages-1 are
+    allocable. Strict double-free/leak checking — the invariants the
+    soak test pins."""
+
+    def __init__(self, num_pages, page_size):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is trash)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free stack, seeded so the first allocs hand out 1, 2, ...
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._live = set()
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_live(self):
+        return len(self._live)
+
+    def alloc(self):
+        if not self._free:
+            raise PoolExhausted(
+                f"all {self.num_pages - 1} KV pages in use")
+        p = self._free.pop()
+        self._live.add(p)
+        return p
+
+    def free(self, pages):
+        for p in pages:
+            if p not in self._live:
+                raise RuntimeError(
+                    f"double free of KV page {p} (live: "
+                    f"{len(self._live)})")
+            self._live.remove(p)
+            self._free.append(p)
+
+    def assert_consistent(self):
+        total = len(self._free) + len(self._live)
+        if total != self.num_pages - 1:
+            raise RuntimeError(
+                f"page leak: {len(self._free)} free + "
+                f"{len(self._live)} live != {self.num_pages - 1}")
+
+
+class LLMEngineConfig:
+    """Engine sizing. Defaults are safe (worst-case pool: no
+    preemption); shrink `num_pages` to trade HBM for occasional
+    preemption under load.
+
+    num_slots     max concurrently-decoding sequences (the compiled
+                  step's batch geometry)
+    page_size     tokens per KV page
+    num_pages     pool size incl. the trash page; default
+                  num_slots * ceil(max_model_len / page_size) + 1
+    max_model_len per-sequence token cap; default model max_seq_len
+    token_budget  flat tokens per step (>= num_slots); the surplus over
+                  the decode tokens is the chunked-prefill bandwidth.
+                  Default num_slots + max(num_slots, 8).
+    """
+
+    def __init__(self, num_slots=4, page_size=16, num_pages=None,
+                 max_model_len=None, token_budget=None):
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.num_pages = num_pages
+        self.max_model_len = max_model_len
+        self.token_budget = token_budget
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+
+
+class _CompiledPagedStep:
+    """The engine's ONE decode executable, built the `jit.TrainStep`
+    way: a pure function over (param_vals, step arrays, kv pools) under
+    `jax.jit`. Weights ride as ARGUMENTS (structurally-equal engines
+    share one correct persistent-cache entry — the same reasoning as
+    TrainStep's base-key-as-argument note), and the kv-pool pytree is
+    DONATED so the paged cache writes update HBM in place instead of
+    copying every pool every tick."""
+
+    def __init__(self, model):
+        self._params = list(model.state_dict().values())
+
+        def pure(param_vals, tok, pos, sid, widx, pt, klen, smp,
+                 kv_vals):
+            from ..autograd import engine as eng
+            from ..tensor_core import Tensor
+
+            def t(v):
+                return Tensor(v, stop_gradient=True)
+
+            originals = [p._value for p in self._params]
+            for p, v in zip(self._params, param_vals):
+                p._value = v
+            try:
+                with eng.no_grad_guard():
+                    out = model._paged_decode_core(
+                        t(tok), t(pos), t(sid), t(widx), t(pt), t(klen),
+                        t(smp), [t(v) for v in kv_vals])
+            finally:
+                for p, v in zip(self._params, originals):
+                    p._value = v
+            logits, *new_kv = out
+            return logits._value, [x._value for x in new_kv]
+
+        self._jit = jax.jit(pure, donate_argnums=(8,))
+        self._warm = False
+
+    def __call__(self, tok, pos, sid, widx, pt, klen, smp, kv_vals):
+        args = ([p._value for p in self._params], tok, pos, sid, widx,
+                pt, klen, smp, kv_vals)
+        if self._warm:
+            return self._jit(*args)
+        # FIRST call compiles OUTSIDE the persistent cache: a
+        # cache-loaded donating executable on jax 0.4.x drops (or worse,
+        # mismatches) its aliasing map — measured 25% slower serving
+        # from the silent donation loss alone (docs/RESILIENCE.md; same
+        # guard as the restored-TrainStep path). Guard the compile only:
+        # the flag is process-global, so flipping it every tick from the
+        # serving thread would race other threads' compiles.
+        from ..core.jax_compat import no_persistent_cache
+
+        with no_persistent_cache():
+            out = self._jit(*args)
+        self._warm = True
+        return out
+
+    def cache_size(self):
+        n = getattr(self._jit, "_cache_size", None)
+        return int(n()) if callable(n) else -1
+
+
+class _Request:
+    _ids = itertools.count()
+
+    def __init__(self, tokens, max_new_tokens, eos_token_id, future):
+        self.rid = next(_Request._ids)
+        self.tokens = [int(t) for t in tokens]  # prompt, grows as decoded
+        self.prompt_len = len(self.tokens)
+        self.max_new = int(max_new_tokens)
+        self.eos = eos_token_id
+        self.future = future if future is not None else Future()
+        self.target = None        # total-token cap, set at add_request
+        self.slot = None
+        self.pages = []           # physical page ids, logical order
+        self.n_prefilled = 0      # kv-written tokens (reset on preempt)
+        self.admit_seq = None     # admission order (preemption picks max)
+        self.preemptions = 0
+
+    @property
+    def num_generated(self):
+        return len(self.tokens) - self.prompt_len
+
+    def result_array(self):
+        return np.asarray(self.tokens, np.int64)
+
+
+class LLMEngine:
+    """Scheduler + paged-KV state around ONE compiled ragged decode step
+    (module docstring has the design). Drive it directly —
+
+        eng = LLMEngine(model)
+        req = eng.add_request(prompt_ids, max_new_tokens=32)
+        while eng.has_work():
+            eng.step()
+        tokens = req.future.result()
+
+    — or through `LLMServer` for the threaded future/queue surface."""
+
+    def __init__(self, model, config=None):
+        model.eval()
+        self.model = model
+        mcfg = model.config
+        cfg = config or LLMEngineConfig()
+        self.num_slots = cfg.num_slots
+        self.page_size = cfg.page_size
+        self.max_model_len = int(cfg.max_model_len or mcfg.max_seq_len)
+        if self.max_model_len > mcfg.max_seq_len:
+            raise ValueError(
+                f"max_model_len {self.max_model_len} exceeds the "
+                f"model's max_seq_len {mcfg.max_seq_len}")
+        self.pages_per_seq = -(-self.max_model_len // self.page_size)
+        self.token_budget = int(
+            cfg.token_budget
+            or self.num_slots + max(self.num_slots, 8))
+        if self.token_budget < self.num_slots:
+            raise ValueError(
+                f"token_budget {self.token_budget} < num_slots "
+                f"{self.num_slots}: every running sequence needs one "
+                "decode token per step")
+        num_pages = int(cfg.num_pages
+                        or self.num_slots * self.pages_per_seq + 1)
+        self.pool = PagePool(num_pages, self.page_size)
+
+        nh = mcfg.num_heads
+        hd = mcfg.hidden_size // nh
+        # pool in the model's compute dtype (decode is HBM-bound; same
+        # reasoning as generate()'s cache dtype). The zero pools are
+        # COMMITTED with the same replicated NamedSharding the step
+        # executable's outputs carry (the TP layers' sharding
+        # constraints stamp the global mesh on every output) — a
+        # placement mismatch between step 0's pools and every later
+        # step's would cost a second dispatch-cache entry (the
+        # zero-recompile probe would read 2 executables, not 1)
+        from ..distributed import mesh as mesh_mod
+
+        cache_dt = model.gpt.wte.weight._value.dtype
+        sharding = mesh_mod.named_sharding()  # replicated on the mesh
+
+        def _fresh_pools():
+            return [
+                jax.device_put(
+                    jnp.zeros((num_pages, self.page_size, nh, hd),
+                              cache_dt), sharding)
+                for _ in range(2 * mcfg.num_layers)]
+
+        self._fresh_pools = _fresh_pools
+        self._kv = _fresh_pools()
+        self._page_tables = np.zeros(
+            (self.num_slots, self.pages_per_seq), np.int32)
+        self._slots = [None] * self.num_slots
+        self.waiting = collections.deque()
+        self._admit_counter = itertools.count()
+        self._step_fn = _CompiledPagedStep(model)
+        self.stats = {"steps": 0, "tokens_in": 0, "generated": 0,
+                      "finished": 0, "preemptions": 0,
+                      "occupancy_sum": 0.0}
+
+    # ---- client side ----
+
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
+                    future=None):
+        toks = np.asarray(prompt).reshape(-1)
+        if toks.size == 0:
+            raise ValueError("empty prompt")
+        if toks.size > self.max_model_len:
+            raise ValueError(
+                f"prompt length {toks.size} exceeds max_model_len "
+                f"{self.max_model_len}")
+        if -(-int(toks.size) // self.page_size) > self.pool.num_pages - 1:
+            raise ValueError(
+                f"prompt needs more KV pages than the pool holds "
+                f"({self.pool.num_pages - 1})")
+        req = _Request(toks, max_new_tokens, eos_token_id, future)
+        req.target = min(req.prompt_len + req.max_new, self.max_model_len)
+        if req.target <= req.prompt_len:
+            # zero budget (same contract as generate()): prompt echoes back
+            if not req.future.cancelled():
+                req.future.set_result(req.result_array())
+            return req
+        self.waiting.append(req)
+        return req
+
+    def has_work(self):
+        return bool(self.waiting) or any(
+            r is not None for r in self._slots)
+
+    @property
+    def mean_occupancy(self):
+        s = self.stats["steps"]
+        return self.stats["occupancy_sum"] / s if s else 0.0
+
+    def compile_stats(self):
+        """Executable count of the decode step (the jit dispatch-cache
+        size) — the zero-recompile-after-warmup probe the engine test
+        asserts on."""
+        return {"executables": self._step_fn.cache_size()}
+
+    def abort_all(self, exc):
+        """Fail every live and queued request (device-error path),
+        release all pages, and re-zero the pools — a step that died
+        mid-donation leaves the old kv buffers deleted, so the engine
+        must not reuse them."""
+        for slot, req in enumerate(self._slots):
+            if req is not None:
+                self._release(slot, req)
+                if not req.future.done():
+                    req.future.set_exception(exc)
+        while self.waiting:
+            req = self.waiting.popleft()
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self._kv = self._fresh_pools()
+
+    # ---- scheduler ----
+
+    def _release(self, slot, req):
+        self.pool.free(req.pages)
+        req.pages = []
+        req.n_prefilled = 0
+        req.slot = None
+        self._page_tables[slot, :] = 0
+        self._slots[slot] = None
+
+    def _finish(self, slot, req):
+        self._release(slot, req)
+        self.stats["finished"] += 1
+        # a client may have cancel()ed while the request was in flight —
+        # set_result would raise InvalidStateError and the server loop
+        # would read that as a device error and abort EVERYONE
+        if not req.future.cancelled():
+            req.future.set_result(req.result_array())
+
+    def _preempt_one(self, keep_req):
+        """Free the youngest running sequence (≠ keep_req) back to the
+        queue front. Returns False when there is no victim."""
+        victim, vslot = None, None
+        for slot, req in enumerate(self._slots):
+            if req is None or req is keep_req:
+                continue
+            if victim is None or req.admit_seq > victim.admit_seq:
+                victim, vslot = req, slot
+        if victim is None:
+            return False
+        # keep the already-generated tokens: greedy re-decode of
+        # prompt+generated reproduces the same continuation, so a
+        # preempted request stays deterministic
+        self._release(vslot, victim)
+        victim.preemptions += 1
+        self.stats["preemptions"] += 1
+        self.waiting.appendleft(victim)
+        return True
+
+    def _admit(self):
+        while self.waiting and None in self._slots:
+            req = self.waiting[0]
+            need = -(-len(req.tokens) // self.page_size)
+            if self.pool.num_free < need:
+                break  # FIFO: don't let a short prompt jump the queue
+            self.waiting.popleft()
+            slot = self._slots.index(None)
+            req.slot = slot
+            req.admit_seq = next(self._admit_counter)
+            self._slots[slot] = req
+
+    def _active(self):
+        """Running sequences in admission order (deterministic plan)."""
+        return sorted(
+            ((slot, req) for slot, req in enumerate(self._slots)
+             if req is not None),
+            key=lambda it: it[1].admit_seq)
+
+    def _plan(self):
+        """Allot this step's flat token budget: one frontier token per
+        running sequence first, then chunked prefill FIFO. Allocates the
+        pages the planned tokens will write; a dry pool preempts the
+        youngest sequence and replans."""
+        while True:
+            active = self._active()
+            if not active:
+                return None
+            alloc = {}
+            budget = self.token_budget - len(active)
+            for slot, req in active:
+                remaining = len(req.tokens) - req.n_prefilled
+                take = 1 + min(remaining - 1, budget)
+                budget -= take - 1
+                alloc[slot] = take
+            ok = True
+            for slot, req in active:
+                last = req.n_prefilled + alloc[slot] - 1
+                try:
+                    while last // self.page_size >= len(req.pages):
+                        page = self.pool.alloc()
+                        self._page_tables[slot, len(req.pages)] = page
+                        req.pages.append(page)
+                except PoolExhausted:
+                    if not self._preempt_one(req):
+                        # lone sequence outgrew the pool: unservable
+                        self._release(slot, req)
+                        if not req.future.done():
+                            req.future.set_exception(PoolExhausted(
+                                f"request {req.rid} needs more KV pages "
+                                f"than the pool holds"))
+                    ok = False
+                    break
+            if ok:
+                return [(slot, req, alloc[slot]) for slot, req in active]
+
+    def step(self):
+        """One scheduler tick: admit → plan → ONE compiled decode step →
+        sample frontiers → evict finished. Returns the list of requests
+        finished this tick."""
+        self._admit()
+        plan = self._plan()
+        if plan is None:
+            return []
+
+        T = self.token_budget
+        tok = np.zeros((T,), np.int32)
+        pos = np.zeros((T,), np.int32)
+        sid = np.zeros((T,), np.int32)
+        widx = np.zeros((T,), np.int32)   # 0 → trash page, row 0
+        klen = np.zeros((T,), np.int32)   # 0 → padding token
+        # per-SLOT sampling frontier: the vocab head only runs on these
+        # gathered rows (stale slots point at row 0; logits ignored)
+        sample_idx = np.zeros((self.num_slots,), np.int32)
+        sample_slots = []
+        i = 0
+        for slot, req, take in plan:
+            for k in range(take):
+                p = req.n_prefilled + k
+                tok[i] = req.tokens[p]
+                pos[i] = p
+                sid[i] = slot
+                widx[i] = (req.pages[p // self.page_size]
+                           * self.page_size + p % self.page_size)
+                klen[i] = p + 1
+                if p == len(req.tokens) - 1:
+                    sample_idx[slot] = i
+                    sample_slots.append(slot)
+                i += 1
+
+        try:
+            logits, self._kv = self._step_fn(
+                tok, pos, sid, widx, self._page_tables, klen, sample_idx,
+                self._kv)
+        except Exception as e:
+            # the donated pools may already be consumed by the failed
+            # dispatch — fail the in-flight work and re-zero so a
+            # direct-drive caller's engine stays serviceable (the server
+            # loop's own abort_all then finds nothing left to do)
+            self.abort_all(e)
+            raise
+
+        self.stats["steps"] += 1
+        self.stats["tokens_in"] += i
+        self.stats["occupancy_sum"] += len(plan) / self.num_slots
+
+        nxt = []
+        if sample_slots:
+            rows = jnp.asarray(sample_slots, jnp.int32)
+            lv = jnp.take(logits[0], rows, axis=0).astype(jnp.float32)
+            # greedy frontier sampling — same pick as generate()'s
+            # default path, so outputs stay token-identical
+            nxt = np.asarray(jnp.argmax(lv, axis=-1))
+
+        for slot, req, take in plan:
+            req.n_prefilled += take
+        finished = []
+        for slot, tok_id in zip(sample_slots, nxt):
+            req = self._slots[slot]
+            t = int(tok_id)
+            req.tokens.append(t)
+            self.stats["generated"] += 1
+            if ((req.eos is not None and t == req.eos)
+                    or len(req.tokens) >= req.target):
+                self._finish(slot, req)
+                finished.append(req)
+        return finished
+
+
+class LLMServer(_FutureQueueServer):
+    """Continuous-batching text-generation server: the future/queue
+    surface of `InferenceServer` over an `LLMEngine` (module docstring
+    has the usage). One background thread owns the engine; `submit` is
+    thread-safe."""
+
+    _thread_name = "llm-engine"
+
+    def __init__(self, model, config=None):
+        super().__init__()
+        self._engine = LLMEngine(model, config)
+        self.stats = self._engine.stats  # shared view + request counts
+        self.stats.setdefault("requests", 0)
+
+    @property
+    def engine(self):
+        return self._engine
+
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None):
+        """Enqueue one prompt (1-D int token ids). Returns a Future
+        resolving to np.int64 [prompt + generated] (eos kept, nothing
+        after it)."""
+        fut = Future()
+        self._enqueue((np.asarray(prompt).reshape(-1),
+                       int(max_new_tokens), eos_token_id, fut))
+        return fut
+
+    def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
+        return self.submit(prompt, max_new_tokens, eos_token_id).result()
+
+    def _ingest(self, payload):
+        prompt, max_new, eos, fut = payload
+        try:
+            self._engine.add_request(prompt, max_new, eos, future=fut)
+            self.stats["requests"] += 1
+        except Exception as e:  # bad request must not kill the loop
+            if not fut.done():
+                fut.set_exception(e)
+
+    def _loop(self):
+        eng = self._engine
+        while self._running or not self._q.empty() or eng.has_work():
+            try:
+                while True:
+                    self._ingest(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            if not eng.has_work():
+                # idle: block briefly for the next submission
+                try:
+                    self._ingest(self._q.get(timeout=0.05))
+                except queue.Empty:
+                    continue
+            try:
+                eng.step()
+            except Exception as e:  # defensive: never die silently
+                eng.abort_all(e)

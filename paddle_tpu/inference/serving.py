@@ -36,6 +36,70 @@ import jax.numpy as jnp
 __all__ = ["BatchingConfig", "InferenceServer"]
 
 
+class _FutureQueueServer:
+    """Shared lifecycle for future/queue servers: ONE background thread
+    owns the device; clients enqueue (payload, Future) pairs from any
+    thread. Subclasses implement `_loop` (and usually a typed `submit`
+    that builds the payload and calls `_enqueue`). Used by the dynamic
+    batcher below and by the continuous-batching `LLMServer`
+    (llm_engine.py)."""
+
+    _thread_name = "serve-loop"
+
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = None
+        self._running = False
+        self._state_lock = threading.Lock()
+
+    # -- lifecycle --
+    def start(self):
+        if self._running:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            # a previous stop() timed out (e.g. serve loop stuck in a
+            # long first compile): restarting would spawn a SECOND loop
+            # consuming the same queue with the revived _running flag
+            raise RuntimeError(
+                "previous batcher thread is still shutting down; "
+                "retry start() after it exits")
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name=self._thread_name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._state_lock:
+            self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            # only forget the thread once it actually exited — a live
+            # thread must block the next start() (see above)
+            if not self._thread.is_alive():
+                self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _enqueue(self, payload):
+        # check+put under the lock: a put racing stop() would otherwise
+        # land in a queue the loop has already drained, leaving the
+        # future unresolved forever
+        with self._state_lock:
+            if not self._running:
+                raise RuntimeError(
+                    "server not started (use `with server:`)")
+            self._q.put(payload)
+
+    def _loop(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
 class BatchingConfig:
     """Dynamic-batching policy: requests queue until `max_batch_size`
     are waiting or the oldest has waited `max_delay_ms`; the batch is
@@ -103,12 +167,15 @@ def _predictor_runner(predictor):
     return run, fixed
 
 
-class InferenceServer:
+class InferenceServer(_FutureQueueServer):
     """Dynamic-batching server over a model or Predictor (see module
     docstring). Thread-safe `submit`/`infer` from any number of client
     threads; one background batcher thread owns the device."""
 
+    _thread_name = "infer-batcher"
+
     def __init__(self, source, batching=None):
+        super().__init__()
         # private copy: a Predictor source rewrites the bucket list, and
         # a caller-shared config must not be mutated under another server
         src_cfg = batching or BatchingConfig()
@@ -137,59 +204,14 @@ class InferenceServer:
             raise TypeError(
                 f"InferenceServer source must be an nn.Layer, a "
                 f"Predictor, or a callable; got {type(source)!r}")
-        self._q = queue.Queue()
-        self._thread = None
-        self._running = False
-        self._state_lock = threading.Lock()
         self.stats = {"requests": 0, "batches": 0, "rows_padded": 0}
-
-    # -- lifecycle --
-    def start(self):
-        if self._running:
-            return self
-        if self._thread is not None and self._thread.is_alive():
-            # a previous stop() timed out (e.g. batcher stuck in a long
-            # first compile): restarting would spawn a SECOND batcher
-            # consuming the same queue with the revived _running flag
-            raise RuntimeError(
-                "previous batcher thread is still shutting down; "
-                "retry start() after it exits")
-        self._running = True
-        self._thread = threading.Thread(target=self._loop,
-                                        name="infer-batcher", daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self):
-        with self._state_lock:
-            self._running = False
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            # only forget the thread once it actually exited — a live
-            # thread must block the next start() (see above)
-            if not self._thread.is_alive():
-                self._thread = None
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
 
     # -- client API --
     def submit(self, *example):
         """Enqueue ONE example (arrays without the batch dim). Returns a
         Future resolving to the list of output rows for this example."""
         fut = Future()
-        payload = (tuple(np.asarray(x) for x in example), fut)
-        # check+put under the lock: a put racing stop() would otherwise
-        # land in a queue the batcher has already drained, leaving the
-        # future unresolved forever
-        with self._state_lock:
-            if not self._running:
-                raise RuntimeError(
-                    "server not started (use `with server:`)")
-            self._q.put(payload)
+        self._enqueue((tuple(np.asarray(x) for x in example), fut))
         return fut
 
     def infer(self, *example):

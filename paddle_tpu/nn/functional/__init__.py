@@ -35,6 +35,7 @@ from ...ops.activation import (  # noqa: F401
     thresholded_relu,
 )
 from ...ops.math import tanh  # noqa: F401
+from .attention import paged_attention  # noqa: F401
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .common import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from ...ops._helpers import apply_jfn, ensure_tensor
 
-__all__ = ["scaled_dot_product_attention", "dense_attention_bshd"]
+__all__ = ["scaled_dot_product_attention", "dense_attention_bshd",
+           "paged_attention"]
 
 
 def dense_attention_bshd(q, k, v, is_causal=False, attn_mask=None,
@@ -120,10 +121,103 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply_jfn("scaled_dot_product_attention", jfn, *tensors)
 
 
-def _pallas_eligible(q, k):
-    """Use the Pallas kernel only on real TPU backends with tileable shapes
-    (both q and kv sequence lengths; the kernel assumes self-attention
-    geometry for the causal diagonal)."""
+def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
+                    name=None):
+    """Ragged paged attention over a paged KV-cache pool — the serving
+    decode path (inference/llm_engine.py; PAPERS.md "Ragged Paged
+    Attention"). One query per FLAT scheduled token, so a single call
+    serves a continuous batch mixing decode tokens (1 per sequence) and
+    chunked-prefill tokens (many per sequence) with zero padding between
+    sequences.
+
+    query        [T, heads, head_dim] — flat token batch
+    k_pool/v_pool [num_pages, page_size, heads, head_dim] — the pool;
+                 page 0 is by convention the engine's trash page
+    page_tables  [num_slots, pages_per_seq] int — physical page id per
+                 (slot, logical page); unallocated entries may hold any
+                 valid id (they are masked by kv_lens)
+    slot_ids     [T] int — owning decode slot per token
+    kv_lens      [T] int — valid kv length for each token (its position
+                 + 1, i.e. the token attends to its own k/v and every
+                 earlier one); 0 marks a padding token → zero output
+
+    jnp reference semantics everywhere (mirrors the dense decode path in
+    text/models/gpt.py `_cached_attention` op for op, so engine greedy
+    decode stays token-identical to `generate()`); the Pallas kernel
+    (ops/pallas_kernels/paged_attention.py) takes over behind the same
+    TPU gate as flash attention.
+    """
+    q = ensure_tensor(query)
+    kp = ensure_tensor(k_pool)
+    vp = ensure_tensor(v_pool)
+    pt = ensure_tensor(page_tables)
+    sid = ensure_tensor(slot_ids)
+    lens = ensure_tensor(kv_lens)
+
+    if _paged_pallas_eligible(q, kp):
+        from ...ops.pallas_kernels import paged_attention as pa_kernel
+
+        def jfn_pallas(qv, kpool, vpool, tables, sids, ls):
+            return pa_kernel.ragged_paged_attention(
+                qv, kpool, vpool, tables, sids, ls)
+
+        return apply_jfn("paged_attention", jfn_pallas, q, kp, vp, pt,
+                         sid, lens)
+
+    def jfn(qv, kpool, vpool, tables, sids, ls):
+        import jax
+
+        n_pages, page_size, h, d = kpool.shape
+        n_slots, pages_per_seq = tables.shape
+        tokens = qv.shape[0]
+        L = pages_per_seq * page_size
+        ls = ls.astype(jnp.int32)
+        sids = sids.astype(jnp.int32)
+        # gather each SLOT's kv once ([S, L, h, d]) and scatter the
+        # queries onto a [S, C] slot grid, so the per-TOKEN [T, L, h, d]
+        # materialization never forms — 2× fewer bytes moved than the
+        # naive per-token gather at serving shapes, and the slot-level
+        # einsum is a clean batched matmul. (The Pallas kernel avoids
+        # even the [S, L] gather by DMA-ing pages from the table.)
+        l_idx = jnp.arange(L, dtype=jnp.int32)
+        phys = (tables.astype(jnp.int32)[:, l_idx // page_size]
+                * page_size + (l_idx % page_size)[None, :])   # [S, L]
+        k_all = kpool.reshape(n_pages * page_size, h, d)
+        v_all = vpool.reshape(n_pages * page_size, h, d)
+        ks = k_all[phys]                            # [S, L, h, d]
+        vs = v_all[phys]
+        # chunk position of each token within its slot (order-stable):
+        # cpos[t] = #earlier tokens with the same slot — collision-free
+        # grid coordinates whatever order the scheduler packed
+        eq = sids[:, None] == sids[None, :]
+        cpos = jnp.sum(jnp.tril(eq, -1), axis=1)    # [T]
+        C = tokens                                  # worst case: 1 slot
+        qs = jnp.zeros((n_slots, C, h, d), qv.dtype).at[
+            (sids, cpos)].set(qv)
+        lgrid = jnp.zeros((n_slots, C), jnp.int32).at[
+            (sids, cpos)].set(ls)
+        sc = jnp.einsum("schd,slhd->shcl", qs, ks) / math.sqrt(d)
+        allowed = (l_idx[None, None, None, :]
+                   < lgrid[:, None, :, None])
+        sc = jnp.where(allowed, sc, jnp.float32(-1e30))
+        # softmax statistics in f32 even for bf16 pools (same contract
+        # as _cached_attention); empty grid cells softmax to uniform
+        # garbage but are never gathered back
+        w = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(
+            vs.dtype)
+        o = jnp.einsum("shcl,slhd->schd", w, vs).astype(qv.dtype)
+        out = o[(sids, cpos)]                       # [T, h, d]
+        # padding tokens (kv_len 0): the fully-masked softmax row is
+        # uniform garbage — zero it explicitly
+        return jnp.where((ls > 0)[:, None, None], out,
+                         jnp.zeros_like(out))
+
+    return apply_jfn("paged_attention", jfn, q, kp, vp, pt, sid, lens)
+
+
+def _pallas_backend_ok():
+    """The shared Pallas gate policy: kernels flag on AND a real TPU
+    backend (ONE place — both the flash and the paged gates call it)."""
     from ...core import flags
 
     if not flags.get_flag("use_pallas_kernels"):
@@ -131,13 +225,31 @@ def _pallas_eligible(q, k):
     try:
         import jax
 
-        if jax.default_backend() != "tpu":
-            return False
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def _paged_pallas_eligible(q, k_pool):
+    """Pallas ragged-paged-attention gate: `_pallas_backend_ok` +
+    MXU-friendly head_dim + lane-tileable page size (the grid is
+    per-token so seq alignment is moot)."""
+    return (
+        _pallas_backend_ok()
+        and len(q.shape) == 3
+        and q.shape[2] in (64, 128, 256)
+        and k_pool.shape[1] % 8 == 0
+    )
+
+
+def _pallas_eligible(q, k):
+    """Use the Pallas kernel only on real TPU backends with tileable shapes
+    (both q and kv sequence lengths; the kernel assumes self-attention
+    geometry for the causal diagonal)."""
     shape = q.shape
     return (
-        len(shape) == 4
+        _pallas_backend_ok()
+        and len(shape) == 4
         and shape[1] % 128 == 0
         and k.shape[1] == shape[1]
         and shape[3] in (64, 128, 256)

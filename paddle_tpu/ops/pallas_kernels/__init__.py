@@ -10,6 +10,9 @@ Kernels run in compiled mode on real TPU backends and in Pallas interpret
 mode in the CPU test tier (tests/test_pallas_kernels.py).
 """
 from . import flash_attention  # noqa: F401
+from . import paged_attention  # noqa: F401
 from .flash_attention import flash_attention_bshd  # noqa: F401
+from .paged_attention import ragged_paged_attention  # noqa: F401
 
-__all__ = ["flash_attention", "flash_attention_bshd"]
+__all__ = ["flash_attention", "flash_attention_bshd",
+           "paged_attention", "ragged_paged_attention"]

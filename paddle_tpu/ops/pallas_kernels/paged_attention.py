@@ -1,0 +1,150 @@
+"""Pallas ragged paged attention for TPU — the serving decode kernel.
+
+TPU-native kernel for the continuous-batching LLM engine
+(inference/llm_engine.py): attention over a PAGED KV cache, one query per
+flat scheduled token, so decode tokens (1 per sequence) and chunked
+prefill tokens (many per sequence) ride one launch with zero padding
+between sequences (PAPERS.md "Ragged Paged Attention"; the reference's
+serving stack keeps a contiguous per-request cache instead — paging is
+what lets HBM scale with live tokens).
+
+Layout: q [T, heads, head_dim]; the pool [num_pages, page_size, heads,
+head_dim]. Grid (T, pages_per_seq) with the page dimension innermost:
+each token revisits its output block across page steps, so the f32
+accumulator and the online-softmax (m, l) statistics live in VMEM
+scratch and are finalized on the last page step — the same
+FlashAttention-2 shape as flash_attention.py, but the kv blocks are
+GATHERED through the page table: the page id for grid step (t, j) is
+read from scalar-prefetch SMEM (page_tables[slot_ids[t], j]) inside the
+BlockSpec index_map, so Mosaic DMAs exactly the pages the token needs
+and blocks past the token's kv length are skipped.
+
+Decode-only (no VJP): serving runs under no_grad. Numerics follow the
+flash kernel: matmuls accumulate f32 on the MXU, masked lanes get -1e30,
+fully-masked rows (padding tokens, kv_len 0) finalize to exact zeros.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ragged_paged_attention"]
+
+NEG_INF = -1e30
+
+
+def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                acc_ref, m_ref, l_ref, *, page_size, pages_per_seq,
+                scale):
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    kvlen = lens_ref[t]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # pages entirely past the token's valid prefix contribute nothing —
+    # skip (padding tokens have kvlen 0, so they skip every page)
+    @pl.when(j * page_size < kvlen)
+    def _compute():
+        q = q_ref[0]                     # [H, D]
+        k = k_ref[0]                     # [P, H, D]
+        v = v_ref[0]
+        kt = jnp.swapaxes(k, 0, 1)       # [H, P, D]
+        s = jax.lax.dot_general(
+            q, kt, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                        # [H, P]
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1) + j * page_size
+        s = jnp.where(cols < kvlen, s, NEG_INF)
+        # freed/unwritten page rows hold stale-but-finite garbage (the
+        # pool is zero-initialized); their weight is exactly 0 below,
+        # but zero the v rows anyway so no accidental inf·0 can form
+        vrows = jax.lax.broadcasted_iota(
+            jnp.int32, v.shape, 0) + j * page_size
+        v = jnp.where(vrows < kvlen, v, jnp.zeros_like(v))
+        vt = jnp.swapaxes(v, 0, 1)       # [H, P, D]
+
+        m_prev = m_ref[:, :1]            # [H, 1] (stats broadcast lanes)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)           # [H, P] f32
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(vt.dtype), vt, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        # padding tokens (kv_len 0) never ran a page: l == 0 → zeros out
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
+                           kv_lens, interpret=False):
+    """q [T, H, D], pools [N, P, H, D], page_tables [S, MP] int,
+    slot_ids [T] int, kv_lens [T] int → out [T, H, D].
+
+    Semantics contract: identical to the jnp reference in
+    nn/functional/attention.py `paged_attention` (pinned by the
+    interpret-mode parity test in tests/test_llm_engine.py)."""
+    tokens, heads, dim = q.shape
+    _, page_size, _, _ = k_pool.shape
+    _, pages_per_seq = page_tables.shape
+    scale = 1.0 / math.sqrt(dim)
+
+    kernel = functools.partial(
+        _rpa_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
+        scale=scale)
+
+    def page_map(t, j, sid, pt, lens):
+        # clamp j to the token's LAST live page: grid steps past the
+        # valid prefix re-request the same block, so Mosaic elides their
+        # HBM→VMEM copy (the compute is already pl.when-gated) — without
+        # the clamp every dead page would still be DMA'd and kernel
+        # bandwidth would scale with max_model_len, not live tokens
+        last = jnp.maximum(lens[t] - 1, 0) // page_size
+        return (pt[sid[t] * pages_per_seq + jnp.minimum(j, last)],
+                0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(tokens, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, heads, dim),
+                         lambda t, j, sid, pt, lens: (t, 0, 0)),
+            pl.BlockSpec((1, page_size, heads, dim), page_map),
+            pl.BlockSpec((1, page_size, heads, dim), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, heads, dim),
+                               lambda t, j, sid, pt, lens: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, dim), jnp.float32),   # acc
+            pltpu.VMEM((heads, 128), jnp.float32),   # running max
+            pltpu.VMEM((heads, 128), jnp.float32),   # running sum
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, heads, dim), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(slot_ids, jnp.int32),
+      jnp.asarray(page_tables, jnp.int32).reshape(-1),
+      jnp.asarray(kv_lens, jnp.int32),
+      q, k_pool, v_pool)

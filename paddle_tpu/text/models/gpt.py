@@ -212,6 +212,54 @@ def _layer_forward_cached(layer, x, cache, index, pad_lens=None):
     return x + layer.fc2(F.gelu(layer.fc1(h))), {"k": ck, "v": cv}
 
 
+def _paged_cache_write(k_pool, v_pool, k_new, v_new, write_idx):
+    """Scatter per-token k/v rows into the paged KV pool.
+
+    k_pool/v_pool [num_pages, page_size, heads, head_dim]; k_new/v_new
+    [T, heads, head_dim]; write_idx [T] int32 flat destination rows
+    (page_id * page_size + offset). Page 0 is the engine's trash page:
+    padding tokens all target row 0, where collisions are harmless —
+    trash content is never attended with nonzero weight."""
+    import jax.numpy as jnp
+
+    from ...ops._helpers import apply_jfn
+
+    def jfn(kp, vp, kn, vn, idx):
+        shape = kp.shape
+        flat = (shape[0] * shape[1],) + shape[2:]
+        idx = idx.astype(jnp.int32)
+        kp2 = kp.reshape(flat).at[idx].set(
+            kn.astype(kp.dtype)).reshape(shape)
+        vp2 = vp.reshape(flat).at[idx].set(
+            vn.astype(vp.dtype)).reshape(shape)
+        return kp2, vp2
+
+    return apply_jfn("paged_cache_write", jfn, k_pool, v_pool, k_new,
+                     v_new, write_idx)
+
+
+def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
+                         page_tables, slot_ids, kv_lens):
+    """Paged-cache decoder block over the FLAT token layout [1, T, d] —
+    the continuous-batching analog of `_layer_forward_cached`: write the
+    step's k/v into pool pages, then ragged paged attention against each
+    token's own sequence prefix. Functional (returns new pools), so the
+    whole engine step compiles to ONE program."""
+    T = x.shape[1]
+    h = layer.ln1(x)
+    qkv = layer.qkv(h)
+    q, k, v = split_fused_qkv(qkv, 1, T, layer.nh, layer.hd)
+    q = manip.reshape(q, [T, layer.nh, layer.hd])
+    k = manip.reshape(k, [T, layer.nh, layer.hd])
+    v = manip.reshape(v, [T, layer.nh, layer.hd])
+    ck, cv = _paged_cache_write(cache_k, cache_v, k, v, write_idx)
+    attn = F.paged_attention(q, ck, cv, page_tables, slot_ids, kv_lens)
+    attn = manip.reshape(attn, [1, T, layer.nh * layer.hd])
+    x = x + layer.proj(attn)
+    h = layer.ln2(x)
+    return x + layer.fc2(F.gelu(layer.fc1(h))), ck, cv
+
+
 class GPTGenerationMixin:
     """Greedy / temperature / top-k decoding with a static KV cache
     (reference capability: PaddleNLP generate() on GPT; here designed
@@ -274,8 +322,35 @@ class GPTGenerationMixin:
             self.__dict__[key] = jit_mod.to_static(impl)
         return self.__dict__[key].__get__(self, type(self))
 
+    # ---- paged-cache ragged decode (continuous-batching serving) ----
+
+    def _paged_decode_core(self, tok, pos_ids, slot_ids, write_idx,
+                           page_tables, kv_lens, sample_idx, kv):
+        """One ragged engine step over flat tokens: tok/pos_ids/slot_ids/
+        write_idx/kv_lens [T], page_tables [S, MP], sample_idx [S] (the
+        flat row holding each slot's sampling frontier; stale slots
+        point anywhere — their logits are ignored), kv = 2·num_layers
+        pool arrays. Returns (logits [1, S, vocab], *new_pools).
+        The vocab head — the step's single biggest matmul — runs ONLY
+        on the S gathered frontier rows, never on prefill tokens.
+        Compiled ONCE by inference/llm_engine.py's _CompiledPagedStep —
+        the TrainStep-style executable behind every scheduler tick
+        (weights as jit arguments, pools donated)."""
+        model = self.gpt
+        x = model.wte(tok.unsqueeze(0)) + model.wpe(pos_ids)
+        flat = []
+        for i, layer in enumerate(model.layers):
+            x, ck, cv = _layer_forward_paged(
+                layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
+                page_tables, slot_ids, kv_lens)
+            flat += [ck, cv]
+        x = model.ln_f(x)
+        x = manip.gather(x, sample_idx, axis=1)  # [1, S, d] frontiers
+        return (self._logits_from_hidden(x, shard=False), *flat)
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, do_sample=False, attention_mask=None):
+                 top_k=None, do_sample=False, attention_mask=None,
+                 eos_token_id=None, pad_token_id=None):
         """input_ids [b, prompt] → [b, min(prompt + max_new_tokens,
         max_seq_len)].
 
@@ -284,6 +359,16 @@ class GPTGenerationMixin:
         token sits at the same column, so one uniform decode loop
         serves the whole batch); pad columns are masked out of
         attention and position ids start after the pads.
+
+        eos_token_id: optional early-stop contract (shared with the
+        continuous-batching engine, inference/llm_engine.py): a row
+        that GENERATES eos is finished — it emits `pad_token_id`
+        (default: eos_token_id) for every later step instead of fresh
+        tokens, and the loop exits as soon as every row is finished, so
+        the result can be shorter than max_new_tokens. Prompt tokens
+        never count as eos. NOTE: the all-finished check syncs one bool
+        per step, trading the decode loop's async dispatch for early
+        exit — only pay it when stopping is actually wanted.
         """
         import jax
         import jax.numpy as jnp
@@ -361,17 +446,36 @@ class GPTGenerationMixin:
                     return step(tok_t, idx_t, pad_lens, *kv)
                 return step(tok_t, idx_t, *kv)
 
+            finished = None
+            if eos_token_id is not None:
+                pad_id = (eos_token_id if pad_token_id is None
+                          else pad_token_id)
+                finished = jnp.zeros((b,), bool)
+
+            def stop_update(tok):
+                # finished rows emit pad; a fresh eos marks its row
+                # finished (the emitted eos itself is kept)
+                nonlocal finished
+                if finished is None:
+                    return tok
+                tok = jnp.where(finished,
+                                jnp.asarray(pad_id, tok.dtype), tok)
+                finished = finished | (tok == eos_token_id)
+                return tok
+
             idx0 = to_tensor(jnp.asarray(0, jnp.int32))
             logits, *flat_kv = run_step(input_ids, idx0, flat_kv)
             out = [input_ids._value.astype(jnp.int64)]
-            tok = pick(logits)
+            tok = stop_update(pick(logits))
             out.append(tok[:, None].astype(jnp.int64))
             for t in range(1, total - prompt):
+                if finished is not None and bool(finished.all()):
+                    break  # every row hit eos: stop early
                 step_idx = to_tensor(jnp.asarray(prompt + t - 1, jnp.int32))
                 logits, *flat_kv = run_step(
                     Tensor(tok[:, None], stop_gradient=True), step_idx,
                     flat_kv)
-                tok = pick(logits)
+                tok = stop_update(pick(logits))
                 out.append(tok[:, None].astype(jnp.int64))
         return Tensor(jnp.concatenate(out, axis=1), stop_gradient=True)
 

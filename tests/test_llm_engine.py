@@ -1,0 +1,370 @@
+"""Continuous-batching LLM serving engine (inference/llm_engine.py).
+
+The ISSUE-2 acceptance suite: paged attention == dense attention to
+fp32 tolerance across page sizes and ragged lengths, engine greedy
+decode == generate() token-for-token, page-pool alloc/free invariants
+(incl. the 100-request soak, slow), and the zero-recompile-after-warmup
+probe on the one compiled decode executable.
+"""
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.inference.llm_engine import (
+    LLMEngine, LLMEngineConfig, PagePool, PoolExhausted)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+# --------------------------------------------------------------------
+# paged attention parity
+# --------------------------------------------------------------------
+
+def _build_paged_case(rng, page_size, lens, H=2, D=16, extra_tokens=()):
+    """Scatter contiguous per-slot K/V into a shuffled page pool.
+
+    Returns (q, pool_k, pool_v, page_tables, slot_ids, kv_lens, kc, vc)
+    where kc/vc are the contiguous [S, L, H, D] ground truth."""
+    S = len(lens)
+    P = page_size
+    MP = -(-max(lens) // P)
+    N = sum(-(-int(l) // P) for l in lens) + 1  # exact + trash
+    kc = rng.standard_normal((S, MP * P, H, D)).astype(np.float32)
+    vc = rng.standard_normal((S, MP * P, H, D)).astype(np.float32)
+    pool_k = np.zeros((N, P, H, D), np.float32)
+    pool_v = np.zeros((N, P, H, D), np.float32)
+    pt = np.zeros((S, MP), np.int32)
+    perm = list(rng.permutation(np.arange(1, N)))
+    for s in range(S):
+        for j in range(-(-int(lens[s]) // P)):
+            pid = int(perm.pop())
+            pt[s, j] = pid
+            pool_k[pid] = kc[s, j * P:(j + 1) * P]
+            pool_v[pid] = vc[s, j * P:(j + 1) * P]
+    # one token at every slot frontier + ragged mid-sequence extras +
+    # one padding token (kv_len 0)
+    sid = list(range(S)) + [s for s, _ in extra_tokens] + [0]
+    klen = [int(l) for l in lens] + [k for _, k in extra_tokens] + [0]
+    T = len(sid)
+    q = rng.standard_normal((T, H, D)).astype(np.float32)
+    return (q, pool_k, pool_v, pt, np.asarray(sid, np.int32),
+            np.asarray(klen, np.int32), kc, vc)
+
+
+def _dense_reference(q, kc, vc, sid, klen):
+    """float64 softmax attention per token over its own prefix."""
+    T, H, D = q.shape
+    out = np.zeros((T, H, D))
+    for t in range(T):
+        L = int(klen[t])
+        if L == 0:
+            continue
+        K = kc[sid[t], :L].astype(np.float64)
+        V = vc[sid[t], :L].astype(np.float64)
+        sc = np.einsum("hd,lhd->hl", q[t].astype(np.float64),
+                       K) / math.sqrt(D)
+        w = np.exp(sc - sc.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        out[t] = np.einsum("hl,lhd->hd", w, V)
+    return out
+
+
+@pytest.mark.parametrize("page_size", [16, 64, 128])
+def test_paged_attention_matches_dense(page_size):
+    rng = np.random.default_rng(page_size)
+    # ragged: full pages, a partial tail, a single token, page-crossing
+    lens = [2 * page_size + 7, page_size, page_size - 1, 1]
+    extras = [(0, 5), (0, page_size + 1), (1, 3)]
+    q, pk, pv, pt, sid, klen, kc, vc = _build_paged_case(
+        rng, page_size, lens, extra_tokens=extras)
+    out = F.paged_attention(
+        paddle.to_tensor(q), paddle.to_tensor(pk), paddle.to_tensor(pv),
+        paddle.to_tensor(pt), paddle.to_tensor(sid),
+        paddle.to_tensor(klen)).numpy()
+    ref = _dense_reference(q, kc, vc, sid, klen)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # the padding token (kv_len 0) is exactly zero, not NaN
+    assert np.all(out[-1] == 0)
+
+
+def test_pallas_ragged_paged_attention_interpret_matches_jnp():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import paged_attention as pak
+
+    rng = np.random.default_rng(3)
+    q, pk, pv, pt, sid, klen, kc, vc = _build_paged_case(
+        rng, 16, [40, 19, 1], extra_tokens=[(0, 7), (1, 13)])
+    jnp_out = F.paged_attention(
+        paddle.to_tensor(q), paddle.to_tensor(pk), paddle.to_tensor(pv),
+        paddle.to_tensor(pt), paddle.to_tensor(sid),
+        paddle.to_tensor(klen)).numpy()
+    pl_out = np.asarray(pak.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+        jnp.asarray(pt), jnp.asarray(sid), jnp.asarray(klen),
+        interpret=True))
+    # online softmax vs plain softmax: identical to fp32 tolerance
+    np.testing.assert_allclose(pl_out, jnp_out, rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------
+# engine == generate()
+# --------------------------------------------------------------------
+
+def _tiny_model(seed=30):
+    paddle.seed(seed)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _ref_generate(model, prompt, max_new, **kw):
+    return model.generate(
+        paddle.to_tensor(np.asarray(prompt)[None].astype(np.int64)),
+        max_new_tokens=max_new, **kw).numpy()[0]
+
+
+def test_engine_greedy_matches_generate_token_for_token():
+    cfg, model = _tiny_model()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (L,))
+               for L in (5, 13, 8, 21, 3)]
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64))
+    reqs = [eng.add_request(p, max_new_tokens=7) for p in prompts]
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < 300
+    for p, r in zip(prompts, reqs):
+        got = r.future.result(timeout=0)
+        ref = _ref_generate(model, p, 7)
+        np.testing.assert_array_equal(got, ref)
+    assert eng.pool.num_live == 0
+    assert eng.stats["finished"] == len(prompts)
+    assert 0.0 < eng.mean_occupancy <= 1.0
+
+
+def test_engine_eos_matches_generate_contract():
+    cfg, model = _tiny_model(seed=24)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, cfg.vocab_size, (6,))
+    base = _ref_generate(model, prompt, 8)
+    eos = int(base[6 + 1])  # the row's 2nd generated token
+    # generate(): emits eos, then stops early (and would pad a batch)
+    stopped = _ref_generate(model, prompt, 8, eos_token_id=eos)
+    assert stopped.shape[0] == 6 + 2
+    np.testing.assert_array_equal(stopped, base[:8])
+    # engine: same stop semantics — eos kept, nothing after it
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, max_model_len=64))
+    req = eng.add_request(prompt, max_new_tokens=8, eos_token_id=eos)
+    while eng.has_work():
+        eng.step()
+    np.testing.assert_array_equal(req.future.result(timeout=0), stopped)
+
+
+def test_engine_preemption_stays_deterministic():
+    cfg, model = _tiny_model(seed=31)
+    rng = np.random.default_rng(7)
+    # 4 sequences of 3 pages each through a 5-page pool: the scheduler
+    # must preempt to make progress, and greedy decode must not notice
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, num_pages=6, max_model_len=48,
+        token_budget=8))
+    prompts = [rng.integers(0, cfg.vocab_size, (20,)) for _ in range(4)]
+    reqs = [eng.add_request(p, max_new_tokens=20) for p in prompts]
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < 500
+    assert eng.stats["preemptions"] > 0, "pool was not tight enough"
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(r.future.result(timeout=0),
+                                      _ref_generate(model, p, 20))
+    assert eng.pool.num_live == 0
+
+
+def test_engine_zero_recompiles_after_warmup():
+    cfg, model = _tiny_model(seed=32)
+    rng = np.random.default_rng(11)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64))
+    # warmup: the first step compiles THE decode executable
+    eng.add_request(rng.integers(0, cfg.vocab_size, (4,)),
+                    max_new_tokens=3)
+    while eng.has_work():
+        eng.step()
+    warm = eng.compile_stats()
+    assert warm == {"executables": 1}, warm
+    # steady state: mixed prompt lengths, admissions, evictions — the
+    # fixed-shape step must never recompile
+    for L in (3, 17, 30, 9, 25):
+        eng.add_request(rng.integers(0, cfg.vocab_size, (L,)),
+                        max_new_tokens=4)
+    while eng.has_work():
+        eng.step()
+    assert eng.compile_stats() == warm, (
+        "steady-state serving recompiled the decode step")
+
+
+# --------------------------------------------------------------------
+# page pool
+# --------------------------------------------------------------------
+
+def test_page_pool_alloc_free_invariants():
+    pool = PagePool(num_pages=5, page_size=16)
+    assert pool.num_free == 4  # page 0 reserved as trash
+    pages = [pool.alloc() for _ in range(4)]
+    assert 0 not in pages and len(set(pages)) == 4
+    with pytest.raises(PoolExhausted):
+        pool.alloc()
+    pool.free(pages[:2])
+    pool.assert_consistent()
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free([pages[0]])
+    pool.free(pages[2:])
+    pool.assert_consistent()
+    assert pool.num_free == 4 and pool.num_live == 0
+
+
+def test_engine_rejects_unservable_requests():
+    cfg, model = _tiny_model(seed=33)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, num_pages=3, max_model_len=64))
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.add_request(np.zeros((0,), np.int64))
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.add_request(np.zeros((65,), np.int64))
+    # prompt alone needs 3 pages; the pool holds 2 allocable
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.add_request(np.zeros((40,), np.int64))
+    # zero generation budget echoes the prompt (generate() contract)
+    req = eng.add_request(np.arange(5), max_new_tokens=0)
+    np.testing.assert_array_equal(req.future.result(timeout=0),
+                                  np.arange(5))
+
+
+@pytest.mark.slow
+def test_page_pool_soak_100_mixed_requests():
+    """100 mixed-length requests through a tight pool: hundreds of
+    scheduler steps with admissions, evictions, and preemptions — the
+    allocator must never double-free or leak."""
+    cfg, model = _tiny_model(seed=34)
+    rng = np.random.default_rng(17)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=4, page_size=16, num_pages=10, max_model_len=64,
+        token_budget=12))
+    reqs = []
+    for i in range(100):
+        L = int(rng.integers(1, 41))
+        gen = int(rng.integers(1, 17))
+        reqs.append(eng.add_request(
+            rng.integers(0, cfg.vocab_size, (L,)), max_new_tokens=gen))
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < 5000
+    assert steps > 100  # a genuine multi-hundred-step soak
+    assert eng.pool.num_live == 0
+    assert eng.stats["finished"] == 100
+    for r in reqs:
+        out = r.future.result(timeout=0)
+        assert out.ndim == 1 and len(out) > r.prompt_len
+
+
+# --------------------------------------------------------------------
+# LLMServer surface
+# --------------------------------------------------------------------
+
+def test_llm_server_concurrent_submits_match_generate():
+    cfg, model = _tiny_model(seed=35)
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(0, cfg.vocab_size, (L,))
+               for L in (4, 11, 7, 16, 2, 9)]
+    server = inference.LLMServer(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64))
+    results = {}
+    lock = threading.Lock()
+
+    def client(idxs):
+        futs = [(i, server.submit(prompts[i], max_new_tokens=5))
+                for i in idxs]
+        for i, f in futs:
+            out = f.result(timeout=120)
+            with lock:
+                results[i] = out
+
+    with server:
+        threads = [threading.Thread(target=client, args=(r,))
+                   for r in (range(0, 3), range(3, 6))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(results[i],
+                                      _ref_generate(model, p, 5))
+    assert server.stats["requests"] == len(prompts)
+    assert server.engine.pool.num_live == 0
+
+
+def test_llm_server_bad_request_fails_future_not_server():
+    cfg, model = _tiny_model(seed=36)
+    with inference.LLMServer(model, LLMEngineConfig(
+            num_slots=2, page_size=16, max_model_len=32)) as server:
+        bad = server.submit(np.zeros((200,), np.int64), max_new_tokens=4)
+        ok = server.submit(np.arange(3), max_new_tokens=2)
+        with pytest.raises(ValueError, match="max_model_len"):
+            bad.result(timeout=60)
+        assert len(ok.result(timeout=60)) == 5  # server stays alive
+
+
+def test_llm_server_cancelled_future_does_not_abort_others():
+    # a client cancel() must fail quietly at resolution time, not bubble
+    # an InvalidStateError into the serve loop's abort-everything path
+    cfg, model = _tiny_model(seed=38)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, cfg.vocab_size, (L,)) for L in (5, 9, 7)]
+    with inference.LLMServer(model, LLMEngineConfig(
+            num_slots=2, page_size=16, token_budget=6,
+            max_model_len=64)) as server:
+        futs = [server.submit(p, max_new_tokens=8) for p in prompts]
+        futs[1].cancel()  # races resolution: both outcomes must be safe
+        results = {i: futs[i].result(timeout=120) for i in (0, 2)}
+    # reference generate() AFTER the server stops: tracing swaps live
+    # param values, which must not race the serving thread
+    for i in (0, 2):
+        np.testing.assert_array_equal(results[i],
+                                      _ref_generate(model, prompts[i], 8))
+    assert server.engine.pool.num_live == 0
+
+
+def test_llm_server_requires_start():
+    cfg, model = _tiny_model(seed=37)
+    server = inference.LLMServer(model, LLMEngineConfig(
+        num_slots=2, page_size=16, max_model_len=32))
+    with pytest.raises(RuntimeError, match="not started"):
+        server.submit(np.arange(3))

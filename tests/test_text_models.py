@@ -281,6 +281,41 @@ class TestGeneration:
                              top_k=10 ** 6)
         assert out.shape == [1, 7]
 
+    def test_generate_eos_early_stop(self):
+        """The stop-semantics contract shared with the serving engine
+        (inference/llm_engine.py): a row that GENERATES eos keeps the
+        eos, emits pad afterwards, and the loop exits once every row is
+        finished."""
+        paddle.seed(26)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.default_rng(12)
+        prompt = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 5)))
+        base = model.generate(prompt, max_new_tokens=8).numpy()
+        # pick row 0's 2nd generated token as eos; row 1 may finish later
+        eos = int(base[0, 5 + 1])
+        out = model.generate(prompt, max_new_tokens=8, eos_token_id=eos,
+                             pad_token_id=0).numpy()
+        assert out.shape[1] <= base.shape[1]
+        for r in range(2):
+            row = out[r, 5:]
+            hits = np.where(row == eos)[0]
+            if hits.size:  # tokens up to+incl eos match, then pad
+                k = hits[0]
+                np.testing.assert_array_equal(row[:k + 1],
+                                              base[r, 5:5 + k + 1])
+                assert (row[k + 1:] == 0).all()
+            else:  # unfinished rows are untouched
+                np.testing.assert_array_equal(row,
+                                              base[r, 5:5 + row.size])
+        # single finished row ends the whole loop early
+        solo = model.generate(prompt[0:1], max_new_tokens=8,
+                              eos_token_id=eos).numpy()
+        assert solo.shape[1] == 5 + 2
+        np.testing.assert_array_equal(solo[0], base[0, :7])
+
     def test_generate_reuses_compiled_step(self):
         paddle.seed(23)
         cfg = gpt_tiny()

@@ -84,6 +84,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="skip the 3x3 flash-block grid (runs batch + fusedce + remat arms)")
+    ap.add_argument("--seq", type=int, default=1024,
+                    help="sequence length for every arm (PERF_NOTES "
+                         "hypothesis 2 re-sweeps flash tiles at s1024)")
     args = ap.parse_args()
 
     os.makedirs(CACHE, exist_ok=True)
@@ -95,11 +98,19 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     print(f"devices: {jax.devices()}", flush=True)
 
-    seq = 1024
+    seq = args.seq
     # config tuple: (kind, batch, seq, block_q, block_k, fused_block,
     # remat) — fused_block 0 = materialized-logits baseline
     configs = [("batch", b, seq, 512, 512, 0, False)
                for b in (8, 16, 24, 32)]
+    # flash-tile RE-SWEEP at the bench seq (PERF_NOTES hypothesis 2):
+    # the 512-tile winner was measured at s2048; at s1024 the kv loop
+    # runs only 2 iterations per 512-q-tile, so 256 tiles may pipeline
+    # better. Runs even under --quick (3 extra configs; the 512/512
+    # baseline is the b16 batch arm above). Promote any winner into
+    # flash_attention.py DEFAULT_BLOCK_* + docs/PERF_NOTES.md.
+    configs += [("tile_rs", 16, seq, bq, bk, 0, False)
+                for (bq, bk) in ((256, 256), (256, 512), (512, 256))]
     # fused-head arms: decide whether bench.py should flip
     # BENCH_GPT_FUSED_HEAD on by default, and at which block size
     # (small fb = small logits tiles but more dw-carry round-trips)
