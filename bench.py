@@ -656,6 +656,12 @@ def bench_llm_serve():
         total = time.perf_counter() - t0
         return outs, lat, total
 
+    # counter fields in LLMServer.metrics() are PROCESS-cumulative
+    # (warmup + every rep share the registry) — report per-rep deltas
+    # so "metrics of the best run" means that run
+    _COUNTER_KEYS = ("requests", "finished", "preemptions", "steps",
+                     "aborts", "prefill_tokens", "decode_tokens")
+
     def run_engine():
         ecfg = inference.LLMEngineConfig(
             num_slots=16, page_size=16, token_budget=48,
@@ -670,6 +676,7 @@ def bench_llm_serve():
                           max_new_tokens=1).result(timeout=1800)
             server.engine.stats.update(
                 {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
+            m0 = server.metrics()
             t0 = time.perf_counter()
             futs = []
             for j in range(n_req):
@@ -693,8 +700,14 @@ def bench_llm_serve():
             while (any(x is None for x in lat)
                    and time.perf_counter() - t_join < 5):
                 time.sleep(0.001)
+            # registry-sourced engine metrics (LLMServer.metrics), read
+            # while the server is still up; counters as THIS-rep deltas
+            # (histogram-derived percentiles stay process-cumulative)
+            em = server.metrics()
+            for k in _COUNTER_KEYS:
+                em[k] -= m0[k]
         occ = server.engine.mean_occupancy
-        return outs, lat, total, occ
+        return outs, lat, total, occ, em
 
     # the two phases run SEQUENTIALLY, so drifting background load on a
     # shared host would skew a single A/B either way (observed ±30%
@@ -702,14 +715,14 @@ def bench_llm_serve():
     # each side by its best run — noise only ever slows a run down.
     e_runs, s_runs = [], []
     for rep in range(2):
-        e_out, e_lat, e_total, occ = run_engine()
+        e_out, e_lat, e_total, occ, em = run_engine()
         log(f"[bench] llm_serve engine[{rep}]: {e_total:.2f}s, "
             f"occ {occ:.2f}")
-        e_runs.append((e_total, e_out, e_lat, occ))
+        e_runs.append((e_total, e_out, e_lat, occ, em))
         s_out, s_lat, s_total = run_static()
         log(f"[bench] llm_serve static[{rep}]: {s_total:.2f}s")
         s_runs.append((s_total, s_out, s_lat))
-    e_total, e_out, e_lat, occ = min(e_runs, key=lambda r: r[0])
+    e_total, e_out, e_lat, occ, em = min(e_runs, key=lambda r: r[0])
     s_total, s_out, s_lat = min(s_runs, key=lambda r: r[0])
     gen_tokens = sum(len(e_out[j]) - len(prompts[j]) for j in range(n_req))
     match = all(np.array_equal(e_out[j], s_out[j]) for j in range(n_req))
@@ -727,7 +740,13 @@ def bench_llm_serve():
                    "p50_latency_ms": round(pctl(e_lat, 50) * 1e3, 1),
                    "p99_latency_ms": round(pctl(e_lat, 99) * 1e3, 1),
                    "mean_slot_occupancy": round(occ, 3),
-                   "totals_s": [round(r[0], 2) for r in e_runs]},
+                   "totals_s": [round(r[0], 2) for r in e_runs],
+                   # registry-sourced (LLMServer.metrics of the best run):
+                   # occupancy/preemptions/token split + latency
+                   # percentiles with attribution
+                   "metrics": {k: (round(v, 4)
+                                   if isinstance(v, float) else v)
+                               for k, v in em.items()}},
         "static": {"tokens_per_sec": round(s_tps),
                    "p50_latency_ms": round(pctl(list(s_lat.values()), 50)
                                            * 1e3, 1),
@@ -763,6 +782,16 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
 def worker_main(which):
     _worker_bootstrap()
     result = _WORKERS[which]()
+    # Stamp the arm with its telemetry snapshot (registry dump incl.
+    # recompile/retry/preemption counters) so a perf regression in the
+    # BENCH_*.json trend series arrives WITH its attribution.
+    try:
+        from paddle_tpu import observability
+
+        result = dict(result)
+        result["telemetry"] = observability.bench_snapshot()
+    except Exception as e:
+        log(f"[bench] telemetry stamp failed: {e!r}")
     # Machine-readable result on stdout (supervisor parses; user sees stderr).
     print(json.dumps({"worker": which, "result": result}), flush=True)
 

@@ -69,6 +69,7 @@ from . import autograd  # noqa: F401
 import importlib as _importlib
 
 for _sub in (
+    "observability",  # first: jit/distributed/inference register metrics
     "nn",
     "optimizer",
     "metric",

@@ -33,15 +33,36 @@ import json
 import os
 import shutil
 import threading
+import time as _time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from ..observability import metrics as _obs
+from ..observability.tracing import trace_span as _trace_span
 from ..tensor_core import Tensor
 from . import chaos
 from .resilience import RetryPolicy, record
+
+# telemetry (docs/OBSERVABILITY.md): durations, bytes moved, and the
+# torn-checkpoint fallbacks that tell an operator a filesystem is
+# eating commits
+_SAVE_SECONDS = _obs.histogram("pt_ckpt_save_seconds",
+                               "save_state_dict wall time")
+_LOAD_SECONDS = _obs.histogram("pt_ckpt_load_seconds",
+                               "load_state_dict wall time")
+_BYTES_TOTAL = _obs.counter("pt_ckpt_bytes_total",
+                            "checkpoint bytes, by direction",
+                            labelnames=("direction",))
+_OPS_TOTAL = _obs.counter("pt_ckpt_ops_total",
+                          "completed checkpoint operations",
+                          labelnames=("op",))
+_TORN_FALLBACKS = _obs.counter(
+    "pt_ckpt_torn_fallbacks_total",
+    "torn checkpoints skipped by load_latest's older-checkpoint "
+    "fallback")
 
 __all__ = ["save_state_dict", "load_state_dict", "Checkpointer",
            "verify_integrity", "TornCheckpointError"]
@@ -243,9 +264,13 @@ def save_state_dict(state, path, async_save=False):
     # loop mostly just collects them. Only file I/O is deferred.
     pending = [(fpath, np.asarray(dev_arr)) for fpath, dev_arr in pending]
 
+    t_start = _time.perf_counter()
+
     def _write():
+        n_bytes = 0
         for fpath, host_arr in pending:
             storage, _ = _to_storage(host_arr)
+            n_bytes += storage.nbytes
             with open(fpath, "wb") as f:
                 np.save(f, storage)
                 if _FSYNC:
@@ -287,12 +312,21 @@ def save_state_dict(state, path, async_save=False):
         else:
             _commit(tmp, path, leaves, scalars, sorted(list_paths),
                     bytes_paths, empties)
+        _BYTES_TOTAL.labels(direction="saved").inc(n_bytes)
+        _OPS_TOTAL.labels(op="save").inc()
+        # duration from the CALLER's save start: includes the host
+        # snapshot above, so async and sync saves report comparably
+        _SAVE_SECONDS.observe(_time.perf_counter() - t_start)
+
+    def _traced_write():
+        with _trace_span("ckpt.save", path=path):
+            _write()
 
     if async_save:
-        h = _AsyncHandle(_write)
+        h = _AsyncHandle(_traced_write)
         h.start()
         return h
-    _write()
+    _traced_write()
     return _DoneHandle()
 
 
@@ -411,6 +445,7 @@ def load_state_dict(path, shardings=None, return_numpy=False):
     The meta.json integrity record (leaf count + per-shard byte sizes)
     is verified first: a torn checkpoint is rejected with ValueError,
     never half-loaded."""
+    t_start = _time.perf_counter()
     meta = verify_integrity(path)
     flat = []
     for e in meta["leaves"]:
@@ -458,7 +493,13 @@ def load_state_dict(path, shardings=None, return_numpy=False):
     for key, tag in meta.get("empties", {}).items():
         flat.append((tuple(key.split("/")),
                      {} if tag == "__empty_dict__" else []))
-    return _nest(flat, set(meta.get("lists", ())))
+    out = _nest(flat, set(meta.get("lists", ())))
+    integ = meta.get("integrity") or {}
+    _BYTES_TOTAL.labels(direction="loaded").inc(
+        sum(integ.get("shards", {}).values()))
+    _OPS_TOTAL.labels(op="load").inc()
+    _LOAD_SECONDS.observe(_time.perf_counter() - t_start)
+    return out
 
 
 # ----------------------------------------------------------- Checkpointer
@@ -595,10 +636,12 @@ class Checkpointer:
             try:
                 return self.load(step)
             except TornCheckpointError as e:
+                _TORN_FALLBACKS.inc()
                 record("ckpt_rejected", step=step, error=str(e))
                 continue
             except RetryError as e:
                 if isinstance(e.last, FileNotFoundError):
+                    _TORN_FALLBACKS.inc()
                     record("ckpt_rejected", step=step, error=str(e))
                     continue
                 raise
@@ -638,7 +681,7 @@ class Checkpointer:
         # core.jax_compat.no_persistent_cache)
         from ..core.jax_compat import no_persistent_cache
 
-        with no_persistent_cache():
+        with no_persistent_cache(), _trace_span("ckpt.load", step=step):
             state = self.retry.run(load_state_dict, self._dir(step),
                                    shardings=shardings,
                                    name=f"ckpt.load:{step}")

@@ -17,8 +17,22 @@ ElasticManager keeps the reference's API shape for scripts that consult
 it (enabled / exit codes / watch loop hooks)."""
 import time
 
+from ...observability import metrics as _obs
+
 __all__ = ["ElasticStatus", "ElasticManager", "run_with_fault_tolerance",
            "request_scale_out", "ELASTIC_EXIT_CODE"]
+
+# heartbeat telemetry: replaces ad-hoc age prints — the launcher, the
+# watch loop, and /metrics scrapes all read the same gauges
+_PEER_AGE = _obs.gauge("pt_elastic_peer_age_seconds",
+                       "seconds since a peer's last heartbeat",
+                       labelnames=("rank",))
+_PEERS = _obs.gauge("pt_elastic_peers", "registered peers")
+_STALE_PEERS = _obs.gauge("pt_elastic_stale_peers",
+                          "peers past the heartbeat timeout")
+_TRAIN_RESTARTS = _obs.counter(
+    "pt_elastic_train_restarts_total",
+    "in-process fault-tolerant restarts (run_with_fault_tolerance)")
 
 ELASTIC_EXIT_CODE = 101  # reference manager.py ELASTIC_EXIT_CODE
 
@@ -69,11 +83,14 @@ class ElasticManager:
 
         if self.master_ep:
             try:
-                return self._client().peers()
+                return self._gauge_peers(self._client().peers())
             except OSError:
-                return []
+                # master unreachable: the membership VIEW is empty —
+                # gauge that (stale last-healthy values lying on
+                # /metrics are worse than an honest zero)
+                return self._gauge_peers([])
         if not self.hb_dir or not os.path.isdir(self.hb_dir):
-            return []
+            return self._gauge_peers([])   # view empty — gauge it too
         now = time.time()
         out = []
         for f in sorted(os.listdir(self.hb_dir)):
@@ -84,7 +101,25 @@ class ElasticManager:
             except OSError:
                 continue
             out.append((int(f[3:]), age))
-        return out
+        return self._gauge_peers(out)
+
+    def _gauge_peers(self, peers):
+        """Mirror the membership view into the registry heartbeat
+        gauges (docs/OBSERVABILITY.md). Ranks that left the view have
+        their per-rank series REMOVED — a departed rank frozen at its
+        last healthy age would scrape as alive forever."""
+        _PEERS.set(len(peers))
+        stale = 0
+        seen = set()
+        for rank, age in peers:
+            seen.add(str(rank))
+            _PEER_AGE.labels(rank=rank).set(age)
+            if age > self.timeout:
+                stale += 1
+        for gone in set(_PEER_AGE._children) - {(r,) for r in seen}:
+            _PEER_AGE.remove(*gone)
+        _STALE_PEERS.set(stale)
+        return peers
 
     def watch(self):
         """HOLD while every registered peer beats within the timeout;
@@ -208,6 +243,7 @@ def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
             return train_fn(start)
         except Exception as e:
             attempt += 1
+            _TRAIN_RESTARTS.inc()
             record("train_restart", attempt=attempt, start_step=start,
                    error=repr(e))
             if attempt > max_restarts:

@@ -267,6 +267,14 @@ def _wait_pod(procs, poll_s=0.2, hb_dir=None, hb_timeout=0.0,
 
 def launch(argv=None):
     args = parse_args(argv)
+    # the launcher is a supervisor, not the measured workload: under
+    # PT_TELEMETRY=1 its own at-exit export would land on rank 0's
+    # files (no PADDLE_TRAINER_ID here) and overwrite the worker's real
+    # snapshot after the pod exits — drop to counting-only
+    from ...observability import full_enabled, set_mode
+
+    if full_enabled():
+        set_mode("metrics")
     if args.training_script_args[:1] == ["--"]:
         args.training_script_args = args.training_script_args[1:]
     master = args.master

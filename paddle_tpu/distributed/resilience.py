@@ -28,6 +28,8 @@ import signal
 import threading
 import time
 
+from ..observability import metrics as _obs
+
 __all__ = ["RetryPolicy", "RetryError", "StepGuard", "StepAbort",
            "PreemptionHandler", "install_preemption_handler",
            "AnomalyJournal", "record", "events", "recent_failures",
@@ -38,6 +40,19 @@ __all__ = ["RetryPolicy", "RetryError", "StepGuard", "StepAbort",
 
 stats = {"retries": collections.Counter(),   # policy name -> retry count
          "giveups": collections.Counter()}   # policy name -> exhausted
+
+# registry mirror (docs/OBSERVABILITY.md): per-call names carry the
+# target ("kv.get:<key>") — label by the op prefix only, or every key
+# becomes its own series
+_RETRIES_TOTAL = _obs.counter(
+    "pt_retries_total", "transient-fault retries, by operation",
+    labelnames=("op",))
+_GIVEUPS_TOTAL = _obs.counter(
+    "pt_retry_giveups_total", "retry budgets exhausted, by operation",
+    labelnames=("op",))
+_JOURNAL_EVENTS = _obs.counter(
+    "pt_journal_events_total", "anomaly-journal events, by kind",
+    labelnames=("kind",))
 
 _recent = collections.deque(maxlen=512)      # (t_monotonic, policy name)
 _recent_lock = threading.Lock()
@@ -54,6 +69,7 @@ def recent_failures(window_s=30.0):
 
 
 def _note_retry(name):
+    _RETRIES_TOTAL.labels(op=name.split(":", 1)[0]).inc()
     with _recent_lock:
         stats["retries"][name] += 1
         _recent.append((time.monotonic(), name))
@@ -90,6 +106,7 @@ class AnomalyJournal:
         return self._resolve()
 
     def write(self, kind, **fields):
+        _JOURNAL_EVENTS.labels(kind=kind).inc()
         entry = {"t": time.time(),
                  "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0")),
                  "kind": kind}
@@ -195,6 +212,7 @@ class RetryPolicy:
                 attempt += 1
                 if isinstance(e, self.give_up_on):
                     stats["giveups"][name] += 1
+                    _GIVEUPS_TOTAL.labels(op=name.split(":", 1)[0]).inc()
                     record("retry_exhausted", op=name, attempts=attempt,
                            error=repr(e))
                     raise RetryError(
@@ -215,6 +233,7 @@ class RetryPolicy:
                         delay = min(delay, remaining)
                 if out_of_attempts:
                     stats["giveups"][name] += 1
+                    _GIVEUPS_TOTAL.labels(op=name.split(":", 1)[0]).inc()
                     record("retry_exhausted", op=name, attempts=attempt,
                            error=repr(e))
                     raise RetryError(

@@ -171,26 +171,28 @@ def _collective_np(kind, nparr, op="sum", src=0):
     backend has none. Returns the gathered (world, ...) matrix for
     'all_gather', the reduced/selected local value otherwise."""
     nparr = np.ascontiguousarray(nparr)
-    if not _kv_coll["fallback"]:
-        try:
-            out = _run(kind, nparr, op=op, src=src)
-            a = np.asarray(out.addressable_data(0))
-            return a if kind == "all_gather" else a[0]
-        except Exception as e:
-            if not (is_multiprocess()
-                    and "Multiprocess computations aren't implemented"
-                    in str(e)):
-                raise
-            _kv_coll["fallback"] = True
-            from .resilience import record
+    with _trace_span(f"xproc.{kind}", op=op, bytes=int(nparr.nbytes)):
+        if not _kv_coll["fallback"]:
+            try:
+                out = _run(kind, nparr, op=op, src=src)
+                a = np.asarray(out.addressable_data(0))
+                return a if kind == "all_gather" else a[0]
+            except Exception as e:
+                if not (is_multiprocess()
+                        and "Multiprocess computations aren't implemented"
+                        in str(e)):
+                    raise
+                _kv_coll["fallback"] = True
+                _KV_FALLBACK.set(1)
+                from .resilience import record
 
-            record("kv_collective_fallback", error=repr(e))
-    if kind == "broadcast":
-        return _kv_broadcast_np(nparr, src)
-    mat = _kv_allgather_np(nparr)
-    if kind == "all_gather":
-        return mat
-    return _NP_REDUCERS[op](mat)
+                record("kv_collective_fallback", error=repr(e))
+        if kind == "broadcast":
+            return _kv_broadcast_np(nparr, src)
+        mat = _kv_allgather_np(nparr)
+        if kind == "all_gather":
+            return mat
+        return _NP_REDUCERS[op](mat)
 
 
 def all_reduce_np(nparr, op="sum"):
@@ -231,7 +233,7 @@ def all_gather_bytes(payload: bytes, max_len=1 << 20):
     n = len(payload)
     lens = all_gather_np(np.array([n], np.int32))[:, 0]
     width = int(lens.max())
-    stats["gather_bytes"] += width * len(lens)
+    _BYTES_TOTAL.labels(channel="gather").inc(width * len(lens))
     if width > max_len:
         # raise on ALL ranks (post-gather) so no peer is left blocking
         raise ValueError(f"object too large to gather ({width} > {max_len})")
@@ -256,6 +258,10 @@ import struct as _struct
 import threading as _threading
 import time as _time
 
+from collections.abc import MutableMapping as _MutableMapping
+
+from ..observability import metrics as _obs
+from ..observability.tracing import trace_span as _trace_span
 from . import chaos
 from .resilience import RetryError, RetryPolicy
 
@@ -267,10 +273,82 @@ _p2p_recv_seq = {}
 # under the socket transport; all_gather_bytes counts the full gathered
 # matrix — what every rank actually receives) plus retry telemetry
 # (resilience.RetryPolicy hardening: chaos tests assert injected faults
-# surface here instead of failing the collective)
-stats = {"p2p_bytes": 0, "gather_bytes": 0, "kv_bulk_bytes": 0,
-         "socket_bytes": 0, "kv_retries": 0, "connect_retries": 0,
-         "send_retries": 0}
+# surface here instead of failing the collective).
+#
+# Source of truth is the observability registry with NORMALIZED names —
+# the old free-form dict had one naming scheme for bytes (p2p_bytes /
+# kv_bulk_bytes) and another for retries (kv_retries vs the policies'
+# kv.get / sock.connect); now bytes are one counter labeled by channel
+# and retries one counter labeled by op:
+_BYTES_TOTAL = _obs.counter(
+    "pt_xproc_bytes_total",
+    "cross-process traffic, by channel (p2p=payload submitted, "
+    "socket=sent over TCP, kv_bulk=base64 through the coordination KV, "
+    "gather=full gathered matrix received)",
+    labelnames=("channel",), always_on=True)
+_RETRIES_TOTAL = _obs.counter(
+    "pt_xproc_retries_total",
+    "transport retries, by op (kv covers get+set)",
+    labelnames=("op",), always_on=True)
+_KV_FALLBACK = _obs.gauge(
+    "pt_xproc_kv_collective_fallback",
+    "1 once collectives ride the coordination KV (backend without "
+    "multi-process collectives)")
+
+
+class _DeprecatedStats(_MutableMapping):
+    """Read-only view keeping the OLD ``xproc.stats`` keys alive over
+    the registry counters. Reads return the counter value minus a
+    per-key offset; assignment (deprecated — kept because existing
+    harnesses reset keys to 0 between phases) only moves the offset, it
+    never touches the underlying counters."""
+
+    _KEYS = {
+        "p2p_bytes": lambda: _BYTES_TOTAL.labels(channel="p2p").value,
+        "gather_bytes": lambda: _BYTES_TOTAL.labels(
+            channel="gather").value,
+        "kv_bulk_bytes": lambda: _BYTES_TOTAL.labels(
+            channel="kv_bulk").value,
+        "socket_bytes": lambda: _BYTES_TOTAL.labels(
+            channel="socket").value,
+        "kv_retries": lambda: _RETRIES_TOTAL.labels(op="kv").value,
+        "connect_retries": lambda: _RETRIES_TOTAL.labels(
+            op="sock.connect").value,
+        "send_retries": lambda: _RETRIES_TOTAL.labels(
+            op="sock.send").value,
+    }
+
+    def __init__(self):
+        self._offsets = {}
+
+    def __getitem__(self, key):
+        return int(self._KEYS[key]() - self._offsets.get(key, 0))
+
+    def __setitem__(self, key, value):
+        import warnings
+
+        if key not in self._KEYS:
+            raise KeyError(
+                f"xproc.stats is a deprecated view over the telemetry "
+                f"registry; unknown key {key!r}")
+        warnings.warn(
+            "writing xproc.stats is deprecated — it only offsets this "
+            "view; use the observability registry "
+            "(pt_xproc_bytes_total / pt_xproc_retries_total)",
+            DeprecationWarning, stacklevel=2)
+        self._offsets[key] = self._KEYS[key]() - value
+
+    def __delitem__(self, key):
+        raise TypeError("xproc.stats is a read-only view")
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+
+stats = _DeprecatedStats()
 
 
 def _kv_client():
@@ -299,10 +377,11 @@ _SEND_RETRY = RetryPolicy(max_attempts=5, base_s=0.05, max_backoff_s=1.0,
                           name="sock.send")
 
 
-def _count_retry(key):
+def _count_retry(op):
+    cell = _RETRIES_TOTAL.labels(op=op)
+
     def note(attempt, exc):
-        with _stats_lock:
-            stats[key] += 1
+        cell.inc()
     return note
 
 
@@ -319,7 +398,7 @@ def _kv_get(key, timeout_ms):
 
     return _KV_RETRY.run(attempt, deadline_s=timeout_ms / 1000.0,
                          name=f"kv.get:{key}",
-                         on_retry=_count_retry("kv_retries"))
+                         on_retry=_count_retry("kv"))
 
 
 def _kv_set(key, value):
@@ -331,7 +410,7 @@ def _kv_set(key, value):
         client.key_value_set(key, value)
 
     _KV_RETRY.run(attempt, deadline_s=30.0, name=f"kv.set:{key}",
-                  on_retry=_count_retry("kv_retries"))
+                  on_retry=_count_retry("kv"))
 
 
 _HDR = _struct.Struct("<iiqq")   # src, tag, seq, payload length
@@ -439,7 +518,7 @@ class _SocketTransport:
                     deadline_s=max(0.001,
                                    deadline - _time.monotonic()),
                     name=f"sock.connect:{dst}",
-                    on_retry=_count_retry("connect_retries"))
+                    on_retry=_count_retry("sock.connect"))
         return slot
 
     def _drop_conn(self, slot):
@@ -458,8 +537,7 @@ class _SocketTransport:
 
     def send(self, data, dst, tag, seq, timeout_ms):
         me = jax.process_index()
-        with _stats_lock:
-            stats["socket_bytes"] += len(data)
+        _BYTES_TOTAL.labels(channel="socket").inc(len(data))
         deadline = _time.monotonic() + timeout_ms / 1000.0
         last_slot = {"slot": None}
 
@@ -488,8 +566,7 @@ class _SocketTransport:
         def _on_retry(attempt, exc):        # timeouts are OSError too
             if last_slot["slot"] is not None:
                 self._drop_conn(last_slot["slot"])
-            with _stats_lock:
-                stats["send_retries"] += 1
+            _RETRIES_TOTAL.labels(op="sock.send").inc()
 
         try:
             _SEND_RETRY.run(_attempt, deadline_s=timeout_ms / 1000.0,
@@ -566,16 +643,16 @@ def send_bytes(data: bytes, dst: int, tag: int = 0,
     with _stats_lock:
         seq = _p2p_send_seq.get((me, dst, tag), 0)
         _p2p_send_seq[(me, dst, tag)] = seq + 1
-        stats["p2p_bytes"] += len(data)
-    if not _use_kv_transport():
-        _socket_transport().send(data, dst, tag, seq, timeout_ms)
-        return
-    import base64
+    _BYTES_TOTAL.labels(channel="p2p").inc(len(data))
+    with _trace_span("xproc.send", dst=dst, tag=tag, bytes=len(data)):
+        if not _use_kv_transport():
+            _socket_transport().send(data, dst, tag, seq, timeout_ms)
+            return
+        import base64
 
-    payload = base64.b64encode(data).decode("ascii")
-    with _stats_lock:
-        stats["kv_bulk_bytes"] += len(payload)
-    _kv_set(f"pt_p2p/{me}/{dst}/{tag}/{seq}", payload)
+        payload = base64.b64encode(data).decode("ascii")
+        _BYTES_TOTAL.labels(channel="kv_bulk").inc(len(payload))
+        _kv_set(f"pt_p2p/{me}/{dst}/{tag}/{seq}", payload)
 
 
 def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
@@ -584,11 +661,13 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
         seq = _p2p_recv_seq.get((src, me, tag), 0)
         _p2p_recv_seq[(src, me, tag)] = seq + 1
     if not _use_kv_transport():
-        return _socket_transport().recv(src, tag, seq, timeout_ms)
+        with _trace_span("xproc.recv", src=src, tag=tag):
+            return _socket_transport().recv(src, tag, seq, timeout_ms)
     import base64
 
     key = f"pt_p2p/{src}/{me}/{tag}/{seq}"
-    val = _kv_get(key, timeout_ms)
+    with _trace_span("xproc.recv", src=src, tag=tag):
+        val = _kv_get(key, timeout_ms)
     # consumed: delete the entry, or bulk transfers (global_shuffle ships
     # whole dataset buckets) grow the coordinator without bound
     try:

@@ -105,12 +105,20 @@ class ProgBarLogger(Callback):
     """Per-epoch progress line with running loss/metrics and steps/sec.
 
     verbose=0 silent, 1 one line per epoch, 2 one line per log_freq steps.
+
+    Step timing comes from the SHARED ``profiler.benchmark()`` meter
+    (armed per epoch if nobody else owns it): a compiled TrainStep
+    auto-ticks the meter, an eager ``Model.fit`` loop is ticked here —
+    either way the steps/s this bar prints, ``benchmark().summary()``,
+    and the registry's ``pt_step_batch_cost_seconds`` report identical
+    numbers (docs/OBSERVABILITY.md).
     """
 
     def __init__(self, log_freq=1, verbose=2):
         super().__init__()
         self.log_freq = log_freq
         self.verbose = verbose
+        self._own_meter = False
 
     def _fmt(self, logs):
         parts = []
@@ -130,22 +138,44 @@ class ProgBarLogger(Callback):
         self.epochs = self.params.get("epochs")
         self.steps = self.params.get("steps")
 
+    def _meter(self):
+        from ..profiler import benchmark
+
+        return benchmark()
+
     def on_epoch_begin(self, epoch, logs=None):
         self._epoch = epoch
         self._t0 = time.time()
         self._seen = 0
+        bm = self._meter()
+        self._own_meter = not bm.enabled
+        if self._own_meter:
+            bm.enable()
+            bm.step()           # arm the first interval
         if self.verbose and self.epochs:
             print("Epoch %d/%d" % (epoch + 1, self.epochs), file=sys.stderr)
 
     def on_train_batch_end(self, step, logs=None):
         self._seen = step + 1
+        bm = self._meter()
+        if bm.enabled and not bm.auto_fed:
+            # eager loop: no instrumented TrainStep ticks the meter
+            # (auto=False: this host-side tick must not claim the
+            # auto-fed flag, or it would lock itself out next batch)
+            bm.auto_step(num_samples=(logs or {}).get("batch_size"),
+                         auto=False)
         if self.verbose > 1 and (step + 1) % self.log_freq == 0:
-            ips = self._seen / max(time.time() - self._t0, 1e-9)
+            s = bm.stats() if bm.enabled else {}
+            ips = s.get("steps_per_sec") or (
+                self._seen / max(time.time() - self._t0, 1e-9))
             total = self.steps if self.steps is not None else "?"
             print("step %s/%s - %s - %.1f step/s"
                   % (step + 1, total, self._fmt(logs), ips), file=sys.stderr)
 
     def on_epoch_end(self, epoch, logs=None):
+        if self._own_meter:
+            self._meter().disable()
+            self._own_meter = False
         if self.verbose:
             dt = time.time() - self._t0
             print("Epoch %d done in %.1fs - %s"

@@ -50,6 +50,7 @@ tests/test_llm_engine.py); eos semantics follow the shared contract
 import collections
 import itertools
 import queue
+import time as _time
 from concurrent.futures import Future
 
 import numpy as np
@@ -57,10 +58,48 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability import metrics as _obs
+from ..observability.tracing import trace_span as _trace_span
 from .serving import _FutureQueueServer
 
 __all__ = ["PagePool", "PoolExhausted", "LLMEngineConfig", "LLMEngine",
            "LLMServer"]
+
+# serving telemetry (docs/OBSERVABILITY.md). Counters/histograms are
+# process-global (engines in one process share them; `LLMServer.metrics()`
+# reads this registry — the bench's attribution source). Gauges carry
+# the most recent scheduler tick's view.
+_REQS_TOTAL = _obs.counter("pt_llm_requests_total", "requests accepted")
+_FINISHED_TOTAL = _obs.counter("pt_llm_finished_total",
+                               "requests finished (eos or budget)")
+_PREEMPTIONS_TOTAL = _obs.counter(
+    "pt_llm_preemptions_total", "sequences preempted on a dry page pool")
+_STEPS_TOTAL = _obs.counter("pt_llm_steps_total", "scheduler ticks")
+_ABORTS_TOTAL = _obs.counter("pt_llm_aborts_total",
+                             "abort_all events (device-error path)")
+_TOKENS_TOTAL = _obs.counter(
+    "pt_llm_tokens_total",
+    "flat tokens through the compiled step: one decode token per "
+    "sampling frontier, the rest chunked prefill",
+    labelnames=("phase",))
+_QUEUE_DEPTH = _obs.gauge("pt_llm_queue_depth", "requests waiting")
+_LIVE_SLOTS = _obs.gauge("pt_llm_live_slots", "sequences decoding")
+_SLOT_OCC = _obs.gauge("pt_llm_slot_occupancy",
+                       "live slots / num_slots, last tick")
+_PAGE_OCC = _obs.gauge("pt_llm_kv_page_occupancy",
+                       "live KV pages / allocable pages")
+_PAGE_FRAG = _obs.gauge(
+    "pt_llm_kv_fragmentation",
+    "internal fragmentation: 1 - written tokens / live page capacity")
+_ADMIT_SECONDS = _obs.histogram("pt_llm_admission_seconds",
+                                "submit -> first decode-slot admission")
+_TTFT_SECONDS = _obs.histogram("pt_llm_ttft_seconds",
+                               "submit -> first generated token")
+_REQ_TOK_RATE = _obs.histogram(
+    "pt_llm_request_tokens_per_sec",
+    "per-request generated tok/s (admission -> finish)",
+    buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000,
+             10000))
 
 
 class PoolExhausted(RuntimeError):
@@ -221,6 +260,9 @@ class _Request:
         self.n_prefilled = 0      # kv-written tokens (reset on preempt)
         self.admit_seq = None     # admission order (preemption picks max)
         self.preemptions = 0
+        # telemetry stamps (admission latency / TTFT / per-request rate)
+        self.t_submit = _time.perf_counter()
+        self.t_first_admit = None
 
     @property
     def num_generated(self):
@@ -318,12 +360,14 @@ class LLMEngine:
                 f"({self.pool.num_pages - 1})")
         req = _Request(toks, max_new_tokens, eos_token_id, future)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
+        _REQS_TOTAL.inc()
         if req.target <= req.prompt_len:
             # zero budget (same contract as generate()): prompt echoes back
             if not req.future.cancelled():
                 req.future.set_result(req.result_array())
             return req
         self.waiting.append(req)
+        _QUEUE_DEPTH.set(len(self.waiting))
         return req
 
     def has_work(self):
@@ -341,6 +385,48 @@ class LLMEngine:
         asserts on."""
         return {"executables": self._step_fn.cache_size()}
 
+    def kv_fragmentation(self):
+        """Internal fragmentation of the live KV pages: 1 − written
+        tokens / (live pages × page_size). High values mean many
+        sequences holding mostly-empty tail pages (page_size too big
+        for the workload)."""
+        cap = self.pool.num_live * self.page_size
+        if not cap:
+            return 0.0
+        used = sum(r.n_prefilled for r in self._slots if r is not None)
+        return max(0.0, 1.0 - used / cap)
+
+    def metrics(self):
+        """Live engine view + the process-global serving counters from
+        the telemetry registry (docs/OBSERVABILITY.md) — what
+        `LLMServer.metrics()` and the bench's llm_serve arm report."""
+        live = sum(r is not None for r in self._slots)
+        return {
+            "queue_depth": len(self.waiting),
+            "live_slots": live,
+            "num_slots": self.num_slots,
+            "slot_occupancy": live / self.num_slots,
+            "mean_slot_occupancy": self.mean_occupancy,
+            "kv_page_occupancy":
+                self.pool.num_live / (self.pool.num_pages - 1),
+            "kv_fragmentation": self.kv_fragmentation(),
+            "requests": int(_REQS_TOTAL.value),
+            "finished": int(_FINISHED_TOTAL.value),
+            "preemptions": int(_PREEMPTIONS_TOTAL.value),
+            "steps": int(_STEPS_TOTAL.value),
+            "aborts": int(_ABORTS_TOTAL.value),
+            "prefill_tokens":
+                int(_TOKENS_TOTAL.labels(phase="prefill").value),
+            "decode_tokens":
+                int(_TOKENS_TOTAL.labels(phase="decode").value),
+            "admission_p50_s": _ADMIT_SECONDS.quantile(0.5),
+            "admission_p99_s": _ADMIT_SECONDS.quantile(0.99),
+            "ttft_p50_s": _TTFT_SECONDS.quantile(0.5),
+            "ttft_p99_s": _TTFT_SECONDS.quantile(0.99),
+            "request_tok_per_s_p50": _REQ_TOK_RATE.quantile(0.5),
+            "executables": self._step_fn.cache_size(),
+        }
+
     def abort_all(self, exc):
         """Fail every live and queued request (device-error path),
         release all pages, and re-zero the pools — a step that died
@@ -356,6 +442,10 @@ class LLMEngine:
             if not req.future.done():
                 req.future.set_exception(exc)
         self._kv = self._fresh_pools()
+        _ABORTS_TOTAL.inc()
+        _QUEUE_DEPTH.set(0)
+        _LIVE_SLOTS.set(0)
+        _SLOT_OCC.set(0.0)
 
     # ---- scheduler ----
 
@@ -370,6 +460,11 @@ class LLMEngine:
     def _finish(self, slot, req):
         self._release(slot, req)
         self.stats["finished"] += 1
+        _FINISHED_TOTAL.inc()
+        if req.t_first_admit is not None and req.num_generated:
+            dt = _time.perf_counter() - req.t_first_admit
+            if dt > 0:
+                _REQ_TOK_RATE.observe(req.num_generated / dt)
         # a client may have cancel()ed while the request was in flight —
         # set_result would raise InvalidStateError and the server loop
         # would read that as a device error and abort EVERYONE
@@ -393,6 +488,7 @@ class LLMEngine:
         self._release(vslot, victim)
         victim.preemptions += 1
         self.stats["preemptions"] += 1
+        _PREEMPTIONS_TOTAL.inc()
         self.waiting.appendleft(victim)
         return True
 
@@ -407,6 +503,9 @@ class LLMEngine:
             req.slot = slot
             req.admit_seq = next(self._admit_counter)
             self._slots[slot] = req
+            if req.t_first_admit is None:
+                req.t_first_admit = _time.perf_counter()
+                _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
 
     def _active(self):
         """Running sequences in admission order (deterministic plan)."""
@@ -487,9 +586,11 @@ class LLMEngine:
                 i += 1
 
         try:
-            logits, self._kv = self._step_fn(
-                tok, pos, sid, widx, self._page_tables, klen, sample_idx,
-                self._kv)
+            with _trace_span("llm_engine.step", tokens=i,
+                             live=len(plan)):
+                logits, self._kv = self._step_fn(
+                    tok, pos, sid, widx, self._page_tables, klen,
+                    sample_idx, self._kv)
         except Exception as e:
             # the donated pools may already be consumed by the failed
             # dispatch — fail the in-flight work and re-zero so a
@@ -501,6 +602,15 @@ class LLMEngine:
         self.stats["steps"] += 1
         self.stats["tokens_in"] += i
         self.stats["occupancy_sum"] += len(plan) / self.num_slots
+        _STEPS_TOTAL.inc()
+        # the flat-budget split: one decode token per sampling frontier,
+        # everything else is (chunked or preemption-replay) prefill
+        _TOKENS_TOTAL.labels(phase="decode").inc(len(sample_slots))
+        _TOKENS_TOTAL.labels(phase="prefill").inc(i - len(sample_slots))
+        _QUEUE_DEPTH.set(len(self.waiting))
+        _LIVE_SLOTS.set(len(plan))
+        _SLOT_OCC.set(len(plan) / self.num_slots)
+        _PAGE_OCC.set(self.pool.num_live / (self.pool.num_pages - 1))
 
         nxt = []
         if sample_slots:
@@ -512,12 +622,16 @@ class LLMEngine:
 
         for slot, req, take in plan:
             req.n_prefilled += take
+        _PAGE_FRAG.set(self.kv_fragmentation())
         finished = []
+        now = _time.perf_counter()
         for slot, tok_id in zip(sample_slots, nxt):
             req = self._slots[slot]
             t = int(tok_id)
             req.tokens.append(t)
             self.stats["generated"] += 1
+            if req.num_generated == 1:      # replays don't re-count
+                _TTFT_SECONDS.observe(now - req.t_submit)
             if ((req.eos is not None and t == req.eos)
                     or len(req.tokens) >= req.target):
                 self._finish(slot, req)
@@ -538,10 +652,35 @@ class LLMServer(_FutureQueueServer):
         self._engine = LLMEngine(model, config)
         self.stats = self._engine.stats  # shared view + request counts
         self.stats.setdefault("requests", 0)
+        self._http = None
 
     @property
     def engine(self):
         return self._engine
+
+    def metrics(self):
+        """Engine telemetry snapshot (registry-sourced; see
+        LLMEngine.metrics). Thread-safe: reads only."""
+        return self._engine.metrics()
+
+    def start_metrics_http(self, port=0, host="127.0.0.1"):
+        """Optional stdlib-only pull endpoint: GET /metrics serves the
+        process registry in Prometheus text format, /metrics.json the
+        full snapshot with this engine's view under "extra". port=0
+        picks a free port; returns the handle (`.url`, `.port`).
+        Stopped automatically with the server."""
+        if self._http is None:
+            from ..observability import start_http_server
+
+            self._http = start_http_server(port=port, host=host,
+                                           extra_json=self.metrics)
+        return self._http
+
+    def stop(self):
+        super().stop()
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
 
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future

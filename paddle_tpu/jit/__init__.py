@@ -12,6 +12,7 @@ This is both the API-parity layer (`@to_static`) and the performance layer
 """
 import functools
 import inspect
+import time as _time
 
 import numpy as np
 
@@ -19,7 +20,31 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd import engine
+from ..observability import metrics as _obs
+from ..observability.tracing import trace_span as _trace_span
 from ..tensor_core import Parameter, Tensor
+
+# runtime telemetry (docs/OBSERVABILITY.md). Step time is dispatch-side
+# wall time — donated-buffer steps chain, so once the pipeline fills it
+# converges to true device step time (same reasoning as profiler's
+# _StepTimer). Loss/grad-norm are FULL-telemetry only: reading them
+# forces a device sync that would stall the async dispatch pipeline.
+_STEP_SECONDS = _obs.histogram(
+    "pt_train_step_seconds", "compiled train-step wall time")
+_STEPS_TOTAL = _obs.counter(
+    "pt_train_steps_total", "compiled train steps dispatched")
+_COMPILES_TOTAL = _obs.counter(
+    "pt_train_compiles_total",
+    "distinct TrainStep batch signatures seen — each is one XLA "
+    "compile; growth after warmup is recompile churn (the PR-2 "
+    "zero-recompile probe, as a counter)")
+_LOSS_GAUGE = _obs.gauge(
+    "pt_train_loss", "last loss (full telemetry only: syncs the device)")
+_GRAD_NORM = _obs.histogram(
+    "pt_train_grad_norm",
+    "global grad L2 norm per step (full telemetry only)",
+    buckets=(0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0,
+             100.0, 300.0, 1000.0))
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "InputSpec", "TrainStep", "ignore_module", "enable_to_static"]
@@ -430,6 +455,7 @@ class TrainStep:
         self._trainable = [not p.stop_gradient for p in self._param_objs]
         self._opt_states = None
         self._compiled = None
+        self._telemetry_full = False
         # shape-churn accounting (see __call__'s recompile guard)
         self._batch_signatures = set()
         self._sig_warned = False
@@ -444,6 +470,7 @@ class TrainStep:
     def _build(self):
         from ..core import rng as rng_mod
 
+        self._telemetry_full = _obs._STATE.mode >= _obs._STATE.FULL
         model = self.model
         loss_fn = self.loss_fn
         param_objs = self._param_objs
@@ -485,6 +512,13 @@ class TrainStep:
             pure_loss = jax.checkpoint(
                 pure_loss, policy=checkpoint_policy(self.remat))
 
+        # full telemetry folds the global grad L2 norm into the step
+        # program (free on-device; reading it costs one sync in
+        # __call__). Decided at BUILD time: the aux output changes the
+        # HLO, and flipping per-call would defeat the one-executable
+        # design.
+        telemetry_full = self._telemetry_full
+
         def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
                  step_idx, base_key):
             step_key = jax.random.fold_in(base_key, step_idx)
@@ -493,6 +527,11 @@ class TrainStep:
                 train_vals, frozen_vals, batch_vals, step_key)
             new_vals, new_states = opt.apply_gradients_tree(
                 train_vals, grads, opt_states, lr, param_objs=train_objs)
+            if telemetry_full:
+                gn = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+                return loss, new_vals, new_states, new_frozen, gn
             return loss, new_vals, new_states, new_frozen
 
         # donate param + optimizer-state + buffer arrays so XLA updates in
@@ -538,7 +577,9 @@ class TrainStep:
         # silently compile per unique length — warn once past the
         # threshold (reference LoD workloads, SURVEY hard part 3).
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
-        self._batch_signatures.add(sig)
+        if sig not in self._batch_signatures:
+            self._batch_signatures.add(sig)
+            _COMPILES_TOTAL.inc()
         if (len(self._batch_signatures) == self.max_batch_signatures + 1
                 and not self._sig_warned):
             self._sig_warned = True
@@ -553,14 +594,28 @@ class TrainStep:
                 RuntimeWarning, stacklevel=2)
         lr = self.optimizer.get_lr()
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
-        loss, new_vals, self._opt_states, new_frozen = self._compiled(
-            train_vals, frozen_vals, self._opt_states, lr, batch_vals,
-            step_idx, self._base_key)
+        t0 = _time.perf_counter()
+        with _trace_span("jit.TrainStep",
+                         step=int(self.optimizer._step_count)):
+            out = self._compiled(
+                train_vals, frozen_vals, self._opt_states, lr, batch_vals,
+                step_idx, self._base_key)
+        if self._telemetry_full:
+            loss, new_vals, self._opt_states, new_frozen, grad_norm = out
+        else:
+            loss, new_vals, self._opt_states, new_frozen = out
+            grad_norm = None
+        _STEP_SECONDS.observe(_time.perf_counter() - t0)
+        _STEPS_TOTAL.inc()
         it = iter(new_vals)
         it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):
             p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
+        if grad_norm is not None:
+            # full telemetry accepts the device sync these reads force
+            _LOSS_GAUGE.set(float(np.asarray(loss)))
+            _GRAD_NORM.observe(float(np.asarray(grad_norm)))
         from ..profiler import benchmark
 
         bm = benchmark()
@@ -569,6 +624,14 @@ class TrainStep:
                 getattr(batch_vals[0], "ndim", 0) else None
             bm.auto_step(num_samples=n)
         return Tensor(loss)
+
+    def compile_stats(self):
+        """Recompile probe (same shape as LLMEngine.compile_stats):
+        batch signatures seen + the jit dispatch-cache executable
+        count. Steady-state training holds both at 1."""
+        n = getattr(self._compiled, "_cache_size", None)
+        return {"batch_signatures": len(self._batch_signatures),
+                "executables": int(n()) if callable(n) else -1}
 
 
 class ProgramTranslator:

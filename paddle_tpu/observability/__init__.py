@@ -1,0 +1,218 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+ONE place to answer "what is this training/serving process doing right
+now, and why is it slow": a process-wide metrics registry
+(``metrics.py``) plus a chrome-trace span tracer (``tracing.py``),
+wired through the hot paths (``jit.TrainStep``, ``inference.LLMEngine``,
+``distributed.checkpoint``, ``distributed.xproc``, ``fleet.elastic``).
+docs/OBSERVABILITY.md has the metric-name catalogue and workflows.
+
+Modes (PT_TELEMETRY):
+
+    PT_TELEMETRY=0   off      every metric write / span is a no-op
+                              (single attribute check; overhead pinned)
+    (unset)          metrics  counters/gauges/histograms live; no spans,
+                              no export, compiled programs unchanged
+    PT_TELEMETRY=1   full     + span tracing, TrainStep loss/grad-norm
+                              observation, at-exit export of
+                              metrics.rank<r>.{prom,json} and
+                              trace.rank<r>.jsonl to PT_TELEMETRY_DIR
+                              (default ./telemetry), and a compact
+                              snapshot folded into the per-rank anomaly
+                              journal (telemetry_snapshot event) so
+                              chaos forensics and telemetry share one
+                              event stream (docs/RESILIENCE.md)
+
+``start_http_server(port)`` serves the registry at ``/metrics``
+(Prometheus text) and ``/metrics.json`` via a stdlib ThreadingHTTPServer
+— the optional pull endpoint ``inference.LLMServer`` exposes.
+"""
+import json
+import os
+import threading
+
+from . import metrics, tracing
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      counter, gauge, histogram, registry, snapshot,
+                      to_jsonl, to_prometheus, _STATE)
+from .tracing import chrome_events, flush, trace_span  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "counter", "gauge", "histogram", "registry", "snapshot",
+           "to_prometheus", "to_jsonl", "trace_span", "chrome_events",
+           "flush", "set_mode", "mode", "metrics_enabled", "full_enabled",
+           "export_all", "journal_snapshot", "bench_snapshot",
+           "start_http_server", "telemetry_dir"]
+
+_MODES = {"off": _STATE.OFF, "metrics": _STATE.METRICS,
+          "full": _STATE.FULL}
+_MODE_NAMES = {v: k for k, v in _MODES.items()}
+
+
+def mode():
+    """Current telemetry mode name: 'off' | 'metrics' | 'full'."""
+    return _MODE_NAMES[_STATE.mode]
+
+
+def set_mode(name):
+    """Switch telemetry mode at runtime ('off'|'metrics'|'full').
+    Returns the previous mode name. Note: compiled-program choices made
+    at build time (TrainStep grad-norm aux) follow the mode seen when
+    the step was built, not later flips."""
+    if name not in _MODES:
+        raise ValueError(f"mode must be one of {sorted(_MODES)}")
+    prev = mode()
+    _STATE.mode = _MODES[name]
+    if _STATE.mode == _STATE.FULL:
+        _install_atexit()
+    return prev
+
+
+def metrics_enabled():
+    return _STATE.mode >= _STATE.METRICS
+
+
+def full_enabled():
+    return _STATE.mode >= _STATE.FULL
+
+
+def telemetry_dir():
+    return os.environ.get("PT_TELEMETRY_DIR") or "./telemetry"
+
+
+def _rank():
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def journal_snapshot(note=None):
+    """Fold a compact registry snapshot into the per-rank anomaly
+    journal (resilience's ``anomalies.rank<r>.jsonl``) as ONE
+    ``telemetry_snapshot`` event — chaos runs and telemetry share that
+    event stream. Returns the journal entry."""
+    from ..distributed.resilience import record
+
+    compact = registry().compact()
+    fields = {"metrics": compact}
+    if note:
+        fields["note"] = note
+    return record("telemetry_snapshot", **fields)
+
+
+def bench_snapshot():
+    """The compact dict bench.py stamps into every BENCH arm: registry
+    dump (non-zero series only) so perf numbers come with attribution
+    (recompile counts, retry storms, preemptions, ...)."""
+    return registry().compact()
+
+
+def export_all(directory=None, journal=True):
+    """Write metrics.rank<r>.prom + metrics.rank<r>.json and flush the
+    span buffer to trace.rank<r>.jsonl under `directory` (default
+    PT_TELEMETRY_DIR). Best-effort; returns the directory."""
+    d = directory or telemetry_dir()
+    r = _rank()
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"metrics.rank{r}.prom"), "w") as f:
+            f.write(to_prometheus())
+        with open(os.path.join(d, f"metrics.rank{r}.json"), "w") as f:
+            json.dump(snapshot(), f, indent=1)
+    except OSError:
+        pass
+    tracing.flush(d)
+    if journal:
+        try:
+            journal_snapshot(note="export_all")
+        except Exception:
+            pass
+    return d
+
+
+_atexit_installed = False
+
+
+def _install_atexit():
+    global _atexit_installed
+    if _atexit_installed:
+        return
+    _atexit_installed = True
+    import atexit
+
+    # re-check the mode AT EXIT: a supervisor process (the pod
+    # launcher, bench.py's driver) drops itself to 'metrics' so it
+    # never overwrites its ranked children's export files
+    atexit.register(
+        lambda: export_all() if _STATE.mode >= _STATE.FULL else None)
+
+
+if _STATE.mode >= _STATE.FULL:
+    _install_atexit()
+
+
+# ----------------------------------------------------- HTTP /metrics pull
+
+class _HTTPHandle:
+    """Running /metrics endpoint. .port, .url; .stop() shuts it down."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self.host, self.port = server.server_address[:2]
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self):
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port=0, host="127.0.0.1", extra_json=None):
+    """Serve the global registry over stdlib HTTP:
+
+        GET /metrics       Prometheus text format
+        GET /metrics.json  registry snapshot (+ `extra_json()` merged
+                           under "extra" when provided)
+
+    port=0 picks a free port. Returns an _HTTPHandle (stop() to end).
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?")[0] == "/metrics":
+                body = to_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/metrics.json":
+                payload = {"metrics": snapshot()}
+                if extra_json is not None:
+                    try:
+                        payload["extra"] = extra_json()
+                    except Exception as e:
+                        payload["extra_error"] = repr(e)
+                body = json.dumps(payload).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):        # no stderr spam per scrape
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="pt-metrics-http", daemon=True)
+    thread.start()
+    return _HTTPHandle(server, thread)

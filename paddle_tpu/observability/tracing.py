@@ -1,0 +1,156 @@
+"""Span tracer — chrome://tracing-compatible host-side spans.
+
+``trace_span("name", key=val)`` is a context manager AND a decorator.
+In full-telemetry mode (``PT_TELEMETRY=1``) each span records one
+complete ("ph": "X") chrome trace event: wall-clock ``ts`` (µs since the
+unix epoch, so per-rank files from different processes align when
+merged), monotonic ``dur``, ``pid`` = trainer rank, ``tid`` = thread id.
+Below full mode entering a span is a single attribute check — the
+overhead test pins it.
+
+Composition with the xprof path: spans optionally ALSO enter the
+existing ``profiler.RecordEvent`` (a jax TraceAnnotation), so the same
+scopes show up on the device timeline when a ``jax.profiler`` capture is
+active. Gated by ``PT_TRACE_ANNOTATE=1`` because TraceAnnotation has a
+per-call cost even without an active capture.
+
+Export: events buffer in memory (bounded; drops counted) and flush to
+``<PT_TELEMETRY_DIR>/trace.rank<r>.jsonl`` — one JSON event per line.
+``tools/trace_merge.py`` merges per-rank files into one
+``trace.json`` the chrome://tracing / perfetto UI loads directly.
+"""
+import json
+import os
+import threading
+import time
+
+from .metrics import _STATE, counter
+
+__all__ = ["trace_span", "chrome_events", "flush", "reset",
+           "trace_path", "MAX_EVENTS"]
+
+MAX_EVENTS = int(os.environ.get("PT_TRACE_BUFFER", "200000"))
+
+_events = []
+_flush_lock = threading.Lock()
+_flushed_paths = set()      # paths this PROCESS already wrote (see flush)
+_dropped = counter("pt_trace_events_dropped_total",
+                   "span events dropped by the bounded trace buffer")
+
+
+def _rank():
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def _annotate_enabled():
+    return os.environ.get("PT_TRACE_ANNOTATE", "0") == "1"
+
+
+class _Span:
+    """One span use. Context manager (enter/exit records an event) and
+    decorator (wraps fn; a fresh span per call)."""
+
+    __slots__ = ("name", "args", "_t0", "_wall0", "_ann")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        if _STATE.mode < 2:
+            return self
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        if _annotate_enabled():
+            try:
+                from ..profiler import RecordEvent
+
+                self._ann = RecordEvent(self.name)
+                self._ann.begin()
+            except Exception:
+                self._ann = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._ann is not None:
+            self._ann.end()
+            self._ann = None
+        if self._t0 is None:
+            return False
+        dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        self._t0 = None
+        if len(_events) >= MAX_EVENTS:
+            _dropped.inc()
+            return False
+        ev = {"name": self.name, "ph": "X",
+              "ts": int(self._wall0 * 1e6), "dur": dur_us,
+              "pid": _rank(), "tid": threading.get_ident()}
+        if self.args:
+            # COPY: decorator usage shares one args dict across calls —
+            # the error annotation below must not poison other events
+            ev["args"] = dict(self.args)
+        if exc_type is not None:
+            ev.setdefault("args", {})["error"] = exc_type.__name__
+        _events.append(ev)          # list.append is atomic under the GIL
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        name, args = self.name, self.args
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with _Span(name, args):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+def trace_span(name, **args):
+    """Span factory: ``with trace_span("x", k=v): ...`` or
+    ``@trace_span("x")``. No-op (one mode check) below full telemetry."""
+    return _Span(name, args)
+
+
+def chrome_events():
+    """Copy of the buffered chrome trace events (oldest first)."""
+    return list(_events)
+
+
+def trace_path(directory=None):
+    d = directory or os.environ.get("PT_TELEMETRY_DIR") or "./telemetry"
+    return os.path.join(d, f"trace.rank{_rank()}.jsonl")
+
+
+def flush(directory=None):
+    """Append buffered events to the per-rank trace JSONL and clear the
+    buffer. Best-effort (exporting must never take the run down).
+    Returns the path, or None when there was nothing to write."""
+    with _flush_lock:
+        if not _events:
+            return None
+        batch = _events[:]
+        del _events[:len(batch)]
+        path = trace_path(directory)
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # first flush of THIS process truncates: successive runs
+            # sharing PT_TELEMETRY_DIR must not concatenate into one
+            # file, or trace_merge would fold distinct runs (hours
+            # apart) onto a single rebased timeline
+            fresh = path not in _flushed_paths
+            _flushed_paths.add(path)
+            with open(path, "w" if fresh else "a") as f:
+                for ev in batch:
+                    f.write(json.dumps(ev) + "\n")
+        except OSError:
+            return None
+        return path
+
+
+def reset():
+    """Test hook: drop all buffered events."""
+    del _events[:]

@@ -28,8 +28,21 @@ import time
 
 import jax
 
+from ..observability import metrics as _obs
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
            "export_chrome_tracing", "RecordEvent", "benchmark"]
+
+# the step timer mirrors every tick into the shared telemetry registry,
+# so hapi Model.fit (ProgBarLogger), raw loops around TrainStep, and a
+# /metrics scrape all report THE SAME reader-cost/batch-cost/ips numbers
+# (docs/OBSERVABILITY.md)
+_BATCH_COST = _obs.histogram("pt_step_batch_cost_seconds",
+                             "per-step wall time (armed step timer)")
+_READER_COST = _obs.histogram("pt_step_reader_cost_seconds",
+                              "dataloader fetch time per batch")
+_SAMPLES_TOTAL = _obs.counter("pt_step_samples_total",
+                              "samples consumed by timed steps")
 
 
 class ProfilerState:
@@ -122,6 +135,7 @@ class _StepTimer:
         self.reader_costs = []
         self.samples = 0
         self._t_last = None
+        self.auto_fed = False   # True once an instrumented step ticked
 
     def enable(self):
         self.enabled = True
@@ -130,13 +144,19 @@ class _StepTimer:
     def disable(self):
         self.enabled = False
 
-    def auto_step(self, num_samples=None):
+    def auto_step(self, num_samples=None, auto=True):
         """Tick from an instrumented step (TrainStep). Steps chain
         through donated buffers, so wall deltas converge to true step
-        time once the dispatch pipeline fills."""
+        time once the dispatch pipeline fills. auto=False ticks without
+        claiming the auto-fed flag — for a HOST-side driver (hapi's
+        ProgBarLogger on an eager loop) that must stand down the moment
+        a compiled step starts feeding the meter itself."""
+        if auto:
+            self.auto_fed = True
         self.step()
         if num_samples:
             self.samples += int(num_samples)
+            _SAMPLES_TOTAL.inc(int(num_samples))
 
     def summary(self):
         s = self.stats()
@@ -153,14 +173,17 @@ class _StepTimer:
         self._t_reader = time.perf_counter()
 
     def after_reader(self):
-        self.reader_costs.append(
-            time.perf_counter() - getattr(self, "_t_reader",
-                                          time.perf_counter()))
+        dt = time.perf_counter() - getattr(self, "_t_reader",
+                                           time.perf_counter())
+        self.reader_costs.append(dt)
+        _READER_COST.observe(dt)
 
     def step(self):
         now = time.perf_counter()
         if self._t_last is not None:
-            self.step_times.append(now - self._t_last)
+            dt = now - self._t_last
+            self.step_times.append(dt)
+            _BATCH_COST.observe(dt)
         self._t_last = now
 
     def stats(self, batch_size=None):

@@ -1,0 +1,69 @@
+"""Worker for the 2-proc telemetry acceptance test
+(test_observability.py::test_two_proc_telemetry_export).
+
+Each rank runs under PT_TELEMETRY=1 (full mode) with an optional chaos
+plan active: a few compiled TrainSteps, a checkpoint save+load, and
+xproc collectives + a p2p ring exchange — then exports its telemetry
+(metrics.rank<r>.{prom,json} + trace.rank<r>.jsonl) so the test can
+assert the snapshots parse and the MERGED chrome trace covers
+TrainStep/engine/checkpoint/xproc spans.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, observability as obs  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.checkpoint import Checkpointer  # noqa: E402
+
+STEPS = 3
+
+
+def main():
+    out_dir = sys.argv[1]
+    os.environ.setdefault("PT_TELEMETRY_DIR",
+                          os.path.join(out_dir, "telemetry"))
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(
+        m, lambda mm, x, y: nn.functional.cross_entropy(mm(x), y), opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (8,)))
+
+    losses = []
+    for i in range(STEPS):
+        losses.append(float(step(x, y).numpy()))
+        # collectives + ring p2p drag xproc (and any chaos injectors)
+        # onto the traced path every step
+        xproc.all_reduce_np(np.asarray([losses[-1]], np.float32))
+        world = dist.get_world_size()
+        xproc.send_bytes(json.dumps(losses[-1]).encode(),
+                         (rank + 1) % world, tag=11)
+        xproc.recv_bytes((rank - 1) % world, tag=11)
+
+    ckpt = Checkpointer(os.path.join(out_dir, "ckpt"), model=m,
+                        train_step=step)
+    ckpt.save(STEPS)
+    assert ckpt.load_latest() == STEPS
+    xproc.barrier()
+
+    d = obs.export_all()            # metrics + trace + journal fold
+    with open(os.path.join(out_dir, f"telemetry_out_{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "losses": losses, "telemetry_dir": d,
+                   "mode": obs.mode()}, f)
+
+
+if __name__ == "__main__":
+    main()

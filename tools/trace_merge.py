@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Merge per-rank span files into ONE chrome://tracing trace.
+
+The span tracer (paddle_tpu/observability/tracing.py) writes one JSONL
+event stream per rank — ``trace.rank<r>.jsonl`` under PT_TELEMETRY_DIR —
+with wall-clock microsecond timestamps, so streams from different
+processes (even different hosts with sane NTP) align on one timeline.
+This tool folds them into the chrome trace-event JSON the
+chrome://tracing and https://ui.perfetto.dev viewers load directly:
+
+    python tools/trace_merge.py ./telemetry -o trace.json
+    python tools/trace_merge.py run1/trace.rank0.jsonl run2/*.jsonl
+
+Each rank becomes one "process" lane (pid = rank, named via metadata
+events); threads keep their tids. Timestamps are re-based to the
+earliest event so the viewer opens at t=0. Malformed lines are counted
+and skipped (a crashed rank's torn last line must not hide the rest of
+the run). Stdlib only.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _rank_of(path):
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def collect(paths):
+    """Read events from trace JSONL files. Returns (events, n_bad)."""
+    events, bad = [], 0
+    for path in paths:
+        rank = _rank_of(path)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if "ts" not in ev or "name" not in ev:
+                    bad += 1
+                    continue
+                ev.setdefault("ph", "X")
+                ev.setdefault("pid", rank)
+                ev.setdefault("tid", 0)
+                events.append(ev)
+    return events, bad
+
+
+def merge(paths):
+    """chrome trace dict from per-rank JSONL paths."""
+    events, bad = collect(paths)
+    if events:
+        t0 = min(e["ts"] for e in events)
+        for e in events:
+            e["ts"] -= t0
+    events.sort(key=lambda e: (e["ts"], e.get("dur", 0)))
+    pids = sorted({e["pid"] for e in events})
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"rank {pid}"}} for pid in pids]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"skipped_lines": bad,
+                          "source_files": [os.path.basename(p)
+                                           for p in paths]}}
+
+
+def expand(inputs):
+    """Args → concrete trace files (a dir means its trace*.jsonl)."""
+    paths = []
+    for item in inputs:
+        if os.path.isdir(item):
+            paths += sorted(glob.glob(os.path.join(item,
+                                                   "trace*.jsonl")))
+        else:
+            paths += sorted(glob.glob(item)) or [item]
+    # dedupe, keep order
+    seen, out = set(), []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+",
+                    help="telemetry dir(s) or trace*.jsonl file(s)")
+    ap.add_argument("-o", "--output", default="trace.json",
+                    help="merged chrome trace path (default trace.json)")
+    args = ap.parse_args(argv)
+    paths = expand(args.inputs)
+    if not paths:
+        print("no trace files found", file=sys.stderr)
+        return 1
+    trace = merge(paths)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    n = len(trace["traceEvents"])
+    print(f"{args.output}: {n} events from {len(paths)} file(s); "
+          f"open in chrome://tracing or ui.perfetto.dev",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
