@@ -680,7 +680,10 @@ def send(tensor, dst=0, group=None, sync_op=True):
             "inside an SPMD program p2p is a compiled collective: use "
             "paddle_tpu.distributed.p2p_shift")
     t = ensure_tensor(tensor)
-    xproc.send_np(np.asarray(value_of(t)), int(dst))
+    # the public paddle API contract is bit-exact delivery (callers ship
+    # parameters/master copies through here); the PT_QUANT_ALLREDUCE
+    # int8 wire stays an xproc.send_np-level opt-in
+    xproc.send_np(np.asarray(value_of(t)), int(dst), quantize=False)
     return _P2PTask()
 
 

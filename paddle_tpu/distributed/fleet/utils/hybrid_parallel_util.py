@@ -57,10 +57,29 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
 
     from ....tensor_core import Tensor
 
+    # bf16 master-copy guard: under the PT_QUANT_ALLREDUCE int8 wire
+    # the codec only understands fp32/fp64 — a bf16/f16 grad group is
+    # upcast to fp32 for the wire and the REDUCED result handed back in
+    # fp32 (the tape already accumulates f32 grads for low-precision
+    # params). Only p.grad is ever rewritten: the params themselves —
+    # the bf16 master copies — and the optimizer's fp32 moments never
+    # touch the quantized path.
+    def _quant_wire_on():
+        try:
+            from ....quantization import runtime as qrt
+
+            return qrt.quant_allreduce_enabled()
+        except Exception:
+            return False
+
+    upcast_low_precision = _quant_wire_on()
     by_dtype = {}
     for p in params:
         g = np.asarray(p.grad._value if hasattr(p.grad, "_value")
                        else p.grad.numpy())
+        if upcast_low_precision and g.dtype.itemsize < 4 and \
+                jnp.issubdtype(g.dtype, jnp.floating):
+            g = g.astype(np.float32)
         by_dtype.setdefault(g.dtype.str, []).append((p, g))
     for _, group in sorted(by_dtype.items()):
         flat = np.concatenate([g.reshape(-1) for _, g in group])

@@ -300,7 +300,10 @@ class ShardedGraphTable:
                 continue
             want = xproc.recv_np(r, self._TAG_REQ,
                                  timeout_ms=self.timeout_ms)
-            xproc.send_np(np.asarray(serve(want)), r, self._TAG_RES)
+            # graph lookups are exact queries, not gradients — never ride
+            # the PT_QUANT_ALLREDUCE int8 wire frame
+            xproc.send_np(np.asarray(serve(want)), r, self._TAG_RES,
+                          quantize=False)
         parts = {self.rank: mine}
         for r in range(self.world):
             if r == self.rank:

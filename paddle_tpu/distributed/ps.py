@@ -681,7 +681,11 @@ class ShardedSparseTable:
                 with local_lock:
                     served = (self.local.pull(want) if len(want)
                               else np.zeros((0, self.dim), np.float32))
-                xproc.send_np(served, r, self._TAG_PULL_ROWS)
+                # parameter rows must arrive bit-exact — the int8 wire
+                # opt-in (PT_QUANT_ALLREDUCE) is for gradient-like
+                # payloads, never the master copies being served
+                xproc.send_np(served, r, self._TAG_PULL_ROWS,
+                              quantize=False)
 
             def _recv(r):
                 return xproc.recv_np(r, self._TAG_PULL_ROWS,

@@ -94,7 +94,12 @@ _kv_coll = {"fallback": False, "gen": 0,
             "ag_done": -1, "bcast_pending": []}
 
 
-def _kv_allgather_np(nparr):
+def _kv_allgather_raw(payload: bytes, decode):
+    """Generation-ordered KV all-gather of one byte payload per rank;
+    `decode(raw) -> np.ndarray` turns a blob back into an array. The
+    local rank decodes its OWN payload too — under the int8 wire codec
+    every rank must reduce the identical dequantized matrix, or eager-DP
+    replicas drift apart one quantization error per step."""
     import base64
 
     me = jax.process_index()
@@ -106,15 +111,12 @@ def _kv_allgather_np(nparr):
     # incarnation's undeleted leftovers
     epoch = _os.environ.get("PADDLE_POD_ATTEMPT", "0")
     pfx = f"pt_coll/{epoch}/{gen}"
-    _kv_set(f"{pfx}/{me}",
-            base64.b64encode(nparr.tobytes()).decode("ascii"))
+    _kv_set(f"{pfx}/{me}", base64.b64encode(payload).decode("ascii"))
     parts = []
     for r in range(jax.process_count()):
-        if r == me:
-            parts.append(nparr)
-            continue
-        raw = base64.b64decode(_kv_get(f"{pfx}/{r}", 600_000))
-        parts.append(np.frombuffer(raw, nparr.dtype).reshape(nparr.shape))
+        raw = payload if r == me else base64.b64decode(
+            _kv_get(f"{pfx}/{r}", 600_000))
+        parts.append(decode(raw))
     # hygiene: a rank reaching `gen` has consumed generation gen-2 on
     # every peer (each read those keys before publishing its gen-1
     # entry), so deleting our own old key can strand nobody
@@ -125,7 +127,14 @@ def _kv_allgather_np(nparr):
         except Exception:
             pass
     _kv_coll["ag_done"] = gen
-    return np.stack(parts)
+    return parts
+
+
+def _kv_allgather_np(nparr):
+    return np.stack(_kv_allgather_raw(
+        nparr.tobytes(),
+        lambda raw: np.frombuffer(raw, nparr.dtype).reshape(
+            nparr.shape)))
 
 
 def _kv_broadcast_np(nparr, src):
@@ -159,6 +168,33 @@ def _kv_broadcast_np(nparr, src):
     return nparr
 
 
+def _quant_runtime():
+    """quantization.runtime, resolved lazily (import cycles: xproc loads
+    during distributed/__init__, long before quantization)."""
+    try:
+        from ..quantization import runtime
+
+        return runtime
+    except Exception:
+        return None
+
+
+def _maybe_quant_encode(nparr, op):
+    """Opt-in (PT_QUANT_ALLREDUCE=1) int8-with-scale wire codec for the
+    KV-fallback all-reduce. Only sum/avg ride it — max/min/prod on
+    quantized values would change the SELECTED element, not just its
+    precision. Returns (payload, decode) or None (exact path)."""
+    if op not in ("sum", "avg"):
+        return None
+    qrt = _quant_runtime()
+    if (qrt is None or not qrt.quant_allreduce_enabled()
+            or not qrt.wire_eligible(nparr)):
+        return None
+    payload = qrt.encode_int8_wire(nparr)
+    _QUANT_SAVED.inc(max(0, nparr.nbytes - len(payload)))
+    return payload, qrt.decode_int8_wire
+
+
 _NP_REDUCERS = {"sum": lambda m: m.sum(axis=0),
                 "avg": lambda m: m.mean(axis=0),
                 "max": lambda m: m.max(axis=0),
@@ -189,6 +225,13 @@ def _collective_np(kind, nparr, op="sum", src=0):
                 record("kv_collective_fallback", error=repr(e))
         if kind == "broadcast":
             return _kv_broadcast_np(nparr, src)
+        if kind == "all_reduce":
+            enc = _maybe_quant_encode(nparr, op)
+            if enc is not None:
+                payload, decode = enc
+                mat = np.stack(_kv_allgather_raw(payload, decode))
+                return _NP_REDUCERS[op](mat).astype(nparr.dtype,
+                                                    copy=False)
         mat = _kv_allgather_np(nparr)
         if kind == "all_gather":
             return mat
@@ -294,6 +337,11 @@ _KV_FALLBACK = _obs.gauge(
     "pt_xproc_kv_collective_fallback",
     "1 once collectives ride the coordination KV (backend without "
     "multi-process collectives)")
+_QUANT_SAVED = _obs.counter(
+    "pt_quant_allreduce_bytes_saved",
+    "wire bytes saved by the opt-in int8-with-scale codec "
+    "(PT_QUANT_ALLREDUCE=1): raw float bytes minus encoded bytes, "
+    "counted at the publishing rank, all-reduce fallback + p2p")
 
 
 class _DeprecatedStats(_MutableMapping):
@@ -677,19 +725,44 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
     return base64.b64decode(val)
 
 
-def send_np(arr, dst: int, tag: int = 0, timeout_ms: int = 600_000):
+# must match quantization.runtime.WIRE_MAGIC (pinned by test) — checked
+# here by prefix so recv never imports the codec for exact frames. No
+# collision with np.save frames (those start with b"\x93NUMPY").
+_QUANT_WIRE_MAGIC = b"PTQ8"
+
+
+def send_np(arr, dst: int, tag: int = 0, timeout_ms: int = 600_000,
+            quantize=None):
+    """Send one array. quantize=None auto-selects the int8-with-scale
+    wire frame for float payloads when PT_QUANT_ALLREDUCE=1 (the socket
+    half of the quantized-collectives opt-in); pass quantize=False on
+    payloads that must stay bit-exact (parameter/row serving — the PS
+    pull path does)."""
+    arr = np.ascontiguousarray(arr)
+    if quantize is None:
+        qrt = _quant_runtime()
+        quantize = (qrt is not None and qrt.quant_allreduce_enabled()
+                    and qrt.wire_eligible(arr))
+    if quantize:
+        qrt = _quant_runtime()
+        payload = qrt.encode_int8_wire(arr)
+        _QUANT_SAVED.inc(max(0, arr.nbytes - len(payload)))
+        send_bytes(payload, dst, tag, timeout_ms)
+        return
     import io
 
     buf = io.BytesIO()
-    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    np.save(buf, arr, allow_pickle=False)
     send_bytes(buf.getvalue(), dst, tag, timeout_ms)
 
 
 def recv_np(src: int, tag: int = 0, timeout_ms: int = 600_000):
     import io
 
-    return np.load(io.BytesIO(recv_bytes(src, tag, timeout_ms)),
-                   allow_pickle=False)
+    raw = recv_bytes(src, tag, timeout_ms)
+    if raw[:4] == _QUANT_WIRE_MAGIC:  # self-describing quantized frame
+        return _quant_runtime().decode_int8_wire(raw)
+    return np.load(io.BytesIO(raw), allow_pickle=False)
 
 
 __all__ += ["send_bytes", "recv_bytes", "send_np", "recv_np"]

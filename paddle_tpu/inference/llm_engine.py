@@ -14,7 +14,12 @@ as its analysis_predictor/serving stack):
   step it finishes, so HBM scales with LIVE TOKENS instead of
   batch × max_seq_len (padding-waste model: docs/PERF_NOTES.md
   "Serving"). Physical page 0 is a reserved trash page: padding-token
-  writes land there and are never attended.
+  writes land there and are never attended. The pool dtype is
+  configurable (`kv_dtype` / PT_KV_DTYPE): "int8" runs the QUANTIZED
+  pool — each written row carries a per-(token, head) fp32 scale in
+  page-shaped scale planes, attention dequantizes on gather, and page
+  bytes drop ~4× vs fp32 (~2× vs bf16), which is more live sequences
+  per HBM byte (quantization runtime, docs/QUANTIZATION.md).
 
 * **Continuous scheduler** — every step admits queued prompts into free
   decode slots, chunks their prefill into the running batch (a FLAT
@@ -100,6 +105,11 @@ _REQ_TOK_RATE = _obs.histogram(
     "per-request generated tok/s (admission -> finish)",
     buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000,
              10000))
+_KV_POOL_BYTES = _obs.gauge(
+    "pt_kv_pool_bytes",
+    "resident KV page-pool bytes (pools + int8 scale planes), by the "
+    "pool dtype (quantized runtime: docs/QUANTIZATION.md)",
+    labelnames=("dtype",))
 
 
 class PoolExhausted(RuntimeError):
@@ -168,19 +178,50 @@ class LLMEngineConfig:
     token_budget  flat tokens per step (>= num_slots); the surplus over
                   the decode tokens is the chunked-prefill bandwidth.
                   Default num_slots + max(num_slots, 8).
+    kv_dtype      pool dtype: "float32" | "bfloat16" | "int8" (the
+                  quantized runtime — int8 pools carry per-row scale
+                  planes and dequantize on gather). Default: the
+                  PT_KV_DTYPE env var, else the model compute dtype.
     """
 
     def __init__(self, num_slots=4, page_size=16, num_pages=None,
-                 max_model_len=None, token_budget=None):
+                 max_model_len=None, token_budget=None, kv_dtype=None):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.token_budget = token_budget
+        self.kv_dtype = kv_dtype
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+
+    @staticmethod
+    def kv_bytes_per_page(model_config, page_size, kv_dtype=None):
+        """Bytes ONE page costs across every layer's k+v pool, scale
+        planes included — the unit of the capacity math below."""
+        from ..quantization import runtime as _qrt
+
+        dt, quantized = _qrt.resolve_kv_dtype(kv_dtype, jnp.float32)
+        nh = model_config.num_heads
+        hd = model_config.hidden_size // nh
+        per_row = nh * hd * jnp.dtype(dt).itemsize
+        if quantized:
+            per_row += nh * 4  # fp32 scale per (row, head)
+        return 2 * model_config.num_layers * page_size * per_row
+
+    @classmethod
+    def for_pool_budget(cls, model_config, budget_bytes, page_size=16,
+                        kv_dtype=None, **kw):
+        """Size `num_pages` to a page-pool BYTE budget — the equal-bytes
+        capacity comparison the quantized-KV acceptance pins (int8 pools
+        admit ~4× the pages of fp32 at the same budget)."""
+        per_page = cls.kv_bytes_per_page(model_config, page_size,
+                                         kv_dtype)
+        num_pages = max(2, int(budget_bytes) // per_page + 1)  # + trash
+        return cls(page_size=page_size, num_pages=num_pages,
+                   kv_dtype=kv_dtype, **kw)
 
 
 class _CompiledPagedStep:
@@ -196,13 +237,17 @@ class _CompiledPagedStep:
         self._params = list(model.state_dict().values())
 
         def pure(param_vals, tok, pos, sid, widx, pt, klen, smp,
-                 kv_vals):
+                 kv_state):
             from ..autograd import engine as eng
             from ..tensor_core import Tensor
 
             def t(v):
                 return Tensor(v, stop_gradient=True)
 
+            # kv_state = (pools, scale planes) — scales empty for float
+            # pools; ONE donated pytree so int8 pools and their scales
+            # update in place together
+            kv_vals, kv_scales = kv_state
             originals = [p._value for p in self._params]
             for p, v in zip(self._params, param_vals):
                 p._value = v
@@ -210,19 +255,24 @@ class _CompiledPagedStep:
                 with eng.no_grad_guard():
                     out = model._paged_decode_core(
                         t(tok), t(pos), t(sid), t(widx), t(pt), t(klen),
-                        t(smp), [t(v) for v in kv_vals])
+                        t(smp), [t(v) for v in kv_vals],
+                        kv_scales=(
+                            [t(s) for s in kv_scales] if kv_scales
+                            else None))
             finally:
                 for p, v in zip(self._params, originals):
                     p._value = v
             logits, *new_kv = out
-            return logits._value, [x._value for x in new_kv]
+            n = len(kv_vals)
+            return logits._value, ([x._value for x in new_kv[:n]],
+                                   [x._value for x in new_kv[n:]])
 
         self._jit = jax.jit(pure, donate_argnums=(8,))
         self._warm = False
 
-    def __call__(self, tok, pos, sid, widx, pt, klen, smp, kv_vals):
+    def __call__(self, tok, pos, sid, widx, pt, klen, smp, kv_state):
         args = ([p._value for p in self._params], tok, pos, sid, widx,
-                pt, klen, smp, kv_vals)
+                pt, klen, smp, kv_state)
         if self._warm:
             return self._jit(*args)
         # FIRST call compiles OUTSIDE the persistent cache: a
@@ -311,28 +361,45 @@ class LLMEngine:
 
         nh = mcfg.num_heads
         hd = mcfg.hidden_size // nh
-        # pool in the model's compute dtype (decode is HBM-bound; same
-        # reasoning as generate()'s cache dtype). The zero pools are
-        # COMMITTED with the same replicated NamedSharding the step
-        # executable's outputs carry (the TP layers' sharding
-        # constraints stamp the global mesh on every output) — a
-        # placement mismatch between step 0's pools and every later
-        # step's would cost a second dispatch-cache entry (the
-        # zero-recompile probe would read 2 executables, not 1)
+        # pool in the configured kv_dtype (default: the model's compute
+        # dtype — decode is HBM-bound, same reasoning as generate()'s
+        # cache dtype; "int8" quantizes each written row per (token,
+        # head) with fp32 scale planes alongside — quantization runtime,
+        # docs/QUANTIZATION.md). The zero pools are COMMITTED with the
+        # same replicated NamedSharding the step executable's outputs
+        # carry (the TP layers' sharding constraints stamp the global
+        # mesh on every output) — a placement mismatch between step 0's
+        # pools and every later step's would cost a second
+        # dispatch-cache entry (the zero-recompile probe would read 2
+        # executables, not 1)
         from ..distributed import mesh as mesh_mod
+        from ..quantization import runtime as _qrt
 
-        cache_dt = model.gpt.wte.weight._value.dtype
+        compute_dt = model.gpt.wte.weight._value.dtype
+        cache_dt, self.kv_quantized = _qrt.resolve_kv_dtype(
+            cfg.kv_dtype, compute_dt)
+        self.kv_dtype = str(jnp.dtype(cache_dt))
         sharding = mesh_mod.named_sharding()  # replicated on the mesh
 
         def _fresh_pools():
-            return [
+            pools = [
                 jax.device_put(
                     jnp.zeros((num_pages, self.page_size, nh, hd),
                               cache_dt), sharding)
                 for _ in range(2 * mcfg.num_layers)]
+            scales = []
+            if self.kv_quantized:
+                sshape = _qrt.kv_scale_shape(num_pages, self.page_size,
+                                             nh)
+                scales = [
+                    jax.device_put(jnp.zeros(sshape, jnp.float32),
+                                   sharding)
+                    for _ in range(2 * mcfg.num_layers)]
+            return pools, scales
 
         self._fresh_pools = _fresh_pools
-        self._kv = _fresh_pools()
+        self._kv, self._kv_scales = _fresh_pools()
+        _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(self.pool_bytes())
         self._page_tables = np.zeros(
             (self.num_slots, self.pages_per_seq), np.int32)
         self._slots = [None] * self.num_slots
@@ -385,6 +452,12 @@ class LLMEngine:
         asserts on."""
         return {"executables": self._step_fn.cache_size()}
 
+    def pool_bytes(self):
+        """Resident KV pool bytes across layers — int8 scale planes
+        included (they are part of the cache's true footprint)."""
+        return int(sum(int(a.nbytes) for a in self._kv)
+                   + sum(int(s.nbytes) for s in self._kv_scales))
+
     def kv_fragmentation(self):
         """Internal fragmentation of the live KV pages: 1 − written
         tokens / (live pages × page_size). High values mean many
@@ -405,6 +478,8 @@ class LLMEngine:
             "queue_depth": len(self.waiting),
             "live_slots": live,
             "num_slots": self.num_slots,
+            "kv_dtype": self.kv_dtype,
+            "kv_pool_bytes": self.pool_bytes(),
             "slot_occupancy": live / self.num_slots,
             "mean_slot_occupancy": self.mean_occupancy,
             "kv_page_occupancy":
@@ -441,7 +516,7 @@ class LLMEngine:
             req = self.waiting.popleft()
             if not req.future.done():
                 req.future.set_exception(exc)
-        self._kv = self._fresh_pools()
+        self._kv, self._kv_scales = self._fresh_pools()
         _ABORTS_TOTAL.inc()
         _QUEUE_DEPTH.set(0)
         _LIVE_SLOTS.set(0)
@@ -588,9 +663,9 @@ class LLMEngine:
         try:
             with _trace_span("llm_engine.step", tokens=i,
                              live=len(plan)):
-                logits, self._kv = self._step_fn(
+                logits, (self._kv, self._kv_scales) = self._step_fn(
                     tok, pos, sid, widx, self._page_tables, klen,
-                    sample_idx, self._kv)
+                    sample_idx, (self._kv, self._kv_scales))
         except Exception as e:
             # the donated pools may already be consumed by the failed
             # dispatch — fail the in-flight work and re-zero so a

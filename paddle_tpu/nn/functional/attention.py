@@ -122,7 +122,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
-                    name=None):
+                    k_scales=None, v_scales=None, name=None):
     """Ragged paged attention over a paged KV-cache pool — the serving
     decode path (inference/llm_engine.py; PAPERS.md "Ragged Paged
     Attention"). One query per FLAT scheduled token, so a single call
@@ -140,6 +140,11 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
     kv_lens      [T] int — valid kv length for each token (its position
                  + 1, i.e. the token attends to its own k/v and every
                  earlier one); 0 marks a padding token → zero output
+    k_scales/v_scales  [num_pages, page_size, heads] fp32 — the
+                 per-row dequant scales of an INT8 pool (quantization
+                 runtime, kv_dtype="int8"): gathered rows are
+                 dequantized `int8 * scale` before attention
+                 (dequant-on-gather). None for float pools.
 
     jnp reference semantics everywhere (mirrors the dense decode path in
     text/models/gpt.py `_cached_attention` op for op, so engine greedy
@@ -153,18 +158,24 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
     pt = ensure_tensor(page_tables)
     sid = ensure_tensor(slot_ids)
     lens = ensure_tensor(kv_lens)
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
+    scales = () if k_scales is None else (
+        ensure_tensor(k_scales), ensure_tensor(v_scales))
 
     if _paged_pallas_eligible(q, kp):
         from ...ops.pallas_kernels import paged_attention as pa_kernel
 
-        def jfn_pallas(qv, kpool, vpool, tables, sids, ls):
+        def jfn_pallas(qv, kpool, vpool, tables, sids, ls, *sc):
             return pa_kernel.ragged_paged_attention(
-                qv, kpool, vpool, tables, sids, ls)
+                qv, kpool, vpool, tables, sids, ls,
+                k_scales=sc[0] if sc else None,
+                v_scales=sc[1] if sc else None)
 
         return apply_jfn("paged_attention", jfn_pallas, q, kp, vp, pt,
-                         sid, lens)
+                         sid, lens, *scales)
 
-    def jfn(qv, kpool, vpool, tables, sids, ls):
+    def jfn(qv, kpool, vpool, tables, sids, ls, *sc):
         import jax
 
         n_pages, page_size, h, d = kpool.shape
@@ -186,6 +197,11 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         v_all = vpool.reshape(n_pages * page_size, h, d)
         ks = k_all[phys]                            # [S, L, h, d]
         vs = v_all[phys]
+        if sc:  # int8 pool: dequant-on-gather by the per-row scales
+            ksc = sc[0].reshape(n_pages * page_size, h)[phys]  # [S,L,h]
+            vsc = sc[1].reshape(n_pages * page_size, h)[phys]
+            ks = ks.astype(jnp.float32) * ksc[..., None]
+            vs = vs.astype(jnp.float32) * vsc[..., None]
         # chunk position of each token within its slot (order-stable):
         # cpos[t] = #earlier tokens with the same slot — collision-free
         # grid coordinates whatever order the scheduler packed
@@ -212,7 +228,8 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         return jnp.where((ls > 0)[:, None, None], out,
                          jnp.zeros_like(out))
 
-    return apply_jfn("paged_attention", jfn, q, kp, vp, pt, sid, lens)
+    return apply_jfn("paged_attention", jfn, q, kp, vp, pt, sid, lens,
+                     *scales)
 
 
 def _pallas_backend_ok():

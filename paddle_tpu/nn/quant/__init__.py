@@ -6,6 +6,11 @@ from ...quantization import (  # noqa: F401
     PostTrainingQuantization,
     QuantizedLinear,
 )
+from ...quantization.runtime import (  # noqa: F401
+    Int8WeightOnlyLinear,
+    quantize_model_int8,
+)
 
 __all__ = ["ImperativeQuantAware", "PostTrainingQuantization",
-           "QuantizedLinear"]
+           "QuantizedLinear", "Int8WeightOnlyLinear",
+           "quantize_model_int8"]

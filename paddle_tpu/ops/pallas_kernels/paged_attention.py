@@ -36,9 +36,14 @@ __all__ = ["ragged_paged_attention"]
 NEG_INF = -1e30
 
 
-def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                acc_ref, m_ref, l_ref, *, page_size, pages_per_seq,
-                scale):
+def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                page_size, pages_per_seq, scale, quantized):
+    if quantized:
+        # int8 pools ride with per-row fp32 scale planes, gathered
+        # through the SAME page_map (quantization runtime, PT_KV_DTYPE)
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     t = pl.program_id(0)
     j = pl.program_id(1)
     kvlen = lens_ref[t]
@@ -56,6 +61,11 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                     # [H, D]
         k = k_ref[0]                     # [P, H, D]
         v = v_ref[0]
+        if quantized:
+            # dequant-on-gather: the DMA moved int8 + [P, H] scales;
+            # the f32 rows only ever exist in VMEM
+            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
+            v = v.astype(jnp.float32) * vs_ref[0][:, :, None]
         kt = jnp.swapaxes(k, 0, 1)       # [H, P, D]
         s = jax.lax.dot_general(
             q, kt, (((1,), (2,)), ((0,), (0,))),
@@ -96,21 +106,30 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
-                           kv_lens, interpret=False):
+                           kv_lens, k_scales=None, v_scales=None,
+                           interpret=False):
     """q [T, H, D], pools [N, P, H, D], page_tables [S, MP] int,
     slot_ids [T] int, kv_lens [T] int → out [T, H, D].
 
+    k_scales/v_scales [N, P, H] fp32: per-row dequant scales of INT8
+    pools (quantization runtime). They are gathered through the same
+    page-table index_map as the pools and the dequant happens in VMEM
+    after the DMA, so HBM traffic for the cache stays int8 — the whole
+    point of the quantized pool (page bytes ≈ ×4 down vs fp32).
+
     Semantics contract: identical to the jnp reference in
     nn/functional/attention.py `paged_attention` (pinned by the
-    interpret-mode parity test in tests/test_llm_engine.py)."""
+    interpret-mode parity tests in tests/test_llm_engine.py and
+    tests/test_quant_runtime.py)."""
     tokens, heads, dim = q.shape
     _, page_size, _, _ = k_pool.shape
     _, pages_per_seq = page_tables.shape
     scale = 1.0 / math.sqrt(dim)
+    quantized = k_scales is not None
 
     kernel = functools.partial(
         _rpa_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
-        scale=scale)
+        scale=scale, quantized=quantized)
 
     def page_map(t, j, sid, pt, lens):
         # clamp j to the token's LAST live page: grid steps past the
@@ -122,15 +141,26 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
         return (pt[sid[t] * pages_per_seq + jnp.minimum(j, last)],
                 0, 0, 0)
 
+    def scale_map(t, j, sid, pt, lens):
+        last = jnp.maximum(lens[t] - 1, 0) // page_size
+        return (pt[sid[t] * pages_per_seq + jnp.minimum(j, last)], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, heads, dim),
+                     lambda t, j, sid, pt, lens: (t, 0, 0)),
+        pl.BlockSpec((1, page_size, heads, dim), page_map),
+        pl.BlockSpec((1, page_size, heads, dim), page_map),
+    ]
+    inputs = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, heads), scale_map),
+                     pl.BlockSpec((1, page_size, heads), scale_map)]
+        inputs += [k_scales, v_scales]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(tokens, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, heads, dim),
-                         lambda t, j, sid, pt, lens: (t, 0, 0)),
-            pl.BlockSpec((1, page_size, heads, dim), page_map),
-            pl.BlockSpec((1, page_size, heads, dim), page_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, heads, dim),
                                lambda t, j, sid, pt, lens: (t, 0, 0)),
         scratch_shapes=[
@@ -147,4 +177,4 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     )(jnp.asarray(slot_ids, jnp.int32),
       jnp.asarray(page_tables, jnp.int32).reshape(-1),
       jnp.asarray(kv_lens, jnp.int32),
-      q, k_pool, v_pool)
+      *inputs)

@@ -31,7 +31,7 @@ from ..ops._helpers import apply_jfn, ensure_tensor, value_of
 from ..tensor_core import Tensor
 
 __all__ = ["fake_quant", "QuantizedLinear", "ImperativeQuantAware",
-           "PostTrainingQuantization", "quantize_weight_int8"]
+           "PostTrainingQuantization", "quantize_weight_int8", "runtime"]
 
 
 def fake_quant(x, scale, bits=8, name=None):
@@ -47,31 +47,110 @@ def fake_quant(x, scale, bits=8, name=None):
     return apply_jfn("fake_quantize_dequantize", jfn, x)
 
 
-def quantize_weight_int8(w, axis=None):
-    """→ (int8 array, float scale per-channel or scalar)."""
+def _search_scale_mse(vals, absmax, bits=8, fracs=None):
+    """Scalar absmax refinement: pick the clip scale minimizing
+    quant-dequant MSE over `vals`. Anchored at the TRUE absmax (f=1.0
+    is in the sweep, so the result can never be worse than absmax —
+    and at 8 bits it usually IS absmax: clipping a real outlier costs
+    more squared error than the finer grid buys). The wide log-spaced
+    range is for lower bit widths, where clipping starts to pay."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if fracs is None:
+        fracs = np.geomspace(0.05, 1.0, 40)
+    vals = np.asarray(vals, np.float64).reshape(-1)
+    best_s, best_e = float(absmax), np.inf
+    for f in fracs:
+        s = max(float(absmax) * float(f), 1e-8)
+        step = s / qmax
+        qd = np.clip(np.round(vals / step), -qmax, qmax) * step
+        e = float(np.mean((qd - vals) ** 2))
+        if e < best_e:
+            best_e, best_s = e, s
+    return best_s
+
+
+def _search_scale_mse_per_channel(wv, scale0, red, bits=8, fracs=None):
+    """Vectorized per-channel variant of `_search_scale_mse`: one MSE
+    sweep over clip fractions, argmin kept independently per channel."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if fracs is None:
+        fracs = np.geomspace(0.05, 1.0, 40)
+    best_s = np.asarray(scale0, np.float64).copy()
+    best_e = np.full(best_s.shape, np.inf)
+    w64 = np.asarray(wv, np.float64)
+    for f in fracs:
+        s = np.maximum(scale0 * float(f), 1e-8)
+        step = s / qmax
+        qd = np.clip(np.round(w64 / step), -qmax, qmax) * step
+        e = ((qd - w64) ** 2).mean(axis=red, keepdims=True)
+        sel = e < best_e
+        best_e = np.where(sel, e, best_e)
+        best_s = np.where(sel, s, best_s)
+    return best_s
+
+
+def quantize_weight_int8(w, axis=None, search_mse=False):
+    """→ (int8 array, float32 scale — per-channel ndarray (keepdims
+    shape) when `axis` is given, np.float32 scalar otherwise).
+
+    search_mse=True refines each scale by the MSE clip search instead of
+    plain absmax (what `QuantizedLinear.freeze` uses)."""
     wv = np.asarray(value_of(ensure_tensor(w)))
     if axis is None:
         scale = np.abs(wv).max() or 1e-8
-    else:
-        red = tuple(d for d in range(wv.ndim) if d != axis)
-        scale = np.maximum(np.abs(wv).max(axis=red, keepdims=True), 1e-8)
+        if search_mse:
+            scale = _search_scale_mse(wv, scale)
+        q = np.clip(np.round(wv / scale * 127.0), -127, 127).astype(np.int8)
+        return q, np.float32(scale)
+    red = tuple(d for d in range(wv.ndim) if d != axis)
+    scale = np.maximum(np.abs(wv).max(axis=red, keepdims=True), 1e-8)
+    if search_mse:
+        scale = _search_scale_mse_per_channel(wv, scale, red)
     q = np.clip(np.round(wv / scale * 127.0), -127, 127).astype(np.int8)
-    return q, np.float32(scale)
+    # the per-channel keepdims shape must SURVIVE: np.float32(arr)
+    # collapses size-1 arrays to a 0-d scalar on older numpy, silently
+    # turning per-channel dequant into per-tensor (regression-tested)
+    return q, np.asarray(scale, dtype=np.float32)
 
 
 class _AbsMaxObserver:
     """Moving-average absmax (reference
-    FakeQuantizeMovingAverageAbsMax)."""
+    FakeQuantizeMovingAverageAbsMax), plus the TRUE absmax and a
+    bounded |x| sample buffer: the decayed average UNDERESTIMATES the
+    range whenever calibration batches vary (silent clipping at freeze
+    — the old tier-1 PTQ failure), so freeze-time scales anchor at the
+    real absmax and MSE-refine over what calibration actually saw."""
+
+    _PER_UPDATE = 2048
+    _CAP = 32768
 
     def __init__(self, momentum=0.9):
         self.momentum = momentum
         self.scale = None
+        self.absmax = 0.0
+        self._samples = []
+        self._kept = 0
 
     def update(self, v):
         cur = float(jnp.abs(v).max())
+        self.absmax = max(self.absmax, cur)
         self.scale = cur if self.scale is None else (
             self.momentum * self.scale + (1 - self.momentum) * cur)
+        if self._kept < self._CAP:
+            a = np.abs(np.asarray(v)).reshape(-1)
+            if a.size > self._PER_UPDATE:  # deterministic stride thinning
+                a = a[:: -(-a.size // self._PER_UPDATE)]
+            self._samples.append(a.astype(np.float32))
+            self._kept += a.size
         return self.scale
+
+    def searched_scale(self, bits=8):
+        """MSE-searched clip scale over the calibration samples; falls
+        back to the moving-average scale when nothing was retained."""
+        if not self._samples:
+            return self.scale
+        vals = np.concatenate(self._samples)
+        return _search_scale_mse(vals, max(self.absmax, 1e-8), bits=bits)
 
 
 class QuantizedLinear(nn.Layer):
@@ -111,10 +190,12 @@ class QuantizedLinear(nn.Layer):
                 "QuantizedLinear.freeze(): the activation observer was "
                 "never updated — run calibration (train-mode forwards or "
                 "PostTrainingQuantization.calibrate) before freezing")
-        q, w_scale = quantize_weight_int8(self.inner.weight, axis=1)
+        q, w_scale = quantize_weight_int8(self.inner.weight, axis=1,
+                                          search_mse=True)
         self._wq = jnp.asarray(q)
         self._w_scale = jnp.asarray(w_scale / 127.0)  # [1, out]
-        self._a_scale = jnp.float32(self.observer.scale / 127.0)
+        self._a_scale = jnp.float32(
+            self.observer.searched_scale(self.bits) / 127.0)
         self._frozen = True
         return self
 
@@ -192,3 +273,6 @@ class PostTrainingQuantization:
             if isinstance(q, QuantizedLinear):
                 q.freeze()
         return self.model
+
+
+from . import runtime  # noqa: E402,F401  (the serving/wire half)

@@ -1,0 +1,327 @@
+"""Quantized RUNTIME — int8 as a throughput format, not a file format.
+
+The QAT/PTQ stack in `paddle_tpu.quantization` trains and calibrates
+models INTO int8; this module is the other half: running the live system
+ON int8 where the bits buy bandwidth (the MXU has a native int8 path and
+every serving byte is HBM- or wire-bound):
+
+* **Int8 weight-only serving** (`quantize_model_int8`): every Linear in
+  a loaded model is swapped for `Int8WeightOnlyLinear` — per-channel
+  int8 weights held as BUFFERS (so `state_dict()` carries them and the
+  engine's compiled decode executable threads int8 weight buffers as jit
+  arguments), activations quantized dynamically per row inside the op,
+  and the matmul runs `lax.dot_general(..., preferred_element_type=
+  int32)` with the dequant folded into the epilogue. No calibration
+  pass: weight-only + dynamic activation scales is calibration-free.
+
+* **Int8 KV-cache codecs** (`quantize_kv_rows` / `dequantize_kv`): the
+  per-(token, head) absmax quantization used by the paged KV pool
+  (inference/llm_engine.py `kv_dtype="int8"`): each written row carries
+  its own scale, so incremental page writes never re-scale earlier
+  tokens (scales live in page-shaped planes alongside the pool).
+
+* **Int8 wire codec** (`encode_int8_wire` / `decode_int8_wire`): the
+  EQuARX-style (PAPERS.md) all-reduce/p2p payload format — per-block
+  absmax scales + int8 payload, ~4× fewer bytes than fp32. Opt-in via
+  `PT_QUANT_ALLREDUCE=1`; distributed/xproc.py applies it to the
+  coordination-KV collective fallback and the socket p2p transport.
+
+Env knobs (docs/QUANTIZATION.md):
+  PT_KV_DTYPE        default kv-cache dtype for LLMEngine
+                     (float32 | bfloat16 | int8; unset = model dtype)
+  PT_QUANT_ALLREDUCE 1 = int8-with-scale wire codec for eager
+                     collectives / float p2p payloads
+"""
+import os
+import struct
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..ops._helpers import apply_jfn, ensure_tensor
+
+__all__ = [
+    "Int8WeightOnlyLinear", "quantize_model_int8", "resolve_kv_dtype",
+    "kv_scale_shape", "quantize_kv_rows", "dequantize_kv",
+    "quant_allreduce_enabled", "wire_eligible", "encode_int8_wire",
+    "decode_int8_wire", "WIRE_MAGIC",
+]
+
+QMAX = 127.0
+
+
+# ---------------------------------------------------------------- weights
+
+class Int8WeightOnlyLinear(nn.Layer):
+    """Serving-time Linear over per-channel int8 weights.
+
+    Built from an existing (fp) linear layer at model-load time. The
+    int8 weight and its per-out-channel dequant step are registered as
+    persistable BUFFERS — they appear in `state_dict()`, so compiled
+    steps that thread `state_dict().values()` as jit arguments (the
+    `_CompiledPagedStep` / TrainStep pattern) carry int8 buffers in the
+    executable instead of fp32 weights. The fp weight is dropped.
+
+    Forward = dynamic per-row activation quant → int8×int8 matmul with
+    int32 accumulation (`preferred_element_type` — the MXU-native path)
+    → dequant in the epilogue by (activation step × weight step).
+    Inference-only: serving runs under no_grad; there is no fake-quant
+    STE here (that is the QAT stack's job)."""
+
+    def __init__(self, linear, post_shard=None):
+        super().__init__()
+        from . import quantize_weight_int8
+        from ..tensor_core import Tensor
+
+        w = linear.weight  # [in, out] (paddle layout)
+        q, scale = quantize_weight_int8(w, axis=1)  # scale [1, out]
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        self.register_buffer("weight_q", Tensor(jnp.asarray(q)))
+        self.register_buffer("w_step", Tensor(
+            jnp.asarray(np.asarray(scale, np.float32) / QMAX)))
+        self.bias = getattr(linear, "bias", None)
+        # activation-layout epilogue of the layer this wrapper replaced
+        # (Column/RowParallelLinear apply a shard_activation hint);
+        # identity off-mesh
+        self._post_shard = post_shard
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+
+        def jfn(v, wq, wstep, *b):
+            f = v.astype(jnp.float32)
+            a_step = jnp.maximum(
+                jnp.max(jnp.abs(f), axis=-1, keepdims=True), 1e-8) / QMAX
+            qv = jnp.clip(jnp.round(f / a_step), -QMAX, QMAX).astype(
+                jnp.int8)
+            acc = lax.dot_general(
+                qv, wq, (((f.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * a_step * wstep
+            if b:
+                out = out + b[0].astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        args = (x, self.weight_q, self.w_step)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        out = apply_jfn("int8_weight_only_matmul", jfn, *args)
+        if self._post_shard is not None:
+            out = self._post_shard(out)
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"weight=int8 per-channel")
+
+
+def _linear_classes():
+    from .. import nn
+    from ..distributed.fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    return nn.Linear, ColumnParallelLinear, RowParallelLinear
+
+
+def _post_shard_for(sub):
+    """Reproduce the activation-sharding epilogue of the parallel-linear
+    classes so a quantized model keeps the same layout hints on a mesh
+    (all of them collapse to the identity off-mesh)."""
+    from ..distributed.fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, shard_activation)
+
+    if isinstance(sub, ColumnParallelLinear) and not sub.gather_output:
+        return lambda out: shard_activation(
+            out, *(["dp"] + [None] * (out.ndim - 2) + ["mp"]))
+    return lambda out: shard_activation(
+        out, *(["dp"] + [None] * (out.ndim - 1)))
+
+
+def quantize_model_int8(model, skip=()):
+    """Swap every Linear-family sublayer for `Int8WeightOnlyLinear`,
+    in place, at model-load time. Embeddings (and the tied vocab head
+    that reads the embedding weight) stay in the float dtype — the
+    gather needs the float table anyway and the head wants full logit
+    precision.
+
+    skip: attribute-name substrings to leave unquantized
+    (e.g. ``skip=("lm_head",)``).
+
+    Returns a report dict: layers swapped, fp bytes before, int8 bytes
+    after (weights only). NOTE: on a >1 mesh the int8 buffers are
+    replicated (no TP sharding of int8 weights yet — documented in
+    docs/QUANTIZATION.md); single-host serving is the supported path.
+    """
+    from . import QuantizedLinear
+
+    linear_types = _linear_classes()
+    report = {"layers": 0, "weight_bytes_fp": 0, "weight_bytes_int8": 0}
+
+    def swap(layer, prefix=""):
+        for name, sub in list(layer.named_children()):
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, (Int8WeightOnlyLinear, QuantizedLinear)):
+                continue  # already quantized (runtime or QAT stack)
+            if isinstance(sub, linear_types) and not any(
+                    s in path for s in skip):
+                w = sub.weight._value
+                wrapped = Int8WeightOnlyLinear(
+                    sub, post_shard=_post_shard_for(sub))
+                report["layers"] += 1
+                report["weight_bytes_fp"] += int(
+                    w.size * w.dtype.itemsize)
+                report["weight_bytes_int8"] += int(
+                    wrapped.weight_q._value.nbytes
+                    + wrapped.w_step._value.nbytes)
+                setattr(layer, name, wrapped)
+            else:
+                swap(sub, path)
+
+    swap(model)
+    model.eval()
+    return report
+
+
+# ---------------------------------------------------------------- kv cache
+
+_KV_DTYPES = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_kv_dtype(requested, compute_dtype):
+    """(requested | $PT_KV_DTYPE | model compute dtype) → (jnp dtype,
+    quantized?). `requested` may be a string name or a dtype."""
+    req = requested
+    if req is None:
+        req = os.environ.get("PT_KV_DTYPE", "").strip() or None
+    if req is None:
+        dt = jnp.dtype(compute_dtype)
+        return dt, False
+    if isinstance(req, str):
+        key = req.lower()
+        if key not in _KV_DTYPES:
+            raise ValueError(
+                f"unknown kv_dtype {req!r}: expected one of "
+                f"{sorted(set(_KV_DTYPES))}")
+        dt = jnp.dtype(_KV_DTYPES[key])
+    else:
+        dt = jnp.dtype(req)
+    return dt, dt == jnp.dtype(jnp.int8)
+
+
+def kv_scale_shape(num_pages, page_size, num_heads):
+    """Shape of the per-page scale plane stored alongside an int8 pool:
+    one fp32 scale per (page, row, head) — each written token row is
+    quantized ONCE with its own scale, so incremental page writes never
+    invalidate earlier rows (a single per-page scalar would)."""
+    return (num_pages, page_size, num_heads)
+
+
+def quantize_kv_rows(x):
+    """[T, H, D] float → (int8 values [T, H, D], fp32 scales [T, H]).
+
+    Per-(token, head) absmax: dequant error ≤ absmax/254 per element,
+    and the scale plane costs 4/D of the int8 payload (~6% at D=64)."""
+    f = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / QMAX
+    q = jnp.clip(jnp.round(f / scale[..., None]), -QMAX, QMAX).astype(
+        jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of `quantize_kv_rows` (broadcasts a trailing dim onto
+    the scales)."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+# ---------------------------------------------------------------- wire
+
+WIRE_MAGIC = b"PTQ8"
+_WIRE_VERSION = 1
+_WIRE_DTYPES = {0: np.float32, 1: np.float64}
+_WIRE_CODES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1}
+_WIRE_HDR = struct.Struct("<4sBBHIQ")  # magic, ver, dtype, ndim, block, size
+
+
+def quant_allreduce_enabled():
+    return os.environ.get("PT_QUANT_ALLREDUCE", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def wire_eligible(arr, min_size=512):
+    """Only fp32/fp64 payloads above a size floor ride the codec: tiny
+    arrays (barriers, scalar telemetry) would pay header overhead for
+    nothing, and int/bool payloads (ids, tokens) must stay exact.
+
+    Deliberately DATA-INDEPENDENT (dtype + size only): inside a
+    collective every rank must take the same encode path, and a
+    value-dependent probe (e.g. isfinite) would let one rank's NaN grad
+    publish a raw frame while its peers publish PTQ8 frames — a
+    mixed-format crash mid-collective. Non-finite values are instead
+    handled inside `encode_int8_wire`: they decode back as NaN blocks,
+    so the NaN signal survives for downstream grad guards on every rank
+    identically. Also keeps eligibility O(1) on the DP-sync hot path."""
+    return arr.dtype in (np.float32, np.float64) and arr.size >= min_size
+
+
+def encode_int8_wire(arr, block=2048):
+    """float array → self-describing int8-with-scale frame.
+
+    Layout: header | shape (u32 each) | per-block fp32 scales | int8
+    payload. Scales are per-`block`-element absmax/127, so the relative
+    error is bounded by each block's own dynamic range — the property
+    that makes a quantized GRADIENT all-reduce converge (EQuARX): big
+    layers can't crush small layers' scale. ~4× smaller than fp32."""
+    a = np.ascontiguousarray(arr)
+    code = _WIRE_CODES[np.dtype(a.dtype)]
+    flat = a.reshape(-1).astype(np.float32)
+    n = flat.size
+    nblocks = -(-n // block) if n else 0
+    pad = nblocks * block - n
+    padded = np.pad(flat, (0, pad)).reshape(nblocks, block)
+    # a non-finite value makes its block's scale NaN/inf, which decodes
+    # the WHOLE block to NaN — the poison signal survives the wire for
+    # every rank identically (see wire_eligible: eligibility must stay
+    # data-independent, so crashing here is not an option either)
+    scales = np.maximum(np.abs(padded).max(axis=1), 1e-12) / QMAX
+    with np.errstate(invalid="ignore", over="ignore"):
+        ratio = np.nan_to_num(padded / scales[:, None],
+                              nan=0.0, posinf=QMAX, neginf=-QMAX)
+    q = np.clip(np.round(ratio), -QMAX, QMAX).astype(np.int8)
+    head = _WIRE_HDR.pack(WIRE_MAGIC, _WIRE_VERSION, code, a.ndim,
+                          block, n)
+    shape = np.asarray(a.shape, np.uint32).tobytes()
+    return head + shape + scales.astype(np.float32).tobytes() + \
+        q.reshape(-1)[:n].tobytes()
+
+
+def decode_int8_wire(buf):
+    """Inverse of `encode_int8_wire` → np array in the original float
+    dtype."""
+    magic, ver, code, ndim, block, n = _WIRE_HDR.unpack_from(buf, 0)
+    if magic != WIRE_MAGIC or ver != _WIRE_VERSION:
+        raise ValueError("not a PTQ8 int8 wire frame")
+    off = _WIRE_HDR.size
+    shape = tuple(np.frombuffer(buf, np.uint32, ndim, off))
+    off += 4 * ndim
+    nblocks = -(-n // block) if n else 0
+    scales = np.frombuffer(buf, np.float32, nblocks, off)
+    off += 4 * nblocks
+    q = np.frombuffer(buf, np.int8, n, off).astype(np.float32)
+    pad = nblocks * block - n
+    with np.errstate(invalid="ignore"):  # poison blocks: 0 × inf → NaN
+        vals = (np.pad(q, (0, pad)).reshape(nblocks, block)
+                * scales[:, None]).reshape(-1)[:n]
+    return vals.astype(_WIRE_DTYPES[code]).reshape(shape)
+
+
+def is_quant_wire(buf):
+    return bytes(buf[:4]) == WIRE_MAGIC

@@ -238,13 +238,48 @@ def _paged_cache_write(k_pool, v_pool, k_new, v_new, write_idx):
                      v_new, write_idx)
 
 
+def _paged_cache_write_quant(k_pool, v_pool, k_scales, v_scales, k_new,
+                             v_new, write_idx):
+    """Int8 variant of `_paged_cache_write`: each incoming k/v row is
+    quantized per (token, head) absmax (quantization.runtime
+    `quantize_kv_rows`) and scattered into the int8 pools, with its
+    fp32 scale scattered into the page-shaped scale planes at the same
+    flat row. A row is quantized exactly once with its own scale, so
+    later writes to the same page never invalidate earlier tokens."""
+    import jax.numpy as jnp
+
+    from ...ops._helpers import apply_jfn
+    from ...quantization import runtime as _qrt
+
+    def jfn(kp, vp, ks, vs, kn, vn, idx):
+        shape = kp.shape
+        flat = (shape[0] * shape[1],) + shape[2:]
+        sflat = (shape[0] * shape[1],) + ks.shape[2:]
+        idx = idx.astype(jnp.int32)
+        kq, kscale = _qrt.quantize_kv_rows(kn)
+        vq, vscale = _qrt.quantize_kv_rows(vn)
+        kp2 = kp.reshape(flat).at[idx].set(kq).reshape(shape)
+        vp2 = vp.reshape(flat).at[idx].set(vq).reshape(shape)
+        ks2 = ks.reshape(sflat).at[idx].set(kscale).reshape(ks.shape)
+        vs2 = vs.reshape(sflat).at[idx].set(vscale).reshape(vs.shape)
+        return kp2, vp2, ks2, vs2
+
+    return apply_jfn("paged_cache_write_int8", jfn, k_pool, v_pool,
+                     k_scales, v_scales, k_new, v_new, write_idx)
+
+
 def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
-                         page_tables, slot_ids, kv_lens):
+                         page_tables, slot_ids, kv_lens,
+                         k_scales=None, v_scales=None):
     """Paged-cache decoder block over the FLAT token layout [1, T, d] —
     the continuous-batching analog of `_layer_forward_cached`: write the
     step's k/v into pool pages, then ragged paged attention against each
     token's own sequence prefix. Functional (returns new pools), so the
-    whole engine step compiles to ONE program."""
+    whole engine step compiles to ONE program.
+
+    With `k_scales`/`v_scales` (int8 pools) the write quantizes each row
+    and attention dequantizes on gather; returns the new scale planes
+    after the pools."""
     T = x.shape[1]
     h = layer.ln1(x)
     qkv = layer.qkv(h)
@@ -252,12 +287,23 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
     q = manip.reshape(q, [T, layer.nh, layer.hd])
     k = manip.reshape(k, [T, layer.nh, layer.hd])
     v = manip.reshape(v, [T, layer.nh, layer.hd])
-    ck, cv = _paged_cache_write(cache_k, cache_v, k, v, write_idx)
-    attn = F.paged_attention(q, ck, cv, page_tables, slot_ids, kv_lens)
+    if k_scales is None:
+        ck, cv = _paged_cache_write(cache_k, cache_v, k, v, write_idx)
+        attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
+                                 kv_lens)
+        cks = cvs = None
+    else:
+        ck, cv, cks, cvs = _paged_cache_write_quant(
+            cache_k, cache_v, k_scales, v_scales, k, v, write_idx)
+        attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
+                                 kv_lens, k_scales=cks, v_scales=cvs)
     attn = manip.reshape(attn, [1, T, layer.nh * layer.hd])
     x = x + layer.proj(attn)
     h = layer.ln2(x)
-    return x + layer.fc2(F.gelu(layer.fc1(h))), ck, cv
+    out = x + layer.fc2(F.gelu(layer.fc1(h)))
+    if k_scales is None:
+        return out, ck, cv
+    return out, ck, cv, cks, cvs
 
 
 class GPTGenerationMixin:
@@ -325,7 +371,8 @@ class GPTGenerationMixin:
     # ---- paged-cache ragged decode (continuous-batching serving) ----
 
     def _paged_decode_core(self, tok, pos_ids, slot_ids, write_idx,
-                           page_tables, kv_lens, sample_idx, kv):
+                           page_tables, kv_lens, sample_idx, kv,
+                           kv_scales=None):
         """One ragged engine step over flat tokens: tok/pos_ids/slot_ids/
         write_idx/kv_lens [T], page_tables [S, MP], sample_idx [S] (the
         flat row holding each slot's sampling frontier; stale slots
@@ -335,18 +382,31 @@ class GPTGenerationMixin:
         on the S gathered frontier rows, never on prefill tokens.
         Compiled ONCE by inference/llm_engine.py's _CompiledPagedStep —
         the TrainStep-style executable behind every scheduler tick
-        (weights as jit arguments, pools donated)."""
+        (weights as jit arguments, pools donated).
+
+        kv_scales: for int8 pools (kv_dtype="int8"), the 2·num_layers
+        page-shaped fp32 scale planes; the new planes are returned
+        AFTER the new pools: (logits, *new_pools, *new_scales)."""
         model = self.gpt
         x = model.wte(tok.unsqueeze(0)) + model.wpe(pos_ids)
-        flat = []
+        flat, scale_flat = [], []
         for i, layer in enumerate(model.layers):
-            x, ck, cv = _layer_forward_paged(
-                layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
-                page_tables, slot_ids, kv_lens)
+            if kv_scales is None:
+                x, ck, cv = _layer_forward_paged(
+                    layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
+                    page_tables, slot_ids, kv_lens)
+            else:
+                x, ck, cv, cks, cvs = _layer_forward_paged(
+                    layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
+                    page_tables, slot_ids, kv_lens,
+                    k_scales=kv_scales[2 * i],
+                    v_scales=kv_scales[2 * i + 1])
+                scale_flat += [cks, cvs]
             flat += [ck, cv]
         x = model.ln_f(x)
         x = manip.gather(x, sample_idx, axis=1)  # [1, S, d] frontiers
-        return (self._logits_from_hidden(x, shard=False), *flat)
+        return (self._logits_from_hidden(x, shard=False), *flat,
+                *scale_flat)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, do_sample=False, attention_mask=None,

@@ -1,0 +1,76 @@
+"""Worker for the quantized all-reduce convergence test
+(test_quant_runtime.py).
+
+Eager data-parallel training, chaos_worker-style: each rank computes
+grads on ITS OWN deterministic data shard and syncs them every step with
+`fused_allreduce_gradients` (on CPU that rides the coordination-KV
+collective fallback — with PT_QUANT_ALLREDUCE=1, through the int8 wire
+codec). The test launches it once clean and once quantized: the final
+losses must agree within the codec's error budget, the quantized run
+must have actually saved wire bytes, and both ranks must hold IDENTICAL
+parameters at the end (every rank dequantizes the same matrices — the
+codec cannot introduce replica drift).
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (  # noqa: E402
+    fused_allreduce_gradients)
+
+STEPS = 8
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+
+    paddle.seed(0)  # identical init on every rank
+    m = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 1))
+    # fused_allreduce_gradients SUMS grads across ranks (reference
+    # semantics) — the lr bakes in the 1/world factor
+    opt = paddle.optimizer.SGD(0.02 / world, parameters=m.parameters())
+
+    # per-rank data shard (deterministic by rank)
+    rng = np.random.default_rng(100 + rank)
+    x = paddle.to_tensor(rng.standard_normal((32, 32)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((32,)).astype(np.float32))
+
+    losses = []
+    for _ in range(STEPS):
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y)
+        loss.backward()
+        fused_allreduce_gradients(m.parameters())
+        opt.step()
+        opt.clear_grad()
+        # the GLOBAL loss is what both variants must agree on
+        g = float(np.asarray(
+            xproc.all_reduce_np(np.asarray([float(loss.numpy())],
+                                           np.float32), op="avg"))[0])
+        losses.append(g)
+
+    digest = hashlib.sha256()
+    for p in m.parameters():
+        digest.update(np.ascontiguousarray(np.asarray(p._value)).tobytes())
+    saved = int(xproc._QUANT_SAVED.value)
+    with open(os.path.join(out_dir, f"quant_ar_out_{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "losses": losses,
+                   "param_sha": digest.hexdigest(),
+                   "bytes_saved": saved,
+                   "kv_fallback": bool(xproc._kv_coll["fallback"])}, f)
+
+
+if __name__ == "__main__":
+    main()

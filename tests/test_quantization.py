@@ -1,9 +1,12 @@
 """Quantization tests (reference: slim/quantization — QAT fake-quant STE,
 PostTrainingQuantization int8)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, quantization as Q
+
+pytestmark = pytest.mark.quant
 
 rng = np.random.default_rng(0)
 
@@ -29,6 +32,68 @@ def test_quantize_weight_int8_per_channel():
     # per-channel: error bounded by each channel's own scale step
     step = np.abs(w).max(axis=0, keepdims=True) / 127.0
     assert (np.abs(deq - w) <= step * 0.51).all()
+
+
+def test_quantize_weight_int8_scale_shape_dtype_regression():
+    """The per-channel scale must come back as an fp32 NDARRAY with the
+    keepdims shape — np.float32(arr) collapses size-1 arrays to a 0-d
+    scalar on older numpy, silently turning per-channel dequant into
+    per-tensor (the ISSUE-4 satellite)."""
+    # single-output-channel per-channel quant: scale stays (1, 1)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    q, scale = Q.quantize_weight_int8(paddle.to_tensor(w), axis=1)
+    assert isinstance(scale, np.ndarray)
+    assert scale.shape == (1, 1) and scale.dtype == np.float32
+    # 1-D weight, axis=0: per-element scales keep the 1-D shape
+    w1 = rng.standard_normal((6,)).astype(np.float32)
+    q1, s1 = Q.quantize_weight_int8(paddle.to_tensor(w1), axis=0)
+    assert isinstance(s1, np.ndarray)
+    assert s1.shape == (6,) and s1.dtype == np.float32
+    # scalar path unchanged: axis=None still yields a 0-d np.float32
+    q0, s0 = Q.quantize_weight_int8(paddle.to_tensor(w1))
+    assert np.ndim(s0) == 0 and np.asarray(s0).dtype == np.float32
+    # dequant with the returned shapes reconstructs within one step
+    deq = q.astype(np.float32) * scale / 127.0
+    step = np.abs(w).max(axis=0, keepdims=True) / 127.0
+    assert (np.abs(deq - w) <= step * 0.51).all()
+
+
+def test_quantize_weight_int8_mse_search_not_worse():
+    """search_mse=True can never lose to plain absmax — f=1.0 is in the
+    sweep, so the searched scale is the argmin over a superset. (At 8
+    bits absmax is already near-MSE-optimal for most weight
+    distributions; the sweep is the safety net, and the knob that
+    matters at lower bit widths.)"""
+    for w in (rng.standard_t(2, (4096, 8)).astype(np.float32),
+              rng.standard_normal((64, 16)).astype(np.float32)):
+        qa, sa = Q.quantize_weight_int8(w, axis=1)
+        qm, sm = Q.quantize_weight_int8(w, axis=1, search_mse=True)
+        ea = ((qa.astype(np.float32) * sa / 127.0 - w) ** 2).mean()
+        em = ((qm.astype(np.float32) * sm / 127.0 - w) ** 2).mean()
+        assert em <= ea * 1.0001, (em, ea)
+
+
+def test_observer_searched_scale_fixes_moving_average_underestimate():
+    """THE PTQ accuracy fix (err 0.137 → 0.015 on the tier-1 model):
+    the momentum moving-average absmax UNDERESTIMATES the true range
+    whenever calibration batches vary, silently clipping in-range
+    activations at freeze time. `searched_scale` anchors at the true
+    absmax over everything calibration saw and MSE-refines from
+    there."""
+    obs = Q._AbsMaxObserver(momentum=0.9)
+    r = np.random.default_rng(7)
+    batches = [r.standard_normal(512).astype(np.float32) * s
+               for s in (1.0,) + (0.2,) * 7]
+    import jax.numpy as jnp
+
+    for b in batches:
+        obs.update(jnp.asarray(b))
+    true_absmax = max(float(np.abs(b).max()) for b in batches)
+    # the decayed average is well below the real range...
+    assert obs.scale < 0.8 * true_absmax
+    # ...the searched scale is not (and never exceeds absmax)
+    s = obs.searched_scale()
+    assert 0.8 * true_absmax <= s <= true_absmax * 1.0001
 
 
 def test_qat_trains_and_freezes():
