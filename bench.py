@@ -973,6 +973,34 @@ def _run_worker(which, timeout_s):
     return "error", None
 
 
+def _ptlint_stamp():
+    """ptlint version + finding count for the run metadata: a perf
+    trend record is only comparable when the measured tree was
+    jit-clean (a host sync or dropped donation skews the number before
+    any kernel change does). Loads the stdlib-only linter standalone —
+    no paddle_tpu/jax import in the supervisor."""
+    try:
+        import importlib.util
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        # one loader, owned by the CLI: tools/ptlint.py knows how to
+        # bring the linter up standalone and which paths the gate covers
+        spec = importlib.util.spec_from_file_location(
+            "_bench_ptlint_cli", os.path.join(here, "tools", "ptlint.py"))
+        cli = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cli)
+        mod = cli._load_lint()
+        res = mod.lint_paths(
+            [os.path.join(here, p) for p in cli.DEFAULT_PATHS])
+        return {"version": mod.PTLINT_VERSION,
+                "findings": len(res["findings"]),
+                "suppressed": res["suppressed"],
+                "files": res["files"]}
+    except Exception as e:  # metadata must never kill the headline
+        log(f"[bench] ptlint stamp failed: {e!r}")
+        return {"error": repr(e)}
+
+
 def _write_detail(detail):
     """Durable per-arm record (the driver captures stdout only; the
     headline line must stay the sole stdout JSON). Written on EVERY
@@ -1027,8 +1055,11 @@ def main():
     detail = {}
     # A run under fault injection (distributed/chaos.py) measures
     # resilience, not speed — stamp the record so chaos runs never
-    # pollute the BENCH_*.json trend series.
+    # pollute the BENCH_*.json trend series. The ptlint stamp serves
+    # the same comparability purpose for jit-safety (docs/ANALYSIS.md).
     chaos_active = bool(os.environ.get("PT_CHAOS_PLAN"))
+    ptlint_stamp = _ptlint_stamp()
+    detail["ptlint"] = ptlint_stamp
     if gpt is not None:
         detail["gpt"] = gpt
         mfu = gpt["mfu"]
@@ -1038,12 +1069,14 @@ def main():
             "unit": "fraction_of_v5e_bf16_peak",
             "vs_baseline": round(mfu / BASELINE_MFU, 4),
             "chaos_plan_active": chaos_active,
+            "ptlint": ptlint_stamp,
             "detail": detail,
         }
     else:
         line = {"metric": "gpt_small_train_mfu", "value": 0.0,
                 "unit": "fraction_of_v5e_bf16_peak", "vs_baseline": 0.0,
-                "chaos_plan_active": chaos_active, "detail": detail}
+                "chaos_plan_active": chaos_active,
+                "ptlint": ptlint_stamp, "detail": detail}
     # Emit the headline NOW: nothing after this point can zero the result.
     print(json.dumps(line), flush=True)
     _write_detail(detail)

@@ -98,6 +98,7 @@ for _sub in (
     "signal",
     "utils",
     "onnx",
+    "analysis",
 ):
     try:
         globals()[_sub] = _importlib.import_module("." + _sub, __name__)

@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — jit-safety static analysis.
+
+The TPU-native analog of the reference's static-graph IR validity
+passes (SURVEY layer 3/4a): PaddlePaddle verifies a ProgramDesc before
+the executor runs it; this framework has no graph IR to verify — the
+program IS python that traces — so correctness checking happens at the
+two layers that exist here:
+
+* **Source level** (`lint`): an AST linter with framework-specific
+  rules — host syncs inside traced code, python control flow on
+  tracers, donated-buffer reuse, weak-type retrace hazards, int8 dots
+  without `preferred_element_type`, rank-divergent collective
+  ordering. `tools/ptlint.py` is the CLI/CI gate; the tier-1 suite
+  pins the shipped tree at zero findings.
+
+* **jaxpr/HLO level** (`step_analysis`): `analyze_step()` traces a
+  live `jit.TrainStep` / `inference.LLMEngine` and reports donation
+  coverage (did the compiled executable really alias the donated
+  buffers — the PR-2 persistent-cache bug, caught mechanically),
+  silent dtype promotions, host callbacks in the step body, and
+  weak-type retrace hazards with a diffable input signature.
+
+Rule catalogue with the real shipped-bug each rule would have caught:
+docs/ANALYSIS.md.
+"""
+from .lint import (  # noqa: F401
+    PTLINT_VERSION, RULES, Rule, Finding,
+    lint_source, lint_file, lint_paths, iter_python_files)
+from .step_analysis import (  # noqa: F401
+    ANALYSIS_RULES, StepReport, analyze_step, analyze_jit,
+    donation_coverage, signature_diff)
+
+__all__ = [
+    "PTLINT_VERSION", "RULES", "Rule", "Finding",
+    "lint_source", "lint_file", "lint_paths", "iter_python_files",
+    "ANALYSIS_RULES", "StepReport", "analyze_step", "analyze_jit",
+    "donation_coverage", "signature_diff",
+]

@@ -1,0 +1,1075 @@
+"""jit-safety AST linter — the source half of `paddle_tpu.analysis`.
+
+Every hard bug this repo shipped and then root-caused is a statically
+detectable misuse of the JAX/XLA programming model: donation silently
+dropped and reused buffers (PR 1/2), per-instance recompiles from
+non-argument rng keys (PR 1), host-sync `float(loss)` on a hot path,
+the mixed int8/raw wire-format deadlock shape (PR 4). This module is
+the same idea as PaddlePaddle's static-graph IR validity passes
+(SURVEY layer 3/4a), run at the SOURCE level: find the misuse before a
+TPU run does.
+
+Design:
+
+* **stdlib-only.** No jax import — `tools/ptlint.py` loads this module
+  standalone, so the CI gate lints the whole tree in a few seconds
+  (python startup + ast.parse, no backend init). The jaxpr/HLO half
+  (donation coverage, dtype promotions) lives in `step_analysis.py`
+  and needs a live step to trace.
+
+* **Traced-context detection.** A function is "traced" when the module
+  shows it entering a jax trace: decorated with / passed to `jax.jit`,
+  `pjit`, `grad`, `value_and_grad`, `vmap`, `pmap`, `checkpoint`,
+  `shard_map`, a `lax` control-flow combinator, `to_static`, or named
+  as a `TrainStep` loss_fn. Nested defs inherit the context.
+  `to_static` functions run under AutoGraph (`jit/autograph.py`
+  rewrites tensor if/for into `lax.cond`/`scan`), so the
+  tracer-control-flow rules are skipped there — only raw-trace
+  contexts get them.
+
+* **Two-level taint.** Inside a traced function, parameters and
+  anything derived from them are `tainted` (may hold tracers);
+  expressions that are *definitely* jax arrays (results of
+  `jnp.*`/`lax.*`/`jax.random.*` calls, arithmetic on them, …) are
+  additionally `array`. Host-sync rules fire on `tainted` (a
+  `float()` of anything trace-derived is a bug); control-flow rules
+  fire only on `array` (iterating a python list OF tracers is fine —
+  iterating a tracer is not). Static accessors (`.shape`, `.dtype`,
+  `len()`, …) launder taint: branching on shapes is legal and
+  idiomatic.
+
+Suppressions: a trailing `# ptlint: disable=PTL101` (comma-separated
+ids or slugs, or `all`) on the offending line — or on the enclosing
+`def` line to waive a whole function — and `# ptlint: skip-file`
+anywhere in the file. Suppressed findings are counted but not
+reported; the CLI's JSON output carries both numbers.
+"""
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+import types
+
+__all__ = ["PTLINT_VERSION", "RULES", "Rule", "Finding", "lint_source",
+           "lint_file", "lint_paths", "iter_python_files"]
+
+PTLINT_VERSION = "1.0.0"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    # the real, shipped-and-root-caused bug this rule would have caught
+    # (or the bug class it fences off) — docs/ANALYSIS.md renders this
+    caught: str
+
+
+RULES = {r.id: r for r in [
+    Rule("PTL101", "host-sync-in-trace",
+         "float()/int()/bool()/.item()/.numpy()/.tolist() on a traced "
+         "value inside a traced function",
+         "host-sync float(loss) on the training hot path; under jit "
+         "this is a ConcretizationTypeError at best, a silent "
+         "per-step device sync at worst"),
+    Rule("PTL102", "numpy-on-tracer",
+         "np.* call applied to a traced value inside a traced function",
+         "np.asarray(tracer) falls out of the XLA program — it either "
+         "crashes the trace or bakes a trace-time constant"),
+    Rule("PTL103", "tracer-branch",
+         "python if/while/assert on a jax array value inside a "
+         "raw-traced function (no AutoGraph)",
+         "branching on a tracer crashes the trace; the fix is "
+         "lax.cond/jnp.where, or @to_static which rewrites it"),
+    Rule("PTL104", "tracer-loop",
+         "python for over a jax array value inside a raw-traced "
+         "function (no AutoGraph)",
+         "iterating a tracer unrolls (or crashes) the trace; use "
+         "lax.scan/fori_loop, or @to_static"),
+    Rule("PTL105", "print-in-trace",
+         "print() of a traced value inside a traced function",
+         "print under trace fires once at trace time with an abstract "
+         "value, not per step — use jax.debug.print"),
+    Rule("PTL201", "donated-reuse",
+         "a buffer passed at a donated argument position is read "
+         "again after the donating call",
+         "the PR-2 class: a donated-then-reused pytree reads freed "
+         "HBM — jax errors on CPU but silently corrupts under some "
+         "backends/caches"),
+    Rule("PTL202", "mixed-weak-arg",
+         "the same jitted callable takes a python scalar literal AND "
+         "a non-literal at the same argument position",
+         "a weak-typed python scalar and a committed array hash to "
+         "different jit signatures — two executables for one step "
+         "(the PR-1 retrace-churn class)"),
+    Rule("PTL203", "impure-time",
+         "time.time()/perf_counter() etc. inside a traced function",
+         "wall-clock reads freeze to a trace-time constant — the "
+         "telemetry that motivated PR 3 measures OUTSIDE the program"),
+    Rule("PTL204", "impure-random",
+         "python random.* / np.random.* inside a traced function",
+         "host RNG bakes one draw into the compiled program (the "
+         "same-mask-every-step dropout bug PR 1 fixed by threading "
+         "the key as an argument)"),
+    Rule("PTL301", "int8-dot-no-preferred",
+         "dot_general/dot/matmul/einsum on int8 operands without "
+         "preferred_element_type",
+         "int8×int8 accumulating in int8 overflows silently; the "
+         "quantized runtime (PR 4) requires "
+         "preferred_element_type=int32 — the MXU-native contract"),
+    Rule("PTL401", "rank-divergent-collective",
+         "a collective call inside a branch conditioned on the "
+         "process rank",
+         "the PR-4 wire-format deadlock shape: one rank entering a "
+         "collective its peers skip (or entering a different one) "
+         "hangs the pod"),
+]}
+
+_SLUG_TO_ID = {r.name: r.id for r in RULES.values()}
+
+# ----------------------------------------------------------------- tables
+
+# transforms whose function argument enters a jax trace:
+# component name -> positions of traced callables in the call args
+_TRACING_CALL_ARGS = {
+    "jit": (0,), "pjit": (0,), "vmap": (0,), "pmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "checkpoint": (0,),
+    "remat": (0,), "shard_map": (0,), "custom_vjp": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "associative_scan": (0,),
+}
+# decorator component names that make the decorated def traced
+_TRACING_DECORATORS = {"jit", "pjit", "vmap", "pmap", "grad",
+                       "value_and_grad", "checkpoint", "remat",
+                       "shard_map", "custom_vjp"}
+# AutoGraph-covered entries (tensor control flow is REWRITTEN, so the
+# tracer-control-flow rules don't apply)
+_AUTOGRAPH_NAMES = {"to_static"}
+# TrainStep-family constructors: positional arg 1 / kwarg loss_fn is
+# traced (raw trace, no autograph)
+_TRAINSTEP_NAMES = {"TrainStep", "DistributedTrainStep",
+                    "SparseTrainStep"}
+
+# attribute reads that LAUNDER taint — static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes",
+                 "itemsize", "weak_type", "sharding", "device",
+                 "aval", "name"}
+# calls whose result is static regardless of argument taint
+_STATIC_FUNCS = {"len", "isinstance", "issubclass", "type", "hasattr",
+                 "callable", "id", "repr", "str", "format", "dir",
+                 "vars", "globals", "locals"}
+# roots whose calls produce jax arrays
+_ARRAY_ROOTS = {"jnp", "lax", "jsp"}
+# jnp/jax functions that return HOST values (static metadata), not arrays
+_STATIC_ARRAY_FUNCS = {"issubdtype", "isdtype", "result_type",
+                       "promote_types", "iinfo", "finfo", "dtype",
+                       "shape", "ndim", "size", "broadcast_shapes",
+                       "eval_shape", "tree_structure", "make_jaxpr"}
+_ARRAY_DOTTED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.",
+                          "jax.nn.", "jax.scipy.")
+
+_DOT_FUNCS = {"dot_general", "dot", "matmul", "einsum", "tensordot"}
+
+_COLLECTIVE_FUNCS = {
+    "all_reduce", "all_reduce_np", "all_gather", "all_gather_np",
+    "all_gather_bytes", "all_gather_obj", "broadcast", "broadcast_np",
+    "barrier", "reduce_scatter", "all_to_all", "psum", "psum_scatter",
+    "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "fused_allreduce_gradients", "allreduce", "allgather",
+}
+_RANK_NAMES = {"rank", "local_rank", "world_rank", "global_rank",
+               "proc_id", "proc_index", "process_index", "pid"}
+_RANK_CALLS = {"get_rank", "process_index", "get_world_rank",
+               "local_rank", "get_local_rank"}
+
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time",
+               "clock_gettime", "time_ns", "perf_counter_ns",
+               "monotonic_ns"}
+
+_SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    name: str
+    path: str
+    line: int
+    col: int
+    message: str
+    func: str = ""
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+    def format(self):
+        loc = f"{self.path}:{self.line}:{self.col}"
+        where = f" [in {self.func}]" if self.func else ""
+        return f"{loc} {self.rule} {self.name}: {self.message}{where}"
+
+
+# ------------------------------------------------------------- utilities
+
+def _dotted(node):
+    """'a.b.c' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _component(node):
+    """Last attribute component of a callable expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _target_key(node):
+    """Trackable key for a call target / assign target: bare name or a
+    self/cls attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    d = _dotted(node)
+    if d and (d.startswith("self.") or d.startswith("cls.")):
+        return d
+    return None
+
+
+def _mentions_int8(node, int8_names):
+    """Does this expression visibly carry int8 data? (astype(jnp.int8),
+    np.int8 casts, names locally assigned from such expressions)"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and n.value == "int8":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("int8", "uint8"):
+            return True
+        if isinstance(n, ast.Name) and n.id in int8_names:
+            return True
+    return False
+
+
+def _walk_shallow(stmts):
+    """ast.walk that does NOT descend into nested function/class
+    scopes — sub-linters prescan their own bodies (keeps the module
+    pass linear; nested re-walks made it quadratic)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                stack.append(child)
+
+
+def _is_rankish(test):
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_NAMES:
+            return True
+        if isinstance(n, ast.Call):
+            c = _component(n.func)
+            if c in _RANK_CALLS:
+                return True
+    return False
+
+
+# ------------------------------------------------- module-level discovery
+
+class _TracedDiscovery(ast.NodeVisitor):
+    """Collect names of functions that enter a jax trace anywhere in
+    the module, and whether they run under AutoGraph."""
+
+    def __init__(self):
+        self.raw = set()        # raw-traced function names
+        self.autograph = set()  # AutoGraph-covered traced names
+
+    def _add_callable_node(self, node, autograph):
+        if isinstance(node, ast.Name):
+            (self.autograph if autograph else self.raw).add(node.id)
+        elif isinstance(node, (ast.List, ast.Tuple)):
+            for elt in node.elts:
+                self._add_callable_node(elt, autograph)
+        # Lambda bodies are handled where the Call is visited (the
+        # linter walks Lambda args of tracing calls directly)
+
+    def visit_Call(self, node):
+        comp = _component(node.func)
+        if comp in _TRACING_CALL_ARGS:
+            for pos in _TRACING_CALL_ARGS[comp]:
+                if pos < len(node.args):
+                    self._add_callable_node(node.args[pos], False)
+        elif comp == "switch" and len(node.args) >= 2:
+            self._add_callable_node(node.args[1], False)
+        elif comp in _AUTOGRAPH_NAMES and node.args:
+            self._add_callable_node(node.args[0], True)
+        elif comp in _TRAINSTEP_NAMES:
+            if len(node.args) >= 2:
+                self._add_callable_node(node.args[1], False)
+            for kw in node.keywords:
+                if kw.arg == "loss_fn":
+                    self._add_callable_node(kw.value, False)
+        self.generic_visit(node)
+
+
+def _decorated_context(fn_node):
+    """(traced, autograph) from this def's decorator list."""
+    for dec in fn_node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        comp = _component(target)
+        if comp in _TRACING_DECORATORS:
+            return True, False
+        if comp in _AUTOGRAPH_NAMES:
+            return True, True
+    return False, False
+
+
+# -------------------------------------------------------------- the pass
+
+class _FunctionLinter:
+    """One function scope: taint tracking + all rule checks.
+
+    `traced` turns on the trace-context rules (PTL1xx/2xx impurity);
+    the host-level rules (PTL201/202/301/401) run in every scope —
+    donation misuse and rank-divergent collectives live in host code.
+    """
+
+    def __init__(self, module, fn_node, traced, autograph, func_name):
+        self.m = module                     # _ModuleLint
+        self.fn = fn_node
+        self.traced = traced
+        self.autograph = autograph
+        self.func_name = func_name
+        self.tainted = set()
+        self.array = set()
+        self.int8_names = set()
+        # PTL201 state: key -> donated positions (from jax.jit assigns
+        # seen in this scope, merged over the module's self-attr map)
+        self.jitted = dict(module.jitted_attrs)
+        self.consumed = {}         # key -> (line, end_line) of donation
+        # store-tracking stacks for loop bodies (PTL201 loop-carried
+        # donation: donated inside the body + never reassigned there =
+        # iteration 2 reuses a freed buffer)
+        self._loop_stores = []
+        # PTL202 state: (callee key, position) -> {"literal","other"}
+        self.arg_kinds = {}
+        self.rank_if_depth = 0
+
+    # ---- taint queries ------------------------------------------------
+
+    def _is_tainted(self, node):
+        return self._level(node) >= 1
+
+    def _is_array(self, node):
+        return self._level(node) >= 2
+
+    def _level(self, node):
+        """0 = clean, 1 = tainted (may derive from tracers), 2 = array
+        (definitely a jax array value)."""
+        if isinstance(node, ast.Name):
+            if node.id in self.array:
+                return 2
+            return 1 if node.id in self.tainted else 0
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return 0
+            base = self._level(node.value)
+            return base
+        if isinstance(node, ast.Subscript):
+            return self._level(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_level(node)
+        if isinstance(node, (ast.BinOp,)):
+            return max(self._level(node.left), self._level(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self._level(node.operand)
+        if isinstance(node, ast.Compare):
+            lv = max([self._level(node.left)]
+                     + [self._level(c) for c in node.comparators])
+            return lv
+        if isinstance(node, ast.BoolOp):
+            return max(self._level(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return max(self._level(node.body), self._level(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            if not node.elts:
+                return 0
+            # containers carry taint but are not themselves arrays
+            return min(1, max(self._level(e) for e in node.elts))
+        if isinstance(node, ast.Dict):
+            vals = [v for v in node.values if v is not None]
+            if not vals:
+                return 0
+            return min(1, max(self._level(v) for v in vals))
+        if isinstance(node, ast.Starred):
+            return self._level(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            lv = max([self._level(g.iter) for g in node.generators]
+                     + [0])
+            return min(1, max(lv, 1) if lv else 0)
+        if isinstance(node, ast.Await):
+            return self._level(node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self._level(node.value)
+        return 0
+
+    def _call_level(self, node):
+        comp = _component(node.func)
+        if comp in _STATIC_FUNCS or comp in _STATIC_ARRAY_FUNCS:
+            return 0
+        root = _root(node.func)
+        dotted = _dotted(node.func) or ""
+        args_lv = max(
+            [self._level(a) for a in node.args]
+            + [self._level(kw.value) for kw in node.keywords]
+            + [0])
+        # jnp./lax./jax.random. calls produce arrays; carve out the
+        # jax callables that DON'T — transform factories return
+        # functions, tree utilities return containers
+        if root == "jax":
+            if comp in _TRACING_CALL_ARGS or comp == "switch":
+                return 0                      # factory → a callable
+            if dotted.startswith(("jax.tree_util.", "jax.tree.")):
+                return min(1, args_lv)        # pytree container
+        if root in _ARRAY_ROOTS or \
+                dotted.startswith(_ARRAY_DOTTED_PREFIXES) or \
+                (root == "jax" and "." in dotted):
+            return 2
+        # method on an array value keeps array-ness (x.sum(), x.astype)
+        if isinstance(node.func, ast.Attribute) and \
+                self._level(node.func.value) == 2:
+            return 2
+        # any other call: taints if anything flowing in is tainted
+        func_lv = self._level(node.func) if \
+            isinstance(node.func, ast.Attribute) else 0
+        return min(1, max(args_lv, func_lv))
+
+    # ---- findings -----------------------------------------------------
+
+    def _emit(self, rule_id, node, message):
+        self.m.emit(rule_id, node, message, self.func_name,
+                    def_line=self.fn.lineno if self.fn is not None
+                    else None)
+
+    # ---- statement walk ----------------------------------------------
+
+    def run(self):
+        if self.fn is None:           # module scope
+            body = self.m.tree.body
+        else:
+            body = self.fn.body
+            self._seed_params()
+        self._prescan_int8(body)
+        self._prescan_jitted(body)
+        for stmt in body:
+            self._visit(stmt)
+
+    def _seed_params(self):
+        a = self.fn.args
+        names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        for i, n in enumerate(names):
+            if i == 0 and n in ("self", "cls"):
+                continue
+            self.tainted.add(n)
+
+    def _prescan_int8(self, body):
+        for n in _walk_shallow(body):
+            if isinstance(n, ast.Assign) and \
+                    _mentions_int8(n.value, self.int8_names):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        self.int8_names.add(t.id)
+
+    def _prescan_jitted(self, body):
+        """Record `<key> = jax.jit(fn, donate_argnums=...)` assignments
+        (key = name or self.attr) for PTL201/PTL202."""
+        for n in _walk_shallow(body):
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            comp = _component(n.value.func)
+            if comp not in ("jit", "pjit"):
+                continue
+            donated = ()
+            for kw in n.value.keywords:
+                if kw.arg == "donate_argnums":
+                    donated = self._literal_ints(kw.value)
+            for t in n.targets:
+                key = _target_key(t)
+                if key:
+                    self.jitted[key] = donated
+
+    @staticmethod
+    def _literal_ints(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+        return ()
+
+    # -- statements --
+
+    def _visit(self, node):
+        meth = getattr(self, "_visit_" + type(node).__name__, None)
+        if meth is not None:
+            meth(node)
+        else:
+            self._generic(node)
+
+    def _generic(self, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            else:
+                self._visit(child)
+
+    def _visit_FunctionDef(self, node):
+        # nested def: traced context (and taint env) flows in; a nested
+        # def inside a host fn is traced only if discovery marked it
+        name = node.name
+        traced = self.traced or name in self.m.raw_traced \
+            or name in self.m.autograph_traced
+        autograph = (self.autograph if self.traced
+                     else name in self.m.autograph_traced)
+        dec_traced, dec_autograph = _decorated_context(node)
+        traced = traced or dec_traced
+        autograph = autograph or dec_autograph
+        sub = _FunctionLinter(self.m, node, traced, autograph,
+                              f"{self.func_name}.{name}" if
+                              self.func_name else name)
+        sub.tainted |= self.tainted
+        sub.array |= self.array
+        sub.int8_names |= self.int8_names
+        sub.jitted.update(self.jitted)
+        sub.run()
+
+    _visit_AsyncFunctionDef = _visit_FunctionDef
+
+    def _visit_ClassDef(self, node):
+        for stmt in node.body:
+            self._visit(stmt)
+
+    def _visit_Assign(self, node):
+        self._expr(node.value)
+        lv = self._level(node.value)
+        for t in node.targets:
+            self._assign_target(t, lv, node.value)
+
+    def _visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._expr(node.value)
+            self._assign_target(node.target, self._level(node.value),
+                                node.value)
+
+    def _visit_AugAssign(self, node):
+        self._expr(node.value)
+        lv = max(self._level(node.value), self._level(node.target))
+        self._assign_target(node.target, lv, node.value)
+
+    def _assign_target(self, t, lv, value):
+        if isinstance(t, ast.Name):
+            self.tainted.discard(t.id)
+            self.array.discard(t.id)
+            if lv >= 1:
+                self.tainted.add(t.id)
+            if lv >= 2:
+                self.array.add(t.id)
+            self._record_store(t.id)
+            if _mentions_int8(value, self.int8_names):
+                self.int8_names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                inner = e.value if isinstance(e, ast.Starred) else e
+                # element-of-container: array-ness survives unpacking
+                self._assign_target(inner, lv and max(lv, 1), value)
+        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+            key = _target_key(t)
+            if key:
+                self._record_store(key)
+
+    def _record_store(self, key):
+        self.consumed.pop(key, None)
+        for stores in self._loop_stores:
+            stores.add(key)
+
+    def _visit_If(self, node):
+        self._expr(node.test)
+        if self.traced and not self.autograph and \
+                self._is_array(node.test):
+            self._emit("PTL103", node.test,
+                       "branching on a jax array value inside a "
+                       "traced function — use lax.cond/jnp.where")
+        rankish = _is_rankish(node.test)
+        if rankish:
+            self.rank_if_depth += 1
+        # branch-aware donation state: a buffer donated on ONE path is
+        # only consumed afterwards if EVERY path donated it (the else
+        # branch of `if fast: out = g(buf)` may legally read buf)
+        saved = dict(self.consumed)
+        for stmt in node.body:
+            self._visit(stmt)
+        after_body = self.consumed
+        self.consumed = dict(saved)
+        for stmt in node.orelse:
+            self._visit(stmt)
+        after_else = self.consumed
+        self.consumed = {k: v for k, v in after_body.items()
+                         if k in after_else}
+        if rankish:
+            self.rank_if_depth -= 1
+
+    def _visit_While(self, node):
+        self._expr(node.test)
+        if self.traced and not self.autograph and \
+                self._is_array(node.test):
+            self._emit("PTL103", node.test,
+                       "while-loop condition on a jax array value "
+                       "inside a traced function — use lax.while_loop")
+        self._loop_body(node.body)
+        for stmt in node.orelse:   # runs once, after the loop
+            self._visit(stmt)
+
+    def _loop_body(self, stmts, _frame_pushed=False):
+        """Visit a loop body with loop-carried donation detection: a
+        buffer donated inside the body and never reassigned there is
+        reused FREED on iteration 2 (the PR-2 class, loop form)."""
+        if not _frame_pushed:
+            self._loop_stores.append(set())
+        pre = set(self.consumed)
+        for stmt in stmts:
+            self._visit(stmt)
+        stores = self._loop_stores.pop()
+        for key, (line, _end) in list(self.consumed.items()):
+            if key not in pre and key not in stores:
+                self._emit(
+                    "PTL201",
+                    types.SimpleNamespace(lineno=line, col_offset=0),
+                    f"'{key}' is donated to a jitted call inside this "
+                    "loop and never reassigned in the body — the next "
+                    "iteration passes a freed buffer")
+                del self.consumed[key]
+
+    def _visit_For(self, node):
+        self._expr(node.iter)
+        if self.traced and not self.autograph and \
+                self._is_array(node.iter):
+            self._emit("PTL104", node.iter,
+                       "iterating a jax array value inside a traced "
+                       "function unrolls the trace — use "
+                       "lax.scan/fori_loop")
+        # the loop target is REASSIGNED by the loop itself each
+        # iteration — record its store inside the loop-store frame so
+        # `for w in ws: step(w, c)` (fresh buffer per pass) stays
+        # silent; the orelse runs ONCE after the loop, outside the
+        # per-iteration donation check
+        self._loop_stores.append(set())
+        self._assign_target(node.target,
+                            min(1, self._level(node.iter)), node.iter)
+        self._loop_body(node.body, _frame_pushed=True)
+        for stmt in node.orelse:
+            self._visit(stmt)
+
+    def _visit_Assert(self, node):
+        self._expr(node.test)
+        if self.traced and not self.autograph and \
+                self._is_array(node.test):
+            self._emit("PTL103", node.test,
+                       "assert on a jax array value inside a traced "
+                       "function — use checkify or a host-side check")
+
+    def _visit_Return(self, node):
+        if node.value is not None:
+            self._expr(node.value)
+
+    def _visit_Expr(self, node):
+        self._expr(node.value)
+
+    # -- expressions --
+
+    def _expr(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+            elif isinstance(n, ast.IfExp):
+                if self.traced and not self.autograph and \
+                        self._is_array(n.test):
+                    self._emit("PTL103", n.test,
+                               "conditional expression on a jax array "
+                               "value inside a traced function — use "
+                               "jnp.where")
+            elif isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Load):
+                self._check_reuse(n.id, n)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.ctx, ast.Load):
+                key = _target_key(n)
+                if key:
+                    self._check_reuse(key, n)
+            elif isinstance(n, ast.Lambda):
+                self._lambda(n)
+
+    def _check_reuse(self, key, node):
+        entry = self.consumed.get(key)
+        if entry is None:
+            return
+        call_line, call_end = entry
+        if node.lineno <= call_end:   # a read inside the call itself
+            return
+        self._emit(
+            "PTL201", node,
+            f"'{key}' was donated to a jitted call on line "
+            f"{call_line} and read again — donated buffers are freed "
+            "by XLA")
+        del self.consumed[key]        # one finding per misuse
+
+    def _lambda(self, node):
+        # a lambda in a TRACED scope runs at trace time (sort keys,
+        # comprehension filters, ...) — lint it with the OUTER taint
+        # env, but do NOT force-taint its own params: what flows into
+        # them depends on the call site (`sorted(dims, key=lambda d:
+        # int(d))` over laundered shape data is legal). Lambdas whose
+        # params ARE tracers — those handed straight to a tracing
+        # transform — get param taint via _check_call below.
+        if not self.traced:
+            return
+        self._lint_lambda(node, taint_params=False)
+
+    def _lint_lambda(self, node, taint_params=True):
+        sub = _FunctionLinter(self.m, None, True, self.autograph,
+                              f"{self.func_name}.<lambda>")
+        sub.tainted = set(self.tainted)
+        sub.array = set(self.array)
+        if taint_params:
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                sub.tainted.add(p.arg)
+        sub.int8_names = set(self.int8_names)
+        sub.jitted = dict(self.jitted)
+        # ast.walk in _expr yields the body node itself first, so a
+        # bare-Call body is checked along with everything nested in it
+        sub._expr(node.body)
+
+    def _consume(self, arg, lineno, end_lineno):
+        """Mark a donated argument (name, self-attr, or a container of
+        them) as consumed for PTL201. `end_lineno` bounds the donating
+        call itself — its own argument reads are not reuse."""
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                self._consume(e, lineno, end_lineno)
+            return
+        akey = _target_key(arg)
+        if akey:
+            self.consumed[akey] = (lineno, end_lineno)
+
+    def _check_call(self, node):
+        comp = _component(node.func)
+        dotted = _dotted(node.func) or ""
+        root = _root(node.func)
+
+        # a lambda handed straight to a tracing transform enters the
+        # trace no matter what scope the call sits in
+        if comp in _TRACING_CALL_ARGS:
+            for pos in _TRACING_CALL_ARGS[comp]:
+                if pos < len(node.args) and \
+                        isinstance(node.args[pos], ast.Lambda):
+                    self._lint_lambda(node.args[pos])
+
+        # ---- trace-context rules ----
+        if self.traced:
+            if comp in _SYNC_BUILTINS and isinstance(node.func, ast.Name) \
+                    and len(node.args) == 1 and \
+                    self._is_tainted(node.args[0]):
+                self._emit("PTL101", node,
+                           f"{comp}() of a traced value forces a host "
+                           "sync / fails under trace — keep it in the "
+                           "program or read it outside the step")
+            if comp in _SYNC_METHODS and \
+                    isinstance(node.func, ast.Attribute) and \
+                    self._is_tainted(node.func.value):
+                self._emit("PTL101", node,
+                           f".{comp}() on a traced value forces a "
+                           "host sync / fails under trace")
+            if root in ("np", "numpy") and \
+                    not dotted.startswith(("np.random.",
+                                           "numpy.random.")) and \
+                    any(self._is_tainted(a) for a in node.args):
+                self._emit("PTL102", node,
+                           f"{dotted}() pulls a traced value out of "
+                           "the XLA program — use jnp instead")
+            if comp == "print" and isinstance(node.func, ast.Name) and \
+                    any(self._is_tainted(a) for a in node.args):
+                self._emit("PTL105", node,
+                           "print() of a traced value fires once at "
+                           "trace time — use jax.debug.print")
+            if (root in ("time", "_time") and comp in _TIME_FUNCS):
+                self._emit("PTL203", node,
+                           f"{dotted}() inside a traced function "
+                           "freezes to a trace-time constant — "
+                           "measure outside the compiled step")
+            if root == "random" or \
+                    dotted.startswith(("np.random.", "numpy.random.")):
+                self._emit("PTL204", node,
+                           f"{dotted}() draws host randomness at "
+                           "trace time — thread a jax.random key "
+                           "through the program instead")
+
+        # ---- host-level rules ----
+        if comp in _DOT_FUNCS and root in ("jnp", "lax", "jax"):
+            # preferred_element_type may ride positionally on the lax
+            # API: dot_general(lhs, rhs, dnums, precision, PREF) /
+            # dot(lhs, rhs, precision, PREF)
+            has_pref = any(kw.arg == "preferred_element_type"
+                           for kw in node.keywords) or \
+                (comp == "dot_general" and len(node.args) >= 5) or \
+                (comp == "dot" and len(node.args) >= 4)
+            if not has_pref and any(
+                    _mentions_int8(a, self.int8_names)
+                    for a in node.args):
+                self._emit("PTL301", node,
+                           f"{dotted}() on int8 operands without "
+                           "preferred_element_type accumulates in "
+                           "int8 and overflows — pass "
+                           "preferred_element_type=jnp.int32")
+
+        if comp in _COLLECTIVE_FUNCS and self.rank_if_depth > 0:
+            self._emit("PTL401", node,
+                       f"collective {comp}() under a rank-conditioned "
+                       "branch — peers that skip (or reorder) it "
+                       "deadlock the pod")
+
+        # PTL201/202: calls THROUGH a recorded jitted callable
+        key = _target_key(node.func)
+        if key and key in self.jitted:
+            donated = self.jitted[key]
+            starred = any(isinstance(a, ast.Starred) for a in node.args)
+            if not starred:
+                end = getattr(node, "end_lineno", node.lineno)
+                for pos in donated:
+                    if pos < len(node.args):
+                        self._consume(node.args[pos], node.lineno, end)
+                for pos, a in enumerate(node.args):
+                    kind = ("literal" if isinstance(a, ast.Constant)
+                            and isinstance(a.value, (int, float))
+                            and not isinstance(a.value, bool)
+                            else "other")
+                    seen = self.arg_kinds.setdefault((key, pos), set())
+                    if kind == "literal" and "other" in seen or \
+                            kind == "other" and "literal" in seen:
+                        self._emit(
+                            "PTL202", node,
+                            f"jitted '{key}' takes a python scalar "
+                            f"literal and a non-literal at position "
+                            f"{pos} across call sites — weak vs "
+                            "committed types compile two executables; "
+                            "pass jnp.asarray(..., dtype=...) "
+                            "consistently")
+                        seen.clear()
+                    seen.add(kind)
+
+
+class _ModuleLint:
+    """One source file: discovery + per-function passes + suppression."""
+
+    def __init__(self, path, source):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        disc = _TracedDiscovery()
+        disc.visit(self.tree)
+        self.raw_traced = disc.raw
+        self.autograph_traced = disc.autograph
+        self.findings = []
+        self.suppressed = 0
+        # class-scope `self._x = jax.jit(...)` assignments are visible
+        # to every method of the module (the TrainStep idiom assigns in
+        # _build and calls in __call__)
+        self.jitted_attrs = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    _component(n.value.func) in ("jit", "pjit"):
+                donated = ()
+                for kw in n.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        donated = _FunctionLinter._literal_ints(kw.value)
+                for t in n.targets:
+                    key = _target_key(t)
+                    if key and key.startswith(("self.", "cls.")):
+                        self.jitted_attrs[key] = donated
+
+    def _suppressions(self, lineno):
+        if lineno is None or lineno < 1 or lineno > len(self.lines):
+            return set()
+        m = re.search(r"#\s*ptlint:\s*disable=([\w,\- ]+)",
+                      self.lines[lineno - 1])
+        if not m:
+            return set()
+        out = set()
+        for tok in m.group(1).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            out.add(_SLUG_TO_ID.get(tok, tok))
+        return out
+
+    def emit(self, rule_id, node, message, func_name, def_line=None):
+        rule = RULES[rule_id]
+        line = getattr(node, "lineno", 1)
+        sup = self._suppressions(line) | self._suppressions(def_line)
+        if rule_id in sup or "all" in sup:
+            self.suppressed += 1
+            return
+        self.findings.append(Finding(
+            rule=rule_id, name=rule.name, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            func=func_name))
+
+    def run(self):
+        if re.search(r"#\s*ptlint:\s*skip-file", self.source):
+            return self
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self._run_def(node, prefix="")
+        # module top-level statements (int8 dots / collectives at
+        # import time)
+        top = _FunctionLinter(self, None, False, False, "<module>")
+        top._prescan_int8(self.tree.body)
+        top._prescan_jitted(self.tree.body)
+        for stmt in self.tree.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                top._visit(stmt)
+        # lambdas are visited both in their enclosing expression walk
+        # and as sub-scopes — dedup identical findings
+        seen, unique = set(), []
+        for f in self.findings:
+            k = (f.rule, f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                unique.append(f)
+        self.findings = unique
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self
+
+    def _run_def(self, node, prefix):
+        if isinstance(node, ast.ClassDef):
+            cprefix = f"{prefix}{node.name}."
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    self._run_def(child, cprefix)
+            return
+        name = node.name
+        traced = name in self.raw_traced or name in self.autograph_traced
+        autograph = name in self.autograph_traced
+        dec_traced, dec_autograph = _decorated_context(node)
+        traced = traced or dec_traced
+        autograph = autograph or dec_autograph
+        _FunctionLinter(self, node, traced, autograph,
+                        prefix + name).run()
+
+
+# --------------------------------------------------------------- frontend
+
+def lint_source(source, path="<string>"):
+    """Lint one source string. Returns (findings, suppressed_count)."""
+    try:
+        ml = _ModuleLint(path, source).run()
+    except SyntaxError as e:
+        return [Finding(rule="PTL000", name="syntax-error", path=path,
+                        line=e.lineno or 1, col=e.offset or 0,
+                        message=f"cannot parse: {e.msg}")], 0
+    return ml.findings, ml.suppressed
+
+
+def lint_file(path):
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path)
+
+
+_DEFAULT_EXCLUDE = ("__pycache__", ".git", ".jax_cache")
+
+
+def iter_python_files(paths, exclude=_DEFAULT_EXCLUDE):
+    """Expand files / directories / globs into .py files, sorted."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in exclude]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            import glob as _glob
+
+            out.extend(f for f in _glob.glob(p, recursive=True)
+                       if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def lint_paths(paths, select=None, ignore=None):
+    """Lint files/dirs/globs.
+
+    Returns dict: {"findings": [Finding], "suppressed": int,
+    "files": int, "version": PTLINT_VERSION}. `select`/`ignore` filter
+    by rule id or slug (fnmatch patterns allowed, e.g. 'PTL1*').
+    """
+    def _norm(pats):
+        return [_SLUG_TO_ID.get(p, p) for p in pats or ()]
+
+    select = _norm(select)
+    ignore = _norm(ignore)
+
+    def keep(f):
+        if select and not any(fnmatch.fnmatch(f.rule, p)
+                              for p in select):
+            return False
+        if ignore and any(fnmatch.fnmatch(f.rule, p) for p in ignore):
+            return False
+        return True
+
+    findings, suppressed, nfiles = [], 0, 0
+    for path in iter_python_files(paths):
+        nfiles += 1
+        fs, sup = lint_file(path)
+        findings.extend(f for f in fs if keep(f))
+        suppressed += sup
+    return {"findings": findings, "suppressed": suppressed,
+            "files": nfiles, "version": PTLINT_VERSION}

@@ -228,7 +228,7 @@ class DistributedTrainStep:
                 compiled = jitted.lower(
                     [p._value for p in train_objs],
                     [p._value for p in frozen_objs],
-                    self._opt_states, self.optimizer.get_lr(),
+                    self._opt_states, np.float32(self.optimizer.get_lr()),
                     batch_vals,
                     jnp.asarray(self.optimizer._step_count, jnp.uint32),
                     self._base_key).compile()
@@ -257,7 +257,10 @@ class DistributedTrainStep:
                                                self._trainable) if t]
         frozen_vals = [p._value for p, t in zip(self._param_objs,
                                                 self._trainable) if not t]
-        lr = self.optimizer.get_lr()
+        # committed f32, not a weak python float — same reasoning as
+        # jit.TrainStep (weak-vs-committed is a retrace hazard, and the
+        # AOT restored path is shape-AND-dtype frozen)
+        lr = np.float32(self.optimizer.get_lr())
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
         loss, new_vals, self._opt_states, new_frozen = self._compiled(
             train_vals, frozen_vals, self._opt_states, lr, batch_vals,

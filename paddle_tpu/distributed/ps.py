@@ -992,6 +992,17 @@ class SparseTrainStep(_TrainStepBase):
             "rows/inv operands; lower a dense TrainStep for memory "
             "analysis instead")
 
+    def compile_stats(self, check_donation=False):
+        if check_donation:
+            # same reason lower() is unsupported: the donation probe
+            # would re-lower with TrainStep's 7-arg layout against this
+            # step's 9-arg signature
+            raise NotImplementedError(
+                "SparseTrainStep's compiled signature carries per-step "
+                "rows/inv operands; run the donation probe on a dense "
+                "TrainStep of the same model instead")
+        return super().compile_stats()
+
     def _build(self):
         import jax
 
@@ -1066,7 +1077,8 @@ class SparseTrainStep(_TrainStepBase):
                       for b in batch]
         loss, new_vals, self._opt_states, new_frozen, rgrads = \
             self._compiled(train_vals, frozen_vals, self._opt_states,
-                           self.optimizer.get_lr(), rows_vals, inv_vals,
+                           np.float32(self.optimizer.get_lr()),
+                           rows_vals, inv_vals,
                            batch_vals,
                            jnp.asarray(self.optimizer._step_count,
                                        jnp.uint32), self._base_key)

@@ -110,6 +110,9 @@ _KV_POOL_BYTES = _obs.gauge(
     "resident KV page-pool bytes (pools + int8 scale planes), by the "
     "pool dtype (quantized runtime: docs/QUANTIZATION.md)",
     labelnames=("dtype",))
+# shared with jit.TrainStep's probe — ONE definition (the registry
+# would raise on a labelnames divergence between two copies)
+from ..jit import _DONATION_HELD
 
 
 class PoolExhausted(RuntimeError):
@@ -446,11 +449,36 @@ class LLMEngine:
         s = self.stats["steps"]
         return self.stats["occupancy_sum"] / s if s else 0.0
 
-    def compile_stats(self):
+    def compile_stats(self, check_donation=False):
         """Executable count of the decode step (the jit dispatch-cache
         size) — the zero-recompile-after-warmup probe the engine test
-        asserts on."""
-        return {"executables": self._step_fn.cache_size()}
+        asserts on.
+
+        `check_donation=True` additionally re-lowers the decode step
+        through the live compile-cache path and reports whether the
+        donated kv pools (and int8 scale planes) actually aliased
+        outputs in the executable — donation silently dropping is the
+        measured-25%-slower PR-2 serving bug (docs/RESILIENCE.md).
+        Adds a `"donation"` key: {"expected", "aliased", "held",
+        "dropped"}.
+
+        THREADING: the donation probe re-TRACES the decode step, and
+        the trace body temporarily swaps the model's live parameter
+        values for tracers — call it from the thread that owns the
+        engine (direct-drive callers; or around, never during, an
+        `LLMServer` loop tick). The plain `check_donation=False` form
+        is read-only and always safe.
+        """
+        out = {"executables": self._step_fn.cache_size()}
+        if not check_donation:
+            return out
+        from .. import analysis
+
+        rep = analysis.analyze_step(self, check_donation=True)
+        out["donation"] = rep.donation
+        _DONATION_HELD.labels(step="paged_decode").set(
+            1.0 if rep.donation["held"] else 0.0)
+        return out
 
     def pool_bytes(self):
         """Resident KV pool bytes across layers — int8 scale planes

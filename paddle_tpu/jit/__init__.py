@@ -45,6 +45,13 @@ _GRAD_NORM = _obs.histogram(
     "global grad L2 norm per step (full telemetry only)",
     buckets=(0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0,
              100.0, 300.0, 1000.0))
+_DONATION_HELD = _obs.gauge(
+    "pt_step_donation_held",
+    "1 when every donated buffer of the compiled step aliased an "
+    "output at the last compile_stats(check_donation=True) probe — 0 "
+    "is the jax-0.4.x persistent-cache aliasing bug resurfacing "
+    "(analysis.donation_coverage; docs/ANALYSIS.md)",
+    labelnames=("step",))
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TranslatedLayer",
            "InputSpec", "TrainStep", "ignore_module", "enable_to_static"]
@@ -455,6 +462,7 @@ class TrainStep:
         self._trainable = [not p.stop_gradient for p in self._param_objs]
         self._opt_states = None
         self._compiled = None
+        self._last_batch_avals = None
         self._telemetry_full = False
         # shape-churn accounting (see __call__'s recompile guard)
         self._batch_signatures = set()
@@ -537,8 +545,29 @@ class TrainStep:
         # donate param + optimizer-state + buffer arrays so XLA updates in
         # place (no HBM copy per step); donate_params=False keeps the
         # pre-step arrays readable (e.g. for step-over-step diffing)
-        donate = (0, 1, 2) if self.donate_params else ()
-        self._compiled = jax.jit(step, donate_argnums=donate)
+        self._compiled = jax.jit(step, donate_argnums=self._donate_argnums)
+
+    # the compiled step's signature, ONE definition for every off-path
+    # consumer (lower(), the donation probe, analysis.analyze_step) —
+    # __call__ inlines the same layout on the hot path; a signature
+    # change must touch _build/__call__ and this block together
+    _STEP_ARG_NAMES = ("params", "buffers", "opt_state", "lr", "batch",
+                       "step_idx", "base_key")
+
+    @property
+    def _donate_argnums(self):
+        return (0, 1, 2) if self.donate_params else ()
+
+    def _step_args(self, batch_vals):
+        """Positional args of the compiled step for the CURRENT live
+        state; `batch_vals` may be arrays or ShapeDtypeStructs."""
+        train_vals, frozen_vals = self._split_vals()
+        states = (self._opt_states if self._opt_states is not None
+                  else self.optimizer.init_states_tree(train_vals))
+        return (train_vals, frozen_vals, states,
+                np.float32(self.optimizer.get_lr()), list(batch_vals),
+                jnp.asarray(self.optimizer._step_count, jnp.uint32),
+                self._base_key)
 
     def _split_vals(self):
         train_vals = [p._value for p, t in zip(self._param_objs,
@@ -553,15 +582,9 @@ class TrainStep:
         is how tools/membudget.py measures HBM budgets off-hardware)."""
         if self._compiled is None:
             self._build()
-        train_vals, frozen_vals = self._split_vals()
-        states = (self._opt_states if self._opt_states is not None
-                  else self.optimizer.init_states_tree(train_vals))
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
-        return self._compiled.lower(
-            train_vals, frozen_vals, states, self.optimizer.get_lr(),
-            batch_vals, jnp.asarray(self.optimizer._step_count,
-                                    jnp.uint32), self._base_key)
+        return self._compiled.lower(*self._step_args(batch_vals))
 
     def __call__(self, *batch):
         if self._compiled is None:
@@ -580,6 +603,13 @@ class TrainStep:
         if sig not in self._batch_signatures:
             self._batch_signatures.add(sig)
             _COMPILES_TOTAL.inc()
+            # abstract batch signature for the donation probe
+            # (compile_stats(check_donation=True) re-lowers without a
+            # batch) — captured per SIGNATURE, not per step: this is
+            # the dispatch hot path
+            self._last_batch_avals = [
+                jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for v in batch_vals]
         if (len(self._batch_signatures) == self.max_batch_signatures + 1
                 and not self._sig_warned):
             self._sig_warned = True
@@ -592,7 +622,14 @@ class TrainStep:
                 "io.BucketedBatchSampler + io.pad_to_bucket_collate "
                 "compile at most one program per bucket.",
                 RuntimeWarning, stacklevel=2)
-        lr = self.optimizer.get_lr()
+        # lr rides as a COMMITTED f32 scalar, not a bare python float: a
+        # weak-typed scalar hashes differently from any committed array
+        # (one stray jnp.asarray at a call site = a second executable),
+        # and under x64 it drags f64 scalar chains through the program
+        # (analysis.analyze_step flagged 62 f64 converts on the tier-1
+        # GPT step). np.float32 keeps the python-float update path free
+        # of device transfers.
+        lr = np.float32(self.optimizer.get_lr())
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
         t0 = _time.perf_counter()
         with _trace_span("jit.TrainStep",
@@ -625,13 +662,38 @@ class TrainStep:
             bm.auto_step(num_samples=n)
         return Tensor(loss)
 
-    def compile_stats(self):
+    def compile_stats(self, check_donation=False):
         """Recompile probe (same shape as LLMEngine.compile_stats):
         batch signatures seen + the jit dispatch-cache executable
-        count. Steady-state training holds both at 1."""
+        count. Steady-state training holds both at 1.
+
+        `check_donation=True` additionally re-lowers the current
+        signature through the live compile-cache path and reports
+        whether every donated buffer (params/buffers/opt state)
+        actually aliased an output in the executable — the mechanical
+        regression guard for the jax 0.4.x persistent-cache bug that
+        silently dropped donation (docs/RESILIENCE.md). Adds a
+        `"donation"` key: {"expected", "aliased", "held", "dropped"}.
+        """
         n = getattr(self._compiled, "_cache_size", None)
-        return {"batch_signatures": len(self._batch_signatures),
-                "executables": int(n()) if callable(n) else -1}
+        out = {"batch_signatures": len(self._batch_signatures),
+               "executables": int(n()) if callable(n) else -1}
+        if not check_donation:
+            return out
+        if self._compiled is None or \
+                getattr(self, "_last_batch_avals", None) is None:
+            raise RuntimeError(
+                "compile_stats(check_donation=True) needs at least one "
+                "executed step (the probe re-lowers the last batch "
+                "signature)")
+        from ..analysis import donation_coverage
+
+        out["donation"] = donation_coverage(
+            self._compiled, self._step_args(self._last_batch_avals),
+            self._donate_argnums, names=self._STEP_ARG_NAMES)
+        _DONATION_HELD.labels(step="train").set(
+            1.0 if out["donation"]["held"] else 0.0)
+        return out
 
 
 class ProgramTranslator:

@@ -1,0 +1,14 @@
+"""ptlint seeded violation: PTL101 host-sync-in-trace.
+
+The shipped bug this reproduces: host-sync float(loss) on the training
+hot path. Never executed — linted only (tests/test_analysis.py).
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    loss = jnp.mean(jnp.square(x))
+    scalar = float(loss)  # FLAG
+    return x * scalar

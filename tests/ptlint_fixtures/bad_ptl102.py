@@ -1,0 +1,16 @@
+"""ptlint seeded violation: PTL102 numpy-on-tracer.
+
+np.asarray of a traced value falls out of the XLA program. Never
+executed — linted only.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    y = jnp.tanh(x)
+    host = np.asarray(y)  # FLAG
+    return host

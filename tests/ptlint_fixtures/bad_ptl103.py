@@ -1,0 +1,15 @@
+"""ptlint seeded violation: PTL103 tracer-branch.
+
+Python `if` on a tracer crashes the trace (raw jit — no AutoGraph).
+Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    s = jnp.sum(x)
+    if s > 0:  # FLAG
+        return x - 1
+    return x + 1

@@ -1,0 +1,15 @@
+"""ptlint seeded violation: PTL104 tracer-loop.
+
+Python `for` over a tracer unrolls (or crashes) the trace. Never
+executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    acc = 0.0
+    for row in jnp.cumsum(x, axis=0):  # FLAG
+        acc = acc + row
+    return acc

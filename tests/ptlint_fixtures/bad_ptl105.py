@@ -1,0 +1,14 @@
+"""ptlint seeded violation: PTL105 print-in-trace.
+
+print() fires once at trace time with an abstract value — use
+jax.debug.print. Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    y = jnp.exp(x)
+    print(y)  # FLAG
+    return y

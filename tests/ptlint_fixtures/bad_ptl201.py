@@ -1,0 +1,14 @@
+"""ptlint seeded violation: PTL201 donated-reuse.
+
+The PR-2 class: a buffer passed at a donated argument position is
+freed by XLA — reading it afterwards is use-after-free. Never
+executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def serve(weights, batch):
+    step = jax.jit(lambda w, b: w * b, donate_argnums=(0,))
+    out = step(weights, batch)
+    return out + weights.sum()  # FLAG

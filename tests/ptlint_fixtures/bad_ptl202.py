@@ -1,0 +1,15 @@
+"""ptlint seeded violation: PTL202 mixed-weak-arg.
+
+The same jitted callable fed a weak python scalar AND a committed
+array at one position compiles two executables (the PR-1
+retrace-churn class). Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def train(x):
+    scale = jax.jit(lambda a, s: a * s)
+    warm = scale(x, 0.5)
+    cold = scale(x, jnp.float32(0.5))  # FLAG
+    return warm, cold

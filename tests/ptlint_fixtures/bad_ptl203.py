@@ -1,0 +1,15 @@
+"""ptlint seeded violation: PTL203 impure-time.
+
+A wall-clock read inside a traced function freezes to a trace-time
+constant. Never executed — linted only.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()  # FLAG
+    return x + t0

@@ -1,0 +1,16 @@
+"""ptlint seeded violation: PTL204 impure-random.
+
+Host RNG inside a traced function bakes ONE draw into the compiled
+program (the same-mask-every-step dropout bug PR 1 fixed). Never
+executed — linted only.
+"""
+import random
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    keep = random.random()  # FLAG
+    return x * keep

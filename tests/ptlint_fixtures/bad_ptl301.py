@@ -1,0 +1,15 @@
+"""ptlint seeded violation: PTL301 int8-dot-no-preferred.
+
+int8 x int8 accumulating in int8 overflows silently; the quantized
+runtime's contract is preferred_element_type=jnp.int32 (the MXU-native
+path). Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def int8_matmul(a, b):
+    ai = a.astype(jnp.int8)
+    bi = b.astype(jnp.int8)
+    return lax.dot_general(ai, bi, (((1,), (0,)), ((), ())))  # FLAG

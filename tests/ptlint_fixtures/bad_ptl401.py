@@ -1,0 +1,12 @@
+"""ptlint seeded violation: PTL401 rank-divergent-collective.
+
+The PR-4 wire-format deadlock shape: one rank enters a collective its
+peers skip. Never executed — linted only.
+"""
+from paddle_tpu.distributed import xproc
+
+
+def sync_masters(rank, grads):
+    if rank == 0:
+        xproc.all_reduce_np(grads)  # FLAG
+    return grads

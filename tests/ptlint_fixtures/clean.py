@@ -1,0 +1,81 @@
+"""ptlint fixture: the CORRECT version of every seeded violation —
+zero findings expected (the false-positive fence for
+tests/test_analysis.py).
+
+Each block mirrors one bad_ptl*.py fixture with the idiomatic fix.
+Never executed — linted only.
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.distributed import xproc
+
+
+@jax.jit
+def step_in_program(x):
+    # PTL101/102: keep reductions in the program; branch via where
+    loss = jnp.mean(jnp.square(x))
+    return x * loss
+
+
+@jax.jit
+def step_static_branches(x):
+    # PTL103/104: shape/dtype reads are static — branching on them is
+    # legal; tracer selection goes through jnp.where / lax.cond
+    if x.ndim == 2 and x.shape[0] > 1:
+        x = x.reshape([-1])
+    s = jnp.sum(x)
+    picked = jnp.where(s > 0, x - 1, x + 1)
+    bounded = lax.fori_loop(0, 4, lambda i, a: a * 0.5, picked)
+    return bounded
+
+
+@jax.jit
+def step_debug_print(x):
+    y = jnp.exp(x)
+    jax.debug.print("y0={v}", v=y[0])  # per-step, not trace-time
+    return y
+
+
+def serve(weights, batch):
+    # PTL201: read everything you need BEFORE donating
+    norm = weights.sum()
+    step = jax.jit(lambda w, b: w * b, donate_argnums=(0,))
+    out = step(weights, batch)
+    return out + norm
+
+
+def train(x):
+    # PTL202: one committed dtype at every call site
+    scale = jax.jit(lambda a, s: a * s)
+    warm = scale(x, jnp.float32(0.5))
+    cold = scale(x, jnp.float32(2.0))
+    return warm, cold
+
+
+def timed_host_loop(step_fn, x):
+    # PTL203/204: clocks and host RNG live OUTSIDE the trace
+    t0 = time.perf_counter()
+    noise = np.random.default_rng(0).standard_normal(x.shape)
+    out = step_fn(x + noise)
+    return out, time.perf_counter() - t0
+
+
+def int8_matmul(a, b):
+    # PTL301: int8 dots accumulate in int32 (the MXU contract)
+    ai = a.astype(jnp.int8)
+    bi = b.astype(jnp.int8)
+    return lax.dot_general(ai, bi, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def sync_all(rank, grads):
+    # PTL401: every rank makes the same collective sequence; the
+    # rank-dependent part is data, not control flow
+    contribution = grads if rank == 0 else np.zeros_like(grads)
+    return xproc.all_reduce_np(contribution)

@@ -1,0 +1,447 @@
+"""jit-safety static analysis (paddle_tpu/analysis/ + tools/ptlint.py).
+
+The ISSUE-5 acceptance suite:
+
+* every lint rule fires on its seeded-violation fixture
+  (tests/ptlint_fixtures/bad_ptl*.py — rule id AND line asserted via
+  the `# FLAG` marker), and the mirrored correct idioms in clean.py
+  stay silent (the false-positive fence);
+* suppression comments (line-level, def-level, skip-file) work;
+* the ptlint CLI gates: exit 1 + JSON findings on the fixtures, exit 0
+  on the shipped tree;
+* the SELF-CHECK: linting the shipped paddle_tpu/ + tools/ + bench.py
+  + examples/ in-process pins the finding count at ZERO, so any new
+  violation fails tier-1;
+* `analyze_step()` reports donation coverage / dtype promotions /
+  host callbacks correctly on the tier-1 GPT TrainStep and on the
+  int8 paged decode executable, and catches seeded donation drops,
+  f64 promotion, and host callbacks on purpose-built jit functions.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis, nn
+from paddle_tpu.analysis import (
+    Finding, PTLINT_VERSION, RULES, analyze_jit, analyze_step,
+    lint_file, lint_paths, lint_source, signature_diff)
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "ptlint_fixtures")
+BAD_FIXTURES = sorted(
+    f for f in os.listdir(FIXTURES) if f.startswith("bad_ptl"))
+# the tree the CI gate pins at zero findings (tools/ptlint.py default)
+GATED_PATHS = [os.path.join(REPO, p)
+               for p in ("paddle_tpu", "tools", "bench.py", "examples")]
+
+
+# --------------------------------------------------------------------
+# seeded-violation fixtures: rule id + line, one per rule
+# --------------------------------------------------------------------
+
+def _expected(path):
+    rule = "PTL" + re.search(r"bad_ptl(\d+)\.py", path).group(1)
+    with open(path) as f:
+        lines = [i + 1 for i, ln in enumerate(f) if "# FLAG" in ln]
+    assert len(lines) == 1, f"fixture {path} needs exactly one # FLAG"
+    return rule, lines[0]
+
+
+@pytest.mark.parametrize("fname", BAD_FIXTURES)
+def test_seeded_violation_flags_rule_and_line(fname):
+    path = os.path.join(FIXTURES, fname)
+    rule, line = _expected(path)
+    findings, suppressed = lint_file(path)
+    assert [f.rule for f in findings] == [rule], findings
+    assert findings[0].line == line, (findings[0], line)
+    assert suppressed == 0
+    assert findings[0].name == RULES[rule].name
+
+
+def test_fixtures_cover_at_least_eight_rules():
+    """The acceptance floor: >= 8 distinct rule ids on the seeded
+    fixtures (we ship 11)."""
+    rules = {_expected(os.path.join(FIXTURES, f))[0]
+             for f in BAD_FIXTURES}
+    assert len(rules) >= 8, rules
+    assert rules <= set(RULES), rules - set(RULES)
+
+
+def test_clean_fixture_has_zero_findings():
+    """Correct versions of every seeded idiom — the false-positive
+    fence. Shape/dtype branches, lax control flow, host-side clocks,
+    preferred_element_type dots, symmetric collectives."""
+    findings, suppressed = lint_file(os.path.join(FIXTURES, "clean.py"))
+    assert findings == [], [f.format() for f in findings]
+    assert suppressed == 0
+
+
+# --------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------
+
+_BAD_SRC = """
+import time
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    t = time.time(){line_sup}
+    return x + t
+"""
+
+
+def test_line_suppression_by_id_and_slug():
+    hot, _ = lint_source(_BAD_SRC.format(line_sup=""), "s.py")
+    assert [f.rule for f in hot] == ["PTL203"]
+    for tag in ("PTL203", "impure-time", "all",
+                "PTL101, PTL203"):
+        src = _BAD_SRC.format(
+            line_sup=f"  # ptlint: disable={tag}")
+        findings, suppressed = lint_source(src, "s.py")
+        assert findings == [] and suppressed == 1, (tag, findings)
+
+
+def test_def_level_and_file_level_suppression():
+    src = ("import time\nimport jax\n\n"
+           "@jax.jit\n"
+           "def step(x):  # ptlint: disable=PTL203\n"
+           "    a = time.time()\n"
+           "    b = time.monotonic()\n"
+           "    return x + a + b\n")
+    findings, suppressed = lint_source(src, "s.py")
+    assert findings == [] and suppressed == 2
+    skip = "# ptlint: skip-file\n" + _BAD_SRC.format(line_sup="")
+    findings, _ = lint_source(skip, "s.py")
+    assert findings == []
+
+
+def test_non_matching_suppression_keeps_finding():
+    src = _BAD_SRC.format(line_sup="  # ptlint: disable=PTL999")
+    findings, suppressed = lint_source(src, "s.py")
+    assert [f.rule for f in findings] == ["PTL203"]
+    assert suppressed == 0
+
+
+# --------------------------------------------------------------------
+# select/ignore + CLI gate
+# --------------------------------------------------------------------
+
+def test_lint_paths_select_and_ignore():
+    res = lint_paths([FIXTURES], select=["PTL1*"])
+    assert {f.rule for f in res["findings"]} == {
+        "PTL101", "PTL102", "PTL103", "PTL104", "PTL105"}
+    res = lint_paths([FIXTURES], ignore=["PTL1*", "int8-dot-no-preferred"])
+    assert {f.rule for f in res["findings"]} == {
+        "PTL201", "PTL202", "PTL203", "PTL204", "PTL401"}
+
+
+def test_ptlint_cli_json_exit_codes():
+    """The CI-gate contract: nonzero exit + parseable JSON with >= 8
+    distinct rule ids on the fixtures; --version prints the version."""
+    cli = os.path.join(REPO, "tools", "ptlint.py")
+    proc = subprocess.run(
+        [sys.executable, cli, "--json", FIXTURES],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["version"] == PTLINT_VERSION
+    rules = {f["rule"] for f in out["findings"]}
+    assert len(rules) >= 8, rules
+    assert out["num_findings"] == len(out["findings"])
+
+    proc = subprocess.run([sys.executable, cli, "--version"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert proc.stdout.strip() == PTLINT_VERSION
+
+
+def test_ptlint_self_check_shipped_tree_is_clean():
+    """THE gate: the shipped tree lints at zero findings, in-process
+    (fast — no subprocess), so any new violation fails tier-1. Ran
+    after the ISSUE-5 dogfood pass; suppressions in tree are visible
+    in the returned count, not silently dropped."""
+    res = lint_paths(GATED_PATHS)
+    assert res["files"] > 200, "gate lost its tree?"
+    assert res["findings"] == [], \
+        "\n".join(f.format() for f in res["findings"])
+
+
+# --------------------------------------------------------------------
+# analyze_step: the tier-1 GPT TrainStep
+# --------------------------------------------------------------------
+
+def _gpt_train_step(seed=0):
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_tiny
+
+    paddle.seed(seed)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), y.reshape([-1]))
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(seed)
+    x = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16)))
+    y = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16)))
+    return step, x, y
+
+
+def test_analyze_step_gpt_trainstep():
+    step, x, y = _gpt_train_step()
+    rep = analyze_step(step, x, y)
+    assert rep.kind == "TrainStep"
+    # donation: params + buffers + opt state all alias in the compiled
+    # executable — the PR-2 cache bug caught mechanically
+    assert rep.donation["held"], rep.donation
+    assert rep.donation["expected"] == rep.donation["aliased"] > 0
+    assert rep.donation["dropped"] == []
+    # no silent float upcasts, no host round trips, no weak-typed
+    # inputs (lr rides as committed f32 since the ISSUE-5 dogfood fix)
+    assert rep.promotions == {}, rep.promotions
+    assert rep.host_calls == {}, rep.host_calls
+    assert rep.weak_type_args == [], rep.weak_type_args
+    assert rep.ok(), [f.format() for f in rep.findings]
+    # the signature is diffable and stable against itself
+    assert signature_diff(rep.signature, rep.signature) == []
+
+
+def test_trainstep_compile_stats_donation_probe():
+    """The recompile probe path (pt_train_compiles_total /
+    compile_stats) now also proves donation held."""
+    step, x, y = _gpt_train_step(seed=1)
+    with pytest.raises(RuntimeError, match="executed step"):
+        step.compile_stats(check_donation=True)
+    step(x, y)
+    st = step.compile_stats(check_donation=True)
+    assert st["batch_signatures"] == 1 and st["executables"] == 1
+    assert st["donation"]["held"], st["donation"]
+    # donate_params=False: probe reports the (empty) donation honestly
+    step2 = paddle.jit.TrainStep(step.model, step.loss_fn,
+                                 step.optimizer, donate_params=False)
+    step2(x, y)
+    st2 = step2.compile_stats(check_donation=True)
+    assert st2["donation"] == {"expected": 0, "aliased": 0,
+                               "held": True, "dropped": []}
+
+
+# --------------------------------------------------------------------
+# analyze_step: the int8 paged decode executable
+# --------------------------------------------------------------------
+
+def test_analyze_step_int8_paged_decode():
+    from paddle_tpu.inference.llm_engine import (
+        LLMEngine, LLMEngineConfig)
+    from paddle_tpu.quantization import runtime as qrt
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_tiny
+
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    qrt.quantize_model_int8(model)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64,
+        kv_dtype="int8"))
+    rep = analyze_step(eng)
+    assert rep.kind == "PagedDecode"
+    # int8 pools AND fp32 scale planes: one donated pytree, every leaf
+    # aliased (2 tensors x k/v x num_layers)
+    assert rep.donation["expected"] == 4 * cfg.num_layers
+    assert rep.donation["held"], rep.donation
+    # the quantized cache is VISIBLE in the conversion map: rows
+    # quantize on write (f32->int8) and dequantize on gather
+    # (int8->f32) — "correctly reports dtype promotions" evidence
+    assert any(k.startswith("float32->int8")
+               for k in rep.conversions), rep.conversions
+    assert any(k.startswith("int8->float32")
+               for k in rep.conversions), rep.conversions
+    assert rep.host_calls == {} and rep.ok()
+
+
+# --------------------------------------------------------------------
+# analyze_jit: seeded defects the analyzer must catch
+# --------------------------------------------------------------------
+
+def test_analyzer_catches_dropped_donation():
+    import jax
+    import jax.numpy as jnp
+
+    # `a` is donated but UNUSED — XLA cannot alias it to any output,
+    # which is exactly what a silently-dropped donation looks like
+    fn = jax.jit(lambda a, b: b * 2.0, donate_argnums=(0,))
+    rep = analyze_jit(fn, (jnp.zeros((8,), jnp.float32),
+                           jnp.zeros((8,), jnp.float32)),
+                      donate_argnums=(0,), kind="seeded")
+    assert not rep.donation["held"]
+    assert rep.donation["dropped"] == ["arg0"]
+    assert [f.rule for f in rep.findings] == ["PTL501"]
+
+
+def test_donated_reuse_is_branch_and_loop_aware():
+    """PTL201 flow sensitivity: a read on a branch that did NOT donate
+    is legal; donation on every branch makes the later read a finding;
+    a donating call inside a loop with no reassignment reuses a freed
+    buffer on iteration 2 (the PR-2 class, loop form); reassigned
+    carries and fresh per-iteration buffers stay silent."""
+    one_branch = (
+        "import jax\n"
+        "def serve(w, b, fast):\n"
+        "    step = jax.jit(lambda a, c: a * c, donate_argnums=(0,))\n"
+        "    if fast:\n"
+        "        out = step(w, b)\n"
+        "    else:\n"
+        "        out = w + b\n"
+        "    return out\n")
+    findings, _ = lint_source(one_branch, "s.py")
+    assert findings == [], [f.format() for f in findings]
+    both = one_branch.replace("out = w + b", "out = step(w, 2 * b)") \
+                     .replace("return out", "return out + w")
+    findings, _ = lint_source(both, "s.py")
+    assert [f.rule for f in findings] == ["PTL201"]
+    loop = (
+        "import jax, jax.numpy as jnp\n"
+        "def serve(w, bs):\n"
+        "    step = jax.jit(lambda a, c: a * c, donate_argnums=(0,))\n"
+        "    outs = []\n"
+        "    for b in bs:\n"
+        "        outs.append(step(w, b))\n"
+        "    return outs\n")
+    findings, _ = lint_source(loop, "s.py")
+    assert [f.rule for f in findings] == ["PTL201"], findings
+    assert "loop" in findings[0].message and findings[0].line == 6
+    safe = (
+        "import jax, jax.numpy as jnp\n"
+        "def train(w, bs):\n"
+        "    step = jax.jit(lambda a, c: a * c, donate_argnums=(0,))\n"
+        "    for b in bs:\n"
+        "        w = step(w, b)\n"
+        "    for b in bs:\n"
+        "        tmp = jnp.zeros_like(b)\n"
+        "        out = step(tmp, b)\n"
+        "    return w\n")
+    findings, _ = lint_source(safe, "s.py")
+    assert findings == [], [f.format() for f in findings]
+    # the loop VARIABLE as the donated buffer is fresh every pass
+    loop_var = (
+        "import jax\n"
+        "def serve(ws, c, outs):\n"
+        "    step = jax.jit(lambda a, b: a * b, donate_argnums=(0,))\n"
+        "    for w in ws:\n"
+        "        outs.append(step(w, c))\n"
+        "    return outs\n")
+    findings, _ = lint_source(loop_var, "s.py")
+    assert findings == [], [f.format() for f in findings]
+    # for-else runs ONCE: a donation there is not loop-carried, but a
+    # read after it is still reuse
+    orelse = (
+        "import jax\n"
+        "def serve(w, bs, c):\n"
+        "    step = jax.jit(lambda a, b: a * b, donate_argnums=(0,))\n"
+        "    for b in bs:\n"
+        "        pass\n"
+        "    else:\n"
+        "        out = step(w, c)\n"
+        "    return out\n")
+    findings, _ = lint_source(orelse, "s.py")
+    assert findings == [], [f.format() for f in findings]
+    findings, _ = lint_source(
+        orelse.replace("return out", "return out + w"), "s.py")
+    assert [f.rule for f in findings] == ["PTL201"]
+
+
+def test_donation_coverage_survives_pruned_unused_args():
+    """jit prunes UNUSED args from the compiled module (default
+    keep_unused=False), shifting HLO parameter numbers — the probe
+    must map them back through kept_var_idx or one dead leaf ahead of
+    a donated one makes every index cry wolf."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import donation_coverage
+
+    x = jnp.zeros((4,), jnp.float32)
+    # b unused -> HLO params are [a, c]; both donated args DO alias
+    fn = jax.jit(lambda a, b, c: (a + 1, c + 1), donate_argnums=(0, 2))
+    d = donation_coverage(fn, (x, x, x), (0, 2), names=("a", "b", "c"))
+    assert d == {"expected": 2, "aliased": 2, "held": True,
+                 "dropped": []}, d
+    # a donated-but-unused leaf truly cannot alias: reported dropped
+    fn2 = jax.jit(lambda a, b: b * 2, donate_argnums=(0,))
+    d2 = donation_coverage(fn2, (x, x), (0,), names=("a", "b"))
+    assert not d2["held"] and d2["dropped"] == ["a"], d2
+
+
+def test_analyzer_catches_f64_promotion():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: jnp.sum(x.astype(jnp.float64)))
+    rep = analyze_jit(fn, (jnp.zeros((4,), jnp.float32),),
+                      kind="seeded")
+    assert rep.promotions.get("float32->float64") == 1, rep.conversions
+    assert "PTL502" in [f.rule for f in rep.findings]
+
+
+def test_analyzer_catches_host_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        jax.debug.callback(lambda v: None, x[0])
+        return x * 2
+
+    rep = analyze_jit(jax.jit(fn), (jnp.zeros((4,), jnp.float32),),
+                      kind="seeded")
+    assert sum(rep.host_calls.values()) >= 1, rep.host_calls
+    assert "PTL503" in [f.rule for f in rep.findings]
+
+
+def test_signature_diff_names_the_retrace_cause():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, s: a * s)
+    x = jnp.zeros((4,), jnp.float32)
+    weak = analyze_jit(fn, (x, 2.0), kind="sig")
+    committed = analyze_jit(fn, (x, jnp.float32(2.0)), kind="sig")
+    # the weak python scalar IS reported as a retrace hazard ...
+    assert weak.weak_type_args == ["arg1"]
+    assert committed.weak_type_args == []
+    # ... and the diff names exactly what forces the second executable
+    diff = signature_diff(weak.signature, committed.signature)
+    assert any("weak_type" in d for d in diff), diff
+    grown = analyze_jit(fn, (jnp.zeros((8,), jnp.float32),
+                             jnp.float32(2.0)), kind="sig")
+    diff = signature_diff(committed.signature, grown.signature)
+    assert any("shape" in d for d in diff), diff
+
+
+def test_findings_share_the_lint_shape():
+    """Analyzer findings round-trip like lint findings (one report
+    pipeline for the CLI/CI surface)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a, b: b * 2.0, donate_argnums=(0,))
+    rep = analyze_jit(fn, (jnp.zeros((4,), jnp.float32),
+                           jnp.zeros((4,), jnp.float32)),
+                      donate_argnums=(0,), kind="seeded")
+    d = rep.as_dict()
+    assert d["findings"][0]["rule"] == "PTL501"
+    assert isinstance(rep.findings[0], Finding)
+    assert "donation dropped" in rep.findings[0].format()

@@ -217,6 +217,12 @@ def test_engine_zero_recompiles_after_warmup():
         eng.step()
     warm = eng.compile_stats()
     assert warm == {"executables": 1}, warm
+    # the executable must also have KEPT its donation: a dropped alias
+    # map (the jax-0.4.x persistent-cache bug) serves correct tokens
+    # 25% slower — invisible to the recompile probe alone
+    don = eng.compile_stats(check_donation=True)["donation"]
+    assert don["held"], don
+    assert don["aliased"] == don["expected"] > 0, don
     # steady state: mixed prompt lengths, admissions, evictions — the
     # fixed-shape step must never recompile
     for L in (3, 17, 30, 9, 25):

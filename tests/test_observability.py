@@ -288,6 +288,13 @@ def test_trainstep_telemetry_smoke(mode, tmp_path):
     assert val("pt_train_compiles_total") - compiles0 == 1
     assert step.compile_stats() == {"batch_signatures": 1,
                                     "executables": 1}
+    # the recompile probe also proves donation held (params/opt-state
+    # aliased in the executable) and publishes the gauge
+    don = step.compile_stats(check_donation=True)["donation"]
+    assert don["held"] and don["expected"] == don["aliased"] > 0, don
+    held = reg.get("pt_step_donation_held")
+    assert held is not None and \
+        held.labels(step="train").value == 1.0
     gn = reg.get("pt_train_grad_norm")
     assert gn is not None and gn.count >= 3 and gn.quantile(0.5) > 0
     assert np.isfinite(reg.get("pt_train_loss").value)

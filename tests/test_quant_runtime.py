@@ -107,6 +107,11 @@ def test_int8_weight_only_engine_serves():
         out = r.future.result(timeout=0)
         assert len(out) == r.prompt_len + 6
     assert eng.compile_stats() == {"executables": 1}
+    # int8 pools AND their fp32 scale planes ride one donated pytree —
+    # the donation probe must see every leaf aliased (a dropped alias
+    # = per-tick pool copies, the PR-2 bug shape)
+    don = eng.compile_stats(check_donation=True)["donation"]
+    assert don["held"] and don["expected"] == don["aliased"], don
 
 
 # --------------------------------------------------------------------
