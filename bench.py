@@ -97,6 +97,11 @@ def bench_gpt():
     paddle.seed(0)
     cfg = gpt_small()
     batch, seq = 16, 1024  # b16 won the on-chip sweep (0.369 vs 0.360 MFU)
+    if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+        # dead-accelerator fallback (see main): the point is a fresh
+        # trend record, not an MFU claim — shrink to a CPU-feasible
+        # geometry so the arm finishes inside the capture window
+        batch, seq = 2, 128
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
     # O1: fp32 params cast to bf16 at the matmuls. (O2 bf16 params were
@@ -136,7 +141,7 @@ def bench_gpt():
         step(ids)
     float(step(ids).numpy())  # sync
 
-    iters = 10
+    iters = 3 if os.environ.get("BENCH_CPU_FALLBACK") == "1" else 10
     t0 = time.perf_counter()
     for _ in range(iters):
         last = step(ids)
@@ -908,7 +913,78 @@ def bench_probe():
     import jax.numpy as jnp
     x = jnp.ones((128, 128), jnp.bfloat16)
     y = (x @ x).block_until_ready()
-    return {"probe": "ok", "compute": float(jnp.asarray(y)[0, 0])}
+    return {"probe": "ok", "compute": float(jnp.asarray(y)[0, 0]),
+            "platform": jax.default_backend(),
+            "n_devices": len(jax.devices())}
+
+
+def bench_train_3d():
+    """3D-parallel (DP × TP × PP) train-step arm: per-config step time +
+    mesh shape for the tier-1-size GPT over the hybrid3d subsystem. The
+    point is the TREND of the hybrid step (schedule/placement changes
+    show up here), stamped with each config's mesh so a regression
+    arrives with its topology. Runs on whatever devices exist (8-chip
+    pod slice or the 8-virtual-device CPU fallback)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import hybrid3d, mesh as mesh_mod
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    ndev = len(jax.devices())
+    model_cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                          num_heads=4, max_seq_len=64)
+    configs = []
+    if ndev >= 8:
+        configs = [
+            hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2),
+            hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, schedule="gpipe"),
+            hybrid3d.Hybrid3DConfig(tp=4, pp=2),
+            hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, zero="os"),
+        ]
+    elif ndev >= 4:
+        configs = [hybrid3d.Hybrid3DConfig(dp=2, pp=2),
+                   hybrid3d.Hybrid3DConfig(tp=2, pp=2)]
+    else:
+        configs = [hybrid3d.Hybrid3DConfig()]  # degenerate 1-device
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, model_cfg.vocab_size, (8, 32))
+    out = {}
+    for cfg3d in configs:
+        mesh_mod.reset_mesh()
+        hybrid3d.init_hybrid_mesh(
+            cfg3d, devices=jax.devices()[:cfg3d.n_devices])
+        paddle.seed(0)
+        m = hybrid3d.build_gpt3d(model_cfg, cfg3d)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                        config=cfg3d)
+        ids = paddle.to_tensor(ids_np)
+        t0 = time.perf_counter()
+        l0 = float(step(ids).numpy())  # compile + step 0
+        compile_s = time.perf_counter() - t0
+        step(ids)  # warmup
+        float(step(ids).numpy())
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            last = step(ids)
+        lN = float(last.numpy())
+        dt = (time.perf_counter() - t0) / iters
+        stats = step.compile_stats(check_donation=True)
+        out[cfg3d.tag()] = {
+            **cfg3d.describe(),
+            "compile_s": round(compile_s, 2),
+            "ms_per_step": round(dt * 1e3, 2),
+            "loss_first": round(l0, 4),
+            "loss_last": round(lN, 4),
+            "executables": stats["executables"],
+            "donation_held": stats["donation"]["held"],
+        }
+        log(f"[bench] train_3d {cfg3d.tag()}: {dt*1e3:.1f} ms/step, "
+            f"donation_held={stats['donation']['held']}")
+        mesh_mod.reset_mesh()
+    return {"n_devices": ndev, "configs": out}
 
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
@@ -916,7 +992,8 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
             "gpt1p3b_pp": bench_gpt1p3b_pp, "serving": bench_serving,
             "llm_serve": bench_llm_serve,
-            "llm_serve_int8": bench_llm_serve_int8, "probe": bench_probe}
+            "llm_serve_int8": bench_llm_serve_int8,
+            "train_3d": bench_train_3d, "probe": bench_probe}
 
 
 def worker_main(which):
@@ -940,17 +1017,23 @@ def worker_main(which):
 # Supervisor side.
 # --------------------------------------------------------------------------
 
-def _run_worker(which, timeout_s):
+def _run_worker(which, timeout_s, extra_env=None):
     """Run one model bench in a subprocess. Returns (status, result_dict).
 
     status ∈ {"ok", "unavailable", "error", "timeout"}. The subprocess owns
     the chip only while alive, so killing it on timeout releases the TPU for
     the next attempt (the round-2 failure mode was a held chip).
+    `extra_env` overlays the worker's environment — the CPU-fallback path
+    uses it to force JAX_PLATFORMS=cpu without touching the supervisor.
     """
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", which]
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
-                            text=True, cwd=os.path.dirname(
-                                os.path.abspath(__file__)))
+                            text=True, env=env,
+                            cwd=os.path.dirname(os.path.abspath(__file__)))
     try:
         out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -1029,24 +1112,64 @@ def main():
     gpt = None
     backoff = 15
     attempt = 0
+    fallback_env = None
+    backend_kind = "accelerator"
     while True:
         remaining = GPT_DEADLINE_S - (time.monotonic() - t_start)
         if remaining < 60:
             log("[bench] gpt deadline exhausted")
             break
         attempt += 1
-        status, _ = _run_worker("probe", timeout_s=min(150, remaining))
+        status, probe = _run_worker("probe", timeout_s=min(150, remaining),
+                                    extra_env=fallback_env)
+        if status == "ok" and fallback_env is None and \
+                (probe or {}).get("platform") == "cpu":
+            # the backend came up but it's the HOST platform (e.g. the
+            # container presets JAX_PLATFORMS=cpu): full-size gpt on CPU
+            # burns the whole capture window to a timeout. Keep the run
+            # but at the cpu-scale geometry, with 8 virtual devices so
+            # the train_3d arm still exercises a real mesh.
+            log("[bench] backend is cpu — using cpu-scale geometry")
+            backend_kind = "cpu"
+            fallback_env = {
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device"
+                                "_count=8").strip(),
+                "BENCH_CPU_FALLBACK": "1",
+            }
         if status == "ok":
             remaining = GPT_DEADLINE_S - (time.monotonic() - t_start)
             if remaining < 60:  # probe ate the window — keep the bound
                 log("[bench] gpt deadline exhausted")
                 break
-            status, gpt = _run_worker("gpt", timeout_s=min(900, remaining))
+            status, gpt = _run_worker("gpt", timeout_s=min(900, remaining),
+                                      extra_env=fallback_env)
             if status == "ok":
                 break
             log(f"[bench] gpt attempt {attempt} -> {status}")
         else:
             log(f"[bench] probe {attempt} -> {status}")
+            if fallback_env is None:
+                # dead-backend fallback: ONE failed probe is the signal.
+                # BENCH_r02–r04 burned the whole capture window
+                # re-probing the unavailable 'axon' backend (probe
+                # timeout × backoff × 40 min) and the DRIVER killed the
+                # run at rc=124 before the deadline path could emit a
+                # line. Flip every subsequent worker to CPU: a cpu-scale
+                # record keeps the perf trajectory alive and is stamped
+                # backend=cpu_fallback so the trend tooling never
+                # compares it against chip numbers.
+                log("[bench] backend down — falling back to "
+                    "JAX_PLATFORMS=cpu for this run")
+                backend_kind = "cpu_fallback"
+                fallback_env = {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                                  + " --xla_force_host_platform_device"
+                                    "_count=8").strip(),
+                    "BENCH_CPU_FALLBACK": "1",
+                }
+                continue  # re-probe immediately on cpu, no backoff
         time.sleep(min(backoff,
                        max(0, GPT_DEADLINE_S
                            - (time.monotonic() - t_start))))
@@ -1060,6 +1183,8 @@ def main():
     chaos_active = bool(os.environ.get("PT_CHAOS_PLAN"))
     ptlint_stamp = _ptlint_stamp()
     detail["ptlint"] = ptlint_stamp
+    backend = backend_kind
+    detail["backend"] = backend
     if gpt is not None:
         detail["gpt"] = gpt
         mfu = gpt["mfu"]
@@ -1069,13 +1194,14 @@ def main():
             "unit": "fraction_of_v5e_bf16_peak",
             "vs_baseline": round(mfu / BASELINE_MFU, 4),
             "chaos_plan_active": chaos_active,
+            "backend": backend,
             "ptlint": ptlint_stamp,
             "detail": detail,
         }
     else:
         line = {"metric": "gpt_small_train_mfu", "value": 0.0,
                 "unit": "fraction_of_v5e_bf16_peak", "vs_baseline": 0.0,
-                "chaos_plan_active": chaos_active,
+                "chaos_plan_active": chaos_active, "backend": backend,
                 "ptlint": ptlint_stamp, "detail": detail}
     # Emit the headline NOW: nothing after this point can zero the result.
     print(json.dumps(line), flush=True)
@@ -1085,14 +1211,21 @@ def main():
     # the headline failed, the backend is down: don't burn more window.
     if gpt is None:
         return
-    for which in ("resnet", "bert", "deepfm", "mnist", "generate",
-                  "serving", "llm_serve", "llm_serve_int8"):
+    if fallback_env is not None:
+        # CPU fallback: the capture window is the scarce resource — run
+        # only the 3D-parallel arm (it is sized for 8 virtual devices)
+        extras = ("train_3d",)
+    else:
+        extras = ("resnet", "bert", "deepfm", "mnist", "generate",
+                  "serving", "llm_serve", "llm_serve_int8", "train_3d")
+    for which in extras:
         # the llm_serve arms run TWO serving phases each (engine vs
         # baseline / int8 vs fp32) plus both compiles: they need a wider
         # cap than the single-model arms
         status, res = _run_worker(
             which,
-            timeout_s=900 if which.startswith("llm_serve") else 420)
+            timeout_s=900 if which.startswith("llm_serve") else 420,
+            extra_env=fallback_env)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")
             detail[which] = res

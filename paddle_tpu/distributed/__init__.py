@@ -24,6 +24,9 @@ from . import moe  # noqa: F401
 from . import ps  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from . import sharding  # noqa: F401
+# after ps (whose jit import fully populates that namespace first):
+# the mesh-native DP × TP × PP subsystem
+from . import hybrid3d  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,

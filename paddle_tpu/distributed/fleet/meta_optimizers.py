@@ -86,7 +86,17 @@ class LocalSGD:
             if self.adaptive:
                 d = float(np.max(np.abs(avg - local)))
                 drift = max(drift, d)
-            p._value = jnp.asarray(avg)
+            new = jnp.asarray(avg)
+            # keep the param's mesh placement: a bare jnp.asarray is an
+            # uncommitted single-device array, and feeding that back
+            # into a compiled step whose params were mesh-sharded costs
+            # a SECOND executable (signature = shardings too) — caught
+            # by the hybrid3d 2-proc one-executable probe
+            try:
+                new = jax.device_put(new, p._value.sharding)
+            except (AttributeError, ValueError):
+                pass
+            p._value = new
         self.syncs += 1
         if self.adaptive:
             # every rank must adapt from the SAME drift or their sync

@@ -1,0 +1,43 @@
+"""paddle_tpu.distributed.hybrid3d — mesh-native DP × TP × PP.
+
+The hybrid-parallel layer of the framework: data, tensor and pipeline
+parallelism composed as ONE sharded, donated, zero-recompile executable
+per mesh config — `shard_map`/`pjit` over the 3-axis (dp, tp→'mp', pp)
+global mesh, the way "Scale MLPerf-0.6 models on Google TPU-v3 Pods"
+scales to 1024-chip pods. The pieces:
+
+* `Hybrid3DConfig` / `init_hybrid_mesh` / `build_gpt3d` (plan.py) —
+  one frozen, validated plan per run; builds the mesh, validates model
+  divisibility, stamps itself into bench/telemetry records.
+* `HybridTrainStep` (jit/hybrid_step.py, re-exported here and as
+  `paddle.jit.HybridTrainStep`) — `TrainStep`'s mesh-aware sibling:
+  same step layout + donation spec (so `analyze_step` and the
+  donation/zero-recompile probes work unchanged), param/opt-state
+  shardings pinned, ZeRO composed on the dp axis, the donation gauge
+  published as `pt_step_donation_held{step="hybrid3d"}`.
+* `pipeline_gpipe` (schedule.py) — the GPipe microbatch schedule as a
+  `lax.scan` over stages, interchangeable with the lockstep 1F1B scan
+  behind the same `PipelineSpecs`.
+* TP sharding rules (tp.py) — weight-stationary column/row placement
+  helpers, including the int8 path: `shard_model_int8_tp` shards
+  `Int8WeightOnlyLinear` weight+scale buffers over the tp axis
+  (closing docs/QUANTIZATION.md's "no TP shard yet" gap).
+
+Strategy meta-optimizers (LARS / DGC / LocalSGD) compose through the
+optimizer protocol: `fleet.distributed_optimizer` swaps the inner
+optimizer per the strategy toggles and `HybridTrainStep` runs it inside
+the same donated executable.
+"""
+from .plan import Hybrid3DConfig, build_gpt3d, init_hybrid_mesh  # noqa: F401
+from .schedule import gpipe_ticks, pipeline_gpipe  # noqa: F401
+from .tp import (  # noqa: F401
+    column_parallel_spec, int8_tp_placement, row_parallel_spec,
+    shard_int8_linear, shard_model_int8_tp, tp_axis)
+from ...jit.hybrid_step import HybridTrainStep  # noqa: F401
+
+__all__ = [
+    "Hybrid3DConfig", "init_hybrid_mesh", "build_gpt3d",
+    "HybridTrainStep", "pipeline_gpipe", "gpipe_ticks",
+    "shard_int8_linear", "shard_model_int8_tp", "int8_tp_placement",
+    "column_parallel_spec", "row_parallel_spec", "tp_axis",
+]

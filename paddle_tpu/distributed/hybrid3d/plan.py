@@ -1,0 +1,151 @@
+"""Hybrid3DConfig — one validated description of a DP × TP × PP run.
+
+The reference scatters the hybrid topology across a protobuf strategy,
+a communicator bootstrap, and per-layer wiring (fleet topology.py +
+HybridCommunicateGroup); here the whole 3-axis plan is ONE frozen value
+that (a) builds the global mesh, (b) validates the model's divisibility
+constraints up front, and (c) stamps itself into bench records and
+telemetry so a measured step time always arrives with its mesh shape.
+
+Axis naming: the public axis is **tp** (tensor parallel); it maps onto
+the mesh's 'mp' axis (the reference's Megatron naming, kept so every
+existing PartitionSpec and mp_ops collective keeps working). ZeRO
+composes on the DP axis: optimizer-state (and optionally param) leaves
+gain the 'dp' axis on a free divisible dim — in a pure-DP or hybrid
+mesh the dp ranks are exactly the replica group that ZeRO-1 shards
+over ("Scale MLPerf-0.6 models on Google TPU-v3 Pods" runs the same
+composition at pod scale).
+"""
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["Hybrid3DConfig", "init_hybrid_mesh", "build_gpt3d"]
+
+_SCHEDULES = ("1f1b", "gpipe")
+_ZERO_LEVELS = (None, "os", "os_g", "p_g_os")
+
+
+@dataclass(frozen=True)
+class Hybrid3DConfig:
+    """Frozen plan for a 3D-parallel training run.
+
+    dp/tp/pp: mesh degrees (tp rides the 'mp' mesh axis).
+    n_micro: microbatches per global batch (the pipeline's M).
+    schedule: '1f1b' (lockstep, O(pp) activations) or 'gpipe'
+        (serialized halves, O(M) activations — the simpler schedule).
+    n_virtual: interleaved virtual stages per device (1F1B only).
+    remat: 'stage' | 'layer' | False — the pipelined model's knob.
+    zero: None | 'os' | 'os_g' | 'p_g_os' — ZeRO level applied by
+        HybridTrainStep; states (and params at p_g_os) shard over
+        `zero_axis` ('dp' by default — the replica axis IS the ZeRO
+        group in a hybrid mesh; 'sharding' keeps the dedicated axis).
+    sp: optional sequence-parallel degree (the 4th axis, for long
+        context inside pipeline stages).
+    """
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    n_micro: int = 4
+    schedule: str = "1f1b"
+    n_virtual: int = 1
+    remat: object = "stage"
+    zero: Optional[str] = None
+    zero_axis: str = "dp"
+    sp: int = 1
+
+    def __post_init__(self):
+        for name in ("dp", "tp", "pp", "n_micro", "n_virtual", "sp"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"{name}={v!r}: expected an int >= 1")
+        if self.schedule not in _SCHEDULES:
+            raise ValueError(
+                f"schedule={self.schedule!r}: expected one of {_SCHEDULES}")
+        if self.schedule == "gpipe" and self.n_virtual > 1:
+            raise ValueError(
+                "interleaved virtual stages are a 1F1B refinement; "
+                "gpipe runs n_virtual=1")
+        if self.zero not in _ZERO_LEVELS:
+            raise ValueError(
+                f"zero={self.zero!r}: expected one of {_ZERO_LEVELS}")
+        if self.zero_axis not in ("dp", "sharding"):
+            raise ValueError(
+                f"zero_axis={self.zero_axis!r}: expected 'dp' or "
+                "'sharding'")
+
+    @property
+    def n_devices(self):
+        return self.dp * self.tp * self.pp * self.sp
+
+    def mesh_kwargs(self):
+        """Keyword args for `mesh.init_mesh` (tp → the 'mp' axis)."""
+        return {"dp": self.dp, "pp": self.pp, "mp": self.tp,
+                "sp": self.sp}
+
+    def validate_model(self, gpt_config, moe=False):
+        """Fail fast on the divisibility constraints the pipeline would
+        otherwise raise mid-loss (same messages, earlier). `moe=True`
+        drops the ffn check — a MoE model's experts shard over 'ep',
+        not 'mp', so the dense-FFN constraint doesn't apply."""
+        if self.pp > 1 and gpt_config.num_layers % (
+                self.pp * self.n_virtual):
+            raise ValueError(
+                f"num_layers={gpt_config.num_layers} not divisible by "
+                f"pp*n_virtual={self.pp}*{self.n_virtual}")
+        if self.tp > 1:
+            dims = [(gpt_config.num_heads, "num_heads"),
+                    (gpt_config.vocab_size, "vocab_size")]
+            if not moe:
+                dims.append((gpt_config.ffn_size, "ffn_size"))
+            for dim, what in dims:
+                if dim % self.tp:
+                    raise ValueError(
+                        f"{what}={dim} not divisible by tp={self.tp}")
+        return self
+
+    def describe(self):
+        """Flat dict for bench stamps / telemetry labels."""
+        return {
+            "mesh_shape": {"dp": self.dp, "tp": self.tp, "pp": self.pp,
+                           **({"sp": self.sp} if self.sp > 1 else {})},
+            "n_micro": self.n_micro,
+            "schedule": self.schedule,
+            "n_virtual": self.n_virtual,
+            "remat": self.remat if self.remat else "off",
+            "zero": self.zero or "off",
+        }
+
+    def tag(self):
+        """Short config id, e.g. 'dp2.tp2.pp2-1f1b' — bench arm keys."""
+        parts = [f"dp{self.dp}", f"tp{self.tp}", f"pp{self.pp}"]
+        if self.sp > 1:
+            parts.append(f"sp{self.sp}")
+        s = ".".join(parts) + f"-{self.schedule}"
+        if self.n_virtual > 1:
+            s += f"v{self.n_virtual}"
+        if self.zero:
+            s += f"-zero_{self.zero}"
+        return s
+
+
+def init_hybrid_mesh(config, devices=None):
+    """Build the global (dp, pp, mp[=tp], sp) mesh for `config`.
+
+    With `devices=None` the plan must use every visible device (the
+    mesh invariant); pass an explicit slice for degenerate test runs.
+    """
+    from .. import mesh as mesh_mod
+
+    return mesh_mod.init_mesh(devices=devices, **config.mesh_kwargs())
+
+
+def build_gpt3d(gpt_config, config, **model_kw):
+    """PipelinedGPTForCausalLM wired for `config` (schedule, virtual
+    stages, remat validated against the mesh degrees up front)."""
+    from ...text.models.gpt_pipeline import PipelinedGPTForCausalLM
+
+    config.validate_model(gpt_config,
+                          moe=bool(model_kw.get("moe_experts")))
+    return PipelinedGPTForCausalLM(
+        gpt_config, n_micro=config.n_micro, remat=config.remat,
+        n_virtual=config.n_virtual, schedule=config.schedule, **model_kw)

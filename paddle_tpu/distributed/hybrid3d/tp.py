@@ -1,0 +1,144 @@
+"""Weight-stationary tensor-parallel sharding rules — fp32 and int8.
+
+The Megatron pattern for the GPT Linears (qkv/fc1 column-sharded on the
+output dim, proj/fc2 row-sharded on the input dim, vocab-parallel
+embedding/head) already exists twice in this tree: as explicit
+shard_map collectives inside the pipeline stages
+(fleet/meta_parallel/mp_layers.py + text/models/gpt_pipeline.py) and as
+GSPMD `mark_sharding` annotations on the stacked parameters. What was
+MISSING is the int8 path: `quantize_model_int8` swaps Linears for
+`Int8WeightOnlyLinear` whose weight lives as int8 BUFFERS
+(weight_q [in, out] + per-out-channel w_step [1, out]) — and until now
+those buffers were replicated on a >1 'mp' mesh
+(docs/QUANTIZATION.md's "no TP shard yet" note). This module is the one
+place that knows how to place them:
+
+* column-parallel: weight_q P(None, 'mp'), w_step P(None, 'mp'),
+  bias P('mp') — each tp rank holds out/tp output channels AND their
+  dequant scales (the scale rides its channel, so dequant needs no
+  collective);
+* row-parallel: weight_q P('mp', None), w_step replicated — the int32
+  accumulator of a row shard is a PARTIAL sum; XLA inserts the
+  all-reduce after the dequant epilogue (GSPMD semantics preserved).
+
+These are GSPMD placements, not shard_map slices — annotation-only, so
+any choice is semantics-preserving and `auto` can fall back safely.
+"""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from .. import mesh as mesh_mod
+
+__all__ = ["shard_int8_linear", "shard_model_int8_tp", "tp_axis",
+           "column_parallel_spec", "row_parallel_spec"]
+
+TP_AXIS = "mp"
+
+
+def tp_axis():
+    """The mesh axis tensor parallelism rides ('mp' — the reference's
+    Megatron naming, shared with every existing PartitionSpec)."""
+    return TP_AXIS
+
+
+def column_parallel_spec(ndim, out_dim=-1, axis=TP_AXIS):
+    """Spec sharding the OUTPUT-channel dim (weight-stationary column
+    parallel: each rank owns out/tp columns)."""
+    out_dim = out_dim % ndim
+    return P(*[axis if d == out_dim else None for d in range(ndim)])
+
+
+def row_parallel_spec(ndim, in_dim=0, axis=TP_AXIS):
+    """Spec sharding the INPUT dim (row parallel: partial sums, XLA
+    all-reduces after the matmul)."""
+    in_dim = in_dim % ndim
+    return P(*[axis if d == in_dim else None for d in range(ndim)])
+
+
+def _mark(buf, spec):
+    from ..fleet.meta_parallel.mp_layers import mark_sharding
+
+    mark_sharding(buf, *spec)
+    return buf
+
+
+def shard_int8_linear(layer, kind="auto", axis=TP_AXIS):
+    """TP-shard one `Int8WeightOnlyLinear`'s buffers over `axis`.
+
+    kind: 'column' | 'row' | 'auto'. Auto prefers column (the scale
+    stays with its channel — no sharded-scale subtleties) and falls
+    back to row, skipping the layer when neither dim divides the axis
+    size. Returns the placement applied: 'column' | 'row' | None.
+    """
+    n = mesh_mod.axis_size(axis)
+    if n <= 1:
+        return None
+    out_f = int(layer.out_features)
+    in_f = int(layer.in_features)
+    want = kind
+    if kind == "auto":
+        want = ("column" if out_f % n == 0
+                else ("row" if in_f % n == 0 else None))
+    if want == "column":
+        if out_f % n:
+            raise ValueError(
+                f"out_features={out_f} not divisible by {axis}={n}")
+        _mark(layer.weight_q, column_parallel_spec(2, 1, axis))
+        _mark(layer.w_step, column_parallel_spec(2, 1, axis))
+        if layer.bias is not None:
+            _mark(layer.bias, P(axis))
+    elif want == "row":
+        if in_f % n:
+            raise ValueError(
+                f"in_features={in_f} not divisible by {axis}={n}")
+        _mark(layer.weight_q, row_parallel_spec(2, 0, axis))
+        # per-OUT-channel scales don't follow a row shard — replicate
+        _mark(layer.w_step, P(None, None))
+        if layer.bias is not None:
+            _mark(layer.bias, P(None))
+    elif want is not None:
+        raise ValueError(f"kind={kind!r}: expected column/row/auto")
+    return want
+
+
+def shard_model_int8_tp(model, rules=None, axis=TP_AXIS):
+    """Walk `model` and TP-shard every `Int8WeightOnlyLinear` (the
+    quantize_model_int8 output) over `axis`.
+
+    rules: optional {substring: 'column'|'row'} matched against the
+    sublayer path (first hit wins) — e.g. the Megatron GPT pattern
+    {'qkv': 'column', 'fc1': 'column', 'proj': 'row', 'fc2': 'row'}.
+    Unmatched layers use 'auto'. Returns {path: placement} for the
+    layers touched (placement None = skipped, indivisible)."""
+    from ...quantization.runtime import Int8WeightOnlyLinear
+
+    placed = {}
+    if mesh_mod.axis_size(axis) <= 1:
+        return placed
+    for path, sub in model.named_sublayers():
+        if not isinstance(sub, Int8WeightOnlyLinear):
+            continue
+        kind = "auto"
+        for pat, k in (rules or {}).items():
+            if pat in path:
+                kind = k
+                break
+        placed[path] = shard_int8_linear(sub, kind, axis)
+    return placed
+
+
+def int8_tp_placement(layer):
+    """Report where a quantized linear's buffers live: 'column', 'row',
+    or 'replicated' — the doc/test-facing probe."""
+    spec = getattr(layer.weight_q, "_pspec", None)
+    if spec is None:
+        return "replicated"
+    spec = tuple(spec)
+    if len(spec) == 2 and spec[1] == TP_AXIS:
+        return "column"
+    if len(spec) >= 1 and spec[0] == TP_AXIS:
+        return "row"
+    return "replicated"
+
+
+__all__.append("int8_tp_placement")

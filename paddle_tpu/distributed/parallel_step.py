@@ -38,24 +38,27 @@ def _contains_axis(entry, axis):
     return entry == axis
 
 
-def _zero_spec(pv, level, base_pspec):
-    """Choose the ZeRO ('sharding' axis) placement for a param/state leaf:
-    shard the largest divisible dim not already taken by the base spec.
-    Idempotent: a spec already carrying 'sharding' (e.g. both
+def _zero_spec(pv, level, base_pspec, axis="sharding"):
+    """Choose the ZeRO placement for a param/state leaf: shard the
+    largest divisible dim not already taken by the base spec, over
+    `axis` — 'sharding' (the dedicated axis) or 'dp' (ZeRO composed on
+    the replica axis, the hybrid3d default: in a DP×TP×PP mesh the dp
+    ranks ARE the replica group the optimizer states shard over).
+    Idempotent: a spec already carrying `axis` (e.g. both
     group_sharded_parallel and DistributedTrainStep(zero_level=...) were
     applied) is returned unchanged."""
     base = tuple(base_pspec) if base_pspec is not None else ()
     base = base + (None,) * (pv.ndim - len(base))
-    if any(_contains_axis(e, "sharding") for e in base):
+    if any(_contains_axis(e, axis) for e in base):
         return P(*base)
-    n = mesh_mod.axis_size("sharding")
+    n = mesh_mod.axis_size(axis)
     if n == 1:
         return P(*base) if any(base) else P()
     for d in np.argsort([-s for s in pv.shape]):
         d = int(d)
         if base[d] is None and pv.shape[d] % n == 0:
             new = list(base)
-            new[d] = "sharding"
+            new[d] = axis
             return P(*new)
     if any(e is None for e in base):
         # a free dim existed but none was divisible — the user CAN fix
@@ -71,12 +74,14 @@ def _zero_spec(pv, level, base_pspec):
     return P(*base) if any(base) else P()
 
 
-def shard_params_and_opt(model, optimizer, level="os_g"):
+def shard_params_and_opt(model, optimizer, level="os_g", axis="sharding"):
     """Assign ZeRO placements (reference group_sharded_parallel levels:
-    os = stage1, os_g = stage2, p_g_os = stage3)."""
+    os = stage1, os_g = stage2, p_g_os = stage3). `axis` picks the mesh
+    axis storage shards over — 'sharding' (dedicated) or 'dp' (the
+    hybrid3d composition)."""
     for _, p in model.named_parameters():
         if level == "p_g_os":
-            p._pspec = _zero_spec(p._value, level, p._pspec)
+            p._pspec = _zero_spec(p._value, level, p._pspec, axis=axis)
         # place now so the first jit call doesn't need a resharding copy
         try:
             p._value = jax.device_put(

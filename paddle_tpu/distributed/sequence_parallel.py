@@ -54,7 +54,15 @@ def ring_attention(q, k, v, causal=False, axis_name="sp"):
 
     qt = jnp.swapaxes(q, 1, 2)  # b,h,sq,d
     acc = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    m = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+    # running max starts at the finite mask floor, NOT -inf: -inf
+    # intermediates make exp(m - new_m) an inf-minus-inf shape that
+    # XLA's algebraic simplifier can rewrite into 0·inf NaNs under some
+    # fusion layouts (observed on XLA:CPU with traced label operands —
+    # the de-optimized program was NaN-free while the jitted one NaN'd).
+    # The ring starts on the diagonal block, where every causal row has
+    # at least one valid key, so the -1e30 floor never wins a max it
+    # shouldn't.
+    m = jnp.full((b, h, s_loc), -1e30, jnp.float32)
     l = jnp.zeros((b, h, s_loc), jnp.float32)
 
     q_pos = idx * s_loc + jnp.arange(s_loc)
@@ -66,13 +74,12 @@ def ring_attention(q, k, v, causal=False, axis_name="sp"):
         scores = jnp.einsum("bhqd,bkhd->bhqk", qt, k_blk).astype(
             jnp.float32) * scale
         if causal:
+            # mask directly to the finite floor (never -inf; see the
+            # running-max init note above): exp underflows to 0 for
+            # masked keys once any valid key sets the row max
             k_pos = src * s_loc + jnp.arange(s_loc)
             mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        if causal:
-            # clamp fully-masked rows to a large negative finite value so
-            # the streaming merge stays NaN-free (exp underflows to 0)
-            scores = jnp.where(jnp.isfinite(scores), scores, -1e30)
+            scores = jnp.where(mask[None, None], scores, -1e30)
         acc, m, l = _online_merge(acc, m, l, scores, v_blk)
         if r != sp - 1:
             perm = [(i, (i + 1) % sp) for i in range(sp)]

@@ -544,8 +544,19 @@ class TrainStep:
 
         # donate param + optimizer-state + buffer arrays so XLA updates in
         # place (no HBM copy per step); donate_params=False keeps the
-        # pre-step arrays readable (e.g. for step-over-step diffing)
-        self._compiled = jax.jit(step, donate_argnums=self._donate_argnums)
+        # pre-step arrays readable (e.g. for step-over-step diffing).
+        # _jit_step is the subclass hook: HybridTrainStep pins mesh
+        # in/out shardings around the SAME step fn and donate layout.
+        self._compiled = self._jit_step(step)
+
+    def _jit_step(self, step):
+        return jax.jit(step, donate_argnums=self._donate_argnums)
+
+    def _init_opt_states(self, train_vals):
+        """First-call optimizer-state init (subclass hook: the hybrid 3D
+        step device_puts the fresh states onto their ZeRO placements so
+        the compiled step never pays a reshard copy)."""
+        return self.optimizer.init_states_tree(train_vals)
 
     # the compiled step's signature, ONE definition for every off-path
     # consumer (lower(), the donation probe, analysis.analyze_step) —
@@ -553,6 +564,9 @@ class TrainStep:
     # change must touch _build/__call__ and this block together
     _STEP_ARG_NAMES = ("params", "buffers", "opt_state", "lr", "batch",
                        "step_idx", "base_key")
+    # label for pt_step_donation_held — subclasses that are a distinct
+    # step family (HybridTrainStep) publish under their own series
+    _donation_gauge_label = "train"
 
     @property
     def _donate_argnums(self):
@@ -591,7 +605,7 @@ class TrainStep:
             self._build()
         train_vals, frozen_vals = self._split_vals()
         if self._opt_states is None:
-            self._opt_states = self.optimizer.init_states_tree(train_vals)
+            self._opt_states = self._init_opt_states(train_vals)
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
         # recompile guard: every distinct batch signature is a separate
@@ -691,7 +705,7 @@ class TrainStep:
         out["donation"] = donation_coverage(
             self._compiled, self._step_args(self._last_batch_avals),
             self._donate_argnums, names=self._STEP_ARG_NAMES)
-        _DONATION_HELD.labels(step="train").set(
+        _DONATION_HELD.labels(step=self._donation_gauge_label).set(
             1.0 if out["donation"]["held"] else 0.0)
         return out
 
@@ -773,3 +787,10 @@ dy2static = _Dy2StaticNamespace()
 
 __all__ += ["ProgramTranslator", "TracedLayer", "set_verbosity",
             "set_code_level", "dy2static"]
+
+# the mesh-aware 3D sibling (distributed.hybrid3d docs) — imported LAST:
+# hybrid_step late-imports paddle_tpu.distributed, whose ps module
+# imports TrainStep back from this (by now fully-populated) namespace
+from .hybrid_step import HybridTrainStep  # noqa: E402,F401
+
+__all__ += ["HybridTrainStep"]

@@ -532,11 +532,18 @@ class LarsMomentum(Optimizer):
         g32 = gv.astype(jnp.float32)
         p_norm = jnp.linalg.norm(p32)
         g_norm = jnp.linalg.norm(g32)
+        # zero-norm fallback keeps the coeff scale: falling back to the
+        # RAW lr hands exactly the zero-init parameters (biases) an
+        # unscaled large-batch learning rate — with momentum they
+        # oscillate and diverge at the big lr LARS exists to enable
+        # (‖b‖ grew monotonically at lr=0.5 on the tier-1 toy). lr·coeff
+        # is the trust-ratio's own scale at ‖p‖/‖g‖ = 1; once ‖p‖ > 0
+        # the standard ratio takes over.
         local_lr = jnp.where(
             (p_norm > 0) & (g_norm > 0),
             lr * self._coeff * p_norm
             / (g_norm + lars_wd * p_norm + self._eps),
-            lr)
+            lr * self._coeff)
         v = self._momentum * state["velocity"] + local_lr * (
             g32 + lars_wd * p32)
         return (p32 - v).astype(pv.dtype), {"velocity": v}

@@ -141,7 +141,7 @@ def _post_shard_for(sub):
         out, *(["dp"] + [None] * (out.ndim - 1)))
 
 
-def quantize_model_int8(model, skip=()):
+def quantize_model_int8(model, skip=(), tp_shard=True):
     """Swap every Linear-family sublayer for `Int8WeightOnlyLinear`,
     in place, at model-load time. Embeddings (and the tied vocab head
     that reads the embedding weight) stay in the float dtype — the
@@ -150,16 +150,24 @@ def quantize_model_int8(model, skip=()):
 
     skip: attribute-name substrings to leave unquantized
     (e.g. ``skip=("lm_head",)``).
+    tp_shard: on a mesh with 'mp' > 1, shard the int8 weight + scale
+    buffers over the tp axis (weight-stationary: ColumnParallelLinear
+    ancestry → column placement, RowParallelLinear → row, plain Linear
+    → whichever dim divides; distributed.hybrid3d.tp rules). False
+    keeps the buffers replicated.
 
     Returns a report dict: layers swapped, fp bytes before, int8 bytes
-    after (weights only). NOTE: on a >1 mesh the int8 buffers are
-    replicated (no TP sharding of int8 weights yet — documented in
-    docs/QUANTIZATION.md); single-host serving is the supported path.
+    after (weights only), and — when sharding applied — a
+    ``tp_placements`` {path: 'column'|'row'|None} map.
     """
     from . import QuantizedLinear
+    from ..distributed import mesh as mesh_mod
+    from ..distributed.fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
 
     linear_types = _linear_classes()
     report = {"layers": 0, "weight_bytes_fp": 0, "weight_bytes_int8": 0}
+    swapped = []  # (path, wrapped, tp kind)
 
     def swap(layer, prefix=""):
         for name, sub in list(layer.named_children()):
@@ -177,11 +185,24 @@ def quantize_model_int8(model, skip=()):
                 report["weight_bytes_int8"] += int(
                     wrapped.weight_q._value.nbytes
                     + wrapped.w_step._value.nbytes)
+                kind = "auto"
+                if isinstance(sub, ColumnParallelLinear):
+                    kind = "column"
+                elif isinstance(sub, RowParallelLinear):
+                    kind = "row"
+                swapped.append((path, wrapped, kind))
                 setattr(layer, name, wrapped)
             else:
                 swap(sub, path)
 
     swap(model)
+    if tp_shard and mesh_mod.axis_size("mp") > 1:
+        from ..distributed.hybrid3d.tp import shard_int8_linear
+
+        placements = {}
+        for path, wrapped, kind in swapped:
+            placements[path] = shard_int8_linear(wrapped, kind)
+        report["tp_placements"] = placements
     model.eval()
     return report
 

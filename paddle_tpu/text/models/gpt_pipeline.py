@@ -228,10 +228,22 @@ class PipelinedGPTForCausalLM(nn.Layer):
     def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
                  n_virtual=1, moe_experts=0, moe_hidden=None,
                  moe_aux_weight=0.01, moe_capacity_factor=1.25,
-                 moe_topk=1):
+                 moe_topk=1, schedule="1f1b"):
         super().__init__()
         self.config = config
         self.n_micro = n_micro
+        # schedule: "1f1b" (lockstep, O(pp) activations — default) or
+        # "gpipe" (all-forward-then-all-backward serialized halves,
+        # O(M) activations; distributed.hybrid3d.schedule). Both share
+        # PipelineSpecs, so tp/dp/sp composition is identical.
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"schedule={schedule!r}: expected '1f1b' or 'gpipe'")
+        if schedule == "gpipe" and n_virtual != 1:
+            raise ValueError(
+                "interleaved virtual stages are a 1F1B refinement; "
+                "gpipe runs n_virtual=1")
+        self.schedule = schedule
         # moe_experts > 0: the dense FFN becomes a switch (top-1) MoE
         # with experts sharded over the 'ep' mesh axis and token-sharded
         # all-to-all dispatch (see _moe_ffn). The load-balancing aux
@@ -554,9 +566,16 @@ class PipelinedGPTForCausalLM(nn.Layer):
                 # (masked). Done HERE, where the full sequence is in
                 # one piece — inside the pipeline the shift would need
                 # a cross-shard collective in a stage-gated branch.
-                lbl = jnp.concatenate(
-                    [lbl[:, 1:],
-                     jnp.full((lbl.shape[0], 1), -1, lbl.dtype)], axis=1)
+                # NOTE: jnp.pad, NOT jnp.concatenate — on jax 0.4.x
+                # XLA:CPU the spmd partitioner mis-shards a concatenate
+                # result entering shard_map through a partial in_spec
+                # (values arrive summed across the unmentioned mesh
+                # axes: labels DOUBLED at pp=2, then OOB vocab indices
+                # take_along_axis-fill as NaN — the whole-suite sp NaN).
+                # Pad partitions correctly; pinned by
+                # test_label_shift_survives_partial_shard_spec.
+                lbl = jnp.pad(lbl[:, 1:], ((0, 0), (0, 1)),
+                              constant_values=-1)
             B = ids.shape[0]
             assert B % M == 0, f"batch {B} not divisible by n_micro {M}"
             specs = self._hybrid_specs(mp, dp, B // M, sp, ep)
@@ -596,6 +615,12 @@ class PipelinedGPTForCausalLM(nn.Layer):
             # also lands here: the fill-drain path has no virtual-stage
             # schedule, and the 1F1B loss is identical, just costlier)
             remat = self.remat == "stage"
+            if self.schedule == "gpipe" and pp > 1 and not fwd_only:
+                from ...distributed.hybrid3d.schedule import pipeline_gpipe
+
+                return pipeline_gpipe(block_fn, loss_fn, stacked, post,
+                                      (x_m, lbl_m), remat=remat,
+                                      specs=specs, aux_weight=aux_w)
             return pipeline_1f1b(block_fn, loss_fn, stacked, post,
                                  (x_m, lbl_m), remat=remat,
                                  num_virtual=V, specs=specs,

@@ -1,0 +1,84 @@
+"""Worker for the 2-proc 3D-parallel acceptance test
+(test_hybrid3d.py::test_two_proc_3d_step_parity).
+
+Each rank builds its own 8-virtual-device (dp2, tp2, pp2) mesh, runs
+the SAME seeded batch through a donated `HybridTrainStep`, and after
+every step averages the parameters across processes over the xproc
+coordination-KV collective fallback (LocalSGD with k_steps=1 — the
+multi-host composition: in-mesh collectives ride the compiled SPMD
+program, cross-host sync rides xproc). With identical data the average
+is a fixed point, so the run must reproduce the single-process loss
+trajectory EXACTLY and both ranks must end with bit-identical
+parameters — divergence means either the collective fallback or the 3D
+step broke determinism.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import hybrid3d, xproc  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGD  # noqa: E402
+from paddle_tpu.text.models.gpt import GPTConfig  # noqa: E402
+
+STEPS = 3
+
+
+def param_sha(model):
+    h = hashlib.sha256()
+    for name, p in sorted(model.named_parameters()):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(p._value)).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    import jax
+
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, n_micro=4)
+    # each rank's mesh is its OWN 8 local devices: in-mesh collectives
+    # stay process-local SPMD, cross-process sync rides xproc below
+    hybrid3d.init_hybrid_mesh(
+        cfg3d, devices=jax.local_devices()[:cfg3d.n_devices])
+    paddle.seed(0)
+    model_cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                          num_heads=4, max_seq_len=32)
+    m = hybrid3d.build_gpt3d(model_cfg, cfg3d)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                    config=cfg3d)
+    sync = LocalSGD(m, k_steps=1)
+
+    rng = np.random.default_rng(0)          # SAME data on every rank
+    ids = paddle.to_tensor(rng.integers(0, 128, (8, 16)))
+
+    losses = []
+    for _ in range(STEPS):
+        losses.append(float(step(ids).numpy()))
+        sync.step()                          # xproc param average
+
+    stats = step.compile_stats(check_donation=True)
+    out = {
+        "rank": rank,
+        "losses": losses,
+        "param_sha": param_sha(m),
+        "syncs": sync.syncs,
+        "executables": stats["executables"],
+        "donation_held": stats["donation"]["held"],
+    }
+    with open(os.path.join(out_dir, f"h3d_{rank}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,388 @@
+"""Mesh-native 3D parallelism (distributed.hybrid3d): DP × TP × PP as
+ONE sharded, donated, zero-recompile executable per mesh config.
+
+Covers: Hybrid3DConfig validation; the GPipe schedule's serial parity
+(vs the 1F1B suite in test_hybrid_pp_mp.py); HybridTrainStep's
+one-executable + donation-held invariants (pt_step_donation_held
+{step="hybrid3d"}) through compile_stats AND analysis.analyze_step;
+ZeRO optimizer-state sharding composed on the dp axis; the strategy
+meta-optimizers (LARS via fleet.distributed_optimizer, DGC) running
+inside the compiled 3D step; TP-sharded int8 weight buffers (closing
+docs/QUANTIZATION.md's "no TP shard yet" gap); and the 2-proc
+multi-host run over the xproc collective fallback (slow).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import hybrid3d
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.text.models.gpt import GPTConfig
+from paddle_tpu.text.models.gpt_pipeline import PipelinedGPTForCausalLM
+
+pytestmark = pytest.mark.hybrid3d
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
+                num_heads=4, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _exact_matmuls():
+    with jax.default_matmul_precision("highest"):
+        yield
+    mesh_mod.reset_mesh()
+
+
+def _serial_losses(ids_np, steps=3):
+    mesh_mod.reset_mesh()
+    mesh_mod.init_mesh(devices=jax.devices()[:1])
+    paddle.seed(0)
+    m = PipelinedGPTForCausalLM(CFG, n_micro=4)
+    ids = paddle.to_tensor(ids_np)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
+    return [float(step(ids).numpy()) for _ in range(steps)]
+
+
+def _hybrid_step(cfg3d, ids_np=None):
+    mesh_mod.reset_mesh()
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    paddle.seed(0)
+    m = hybrid3d.build_gpt3d(CFG, cfg3d)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    return m, hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                       config=cfg3d)
+
+
+# ----------------------------------------------------------------- plan
+
+def test_config_validation_and_stamps():
+    cfg = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, zero="os")
+    assert cfg.n_devices == 8
+    assert cfg.mesh_kwargs() == {"dp": 2, "pp": 2, "mp": 2, "sp": 1}
+    assert cfg.tag() == "dp2.tp2.pp2-1f1b-zero_os"
+    d = cfg.describe()
+    assert d["mesh_shape"] == {"dp": 2, "tp": 2, "pp": 2}
+    assert d["zero"] == "os"
+
+    with pytest.raises(ValueError, match="schedule"):
+        hybrid3d.Hybrid3DConfig(schedule="pipedream")
+    with pytest.raises(ValueError, match="1F1B"):
+        hybrid3d.Hybrid3DConfig(schedule="gpipe", n_virtual=2)
+    with pytest.raises(ValueError, match="zero"):
+        hybrid3d.Hybrid3DConfig(zero="stage9")
+    with pytest.raises(ValueError, match="dp"):
+        hybrid3d.Hybrid3DConfig(dp=0)
+    # model divisibility is validated up front, not mid-loss
+    with pytest.raises(ValueError, match="num_heads"):
+        hybrid3d.Hybrid3DConfig(tp=8).validate_model(CFG)
+    with pytest.raises(ValueError, match="num_layers"):
+        hybrid3d.Hybrid3DConfig(pp=2, n_virtual=4).validate_model(CFG)
+    # the model surface rejects the same combination
+    with pytest.raises(ValueError, match="1F1B"):
+        PipelinedGPTForCausalLM(CFG, schedule="gpipe", n_virtual=2)
+
+
+# ------------------------------------------------------ partitioner bug
+
+def test_label_shift_survives_partial_shard_spec():
+    """Regression: on this jax/XLA, a jnp.concatenate result entering
+    shard_map through a partial in_spec arrives SUMMED across the
+    unmentioned mesh axes (labels doubled at pp=2 → OOB vocab ids →
+    take_along_axis NaN-fill — the whole-suite sp NaN). The jnp.pad
+    shift the pipeline now uses must deliver exact shards."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh_mod.reset_mesh()
+    mesh_mod.init_mesh(pp=2, sp=4)
+    mesh = mesh_mod.global_mesh()
+    lbl_np = np.arange(1, 129).reshape(8, 16)
+
+    def jfn(lbl_in):
+        lbl = jnp.pad(lbl_in[:, 1:], ((0, 0), (0, 1)),
+                      constant_values=-1)
+        lbl_m = lbl.reshape(4, 2, 16)
+
+        def per_stage(ys):
+            return ys[0].reshape(-1)[:, None]
+
+        return jax.shard_map(per_stage, mesh=mesh,
+                             in_specs=(P(None, None, "sp"),),
+                             out_specs=P("sp", "pp"),
+                             check_vma=False)(lbl_m)
+
+    got = np.asarray(jax.jit(jfn)(jnp.asarray(lbl_np, jnp.int64)))
+    # micro 0 = rows 0..1, each 'sp' shard holds 4 consecutive columns
+    # of the SHIFTED labels; out stacking is [sp-shard, pp-copy]:
+    # shard k contributes [row0[4k:4k+4], row1[4k:4k+4]]
+    shifted = np.concatenate(
+        [lbl_np[:, 1:], np.full((8, 1), -1, lbl_np.dtype)], axis=1)
+    exp = shifted[:2].reshape(2, 4, 4).transpose(1, 0, 2).reshape(32)
+    assert got.shape == (32, 2)
+    for col in range(2):   # every pp rank got the same (unsummed) shard
+        np.testing.assert_array_equal(got[:, col], exp)
+
+
+# ------------------------------------------- one executable per config
+
+def test_one_donated_executable_per_config_and_parity():
+    """The acceptance invariant: per mesh config the 3D step is ONE
+    donated executable (zero recompiles across steps, every donated
+    buffer aliased), and every config reproduces the serial trajectory.
+    Covers both schedules and ZeRO-on-dp."""
+    rng = np.random.default_rng(1)
+    ids_np = rng.integers(0, 256, (8, 16))
+    serial = _serial_losses(ids_np)
+
+    for cfg3d in (hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2),
+                  hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2,
+                                          schedule="gpipe"),
+                  hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, zero="os")):
+        m, step = _hybrid_step(cfg3d)
+        ids = paddle.to_tensor(ids_np)
+        losses = [float(step(ids).numpy()) for _ in range(3)]
+        np.testing.assert_allclose(serial, losses, rtol=2e-4,
+                                   err_msg=cfg3d.tag())
+        stats = step.compile_stats(check_donation=True)
+        assert stats["batch_signatures"] == 1, cfg3d.tag()
+        assert stats["executables"] == 1, (cfg3d.tag(), stats)
+        don = stats["donation"]
+        assert don["held"] and don["aliased"] == don["expected"] > 0, (
+            cfg3d.tag(), don)
+        held = obs_metrics.registry().get("pt_step_donation_held")
+        assert held is not None and \
+            held.labels(step="hybrid3d").value == 1.0
+
+
+def test_analyze_step_hybrid3d():
+    """The donation/zero-recompile probes extend to the 3D step through
+    analysis.analyze_step (HybridTrainStep shares TrainStep's
+    _step_args/donate layout, so the jaxpr/HLO inspection works
+    unchanged): donation fully held, no host callbacks, no f64
+    promotions in the compiled hybrid program."""
+    from paddle_tpu.analysis import analyze_step
+
+    rng = np.random.default_rng(2)
+    ids_np = rng.integers(0, 256, (8, 16))
+    m, step = _hybrid_step(hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2))
+    ids = paddle.to_tensor(ids_np)
+    float(step(ids).numpy())
+
+    report = analyze_step(step, ids)
+    assert report.donation["held"]
+    assert report.donation["aliased"] == report.donation["expected"] > 0
+    assert not report.host_calls
+    assert not [f for f in report.findings if f.rule == "PTL502"]
+
+
+def test_zero_composes_on_dp_axis():
+    """config.zero='os' shards the optimizer moments over the DP axis
+    (the replica group IS the ZeRO group); params stay on their TP/PP
+    placements and the trajectory is unchanged (covered above) — here
+    we pin the placement itself."""
+    m, step = _hybrid_step(hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2,
+                                                   zero="os"))
+    ids = paddle.to_tensor(
+        np.random.default_rng(3).integers(0, 256, (8, 16)))
+    float(step(ids).numpy())
+    sharded = 0
+    for st in step._opt_states:
+        for v in st.values():
+            if v.ndim and "dp" in str(v.sharding.spec):
+                sharded += 1
+    assert sharded > 0, "no optimizer-state leaf carries the dp axis"
+    # params themselves stay on their TP/PP placements (ZeRO-1, not 3)
+    assert "dp" not in str(m.stk_qkv_w._value.sharding.spec)
+
+
+# ----------------------------------------------- strategy meta-optimizers
+
+def test_fleet_lars_strategy_end_to_end():
+    """fleet.distributed_optimizer honors strategy.lars and the swapped
+    LarsMomentum runs INSIDE the compiled 3D step — the reference's
+    meta-optimizer pass composed with hybrid parallelism."""
+    import paddle_tpu.distributed.fleet as fleet
+
+    st = fleet.DistributedStrategy()
+    st.lars = True
+    st.hybrid_configs.update(dp_degree=2, mp_degree=2, pp_degree=2)
+    fleet.fleet.init(strategy=st)
+    try:
+        paddle.seed(0)
+        cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+        m = hybrid3d.build_gpt3d(CFG, cfg3d)
+        opt = paddle.optimizer.Momentum(0.5, parameters=m.parameters())
+        opt = fleet.fleet.distributed_optimizer(opt)
+        assert type(opt).__name__ == "LarsMomentum"
+        step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                        config=cfg3d)
+        ids = paddle.to_tensor(
+            np.random.default_rng(4).integers(0, 256, (8, 16)))
+        losses = [float(step(ids).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()
+        assert step.compile_stats()["executables"] == 1
+    finally:
+        mesh_mod.reset_mesh()
+
+
+@pytest.mark.slow
+def test_dgc_momentum_inside_hybrid_step():
+    from paddle_tpu.distributed.fleet.meta_optimizers import DGCMomentum
+
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+    mesh_mod.reset_mesh()
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    paddle.seed(0)
+    m = hybrid3d.build_gpt3d(CFG, cfg3d)
+    opt = DGCMomentum(0.05, momentum=0.9, sparsity=0.5,
+                      parameters=m.parameters())
+    step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                    config=cfg3d)
+    ids = paddle.to_tensor(
+        np.random.default_rng(5).integers(0, 256, (8, 16)))
+    losses = [float(step(ids).numpy()) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.slow
+def test_gpipe_moe_aux_channel_matches_serial():
+    """The GPipe scan carries the MoE aux-loss channel exactly like
+    1F1B: at lossless capacity the total loss AND the aux metric equal
+    the serial values (the aux cotangent seeding/psum reassembly is the
+    subtle part — a wrong seed shows up here, not in the dense tests)."""
+    rng = np.random.default_rng(6)
+    ids_np = rng.integers(0, 256, (8, 16))
+
+    def run(mesh_kw, schedule):
+        mesh_mod.reset_mesh()
+        if mesh_kw is None:
+            mesh_mod.init_mesh(devices=jax.devices()[:1])
+        else:
+            mesh_mod.init_mesh(**mesh_kw)
+        paddle.seed(0)
+        m = PipelinedGPTForCausalLM(CFG, n_micro=4, moe_experts=4,
+                                    moe_hidden=64,
+                                    moe_capacity_factor=4.0,
+                                    schedule=schedule)
+        ids = paddle.to_tensor(ids_np)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, lambda mm, i: mm.loss(i), opt)
+        losses = [float(step(ids).numpy()) for _ in range(2)]
+        return losses, float(m.aux_loss.numpy())
+
+    serial, s_aux = run(None, "1f1b")
+    gp, g_aux = run({"pp": 2, "ep": 4}, "gpipe")
+    np.testing.assert_allclose(serial, gp, rtol=2e-5)
+    np.testing.assert_allclose(s_aux, g_aux, rtol=2e-4)
+
+
+# --------------------------------------------------------- int8 TP shard
+
+def test_int8_weight_buffers_shard_on_tp_axis():
+    """quantize_model_int8 on a tp mesh shards weight_q + w_step over
+    'mp' (weight-stationary column placement; docs/QUANTIZATION.md's
+    'no TP shard yet' limitation is closed) and the quantized forward
+    stays within int8 error of fp32."""
+    from paddle_tpu.quantization.runtime import quantize_model_int8
+
+    mesh_mod.reset_mesh()
+    mesh_mod.init_mesh(mp=4, devices=jax.devices()[:4])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 16)).astype(np.float32))
+    ref = m(x).numpy()
+    report = quantize_model_int8(m)
+    assert report["tp_placements"] == {"0": "column", "2": "column"}
+    assert tuple(m[0].weight_q._pspec) == (None, "mp")
+    assert tuple(m[0].w_step._pspec) == (None, "mp")
+    assert hybrid3d.int8_tp_placement(m[0]) == "column"
+    # the VALUE is really placed, not just annotated
+    assert "mp" in tuple(m[0].weight_q._value.sharding.spec)
+    got = m(x).numpy()
+    assert np.abs(got - ref).max() < 0.1
+    # row placement is available for in-dim sharding
+    lin = nn.Linear(32, 5)   # out=5 indivisible by 4 → auto falls to row
+    from paddle_tpu.quantization.runtime import Int8WeightOnlyLinear
+
+    q = Int8WeightOnlyLinear(lin)
+    assert hybrid3d.shard_int8_linear(q, "auto") == "row"
+    assert hybrid3d.int8_tp_placement(q) == "row"
+
+
+def test_int8_tp_opt_out_and_off_mesh():
+    from paddle_tpu.quantization.runtime import quantize_model_int8
+
+    mesh_mod.reset_mesh()
+    mesh_mod.init_mesh(mp=4, devices=jax.devices()[:4])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32))
+    report = quantize_model_int8(m, tp_shard=False)
+    assert "tp_placements" not in report
+    assert hybrid3d.int8_tp_placement(m[0]) == "replicated"
+    # off-mesh (mp=1): no placements, no error
+    mesh_mod.reset_mesh()
+    mesh_mod.init_mesh(devices=jax.devices()[:1])
+    m2 = nn.Sequential(nn.Linear(16, 32))
+    report2 = quantize_model_int8(m2)
+    assert "tp_placements" not in report2
+
+
+# ------------------------------------------------------------ multi-host
+
+@pytest.mark.slow
+def test_two_proc_3d_step_parity(tmp_path):
+    """The multi-host composition: each rank runs the donated 3D step
+    on its own (dp2, tp2, pp2) mesh, parameters averaged across
+    processes over the xproc coordination-KV collective fallback after
+    every step. Same data ⇒ the trajectory must equal the
+    single-process run and both ranks must end bit-identical."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(ROOT, "tests", "hybrid3d_worker.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+         worker, str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    w0 = json.load(open(tmp_path / "h3d_0.json"))
+    w1 = json.load(open(tmp_path / "h3d_1.json"))
+    # ranks agree bit-for-bit: the collective fallback kept determinism
+    assert w0["param_sha"] == w1["param_sha"]
+    np.testing.assert_allclose(w0["losses"], w1["losses"], rtol=0)
+    assert w0["syncs"] == w1["syncs"] == 3   # xproc path exercised
+    assert w0["donation_held"] and w1["donation_held"]
+    assert w0["executables"] == w1["executables"] == 1
+
+    # single-process reference (the same seeded run, in-process)
+    mesh_mod.reset_mesh()
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, n_micro=4)
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    paddle.seed(0)
+    model_cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                          num_heads=4, max_seq_len=32)
+    m = hybrid3d.build_gpt3d(model_cfg, cfg3d)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                    config=cfg3d)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 128, (8, 16)))
+    ref = [float(step(ids).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(w0["losses"], ref, rtol=1e-5)
