@@ -900,6 +900,132 @@ def bench_llm_serve_int8():
     }
 
 
+def bench_llm_fleet():
+    """Fleet serving A/B (ISSUE-7 acceptance): a shared-system-prompt
+    Poisson workload served twice by the SAME model/backend —
+
+      * fifo:  prefix cache OFF, default scheduler (the pre-fleet
+        engine: every request re-prefills the full system prompt);
+      * fleet: prefix cache ON + multi-tenant traffic through the SLA
+        scheduler (the shared prefix maps copy-on-write from the radix
+        trie, so its prefill is paid once).
+
+    Reports the prefill-token reduction (the acceptance floor is 30%),
+    p50/p99 TTFT per side, greedy token parity fifo-vs-fleet, and the
+    prefix-cache / scheduler snapshots of the fleet run. Prefill token
+    counts are deterministic; TTFT is timing, so the phases interleave
+    F/S/F/S and each side scores its best run (the llm_serve noise
+    defense)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
+
+    paddle.seed(0)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        cfg, n_req, sys_len, max_suffix = gpt_tiny(), 12, 96, 24
+        name = "gpt-tiny-llm-fleet"
+    else:
+        cfg, n_req, sys_len, max_suffix = gpt_small(), 24, 192, 48
+        name = "gpt-small-llm-fleet"
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(0, cfg.vocab_size, (sys_len,)).astype(
+        np.int32)
+    prompts = [np.concatenate([sys_prompt, rng.integers(
+        0, cfg.vocab_size, (int(L),)).astype(np.int32)])
+        for L in rng.integers(8, max_suffix + 1, n_req)]
+    gens = rng.integers(8, 33, n_req)
+    arrive = np.cumsum(rng.exponential(0.02, n_req))
+    # multi-tenant traffic: 3 tenants, one of them interactive-class —
+    # greedy outputs are schedule-independent (each continuation depends
+    # only on its own prompt), so parity vs the FIFO run still holds
+    tenants = [f"tenant{j % 3}" for j in range(n_req)]
+    prios = [inference.Priority.INTERACTIVE if j % 3 == 0
+             else inference.Priority.STANDARD for j in range(n_req)]
+
+    def pctl(lat, p):
+        return float(np.percentile(np.asarray(lat), p))
+
+    def run(fleet):
+        eng = inference.LLMEngine(model, inference.LLMEngineConfig(
+            num_slots=8, page_size=16, token_budget=48,
+            max_model_len=sys_len + max_suffix + 40,
+            prefix_cache=fleet))
+        # warm THE decode executable outside the timed window
+        eng.add_request(np.zeros((1,), np.int32), max_new_tokens=1)
+        while eng.has_work():
+            eng.step()
+        eng.stats.update({"steps": 0, "tokens_in": 0, "generated": 0,
+                          "occupancy_sum": 0.0})
+        reqs, nxt = [None] * n_req, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or eng.has_work():
+            now = time.perf_counter() - t0
+            while nxt < n_req and arrive[nxt] <= now:
+                kw = (dict(tenant=tenants[nxt], priority=prios[nxt])
+                      if fleet else {})
+                reqs[nxt] = eng.add_request(
+                    prompts[nxt], max_new_tokens=int(gens[nxt]), **kw)
+                nxt += 1
+            if eng.has_work():
+                eng.step()
+            elif nxt < n_req:
+                time.sleep(min(0.002, arrive[nxt] - now))
+        total = time.perf_counter() - t0
+        outs = [r.future.result(timeout=0) for r in reqs]
+        ttft = [r.t_first_token - r.t_submit for r in reqs]
+        prefill = eng.stats["tokens_in"] - eng.stats["generated"]
+        snap = (eng.prefix_cache.snapshot() if eng.prefix_cache
+                else None)
+        sched = eng.sched.snapshot()
+        eng.close()   # retract the trie's resident-pages gauge delta
+        return outs, ttft, total, prefill, snap, sched
+
+    f_runs, s_runs = [], []
+    for rep in range(2):
+        f_runs.append(run(fleet=True))
+        log(f"[bench] llm_fleet fleet[{rep}]: {f_runs[-1][2]:.2f}s, "
+            f"prefill {f_runs[-1][3]} tok")
+        s_runs.append(run(fleet=False))
+        log(f"[bench] llm_fleet fifo[{rep}]: {s_runs[-1][2]:.2f}s, "
+            f"prefill {s_runs[-1][3]} tok")
+    f_out, f_ttft, f_total, f_prefill, f_snap, f_sched = min(
+        f_runs, key=lambda r: r[2])
+    s_out, s_ttft, s_total, s_prefill, _, _ = min(
+        s_runs, key=lambda r: r[2])
+    match = all(np.array_equal(a, b) for a, b in zip(f_out, s_out))
+    saved_frac = 1.0 - f_prefill / s_prefill
+    gen_tokens = sum(len(f_out[j]) - len(prompts[j])
+                     for j in range(n_req))
+    log(f"[bench] llm_fleet: prefill {s_prefill} -> {f_prefill} tok "
+        f"(-{saved_frac:.1%}), ttft p50 {pctl(s_ttft, 50)*1e3:.0f} -> "
+        f"{pctl(f_ttft, 50)*1e3:.0f} ms, p99 {pctl(s_ttft, 99)*1e3:.0f}"
+        f" -> {pctl(f_ttft, 99)*1e3:.0f} ms, greedy_match={match}")
+    return {
+        "model": name,
+        "requests": n_req, "gen_tokens": gen_tokens,
+        "sys_prompt_tokens": sys_len,
+        "greedy_match": bool(match),
+        "prefill_tokens": {"fifo": int(s_prefill),
+                           "fleet": int(f_prefill),
+                           "saved_frac": round(saved_frac, 4)},
+        "ttft_ms": {
+            "fifo": {"p50": round(pctl(s_ttft, 50) * 1e3, 1),
+                     "p99": round(pctl(s_ttft, 99) * 1e3, 1)},
+            "fleet": {"p50": round(pctl(f_ttft, 50) * 1e3, 1),
+                      "p99": round(pctl(f_ttft, 99) * 1e3, 1)}},
+        "tok_s": {"fifo": round(gen_tokens / s_total),
+                  "fleet": round(gen_tokens / f_total)},
+        "prefix_cache": f_snap,
+        "sched": f_sched,
+        "totals_s": {"fleet": [round(r[2], 2) for r in f_runs],
+                     "fifo": [round(r[2], 2) for r in s_runs]},
+    }
+
+
 def bench_probe():
     """Prove the backend can COMPUTE, not just enumerate devices.
 
@@ -993,6 +1119,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "gpt1p3b_pp": bench_gpt1p3b_pp, "serving": bench_serving,
             "llm_serve": bench_llm_serve,
             "llm_serve_int8": bench_llm_serve_int8,
+            "llm_fleet": bench_llm_fleet,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
 
@@ -1213,18 +1340,20 @@ def main():
         return
     if fallback_env is not None:
         # CPU fallback: the capture window is the scarce resource — run
-        # only the 3D-parallel arm (it is sized for 8 virtual devices)
-        extras = ("train_3d",)
+        # only the arms with cpu-scale geometry (train_3d is sized for
+        # 8 virtual devices; llm_fleet drops to gpt-tiny traffic)
+        extras = ("llm_fleet", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
-                  "serving", "llm_serve", "llm_serve_int8", "train_3d")
+                  "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
+                  "train_3d")
     for which in extras:
-        # the llm_serve arms run TWO serving phases each (engine vs
-        # baseline / int8 vs fp32) plus both compiles: they need a wider
-        # cap than the single-model arms
+        # the llm_serve/llm_fleet arms run TWO serving phases each
+        # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both
+        # compiles: they need a wider cap than the single-model arms
         status, res = _run_worker(
             which,
-            timeout_s=900 if which.startswith("llm_serve") else 420,
+            timeout_s=900 if which.startswith("llm_") else 420,
             extra_env=fallback_env)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")

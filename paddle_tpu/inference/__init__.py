@@ -26,11 +26,16 @@ __all__ = [
     "get_version", "get_num_bytes_of_data_type",
     "convert_to_mixed_precision", "InferenceServer", "BatchingConfig",
     "LLMEngine", "LLMEngineConfig", "LLMServer", "PagePool",
+    "fleet_serving", "RadixPrefixCache", "SLAPolicy", "SLAScheduler",
+    "Priority",
 ]
 
 from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
 from .llm_engine import (  # noqa: E402,F401
     LLMEngine, LLMEngineConfig, LLMServer, PagePool)
+from . import fleet_serving  # noqa: E402,F401
+from .fleet_serving import (  # noqa: E402,F401
+    Priority, RadixPrefixCache, SLAPolicy, SLAScheduler)
 
 
 class DataType:

@@ -1,0 +1,34 @@
+"""paddle_tpu.inference.fleet_serving — serving at fleet economics.
+
+The continuous-batching `LLMEngine` (inference/llm_engine.py) solved
+the single-replica problem: live tokens instead of padded batches, one
+compiled decode executable, per-request eviction. This package solves
+the FLEET problem — millions-of-users traffic where most requests share
+a system prompt and tenants with different latency contracts share one
+page pool (ROADMAP item 2; PAPERS.md "Fine-Tuning and Serving Gemma on
+Cloud TPU" is the serving-economics reference):
+
+* **Radix prefix cache** (`prefix_cache.py`) — a content-addressed
+  token trie over full KV pages. A new request whose prompt prefix is
+  already resident maps the shared pages read-only into its page table
+  and skips their prefill entirely; system prompts amortize to ~zero.
+  Copy-on-write: a write that would land in a shared page first splits
+  the mapping. LRU eviction reclaims trie-only pages under pool
+  pressure. Greedy outputs stay token-identical to the uncached path.
+
+* **SLA scheduler** (`scheduler.py`) — replaces FIFO admission with
+  priority classes, per-tenant token-budget fair queuing, TTFT-SLO
+  deadline boosting, and an explicit preemption path (evict-and-requeue
+  the lowest-priority running sequence) on slot/pool exhaustion.
+
+Both pieces plug into `LLMEngine` via `LLMEngineConfig(prefix_cache=
+True, sla_policy=...)` and change NOTHING about the compiled decode
+step: sharing and scheduling are host-side page-table/queue policy, so
+the zero-recompile contract (ONE executable) holds with the cache on.
+
+Docs: docs/SERVING.md. Bench: `python bench.py --worker llm_fleet`.
+"""
+from .prefix_cache import RadixPrefixCache
+from .scheduler import Priority, SLAPolicy, SLAScheduler
+
+__all__ = ["RadixPrefixCache", "Priority", "SLAPolicy", "SLAScheduler"]

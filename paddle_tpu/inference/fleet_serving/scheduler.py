@@ -1,0 +1,350 @@
+"""SLA-aware multi-tenant scheduler — admission policy for `LLMEngine`.
+
+FIFO admission treats a batch tenant's 4k-token backfill job and an
+interactive tenant's 20-token chat turn as equals; under load the chat
+turn queues behind the backfill and its TTFT SLO dies. This scheduler
+replaces arrival order with three composed policies, all host-side (the
+compiled decode step never sees any of it):
+
+* **Priority classes** — `Priority.INTERACTIVE < STANDARD < BATCH`
+  (lower value = more urgent). Admission always prefers the most urgent
+  non-empty class; within a class tenants share; within a tenant, FIFO.
+
+* **Per-tenant token-budget fair queuing** — each tenant accrues the
+  flat tokens (prefill + decode) the engine actually spent on it,
+  divided by its configured weight. Among same-priority tenants the
+  LEAST-served tenant's head request admits next (deficit-style: a
+  flooding tenant cannot starve a light one; a tenant that was idle has
+  low usage and catches up immediately).
+
+* **TTFT SLO deadline boost** — a request carrying `ttft_slo_s` (or
+  inheriting the policy default) whose wait exceeds
+  `slo_boost_fraction × slo` escalates above every priority class,
+  earliest deadline first. SLO attainment is tracked at first-token
+  time and published as the `pt_sched_ttft_slo_attainment` gauge
+  (the same stamp feeding the engine's pt_llm_ttft_seconds histogram).
+
+Preemption: on slot or pool exhaustion the engine asks `pick_victim`
+for the LOWEST-priority running sequence (tie: youngest admission) —
+evict-and-requeue instead of raising `PoolExhausted` — counted by
+`pt_sched_preemptions{reason=pool|priority}`. With every request on
+default tenant/priority all three policies degrade to exact FIFO plus
+preempt-youngest, the pre-fleet engine semantics (pinned by
+tests/test_llm_engine.py passing unchanged).
+"""
+import collections
+import itertools
+
+from ...observability import metrics as _obs
+
+__all__ = ["Priority", "SLAPolicy", "SLAScheduler"]
+
+_PREEMPTIONS = _obs.counter(
+    "pt_sched_preemptions",
+    "scheduler preemptions: evict-and-requeue of a running sequence",
+    labelnames=("reason",))
+_SLO_FIRST_TOKENS = _obs.counter(
+    "pt_sched_slo_first_tokens",
+    "first tokens of SLO-carrying requests, by TTFT outcome",
+    labelnames=("outcome",))
+_SLO_ATTAINMENT = _obs.gauge(
+    "pt_sched_ttft_slo_attainment",
+    "TTFT SLO attainment: met / (met + missed), process-cumulative")
+
+
+class Priority:
+    """Admission urgency classes (lower value = more urgent)."""
+    INTERACTIVE = 0
+    STANDARD = 1
+    BATCH = 2
+
+
+class SLAPolicy:
+    """Scheduler knobs (docs/SERVING.md has the tuning table).
+
+    default_ttft_slo_s  TTFT SLO applied to requests that don't carry
+                        their own (None = no SLO tracking by default)
+    slo_boost_fraction  fraction of the SLO a request may wait before
+                        it escalates above every priority class
+    tenant_weights      {tenant: weight} for fair queuing — a weight-2
+                        tenant is entitled to 2x the token share of a
+                        weight-1 tenant (missing tenants weigh 1.0)
+    """
+
+    def __init__(self, default_ttft_slo_s=None, slo_boost_fraction=0.7,
+                 tenant_weights=None):
+        self.default_ttft_slo_s = default_ttft_slo_s
+        self.slo_boost_fraction = float(slo_boost_fraction)
+        if not 0.0 < self.slo_boost_fraction <= 1.0:
+            raise ValueError("slo_boost_fraction must be in (0, 1]")
+        self.tenant_weights = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r} weight must be > 0")
+
+    def weight(self, tenant):
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    def slo_for(self, req):
+        slo = getattr(req, "ttft_slo_s", None)
+        return self.default_ttft_slo_s if slo is None else slo
+
+
+class SLAScheduler:
+    """Waiting-queue policy for `LLMEngine` (module docstring). One
+    deque per (priority, tenant); `pop_next` scans queue HEADS only, so
+    a tick costs O(active priority-tenant pairs), not O(waiting)."""
+
+    def __init__(self, policy=None):
+        self.policy = policy or SLAPolicy()
+        self._q = {}      # (priority, tenant) -> deque of requests
+        self._used = collections.defaultdict(float)  # tenant -> tokens/w
+        self._arrival = itertools.count()
+        self._n = 0
+        # count of WAITING requests carrying a per-request TTFT SLO —
+        # gates pop_next's deeper-than-head escalation scan (heads-only
+        # stays O(active pairs) for the SLO-free default, and a
+        # saturated never-empty queue returns to it as soon as the last
+        # SLO-carrying request pops)
+        self._n_slo = 0
+        self.stats = {"preemptions_pool": 0, "preemptions_priority": 0,
+                      "slo_met": 0, "slo_missed": 0}
+
+    @property
+    def _any_slo(self):
+        return (self.policy.default_ttft_slo_s is not None
+                or self._n_slo > 0)
+
+    @staticmethod
+    def _counts_slo(req):
+        # only a request that can still ESCALATE (no first token yet —
+        # _at_risk's own gate) arms the deep scan; a preempted
+        # mid-decode request never re-escalates, so it must not flip
+        # every tick to O(waiting). t_first_token is stable while the
+        # request waits, so enqueue/pop stay balanced.
+        return (getattr(req, "ttft_slo_s", None) is not None
+                and getattr(req, "t_first_token", None) is None)
+
+    def __len__(self):
+        return self._n
+
+    def __bool__(self):
+        return self._n > 0
+
+    def __iter__(self):
+        """Waiting requests in plain queue order (metrics/abort use)."""
+        for dq in self._q.values():
+            yield from dq
+
+    # ---- enqueue side ----
+
+    def enqueue(self, req):
+        if getattr(req, "_arrival", None) is None:
+            req._arrival = next(self._arrival)
+        if self._counts_slo(req):
+            self._n_slo += 1
+        self._dq(req).append(req)
+        self._n += 1
+
+    def push_front(self, req):
+        """Return a popped-but-not-admitted (or preempted) request to
+        the head of its class queue — it keeps its original arrival
+        stamp, so class-internal order is stable."""
+        if self._counts_slo(req):
+            self._n_slo += 1
+        self._dq(req).appendleft(req)
+        self._n += 1
+
+    def _dq(self, req):
+        key = (int(req.priority), req.tenant)
+        dq = self._q.get(key)
+        if dq is None:
+            dq = self._q[key] = collections.deque()
+        return dq
+
+    def drain(self):
+        """Pop every waiting request (abort path)."""
+        out = []
+        for dq in self._q.values():
+            out.extend(dq)
+        self._q.clear()
+        self._n = 0
+        self._n_slo = 0
+        return out
+
+    # ---- admission order ----
+
+    def _at_risk(self, req, now):
+        # TTFT is a FIRST-token target: once a request has produced
+        # one, escalation ends (its SLO is already met or missed).
+        # Keeping it escalated after that point livelocks: a running
+        # low-priority request would be preempted by a standard
+        # candidate, re-escalate from the queue, preempt the standard
+        # one back, and neither would ever finish.
+        if getattr(req, "t_first_token", None) is not None:
+            return None
+        slo = self.policy.slo_for(req)
+        if slo is None:
+            return None
+        waited = now - req.t_submit
+        if waited >= self.policy.slo_boost_fraction * float(slo):
+            return req.t_submit + float(slo)  # deadline
+        return None
+
+    def _eff_priority(self, req, now):
+        """Priority with SLO escalation folded in (-1 = escalated) —
+        used for BOTH admission candidates and preemption victims, so
+        an at-risk sequence a moment from its first token cannot be
+        preempted by the very class it just escalated above."""
+        return (-1 if self._at_risk(req, now) is not None
+                else int(req.priority))
+
+    def _order_key(self, req, now):
+        deadline = self._at_risk(req, now)
+        if deadline is not None:
+            # escalated above every class: earliest deadline first
+            return (-1, deadline, req._arrival)
+        # .get, not [] — a defaultdict read would materialize a phantom
+        # 0.0 meter for every tenant that merely WAITS (snapshot noise
+        # + pressure on the _MAX_TENANT_METERS cap)
+        return (int(req.priority), self._used.get(req.tenant, 0.0),
+                req._arrival)
+
+    def pop_next(self, now):
+        """Most-urgent waiting request, or None: SLO-escalated first
+        (earliest deadline), then priority class, then least-served
+        tenant, then arrival order. Scans per-class queue HEADS —
+        except when TTFT SLOs are in play, where deque members are
+        scanned too: a buried request with a tight per-request SLO
+        must not wait out its deadline behind an un-escalated head.
+        Non-head members compete ONLY once escalated, so within-class
+        order stays FIFO."""
+        best_key, best_q, best_i = None, None, None
+        for key, dq in self._q.items():
+            if not dq:
+                continue
+            candidates = enumerate(dq) if self._any_slo else ((0, dq[0]),)
+            for i, r in candidates:
+                k = self._order_key(r, now)
+                if i and k[0] != -1:
+                    continue   # buried + not escalated: FIFO holds
+                if best_key is None or k < best_key:
+                    best_key, best_q, best_i = k, key, i
+        if best_q is None:
+            return None
+        self._n -= 1
+        dq = self._q[best_q]
+        req = dq[best_i]
+        del dq[best_i]
+        if not dq:
+            # drop emptied class queues: tenant ids are client-supplied,
+            # so keys would otherwise accumulate forever and pop_next
+            # would scan every tenant EVER seen each engine tick
+            del self._q[best_q]
+        if self._counts_slo(req):
+            # last SLO-carrying waiter gone: back to the heads-only scan
+            # even on a saturated queue that never fully drains (one SLO
+            # request long ago must not make every future tick
+            # O(waiting))
+            self._n_slo -= 1
+        return req
+
+    # ---- preemption ----
+
+    def pick_victim(self, slots, keep=None, worse_than=None, now=0.0,
+                    allow_equal=False):
+        """(slot, request) to evict-and-requeue, or None.
+
+        Victim = lowest-priority running sequence (max priority value),
+        tie-broken youngest (max admit_seq) — the request with the
+        least sunk cost in its class. `keep` is never picked.
+        `worse_than` (an admission candidate, or a running sequence
+        that needs to GROW) demands a victim no more urgent than its
+        effective urgency: STRICTLY less urgent by default — equal
+        priorities never preempt each other, which is what keeps the
+        default single-class configuration FIFO-stable — while
+        `allow_equal=True` admits equal-urgency victims too (the page-
+        growth path's pre-fleet preempt-youngest baseline)."""
+        victim, vslot, vkey = None, None, None
+        for slot, req in enumerate(slots):
+            if req is None or req is keep:
+                continue
+            key = (self._eff_priority(req, now), req.admit_seq)
+            if victim is None or key > vkey:
+                victim, vslot, vkey = req, slot, key
+        if victim is None:
+            return None
+        if worse_than is not None:
+            cand = self._eff_priority(worse_than, now)
+            if vkey[0] < cand or (vkey[0] == cand and not allow_equal):
+                return None
+        return vslot, victim
+
+    def less_urgent(self, a, b, now=0.0):
+        """True when running sequence `a` is STRICTLY less urgent than
+        admission candidate `b` — i.e. a legal preemption victim for it
+        (the engine's admission-feasibility view of `worse_than`)."""
+        return self._eff_priority(a, now) > self._eff_priority(b, now)
+
+    def note_preemption(self, reason):
+        self.stats[f"preemptions_{reason}"] += 1
+        _PREEMPTIONS.labels(reason=reason).inc()
+
+    # ---- accounting ----
+
+    # fair-queuing meters kept at most (tenant ids are client-supplied:
+    # a per-user tenant scheme must not leak one float per user forever)
+    _MAX_TENANT_METERS = 10000
+
+    def note_tokens(self, tenant, n):
+        """Charge `n` flat tokens (prefill + decode actually scheduled)
+        to the tenant's fair-queuing meter."""
+        self._used[tenant] += n / self.policy.weight(tenant)
+        if len(self._used) > self._MAX_TENANT_METERS:
+            # drop the least-served half: their meters sit nearest the
+            # fresh-tenant default of 0, so an evicted tenant returns
+            # exactly as entitled as a brand-new one
+            keep = sorted(self._used.items(), key=lambda kv: kv[1],
+                          reverse=True)[:self._MAX_TENANT_METERS // 2]
+            self._used = collections.defaultdict(float, keep)
+
+    def note_first_token(self, req, ttft_s):
+        slo = self.policy.slo_for(req)
+        if slo is None:
+            return
+        met = ttft_s <= float(slo)
+        self.stats["slo_met" if met else "slo_missed"] += 1
+        _SLO_FIRST_TOKENS.labels(outcome="met" if met else "missed").inc()
+        # the gauge is PROCESS-cumulative (docs/OBSERVABILITY.md), so
+        # derive it from the global counters — several engines in one
+        # process must not each overwrite it with their local ratio.
+        # Under PT_TELEMETRY=0 the counters are no-ops and both read 0:
+        # skip the gauge (also a no-op) instead of dividing by zero.
+        n_met = _SLO_FIRST_TOKENS.labels(outcome="met").value
+        n_missed = _SLO_FIRST_TOKENS.labels(outcome="missed").value
+        if n_met + n_missed:
+            _SLO_ATTAINMENT.set(n_met / (n_met + n_missed))
+
+    def snapshot(self):
+        """Metrics view for `LLMEngine.metrics()`."""
+        # list() copies: the metrics HTTP scrape thread snapshots while
+        # the engine thread creates/deletes queues and tenant meters
+        depths = {f"{prio}:{tenant}": len(dq)
+                  for (prio, tenant), dq in list(self._q.items()) if dq}
+        met, missed = self.stats["slo_met"], self.stats["slo_missed"]
+        # top consumers only: per-user tenant schemes run the meter
+        # table to its 10k cap, and every metrics() call / HTTP scrape
+        # would otherwise serialize the whole thing
+        top = sorted(list(self._used.items()), key=lambda kv: kv[1],
+                     reverse=True)[:32]
+        return {
+            "waiting": self._n,
+            "queue_depths": depths,
+            "tenant_meters": len(self._used),
+            "tenant_used_tokens": {t: round(u, 1) for t, u in top},
+            "preemptions_pool": self.stats["preemptions_pool"],
+            "preemptions_priority": self.stats["preemptions_priority"],
+            "slo_met": met, "slo_missed": missed,
+            "slo_attainment": (met / (met + missed)
+                               if met + missed else None),
+        }

@@ -25,9 +25,22 @@ as its analysis_predictor/serving stack):
   decode slots, chunks their prefill into the running batch (a FLAT
   token budget: each step carries one decode token per running sequence
   plus as many prefill tokens as fit), samples at each sequence
-  frontier, and evicts on EOS or token budget. When the pool runs dry
-  the youngest sequence is preempted back to the queue (pages freed;
-  greedy decode makes the re-run deterministic).
+  frontier, and evicts on EOS or token budget. Admission ORDER is the
+  fleet_serving `SLAScheduler` — priority classes, per-tenant
+  token-budget fair queuing, TTFT-SLO deadline boosting — which
+  degrades to exact FIFO under the default single class. When the pool
+  (or slot table) runs dry the lowest-priority / youngest sequence is
+  preempted back to the queue (pages freed; greedy decode makes the
+  re-run deterministic), after the prefix cache — when enabled — has
+  given back its LRU unmapped pages.
+
+* **Shared-prefix radix KV cache** (`LLMEngineConfig(prefix_cache=
+  True)` / PT_PREFIX_CACHE) — fleet_serving.RadixPrefixCache indexes
+  full prompt pages by token content; a new request whose prompt
+  prefix is resident maps the shared pages copy-on-write into its page
+  table and skips their prefill entirely, so a fleet sharing a system
+  prompt pays its prefill once (docs/SERVING.md; greedy outputs stay
+  token-identical — tests/test_fleet_serving.py pins it).
 
 * **ONE compiled decode executable** — every scheduler tick calls the
   same fixed-shape program (`_CompiledPagedStep` over
@@ -54,6 +67,7 @@ tests/test_llm_engine.py); eos semantics follow the shared contract
 """
 import collections
 import itertools
+import os
 import queue
 import time as _time
 from concurrent.futures import Future
@@ -65,6 +79,7 @@ import jax.numpy as jnp
 
 from ..observability import metrics as _obs
 from ..observability.tracing import trace_span as _trace_span
+from .fleet_serving import Priority, RadixPrefixCache, SLAScheduler
 from .serving import _FutureQueueServer
 
 __all__ = ["PagePool", "PoolExhausted", "LLMEngineConfig", "LLMEngine",
@@ -120,10 +135,17 @@ class PoolExhausted(RuntimeError):
 
 
 class PagePool:
-    """Fixed-size KV-page allocator. Physical page 0 is reserved as the
-    trash page (padding-token writes), so pages 1..num_pages-1 are
-    allocable. Strict double-free/leak checking — the invariants the
-    soak test pins."""
+    """Refcounted fixed-size KV-page allocator. Physical page 0 is
+    reserved as the trash page (padding-token writes), so pages
+    1..num_pages-1 are allocable. `alloc()` hands out a page at
+    refcount 1; `share()` adds a holder (the prefix cache's trie and
+    every request mapping a shared page each hold one reference);
+    `free()` drops one reference per page and only returns the page to
+    the free list at refcount 0. Strict double-free / free-list
+    corruption / leak checking — the invariants the soak and refcount
+    tests pin (a free of an already-free page RAISES instead of
+    silently double-inserting it into the free list, which would later
+    hand the same page to two sequences)."""
 
     def __init__(self, num_pages, page_size):
         if num_pages < 2:
@@ -132,7 +154,7 @@ class PagePool:
         self.page_size = int(page_size)
         # LIFO free stack, seeded so the first allocs hand out 1, 2, ...
         self._free = list(range(self.num_pages - 1, 0, -1))
-        self._live = set()
+        self._ref = {}  # live page id -> refcount (>= 1)
 
     @property
     def num_free(self):
@@ -140,31 +162,66 @@ class PagePool:
 
     @property
     def num_live(self):
-        return len(self._live)
+        return len(self._ref)
+
+    @property
+    def num_shared(self):
+        # list() copy: the metrics HTTP scrape thread reads this while
+        # the engine thread alloc/frees (dict resize mid-iteration)
+        return sum(1 for c in list(self._ref.values()) if c > 1)
+
+    def refcount(self, page):
+        return self._ref.get(int(page), 0)
 
     def alloc(self):
         if not self._free:
             raise PoolExhausted(
                 f"all {self.num_pages - 1} KV pages in use")
         p = self._free.pop()
-        self._live.add(p)
+        if p in self._ref:  # a corrupted free list must fail loudly
+            raise RuntimeError(
+                f"corrupt free list: page {p} is already live")
+        self._ref[p] = 1
+        return p
+
+    def share(self, page):
+        """Add one holder to a LIVE page (shared-prefix mapping).
+        Sharing a freed page is a use-after-free — the page may already
+        belong to another sequence — so it raises."""
+        p = int(page)
+        if p not in self._ref:
+            raise RuntimeError(
+                f"share of non-live KV page {p}: the page was freed "
+                "(or never allocated) — stale prefix-cache mapping?")
+        self._ref[p] += 1
         return p
 
     def free(self, pages):
         for p in pages:
-            if p not in self._live:
+            p = int(p)
+            if p not in self._ref:
                 raise RuntimeError(
                     f"double free of KV page {p} (live: "
-                    f"{len(self._live)})")
-            self._live.remove(p)
-            self._free.append(p)
+                    f"{len(self._ref)})")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
 
     def assert_consistent(self):
-        total = len(self._free) + len(self._live)
+        if len(self._free) != len(set(self._free)):
+            raise RuntimeError("corrupt free list: duplicate pages")
+        both = set(self._free) & set(self._ref)
+        if both:
+            raise RuntimeError(
+                f"pages both free and live: {sorted(both)}")
+        if 0 in self._ref or 0 in self._free:
+            raise RuntimeError("trash page 0 entered circulation")
+        total = len(self._free) + len(self._ref)
         if total != self.num_pages - 1:
             raise RuntimeError(
                 f"page leak: {len(self._free)} free + "
-                f"{len(self._live)} live != {self.num_pages - 1}")
+                f"{len(self._ref)} live != {self.num_pages - 1}")
 
 
 class LLMEngineConfig:
@@ -185,20 +242,59 @@ class LLMEngineConfig:
                   quantized runtime — int8 pools carry per-row scale
                   planes and dequantize on gather). Default: the
                   PT_KV_DTYPE env var, else the model compute dtype.
+    prefix_cache  enable the shared-prefix radix KV cache
+                  (fleet_serving.RadixPrefixCache): requests with a
+                  cached prompt prefix map shared pages read-only and
+                  skip their prefill. Default: the PT_PREFIX_CACHE env
+                  var, else off.
+    hash_block_tokens
+                  content-hash granularity of the prefix trie, in
+                  tokens. Must be a positive multiple of `page_size`
+                  (a trie node maps WHOLE pages; a block that ends
+                  mid-page would alias half-written KV). Default:
+                  page_size.
+    sla_policy    fleet_serving.SLAPolicy for the admission scheduler
+                  (priority classes, tenant fair queuing, TTFT SLO
+                  boost). Default policy degrades to FIFO when every
+                  request uses the default tenant/priority.
     """
 
     def __init__(self, num_slots=4, page_size=16, num_pages=None,
-                 max_model_len=None, token_budget=None, kv_dtype=None):
+                 max_model_len=None, token_budget=None, kv_dtype=None,
+                 prefix_cache=None, hash_block_tokens=None,
+                 sla_policy=None):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
         self.max_model_len = max_model_len
         self.token_budget = token_budget
         self.kv_dtype = kv_dtype
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PT_PREFIX_CACHE", "0").strip().lower() in (
+                    "1", "true", "yes", "on")
+        self.prefix_cache = bool(prefix_cache)
+        self.hash_block_tokens = int(
+            self.page_size if hash_block_tokens is None
+            else hash_block_tokens)
+        self.sla_policy = sla_policy
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if self.hash_block_tokens < 1:
+            raise ValueError("hash_block_tokens must be >= 1")
+        if self.prefix_cache and (
+                self.hash_block_tokens % self.page_size != 0):
+            # silent misalignment would map pages whose tail rows hold
+            # a DIFFERENT request's tokens — reject loudly at config
+            # time, not with corrupted logits at serve time
+            raise ValueError(
+                f"prefix_cache requires page_size ({self.page_size}) "
+                f"to divide hash_block_tokens "
+                f"({self.hash_block_tokens}): a trie block must cover "
+                "an exact number of KV pages, otherwise a shared "
+                "mapping would alias a partially-matching page")
 
     @staticmethod
     def kv_bytes_per_page(model_config, page_size, kv_dtype=None):
@@ -300,7 +396,8 @@ class _CompiledPagedStep:
 class _Request:
     _ids = itertools.count()
 
-    def __init__(self, tokens, max_new_tokens, eos_token_id, future):
+    def __init__(self, tokens, max_new_tokens, eos_token_id, future,
+                 tenant="default", priority=None, ttft_slo_s=None):
         self.rid = next(_Request._ids)
         self.tokens = [int(t) for t in tokens]  # prompt, grows as decoded
         self.prompt_len = len(self.tokens)
@@ -313,9 +410,27 @@ class _Request:
         self.n_prefilled = 0      # kv-written tokens (reset on preempt)
         self.admit_seq = None     # admission order (preemption picks max)
         self.preemptions = 0
+        # fleet_serving fields (scheduler class / fairness / SLO)
+        self.tenant = str(tenant)
+        self.priority = int(Priority.STANDARD if priority is None
+                            else priority)
+        if self.priority < 0:
+            # -1 is the scheduler's SLO-escalation rank: a client
+            # priority below 0 would outrank every deadline-escalated
+            # request AND compare its fair-queuing meter against their
+            # absolute deadlines (meaningless tuple order)
+            raise ValueError(
+                f"priority must be >= 0, got {self.priority} "
+                "(negative ranks are reserved for SLO escalation)")
+        self.ttft_slo_s = ttft_slo_s
+        self._arrival = None      # scheduler enqueue stamp
+        self.cached_prefix = 0    # tokens served from the prefix cache
+        self._cow_pending = 0     # COW splits taken by the last match
+        self.published_blocks = 0  # trie blocks this mapping covers
         # telemetry stamps (admission latency / TTFT / per-request rate)
         self.t_submit = _time.perf_counter()
         self.t_first_admit = None
+        self.t_first_token = None
 
     @property
     def num_generated(self):
@@ -406,17 +521,32 @@ class LLMEngine:
         self._page_tables = np.zeros(
             (self.num_slots, self.pages_per_seq), np.int32)
         self._slots = [None] * self.num_slots
-        self.waiting = collections.deque()
+        # fleet_serving: SLA admission (default policy degrades to
+        # FIFO) + optional shared-prefix radix cache over the pool
+        self.sched = SLAScheduler(cfg.sla_policy)
+        self.hash_block_tokens = int(cfg.hash_block_tokens)
+        self.prefix_cache = (
+            RadixPrefixCache(self.pool, self.page_size,
+                             self.hash_block_tokens)
+            if cfg.prefix_cache else None)
         self._admit_counter = itertools.count()
         self._step_fn = _CompiledPagedStep(model)
         self.stats = {"steps": 0, "tokens_in": 0, "generated": 0,
                       "finished": 0, "preemptions": 0,
                       "occupancy_sum": 0.0}
 
+    @property
+    def waiting(self):
+        """The admission queue (fleet_serving.SLAScheduler). Supports
+        len() / bool() / iteration; admission ORDER is the scheduler's
+        (docs/SERVING.md), not necessarily arrival."""
+        return self.sched
+
     # ---- client side ----
 
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
-                    future=None):
+                    future=None, tenant="default", priority=None,
+                    ttft_slo_s=None):
         toks = np.asarray(prompt).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -428,7 +558,9 @@ class LLMEngine:
             raise ValueError(
                 f"prompt needs more KV pages than the pool holds "
                 f"({self.pool.num_pages - 1})")
-        req = _Request(toks, max_new_tokens, eos_token_id, future)
+        req = _Request(toks, max_new_tokens, eos_token_id, future,
+                       tenant=tenant, priority=priority,
+                       ttft_slo_s=ttft_slo_s)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
         _REQS_TOTAL.inc()
         if req.target <= req.prompt_len:
@@ -436,8 +568,8 @@ class LLMEngine:
             if not req.future.cancelled():
                 req.future.set_result(req.result_array())
             return req
-        self.waiting.append(req)
-        _QUEUE_DEPTH.set(len(self.waiting))
+        self.sched.enqueue(req)
+        _QUEUE_DEPTH.set(len(self.sched))
         return req
 
     def has_work(self):
@@ -487,15 +619,21 @@ class LLMEngine:
                    + sum(int(s.nbytes) for s in self._kv_scales))
 
     def kv_fragmentation(self):
-        """Internal fragmentation of the live KV pages: 1 − written
-        tokens / (live pages × page_size). High values mean many
+        """Internal fragmentation of the live KV pages: unwritten
+        slots / (live pages × page_size). High values mean many
         sequences holding mostly-empty tail pages (page_size too big
-        for the workload)."""
+        for the workload). Counted as per-request tail waste — NOT as
+        1 − Σ n_prefilled / capacity, which double-counts shared-prefix
+        tokens once per sharer and pins the gauge to 0 exactly when the
+        prefix cache is busiest. Unwritten slots live only in a
+        request's PRIVATE tail pages (shared and trie pages are full by
+        construction), so the sum never double-counts."""
         cap = self.pool.num_live * self.page_size
         if not cap:
             return 0.0
-        used = sum(r.n_prefilled for r in self._slots if r is not None)
-        return max(0.0, 1.0 - used / cap)
+        waste = sum(len(r.pages) * self.page_size - r.n_prefilled
+                    for r in self._slots if r is not None)
+        return max(0.0, waste / cap)
 
     def metrics(self):
         """Live engine view + the process-global serving counters from
@@ -513,6 +651,10 @@ class LLMEngine:
             "kv_page_occupancy":
                 self.pool.num_live / (self.pool.num_pages - 1),
             "kv_fragmentation": self.kv_fragmentation(),
+            "kv_pages_shared": self.pool.num_shared,
+            "prefix_cache": (self.prefix_cache.snapshot()
+                             if self.prefix_cache is not None else None),
+            "sched": self.sched.snapshot(),
             "requests": int(_REQS_TOTAL.value),
             "finished": int(_FINISHED_TOTAL.value),
             "preemptions": int(_PREEMPTIONS_TOTAL.value),
@@ -540,22 +682,36 @@ class LLMEngine:
                 self._release(slot, req)
                 if not req.future.done():
                     req.future.set_exception(exc)
-        while self.waiting:
-            req = self.waiting.popleft()
+        for req in self.sched.drain():
             if not req.future.done():
                 req.future.set_exception(exc)
+        if self.prefix_cache is not None:
+            # the re-zeroed pools invalidate every cached KV page — a
+            # stale trie mapping would serve zeros as a system prompt
+            self.prefix_cache.clear()
         self._kv, self._kv_scales = self._fresh_pools()
         _ABORTS_TOTAL.inc()
         _QUEUE_DEPTH.set(0)
         _LIVE_SLOTS.set(0)
         _SLOT_OCC.set(0.0)
 
+    def close(self):
+        """Retire the engine: drop the prefix trie (its clear()
+        publishes the NEGATIVE resident-pages delta, so a process that
+        cycles engines doesn't leave pt_prefix_cache_resident_pages
+        permanently inflated by gc'd tries). Idempotent; the engine
+        stays usable — the trie just starts cold."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+
     # ---- scheduler ----
 
     def _release(self, slot, req):
-        self.pool.free(req.pages)
-        req.pages = []
+        self.pool.free(req.pages)  # shared pages decref; trie keeps its
+        req.pages = []             # own reference, private pages free
         req.n_prefilled = 0
+        req.cached_prefix = 0
+        req.published_blocks = 0
         req.slot = None
         self._page_tables[slot, :] = 0
         self._slots[slot] = None
@@ -574,41 +730,181 @@ class LLMEngine:
         if not req.future.cancelled():
             req.future.set_result(req.result_array())
 
-    def _preempt_one(self, keep_req):
-        """Free the youngest running sequence (≠ keep_req) back to the
-        queue front. Returns False when there is no victim."""
-        victim, vslot = None, None
-        for slot, req in enumerate(self._slots):
-            if req is None or req is keep_req:
-                continue
-            if victim is None or req.admit_seq > victim.admit_seq:
-                victim, vslot = req, slot
-        if victim is None:
-            return False
-        # keep the already-generated tokens: greedy re-decode of
-        # prompt+generated reproduces the same continuation, so a
-        # preempted request stays deterministic
-        self._release(vslot, victim)
-        victim.preemptions += 1
+    def _preempt(self, slot, req, reason):
+        """Evict-and-requeue one RUNNING sequence (the explicit
+        preemption path: pool/slot exhaustion never surfaces as
+        `PoolExhausted` while a lower-priority victim exists). The
+        already-generated tokens are kept: greedy re-decode of
+        prompt+generated reproduces the same continuation, so a
+        preempted request stays deterministic — and with the prefix
+        cache on, its replayed prefill re-hits the trie."""
+        self._release(slot, req)
+        req.preemptions += 1
         self.stats["preemptions"] += 1
         _PREEMPTIONS_TOTAL.inc()
-        self.waiting.appendleft(victim)
+        self.sched.note_preemption(reason)
+        self.sched.push_front(req)
+
+    def _preempt_one(self, keep_req, worse_than=None, reason="pool",
+                     allow_equal=False):
+        """Preempt the scheduler's victim pick (lowest priority class,
+        then youngest). Returns False when there is no victim (or none
+        `worse_than` allows)."""
+        pick = self.sched.pick_victim(
+            self._slots, keep=keep_req, worse_than=worse_than,
+            now=_time.perf_counter(), allow_equal=allow_equal)
+        if pick is None:
+            return False
+        self._preempt(*pick, reason=reason)
+        return True
+
+    def _alloc_page(self):
+        """Pool alloc with prefix-cache pressure relief: a dry pool
+        first reclaims LRU trie-only pages before the caller has to
+        preempt anything."""
+        try:
+            return self.pool.alloc()
+        except PoolExhausted:
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.evict(1) > 0):
+                return self.pool.alloc()
+            raise
+
+    def _map_prefix(self, req):
+        """Match the request's tokens against the radix trie and map
+        the shared pages. Returns the mapped page list (the request now
+        holds one pool reference per page); `req.cached_prefix` tokens
+        of prefill will be SKIPPED. Copy-on-write cap: at least one
+        token must run through the model (the frontier logit), and its
+        KV write may not land in a shared page — a fully-cached prompt
+        splits its tail block back to private recompute."""
+        cached, pages = self.prefix_cache.match(req.tokens)
+        splits = 0
+        while pages and cached >= len(req.tokens):
+            cached -= self.prefix_cache.cow_split(pages)
+            splits += 1
+        req.cached_prefix = cached
+        req._cow_pending = splits
+        return pages
+
+    def _publish_prefix(self, req):
+        """Register the request's newly-completed full PROMPT blocks in
+        the radix trie (its own mapped blocks are already there —
+        insert is idempotent). Generated-token pages stay private:
+        the fleet workload shares SYSTEM PROMPTS, and restricting the
+        trie to prompt content keeps its size bounded by distinct
+        prompts, not distinct continuations."""
+        bt = self.hash_block_tokens
+        covered = min(req.n_prefilled, req.prompt_len)
+        nblocks = covered // bt
+        if nblocks > req.published_blocks:
+            ppb = self.prefix_cache.pages_per_block
+            self.prefix_cache.insert(req.tokens[:nblocks * bt],
+                                     req.pages[:nblocks * ppb])
+            req.published_blocks = nblocks
+
+    def _try_admit(self, req):
+        """Place one popped request into a slot: prefix-cache mapping,
+        page-fit check (with trie eviction and lowest-priority
+        preemption as pressure valves), page-table setup. Returns False
+        — with every transient reference released — when the request
+        cannot be placed yet."""
+        # cheap bails FIRST — a blocked head-of-queue request must not
+        # pay a full prefix match, a share/free refcount round-trip,
+        # and an O(trie) feasibility walk on every engine tick.
+        # (a) no free slot AND no legal victim:
+        if None not in self._slots:
+            now = _time.perf_counter()
+            if not any(r is not None
+                       and self.sched.less_urgent(r, req, now)
+                       for r in self._slots):
+                return False
+        # (b) pool provably short even in the BEST case: the trie can
+        # map at most resident_pages into the prompt and reclaim at
+        # most resident_pages more, so free + victims + 2·resident <
+        # prompt pages is infeasible regardless of what match() finds —
+        # O(slots) with no trie walk
+        need_all = -(-len(req.tokens) // self.page_size)
+        if self.pool.num_free < need_all:
+            now = _time.perf_counter()
+            avail = self.pool.num_free + sum(
+                len(r.pages) for r in self._slots if r is not None
+                and self.sched.less_urgent(r, req, now))
+            resident = (self.prefix_cache.resident_pages
+                        if self.prefix_cache is not None else 0)
+            if avail + 2 * resident < need_all:
+                return False
+        pages = self._map_prefix(req) if self.prefix_cache is not None \
+            else []
+
+        def give_up():
+            if pages:
+                self.pool.free(pages)
+            req.cached_prefix = 0
+            return False
+
+        # feasibility FIRST: preempting a runner destroys its generated
+        # progress, so don't start evicting until a slot AND enough
+        # reclaimable pages can possibly exist. `reclaimable` is an
+        # upper bound (a page shared by two victims counts twice) — the
+        # loops below still give up cleanly when eviction falls short.
+        # Skipped entirely on the uncontended fast path (free slot +
+        # pool already covers the prompt): the trie walk is O(nodes).
+        need = -(-len(req.tokens) // self.page_size) - len(pages)
+        if None not in self._slots or self.pool.num_free < need:
+            now = _time.perf_counter()
+            victims = [r for r in self._slots if r is not None
+                       and self.sched.less_urgent(r, req, now)]
+            if None not in self._slots and not victims:
+                return give_up()
+            reclaimable = self.pool.num_free + sum(
+                len(r.pages) for r in victims)
+            if self.prefix_cache is not None:
+                reclaimable += self.prefix_cache.reclaimable_pages()
+            if reclaimable < need:
+                return give_up()
+        # a slot: free one, or preempt a strictly-less-urgent runner
+        if None not in self._slots:
+            if not self._preempt_one(None, worse_than=req,
+                                     reason="priority"):
+                return give_up()
+        # the prompt's remaining pages must fit (head-of-class
+        # blocking: a short prompt never jumps its own class's queue)
+        while self.pool.num_free < need:
+            short = need - self.pool.num_free
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.evict(short) > 0):
+                continue
+            if not self._preempt_one(None, worse_than=req,
+                                     reason="priority"):
+                return give_up()
+        slot = self._slots.index(None)
+        req.slot = slot
+        req.admit_seq = next(self._admit_counter)
+        req.pages = list(pages)
+        req.n_prefilled = req.cached_prefix
+        req.published_blocks = req.cached_prefix // self.hash_block_tokens
+        self._page_tables[slot, :] = 0
+        self._page_tables[slot, :len(pages)] = pages
+        self._slots[slot] = req
+        if self.prefix_cache is not None:
+            self.prefix_cache.note_mapped(
+                req.cached_prefix, pages,
+                cow_splits=getattr(req, "_cow_pending", 0))
+        if req.t_first_admit is None:
+            req.t_first_admit = _time.perf_counter()
+            _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
         return True
 
     def _admit(self):
-        while self.waiting and None in self._slots:
-            req = self.waiting[0]
-            need = -(-len(req.tokens) // self.page_size)
-            if self.pool.num_free < need:
-                break  # FIFO: don't let a short prompt jump the queue
-            self.waiting.popleft()
-            slot = self._slots.index(None)
-            req.slot = slot
-            req.admit_seq = next(self._admit_counter)
-            self._slots[slot] = req
-            if req.t_first_admit is None:
-                req.t_first_admit = _time.perf_counter()
-                _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
+        now = _time.perf_counter()
+        while self.sched:
+            req = self.sched.pop_next(now)
+            if req is None:
+                break
+            if not self._try_admit(req):
+                self.sched.push_front(req)
+                break
 
     def _active(self):
         """Running sequences in admission order (deterministic plan)."""
@@ -638,17 +934,32 @@ class LLMEngine:
                 last = req.n_prefilled + alloc[slot] - 1
                 try:
                     while last // self.page_size >= len(req.pages):
-                        page = self.pool.alloc()
+                        page = self._alloc_page()
                         self._page_tables[slot, len(req.pages)] = page
                         req.pages.append(page)
                 except PoolExhausted:
-                    if not self._preempt_one(req):
-                        # lone sequence outgrew the pool: unservable
-                        self._release(slot, req)
-                        if not req.future.done():
-                            req.future.set_exception(PoolExhausted(
-                                f"request {req.rid} needs more KV pages "
-                                f"than the pool holds"))
+                    # the victim may be no MORE urgent than the growing
+                    # sequence: a BATCH job's page growth must never
+                    # evict an INTERACTIVE runner (equal urgency keeps
+                    # the pre-fleet preempt-youngest baseline)
+                    if not self._preempt_one(req, worse_than=req,
+                                             allow_equal=True):
+                        kept = -(-len(req.tokens) // self.page_size)
+                        if (kept <= self.pool.num_pages - 1
+                                and any(r is not None and r is not req
+                                        for r in self._slots)):
+                            # every other runner outranks req: req
+                            # itself yields its pages and requeues
+                            self._preempt(slot, req, reason="pool")
+                        else:
+                            # kept tokens outgrew the whole pool:
+                            # unservable even alone — requeueing would
+                            # spin _try_admit forever
+                            self._release(slot, req)
+                            if not req.future.done():
+                                req.future.set_exception(PoolExhausted(
+                                    f"request {req.rid} needs more KV "
+                                    f"pages than the pool holds"))
                     ok = False
                     break
             if ok:
@@ -725,6 +1036,10 @@ class LLMEngine:
 
         for slot, req, take in plan:
             req.n_prefilled += take
+            # per-tenant fair-queuing meter: flat tokens actually spent
+            self.sched.note_tokens(req.tenant, take)
+            if self.prefix_cache is not None:
+                self._publish_prefix(req)
         _PAGE_FRAG.set(self.kv_fragmentation())
         finished = []
         now = _time.perf_counter()
@@ -734,7 +1049,10 @@ class LLMEngine:
             req.tokens.append(t)
             self.stats["generated"] += 1
             if req.num_generated == 1:      # replays don't re-count
-                _TTFT_SECONDS.observe(now - req.t_submit)
+                ttft = now - req.t_submit
+                req.t_first_token = now
+                _TTFT_SECONDS.observe(ttft)
+                self.sched.note_first_token(req, ttft)
             if ((req.eos is not None and t == req.eos)
                     or len(req.tokens) >= req.target):
                 self._finish(slot, req)
@@ -781,26 +1099,37 @@ class LLMServer(_FutureQueueServer):
 
     def stop(self):
         super().stop()
+        self._engine.close()
         if self._http is not None:
             self._http.stop()
             self._http = None
 
-    def submit(self, prompt, max_new_tokens=32, eos_token_id=None):
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               tenant="default", priority=None, ttft_slo_s=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
-        after it)."""
+        after it).
+
+        Fleet fields (docs/SERVING.md): `tenant` groups requests for
+        token-budget fair queuing, `priority` is a
+        `fleet_serving.Priority` class (default STANDARD), and
+        `ttft_slo_s` sets this request's TTFT SLO for deadline
+        boosting and the attainment gauge."""
         fut = Future()
         self._enqueue((np.asarray(prompt).reshape(-1),
-                       int(max_new_tokens), eos_token_id, fut))
+                       int(max_new_tokens), eos_token_id, fut,
+                       tenant, priority, ttft_slo_s))
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
         return self.submit(prompt, max_new_tokens, eos_token_id).result()
 
     def _ingest(self, payload):
-        prompt, max_new, eos, fut = payload
+        prompt, max_new, eos, fut, tenant, priority, slo = payload
         try:
-            self._engine.add_request(prompt, max_new, eos, future=fut)
+            self._engine.add_request(prompt, max_new, eos, future=fut,
+                                     tenant=tenant, priority=priority,
+                                     ttft_slo_s=slo)
             self.stats["requests"] += 1
         except Exception as e:  # bad request must not kill the loop
             if not fut.done():

@@ -1,0 +1,998 @@
+"""Fleet serving (inference/fleet_serving/): shared-prefix radix KV
+cache + SLA-aware multi-tenant scheduler.
+
+The ISSUE-7 acceptance suite: PagePool refcount/double-free/corruption
+invariants, config geometry validation, radix-trie match/insert/COW/
+LRU-eviction semantics, greedy token parity of cache hits vs the
+uncached engine (fp32 AND int8 with byte-identical scale planes),
+scheduler policy (priority inversion, tenant fairness, TTFT-SLO boost,
+preempt-on-exhaustion), and the CI gate: ptlint zero findings over the
+package + analyze_step donation + ONE decode executable with the
+prefix cache enabled.
+"""
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.inference.fleet_serving import (
+    Priority, RadixPrefixCache, SLAPolicy, SLAScheduler)
+from paddle_tpu.inference.llm_engine import (
+    LLMEngine, LLMEngineConfig, PagePool, PoolExhausted)
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _drain(eng, cap=600):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+    return steps
+
+
+def _run_batch(model, prompts, max_new, **cfg_kw):
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64,
+        **cfg_kw))
+    reqs = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    _drain(eng)
+    return eng, [r.future.result(timeout=0) for r in reqs]
+
+
+# --------------------------------------------------------------------
+# PagePool refcounting (satellite: alloc/free/refcount invariants)
+# --------------------------------------------------------------------
+
+def test_page_pool_share_refcount_invariants():
+    pool = PagePool(num_pages=6, page_size=16)
+    a, b = pool.alloc(), pool.alloc()
+    assert pool.refcount(a) == 1 and pool.num_shared == 0
+    pool.share(a)
+    pool.share(a)
+    assert pool.refcount(a) == 3 and pool.num_shared == 1
+    pool.assert_consistent()
+    # two frees drop holders without releasing the page
+    pool.free([a, a])
+    assert pool.refcount(a) == 1 and pool.num_live == 2
+    pool.free([a])
+    assert pool.refcount(a) == 0 and pool.num_free == 4
+    # the released page is reallocable exactly once
+    c = pool.alloc()
+    assert c == a and pool.refcount(c) == 1
+    pool.free([b, c])
+    pool.assert_consistent()
+    assert pool.num_live == 0
+
+
+def test_page_pool_free_and_share_after_free_raise():
+    """A free of an already-free page must RAISE, not double-insert it
+    into the free list (two sequences would later share one page); a
+    share of a freed page is a use-after-free."""
+    pool = PagePool(num_pages=4, page_size=16)
+    pages = [pool.alloc() for _ in range(3)]
+    with pytest.raises(PoolExhausted):
+        pool.alloc()
+    # free everything at exhaustion, then free again: each must raise
+    pool.free(pages)
+    for p in pages:
+        with pytest.raises(RuntimeError, match="double free"):
+            pool.free([p])
+        with pytest.raises(RuntimeError, match="share of non-live"):
+            pool.share(p)
+    pool.assert_consistent()
+    # the free list was not corrupted by the rejected frees: the pool
+    # still hands out exactly 3 distinct pages
+    again = [pool.alloc() for _ in range(3)]
+    assert len(set(again)) == 3
+    with pytest.raises(PoolExhausted):
+        pool.alloc()
+    pool.free(again)
+    pool.assert_consistent()
+
+
+def test_page_pool_shared_page_free_order_is_immaterial():
+    pool = PagePool(num_pages=4, page_size=16)
+    p = pool.alloc()
+    pool.share(p)
+    pool.free([p])          # first holder gone
+    assert pool.refcount(p) == 1
+    pool.share(p)           # still live: new holder is legal
+    pool.free([p, p])
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free([p])
+    pool.assert_consistent()
+
+
+# --------------------------------------------------------------------
+# config validation (satellite: geometry rejection)
+# --------------------------------------------------------------------
+
+def test_config_rejects_misaligned_hash_block():
+    with pytest.raises(ValueError, match="divide hash_block_tokens"):
+        LLMEngineConfig(page_size=16, prefix_cache=True,
+                        hash_block_tokens=24)
+    with pytest.raises(ValueError, match="divide hash_block_tokens"):
+        LLMEngineConfig(page_size=16, prefix_cache=True,
+                        hash_block_tokens=8)
+    # multiples are fine; disabled cache skips the check (the knob is
+    # inert then)
+    assert LLMEngineConfig(page_size=16, prefix_cache=True,
+                           hash_block_tokens=32).hash_block_tokens == 32
+    assert LLMEngineConfig(page_size=16, prefix_cache=False,
+                           hash_block_tokens=24).prefix_cache is False
+    with pytest.raises(ValueError, match="hash_block_tokens"):
+        LLMEngineConfig(page_size=16, prefix_cache=True,
+                        hash_block_tokens=0)
+
+
+def test_config_prefix_cache_env_knob(monkeypatch):
+    monkeypatch.setenv("PT_PREFIX_CACHE", "1")
+    assert LLMEngineConfig().prefix_cache is True
+    monkeypatch.setenv("PT_PREFIX_CACHE", "0")
+    assert LLMEngineConfig().prefix_cache is False
+    # explicit argument beats the env
+    assert LLMEngineConfig(prefix_cache=True).prefix_cache is True
+    monkeypatch.delenv("PT_PREFIX_CACHE")
+    assert LLMEngineConfig().prefix_cache is False
+    # the RadixPrefixCache constructor enforces the same contract for
+    # direct users
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        RadixPrefixCache(PagePool(4, 16), 16, block_tokens=24)
+
+
+# --------------------------------------------------------------------
+# radix trie semantics (bare pool, no model)
+# --------------------------------------------------------------------
+
+def test_radix_cache_match_insert_refcounts():
+    pool = PagePool(num_pages=10, page_size=4)
+    cache = RadixPrefixCache(pool, page_size=4)
+    toks = list(range(100, 112))           # 3 full blocks of 4
+    pages = [pool.alloc() for _ in range(3)]
+    assert cache.insert(toks, pages) == 3
+    assert cache.num_nodes == 3 and cache.resident_pages == 3
+    assert all(pool.refcount(p) == 2 for p in pages)  # owner + trie
+    # re-insert is idempotent
+    assert cache.insert(toks, pages) == 0
+    # full match maps all 3 blocks and takes one ref per page
+    cached, mapped = cache.match(toks + [7, 8])
+    assert cached == 12 and mapped == pages
+    assert all(pool.refcount(p) == 3 for p in pages)
+    pool.free(mapped)
+    # partial match: 2 blocks + divergent third
+    cached, mapped = cache.match(toks[:8] + [55, 56, 57, 58])
+    assert cached == 8 and mapped == pages[:2]
+    pool.free(mapped)
+    # no match under a different FIRST block (path-keyed trie)
+    cached, mapped = cache.match([1, 2, 3, 4] + toks)
+    assert cached == 0 and mapped == []
+    # original owner releases; trie alone keeps the pages live
+    pool.free(pages)
+    assert all(pool.refcount(p) == 1 for p in pages)
+    cache.clear()
+    assert pool.num_live == 0
+    pool.assert_consistent()
+
+
+def test_radix_cache_lru_eviction_leaves_first():
+    pool = PagePool(num_pages=8, page_size=4)
+    cache = RadixPrefixCache(pool, page_size=4)
+    a = [pool.alloc() for _ in range(2)]
+    b = [pool.alloc() for _ in range(2)]
+    cache.insert([1, 2, 3, 4, 5, 6, 7, 8], a)       # chain A: 2 nodes
+    cache.insert([9, 10, 11, 12, 13, 14, 15, 16], b)  # chain B
+    pool.free(a + b)                                 # trie-only now
+    # touch the whole of chain A (both nodes); B is now LRU
+    pool.free(cache.match([1, 2, 3, 4, 5, 6, 7, 8])[1])
+    # B is LRU -> its leaf, then its root, evict before A's nodes
+    assert cache.evict(1) >= 1
+    cached_b, mapped_b = cache.match([9, 10, 11, 12, 13, 14, 15, 16])
+    assert cached_b <= 4
+    pool.free(mapped_b)
+    # a page still mapped by a "request" is not evictable
+    cached, mapped = cache.match([1, 2, 3, 4])
+    assert cached == 4
+    assert cache.evict(100) >= 1   # reclaims everything unmapped
+    assert cache.match([5, 6, 7, 8])[0] == 0
+    pool.free(mapped)
+    cache.clear()
+    pool.assert_consistent()
+    assert pool.num_live == 0
+
+
+# --------------------------------------------------------------------
+# engine: cache hits are token-identical and actually skip prefill
+# --------------------------------------------------------------------
+
+def test_prefix_hit_greedy_parity_and_prefill_savings(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(5)
+    sys_p = rng.integers(0, cfg.vocab_size, (32,))
+    prompts = [np.concatenate([sys_p,
+                               rng.integers(0, cfg.vocab_size, (L,))])
+               for L in (5, 9, 3, 12)]
+    e0, base = _run_batch(model, prompts, 6, prefix_cache=False)
+    e1, fleet = _run_batch(model, prompts, 6, prefix_cache=True)
+    for got, ref in zip(fleet, base):
+        np.testing.assert_array_equal(got, ref)
+    snap = e1.prefix_cache.snapshot()
+    assert snap["hits"] >= 2, snap
+    assert snap["pages_shared"] >= 4, snap
+    assert snap["tokens_saved"] >= 64, snap
+    prefill = lambda e: e.stats["tokens_in"] - e.stats["generated"]
+    assert prefill(e1) == prefill(e0) - snap["tokens_saved"]
+    # the trie (not leaked request refs) holds the surviving pages
+    assert e1.pool.num_live == snap["resident_pages"]
+    assert e1.metrics()["prefix_cache"]["hits"] == snap["hits"]
+    e1.prefix_cache.clear()
+    assert e1.pool.num_live == 0
+
+
+def test_cow_split_on_fully_cached_prompt(tiny_model):
+    """Prompt an exact page multiple + fully cached: the frontier
+    token's KV write would land INSIDE the last shared page — the
+    mapping must split copy-on-write, and greedy output must not
+    notice."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(6)
+    p32 = rng.integers(0, cfg.vocab_size, (32,))
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64,
+        prefix_cache=True))
+    r1 = eng.add_request(p32, max_new_tokens=5)
+    _drain(eng)
+    r2 = eng.add_request(p32, max_new_tokens=5)
+    _drain(eng)
+    snap = eng.prefix_cache.snapshot()
+    assert snap["cow_splits"] >= 1, snap
+    assert snap["tokens_saved"] == 16, snap  # block 2 split to private
+    np.testing.assert_array_equal(r1.future.result(timeout=0),
+                                  r2.future.result(timeout=0))
+    eng.pool.assert_consistent()
+
+
+def test_prefix_cache_eviction_under_pool_pressure(tiny_model):
+    """Distinct prompts fill the trie until the pool runs dry: LRU
+    trie-only pages must be reclaimed (counted), every request must
+    still complete, and the allocator must stay consistent."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(8)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, num_pages=7, token_budget=8,
+        max_model_len=48, prefix_cache=True))
+    reqs = [eng.add_request(rng.integers(0, cfg.vocab_size, (20,)),
+                            max_new_tokens=6) for _ in range(6)]
+    _drain(eng, cap=2000)
+    snap = eng.prefix_cache.snapshot()
+    assert snap["evicted_pages"] > 0, snap
+    for r in reqs:
+        out = r.future.result(timeout=0)
+        assert len(out) == 26
+    eng.pool.assert_consistent()
+
+
+def test_preempted_request_replays_through_cache(tiny_model):
+    """A preempted sequence re-prefills on re-admission; with the
+    cache on, the replay re-hits its own published prompt blocks —
+    and stays deterministic."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (20,)) for _ in range(4)]
+    e0, base = [None, None], None
+    eng_kw = dict(num_slots=3, page_size=16, num_pages=8,
+                  max_model_len=48, token_budget=8)
+    ref_eng = LLMEngine(model, LLMEngineConfig(
+        prefix_cache=False, **eng_kw))
+    refs = [ref_eng.add_request(p, max_new_tokens=20) for p in prompts]
+    _drain(ref_eng, cap=2000)
+    eng = LLMEngine(model, LLMEngineConfig(prefix_cache=True, **eng_kw))
+    reqs = [eng.add_request(p, max_new_tokens=20) for p in prompts]
+    _drain(eng, cap=2000)
+    assert eng.stats["preemptions"] > 0, "pool was not tight enough"
+    # exhaustion surfaced as evict-and-requeue (reason="pool"), never
+    # as a PoolExhausted on a servable request
+    assert eng.sched.stats["preemptions_pool"] >= 1
+    for got, ref in zip(reqs, refs):
+        np.testing.assert_array_equal(got.future.result(timeout=0),
+                                      ref.future.result(timeout=0))
+    # at least one replay hit the trie (tokens_saved counts it)
+    assert eng.prefix_cache.snapshot()["tokens_saved"] > 0
+
+
+# --------------------------------------------------------------------
+# int8 KV x shared pages (satellite: scale planes reused byte-for-byte)
+# --------------------------------------------------------------------
+
+@pytest.mark.quant
+def test_int8_prefix_hit_parity_and_scale_plane_bytes(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(11)
+    sys_p = rng.integers(0, cfg.vocab_size, (32,))
+    prompts = [np.concatenate([sys_p,
+                               rng.integers(0, cfg.vocab_size, (L,))])
+               for L in (5, 9)]
+    # greedy parity: cached int8 engine == uncached int8 engine
+    _, base = _run_batch(model, prompts, 6, kv_dtype="int8",
+                         prefix_cache=False)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64,
+        kv_dtype="int8", prefix_cache=True))
+    r1 = eng.add_request(prompts[0], max_new_tokens=6)
+    _drain(eng)
+    # the two trie pages hold the system prompt's int8 rows + scales;
+    # a hit must REUSE the stored planes, not re-quantize them
+    trie_pages = sorted(p for p in range(1, eng.pool.num_pages)
+                        if eng.pool.refcount(p) == 1)
+    assert len(trie_pages) == 2
+    before = [np.asarray(s)[trie_pages].copy() for s in eng._kv_scales]
+    pool_before = [np.asarray(k)[trie_pages].copy() for k in eng._kv]
+    r2 = eng.add_request(prompts[1], max_new_tokens=6)
+    _drain(eng)
+    assert eng.prefix_cache.snapshot()["hits"] == 1
+    for b, a in zip(before,
+                    (np.asarray(s)[trie_pages] for s in eng._kv_scales)):
+        assert b.tobytes() == a.tobytes()
+    for b, a in zip(pool_before,
+                    (np.asarray(k)[trie_pages] for k in eng._kv)):
+        assert b.tobytes() == a.tobytes()
+    np.testing.assert_array_equal(r1.future.result(timeout=0), base[0])
+    np.testing.assert_array_equal(r2.future.result(timeout=0), base[1])
+
+
+# --------------------------------------------------------------------
+# scheduler policy (unit: no model)
+# --------------------------------------------------------------------
+
+def _fake_req(tenant="default", priority=Priority.STANDARD,
+              ttft_slo_s=None, t_submit=0.0):
+    return types.SimpleNamespace(
+        tenant=tenant, priority=priority, ttft_slo_s=ttft_slo_s,
+        t_submit=t_submit, t_first_token=None, _arrival=None,
+        admit_seq=None)
+
+
+def test_scheduler_priority_then_fairness_then_fifo():
+    s = SLAScheduler()
+    batch = _fake_req(priority=Priority.BATCH)
+    std1, std2 = _fake_req(), _fake_req()
+    inter = _fake_req(priority=Priority.INTERACTIVE)
+    for r in (batch, std1, std2, inter):
+        s.enqueue(r)
+    assert len(s) == 4
+    assert s.pop_next(0.0) is inter
+    assert s.pop_next(0.0) is std1          # FIFO within a class
+    # fairness: tenant "hog" has consumed tokens, "idle" has not
+    hog, idle = _fake_req(tenant="hog"), _fake_req(tenant="idle")
+    s.enqueue(hog)
+    s.enqueue(idle)
+    s.note_tokens("hog", 100)
+    assert s.pop_next(0.0) is std2          # least-served: default=0
+    assert s.pop_next(0.0) is idle
+    assert s.pop_next(0.0) is hog
+    assert s.pop_next(0.0) is batch
+    assert s.pop_next(0.0) is None and not s
+
+
+def test_scheduler_slo_escalation_and_victims():
+    s = SLAScheduler(SLAPolicy(slo_boost_fraction=0.5))
+    inter = _fake_req(priority=Priority.INTERACTIVE, t_submit=0.0)
+    slo = _fake_req(priority=Priority.BATCH, ttft_slo_s=1.0,
+                    t_submit=0.0)
+    s.enqueue(inter)
+    s.enqueue(slo)
+    # before the boost window: plain classes
+    assert s.pop_next(0.1) is inter
+    s.push_front(inter)
+    # past 50% of the SLO: the batch request escalates above everyone
+    assert s.pop_next(0.6) is slo
+    # victim pick: lowest class, then youngest; escalated runners and
+    # equal classes are protected
+    a = _fake_req(priority=Priority.STANDARD)
+    a.admit_seq = 1
+    b = _fake_req(priority=Priority.BATCH)
+    b.admit_seq = 2
+    c = _fake_req(priority=Priority.BATCH)
+    c.admit_seq = 3
+    slots = [a, b, c, None]
+    assert s.pick_victim(slots) == (2, c)
+    assert s.pick_victim(slots, keep=c) == (1, b)
+    assert s.pick_victim(slots, worse_than=b, now=0.0) is None
+    assert s.pick_victim(slots, worse_than=a, now=0.0) == (2, c)
+    # an at-risk runner (no first token yet) is shielded from its own
+    # escalation class — the anti-livelock rule
+    c.ttft_slo_s = 0.1
+    c.t_submit = 0.0
+    assert s.pick_victim([c], worse_than=a, now=5.0) is None
+    c.t_first_token = 5.0   # SLO settled: plain BATCH again
+    assert s.pick_victim([c], worse_than=a, now=5.0) == (0, c)
+
+
+def test_slo_attainment_gauge_is_process_cumulative():
+    """Several engines share the registry: the attainment gauge must
+    reflect the GLOBAL met/missed counters, not whichever scheduler
+    instance wrote last."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.fleet_serving import scheduler as smod
+
+    prev = obs.mode()
+    obs.set_mode("metrics")   # the registry must COUNT here
+    try:
+        s1, s2 = SLAScheduler(), SLAScheduler()
+        s1.note_first_token(_fake_req(ttft_slo_s=1.0), 0.5)   # met
+        s2.note_first_token(_fake_req(ttft_slo_s=1.0), 5.0)   # missed
+    finally:
+        obs.set_mode(prev)
+    met = smod._SLO_FIRST_TOKENS.labels(outcome="met").value
+    missed = smod._SLO_FIRST_TOKENS.labels(outcome="missed").value
+    assert met >= 1 and missed >= 1
+    assert smod._SLO_ATTAINMENT.value == pytest.approx(
+        met / (met + missed))
+    # s2's LOCAL ratio is 0/1 — the gauge must not have been stomped
+    assert s2.snapshot()["slo_attainment"] == 0.0
+
+
+def test_slo_first_token_survives_telemetry_off():
+    """Under PT_TELEMETRY=0 the registry counters are no-ops and read
+    0 — the attainment-gauge derivation must skip, not divide by zero
+    (which would propagate out of step() and abort every request)."""
+    from paddle_tpu import observability as obs
+
+    prev = obs.mode()
+    obs.set_mode("off")
+    try:
+        s = SLAScheduler()
+        s.note_first_token(_fake_req(ttft_slo_s=1.0), 0.5)
+        s.note_first_token(_fake_req(ttft_slo_s=1.0), 5.0)
+    finally:
+        obs.set_mode(prev)
+    # local stats still count — snapshot() stays correct with
+    # telemetry disabled
+    assert s.stats["slo_met"] == 1 and s.stats["slo_missed"] == 1
+    assert s.snapshot()["slo_attainment"] == 0.5
+
+
+def test_resident_pages_gauge_sums_across_caches():
+    """pt_prefix_cache_resident_pages is process-global: each cache
+    publishes DELTAS, so two engines' tries sum into the gauge instead
+    of last-writer-wins (engine B clearing must not zero out engine
+    A's still-pinned pages)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.fleet_serving import prefix_cache as pmod
+
+    prev = obs.mode()
+    obs.set_mode("metrics")   # the registry must COUNT here
+    try:
+        base = pmod._RESIDENT.value
+        pool_a, pool_b = PagePool(8, 4), PagePool(8, 4)
+        ca = RadixPrefixCache(pool_a, page_size=4)
+        cb = RadixPrefixCache(pool_b, page_size=4)
+        ca.insert(list(range(8)), [pool_a.alloc() for _ in range(2)])
+        cb.insert(list(range(4)), [pool_b.alloc()])
+        assert pmod._RESIDENT.value - base == 3
+        cb.clear()
+        # A's 2 pages stay published; B retracted only its own
+        assert pmod._RESIDENT.value - base == 2
+        ca.clear()
+        assert pmod._RESIDENT.value - base == 0
+    finally:
+        obs.set_mode(prev)
+
+
+def test_buried_slo_request_escalates_past_unescalated_head():
+    """A non-head request with a tight per-request SLO must escalate
+    even though its class-queue head carries no SLO (pop_next scans
+    members, not just heads, once SLOs are in play) — and within-class
+    FIFO is undisturbed for everyone un-escalated."""
+    s = SLAScheduler(SLAPolicy(slo_boost_fraction=0.5))
+    head = _fake_req(tenant="t", t_submit=0.0)             # no SLO
+    buried = _fake_req(tenant="t", ttft_slo_s=1.0, t_submit=0.0)
+    inter = _fake_req(priority=Priority.INTERACTIVE, t_submit=0.0)
+    for r in (head, buried, inter):
+        s.enqueue(r)
+    # before the boost window: plain classes, FIFO within
+    assert s.pop_next(0.1) is inter
+    s.push_front(inter)
+    # past 50% of the buried request's SLO: it out-ranks every class
+    assert s.pop_next(0.7) is buried
+    assert s.pop_next(0.7) is inter
+    assert s.pop_next(0.7) is head
+    assert s.pop_next(0.7) is None
+
+
+def test_push_front_rearms_buried_slo_escalation():
+    """A preempted SLO-carrying request re-enters via push_front; the
+    member-escalation scan must stay armed even though the queue fully
+    drained in between (pop_next resets the gate when it empties) and a
+    later push_front then buries the request behind an SLO-free head."""
+    s = SLAScheduler(SLAPolicy(slo_boost_fraction=0.5))
+    slo = _fake_req(tenant="t", ttft_slo_s=1.0, t_submit=0.0)
+    head = _fake_req(tenant="t", t_submit=0.0)
+    inter = _fake_req(priority=Priority.INTERACTIVE, t_submit=0.0)
+    s.enqueue(slo)
+    assert s.pop_next(0.1) is slo   # admitted; queue drains, gate resets
+    s.enqueue(inter)
+    s.push_front(slo)               # preempted before its first token
+    s.push_front(head)              # a second preemption buries it
+    # past the boost window the buried request out-ranks every class
+    assert s.pop_next(0.7) is slo
+    assert s.pop_next(0.7) is inter
+    assert s.pop_next(0.7) is head
+    assert s.pop_next(0.7) is None
+
+
+def test_post_first_token_slo_requeue_keeps_heads_only_scan():
+    """A preempted mid-decode SLO request (t_first_token set) can
+    never re-escalate — _at_risk gates on first token — so its
+    requeue must not arm pop_next's O(waiting) member scan."""
+    s = SLAScheduler()
+    done = _fake_req(ttft_slo_s=1.0, t_submit=0.0)
+    done.t_first_token = 0.2   # already produced its first token
+    s.push_front(done)
+    assert s._n_slo == 0 and not s._any_slo
+    live = _fake_req(ttft_slo_s=1.0, t_submit=0.0)
+    s.enqueue(live)
+    assert s._n_slo == 1 and s._any_slo
+    assert s.pop_next(0.0) is done   # FIFO order intact
+    assert s.pop_next(0.0) is live
+    assert s._n_slo == 0 and not s._any_slo
+
+
+def test_overgrown_request_fails_instead_of_livelocking(tiny_model):
+    """A sequence whose KEPT tokens (prompt + generated) outgrow the
+    whole pool while a more-urgent runner holds a slot must get
+    `PoolExhausted` on its future — requeueing it would make every
+    later `_try_admit` infeasible and the engine would spin forever
+    with `has_work()` true."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(47)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, num_pages=4, token_budget=32,
+        max_model_len=64))
+    inter = eng.add_request(rng.integers(0, cfg.vocab_size, (10,)),
+                            max_new_tokens=4,
+                            priority=Priority.INTERACTIVE)
+    batch = eng.add_request(rng.integers(0, cfg.vocab_size, (16,)),
+                            max_new_tokens=48, priority=Priority.BATCH)
+    eng.step()
+    assert batch.slot is not None and inter.slot is not None
+    # simulate decoded growth: 60 kept tokens need 4 pages, one more
+    # than the 3-page pool can EVER hold
+    batch.tokens.extend(
+        int(t) for t in rng.integers(0, cfg.vocab_size, (44,)))
+    _drain(eng)
+    with pytest.raises(PoolExhausted):
+        batch.future.result(timeout=0)
+    assert len(inter.future.result(timeout=0)) == 14
+    eng.pool.assert_consistent()
+
+
+def test_kv_fragmentation_not_zeroed_by_shared_pages(tiny_model):
+    """Shared-prefix tokens must not double-count into the
+    fragmentation gauge: the old 1 − Σn_prefilled/capacity form went
+    NEGATIVE (clamped to 0) as soon as two runners shared pages."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(41)
+    sys_p = rng.integers(0, cfg.vocab_size, (32,))
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=16, max_model_len=64,
+        prefix_cache=True))
+    eng.add_request(np.concatenate([sys_p, [5, 6, 7]]), max_new_tokens=8)
+    _drain(eng)
+    eng.add_request(np.concatenate([sys_p, [9]]), max_new_tokens=8)
+    eng.add_request(np.concatenate([sys_p, [11]]), max_new_tokens=8)
+    eng.step()
+    live = [r for r in eng._slots if r is not None]
+    assert len(live) == 2 and all(r.cached_prefix == 32 for r in live)
+    cap = eng.pool.num_live * 16
+    # the double-counting scenario is real: naive used exceeds capacity
+    assert sum(r.n_prefilled for r in live) > cap
+    waste = sum(len(r.pages) * 16 - r.n_prefilled for r in live)
+    assert eng.kv_fragmentation() == pytest.approx(waste / cap)
+    assert 0.0 < eng.kv_fragmentation() < 1.0
+    _drain(eng)
+
+
+def test_cow_split_counts_once_per_admission(tiny_model):
+    """Pushed-back admission attempts re-match (and re-split) the
+    trie mapping; the cow_splits stat must count the split ONCE, on
+    the admission that succeeded."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(43)
+    p32 = rng.integers(0, cfg.vocab_size, (32,))
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64,
+        prefix_cache=True))
+    eng.add_request(p32, max_new_tokens=12)
+    _drain(eng)
+    # occupy the single slot, then queue the fully-cached prompt: its
+    # admission attempts get pushed back while the slot is busy
+    eng.add_request(rng.integers(0, cfg.vocab_size, (20,)),
+                    max_new_tokens=12)
+    r2 = eng.add_request(p32, max_new_tokens=12)
+    _drain(eng)
+    assert r2.future.done()
+    assert eng.prefix_cache.snapshot()["cow_splits"] == 1
+
+
+def test_scheduler_tenant_weights_and_drain():
+    s = SLAScheduler(SLAPolicy(tenant_weights={"gold": 4.0}))
+    gold, bronze = _fake_req(tenant="gold"), _fake_req(tenant="bronze")
+    s.enqueue(bronze)
+    s.enqueue(gold)
+    s.note_tokens("gold", 100)    # /4 weight -> 25 effective
+    s.note_tokens("bronze", 50)
+    assert s.pop_next(0.0) is gold
+    assert [r for r in s] == [bronze]
+    assert s.drain() == [bronze] and len(s) == 0
+    with pytest.raises(ValueError):
+        SLAPolicy(tenant_weights={"t": 0})
+    with pytest.raises(ValueError):
+        SLAPolicy(slo_boost_fraction=0.0)
+
+
+# --------------------------------------------------------------------
+# scheduler policy (engine e2e)
+# --------------------------------------------------------------------
+
+def test_priority_inversion_preempts_running_batch(tiny_model):
+    """One slot, a long batch-class sequence running: an interactive
+    arrival must evict-and-requeue it (not queue behind it), both must
+    finish, and both must match their solo greedy runs."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(13)
+    p_low = rng.integers(0, cfg.vocab_size, (10,))
+    p_hi = rng.integers(0, cfg.vocab_size, (8,))
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64))
+    low = eng.add_request(p_low, max_new_tokens=20,
+                          priority=Priority.BATCH)
+    eng.step()
+    assert low.slot is not None
+    hi = eng.add_request(p_hi, max_new_tokens=5,
+                         priority=Priority.INTERACTIVE)
+    low_done_when_hi_finished = []
+    hi.future.add_done_callback(
+        lambda f: low_done_when_hi_finished.append(low.future.done()))
+    _drain(eng)
+    assert low.preemptions >= 1
+    assert eng.sched.stats["preemptions_priority"] >= 1
+    # the interactive request finished before the batch request did
+    assert low_done_when_hi_finished == [False]
+
+    def solo(p, mx):
+        e = LLMEngine(model, LLMEngineConfig(
+            num_slots=1, page_size=16, token_budget=8,
+            max_model_len=64))
+        r = e.add_request(p, max_new_tokens=mx)
+        _drain(e)
+        return r.future.result(timeout=0)
+
+    np.testing.assert_array_equal(hi.future.result(timeout=0),
+                                  solo(p_hi, 5))
+    np.testing.assert_array_equal(low.future.result(timeout=0),
+                                  solo(p_low, 20))
+    assert eng.metrics()["sched"]["preemptions_priority"] >= 1
+
+
+def test_growth_preemption_never_evicts_more_urgent(tiny_model):
+    """Page growth of a BATCH sequence must never evict an INTERACTIVE
+    runner (the _plan pool-dry path): when every other runner outranks
+    the growing sequence, it yields ITSELF back to the queue."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(31)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, num_pages=5, max_model_len=64,
+        token_budget=8))
+    inter = eng.add_request(rng.integers(0, cfg.vocab_size, (20,)),
+                            max_new_tokens=24,
+                            priority=Priority.INTERACTIVE)
+    batch = eng.add_request(rng.integers(0, cfg.vocab_size, (20,)),
+                            max_new_tokens=24, priority=Priority.BATCH)
+    _drain(eng, cap=2000)
+    # 2+2 prompt pages fill the 4-page pool; growth preempts — and the
+    # victim is always the batch sequence, never the interactive one
+    assert batch.preemptions >= 1
+    assert inter.preemptions == 0
+    assert eng.sched.stats["preemptions_pool"] >= 1
+    for r in (inter, batch):
+        assert len(r.future.result(timeout=0)) == 44
+    eng.pool.assert_consistent()
+
+
+def test_admission_feasibility_no_pointless_preemption(tiny_model):
+    """An unplaceable candidate (needs more pages than free + trie +
+    strictly-worse victims hold) must NOT evict anyone: running
+    sequences keep their KV and the candidate waits."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(37)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, num_pages=7, max_model_len=96,
+        token_budget=8))
+    # two INTERACTIVE runners holding 2 pages each (6 allocable total)
+    runners = [eng.add_request(rng.integers(0, cfg.vocab_size, (20,)),
+                               max_new_tokens=4,
+                               priority=Priority.INTERACTIVE)
+               for _ in range(2)]
+    eng.step()
+    # STANDARD candidate needing 5 pages: free pages are 2, victims are
+    # [] (both runners outrank it) -> infeasible, nobody preempted
+    big = eng.add_request(rng.integers(0, cfg.vocab_size, (66,)),
+                          max_new_tokens=4)
+    eng.step()
+    assert all(r.preemptions == 0 for r in runners)
+    assert big.slot is None and len(eng.sched) == 1
+    _drain(eng)
+    assert all(r.future.done() for r in runners + [big])
+    eng.pool.assert_consistent()
+
+
+def test_reclaimable_pages_counts_cascade_not_mapped():
+    pool = PagePool(num_pages=8, page_size=4)
+    cache = RadixPrefixCache(pool, page_size=4)
+    a = [pool.alloc() for _ in range(3)]
+    cache.insert(list(range(1, 13)), a)     # chain of 3 nodes
+    pool.free(a)
+    assert cache.reclaimable_pages() == 3   # whole cascade
+    cached, mapped = cache.match([1, 2, 3, 4])
+    assert cached == 4
+    # root mapped: its page is pinned, the 2 deeper nodes still evict
+    assert cache.reclaimable_pages() == 2
+    assert cache.evict(100) == 2
+    pool.free(mapped)
+    assert cache.reclaimable_pages() == 1
+    cache.clear()
+    pool.assert_consistent()
+
+
+def test_negative_priority_rejected(tiny_model):
+    """-1 is the scheduler's SLO-escalation rank: a client-supplied
+    negative priority would outrank every deadline-escalated request
+    and compare fair-queuing meters against absolute deadlines in
+    _order_key's tuple — reject it loudly at add_request."""
+    cfg, model = tiny_model
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64))
+    with pytest.raises(ValueError, match="priority"):
+        eng.add_request(np.arange(4), max_new_tokens=2, priority=-1)
+    assert not eng.has_work()
+
+
+def test_engine_close_retracts_resident_pages_gauge(tiny_model):
+    """A process that cycles engines (the bench builds four per run)
+    must not leave the process-global resident-pages gauge inflated by
+    gc'd tries: close() publishes the trie's negative delta."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.fleet_serving import prefix_cache as pmod
+
+    cfg, model = tiny_model
+    rng = np.random.default_rng(29)
+    prev = obs.mode()
+    obs.set_mode("metrics")   # the registry must COUNT here
+    try:
+        base = pmod._RESIDENT.value
+        eng = LLMEngine(model, LLMEngineConfig(
+            num_slots=2, page_size=16, token_budget=8,
+            max_model_len=64, prefix_cache=True))
+        r = eng.add_request(rng.integers(0, cfg.vocab_size, (32,)),
+                            max_new_tokens=4)
+        _drain(eng)
+        r.future.result(timeout=0)
+        assert pmod._RESIDENT.value - base > 0
+        eng.close()
+        assert pmod._RESIDENT.value - base == 0
+        eng.close()   # idempotent
+        assert pmod._RESIDENT.value - base == 0
+    finally:
+        obs.set_mode(prev)
+
+
+def test_reclaimable_pages_deep_chain_no_recursion_limit():
+    """A long-context prompt chains ONE trie node per block — deeper
+    than python's default ~1000-frame recursion limit. The feasibility
+    walk must be iterative like every other traversal here (a
+    RecursionError would propagate out of step() and abort_all)."""
+    depth = 1500
+    pool = PagePool(depth + 2, 1)
+    cache = RadixPrefixCache(pool, page_size=1)
+    pages = [pool.alloc() for _ in range(depth)]
+    cache.insert(list(range(depth)), pages)
+    pool.free(pages)   # trie keeps its own reference
+    assert cache.reclaimable_pages() == depth
+    assert cache.evict(depth) == depth
+    pool.assert_consistent()
+
+
+def test_blocked_admission_skips_trie_walk(tiny_model):
+    """With no free slot and no legal victim (equal priority), the
+    popped head request must bail BEFORE the prefix match — not pay a
+    full trie walk plus a share/free refcount round-trip every tick."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(23)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64,
+        prefix_cache=True))
+    first = eng.add_request(rng.integers(0, cfg.vocab_size, (16,)),
+                            max_new_tokens=12)
+    eng.step()
+    assert first.slot is not None
+    blocked = eng.add_request(rng.integers(0, cfg.vocab_size, (16,)),
+                              max_new_tokens=4)
+    calls = []
+    real_match = eng.prefix_cache.match
+    eng.prefix_cache.match = lambda toks: (calls.append(1)
+                                           or real_match(toks))
+    for _ in range(3):   # first still running: blocked can't admit
+        eng.step()
+    assert blocked.slot is None and not calls
+    eng.prefix_cache.match = real_match
+    _drain(eng)
+    assert len(blocked.future.result(timeout=0)) == 20
+    eng.pool.assert_consistent()
+
+
+def test_scheduler_queue_keys_do_not_leak():
+    s = SLAScheduler()
+    for i in range(50):
+        s.enqueue(_fake_req(tenant=f"user{i}"))
+    while s.pop_next(0.0) is not None:
+        pass
+    # per-tenant class queues are dropped when emptied (client-supplied
+    # tenant ids must not grow the per-tick scan forever); drain too
+    assert len(s._q) == 0
+    s.enqueue(_fake_req(tenant="x"))
+    s.drain()
+    assert len(s._q) == 0 and len(s) == 0
+
+
+def test_tenant_fairness_light_tenant_not_starved(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(17)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64))
+    heavy = [eng.add_request(rng.integers(0, cfg.vocab_size, (6,)),
+                             max_new_tokens=6, tenant="heavy")
+             for _ in range(4)]
+    eng.step()   # heavy[0] admitted; 'heavy' starts accruing tokens
+    light = eng.add_request(rng.integers(0, cfg.vocab_size, (6,)),
+                            max_new_tokens=6, tenant="light")
+    finish_order, seen = [], set()
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+        assert steps < 600
+        for r in heavy + [light]:
+            if r.future.done() and r.rid not in seen:
+                seen.add(r.rid)
+                finish_order.append("light" if r is light else "heavy")
+    # FIFO would finish light LAST; fair queuing admits it right after
+    # the in-flight heavy request
+    assert finish_order.index("light") <= 1, finish_order
+    used = eng.sched.snapshot()["tenant_used_tokens"]
+    assert used["heavy"] > used["light"] > 0
+
+
+def test_ttft_slo_boost_front_runs_the_queue(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(19)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=1, page_size=16, token_budget=8, max_model_len=64,
+        sla_policy=SLAPolicy(slo_boost_fraction=0.5)))
+    running = eng.add_request(rng.integers(0, cfg.vocab_size, (6,)),
+                              max_new_tokens=30)
+    eng.step()
+    std = [eng.add_request(rng.integers(0, cfg.vocab_size, (6,)),
+                           max_new_tokens=8) for _ in range(2)]
+    slo = eng.add_request(rng.integers(0, cfg.vocab_size, (6,)),
+                          max_new_tokens=4, priority=Priority.BATCH,
+                          ttft_slo_s=0.05)
+    time.sleep(0.06)   # the SLO is now at risk
+    _drain(eng)
+    # the batch-class request out-ran the earlier STANDARD queue to its
+    # first token (it preempted the running sequence to do it)
+    assert slo.t_first_token < min(s.t_first_token for s in std)
+    snap = eng.sched.snapshot()
+    assert snap["slo_met"] + snap["slo_missed"] == 1
+    assert eng.metrics()["sched"]["slo_attainment"] in (0.0, 1.0)
+    for r in std + [slo, running]:
+        assert r.future.done()
+
+
+def test_llm_server_threads_fleet_fields(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, cfg.vocab_size, (L,)) for L in (5, 9)]
+    with inference.LLMServer(model, LLMEngineConfig(
+            num_slots=2, page_size=16, token_budget=8,
+            max_model_len=64, prefix_cache=True)) as server:
+        futs = [server.submit(p, max_new_tokens=4, tenant=f"t{i}",
+                              priority=Priority.INTERACTIVE,
+                              ttft_slo_s=30.0)
+                for i, p in enumerate(prompts)]
+        outs = [f.result(timeout=120) for f in futs]
+        m = server.metrics()
+    assert all(len(o) == len(p) + 4 for o, p in zip(outs, prompts))
+    assert m["prefix_cache"] is not None
+    assert m["sched"]["slo_met"] >= 2
+    used = m["sched"]["tenant_used_tokens"]
+    assert "t0" in used and "t1" in used
+
+
+# --------------------------------------------------------------------
+# CI gate: ptlint + analyze_step + the zero-recompile contract
+# --------------------------------------------------------------------
+
+def test_fleet_serving_passes_ptlint_gate():
+    import os
+
+    from paddle_tpu.analysis import lint_paths
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "inference",
+        "fleet_serving")
+    res = lint_paths([pkg])
+    assert res["files"] >= 3
+    assert res["findings"] == [], \
+        "\n".join(f.format() for f in res["findings"])
+
+
+@pytest.mark.analysis
+def test_prefix_cache_engine_keeps_one_executable_and_donation(
+        tiny_model):
+    """The fleet features are host-side policy: with the prefix cache
+    ON and mixed cached/uncached/preempting traffic, the decode step
+    still runs as ONE compiled executable with its kv-pool donation
+    held (analyze_step through the live compile cache)."""
+    from paddle_tpu import analysis
+
+    cfg, model = tiny_model
+    rng = np.random.default_rng(29)
+    sys_p = rng.integers(0, cfg.vocab_size, (16,))
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, token_budget=8, max_model_len=64,
+        prefix_cache=True))
+    eng.add_request(np.concatenate([sys_p, [1, 2, 3]]),
+                    max_new_tokens=3)
+    _drain(eng)
+    warm = eng.compile_stats()
+    assert warm == {"executables": 1}, warm
+    rep = analysis.analyze_step(eng)
+    assert rep.kind == "PagedDecode"
+    assert rep.donation["held"], rep.donation
+    assert rep.host_calls == {} and rep.ok()
+    # steady state: hits, misses, COW splits — never a second program
+    for L in (3, 16, 7, 29):
+        eng.add_request(
+            np.concatenate([sys_p, rng.integers(0, cfg.vocab_size,
+                                                (L,))]),
+            max_new_tokens=4)
+    eng.add_request(sys_p, max_new_tokens=4)   # exact-multiple: COW
+    _drain(eng)
+    assert eng.prefix_cache.snapshot()["hits"] > 0
+    assert eng.compile_stats() == warm, (
+        "prefix-cache serving recompiled the decode step")

@@ -16,7 +16,14 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the claim-sheet docs whose citations are audited (the test iterates
 # this same tuple — one place to extend)
 AUDITED_MDS = ("COVERAGE.md", "BASELINE.md", "docs/PERF_NOTES.md",
-               "docs/ARCHITECTURE.md")
+               "docs/ARCHITECTURE.md", "docs/SERVING.md")
+
+# Absolute citations under these roots reference trees that exist only
+# in the SEEDING environment (the reference-repo snapshot BASELINE.md
+# describes). When the root is not mounted on the auditing machine they
+# are a capability gap (UNVERIFIABLE — the test skips), not dead
+# citations; any other dead absolute path stays a hard failure.
+EXTERNAL_ROOTS = ("/root/reference",)
 
 # `token` is path-like if it names a file with an extension or a
 # package dir under the repo; pure code identifiers are skipped.
@@ -37,7 +44,16 @@ def cited_paths(md_text):
     return out
 
 
-def missing_paths(md_name):
+def audit(md_name):
+    """(missing, unverifiable) citation lists for one audited doc.
+
+    `missing` are dead citations the repo can fix. `unverifiable` are
+    absolute paths OUTSIDE the repo (e.g. the seeding container's
+    `/root/reference` snapshot) whose anchor tree is not mounted in
+    this environment — a capability gap of the machine running the
+    audit, not a false claim in the doc; the test skips on these
+    instead of failing, so the suite's red count reflects real
+    regressions."""
     with open(os.path.join(ROOT, md_name)) as f:
         text = f.read()
     # rows cite in-package files relative to paddle_tpu/, to
@@ -46,25 +62,45 @@ def missing_paths(md_name):
     prefixes = ("", "paddle_tpu", "paddle_tpu/distributed",
                 "paddle_tpu/distributed/fleet",
                 "paddle_tpu/distributed/fleet/meta_parallel")
-    missing = []
+    missing, unverifiable = [], []
     for p in sorted(cited_paths(text)):
+        if os.path.isabs(p) and not (
+                p == ROOT or p.startswith(ROOT + os.sep)):
+            if os.path.exists(p):
+                continue
+            # a dead citation under a known external root is only
+            # UNVERIFIABLE when that whole tree is absent; any other
+            # dead absolute path is a real dead citation
+            ext = next((r for r in EXTERNAL_ROOTS
+                        if p == r or p.startswith(r + os.sep)), None)
+            (unverifiable if ext is not None
+             and not os.path.isdir(ext) else missing).append(p)
+            continue
+        rel = os.path.relpath(p, ROOT) if os.path.isabs(p) else p
         found = False
         for pre in prefixes:
-            full = os.path.join(ROOT, pre, p)
+            full = os.path.join(ROOT, pre, rel)
             if os.path.exists(full) or os.path.exists(full + ".py"):
                 found = True
                 break
         if not found:
             missing.append(p)
-    return missing
+    return missing, unverifiable
+
+
+def missing_paths(md_name):
+    """Dead citations only (capability-gated externals excluded)."""
+    return audit(md_name)[0]
 
 
 def main():
     bad = {}
     for md in AUDITED_MDS:
-        m = missing_paths(md)
+        m, unv = audit(md)
         if m:
             bad[md] = m
+        for p in unv:
+            print(f"{md}: UNVERIFIABLE {p} (external tree not mounted)")
     if bad:
         for md, paths in bad.items():
             print(f"{md}: {len(paths)} dead citations")
