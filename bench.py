@@ -595,26 +595,49 @@ def bench_llm_serve():
         the running batch, per-request eviction the step a sequence
         meets its own budget.
 
+    The engine side runs TWICE per rep — decode_k = BENCH_DECODE_K
+    (default 8, the fused multi-token window) and decode_k = 1 (the
+    single-tick host loop) — interleaved on the same Poisson schedule,
+    each side scored best-of-2: the fused-decode acceptance A/B
+    (ISSUE 8, docs/PERF_NOTES.md "Fused decode"). Under
+    BENCH_CPU_FALLBACK the arm drops to gpt-tiny small-batch geometry,
+    exactly the dispatch-overhead-dominated regime the fused window
+    targets.
+
     Reports tok/s (requested generated tokens / wall), p50/p99 request
     latency (completion − arrival), mean live-slot occupancy, the
-    speedup, and whether greedy outputs matched token-for-token."""
+    speedups (fused vs k=1, fused vs static), and whether greedy
+    outputs matched token-for-token across all three servers."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import inference
-    from paddle_tpu.text.models import GPTForCausalLM, gpt_small
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
 
     paddle.seed(0)
-    cfg = gpt_small()
+    fused_k = int(os.environ.get("BENCH_DECODE_K", "8"))
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        # cpu-scale small-batch geometry: tiny model, 4 slots — per-tick
+        # python dispatch dominates here, the regime ISSUE 8 moves.
+        # Decode-heavy budgets (32-64 generated vs 8-64 tokens of
+        # prompt): prefill cost is identical on every engine, so an
+        # output-light mix would only dilute the decode A/B
+        cfg, name = gpt_tiny(), "gpt-tiny-llm-serve"
+        n_req, bucket, B = 16, 64, 4
+        len_lo, gen_lo, slots, budget, rate = 8, 32, 4, 16, 0.01
+    else:
+        cfg, name = gpt_small(), "gpt-small-llm-serve"
+        n_req, bucket, B = 32, 256, 8
+        len_lo, gen_lo, slots, budget, rate = 16, 8, 16, 48, 0.03
     model = GPTForCausalLM(cfg)
     model.eval()
     rng = np.random.default_rng(0)
-    n_req, bucket, B = 32, 256, 8
-    lens = rng.integers(16, bucket + 1, n_req)
-    gens = rng.integers(8, 65, n_req)   # mixed per-request budgets
+    lens = rng.integers(len_lo, bucket + 1, n_req)
+    gens = rng.integers(gen_lo, 65, n_req)   # mixed per-request budgets
     max_gen = 64
     prompts = [rng.integers(0, cfg.vocab_size, (int(L),)).astype(np.int32)
                for L in lens]
-    arrive = np.cumsum(rng.exponential(0.03, n_req))  # Poisson arrivals
+    arrive = np.cumsum(rng.exponential(rate, n_req))  # Poisson arrivals
 
     def pctl(lat, p):
         return float(np.percentile(np.asarray(lat), p))
@@ -665,20 +688,28 @@ def bench_llm_serve():
     # (warmup + every rep share the registry) — report per-rep deltas
     # so "metrics of the best run" means that run
     _COUNTER_KEYS = ("requests", "finished", "preemptions", "steps",
-                     "aborts", "prefill_tokens", "decode_tokens")
+                     "aborts", "prefill_tokens", "decode_tokens",
+                     "fused_steps", "dispatches")
 
-    def run_engine():
+    def run_engine(decode_k):
         ecfg = inference.LLMEngineConfig(
-            num_slots=16, page_size=16, token_budget=48,
-            max_model_len=bucket + max_gen)
+            num_slots=slots, page_size=16, token_budget=budget,
+            max_model_len=bucket + max_gen, decode_k=decode_k)
         server = inference.LLMServer(model, ecfg)
         outs, lat = {}, [None] * n_req
         with server:
-            # warm THE decode executable outside the timed window, then
-            # drop the warmup's low-occupancy steps from the stats the
-            # occupancy metric averages over
-            server.submit(np.zeros((1,), np.int32),
-                          max_new_tokens=1).result(timeout=1800)
+            # warm BOTH decode executables outside the timed window: a
+            # multi-page prompt forces chunked-prefill single ticks
+            # (the single-tick step) and a > k generation runs at least
+            # one fused window. A 1-token warmup on a fused engine
+            # never leaves the fused path, and the first mixed tick of
+            # the measured run then eats the single-tick compile
+            # (observed: one 1.2 s tick mid-window). Then drop the
+            # warmup's low-occupancy steps from the stats the occupancy
+            # metric averages over.
+            server.submit(np.zeros((2 * budget,), np.int32),
+                          max_new_tokens=max(2, decode_k + 1)
+                          ).result(timeout=1800)
             server.engine.stats.update(
                 {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
             m0 = server.metrics()
@@ -714,44 +745,65 @@ def bench_llm_serve():
         occ = server.engine.mean_occupancy
         return outs, lat, total, occ, em
 
-    # the two phases run SEQUENTIALLY, so drifting background load on a
+    # every phase runs SEQUENTIALLY, so drifting background load on a
     # shared host would skew a single A/B either way (observed ±30%
-    # machine-wide swings between runs). Interleave E/S/E/S and score
-    # each side by its best run — noise only ever slows a run down.
-    e_runs, s_runs = [], []
+    # machine-wide swings between runs). Interleave F/E/S, F/E/S
+    # (fused engine / k=1 engine / static) and score each side by its
+    # best run — noise only ever slows a run down.
+    f_runs, e_runs, s_runs = [], [], []
     for rep in range(2):
-        e_out, e_lat, e_total, occ, em = run_engine()
-        log(f"[bench] llm_serve engine[{rep}]: {e_total:.2f}s, "
+        f_out, f_lat, f_total, f_occ, fm = run_engine(fused_k)
+        log(f"[bench] llm_serve fused-k{fused_k}[{rep}]: "
+            f"{f_total:.2f}s, occ {f_occ:.2f}, "
+            f"fused_steps {fm['fused_steps']}")
+        f_runs.append((f_total, f_out, f_lat, f_occ, fm))
+        e_out, e_lat, e_total, occ, em = run_engine(1)
+        log(f"[bench] llm_serve k1[{rep}]: {e_total:.2f}s, "
             f"occ {occ:.2f}")
         e_runs.append((e_total, e_out, e_lat, occ, em))
         s_out, s_lat, s_total = run_static()
         log(f"[bench] llm_serve static[{rep}]: {s_total:.2f}s")
         s_runs.append((s_total, s_out, s_lat))
+    f_total, f_out, f_lat, f_occ, fm = min(f_runs, key=lambda r: r[0])
     e_total, e_out, e_lat, occ, em = min(e_runs, key=lambda r: r[0])
     s_total, s_out, s_lat = min(s_runs, key=lambda r: r[0])
-    gen_tokens = sum(len(e_out[j]) - len(prompts[j]) for j in range(n_req))
-    match = all(np.array_equal(e_out[j], s_out[j]) for j in range(n_req))
+    gen_tokens = sum(len(f_out[j]) - len(prompts[j]) for j in range(n_req))
+    # greedy identity across ALL THREE servers: fused == k1 == static
+    match = all(np.array_equal(f_out[j], s_out[j])
+                and np.array_equal(f_out[j], e_out[j])
+                for j in range(n_req))
+    f_tps = gen_tokens / f_total
     e_tps, s_tps = gen_tokens / e_total, gen_tokens / s_total
-    speedup = e_tps / s_tps if s_tps else 0.0
-    log(f"[bench] llm_serve: engine {e_tps:,.0f} tok/s vs static "
-        f"{s_tps:,.0f} tok/s = {speedup:.2f}x, greedy_match={match}")
+    speedup = f_tps / s_tps if s_tps else 0.0
+    speedup_k1 = f_tps / e_tps if e_tps else 0.0
+    log(f"[bench] llm_serve: fused-k{fused_k} {f_tps:,.0f} tok/s vs "
+        f"k1 {e_tps:,.0f} = {speedup_k1:.2f}x, vs static "
+        f"{s_tps:,.0f} = {speedup:.2f}x, greedy_match={match}")
+    f_lat = [x for x in f_lat if x is not None]
     e_lat = [x for x in e_lat if x is not None]
+
+    def _eng_block(total, lat, occ_v, m, runs):
+        return {"tokens_per_sec": round(gen_tokens / total),
+                "p50_latency_ms": round(pctl(lat, 50) * 1e3, 1),
+                "p99_latency_ms": round(pctl(lat, 99) * 1e3, 1),
+                "mean_slot_occupancy": round(occ_v, 3),
+                "totals_s": [round(r[0], 2) for r in runs],
+                # registry-sourced (LLMServer.metrics of the best run):
+                # occupancy/preemptions/token split/dispatch
+                # amortization + latency percentiles with attribution
+                "metrics": {k: (round(v, 4)
+                                if isinstance(v, float) else v)
+                            for k, v in m.items()}}
+
     return {
-        "model": "gpt-small-llm-serve",
+        "model": name,
         "requests": n_req, "gen_tokens": gen_tokens,
+        "decode_k": fused_k,
         "greedy_match": bool(match),
         "speedup_vs_static": round(speedup, 3),
-        "engine": {"tokens_per_sec": round(e_tps),
-                   "p50_latency_ms": round(pctl(e_lat, 50) * 1e3, 1),
-                   "p99_latency_ms": round(pctl(e_lat, 99) * 1e3, 1),
-                   "mean_slot_occupancy": round(occ, 3),
-                   "totals_s": [round(r[0], 2) for r in e_runs],
-                   # registry-sourced (LLMServer.metrics of the best run):
-                   # occupancy/preemptions/token split + latency
-                   # percentiles with attribution
-                   "metrics": {k: (round(v, 4)
-                                   if isinstance(v, float) else v)
-                               for k, v in em.items()}},
+        "speedup_vs_k1": round(speedup_k1, 3),
+        "engine": _eng_block(f_total, f_lat, f_occ, fm, f_runs),
+        "engine_k1": _eng_block(e_total, e_lat, occ, em, e_runs),
         "static": {"tokens_per_sec": round(s_tps),
                    "p50_latency_ms": round(pctl(list(s_lat.values()), 50)
                                            * 1e3, 1),
@@ -915,7 +967,9 @@ def bench_llm_fleet():
     prefix-cache / scheduler snapshots of the fleet run. Prefill token
     counts are deterministic; TTFT is timing, so the phases interleave
     F/S/F/S and each side scores its best run (the llm_serve noise
-    defense)."""
+    defense). Both sides decode through the fused k-step executable
+    (BENCH_DECODE_K, default 8) — the arm doubles as the ISSUE-8 proof
+    that boundary-granularity scheduling keeps fleet parity."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import inference
@@ -949,17 +1003,28 @@ def bench_llm_fleet():
     def pctl(lat, p):
         return float(np.percentile(np.asarray(lat), p))
 
+    # the fleet arm runs with the fused multi-token decode ON (both
+    # sides) to prove scheduler parity at window-boundary granularity:
+    # admission/preemption/SLO escalation now only happen once per k
+    # tokens, and greedy outputs must STILL match the FIFO engine
+    # token-for-token (docs/SERVING.md "Fused decode")
+    fused_k = int(os.environ.get("BENCH_DECODE_K", "8"))
+
     def run(fleet):
         eng = inference.LLMEngine(model, inference.LLMEngineConfig(
             num_slots=8, page_size=16, token_budget=48,
             max_model_len=sys_len + max_suffix + 40,
-            prefix_cache=fleet))
-        # warm THE decode executable outside the timed window
-        eng.add_request(np.zeros((1,), np.int32), max_new_tokens=1)
+            prefix_cache=fleet, decode_k=fused_k))
+        # warm BOTH decode executables outside the timed window (the
+        # llm_serve warmup note: a 1-token prompt never leaves the
+        # fused path, leaving the single-tick compile inside the
+        # measured window)
+        eng.add_request(np.zeros((8,), np.int32),
+                        max_new_tokens=fused_k + 1)
         while eng.has_work():
             eng.step()
         eng.stats.update({"steps": 0, "tokens_in": 0, "generated": 0,
-                          "occupancy_sum": 0.0})
+                          "occupancy_sum": 0.0, "fused_steps": 0})
         reqs, nxt = [None] * n_req, 0
         t0 = time.perf_counter()
         while nxt < n_req or eng.has_work():
@@ -981,8 +1046,9 @@ def bench_llm_fleet():
         snap = (eng.prefix_cache.snapshot() if eng.prefix_cache
                 else None)
         sched = eng.sched.snapshot()
+        fused_steps = eng.stats["fused_steps"]
         eng.close()   # retract the trie's resident-pages gauge delta
-        return outs, ttft, total, prefill, snap, sched
+        return outs, ttft, total, prefill, snap, sched, fused_steps
 
     f_runs, s_runs = [], []
     for rep in range(2):
@@ -992,9 +1058,9 @@ def bench_llm_fleet():
         s_runs.append(run(fleet=False))
         log(f"[bench] llm_fleet fifo[{rep}]: {s_runs[-1][2]:.2f}s, "
             f"prefill {s_runs[-1][3]} tok")
-    f_out, f_ttft, f_total, f_prefill, f_snap, f_sched = min(
+    f_out, f_ttft, f_total, f_prefill, f_snap, f_sched, f_fused = min(
         f_runs, key=lambda r: r[2])
-    s_out, s_ttft, s_total, s_prefill, _, _ = min(
+    s_out, s_ttft, s_total, s_prefill, _, _, s_fused = min(
         s_runs, key=lambda r: r[2])
     match = all(np.array_equal(a, b) for a, b in zip(f_out, s_out))
     saved_frac = 1.0 - f_prefill / s_prefill
@@ -1008,6 +1074,8 @@ def bench_llm_fleet():
         "model": name,
         "requests": n_req, "gen_tokens": gen_tokens,
         "sys_prompt_tokens": sys_len,
+        "decode_k": fused_k,
+        "fused_steps": {"fleet": int(f_fused), "fifo": int(s_fused)},
         "greedy_match": bool(match),
         "prefill_tokens": {"fifo": int(s_prefill),
                            "fleet": int(f_prefill),
@@ -1341,8 +1409,10 @@ def main():
     if fallback_env is not None:
         # CPU fallback: the capture window is the scarce resource — run
         # only the arms with cpu-scale geometry (train_3d is sized for
-        # 8 virtual devices; llm_fleet drops to gpt-tiny traffic)
-        extras = ("llm_fleet", "train_3d")
+        # 8 virtual devices; llm_serve and llm_fleet drop to gpt-tiny
+        # traffic — llm_serve's small-batch A/B is the fused-decode
+        # acceptance regime, ISSUE 8)
+        extras = ("llm_serve", "llm_fleet", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",

@@ -339,16 +339,25 @@ def _analyze_trainstep(step, batch, check_donation):
 
 def _paged_step_args(engine):
     """The engine's compiled-step example args, from its live geometry
-    and pools (nothing is executed — donation is safe to analyze)."""
+    and pools (nothing is executed — donation is safe to analyze). The
+    kv_state pytree is (pools, scale planes, PRNG key) — the sampling
+    key rides the donated state so reseeding never recompiles."""
+    from ..distributed import mesh as mesh_mod
+
     T = engine.token_budget
     i32 = np.int32
     sf = engine._step_fn
+    sharding = mesh_mod.named_sharding()
+    # sid / sample_idx are device-COMMITTED at runtime (the engine's
+    # staging cache) — match, or the probe itself would trace a second
+    # signature
     return (
         [p._value for p in sf._params],
-        np.zeros((T,), i32), np.zeros((T,), i32), np.zeros((T,), i32),
+        np.zeros((T,), i32), np.zeros((T,), i32),
+        jax.device_put(np.zeros((T,), i32), sharding),
         np.zeros((T,), i32), engine._page_tables, np.zeros((T,), i32),
-        np.zeros((engine.num_slots,), i32),
-        (engine._kv, engine._kv_scales),
+        jax.device_put(np.zeros((engine.num_slots,), i32), sharding),
+        (engine._kv, engine._kv_scales, engine._key),
     )
 
 
@@ -356,20 +365,52 @@ _PAGED_NAMES = ("weights", "tok", "pos", "slot_id", "write_idx",
                 "page_tables", "kv_len", "sample_idx", "kv_state")
 
 
-def _analyze_engine(engine, check_donation):
+def _fused_step_args(engine):
+    """Example args of the fused k-step decode executable
+    (`_CompiledFusedStep`): per-SLOT frontier state + the same donated
+    kv_state pytree as the single-tick step."""
+    S = engine.num_slots
+    i32 = np.int32
+    sf = engine._ensure_fused()
+    return (
+        [p._value for p in sf._params],
+        np.zeros((S,), i32), np.zeros((S,), i32), np.ones((S,), i32),
+        np.zeros((S,), bool), np.full((S,), -1, i32),
+        np.zeros((S,), np.float32), np.ones((S,), np.float32),
+        np.zeros((S,), i32), engine._page_tables,
+        (engine._kv, engine._kv_scales, engine._key),
+    )
+
+
+_FUSED_NAMES = ("weights", "tok0", "pos0", "rem", "fin0", "eos",
+                "temps", "top_ps", "streams", "page_tables", "kv_state")
+
+
+def _analyze_engine(engine, check_donation, which="paged"):
+    if which == "fused":
+        # the fused-window CI contract (tests/test_fused_decode.py):
+        # zero host callbacks (PTL503) in the k-step scan and full
+        # donation of the pools + scales + PRNG key pytree
+        args = _fused_step_args(engine)
+        return analyze_jit(engine._fused_fn._jit, args,
+                           donate_argnums=(10,), kind="FusedDecode",
+                           names=_FUSED_NAMES,
+                           check_donation=check_donation)
     args = _paged_step_args(engine)
     return analyze_jit(engine._step_fn._jit, args, donate_argnums=(8,),
                        kind="PagedDecode", names=_PAGED_NAMES,
                        check_donation=check_donation)
 
 
-def analyze_step(step, *batch, check_donation=True):
+def analyze_step(step, *batch, check_donation=True, which="paged"):
     """Analyze a live step object. Dispatches on type:
 
     * `jit.TrainStep` — pass one example batch:
       `analyze_step(step, x, y)`
     * `inference.LLMEngine` / `LLMServer` — no batch needed (the
-      compiled decode step has fixed geometry)
+      compiled decode step has fixed geometry). `which="fused"`
+      analyzes the fused k-step decode executable instead of the
+      single-tick step (building it if the engine hasn't yet).
     * anything `jax.jit`-wrapped — `analyze_step(jitted, *args)`
       (donation not inferred; use `analyze_jit` to pass
       `donate_argnums`)
@@ -389,9 +430,9 @@ def analyze_step(step, *batch, check_donation=True):
     if isinstance(step, TrainStep):
         return _analyze_trainstep(step, batch, check_donation)
     if LLMServer and isinstance(step, LLMServer):
-        return _analyze_engine(step.engine, check_donation)
+        return _analyze_engine(step.engine, check_donation, which=which)
     if LLMEngine and isinstance(step, LLMEngine):
-        return _analyze_engine(step, check_donation)
+        return _analyze_engine(step, check_donation, which=which)
     if hasattr(step, "trace") and hasattr(step, "lower"):
         return analyze_jit(step, batch, kind="jit",
                            check_donation=check_donation)

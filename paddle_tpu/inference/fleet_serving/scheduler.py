@@ -107,6 +107,13 @@ class SLAScheduler:
         # saturated never-empty queue returns to it as soon as the last
         # SLO-carrying request pops)
         self._n_slo = 0
+        # fused-decode boundary granularity (docs/SERVING.md): with
+        # decode_k > 1 the engine only consults the scheduler once per
+        # k-token window, so an escalation deadline crossed MID-window
+        # would otherwise be noticed one whole window late. The engine
+        # feeds the measured window wall time here and _at_risk
+        # escalates when the deadline falls before the NEXT boundary.
+        self.boundary_lag_s = 0.0
         self.stats = {"preemptions_pool": 0, "preemptions_priority": 0,
                       "slo_met": 0, "slo_missed": 0}
 
@@ -186,7 +193,11 @@ class SLAScheduler:
         slo = self.policy.slo_for(req)
         if slo is None:
             return None
-        waited = now - req.t_submit
+        # boundary clamp: escalation checks only run at decode-window
+        # boundaries, so look one expected window AHEAD — a request
+        # whose boost point falls mid-window escalates at the boundary
+        # BEFORE it, not the one after its deadline already slipped
+        waited = now - req.t_submit + self.boundary_lag_s
         if waited >= self.policy.slo_boost_fraction * float(slo):
             return req.t_submit + float(slo)  # deadline
         return None
@@ -290,6 +301,16 @@ class SLAScheduler:
         self.stats[f"preemptions_{reason}"] += 1
         _PREEMPTIONS.labels(reason=reason).inc()
 
+    def note_boundary(self, window_s):
+        """EMA of the fused decode window's wall time — the engine
+        calls this once per window so `_at_risk` can clamp escalation
+        checks to boundary granularity (module `boundary_lag_s` note).
+        Capped at 1 s: a one-off stall must not permanently escalate
+        every SLO request a second early."""
+        w = min(float(window_s), 1.0)
+        self.boundary_lag_s = (w if self.boundary_lag_s == 0.0
+                               else 0.5 * self.boundary_lag_s + 0.5 * w)
+
     # ---- accounting ----
 
     # fair-queuing meters kept at most (tenant ids are client-supplied:
@@ -339,6 +360,7 @@ class SLAScheduler:
                      reverse=True)[:32]
         return {
             "waiting": self._n,
+            "boundary_lag_s": round(self.boundary_lag_s, 6),
             "queue_depths": depths,
             "tenant_meters": len(self._used),
             "tenant_used_tokens": {t: round(u, 1) for t, u in top},

@@ -125,6 +125,21 @@ _KV_POOL_BYTES = _obs.gauge(
     "resident KV page-pool bytes (pools + int8 scale planes), by the "
     "pool dtype (quantized runtime: docs/QUANTIZATION.md)",
     labelnames=("dtype",))
+# fused multi-token decode (docs/SERVING.md "Fused decode"): host
+# round trips vs tokens produced — the dispatch-overhead economics the
+# decode_k knob trades TTFT granularity for
+_FUSED_STEPS = _obs.counter(
+    "pt_decode_fused_steps",
+    "fused k-step decode windows dispatched (one host sync per window)")
+_DISPATCHES = _obs.counter(
+    "pt_decode_dispatches_total",
+    "compiled decode-step dispatches (host round trips), single-tick "
+    "or fused window")
+_TOK_PER_DISPATCH = _obs.gauge(
+    "pt_decode_tokens_per_dispatch",
+    "generated tokens the LAST compiled-step dispatch produced (the "
+    "fused-decode amortization: up to num_slots on a k=1 tick — one "
+    "per sampling frontier — and up to k*num_slots per fused window)")
 # shared with jit.TrainStep's probe — ONE definition (the registry
 # would raise on a labelnames divergence between two copies)
 from ..jit import _DONATION_HELD
@@ -257,12 +272,25 @@ class LLMEngineConfig:
                   (priority classes, tenant fair queuing, TTFT SLO
                   boost). Default policy degrades to FIFO when every
                   request uses the default tenant/priority.
+    decode_k      fused-decode window size: pure-decode ticks run k
+                  tokens per compiled dispatch (a `lax.scan` with
+                  in-executable sampling + EOS masking), so the host
+                  syncs once per k tokens. 1 (the default / env
+                  PT_DECODE_K) keeps the single-tick host loop.
+                  Admission, preemption, SLO escalation, and
+                  prefix-cache publication happen at window
+                  BOUNDARIES (docs/SERVING.md has the TTFT/SLO
+                  granularity contract).
+    seed          engine PRNG seed for temperature/top-p sampling
+                  (threaded through the compiled step as an argument —
+                  `reseed()` never recompiles). Greedy decode ignores
+                  it.
     """
 
     def __init__(self, num_slots=4, page_size=16, num_pages=None,
                  max_model_len=None, token_budget=None, kv_dtype=None,
                  prefix_cache=None, hash_block_tokens=None,
-                 sla_policy=None):
+                 sla_policy=None, decode_k=None, seed=0):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
@@ -278,10 +306,16 @@ class LLMEngineConfig:
             self.page_size if hash_block_tokens is None
             else hash_block_tokens)
         self.sla_policy = sla_policy
+        if decode_k is None:
+            decode_k = int(os.environ.get("PT_DECODE_K", "1"))
+        self.decode_k = int(decode_k)
+        self.seed = int(seed)
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
+        if self.decode_k < 1:
+            raise ValueError("decode_k must be >= 1")
         if self.hash_block_tokens < 1:
             raise ValueError("hash_block_tokens must be >= 1")
         if self.prefix_cache and (
@@ -343,10 +377,13 @@ class _CompiledPagedStep:
             def t(v):
                 return Tensor(v, stop_gradient=True)
 
-            # kv_state = (pools, scale planes) — scales empty for float
-            # pools; ONE donated pytree so int8 pools and their scales
-            # update in place together
-            kv_vals, kv_scales = kv_state
+            # kv_state = (pools, scale planes, PRNG key) — scales empty
+            # for float pools; ONE donated pytree so int8 pools, their
+            # scales, and the sampling key update in place together.
+            # The single-tick step never consumes randomness (sampling
+            # rows draw on the host through the SAME sample_tokens
+            # math), so the key passes through untouched.
+            kv_vals, kv_scales, key = kv_state
             originals = [p._value for p in self._params]
             for p, v in zip(self._params, param_vals):
                 p._value = v
@@ -364,7 +401,7 @@ class _CompiledPagedStep:
             logits, *new_kv = out
             n = len(kv_vals)
             return logits._value, ([x._value for x in new_kv[:n]],
-                                   [x._value for x in new_kv[n:]])
+                                   [x._value for x in new_kv[n:]], key)
 
         self._jit = jax.jit(pure, donate_argnums=(8,))
         self._warm = False
@@ -393,11 +430,71 @@ class _CompiledPagedStep:
         return int(n()) if callable(n) else -1
 
 
+class _CompiledFusedStep:
+    """The engine's fused k-step decode executable: `lax.scan` over the
+    paged step (`GPTGenerationMixin._paged_decode_fused`) with sampling
+    and EOS/budget masking INSIDE the scan — one host round trip per k
+    tokens. Built exactly like `_CompiledPagedStep` (weights as jit
+    ARGUMENTS, the kv pytree — pools + scale planes + PRNG key —
+    DONATED, first compile outside the persistent cache). k is baked
+    into the scan length, so one engine holds ONE fused executable per
+    (k, geometry); window spill (pool pressure / short budgets) rides
+    the `rem` argument instead of re-tracing a shorter scan."""
+
+    def __init__(self, model, k, page_size):
+        self._params = list(model.state_dict().values())
+        self.k = int(k)
+        ps = int(page_size)
+
+        def pure(param_vals, tok0, pos0, rem, fin0, eos, temps, top_ps,
+                 streams, pt, kv_state):
+            from ..autograd import engine as eng
+
+            kv_vals, kv_scales, key = kv_state
+            originals = [p._value for p in self._params]
+            for p, v in zip(self._params, param_vals):
+                p._value = v
+            try:
+                with eng.no_grad_guard():
+                    emits, new_kv, new_scales = model._paged_decode_fused(
+                        self.k, ps, tok0, pos0, rem, fin0, eos, temps,
+                        top_ps, streams, pt, list(kv_vals),
+                        list(kv_scales) if kv_scales else None, key)
+            finally:
+                for p, v in zip(self._params, originals):
+                    p._value = v
+            return emits, (new_kv, new_scales, key)
+
+        self._jit = jax.jit(pure, donate_argnums=(10,))
+        self._warm = False
+
+    def __call__(self, tok0, pos0, rem, fin0, eos, temps, top_ps,
+                 streams, pt, kv_state):
+        args = ([p._value for p in self._params], tok0, pos0, rem,
+                fin0, eos, temps, top_ps, streams, pt, kv_state)
+        if self._warm:
+            return self._jit(*args)
+        # same persistent-cache guard as _CompiledPagedStep: a
+        # cache-loaded donating executable on jax 0.4.x can drop its
+        # aliasing map (docs/RESILIENCE.md)
+        from ..core.jax_compat import no_persistent_cache
+
+        with no_persistent_cache():
+            out = self._jit(*args)
+        self._warm = True
+        return out
+
+    def cache_size(self):
+        n = getattr(self._jit, "_cache_size", None)
+        return int(n()) if callable(n) else -1
+
+
 class _Request:
     _ids = itertools.count()
 
     def __init__(self, tokens, max_new_tokens, eos_token_id, future,
-                 tenant="default", priority=None, ttft_slo_s=None):
+                 tenant="default", priority=None, ttft_slo_s=None,
+                 temperature=0.0, top_p=1.0):
         self.rid = next(_Request._ids)
         self.tokens = [int(t) for t in tokens]  # prompt, grows as decoded
         self.prompt_len = len(self.tokens)
@@ -423,6 +520,20 @@ class _Request:
                 f"priority must be >= 0, got {self.priority} "
                 "(negative ranks are reserved for SLO escalation)")
         self.ttft_slo_s = ttft_slo_s
+        # sampling contract: temperature 0 = greedy (the default,
+        # token-identical to generate()); > 0 samples the temperature-
+        # scaled top-p-truncated distribution, keyed on (engine seed,
+        # sample_stream, position) — deterministic under preemption
+        # replay and invariant to decode_k (gpt.py sample_tokens)
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}")
+        self.sample_stream = 0    # engine-assigned at add_request
         self._arrival = None      # scheduler enqueue stamp
         self.cached_prefix = 0    # tokens served from the prefix cache
         self._cow_pending = 0     # COW splits taken by the last match
@@ -431,6 +542,10 @@ class _Request:
         self.t_submit = _time.perf_counter()
         self.t_first_admit = None
         self.t_first_token = None
+
+    @property
+    def do_sample(self):
+        return self.temperature > 0.0
 
     @property
     def num_generated(self):
@@ -521,6 +636,23 @@ class LLMEngine:
         self._page_tables = np.zeros(
             (self.num_slots, self.pages_per_seq), np.int32)
         self._slots = [None] * self.num_slots
+        # fused multi-token decode (decode_k > 1): pure-decode ticks go
+        # through ONE k-step scan executable; the engine-owned PRNG key
+        # rides the same donated pytree as the pools. Committed to the
+        # pools' sharding for the same one-executable reason.
+        self.decode_k = int(cfg.decode_k)
+        self._seed = int(cfg.seed)
+        self._key = jax.device_put(
+            jax.random.PRNGKey(cfg.seed), sharding)
+        self._sample_streams = itertools.count()
+        self._fused_fn = None     # built lazily on the first window
+        self._host_sample = None  # jitted sample_tokens for host ticks
+        # staging cache: per-tick host arrays whose values depend only
+        # on slot MEMBERSHIP (sid / sample_idx) are device-committed
+        # once per slot-assignment generation instead of rebuilt and
+        # re-uploaded every decode tick
+        self._slot_gen = 0
+        self._stage = None
         # fleet_serving: SLA admission (default policy degrades to
         # FIFO) + optional shared-prefix radix cache over the pool
         self.sched = SLAScheduler(cfg.sla_policy)
@@ -533,7 +665,8 @@ class LLMEngine:
         self._step_fn = _CompiledPagedStep(model)
         self.stats = {"steps": 0, "tokens_in": 0, "generated": 0,
                       "finished": 0, "preemptions": 0,
-                      "occupancy_sum": 0.0}
+                      "occupancy_sum": 0.0, "fused_steps": 0,
+                      "stage_hits": 0}
 
     @property
     def waiting(self):
@@ -546,7 +679,7 @@ class LLMEngine:
 
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     future=None, tenant="default", priority=None,
-                    ttft_slo_s=None):
+                    ttft_slo_s=None, temperature=0.0, top_p=1.0):
         toks = np.asarray(prompt).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -560,7 +693,12 @@ class LLMEngine:
                 f"({self.pool.num_pages - 1})")
         req = _Request(toks, max_new_tokens, eos_token_id, future,
                        tenant=tenant, priority=priority,
-                       ttft_slo_s=ttft_slo_s)
+                       ttft_slo_s=ttft_slo_s, temperature=temperature,
+                       top_p=top_p)
+        # per-engine sampling stream: stable across preemption replays
+        # (assigned once, BEFORE any admission), so a replayed sampled
+        # request reproduces its original continuation
+        req.sample_stream = next(self._sample_streams)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
         _REQS_TOTAL.inc()
         if req.target <= req.prompt_len:
@@ -602,6 +740,10 @@ class LLMEngine:
         is read-only and always safe.
         """
         out = {"executables": self._step_fn.cache_size()}
+        if self._fused_fn is not None:
+            # ONE fused executable per (k, geometry) — window spill and
+            # EOS mid-window ride arguments, never a re-trace
+            out["fused_executables"] = self._fused_fn.cache_size()
         if not check_donation:
             return out
         from .. import analysis
@@ -610,7 +752,24 @@ class LLMEngine:
         out["donation"] = rep.donation
         _DONATION_HELD.labels(step="paged_decode").set(
             1.0 if rep.donation["held"] else 0.0)
+        if self._fused_fn is not None:
+            frep = analysis.analyze_step(self, check_donation=True,
+                                         which="fused")
+            out["fused"] = {"donation": frep.donation,
+                            "host_calls": frep.host_calls}
+            _DONATION_HELD.labels(step="fused_decode").set(
+                1.0 if frep.donation["held"] else 0.0)
         return out
+
+    def reseed(self, seed):
+        """Swap the sampling PRNG key. The key is a step ARGUMENT (not
+        a baked constant), so this never recompiles — pinned by the
+        recompile probe in tests/test_fused_decode.py."""
+        from ..distributed import mesh as mesh_mod
+
+        self._seed = int(seed)
+        self._key = jax.device_put(
+            jax.random.PRNGKey(self._seed), mesh_mod.named_sharding())
 
     def pool_bytes(self):
         """Resident KV pool bytes across layers — int8 scale planes
@@ -664,6 +823,10 @@ class LLMEngine:
                 int(_TOKENS_TOTAL.labels(phase="prefill").value),
             "decode_tokens":
                 int(_TOKENS_TOTAL.labels(phase="decode").value),
+            "decode_k": self.decode_k,
+            "fused_steps": int(_FUSED_STEPS.value),
+            "dispatches": int(_DISPATCHES.value),
+            "tokens_per_dispatch": _TOK_PER_DISPATCH.value,
             "admission_p50_s": _ADMIT_SECONDS.quantile(0.5),
             "admission_p99_s": _ADMIT_SECONDS.quantile(0.99),
             "ttft_p50_s": _TTFT_SECONDS.quantile(0.5),
@@ -690,6 +853,10 @@ class LLMEngine:
             # stale trie mapping would serve zeros as a system prompt
             self.prefix_cache.clear()
         self._kv, self._kv_scales = self._fresh_pools()
+        # the PRNG key rides the SAME donated pytree as the pools — a
+        # consumed key leaf would wedge the recovered engine on its
+        # next dispatch ("Array has been deleted")
+        self.reseed(self._seed)
         _ABORTS_TOTAL.inc()
         _QUEUE_DEPTH.set(0)
         _LIVE_SLOTS.set(0)
@@ -715,6 +882,7 @@ class LLMEngine:
         req.slot = None
         self._page_tables[slot, :] = 0
         self._slots[slot] = None
+        self._slot_gen += 1  # membership changed: staged arrays stale
 
     def _finish(self, slot, req):
         self._release(slot, req)
@@ -887,6 +1055,7 @@ class LLMEngine:
         self._page_tables[slot, :] = 0
         self._page_tables[slot, :len(pages)] = pages
         self._slots[slot] = req
+        self._slot_gen += 1  # membership changed: staged arrays stale
         if self.prefix_cache is not None:
             self.prefix_cache.note_mapped(
                 req.cached_prefix, pages,
@@ -966,45 +1135,287 @@ class LLMEngine:
                 return [(slot, req, alloc[slot]) for slot, req in active]
 
     def step(self):
-        """One scheduler tick: admit → plan → ONE compiled decode step →
-        sample frontiers → evict finished. Returns the list of requests
-        finished this tick."""
+        """One scheduler tick: admit (deferred — new and preempted
+        sequences only ever join HERE, i.e. at window boundaries) →
+        either ONE fused k-token decode window (decode_k > 1 and every
+        running sequence is at its sampling frontier) or one
+        single-tick compiled step → evict finished. Returns the list of
+        requests finished this tick."""
         self._admit()
+        if self.decode_k > 1:
+            out = self._try_step_fused()
+            if out is not None:
+                return out
+        return self._step_tick()
+
+    # ---- fused multi-token decode window ----
+
+    def _ensure_fused(self):
+        """The fused k-step executable, built lazily: decode_k and the
+        engine geometry are fixed per engine, so this is ONE executable
+        per (k, config) — the zero-recompile probe's contract."""
+        if self._fused_fn is None:
+            self._fused_fn = _CompiledFusedStep(
+                self.model, self.decode_k, self.page_size)
+        return self._fused_fn
+
+    def _try_step_fused(self):
+        """One fused decode window, or None when the engine must take a
+        single tick instead (chunked prefill outstanding, or the pool
+        cannot cover even a 1-token window — the single-tick path owns
+        preemption). Page capacity for the window is reserved UP FRONT;
+        when the pool (or a sequence's budget) can't cover a full k,
+        the window spills to k' = what fits via the `rem` argument —
+        the scan length never changes, so spill never recompiles."""
+        active = self._active()
+        if not active:
+            return None
+        for _, req in active:
+            if req.n_prefilled != len(req.tokens) - 1:
+                return None     # prefill outstanding: single tick first
+        ps = self.page_size
+        k = self.decode_k
+
+        def pages_needed(w):
+            tot = 0
+            for _, req in active:
+                writes = min(w, req.target - len(req.tokens))
+                last = req.n_prefilled + writes - 1
+                tot += max(0, last // ps + 1 - len(req.pages))
+            return tot
+
+        avail = self.pool.num_free
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.reclaimable_pages()
+        w = k
+        while w > 1 and pages_needed(w) > avail:
+            w -= 1        # spill: the largest window the pool covers
+        if pages_needed(w) > avail:
+            return None   # not even 1 token/row: single tick preempts
+
+        # reserve the window's pages up front (_alloc_page evicts LRU
+        # trie pages under pressure; reclaimable was an upper bound, so
+        # a short row spills further instead of failing the window)
+        rem_arg = {}
+        for slot, req in active:
+            want = min(w, req.target - len(req.tokens))
+            last = req.n_prefilled + want - 1
+            try:
+                while last // ps >= len(req.pages):
+                    page = self._alloc_page()
+                    self._page_tables[slot, len(req.pages)] = page
+                    req.pages.append(page)
+                writes = want
+            except PoolExhausted:
+                writes = min(want,
+                             len(req.pages) * ps - req.n_prefilled)
+                if writes < 1:
+                    return None
+            rem_arg[slot] = writes
+
+        S = self.num_slots
+        tok0 = np.zeros((S,), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        rem = np.zeros((S,), np.int32)
+        fin0 = np.ones((S,), bool)        # empty slots: finished
+        eos = np.full((S,), -1, np.int32)
+        temps = np.zeros((S,), np.float32)
+        tops = np.ones((S,), np.float32)
+        streams = np.zeros((S,), np.int32)
+        gen_before = {}
+        for slot, req in active:
+            tok0[slot] = req.tokens[-1]
+            pos0[slot] = req.n_prefilled
+            rem[slot] = rem_arg[slot]
+            fin0[slot] = False
+            if req.eos is not None:
+                eos[slot] = int(req.eos)
+            temps[slot] = req.temperature
+            tops[slot] = req.top_p
+            streams[slot] = req.sample_stream
+            gen_before[slot] = req.num_generated
+
+        fused = self._ensure_fused()
+        t0 = _time.perf_counter()
+        try:
+            with _trace_span("llm_engine.fused_step", k=k,
+                             live=len(active)):
+                emits, (self._kv, self._kv_scales, self._key) = fused(
+                    tok0, pos0, rem, fin0, eos, temps, tops, streams,
+                    self._page_tables,
+                    (self._kv, self._kv_scales, self._key))
+                emits = np.asarray(emits)   # the once-per-k host sync
+        except Exception as e:
+            # same contract as the single tick: the donated pytree may
+            # already be consumed — fail in-flight work and re-zero
+            self.abort_all(e)
+            raise
+        # k-boundary SLO accounting: tell the scheduler how long a
+        # window runs so escalation checks fire a boundary EARLY
+        # instead of a boundary late (docs/SERVING.md)
+        self.sched.note_boundary(_time.perf_counter() - t0)
+
+        self.stats["steps"] += 1
+        self.stats["fused_steps"] += 1
+        self.stats["occupancy_sum"] += len(active) / self.num_slots
+        _STEPS_TOTAL.inc()
+        _FUSED_STEPS.inc()
+        _DISPATCHES.inc()
+
+        finished = []
+        now = _time.perf_counter()
+        total = 0
+        for slot, req in active:
+            emitted, done = 0, False
+            for j in range(int(rem[slot])):
+                t = int(emits[j, slot])
+                req.tokens.append(t)
+                emitted += 1
+                if ((req.eos is not None and t == req.eos)
+                        or len(req.tokens) >= req.target):
+                    done = True   # in-executable masking already
+                    break         # padded the rest of the window
+            req.n_prefilled += emitted
+            total += emitted
+            self.stats["generated"] += emitted
+            self.sched.note_tokens(req.tenant, emitted)
+            if gen_before[slot] == 0 and emitted > 0:
+                ttft = now - req.t_submit
+                req.t_first_token = now
+                _TTFT_SECONDS.observe(ttft)
+                self.sched.note_first_token(req, ttft)
+            if done:
+                self._finish(slot, req)
+                finished.append(req)
+        self.stats["tokens_in"] += total
+        _TOKENS_TOTAL.labels(phase="decode").inc(total)
+        _TOK_PER_DISPATCH.set(total)
+        _QUEUE_DEPTH.set(len(self.waiting))
+        _LIVE_SLOTS.set(len(active) - len(finished))
+        _SLOT_OCC.set(len(active) / self.num_slots)
+        _PAGE_OCC.set(self.pool.num_live / (self.pool.num_pages - 1))
+        _PAGE_FRAG.set(self.kv_fragmentation())
+        return finished
+
+    # ---- single-tick step (prefill / mixed / k=1) ----
+
+    def _host_sample_rows(self, lv, reqs):
+        """Temperature/top-p (+ greedy rows) for a host tick's frontier
+        logits — the SAME `sample_tokens` math the fused scan runs
+        in-executable, position-keyed on the SAME engine key, so a
+        request's draws are identical whichever path serves the tick
+        (that invariance is what makes sampled outputs reproducible
+        across decode_k — tests/test_fused_decode.py pins it).
+
+        Padded to num_slots so the jitted sampler traces ONCE per
+        engine: the frontier row count varies tick-to-tick with
+        arrivals/finishes, and a per-count specialization would stall
+        the serving loop on a fresh vocab-sort compile mid-traffic."""
+        if self._host_sample is None:
+            from ..text.models.gpt import sample_tokens
+
+            self._host_sample = jax.jit(sample_tokens)
+        n, S = len(reqs), self.num_slots
+        temps = np.zeros((S,), np.float32)   # pad rows: greedy, key 0
+        tops = np.ones((S,), np.float32)
+        streams = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        for j, r in enumerate(reqs):
+            temps[j] = r.temperature
+            tops[j] = r.top_p
+            streams[j] = r.sample_stream
+            positions[j] = len(r.tokens)  # index the new token takes
+        lv = jnp.pad(lv, ((0, S - n), (0, 0)))
+        return self._host_sample(lv, temps, tops, streams, positions,
+                                 self._key)[:n]
+
+    def _step_tick(self):
+        """One single-tick compiled step: plan → dispatch → sample
+        frontiers on the host → evict finished."""
         plan = self._plan()
         if plan is None:
             return []
 
         T = self.token_budget
+        # pure-decode staging cache: when every planned row is a
+        # 1-token sampling frontier AND slot membership is unchanged,
+        # sid / sample_idx are IDENTICAL to last tick's — reuse the
+        # device-committed copies instead of rebuilding and re-uploading
+        # them every tick (keyed on the slot-assignment generation)
+        staged = None
+        if all(take == 1 and len(req.tokens) - req.n_prefilled == 1
+               for _, req, take in plan):
+            staged = self._stage
+            if staged is None or staged["gen"] != self._slot_gen:
+                from ..distributed import mesh as mesh_mod
+
+                sid_np = np.zeros((T,), np.int32)
+                sidx_np = np.zeros((self.num_slots,), np.int32)
+                for row, (slot, _, _) in enumerate(plan):
+                    sid_np[row] = slot
+                    sidx_np[slot] = row
+                sharding = mesh_mod.named_sharding()
+                staged = self._stage = {
+                    "gen": self._slot_gen,
+                    "slots": [slot for slot, _, _ in plan],
+                    "sid": jax.device_put(sid_np, sharding),
+                    "sample_idx": jax.device_put(sidx_np, sharding)}
+            else:
+                self.stats["stage_hits"] += 1
+
         tok = np.zeros((T,), np.int32)
         pos = np.zeros((T,), np.int32)
-        sid = np.zeros((T,), np.int32)
         widx = np.zeros((T,), np.int32)   # 0 → trash page, row 0
         klen = np.zeros((T,), np.int32)   # 0 → padding token
-        # per-SLOT sampling frontier: the vocab head only runs on these
-        # gathered rows (stale slots point at row 0; logits ignored)
-        sample_idx = np.zeros((self.num_slots,), np.int32)
-        sample_slots = []
-        i = 0
-        for slot, req, take in plan:
-            for k in range(take):
-                p = req.n_prefilled + k
-                tok[i] = req.tokens[p]
-                pos[i] = p
-                sid[i] = slot
-                widx[i] = (req.pages[p // self.page_size]
-                           * self.page_size + p % self.page_size)
-                klen[i] = p + 1
-                if p == len(req.tokens) - 1:
-                    sample_idx[slot] = i
-                    sample_slots.append(slot)
-                i += 1
+        if staged is not None:
+            sid = staged["sid"]
+            sample_idx = staged["sample_idx"]
+            sample_slots = staged["slots"]
+            for row, (slot, req, _) in enumerate(plan):
+                p = req.n_prefilled
+                tok[row] = req.tokens[p]
+                pos[row] = p
+                widx[row] = (req.pages[p // self.page_size]
+                             * self.page_size + p % self.page_size)
+                klen[row] = p + 1
+            i = len(plan)
+        else:
+            from ..distributed import mesh as mesh_mod
+
+            sid = np.zeros((T,), np.int32)
+            # per-SLOT sampling frontier: the vocab head only runs on
+            # these gathered rows (stale slots point at row 0; logits
+            # ignored)
+            sample_idx = np.zeros((self.num_slots,), np.int32)
+            sample_slots = []
+            i = 0
+            for slot, req, take in plan:
+                for k in range(take):
+                    p = req.n_prefilled + k
+                    tok[i] = req.tokens[p]
+                    pos[i] = p
+                    sid[i] = slot
+                    widx[i] = (req.pages[p // self.page_size]
+                               * self.page_size + p % self.page_size)
+                    klen[i] = p + 1
+                    if p == len(req.tokens) - 1:
+                        sample_idx[slot] = i
+                        sample_slots.append(slot)
+                    i += 1
+            # committed like the staged copies: a committed/uncommitted
+            # flip at one arg position would cost a second executable
+            sharding = mesh_mod.named_sharding()
+            sid = jax.device_put(sid, sharding)
+            sample_idx = jax.device_put(sample_idx, sharding)
 
         try:
             with _trace_span("llm_engine.step", tokens=i,
                              live=len(plan)):
-                logits, (self._kv, self._kv_scales) = self._step_fn(
-                    tok, pos, sid, widx, self._page_tables, klen,
-                    sample_idx, (self._kv, self._kv_scales))
+                logits, (self._kv, self._kv_scales, self._key) = \
+                    self._step_fn(
+                        tok, pos, sid, widx, self._page_tables, klen,
+                        sample_idx,
+                        (self._kv, self._kv_scales, self._key))
         except Exception as e:
             # the donated pools may already be consumed by the failed
             # dispatch — fail the in-flight work and re-zero so a
@@ -1017,6 +1428,8 @@ class LLMEngine:
         self.stats["tokens_in"] += i
         self.stats["occupancy_sum"] += len(plan) / self.num_slots
         _STEPS_TOTAL.inc()
+        _DISPATCHES.inc()
+        _TOK_PER_DISPATCH.set(len(sample_slots))
         # the flat-budget split: one decode token per sampling frontier,
         # everything else is (chunked or preemption-replay) prefill
         _TOKENS_TOTAL.labels(phase="decode").inc(len(sample_slots))
@@ -1030,9 +1443,13 @@ class LLMEngine:
         if sample_slots:
             rows = jnp.asarray(sample_slots, jnp.int32)
             lv = jnp.take(logits[0], rows, axis=0).astype(jnp.float32)
-            # greedy frontier sampling — same pick as generate()'s
-            # default path, so outputs stay token-identical
-            nxt = np.asarray(jnp.argmax(lv, axis=-1))
+            frontier = [self._slots[s] for s in sample_slots]
+            if any(r.do_sample for r in frontier):
+                nxt = np.asarray(self._host_sample_rows(lv, frontier))
+            else:
+                # greedy frontier sampling — same pick as generate()'s
+                # default path, so outputs stay token-identical
+                nxt = np.asarray(jnp.argmax(lv, axis=-1))
 
         for slot, req, take in plan:
             req.n_prefilled += take
@@ -1105,7 +1522,8 @@ class LLMServer(_FutureQueueServer):
             self._http = None
 
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
-               tenant="default", priority=None, ttft_slo_s=None):
+               tenant="default", priority=None, ttft_slo_s=None,
+               temperature=0.0, top_p=1.0):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
         after it).
@@ -1114,22 +1532,32 @@ class LLMServer(_FutureQueueServer):
         token-budget fair queuing, `priority` is a
         `fleet_serving.Priority` class (default STANDARD), and
         `ttft_slo_s` sets this request's TTFT SLO for deadline
-        boosting and the attainment gauge."""
+        boosting and the attainment gauge.
+
+        Sampling: `temperature` 0 (default) decodes greedily,
+        token-identical to generate(); > 0 samples the temperature-
+        scaled, `top_p`-truncated distribution, seeded from the engine
+        PRNG key and keyed on (stream, position) — reproducible for a
+        given engine seed whatever decode_k is."""
         fut = Future()
         self._enqueue((np.asarray(prompt).reshape(-1),
                        int(max_new_tokens), eos_token_id, fut,
-                       tenant, priority, ttft_slo_s))
+                       tenant, priority, ttft_slo_s,
+                       float(temperature), float(top_p)))
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
         return self.submit(prompt, max_new_tokens, eos_token_id).result()
 
     def _ingest(self, payload):
-        prompt, max_new, eos, fut, tenant, priority, slo = payload
+        (prompt, max_new, eos, fut, tenant, priority, slo,
+         temperature, top_p) = payload
         try:
             self._engine.add_request(prompt, max_new, eos, future=fut,
                                      tenant=tenant, priority=priority,
-                                     ttft_slo_s=slo)
+                                     ttft_slo_s=slo,
+                                     temperature=temperature,
+                                     top_p=top_p)
             self.stats["requests"] += 1
         except Exception as e:  # bad request must not kill the loop
             if not fut.done():

@@ -122,7 +122,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
-                    k_scales=None, v_scales=None, name=None):
+                    k_scales=None, v_scales=None, frontier_offset=None,
+                    name=None):
     """Ragged paged attention over a paged KV-cache pool — the serving
     decode path (inference/llm_engine.py; PAPERS.md "Ragged Paged
     Attention"). One query per FLAT scheduled token, so a single call
@@ -145,6 +146,12 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                  runtime, kv_dtype="int8"): gathered rows are
                  dequantized `int8 * scale` before attention
                  (dequant-on-gather). None for float pools.
+    frontier_offset  optional scalar int added to every NONZERO
+                 kv_lens row (zero rows stay padding). The fused
+                 decode window (gpt.py `_paged_decode_fused`) passes
+                 its scan iteration here, so the kv_lens VECTOR stays
+                 window-invariant and only one scalar advances the
+                 frontier per iteration.
 
     jnp reference semantics everywhere (mirrors the dense decode path in
     text/models/gpt.py `_cached_attention` op for op, so engine greedy
@@ -162,20 +169,25 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         raise ValueError("pass both k_scales and v_scales or neither")
     scales = () if k_scales is None else (
         ensure_tensor(k_scales), ensure_tensor(v_scales))
+    has_off = frontier_offset is not None
+    off = (ensure_tensor(frontier_offset),) if has_off else ()
 
     if _paged_pallas_eligible(q, kp):
         from ...ops.pallas_kernels import paged_attention as pa_kernel
 
-        def jfn_pallas(qv, kpool, vpool, tables, sids, ls, *sc):
+        def jfn_pallas(qv, kpool, vpool, tables, sids, ls, *rest):
+            off_v, sc = ((rest[0], rest[1:]) if has_off
+                         else (None, rest))
             return pa_kernel.ragged_paged_attention(
                 qv, kpool, vpool, tables, sids, ls,
                 k_scales=sc[0] if sc else None,
-                v_scales=sc[1] if sc else None)
+                v_scales=sc[1] if sc else None,
+                frontier_offset=off_v)
 
         return apply_jfn("paged_attention", jfn_pallas, q, kp, vp, pt,
-                         sid, lens, *scales)
+                         sid, lens, *off, *scales)
 
-    def jfn(qv, kpool, vpool, tables, sids, ls, *sc):
+    def jfn(qv, kpool, vpool, tables, sids, ls, *rest):
         import jax
 
         n_pages, page_size, h, d = kpool.shape
@@ -183,6 +195,10 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         tokens = qv.shape[0]
         L = pages_per_seq * page_size
         ls = ls.astype(jnp.int32)
+        off_v, sc = (rest[0], rest[1:]) if has_off else (None, rest)
+        if has_off:
+            # advance every live token's frontier; padding rows stay 0
+            ls = jnp.where(ls > 0, ls + off_v.astype(jnp.int32), 0)
         sids = sids.astype(jnp.int32)
         # gather each SLOT's kv once ([S, L, h, d]) and scatter the
         # queries onto a [S, C] slot grid, so the per-TOKEN [T, L, h, d]
@@ -229,7 +245,7 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                          jnp.zeros_like(out))
 
     return apply_jfn("paged_attention", jfn, q, kp, vp, pt, sid, lens,
-                     *scales)
+                     *off, *scales)
 
 
 def _pallas_backend_ok():

@@ -36,8 +36,8 @@ __all__ = ["ragged_paged_attention"]
 NEG_INF = -1e30
 
 
-def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
-                page_size, pages_per_seq, scale, quantized):
+def _rpa_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref, v_ref,
+                *rest, page_size, pages_per_seq, scale, quantized):
     if quantized:
         # int8 pools ride with per-row fp32 scale planes, gathered
         # through the SAME page_map (quantization runtime, PT_KV_DTYPE)
@@ -46,7 +46,12 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
         o_ref, acc_ref, m_ref, l_ref = rest
     t = pl.program_id(0)
     j = pl.program_id(1)
-    kvlen = lens_ref[t]
+    # the frontier offset (scalar-prefetch SMEM) advances every LIVE
+    # token's kv length; padding rows (base 0) stay padding — the fused
+    # decode window's per-iteration frontier (one scalar per iteration,
+    # the lens vector itself stays window-invariant)
+    base = lens_ref[t]
+    kvlen = jnp.where(base > 0, base + off_ref[0], 0)
 
     @pl.when(j == 0)
     def _init():
@@ -107,9 +112,15 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
 
 def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
                            kv_lens, k_scales=None, v_scales=None,
-                           interpret=False):
+                           frontier_offset=None, interpret=False):
     """q [T, H, D], pools [N, P, H, D], page_tables [S, MP] int,
     slot_ids [T] int, kv_lens [T] int → out [T, H, D].
+
+    frontier_offset: optional scalar int32 added to every NONZERO
+    kv_lens row (rides scalar-prefetch SMEM like the page table). The
+    fused multi-token decode window passes its scan iteration here so
+    one loop-invariant lens vector serves every iteration — rows with
+    base 0 (padding / finished) keep skipping all pages.
 
     k_scales/v_scales [N, P, H] fp32: per-row dequant scales of INT8
     pools (quantization runtime). They are gathered through the same
@@ -130,24 +141,34 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     kernel = functools.partial(
         _rpa_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
         scale=scale, quantized=quantized)
+    if frontier_offset is None:
+        frontier_offset = 0
+    off = jnp.asarray(frontier_offset, jnp.int32).reshape((1,))
 
-    def page_map(t, j, sid, pt, lens):
+    def _eff_last(t, lens, offv):
+        # last live page under the offset frontier (index_map twin of
+        # the kernel's kvlen = where(base > 0, base + off, 0))
+        base = lens[t]
+        eff = jnp.where(base > 0, base + offv[0], 0)
+        return jnp.maximum(eff - 1, 0) // page_size
+
+    def page_map(t, j, sid, pt, lens, offv):
         # clamp j to the token's LAST live page: grid steps past the
         # valid prefix re-request the same block, so Mosaic elides their
         # HBM→VMEM copy (the compute is already pl.when-gated) — without
         # the clamp every dead page would still be DMA'd and kernel
         # bandwidth would scale with max_model_len, not live tokens
-        last = jnp.maximum(lens[t] - 1, 0) // page_size
+        last = _eff_last(t, lens, offv)
         return (pt[sid[t] * pages_per_seq + jnp.minimum(j, last)],
                 0, 0, 0)
 
-    def scale_map(t, j, sid, pt, lens):
-        last = jnp.maximum(lens[t] - 1, 0) // page_size
+    def scale_map(t, j, sid, pt, lens, offv):
+        last = _eff_last(t, lens, offv)
         return (pt[sid[t] * pages_per_seq + jnp.minimum(j, last)], 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, heads, dim),
-                     lambda t, j, sid, pt, lens: (t, 0, 0)),
+                     lambda t, j, sid, pt, lens, offv: (t, 0, 0)),
         pl.BlockSpec((1, page_size, heads, dim), page_map),
         pl.BlockSpec((1, page_size, heads, dim), page_map),
     ]
@@ -158,11 +179,12 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
         inputs += [k_scales, v_scales]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(tokens, pages_per_seq),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, heads, dim),
-                               lambda t, j, sid, pt, lens: (t, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, heads, dim),
+            lambda t, j, sid, pt, lens, offv: (t, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((heads, dim), jnp.float32),   # acc
             pltpu.VMEM((heads, 128), jnp.float32),   # running max
@@ -176,5 +198,5 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
         interpret=interpret,
     )(jnp.asarray(slot_ids, jnp.int32),
       jnp.asarray(page_tables, jnp.int32).reshape(-1),
-      jnp.asarray(kv_lens, jnp.int32),
+      jnp.asarray(kv_lens, jnp.int32), off,
       *inputs)

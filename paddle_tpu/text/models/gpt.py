@@ -33,7 +33,7 @@ from ...ops import manipulation as manip
 __all__ = [
     "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
     "GPTPretrainingCriterion", "gpt_tiny", "gpt_small", "gpt_medium",
-    "gpt_1p3b",
+    "gpt_1p3b", "sample_tokens",
 ]
 
 
@@ -270,7 +270,8 @@ def _paged_cache_write_quant(k_pool, v_pool, k_scales, v_scales, k_new,
 
 def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
                          page_tables, slot_ids, kv_lens,
-                         k_scales=None, v_scales=None):
+                         k_scales=None, v_scales=None,
+                         frontier_offset=None):
     """Paged-cache decoder block over the FLAT token layout [1, T, d] —
     the continuous-batching analog of `_layer_forward_cached`: write the
     step's k/v into pool pages, then ragged paged attention against each
@@ -279,7 +280,9 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
 
     With `k_scales`/`v_scales` (int8 pools) the write quantizes each row
     and attention dequantizes on gather; returns the new scale planes
-    after the pools."""
+    after the pools. `frontier_offset` is the fused-decode window's
+    per-iteration scalar: kv_lens stays the window-invariant BASE
+    length and attention adds the offset to every nonzero row."""
     T = x.shape[1]
     h = layer.ln1(x)
     qkv = layer.qkv(h)
@@ -290,13 +293,15 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
     if k_scales is None:
         ck, cv = _paged_cache_write(cache_k, cache_v, k, v, write_idx)
         attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
-                                 kv_lens)
+                                 kv_lens,
+                                 frontier_offset=frontier_offset)
         cks = cvs = None
     else:
         ck, cv, cks, cvs = _paged_cache_write_quant(
             cache_k, cache_v, k_scales, v_scales, k, v, write_idx)
         attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
-                                 kv_lens, k_scales=cks, v_scales=cvs)
+                                 kv_lens, k_scales=cks, v_scales=cvs,
+                                 frontier_offset=frontier_offset)
     attn = manip.reshape(attn, [1, T, layer.nh * layer.hd])
     x = x + layer.proj(attn)
     h = layer.ln2(x)
@@ -304,6 +309,56 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
     if k_scales is None:
         return out, ck, cv
     return out, ck, cv, cks, cvs
+
+
+def sample_tokens(logits, temps, top_ps, streams, positions, key):
+    """Greedy / temperature / top-p next-token sampler — pure jnp,
+    shared by the engine's host tick (first tokens after prefill) and
+    the fused decode window's in-executable scan, so both paths pick
+    identical tokens from identical logits.
+
+    logits [S, vocab] f32; temps/top_ps [S] f32; streams/positions [S]
+    int32; key uint32[2] (the engine-owned PRNG key, threaded as a step
+    ARGUMENT so reseeding never recompiles).
+
+    Rows with temps <= 0 take the greedy argmax (the generate()/engine
+    default pick, bit-identical to the host argmax path). Sampling rows
+    draw from the temperature-scaled, top-p-truncated distribution with
+    a per-row key `fold_in(fold_in(key, stream), position)` — the draw
+    depends ONLY on (engine seed, request stream, token position), so a
+    request's sampled continuation is invariant to the window size k,
+    to batch composition, and to preemption replays (the same
+    determinism contract greedy decode gets for free)."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        # top-p: keep the smallest prefix of the descending-prob list
+        # whose EXCLUSIVE cumulative mass is < top_p (always keeps the
+        # top-1)
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_ps[:, None]
+        thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)
+        masked = jnp.where(scaled >= thresh[:, None], scaled,
+                           jnp.float32(-1e30))
+        keys = jax.vmap(
+            lambda s, p: jax.random.fold_in(jax.random.fold_in(key, s),
+                                            p)
+        )(streams.astype(jnp.uint32), positions.astype(jnp.uint32))
+        pick = jax.vmap(jax.random.categorical)(keys, masked)
+        return jnp.where(temps > 0, pick, greedy).astype(jnp.int32)
+
+    # all-greedy batches skip the whole sort/cumsum/draw branch at RUN
+    # time (lax.cond executes one side): the fused scan calls this every
+    # iteration, and a vocab-wide sort per tick would tax exactly the
+    # dispatch-bound serving the fused window exists to speed up
+    return jax.lax.cond(jnp.any(temps > 0), drawn,
+                        lambda _: greedy, None)
 
 
 class GPTGenerationMixin:
@@ -372,7 +427,7 @@ class GPTGenerationMixin:
 
     def _paged_decode_core(self, tok, pos_ids, slot_ids, write_idx,
                            page_tables, kv_lens, sample_idx, kv,
-                           kv_scales=None):
+                           kv_scales=None, frontier_offset=None):
         """One ragged engine step over flat tokens: tok/pos_ids/slot_ids/
         write_idx/kv_lens [T], page_tables [S, MP], sample_idx [S] (the
         flat row holding each slot's sampling frontier; stale slots
@@ -386,7 +441,11 @@ class GPTGenerationMixin:
 
         kv_scales: for int8 pools (kv_dtype="int8"), the 2·num_layers
         page-shaped fp32 scale planes; the new planes are returned
-        AFTER the new pools: (logits, *new_pools, *new_scales)."""
+        AFTER the new pools: (logits, *new_pools, *new_scales).
+
+        frontier_offset: optional scalar added to every NONZERO kv_len
+        (the fused decode window passes iteration i here so the base
+        kv_lens vector stays window-invariant)."""
         model = self.gpt
         x = model.wte(tok.unsqueeze(0)) + model.wpe(pos_ids)
         flat, scale_flat = [], []
@@ -394,19 +453,96 @@ class GPTGenerationMixin:
             if kv_scales is None:
                 x, ck, cv = _layer_forward_paged(
                     layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
-                    page_tables, slot_ids, kv_lens)
+                    page_tables, slot_ids, kv_lens,
+                    frontier_offset=frontier_offset)
             else:
                 x, ck, cv, cks, cvs = _layer_forward_paged(
                     layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
                     page_tables, slot_ids, kv_lens,
                     k_scales=kv_scales[2 * i],
-                    v_scales=kv_scales[2 * i + 1])
+                    v_scales=kv_scales[2 * i + 1],
+                    frontier_offset=frontier_offset)
                 scale_flat += [cks, cvs]
             flat += [ck, cv]
         x = model.ln_f(x)
         x = manip.gather(x, sample_idx, axis=1)  # [1, S, d] frontiers
         return (self._logits_from_hidden(x, shard=False), *flat,
                 *scale_flat)
+
+    def _paged_decode_fused(self, k, page_size, tok0, pos0, rem, fin0,
+                            eos_ids, temps, top_ps, streams,
+                            page_tables, kv, kv_scales, key):
+        """k decode ticks fused into ONE `lax.scan` over the paged step
+        — the body of the engine's fused executable (`_CompiledFusedStep`
+        in inference/llm_engine.py): per iteration, write the frontier
+        token's KV, ragged paged attention over each slot's own prefix,
+        vocab head on the S frontier rows, and sampling (greedy /
+        temperature / top-p via `sample_tokens`) IN-EXECUTABLE, so the
+        host syncs once per k tokens instead of once per token.
+
+        Raw jax values in and out (the jit wrapper owns the Tensor
+        boundary): tok0/pos0/rem/streams [S] int32 (frontier token, its
+        write position, tokens the row may still emit, sampling stream
+        id), fin0 [S] bool (True = empty/ignored slot), eos_ids [S]
+        int32 (-1 = no eos), temps/top_ps [S] f32, page_tables [S, MP],
+        kv / kv_scales the pool pytree, key the engine PRNG key.
+
+        In-executable EOS + budget masking: a row that samples its eos
+        or exhausts `rem` mid-window flips finished — later iterations
+        write its KV to the trash row, skip its attention (kv_len 0),
+        and emit the pad sentinel -1 — no host sync. Page capacity for
+        every live iteration is reserved by the engine BEFORE dispatch
+        (`rem` is pre-clamped to the reserved window), so in-scan write
+        indices never leave the request's own pages. Returns
+        (emitted [k, S] int32, new_kv, new_scales) — the key passes
+        through the donated pytree untouched (sampling folds per-row
+        (stream, position) into it instead of splitting, which is what
+        makes the draw window-size-invariant)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...tensor_core import Tensor
+
+        S = tok0.shape[0]
+        sl = jnp.arange(S, dtype=jnp.int32)
+        pt = jnp.asarray(page_tables, jnp.int32)
+        klen0 = pos0 + 1
+        pad = jnp.asarray(-1, jnp.int32)
+
+        def t(v):
+            return Tensor(v, stop_gradient=True)
+
+        def body(carry, i):
+            tok, fin, kv_c, kvs_c = carry
+            live = ~fin
+            tok_in = jnp.where(live, tok, 0)
+            pos_in = jnp.where(live, pos0 + i, 0)
+            klen = jnp.where(live, klen0, 0)  # + i rides the offset
+            page = pt[sl, pos_in // page_size]
+            widx = jnp.where(live,
+                             page * page_size + pos_in % page_size, 0)
+            out = self._paged_decode_core(
+                t(tok_in), t(pos_in), t(sl), t(widx), t(pt), t(klen),
+                t(sl), [t(v) for v in kv_c],
+                kv_scales=([t(s) for s in kvs_c] if kvs_c else None),
+                frontier_offset=t(i))
+            logits, *new = out
+            n = len(kv_c)
+            kv2 = [x._value for x in new[:n]]
+            kvs2 = [x._value for x in new[n:]]
+            lv = logits._value[0].astype(jnp.float32)  # [S, vocab]
+            nxt = sample_tokens(lv, temps, top_ps, streams, pos_in + 1,
+                                key)
+            emit = jnp.where(live, nxt, pad)
+            fin2 = (fin | (live & (eos_ids >= 0) & (nxt == eos_ids))
+                    | (live & (i + 1 >= rem)))
+            tok2 = jnp.where(live, nxt, tok)
+            return (tok2, fin2, kv2, kvs2), emit
+
+        (_, _, kv_f, kvs_f), emits = jax.lax.scan(
+            body, (tok0, fin0, list(kv), list(kv_scales or [])),
+            jnp.arange(int(k), dtype=jnp.int32))
+        return emits, kv_f, kvs_f
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, do_sample=False, attention_mask=None,

@@ -260,8 +260,9 @@ def test_analyze_step_int8_paged_decode():
     rep = analyze_step(eng)
     assert rep.kind == "PagedDecode"
     # int8 pools AND fp32 scale planes: one donated pytree, every leaf
-    # aliased (2 tensors x k/v x num_layers)
-    assert rep.donation["expected"] == 4 * cfg.num_layers
+    # aliased (2 tensors x k/v x num_layers, + the PRNG key leaf that
+    # rides the same donated kv_state pytree)
+    assert rep.donation["expected"] == 4 * cfg.num_layers + 1
     assert rep.donation["held"], rep.donation
     # the quantized cache is VISIBLE in the conversion map: rows
     # quantize on write (f32->int8) and dequantize on gather

@@ -1,0 +1,338 @@
+"""Fused multi-token decode (ISSUE 8): k decode ticks in ONE compiled
+executable with in-scan sampling and EOS masking.
+
+The acceptance suite: greedy token-identity at every k vs the k=1
+engine (incl. EOS mid-window, preemption at a boundary, prefix-cache
+on, int8 KV), seeded temperature/top-p reproducibility across k, the
+PRNG-key-in-donated-pytree recompile probe (reseed() must never
+recompile), and the CI assertion that the fused executable has ZERO
+host callbacks (PTL503) with full donation — the host loop is dead
+inside the window by construction, not by luck.
+
+Budget note: every (k, geometry) pair compiles a fresh fused scan, so
+fast cases share ONE geometry and the widest sweeps carry `slow`
+(tier-1 runs near its 870 s cap).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.llm_engine import LLMEngine, LLMEngineConfig
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+@pytest.fixture(scope="module")
+def prompts(tiny_model):
+    cfg, _ = tiny_model
+    rng = np.random.default_rng(5)
+    return [rng.integers(0, cfg.vocab_size, (L,)) for L in (5, 13, 8)]
+
+
+MAX_NEW = 24
+
+
+def _drain(eng, cap=500):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+
+
+def _serve(model, prompts, *, max_new=MAX_NEW, temperature=0.0,
+           eos=None, **cfg_kw):
+    cfg_kw.setdefault("num_slots", 3)
+    cfg_kw.setdefault("page_size", 16)
+    cfg_kw.setdefault("token_budget", 8)
+    cfg_kw.setdefault("max_model_len", 64)
+    eng = LLMEngine(model, LLMEngineConfig(**cfg_kw))
+    reqs = [eng.add_request(p, max_new_tokens=max_new, eos_token_id=eos,
+                            temperature=temperature) for p in prompts]
+    _drain(eng)
+    if eng.prefix_cache is None:
+        assert eng.pool.num_live == 0
+    return [r.future.result(timeout=0) for r in reqs], eng
+
+
+@pytest.fixture(scope="module")
+def k1_greedy(tiny_model, prompts):
+    """The k=1 engine's outputs — the identity baseline every fused k
+    is held to (itself pinned against generate() in test_llm_engine)."""
+    _, model = tiny_model
+    outs, _ = _serve(model, prompts, decode_k=1)
+    return outs
+
+
+# --------------------------------------------------------------------
+# greedy token identity
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_greedy_token_identical(tiny_model, prompts, k1_greedy, k):
+    _, model = tiny_model
+    outs, eng = _serve(model, prompts, decode_k=k)
+    for ref, got in zip(k1_greedy, outs):
+        np.testing.assert_array_equal(got, ref)
+    # the window actually ran fused — this test must not pass by
+    # silently falling back to single ticks
+    assert eng.stats["fused_steps"] > 0
+    assert eng.stats["steps"] > eng.stats["fused_steps"]  # prefill ticks
+
+
+@pytest.mark.slow
+def test_fused_greedy_token_identical_k8(tiny_model, prompts, k1_greedy):
+    _, model = tiny_model
+    outs, eng = _serve(model, prompts, decode_k=8)
+    for ref, got in zip(k1_greedy, outs):
+        np.testing.assert_array_equal(got, ref)
+    assert eng.stats["fused_steps"] > 0
+
+
+def test_fused_eos_mid_window(tiny_model, prompts, k1_greedy):
+    """A row that samples its eos MID-window must stop exactly where
+    the k=1 engine stops: in-executable masking pads the rest of the
+    window and the host trims at the boundary."""
+    _, model = tiny_model
+    k = 4
+    ref0 = k1_greedy[0]
+    plen = len(prompts[0])
+    # an eos landing at generated index 1 (mod k != k-1): iterations
+    # 2..3 of its window run MASKED for that row
+    eos = int(ref0[plen + 1])
+    ref_outs, _ = _serve(model, prompts, decode_k=1, eos=eos)
+    outs, eng = _serve(model, prompts, decode_k=k, eos=eos)
+    assert eng.stats["fused_steps"] > 0
+    for ref, got in zip(ref_outs, outs):
+        np.testing.assert_array_equal(got, ref)
+    # row 0 really did stop early, eos kept, nothing after it
+    assert len(outs[0]) == plen + 2 and outs[0][-1] == eos
+
+
+def test_fused_preemption_at_boundary(tiny_model):
+    """4 sequences of 3 pages each through a 5-page pool with
+    decode_k=2: the window reserves pages up front, spills to what the
+    pool covers, and hands the tick to the single-tick path when even
+    1 token/row won't fit — which preempts at the BOUNDARY. Greedy
+    outputs must not notice any of it."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+    prompts4 = [rng.integers(0, cfg.vocab_size, (20,)) for _ in range(4)]
+    ref, _ = _serve(model, prompts4, max_new=20, decode_k=1,
+                    num_slots=3, num_pages=6, max_model_len=48)
+    outs, eng = _serve(model, prompts4, max_new=20, decode_k=2,
+                       num_slots=3, num_pages=6, max_model_len=48)
+    assert eng.stats["preemptions"] > 0, "pool was not tight enough"
+    assert eng.stats["fused_steps"] > 0
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_fused_with_prefix_cache(tiny_model):
+    """Shared-prefix radix cache + fused windows: the first wave
+    publishes the system prefix, the second wave maps it read-only
+    (a real trie hit) and decodes through fused windows — greedy
+    outputs stay identical to the uncached k=1 engine."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(11)
+    sys_prompt = rng.integers(0, cfg.vocab_size, (16,))
+    shared = [np.concatenate([sys_prompt,
+                              rng.integers(0, cfg.vocab_size, (L,))])
+              for L in (4, 9, 6)]
+    ref, _ = _serve(model, shared[:1], max_new=8, decode_k=1)
+    ref2, _ = _serve(model, shared[1:], max_new=8, decode_k=1)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64,
+        decode_k=4, prefix_cache=True))
+    r0 = eng.add_request(shared[0], max_new_tokens=8)
+    _drain(eng)   # wave 1 publishes the 16-token system prefix
+    wave2 = [eng.add_request(p, max_new_tokens=8) for p in shared[1:]]
+    _drain(eng)
+    assert eng.stats["fused_steps"] > 0
+    assert eng.prefix_cache.snapshot()["hits"] > 0
+    np.testing.assert_array_equal(r0.future.result(timeout=0), ref[0])
+    for a, r in zip(ref2, wave2):
+        np.testing.assert_array_equal(r.future.result(timeout=0), a)
+    eng.close()   # release trie-resident pages
+    assert eng.pool.num_live == 0
+
+
+@pytest.mark.slow
+@pytest.mark.quant
+def test_fused_int8_kv(tiny_model, prompts):
+    """int8 KV pools ride the fused scan: per-row scale planes update
+    in the same donated pytree, greedy outputs identical to the int8
+    k=1 engine (int8-vs-fp32 drift is the quant suite's contract, not
+    this one's)."""
+    _, model = tiny_model
+    ref, _ = _serve(model, prompts, decode_k=1, kv_dtype="int8")
+    outs, eng = _serve(model, prompts, decode_k=4, kv_dtype="int8")
+    assert eng.stats["fused_steps"] > 0
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(b, a)
+
+
+# --------------------------------------------------------------------
+# sampling
+# --------------------------------------------------------------------
+
+def test_sampling_reproducible_across_k(tiny_model, prompts):
+    """temperature/top-p draws key on (engine seed, stream, position) —
+    NOT on window size or batch composition — so a sampled request's
+    continuation is identical at every decode_k; a different engine
+    seed must change it."""
+    _, model = tiny_model
+
+    def sample(k, seed):
+        outs, _ = _serve(model, prompts, decode_k=k, seed=seed,
+                         temperature=0.8)
+        return outs
+
+    base = sample(1, seed=7)   # host-side sample_tokens path
+    fused = sample(2, seed=7)  # in-executable sample_tokens path
+    for a, b in zip(base, fused):
+        np.testing.assert_array_equal(b, a)
+    # sampling actually happened (greedy and sampled outputs diverge)
+    greedy, _ = _serve(model, prompts, decode_k=1)
+    assert any(not np.array_equal(a, g) for a, g in zip(base, greedy))
+    # seed sensitivity
+    other = sample(2, seed=8)
+    assert any(not np.array_equal(a, b) for a, b in zip(fused, other))
+
+
+def test_request_sampling_validation(tiny_model):
+    _, model = tiny_model
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=2, page_size=16, max_model_len=64))
+    with pytest.raises(ValueError, match="temperature"):
+        eng.add_request(np.zeros((3,), np.int32), temperature=-0.5)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.add_request(np.zeros((3,), np.int32), top_p=0.0)
+    with pytest.raises(ValueError, match="decode_k"):
+        LLMEngineConfig(decode_k=0)
+
+
+# --------------------------------------------------------------------
+# CI contract: zero host callbacks, donation, zero recompiles
+# --------------------------------------------------------------------
+
+def test_fused_zero_host_callbacks_donation_and_recompile_probe(
+        tiny_model, prompts):
+    """The ISSUE-8 CI assertion, one engine end-to-end: (1) the fused
+    k-step executable has ZERO host callbacks (PTL503) and every leaf
+    of the kv pytree — pools AND the PRNG key — donated; (2) reseed()
+    swaps the key without a recompile (the key is an ARGUMENT); (3)
+    steady-state serving holds ONE executable per (k, geometry)."""
+    from paddle_tpu import analysis
+
+    _, model = tiny_model
+    outs, eng = _serve(model, prompts, decode_k=4)
+    stats = eng.compile_stats(check_donation=True)
+    assert stats["executables"] == 1
+    assert stats["fused_executables"] == 1
+    assert stats["donation"]["held"], stats["donation"]
+    assert stats["fused"]["donation"]["held"], stats["fused"]
+    assert stats["fused"]["host_calls"] == {}, stats["fused"]
+    # the analyzer names the fused executable and counts the key leaf
+    rep = analysis.analyze_step(eng, which="fused")
+    assert rep.kind == "FusedDecode"
+    assert rep.host_calls == {}
+    assert rep.donation["aliased"] == rep.donation["expected"] > 0
+    # reseed + more traffic: same executables, so the PRNG key rides
+    # the donated pytree instead of forcing a re-trace
+    eng.reseed(123)
+    rng = np.random.default_rng(13)
+    for L in (3, 17, 9):
+        eng.add_request(rng.integers(0, 2048, (L,)), max_new_tokens=6,
+                        temperature=0.5)
+    _drain(eng)
+    after = eng.compile_stats()
+    assert after == {"executables": 1, "fused_executables": 1}, after
+
+
+def test_abort_recovery_restores_prng_key(tiny_model, prompts):
+    """abort_all() re-zeros the donated pools AND recreates the PRNG
+    key — the key leaf rides the same donated pytree, so a dispatch
+    that died mid-donation left it consumed; a recovered engine must
+    serve (and sample) again instead of wedging on a deleted buffer."""
+    _, model = tiny_model
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64,
+        decode_k=2, seed=7))
+    doomed = eng.add_request(prompts[0], max_new_tokens=8,
+                             temperature=0.8)
+    eng.step()
+    eng.abort_all(RuntimeError("injected device error"))
+    with pytest.raises(RuntimeError, match="injected"):
+        doomed.future.result(timeout=0)
+    # the recovered engine serves sampled traffic with the SAME seed
+    # semantics as an unaborted engine with the same request history
+    # (streams are assigned per add_request, so the ref engine burns
+    # one request where the recovered one burned `doomed`)
+    ref_eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64,
+        decode_k=2, seed=7))
+    ref_eng.add_request(prompts[0], max_new_tokens=8, temperature=0.8)
+    _drain(ref_eng)
+    ref = [ref_eng.add_request(p, max_new_tokens=MAX_NEW,
+                               temperature=0.8) for p in prompts]
+    _drain(ref_eng)
+    reqs = [eng.add_request(p, max_new_tokens=MAX_NEW, temperature=0.8)
+            for p in prompts]
+    _drain(eng)
+    for a, r in zip(ref, reqs):
+        np.testing.assert_array_equal(r.future.result(timeout=0),
+                                      a.future.result(timeout=0))
+
+
+def test_host_sampler_compiles_once_across_frontier_counts(tiny_model):
+    """The host-tick sampler pads to num_slots: frontier row counts
+    that vary with arrivals/finishes must NOT specialize fresh
+    executables (one vocab-sort compile per count would stall the
+    serving loop mid-traffic)."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(17)
+    eng = LLMEngine(model, LLMEngineConfig(
+        num_slots=3, page_size=16, token_budget=8, max_model_len=64,
+        decode_k=1, seed=3))
+    # staggered budgets: the live-frontier count sweeps 1..3 both ways
+    for j, L in enumerate((4, 7, 5)):
+        eng.add_request(rng.integers(0, cfg.vocab_size, (L,)),
+                        max_new_tokens=4 + 4 * j, temperature=0.6)
+    _drain(eng)
+    n = getattr(eng._host_sample, "_cache_size", None)
+    if callable(n):   # jax version guard, same as cache_size()
+        assert int(n()) == 1, "host sampler specialized per row count"
+
+
+def test_stage_cache_reused_across_ticks(tiny_model, prompts):
+    """The k=1 per-tick staging fix: sid/sample_idx host arrays are
+    rebuilt only when slot MEMBERSHIP changes, not every tick — pure
+    decode stretches must hit the cache, and outputs stay identical
+    (k1_greedy above IS this engine's output)."""
+    _, model = tiny_model
+    outs, eng = _serve(model, prompts, decode_k=1)
+    assert eng.stats["stage_hits"] > 0
+    # membership churn (finishes) forced at least one rebuild beyond
+    # the first: hits < pure-decode ticks
+    assert eng.stats["stage_hits"] < eng.stats["steps"]
