@@ -386,7 +386,83 @@ _FUSED_NAMES = ("weights", "tok0", "pos0", "rem", "fin0", "eos",
                 "temps", "top_ps", "streams", "page_tables", "kv_state")
 
 
+def _verify_step_args(engine):
+    """Example args of the speculative-verify executable
+    (`speculative._CompiledVerifyStep`): per-SLOT frontier state plus
+    the [S, k] draft-proposal matrix, and the same donated kv_state
+    pytree as every other decode executable."""
+    spec = engine._spec
+    if spec is None:
+        raise TypeError(
+            "analyze_step(which='verify') needs a speculative engine — "
+            "configure LLMEngineConfig(draft_model=..., spec_k=...)")
+    S = engine.num_slots
+    i32 = np.int32
+    return (
+        [p._value for p in spec._verify_fn._params],
+        np.zeros((S,), i32), np.zeros((S,), i32),
+        np.zeros((S, spec.k), i32), np.ones((S,), i32),
+        np.ones((S,), i32), np.zeros((S,), bool),
+        np.full((S,), -1, i32), np.zeros((S,), np.float32),
+        np.ones((S,), np.float32), np.zeros((S,), i32),
+        engine._page_tables,
+        (engine._kv, engine._kv_scales, engine._key),
+    )
+
+
+_VERIFY_NAMES = ("weights", "tok0", "pos0", "drafts", "width", "rem",
+                 "fin0", "eos", "temps", "top_ps", "streams",
+                 "page_tables", "kv_state")
+
+
+def _propose_step_args(engine):
+    """Example args of the draft propose executable
+    (`speculative._CompiledProposeStep`) — donates the DRAFT pool
+    pytree + the shared PRNG key."""
+    spec = engine._spec
+    if spec is None:
+        raise TypeError(
+            "analyze_step(which='propose') needs a speculative engine "
+            "— configure LLMEngineConfig(draft_model=..., spec_k=...)")
+    S = engine.num_slots
+    i32 = np.int32
+    return (
+        [p._value for p in spec._propose_fn._params],
+        np.zeros((S,), i32), np.zeros((S,), i32),
+        np.ones((S,), i32), np.zeros((S,), bool),
+        np.full((S,), -1, i32), np.zeros((S,), np.float32),
+        np.ones((S,), np.float32), np.zeros((S,), i32),
+        np.zeros((S,), i32), np.zeros((S,), i32),
+        engine._page_tables,
+        (spec._kv, spec._kv_scales, engine._key),
+    )
+
+
+_PROPOSE_NAMES = ("weights", "tok0", "pos0", "rem", "fin0", "eos",
+                  "temps", "top_ps", "streams", "lag", "frontier",
+                  "page_tables", "kv_state")
+
+
 def _analyze_engine(engine, check_donation, which="paged"):
+    if which == "verify":
+        # the speculative CI contract (tests/test_speculative.py):
+        # zero host callbacks (PTL503) in the one-dispatch ragged
+        # verify and full donation of the big pools + scales + PRNG
+        # key pytree (gauge pt_step_donation_held{step="spec_verify"})
+        args = _verify_step_args(engine)
+        return analyze_jit(engine._spec._verify_fn._jit, args,
+                           donate_argnums=(12,), kind="SpecVerify",
+                           names=_VERIFY_NAMES,
+                           check_donation=check_donation)
+    if which == "propose":
+        # the DRAFT side of the speculative contract: the propose
+        # scan donates the draft pool pytree — a silent aliasing drop
+        # there would copy the whole draft pool every window
+        args = _propose_step_args(engine)
+        return analyze_jit(engine._spec._propose_fn._jit, args,
+                           donate_argnums=(12,), kind="SpecPropose",
+                           names=_PROPOSE_NAMES,
+                           check_donation=check_donation)
     if which == "fused":
         # the fused-window CI contract (tests/test_fused_decode.py):
         # zero host callbacks (PTL503) in the k-step scan and full
@@ -410,7 +486,10 @@ def analyze_step(step, *batch, check_donation=True, which="paged"):
     * `inference.LLMEngine` / `LLMServer` — no batch needed (the
       compiled decode step has fixed geometry). `which="fused"`
       analyzes the fused k-step decode executable instead of the
-      single-tick step (building it if the engine hasn't yet).
+      single-tick step (building it if the engine hasn't yet);
+      `which="verify"` analyzes the speculative-decoding ragged verify
+      executable and `which="propose"` the draft propose scan (both
+      require a draft_model-configured engine).
     * anything `jax.jit`-wrapped — `analyze_step(jitted, *args)`
       (donation not inferred; use `analyze_jit` to pass
       `donate_argnums`)

@@ -736,10 +736,23 @@ def _restore_train_step_opt(ts, opt_sd):
         d = {}
         for k, v in st.items():
             val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
-            if old is not None and isinstance(old[i].get(k), jax.Array):
-                # step already ran: re-place onto the live sharding (the
+            if (old is not None and isinstance(old[i].get(k), jax.Array)
+                    and old[i][k].committed):
+                # step already ran on COMMITTED accumulators (mesh
+                # placement): re-place onto the live sharding (the
                 # _build-time device_put won't run again)
                 val = jax.device_put(val, old[i][k].sharding)
+            elif not isinstance(val, jax.Array) or val.committed:
+                # live accumulators are UNCOMMITTED (the single-device
+                # _build path) — restore them uncommitted too.
+                # device_put here yielded committed arrays, flipped the
+                # step's jit signature, and the post-restore recompile
+                # could be served from the persistent cache with a
+                # mismatched donation/aliasing map (jax-0.4.x platform
+                # bug, docs/RESILIENCE.md): the first resumed update
+                # silently diverged ~1-in-3 full-suite runs
+                # (test_fault_tolerant_resume_matches_uninterrupted).
+                val = jnp.asarray(np.asarray(val))
             d[k] = val
         states.append(d)
     ts._opt_states = states

@@ -27,12 +27,13 @@ __all__ = [
     "convert_to_mixed_precision", "InferenceServer", "BatchingConfig",
     "LLMEngine", "LLMEngineConfig", "LLMServer", "PagePool",
     "fleet_serving", "RadixPrefixCache", "SLAPolicy", "SLAScheduler",
-    "Priority",
+    "Priority", "SpeculativeDecoder",
 ]
 
 from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
 from .llm_engine import (  # noqa: E402,F401
     LLMEngine, LLMEngineConfig, LLMServer, PagePool)
+from .speculative import SpeculativeDecoder  # noqa: E402,F401
 from . import fleet_serving  # noqa: E402,F401
 from .fleet_serving import (  # noqa: E402,F401
     Priority, RadixPrefixCache, SLAPolicy, SLAScheduler)

@@ -115,7 +115,8 @@ class SLAScheduler:
         # escalates when the deadline falls before the NEXT boundary.
         self.boundary_lag_s = 0.0
         self.stats = {"preemptions_pool": 0, "preemptions_priority": 0,
-                      "slo_met": 0, "slo_missed": 0}
+                      "slo_met": 0, "slo_missed": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
 
     @property
     def _any_slo(self):
@@ -301,6 +302,21 @@ class SLAScheduler:
         self.stats[f"preemptions_{reason}"] += 1
         _PREEMPTIONS.labels(reason=reason).inc()
 
+    def note_spec_window(self, proposed, accepted):
+        """Per-window speculative accounting (the engine calls this
+        once per verify dispatch): draft tokens proposed vs accepted.
+        The scheduler tracks it because the acceptance rate IS the
+        boundary-granularity knob — each window emits up to
+        accepted+1 tokens per slot before the next admission /
+        escalation check, so a high-acceptance engine coarsens TTFT
+        observability exactly like a larger decode_k would (the
+        boundary_lag_s EMA already absorbs the wall-clock side; the
+        page accounting side is the engine's per-window k-token
+        reservation + admission headroom over the mirrored draft
+        pool — docs/SERVING.md "Speculative decoding")."""
+        self.stats["spec_proposed"] += int(proposed)
+        self.stats["spec_accepted"] += int(accepted)
+
     def note_boundary(self, window_s):
         """EMA of the fused decode window's wall time — the engine
         calls this once per window so `_at_risk` can clamp escalation
@@ -366,6 +382,11 @@ class SLAScheduler:
             "tenant_used_tokens": {t: round(u, 1) for t, u in top},
             "preemptions_pool": self.stats["preemptions_pool"],
             "preemptions_priority": self.stats["preemptions_priority"],
+            "spec_proposed": self.stats["spec_proposed"],
+            "spec_accepted": self.stats["spec_accepted"],
+            "spec_acceptance": (
+                self.stats["spec_accepted"] / self.stats["spec_proposed"]
+                if self.stats["spec_proposed"] else None),
             "slo_met": met, "slo_missed": missed,
             "slo_attainment": (met / (met + missed)
                                if met + missed else None),

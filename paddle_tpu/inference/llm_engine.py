@@ -285,12 +285,27 @@ class LLMEngineConfig:
                   (threaded through the compiled step as an argument —
                   `reseed()` never recompiles). Greedy decode ignores
                   it.
+    draft_model   optional small draft model (same GPT family, tied
+                  tokenizer — vocab ids must match) enabling
+                  SPECULATIVE DECODING (inference/speculative.py,
+                  docs/SERVING.md): the draft proposes spec_k tokens
+                  per live sequence through its own mirrored paged KV
+                  pool, the big model verifies all k+1 positions per
+                  slot in ONE ragged batched dispatch, and lossless
+                  exact-match acceptance keeps greedy AND sampled
+                  outputs token-identical to the non-speculative
+                  engine. None (default) keeps the PR-8 fused /
+                  single-tick paths.
+    spec_k        draft tokens proposed per speculative window.
+                  Default: the PT_SPEC_K env var, else 4. Ignored
+                  without a draft_model.
     """
 
     def __init__(self, num_slots=4, page_size=16, num_pages=None,
                  max_model_len=None, token_budget=None, kv_dtype=None,
                  prefix_cache=None, hash_block_tokens=None,
-                 sla_policy=None, decode_k=None, seed=0):
+                 sla_policy=None, decode_k=None, seed=0,
+                 draft_model=None, spec_k=None):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
@@ -310,12 +325,18 @@ class LLMEngineConfig:
             decode_k = int(os.environ.get("PT_DECODE_K", "1"))
         self.decode_k = int(decode_k)
         self.seed = int(seed)
+        self.draft_model = draft_model
+        if spec_k is None:
+            spec_k = int(os.environ.get("PT_SPEC_K", "4"))
+        self.spec_k = int(spec_k)
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.page_size < 1:
             raise ValueError("page_size must be >= 1")
         if self.decode_k < 1:
             raise ValueError("decode_k must be >= 1")
+        if self.spec_k < 1:
+            raise ValueError("spec_k must be >= 1")
         if self.hash_block_tokens < 1:
             raise ValueError("hash_block_tokens must be >= 1")
         if self.prefix_cache and (
@@ -357,7 +378,38 @@ class LLMEngineConfig:
                    kv_dtype=kv_dtype, **kw)
 
 
-class _CompiledPagedStep:
+class _CompiledStepBase:
+    """Shared dispatch shell of every compiled decode executable
+    (single-tick, fused window, speculative propose/verify): the
+    first call compiles OUTSIDE the persistent cache — a cache-loaded
+    donating executable on jax 0.4.x drops (or worse, mismatches) its
+    aliasing map, measured 25% slower serving from the silent
+    donation loss alone (docs/RESILIENCE.md) — and every later call
+    dispatches the warm jit directly. Subclasses build `self._jit`
+    (weights as ARGUMENTS, kv pytree DONATED) and call `_run`."""
+
+    _jit = None
+    _warm = False
+
+    def _run(self, *args):
+        if self._warm:
+            return self._jit(*args)
+        # guard the compile only: the no-persistent-cache flag is
+        # process-global, so flipping it every tick from the serving
+        # thread would race other threads' compiles
+        from ..core.jax_compat import no_persistent_cache
+
+        with no_persistent_cache():
+            out = self._jit(*args)
+        self._warm = True
+        return out
+
+    def cache_size(self):
+        n = getattr(self._jit, "_cache_size", None)
+        return int(n()) if callable(n) else -1
+
+
+class _CompiledPagedStep(_CompiledStepBase):
     """The engine's ONE decode executable, built the `jit.TrainStep`
     way: a pure function over (param_vals, step arrays, kv pools) under
     `jax.jit`. Weights ride as ARGUMENTS (structurally-equal engines
@@ -404,33 +456,13 @@ class _CompiledPagedStep:
                                    [x._value for x in new_kv[n:]], key)
 
         self._jit = jax.jit(pure, donate_argnums=(8,))
-        self._warm = False
 
     def __call__(self, tok, pos, sid, widx, pt, klen, smp, kv_state):
-        args = ([p._value for p in self._params], tok, pos, sid, widx,
-                pt, klen, smp, kv_state)
-        if self._warm:
-            return self._jit(*args)
-        # FIRST call compiles OUTSIDE the persistent cache: a
-        # cache-loaded donating executable on jax 0.4.x drops (or worse,
-        # mismatches) its aliasing map — measured 25% slower serving
-        # from the silent donation loss alone (docs/RESILIENCE.md; same
-        # guard as the restored-TrainStep path). Guard the compile only:
-        # the flag is process-global, so flipping it every tick from the
-        # serving thread would race other threads' compiles.
-        from ..core.jax_compat import no_persistent_cache
-
-        with no_persistent_cache():
-            out = self._jit(*args)
-        self._warm = True
-        return out
-
-    def cache_size(self):
-        n = getattr(self._jit, "_cache_size", None)
-        return int(n()) if callable(n) else -1
+        return self._run([p._value for p in self._params], tok, pos,
+                         sid, widx, pt, klen, smp, kv_state)
 
 
-class _CompiledFusedStep:
+class _CompiledFusedStep(_CompiledStepBase):
     """The engine's fused k-step decode executable: `lax.scan` over the
     paged step (`GPTGenerationMixin._paged_decode_fused`) with sampling
     and EOS/budget masking INSIDE the scan — one host round trip per k
@@ -466,27 +498,12 @@ class _CompiledFusedStep:
             return emits, (new_kv, new_scales, key)
 
         self._jit = jax.jit(pure, donate_argnums=(10,))
-        self._warm = False
 
     def __call__(self, tok0, pos0, rem, fin0, eos, temps, top_ps,
                  streams, pt, kv_state):
-        args = ([p._value for p in self._params], tok0, pos0, rem,
-                fin0, eos, temps, top_ps, streams, pt, kv_state)
-        if self._warm:
-            return self._jit(*args)
-        # same persistent-cache guard as _CompiledPagedStep: a
-        # cache-loaded donating executable on jax 0.4.x can drop its
-        # aliasing map (docs/RESILIENCE.md)
-        from ..core.jax_compat import no_persistent_cache
-
-        with no_persistent_cache():
-            out = self._jit(*args)
-        self._warm = True
-        return out
-
-    def cache_size(self):
-        n = getattr(self._jit, "_cache_size", None)
-        return int(n()) if callable(n) else -1
+        return self._run([p._value for p in self._params], tok0, pos0,
+                         rem, fin0, eos, temps, top_ps, streams, pt,
+                         kv_state)
 
 
 class _Request:
@@ -505,6 +522,7 @@ class _Request:
         self.slot = None
         self.pages = []           # physical page ids, logical order
         self.n_prefilled = 0      # kv-written tokens (reset on preempt)
+        self.draft_prefilled = 0  # draft-pool valid prefix (speculative)
         self.admit_seq = None     # admission order (preemption picks max)
         self.preemptions = 0
         # fleet_serving fields (scheduler class / fairness / SLO)
@@ -632,6 +650,7 @@ class LLMEngine:
 
         self._fresh_pools = _fresh_pools
         self._kv, self._kv_scales = _fresh_pools()
+        self._spec = None  # set below; pool_bytes() reads it
         _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(self.pool_bytes())
         self._page_tables = np.zeros(
             (self.num_slots, self.pages_per_seq), np.int32)
@@ -667,6 +686,19 @@ class LLMEngine:
                       "finished": 0, "preemptions": 0,
                       "occupancy_sum": 0.0, "fused_steps": 0,
                       "stage_hits": 0}
+        # speculative decoding (draft_model configured): draft pools
+        # mirror this pool's page ids, the big model verifies k+1
+        # ragged positions per slot in one dispatch — the spec window
+        # replaces the fused window for pure-decode ticks
+        # (inference/speculative.py; late import: train-only use must
+        # not drag the speculative machinery in)
+        if cfg.draft_model is not None:
+            from .speculative import SpeculativeDecoder
+
+            self._spec = SpeculativeDecoder(self, cfg.draft_model,
+                                            cfg.spec_k)
+            _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(
+                self.pool_bytes())
 
     @property
     def waiting(self):
@@ -744,6 +776,10 @@ class LLMEngine:
             # ONE fused executable per (k, geometry) — window spill and
             # EOS mid-window ride arguments, never a re-trace
             out["fused_executables"] = self._fused_fn.cache_size()
+        if self._spec is not None:
+            # ONE verify executable per (spec_k, geometry) — narrow
+            # windows ride the width/rem arguments, never a re-trace
+            out["verify_executables"] = self._spec._verify_fn.cache_size()
         if not check_donation:
             return out
         from .. import analysis
@@ -759,6 +795,21 @@ class LLMEngine:
                             "host_calls": frep.host_calls}
             _DONATION_HELD.labels(step="fused_decode").set(
                 1.0 if frep.donation["held"] else 0.0)
+        if self._spec is not None:
+            vrep = analysis.analyze_step(self, check_donation=True,
+                                         which="verify")
+            out["verify"] = {"donation": vrep.donation,
+                             "host_calls": vrep.host_calls}
+            _DONATION_HELD.labels(step="spec_verify").set(
+                1.0 if vrep.donation["held"] else 0.0)
+            # BOTH kv pytrees of the speculative contract: the draft
+            # propose scan donates the draft pools + shared key too
+            prep = analysis.analyze_step(self, check_donation=True,
+                                         which="propose")
+            out["propose"] = {"donation": prep.donation,
+                              "host_calls": prep.host_calls}
+            _DONATION_HELD.labels(step="spec_propose").set(
+                1.0 if prep.donation["held"] else 0.0)
         return out
 
     def reseed(self, seed):
@@ -773,9 +824,13 @@ class LLMEngine:
 
     def pool_bytes(self):
         """Resident KV pool bytes across layers — int8 scale planes
-        included (they are part of the cache's true footprint)."""
-        return int(sum(int(a.nbytes) for a in self._kv)
-                   + sum(int(s.nbytes) for s in self._kv_scales))
+        and the speculative draft pool included (a shared page costs
+        big-bytes + draft-bytes; docs/SERVING.md has the sizing)."""
+        total = int(sum(int(a.nbytes) for a in self._kv)
+                    + sum(int(s.nbytes) for s in self._kv_scales))
+        if self._spec is not None:
+            total += self._spec.pool_bytes()
+        return total
 
     def kv_fragmentation(self):
         """Internal fragmentation of the live KV pages: unwritten
@@ -824,6 +879,7 @@ class LLMEngine:
             "decode_tokens":
                 int(_TOKENS_TOTAL.labels(phase="decode").value),
             "decode_k": self.decode_k,
+            "spec": self._spec_metrics(),
             "fused_steps": int(_FUSED_STEPS.value),
             "dispatches": int(_DISPATCHES.value),
             "tokens_per_dispatch": _TOK_PER_DISPATCH.value,
@@ -833,6 +889,28 @@ class LLMEngine:
             "ttft_p99_s": _TTFT_SECONDS.quantile(0.99),
             "request_tok_per_s_p50": _REQ_TOK_RATE.quantile(0.5),
             "executables": self._step_fn.cache_size(),
+        }
+
+    def _spec_metrics(self):
+        """Speculative-decoding block of `metrics()`: None without a
+        draft model; else the window/acceptance view (counters are
+        PROCESS-cumulative — docs/OBSERVABILITY.md; the per-engine
+        window/proposed/accepted splits ride `stats`)."""
+        if self._spec is None:
+            return None
+        from .speculative import (_SPEC_ACCEPTED, _SPEC_DRAFT_SECONDS,
+                                  _SPEC_PROPOSED)
+
+        proposed = _SPEC_PROPOSED.value
+        return {
+            "spec_k": self._spec.k,
+            "windows": self.stats.get("spec_windows", 0),
+            "proposed": int(proposed),
+            "accepted": int(_SPEC_ACCEPTED.value),
+            "acceptance_rate": (
+                _SPEC_ACCEPTED.value / proposed if proposed else None),
+            "draft_seconds": round(float(_SPEC_DRAFT_SECONDS.value), 4),
+            "draft_pool_bytes": self._spec.pool_bytes(),
         }
 
     def abort_all(self, exc):
@@ -853,6 +931,10 @@ class LLMEngine:
             # stale trie mapping would serve zeros as a system prompt
             self.prefix_cache.clear()
         self._kv, self._kv_scales = self._fresh_pools()
+        if self._spec is not None:
+            # the draft pools ride their own donated pytree through the
+            # draft executables — same consumed-buffer hazard
+            self._spec.reset_pools()
         # the PRNG key rides the SAME donated pytree as the pools — a
         # consumed key leaf would wedge the recovered engine on its
         # next dispatch ("Array has been deleted")
@@ -877,6 +959,7 @@ class LLMEngine:
         self.pool.free(req.pages)  # shared pages decref; trie keeps its
         req.pages = []             # own reference, private pages free
         req.n_prefilled = 0
+        req.draft_prefilled = 0   # preemption replay re-prefills BOTH pools
         req.cached_prefix = 0
         req.published_blocks = 0
         req.slot = None
@@ -987,15 +1070,23 @@ class LLMEngine:
                        and self.sched.less_urgent(r, req, now)
                        for r in self._slots):
                 return False
+        # speculative k-token reservation (docs/SERVING.md): leave one
+        # page of headroom per live frontier slot so a burst of
+        # admissions can't drain the pool to where every verify window
+        # collapses to 1-token widths — admission waits behind the
+        # windows' working set, it never starves (runners finish and
+        # the headroom shrinks with them)
+        headroom = (self._spec.window_headroom()
+                    if self._spec is not None else 0)
         # (b) pool provably short even in the BEST case: the trie can
         # map at most resident_pages into the prompt and reclaim at
         # most resident_pages more, so free + victims + 2·resident <
         # prompt pages is infeasible regardless of what match() finds —
         # O(slots) with no trie walk
         need_all = -(-len(req.tokens) // self.page_size)
-        if self.pool.num_free < need_all:
+        if self.pool.num_free - headroom < need_all:
             now = _time.perf_counter()
-            avail = self.pool.num_free + sum(
+            avail = self.pool.num_free - headroom + sum(
                 len(r.pages) for r in self._slots if r is not None
                 and self.sched.less_urgent(r, req, now))
             resident = (self.prefix_cache.resident_pages
@@ -1018,7 +1109,8 @@ class LLMEngine:
         # loops below still give up cleanly when eviction falls short.
         # Skipped entirely on the uncontended fast path (free slot +
         # pool already covers the prompt): the trie walk is O(nodes).
-        need = -(-len(req.tokens) // self.page_size) - len(pages)
+        need = (-(-len(req.tokens) // self.page_size) - len(pages)
+                + headroom)
         if None not in self._slots or self.pool.num_free < need:
             now = _time.perf_counter()
             victims = [r for r in self._slots if r is not None
@@ -1051,6 +1143,15 @@ class LLMEngine:
         req.admit_seq = next(self._admit_counter)
         req.pages = list(pages)
         req.n_prefilled = req.cached_prefix
+        # mirrored draft pool: a shared page's draft rows were written
+        # by the publishing request's own catch-up (same page ids, same
+        # tokens, same draft model), so the mapped prefix is draft-valid
+        # too. Worst case — a publisher that never ran a spec window —
+        # leaves garbage draft rows there: proposals from them get
+        # REJECTED by the lossless verify, costing acceptance rate,
+        # never correctness.
+        req.draft_prefilled = (req.cached_prefix
+                               if self._spec is not None else 0)
         req.published_blocks = req.cached_prefix // self.hash_block_tokens
         self._page_tables[slot, :] = 0
         self._page_tables[slot, :len(pages)] = pages
@@ -1082,13 +1183,18 @@ class LLMEngine:
              if req is not None),
             key=lambda it: it[1].admit_seq)
 
-    def _plan(self):
+    def _plan(self, only_slots=None):
         """Allot this step's flat token budget: one frontier token per
         running sequence first, then chunked prefill FIFO. Allocates the
         pages the planned tokens will write; a dry pool preempts the
-        youngest sequence and replans."""
+        youngest sequence and replans. `only_slots` restricts the plan
+        to those slots (the ragged-window straggler tick: frontier rows
+        already took their window this step); victims of a dry pool are
+        still picked from ALL running sequences."""
         while True:
             active = self._active()
+            if only_slots is not None:
+                active = [(s, r) for s, r in active if s in only_slots]
             if not active:
                 return None
             alloc = {}
@@ -1137,15 +1243,36 @@ class LLMEngine:
     def step(self):
         """One scheduler tick: admit (deferred — new and preempted
         sequences only ever join HERE, i.e. at window boundaries) →
-        either ONE fused k-token decode window (decode_k > 1 and every
-        running sequence is at its sampling frontier) or one
-        single-tick compiled step → evict finished. Returns the list of
-        requests finished this tick."""
+        either ONE multi-token decode window (speculative when a draft
+        model is configured, else the fused k-scan when decode_k > 1)
+        over the rows at their sampling frontier, or one single-tick
+        compiled step → evict finished. Returns the list of requests
+        finished this tick.
+
+        RAGGED WINDOWS (the PR-8 leftover, fixed): a straggler row
+        still chunk-prefilling no longer forces the whole engine onto
+        single ticks — the frontier rows take their window and the
+        straggler gets a prefill-only single tick in the same
+        `step()` call (two dispatches, full progress on both fronts).
+        The straggler joins windows at the boundary after its prefill
+        completes, and per-request greedy/sampled outputs are
+        schedule-invariant, so nothing observable changes per request."""
         self._admit()
-        if self.decode_k > 1:
-            out = self._try_step_fused()
-            if out is not None:
-                return out
+        if self._spec is not None or self.decode_k > 1:
+            active = self._active()
+            frontier = [(s, r) for s, r in active
+                        if r.n_prefilled == len(r.tokens) - 1]
+            if frontier:
+                out = (self._spec.try_window(frontier)
+                       if self._spec is not None
+                       else self._try_step_fused(frontier))
+                if out is not None:
+                    stragglers = {s for s, r in active
+                                  if r.n_prefilled != len(r.tokens) - 1}
+                    if stragglers:
+                        out = out + self._step_tick(
+                            only_slots=stragglers)
+                    return out
         return self._step_tick()
 
     # ---- fused multi-token decode window ----
@@ -1159,20 +1286,17 @@ class LLMEngine:
                 self.model, self.decode_k, self.page_size)
         return self._fused_fn
 
-    def _try_step_fused(self):
-        """One fused decode window, or None when the engine must take a
-        single tick instead (chunked prefill outstanding, or the pool
-        cannot cover even a 1-token window — the single-tick path owns
-        preemption). Page capacity for the window is reserved UP FRONT;
-        when the pool (or a sequence's budget) can't cover a full k,
-        the window spills to k' = what fits via the `rem` argument —
-        the scan length never changes, so spill never recompiles."""
-        active = self._active()
+    def _try_step_fused(self, active):
+        """One fused decode window over `active` (the caller's frontier
+        rows — every one at its sampling frontier), or None when the
+        pool cannot cover even a 1-token window (the single-tick path
+        takes the tick and owns preemption). Page capacity for the
+        window is reserved UP FRONT; when the pool (or a sequence's
+        budget) can't cover a full k, the window spills to k' = what
+        fits via the `rem` argument — the scan length never changes, so
+        spill never recompiles."""
         if not active:
             return None
-        for _, req in active:
-            if req.n_prefilled != len(req.tokens) - 1:
-                return None     # prefill outstanding: single tick first
         ps = self.page_size
         k = self.decode_k
 
@@ -1291,8 +1415,11 @@ class LLMEngine:
         _TOKENS_TOTAL.labels(phase="decode").inc(total)
         _TOK_PER_DISPATCH.set(total)
         _QUEUE_DEPTH.set(len(self.waiting))
-        _LIVE_SLOTS.set(len(active) - len(finished))
-        _SLOT_OCC.set(len(active) / self.num_slots)
+        # whole-engine load — `active` is only the window's frontier
+        # rows; a chunk-prefilling straggler still occupies its slot
+        live = sum(r is not None for r in self._slots)
+        _LIVE_SLOTS.set(live)
+        _SLOT_OCC.set(live / self.num_slots)
         _PAGE_OCC.set(self.pool.num_live / (self.pool.num_pages - 1))
         _PAGE_FRAG.set(self.kv_fragmentation())
         return finished
@@ -1329,10 +1456,11 @@ class LLMEngine:
         return self._host_sample(lv, temps, tops, streams, positions,
                                  self._key)[:n]
 
-    def _step_tick(self):
+    def _step_tick(self, only_slots=None):
         """One single-tick compiled step: plan → dispatch → sample
-        frontiers on the host → evict finished."""
-        plan = self._plan()
+        frontiers on the host → evict finished. `only_slots` is the
+        ragged-window straggler tick (prefill-only rows; see step())."""
+        plan = self._plan(only_slots)
         if plan is None:
             return []
 
@@ -1341,10 +1469,13 @@ class LLMEngine:
         # 1-token sampling frontier AND slot membership is unchanged,
         # sid / sample_idx are IDENTICAL to last tick's — reuse the
         # device-committed copies instead of rebuilding and re-uploading
-        # them every tick (keyed on the slot-assignment generation)
+        # them every tick (keyed on the slot-assignment generation).
+        # Never staged for a restricted straggler tick: its row set is
+        # a subset the generation counter doesn't describe.
         staged = None
-        if all(take == 1 and len(req.tokens) - req.n_prefilled == 1
-               for _, req, take in plan):
+        if only_slots is None and all(
+                take == 1 and len(req.tokens) - req.n_prefilled == 1
+                for _, req, take in plan):
             staged = self._stage
             if staged is None or staged["gen"] != self._slot_gen:
                 from ..distributed import mesh as mesh_mod
@@ -1429,14 +1560,23 @@ class LLMEngine:
         self.stats["occupancy_sum"] += len(plan) / self.num_slots
         _STEPS_TOTAL.inc()
         _DISPATCHES.inc()
-        _TOK_PER_DISPATCH.set(len(sample_slots))
+        # a ragged-window straggler tick covers only the PREFILL rows —
+        # its plan must not overwrite the window's whole-engine load
+        # gauges with straggler-only values (7 decoding rows + 1
+        # straggler would read as 1/8 occupancy), and the window's
+        # tokens-per-dispatch amortization stamp stays unless this
+        # tick actually decoded something
+        live_now = (len(plan) if only_slots is None
+                    else sum(r is not None for r in self._slots))
+        if only_slots is None or sample_slots:
+            _TOK_PER_DISPATCH.set(len(sample_slots))
         # the flat-budget split: one decode token per sampling frontier,
         # everything else is (chunked or preemption-replay) prefill
         _TOKENS_TOTAL.labels(phase="decode").inc(len(sample_slots))
         _TOKENS_TOTAL.labels(phase="prefill").inc(i - len(sample_slots))
         _QUEUE_DEPTH.set(len(self.waiting))
-        _LIVE_SLOTS.set(len(plan))
-        _SLOT_OCC.set(len(plan) / self.num_slots)
+        _LIVE_SLOTS.set(live_now)
+        _SLOT_OCC.set(live_now / self.num_slots)
         _PAGE_OCC.set(self.pool.num_live / (self.pool.num_pages - 1))
 
         nxt = []

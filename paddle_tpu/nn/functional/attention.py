@@ -123,7 +123,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                     k_scales=None, v_scales=None, frontier_offset=None,
-                    name=None):
+                    max_tokens_per_slot=None, name=None):
     """Ragged paged attention over a paged KV-cache pool — the serving
     decode path (inference/llm_engine.py; PAPERS.md "Ragged Paged
     Attention"). One query per FLAT scheduled token, so a single call
@@ -152,6 +152,19 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                  its scan iteration here, so the kv_lens VECTOR stays
                  window-invariant and only one scalar advances the
                  frontier per iteration.
+    max_tokens_per_slot  optional STATIC int: the caller's guarantee
+                 that no slot owns more than this many of the T query
+                 tokens. Sizes the jnp slot grid [S, C] at
+                 C = max_tokens_per_slot instead of the worst-case
+                 C = T — the speculative verify step packs exactly
+                 k+1 tokens per slot, so its score tensor shrinks from
+                 [S, h, T, L] to [S, h, k+1, L]. When the T tokens are
+                 additionally slot-major contiguous in blocks of this
+                 size (the verify layout), the Pallas path amortizes
+                 each slot's page DMAs across the whole query block.
+                 A caller that VIOLATES the bound gets silently
+                 dropped queries (out-of-bounds scatter) — it is a
+                 contract, not a clamp.
 
     jnp reference semantics everywhere (mirrors the dense decode path in
     text/models/gpt.py `_cached_attention` op for op, so engine greedy
@@ -175,6 +188,13 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
     if _paged_pallas_eligible(q, kp):
         from ...ops.pallas_kernels import paged_attention as pa_kernel
 
+        # the blocked-query kernel variant needs the slot-major
+        # contract: q rows arrive in contiguous blocks of
+        # max_tokens_per_slot, one slot per block (the verify layout)
+        qps = (max_tokens_per_slot
+               if max_tokens_per_slot is not None
+               and q.shape[0] % max_tokens_per_slot == 0 else None)
+
         def jfn_pallas(qv, kpool, vpool, tables, sids, ls, *rest):
             off_v, sc = ((rest[0], rest[1:]) if has_off
                          else (None, rest))
@@ -182,7 +202,7 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                 qv, kpool, vpool, tables, sids, ls,
                 k_scales=sc[0] if sc else None,
                 v_scales=sc[1] if sc else None,
-                frontier_offset=off_v)
+                frontier_offset=off_v, q_per_slot=qps)
 
         return apply_jfn("paged_attention", jfn_pallas, q, kp, vp, pt,
                          sid, lens, *off, *scales)
@@ -223,7 +243,11 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         # grid coordinates whatever order the scheduler packed
         eq = sids[:, None] == sids[None, :]
         cpos = jnp.sum(jnp.tril(eq, -1), axis=1)    # [T]
-        C = tokens                                  # worst case: 1 slot
+        # worst case one slot owns every token; a caller-provided
+        # per-slot bound (the verify step: exactly k+1) shrinks the
+        # grid — and the [S, h, C, L] score tensor — accordingly
+        C = (tokens if max_tokens_per_slot is None
+             else min(tokens, int(max_tokens_per_slot)))
         qs = jnp.zeros((n_slots, C, h, d), qv.dtype).at[
             (sids, cpos)].set(qv)
         lgrid = jnp.zeros((n_slots, C), jnp.int32).at[

@@ -110,9 +110,94 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref, v_ref,
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _rpa_qblock_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref,
+                       v_ref, *rest, page_size, pages_per_seq, scale,
+                       quantized, qb):
+    """Query-blocked variant for the speculative VERIFY step: the flat
+    token batch arrives slot-major in contiguous blocks of `qb` rows
+    (one slot per block — the verify layout packs exactly k+1 query
+    tokens per slot), so the grid is (T/qb, pages_per_seq) and each of
+    the slot's pages is DMA'd ONCE per block instead of once per query
+    row — the per-token kernel would move the same page k+1 times.
+    Query lengths stay ragged PER ROW: row i of block b masks its
+    scores at its own kv_len, which is what lets draft token j attend
+    to drafts 0..j-1 written in this same dispatch and never to later
+    ones."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    # per-row lens from scalar-prefetch SMEM: unrolled scalar reads
+    # over the STATIC block height (qb = k+1)
+    base = jnp.stack([lens_ref[b * qb + i] for i in range(qb)])
+    kvlen = jnp.where(base > 0, base + off_ref[0], 0)    # [qb]
+    kvmax = jnp.max(kvlen)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # pages past the LONGEST row's prefix contribute to no row — skip
+    @pl.when(j * page_size < kvmax)
+    def _compute():
+        q = q_ref[...]                   # [qb, H, D]
+        k = k_ref[0]                     # [P, H, D]
+        v = v_ref[0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
+            v = v.astype(jnp.float32) * vs_ref[0][:, :, None]
+        qt = jnp.swapaxes(q, 0, 1)       # [H, qb, D]
+        kt = jnp.swapaxes(k, 0, 1)       # [H, P, D]
+        s = jax.lax.dot_general(
+            qt, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                        # [H, qb, P]
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2) + j * page_size
+        s = jnp.where(cols < kvlen[None, :, None], s, NEG_INF)
+        vrows = jax.lax.broadcasted_iota(
+            jnp.int32, v.shape, 0) + j * page_size
+        v = jnp.where(vrows < kvmax, v, jnp.zeros_like(v))
+        vt = jnp.swapaxes(v, 0, 1)       # [H, P, D]
+
+        m_prev = m_ref[:, :, :1]         # [H, qb, 1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)           # [H, qb, P] f32
+        # a row this page is entirely PAST (the block ran because a
+        # longer sibling row needed it) is all-masked here: its m_new
+        # stays NEG_INF and exp(s - m_new) would be exp(0) = 1 across
+        # the lane — zero such rows' weights so l/acc only ever see
+        # real probability mass (the per-token kernel gets this for
+        # free from its per-token pl.when gate)
+        p = jnp.where(kvlen[None, :, None] > j * page_size, p, 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(vt.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                # [H, qb, D]
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = jnp.swapaxes(acc_ref[:] / safe_l, 0, 1).astype(
+            o_ref.dtype)                 # [qb, H, D]
+
+
 def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
                            kv_lens, k_scales=None, v_scales=None,
-                           frontier_offset=None, interpret=False):
+                           frontier_offset=None, q_per_slot=None,
+                           interpret=False):
     """q [T, H, D], pools [N, P, H, D], page_tables [S, MP] int,
     slot_ids [T] int, kv_lens [T] int → out [T, H, D].
 
@@ -128,6 +213,14 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     after the DMA, so HBM traffic for the cache stays int8 — the whole
     point of the quantized pool (page bytes ≈ ×4 down vs fp32).
 
+    q_per_slot: optional STATIC int — the caller's guarantee that the
+    T query rows are slot-major contiguous blocks of exactly this many
+    rows, one slot per block (the speculative VERIFY layout: k+1 rows
+    per slot). Switches to the query-blocked kernel whose grid is
+    (T/q_per_slot, pages_per_seq): each slot's pages are DMA'd once
+    per BLOCK instead of once per row, while per-row kv_lens keep the
+    in-window causal raggedness. Ignored when T is not a multiple.
+
     Semantics contract: identical to the jnp reference in
     nn/functional/attention.py `paged_attention` (pinned by the
     interpret-mode parity tests in tests/test_llm_engine.py and
@@ -138,12 +231,18 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     scale = 1.0 / math.sqrt(dim)
     quantized = k_scales is not None
 
-    kernel = functools.partial(
-        _rpa_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
-        scale=scale, quantized=quantized)
     if frontier_offset is None:
         frontier_offset = 0
     off = jnp.asarray(frontier_offset, jnp.int32).reshape((1,))
+
+    if q_per_slot is not None and tokens % int(q_per_slot) == 0:
+        return _qblock_call(q, k_pool, v_pool, page_tables, slot_ids,
+                            kv_lens, off, k_scales, v_scales,
+                            int(q_per_slot), scale, interpret)
+
+    kernel = functools.partial(
+        _rpa_kernel, page_size=page_size, pages_per_seq=pages_per_seq,
+        scale=scale, quantized=quantized)
 
     def _eff_last(t, lens, offv):
         # last live page under the offset frontier (index_map twin of
@@ -189,6 +288,82 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
             pltpu.VMEM((heads, dim), jnp.float32),   # acc
             pltpu.VMEM((heads, 128), jnp.float32),   # running max
             pltpu.VMEM((heads, 128), jnp.float32),   # running sum
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, heads, dim), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(slot_ids, jnp.int32),
+      jnp.asarray(page_tables, jnp.int32).reshape(-1),
+      jnp.asarray(kv_lens, jnp.int32), off,
+      *inputs)
+
+
+def _qblock_call(q, k_pool, v_pool, page_tables, slot_ids, kv_lens,
+                 off, k_scales, v_scales, qb, scale, interpret):
+    """Build the query-blocked pallas_call (`_rpa_qblock_kernel`):
+    grid (T/qb, pages_per_seq), q/out blocked [qb, H, D], kv pages
+    gathered once per BLOCK through the slot of the block's first row
+    (the slot-major contract — one slot per block)."""
+    tokens, heads, dim = q.shape
+    _, page_size, _, _ = k_pool.shape
+    _, pages_per_seq = page_tables.shape
+    quantized = k_scales is not None
+    nblocks = tokens // qb
+
+    kernel = functools.partial(
+        _rpa_qblock_kernel, page_size=page_size,
+        pages_per_seq=pages_per_seq, scale=scale, quantized=quantized,
+        qb=qb)
+
+    def _blk_last(b, lens, offv):
+        # last live page any row of block b needs (index_map twin of
+        # the kernel's per-row kvlen; the block clamp uses the MAX so
+        # every row's pages are covered). The prefetched operands are
+        # SMEM refs here — scalar reads only, unrolled over the STATIC
+        # block height (qb = k+1, single digits).
+        eff_max = jnp.asarray(0, jnp.int32)
+        for i in range(qb):
+            base = lens[b * qb + i]
+            eff = jnp.where(base > 0, base + offv[0], 0)
+            eff_max = jnp.maximum(eff_max, eff)
+        return jnp.maximum(eff_max - 1, 0) // page_size
+
+    def page_map(b, j, sid, pt, lens, offv):
+        last = _blk_last(b, lens, offv)
+        return (pt[sid[b * qb] * pages_per_seq + jnp.minimum(j, last)],
+                0, 0, 0)
+
+    def scale_map(b, j, sid, pt, lens, offv):
+        last = _blk_last(b, lens, offv)
+        return (pt[sid[b * qb] * pages_per_seq + jnp.minimum(j, last)],
+                0, 0)
+
+    in_specs = [
+        pl.BlockSpec((qb, heads, dim),
+                     lambda b, j, sid, pt, lens, offv: (b, 0, 0)),
+        pl.BlockSpec((1, page_size, heads, dim), page_map),
+        pl.BlockSpec((1, page_size, heads, dim), page_map),
+    ]
+    inputs = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, heads), scale_map),
+                     pl.BlockSpec((1, page_size, heads), scale_map)]
+        inputs += [k_scales, v_scales]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nblocks, pages_per_seq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (qb, heads, dim),
+            lambda b, j, sid, pt, lens, offv: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, qb, dim), jnp.float32),   # acc
+            pltpu.VMEM((heads, qb, 128), jnp.float32),   # running max
+            pltpu.VMEM((heads, qb, 128), jnp.float32),   # running sum
         ],
     )
     return pl.pallas_call(

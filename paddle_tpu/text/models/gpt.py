@@ -271,7 +271,7 @@ def _paged_cache_write_quant(k_pool, v_pool, k_scales, v_scales, k_new,
 def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
                          page_tables, slot_ids, kv_lens,
                          k_scales=None, v_scales=None,
-                         frontier_offset=None):
+                         frontier_offset=None, max_q_per_slot=None):
     """Paged-cache decoder block over the FLAT token layout [1, T, d] —
     the continuous-batching analog of `_layer_forward_cached`: write the
     step's k/v into pool pages, then ragged paged attention against each
@@ -282,7 +282,11 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
     and attention dequantizes on gather; returns the new scale planes
     after the pools. `frontier_offset` is the fused-decode window's
     per-iteration scalar: kv_lens stays the window-invariant BASE
-    length and attention adds the offset to every nonzero row."""
+    length and attention adds the offset to every nonzero row.
+    `max_q_per_slot` is the speculative-verify grid hint: a caller that
+    packs at most that many query tokens per slot (the verify step:
+    exactly k+1) lets attention size its slot grid [S, k+1] instead of
+    the worst-case [S, T]."""
     T = x.shape[1]
     h = layer.ln1(x)
     qkv = layer.qkv(h)
@@ -294,14 +298,16 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
         ck, cv = _paged_cache_write(cache_k, cache_v, k, v, write_idx)
         attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
                                  kv_lens,
-                                 frontier_offset=frontier_offset)
+                                 frontier_offset=frontier_offset,
+                                 max_tokens_per_slot=max_q_per_slot)
         cks = cvs = None
     else:
         ck, cv, cks, cvs = _paged_cache_write_quant(
             cache_k, cache_v, k_scales, v_scales, k, v, write_idx)
         attn = F.paged_attention(q, ck, cv, page_tables, slot_ids,
                                  kv_lens, k_scales=cks, v_scales=cvs,
-                                 frontier_offset=frontier_offset)
+                                 frontier_offset=frontier_offset,
+                                 max_tokens_per_slot=max_q_per_slot)
     attn = manip.reshape(attn, [1, T, layer.nh * layer.hd])
     x = x + layer.proj(attn)
     h = layer.ln2(x)
@@ -427,7 +433,8 @@ class GPTGenerationMixin:
 
     def _paged_decode_core(self, tok, pos_ids, slot_ids, write_idx,
                            page_tables, kv_lens, sample_idx, kv,
-                           kv_scales=None, frontier_offset=None):
+                           kv_scales=None, frontier_offset=None,
+                           max_q_per_slot=None):
         """One ragged engine step over flat tokens: tok/pos_ids/slot_ids/
         write_idx/kv_lens [T], page_tables [S, MP], sample_idx [S] (the
         flat row holding each slot's sampling frontier; stale slots
@@ -445,7 +452,11 @@ class GPTGenerationMixin:
 
         frontier_offset: optional scalar added to every NONZERO kv_len
         (the fused decode window passes iteration i here so the base
-        kv_lens vector stays window-invariant)."""
+        kv_lens vector stays window-invariant).
+
+        max_q_per_slot: the speculative-verify grid hint (see
+        `_layer_forward_paged`) — the caller guarantees no slot owns
+        more than this many flat tokens this step."""
         model = self.gpt
         x = model.wte(tok.unsqueeze(0)) + model.wpe(pos_ids)
         flat, scale_flat = [], []
@@ -454,14 +465,16 @@ class GPTGenerationMixin:
                 x, ck, cv = _layer_forward_paged(
                     layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
                     page_tables, slot_ids, kv_lens,
-                    frontier_offset=frontier_offset)
+                    frontier_offset=frontier_offset,
+                    max_q_per_slot=max_q_per_slot)
             else:
                 x, ck, cv, cks, cvs = _layer_forward_paged(
                     layer, x, kv[2 * i], kv[2 * i + 1], write_idx,
                     page_tables, slot_ids, kv_lens,
                     k_scales=kv_scales[2 * i],
                     v_scales=kv_scales[2 * i + 1],
-                    frontier_offset=frontier_offset)
+                    frontier_offset=frontier_offset,
+                    max_q_per_slot=max_q_per_slot)
                 scale_flat += [cks, cvs]
             flat += [ck, cv]
         x = model.ln_f(x)
@@ -471,7 +484,8 @@ class GPTGenerationMixin:
 
     def _paged_decode_fused(self, k, page_size, tok0, pos0, rem, fin0,
                             eos_ids, temps, top_ps, streams,
-                            page_tables, kv, kv_scales, key):
+                            page_tables, kv, kv_scales, key,
+                            lag=None, frontier=None):
         """k decode ticks fused into ONE `lax.scan` over the paged step
         — the body of the engine's fused executable (`_CompiledFusedStep`
         in inference/llm_engine.py): per iteration, write the frontier
@@ -497,7 +511,18 @@ class GPTGenerationMixin:
         (emitted [k, S] int32, new_kv, new_scales) — the key passes
         through the donated pytree untouched (sampling folds per-row
         (stream, position) into it instead of splitting, which is what
-        makes the draw window-size-invariant)."""
+        makes the draw window-size-invariant).
+
+        lag/frontier (speculative draft PROPOSE mode — both [S] or
+        both None): a row with lag 1 starts the scan ONE position
+        early at pos0-1 — `tok0` then carries the token AT pos0-1 —
+        so its missing draft-KV row (the previous window's k-th
+        accepted token, which the propose scan never wrote) is
+        replayed inside this same dispatch instead of costing a
+        separate catch-up tick; iteration 0's carry is FORCED to
+        `frontier` (the already-known token at pos0) for lag rows, so
+        the later proposals condition on the true sequence, not on
+        the draft's guess of a token the engine already holds."""
         import jax
         import jax.numpy as jnp
 
@@ -506,7 +531,8 @@ class GPTGenerationMixin:
         S = tok0.shape[0]
         sl = jnp.arange(S, dtype=jnp.int32)
         pt = jnp.asarray(page_tables, jnp.int32)
-        klen0 = pos0 + 1
+        start = pos0 if lag is None else pos0 - lag
+        klen0 = start + 1
         pad = jnp.asarray(-1, jnp.int32)
 
         def t(v):
@@ -516,7 +542,7 @@ class GPTGenerationMixin:
             tok, fin, kv_c, kvs_c = carry
             live = ~fin
             tok_in = jnp.where(live, tok, 0)
-            pos_in = jnp.where(live, pos0 + i, 0)
+            pos_in = jnp.where(live, start + i, 0)
             klen = jnp.where(live, klen0, 0)  # + i rides the offset
             page = pt[sl, pos_in // page_size]
             widx = jnp.where(live,
@@ -533,6 +559,11 @@ class GPTGenerationMixin:
             lv = logits._value[0].astype(jnp.float32)  # [S, vocab]
             nxt = sample_tokens(lv, temps, top_ps, streams, pos_in + 1,
                                 key)
+            if lag is not None:
+                # propose mode: a lag row's iteration-0 output IS the
+                # already-known frontier token — force it so later
+                # proposals condition on the true sequence
+                nxt = jnp.where((i == 0) & (lag > 0), frontier, nxt)
             emit = jnp.where(live, nxt, pad)
             fin2 = (fin | (live & (eos_ids >= 0) & (nxt == eos_ids))
                     | (live & (i + 1 >= rem)))
@@ -543,6 +574,112 @@ class GPTGenerationMixin:
             body, (tok0, fin0, list(kv), list(kv_scales or [])),
             jnp.arange(int(k), dtype=jnp.int32))
         return emits, kv_f, kvs_f
+
+    def _paged_verify_fused(self, k, page_size, tok0, pos0, drafts,
+                            width, rem, fin0, eos_ids, temps, top_ps,
+                            streams, page_tables, kv, kv_scales, key):
+        """Speculative-decoding verify: score ALL k+1 positions of every
+        slot — the real frontier token plus k draft proposals — in ONE
+        ragged batched step, then accept the longest prefix of drafts
+        that matches the target model's own keyed picks
+        (inference/speculative.py has the window orchestration;
+        docs/SERVING.md "Speculative decoding" the contract).
+
+        Lossless by construction: `sample_tokens` keys every draw on
+        (engine seed, stream, position) only, so the target pick at a
+        position is a deterministic function of the accepted prefix —
+        greedy AND sampled outputs are token-identical to the
+        non-speculative engine, and invariant to spec_k. Acceptance is
+        therefore exact-match against the target pick (for greedy rows
+        that IS longest-prefix argmax match; for sampled rows the
+        rejection test degenerates to equality because the keyed
+        categorical draw is the target sample itself — couple the draft
+        to the same key and agreement is high whenever the
+        distributions are close).
+
+        Raw jax values in and out (the jit wrapper in speculative.py
+        owns the Tensor boundary): tok0/pos0 [S] int32 (frontier token
+        + its write position), drafts [S, k] int32 (draft proposals —
+        entries at or past `width` are ignored), width [S] int32
+        (drafts actually processed this window: positions
+        pos0+1..pos0+width get KV written; pre-clamped by the engine to
+        the reserved pages), rem [S] int32 (emit budget: at most this
+        many tokens may be emitted), fin0 [S] bool (True = dead slot),
+        eos_ids/temps/top_ps/streams [S], page_tables [S, MP], kv /
+        kv_scales the pool pytree, key the engine PRNG key (passes
+        through untouched — same contract as the fused scan).
+
+        Flat layout is slot-major [S*(k+1)]: row s*(k+1)+j carries the
+        token at position pos0[s]+j with kv_len pos0[s]+j+1, so ragged
+        paged attention lets every draft attend to the earlier drafts
+        written in this same dispatch and never to later ones. Invalid
+        rows (dead slots, j > width) write the trash page at kv_len 0.
+        Rejected-draft KV rows stay in the pool as stale garbage past
+        the accepted frontier — never attended (kv_len masks them) and
+        overwritten by position when the real tokens arrive: rollback
+        is positional, no cleanup pass (the draft pool relies on the
+        same property — tests pin it).
+
+        Returns (emits [k+1, S] int32, new_kv, new_scales): column s
+        holds the accepted target picks — between 1 and k+1 tokens —
+        then -1 padding; EOS and budget masking applied in-executable
+        (the emitted eos is kept, nothing after it)."""
+        import jax.numpy as jnp
+
+        from ...tensor_core import Tensor
+
+        S = tok0.shape[0]
+        Q = int(k) + 1
+        T = S * Q
+        live = ~fin0
+        j = jnp.arange(Q, dtype=jnp.int32)
+        pt = jnp.asarray(page_tables, jnp.int32)
+        drafts = drafts.astype(jnp.int32)
+        tok_mat = jnp.concatenate([tok0[:, None], drafts], axis=1)
+        valid = live[:, None] & (j[None, :] <= width[:, None])  # [S, Q]
+        pos_mat = pos0[:, None] + j[None, :]
+        sid = jnp.repeat(jnp.arange(S, dtype=jnp.int32), Q)
+        tokf = jnp.where(valid, tok_mat, 0).reshape(T)
+        posf = jnp.where(valid, pos_mat, 0).reshape(T)
+        validf = valid.reshape(T)
+        page = pt[sid, posf // page_size]
+        widx = jnp.where(validf,
+                         page * page_size + posf % page_size, 0)
+        klen = jnp.where(validf, posf + 1, 0)
+
+        def t(v):
+            return Tensor(v, stop_gradient=True)
+
+        out = self._paged_decode_core(
+            t(tokf), t(posf), t(sid), t(widx), t(pt), t(klen),
+            t(jnp.arange(T, dtype=jnp.int32)), [t(v) for v in kv],
+            kv_scales=([t(s) for s in kv_scales] if kv_scales
+                       else None),
+            max_q_per_slot=Q)
+        logits, *new = out
+        n = len(kv)
+        kv2 = [x._value for x in new[:n]]
+        kvs2 = [x._value for x in new[n:]]
+        lv = logits._value[0].astype(jnp.float32)       # [T, vocab]
+        picks = sample_tokens(
+            lv, jnp.repeat(temps, Q), jnp.repeat(top_ps, Q),
+            jnp.repeat(streams, Q), posf + 1, key).reshape(S, Q)
+        # longest matching draft prefix, clamped to the window width
+        match = (drafts == picks[:, :k]) & (
+            jnp.arange(int(k), dtype=jnp.int32)[None, :]
+            < width[:, None])
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        a = jnp.sum(acc, axis=1)                        # [S] accepted
+        n_emit = jnp.where(live, jnp.minimum(a + 1, rem), 0)
+        # in-executable EOS masking: the emitted eos is kept, every
+        # later pick in the window is suppressed (exclusive cumsum)
+        is_eos = ((eos_ids[:, None] >= 0)
+                  & (picks == eos_ids[:, None])).astype(jnp.int32)
+        eos_before = jnp.cumsum(is_eos, axis=1) - is_eos
+        emit_mask = (j[None, :] < n_emit[:, None]) & (eos_before == 0)
+        emits = jnp.where(emit_mask, picks,
+                          jnp.asarray(-1, jnp.int32))
+        return jnp.swapaxes(emits, 0, 1), kv2, kvs2     # [Q, S]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, do_sample=False, attention_mask=None,

@@ -96,6 +96,41 @@ def _loss_fn(m, x, y):
     return nn.functional.cross_entropy(m(x), y)
 
 
+def test_restore_holds_one_executable(tmp_path):
+    """The ISSUE-10 deflake (docs/RESILIENCE.md): restoring a LIVE
+    TrainStep's optimizer accumulators must not flip the step's jit
+    signature. The old restore re-placed them with device_put —
+    COMMITTED arrays where the live single-device accumulators were
+    uncommitted — so the first post-restore step recompiled, and that
+    recompile could be served from the persistent cache with a
+    mismatched donation/aliasing map (the flaky
+    test_fault_tolerant_resume_matches_uninterrupted divergence).
+    Pinned mechanically: ONE executable across the whole resume
+    lifecycle, and the resumed losses stay exact."""
+    m1, xs, ys = _tiny_model_and_data()
+    opt1 = paddle.optimizer.AdamW(1e-2, parameters=m1.parameters())
+    st1 = paddle.jit.TrainStep(m1, _loss_fn, opt1)
+    for _ in range(5):
+        ref = float(st1(xs, ys).numpy())
+
+    m2, _, _ = _tiny_model_and_data()
+    opt2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+    st2 = paddle.jit.TrainStep(m2, _loss_fn, opt2)
+    cp = ckpt.Checkpointer(str(tmp_path / "one"), model=m2,
+                           train_step=st2)
+    for _ in range(3):
+        st2(xs, ys)
+    cp.save(3)
+    assert st2.compile_stats()["executables"] == 1
+    assert cp.load_latest() == 3
+    for _ in range(2):
+        res = float(st2(xs, ys).numpy())
+    # the restore changed no leaf's commitment → no retrace, and the
+    # donating executable was never re-fetched through the cache
+    assert st2.compile_stats()["executables"] == 1
+    np.testing.assert_allclose(res, ref, rtol=1e-6, atol=1e-7)
+
+
 def test_train_kill_resume_matches_uninterrupted(tmp_path):
     # uninterrupted: 6 steps
     m1, xs, ys = _tiny_model_and_data()
