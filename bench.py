@@ -1332,6 +1332,21 @@ def bench_train_3d():
         lN = float(last.numpy())
         dt = (time.perf_counter() - t0) / iters
         stats = step.compile_stats(check_donation=True)
+        # per-axis collective bytes off the live trace: the measured
+        # baseline ROADMAP item 2's quantized all-reduce must beat
+        # (the dp axis carries the gradient psums), plus the
+        # jaxpr-level finding count (rank-conditioned collectives /
+        # placement drift) — one call, same aggregation as the
+        # `ptlint --spmd` gate (docs/ANALYSIS.md "SPMD passes").
+        # Guarded like _ptlint_stamp: metadata must never kill the
+        # measured headline timings.
+        try:
+            from paddle_tpu.analysis import spmd_report
+            spmd = spmd_report(step, ids)
+        except Exception as e:
+            log(f"[bench] train_3d spmd stamp failed: {e!r}")
+            spmd = {"per_axis_bytes": {}, "per_axis_counts": {},
+                    "num_findings": -1, "error": repr(e)}
         out[cfg3d.tag()] = {
             **cfg3d.describe(),
             "compile_s": round(compile_s, 2),
@@ -1340,9 +1355,14 @@ def bench_train_3d():
             "loss_last": round(lN, 4),
             "executables": stats["executables"],
             "donation_held": stats["donation"]["held"],
+            "collective_bytes_per_axis": spmd["per_axis_bytes"],
+            "collective_execs_per_axis": spmd["per_axis_counts"],
+            "spmd_findings": spmd["num_findings"],
         }
         log(f"[bench] train_3d {cfg3d.tag()}: {dt*1e3:.1f} ms/step, "
-            f"donation_held={stats['donation']['held']}")
+            f"donation_held={stats['donation']['held']}, "
+            f"coll_bytes={spmd['per_axis_bytes']}, "
+            f"spmd_findings={spmd['num_findings']}")
         mesh_mod.reset_mesh()
     return {"n_devices": ndev, "configs": out}
 
@@ -1436,10 +1456,19 @@ def _ptlint_stamp():
         mod = cli._load_lint()
         res = mod.lint_paths(
             [os.path.join(here, p) for p in cli.DEFAULT_PATHS])
+        # the SPMD families ride the same stamp: version of the
+        # jaxpr-level pass suite (stdlib-readable from lint.py) plus
+        # the AST-side PTL6xx/PTL7xx finding count — the jaxpr-level
+        # counts are stamped per-config by the train_3d arm, which
+        # owns a live step
+        spmd_ast = sum(1 for f in res["findings"]
+                       if f.rule.startswith(("PTL6", "PTL7")))
         return {"version": mod.PTLINT_VERSION,
                 "findings": len(res["findings"]),
                 "suppressed": res["suppressed"],
-                "files": res["files"]}
+                "files": res["files"],
+                "spmd": {"version": mod.SPMD_ANALYSIS_VERSION,
+                         "ast_findings": spmd_ast}}
     except Exception as e:  # metadata must never kill the headline
         log(f"[bench] ptlint stamp failed: {e!r}")
         return {"error": repr(e)}

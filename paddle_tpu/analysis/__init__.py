@@ -24,15 +24,23 @@ Rule catalogue with the real shipped-bug each rule would have caught:
 docs/ANALYSIS.md.
 """
 from .lint import (  # noqa: F401
-    PTLINT_VERSION, RULES, Rule, Finding,
+    PTLINT_VERSION, SPMD_ANALYSIS_VERSION, RULES, Rule, Finding,
     lint_source, lint_file, lint_paths, iter_python_files)
 from .step_analysis import (  # noqa: F401
     ANALYSIS_RULES, StepReport, analyze_step, analyze_jit,
     donation_coverage, signature_diff)
+from .spmd_analysis import (  # noqa: F401
+    SPMD_RULES, Collective, CollectiveSchedule, collectives_of_jaxpr,
+    extract_schedule, schedule_diff, rank_divergence, check_placement,
+    spmd_report)
 
 __all__ = [
-    "PTLINT_VERSION", "RULES", "Rule", "Finding",
+    "PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "RULES", "Rule",
+    "Finding",
     "lint_source", "lint_file", "lint_paths", "iter_python_files",
     "ANALYSIS_RULES", "StepReport", "analyze_step", "analyze_jit",
     "donation_coverage", "signature_diff",
+    "SPMD_RULES", "Collective", "CollectiveSchedule",
+    "collectives_of_jaxpr", "extract_schedule", "schedule_diff",
+    "rank_divergence", "check_placement", "spmd_report",
 ]

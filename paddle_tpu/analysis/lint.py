@@ -51,10 +51,15 @@ import os
 import re
 import types
 
-__all__ = ["PTLINT_VERSION", "RULES", "Rule", "Finding", "lint_source",
-           "lint_file", "lint_paths", "iter_python_files"]
+__all__ = ["PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "RULES", "Rule",
+           "Finding", "lint_source", "lint_file", "lint_paths",
+           "iter_python_files"]
 
-PTLINT_VERSION = "1.0.0"
+PTLINT_VERSION = "1.1.0"
+# version of the jaxpr-level SPMD pass suite (analysis/spmd_analysis.py).
+# Declared HERE so the stdlib-only loaders (tools/ptlint.py, bench.py's
+# supervisor-side stamp) can report it without importing jax.
+SPMD_ANALYSIS_VERSION = "1.0.0"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,11 +125,48 @@ RULES = {r.id: r for r in [
          "quantized runtime (PR 4) requires "
          "preferred_element_type=int32 — the MXU-native contract"),
     Rule("PTL401", "rank-divergent-collective",
-         "a collective call inside a branch conditioned on the "
-         "process rank",
+         "a collective call (direct, or through any call depth) "
+         "inside a branch conditioned on the process rank",
          "the PR-4 wire-format deadlock shape: one rank entering a "
          "collective its peers skip (or entering a different one) "
-         "hangs the pod"),
+         "hangs the pod; interprocedural since ISSUE-11 — a helper "
+         "that reaches a collective is as divergent as the "
+         "collective itself"),
+    Rule("PTL601", "concat-into-partial-shard-map-spec",
+         "a jnp.concatenate/stack-derived value enters shard_map "
+         "through a partial in_spec (a PartitionSpec leaving mesh "
+         "axes unmentioned)",
+         "the PR-6 hybrid-pp NaN: jax-0.4.37's spmd partitioner "
+         "mis-shards a concatenate result entering shard_map "
+         "through a partial in_spec — values arrive SUMMED over "
+         "the unmentioned axes (labels doubled at pp=2 -> OOB "
+         "vocab ids -> take_along_axis NaN-fill). jnp.pad "
+         "partitions correctly and is the pinned-safe rewrite "
+         "(test_label_shift_survives_partial_shard_spec)"),
+    Rule("PTL701", "shared-dict-iter",
+         "iteration over a shared dict attribute of a "
+         "thread-shared class outside a list()/sorted()/dict() "
+         "snapshot or the class lock",
+         "the PR-7 scrape race: the /metrics HTTP thread iterating "
+         "scheduler/pool dicts while the engine thread "
+         "inserts/deletes -> intermittent RuntimeError 500s — "
+         "fixed by hand in PR 7's fifth review pass, mechanized "
+         "here"),
+    Rule("PTL702", "unlocked-rmw",
+         "read-modify-write of shared state outside the lock, in "
+         "a class that declares one",
+         "a lock-owning class whose `+=` runs unlocked loses "
+         "increments under concurrency — the shared-counter race "
+         "class the observability registry's per-thread cells "
+         "(PR 3) exist to avoid"),
+    Rule("PTL703", "defaultdict-read-materializes",
+         "Load-context subscript of a defaultdict attribute in a "
+         "thread-shared class — a read that INSERTS races every "
+         "concurrent snapshot; use .get()",
+         "the PR-7 phantom-meter bug: _order_key reading the "
+         "tenant fair-queuing defaultdict materialized a 0.0 "
+         "meter per merely-waiting tenant (mutation on the read "
+         "path), fixed to .get in review pass 2"),
 ]}
 
 _SLUG_TO_ID = {r.name: r.id for r in RULES.values()}
@@ -190,6 +232,37 @@ _TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time",
 
 _SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
 _SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+# ---- PTL601 (sharding hazard) tables ----
+# the concatenate family the jax-0.4.37 partitioner mis-shards into a
+# partial-spec shard_map input (jnp.pad is the pinned-safe rewrite and
+# deliberately NOT listed — flagging the documented fix idiom would
+# turn the regression-pinned safe shape into a permanent suppression)
+_CONCAT_FUNCS = {"concatenate", "stack", "hstack", "vstack",
+                 "column_stack", "row_stack"}
+_SHARD_MAP_NAMES = {"shard_map"}
+
+# ---- PTL7xx (host concurrency) tables ----
+# classes opt in to the race fence with `# ptlint: thread-shared` on
+# the class line (the serving/fleet scrape contract), or implicitly by
+# owning a threading lock (declared lock discipline)
+_THREAD_SHARED_RE = re.compile(r"#\s*ptlint:\s*thread-shared")
+_LOCK_FACTORIES = {"Lock", "RLock"}
+_DICT_FACTORIES = {"dict", "defaultdict", "OrderedDict", "Counter"}
+# wrappers that materialize a dict view in one C-level pass (no
+# bytecode boundary another thread could interleave a resize into)
+_LAZY_ITER_WRAPPERS = {"enumerate", "zip", "map", "filter", "iter",
+                       "reversed", "chain"}
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    """Concurrency contract of one class, prescanned from its body."""
+    name: str
+    shared: bool = False          # thread-shared marker or owns a lock
+    dict_attrs: frozenset = frozenset()        # self attrs holding dicts
+    defaultdict_attrs: frozenset = frozenset()
+    lock_attrs: frozenset = frozenset()        # self attrs holding locks
 
 
 @dataclasses.dataclass
@@ -354,15 +427,21 @@ class _FunctionLinter:
     donation misuse and rank-divergent collectives live in host code.
     """
 
-    def __init__(self, module, fn_node, traced, autograph, func_name):
+    def __init__(self, module, fn_node, traced, autograph, func_name,
+                 cls_info=None):
         self.m = module                     # _ModuleLint
         self.fn = fn_node
         self.traced = traced
         self.autograph = autograph
         self.func_name = func_name
+        self.cls_info = cls_info            # _ClassInfo of enclosing class
+        self._lock_depth = 0                # inside `with self.<lock>:`
         self.tainted = set()
         self.array = set()
         self.int8_names = set()
+        self.concat_names = set()   # names derived from jnp.concatenate
+        # PTL601 state: key -> in_specs AST node of a shard_map wrapper
+        self.shard_wraps = {}
         # PTL201 state: key -> donated positions (from jax.jit assigns
         # seen in this scope, merged over the module's self-attr map)
         self.jitted = dict(module.jitted_attrs)
@@ -481,6 +560,7 @@ class _FunctionLinter:
             self._seed_params()
         self._prescan_int8(body)
         self._prescan_jitted(body)
+        self._prescan_shard_map(body)
         for stmt in body:
             self._visit(stmt)
 
@@ -522,6 +602,67 @@ class _FunctionLinter:
                 key = _target_key(t)
                 if key:
                     self.jitted[key] = donated
+
+    def _mentions_concat(self, node):
+        """Does this expression carry a concatenate-family result?
+        Flow-sensitive via _assign_target (a clean reassignment clears
+        the taint), and `jnp.pad(...)` LAUNDERS: its result partitions
+        correctly whatever fed it — pad is the documented fix idiom,
+        so the rule must not chase taint through it."""
+        if isinstance(node, ast.Call):
+            comp = _component(node.func)
+            root = _root(node.func)
+            arrayish = root in ("jnp", "lax", "np", "numpy", "jax",
+                                "jsp")
+            if arrayish and comp in _CONCAT_FUNCS:
+                return True
+            if arrayish and comp == "pad":
+                return False
+        if isinstance(node, ast.Name):
+            return node.id in self.concat_names
+        return any(self._mentions_concat(c)
+                   for c in ast.iter_child_nodes(node))
+
+    def _prescan_shard_map(self, body):
+        """Record `<key> = jax.shard_map(fn, ..., in_specs=...)`
+        wrappers for the PTL601 partial-spec check at call sites."""
+        for n in _walk_shallow(body):
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            if _component(n.value.func) not in _SHARD_MAP_NAMES:
+                continue
+            in_specs = None
+            for kw in n.value.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+            for t in n.targets:
+                key = _target_key(t)
+                if key and in_specs is not None:
+                    self.shard_wraps[key] = in_specs
+
+    @staticmethod
+    def _spec_at(in_specs, pos):
+        """The in_specs entry feeding argument `pos` (a single spec
+        broadcasts over every argument)."""
+        if isinstance(in_specs, (ast.Tuple, ast.List)):
+            return in_specs.elts[pos] if pos < len(in_specs.elts) \
+                else None
+        return in_specs
+
+    @staticmethod
+    def _is_partial_pspec(spec):
+        """A P(...)/PartitionSpec(...) literal that leaves mesh axes
+        unmentioned: an explicit None entry, or no axis names at all.
+        Non-literal specs are unknown — never flagged."""
+        if not isinstance(spec, ast.Call):
+            return False
+        if _component(spec.func) not in ("P", "PartitionSpec"):
+            return False
+        if not spec.args:
+            return True
+        return any(isinstance(a, ast.Constant) and a.value is None
+                   for a in spec.args)
 
     @staticmethod
     def _literal_ints(node):
@@ -565,11 +706,15 @@ class _FunctionLinter:
         autograph = autograph or dec_autograph
         sub = _FunctionLinter(self.m, node, traced, autograph,
                               f"{self.func_name}.{name}" if
-                              self.func_name else name)
+                              self.func_name else name,
+                              cls_info=self.cls_info)
         sub.tainted |= self.tainted
         sub.array |= self.array
         sub.int8_names |= self.int8_names
+        sub.concat_names |= self.concat_names
         sub.jitted.update(self.jitted)
+        sub.shard_wraps.update(self.shard_wraps)
+        sub._lock_depth = self._lock_depth
         sub.run()
 
     _visit_AsyncFunctionDef = _visit_FunctionDef
@@ -593,7 +738,32 @@ class _FunctionLinter:
     def _visit_AugAssign(self, node):
         self._expr(node.value)
         lv = max(self._level(node.value), self._level(node.target))
+        self._check_unlocked_rmw(node)
         self._assign_target(node.target, lv, node.value)
+
+    def _check_unlocked_rmw(self, node):
+        """PTL702: `self.X += ...` / `self.X[k] += ...` outside the
+        lock, in a class that DECLARES one — the declared lock names
+        the multi-writer contract; an unlocked read-modify-write
+        loses updates."""
+        info = self.cls_info
+        if info is None or not info.lock_attrs or self._lock_depth or \
+                (self.fn is not None and self.fn.name == "__init__"):
+            return
+        t = node.target
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        key = _target_key(t)
+        if not key or not key.startswith("self."):
+            return
+        if key[len("self."):] in info.lock_attrs:
+            return
+        self._emit(
+            "PTL702", node,
+            f"read-modify-write of '{key}' outside the lock "
+            f"{info.name} declares — a concurrent writer loses this "
+            "update; hold the lock or route through the telemetry "
+            "registry's per-thread counters")
 
     def _assign_target(self, t, lv, value):
         if isinstance(t, ast.Name):
@@ -606,6 +776,13 @@ class _FunctionLinter:
             self._record_store(t.id)
             if _mentions_int8(value, self.int8_names):
                 self.int8_names.add(t.id)
+            # flow-sensitive (unlike the int8 prescan): a clean
+            # reassignment launders — `x = jnp.zeros(...)` after a
+            # concatenate must not keep flagging x
+            if self._mentions_concat(value):
+                self.concat_names.add(t.id)
+            else:
+                self.concat_names.discard(t.id)
         elif isinstance(t, (ast.Tuple, ast.List)):
             for e in t.elts:
                 inner = e.value if isinstance(e, ast.Starred) else e
@@ -647,6 +824,75 @@ class _FunctionLinter:
         if rankish:
             self.rank_if_depth -= 1
 
+    def _visit_With(self, node):
+        for item in node.items:
+            self._expr(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign_target(item.optional_vars,
+                                    self._level(item.context_expr),
+                                    item.context_expr)
+        locked = any(self._is_lock_expr(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self._lock_depth += 1
+        for stmt in node.body:
+            self._visit(stmt)
+        if locked:
+            self._lock_depth -= 1
+
+    _visit_AsyncWith = _visit_With
+
+    def _is_lock_expr(self, expr):
+        if self.cls_info is None:
+            return False
+        key = _target_key(expr)
+        return bool(key) and key.startswith("self.") and \
+            key[len("self."):] in self.cls_info.lock_attrs
+
+    # ---- PTL7xx: host-concurrency race fence -------------------------
+
+    def _race_fence_active(self):
+        """The PTL7xx rules run in thread-shared classes (marker or
+        declared lock), outside __init__ (no concurrency during
+        construction) and outside the class lock."""
+        return (self.cls_info is not None and self.cls_info.shared
+                and not self._lock_depth
+                and not (self.fn is not None
+                         and self.fn.name == "__init__"))
+
+    def _shared_dict_view(self, expr):
+        """Attr name when `expr` is an UNSNAPSHOTTED view of a shared
+        dict attribute: `self.X` / `self.X.items()/values()/keys()`,
+        possibly under a lazy wrapper (enumerate/zip/...). Snapshot
+        wrappers (list/sorted/dict/...) produce a Call that simply
+        doesn't match — safe by construction."""
+        while isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Name) and \
+                expr.func.id in _LAZY_ITER_WRAPPERS and expr.args:
+            expr = expr.args[0]
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr in ("items", "values", "keys"):
+            expr = expr.func.value
+        key = _target_key(expr)
+        if key and key.startswith("self.") and \
+                key[len("self."):] in self.cls_info.dict_attrs:
+            return key
+        return None
+
+    def _check_shared_iter(self, iter_expr, report_node):
+        if not self._race_fence_active():
+            return
+        key = self._shared_dict_view(iter_expr)
+        if key is None:
+            return
+        self._emit(
+            "PTL701", report_node,
+            f"iterating '{key}' (a shared dict of thread-shared class "
+            f"{self.cls_info.name}) without a list()/sorted() snapshot "
+            "or the class lock — a concurrent insert/delete raises "
+            "RuntimeError mid-iteration (the /metrics scrape race)")
+
     def _visit_While(self, node):
         self._expr(node.test)
         if self.traced and not self.autograph and \
@@ -680,6 +926,7 @@ class _FunctionLinter:
 
     def _visit_For(self, node):
         self._expr(node.iter)
+        self._check_shared_iter(node.iter, node.iter)
         if self.traced and not self.autograph and \
                 self._is_array(node.iter):
             self._emit("PTL104", node.iter,
@@ -734,8 +981,37 @@ class _FunctionLinter:
                 key = _target_key(n)
                 if key:
                     self._check_reuse(key, n)
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                for gen in n.generators:
+                    self._check_shared_iter(gen.iter, gen.iter)
+            elif isinstance(n, ast.YieldFrom):
+                self._check_shared_iter(n.value, n.value)
+            elif isinstance(n, ast.Subscript) and \
+                    isinstance(n.ctx, ast.Load):
+                self._check_defaultdict_read(n)
             elif isinstance(n, ast.Lambda):
                 self._lambda(n)
+
+    def _check_defaultdict_read(self, node):
+        """PTL703: a Load-context subscript of a defaultdict attr in a
+        thread-shared class INSERTS on miss — mutation on the read
+        path, racing every concurrent snapshot (the PR-7 phantom-meter
+        bug). Writes (Store/AugAssign targets) are the owner's
+        intentional materialization and stay legal."""
+        if not self._race_fence_active():
+            return
+        key = _target_key(node.value)
+        if not key or not key.startswith("self.") or \
+                key[len("self."):] not in \
+                self.cls_info.defaultdict_attrs:
+            return
+        self._emit(
+            "PTL703", node,
+            f"reading '{key}[...]' materializes a default entry in a "
+            f"thread-shared defaultdict of {self.cls_info.name} — a "
+            "mutation on the read path; use .get() with an explicit "
+            "default")
 
     def _check_reuse(self, key, node):
         entry = self.consumed.get(key)
@@ -765,7 +1041,9 @@ class _FunctionLinter:
 
     def _lint_lambda(self, node, taint_params=True):
         sub = _FunctionLinter(self.m, None, True, self.autograph,
-                              f"{self.func_name}.<lambda>")
+                              f"{self.func_name}.<lambda>",
+                              cls_info=self.cls_info)
+        sub._lock_depth = self._lock_depth
         sub.tainted = set(self.tainted)
         sub.array = set(self.array)
         if taint_params:
@@ -773,7 +1051,9 @@ class _FunctionLinter:
             for p in a.posonlyargs + a.args + a.kwonlyargs:
                 sub.tainted.add(p.arg)
         sub.int8_names = set(self.int8_names)
+        sub.concat_names = set(self.concat_names)
         sub.jitted = dict(self.jitted)
+        sub.shard_wraps = dict(self.shard_wraps)
         # ast.walk in _expr yields the body node itself first, so a
         # bare-Call body is checked along with everything nested in it
         sub._expr(node.body)
@@ -865,6 +1145,68 @@ class _FunctionLinter:
                        f"collective {comp}() under a rank-conditioned "
                        "branch — peers that skip (or reorder) it "
                        "deadlock the pod")
+        elif self.rank_if_depth > 0 and \
+                comp in self.m.collective_reach and \
+                (isinstance(node.func, ast.Name) or
+                 (isinstance(node.func, ast.Attribute) and
+                  isinstance(node.func.value, ast.Name) and
+                  node.func.value.id in ("self", "cls"))):
+            # interprocedural: a helper that (transitively) reaches a
+            # collective is as divergent as the collective itself.
+            # Matching is by bare def name, so only plain-name and
+            # direct self/cls method calls qualify — an unrelated
+            # object's same-named method (`self.log_file.flush()`)
+            # must not inherit another class's reachability
+            via = self.m.collective_reach[comp]
+            self._emit("PTL401", node,
+                       f"{comp}() reaches collective {via}() (through "
+                       "its call chain) under a rank-conditioned "
+                       "branch — peers that skip it deadlock the pod")
+
+        # PTL601: a concatenate-family result entering shard_map
+        # through a partial in_spec (the PR-6 partitioner bug shape)
+        in_specs = None
+        if isinstance(node.func, ast.Call) and \
+                _component(node.func.func) in _SHARD_MAP_NAMES:
+            for kw in node.func.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+        else:
+            fkey = _target_key(node.func)
+            if fkey and fkey in self.shard_wraps:
+                in_specs = self.shard_wraps[fkey]
+        if in_specs is not None:
+            for pos, a in enumerate(node.args):
+                if not self._mentions_concat(a):
+                    continue
+                spec = self._spec_at(in_specs, pos)
+                if spec is not None and self._is_partial_pspec(spec):
+                    self._emit(
+                        "PTL601", node,
+                        "jnp.concatenate-derived value enters "
+                        f"shard_map at position {pos} through a "
+                        "partial in_spec — jax-0.4.37's partitioner "
+                        "delivers it SUMMED over the unmentioned mesh "
+                        "axes (the PR-6 hybrid-pp NaN); rewrite with "
+                        "jnp.pad or mention every mesh axis in the "
+                        "spec")
+            # keyword-passed operands can't be mapped to a spec
+            # position statically — flag when ANY spec is partial
+            # (conservative: the PR-6 shape must not hide behind a
+            # kwarg)
+            specs = (in_specs.elts
+                     if isinstance(in_specs, (ast.Tuple, ast.List))
+                     else [in_specs])
+            if any(self._is_partial_pspec(s) for s in specs):
+                for kw in node.keywords:
+                    if self._mentions_concat(kw.value):
+                        self._emit(
+                            "PTL601", node,
+                            "jnp.concatenate-derived value enters "
+                            f"shard_map via keyword '{kw.arg}' and at "
+                            "least one in_spec is partial — the PR-6 "
+                            "partitioner mis-shard shape; rewrite "
+                            "with jnp.pad or mention every mesh axis")
 
         # PTL201/202: calls THROUGH a recorded jitted callable
         key = _target_key(node.func)
@@ -926,6 +1268,43 @@ class _ModuleLint:
                     key = _target_key(t)
                     if key and key.startswith(("self.", "cls.")):
                         self.jitted_attrs[key] = donated
+        self.collective_reach = self._collective_reach()
+
+    def _collective_reach(self):
+        """PTL401 interprocedural closure: function name -> the
+        collective it (transitively) reaches through calls to other
+        module functions. Direct calls only per body (nested defs lint
+        their own scope); bare-name matching covers both module
+        functions and methods."""
+        direct, calls = {}, {}
+        for n in ast.walk(self.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            called = set()
+            for sub in _walk_shallow(n.body):
+                if isinstance(sub, ast.Call):
+                    comp = _component(sub.func)
+                    if comp in _COLLECTIVE_FUNCS:
+                        direct.setdefault(n.name, comp)
+                    elif comp:
+                        called.add(comp)
+            # UNION when defs share a name (overloads/methods across
+            # classes) — overwriting would make reach depend on
+            # definition order
+            calls.setdefault(n.name, set()).update(called)
+        reach = dict(direct)
+        changed = True
+        while changed:
+            changed = False
+            for fn, called in calls.items():
+                if fn in reach:
+                    continue
+                for c in called:
+                    if c in reach:
+                        reach[fn] = reach[c]
+                        changed = True
+                        break
+        return reach
 
     def _suppressions(self, lineno):
         if lineno is None or lineno < 1 or lineno > len(self.lines):
@@ -983,14 +1362,58 @@ class _ModuleLint:
         self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
         return self
 
-    def _run_def(self, node, prefix):
+    def _scan_class(self, node):
+        """Build the class's concurrency contract (_ClassInfo): the
+        thread-shared marker on the class line, declared locks, and
+        which self attributes hold dicts / defaultdicts."""
+        line = (self.lines[node.lineno - 1]
+                if 0 < node.lineno <= len(self.lines) else "")
+        marked = bool(_THREAD_SHARED_RE.search(line))
+        dict_attrs, dd_attrs, lock_attrs = set(), set(), set()
+        for n in ast.walk(node):
+            # AnnAssign too: `self.q: dict = {}` must not silently
+            # switch the whole race fence off for an annotated class
+            if isinstance(n, ast.AnnAssign):
+                if n.value is None:
+                    continue
+                targets = [n.target]
+            elif isinstance(n, ast.Assign):
+                targets = n.targets
+            else:
+                continue
+            for t in targets:
+                key = _target_key(t)
+                if not key or not key.startswith("self."):
+                    continue
+                attr = key[len("self."):]
+                if "." in attr:
+                    continue
+                v = n.value
+                if isinstance(v, (ast.Dict, ast.DictComp)):
+                    dict_attrs.add(attr)
+                elif isinstance(v, ast.Call):
+                    comp = _component(v.func)
+                    if comp in _DICT_FACTORIES:
+                        dict_attrs.add(attr)
+                        if comp == "defaultdict":
+                            dd_attrs.add(attr)
+                    elif comp in _LOCK_FACTORIES:
+                        lock_attrs.add(attr)
+        return _ClassInfo(name=node.name,
+                          shared=marked or bool(lock_attrs),
+                          dict_attrs=frozenset(dict_attrs),
+                          defaultdict_attrs=frozenset(dd_attrs),
+                          lock_attrs=frozenset(lock_attrs))
+
+    def _run_def(self, node, prefix, cls_info=None):
         if isinstance(node, ast.ClassDef):
             cprefix = f"{prefix}{node.name}."
+            info = self._scan_class(node)
             for child in node.body:
                 if isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef,
                                       ast.ClassDef)):
-                    self._run_def(child, cprefix)
+                    self._run_def(child, cprefix, cls_info=info)
             return
         name = node.name
         traced = name in self.raw_traced or name in self.autograph_traced
@@ -999,7 +1422,7 @@ class _ModuleLint:
         traced = traced or dec_traced
         autograph = autograph or dec_autograph
         _FunctionLinter(self, node, traced, autograph,
-                        prefix + name).run()
+                        prefix + name, cls_info=cls_info).run()
 
 
 # --------------------------------------------------------------- frontend

@@ -1,0 +1,515 @@
+"""SPMD safety analysis — collective schedules, rank divergence, and
+declared-vs-live sharding, at the jaxpr level.
+
+Every distributed bug this project shipped was found by a human after
+a flaky multi-host failure: the PR-6 partial-spec concatenate
+mis-shard (NaN'd hybrid-pp), the PR-4 rank-conditioned collective
+deadlock shape, the PR-7 scrape races. The AST half of those fences
+lives in `lint.py` (PTL601/PTL7xx); this module is the compiled half —
+what only exists after tracing:
+
+* **Collective-schedule extraction** (`extract_schedule`): walk the
+  jaxpr of a `HybridTrainStep`/`DistributedTrainStep`/shard_map body
+  and emit the ORDERED schedule of collectives — op kind, mesh axes,
+  reduce op, payload bytes per execution, execution count (scan trip
+  multipliers folded in). Two uses: (a) the tier-1 hybrid3d schedule
+  is pinned as a golden in tests, so an accidental extra all-gather
+  fails CI before a pod ever sees it; (b) `per_axis_bytes` is the
+  measured baseline ROADMAP item 2's quantized in-XLA all-reduce
+  (EQuARX) must beat.
+
+* **Rank-invariance** (`rank_divergence`): trace the same step builder
+  under different host ranks and diff the schedules. A divergence IS
+  the PR-4 deadlock class — one rank compiles a collective its peers
+  don't — caught at trace time instead of wedging a pod (PTL603).
+
+* **Rank-conditioned collectives in-program** (PTL604, found during
+  the walk): a collective under a `lax.cond` whose predicate derives
+  from `axis_index` over an axis the collective itself reduces —
+  members of one axis group take different branches, so some enter the
+  collective and some don't. A predicate over a DIFFERENT axis is
+  legal (every member of the collective's own group branches the same
+  way — the 1F1B head-stage loss is the shipped example) and stays
+  silent.
+
+* **Declared-PSpec vs live placement** (`check_placement`, PTL602):
+  each parameter's `_pspec` annotation vs the sharding its live value
+  actually has. Drift here is the PR-6 LocalSGD bug class — a host
+  path re-placed averaged params and silently flipped the step to a
+  second executable.
+
+Byte accounting semantics: `count` multiplies scan trip lengths;
+`lax.cond` branches are BOTH counted (the compiled program's upper
+bound — at most one executes per rank per trip); `while_loop` bodies
+count one trip (length unknowable statically — flagged in context as
+`while[?]`).
+
+CLI: `tools/ptlint.py --spmd` runs these passes on the tier-1
+dp2.tp2.pp2 reference step and dumps the machine-readable schedule;
+the stdlib-only AST gate stays jax-free and ~4 s.
+"""
+import dataclasses
+import types as _types
+
+import numpy as np
+
+import jax
+
+from .lint import Finding, SPMD_ANALYSIS_VERSION
+
+__all__ = ["SPMD_ANALYSIS_VERSION", "SPMD_RULES", "Collective",
+           "CollectiveSchedule", "collectives_of_jaxpr",
+           "extract_schedule", "schedule_diff", "rank_divergence",
+           "check_placement", "spmd_report", "reference_report"]
+
+# the jaxpr-level SPMD finding ids (the AST linter owns PTL6xx's
+# source-visible shapes; these need a trace)
+SPMD_RULES = {
+    "PTL602": "pspec-placement-drift",
+    "PTL603": "rank-divergent-schedule",
+    "PTL604": "rank-conditioned-collective",
+}
+
+# collective primitive -> reduce op (None = data movement, no reduce)
+_COLLECTIVES = {
+    "psum": "add", "pmax": "max", "pmin": "min",
+    "psum_scatter": "add",
+    "ppermute": None, "pbroadcast": None, "all_gather": None,
+    "all_to_all": None, "pgather": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective in the compiled program."""
+    op: str          # primitive name (psum, ppermute, all_gather, ...)
+    axes: tuple      # mesh axis names it communicates over
+    reduce: object   # "add"/"max"/"min", or None for pure movement
+    bytes: int       # payload bytes per execution (per-shard avals)
+    count: int       # executions per step (scan trips folded in)
+    context: str     # program path, e.g. "/shard_map/scan[15]"
+
+    def key(self):
+        """Identity WITHOUT context — rank-divergence and the golden
+        compare care about what communicates, not sub-jaxpr naming."""
+        return (self.op, self.axes, self.reduce, self.bytes, self.count)
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CollectiveSchedule:
+    ops: list                  # [Collective] in program order
+    findings: list             # PTL604 from the walk
+
+    @property
+    def per_axis_bytes(self):
+        """axis -> total payload bytes per step (bytes x count summed
+        over every collective touching the axis; cond branches both
+        counted — the compiled upper bound)."""
+        out = {}
+        for c in self.ops:
+            for ax in c.axes:
+                out[ax] = out.get(ax, 0) + c.bytes * c.count
+        return dict(sorted(out.items()))
+
+    @property
+    def per_axis_counts(self):
+        out = {}
+        for c in self.ops:
+            for ax in c.axes:
+                out[ax] = out.get(ax, 0) + c.count
+        return dict(sorted(out.items()))
+
+    def keys(self):
+        return [c.key() for c in self.ops]
+
+    def identical(self, other):
+        return self.keys() == other.keys()
+
+    def as_dict(self):
+        return {"version": SPMD_ANALYSIS_VERSION,
+                "n_collectives": len(self.ops),
+                "executions": sum(c.count for c in self.ops),
+                "per_axis_bytes": self.per_axis_bytes,
+                "per_axis_counts": self.per_axis_counts,
+                "ops": [c.as_dict() for c in self.ops],
+                "findings": [f.as_dict() for f in self.findings]}
+
+    def summary(self):
+        axes = ", ".join(f"{a}: {b / 1e6:.3f} MB"
+                         for a, b in self.per_axis_bytes.items())
+        return (f"{len(self.ops)} collectives "
+                f"({sum(c.count for c in self.ops)} executions) — "
+                f"{axes or 'no communication'}")
+
+
+# --------------------------------------------------------------- walker
+
+def _axes_of(eqn):
+    p = eqn.params
+    ax = p.get("axes", p.get("axis_name", p.get("axis", ())))
+    if isinstance(ax, (str, int)):
+        ax = (ax,)
+    return tuple(str(a) for a in ax)
+
+
+def _payload_bytes(eqn):
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        try:
+            total += int(np.prod(aval.shape, dtype=np.int64)) * \
+                np.dtype(aval.dtype).itemsize
+        except (TypeError, ValueError):
+            pass       # extended dtypes (PRNG keys) — no collective use
+    return total
+
+
+def _is_var(v):
+    return not hasattr(v, "val")     # jax Literal carries .val
+
+
+class _Walker:
+    """Ordered jaxpr walk with scan-trip multipliers and rank-origin
+    taint (which mesh axes a value's `axis_index` ancestry covers)."""
+
+    def __init__(self):
+        self.ops = []
+        self.findings = []
+
+    def walk(self, jaxpr, mult=1, ctx="", taint=None):
+        taint = {} if taint is None else taint   # Var -> frozenset(axes)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_taint = frozenset().union(
+                *(taint.get(v, frozenset()) for v in eqn.invars
+                  if _is_var(v))) if eqn.invars else frozenset()
+            if name == "axis_index":
+                ax = _axes_of(eqn)
+                for ov in eqn.outvars:
+                    taint[ov] = in_taint | set(ax)
+                continue
+            if name in _COLLECTIVES:
+                self.ops.append(Collective(
+                    op=name, axes=_axes_of(eqn),
+                    reduce=_COLLECTIVES[name],
+                    bytes=_payload_bytes(eqn), count=mult,
+                    context=ctx or "/"))
+            if in_taint:
+                for ov in eqn.outvars:
+                    taint[ov] = in_taint
+            # ---- sub-jaxprs ----
+            if name == "scan":
+                length = int(eqn.params.get("length", 1) or 1)
+                self._enter(eqn.params["jaxpr"], eqn, taint,
+                            mult * length, f"{ctx}/scan[{length}]")
+            elif name == "cond":
+                self._cond(eqn, mult, ctx, taint)
+            elif name == "while":
+                for key in ("cond_jaxpr", "body_jaxpr"):
+                    sub = eqn.params.get(key)
+                    if sub is not None:
+                        self._enter(sub, eqn, taint, mult,
+                                    f"{ctx}/while[?]")
+            elif name == "pjit":
+                label = eqn.params.get("name") or "pjit"
+                self._enter(eqn.params["jaxpr"], eqn, taint,
+                            mult, f"{ctx}/{label}")
+            else:
+                for key in sorted(eqn.params):
+                    v = eqn.params[key]
+                    for sub in (v if isinstance(v, (list, tuple))
+                                else (v,)):
+                        if hasattr(sub, "eqns") or (
+                                hasattr(sub, "jaxpr")
+                                and hasattr(sub.jaxpr, "eqns")):
+                            self._enter(sub, eqn, taint, mult,
+                                        f"{ctx}/{name}")
+        return taint
+
+    def _enter(self, sub, eqn, taint, mult, ctx):
+        jx = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        outer_invars = eqn.invars
+        inner = {}
+        if len(jx.invars) == len(outer_invars):
+            for ov, iv in zip(outer_invars, jx.invars):
+                if _is_var(ov):
+                    t = taint.get(ov)
+                    if t:
+                        inner[iv] = t
+        else:
+            # arity mismatch (pruned/const-hoisted): conservative union
+            u = frozenset().union(
+                *(taint.get(v, frozenset()) for v in outer_invars
+                  if _is_var(v))) if outer_invars else frozenset()
+            if u:
+                inner = {v: u for v in jx.invars}
+        inner = self.walk(jx, mult, ctx, inner)
+        # taint flows back OUT: an axis_index computed INSIDE a
+        # pjit/scan must taint the outer result, or a rank-derived
+        # cond predicate behind any sub-jaxpr boundary goes invisible
+        if len(jx.outvars) == len(eqn.outvars):
+            for iv, ov in zip(jx.outvars, eqn.outvars):
+                t = inner.get(iv) if _is_var(iv) else None
+                if t:
+                    taint[ov] = taint.get(ov, frozenset()) | t
+
+    def _cond(self, eqn, mult, ctx, taint):
+        pred = eqn.invars[0]
+        pred_axes = (taint.get(pred, frozenset())
+                     if _is_var(pred) else frozenset())
+        branches = eqn.params.get("branches", ())
+        walkers = []
+        for i, br in enumerate(branches):
+            w = _Walker()
+            # branch operands are eqn.invars[1:] (invars[0] is the
+            # predicate); branch outvar taint flows back to the cond's
+            # outvars through _enter's out-mapping (the shared taint
+            # dict is written in place — unions across branches)
+            shim = _types.SimpleNamespace(invars=eqn.invars[1:],
+                                          outvars=eqn.outvars)
+            w._enter(br, shim, taint, mult, f"{ctx}/cond[{i}]")
+            walkers.append(w)
+            self.ops.extend(w.ops)
+            self.findings.extend(w.findings)
+        if pred_axes and walkers:
+            # deadlock shape: members of a predicate axis group take
+            # different branches, so a collective OVER that axis runs
+            # on some members and not others. Filter each branch's
+            # sub-schedule to the predicate axes and demand identity.
+            def filt(w):
+                return [c.key() for c in w.ops
+                        if set(c.axes) & pred_axes]
+
+            base = filt(walkers[0])
+            for i, w in enumerate(walkers[1:], start=1):
+                if filt(w) != base:
+                    self.findings.append(Finding(
+                        rule="PTL604",
+                        name=SPMD_RULES["PTL604"],
+                        path=f"<jaxpr{ctx or '/'}>", line=0, col=0,
+                        message=(
+                            "collective over axes "
+                            f"{sorted(pred_axes)} inside a lax.cond "
+                            "whose predicate derives from axis_index "
+                            "over the same axes — branch "
+                            f"0 and branch {i} schedule different "
+                            "collectives, so members of one axis "
+                            "group diverge (the PR-4 deadlock shape, "
+                            "in-program form)"),
+                        func="cond"))
+                    break
+
+
+def collectives_of_jaxpr(closed):
+    """CollectiveSchedule of a (Closed)Jaxpr — the walk behind
+    `extract_schedule`, usable on a jaxpr you already hold."""
+    w = _Walker()
+    jx = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    w.walk(jx)
+    return CollectiveSchedule(ops=w.ops, findings=w.findings)
+
+
+# ------------------------------------------------------------ frontends
+
+def _trace_step(step, batch):
+    """ClosedJaxpr of a TrainStep-family step (the SAME `_step_args`
+    layout the runtime dispatches with — see step_analysis)."""
+    from ..tensor_core import Tensor
+    import jax.numpy as jnp
+
+    if type(step).__name__ == "SparseTrainStep":
+        raise TypeError(
+            "extract_schedule does not support SparseTrainStep "
+            "(per-step rows/inv operands); analyze a dense step")
+    if step._compiled is None:
+        step._build()
+    if not batch:
+        raise ValueError(
+            "extract_schedule(TrainStep) needs one example batch: "
+            "extract_schedule(step, x, y)")
+    batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+    return step._compiled.trace(*step._step_args(batch_vals)).jaxpr
+
+
+def extract_schedule(step, *args):
+    """Ordered per-mesh-axis collective schedule of a live step.
+
+    Accepts a `jit.TrainStep` (incl. `HybridTrainStep` /
+    `DistributedTrainStep`) plus one example batch, any `jax.jit`-
+    wrapped callable plus example args (ShapeDtypeStructs work), or a
+    (Closed)Jaxpr directly. Nothing is executed — the walk is pure
+    trace inspection.
+    """
+    from ..jit import TrainStep
+    from ..distributed.parallel_step import DistributedTrainStep
+
+    if isinstance(step, TrainStep):
+        closed = _trace_step(step, args)
+    elif isinstance(step, DistributedTrainStep):
+        from ..tensor_core import Tensor
+        import jax.numpy as jnp
+
+        if not args:
+            raise ValueError(
+                "extract_schedule(DistributedTrainStep) needs one "
+                "example batch")
+        batch_vals = [b._value if isinstance(b, Tensor)
+                      else jnp.asarray(b) for b in args]
+        if step._compiled is None:
+            step._build(batch_vals)
+        if not hasattr(step._compiled, "trace"):
+            raise TypeError(
+                "extract_schedule: AOT-restored DistributedTrainStep "
+                "is shape-frozen — extract before restore")
+        closed = step._compiled.trace(
+            *step._step_args(batch_vals)).jaxpr
+    elif hasattr(step, "trace") and hasattr(step, "lower"):
+        closed = step.trace(*args).jaxpr
+    elif hasattr(step, "eqns") or hasattr(step, "jaxpr"):
+        closed = step
+    else:
+        raise TypeError(
+            f"extract_schedule: unsupported subject "
+            f"{type(step).__name__} — expected jit.TrainStep, a "
+            "jax.jit-wrapped callable, or a jaxpr")
+    return collectives_of_jaxpr(closed)
+
+
+def schedule_diff(a, b, label_a="a", label_b="b"):
+    """Human-readable divergences between two schedules: the first
+    op-stream mismatch plus per-axis byte deltas. Empty = identical
+    (the rank-invariance pass passes)."""
+    out = []
+    ka, kb = a.keys(), b.keys()
+    for i, (x, y) in enumerate(zip(ka, kb)):
+        if x != y:
+            out.append(f"op[{i}]: {label_a}={x} vs {label_b}={y}")
+            break
+    if len(ka) != len(kb):
+        out.append(f"length: {label_a}={len(ka)} vs "
+                   f"{label_b}={len(kb)} collectives")
+    ba, bb = a.per_axis_bytes, b.per_axis_bytes
+    for ax in sorted(set(ba) | set(bb)):
+        if ba.get(ax, 0) != bb.get(ax, 0):
+            out.append(f"axis '{ax}': {label_a}={ba.get(ax, 0)} vs "
+                       f"{label_b}={bb.get(ax, 0)} bytes")
+    return out
+
+
+def rank_divergence(schedules):
+    """PTL603 findings from rank-parameterized schedules
+    (`{rank: CollectiveSchedule}` — trace the same builder once per
+    rank). Any divergence is the deadlock class: one rank compiles a
+    collective its peers don't."""
+    findings = []
+    ranks = sorted(schedules)
+    if len(ranks) < 2:
+        return findings
+    base = schedules[ranks[0]]
+    for r in ranks[1:]:
+        diff = schedule_diff(base, schedules[r],
+                             f"rank{ranks[0]}", f"rank{r}")
+        if diff:
+            findings.append(Finding(
+                rule="PTL603", name=SPMD_RULES["PTL603"],
+                path="<rank-traces>", line=0, col=0,
+                message=("collective schedule diverges across ranks "
+                         f"({'; '.join(diff[:3])}) — at a multi-host "
+                         "run this wedges the pod at the first "
+                         "mismatched collective"),
+                func=f"rank{r}"))
+    return findings
+
+
+# ------------------------------------------------------------ placement
+
+def check_placement(step):
+    """PTL602: declared `_pspec` vs the LIVE sharding of each
+    parameter. Drift means a host path re-placed a buffer (the PR-6
+    LocalSGD bug class): the next dispatch reshards silently or
+    compiles a second executable."""
+    params = getattr(step, "_param_objs", None)
+    if params is None:
+        raise TypeError(
+            "check_placement expects a built jit.TrainStep-family "
+            "step (needs its parameter objects)")
+    from ..distributed.parallel_step import sharding_of
+
+    findings = []
+    for i, p in enumerate(params):
+        spec = getattr(p, "_pspec", None)
+        val = getattr(p, "_value", None)
+        if spec is None or val is None or \
+                not hasattr(val, "sharding"):
+            continue
+        try:
+            expected = sharding_of(val, spec)
+            actual = val.sharding
+            same = actual.is_equivalent_to(expected, val.ndim)
+        except Exception:      # degenerate mesh / non-addressable
+            continue
+        if not same:
+            name = getattr(p, "name", "") or f"param{i}"
+            findings.append(Finding(
+                rule="PTL602", name=SPMD_RULES["PTL602"],
+                path="<placement>", line=0, col=0,
+                message=(f"parameter '{name}' declares PSpec "
+                         f"{spec} but its live value is placed as "
+                         f"{actual} — a host path re-placed it "
+                         "(the LocalSGD drift class); the next "
+                         "dispatch pays a silent reshard or a second "
+                         "executable"),
+                func=name))
+    return findings
+
+
+# -------------------------------------------------------------- surface
+
+def spmd_report(step, *batch):
+    """One-call SPMD report (bench / CLI surface): schedule dump +
+    placement check + all jaxpr-level findings."""
+    sched = extract_schedule(step, *batch)
+    findings = list(sched.findings)
+    try:
+        findings.extend(check_placement(step))
+    except TypeError:
+        pass                   # raw jitfn/jaxpr: no parameters to check
+    d = sched.as_dict()
+    d["findings"] = [f.as_dict() for f in findings]
+    d["num_findings"] = len(findings)
+    return d
+
+
+def reference_report():
+    """`ptlint --spmd`'s subject: the tier-1-size GPT over the
+    dp2.tp2.pp2 hybrid mesh — the same geometry the golden-schedule
+    test pins. Needs 8 devices (the CLI forces 8 virtual CPU devices
+    before importing jax)."""
+    import paddle_tpu as paddle
+    from ..distributed import hybrid3d, mesh as mesh_mod
+    from ..text.models.gpt import GPTConfig
+
+    gpt_cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
+                        num_heads=4, max_seq_len=32)
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+    mesh_mod.reset_mesh()
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    try:
+        paddle.seed(0)
+        model = hybrid3d.build_gpt3d(gpt_cfg, cfg3d)
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        step = hybrid3d.HybridTrainStep(
+            model, lambda mm, i: mm.loss(i), opt, config=cfg3d)
+        ids = np.random.default_rng(1).integers(0, 256, (8, 16))
+        rep = spmd_report(step, ids)
+        rep["config"] = cfg3d.describe()
+        return rep
+    finally:
+        mesh_mod.reset_mesh()

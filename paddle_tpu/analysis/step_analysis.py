@@ -74,6 +74,10 @@ class StepReport:
     # ((shape, dtype, weak_type), ...) per flat input — diffable
     signature: tuple
     findings: list
+    # collective summary from the same trace (spmd_analysis walk):
+    # {"n_collectives", "executions", "per_axis_bytes",
+    # "per_axis_counts"} — {} when the program has no collectives
+    collectives: dict = dataclasses.field(default_factory=dict)
 
     def ok(self):
         return not self.findings
@@ -303,11 +307,25 @@ def analyze_jit(jitfn, args, donate_argnums=(), kind="jit", names=None,
           f"host callbacks inside the step body ({dict(host_calls)}) "
           "— each is a per-step device-host round trip")
 
+    # collective schedule off the SAME trace (no second lowering):
+    # summary stats ride the report, and a rank-conditioned collective
+    # (PTL604) found during the walk is a finding like any other
+    from .spmd_analysis import collectives_of_jaxpr
+
+    sched = collectives_of_jaxpr(closed)
+    findings.extend(sched.findings)
+    collectives = {}
+    if sched.ops:
+        collectives = {"n_collectives": len(sched.ops),
+                       "executions": sum(c.count for c in sched.ops),
+                       "per_axis_bytes": sched.per_axis_bytes,
+                       "per_axis_counts": sched.per_axis_counts}
+
     return StepReport(kind=kind, donation=donation,
                       conversions=conversions, promotions=promotions,
                       host_calls=dict(host_calls),
                       weak_type_args=weak, signature=sig,
-                      findings=findings)
+                      findings=findings, collectives=collectives)
 
 
 def _analyze_trainstep(step, batch, check_donation):
@@ -334,6 +352,33 @@ def _analyze_trainstep(step, batch, check_donation):
     return analyze_jit(step._compiled, step._step_args(batch_vals),
                        donate_argnums=step._donate_argnums,
                        kind="TrainStep", names=step._STEP_ARG_NAMES,
+                       check_donation=check_donation)
+
+
+def _analyze_dist_trainstep(step, batch, check_donation):
+    from ..tensor_core import Tensor
+
+    if not batch:
+        raise ValueError(
+            "analyze_step(DistributedTrainStep) needs one example "
+            "batch: analyze_step(step, x, y)")
+    batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+    if step._compiled is None:
+        step._build(batch_vals)
+    if not hasattr(step._compiled, "trace"):
+        raise TypeError(
+            "analyze_step: this DistributedTrainStep was checkpoint-"
+            "restored onto an AOT executable (shape-frozen, compiled "
+            "outside the persistent cache) — analyze it before "
+            "restore, or rebuild")
+    # the step's OWN layout helpers (parallel_step._step_args /
+    # _donate_argnums / _STEP_ARG_NAMES) — one definition shared with
+    # __call__, so probe-vs-runtime drift can't defeat the guard
+    return analyze_jit(step._compiled, step._step_args(batch_vals),
+                       donate_argnums=step._donate_argnums,
+                       kind="DistributedTrainStep",
+                       names=step._STEP_ARG_NAMES,
                        check_donation=check_donation)
 
 
@@ -481,7 +526,8 @@ def _analyze_engine(engine, check_donation, which="paged"):
 def analyze_step(step, *batch, check_donation=True, which="paged"):
     """Analyze a live step object. Dispatches on type:
 
-    * `jit.TrainStep` — pass one example batch:
+    * `jit.TrainStep` (incl. `HybridTrainStep`) or
+      `distributed.DistributedTrainStep` — pass one example batch:
       `analyze_step(step, x, y)`
     * `inference.LLMEngine` / `LLMServer` — no batch needed (the
       compiled decode step has fixed geometry). `which="fused"`
@@ -505,9 +551,12 @@ def analyze_step(step, *batch, check_donation=True, which="paged"):
     except Exception:           # pragma: no cover - circular-import guard
         LLMEngine = LLMServer = ()
     from ..jit import TrainStep
+    from ..distributed.parallel_step import DistributedTrainStep
 
     if isinstance(step, TrainStep):
         return _analyze_trainstep(step, batch, check_donation)
+    if isinstance(step, DistributedTrainStep):
+        return _analyze_dist_trainstep(step, batch, check_donation)
     if LLMServer and isinstance(step, LLMServer):
         return _analyze_engine(step.engine, check_donation, which=which)
     if LLMEngine and isinstance(step, LLMEngine):

@@ -217,7 +217,7 @@ class DistributedTrainStep:
             step,
             in_shardings=(t_sh, f_sh, s_sh, None, b_sh, None, None),
             out_shardings=(NamedSharding(mesh, P()), t_sh, s_sh, f_sh),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=self._donate_argnums,
         )
         if restored:
             # checkpoint-restored before the first step: AOT-compile
@@ -253,11 +253,17 @@ class DistributedTrainStep:
         else:
             self._compiled = jitted
 
-    def __call__(self, *batch):
-        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                      for b in batch]
-        if self._compiled is None:
-            self._build(batch_vals)
+    # ONE layout definition, shared by __call__ and the analysis
+    # probes (analyze_step / extract_schedule) — probe-vs-runtime
+    # drift would silently defeat the donation/schedule guards (the
+    # same single-source rule jit.TrainStep._step_args follows)
+    _STEP_ARG_NAMES = ("train_vals", "frozen_vals", "opt_state", "lr",
+                       "batch", "step_idx", "base_key")
+    _donate_argnums = (0, 1, 2)
+
+    def _step_args(self, batch_vals):
+        """Positional args of the compiled step for the CURRENT live
+        state; `batch_vals` may be arrays or ShapeDtypeStructs."""
         train_vals = [p._value for p, t in zip(self._param_objs,
                                                self._trainable) if t]
         frozen_vals = [p._value for p, t in zip(self._param_objs,
@@ -265,11 +271,18 @@ class DistributedTrainStep:
         # committed f32, not a weak python float — same reasoning as
         # jit.TrainStep (weak-vs-committed is a retrace hazard, and the
         # AOT restored path is shape-AND-dtype frozen)
-        lr = np.float32(self.optimizer.get_lr())
-        step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
+        return (train_vals, frozen_vals, self._opt_states,
+                np.float32(self.optimizer.get_lr()), list(batch_vals),
+                jnp.asarray(self.optimizer._step_count, jnp.uint32),
+                self._base_key)
+
+    def __call__(self, *batch):
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        if self._compiled is None:
+            self._build(batch_vals)
         loss, new_vals, self._opt_states, new_frozen = self._compiled(
-            train_vals, frozen_vals, self._opt_states, lr, batch_vals,
-            step_idx, self._base_key)
+            *self._step_args(batch_vals))
         it = iter(new_vals)
         it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):

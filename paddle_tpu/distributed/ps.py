@@ -736,7 +736,10 @@ class ShardedSparseTable:
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         self._pending_ids.append(ids)
         self._pending_grads.append(grads)
-        self._push_calls += 1
+        # single-writer: push() runs only on the training-loop thread;
+        # _local_lock guards the LOCAL table against the pull-serving
+        # io-pool, which never touches the push-side staleness counter
+        self._push_calls += 1  # ptlint: disable=PTL702
         if self._push_calls % self.staleness == 0:
             self.flush()
 

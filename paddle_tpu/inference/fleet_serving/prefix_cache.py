@@ -85,7 +85,7 @@ class _TrieNode:
         self.last_used = 0
 
 
-class RadixPrefixCache:
+class RadixPrefixCache:  # ptlint: thread-shared (scraped by /metrics)
     """Token-trie index over a `PagePool`'s resident KV pages (module
     docstring has the design). The cache owns one pool reference per
     indexed page; `match()` hands the caller one more per mapped page

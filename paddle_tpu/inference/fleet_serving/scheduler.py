@@ -90,7 +90,7 @@ class SLAPolicy:
         return self.default_ttft_slo_s if slo is None else slo
 
 
-class SLAScheduler:
+class SLAScheduler:  # ptlint: thread-shared (scraped by /metrics)
     """Waiting-queue policy for `LLMEngine` (module docstring). One
     deque per (priority, tenant); `pop_next` scans queue HEADS only, so
     a tick costs O(active priority-tenant pairs), not O(waiting)."""
@@ -140,9 +140,12 @@ class SLAScheduler:
         return self._n > 0
 
     def __iter__(self):
-        """Waiting requests in plain queue order (metrics/abort use)."""
-        for dq in self._q.values():
-            yield from dq
+        """Waiting requests in plain queue order (metrics/abort use).
+        list()/tuple() snapshots: a scrape-thread caller must not race
+        the engine thread's queue inserts (dict OR deque resize raises
+        RuntimeError mid-iteration)."""
+        for dq in list(self._q.values()):
+            yield from tuple(dq)
 
     # ---- enqueue side ----
 
@@ -173,7 +176,7 @@ class SLAScheduler:
     def drain(self):
         """Pop every waiting request (abort path)."""
         out = []
-        for dq in self._q.values():
+        for dq in list(self._q.values()):
             out.extend(dq)
         self._q.clear()
         self._n = 0
@@ -232,7 +235,7 @@ class SLAScheduler:
         Non-head members compete ONLY once escalated, so within-class
         order stays FIFO."""
         best_key, best_q, best_i = None, None, None
-        for key, dq in self._q.items():
+        for key, dq in list(self._q.items()):
             if not dq:
                 continue
             candidates = enumerate(dq) if self._any_slo else ((0, dq[0]),)

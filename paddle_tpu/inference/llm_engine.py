@@ -149,7 +149,7 @@ class PoolExhausted(RuntimeError):
     """No free KV pages (the scheduler preempts and retries on this)."""
 
 
-class PagePool:
+class PagePool:  # ptlint: thread-shared (scraped by /metrics)
     """Refcounted fixed-size KV-page allocator. Physical page 0 is
     reserved as the trash page (padding-token writes), so pages
     1..num_pages-1 are allocable. `alloc()` hands out a page at
@@ -573,7 +573,7 @@ class _Request:
         return np.asarray(self.tokens, np.int64)
 
 
-class LLMEngine:
+class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
     """Scheduler + paged-KV state around ONE compiled ragged decode step
     (module docstring has the design). Drive it directly —
 

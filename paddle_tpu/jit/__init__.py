@@ -709,6 +709,19 @@ class TrainStep:
             1.0 if out["donation"]["held"] else 0.0)
         return out
 
+    def collective_schedule(self, *batch):
+        """Ordered per-mesh-axis collective schedule of the compiled
+        step (analysis.spmd_analysis.extract_schedule): op kind, axes,
+        reduce op, payload bytes, execution count. The per-axis byte
+        totals are the measured baseline ROADMAP item 2's quantized
+        in-XLA all-reduce must beat; the tier-1 hybrid3d schedule is
+        pinned as a golden in tests. Pure trace inspection — nothing
+        executes, but like analyze_step it must run on the thread that
+        owns the step."""
+        from ..analysis.spmd_analysis import extract_schedule
+
+        return extract_schedule(self, *batch)
+
 
 class ProgramTranslator:
     """Global dy2static switch (reference:

@@ -14,7 +14,13 @@ mesh-aware placement the generic step cannot know about:
   (config.zero: 'os' / 'os_g' shard the moments, 'p_g_os' additionally
   shards the parameters — `parallel_step._zero_spec` placement policy,
   axis-parameterized);
-* the donation probe publishes `pt_step_donation_held{step="hybrid3d"}`.
+* the donation probe publishes `pt_step_donation_held{step="hybrid3d"}`;
+* `collective_schedule(*batch)` (inherited from TrainStep, backed by
+  `analysis.spmd_analysis`) emits the ordered per-mesh-axis collective
+  schedule of the compiled step — the tier-1 dp2.tp2.pp2 schedule is
+  pinned as a golden (tests/golden/hybrid3d_dp2tp2pp2_schedule.json),
+  and the per-axis payload bytes are the baseline ROADMAP item 2's
+  quantized all-reduce must beat (docs/ANALYSIS.md "SPMD passes").
 
 Strategy meta-optimizers compose for free: LARS/DGC run through the
 same `apply_gradients_tree` protocol inside the compiled step, so

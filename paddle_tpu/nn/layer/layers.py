@@ -256,7 +256,28 @@ class Layer:
                 continue
             layer, store, key = entries[name]
             target = getattr(layer, store)[key]
-            arr = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+            # COPY on ingest, both branches: loaded state must own its
+            # buffers. np.asarray(jax_cpu_array) and jnp.asarray(
+            # np_view) are both zero-copy on CPU, so a state_dict() ->
+            # .numpy() -> set_state_dict round-trip would hand two
+            # models ONE buffer — and a DONATING compiled step then
+            # updates the "independent" copy's params in place
+            # (root-caused as the dp-equivalence/zero2 divergence;
+            # docs/RESILIENCE.md "Buffer aliasing"). The direct
+            # Tensor->Tensor route shares the same hazard through the
+            # Array OBJECT. jnp.array(copy=True) preserves sharding
+            # AND commitment (verified), so jit signatures don't flip;
+            # numpy input copies at the host level as before.
+            if isinstance(value, Tensor):
+                arr = jnp.array(value._value, copy=True)
+            elif hasattr(value, "sharding"):
+                # raw jax.Array (the load_raw_state_dict route): the
+                # host-level np.array round-trip would collapse a
+                # sharded array to one device (the PTL602 drift class)
+                # — copy on-device instead, sharding/commitment kept
+                arr = jnp.array(value, copy=True)
+            else:
+                arr = jnp.asarray(np.array(value))
             if tuple(arr.shape) != tuple(target._value.shape):
                 raise ValueError(
                     f"shape mismatch for {name}: loaded {tuple(arr.shape)} vs "

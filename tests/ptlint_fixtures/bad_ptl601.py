@@ -1,0 +1,19 @@
+"""ptlint seeded violation: PTL601 concat-into-partial-shard-map-spec.
+
+The PR-6 hybrid-pp NaN shape: a jnp.concatenate result enters
+shard_map through a partial in_spec (an axis left unmentioned), so
+jax-0.4.37's spmd partitioner delivers it SUMMED over the unmentioned
+mesh axes. Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def shift_labels(mesh, lbl, per_stage):
+    lbl = jnp.concatenate(
+        [lbl[:, 1:], jnp.full_like(lbl[:, :1], -1)], axis=1)
+    run = jax.shard_map(per_stage, mesh=mesh,
+                        in_specs=(P(None, None, "sp"),),
+                        out_specs=P("sp", "pp"), check_vma=False)
+    return run(lbl.reshape(4, 2, 16))  # FLAG

@@ -1,0 +1,18 @@
+"""ptlint seeded violation: PTL701 shared-dict-iter.
+
+The PR-7 scrape race: a thread-shared class iterating one of its
+shared dicts without a list() snapshot — a concurrent insert from the
+engine thread raises RuntimeError mid-iteration. Never executed —
+linted only.
+"""
+
+
+class EngineStats:  # ptlint: thread-shared (scraped by /metrics)
+    def __init__(self):
+        self.queues = {}
+
+    def add(self, key, req):
+        self.queues.setdefault(key, []).append(req)
+
+    def snapshot(self):
+        return {k: len(v) for k, v in self.queues.items()}  # FLAG

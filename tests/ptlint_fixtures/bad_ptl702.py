@@ -1,0 +1,20 @@
+"""ptlint seeded violation: PTL702 unlocked-rmw.
+
+A class that declares a lock but runs a read-modify-write of shared
+state outside it — a concurrent writer loses the update (the
+shared-counter race class). Never executed — linted only.
+"""
+import threading
+
+
+class HitCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def hit(self):
+        self.hits += 1  # FLAG
+
+    def reset(self):
+        with self._lock:
+            self.hits = 0

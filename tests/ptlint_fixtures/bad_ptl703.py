@@ -1,0 +1,19 @@
+"""ptlint seeded violation: PTL703 defaultdict-read-materializes.
+
+The PR-7 phantom-meter bug: a thread-shared class reads a defaultdict
+attribute with [] — the miss INSERTS a default entry, a mutation on
+the read path that races every concurrent snapshot. Never executed —
+linted only.
+"""
+import collections
+
+
+class FairMeters:  # ptlint: thread-shared (scraped by /metrics)
+    def __init__(self):
+        self._used = collections.defaultdict(float)
+
+    def charge(self, tenant, n):
+        self._used[tenant] += n
+
+    def order_key(self, req):
+        return (req.priority, self._used[req.tenant])  # FLAG

@@ -5,6 +5,8 @@ tests/test_analysis.py).
 Each block mirrors one bad_ptl*.py fixture with the idiomatic fix.
 Never executed — linted only.
 """
+import collections
+import threading
 import time
 
 import numpy as np
@@ -12,6 +14,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.distributed import xproc
 
@@ -79,3 +82,65 @@ def sync_all(rank, grads):
     # rank-dependent part is data, not control flow
     contribution = grads if rank == 0 else np.zeros_like(grads)
     return xproc.all_reduce_np(contribution)
+
+
+def _reduce_helper(grads):
+    # reaches a collective — legal when called UNCONDITIONALLY
+    return xproc.all_reduce_np(grads)
+
+
+def _host_log(rank, msg):
+    return f"[{rank}] {msg}"
+
+
+def sync_interprocedural(rank, grads):
+    # PTL401 interprocedural FP fence: the collective-reaching helper
+    # runs on EVERY rank; only host-side logging is rank-gated
+    out = _reduce_helper(grads)
+    if rank == 0:
+        _host_log(rank, "reduced")
+    return out
+
+
+def shift_labels_safe(mesh, lbl, per_stage):
+    # PTL601: jnp.pad is the pinned-safe rewrite
+    # (test_label_shift_survives_partial_shard_spec) — and a
+    # concatenate entering through a FULL spec partitions correctly
+    lbl = jnp.pad(lbl[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+    run = jax.shard_map(per_stage, mesh=mesh,
+                        in_specs=(P(None, None, "sp"),),
+                        out_specs=P("sp", "pp"), check_vma=False)
+    padded = run(lbl.reshape(4, 2, 16))
+    glue = jnp.concatenate([padded, padded], axis=0)
+    full = jax.shard_map(per_stage, mesh=mesh,
+                         in_specs=(P("sp", "pp"),),
+                         out_specs=P("sp", "pp"), check_vma=False)
+    return full(glue)
+
+
+class ScrapeSafeStats:  # ptlint: thread-shared (scraped by /metrics)
+    # PTL701/703: snapshot iteration through list()/sorted(), reads
+    # through .get — the engine thread owns the writes
+    def __init__(self):
+        self.queues = {}
+        self._used = collections.defaultdict(float)
+
+    def charge(self, tenant, n):
+        self._used[tenant] += n
+
+    def snapshot(self):
+        depths = {k: len(v) for k, v in list(self.queues.items())}
+        top = sorted(self._used.items(), key=lambda kv: kv[1])[:8]
+        return {"depths": depths, "top": top,
+                "one": self._used.get("tenant0", 0.0)}
+
+
+class LockedCounter:
+    # PTL702: every read-modify-write holds the declared lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def hit(self):
+        with self._lock:
+            self.hits += 1

@@ -142,7 +142,12 @@ def test_lint_paths_select_and_ignore():
         "PTL101", "PTL102", "PTL103", "PTL104", "PTL105"}
     res = lint_paths([FIXTURES], ignore=["PTL1*", "int8-dot-no-preferred"])
     assert {f.rule for f in res["findings"]} == {
-        "PTL201", "PTL202", "PTL203", "PTL204", "PTL401"}
+        "PTL201", "PTL202", "PTL203", "PTL204", "PTL401",
+        "PTL601", "PTL701", "PTL702", "PTL703"}
+    # the ISSUE-11 families select as units (sharding / host-race)
+    res = lint_paths([FIXTURES], select=["PTL7*"])
+    assert {f.rule for f in res["findings"]} == {
+        "PTL701", "PTL702", "PTL703"}
 
 
 def test_ptlint_cli_json_exit_codes():
@@ -174,6 +179,179 @@ def test_ptlint_self_check_shipped_tree_is_clean():
     assert res["files"] > 200, "gate lost its tree?"
     assert res["findings"] == [], \
         "\n".join(f.format() for f in res["findings"])
+
+
+# --------------------------------------------------------------------
+# ISSUE-11 rule semantics: interprocedural PTL401 + the PTL7xx fence
+# --------------------------------------------------------------------
+
+def test_ptl401_interprocedural_any_call_depth():
+    """A collective reached THROUGH helpers (any call depth in the
+    module) under a rank-conditioned branch is the same deadlock as a
+    direct call; unconditional helper calls stay clean."""
+    src = (
+        "from paddle_tpu.distributed import xproc\n"
+        "def _reduce(g):\n"
+        "    return xproc.all_reduce_np(g)\n"
+        "def _sync(g):\n"
+        "    return _reduce(g)\n"              # depth 2
+        "def step(rank, g):\n"
+        "    if rank == 0:\n"
+        "        g = _sync(g)\n"
+        "    return g\n")
+    findings, _ = lint_source(src, "s.py")
+    assert [f.rule for f in findings] == ["PTL401"], findings
+    assert "call chain" in findings[0].message
+    clean = src.replace("    if rank == 0:\n        g = _sync(g)\n",
+                        "    g = _sync(g)\n    if rank == 0:\n"
+                        "        g = g * 2\n")
+    findings, _ = lint_source(clean, "s.py")
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_ptl601_taint_is_flow_sensitive_and_pad_launders():
+    """A clean reassignment clears the concat taint, and jnp.pad — the
+    documented fix idiom — LAUNDERS it; the flag survives shape ops
+    like reshape."""
+    base = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f(mesh, x, blk):\n"
+        "    x = jnp.concatenate([x, x], axis=1)\n"
+        "{mid}"
+        "    run = jax.shard_map(blk, mesh=mesh,\n"
+        "                        in_specs=(P(None, 'sp'),),\n"
+        "                        out_specs=P('sp'), check_vma=False)\n"
+        "    return run(x)\n")
+    hot, _ = lint_source(base.format(mid="    x = x.reshape(4, -1)\n"),
+                         "s.py")
+    assert [f.rule for f in hot] == ["PTL601"], hot
+    for mid in ("    x = jnp.zeros((4, 8))\n",
+                "    x = jnp.pad(x[:, 1:], ((0, 0), (0, 1)))\n"):
+        cold, _ = lint_source(base.format(mid=mid), "s.py")
+        assert cold == [], (mid, [f.format() for f in cold])
+
+
+def test_ptl401_interprocedural_scoping_precision():
+    """Only plain-name and direct self/cls method calls inherit
+    reachability — an unrelated object's same-named method under a
+    rank branch must NOT flag; and two defs sharing a name UNION
+    their call edges (no definition-order dependence)."""
+    src = (
+        "from paddle_tpu.distributed import xproc\n"
+        "class Sync:\n"
+        "    def flush(self):\n"
+        "        return xproc.barrier()\n"
+        "def step(rank, log_file):\n"
+        "    if rank == 0:\n"
+        "        log_file.flush()\n"       # unrelated object: clean
+        "    return rank\n")
+    findings, _ = lint_source(src, "s.py")
+    assert findings == [], [f.format() for f in findings]
+    # direct self-method call DOES flag ...
+    hot = src.replace("        log_file.flush()\n",
+                      "        self.flush()\n")
+    findings, _ = lint_source(hot, "s.py")
+    assert [f.rule for f in findings] == ["PTL401"]
+    # ... and name-sharing defs union: the collective-reaching edge
+    # survives a later same-named collective-free def
+    dual = (
+        "from paddle_tpu.distributed import xproc\n"
+        "def helper(g):\n"
+        "    return xproc.all_reduce_np(g)\n"
+        "class Other:\n"
+        "    def helper(self, g):\n"
+        "        return g\n"
+        "def step(rank, g):\n"
+        "    if rank == 0:\n"
+        "        g = helper(g)\n"
+        "    return g\n")
+    findings, _ = lint_source(dual, "s.py")
+    assert [f.rule for f in findings] == ["PTL401"]
+
+
+def test_ptl7xx_annotated_attrs_and_ptl601_kwargs():
+    """AnnAssign attribute declarations keep the race fence armed,
+    and a concat value passed to a partial-spec shard_map by KEYWORD
+    still flags."""
+    ann = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock: threading.Lock = threading.Lock()\n"
+        "        self.q: dict = {}\n"
+        "    def scan(self):\n"
+        "        return [k for k in self.q.items()]\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n")
+    findings, _ = lint_source(ann, "s.py")
+    assert sorted(f.rule for f in findings) == ["PTL701", "PTL702"], \
+        findings
+    kwarg = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f(mesh, x, blk):\n"
+        "    x = jnp.concatenate([x, x], axis=1)\n"
+        "    run = jax.shard_map(blk, mesh=mesh,\n"
+        "                        in_specs=(P(None, 'sp'),),\n"
+        "                        out_specs=P('sp'), check_vma=False)\n"
+        "    return run(xs=x)\n")
+    findings, _ = lint_source(kwarg, "s.py")
+    assert [f.rule for f in findings] == ["PTL601"], findings
+    assert "keyword" in findings[0].message
+
+
+def test_ptl701_lazy_wrappers_and_lock_scope():
+    """enumerate()/zip() over a shared dict view are still lazy (the
+    race survives the wrapper); iteration under the declared lock, or
+    through a list()/sorted() snapshot, is clean; __init__ is exempt
+    (no concurrency during construction)."""
+    base = (
+        "import threading\n"
+        "class S:  # ptlint: thread-shared\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.q = {}\n"
+        "        for k in self.q.values():\n"       # __init__: exempt
+        "            pass\n"
+        "    def scan(self):\n"
+        "        for i, v in enumerate(@IT@):\n"
+        "            pass\n")
+    hot, _ = lint_source(base.replace("@IT@", "self.q.values()"),
+                         "s.py")
+    assert [f.rule for f in hot] == ["PTL701"]
+    cold, _ = lint_source(
+        base.replace("@IT@", "list(self.q.values())"), "s.py")
+    assert cold == [], [f.format() for f in cold]
+    locked = base.replace(
+        "        for i, v in enumerate(@IT@):\n            pass\n",
+        "        with self._lock:\n"
+        "            for i, v in enumerate(@IT@):\n"
+        "                pass\n")
+    ok, _ = lint_source(locked.replace("@IT@", "self.q.values()"),
+                        "s.py")
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_ptl7xx_suppression_and_unmarked_class():
+    """The PTL7xx family honors line suppressions, and an UNMARKED
+    lock-free class is out of scope — the fence is the declared
+    contract, not a tree-wide dict ban."""
+    marked = (
+        "class S:  # ptlint: thread-shared\n"
+        "    def __init__(self):\n"
+        "        self.q = {}\n"
+        "    def scan(self):\n"
+        "        return [k for k in self.q.items()]"
+        "  # ptlint: disable=PTL701\n")
+    findings, suppressed = lint_source(marked, "s.py")
+    assert findings == [] and suppressed == 1
+    unmarked = marked.replace("  # ptlint: thread-shared", "") \
+                     .replace("  # ptlint: disable=PTL701", "")
+    findings, suppressed = lint_source(unmarked, "s.py")
+    assert findings == [] and suppressed == 0
 
 
 # --------------------------------------------------------------------

@@ -1,0 +1,290 @@
+"""SPMD safety analyzer (analysis/spmd_analysis.py + the PTL6xx AST
+rules' jaxpr-level siblings + tools/ptlint.py --spmd).
+
+The ISSUE-11 acceptance suite:
+
+* the tier-1 dp2.tp2.pp2 hybrid3d collective schedule matches the
+  checked-in GOLDEN (tests/golden/hybrid3d_dp2tp2pp2_schedule.json) —
+  an accidental extra all-gather (or a payload-bytes change) fails CI
+  here, and the per-axis byte totals are the measured baseline ROADMAP
+  item 2's quantized all-reduce must beat;
+* the schedule is IDENTICAL across rank-parameterized traces of the
+  same step (rank divergence = the PR-4 deadlock class, PTL603), and a
+  seeded rank-divergent builder IS caught;
+* a collective under an `axis_index`-derived `lax.cond` over the SAME
+  axis is caught (PTL604), while a predicate over a different axis
+  (the shipped 1F1B head-stage loss) and identical-branch collectives
+  stay silent — the false-positive fence;
+* declared `_pspec` vs live placement drift is caught (PTL602, the
+  PR-6 LocalSGD class) and the shipped hybrid step holds zero;
+* scan trip multipliers and payload-bytes accounting are exact on a
+  purpose-built program;
+* `analyze_step` carries the collectives summary off the same trace;
+* the `ptlint --spmd` CLI gate exits 0 with a machine-readable
+  schedule dump on the shipped tree (slow: subprocess + jax import).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import (
+    analyze_step, check_placement, extract_schedule, rank_divergence,
+    schedule_diff)
+from paddle_tpu.distributed import hybrid3d, mesh as mesh_mod
+from paddle_tpu.text.models.gpt import GPTConfig
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden",
+                      "hybrid3d_dp2tp2pp2_schedule.json")
+
+CFG = GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
+                num_heads=4, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh():
+    mesh_mod.reset_mesh()
+    yield
+    mesh_mod.reset_mesh()
+
+
+def _hybrid_step():
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+    mesh_mod.reset_mesh()
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    paddle.seed(0)
+    m = hybrid3d.build_gpt3d(CFG, cfg3d)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = hybrid3d.HybridTrainStep(m, lambda mm, i: mm.loss(i), opt,
+                                    config=cfg3d)
+    ids = np.random.default_rng(1).integers(0, 256, (8, 16))
+    return step, ids
+
+
+# --------------------------------------------------------------------
+# the golden schedule + rank invariance on the tier-1 3D step
+# --------------------------------------------------------------------
+
+def test_golden_hybrid3d_schedule_and_rank_invariance(monkeypatch):
+    """THE tentpole gate: the dp2.tp2.pp2 step's collective schedule
+    — op kinds, axes, reduce ops, payload bytes, trip counts — equals
+    the checked-in golden, holds zero jaxpr-level findings, and is
+    identical when the step is rebuilt under a different host rank."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+
+    step, ids = _hybrid_step()
+    sched = step.collective_schedule(ids)
+
+    got_keys = [[c.op, list(c.axes), c.reduce, c.bytes, c.count]
+                for c in sched.ops]
+    assert got_keys == golden["keys"], (
+        "hybrid3d collective schedule drifted from the golden — if "
+        "the change is intentional, regenerate "
+        "tests/golden/hybrid3d_dp2tp2pp2_schedule.json and justify "
+        "the new per-axis bytes in docs/PERF_NOTES.md")
+    assert sched.per_axis_bytes == {
+        k: int(v) for k, v in golden["per_axis_bytes"].items()}
+    assert sched.per_axis_counts == {
+        k: int(v) for k, v in golden["per_axis_counts"].items()}
+    assert sched.findings == [], \
+        [f.format() for f in sched.findings]
+    # the gradient psum baseline ROADMAP item 2 quantizes against
+    assert sched.per_axis_bytes["dp"] > 0
+
+    # rank invariance: the SAME builder traced under a different host
+    # rank must compile the SAME schedule (divergence wedges a pod)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    step_r1, _ = _hybrid_step()
+    sched_r1 = step_r1.collective_schedule(ids)
+    assert sched.identical(sched_r1), \
+        schedule_diff(sched, sched_r1, "rank0", "rank1")
+    assert rank_divergence({0: sched, 1: sched_r1}) == []
+
+    # placement: every _pspec-annotated param is live where it
+    # declares (PTL602 holds zero on the shipped step) ...
+    assert check_placement(step_r1) == []
+    # ... and a seeded drift — a host path re-placing a sharded param
+    # replicated (the LocalSGD bug class) — is caught
+    mesh = mesh_mod.global_mesh()
+    drifted = None
+    for p in step_r1._param_objs:
+        spec = getattr(p, "_pspec", None)
+        if spec is not None and any(s is not None for s in spec):
+            drifted = p
+            break
+    assert drifted is not None, "no sharded param to drift?"
+    drifted._value = jax.device_put(drifted._value,
+                                    NamedSharding(mesh, P()))
+    findings = check_placement(step_r1)
+    assert [f.rule for f in findings] == ["PTL602"], findings
+    assert "re-placed" in findings[0].message
+
+
+def test_analyze_step_carries_collectives_summary():
+    """analyze_step wiring: the hybrid step's report includes the
+    collective summary from the SAME trace (no second lowering), and
+    stays finding-free — the 1F1B head-stage cond (predicate over
+    'pp', loss collectives over 'mp') must NOT read as PTL604."""
+    step, ids = _hybrid_step()
+    rep = analyze_step(step, ids)
+    assert rep.ok(), [f.format() for f in rep.findings]
+    assert rep.collectives["n_collectives"] > 0
+    assert set(rep.collectives["per_axis_bytes"]) == {"dp", "mp", "pp"}
+    # a collective-free program reports an empty summary
+    plain = jax.jit(lambda x: x * 2.0)
+    from paddle_tpu.analysis import analyze_jit
+
+    rep2 = analyze_jit(plain, (jnp.zeros((4,), jnp.float32),))
+    assert rep2.collectives == {}
+
+
+# --------------------------------------------------------------------
+# extraction semantics on purpose-built programs
+# --------------------------------------------------------------------
+
+def test_scan_multiplier_and_payload_bytes():
+    """A ppermute inside a length-5 scan counts 5 executions; payload
+    bytes are the per-shard aval (shape x itemsize)."""
+    mesh_mod.init_mesh(pp=8)
+    mesh = mesh_mod.global_mesh()
+
+    def body(x):
+        def tick(carry, _):
+            carry = lax.ppermute(
+                carry, "pp", [(i, (i + 1) % 8) for i in range(8)])
+            return carry, ()
+
+        out, _ = lax.scan(tick, x, jnp.arange(5))
+        return lax.psum(out, "pp")
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                               out_specs=P(), check_vma=False))
+    sched = extract_schedule(fn, jnp.zeros((4, 8), jnp.float32))
+    by_op = {c.op: c for c in sched.ops}
+    assert by_op["ppermute"].count == 5
+    assert by_op["ppermute"].bytes == 4 * 8 * 4   # f32 [4, 8]
+    assert "scan[5]" in by_op["ppermute"].context
+    assert by_op["psum"].count == 1
+    assert by_op["psum"].reduce == "add" and \
+        by_op["ppermute"].reduce is None
+    assert sched.per_axis_bytes == {"pp": 5 * 128 + 128}
+    assert sched.findings == []
+
+
+def test_rank_conditioned_collective_caught_and_fenced():
+    """PTL604: a psum over 'dp' under a cond whose predicate derives
+    from axis_index('dp') diverges within the psum's own group —
+    caught. Identical collectives in BOTH branches, and predicates
+    over a DIFFERENT axis, stay silent."""
+    mesh_mod.init_mesh(dp=8)
+    mesh = mesh_mod.global_mesh()
+
+    def divergent(x):
+        r = lax.axis_index("dp")
+        return lax.cond(r == 0,
+                        lambda v: lax.psum(v, "dp"),
+                        lambda v: v * 1.0, x)
+
+    fn = jax.jit(jax.shard_map(divergent, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp"), check_vma=False))
+    sched = extract_schedule(fn, jnp.zeros((8, 4), jnp.float32))
+    assert [f.rule for f in sched.findings] == ["PTL604"]
+    assert "deadlock" in sched.findings[0].message
+
+    def symmetric(x):
+        r = lax.axis_index("dp")
+        return lax.cond(r == 0,
+                        lambda v: lax.psum(v, "dp") * 2.0,
+                        lambda v: lax.psum(v, "dp"), x)
+
+    fn2 = jax.jit(jax.shard_map(symmetric, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))
+    assert extract_schedule(
+        fn2, jnp.zeros((8, 4), jnp.float32)).findings == []
+
+
+def test_rank_divergent_builder_caught():
+    """PTL603: the same step builder traced at rank 0 vs rank 1
+    compiling DIFFERENT collective streams is the PR-4 deadlock class,
+    caught at trace time."""
+    mesh_mod.init_mesh(dp=8)
+    mesh = mesh_mod.global_mesh()
+
+    def build(rank):
+        def body(x):
+            # host-rank control flow baked into the TRACE — the bug
+            return lax.psum(x, "dp") if rank == 0 else x * 1.0
+
+        return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                                     out_specs=P("dp"),
+                                     check_vma=False))
+
+    x = jnp.zeros((8, 4), jnp.float32)
+    scheds = {r: extract_schedule(build(r), x) for r in (0, 1)}
+    findings = rank_divergence(scheds)
+    assert [f.rule for f in findings] == ["PTL603"]
+    assert "wedges the pod" in findings[0].message
+    diff = schedule_diff(scheds[0], scheds[1], "rank0", "rank1")
+    assert any("dp" in d for d in diff), diff
+    # invariant builders pass
+    same = {r: extract_schedule(build(0), x) for r in (0, 1)}
+    assert rank_divergence(same) == []
+
+
+# --------------------------------------------------------------------
+# CLI gate
+# --------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ptlint_spmd_cli_json_gate():
+    """`ptlint --spmd --json` runs the jaxpr passes in a fresh
+    interpreter (8 virtual CPU devices staged before jax imports) and
+    exits 0 with the machine-readable schedule dump on the shipped
+    tree."""
+    cli = os.path.join(REPO, "tools", "ptlint.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    proc = subprocess.run(
+        [sys.executable, cli, "--spmd", "--json"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["num_findings"] == 0
+    assert out["n_collectives"] > 0
+    assert set(out["per_axis_bytes"]) == {"dp", "mp", "pp"}
+    assert out["config"]["mesh_shape"] == {"dp": 2, "tp": 2, "pp": 2}
+    assert all({"op", "axes", "reduce", "bytes", "count",
+                "context"} <= set(op) for op in out["ops"])
+
+
+def test_rank_taint_crosses_subjaxpr_boundaries():
+    """PTL604 soundness: an axis_index computed INSIDE a jit/pjit
+    sub-jaxpr still taints the outer cond predicate — the deadlock
+    shape must not hide behind a call boundary."""
+    mesh_mod.init_mesh(dp=8)
+    mesh = mesh_mod.global_mesh()
+
+    def body(x):
+        r = jax.jit(lambda: lax.axis_index("dp"))()
+        return lax.cond(r == 0,
+                        lambda v: lax.psum(v, "dp"),
+                        lambda v: v * 1.0, x)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp"), check_vma=False))
+    sched = extract_schedule(fn, jnp.zeros((8, 4), jnp.float32))
+    assert [f.rule for f in sched.findings] == ["PTL604"], \
+        [f.format() for f in sched.findings]

@@ -10,6 +10,13 @@ nonzero on findings, so CI can gate on it:
     python tools/ptlint.py --select 'PTL1*'     # only the trace rules
     python tools/ptlint.py --list-rules         # catalogue + the real
                                                 # bug each rule caught
+    python tools/ptlint.py --spmd               # jaxpr-level SPMD gate:
+                                                # collective schedule +
+                                                # placement of the tier-1
+                                                # dp2.tp2.pp2 step (needs
+                                                # jax — NOT the fast path)
+    python tools/ptlint.py --spmd --json        # machine-readable
+                                                # schedule dump
 
 Suppressions: `# ptlint: disable=PTL101` (ids or slugs, comma-
 separated, `all`) on the offending line or the enclosing `def` line;
@@ -44,6 +51,42 @@ def _load_lint():
 DEFAULT_PATHS = ("paddle_tpu", "tools", "bench.py", "examples")
 
 
+def _spmd_main(args):
+    """The jaxpr-level gate: collective-schedule extraction + the
+    placement/rank checks on the tier-1 dp2.tp2.pp2 reference step.
+    Env must be staged BEFORE jax imports: the reference mesh needs 8
+    devices (virtual CPU devices unless the caller pre-set a real
+    backend)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    sys.path.insert(0, _REPO)
+    try:
+        from paddle_tpu.analysis.spmd_analysis import reference_report
+    except Exception as e:
+        print(f"ptlint --spmd: cannot import the analyzer: {e!r}",
+              file=sys.stderr)
+        return 2
+    rep = reference_report()
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        axes = rep["per_axis_bytes"]
+        print(f"spmd {rep['version']}: {rep['n_collectives']} "
+              f"collectives / {rep['executions']} executions on "
+              f"{'.'.join(f'{k}{v}' for k, v in rep['config']['mesh_shape'].items())}")
+        for ax, b in sorted(axes.items()):
+            print(f"  axis {ax}: {b} bytes/step "
+                  f"({rep['per_axis_counts'][ax]} executions)")
+        for f in rep["findings"]:
+            print(f"  {f['rule']} {f['name']}: {f['message']}")
+        print(f"spmd: {rep['num_findings']} finding(s)")
+    return 1 if rep["num_findings"] else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="ptlint", description=__doc__,
@@ -62,7 +105,15 @@ def main(argv=None):
                     help="print the rule catalogue and exit")
     ap.add_argument("--version", action="store_true",
                     help="print ptlint version and exit")
+    ap.add_argument("--spmd", action="store_true",
+                    help="run the jaxpr-level SPMD passes (collective "
+                         "schedule + placement) on the tier-1 "
+                         "dp2.tp2.pp2 reference step — imports jax, "
+                         "so it is NOT part of the ~4 s AST gate")
     args = ap.parse_args(argv)
+
+    if args.spmd:
+        return _spmd_main(args)
 
     try:
         lint = _load_lint()
