@@ -1060,9 +1060,11 @@ def bench_llm_serve_int8():
         return outs, [x for x in lat if x is not None], total, occ, \
             pool_bytes
 
-    # interleave int8/fp32 ×2 and score each side's best run — the same
-    # drifting-host-noise defense as llm_serve
-    q_runs, f_runs = [], []
+    # interleave int8/fp32 (and the ISSUE-12 int4-KV variant) ×2 and
+    # score each side's best run — the same drifting-host-noise
+    # defense as llm_serve. BENCH_INT4_KV=0 skips the third arm.
+    int4_kv = os.environ.get("BENCH_INT4_KV", "1") != "0"
+    q_runs, f_runs, i4_runs = [], [], []
     for rep in range(2):
         q = run("int8", qmodel)
         log(f"[bench] llm_serve_int8 int8[{rep}]: {q[2]:.2f}s, "
@@ -1072,6 +1074,11 @@ def bench_llm_serve_int8():
         log(f"[bench] llm_serve_int8 fp32[{rep}]: {f[2]:.2f}s, "
             f"occ {f[3]:.2f}, pool {f[4]/1e6:.1f} MB")
         f_runs.append(f)
+        if int4_kv:
+            i4 = run("int4", qmodel)
+            log(f"[bench] llm_serve_int8 int4[{rep}]: {i4[2]:.2f}s, "
+                f"occ {i4[3]:.2f}, pool {i4[4]/1e6:.1f} MB")
+            i4_runs.append(i4)
     q_out, q_lat, q_total, q_occ, q_bytes = min(q_runs,
                                                 key=lambda r: r[2])
     f_out, f_lat, f_total, f_occ, f_bytes = min(f_runs,
@@ -1097,7 +1104,7 @@ def bench_llm_serve_int8():
         f"{f_tps:,.0f} tok/s ({q_tps / f_tps:.2f}x), pool bytes "
         f"{q_bytes / f_bytes:.3f}x of fp32 / "
         f"{q_bytes / bf16_bytes:.3f}x of bf16, match {match_rate:.3f}")
-    return {
+    result = {
         "model": "gpt-small-llm-serve-int8",
         "int8_weights": int8_weights,
         "requests": n_req, "gen_tokens": gen_tokens,
@@ -1116,6 +1123,43 @@ def bench_llm_serve_int8():
         "totals_s": {"int8": [round(r[2], 2) for r in q_runs],
                      "fp32": [round(r[2], 2) for r in f_runs]},
     }
+    if i4_runs:
+        # the int4-KV variant (ISSUE-12): same workload, packed-nibble
+        # pool — stamp the EQUAL-BYTES capacity (pages a fixed byte
+        # budget admits, the serving-economics lever) next to the
+        # greedy match vs the fp32 outputs
+        i4_out, i4_lat, i4_total, i4_occ, i4_bytes = min(
+            i4_runs, key=lambda r: r[2])
+        i4_match = i4_tot = 0
+        for j in range(n_req):
+            a, b = f_out[j], i4_out[j]
+            pl = len(prompts[j])
+            i4_tot += len(a) - pl
+            i4_match += int((np.asarray(a[pl:]) == np.asarray(
+                b[pl:len(a)])).sum())
+        per_page = {kv: inference.LLMEngineConfig.kv_bytes_per_page(
+            cfg, 16, kv) for kv in ("float32", "int8", "int4")}
+        result["int4_kv"] = {
+            "greedy_match_rate": round(i4_match / max(i4_tot, 1), 4),
+            "tok_s": round(gen_tokens / i4_total),
+            "page_pool_bytes": int(i4_bytes),
+            "pool_ratio_vs_int8": round(i4_bytes / q_bytes, 4),
+            "pool_ratio_vs_fp32": round(i4_bytes / f_bytes, 4),
+            "equal_bytes_capacity": {
+                "pages_per_mb": {k: round(1e6 / v, 2)
+                                 for k, v in per_page.items()},
+                "vs_int8": round(per_page["int8"] / per_page["int4"], 3),
+                "vs_fp32": round(per_page["float32"] / per_page["int4"],
+                                 3)},
+            "p99_latency_ms": round(pctl(i4_lat, 99) * 1e3, 1),
+            "totals_s": [round(r[2], 2) for r in i4_runs],
+        }
+        log(f"[bench] llm_serve_int8 int4_kv: match "
+            f"{result['int4_kv']['greedy_match_rate']}, equal-bytes "
+            f"capacity {result['int4_kv']['equal_bytes_capacity']['vs_int8']}x "
+            f"int8 / {result['int4_kv']['equal_bytes_capacity']['vs_fp32']}x "
+            f"fp32")
+    return result
 
 
 def bench_llm_fleet():
@@ -1301,10 +1345,17 @@ def bench_train_3d():
             hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, schedule="gpipe"),
             hybrid3d.Hybrid3DConfig(tp=4, pp=2),
             hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2, zero="os"),
+            # the ISSUE-12 quantized-collective arm: identical geometry
+            # to config 0 so the A/B block below can stamp the dp-axis
+            # byte shrink + final-loss delta vs the exact run
+            hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2,
+                                    quant_allreduce=True),
         ]
     elif ndev >= 4:
         configs = [hybrid3d.Hybrid3DConfig(dp=2, pp=2),
-                   hybrid3d.Hybrid3DConfig(tp=2, pp=2)]
+                   hybrid3d.Hybrid3DConfig(tp=2, pp=2),
+                   hybrid3d.Hybrid3DConfig(dp=2, pp=2,
+                                           quant_allreduce=True)]
     else:
         configs = [hybrid3d.Hybrid3DConfig()]  # degenerate 1-device
     rng = np.random.default_rng(0)
@@ -1364,7 +1415,42 @@ def bench_train_3d():
             f"coll_bytes={spmd['per_axis_bytes']}, "
             f"spmd_findings={spmd['num_findings']}")
         mesh_mod.reset_mesh()
-    return {"n_devices": ndev, "configs": out}
+    # quant_allreduce A/B (ISSUE-12): pair each -q8 config with its
+    # exact twin and stamp collective bytes before/after + the
+    # final-loss delta — same model seed and batch both sides, so the
+    # delta IS the quantization noise. Guarded like the spmd stamp:
+    # a pairing miss must not kill the measured per-config records.
+    try:
+        quant_ab = {}
+        for tag, rec in out.items():
+            if not tag.endswith("-q8"):
+                continue
+            base = out.get(tag[:-len("-q8")])
+            if base is None:
+                continue
+            b_dp = base["collective_bytes_per_axis"].get("dp", 0)
+            q_dp = rec["collective_bytes_per_axis"].get("dp", 0)
+            quant_ab[tag] = {
+                "collective_bytes_per_axis": {
+                    "exact": base["collective_bytes_per_axis"],
+                    "quant": rec["collective_bytes_per_axis"]},
+                "dp_bytes_ratio": round(b_dp / q_dp, 3) if q_dp else None,
+                "final_loss": {"exact": base["loss_last"],
+                               "quant": rec["loss_last"]},
+                "final_loss_delta": round(
+                    rec["loss_last"] - base["loss_last"], 5),
+                "ms_per_step": {"exact": base["ms_per_step"],
+                                "quant": rec["ms_per_step"]},
+            }
+            log(f"[bench] train_3d quant_ab {tag}: dp bytes "
+                f"{b_dp} -> {q_dp} "
+                f"({quant_ab[tag]['dp_bytes_ratio']}x), loss delta "
+                f"{quant_ab[tag]['final_loss_delta']}")
+    except Exception as e:
+        log(f"[bench] train_3d quant_ab stamp failed: {e!r}")
+        quant_ab = {"error": repr(e)}
+    return {"n_devices": ndev, "configs": out,
+            "quant_allreduce_ab": quant_ab}
 
 
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,

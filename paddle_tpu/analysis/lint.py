@@ -55,7 +55,7 @@ __all__ = ["PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "RULES", "Rule",
            "Finding", "lint_source", "lint_file", "lint_paths",
            "iter_python_files"]
 
-PTLINT_VERSION = "1.1.0"
+PTLINT_VERSION = "1.2.0"
 # version of the jaxpr-level SPMD pass suite (analysis/spmd_analysis.py).
 # Declared HERE so the stdlib-only loaders (tools/ptlint.py, bench.py's
 # supervisor-side stamp) can report it without importing jax.
@@ -119,11 +119,13 @@ RULES = {r.id: r for r in [
          "same-mask-every-step dropout bug PR 1 fixed by threading "
          "the key as an argument)"),
     Rule("PTL301", "int8-dot-no-preferred",
-         "dot_general/dot/matmul/einsum on int8 operands without "
+         "dot_general/dot/matmul/einsum on int8-family operands "
+         "(astype(int8) or the packed-nibble int4 unpack) without "
          "preferred_element_type",
          "int8×int8 accumulating in int8 overflows silently; the "
-         "quantized runtime (PR 4) requires "
-         "preferred_element_type=int32 — the MXU-native contract"),
+         "quantized runtime (PR 4, int4 in PR 12) requires "
+         "preferred_element_type=int32 — the MXU-native contract; "
+         "unpack_int4 codes are int8 on the wire into the dot"),
     Rule("PTL401", "rank-divergent-collective",
          "a collective call (direct, or through any call depth) "
          "inside a branch conditioned on the process rank",
@@ -326,17 +328,63 @@ def _target_key(node):
     return None
 
 
-def _mentions_int8(node, int8_names):
-    """Does this expression visibly carry int8 data? (astype(jnp.int8),
-    np.int8 casts, names locally assigned from such expressions)"""
-    for n in ast.walk(node):
-        if isinstance(n, ast.Constant) and n.value == "int8":
+# helpers whose RESULT is int8-family code data (the packed-nibble
+# path: unpack_int4 yields sign-extended int8 codes, pack_int4 packed
+# bytes — both overflow an int8-accumulating dot exactly like a plain
+# astype(int8)). dequantize_kv_int4 returns FLOAT and is deliberately
+# absent.
+_INT4_CODE_FUNCS = ("unpack_int4", "pack_int4", "quantize_kv_rows_int4")
+
+
+_FLOAT_DTYPE_NAMES = ("float32", "float16", "bfloat16", "float64",
+                      "float_", "double")
+
+
+def _is_float_cast(node):
+    """`<expr>.astype(<float dtype>)` — the dequant idiom. A float
+    cast LAUNDERS the int8 carrier property: `codes.astype(f32) *
+    scale` is exactly how every dequant-on-gather path leaves the
+    int8 domain, and flagging the float einsum downstream of it was
+    the first dogfood FP of the int4 rule extension."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype" and node.args):
+        return False
+    arg = node.args[0]
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Constant) and n.value in _FLOAT_DTYPE_NAMES:
             return True
-        if isinstance(n, ast.Attribute) and n.attr in ("int8", "uint8"):
+        if isinstance(n, ast.Attribute) and n.attr in _FLOAT_DTYPE_NAMES:
             return True
-        if isinstance(n, ast.Name) and n.id in int8_names:
+        if isinstance(n, ast.Name) and n.id in _FLOAT_DTYPE_NAMES:
             return True
     return False
+
+
+def _mentions_int8(node, int8_names):
+    """Does this expression visibly carry int8-family data?
+    (astype(jnp.int8), np.int8 casts, the packed-nibble int4 helpers —
+    unpack_int4 codes are int8 on the wire into the dot — and names
+    locally assigned from such expressions). Float casts prune their
+    subtree (`_is_float_cast`): a dequantized value is not a carrier."""
+
+    def carrier(n):
+        if _is_float_cast(n):
+            return False
+        if isinstance(n, ast.Constant) and n.value in ("int8", "int4"):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "int8", "uint8", "int4", "uint4"):
+            return True
+        if isinstance(n, ast.Call):
+            comp = _component(n.func)
+            if comp in _INT4_CODE_FUNCS:
+                return True
+        if isinstance(n, ast.Name) and n.id in int8_names:
+            return True
+        return any(carrier(c) for c in ast.iter_child_nodes(n))
+
+    return carrier(node)
 
 
 def _walk_shallow(stmts):
@@ -776,6 +824,13 @@ class _FunctionLinter:
             self._record_store(t.id)
             if _mentions_int8(value, self.int8_names):
                 self.int8_names.add(t.id)
+            else:
+                # flow-sensitive like PTL601's concat taint: a clean
+                # reassignment launders — the dequant idiom
+                # `ks = ks.astype(f32) * scale` leaves the int8 domain
+                # and the float math downstream must not keep flagging
+                # (the prescan still covers use-before-assign order)
+                self.int8_names.discard(t.id)
             # flow-sensitive (unlike the int8 prescan): a clean
             # reassignment launders — `x = jnp.zeros(...)` after a
             # concatenate must not keep flagging x

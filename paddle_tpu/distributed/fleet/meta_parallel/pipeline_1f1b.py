@@ -69,6 +69,11 @@ class PipelineSpecs(NamedTuple):
     # contributions) — contrast dp_axis, whose shards each compute a
     # full mean and therefore pmean + 1/dp-scale.
     sum_axes: Optional[Tuple[str, ...]] = None
+    # quantize the dp-axis gradient pmean: the block-scaled int8
+    # all-reduce of distributed.quant_collective replaces the fp32
+    # pgrads/hgrads pmean (EQuARX in-XLA; loss/aux scalars stay exact).
+    # Hashable bool — rides custom_vjp nondiff_argnums like the rest.
+    quant_dp: bool = False
 
 
 def _unflatten_like(tree, leaf_specs, default_fn, require_pp=False):
@@ -128,7 +133,7 @@ def schedule_ticks(M, pp, num_virtual=1):
 
 def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
                   y_micro, pp, remat, num_virtual=1, dp_axis=None,
-                  sum_axes=None, aux_weight=None):
+                  sum_axes=None, aux_weight=None, quant_dp=False):
     """Inside shard_map over 'pp'. Returns (loss, aux, param_grads,
     post_grads, dx_micro).
 
@@ -361,10 +366,20 @@ def _run_schedule(block_fn, loss_fn, stacked_params, post_params, x_micro,
         inv_dp = 1.0 / mesh_mod.axis_size(dp_axis)
         loss = lax.pmean(loss, dp_axis)
         aux = lax.pmean(aux, dp_axis)
-        pgrads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), pgrads)
-        hgrads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), hgrads)
+        if quant_dp:
+            # block-scaled int8 all-reduce of the WHOLE grad tree
+            # (pgrads + hgrads fused into one payload) — the EQuARX
+            # in-XLA path; the scalar loss/aux reductions above stay
+            # exact fp32 (distributed.quant_collective, ROADMAP item 2)
+            from ...quant_collective import quantized_pmean_tree
+
+            pgrads, hgrads = quantized_pmean_tree(
+                (pgrads, hgrads), dp_axis)
+        else:
+            pgrads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), pgrads)
+            hgrads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), hgrads)
         dxs = dxs * inv_dp
     return loss + aw * aux, aux, pgrads, hgrads, dxs
 
@@ -551,7 +566,8 @@ def _pipeline_call(block_fn, loss_fn, stacked_params, post_params, batch,
     run = jax.shard_map(
         functools.partial(_run_schedule, block_fn, loss_fn, pp=pp,
                           remat=remat, num_virtual=V, dp_axis=sp.dp_axis,
-                          sum_axes=sp.sum_axes, aux_weight=aux_weight),
+                          sum_axes=sp.sum_axes, aux_weight=aux_weight,
+                          quant_dp=sp.quant_dp),
         mesh=mesh,
         in_specs=(stack_spec, post_spec, x_spec, y_spec),
         out_specs=(P(), P(), stack_spec, post_spec, x_spec),

@@ -41,6 +41,15 @@ class Hybrid3DConfig:
         group in a hybrid mesh; 'sharding' keeps the dedicated axis).
     sp: optional sequence-parallel degree (the 4th axis, for long
         context inside pipeline stages).
+    quant_allreduce: quantize the dp-axis gradient all-reduce to
+        block-scaled int8 INSIDE the compiled step (EQuARX in-XLA —
+        distributed.quant_collective; docs/QUANTIZATION.md "In-XLA
+        collectives"). ~3.9× fewer dp bytes per step; loss/aux scalars
+        and the mp/pp collectives stay exact. TRI-STATE: None (the
+        default) defers to the PT_QUANT_ALLREDUCE_XLA env opt-in;
+        True/False pin it explicitly (a default of False would make
+        the documented knob→config→env chain unreachable whenever a
+        config is passed).
     """
     dp: int = 1
     tp: int = 1
@@ -52,6 +61,7 @@ class Hybrid3DConfig:
     zero: Optional[str] = None
     zero_axis: str = "dp"
     sp: int = 1
+    quant_allreduce: Optional[bool] = None
 
     def __post_init__(self):
         for name in ("dp", "tp", "pp", "n_micro", "n_virtual", "sp"):
@@ -113,6 +123,8 @@ class Hybrid3DConfig:
             "n_virtual": self.n_virtual,
             "remat": self.remat if self.remat else "off",
             "zero": self.zero or "off",
+            **({"quant_allreduce": True} if self.quant_allreduce
+               else {}),
         }
 
     def tag(self):
@@ -125,6 +137,8 @@ class Hybrid3DConfig:
             s += f"v{self.n_virtual}"
         if self.zero:
             s += f"-zero_{self.zero}"
+        if self.quant_allreduce:
+            s += "-q8"
         return s
 
 

@@ -41,7 +41,7 @@ def gpipe_ticks(M, pp):
 
 def _run_gpipe(block_fn, loss_fn, stacked_params, post_params, x_micro,
                y_micro, pp, remat, dp_axis=None, sum_axes=None,
-               aux_weight=None):
+               aux_weight=None, quant_dp=False):
     """Inside shard_map over 'pp'. Returns (loss, aux, param_grads,
     post_grads, dx_micro) — the same contract as 1F1B's `_run_schedule`,
     with the same psum/pmean finishing, so the two schedules are
@@ -167,10 +167,19 @@ def _run_gpipe(block_fn, loss_fn, stacked_params, post_params, x_micro,
         inv_dp = 1.0 / mesh_mod.axis_size(dp_axis)
         loss = lax.pmean(loss, dp_axis)
         aux = lax.pmean(aux, dp_axis)
-        pgrads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), pgrads)
-        hgrads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, dp_axis), hgrads)
+        if quant_dp:
+            # the 1F1B schedule's int8 grad all-reduce, identically
+            # (see _run_schedule — the two schedules share the
+            # finishing-reduction contract)
+            from ..quant_collective import quantized_pmean_tree
+
+            pgrads, hgrads = quantized_pmean_tree(
+                (pgrads, hgrads), dp_axis)
+        else:
+            pgrads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), pgrads)
+            hgrads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), hgrads)
         dxs = dxs * inv_dp
     return loss + aw * aux, aux, pgrads, hgrads, dxs
 
@@ -233,7 +242,8 @@ def _gpipe_call(block_fn, loss_fn, stacked_params, post_params, batch,
     run = jax.shard_map(
         functools.partial(_run_gpipe, block_fn, loss_fn, pp=pp,
                           remat=remat, dp_axis=sp.dp_axis,
-                          sum_axes=sp.sum_axes, aux_weight=aux_weight),
+                          sum_axes=sp.sum_axes, aux_weight=aux_weight,
+                          quant_dp=sp.quant_dp),
         mesh=mesh,
         in_specs=(stack_spec, post_spec, x_spec, y_spec),
         out_specs=(P(), P(), stack_spec, post_spec, x_spec),

@@ -100,13 +100,27 @@ class DistributedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, zero_level=None,
-                 batch_specs=None, remat=False):
+                 batch_specs=None, remat=False, quant_allreduce=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.zero = zero_level
         self.batch_specs = batch_specs
         self.remat = remat
+        # quantized gradient all-reduce (block-scaled int8 in-XLA —
+        # distributed.quant_collective): None follows the
+        # PT_QUANT_ALLREDUCE_XLA env. On the plain-jit step the grad
+        # sync is partitioner-inserted and invisible; with the knob on,
+        # the grad computation moves into an explicit shard_map over
+        # the replica axes so the int8 exchange (and its schedule —
+        # extract_schedule sees it) replaces the fp32 psum. Supported
+        # for the replicated-param DP/ZeRO-1/2 shape only (validated
+        # at build).
+        if quant_allreduce is None:
+            from .quant_collective import xla_quant_enabled
+
+            quant_allreduce = xla_quant_enabled()
+        self.quant_allreduce = bool(quant_allreduce)
         if zero_level:
             shard_params_and_opt(model, optimizer, zero_level)
         sd = model.state_dict()
@@ -180,12 +194,25 @@ class DistributedTrainStep:
         train_objs = [p for p, t in zip(param_objs, trainable) if t]
         frozen_objs = [p for p, t in zip(param_objs, trainable) if not t]
 
+        quant_axes = ()
+        if self.quant_allreduce:
+            quant_axes = tuple(a for a in ("dp", "sharding")
+                               if mesh_mod.axis_size(a) > 1)
+        if quant_axes:
+            self._validate_quant_path()
+            grad_sm = self._quant_grad_program(loss_f, batch_vals,
+                                               quant_axes, mesh)
+
         def step(train_vals, frozen_vals, opt_states, lr, batch_vals,
                  step_idx, base_key):
             step_key = jax.random.fold_in(base_key, step_idx)
-            (loss, new_frozen), grads = jax.value_and_grad(
-                loss_f, has_aux=True)(
-                train_vals, frozen_vals, batch_vals, step_key)
+            if quant_axes:
+                loss, grads, new_frozen = grad_sm(
+                    train_vals, frozen_vals, batch_vals, step_key)
+            else:
+                (loss, new_frozen), grads = jax.value_and_grad(
+                    loss_f, has_aux=True)(
+                    train_vals, frozen_vals, batch_vals, step_key)
             new_vals, new_states = opt.apply_gradients_tree(
                 train_vals, grads, opt_states, lr, param_objs=train_objs)
             return loss, new_vals, new_states, new_frozen
@@ -252,6 +279,80 @@ class DistributedTrainStep:
             self._compiled = call
         else:
             self._compiled = jitted
+
+    # ---- quantized gradient all-reduce (in-XLA EQuARX) ----
+    def _validate_quant_path(self):
+        """The quant path moves the grad computation into a manual
+        shard_map over the replica axes: params must be REPLICATED
+        (ZeRO-3 sharded storage and TP pspecs would need their own
+        in_specs and in-shard collectives) and the batch must ride the
+        default replica-axis sharding. Fail loudly, not numerically."""
+        if self.zero == "p_g_os":
+            raise ValueError(
+                "quant_allreduce does not compose with zero_level="
+                "'p_g_os' (sharded param storage): the int8 grad "
+                "exchange assumes replicated params. Use 'os'/'os_g' "
+                "(sharded optimizer state composes fine) or disable "
+                "PT_QUANT_ALLREDUCE_XLA for this step")
+        if self.batch_specs is not None:
+            raise ValueError(
+                "quant_allreduce supports the default replica-axis "
+                "batch sharding only (custom batch_specs — e.g. "
+                "sequence sharding — would need their own loss "
+                "reduction semantics inside the shard_map)")
+        for p in self._param_objs:
+            spec = getattr(p, "_pspec", None)
+            if spec is not None and any(s is not None for s in spec):
+                raise ValueError(
+                    f"quant_allreduce: parameter with _pspec {spec} is "
+                    "mesh-sharded — the int8 grad exchange supports "
+                    "replicated params only (TP models: use "
+                    "HybridTrainStep, whose pipeline schedule "
+                    "quantizes the dp axis while mp stays exact)")
+
+    def _quant_grad_program(self, loss_f, batch_vals, quant_axes, mesh):
+        """shard_map'd (loss, grads, new_frozen) with the block-scaled
+        int8 all-reduce-mean in place of the partitioner's fp32 grad
+        psum. Per-shard loss is the local-batch mean → pmean'd exact;
+        float buffer updates (BN stats) are pmean'd so replicas stay
+        identical; int buffers pass through (identical by
+        construction)."""
+        from .quant_collective import quantized_pmean_tree
+
+        axes = quant_axes if len(quant_axes) > 1 else quant_axes[0]
+
+        def grad_program(train_vals, frozen_vals, batch_vals, step_key):
+            # decorrelate per-replica randomness: the plain-jit path's
+            # dropout mask spans the GLOBAL batch (different per row);
+            # inside shard_map every replica would otherwise draw from
+            # the identical key and apply the SAME mask to its local
+            # rows — fold the replica index in so flipping
+            # quant_allreduce doesn't change RNG semantics
+            rank = jnp.int32(0)
+            for a in quant_axes:
+                rank = rank * mesh_mod.axis_size(a) + \
+                    jax.lax.axis_index(a)
+            step_key = jax.random.fold_in(step_key, rank)
+            (loss, new_frozen), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(
+                train_vals, frozen_vals, batch_vals, step_key)
+            loss = jax.lax.pmean(loss, axes)
+            grads = quantized_pmean_tree(grads, quant_axes)
+            new_frozen = [
+                jax.lax.pmean(v, axes)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v in new_frozen]
+            return loss, grads, new_frozen
+
+        rep = P()
+        bspecs = [P(*((("dp", "sharding"),)
+                      + (None,) * (np.ndim(v) - 1)))
+                  if np.ndim(v) else rep for v in batch_vals]
+        return jax.shard_map(
+            grad_program, mesh=mesh,
+            in_specs=(rep, rep, bspecs, rep),
+            out_specs=(rep, rep, rep),
+            check_vma=False)
 
     # ONE layout definition, shared by __call__ and the analysis
     # probes (analyze_step / extract_schedule) — probe-vs-runtime

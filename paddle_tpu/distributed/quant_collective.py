@@ -1,0 +1,211 @@
+"""In-XLA quantized gradient collectives — EQuARX inside the compiled step.
+
+The PR-4 int8 wire codec (`quantization.runtime.encode_int8_wire`) only
+covers the EAGER socket/KV fallback; the compiled DP/hybrid3d gradient
+path still moves fp32 over the mesh (the dp-axis bytes pinned by
+tests/golden/hybrid3d_dp2tp2pp2_schedule.json). This module is the
+in-program half: a block-scaled int8 all-reduce-mean built from explicit
+`shard_map` collectives, so the payload on the wire IS int8 and the
+schedule (and its byte accounting) is visible to
+`analysis.spmd_analysis.extract_schedule`.
+
+Design (per `quantized_pmean` call, axis group size n):
+
+1. per-block absmax over the flat payload, `lax.pmax` over the axis →
+   every rank holds the SAME per-block scales (the only fp32 collective,
+   4/block bytes per element). Shared scales are what make step 3's
+   accumulation EXACT in int32 — per-rank scales would force a float
+   re-quantization per hop (EQuARX's ring error compounding).
+2. quantize to int8 codes against the shared scales.
+3. reduce-scatter, as n−1 `lax.ppermute` hops of ONE int8 shard each:
+   at hop s every rank sends the codes of the shard owned by rank
+   (idx − s) mod n straight to its owner and int32-accumulates the shard
+   it receives. Direct exchange — codes never re-quantize, and the
+   per-axis payload is exactly the (n−1)/n · N int8 bytes a
+   reduce-scatter must move (an `all_to_all` would count the full input
+   in the schedule's byte accounting).
+4. dequant-accumulate: the int32 code sum × shared scale / n = this
+   rank's shard of the MEAN gradient, at full precision.
+5. re-quantize the finished shard (fresh per-block scales — the mean's
+   dynamic range shrank) and `all_gather` int8 codes + fp32 scales;
+   every rank dequantizes the identical bytes, so replicas cannot drift.
+
+NaN-poison contract (the PR-4 wire-codec semantics, in-program): a
+non-finite gradient value on ANY rank makes its block's absmax — and,
+through the pmax, the SHARED scale — NaN/inf. Its codes clamp to finite
+int8, and the dequant (codes × non-finite scale) resolves to NaN for the
+whole block on EVERY rank identically, so each replica's grad guards
+(StepGuard NaN skip-and-journal) fire in lockstep instead of one rank
+publishing a poisoned update its peers never see. Eligibility never
+depends on the data (same reasoning as `wire_eligible`).
+
+Wiring (docs/QUANTIZATION.md "In-XLA collectives"):
+  * `Hybrid3DConfig(quant_allreduce=True)` / `HybridTrainStep(...,
+    quant_allreduce=True)` — the pipeline schedules' dp-axis grad pmean.
+  * `DistributedTrainStep(..., quant_allreduce=True)` — the pure-DP
+    plain-jit step (the grad sync moves into an explicit shard_map).
+  * env `PT_QUANT_ALLREDUCE_XLA=1` — the opt-in default for both.
+"""
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import mesh as mesh_mod
+
+__all__ = ["xla_quant_enabled", "quantized_pmean", "quantized_pmean_tree",
+           "DEFAULT_BLOCK", "MIN_QUANT_SIZE", "QMAX"]
+
+QMAX = 127.0
+# per-block shared-scale granularity: scales cost 4/block bytes per
+# element (0.8% at 512) and bound each block's quant error to its OWN
+# absmax/127 — big layers can't crush small layers' precision (EQuARX)
+DEFAULT_BLOCK = 512
+# leaves below this many elements ride a plain fp32 pmean: scalars and
+# tiny vectors would pay the block machinery for no measurable bytes
+MIN_QUANT_SIZE = 64
+
+
+def xla_quant_enabled():
+    """The `PT_QUANT_ALLREDUCE_XLA` env opt-in (the compiled-path
+    sibling of `quantization.runtime.quant_allreduce_enabled`, which
+    gates the eager wire codec)."""
+    return os.environ.get(
+        "PT_QUANT_ALLREDUCE_XLA", "0").strip().lower() in (
+            "1", "true", "yes", "on")
+
+
+def _axis_tuple(axes):
+    if isinstance(axes, str):
+        return (axes,)
+    return tuple(axes)
+
+
+def _quantize_shared(blocks, scale):
+    """int8 codes of [nb, block] f32 against per-block `scale` [nb].
+    Non-finite ratios (poisoned scale) clamp to finite codes — the
+    poison travels in the SCALE, not the payload (wire-codec parity)."""
+    ratio = blocks / scale[:, None]
+    q = jnp.nan_to_num(jnp.round(ratio), nan=0.0, posinf=QMAX,
+                       neginf=-QMAX)
+    return jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+
+
+def _block_scales(blocks):
+    """Per-block absmax/127 with the poison property: a non-finite
+    element makes its block's scale +inf. The poison must ride as inf,
+    not NaN — XLA:CPU's all-reduce max silently DROPS NaN (its reduce
+    is maxnum-style), while inf orders above every finite value and
+    survives the `lax.pmax`; `codes × inf` then decodes the whole block
+    to NaN on every rank identically."""
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    absmax = jnp.where(jnp.isfinite(absmax), absmax, jnp.float32(jnp.inf))
+    return jnp.maximum(absmax, jnp.float32(1e-12)) / jnp.float32(QMAX)
+
+
+def _quantized_pmean_one_axis(flat, axis, block):
+    """Block-scaled int8 mean over ONE mesh axis, inside shard_map.
+    flat: [N] float32 (every rank holds its own full copy — the
+    replicated-gradient layout the dp pmean reduces). Returns [N] f32."""
+    n = mesh_mod.axis_size(axis)
+    if n == 1:
+        return flat
+    N = flat.shape[0]
+    # shard length: a multiple of `block`, n shards cover the payload
+    per = int(-(-N // (n * block))) * block
+    padded = jnp.pad(flat, (0, per * n - N))
+    nb_total = (per * n) // block
+
+    # 1. shared per-block scales (pmax: one rank's non-finite block
+    #    poisons the block's scale on EVERY rank — the NaN contract)
+    scale_all = _block_scales(padded.reshape(nb_total, block))
+    scale_all = lax.pmax(scale_all, axis)
+
+    # 2. int8 codes of MY copy of the whole payload
+    q = _quantize_shared(padded.reshape(nb_total, block), scale_all)
+    q = q.reshape(n, per)
+
+    # 3. reduce-scatter by direct exchange: hop s sends the shard owned
+    #    by rank (idx - s) mod n straight to its owner; the received
+    #    shard is always MY own, accumulated exactly in int32
+    #    (|codes| <= 127·n << 2^31)
+    idx = lax.axis_index(axis).astype(jnp.int32)
+    zero = jnp.int32(0)
+    acc = lax.dynamic_slice(q, (idx, zero), (1, per)).reshape(per)
+    acc = acc.astype(jnp.int32)
+    for s in range(1, n):
+        dest = ((idx - s) % n).astype(jnp.int32)
+        chunk = lax.dynamic_slice(q, (dest, zero), (1, per)).reshape(per)
+        recv = lax.ppermute(
+            chunk, axis, [(r, (r - s) % n) for r in range(n)])
+        acc = acc + recv.astype(jnp.int32)
+
+    # 4. dequant-accumulate: my shard of the mean, full precision
+    nb = per // block
+    my_scale = lax.dynamic_slice(scale_all,
+                                 ((idx * nb).astype(jnp.int32),), (nb,))
+    mean = (acc.reshape(nb, block).astype(jnp.float32)
+            * my_scale[:, None]) / jnp.float32(n)
+
+    # 5. re-quantize the finished shard and all-gather codes + scales;
+    #    every rank decodes identical bytes (replicas cannot drift)
+    scale2 = _block_scales(mean)
+    q2 = _quantize_shared(mean, scale2).reshape(per)
+    full_q = lax.all_gather(q2, axis, tiled=True)         # [n*per] int8
+    full_s = lax.all_gather(scale2, axis, tiled=True)     # [n*nb] f32
+    out = (full_q.reshape(nb_total, block).astype(jnp.float32)
+           * full_s[:, None])
+    return out.reshape(-1)[:N]
+
+
+def quantized_pmean(x, axes, block=DEFAULT_BLOCK):
+    """`lax.pmean(x, axes)` with block-scaled int8 payloads — must run
+    inside `shard_map` where `axes` are manual mesh axes and `x` is
+    replicated over them (each rank holds its own full gradient, the
+    layout a DP grad sync reduces). Multiple axes reduce sequentially
+    (mean of means == global mean at equal group sizes)."""
+    axes = tuple(a for a in _axis_tuple(axes)
+                 if mesh_mod.axis_size(a) > 1)
+    if not axes:
+        return x
+    dtype = x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    for ax in axes:
+        flat = _quantized_pmean_one_axis(flat, ax, block)
+    return flat.reshape(x.shape).astype(dtype)
+
+
+def quantized_pmean_tree(tree, axes, block=DEFAULT_BLOCK,
+                         min_size=MIN_QUANT_SIZE):
+    """Tree-fused `quantized_pmean`: every leaf with >= `min_size`
+    elements rides ONE fused flat payload (one scale/exchange/gather
+    sequence for the whole gradient tree — blocks may span leaf
+    boundaries, the 4/block scale overhead is paid once), tiny leaves
+    keep the exact fp32 `lax.pmean`. Leaf dtypes are preserved."""
+    axes = tuple(a for a in _axis_tuple(axes)
+                 if mesh_mod.axis_size(a) > 1)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not axes or not leaves:
+        return tree
+    big = [i for i, v in enumerate(leaves)
+           if int(np.prod(v.shape, dtype=np.int64)) >= min_size]
+    out = list(leaves)
+    if big:
+        flat = jnp.concatenate(
+            [leaves[i].astype(jnp.float32).reshape(-1) for i in big])
+        for ax in axes:
+            flat = _quantized_pmean_one_axis(flat, ax, block)
+        off = 0
+        for i in big:
+            v = leaves[i]
+            size = int(np.prod(v.shape, dtype=np.int64))
+            out[i] = lax.dynamic_slice(flat, (off,), (size,)).reshape(
+                v.shape).astype(v.dtype)
+            off += size
+    for i, v in enumerate(leaves):
+        if i not in big:
+            out[i] = lax.pmean(v, axes[0] if len(axes) == 1 else axes)
+    return jax.tree_util.tree_unflatten(treedef, out)

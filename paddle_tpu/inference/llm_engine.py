@@ -253,10 +253,14 @@ class LLMEngineConfig:
     token_budget  flat tokens per step (>= num_slots); the surplus over
                   the decode tokens is the chunked-prefill bandwidth.
                   Default num_slots + max(num_slots, 8).
-    kv_dtype      pool dtype: "float32" | "bfloat16" | "int8" (the
-                  quantized runtime — int8 pools carry per-row scale
-                  planes and dequantize on gather). Default: the
-                  PT_KV_DTYPE env var, else the model compute dtype.
+    kv_dtype      pool dtype: "float32" | "bfloat16" | "int8" | "int4"
+                  (the quantized runtime — int8/int4 pools carry
+                  per-row scale planes and dequantize on gather; int4
+                  packs two nibbles per byte along head_dim, ~1.9×
+                  the equal-bytes page capacity of int8 and ~7× fp32,
+                  at a coarser 15-level grid — docs/QUANTIZATION.md
+                  "int4"). Default: the PT_KV_DTYPE env var, else the
+                  model compute dtype.
     prefix_cache  enable the shared-prefix radix KV cache
                   (fleet_serving.RadixPrefixCache): requests with a
                   cached prompt prefix map shared pages read-only and
@@ -354,13 +358,20 @@ class LLMEngineConfig:
     @staticmethod
     def kv_bytes_per_page(model_config, page_size, kv_dtype=None):
         """Bytes ONE page costs across every layer's k+v pool, scale
-        planes included — the unit of the capacity math below."""
+        planes included — the unit of the capacity math below. int8
+        rows cost hd + 4 bytes per head; packed int4 rows cost hd/2 +
+        4 (two nibbles per byte — the scale plane is shared machinery,
+        so its 4 bytes/head weigh relatively more: equal-bytes
+        capacity lands ≈ ×1.9 over int8, ≈ ×7 over fp32 at hd 32)."""
         from ..quantization import runtime as _qrt
 
         dt, quantized = _qrt.resolve_kv_dtype(kv_dtype, jnp.float32)
         nh = model_config.num_heads
         hd = model_config.hidden_size // nh
-        per_row = nh * hd * jnp.dtype(dt).itemsize
+        if quantized == 4:
+            per_row = nh * (hd // 2)      # packed nibbles
+        else:
+            per_row = nh * hd * jnp.dtype(dt).itemsize
         if quantized:
             per_row += nh * 4  # fp32 scale per (row, head)
         return 2 * model_config.num_layers * page_size * per_row
@@ -629,13 +640,27 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         compute_dt = model.gpt.wte.weight._value.dtype
         cache_dt, self.kv_quantized = _qrt.resolve_kv_dtype(
             cfg.kv_dtype, compute_dt)
-        self.kv_dtype = str(jnp.dtype(cache_dt))
+        # kv_quantized is the code width (0 float / 8 / 4 — truthy when
+        # quantized); int4 packs two nibbles per byte along head_dim,
+        # so the pool's last dim is hd/2 and attention unpacks on
+        # gather (the shape IS the codec discriminator — gpt.py
+        # _paged_cache_write_quant / F.paged_attention)
+        hd_store = hd
+        if self.kv_quantized == 4:
+            if hd % 2:
+                raise ValueError(
+                    f"kv_dtype='int4' needs an even head_dim, got {hd} "
+                    "(nibble packing pairs head_dim elements)")
+            hd_store = hd // 2
+            self.kv_dtype = "int4"
+        else:
+            self.kv_dtype = str(jnp.dtype(cache_dt))
         sharding = mesh_mod.named_sharding()  # replicated on the mesh
 
         def _fresh_pools():
             pools = [
                 jax.device_put(
-                    jnp.zeros((num_pages, self.page_size, nh, hd),
+                    jnp.zeros((num_pages, self.page_size, nh, hd_store),
                               cache_dt), sharding)
                 for _ in range(2 * mcfg.num_layers)]
             scales = []

@@ -218,11 +218,18 @@ class SpeculativeDecoder:
         # and page tables, its own buffers in the engine's kv dtype
         draft_dt, self._quantized = _qrt.resolve_kv_dtype(
             engine.kv_dtype, draft_model.gpt.wte.weight._value.dtype)
+        # packed int4 pools halve the stored head_dim (same shape
+        # discriminator the engine pool uses)
+        hd_store = hd // 2 if self._quantized == 4 else hd
+        if self._quantized == 4 and hd % 2:
+            raise ValueError(
+                f"kv_dtype='int4': draft head_dim {hd} is odd — nibble "
+                "packing pairs head_dim elements")
         sharding = mesh_mod.named_sharding()
 
         def _fresh_pools():
             pools = [
-                jax.device_put(jnp.zeros((num_pages, ps, nh, hd),
+                jax.device_put(jnp.zeros((num_pages, ps, nh, hd_store),
                                          draft_dt), sharding)
                 for _ in range(2 * dcfg.num_layers)]
             scales = []

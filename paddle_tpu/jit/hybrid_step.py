@@ -46,15 +46,32 @@ class HybridTrainStep(TrainStep):
     config: optional `hybrid3d.Hybrid3DConfig` — supplies the ZeRO
         level/axis and rides along into `describe()`/bench stamps. When
         None the step is placement-pinning only (no ZeRO).
+    quant_allreduce: quantize the dp-axis gradient all-reduce to
+        block-scaled int8 inside the compiled step
+        (distributed.quant_collective — EQuARX in-XLA). Resolution
+        order: this argument → config.quant_allreduce → the
+        PT_QUANT_ALLREDUCE_XLA env. The knob lands on the MODEL
+        (PipelinedGPTForCausalLM.quant_allreduce) because the pipeline
+        specs are built at trace time — so `collective_schedule` and
+        the dispatched executable always agree.
     """
 
     _donation_gauge_label = "hybrid3d"
 
     def __init__(self, model, loss_fn, optimizer, config=None,
-                 donate_params=True, remat=False):
+                 donate_params=True, remat=False, quant_allreduce=None):
         self.config = config
         self._zero = getattr(config, "zero", None)
         self._zero_axis = getattr(config, "zero_axis", "dp")
+        if quant_allreduce is None:
+            quant_allreduce = getattr(config, "quant_allreduce", None)
+        if hasattr(model, "quant_allreduce"):
+            # write None too: a model REUSED across steps must not
+            # inherit the previous step's pinned setting — None
+            # restores the documented arg → config → env chain
+            model.quant_allreduce = (None if quant_allreduce is None
+                                     else bool(quant_allreduce))
+        self.quant_allreduce = quant_allreduce
         if self._zero == "p_g_os":
             # param storage sharded too (ZeRO-3): placement must happen
             # BEFORE the step captures the parameter values

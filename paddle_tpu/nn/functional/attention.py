@@ -142,10 +142,12 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
                  + 1, i.e. the token attends to its own k/v and every
                  earlier one); 0 marks a padding token → zero output
     k_scales/v_scales  [num_pages, page_size, heads] fp32 — the
-                 per-row dequant scales of an INT8 pool (quantization
-                 runtime, kv_dtype="int8"): gathered rows are
-                 dequantized `int8 * scale` before attention
-                 (dequant-on-gather). None for float pools.
+                 per-row dequant scales of an INT8 or packed-INT4 pool
+                 (quantization runtime, kv_dtype="int8"/"int4"):
+                 gathered rows are dequantized `codes * scale` before
+                 attention (dequant-on-gather). A pool whose head_dim
+                 is HALF the query's holds packed int4 nibbles and is
+                 unpacked after the gather. None for float pools.
     frontier_offset  optional scalar int added to every NONZERO
                  kv_lens row (zero rows stay padding). The fused
                  decode window (gpt.py `_paged_decode_fused`) passes
@@ -211,6 +213,11 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         import jax
 
         n_pages, page_size, h, d = kpool.shape
+        # a quantized pool whose rows are HALF the query head_dim holds
+        # PACKED int4 (kv_dtype="int4"): unpack after the gather, then
+        # dequant by the same per-row scale planes. The shape mismatch
+        # is the discriminator — an unpacked pool always matches q.
+        packed4 = bool(scales) and d * 2 == qv.shape[-1]
         n_slots, pages_per_seq = tables.shape
         tokens = qv.shape[0]
         L = pages_per_seq * page_size
@@ -233,7 +240,13 @@ def paged_attention(query, k_pool, v_pool, page_tables, slot_ids, kv_lens,
         v_all = vpool.reshape(n_pages * page_size, h, d)
         ks = k_all[phys]                            # [S, L, h, d]
         vs = v_all[phys]
-        if sc:  # int8 pool: dequant-on-gather by the per-row scales
+        if sc:  # int8/int4 pool: dequant-on-gather by per-row scales
+            if packed4:
+                from ...quantization.runtime import unpack_int4
+
+                ks = unpack_int4(ks, axis=-1)   # [S, L, h, 2d] int8
+                vs = unpack_int4(vs, axis=-1)
+                d = d * 2
             ksc = sc[0].reshape(n_pages * page_size, h)[phys]  # [S,L,h]
             vsc = sc[1].reshape(n_pages * page_size, h)[phys]
             ks = ks.astype(jnp.float32) * ksc[..., None]

@@ -7,10 +7,13 @@ from ...quantization import (  # noqa: F401
     QuantizedLinear,
 )
 from ...quantization.runtime import (  # noqa: F401
+    Int4WeightOnlyLinear,
     Int8WeightOnlyLinear,
+    quantize_model_int4,
     quantize_model_int8,
 )
 
 __all__ = ["ImperativeQuantAware", "PostTrainingQuantization",
            "QuantizedLinear", "Int8WeightOnlyLinear",
-           "quantize_model_int8"]
+           "Int4WeightOnlyLinear", "quantize_model_int8",
+           "quantize_model_int4"]

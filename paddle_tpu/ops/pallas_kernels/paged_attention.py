@@ -36,11 +36,26 @@ __all__ = ["ragged_paged_attention"]
 NEG_INF = -1e30
 
 
+def _unpack_nibbles(k):
+    """Packed int4 page block [P, H, D/2] → sign-extended int8 codes
+    [P, H, D] in VMEM — the ONE nibble codec, reused from
+    quantization.runtime (shift/mask int32 arithmetic + a CONCATENATE
+    on the lane dim — an interleave reshape would not lower on Mosaic;
+    the split-halves layout was chosen for exactly this). A second
+    copy here would have to stay bit-identical with `pack_int4`
+    forever; lazy import keeps the kernel module free of the package
+    import cycle."""
+    from ...quantization.runtime import unpack_int4
+
+    return unpack_int4(k, axis=-1)
+
+
 def _rpa_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref, v_ref,
                 *rest, page_size, pages_per_seq, scale, quantized):
     if quantized:
-        # int8 pools ride with per-row fp32 scale planes, gathered
-        # through the SAME page_map (quantization runtime, PT_KV_DTYPE)
+        # int8/int4 pools ride with per-row fp32 scale planes, gathered
+        # through the SAME page_map (quantization runtime, PT_KV_DTYPE);
+        # quantized == 4 marks packed nibbles (pool lane dim D/2)
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
@@ -64,11 +79,14 @@ def _rpa_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref, v_ref,
     @pl.when(j * page_size < kvlen)
     def _compute():
         q = q_ref[0]                     # [H, D]
-        k = k_ref[0]                     # [P, H, D]
+        k = k_ref[0]                     # [P, H, D] (or [P, H, D/2] int4)
         v = v_ref[0]
         if quantized:
-            # dequant-on-gather: the DMA moved int8 + [P, H] scales;
-            # the f32 rows only ever exist in VMEM
+            # dequant-on-gather: the DMA moved int8 (or packed int4)
+            # + [P, H] scales; the f32 rows only ever exist in VMEM
+            if quantized == 4:
+                k = _unpack_nibbles(k)
+                v = _unpack_nibbles(v)
             k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
             v = v.astype(jnp.float32) * vs_ref[0][:, :, None]
         kt = jnp.swapaxes(k, 0, 1)       # [H, P, D]
@@ -145,9 +163,12 @@ def _rpa_qblock_kernel(sid_ref, pt_ref, lens_ref, off_ref, q_ref, k_ref,
     @pl.when(j * page_size < kvmax)
     def _compute():
         q = q_ref[...]                   # [qb, H, D]
-        k = k_ref[0]                     # [P, H, D]
+        k = k_ref[0]                     # [P, H, D] (or [P, H, D/2] int4)
         v = v_ref[0]
         if quantized:
+            if quantized == 4:
+                k = _unpack_nibbles(k)
+                v = _unpack_nibbles(v)
             k = k.astype(jnp.float32) * ks_ref[0][:, :, None]
             v = v.astype(jnp.float32) * vs_ref[0][:, :, None]
         qt = jnp.swapaxes(q, 0, 1)       # [H, qb, D]
@@ -221,15 +242,22 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     per BLOCK instead of once per row, while per-row kv_lens keep the
     in-window causal raggedness. Ignored when T is not a multiple.
 
+    A quantized pool whose last dim is HALF the query head_dim holds
+    PACKED int4 nibbles (kv_dtype="int4"): the kernel unpacks in VMEM
+    after the DMA, so HBM traffic for the cache is int4 — page bytes
+    ≈ ×8 down vs fp32 (same shape discriminator as the jnp reference).
+
     Semantics contract: identical to the jnp reference in
     nn/functional/attention.py `paged_attention` (pinned by the
     interpret-mode parity tests in tests/test_llm_engine.py and
     tests/test_quant_runtime.py)."""
     tokens, heads, dim = q.shape
-    _, page_size, _, _ = k_pool.shape
+    _, page_size, _, kdim = k_pool.shape
     _, pages_per_seq = page_tables.shape
     scale = 1.0 / math.sqrt(dim)
-    quantized = k_scales is not None
+    quantized = 0
+    if k_scales is not None:
+        quantized = 4 if kdim * 2 == dim else 8
 
     if frontier_offset is None:
         frontier_offset = 0
@@ -268,8 +296,8 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, slot_ids,
     in_specs = [
         pl.BlockSpec((1, heads, dim),
                      lambda t, j, sid, pt, lens, offv: (t, 0, 0)),
-        pl.BlockSpec((1, page_size, heads, dim), page_map),
-        pl.BlockSpec((1, page_size, heads, dim), page_map),
+        pl.BlockSpec((1, page_size, heads, kdim), page_map),
+        pl.BlockSpec((1, page_size, heads, kdim), page_map),
     ]
     inputs = [q, k_pool, v_pool]
     if quantized:
@@ -308,9 +336,11 @@ def _qblock_call(q, k_pool, v_pool, page_tables, slot_ids, kv_lens,
     gathered once per BLOCK through the slot of the block's first row
     (the slot-major contract — one slot per block)."""
     tokens, heads, dim = q.shape
-    _, page_size, _, _ = k_pool.shape
+    _, page_size, _, kdim = k_pool.shape
     _, pages_per_seq = page_tables.shape
-    quantized = k_scales is not None
+    quantized = 0
+    if k_scales is not None:
+        quantized = 4 if kdim * 2 == dim else 8
     nblocks = tokens // qb
 
     kernel = functools.partial(
@@ -344,8 +374,8 @@ def _qblock_call(q, k_pool, v_pool, page_tables, slot_ids, kv_lens,
     in_specs = [
         pl.BlockSpec((qb, heads, dim),
                      lambda b, j, sid, pt, lens, offv: (b, 0, 0)),
-        pl.BlockSpec((1, page_size, heads, dim), page_map),
-        pl.BlockSpec((1, page_size, heads, dim), page_map),
+        pl.BlockSpec((1, page_size, heads, kdim), page_map),
+        pl.BlockSpec((1, page_size, heads, kdim), page_map),
     ]
     inputs = [q, k_pool, v_pool]
     if quantized:

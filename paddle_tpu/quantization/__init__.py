@@ -89,24 +89,32 @@ def _search_scale_mse_per_channel(wv, scale0, red, bits=8, fracs=None):
     return best_s
 
 
-def quantize_weight_int8(w, axis=None, search_mse=False):
-    """→ (int8 array, float32 scale — per-channel ndarray (keepdims
-    shape) when `axis` is given, np.float32 scalar otherwise).
+def quantize_weight_int8(w, axis=None, search_mse=False, bits=8):
+    """→ (int8 array of [-qmax, qmax] codes, float32 scale —
+    per-channel ndarray (keepdims shape) when `axis` is given,
+    np.float32 scalar otherwise).
 
-    search_mse=True refines each scale by the MSE clip search instead of
-    plain absmax (what `QuantizedLinear.freeze` uses)."""
+    search_mse=True refines each scale by the MSE clip search instead
+    of plain absmax (what `QuantizedLinear.freeze` uses). `bits` sets
+    the code width (qmax = 2^(bits-1) − 1): at 8 bits the search
+    nearly always lands on absmax (the never-worse safety net); at 4
+    bits (15 levels) clipping real outliers buys grid resolution and
+    the search becomes LOAD-BEARING — `runtime.Int4WeightOnlyLinear`
+    always runs it."""
+    qmax = float(2 ** (bits - 1) - 1)
     wv = np.asarray(value_of(ensure_tensor(w)))
     if axis is None:
         scale = np.abs(wv).max() or 1e-8
         if search_mse:
-            scale = _search_scale_mse(wv, scale)
-        q = np.clip(np.round(wv / scale * 127.0), -127, 127).astype(np.int8)
+            scale = _search_scale_mse(wv, scale, bits=bits)
+        q = np.clip(np.round(wv / scale * qmax), -qmax, qmax).astype(
+            np.int8)
         return q, np.float32(scale)
     red = tuple(d for d in range(wv.ndim) if d != axis)
     scale = np.maximum(np.abs(wv).max(axis=red, keepdims=True), 1e-8)
     if search_mse:
-        scale = _search_scale_mse_per_channel(wv, scale, red)
-    q = np.clip(np.round(wv / scale * 127.0), -127, 127).astype(np.int8)
+        scale = _search_scale_mse_per_channel(wv, scale, red, bits=bits)
+    q = np.clip(np.round(wv / scale * qmax), -qmax, qmax).astype(np.int8)
     # the per-channel keepdims shape must SURVIVE: np.float32(arr)
     # collapses size-1 arrays to a 0-d scalar on older numpy, silently
     # turning per-channel dequant into per-tensor (regression-tested)

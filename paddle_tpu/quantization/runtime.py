@@ -44,13 +44,46 @@ from .. import nn
 from ..ops._helpers import apply_jfn, ensure_tensor
 
 __all__ = [
-    "Int8WeightOnlyLinear", "quantize_model_int8", "resolve_kv_dtype",
-    "kv_scale_shape", "quantize_kv_rows", "dequantize_kv",
+    "Int8WeightOnlyLinear", "Int4WeightOnlyLinear", "quantize_model_int8",
+    "quantize_model_int4", "resolve_kv_dtype", "kv_scale_shape",
+    "quantize_kv_rows", "dequantize_kv", "pack_int4", "unpack_int4",
+    "quantize_kv_rows_int4", "dequantize_kv_int4",
     "quant_allreduce_enabled", "wire_eligible", "encode_int8_wire",
     "decode_int8_wire", "WIRE_MAGIC",
 ]
 
 QMAX = 127.0
+QMAX4 = 7.0
+
+
+# ------------------------------------------------------------- int4 pack
+
+def pack_int4(codes, axis=0):
+    """int8 codes in [-8, 7] → packed bytes, HALF the size along `axis`
+    (which must be even-sized). Split-halves layout: byte j holds code
+    j (low nibble) and code j + size/2 (high nibble), so unpacking is a
+    cheap CONCATENATE of the two de-nibbled halves — never an
+    interleave reshape (the Pallas paged-attention kernel unpacks in
+    VMEM, where a lane-dim interleave would not lower)."""
+    codes = jnp.asarray(codes)
+    n = codes.shape[axis]
+    if n % 2:
+        raise ValueError(f"pack_int4: axis {axis} size {n} is odd")
+    lo, hi = jnp.split(codes, 2, axis=axis)
+    lo_u = lo.astype(jnp.uint8) & jnp.uint8(0x0F)
+    hi_u = (hi.astype(jnp.uint8) & jnp.uint8(0x0F)) << 4
+    return (lo_u | hi_u).astype(jnp.int8)
+
+
+def unpack_int4(packed, axis=0):
+    """Inverse of `pack_int4`: packed int8 bytes → sign-extended int8
+    codes, double the size along `axis`. Pure shift/mask arithmetic in
+    int32 (the `(x ^ 8) - 8` sign-extension), so it lowers identically
+    under XLA and inside Pallas kernels."""
+    p = jnp.asarray(packed).astype(jnp.int32) & 0xFF
+    lo = (((p & 0xF) ^ 8) - 8).astype(jnp.int8)
+    hi = ((((p >> 4) & 0xF) ^ 8) - 8).astype(jnp.int8)
+    return jnp.concatenate([lo, hi], axis=axis)
 
 
 # ---------------------------------------------------------------- weights
@@ -119,6 +152,81 @@ class Int8WeightOnlyLinear(nn.Layer):
                 f"weight=int8 per-channel")
 
 
+class Int4WeightOnlyLinear(nn.Layer):
+    """Serving-time Linear over per-channel PACKED int4 weights — the
+    lower-bit sibling of `Int8WeightOnlyLinear` (half the weight bytes
+    again: two nibbles per byte along the in-dim, split-halves layout).
+
+    At 4 bits (15 levels) plain absmax wastes most of the grid on one
+    outlier, so the MSE clip search (`quantize_weight_int8(bits=4,
+    search_mse=True)` — documented in PR 4 as "the knob that matters at
+    int4") is ALWAYS on. Forward: unpack nibbles → sign-extended int8
+    codes → the same dynamic per-row activation quant →
+    `dot_general(int8, int8, preferred_element_type=int32)` → dequant
+    epilogue. The unpack is shift/mask arithmetic the compiler fuses
+    into the matmul's operand read; HBM (and `state_dict()` /
+    checkpoint bytes) stay packed.
+
+    in_features must be even (nibble pairing); `quantize_model_int4`
+    leaves odd layers unquantized. TP note: the packed in-dim interleaves
+    rows j and j+in/2 into one byte, so row/column mesh sharding of the
+    packed buffer would split activation rows non-contiguously — int4
+    buffers stay REPLICATED (use int8 for TP-sharded weight-stationary
+    serving)."""
+
+    def __init__(self, linear, post_shard=None):
+        super().__init__()
+        from . import quantize_weight_int8
+        from ..tensor_core import Tensor
+
+        w = linear.weight  # [in, out] (paddle layout)
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        if self.in_features % 2:
+            raise ValueError(
+                f"Int4WeightOnlyLinear: in_features "
+                f"{self.in_features} is odd — nibble packing pairs "
+                "in-dim rows (quantize_model_int4 skips such layers)")
+        q, scale = quantize_weight_int8(w, axis=1, bits=4,
+                                        search_mse=True)  # scale [1, out]
+        self.register_buffer("weight_q",
+                             Tensor(pack_int4(jnp.asarray(q), axis=0)))
+        self.register_buffer("w_step", Tensor(
+            jnp.asarray(np.asarray(scale, np.float32) / QMAX4)))
+        self.bias = getattr(linear, "bias", None)
+        self._post_shard = post_shard
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+
+        def jfn(v, wq_packed, wstep, *b):
+            wq = unpack_int4(wq_packed, axis=0)     # [in, out] int8
+            f = v.astype(jnp.float32)
+            a_step = jnp.maximum(
+                jnp.max(jnp.abs(f), axis=-1, keepdims=True), 1e-8) / QMAX
+            qv = jnp.clip(jnp.round(f / a_step), -QMAX, QMAX).astype(
+                jnp.int8)
+            acc = lax.dot_general(
+                qv, wq, (((f.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * a_step * wstep
+            if b:
+                out = out + b[0].astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        args = (x, self.weight_q, self.w_step)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        out = apply_jfn("int4_weight_only_matmul", jfn, *args)
+        if self._post_shard is not None:
+            out = self._post_shard(out)
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"weight=int4 packed per-channel (MSE clip)")
+
+
 def _linear_classes():
     from .. import nn
     from ..distributed.fleet.meta_parallel.mp_layers import (
@@ -172,7 +280,8 @@ def quantize_model_int8(model, skip=(), tp_shard=True):
     def swap(layer, prefix=""):
         for name, sub in list(layer.named_children()):
             path = f"{prefix}.{name}" if prefix else name
-            if isinstance(sub, (Int8WeightOnlyLinear, QuantizedLinear)):
+            if isinstance(sub, (Int8WeightOnlyLinear, Int4WeightOnlyLinear,
+                                QuantizedLinear)):
                 continue  # already quantized (runtime or QAT stack)
             if isinstance(sub, linear_types) and not any(
                     s in path for s in skip):
@@ -207,6 +316,52 @@ def quantize_model_int8(model, skip=(), tp_shard=True):
     return report
 
 
+def quantize_model_int4(model, skip=()):
+    """`quantize_model_int8`'s packed-int4 sibling: swap every
+    Linear-family sublayer for `Int4WeightOnlyLinear` in place (MSE
+    clip search per out-channel — load-bearing at 4 bits). Layers with
+    an ODD in_features cannot nibble-pair and are left unquantized
+    (counted in the report as `skipped_odd`). Buffers stay REPLICATED
+    on a mesh (see the class TP note); embeddings/tied head stay float
+    as in the int8 path.
+
+    Returns {layers, skipped_odd, weight_bytes_fp, weight_bytes_int4}.
+    """
+    from . import QuantizedLinear
+
+    linear_types = _linear_classes()
+    report = {"layers": 0, "skipped_odd": 0,
+              "weight_bytes_fp": 0, "weight_bytes_int4": 0}
+
+    def swap(layer, prefix=""):
+        for name, sub in list(layer.named_children()):
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, (Int4WeightOnlyLinear,
+                                Int8WeightOnlyLinear, QuantizedLinear)):
+                continue
+            if isinstance(sub, linear_types) and not any(
+                    s in path for s in skip):
+                w = sub.weight._value
+                if int(w.shape[0]) % 2:
+                    report["skipped_odd"] += 1
+                    continue
+                wrapped = Int4WeightOnlyLinear(
+                    sub, post_shard=_post_shard_for(sub))
+                report["layers"] += 1
+                report["weight_bytes_fp"] += int(
+                    w.size * w.dtype.itemsize)
+                report["weight_bytes_int4"] += int(
+                    wrapped.weight_q._value.nbytes
+                    + wrapped.w_step._value.nbytes)
+                setattr(layer, name, wrapped)
+            else:
+                swap(sub, path)
+
+    swap(model)
+    model.eval()
+    return report
+
+
 # ---------------------------------------------------------------- kv cache
 
 _KV_DTYPES = {
@@ -217,24 +372,30 @@ _KV_DTYPES = {
 
 
 def resolve_kv_dtype(requested, compute_dtype):
-    """(requested | $PT_KV_DTYPE | model compute dtype) → (jnp dtype,
-    quantized?). `requested` may be a string name or a dtype."""
+    """(requested | $PT_KV_DTYPE | model compute dtype) → (storage jnp
+    dtype, quantized bits). `requested` may be a string name or a
+    dtype. `bits` is 0 for float pools, 8 for int8, 4 for packed int4
+    (storage dtype int8, head_dim HALVED in the pool — two nibbles per
+    byte; truthiness keeps every existing `if quantized:` site
+    working)."""
     req = requested
     if req is None:
         req = os.environ.get("PT_KV_DTYPE", "").strip() or None
     if req is None:
         dt = jnp.dtype(compute_dtype)
-        return dt, False
+        return dt, 0
     if isinstance(req, str):
         key = req.lower()
+        if key in ("int4", "i4"):
+            return jnp.dtype(jnp.int8), 4
         if key not in _KV_DTYPES:
             raise ValueError(
                 f"unknown kv_dtype {req!r}: expected one of "
-                f"{sorted(set(_KV_DTYPES))}")
+                f"{sorted(set(_KV_DTYPES) | {'int4'})}")
         dt = jnp.dtype(_KV_DTYPES[key])
     else:
         dt = jnp.dtype(req)
-    return dt, dt == jnp.dtype(jnp.int8)
+    return dt, 8 if dt == jnp.dtype(jnp.int8) else 0
 
 
 def kv_scale_shape(num_pages, page_size, num_heads):
@@ -261,6 +422,27 @@ def dequantize_kv(q, scale):
     """Inverse of `quantize_kv_rows` (broadcasts a trailing dim onto
     the scales)."""
     return q.astype(jnp.float32) * scale[..., None]
+
+
+def quantize_kv_rows_int4(x):
+    """[T, H, D] float → (packed int4 values [T, H, D/2], fp32 scales
+    [T, H]). Per-(token, head) absmax against qmax 7 (15 levels);
+    dequant error ≤ absmax/14 per element — measurably coarser than
+    int8, which is why the engine acceptance pins greedy token-match
+    ≥ 0.95 rather than int8's 0.98. Packed split-halves along head_dim
+    (`pack_int4`), so the pool's last dim is D/2 and the existing
+    per-row scale planes carry the dequant exactly as for int8."""
+    f = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / QMAX4
+    q = jnp.clip(jnp.round(f / scale[..., None]), -QMAX4, QMAX4).astype(
+        jnp.int8)
+    return pack_int4(q, axis=-1), scale
+
+
+def dequantize_kv_int4(packed, scale):
+    """Inverse of `quantize_kv_rows_int4` → [T, H, D] float32."""
+    return unpack_int4(packed, axis=-1).astype(jnp.float32) \
+        * scale[..., None]
 
 
 # ---------------------------------------------------------------- wire

@@ -240,31 +240,42 @@ def _paged_cache_write(k_pool, v_pool, k_new, v_new, write_idx):
 
 def _paged_cache_write_quant(k_pool, v_pool, k_scales, v_scales, k_new,
                              v_new, write_idx):
-    """Int8 variant of `_paged_cache_write`: each incoming k/v row is
-    quantized per (token, head) absmax (quantization.runtime
-    `quantize_kv_rows`) and scattered into the int8 pools, with its
-    fp32 scale scattered into the page-shaped scale planes at the same
-    flat row. A row is quantized exactly once with its own scale, so
-    later writes to the same page never invalidate earlier tokens."""
+    """Int8/int4 variant of `_paged_cache_write`: each incoming k/v row
+    is quantized per (token, head) absmax (quantization.runtime
+    `quantize_kv_rows` / `quantize_kv_rows_int4`) and scattered into
+    the quantized pools, with its fp32 scale scattered into the
+    page-shaped scale planes at the same flat row. A row is quantized
+    exactly once with its own scale, so later writes to the same page
+    never invalidate earlier tokens.
+
+    The pool's last dim picks the codec: head_dim → int8 rows,
+    head_dim/2 → PACKED int4 (two nibbles per byte, `kv_dtype="int4"`
+    — the shape mismatch is unambiguous, so the compiled step needs no
+    extra bits argument threaded through)."""
     import jax.numpy as jnp
 
     from ...ops._helpers import apply_jfn
     from ...quantization import runtime as _qrt
+
+    packed4 = int(k_pool.shape[-1]) * 2 == int(k_new.shape[-1])
+    quant_rows = (_qrt.quantize_kv_rows_int4 if packed4
+                  else _qrt.quantize_kv_rows)
 
     def jfn(kp, vp, ks, vs, kn, vn, idx):
         shape = kp.shape
         flat = (shape[0] * shape[1],) + shape[2:]
         sflat = (shape[0] * shape[1],) + ks.shape[2:]
         idx = idx.astype(jnp.int32)
-        kq, kscale = _qrt.quantize_kv_rows(kn)
-        vq, vscale = _qrt.quantize_kv_rows(vn)
+        kq, kscale = quant_rows(kn)
+        vq, vscale = quant_rows(vn)
         kp2 = kp.reshape(flat).at[idx].set(kq).reshape(shape)
         vp2 = vp.reshape(flat).at[idx].set(vq).reshape(shape)
         ks2 = ks.reshape(sflat).at[idx].set(kscale).reshape(ks.shape)
         vs2 = vs.reshape(sflat).at[idx].set(vscale).reshape(vs.shape)
         return kp2, vp2, ks2, vs2
 
-    return apply_jfn("paged_cache_write_int8", jfn, k_pool, v_pool,
+    return apply_jfn("paged_cache_write_int4" if packed4
+                     else "paged_cache_write_int8", jfn, k_pool, v_pool,
                      k_scales, v_scales, k_new, v_new, write_idx)
 
 

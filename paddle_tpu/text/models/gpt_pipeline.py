@@ -225,6 +225,12 @@ class PipelinedGPTForCausalLM(nn.Layer):
     serial parity. The aux term itself is always the global-batch value
     (gate statistics psum'd over every token-sharding axis)."""
 
+    # dp-axis gradient all-reduce in block-scaled int8 (EQuARX in-XLA,
+    # distributed.quant_collective): None = follow the
+    # PT_QUANT_ALLREDUCE_XLA env; set True/False explicitly via
+    # HybridTrainStep(quant_allreduce=...) / Hybrid3DConfig
+    quant_allreduce = None
+
     def __init__(self, config: GPTConfig, n_micro=4, remat="stage",
                  n_virtual=1, moe_experts=0, moe_hidden=None,
                  moe_aux_weight=0.01, moe_capacity_factor=1.25,
@@ -460,9 +466,20 @@ class PipelinedGPTForCausalLM(nn.Layer):
             dp_axis = "dp"
             x_spec = P(None, "dp", seq, None)
             y_spec = P(None, "dp", seq)
+        # quantized dp grad all-reduce: the model attribute is set by
+        # HybridTrainStep(quant_allreduce=...)/Hybrid3DConfig; None
+        # falls back to the PT_QUANT_ALLREDUCE_XLA env opt-in. Read at
+        # TRACE time, so extract_schedule/collective_schedule see the
+        # same program the step dispatches.
+        quant = self.quant_allreduce
+        if quant is None:
+            from ...distributed.quant_collective import xla_quant_enabled
+
+            quant = xla_quant_enabled()
         return PipelineSpecs(stacked=stacked, post=post, x=x_spec,
                              y=y_spec, dp_axis=dp_axis,
-                             sum_axes=("sp",) if sp > 1 else None)
+                             sum_axes=("sp",) if sp > 1 else None,
+                             quant_dp=bool(quant) and dp_axis is not None)
 
     # ---- API ----
     def forward(self, input_ids):

@@ -1,0 +1,17 @@
+"""ptlint seeded violation: PTL301 on the packed-nibble int4 path.
+
+unpack_int4 yields sign-extended int8 CODES — a dot_general over them
+without preferred_element_type accumulates in int8 and overflows
+exactly like the plain astype(int8) case (the quantized runtime's
+Int4WeightOnlyLinear contract). Never executed — linted only.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.quantization.runtime import unpack_int4
+
+
+def int4_matmul(act_q, packed_w):
+    w_codes = unpack_int4(packed_w, axis=0)
+    return lax.dot_general(act_q, w_codes, (((1,), (0,)), ((), ())))  # FLAG

@@ -77,6 +77,20 @@ def int8_matmul(a, b):
                            preferred_element_type=jnp.int32)
 
 
+def int4_matmul(act_q, packed_w):
+    # PTL301 int4 mirror: unpacked nibble codes are int8-family — the
+    # dot carries preferred_element_type, and the FLOAT dequant helper
+    # (dequantize_kv_int4) is not an int8 carrier at all
+    from paddle_tpu.quantization.runtime import (dequantize_kv_int4,
+                                                 unpack_int4)
+
+    w_codes = unpack_int4(packed_w, axis=0)
+    acc = lax.dot_general(act_q, w_codes, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    kv = dequantize_kv_int4(packed_w, jnp.float32(1.0))
+    return acc, kv @ kv.T
+
+
 def sync_all(rank, grads):
     # PTL401: every rank makes the same collective sequence; the
     # rank-dependent part is data, not control flow

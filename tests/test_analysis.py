@@ -48,7 +48,9 @@ GATED_PATHS = [os.path.join(REPO, p)
 # --------------------------------------------------------------------
 
 def _expected(path):
-    rule = "PTL" + re.search(r"bad_ptl(\d+)\.py", path).group(1)
+    # fixture names may carry a variant suffix (bad_ptl301_int4.py —
+    # the packed-nibble extension of the int8 rule)
+    rule = "PTL" + re.search(r"bad_ptl(\d+)", path).group(1)
     with open(path) as f:
         lines = [i + 1 for i, ln in enumerate(f) if "# FLAG" in ln]
     assert len(lines) == 1, f"fixture {path} needs exactly one # FLAG"

@@ -48,6 +48,8 @@ pytestmark = pytest.mark.analysis
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "tests", "golden",
                       "hybrid3d_dp2tp2pp2_schedule.json")
+GOLDEN_QUANT = os.path.join(REPO, "tests", "golden",
+                            "hybrid3d_dp2tp2pp2_quant_schedule.json")
 
 CFG = GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
                 num_heads=4, max_seq_len=32)
@@ -60,8 +62,9 @@ def _fresh_mesh():
     mesh_mod.reset_mesh()
 
 
-def _hybrid_step():
-    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+def _hybrid_step(quant_allreduce=False):
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2,
+                                    quant_allreduce=quant_allreduce)
     mesh_mod.reset_mesh()
     hybrid3d.init_hybrid_mesh(cfg3d)
     paddle.seed(0)
@@ -131,6 +134,46 @@ def test_golden_hybrid3d_schedule_and_rank_invariance(monkeypatch):
     findings = check_placement(step_r1)
     assert [f.rule for f in findings] == ["PTL602"], findings
     assert "re-placed" in findings[0].message
+
+
+def test_golden_quant_schedule_dp_bytes_drop_3x():
+    """The ISSUE-12 tentpole gate: with quant_allreduce=True the SAME
+    tier-1 dp2.tp2.pp2 step compiles the pinned QUANTIZED schedule
+    (tests/golden/hybrid3d_dp2tp2pp2_quant_schedule.json) — the
+    dp-axis gradient payload is >= 3x smaller than the exact golden's
+    (the int8 exchange: pmax shared scales / ppermute int8
+    reduce-scatter / all_gather int8+scales) while the mp and pp axes
+    stay byte-identical (the quantizer must not touch them)."""
+    with open(GOLDEN) as f:
+        base = json.load(f)
+    with open(GOLDEN_QUANT) as f:
+        golden = json.load(f)
+
+    step, ids = _hybrid_step(quant_allreduce=True)
+    sched = step.collective_schedule(ids)
+
+    got_keys = [[c.op, list(c.axes), c.reduce, c.bytes, c.count]
+                for c in sched.ops]
+    assert got_keys == golden["keys"], (
+        "quantized hybrid3d collective schedule drifted from the "
+        "golden — if intentional, regenerate "
+        "tests/golden/hybrid3d_dp2tp2pp2_quant_schedule.json and "
+        "justify the new per-axis bytes in docs/PERF_NOTES.md")
+    got_bytes = sched.per_axis_bytes
+    assert got_bytes == {k: int(v)
+                         for k, v in golden["per_axis_bytes"].items()}
+    # the acceptance floor: >= 3x fewer dp bytes than the exact step
+    base_dp = int(base["per_axis_bytes"]["dp"])
+    assert got_bytes["dp"] * 3 <= base_dp, (got_bytes["dp"], base_dp)
+    # the int8 payload IS visible to the byte accounting: the exchange
+    # ops (ppermute reduce-scatter + all_gather) ride int8 avals
+    exch = [c for c in sched.ops
+            if "dp" in c.axes and c.op in ("ppermute", "all_gather")]
+    assert exch, "int8 exchange collectives missing from the schedule"
+    # mp/pp untouched, byte-identical to the exact golden
+    assert got_bytes["mp"] == int(base["per_axis_bytes"]["mp"])
+    assert got_bytes["pp"] == int(base["per_axis_bytes"]["pp"])
+    assert sched.findings == [], [f.format() for f in sched.findings]
 
 
 def test_analyze_step_carries_collectives_summary():
