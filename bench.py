@@ -1304,6 +1304,268 @@ def bench_llm_fleet():
     }
 
 
+def bench_llm_fleet_multi():
+    """Multi-replica fleet A/B (ISSUE-13 acceptance): the SAME shared-
+    prefix Poisson workload served by ONE engine (threaded LLMServer,
+    fused decode, prefix cache) and by a 2-replica FleetRouter
+    (radix-affinity routing, each replica its own forked model +
+    pools). Headline: aggregate tok/s ratio (the capacity-doubling
+    claim — the single engine is slot-saturated by the arrival rate,
+    the fleet has 2x slots), plus router TTFT p50/p99, affinity hit
+    rate and per-replica occupancy. Phases interleave M/S/M/S and each
+    side scores its best run (the llm_serve noise defense); greedy
+    outputs must be token-identical across ALL sides.
+
+    Two guarded extra scenarios (a stamp failure can't kill the
+    headline): a seeded replica-kill mid-stream (failover requeue,
+    outputs still token-identical) and a long-prompt PREFILL STORM
+    A/B — short interactive TTFT p99 with the storm prefilling on a
+    dedicated prefill replica (KV pages streamed to the decode
+    replica) vs mixed into the single engine."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.distributed import chaos
+    from paddle_tpu.inference.fleet_serving import (AutoscalePolicy,
+                                                    FleetRouter,
+                                                    LocalReplica,
+                                                    fork_model)
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
+
+    paddle.seed(0)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        cfg, n_req, sys_len, max_suffix = gpt_tiny(), 64, 32, 16
+        name = "gpt-tiny-llm-fleet-multi"
+    else:
+        cfg, n_req, sys_len, max_suffix = gpt_small(), 96, 96, 32
+        name = "gpt-small-llm-fleet-multi"
+    base = GPTForCausalLM(cfg)
+    base.eval()
+    rng = np.random.default_rng(0)
+    # 4 tenant groups, each sharing a system prompt — the affinity
+    # workload: the router should concentrate each group on one
+    # replica (hit rate > 0.5 is the acceptance floor)
+    sys_prompts = [rng.integers(0, cfg.vocab_size, (sys_len,)).astype(
+        np.int32) for _ in range(4)]
+    prompts = [np.concatenate([sys_prompts[j % 4], rng.integers(
+        0, cfg.vocab_size, (int(L),)).astype(np.int32)])
+        for j, L in enumerate(rng.integers(4, max_suffix + 1, n_req))]
+    gens = rng.integers(24, 49, n_req)
+    # arrival rate chosen to SATURATE one 4-slot engine (queue builds),
+    # so the fleet's extra slots are the binding resource under test
+    arrive = np.cumsum(rng.exponential(0.002, n_req))
+    fused_k = int(os.environ.get("BENCH_DECODE_K", "8"))
+    ecfg_kw = dict(num_slots=4, page_size=16, token_budget=48,
+                   max_model_len=sys_len + max_suffix + 40,
+                   prefix_cache=True, decode_k=fused_k)
+
+    def pctl(lat, p):
+        vals = [v for v in lat if v is not None]
+        return float(np.percentile(np.asarray(vals), p)) if vals else -1.0
+
+    def drive(submit, arrivals=None, plist=None):
+        """Poisson-feed `plist` (default: the main workload) through
+        `submit(j, prompt) -> Future`; returns (outputs, client-TTFTs,
+        makespan). ONE driver for every phase — single, fleet, and the
+        storm A/B must pace and stamp identically or the comparison
+        silently measures different things."""
+        arrivals = arrive if arrivals is None else arrivals
+        plist = prompts if plist is None else plist
+        n = len(plist)
+        futs, stamps, nxt = [None] * n, [None] * n, 0
+        t0 = time.perf_counter()
+        while nxt < n:
+            now = time.perf_counter() - t0
+            if arrivals[nxt] <= now:
+                stamps[nxt] = time.perf_counter()
+                futs[nxt] = submit(nxt, plist[nxt])
+                nxt += 1
+            else:
+                time.sleep(min(0.002, arrivals[nxt] - now))
+        outs = [f.result(timeout=600) for f in futs]
+        total = time.perf_counter() - t0
+        ttfts = []
+        for f, s in zip(futs, stamps):
+            req = getattr(f, "pt_request", None)
+            t = getattr(req, "t_first_token", None)
+            ttfts.append(None if t is None else t - s)
+        return outs, ttfts, total
+
+    def run_single():
+        server = inference.LLMServer(
+            fork_model(base), inference.LLMEngineConfig(**ecfg_kw))
+        with server:
+            # warm both executables outside the timed window
+            server.submit(np.zeros((2,), np.int32),
+                          max_new_tokens=fused_k + 1).result(timeout=300)
+            outs, ttfts, total = drive(
+                lambda j, p: server.submit(
+                    p, max_new_tokens=int(gens[j])))
+            occ = server.engine.mean_occupancy
+        return outs, ttfts, total, occ
+
+    def make_replica(nm, role="serve"):
+        return LocalReplica(fork_model(base), name=nm, role=role,
+                            config=inference.LLMEngineConfig(**ecfg_kw))
+
+    def run_multi(tag, chaos_kill=None):
+        names = [f"{tag}0", f"{tag}1"]
+        if chaos_kill is not None:
+            chaos.install({"seed": 13, "injectors": [
+                {"scope": f"replica.kill.{names[0]}", "kind": "error",
+                 "at": [chaos_kill]}]})
+        router = FleetRouter(
+            replicas=[make_replica(nm) for nm in names],
+            hash_block_tokens=16,
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                   heartbeat_timeout_s=1.0,
+                                   poll_s=0.01))
+        try:
+            with router:
+                outs, _, total = drive(
+                    lambda j, p: router.submit(
+                        p, max_new_tokens=int(gens[j])))
+                m = router.metrics()
+        finally:
+            if chaos_kill is not None:
+                chaos.clear()
+        return outs, total, m
+
+    m_runs, s_runs = [], []
+    for rep in range(2):
+        m_runs.append(run_multi(f"m{rep}r"))
+        log(f"[bench] llm_fleet_multi fleet[{rep}]: "
+            f"{m_runs[-1][1]:.2f}s, affinity "
+            f"{m_runs[-1][2]['affinity_hit_rate']:.2f}")
+        s_runs.append(run_single())
+        log(f"[bench] llm_fleet_multi single[{rep}]: "
+            f"{s_runs[-1][2]:.2f}s")
+    m_out, m_total, m_metrics = min(m_runs, key=lambda r: r[1])
+    s_out, s_ttft, s_total, s_occ = min(s_runs, key=lambda r: r[2])
+    match = all(np.array_equal(a, b) for a, b in zip(s_out, m_out))
+    gen_tokens = sum(len(s_out[j]) - len(prompts[j])
+                     for j in range(n_req))
+    s_tps, m_tps = gen_tokens / s_total, gen_tokens / m_total
+    log(f"[bench] llm_fleet_multi: fleet {m_tps:,.0f} tok/s vs single "
+        f"{s_tps:,.0f} ({m_tps / s_tps:.2f}x), affinity "
+        f"{m_metrics['affinity_hit_rate']:.2f}, greedy_match={match}")
+    result = {
+        "model": name, "requests": n_req, "gen_tokens": gen_tokens,
+        "decode_k": fused_k, "replicas": 2,
+        "greedy_match": bool(match),
+        "tok_s": {"single": round(s_tps), "fleet": round(m_tps)},
+        "speedup_fleet_vs_single": round(m_tps / s_tps, 3),
+        "affinity_hit_rate": round(m_metrics["affinity_hit_rate"], 4),
+        "router_ttft_ms": {
+            "p50": round((m_metrics["ttft_p50_s"] or 0) * 1e3, 1),
+            "p99": round((m_metrics["ttft_p99_s"] or 0) * 1e3, 1)},
+        "single_ttft_ms": {
+            "p50": round(pctl(s_ttft, 50) * 1e3, 1),
+            "p99": round(pctl(s_ttft, 99) * 1e3, 1)},
+        "per_replica_occupancy": {
+            nm: round(v["mean_slot_occupancy"], 3)
+            for nm, v in m_metrics["replicas"].items()},
+        "single_occupancy": round(s_occ, 3),
+        "totals_s": {"fleet": [round(r[1], 2) for r in m_runs],
+                     "single": [round(r[2], 2) for r in s_runs]},
+    }
+
+    # guarded extra 1: seeded replica-kill recovery mid-stream
+    try:
+        k_out, k_total, k_metrics = run_multi("kill", chaos_kill=12)
+        k_match = all(np.array_equal(a, b)
+                      for a, b in zip(s_out, k_out))
+        result["replica_kill_recovery"] = {
+            "greedy_match": bool(k_match),
+            "replicas_lost": k_metrics["replicas_lost"],
+            "requeues": k_metrics["requeues"],
+            "total_s": round(k_total, 2),
+            "tok_s": round(gen_tokens / k_total),
+        }
+        log(f"[bench] llm_fleet_multi kill-recovery: match={k_match}, "
+            f"requeues={k_metrics['requeues']}, {k_total:.2f}s")
+    except Exception as e:
+        log(f"[bench] llm_fleet_multi kill-recovery stamp failed: "
+            f"{e!r}")
+        result["replica_kill_recovery"] = {"error": repr(e)}
+
+    # guarded extra 2: long-prompt prefill storm — disaggregated
+    # prefill replica vs everything on one engine; the decode-side
+    # interactive TTFT p99 is the measured win
+    try:
+        n_short, n_long = 12, 8
+        long_len = ecfg_kw["max_model_len"] - 12
+        shorts = [rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+                  for _ in range(n_short)]
+        longs = [rng.integers(0, cfg.vocab_size,
+                              (long_len,)).astype(np.int32)
+                 for _ in range(n_long)]
+        storm, kinds = [], []
+        for i in range(max(n_short, n_long)):
+            if i < n_long:
+                storm.append(longs[i])
+                kinds.append("long")
+            if i < n_short:
+                storm.append(shorts[i])
+                kinds.append("short")
+        s_arrive = np.cumsum(
+            rng.exponential(0.004, len(storm)))
+
+        def storm_gen(j):
+            return 16 if kinds[j] == "short" else 8
+
+        server = inference.LLMServer(
+            fork_model(base), inference.LLMEngineConfig(**ecfg_kw))
+        with server:
+            server.submit(np.zeros((2,), np.int32),
+                          max_new_tokens=fused_k + 1).result(timeout=300)
+            sp_out, sp_ttft, _ = drive(
+                lambda j, p: server.submit(
+                    p, max_new_tokens=storm_gen(j)),
+                arrivals=s_arrive, plist=storm)
+        router = FleetRouter(
+            replicas=[make_replica("storm_d")],
+            prefill_replicas=[make_replica("storm_p", role="prefill")],
+            prefill_min_tokens=48,
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=1))
+        with router:
+            # router futures carry pt_request too (the FleetRouter
+            # contract mirrors LLMServer.submit), so the same driver
+            # paces and stamps both sides of the A/B
+            dp_out, dp_ttft, _ = drive(
+                lambda j, p: router.submit(
+                    p, max_new_tokens=storm_gen(j)),
+                arrivals=s_arrive, plist=storm)
+            dm = router.metrics()
+        storm_match = all(np.array_equal(a, b)
+                          for a, b in zip(sp_out, dp_out))
+        short_ttft_single = [t for t, k in zip(sp_ttft, kinds)
+                             if k == "short"]
+        short_ttft_disagg = [t for t, k in zip(dp_ttft, kinds)
+                             if k == "short"]
+        result["prefill_storm"] = {
+            "greedy_match": bool(storm_match),
+            "short_ttft_p99_ms": {
+                "single": round(pctl(short_ttft_single, 99) * 1e3, 1),
+                "disagg": round(pctl(short_ttft_disagg, 99) * 1e3, 1)},
+            "short_ttft_p50_ms": {
+                "single": round(pctl(short_ttft_single, 50) * 1e3, 1),
+                "disagg": round(pctl(short_ttft_disagg, 50) * 1e3, 1)},
+            "disagg_handoffs": dm["disagg_handoffs"],
+        }
+        log(f"[bench] llm_fleet_multi prefill-storm: short ttft p99 "
+            f"{result['prefill_storm']['short_ttft_p99_ms']['single']}"
+            f" -> "
+            f"{result['prefill_storm']['short_ttft_p99_ms']['disagg']}"
+            f" ms, match={storm_match}")
+    except Exception as e:
+        log(f"[bench] llm_fleet_multi prefill-storm stamp failed: "
+            f"{e!r}")
+        result["prefill_storm"] = {"error": repr(e)}
+    return result
+
+
 def bench_probe():
     """Prove the backend can COMPUTE, not just enumerate devices.
 
@@ -1460,6 +1722,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "llm_serve": bench_llm_serve,
             "llm_serve_int8": bench_llm_serve_int8,
             "llm_fleet": bench_llm_fleet,
+            "llm_fleet_multi": bench_llm_fleet_multi,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
 
@@ -1693,11 +1956,12 @@ def main():
         # 8 virtual devices; llm_serve and llm_fleet drop to gpt-tiny
         # traffic — llm_serve's small-batch A/B is the fused-decode
         # acceptance regime, ISSUE 8)
-        extras = ("llm_serve", "llm_fleet", "train_3d")
+        extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
+                  "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
-                  "train_3d")
+                  "llm_fleet_multi", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both

@@ -38,6 +38,11 @@ scope               injection point
 ``ckpt.kill_window``between shard write and meta.json commit
 ``step``            train-step entry (crash/hang at step N)
 ``step.nan``        StepGuard loss poisoning (NaN/Inf grad shape)
+``replica.kill``    fleet-replica serve-loop tick (fleet_serving
+                    .replica): a fired injector stops that replica's
+                    loop DEAD — no drain, no future resolution — and
+                    the router's failover requeues its in-flight work.
+                    ``replica.kill.<name>`` targets one replica.
 ==================  =====================================================
 
 Injector spec (JSON object inside the plan's ``injectors`` list)::

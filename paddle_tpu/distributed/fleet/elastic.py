@@ -20,7 +20,8 @@ import time
 from ...observability import metrics as _obs
 
 __all__ = ["ElasticStatus", "ElasticManager", "run_with_fault_tolerance",
-           "request_scale_out", "ELASTIC_EXIT_CODE"]
+           "request_scale_out", "ELASTIC_EXIT_CODE",
+           "touch_heartbeat", "remove_heartbeat"]
 
 # heartbeat telemetry: replaces ad-hoc age prints — the launcher, the
 # watch loop, and /metrics scrapes all read the same gauges
@@ -157,6 +158,39 @@ class ElasticManager:
             except OSError:
                 return 0
         return len(pending_join_files(self.hb_dir))
+
+
+# the heartbeat file protocol (env.py:_start_heartbeat writer,
+# ElasticManager.peers / the launcher readers), exposed for OTHER
+# heartbeat publishers — the fleet-serving replica runtime
+# (inference/fleet_serving/replica.py) registers its replicas through
+# these, so a serving fleet's liveness is observable via the SAME
+# ElasticManager view as a training pod's
+HB_PREFIX = "hb_"
+
+
+def touch_heartbeat(hb_dir, rank):
+    """Write/refresh `hb_<rank>` in the membership directory (same
+    format as the worker heartbeat thread: the beat wall-time). Returns
+    the path."""
+    import os
+
+    os.makedirs(hb_dir, exist_ok=True)
+    path = os.path.join(hb_dir, f"{HB_PREFIX}{int(rank)}")
+    with open(path, "w") as f:
+        f.write(str(time.time()))
+    return path
+
+
+def remove_heartbeat(hb_dir, rank):
+    """Tombstone one rank's heartbeat (clean exit must not read as a
+    wedged peer — the env.py atexit contract). Idempotent."""
+    import os
+
+    try:
+        os.unlink(os.path.join(hb_dir, f"{HB_PREFIX}{int(rank)}"))
+    except OSError:
+        pass
 
 
 # the join-request file protocol, shared by request_scale_out (writer),

@@ -27,7 +27,8 @@ __all__ = [
     "convert_to_mixed_precision", "InferenceServer", "BatchingConfig",
     "LLMEngine", "LLMEngineConfig", "LLMServer", "PagePool",
     "fleet_serving", "RadixPrefixCache", "SLAPolicy", "SLAScheduler",
-    "Priority", "SpeculativeDecoder",
+    "Priority", "SpeculativeDecoder", "FleetRouter", "AutoscalePolicy",
+    "LocalReplica", "ReplicaRegistry", "KVPagePayload",
 ]
 
 from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
@@ -36,7 +37,9 @@ from .llm_engine import (  # noqa: E402,F401
 from .speculative import SpeculativeDecoder  # noqa: E402,F401
 from . import fleet_serving  # noqa: E402,F401
 from .fleet_serving import (  # noqa: E402,F401
-    Priority, RadixPrefixCache, SLAPolicy, SLAScheduler)
+    AutoscalePolicy, FleetRouter, KVPagePayload, LocalReplica,
+    Priority, RadixPrefixCache, ReplicaRegistry, SLAPolicy,
+    SLAScheduler)
 
 
 class DataType:

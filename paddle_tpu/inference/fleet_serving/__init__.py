@@ -26,9 +26,39 @@ True, sla_policy=...)` and change NOTHING about the compiled decode
 step: sharing and scheduling are host-side page-table/queue policy, so
 the zero-recompile contract (ONE executable) holds with the cache on.
 
-Docs: docs/SERVING.md. Bench: `python bench.py --worker llm_fleet`.
+The MULTI-REPLICA tier (ISSUE 13) lives here too — N engines behind
+one surface:
+
+* **KV-page transfer** (`kv_transfer.py`) — a request's finished KV
+  pages (int8/int4 pools AND fp32 scale planes, byte-for-byte) as a
+  self-describing payload over the xproc p2p transport: the
+  disaggregated prefill→decode hand-off primitive.
+
+* **Replica runtime** (`replica.py`) — `LLMServer`+engine as a fleet
+  member: heartbeat registration into elastic-style membership,
+  prefill/serve roles, chaos-injectable kill.
+
+* **Fleet router** (`router.py`) — radix-affinity routing (longest
+  cached prefix wins, least-loaded fallback), prefill/decode
+  disaggregation, SLO autoscale, and chaos-proven failover (a killed
+  replica's in-flight requests requeue with token-identical greedy
+  outputs).
+
+Docs: docs/SERVING.md. Bench: `python bench.py --worker llm_fleet`
+(single engine) / `--worker llm_fleet_multi` (the 2-replica A/B).
 """
+from .kv_transfer import (KVPagePayload, pack_kv_payload,
+                          recv_kv_payload, send_kv_payload,
+                          unpack_kv_payload)
 from .prefix_cache import RadixPrefixCache
+from .replica import (LocalReplica, ReplicaRegistry, fork_model,
+                      recv_and_decode, stream_prefill)
+from .router import AutoscalePolicy, FleetRouter
 from .scheduler import Priority, SLAPolicy, SLAScheduler
 
-__all__ = ["RadixPrefixCache", "Priority", "SLAPolicy", "SLAScheduler"]
+__all__ = ["RadixPrefixCache", "Priority", "SLAPolicy", "SLAScheduler",
+           "KVPagePayload", "pack_kv_payload", "unpack_kv_payload",
+           "send_kv_payload", "recv_kv_payload",
+           "LocalReplica", "ReplicaRegistry", "fork_model",
+           "stream_prefill", "recv_and_decode",
+           "AutoscalePolicy", "FleetRouter"]

@@ -1,0 +1,173 @@
+"""KV-page transfer — the disaggregated-serving wire format.
+
+Prefill/decode disaggregation (docs/SERVING.md "Disaggregated fleet")
+moves a request's FINISHED KV pages from the replica that computed them
+to the replica that will decode from them. The transport primitive is
+cheap exactly because of the byte discipline PRs 4/12 already bought:
+an int8/int4 pool's pages plus their fp32 scale planes ARE the
+quantized wire format — the pool is stored pre-quantized, so streaming
+it byte-for-byte ships ~4x (int8) / ~7x (int4) fewer bytes than an
+fp32 KV re-materialization would, with zero re-encode work and zero
+additional quantization error (the decode replica attends over the
+IDENTICAL bytes the prefill replica wrote — greedy outputs cannot
+diverge; tests/test_fleet_router.py pins byte identity for
+fp32/int8/int4 including a mid-page frontier page).
+
+The payload is self-describing (`KVPagePayload`): the request's tokens,
+how many of them have KV written (`n_prefilled` — the frontier), the
+pool geometry it was cut from, and one page-array per layer pool (+ one
+scale-plane array per pool when quantized). `pack`/`unpack` give the
+byte form; `send_kv_payload`/`recv_kv_payload` move it over the xproc
+p2p transport — the same socket path (RetryPolicy reconnect/resend,
+chaos `sock.send`/`sock.recv` injection points) every other
+cross-process byte in this repo rides, so the KV stream inherits the
+PR-1 fault tolerance for free (the 2-proc chaos test injects faults on
+exactly this path).
+
+Engine surface: `LLMEngine.export_kv_pages(req)` cuts a payload,
+`LLMEngine.import_kv_pages(payload, ...)` admits it at its frontier
+(inference/llm_engine.py).
+"""
+import io
+import json
+import struct
+
+import numpy as np
+
+from ...observability import metrics as _obs
+
+__all__ = ["KVPagePayload", "pack_kv_payload", "unpack_kv_payload",
+           "send_kv_payload", "recv_kv_payload", "KV_STREAM_TAG"]
+
+# default p2p tag for the disaggregated KV stream (one logical channel;
+# routers multiplex per-request streams by sequencing on one tag — the
+# xproc inbox already orders frames per (src, tag, seq))
+KV_STREAM_TAG = 0x4B56  # "KV"
+
+_KV_PAGES_STREAMED = _obs.counter(
+    "pt_disagg_kv_pages_streamed",
+    "KV pages imported into a decode replica's pool from a prefill "
+    "replica's export (disaggregated serving, docs/SERVING.md "
+    "\"Disaggregated fleet\")")
+
+# frame: magic, version, meta-json length; then the meta json, then one
+# np.save blob per pool array (kv pools first, then scale planes).
+# np.save is byte-exact for every pool dtype this repo ships (fp32 /
+# bf16 via uint16 view is not needed — jnp bf16 pools export as their
+# numpy dtype), and self-describing, so unpack needs no shape math.
+_MAGIC = b"PTKV"
+_VERSION = 1
+_HDR = struct.Struct("<4sBI")
+
+
+class KVPagePayload:
+    """One request's exported KV pages (module docstring). Fields:
+
+    tokens       np.int32 [n] — the request's tokens (prompt so far)
+    n_prefilled  tokens whose KV rows the pages hold (the frontier —
+                 the last page may be PARTIALLY filled; rows past the
+                 frontier are whatever bytes the pool held and are
+                 masked by kv_len on the decode side, exactly as they
+                 are on the exporting engine)
+    page_size    tokens per page of the source pool
+    kv_dtype     source pool dtype label ("float32"/"bfloat16"/"int8"/
+                 "int4" — import requires an exact match: a cross-dtype
+                 import would silently reinterpret quantized codes)
+    kv           one np array [num_pages, page_size, H, D'] per layer
+                 pool (2 x num_layers: k then v interleaved in pool
+                 order), byte-for-byte as stored
+    scales       the fp32 scale planes [num_pages, page_size, H] per
+                 pool for quantized kv_dtypes, else []
+    """
+
+    def __init__(self, tokens, n_prefilled, page_size, kv_dtype, kv,
+                 scales):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.n_prefilled = int(n_prefilled)
+        self.page_size = int(page_size)
+        self.kv_dtype = str(kv_dtype)
+        self.kv = list(kv)
+        self.scales = list(scales)
+
+    @property
+    def num_pages(self):
+        return int(self.kv[0].shape[0]) if self.kv else 0
+
+    def nbytes(self):
+        return int(sum(a.nbytes for a in self.kv)
+                   + sum(a.nbytes for a in self.scales))
+
+
+def _np_dtype(name):
+    """np.dtype by name, extension float types (bfloat16) included —
+    np.load round-trips their BYTES but reads the dtype back as a
+    void type, so the frame records names and unpack restores them."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_kv_payload(payload):
+    """KVPagePayload -> bytes (module docstring has the frame)."""
+    meta = json.dumps({
+        "n_prefilled": payload.n_prefilled,
+        "page_size": payload.page_size,
+        "kv_dtype": payload.kv_dtype,
+        "n_kv": len(payload.kv),
+        "n_scales": len(payload.scales),
+        "pool_dtypes": [str(a.dtype) for a in payload.kv],
+    }).encode("utf-8")
+    buf = io.BytesIO()
+    buf.write(_HDR.pack(_MAGIC, _VERSION, len(meta)))
+    buf.write(meta)
+    np.save(buf, payload.tokens, allow_pickle=False)
+    for a in payload.kv:
+        np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+    for a in payload.scales:
+        np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+    return buf.getvalue()
+
+
+def unpack_kv_payload(raw):
+    """bytes -> KVPagePayload; byte-identical arrays (parity-pinned)."""
+    magic, ver, meta_len = _HDR.unpack_from(raw, 0)
+    if magic != _MAGIC:
+        raise ValueError(
+            f"not a KV-page frame (magic {magic!r}): the KV stream and "
+            "other p2p traffic must not share a tag")
+    if ver != _VERSION:
+        raise ValueError(f"KV-page frame version {ver} != {_VERSION}")
+    meta = json.loads(raw[_HDR.size:_HDR.size + meta_len].decode("utf-8"))
+    buf = io.BytesIO(raw)
+    buf.seek(_HDR.size + meta_len)
+    tokens = np.load(buf, allow_pickle=False)
+    kv = []
+    for name in meta["pool_dtypes"]:
+        a = np.load(buf, allow_pickle=False)
+        want = _np_dtype(name)
+        kv.append(a if a.dtype == want else a.view(want))
+    scales = [np.load(buf, allow_pickle=False)
+              for _ in range(meta["n_scales"])]
+    return KVPagePayload(tokens, meta["n_prefilled"], meta["page_size"],
+                         meta["kv_dtype"], kv, scales)
+
+
+def send_kv_payload(payload, dst, tag=KV_STREAM_TAG, timeout_ms=600_000):
+    """Stream one payload to rank `dst` over the xproc p2p transport.
+    Byte-for-byte: the frame is already pool-quantized, so it must NOT
+    ride the PTQ8 float re-encoder (`send_bytes`, not `send_np`) —
+    re-quantizing quantized codes would corrupt them."""
+    from ...distributed import xproc
+
+    xproc.send_bytes(pack_kv_payload(payload), dst, tag=tag,
+                     timeout_ms=timeout_ms)
+
+
+def recv_kv_payload(src, tag=KV_STREAM_TAG, timeout_ms=600_000):
+    from ...distributed import xproc
+
+    return unpack_kv_payload(xproc.recv_bytes(src, tag=tag,
+                                              timeout_ms=timeout_ms))

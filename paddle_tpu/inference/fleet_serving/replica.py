@@ -1,0 +1,338 @@
+"""Replica runtime — one serving engine as a fleet member.
+
+One `LLMServer` owns one engine; the millions-of-users tier runs N of
+them behind a router (docs/SERVING.md "Disaggregated fleet"). This
+module is the MEMBER side of that tier:
+
+* **LocalReplica** wraps model + `LLMServer` (its own engine thread)
+  and registers into a `ReplicaRegistry` with per-tick heartbeats —
+  the `fleet/elastic` membership shape: the registry mirrors beats
+  into the launcher's `hb_<rank>` file protocol when given a
+  directory (`distributed.fleet.elastic.touch_heartbeat`), so an
+  `ElasticManager` pointed at the same dir observes the serving fleet
+  exactly as it observes a training pod.
+
+* **Roles.** A `role="prefill"` replica only ever runs prefill-only
+  requests (`submit_prefill` → `KVPagePayload`); a `role="serve"`
+  replica decodes — from scratch or from an imported payload
+  (`submit_imported`). The split is policy, not mechanism: every
+  replica's engine can do both, the router just routes by role.
+
+* **Chaos kill.** Each serve-loop tick fires the `replica.kill` and
+  `replica.kill.<name>` chaos scopes (distributed/chaos.py); an
+  injector there stops the loop DEAD — no drain, no future
+  resolution, heartbeats cease — the process-death shape. `kill()`
+  does the same programmatically. The router's failover requeues the
+  replica's in-flight work and greedy replay keeps outputs
+  token-identical (tests/test_fleet_router.py pins it).
+
+* **Cross-process streams.** `stream_prefill` / `recv_and_decode` are
+  the xproc-transport halves of the disaggregated hand-off used by
+  multi-host fleets (and the 2-proc chaos launch test): finished KV
+  pages ride `kv_transfer.send_kv_payload` over the p2p socket path —
+  RetryPolicy reconnect/resend and the `sock.send`/`sock.recv` chaos
+  scopes included.
+
+SHARED-MODEL CAVEAT: two replicas may share one model object only if
+both are WARM before concurrent traffic (tracing a compiled step swaps
+the model's parameter values for tracers — `fork_model` gives each
+replica its own copy and is what the router's autoscale factory should
+use; `LocalReplica(warm=True)` (default) warms in the constructor's
+thread, so replicas built sequentially over one model are also safe).
+"""
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from ...distributed import chaos
+from ...observability import metrics as _obs
+
+__all__ = ["ReplicaRegistry", "LocalReplica", "fork_model",
+           "stream_prefill", "recv_and_decode"]
+
+_REPLICA_LIVE = _obs.gauge(
+    "pt_router_replica_live",
+    "replicas currently alive in the registry (heartbeat fresh, loop "
+    "running) — the fleet-capacity gauge the autoscaler moves")
+
+_replica_ids = itertools.count()
+
+
+def fork_model(model):
+    """A private copy of `model` (same config, copied weights) for a
+    new replica. `set_state_dict` COPIES at ingest (the PR-11 aliasing
+    fix), so the fork shares no mutable state with the source — the
+    only safe shape for scale-up while other replicas are serving
+    (module docstring caveat)."""
+    m = type(model)(model.config)
+    m.set_state_dict(model.state_dict())
+    m.eval()
+    return m
+
+
+class ReplicaRegistry:  # ptlint: thread-shared (router monitor + replica threads)
+    """Heartbeat membership for a replica fleet (elastic-style: the
+    registry IS the `hb_<rank>` view, held in-process with an optional
+    file mirror for cross-process observers)."""
+
+    def __init__(self, hb_dir=None, timeout_s=2.0):
+        self._lock = threading.Lock()
+        self._members = {}   # name -> {"replica", "rid", "beat"}
+        self.hb_dir = hb_dir
+        self.timeout_s = float(timeout_s)
+
+    def register(self, replica):
+        with self._lock:
+            self._members[replica.name] = {
+                "replica": replica, "rid": replica.rid,
+                "beat": time.monotonic()}
+        self._mirror(replica.rid)
+        self._publish()
+
+    def deregister(self, name):
+        with self._lock:
+            entry = self._members.pop(name, None)
+        if entry is not None and self.hb_dir:
+            from ...distributed.fleet.elastic import remove_heartbeat
+
+            remove_heartbeat(self.hb_dir, entry["rid"])
+        self._publish()
+
+    def beat(self, name):
+        with self._lock:
+            entry = self._members.get(name)
+            if entry is None:
+                return
+            entry["beat"] = time.monotonic()
+            rid = entry["rid"]
+        self._mirror(rid)
+
+    def _mirror(self, rid):
+        if self.hb_dir:
+            from ...distributed.fleet.elastic import touch_heartbeat
+
+            touch_heartbeat(self.hb_dir, rid)
+
+    def ages(self):
+        """name -> seconds since the last beat (scrape-safe snapshot)."""
+        now = time.monotonic()
+        with self._lock:
+            return {name: now - e["beat"]
+                    for name, e in list(self._members.items())}
+
+    def alive(self, name):
+        """Alive = loop running AND heartbeat inside the timeout — a
+        wedged loop (hang injector) goes dead by staleness even though
+        its thread still exists."""
+        with self._lock:
+            entry = self._members.get(name)
+            if entry is None:
+                return False
+            fresh = time.monotonic() - entry["beat"] <= self.timeout_s
+            return fresh and entry["replica"].running
+
+    def live(self):
+        """Names of alive replicas (snapshot)."""
+        return [name for name in list(self._members) if self.alive(name)]
+
+    def _publish(self):
+        _REPLICA_LIVE.set(len(self.live()))
+
+
+def _make_server_class():
+    """The replica's `LLMServer` subclass (per-tick heartbeat + chaos
+    kill hook), built lazily: fleet_serving loads BEFORE
+    inference.llm_engine, so the base class cannot be imported at
+    module level."""
+    from ..llm_engine import LLMServer
+
+    class _Server(LLMServer):
+        _thread_name = "fleet-replica"
+
+        def __init__(self, model, config, replica):
+            super().__init__(model, config)
+            self._replica = replica
+
+        def _tick_hook(self):
+            rep = self._replica
+            if not rep._killed:
+                rep.last_tick = time.monotonic()
+                rep._registry.beat(rep.name)
+                # the kill scopes count BUSY ticks only: an idle loop
+                # polls on a wall-clock cadence, so a seeded call
+                # index would name a moment, not a serving state —
+                # counting work ticks makes "kill at tick N" mean
+                # "mid-stream after N scheduling rounds" on every run
+                if self._engine.has_work() or not self._q.empty():
+                    try:
+                        chaos.fire("replica.kill")
+                        chaos.fire(f"replica.kill.{rep.name}")
+                    except chaos.InjectedFault:
+                        rep._killed = True
+            # True aborts the loop dead: in-flight futures stay
+            # unresolved and heartbeats stop — the router requeues
+            return rep._killed
+
+    return _Server
+
+
+class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine thread writes)
+    """One fleet member: model + threaded `LLMServer` + registry
+    heartbeat (module docstring). The submit surface returns the
+    server's futures unchanged; `metrics()`/`queue_depth()` are the
+    router's load signals."""
+
+    def __init__(self, model, name=None, config=None, registry=None,
+                 role="serve", warm=True):
+        self.rid = next(_replica_ids)
+        self.name = name or f"replica{self.rid}"
+        self.role = str(role)
+        self._registry = registry if registry is not None \
+            else ReplicaRegistry()
+        self._killed = False
+        # monotonic stamp of the last serve-loop tick, kept on the
+        # REPLICA (the registry drops a deregistered member's beats):
+        # the router's failover recovery needs progress evidence that
+        # survives expulsion — a hung thread is `running` but does not
+        # tick, so `last_tick` is what distinguishes a cleared wedge
+        # from an ongoing one
+        self.last_tick = 0.0
+        cls = _make_server_class()
+        self._server = cls(model, config, self)
+        if warm:
+            self._warm()
+        self._server.start()
+        self._registry.register(self)
+
+    @property
+    def engine(self):
+        return self._server.engine
+
+    @property
+    def server(self):
+        return self._server
+
+    def _warm(self):
+        """Compile the decode executables in THIS thread before the
+        serve loop starts (the shared-model tracing caveat; also keeps
+        first-request latency off the serving path). A short request
+        long enough to cross one fused window warms both the
+        single-tick and the fused/spec paths."""
+        eng = self.engine
+        k = max(eng.decode_k,
+                eng._spec.k + 1 if eng._spec is not None else 1)
+        req = eng.add_request(np.zeros((2,), np.int32),
+                              max_new_tokens=k + 1)
+        while eng.has_work():
+            eng.step()
+        req.future.result(timeout=0)
+        # warm the disaggregation pair too: export gather + import
+        # scatter are fixed-shape (pages_per_seq-padded), so one tiny
+        # round trip compiles the executables every later hand-off
+        # reuses — the first streamed payload must not pay a compile
+        # stall on the decode tier's admission path
+        pr = eng.add_request(np.zeros((2,), np.int32),
+                             prefill_only=True)
+        while eng.has_work():
+            eng.step()
+        ir = eng.import_kv_pages(pr.future.result(timeout=0),
+                                 max_new_tokens=1)
+        while eng.has_work():
+            eng.step()
+        ir.future.result(timeout=0)
+        eng.stats.update({"steps": 0, "tokens_in": 0, "generated": 0,
+                          "finished": 0, "occupancy_sum": 0.0,
+                          "fused_steps": 0, "kv_pages_exported": 0,
+                          "kv_pages_imported": 0, "prefill_exports": 0})
+
+    # ---- submit surface (thread-safe: LLMServer queue) ----
+
+    def submit(self, prompt, **kw):
+        return self._server.submit(prompt, **kw)
+
+    def submit_prefill(self, prompt, **kw):
+        """Future -> KVPagePayload (the disaggregated prefill half)."""
+        kw.pop("max_new_tokens", None)  # ignored by prefill-only
+        return self._server.submit(prompt, prefill_only=True, **kw)
+
+    def submit_imported(self, payload, **kw):
+        """Future -> tokens, decoding from an imported payload's
+        frontier (the disaggregated decode half)."""
+        return self._server.submit(payload.tokens, kv_import=payload,
+                                   **kw)
+
+    # ---- liveness / load ----
+
+    @property
+    def running(self):
+        t = self._server._thread
+        return (not self._killed and self._server._running
+                and t is not None and t.is_alive())
+
+    @property
+    def alive(self):
+        return self._registry.alive(self.name)
+
+    def queue_depth(self):
+        eng = self.engine
+        return len(eng.waiting) + self._server._q.qsize()
+
+    def load(self):
+        """(queue_depth, live-slot occupancy): the least-loaded order
+        the router's fallback uses — the PR-3 queue/TTFT gauges'
+        per-replica view."""
+        eng = self.engine
+        live = sum(r is not None for r in list(eng._slots))
+        return (self.queue_depth(), live / eng.num_slots)
+
+    def metrics(self):
+        out = self.engine.metrics()
+        out["replica"] = {"name": self.name, "rid": self.rid,
+                          "role": self.role, "alive": self.alive,
+                          "queue_depth": self.queue_depth()}
+        return out
+
+    # ---- lifecycle ----
+
+    def kill(self):
+        """Die like a lost process: the serve loop exits at its next
+        tick without resolving anything, heartbeats stop. (The chaos
+        `replica.kill` injector lands here too.)"""
+        self._killed = True
+
+    def stop(self):
+        """Graceful retirement (scale-down): drain the queue, stop the
+        loop, deregister."""
+        self._server.stop()
+        self._registry.deregister(self.name)
+
+
+# ---- cross-process disaggregation (xproc transport) -----------------
+
+def stream_prefill(replica, prompt, dst, tag=None, timeout_ms=600_000,
+                   **kw):
+    """Prefill `prompt` on `replica` and stream the finished KV pages
+    to rank `dst` over the p2p socket path (kv_transfer module
+    docstring: byte-for-byte, RetryPolicy + chaos-injectable). Returns
+    the payload's page count."""
+    from .kv_transfer import KV_STREAM_TAG, send_kv_payload
+
+    payload = replica.submit_prefill(prompt, **kw).result()
+    send_kv_payload(payload, dst,
+                    tag=KV_STREAM_TAG if tag is None else tag,
+                    timeout_ms=timeout_ms)
+    return payload.num_pages
+
+
+def recv_and_decode(replica, src, tag=None, timeout_ms=600_000, **kw):
+    """Receive one streamed payload from rank `src` and admit it on
+    `replica` at its frontier. Returns the decode future."""
+    from .kv_transfer import KV_STREAM_TAG, recv_kv_payload
+
+    payload = recv_kv_payload(src,
+                              tag=KV_STREAM_TAG if tag is None else tag,
+                              timeout_ms=timeout_ms)
+    return replica.submit_imported(payload, **kw)

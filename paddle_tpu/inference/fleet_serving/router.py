@@ -1,0 +1,627 @@
+"""Fleet router — radix-affinity routing, SLO autoscale, failover.
+
+The tier above everything PRs 2–12 built (ROADMAP item 1): N replica
+engines behind ONE submit surface. Three policies, all host-side:
+
+* **Radix-affinity routing.** The prompt's leading blocks are
+  fingerprinted at `hash_block_tokens` granularity — the SAME
+  content-at-position identity the per-engine `RadixPrefixCache` tries
+  key on — and the request routes to the replica whose (router-side)
+  prefix view holds the LONGEST match, so a fleet sharing system
+  prompts concentrates each prefix's KV on one replica instead of
+  re-prefilling it everywhere: the PR-7 cache becomes a fleet-wide
+  asset. No match (or a tie at zero) falls back to LEAST-LOADED by the
+  per-replica queue-depth/occupancy gauges (the PR-3 load signals).
+
+* **Prefill/decode disaggregation.** With prefill-role replicas
+  attached, prompts at/above `prefill_min_tokens` chunk-prefill on a
+  prefill replica and their finished KV pages stream to the affinity-
+  chosen decode replica (`kv_transfer` byte discipline), which admits
+  the request AT ITS FRONTIER — long-prompt admission stops stealing
+  the decode replicas' fused/speculative windows, which is the
+  decode-side TTFT p99 win the `llm_fleet_multi` bench arm measures.
+
+* **SLO autoscale + failover.** A monitor thread watches heartbeats
+  and queue depth: sustained pressure above `queue_high` grows the
+  fleet through the replica factory (up to `max_replicas`), an idle
+  fleet shrinks gracefully (drained replicas retire), and a DEAD
+  replica (chaos kill, wedge, crash) has its in-flight requests
+  REQUEUED — prompts replay through the prefix/KV machinery on a
+  surviving replica, and greedy decode makes the replayed outputs
+  token-identical to the unkilled run (the chaos acceptance;
+  client futures never observe the death).
+
+Failover guarantee (docs/SERVING.md "Disaggregated fleet"): at-least-
+once execution with deterministic outputs — a request may run twice
+(the killed replica's partial work is discarded), never zero times,
+and the client-visible tokens are identical either way. Requests are
+NOT persisted: losing the router process loses its queue (the router
+is one process supervising in-process replicas; cross-process fleets
+put the durable queue in front).
+
+Metrics: pt_router_requests / pt_router_affinity_hits /
+pt_router_replica_live / pt_router_requeues (+ the kv_transfer stream
+counter). docs/OBSERVABILITY.md has the catalogue rows.
+"""
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ...observability import metrics as _obs
+from .replica import LocalReplica, ReplicaRegistry
+
+__all__ = ["AutoscalePolicy", "FleetRouter"]
+
+_ROUTER_REQS = _obs.counter(
+    "pt_router_requests",
+    "requests routed by the fleet router (process-global)")
+_AFFINITY_HITS = _obs.counter(
+    "pt_router_affinity_hits",
+    "routed requests whose chosen replica held a non-empty radix "
+    "prefix match (the fleet-wide cache-locality rate)")
+_REQUEUES = _obs.counter(
+    "pt_router_requeues",
+    "in-flight requests requeued off a dead replica (failover — "
+    "greedy outputs stay token-identical under replay)")
+_MONITOR_ERRORS = _obs.counter(
+    "pt_router_monitor_errors",
+    "exceptions swallowed by the router monitor's failover/autoscale "
+    "ticks (supervision survives a bad tick, but a persistently "
+    "failing one — e.g. a factory that cannot build replicas — must "
+    "be visible, not a silent poll-rate retry loop)")
+
+
+class AutoscalePolicy:
+    """Autoscale/monitor knobs (docs/SERVING.md has the tuning table).
+
+    min_replicas / max_replicas  fleet size bounds
+    queue_high       mean waiting-per-replica that triggers scale-UP
+                     (sustained: two consecutive monitor ticks)
+    queue_low        fleet-wide waiting total at/below which an IDLE
+                     replica (no queue, no in-flight) may retire
+    cooldown_s       minimum seconds between scaling actions
+    heartbeat_timeout_s  staleness after which a replica counts dead
+    poll_s           monitor loop period
+    """
+
+    def __init__(self, min_replicas=1, max_replicas=4, queue_high=8,
+                 queue_low=0, cooldown_s=1.0, heartbeat_timeout_s=2.0,
+                 poll_s=0.02):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.cooldown_s = float(cooldown_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_s = float(poll_s)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+
+
+class _RoutedRequest:
+    _ids = itertools.count()
+
+    def __init__(self, prompt, kwargs, future):
+        self.rid = next(_RoutedRequest._ids)
+        self.prompt = prompt
+        self.kwargs = kwargs       # submit kwargs (eos, sampling, SLA)
+        self.future = future       # client-facing
+        self.replica = None        # name currently serving it
+        self.internal = None       # the replica-side Future
+        self.stage = None          # "prefill" | "decode"
+        self.payload = None        # streamed KV (between stages)
+        self.no_disagg = False     # prefill fallback taken
+        self.requeues = 0
+        self.affinity_hit = False
+        self.resolved = False      # exactly-one-outcome gate (lock-held)
+        self.t_submit = time.perf_counter()
+
+
+class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica callbacks)
+    """N replicas behind one `submit` (module docstring).
+
+        router = FleetRouter(factory=make_replica, policy=...)
+        with router:
+            fut = router.submit(prompt_ids, max_new_tokens=64)
+            tokens = fut.result()
+
+    `factory(name) -> LocalReplica` builds members (give each its OWN
+    model copy — `replica.fork_model`); pre-built replicas can be
+    passed instead/in addition via `replicas=[...]`. Prefill-role
+    replicas (`prefill_replicas` or factory-built with
+    `prefill_factory`) enable the disaggregated hand-off for prompts
+    >= `prefill_min_tokens`."""
+
+    def __init__(self, replicas=None, factory=None, policy=None,
+                 hash_block_tokens=16, max_affinity_blocks=8,
+                 prefill_replicas=None, prefill_min_tokens=None,
+                 registry=None):
+        self.policy = policy or AutoscalePolicy()
+        self.registry = registry if registry is not None else \
+            ReplicaRegistry(timeout_s=self.policy.heartbeat_timeout_s)
+        self._factory = factory
+        self.hash_block_tokens = int(hash_block_tokens)
+        self.max_affinity_blocks = int(max_affinity_blocks)
+        self.prefill_min_tokens = (None if prefill_min_tokens is None
+                                   else int(prefill_min_tokens))
+        self._lock = threading.Lock()
+        self._replicas = {}        # name -> LocalReplica (decode/serve)
+        self._prefill = {}         # name -> LocalReplica (prefill role)
+        self._expelled = {}        # name -> replica removed by failover
+        self._affinity = {}        # name -> {prefix-key: last-use clock}
+        self._clock = itertools.count()
+        self._inflight = {}        # rid -> _RoutedRequest
+        self._ttfts = []           # completed-request TTFTs (bounded)
+        self._monitor = None
+        self._running = False
+        self._last_scale = 0.0
+        self._pressure_ticks = 0
+        self.stats = {"requests": 0, "affinity_hits": 0, "requeues": 0,
+                      "scale_ups": 0, "scale_downs": 0,
+                      "disagg_handoffs": 0, "replicas_lost": 0}
+        for r in (replicas or ()):
+            self._adopt(r)
+        for r in (prefill_replicas or ()):
+            self._adopt(r)
+
+    def _adopt(self, replica):
+        with self._lock:
+            if replica.role == "prefill":
+                self._prefill[replica.name] = replica
+            else:
+                self._replicas[replica.name] = replica
+                self._affinity.setdefault(replica.name, {})
+        if replica._registry is not self.registry:
+            # one membership view: the router's failover watches ITS
+            # registry, so members must beat into it
+            replica._registry = self.registry
+            self.registry.register(replica)
+
+    # ---- lifecycle ----
+
+    def start(self):
+        if self._running:
+            return self
+        while (len(self._replicas) < self.policy.min_replicas
+               and self._factory is not None):
+            self._scale_up()
+        if not self._replicas:
+            raise RuntimeError(
+                "FleetRouter needs at least one serve-role replica "
+                "(pass replicas=[...] or a factory)")
+        self._running = True
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="fleet-router",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        if self._monitor is not None:
+            self._monitor.join(timeout=30)
+            self._monitor = None
+        for r in (list(self._replicas.values())
+                  + list(self._prefill.values())
+                  + [rep for rep, _ in list(self._expelled.values())]):
+            # expelled members included: a wedged-then-recovered-too-
+            # late replica still owns a live serve thread
+            r.stop()
+        # anything still unresolved after the graceful drain is lost
+        for rr in self._drain_inflight():
+            if not rr.future.done():
+                rr.future.set_exception(
+                    RuntimeError("router stopped with request in flight"))
+
+    def _drain_inflight(self):
+        with self._lock:
+            out = list(self._inflight.values())
+            self._inflight.clear()
+        return out
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- client surface ----
+
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               **kw):
+        """Route one prompt; returns the client Future (tokens). The
+        kwargs surface is `LLMServer.submit`'s."""
+        from concurrent.futures import Future
+
+        if not self._running:
+            raise RuntimeError("router not started (use `with router:`)")
+        prompt = np.asarray(prompt).reshape(-1)
+        rr = _RoutedRequest(
+            prompt, dict(max_new_tokens=int(max_new_tokens),
+                         eos_token_id=eos_token_id, **kw), Future())
+        with self._lock:
+            self._inflight[rr.rid] = rr
+            self.stats["requests"] += 1
+        _ROUTER_REQS.inc()
+        self._dispatch(rr)
+        return rr.future
+
+    def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
+        return self.submit(prompt, max_new_tokens, eos_token_id).result()
+
+    # ---- routing ----
+
+    def _block_keys(self, tokens):
+        """Leading-block fingerprints: key i covers tokens[:(i+1)*bt] —
+        content AND position, the RadixPrefixCache node identity, so
+        router affinity and the engine trie agree on what 'the same
+        prefix' means."""
+        bt = self.hash_block_tokens
+        n = min(len(tokens) // bt, self.max_affinity_blocks)
+        return [np.asarray(tokens[:(i + 1) * bt], np.int32).tobytes()
+                for i in range(n)]
+
+    def _alive_replicas(self, exclude=()):
+        with self._lock:
+            reps = list(self._replicas.values())
+        return [r for r in reps
+                if r.name not in exclude and r.alive]
+
+    def _pick(self, tokens, exclude=()):
+        """(replica, matched_blocks): longest router-side prefix match,
+        least-loaded fallback. Registers the prompt's blocks on the
+        winner so the NEXT same-prefix request lands there too."""
+        alive = self._alive_replicas(exclude)
+        if not alive:
+            return None, 0
+        keys = self._block_keys(tokens)
+        best, best_len = None, 0
+        for r in alive:
+            store = self._affinity.get(r.name, {})
+            ln = 0
+            for k in keys:
+                if k not in store:
+                    break
+                ln += 1
+            if ln > best_len:
+                best, best_len = r, ln
+        if best is None:
+            best = min(alive, key=lambda r: r.load())
+        if keys:
+            with self._lock:
+                store = self._affinity.setdefault(best.name, {})
+                for k in keys:
+                    store[k] = next(self._clock)
+                cap = 4096 * self.max_affinity_blocks
+                if len(store) > cap:
+                    # LRU cap: affinity is a ROUTING HINT, not state —
+                    # dropping old keys only costs a fallback route.
+                    # Trim to HALF the cap (not a flat floor): the hit
+                    # rate degrades smoothly instead of collapsing to
+                    # ~nothing on every trim
+                    keep = sorted(store.items(), key=lambda kv: kv[1],
+                                  reverse=True)[:cap // 2]
+                    self._affinity[best.name] = dict(keep)
+        return best, best_len
+
+    def _pick_prefill(self, exclude=()):
+        with self._lock:
+            reps = list(self._prefill.values())
+        alive = [r for r in reps if r.name not in exclude and r.alive]
+        if not alive:
+            return None
+        return min(alive, key=lambda r: r.load())
+
+    def _dispatch(self, rr, exclude=()):
+        """Place `rr` on a replica (possibly via the prefill stage).
+        Called at submit, at stage hand-off, and at failover requeue —
+        always with rr NOT currently bound to a live internal future."""
+        if rr.future.done():
+            return
+        disagg = (self.prefill_min_tokens is not None
+                  and not rr.no_disagg and rr.payload is None
+                  and len(rr.prompt) >= self.prefill_min_tokens)
+        if disagg:
+            pre = self._pick_prefill(exclude)
+            if pre is not None:
+                rr.stage, rr.replica = "prefill", pre.name
+                rr.internal = pre.submit_prefill(
+                    rr.prompt,
+                    **{k: rr.kwargs[k] for k in
+                       ("tenant", "priority", "ttft_slo_s")
+                       if k in rr.kwargs})
+                rr.internal.add_done_callback(
+                    lambda f, rr=rr: self._on_prefill_done(rr, f))
+                return
+            rr.no_disagg = True  # no live prefill replica: serve whole
+        rep, matched = self._pick(rr.prompt, exclude)
+        if rep is None:
+            # no live replica AT ALL: park it — the monitor requeues
+            # once the factory (or a recovering heartbeat) restores one
+            rr.stage, rr.replica, rr.internal = "parked", None, None
+            return
+        if matched and rr.requeues == 0 and rr.payload is None:
+            rr.affinity_hit = True
+            with self._lock:
+                self.stats["affinity_hits"] += 1
+            _AFFINITY_HITS.inc()
+        rr.stage = "decode"
+        rr.replica = rep.name
+        if rr.payload is not None:
+            with self._lock:
+                self.stats["disagg_handoffs"] += 1
+            payload, rr.payload = rr.payload, None  # consumed
+            rr.internal = rep.submit_imported(payload, **rr.kwargs)
+        else:
+            rr.internal = rep.submit(rr.prompt, **rr.kwargs)
+        rr.internal.add_done_callback(
+            lambda f, rr=rr: self._on_decode_done(rr, f))
+
+    def _on_prefill_done(self, rr, fut):
+        if rr.future.done() or fut is not rr.internal:
+            # stale attempt: the request was already requeued onto
+            # another replica — the live attempt owns the hand-off
+            return
+        err = fut.exception()
+        if err is not None:
+            # prefill failed (bad request / replica abort): fall back
+            # to serving the whole request on a decode replica — only a
+            # request the DECODE side also rejects errors the client
+            rr.no_disagg = True
+            self._dispatch(rr)
+            return
+        rr.payload = fut.result()
+        self._dispatch(rr)
+
+    def _on_decode_done(self, rr, fut):
+        if rr.future.done():
+            return
+        err = fut.exception()
+        if err is not None and fut is not rr.internal:
+            # a SUPERSEDED attempt failing late (the replica it ran
+            # on died/aborted after the requeue) must not poison the
+            # client while the live retry is still running — that
+            # would be the very death the failover guarantee hides.
+            # (A stale SUCCESS is kept: greedy outputs are
+            # deterministic, so first-wins is correct.)
+            return
+        # exactly-one-outcome gate: a stale and a live attempt can
+        # complete near-simultaneously on two replica threads — only
+        # the winner may resolve, attach pt_request, and record TTFT
+        # (the loser would otherwise clobber pt_request and append a
+        # second, wedge-inflated TTFT sample)
+        with self._lock:
+            if rr.resolved:
+                return
+            rr.resolved = True
+        if err is not None:
+            if not rr.future.done():
+                rr.future.set_exception(err)
+        else:
+            req = getattr(fut, "pt_request", None)
+            # mirror the LLMServer.submit contract on the CLIENT
+            # future (set BEFORE the result so a completed future
+            # always carries it): the serving replica's _Request is
+            # where per-request TTFT stamps live
+            rr.future.pt_request = req
+            if not rr.future.done():
+                rr.future.set_result(fut.result())
+            if req is not None and req.t_first_token is not None:
+                with self._lock:
+                    self._ttfts.append(req.t_first_token - rr.t_submit)
+                    if len(self._ttfts) > 10000:
+                        del self._ttfts[:5000]
+        with self._lock:
+            self._inflight.pop(rr.rid, None)
+
+    # ---- monitor: failover + autoscale ----
+
+    def _monitor_loop(self):
+        # the monitor must outlive any single bad tick (a failover
+        # racing a graceful stop() retries next poll rather than
+        # ending supervision) — but every swallowed error is COUNTED
+        # and kept in the snapshot, and the ticks fail independently
+        # (an autoscale error must not mask the failover scan)
+        while self._running:
+            time.sleep(self.policy.poll_s)
+            try:
+                self._failover_tick()
+            except Exception as e:
+                self._note_monitor_error(e)
+            try:
+                self._autoscale_tick()
+            except Exception as e:
+                self._note_monitor_error(e)
+
+    def _note_monitor_error(self, exc):
+        _MONITOR_ERRORS.inc()
+        with self._lock:
+            self.stats["monitor_errors"] = (
+                self.stats.get("monitor_errors", 0) + 1)
+            self.stats["last_monitor_error"] = repr(exc)
+
+    def _failover_tick(self):
+        # recovery scan FIRST: an expelled member that TICKED after
+        # its expulsion was only transiently stale (a wedge that
+        # cleared, a slow step) — re-register (fresh beat) and
+        # re-adopt it, so a stall never permanently shrinks the fleet
+        # (its requeued work may have run twice: at-least-once,
+        # outputs deterministic). Progress evidence is `last_tick`,
+        # NOT thread aliveness: a STILL-hung loop is `running` too,
+        # and re-adopting it would flap expel→re-adopt every
+        # heartbeat timeout, stranding fresh dispatches on a wedge.
+        # A killed/dead member never ticks again and stays expelled
+        # until stop().
+        with self._lock:
+            expelled = list(self._expelled.items())
+        for name, (rep, t_expelled) in expelled:
+            if rep.running and rep.last_tick > t_expelled:
+                with self._lock:
+                    self._expelled.pop(name, None)
+                    self.stats["replicas_recovered"] = (
+                        self.stats.get("replicas_recovered", 0) + 1)
+                self.registry.register(rep)
+                self._adopt(rep)
+        with self._lock:
+            serve = list(self._replicas.items())
+            pre = list(self._prefill.items())
+        for name, rep in serve + pre:
+            # DEAD = not alive: loop stopped OR heartbeat stale. A
+            # WEDGED loop (hang injector, stuck dispatch) keeps its
+            # thread — gating on `running` too would strand its
+            # in-flight work forever
+            if rep.alive:
+                continue
+            self._handle_death(name, rep)
+        # orphan sweep: a dispatch that raced a death can bind a
+        # request to a member _handle_death already removed (its
+        # victims snapshot predates the bind) — requeue anything
+        # pointing at a name that is no longer registered
+        with self._lock:
+            members = set(self._replicas) | set(self._prefill)
+            orphans = [rr for rr in self._inflight.values()
+                       if rr.stage in ("prefill", "decode")
+                       and rr.replica is not None
+                       and rr.replica not in members
+                       and not rr.future.done()]
+        for rr in orphans:
+            self._requeue(rr, exclude={rr.replica})
+        self.registry._publish()
+
+    def _requeue(self, rr, exclude):
+        rr.requeues += 1
+        rr.internal = None
+        rr.payload = None        # streamed KV lived in the dead pool
+        with self._lock:
+            self.stats["requeues"] += 1
+        _REQUEUES.inc()
+        self._dispatch(rr, exclude=exclude)
+
+    def _handle_death(self, name, rep):
+        """Remove a dead member and requeue everything it was serving.
+        The replay path IS the ordinary dispatch path: prompts re-route
+        (minus the dead replica) through prefix-cache/KV machinery, and
+        greedy decode reproduces the identical tokens."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._prefill.pop(name, None)
+            self._affinity.pop(name, None)  # its cached KV died with it
+            # recovery scan / stop() track it; the stamp is the bar a
+            # future tick must clear to prove the wedge ended
+            self._expelled[name] = (rep, time.monotonic())
+            victims = [rr for rr in self._inflight.values()
+                       if rr.replica == name and not rr.future.done()]
+            self.stats["replicas_lost"] += 1
+        self.registry.deregister(name)
+        for rr in victims:
+            self._requeue(rr, exclude={name})
+
+    def _autoscale_tick(self):
+        pol = self.policy
+        now = time.monotonic()
+        alive = self._alive_replicas()
+        # parked requests (a no-replica window) re-dispatch as soon as
+        # capacity exists
+        if alive:
+            with self._lock:
+                parked = [rr for rr in self._inflight.values()
+                          if rr.stage == "parked"]
+            for rr in parked:
+                self._dispatch(rr)
+        if self._factory is None:
+            return
+        if now - self._last_scale < pol.cooldown_s:
+            return
+        with self._lock:
+            waiting = sum(rr.stage == "parked"
+                          for rr in self._inflight.values())
+        depth = sum(r.queue_depth() for r in alive) + waiting
+        if len(alive) < pol.min_replicas:
+            self._scale_up()
+            return
+        if (alive and depth / len(alive) >= pol.queue_high
+                and len(alive) < pol.max_replicas):
+            # sustained pressure only: one hot tick must not double the
+            # fleet
+            with self._lock:
+                self._pressure_ticks += 1
+                fire = self._pressure_ticks >= 2
+                if fire:
+                    self._pressure_ticks = 0
+            if fire:
+                self._scale_up()
+            return
+        self._pressure_ticks = 0
+        if (depth <= pol.queue_low and len(alive) > pol.min_replicas):
+            idle = [r for r in alive if r.load() == (0, 0.0)
+                    and not self._has_inflight(r.name)]
+            if idle:
+                self._scale_down(idle[-1])
+
+    def _has_inflight(self, name):
+        with self._lock:
+            return any(rr.replica == name
+                       for rr in self._inflight.values())
+
+    def _scale_up(self):
+        name = f"replica{next(_scale_names)}"
+        rep = self._factory(name)
+        self._adopt(rep)
+        with self._lock:
+            self.stats["scale_ups"] += 1
+        self._last_scale = time.monotonic()
+        self.registry._publish()
+        return rep
+
+    def _scale_down(self, rep):
+        with self._lock:
+            self._replicas.pop(rep.name, None)
+            self._affinity.pop(rep.name, None)
+            self.stats["scale_downs"] += 1
+        rep.stop()   # graceful: queue is empty by the idle check
+        self._last_scale = time.monotonic()
+        self.registry._publish()
+
+    # ---- observability ----
+
+    def num_replicas(self):
+        with self._lock:
+            return len(self._replicas)
+
+    def ttft_quantile(self, q):
+        with self._lock:
+            samples = list(self._ttfts)
+        if not samples:
+            return None
+        return float(np.percentile(np.asarray(samples), q * 100))
+
+    def metrics(self):
+        """Router snapshot + per-replica engine views (scrape-safe)."""
+        with self._lock:
+            reqs = self.stats["requests"]
+            hits = self.stats["affinity_hits"]
+            snap = dict(self.stats)
+            inflight = len(self._inflight)
+            reps = list(self._replicas.values()) + list(
+                self._prefill.values())
+        snap.update({
+            "inflight": inflight,
+            "affinity_hit_rate": hits / reqs if reqs else None,
+            "ttft_p50_s": self.ttft_quantile(0.5),
+            "ttft_p99_s": self.ttft_quantile(0.99),
+            "replica_ages": self.registry.ages(),
+            "replicas": {
+                r.name: {"role": r.role, "alive": r.alive,
+                         "queue_depth": r.queue_depth(),
+                         "mean_slot_occupancy":
+                             r.engine.mean_occupancy}
+                for r in reps},
+        })
+        return snap
+
+
+_scale_names = itertools.count(1000)   # factory-built replica names

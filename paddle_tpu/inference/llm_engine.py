@@ -563,6 +563,14 @@ class _Request:
             raise ValueError(
                 f"top_p must be in (0, 1], got {self.top_p}")
         self.sample_stream = 0    # engine-assigned at add_request
+        # disaggregated serving (fleet_serving.kv_transfer): a prefill-
+        # only request stops AT its sampling frontier and resolves its
+        # future to the exported KVPagePayload instead of tokens; a
+        # request carrying _kv_import admits with its prompt KV written
+        # from another replica's payload (consumed at admission — a
+        # preemption replay falls back to ordinary prefill)
+        self.prefill_only = False
+        self._kv_import = None
         self._arrival = None      # scheduler enqueue stamp
         self.cached_prefix = 0    # tokens served from the prefix cache
         self._cow_pending = 0     # COW splits taken by the last match
@@ -736,7 +744,24 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
 
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     future=None, tenant="default", priority=None,
-                    ttft_slo_s=None, temperature=0.0, top_p=1.0):
+                    ttft_slo_s=None, temperature=0.0, top_p=1.0,
+                    prefill_only=False, kv_import=None):
+        """Enqueue one request. The disaggregated-serving knobs
+        (docs/SERVING.md "Disaggregated fleet"):
+
+        prefill_only  run chunked prefill up to the SAMPLING FRONTIER
+                      (prompt_len - 1 tokens written) and resolve the
+                      future to the exported
+                      `fleet_serving.KVPagePayload` — no token is ever
+                      sampled, so a prefill replica never steals a
+                      decode window. max_new_tokens is ignored.
+        kv_import     a KVPagePayload from another replica's
+                      `export_kv_pages`: the request admits with its
+                      prompt KV written from the payload (skipping that
+                      prefill) and decodes from its frontier. Geometry
+                      must match this engine's pool exactly — checked
+                      loudly HERE, not with corrupt logits at serve
+                      time."""
         toks = np.asarray(prompt).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -758,7 +783,19 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         req.sample_stream = next(self._sample_streams)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
         _REQS_TOTAL.inc()
-        if req.target <= req.prompt_len:
+        if kv_import is not None:
+            self._check_import(req, kv_import)
+            req._kv_import = kv_import
+        if prefill_only:
+            req.prefill_only = True
+            req.target = req.prompt_len
+            if req.prompt_len == 1:
+                # nothing before the frontier: an empty export (the
+                # decode side prefills the single prompt token itself)
+                if not req.future.cancelled():
+                    req.future.set_result(self._empty_payload(toks))
+                return req
+        elif req.target <= req.prompt_len:
             # zero budget (same contract as generate()): prompt echoes back
             if not req.future.cancelled():
                 req.future.set_result(req.result_array())
@@ -856,6 +893,168 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         if self._spec is not None:
             total += self._spec.pool_bytes()
         return total
+
+    # ---- disaggregated serving: KV-page export / import ----
+    # (fleet_serving.kv_transfer; docs/SERVING.md "Disaggregated
+    # fleet"). Both run on the thread that owns the engine — they read/
+    # replace the donated pool arrays, so calling them while a step is
+    # dispatching from another thread would race the donation.
+
+    def export_kv_pages(self, req):
+        """Cut the request's KV pages (every layer pool + scale plane,
+        byte-for-byte, the partially-filled frontier page included)
+        into a `fleet_serving.KVPagePayload`. The request keeps its
+        pages — export is a read.
+
+        The device gather runs at the FIXED `pages_per_seq` width
+        (pad index 0 = the trash page, rows sliced off on the host):
+        a per-page-count gather shape would compile one executable
+        per distinct prompt length — a mid-traffic stall on exactly
+        the prefill-storm path the disaggregation exists to protect."""
+        from .fleet_serving.kv_transfer import KVPagePayload
+
+        n = len(req.pages)
+        ids_np = np.zeros((self.pages_per_seq,), np.int32)
+        ids_np[:n] = req.pages
+        ids = jnp.asarray(ids_np)
+        # ONE batched host transfer for all pools + scale planes (a
+        # per-pool device_get would serialize 2L+ round trips inside
+        # the serve loop, on the prefill-storm path)
+        gathered = jax.device_get([p[ids] for p in self._kv]
+                                  + [s[ids] for s in self._kv_scales])
+        kv = [np.ascontiguousarray(a[:n])
+              for a in gathered[:len(self._kv)]]
+        scales = [np.ascontiguousarray(a[:n])
+                  for a in gathered[len(self._kv):]]
+        self.stats["kv_pages_exported"] = (
+            self.stats.get("kv_pages_exported", 0) + n)
+        return KVPagePayload(np.asarray(req.tokens, np.int32),
+                             req.n_prefilled, self.page_size,
+                             self.kv_dtype, kv, scales)
+
+    def import_kv_pages(self, payload, **kw):
+        """Admit one request whose prompt KV arrives pre-computed (a
+        prefill replica's `export_kv_pages`). The payload's tokens are
+        the prompt; decoding starts at its frontier, so the first tick
+        samples the first generated token without re-running the
+        prompt. Accepts the `add_request` keyword surface."""
+        return self.add_request(payload.tokens, kv_import=payload, **kw)
+
+    def _empty_payload(self, toks):
+        from .fleet_serving.kv_transfer import KVPagePayload
+
+        return KVPagePayload(
+            toks, 0, self.page_size, self.kv_dtype,
+            [np.zeros((0,) + p.shape[1:], np.asarray(p[:0]).dtype)
+             for p in self._kv],
+            [np.zeros((0,) + s.shape[1:], np.float32)
+             for s in self._kv_scales])
+
+    def _check_import(self, req, payload):
+        """Loud geometry validation at submit time (an import that
+        reinterprets pages under a different page_size / kv_dtype /
+        head layout would serve garbage logits, not an error)."""
+        if payload.page_size != self.page_size:
+            raise ValueError(
+                f"kv_import page_size {payload.page_size} != engine "
+                f"page_size {self.page_size}")
+        if payload.kv_dtype != self.kv_dtype:
+            raise ValueError(
+                f"kv_import kv_dtype {payload.kv_dtype!r} != engine "
+                f"kv_dtype {self.kv_dtype!r} (pools must match "
+                "byte-for-byte; re-prefill instead)")
+        if len(payload.kv) != len(self._kv):
+            raise ValueError(
+                f"kv_import carries {len(payload.kv)} pools, engine "
+                f"has {len(self._kv)} (different num_layers?)")
+        if len(payload.scales) != len(self._kv_scales):
+            raise ValueError(
+                "kv_import scale planes do not match the engine pool "
+                f"({len(payload.scales)} vs {len(self._kv_scales)})")
+        # EVERY pool and scale plane, not just kv[0]: a ragged payload
+        # (per-layer page counts or a mis-shaped scale plane) must be
+        # rejected here — failing later inside _write_imported_pages
+        # would abort the whole serve loop (and every co-resident
+        # request) for one bad payload
+        n_pages = payload.num_pages
+        for i, a in enumerate(payload.kv):
+            want = (n_pages,) + tuple(self._kv[i].shape[1:])
+            if tuple(a.shape) != want:
+                raise ValueError(
+                    f"kv_import pool {i} shape {tuple(a.shape)} != "
+                    f"{want} (engine page geometry x {n_pages} pages)")
+        for i, a in enumerate(payload.scales):
+            want = (n_pages,) + tuple(self._kv_scales[i].shape[1:])
+            if tuple(a.shape) != want:
+                raise ValueError(
+                    f"kv_import scale plane {i} shape "
+                    f"{tuple(a.shape)} != {want}")
+        if not 0 <= payload.n_prefilled <= req.prompt_len - 1:
+            raise ValueError(
+                f"kv_import n_prefilled {payload.n_prefilled} outside "
+                f"[0, prompt_len-1] ({req.prompt_len - 1}): the decode "
+                "side owns the frontier token")
+        need = -(-payload.n_prefilled // self.page_size)
+        if payload.num_pages != need:
+            raise ValueError(
+                f"kv_import ships {payload.num_pages} pages but "
+                f"n_prefilled {payload.n_prefilled} needs {need}")
+
+    def _write_imported_pages(self, page_ids, payload):
+        """Write the payload's page rows into this engine's pools at
+        freshly-allocated page ids — byte-for-byte (no dequant/requant:
+        the parity the wire test pins). Replaces the pool arrays;
+        re-committed to the pools' sharding so the next compiled-step
+        dispatch sees the SAME placement signature (a committed/
+        uncommitted flip would cost a second executable). Like the
+        export gather, the scatter runs at the FIXED `pages_per_seq`
+        width — pad rows land in trash page 0, whose rows are never
+        attended — so every import reuses ONE compiled scatter instead
+        of one per distinct page count (a mid-traffic compile stall on
+        the decode tier's admission path)."""
+        if not page_ids:
+            return
+        from ..distributed import mesh as mesh_mod
+        from .fleet_serving.kv_transfer import _KV_PAGES_STREAMED
+
+        sharding = mesh_mod.named_sharding()
+        n = len(page_ids)
+        ids_np = np.zeros((self.pages_per_seq,), np.int32)
+        ids_np[:n] = page_ids
+        ids = jnp.asarray(ids_np)
+
+        def pad(rows):
+            out = np.zeros((self.pages_per_seq,) + rows.shape[1:],
+                           rows.dtype)
+            out[:n] = rows
+            return jnp.asarray(out)
+
+        updated = [pool.at[ids].set(pad(rows))
+                   for pool, rows in zip(self._kv, payload.kv)]
+        updated += [plane.at[ids].set(pad(rows))
+                    for plane, rows in zip(self._kv_scales,
+                                           payload.scales)]
+        # one batched placement for the whole pytree (mirrors the
+        # export-side batching)
+        placed = jax.device_put(updated, sharding)
+        self._kv = placed[:len(self._kv)]
+        self._kv_scales = placed[len(self._kv):]
+        self.stats["kv_pages_imported"] = (
+            self.stats.get("kv_pages_imported", 0) + len(page_ids))
+        _KV_PAGES_STREAMED.inc(len(page_ids))
+
+    def _finish_prefill(self, slot, req):
+        """Retire a prefill-only request AT its frontier: export the
+        payload, release the slot/pages, resolve the future to the
+        payload (docs/SERVING.md "Disaggregated fleet")."""
+        payload = self.export_kv_pages(req)
+        self._release(slot, req)
+        self.stats["finished"] += 1
+        self.stats["prefill_exports"] = (
+            self.stats.get("prefill_exports", 0) + 1)
+        _FINISHED_TOTAL.inc()
+        if not req.future.cancelled():
+            req.future.set_result(payload)
 
     def kv_fragmentation(self):
         """Internal fragmentation of the live KV pages: unwritten
@@ -1118,8 +1317,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                         if self.prefix_cache is not None else 0)
             if avail + 2 * resident < need_all:
                 return False
-        pages = self._map_prefix(req) if self.prefix_cache is not None \
-            else []
+        # an imported request's prompt KV arrives in its payload — a
+        # trie mapping on top would alias pages the import must write
+        pages = (self._map_prefix(req)
+                 if self.prefix_cache is not None
+                 and req._kv_import is None else [])
 
         def give_up():
             if pages:
@@ -1168,6 +1370,16 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         req.admit_seq = next(self._admit_counter)
         req.pages = list(pages)
         req.n_prefilled = req.cached_prefix
+        if req._kv_import is not None:
+            # disaggregated hand-off: write the streamed pages and join
+            # at the frontier. The payload is CONSUMED — a later
+            # preemption replay re-prefills the prompt the ordinary way
+            # (greedy replay reproduces the identical continuation).
+            imp, req._kv_import = req._kv_import, None
+            req.pages = [self._alloc_page()
+                         for _ in range(imp.num_pages)]
+            self._write_imported_pages(req.pages, imp)
+            req.n_prefilled = imp.n_prefilled
         # mirrored draft pool: a shared page's draft rows were written
         # by the publishing request's own catch-up (same page ids, same
         # tokens, same draft model), so the mapped prefix is draft-valid
@@ -1179,7 +1391,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                                if self._spec is not None else 0)
         req.published_blocks = req.cached_prefix // self.hash_block_tokens
         self._page_tables[slot, :] = 0
-        self._page_tables[slot, :len(pages)] = pages
+        self._page_tables[slot, :len(req.pages)] = req.pages
         self._slots[slot] = req
         self._slot_gen += 1  # membership changed: staged arrays stale
         if self.prefix_cache is not None:
@@ -1189,6 +1401,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         if req.t_first_admit is None:
             req.t_first_admit = _time.perf_counter()
             _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
+        if (req.prefill_only
+                and req.n_prefilled >= req.prompt_len - 1):
+            # an import (or full trie hit) already covers the frontier:
+            # nothing left for this replica to compute
+            self._finish_prefill(slot, req)
         return True
 
     def _admit(self):
@@ -1226,6 +1443,12 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             budget = self.token_budget - len(active)
             for slot, req in active:
                 remaining = len(req.tokens) - req.n_prefilled
+                if req.prefill_only:
+                    # the frontier token belongs to the DECODE side of
+                    # the disaggregated hand-off: stop one short, so no
+                    # logit is ever computed (and no token sampled) on
+                    # a prefill replica
+                    remaining -= 1
                 take = 1 + min(remaining - 1, budget)
                 budget -= take - 1
                 alloc[slot] = take
@@ -1616,14 +1839,21 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 # default path, so outputs stay token-identical
                 nxt = np.asarray(jnp.argmax(lv, axis=-1))
 
+        finished = []
         for slot, req, take in plan:
             req.n_prefilled += take
             # per-tenant fair-queuing meter: flat tokens actually spent
             self.sched.note_tokens(req.tenant, take)
             if self.prefix_cache is not None:
                 self._publish_prefix(req)
+            if (req.prefill_only
+                    and req.n_prefilled >= len(req.tokens) - 1):
+                # disaggregated hand-off: the frontier is reached —
+                # export the pages and retire (publish above already
+                # registered the full prompt blocks in the trie)
+                self._finish_prefill(slot, req)
+                finished.append(req)
         _PAGE_FRAG.set(self.kv_fragmentation())
-        finished = []
         now = _time.perf_counter()
         for slot, tok_id in zip(sample_slots, nxt):
             req = self._slots[slot]
@@ -1688,10 +1918,17 @@ class LLMServer(_FutureQueueServer):
 
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                tenant="default", priority=None, ttft_slo_s=None,
-               temperature=0.0, top_p=1.0):
+               temperature=0.0, top_p=1.0, prefill_only=False,
+               kv_import=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
-        after it).
+        after it) — or, with `prefill_only=True`, to the exported
+        `fleet_serving.KVPagePayload` (the disaggregated hand-off;
+        `kv_import` is the receiving side — see
+        `LLMEngine.add_request`). The engine-side `_Request` is
+        attached to the future as `fut.pt_request` once ingested (the
+        router's TTFT source; None until the engine thread picks the
+        submission up).
 
         Fleet fields (docs/SERVING.md): `tenant` groups requests for
         token-budget fair queuing, `priority` is a
@@ -1705,32 +1942,41 @@ class LLMServer(_FutureQueueServer):
         PRNG key and keyed on (stream, position) — reproducible for a
         given engine seed whatever decode_k is."""
         fut = Future()
-        self._enqueue((np.asarray(prompt).reshape(-1),
-                       int(max_new_tokens), eos_token_id, fut,
-                       tenant, priority, ttft_slo_s,
-                       float(temperature), float(top_p)))
+        fut.pt_request = None
+        self._enqueue(dict(
+            prompt=np.asarray(prompt).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, future=fut, tenant=tenant,
+            priority=priority, ttft_slo_s=ttft_slo_s,
+            temperature=float(temperature), top_p=float(top_p),
+            prefill_only=bool(prefill_only), kv_import=kv_import))
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
         return self.submit(prompt, max_new_tokens, eos_token_id).result()
 
     def _ingest(self, payload):
-        (prompt, max_new, eos, fut, tenant, priority, slo,
-         temperature, top_p) = payload
+        fut = payload.pop("future")
         try:
-            self._engine.add_request(prompt, max_new, eos, future=fut,
-                                     tenant=tenant, priority=priority,
-                                     ttft_slo_s=slo,
-                                     temperature=temperature,
-                                     top_p=top_p)
+            fut.pt_request = self._engine.add_request(future=fut,
+                                                      **payload)
             self.stats["requests"] += 1
         except Exception as e:  # bad request must not kill the loop
             if not fut.done():
                 fut.set_exception(e)
 
+    def _tick_hook(self):
+        """Per-loop-iteration hook (fleet replica runtime: heartbeat +
+        chaos kill — fleet_serving.replica overrides). Returning True
+        aborts the serve loop DEAD: no drain, no future resolution —
+        the process-death shape the router's failover requeues."""
+        return False
+
     def _loop(self):
         eng = self._engine
         while self._running or not self._q.empty() or eng.has_work():
+            if self._tick_hook():
+                return
             try:
                 while True:
                     self._ingest(self._q.get_nowait())

@@ -1,0 +1,144 @@
+"""Worker for the 2-proc disaggregated-fleet chaos test
+(test_fleet_router.py::test_fleet_replica_2proc_kv_stream_chaos).
+
+Rank 0 is the PREFILL tier: it chunk-prefills a shared prompt set on a
+prefill-only engine and streams each request's finished KV pages to
+rank 1 over the xproc socket transport (kv_transfer) — the seeded
+chaos plan injects a `sock.send` fault on this path, which the
+transport's existing RetryPolicy must absorb by resending.
+
+Rank 1 is the DECODE tier: it imports every payload at its frontier,
+decodes, and compares against a locally-computed single-engine
+reference (same seed -> identical weights). It then runs the in-
+process failover scenario under the SAME plan: a 2-replica router
+whose replica "a" the plan kills mid-stream — the requeued outputs
+must match the reference too.
+
+Each rank writes fleet_out_<rank>.json; the test asserts matches,
+retry visibility, and the journal entries.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.inference.fleet_serving import (  # noqa: E402
+    AutoscalePolicy, FleetRouter, LocalReplica, fork_model, kv_transfer)
+from paddle_tpu.inference.llm_engine import (  # noqa: E402
+    LLMEngine, LLMEngineConfig)
+from paddle_tpu.text.models import GPTForCausalLM  # noqa: E402
+from paddle_tpu.text.models.gpt import gpt_tiny  # noqa: E402
+
+N_REQ = 5
+MAX_NEW = 8
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=4, page_size=16, token_budget=32,
+                max_model_len=96)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _drain(eng):
+    n = 0
+    while eng.has_work():
+        eng.step()
+        n += 1
+        assert n < 2000
+    return n
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    # each replica tier is a SINGLE-process serving engine: pin the
+    # global mesh to this rank's own device (the default mesh picks
+    # jax.devices()[:1] — rank 0's device, which rank 1 cannot even
+    # address; KV pools must live on the local replica)
+    import jax
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.init_mesh(devices=jax.local_devices()[:1])
+
+    paddle.seed(30)          # identical weights on both ranks
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(L),)).astype(
+        np.int32) for L in rng.integers(20, 60, N_REQ)]
+
+    out = {}
+    if rank == 0:
+        eng = LLMEngine(model, _ecfg())
+        sent_pages = 0
+        for p in prompts:
+            r = eng.add_request(p, prefill_only=True)
+            _drain(eng)
+            payload = r.future.result(timeout=0)
+            kv_transfer.send_kv_payload(payload, dst=1,
+                                        timeout_ms=300_000)
+            sent_pages += payload.num_pages
+        out = {"sent_pages": sent_pages,
+               "send_retries": int(xproc.stats["send_retries"]),
+               "generated_on_prefill_tier": eng.stats["generated"]}
+    else:
+        # local single-engine reference
+        ref_eng = LLMEngine(model, _ecfg())
+        refs = [ref_eng.add_request(p, max_new_tokens=MAX_NEW)
+                for p in prompts]
+        _drain(ref_eng)
+        ref = [r.future.result(timeout=0) for r in refs]
+
+        # disaggregated decode from the streamed pages
+        dec = LLMEngine(model, _ecfg())
+        outs = []
+        for p in prompts:
+            payload = kv_transfer.recv_kv_payload(0, timeout_ms=300_000)
+            r = dec.import_kv_pages(payload, max_new_tokens=MAX_NEW)
+            _drain(dec)
+            outs.append(r.future.result(timeout=0))
+        disagg_match = all(np.array_equal(a, b)
+                           for a, b in zip(ref, outs))
+
+        # in-process failover under the same seeded plan: the plan
+        # kills replica "a" at its 6th busy tick, mid-stream
+        def make(name):
+            return LocalReplica(fork_model(model), name=name,
+                                config=_ecfg())
+
+        router = FleetRouter(
+            replicas=[make("a"), make("b")],
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                   heartbeat_timeout_s=1.0,
+                                   poll_s=0.01))
+        with router:
+            futs = [router.submit(p, max_new_tokens=MAX_NEW)
+                    for p in prompts]
+            r_outs = [f.result(timeout=180) for f in futs]
+            m = router.metrics()
+        out = {
+            "disagg_match": bool(disagg_match),
+            "kv_pages_imported": dec.stats.get("kv_pages_imported", 0),
+            "router_match": all(np.array_equal(a, b)
+                                for a, b in zip(ref, r_outs)),
+            "replicas_lost": m["replicas_lost"],
+            "requeues": m["requeues"],
+        }
+
+    with open(os.path.join(out_dir, f"fleet_out_{rank}.json"),
+              "w") as f:
+        json.dump(out, f)
+    xproc.barrier()          # neither rank exits before both finished
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,626 @@
+"""Disaggregated multi-replica serving fleet (ISSUE 13):
+prefill/decode split over the KV-page wire, radix-affinity router,
+SLO autoscale, chaos-proven failover.
+
+The acceptance suite: KV-page wire parity (fp32/int8/int4 pools +
+scale planes byte-identical through export -> pack -> unpack -> import,
+mid-page frontier included), prefill-only engine contract, disagg
+greedy token identity vs the single engine, import geometry
+validation + zero-recompile + donation probes, router affinity /
+least-loaded routing, SLO autoscale up+down, and the seeded chaos
+replica-kill failover with token-identical outputs. The 2-proc xproc
+KV-stream chaos test (launch-based) carries `slow`.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import chaos
+from paddle_tpu.inference.fleet_serving import (
+    AutoscalePolicy, FleetRouter, LocalReplica, ReplicaRegistry,
+    fork_model, pack_kv_payload, unpack_kv_payload)
+from paddle_tpu.inference.llm_engine import (LLMEngine, LLMEngineConfig)
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # reset HERE, not only in the autouse fixture: module-scoped
+    # fixtures instantiate before function-scoped ones, so in a full
+    # suite run this would otherwise build the model under whatever
+    # 8-device mesh a previous test file left behind (mixed param
+    # placement -> "incompatible devices" at the first engine dispatch)
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _drain(eng, cap=800):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+    return steps
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=4, page_size=16, token_budget=32,
+                max_model_len=96)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _reference(model, prompts, max_new=12, **cfg_kw):
+    eng = LLMEngine(model, _ecfg(**cfg_kw))
+    reqs = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    _drain(eng)
+    return [r.future.result(timeout=0) for r in reqs]
+
+
+def _prompts(rng, cfg, lens):
+    return [rng.integers(0, cfg.vocab_size, (int(L),)).astype(np.int32)
+            for L in lens]
+
+
+# --------------------------------------------------------------------
+# KV-page wire parity (satellite 1)
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype",
+                         ["float32", "bfloat16", "int8", "int4"])
+def test_kv_page_wire_parity_byte_identical(tiny_model, kv_dtype):
+    """export -> pack -> unpack round-trips every pool (and, for the
+    quantized dtypes, every fp32 scale plane) BYTE-identical — the
+    contract that makes greedy outputs provably dtype-stable across
+    the hand-off. Prompt 23 leaves a PARTIALLY-FILLED frontier page
+    (n_prefilled 22 over page_size 16); prompt 33 lands the frontier
+    exactly on a page boundary."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(3)
+    for plen in (23, 33):
+        prompt = _prompts(rng, cfg, [plen])[0]
+        eng = LLMEngine(model, _ecfg(kv_dtype=kv_dtype))
+        req = eng.add_request(prompt, prefill_only=True)
+        _drain(eng)
+        payload = req.future.result(timeout=0)
+        assert payload.n_prefilled == plen - 1
+        assert payload.num_pages == -(-(plen - 1) // 16)
+        assert payload.kv_dtype == eng.kv_dtype
+        if kv_dtype in ("int8", "int4"):
+            assert payload.scales and payload.scales[0].dtype == \
+                np.float32
+        else:
+            assert payload.scales == []
+        back = unpack_kv_payload(pack_kv_payload(payload))
+        assert back.n_prefilled == payload.n_prefilled
+        assert np.array_equal(back.tokens, payload.tokens)
+        for a, b in zip(payload.kv + payload.scales,
+                        back.kv + back.scales):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes()
+        # and the import writes the SAME bytes: re-exporting from the
+        # importing engine returns them unchanged
+        dec = LLMEngine(model, _ecfg(kv_dtype=kv_dtype))
+        req2 = dec.import_kv_pages(back, max_new_tokens=4)
+        dec._admit()
+        assert req2.slot is not None
+        out = dec.export_kv_pages(req2)
+        for a, b in zip(payload.kv + payload.scales,
+                        out.kv + out.scales):
+            assert a.tobytes() == b.tobytes()
+        _drain(dec)
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_disagg_prefill_decode_token_identity(tiny_model, kv_dtype):
+    """The tentpole identity: prefill on engine A, stream pages,
+    decode on engine B == the single engine, token for token, across
+    mixed prompt lengths (mid-page and page-aligned frontiers)."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(0)
+    prompts = _prompts(rng, cfg, [7, 16, 17, 23, 33, 48])
+    ref = _reference(model, prompts, kv_dtype=kv_dtype)
+
+    pre = LLMEngine(model, _ecfg(kv_dtype=kv_dtype))
+    dec = LLMEngine(model, _ecfg(kv_dtype=kv_dtype))
+    payloads = []
+    for p in prompts:
+        r = pre.add_request(p, prefill_only=True)
+        _drain(pre)
+        payloads.append(r.future.result(timeout=0))
+        # no token was ever sampled on the prefill side, and the pages
+        # were handed back after export
+        assert pre.stats["generated"] == 0
+    assert pre.pool.num_live == 0
+    reqs = [dec.import_kv_pages(unpack_kv_payload(pack_kv_payload(pl)),
+                                max_new_tokens=12)
+            for pl in payloads]
+    _drain(dec)
+    for a, r in zip(ref, reqs):
+        assert np.array_equal(a, r.future.result(timeout=0))
+    assert dec.stats["kv_pages_imported"] == sum(
+        pl.num_pages for pl in payloads)
+
+
+def test_prefill_only_single_token_prompt(tiny_model):
+    """prompt_len == 1: nothing before the frontier — the export is
+    EMPTY and the decode side prefills the lone token itself."""
+    cfg, model = tiny_model
+    prompt = np.asarray([5], np.int32)
+    ref = _reference(model, [prompt], max_new=6)[0]
+    pre = LLMEngine(model, _ecfg())
+    req = pre.add_request(prompt, prefill_only=True)
+    payload = req.future.result(timeout=0)   # resolved without a step
+    assert payload.n_prefilled == 0 and payload.num_pages == 0
+    dec = LLMEngine(model, _ecfg())
+    r = dec.import_kv_pages(payload, max_new_tokens=6)
+    _drain(dec)
+    assert np.array_equal(ref, r.future.result(timeout=0))
+
+
+def test_import_geometry_validation(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(1)
+    prompt = _prompts(rng, cfg, [20])[0]
+    pre = LLMEngine(model, _ecfg())
+    req = pre.add_request(prompt, prefill_only=True)
+    _drain(pre)
+    payload = req.future.result(timeout=0)
+
+    wrong_ps = LLMEngine(model, _ecfg(page_size=8, token_budget=32))
+    with pytest.raises(ValueError, match="page_size"):
+        wrong_ps.import_kv_pages(payload, max_new_tokens=4)
+    wrong_dt = LLMEngine(model, _ecfg(kv_dtype="int8"))
+    with pytest.raises(ValueError, match="kv_dtype"):
+        wrong_dt.import_kv_pages(payload, max_new_tokens=4)
+    dec = LLMEngine(model, _ecfg())
+    bad = unpack_kv_payload(pack_kv_payload(payload))
+    bad.n_prefilled = len(prompt)       # frontier belongs to decode
+    with pytest.raises(ValueError, match="n_prefilled"):
+        dec.import_kv_pages(bad, max_new_tokens=4)
+    bad2 = unpack_kv_payload(pack_kv_payload(payload))
+    bad2.kv = bad2.kv[:-1]
+    with pytest.raises(ValueError, match="pools"):
+        dec.import_kv_pages(bad2, max_new_tokens=4)
+    # RAGGED payload: a non-first pool with a different page count
+    # must fail HERE, not inside the serve loop's page write (which
+    # would abort every co-resident request on the decode replica)
+    bad3 = unpack_kv_payload(pack_kv_payload(payload))
+    bad3.kv[1] = bad3.kv[1][:-1]
+    with pytest.raises(ValueError, match="pool 1"):
+        dec.import_kv_pages(bad3, max_new_tokens=4)
+    q = LLMEngine(model, _ecfg(kv_dtype="int8"))
+    qr = q.add_request(prompt, prefill_only=True)
+    _drain(q)
+    qpl = qr.future.result(timeout=0)
+    qbad = unpack_kv_payload(pack_kv_payload(qpl))
+    qbad.scales[0] = qbad.scales[0][:, :8]   # mis-shaped scale plane
+    dec8 = LLMEngine(model, _ecfg(kv_dtype="int8"))
+    with pytest.raises(ValueError, match="scale plane 0"):
+        dec8.import_kv_pages(qbad, max_new_tokens=4)
+
+
+def test_import_zero_recompile_and_donation(tiny_model):
+    """The CI probe on the new path: imports + decode hold ONE
+    compiled decode executable with donation intact — the page write
+    re-commits the pools at the same placement signature."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(2)
+    prompts = _prompts(rng, cfg, [18, 25, 40])
+    pre = LLMEngine(model, _ecfg())
+    dec = LLMEngine(model, _ecfg())
+    for p in prompts:
+        r = pre.add_request(p, prefill_only=True)
+        _drain(pre)
+        dr = dec.import_kv_pages(r.future.result(timeout=0),
+                                 max_new_tokens=8)
+        _drain(dec)
+        dr.future.result(timeout=0)
+    stats = dec.compile_stats(check_donation=True)
+    assert stats["executables"] == 1
+    assert stats["donation"]["held"], stats["donation"]
+    assert pre.compile_stats()["executables"] == 1
+
+
+def test_preempted_import_replays_deterministically(tiny_model):
+    """A preempted imported request lost its streamed pages — the
+    replay falls back to ordinary prefill and the greedy continuation
+    is unchanged (the payload is consumed exactly once)."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(4)
+    prompt = _prompts(rng, cfg, [30])[0]
+    ref = _reference(model, [prompt], max_new=10)[0]
+    pre = LLMEngine(model, _ecfg())
+    req = pre.add_request(prompt, prefill_only=True)
+    _drain(pre)
+    dec = LLMEngine(model, _ecfg())
+    r = dec.import_kv_pages(req.future.result(timeout=0),
+                            max_new_tokens=10)
+    dec.step()                      # decode a couple of tokens...
+    dec.step()
+    assert r.slot is not None
+    dec._preempt(r.slot, r, reason="pool")   # ...then evict mid-decode
+    _drain(dec)
+    assert np.array_equal(ref, r.future.result(timeout=0))
+    assert r.preemptions == 1
+
+
+def test_prefill_only_publishes_prefix_blocks(tiny_model):
+    """A prefill replica with the radix cache on indexes the prompt it
+    prefilled — the NEXT prefill of the same system prompt maps the
+    trie instead of recomputing (fleet-wide asset on the prefill tier
+    too)."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(5)
+    sysp = _prompts(rng, cfg, [32])[0]
+    a = np.concatenate([sysp, _prompts(rng, cfg, [8])[0]])
+    b = np.concatenate([sysp, _prompts(rng, cfg, [9])[0]])
+    pre = LLMEngine(model, _ecfg(prefix_cache=True))
+    ra = pre.add_request(a, prefill_only=True)
+    _drain(pre)
+    rb = pre.add_request(b, prefill_only=True)
+    _drain(pre)
+    assert pre.prefix_cache.stats["hits"] >= 1
+    assert rb.future.result(timeout=0).n_prefilled == len(b) - 1
+    ra.future.result(timeout=0)
+
+
+# --------------------------------------------------------------------
+# Replica runtime + registry
+# --------------------------------------------------------------------
+
+def test_replica_registry_heartbeats_and_elastic_view(tiny_model,
+                                                      tmp_path,
+                                                      monkeypatch):
+    cfg, model = tiny_model
+    hb = str(tmp_path / "hb")
+    reg = ReplicaRegistry(hb_dir=hb, timeout_s=1.0)
+    rep = LocalReplica(fork_model(model), name="r0", registry=reg,
+                       config=_ecfg())
+    try:
+        assert reg.alive("r0") and rep.alive
+        assert "r0" in reg.live()
+        # the hb_<rid> mirror makes the fleet observable through the
+        # SAME ElasticManager view as a training pod
+        assert os.path.exists(os.path.join(hb, f"hb_{rep.rid}"))
+        monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", hb)
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        peers = ElasticManager().peers()
+        assert [r for r, _ in peers] == [rep.rid]
+        # a killed replica stops beating and goes dead by staleness
+        rep.kill()
+        deadline = time.monotonic() + 10
+        while reg.alive("r0") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not reg.alive("r0") and not rep.running
+    finally:
+        reg.deregister("r0")
+    assert not os.path.exists(os.path.join(hb, f"hb_{rep.rid}"))
+
+
+def test_replica_submit_surface_matches_engine(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(6)
+    prompts = _prompts(rng, cfg, [10, 22, 35])
+    ref = _reference(model, prompts, max_new=8)
+    rep = LocalReplica(fork_model(model), config=_ecfg())
+    try:
+        futs = [rep.submit(p, max_new_tokens=8) for p in prompts]
+        for a, f in zip(ref, futs):
+            assert np.array_equal(a, f.result(timeout=60))
+        # prefill -> imported round trip through the server surface
+        pf = rep.submit_prefill(prompts[2])
+        payload = pf.result(timeout=60)
+        assert payload.n_prefilled == len(prompts[2]) - 1
+        rf = rep.submit_imported(payload, max_new_tokens=8)
+        assert np.array_equal(ref[2], rf.result(timeout=60))
+    finally:
+        rep.stop()
+
+
+# --------------------------------------------------------------------
+# Router: affinity, fallback, autoscale, failover
+# --------------------------------------------------------------------
+
+def _mk_factory(model, **cfg_kw):
+    def make(name, role="serve"):
+        return LocalReplica(fork_model(model), name=name, role=role,
+                            config=_ecfg(**cfg_kw))
+    return make
+
+
+def test_router_affinity_concentrates_shared_prefixes(tiny_model):
+    """Shared-prefix traffic routes to the replica whose view holds
+    the prefix (hit rate > 0.5 on a 2-group workload), and greedy
+    outputs stay token-identical to the single engine."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+    groups = _prompts(rng, cfg, [32, 32])
+    prompts = [np.concatenate([groups[j % 2],
+                               _prompts(rng, cfg, [4 + j])[0]])
+               for j in range(10)]
+    ref = _reference(model, prompts, max_new=8,
+                     prefix_cache=True)
+    make = _mk_factory(model, prefix_cache=True)
+    router = FleetRouter(replicas=[make("a"), make("b")],
+                         hash_block_tokens=16,
+                         policy=AutoscalePolicy(min_replicas=2,
+                                                max_replicas=2))
+    with router:
+        futs = [router.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        m = router.metrics()
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+    # first request of each group misses, the rest of its group hits
+    assert m["affinity_hit_rate"] > 0.5
+    assert m["requests"] == 10
+
+
+def test_router_least_loaded_fallback_spreads(tiny_model):
+    """Prefix-free traffic (no affinity signal) spreads by the
+    queue-depth/occupancy load gauges — both replicas serve work."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(8)
+    prompts = _prompts(rng, cfg, [8] * 8)   # < hash_block_tokens: no keys
+    make = _mk_factory(model)
+    router = FleetRouter(replicas=[make("a"), make("b")],
+                         policy=AutoscalePolicy(min_replicas=2,
+                                                max_replicas=2))
+    with router:
+        futs = [router.submit(p, max_new_tokens=16) for p in prompts]
+        [f.result(timeout=120) for f in futs]
+        m = router.metrics()
+        served = {name: rep for name, rep in m["replicas"].items()}
+    assert m["affinity_hit_rate"] == 0.0
+    assert all(v["mean_slot_occupancy"] > 0 for v in served.values()), \
+        served
+
+
+def test_router_failover_chaos_kill_token_identity(tiny_model):
+    """THE acceptance scenario: a seeded chaos plan kills replica "a"
+    mid-stream (busy tick 6); its in-flight requests requeue onto the
+    survivor and the router's greedy outputs are token-identical to
+    the unkilled single-engine run. Client futures never observe the
+    death."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(9)
+    prompts = _prompts(rng, cfg, rng.integers(6, 40, 10))
+    ref = _reference(model, prompts, max_new=12)
+    chaos.install({"seed": 5, "injectors": [
+        {"scope": "replica.kill.a", "kind": "error", "at": [6]}]})
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("a"), make("b")],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               heartbeat_timeout_s=1.0, poll_s=0.01))
+    with router:
+        futs = [router.submit(p, max_new_tokens=12) for p in prompts]
+        outs = [f.result(timeout=180) for f in futs]
+        m = router.metrics()
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+    assert m["replicas_lost"] == 1
+    assert m["requeues"] >= 1            # it WAS mid-stream
+    assert chaos.get_plan().injected.get("replica.kill.a") == 1
+
+
+def test_router_wedged_replica_fails_over(tiny_model):
+    """A replica whose loop WEDGES (hang injector: thread still
+    alive, heartbeats stopped) counts DEAD by staleness and its
+    in-flight work requeues — the contract is `not alive`, not
+    thread-death. At-least-once semantics: when the wedge clears, the
+    zombie may finish duplicate work, but every client future already
+    carries (or will carry) the identical greedy result."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(13)
+    prompts = _prompts(rng, cfg, rng.integers(6, 40, 10))
+    ref = _reference(model, prompts, max_new=12)
+    chaos.install({"seed": 2, "injectors": [
+        {"scope": "replica.kill.a", "kind": "delay", "at": [4],
+         "delay_s": 4.0}]})
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("a"), make("b")],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               heartbeat_timeout_s=0.5, poll_s=0.01))
+    with router:
+        futs = [router.submit(p, max_new_tokens=12) for p in prompts]
+        outs = [f.result(timeout=180) for f in futs]
+        # once the wedge clears (the 4s delay ends and the loop keeps
+        # running), the monitor must RE-ADOPT the expelled member — a
+        # transient stall never permanently shrinks the fleet
+        deadline = time.monotonic() + 30
+        while (router.num_replicas() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        m = router.metrics()
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+    assert m["replicas_lost"] == 1
+    assert m["requeues"] >= 1
+    assert m.get("replicas_recovered", 0) == 1
+    assert set(m["replicas"]) == {"a", "b"}
+
+
+def test_router_autoscale_up_and_down(tiny_model):
+    """SLO autoscale on the heartbeat+metrics plumbing: a burst above
+    queue_high grows the fleet (factory-built members join live), the
+    idle fleet shrinks back to min_replicas, and every output is
+    correct across the resizes."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(10)
+    # the burst must outlast the factory's replica warm-up (a compile,
+    # seconds on this CPU), or the fleet legitimately never needs to
+    # grow — 36 requests x 24 tokens holds the queue high long enough
+    prompts = _prompts(rng, cfg, rng.integers(6, 30, 36))
+    ref = _reference(model, prompts, max_new=24)
+    make = _mk_factory(model)
+    router = FleetRouter(
+        factory=make,
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=3,
+                               queue_high=2, queue_low=0,
+                               cooldown_s=0.05, poll_s=0.01))
+    with router:
+        assert router.num_replicas() == 1
+        futs = [router.submit(p, max_new_tokens=24) for p in prompts]
+        peak = 1
+        while not all(f.done() for f in futs):
+            peak = max(peak, router.num_replicas())
+            time.sleep(0.01)
+        outs = [f.result(timeout=0) for f in futs]
+        deadline = time.monotonic() + 30
+        while (router.num_replicas() > 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        final = router.num_replicas()
+        m = router.metrics()
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+    assert peak >= 2, "burst never scaled up"
+    assert final == 1, "idle fleet failed to shrink"
+    assert m["scale_ups"] >= 1 and m["scale_downs"] >= 1
+
+
+def test_router_disaggregated_prefill_decode(tiny_model):
+    """Long prompts route through the prefill replica and hand off at
+    the frontier; short ones go straight to decode. Outputs match the
+    single engine either way and the hand-off count is exact."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(11)
+    long_p = _prompts(rng, cfg, [64, 80, 72])
+    short_p = _prompts(rng, cfg, [8, 10])
+    prompts = [long_p[0], short_p[0], long_p[1], short_p[1], long_p[2]]
+    ref = _reference(model, prompts, max_new=8)
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("d1")],
+        prefill_replicas=[make("p1", role="prefill")],
+        prefill_min_tokens=48,
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1))
+    with router:
+        futs = [router.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        m = router.metrics()
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+    assert m["disagg_handoffs"] == 3
+    assert m["replicas"]["p1"]["role"] == "prefill"
+
+
+def test_router_dead_prefill_replica_falls_back(tiny_model):
+    """Losing the ONLY prefill replica degrades to whole-request
+    serving on the decode tier — no client-visible failure, outputs
+    unchanged."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(12)
+    prompts = _prompts(rng, cfg, [64, 70])
+    ref = _reference(model, prompts, max_new=8)
+    make = _mk_factory(model)
+    pre = make("p1", role="prefill")
+    router = FleetRouter(
+        replicas=[make("d1")], prefill_replicas=[pre],
+        prefill_min_tokens=48,
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1,
+                               heartbeat_timeout_s=0.5, poll_s=0.01))
+    with router:
+        pre.kill()
+        deadline = time.monotonic() + 10
+        while pre.alive and time.monotonic() < deadline:
+            time.sleep(0.02)
+        futs = [router.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+    for a, b in zip(ref, outs):
+        assert np.array_equal(a, b)
+
+
+# --------------------------------------------------------------------
+# 2-proc xproc KV stream under chaos (satellite 5; slow launch test)
+# --------------------------------------------------------------------
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_replica_2proc_kv_stream_chaos(tmp_path):
+    """Cross-process disaggregation under a seeded fault plan: rank 0
+    prefills and streams KV payloads to rank 1 over the xproc socket
+    path while chaos injects a send fault (absorbed by the existing
+    RetryPolicy resend) and a recv stall; rank 1 additionally runs a
+    2-replica router under a seeded replica kill. Greedy outputs must
+    match rank-1-local references on BOTH paths, retries must be
+    visible, and the injections journaled."""
+    plan = json.dumps({"seed": 77, "injectors": [
+        {"scope": "sock.send", "kind": "error", "at": [1],
+         "ranks": [0]},
+        {"scope": "sock.recv", "kind": "delay", "at": [0],
+         "delay_s": 0.2, "ranks": [1]},
+        {"scope": "replica.kill.a", "kind": "error", "at": [5],
+         "ranks": [1]}]})
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+           os.path.join(ROOT, "tests", "fleet_replica_worker.py"),
+           str(tmp_path)]
+    r = subprocess.run(cmd, env=_env({chaos.ENV_PLAN: plan}), cwd=ROOT,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    with open(tmp_path / "fleet_out_0.json") as f:
+        out0 = json.load(f)
+    with open(tmp_path / "fleet_out_1.json") as f:
+        out1 = json.load(f)
+    # the KV stream arrived byte-faithful and token-identical
+    assert out1["disagg_match"] is True
+    assert out1["kv_pages_imported"] == out0["sent_pages"] > 0
+    # the injected send fault was absorbed by the transport retry
+    assert out0["send_retries"] >= 1
+    # the seeded replica kill requeued mid-stream work, outputs intact
+    assert out1["router_match"] is True
+    assert out1["replicas_lost"] == 1
+    # both injections journaled per rank
+    for rank, scope in ((0, "sock.send"), (1, "replica.kill.a")):
+        journal = tmp_path / "log" / f"anomalies.rank{rank}.jsonl"
+        events = [json.loads(line)
+                  for line in journal.read_text().splitlines()]
+        assert any(e["kind"] == "chaos_injected"
+                   and e.get("scope") == scope for e in events), scope
