@@ -35,9 +35,21 @@ scope               injection point
 ``sock.connect``    p2p transport connection establishment
 ``sock.send``       p2p frame send (stall or pre-write drop)
 ``sock.recv``       p2p frame receive (stall)
-``ckpt.kill_window``between shard write and meta.json commit
-``step``            train-step entry (crash/hang at step N)
-``step.nan``        StepGuard loss poisoning (NaN/Inf grad shape)
+``ckpt.snapshot``   checkpoint SNAPSHOT phase, on the step path (one
+                    call per save, after host materialization, before
+                    the commit is handed off)
+``ckpt.commit``     checkpoint COMMIT phase entry (background thread
+                    under async_save) — call n = this rank's nth commit
+``ckpt.commit.<r>`` same tick, but only rank r fires its own scope —
+                    the way a FaultPlan SIGKILLs exactly one rank
+                    mid-commit (busy-tick counting like
+                    ``replica.kill.<name>``)
+``ckpt.kill_window``between this rank's shard write and its DONE.<rank>
+                    commit marker (THE torn-commit window)
+``step``            train-step entry (crash/hang at step N; fired by
+                    StepGuard.check AND DivergenceSentinel.check)
+``step.nan``        StepGuard/DivergenceSentinel loss poisoning
+                    (NaN/Inf grad shape)
 ``replica.kill``    fleet-replica serve-loop tick (fleet_serving
                     .replica): a fired injector stops that replica's
                     loop DEAD — no drain, no future resolution — and

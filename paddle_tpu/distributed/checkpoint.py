@@ -11,22 +11,45 @@ Key differences from the reference design:
   full params onto rank 0 before writing. Here every process writes only
   its *addressable* array shards (`Array.addressable_shards`), so a ZeRO-3
   / TP-sharded model checkpoints with zero cross-device traffic.
-- **Async by construction.** Device→host copies are started with
-  `copy_to_host_async()` for every shard up front; with `async_save=True`
-  file writes happen on a background thread while training continues
-  (reference FLAGS_save_* has no async path).
-- **Atomic commit.** Everything is written into `<dir>.tmp` and renamed
-  into place after `meta.json` (the commit record) is complete — a killed
-  job never leaves a half-checkpoint that `load_latest` would pick up.
+- **Async by construction.** A save is two phases. The synchronous
+  SNAPSHOT phase runs on the step path: per-shard `copy_to_host_async`,
+  host-buffer materialization, and ALL cross-rank coordination
+  (barriers) — so the step can keep donating its buffers the moment it
+  returns. The COMMIT phase (durable write → fsync → atomic rename) runs
+  on a background thread with `async_save=True` and issues ZERO
+  collectives: cross-rank completion is coordinated through per-rank
+  ``DONE.<rank>`` marker files in the tmp dir (the `fleet/elastic`
+  heartbeat file-protocol style), never through the coordination KV or
+  XLA collectives — a writer-thread collective would race whatever the
+  main thread dispatches meanwhile (mismatched programs → hang), which
+  is exactly why the old design force-downgraded multi-process saves to
+  synchronous. A second save issued while a commit is in flight
+  back-pressures (joins the in-flight commit, journaled
+  ``ckpt_backpressure``, counted into ``pt_ckpt_step_stall_seconds``).
+- **Atomic commit.** Everything is written into `<dir>.tmp`; each rank
+  drops its ``DONE.<rank>`` marker only after its shards + meta fragment
+  are durable, and the rename into place happens exactly once (leader
+  elected by ``COMMIT_LEADER`` O_EXCL) only after EVERY rank's marker is
+  present — a killed job, or one killed rank, never leaves a
+  half-checkpoint that `load_latest` would pick up. `is_complete`
+  re-verifies the marker set against the ``commit.world`` recorded in
+  meta.json, so even a hand-mutilated directory missing one rank's
+  marker stays invisible (`pt_ckpt_incomplete_discarded_total`).
 
 Layout::
 
     ckpt-000042/
-      meta.json            # commit marker: leaf table, shapes, dtypes
+      meta.json            # commit record: leaf table + commit.world
+      DONE.<r>             # per-rank commit markers (all present by
+                           # construction once meta.json is visible)
       shards/<leaf>#<k>.npy
 
 Multi-controller jobs: each process writes its own shard files plus a
-``meta.rank<r>.json`` fragment; rank 0 merges fragments and commits.
+``meta.rank<r>.json`` fragment into the SHARED checkpoint filesystem;
+the elected leader merges fragments and renames. Chaos scopes
+``ckpt.snapshot`` / ``ckpt.commit`` / ``ckpt.commit.<rank>`` /
+``ckpt.kill_window`` target the phases deterministically
+(docs/RESILIENCE.md).
 """
 import hashlib
 import json
@@ -63,6 +86,21 @@ _TORN_FALLBACKS = _obs.counter(
     "pt_ckpt_torn_fallbacks_total",
     "torn checkpoints skipped by load_latest's older-checkpoint "
     "fallback")
+_STALL_SECONDS = _obs.histogram(
+    "pt_ckpt_step_stall_seconds",
+    "time the training step path actually blocked on a save "
+    "(back-pressure + snapshot phase; the commit runs off the step "
+    "path under async_save)")
+_COMMIT_SECONDS = _obs.histogram(
+    "pt_ckpt_commit_seconds",
+    "background COMMIT phase wall time (durable shard write -> rename "
+    "visible)")
+_INFLIGHT = _obs.gauge(
+    "pt_ckpt_inflight", "checkpoint commits currently in flight")
+_INCOMPLETE_DISCARDED = _obs.counter(
+    "pt_ckpt_incomplete_discarded_total",
+    "checkpoint dirs rejected because a rank's DONE commit marker is "
+    "missing (counted once per directory per process)")
 
 __all__ = ["save_state_dict", "load_state_dict", "Checkpointer",
            "verify_integrity", "TornCheckpointError"]
@@ -77,6 +115,17 @@ class TornCheckpointError(ValueError):
 
 
 _META = "meta.json"
+
+# two-phase commit protocol files (inside <path>.tmp): per-rank DONE
+# markers + the leader-election lock for the final rename
+_DONE_PREFIX = "DONE."
+_LEADER = "COMMIT_LEADER"
+# commit-phase marker-wait budget: bounded so a rank SIGKILLed before
+# its marker can never wedge a surviving writer thread forever (the
+# elastic layer restarts the pod long before this fires in practice)
+_COMMIT_TIMEOUT_S = float(os.environ.get("PT_CKPT_COMMIT_TIMEOUT_S",
+                                         "600"))
+_POLL_S = 0.01
 
 # Durability: fsync shard files, meta.json and the directories before the
 # .tmp rename — without it a host crash right AFTER the rename can still
@@ -195,17 +244,40 @@ def _proc_index():
         return 0, 1
 
 
-def save_state_dict(state, path, async_save=False):
+# back-pressure: commits in flight in this process. A new save joins
+# them before snapshotting (two concurrent commits to sibling dirs are
+# safe, but unbounded pile-up under a slow filesystem would eat host
+# RAM one full host-snapshot per lap) — the join time is step-path
+# stall and is counted into pt_ckpt_step_stall_seconds.
+_inflight_lock = threading.Lock()
+_inflight = []
+
+
+def _join_inflight():
+    with _inflight_lock:
+        handles = [h for h in _inflight if h.is_alive()]
+    for h in handles:
+        h.join()
+    return bool(handles)
+
+
+def save_state_dict(state, path, async_save=False, _stall_start=None):
     """Write `state` (nested dict of Tensors / arrays / scalars) to
     directory `path`. Every process saves only its addressable shards.
-    Returns a handle with .result() (joins the writer; re-raises errors);
-    with async_save=False the write is complete on return."""
+
+    The SNAPSHOT phase (everything up to the returned handle: D2H
+    copies, host materialization, cross-rank barriers) is synchronous —
+    after it, the caller may donate/overwrite every saved buffer. With
+    async_save=True the COMMIT phase (durable write + marker protocol +
+    rename) runs on a background thread and issues no collectives; this
+    is safe at any process count. Returns a handle with .result()
+    (joins the committer; re-raises errors); with async_save=False the
+    checkpoint is complete and visible on return."""
+    t_stall0 = _time.perf_counter() if _stall_start is None \
+        else _stall_start
+    if _join_inflight():
+        record("ckpt_backpressure", path=path)
     rank, nproc = _proc_index()
-    if async_save and nproc > 1:
-        # the writer thread's merge barriers would race any collective the
-        # main thread issues meanwhile (mismatched programs → hang); the
-        # multi-controller path is synchronous by design
-        async_save = False
     tmp = path + ".tmp"
     if rank == 0:
         if os.path.isdir(tmp):
@@ -258,17 +330,101 @@ def save_state_dict(state, path, async_save=False):
                 leaf = leaf.decode("latin1")
             scalars[key] = leaf
 
-    # Snapshot to host NOW: compiled steps donate param/opt buffers, so a
-    # device array held past this call may be deleted under the writer
-    # thread. copy_to_host_async above pipelined the D2H transfers; this
-    # loop mostly just collects them. Only file I/O is deferred.
-    pending = [(fpath, np.asarray(dev_arr)) for fpath, dev_arr in pending]
+    # Snapshot to host NOW, on the step path: compiled steps donate
+    # param/opt buffers, so a device array held past this call may be
+    # deleted — or updated IN PLACE — under the committer thread.
+    # copy_to_host_async above pipelined the D2H transfers; this loop
+    # mostly just collects them. On CPU backends np.asarray of a device
+    # array is ZERO-COPY (the ISSUE-11 aliasing lesson): the "snapshot"
+    # would be a live view of a donated buffer, and the overlapped
+    # commit would serialize bytes the next train step is mutating —
+    # force an owned host copy whenever the array aliases foreign
+    # memory (`base is not None`; a real D2H transfer owns its buffer
+    # and costs nothing extra here). Only durable file I/O is deferred
+    # to the commit phase.
+    def _own(dev_arr):
+        host = np.asarray(dev_arr)
+        return host.copy() if host.base is not None else host
 
-    t_start = _time.perf_counter()
+    pending = [(fpath, _own(dev_arr)) for fpath, dev_arr in pending]
+    # scope contract (chaos.py table): fires AFTER host materialization,
+    # BEFORE the commit hand-off — the captured-but-uncommitted window
+    chaos.fire("ckpt.snapshot")
+    if nproc > 1:
+        from . import xproc
 
-    def _write():
+        # every rank snapshotted — the LAST collective of this save;
+        # the commit phase coordinates through marker files only
+        xproc.barrier()
+
+    committer = _Committer(
+        tmp=tmp, path=path, rank=rank, nproc=nproc, pending=pending,
+        leaves=leaves, scalars=scalars, lists=sorted(list_paths),
+        bytes_paths=bytes_paths, empties=empties, t_start=t_stall0)
+    if async_save:
+        h = _AsyncHandle(committer.run)
+        with _inflight_lock:
+            _inflight.append(h)
+        h.start()
+        _STALL_SECONDS.observe(_time.perf_counter() - t_stall0)
+        return h
+    committer.run()
+    # synchronous saves stall the step path for the whole commit — that
+    # asymmetry IS the overlapped-checkpointing win the bench
+    # ckpt_overlap_ab stamp measures. Observed on SUCCESS only: under
+    # the Checkpointer retry policy each attempt re-enters with the
+    # original _stall_start, so a per-attempt (finally) observation
+    # would double-count the same logical save
+    _STALL_SECONDS.observe(_time.perf_counter() - t_stall0)
+    return _DoneHandle()
+
+
+class _Committer:  # ptlint: thread-shared
+    """The background COMMIT phase of one save: durable shard writes,
+    the per-rank DONE marker protocol, and the leader-elected atomic
+    rename. Runs on the caller thread (sync) or an _AsyncHandle thread
+    (async). INVARIANT: no collectives and no coordination-KV traffic
+    here, ever — a commit-thread collective would interleave with
+    whatever program the main thread dispatches concurrently and hang
+    the pod (the documented race that used to force multi-process
+    saves synchronous). Cross-rank coordination is marker files on the
+    shared checkpoint filesystem only."""
+
+    def __init__(self, tmp, path, rank, nproc, pending, leaves, scalars,
+                 lists, bytes_paths, empties, t_start):
+        self.tmp = tmp
+        self.path = path
+        self.rank = rank
+        self.nproc = nproc
+        self.pending = pending
+        self.leaves = leaves
+        self.scalars = scalars
+        self.lists = lists
+        self.bytes_paths = bytes_paths
+        self.empties = empties
+        self.t_start = t_start
+
+    def run(self):
+        t0 = _time.perf_counter()
+        _INFLIGHT.inc()
+        try:
+            with _trace_span("ckpt.save", path=self.path):
+                self._commit_phase()
+            _OPS_TOTAL.labels(op="save").inc()
+            # duration from the CALLER's save start: includes snapshot
+            # + any back-pressure, so async and sync report comparably
+            _SAVE_SECONDS.observe(_time.perf_counter() - self.t_start)
+        finally:
+            _INFLIGHT.dec()
+            _COMMIT_SECONDS.observe(_time.perf_counter() - t0)
+
+    def _commit_phase(self):
+        # deterministic chaos targets for the new phase (counted like
+        # every scope: nth call of this scope on this rank)
+        chaos.fire("ckpt.commit")
+        chaos.fire(f"ckpt.commit.{self.rank}")
         n_bytes = 0
-        for fpath, host_arr in pending:
+        for fpath, host_arr in self.pending:
             storage, _ = _to_storage(host_arr)
             n_bytes += storage.nbytes
             with open(fpath, "wb") as f:
@@ -276,65 +432,120 @@ def save_state_dict(state, path, async_save=False):
                 if _FSYNC:
                     f.flush()
                     os.fsync(f.fileno())
-        # THE torn-commit window: shards are on disk, the commit record
-        # is not — a kill here must leave only an invisible .tmp
-        chaos.fire("ckpt.kill_window")
-        frag = {"leaves": leaves, "scalars": scalars,
-                "lists": sorted(list_paths), "bytes": bytes_paths,
-                "empties": empties}
-        if nproc > 1:
-            with open(os.path.join(tmp, f"meta.rank{rank}.json"), "w") as f:
+        if self.nproc > 1:
+            frag = {"leaves": self.leaves, "scalars": self.scalars,
+                    "lists": self.lists, "bytes": self.bytes_paths,
+                    "empties": self.empties}
+            with open(os.path.join(self.tmp,
+                                   f"meta.rank{self.rank}.json"),
+                      "w") as f:
                 json.dump(frag, f)
                 if _FSYNC:
                     f.flush()
                     os.fsync(f.fileno())
-            from . import xproc
-
-            xproc.barrier()  # all fragments + shards on disk
-            if rank == 0:
-                seen_scalars, by_path, empt = {}, {}, {}
-                lists, byts = set(), set()
-                for r in range(nproc):
-                    with open(os.path.join(
-                            tmp, f"meta.rank{r}.json")) as f:
-                        fr = json.load(f)
-                    seen_scalars.update(fr["scalars"])
-                    lists.update(fr["lists"])
-                    byts.update(fr["bytes"])
-                    empt.update(fr.get("empties", {}))
-                    for e in fr["leaves"]:
-                        tgt = by_path.setdefault(e["path"], e)
-                        if tgt is not e:
-                            tgt["shards"] += e["shards"]
-                _commit(tmp, path, list(by_path.values()), seen_scalars,
-                        sorted(lists), sorted(byts), empt)
-            xproc.barrier()  # commit visible before anyone proceeds
-        else:
-            _commit(tmp, path, leaves, scalars, sorted(list_paths),
-                    bytes_paths, empties)
+        # THE torn-commit window: this rank's shards are on disk, its
+        # commit marker is not — a kill here leaves the marker set
+        # incomplete, so no rank can ever rename the tmp visible
+        chaos.fire("ckpt.kill_window")
+        marker = os.path.join(self.tmp, f"{_DONE_PREFIX}{self.rank}")
+        with open(marker, "w") as f:
+            f.write(str(_time.time()))
+            if _FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
+        _fsync_dir(os.path.join(self.tmp, "shards"))
+        _fsync_dir(self.tmp)
+        self._await_markers()
+        if self._claim_leader():
+            self._finalize()
+        self._await_visible()
         _BYTES_TOTAL.labels(direction="saved").inc(n_bytes)
-        _OPS_TOTAL.labels(op="save").inc()
-        # duration from the CALLER's save start: includes the host
-        # snapshot above, so async and sync saves report comparably
-        _SAVE_SECONDS.observe(_time.perf_counter() - t_start)
 
-    def _traced_write():
-        with _trace_span("ckpt.save", path=path):
-            _write()
+    def _deadline(self):
+        return _time.monotonic() + _COMMIT_TIMEOUT_S
 
-    if async_save:
-        h = _AsyncHandle(_traced_write)
-        h.start()
-        return h
-    _traced_write()
-    return _DoneHandle()
+    def _visible(self):
+        """The rename happened: tmp is gone (a peer — or this rank —
+        published the checkpoint)."""
+        return not os.path.isdir(self.tmp)
+
+    def _await_markers(self):
+        deadline = self._deadline()
+        while True:
+            if self._visible():
+                return
+            if all(os.path.exists(
+                    os.path.join(self.tmp, f"{_DONE_PREFIX}{r}"))
+                    for r in range(self.nproc)):
+                return
+            if _time.monotonic() > deadline:
+                record("ckpt_commit_timeout", path=self.path,
+                       phase="markers", rank=self.rank)
+                raise TimeoutError(
+                    f"ckpt commit {self.path}: not every rank's "
+                    f"{_DONE_PREFIX}<r> marker appeared within "
+                    f"{_COMMIT_TIMEOUT_S:.0f}s — a peer likely died "
+                    "mid-commit; this checkpoint stays invisible and "
+                    "load_latest falls back to the previous one")
+            _time.sleep(_POLL_S)
+
+    def _claim_leader(self):
+        """Exactly-once rename election: O_CREAT|O_EXCL on the shared
+        lock file. Claimed only after every marker is present, so the
+        leader is guaranteed to see all fragments."""
+        try:
+            fd = os.open(os.path.join(self.tmp, _LEADER),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except FileNotFoundError:
+            return False        # a peer already renamed tmp away
+        os.close(fd)
+        return True
+
+    def _finalize(self):
+        if self.nproc > 1:
+            seen_scalars, by_path, empt = {}, {}, {}
+            lists, byts = set(), set()
+            for r in range(self.nproc):
+                with open(os.path.join(
+                        self.tmp, f"meta.rank{r}.json")) as f:
+                    fr = json.load(f)
+                seen_scalars.update(fr["scalars"])
+                lists.update(fr["lists"])
+                byts.update(fr["bytes"])
+                empt.update(fr.get("empties", {}))
+                for e in fr["leaves"]:
+                    tgt = by_path.setdefault(e["path"], e)
+                    if tgt is not e:
+                        tgt["shards"] += e["shards"]
+            _commit(self.tmp, self.path, list(by_path.values()),
+                    seen_scalars, sorted(lists), sorted(byts), empt,
+                    world=self.nproc)
+        else:
+            _commit(self.tmp, self.path, self.leaves, self.scalars,
+                    self.lists, self.bytes_paths, self.empties,
+                    world=1)
+
+    def _await_visible(self):
+        deadline = self._deadline()
+        while not self._visible():
+            if _time.monotonic() > deadline:
+                record("ckpt_commit_timeout", path=self.path,
+                       phase="rename", rank=self.rank)
+                raise TimeoutError(
+                    f"ckpt commit {self.path}: the elected leader never "
+                    f"published the rename within "
+                    f"{_COMMIT_TIMEOUT_S:.0f}s")
+            _time.sleep(_POLL_S)
 
 
 def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=(),
-            empties=None):
+            empties=None, world=1):
     # integrity record: leaf count + per-shard byte size, so load can
     # reject a torn checkpoint (shard truncated/missing despite a
-    # committed meta.json) instead of half-loading it
+    # committed meta.json) instead of half-loading it. `commit.world`
+    # records how many DONE.<r> markers is_complete must re-verify.
     shard_sizes = {}
     for e in leaves:
         for srec in e["shards"]:
@@ -345,6 +556,7 @@ def _commit(tmp, path, leaves, scalars, list_paths=(), bytes_paths=(),
                    "lists": list(list_paths),
                    "bytes": list(bytes_paths),
                    "empties": empties or {},
+                   "commit": {"world": int(world)},
                    "integrity": {"leaf_count": len(leaves),
                                  "shards": shard_sizes}}, f)
         if _FSYNC:
@@ -416,6 +628,12 @@ class _AsyncHandle(threading.Thread):
             self._fn()
         except BaseException as e:  # surfaced in result()
             self._err = e
+        finally:
+            with _inflight_lock:
+                try:
+                    _inflight.remove(self)
+                except ValueError:
+                    pass
 
     def result(self):
         self.join()
@@ -430,8 +648,53 @@ class _DoneHandle:
 
 # ------------------------------------------------------------------- load
 
+# incomplete dirs counted once per path per process (is_complete runs
+# on every steps() scan — a raw per-call count would just measure scan
+# frequency); completeness VERDICTS are cached the same way, because a
+# published checkpoint dir is immutable and meta.json embeds the full
+# per-shard index — re-parsing it on every _prune/load_latest scan
+# would put keep× full-JSON parses on the step path
+_incomplete_seen_lock = threading.Lock()
+_incomplete_seen = set()
+_complete_seen = set()
+
+
 def is_complete(path):
-    return os.path.isfile(os.path.join(path, _META))
+    """A committed checkpoint: meta.json present AND every rank's
+    DONE.<r> commit marker (per meta's commit.world) present. By
+    construction the rename that publishes meta.json only happens after
+    all markers exist, so a missing marker means tampering or a
+    pre-marker-protocol bug — either way the directory is invisible
+    (pt_ckpt_incomplete_discarded_total), never half-trusted.
+    Checkpoints written before the commit record pass on meta.json
+    alone; an unreadable meta.json is left for verify_integrity to
+    classify as torn."""
+    meta_p = os.path.join(path, _META)
+    if not os.path.isfile(meta_p):
+        return False
+    with _incomplete_seen_lock:
+        if path in _complete_seen:
+            return True
+    try:
+        with open(meta_p) as f:
+            world = int((json.load(f).get("commit") or {})
+                        .get("world", 0))
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError,
+            TypeError, ValueError):
+        return True    # torn meta: load_latest's fallback handles it
+    missing = [r for r in range(world)
+               if not os.path.isfile(
+                   os.path.join(path, f"{_DONE_PREFIX}{r}"))]
+    if not missing:
+        with _incomplete_seen_lock:
+            _complete_seen.add(path)
+        return True
+    with _incomplete_seen_lock:
+        if path not in _incomplete_seen:
+            _incomplete_seen.add(path)
+            _INCOMPLETE_DISCARDED.inc()
+            record("ckpt_incomplete", path=path, missing_ranks=missing)
+    return False
 
 
 def load_state_dict(path, shardings=None, return_numpy=False):
@@ -502,6 +765,31 @@ def load_state_dict(path, shardings=None, return_numpy=False):
     return out
 
 
+def _xla_owned(arr):
+    """Re-ingest a restored leaf through a trivial on-device program so
+    the result's buffer is ALLOCATED AND OWNED BY XLA, preserving
+    sharding and commitment (elementwise ops keep both; verified for
+    this jax build).
+
+    Root-caused this session: `jax.make_array_from_callback` ALIASES
+    the callback's numpy buffers on CPU (np↔jnp zero-copy is the same
+    family), so a checkpoint-restored sharded param/accumulator entered
+    the DONATING train-step executable backed by numpy-owned memory —
+    and when the persistent compile cache serves the executable with
+    true in-place donation, XLA reuses/frees host memory numpy still
+    owns: heap corruption ('corrupted double-linked list' at the second
+    post-restore dispatch or at exit, ~2-in-3 runs on the hybrid3d
+    restore path). This is the PTL201 'zero-copy route into a donated
+    pytree' signature (docs/RESILIENCE.md 'Buffer aliasing'), at the
+    checkpoint-restore ingest boundary. One device-local memcpy per
+    restored leaf buys ownership."""
+    if not isinstance(arr, jax.Array):
+        return arr
+    if arr.dtype == jnp.bool_:
+        return jnp.logical_or(arr, False)
+    return arr + jnp.zeros((), arr.dtype)
+
+
 # ----------------------------------------------------------- Checkpointer
 
 class Checkpointer:
@@ -526,9 +814,11 @@ class Checkpointer:
         self._last = None
         # transient-FS hardening (flaky NFS/GCS-fuse mounts): loads are
         # always retried; saves only single-process + synchronous, where
-        # re-running is idempotent (the multi-controller path has merge
-        # barriers inside — a partial re-run would desync the pod, so it
-        # relies on the elastic restart layer instead)
+        # re-running is idempotent (a multi-controller save re-run on one
+        # rank alone would re-enter the snapshot barrier without its
+        # peers and desync the pod — that path relies on the marker
+        # protocol's invisible-until-complete guarantee plus the elastic
+        # restart layer instead)
         # give_up_on FileNotFoundError: a missing shard behind a
         # committed meta is a TORN checkpoint (load_latest's fallback
         # signal), never a transient — don't burn backoff sleeps on it
@@ -568,6 +858,12 @@ class Checkpointer:
         return out
 
     def save(self, step):
+        # back-pressure: a still-running commit of the previous save is
+        # joined HERE (error-propagating), and the wait counts into the
+        # step-path stall this save reports
+        t_stall0 = _time.perf_counter()
+        if isinstance(self._last, _AsyncHandle) and self._last.is_alive():
+            record("ckpt_backpressure", step=int(step))
         self.wait()
         state = {"step": int(step)}
         if self.model is not None:
@@ -584,10 +880,11 @@ class Checkpointer:
         if nproc == 1 and not self.async_save:
             self._last = self.retry.run(
                 save_state_dict, state, self._dir(step),
-                name=f"ckpt.save:{step}")
+                name=f"ckpt.save:{step}", _stall_start=t_stall0)
         else:
             self._last = save_state_dict(state, self._dir(step),
-                                         async_save=self.async_save)
+                                         async_save=self.async_save,
+                                         _stall_start=t_stall0)
         self._prune()
         return self._last
 
@@ -693,8 +990,12 @@ class Checkpointer:
                     f"checkpoint is missing model params {missing}; "
                     "model structure differs from the one checkpointed")
             for name, p in sd.items():
-                p._value = state["model"][name]._value.astype(
-                    p._value.dtype)
+                # _xla_owned: the restored array may alias numpy-owned
+                # region buffers (make_array_from_callback) — donated
+                # in place by the compiled step, that memory corrupts
+                # the host heap; re-ingest to an XLA-owned buffer
+                p._value = _xla_owned(
+                    state["model"][name]._value.astype(p._value.dtype))
         if self.optimizer is not None and "optimizer" in state:
             _, by_struct = self._name_maps()
             self.optimizer.set_state_dict(self._remap_opt_keys(
@@ -753,6 +1054,15 @@ def _restore_train_step_opt(ts, opt_sd):
                 # silently diverged ~1-in-3 full-suite runs
                 # (test_fault_tolerant_resume_matches_uninterrupted).
                 val = jnp.asarray(np.asarray(val))
-            d[k] = val
+            # donated next step — must be XLA-owned (see _xla_owned)
+            d[k] = _xla_owned(val)
         states.append(d)
+    if getattr(ts, "_compiled", None) is None:
+        # restored BEFORE the step's first compile: flag it so the
+        # first post-restore dispatch compiles OUTSIDE the persistent
+        # compilation cache (jit.TrainStep.__call__ honors this; the
+        # DistributedTrainStep _build(restored) AOT path has its own
+        # guard) — a cache-served donating executable is the known
+        # jax-0.4.x aliasing-corruption window (docs/RESILIENCE.md)
+        ts._restored_pre_build = True
     ts._opt_states = states

@@ -129,6 +129,7 @@ class DistributedTrainStep:
         self._trainable = [not p.stop_gradient for p in self._param_objs]
         self._opt_states = None
         self._compiled = None
+        self._aot_fallback = None   # retracing jit behind the AOT path
 
     # ---- shardings ----
     def _param_shardings(self, objs):
@@ -277,6 +278,7 @@ class DistributedTrainStep:
                         return _j(*args)
 
             self._compiled = call
+            self._aot_fallback = jitted
         else:
             self._compiled = jitted
 
@@ -376,6 +378,23 @@ class DistributedTrainStep:
                 np.float32(self.optimizer.get_lr()), list(batch_vals),
                 jnp.asarray(self.optimizer._step_count, jnp.uint32),
                 self._base_key)
+
+    def compile_stats(self):
+        """Recompile probe (jit.TrainStep.compile_stats shape, minus
+        the per-batch-signature accounting): executables held by the
+        step. Steady state — INCLUDING a save+restore lifecycle — is 1;
+        a restore that flipped a leaf's commitment would read 2+ (the
+        ISSUE-10 retrace family, docs/RESILIENCE.md)."""
+        if self._compiled is None:
+            return {"executables": 0}
+        n = getattr(self._compiled, "_cache_size", None)
+        if callable(n):
+            return {"executables": int(n())}
+        # checkpoint-restored AOT path: one frozen executable plus any
+        # ragged-batch fallback retraces through the jit wrapper
+        fb = self._aot_fallback
+        n_fb = fb._cache_size() if fb is not None else 0
+        return {"executables": 1 + int(n_fb)}
 
     def __call__(self, *batch):
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)

@@ -6,7 +6,12 @@ policy ``tools/tpu_retry.sh`` hand-rolls in bash) is applied uniformly to
 the coordination-KV gets in ``xproc``, the p2p transport's reconnects,
 and ``Checkpointer`` I/O, so every transient-fault path shares one
 telemetry stream.  :class:`StepGuard` detects NaN/Inf losses and
-skips-and-journals the step with a bounded consecutive-skip abort.
+skips-and-journals the step with a bounded consecutive-skip abort;
+:class:`DivergenceSentinel` is its escalation for compiled (fused-
+update) steps — NaN/Inf or a loss spike triggers a checkpoint
+ROLLBACK (:class:`DivergenceRollback`, caught by
+``fleet.elastic.run_with_fault_tolerance``) with a poisoned-data-window
+skip set and a bounded rollback budget.
 :class:`PreemptionHandler` turns SIGTERM (the TPU maintenance-event
 shape) into a drain-to-final-checkpoint instead of a mid-step kill.
 
@@ -31,6 +36,7 @@ import time
 from ..observability import metrics as _obs
 
 __all__ = ["RetryPolicy", "RetryError", "StepGuard", "StepAbort",
+           "DivergenceSentinel", "DivergenceRollback",
            "PreemptionHandler", "install_preemption_handler",
            "AnomalyJournal", "record", "events", "recent_failures",
            "stats", "reset"]
@@ -53,6 +59,10 @@ _GIVEUPS_TOTAL = _obs.counter(
 _JOURNAL_EVENTS = _obs.counter(
     "pt_journal_events_total", "anomaly-journal events, by kind",
     labelnames=("kind",))
+_ROLLBACKS_TOTAL = _obs.counter(
+    "pt_rollback_total",
+    "DivergenceSentinel-triggered checkpoint rollbacks, by reason "
+    "(nan | loss_spike)", labelnames=("reason",))
 
 _recent = collections.deque(maxlen=512)      # (t_monotonic, policy name)
 _recent_lock = threading.Lock()
@@ -321,6 +331,136 @@ class StepGuard:
                 f"{self.name}: {self._consecutive} consecutive non-finite "
                 f"losses (> {self.max_consecutive_skips}) at step {step}")
         return False
+
+
+# ---------------------------------------------- DivergenceSentinel
+
+class DivergenceRollback(RuntimeError):
+    """The sentinel demands a checkpoint rollback: the live parameters
+    are presumed poisoned (a fused-update compiled step applies the
+    update BEFORE the loss is observable on the host), so skipping
+    forward is not enough — restore the last COMPLETE checkpoint and
+    advance past the poisoned data window.
+    `fleet.elastic.run_with_fault_tolerance` catches this and restores
+    WITHOUT consuming a restart (the sentinel bounds its own budget)."""
+
+    def __init__(self, msg, step=None, reason="nan", value=None):
+        super().__init__(msg)
+        self.step = step
+        self.reason = reason
+        self.value = value
+
+
+class DivergenceSentinel:  # ptlint: thread-shared
+    """Divergence monitor + rollback trigger over the per-step loss
+    telemetry — StepGuard's escalation path for compiled train steps.
+
+    StepGuard's skip-and-retry is the right call for an EAGER loop,
+    where a NaN loss can gate the update. With a compiled
+    TrainStep/DistributedTrainStep/HybridTrainStep the optimizer update
+    is fused into the step program: by the time the host sees the loss,
+    the parameters are already updated — a NaN or a spiking loss means
+    the live state may be poisoned. The sentinel therefore journals the
+    anomaly, marks the poisoned data window (``should_skip``), and
+    raises :class:`DivergenceRollback` so the supervision loop
+    (`run_with_fault_tolerance`) restores the last COMPLETE checkpoint
+    and resumes in-process — no pod restart, commitment preserved by
+    `Checkpointer.load` (docs/RESILIENCE.md "Coordinated checkpointing
+    + rollback").
+
+    Detection: non-finite loss (reason ``nan``), or — once
+    ``min_history`` finite losses are in the rolling window — a loss
+    above ``spike_factor`` × the window median (reason ``loss_spike``;
+    assumes the positive-loss shape of CE/MSE objectives).
+    ``max_rollbacks`` bounds the budget: the rollback that exceeds it
+    raises StepAbort instead (systemic divergence — hand the job to the
+    elastic restart layer rather than thrash restore/replay forever).
+
+    Usage inside a run_with_fault_tolerance train_fn::
+
+        sentinel = DivergenceSentinel()
+        def train_fn(start):
+            step = start
+            while step < STEPS:
+                if sentinel.should_skip(step):   # poisoned data window
+                    advance_data(); step += 1; continue
+                loss = train_step(*batch(step))
+                sentinel.check(loss, step=step)  # raises on divergence
+                ckpt.save(step + 1); step += 1
+
+    Chaos integration mirrors StepGuard: every check fires scope
+    ``step`` and routes the observed loss through the ``step.nan``
+    poisoner. Thread-shared: the heartbeat/telemetry threads read
+    counters while the train loop writes them — all mutation is under
+    one lock (PTL7xx fence)."""
+
+    def __init__(self, window=16, spike_factor=4.0, min_history=4,
+                 max_rollbacks=3, skip_window=1, name="train"):
+        self.window = int(window)
+        self.spike_factor = float(spike_factor)
+        self.min_history = max(1, int(min_history))
+        self.max_rollbacks = int(max_rollbacks)
+        self.skip_window = max(1, int(skip_window))
+        self.name = name
+        self.rollbacks = 0          # rollbacks demanded so far
+        self.ok = 0                 # accepted steps
+        self._lock = threading.Lock()
+        self._history = collections.deque(maxlen=self.window)
+        self._poisoned = set()      # step indices to skip after restore
+
+    def should_skip(self, step):
+        """True when `step` sits in a poisoned data window — the loop
+        must advance its data pipeline past it WITHOUT dispatching the
+        update (replaying the batch that diverged once diverges
+        again)."""
+        with self._lock:
+            return step in self._poisoned
+
+    def poisoned_steps(self):
+        with self._lock:
+            return sorted(self._poisoned)
+
+    def check(self, loss, step=None):
+        """Accept one observed loss. Returns True when training may
+        proceed; raises DivergenceRollback (restore + skip window) on
+        NaN/Inf or a loss spike, StepAbort past the rollback budget."""
+        from . import chaos
+
+        chaos.fire("step")          # crash/hang-at-step-N injectors
+        value = chaos.poison(_scalar(loss))
+        with self._lock:
+            reason = None
+            if not math.isfinite(value):
+                reason = "nan"
+            elif len(self._history) >= self.min_history:
+                med = sorted(self._history)[len(self._history) // 2]
+                if med > 0 and value > self.spike_factor * med:
+                    reason = "loss_spike"
+            if reason is None:
+                self.ok += 1
+                self._history.append(value)
+                return True
+            # poison the data window ending at `step`, so the resumed
+            # run advances past the batches that fed the divergence
+            if step is not None:
+                for s in range(step - self.skip_window + 1, step + 1):
+                    self._poisoned.add(s)
+            self.rollbacks += 1
+            n = self.rollbacks
+        record("rollback", guard=self.name, step=step, reason=reason,
+               value=str(value), rollbacks=n)
+        if n > self.max_rollbacks:
+            record("step_abort", guard=self.name, step=step,
+                   rollbacks=n)
+            raise StepAbort(
+                f"{self.name}: rollback budget exhausted ({n} > "
+                f"{self.max_rollbacks}) at step {step} — divergence is "
+                "systemic, not transient")
+        _ROLLBACKS_TOTAL.labels(reason=reason).inc()
+        raise DivergenceRollback(
+            f"{self.name}: {reason} at step {step} (loss={value!r}) — "
+            "restoring last complete checkpoint",
+            step=step, reason=reason, value=value)
 
 
 # ---------------------------------------------------- PreemptionHandler

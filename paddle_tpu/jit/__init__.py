@@ -464,6 +464,12 @@ class TrainStep:
         self._compiled = None
         self._last_batch_avals = None
         self._telemetry_full = False
+        # set by Checkpointer._restore_train_step_opt when state is
+        # restored BEFORE the first compile: the first dispatch then
+        # compiles outside the persistent compilation cache (the
+        # jax-0.4.x donating-executable aliasing hazard — the same
+        # guard DistributedTrainStep's restored AOT path carries)
+        self._restored_pre_build = False
         # shape-churn accounting (see __call__'s recompile guard)
         self._batch_signatures = set()
         self._sig_warned = False
@@ -648,9 +654,23 @@ class TrainStep:
         t0 = _time.perf_counter()
         with _trace_span("jit.TrainStep",
                          step=int(self.optimizer._step_count)):
-            out = self._compiled(
-                train_vals, frozen_vals, self._opt_states, lr, batch_vals,
-                step_idx, self._base_key)
+            if self._restored_pre_build:
+                # first dispatch after a pre-compile checkpoint restore:
+                # compile OUTSIDE the persistent cache — a cache-served
+                # donating executable can carry a mismatched aliasing
+                # map on this jax build (docs/RESILIENCE.md); later
+                # dispatches reuse the in-memory executable as usual
+                from ..core.jax_compat import no_persistent_cache
+
+                with no_persistent_cache():
+                    out = self._compiled(
+                        train_vals, frozen_vals, self._opt_states, lr,
+                        batch_vals, step_idx, self._base_key)
+                self._restored_pre_build = False
+            else:
+                out = self._compiled(
+                    train_vals, frozen_vals, self._opt_states, lr,
+                    batch_vals, step_idx, self._base_key)
         if self._telemetry_full:
             loss, new_vals, self._opt_states, new_frozen, grad_norm = out
         else:

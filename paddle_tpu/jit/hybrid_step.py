@@ -150,6 +150,21 @@ class HybridTrainStep(TrainStep):
         out_sh = (rep, t_sh, s_sh, f_sh)
         if self._telemetry_full:
             out_sh = out_sh + (rep,)
+        if self._opt_states is not None:
+            # checkpoint-restored BEFORE the first step: the restore
+            # kept the accumulators' original commitment (uncommitted
+            # host arrays — the ISSUE-10 rule), but the hybrid step's
+            # steady state is COMMITTED mesh placements (its outputs
+            # carry out_shardings). (Re)place them now so the first
+            # dispatch's signature already matches step 2's — otherwise
+            # the commitment flip costs a second executable, exactly
+            # the retrace the save+restore one-executable probe pins.
+            # The reshard compiles stay outside the persistent cache
+            # (same hazard as Checkpointer.load's sharded restore).
+            from ..core.jax_compat import no_persistent_cache
+
+            with no_persistent_cache():
+                self._opt_states = jax.device_put(self._opt_states, s_sh)
         return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=self._donate_argnums)
 

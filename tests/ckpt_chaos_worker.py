@@ -1,0 +1,67 @@
+"""Worker for the mid-commit SIGKILL chaos test (test_chaos.py).
+
+Trains a small model for N deterministic steps with OVERLAPPED
+(async_save=True) per-step checkpointing through the coordinated
+snapshot/commit protocol — the multi-process async path that used to be
+silently downgraded to synchronous. The test launches it under a seeded
+PT_CHAOS_PLAN that SIGKILLs rank 1 at one commit's entry
+(scope ``ckpt.commit.1``): rank 1 dies before writing its ``DONE.1``
+marker, so that checkpoint can never become COMPLETE; the launcher
+restarts the pod and BOTH ranks must resume from the last COMPLETE
+step with a loss sequence identical to an uninterrupted run — which
+also proves the snapshot phase isolated the saved state from the
+training that continued over the in-flight commits.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.distributed.checkpoint import Checkpointer  # noqa: E402
+
+STEPS = 6
+
+
+def main():
+    out_dir = sys.argv[1]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    ckpt = Checkpointer(os.path.join(out_dir, "ckpt"), model=m,
+                        optimizer=opt, keep=8, async_save=True)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16,)).astype(np.float32))
+
+    latest = ckpt.load_latest()
+    start = 0 if latest is None else latest + 1
+    losses = []
+    for step in range(start, STEPS):
+        loss = nn.functional.mse_loss(m(x).squeeze(-1), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        # overlapped save: the snapshot (with its barriers) runs here,
+        # the durable commit runs behind the next step(s)
+        ckpt.save(step)
+        xproc.barrier()     # lockstep: both ranks completed `step`
+    ckpt.wait()             # drain the final in-flight commit
+    with open(os.path.join(out_dir, f"ckpt_out_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "start": start, "losses": losses,
+                   "complete_steps": ckpt.steps()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -227,6 +227,144 @@ def test_step_guard_accepts_tensor_losses():
     assert not guard.check(paddle.to_tensor(np.float32("nan")))
 
 
+# ---------------------------------------- DivergenceSentinel + rollback
+
+def test_sentinel_nan_demands_rollback_and_marks_window():
+    s = resilience.DivergenceSentinel(max_rollbacks=2)
+    assert s.check(1.0, step=0)
+    with pytest.raises(resilience.DivergenceRollback) as ei:
+        s.check(float("nan"), step=1)
+    assert ei.value.reason == "nan" and ei.value.step == 1
+    assert s.should_skip(1) and not s.should_skip(0)
+    assert resilience.events("rollback")
+
+
+def test_sentinel_loss_spike_detection():
+    s = resilience.DivergenceSentinel(window=8, spike_factor=4.0,
+                                      min_history=4)
+    for i in range(4):
+        assert s.check(1.0 + 0.01 * i, step=i)
+    assert s.check(2.0, step=4)             # over median but under 4x
+    with pytest.raises(resilience.DivergenceRollback) as ei:
+        s.check(50.0, step=5)
+    assert ei.value.reason == "loss_spike"
+    assert s.should_skip(5)
+
+
+def test_sentinel_rollback_budget_aborts():
+    s = resilience.DivergenceSentinel(max_rollbacks=1)
+    with pytest.raises(resilience.DivergenceRollback):
+        s.check(float("inf"), step=0)
+    with pytest.raises(resilience.StepAbort):
+        s.check(float("nan"), step=1)
+
+
+def test_sentinel_skip_window_spans_steps():
+    s = resilience.DivergenceSentinel(skip_window=3)
+    with pytest.raises(resilience.DivergenceRollback):
+        s.check(float("nan"), step=7)
+    assert s.poisoned_steps() == [5, 6, 7]
+
+
+def test_nan_rollback_resumes_in_process_and_reconverges(tmp_path):
+    """THE in-process rollback acceptance (ISSUE 14): a chaos-poisoned
+    NaN step on a FUSED-update compiled TrainStep triggers the sentinel
+    → run_with_fault_tolerance restores the last COMPLETE checkpoint
+    (no process restart), the poisoned data window is skipped, and the
+    run re-converges to the clean run's final loss within 5% — with the
+    rollback journaled and counted in pt_rollback_total{reason=nan}."""
+    from paddle_tpu.distributed import resilience as res
+    from paddle_tpu.distributed.fleet import elastic as fleet_elastic
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    STEPS = 16    # enough post-rollback runway to re-converge within 5%
+
+    def build(seed=0):
+        paddle.seed(seed)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.default_rng(3)
+        xs = paddle.to_tensor(
+            rng.standard_normal((16, 8)).astype(np.float32))
+        ys = paddle.to_tensor(rng.integers(0, 4, (16,)))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        loss_fn = lambda mm, x, y: nn.functional.cross_entropy(mm(x), y)
+        return m, paddle.jit.TrainStep(m, loss_fn, opt), xs, ys
+
+    def run(root, poisoned_at=None):
+        if poisoned_at is not None:
+            chaos.install({"injectors": [
+                {"scope": "step.nan", "kind": "nan",
+                 "at": [poisoned_at]}]})
+        m, st, xs, ys = build()
+        cp = Checkpointer(str(root), model=m, train_step=st,
+                          async_save=True)
+        sentinel = res.DivergenceSentinel(max_rollbacks=2)
+        last = [None]
+
+        def train_fn(start):
+            step = start
+            while step < STEPS:
+                if sentinel.should_skip(step):
+                    step += 1          # advance past the poisoned batch
+                    continue
+                loss = st(xs, ys)
+                sentinel.check(loss, step=step)
+                last[0] = float(loss.numpy())
+                cp.save(step + 1)
+                step += 1
+            cp.wait()
+            return last[0]
+
+        try:
+            final = fleet_elastic.run_with_fault_tolerance(
+                train_fn, cp, max_restarts=0)
+        finally:
+            chaos.clear()
+        return final, sentinel
+
+    clean, _ = run(tmp_path / "clean")
+    before = obs_metrics.registry().get(
+        "pt_rollback_total").labels(reason="nan").value
+    faulted, sentinel = run(tmp_path / "faulted", poisoned_at=5)
+    assert sentinel.rollbacks == 1
+    assert sentinel.should_skip(5)
+    assert resilience.events("rollback")
+    assert resilience.events("train_rollback")
+    assert obs_metrics.registry().get(
+        "pt_rollback_total").labels(reason="nan").value == before + 1
+    # one good update was sacrificed with the poisoned window; the run
+    # still re-converges to the clean trajectory within 5%
+    np.testing.assert_allclose(faulted, clean, rtol=0.05)
+
+
+def test_run_with_fault_tolerance_escalates_on_stale_peer(tmp_path,
+                                                          monkeypatch):
+    """With an ElasticManager reporting a STALE peer, an in-process
+    restart is pointless (the pod member is gone): the failure must
+    re-raise immediately for the launcher, without burning restarts."""
+    from paddle_tpu.distributed.fleet import elastic as fleet_elastic
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path / "hb"))
+    mgr = ElasticManager()
+    assert mgr.enabled
+    monkeypatch.setattr(mgr, "watch", lambda: ElasticStatus.RESTART)
+    cp = Checkpointer(str(tmp_path / "ck"))
+    calls = {"n": 0}
+
+    def train_fn(start):
+        calls["n"] += 1
+        raise RuntimeError("collective failed: peer gone")
+
+    with pytest.raises(RuntimeError):
+        fleet_elastic.run_with_fault_tolerance(train_fn, cp,
+                                               max_restarts=5,
+                                               manager=mgr)
+    assert calls["n"] == 1                 # no in-process retry
+    assert resilience.events("elastic_escalate")
+
+
 # ------------------------------------------------- preemption + journal
 
 def test_preemption_handler_drains_to_final_checkpoint(tmp_path):
@@ -324,6 +462,65 @@ def test_chaos_kill_window_crash_then_relaunch(tmp_path):
     assert "BOTH_SAVED" in r2.stdout
     back = ckpt_mod.load_state_dict(str(tmp_path / "ckpt-00000002"))
     assert back["step"] == 2
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_rank_mid_commit_resumes_from_complete(tmp_path):
+    """ISSUE-14 chaos acceptance: a seeded FaultPlan SIGKILLs rank 1 at
+    a commit's entry (scope ckpt.commit.1 — BEFORE its DONE.1 marker),
+    during an OVERLAPPED (async, multi-process) save. The marker
+    protocol must keep that checkpoint invisible on every rank, the
+    relaunched pod resumes BOTH ranks from the last COMPLETE step, and
+    the stitched loss sequence is EXACTLY the uninterrupted run's —
+    which also proves the snapshot phase isolated saved state from the
+    training that overlapped the in-flight commits."""
+    plan = json.dumps({"seed": 7, "state_dir": str(tmp_path / "state"),
+                       "injectors": [
+                           {"scope": "ckpt.commit.1", "kind": "crash",
+                            "at": [2], "once": True}]})
+
+    def launch(out_dir, extra_env):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node=2", "--max_restart=2",
+               f"--log_dir={out_dir}/log",
+               os.path.join(ROOT, "tests", "ckpt_chaos_worker.py"),
+               str(out_dir)]
+        return subprocess.run(cmd, env=_env(extra_env), cwd=ROOT,
+                              capture_output=True, text=True, timeout=420)
+
+    r = launch(tmp_path, {chaos.ENV_PLAN: plan})
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "restart 1/2" in r.stderr          # the mid-commit kill fired
+    out = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"ckpt_out_{rank}.json") as f:
+            out[rank] = json.load(f)
+    # both ranks resumed from the same LAST COMPLETE step, not scratch
+    assert out[0]["start"] == out[1]["start"] > 0
+    # the checkpoint whose commit was killed stayed invisible until its
+    # re-save; every final checkpoint verifies clean
+    cp = Checkpointer(str(tmp_path / "ckpt"))
+    for s in cp.steps():
+        ckpt_mod.verify_integrity(
+            os.path.join(str(tmp_path / "ckpt"), f"ckpt-{s:08d}"))
+    # the kill is journaled on rank 1 (written before the SIGKILL)
+    journal = tmp_path / "log" / "anomalies.rank1.jsonl"
+    kinds = [json.loads(line)["kind"]
+             for line in journal.read_text().splitlines()]
+    assert "chaos_injected" in kinds
+
+    # fault-free reference: identical losses, exactly
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    r2 = launch(ref_dir, {})
+    assert r2.returncode == 0, f"stdout:{r2.stdout}\nstderr:{r2.stderr}"
+    with open(ref_dir / "ckpt_out_0.json") as f:
+        ref = json.load(f)
+    assert ref["start"] == 0
+    for rank in (0, 1):
+        tail = ref["losses"][out[rank]["start"]:]
+        np.testing.assert_allclose(out[rank]["losses"], tail, rtol=0,
+                                   atol=0)
 
 
 @pytest.mark.slow

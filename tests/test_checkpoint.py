@@ -169,17 +169,18 @@ def test_train_kill_resume_matches_uninterrupted(tmp_path):
                                atol=1e-6)
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="jax 0.4.x CPU: the persistent compile cache can serve the "
-           "donating sharded step/reshard executables with a mismatched "
-           "aliasing map, nondeterministically perturbing the restored "
-           "state by ~1e-3..1e-2 (identical in-process runs are "
-           "bit-exact; the fault is restore+cache-specific). The "
-           "single-device resume path is fully guarded (see "
-           "core.jax_compat.no_persistent_cache); this sharded variant "
-           "still flakes ~25% under pytest on this jax build.")
 def test_resume_distributed_zero_sharded(tmp_path):
+    """Historically xfail(strict=False): flaked ~25% with the restored
+    state perturbed ~1e-3..1e-2 under a warm persistent cache.
+    Root-caused in ISSUE 14: `jax.make_array_from_callback` ALIASES the
+    restore callback's numpy buffers on CPU, so the restored sharded
+    leaves entered the donating step executable backed by numpy-owned
+    memory; when the cache served the executable with true in-place
+    donation, XLA scribbled over (or freed) that memory — observed as
+    value perturbation here and as outright heap corruption on the
+    hybrid3d restore path. Fixed at the restore ingest boundary
+    (`checkpoint._xla_owned`); stable by construction now — the xfail
+    is gone on purpose."""
     mesh_mod.init_mesh(dp=2, sharding=4)
     try:
         m1, xs, ys = _tiny_model_and_data()
@@ -375,6 +376,168 @@ def test_load_latest_falls_back_past_torn_checkpoint(tmp_path):
     resilience.reset()
     assert cp.load_latest() == 1              # torn step-2 skipped
     assert resilience.events("ckpt_rejected")
+
+
+# ------------------------------- coordinated (snapshot/commit) saves
+
+def test_commit_protocol_files_and_world_recorded(tmp_path):
+    import json
+
+    ckpt.save_state_dict({"w": jnp.ones((4, 4))}, str(tmp_path / "c"),
+                         async_save=True).result()
+    with open(tmp_path / "c" / "meta.json") as f:
+        meta = json.load(f)
+    assert meta["commit"]["world"] == 1
+    assert (tmp_path / "c" / "DONE.0").is_file()
+    assert ckpt.is_complete(str(tmp_path / "c"))
+
+
+def test_missing_done_marker_is_invisible(tmp_path):
+    """A checkpoint dir missing one rank's DONE marker must be
+    invisible to is_complete/steps/load_latest — the torn-commit
+    defense for a rank killed mid-commit (here simulated by deleting
+    the marker behind a committed meta)."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    m, xs, ys = _tiny_model_and_data()
+    opt = paddle.optimizer.SGD(1e-2, parameters=m.parameters())
+    cp = ckpt.Checkpointer(str(tmp_path / "m"), model=m, optimizer=opt)
+    cp.save(1)
+    cp.save(2)
+    os.unlink(tmp_path / "m" / "ckpt-00000002" / "DONE.0")
+    # published dirs are immutable, so is_complete caches verdicts —
+    # in-process tampering (this unlink) must drop the cache entry the
+    # way a fresh process (the real resume-after-crash reader) starts
+    ckpt._complete_seen.discard(str(tmp_path / "m" / "ckpt-00000002"))
+    before = obs_metrics.registry().get(
+        "pt_ckpt_incomplete_discarded_total").value
+    assert not ckpt.is_complete(str(tmp_path / "m" / "ckpt-00000002"))
+    assert cp.steps() == [1]
+    assert cp.load_latest() == 1
+    assert obs_metrics.registry().get(
+        "pt_ckpt_incomplete_discarded_total").value == before + 1
+
+
+def test_missing_marker_for_other_rank_world(tmp_path):
+    """Same defense when meta claims a LARGER world than this process:
+    a 2-rank checkpoint carrying only rank 0's marker (rank 1 died
+    after the — hypothetical — rename) is rejected."""
+    import json
+
+    ckpt.save_state_dict({"w": jnp.ones(3)}, str(tmp_path / "c"))
+    meta_p = tmp_path / "c" / "meta.json"
+    with open(meta_p) as f:
+        meta = json.load(f)
+    meta["commit"]["world"] = 2          # DONE.1 does not exist
+    meta_p.write_text(json.dumps(meta))
+    assert not ckpt.is_complete(str(tmp_path / "c"))
+
+
+@pytest.mark.chaos
+def test_overlapped_save_returns_before_commit(tmp_path):
+    """async_save hands the durable write to the background committer:
+    the step path only pays the snapshot. A chaos delay pinned to the
+    COMMIT phase must not stall the caller."""
+    import time
+
+    from paddle_tpu.distributed import chaos
+
+    chaos.install({"injectors": [
+        {"scope": "ckpt.commit", "kind": "delay", "at": [0],
+         "delay_s": 1.0}]})
+    try:
+        t0 = time.perf_counter()
+        h = ckpt.save_state_dict({"w": jnp.ones((64, 64))},
+                                 str(tmp_path / "c"), async_save=True)
+        returned = time.perf_counter() - t0
+        assert returned < 0.5, f"snapshot blocked {returned:.2f}s"
+        h.result()
+    finally:
+        chaos.clear()
+    assert ckpt.is_complete(str(tmp_path / "c"))
+
+
+@pytest.mark.chaos
+def test_backpressure_joins_inflight_commit(tmp_path):
+    """A save issued while the previous commit is in flight must join
+    it (bounded memory: one host snapshot in flight) and journal the
+    stall."""
+    import time
+
+    from paddle_tpu.distributed import chaos, resilience
+
+    resilience.reset()
+    chaos.install({"injectors": [
+        {"scope": "ckpt.commit", "kind": "delay", "at": [0],
+         "delay_s": 0.4}]})
+    try:
+        h1 = ckpt.save_state_dict({"w": jnp.ones(8)},
+                                  str(tmp_path / "c1"), async_save=True)
+        t0 = time.perf_counter()
+        h2 = ckpt.save_state_dict({"w": jnp.ones(8)},
+                                  str(tmp_path / "c2"), async_save=True)
+        waited = time.perf_counter() - t0
+        h1.result()
+        h2.result()
+    finally:
+        chaos.clear()
+    assert waited >= 0.2, f"second save did not back-pressure ({waited:.2f}s)"
+    assert resilience.events("ckpt_backpressure")
+    assert ckpt.is_complete(str(tmp_path / "c1"))
+    assert ckpt.is_complete(str(tmp_path / "c2"))
+
+
+@pytest.mark.chaos
+def test_chaos_commit_scope_rank_targeting(tmp_path):
+    """ckpt.commit.<rank> only fires on its rank: an injector for rank
+    1 is inert in this rank-0 process, while the unsuffixed scope
+    fires."""
+    from paddle_tpu.distributed import chaos
+
+    chaos.install({"injectors": [
+        {"scope": "ckpt.commit.1", "kind": "error", "at": [0]}]})
+    try:
+        ckpt.save_state_dict({"w": jnp.ones(3)}, str(tmp_path / "a"))
+    finally:
+        chaos.clear()
+    assert ckpt.is_complete(str(tmp_path / "a"))
+
+    chaos.install({"injectors": [
+        {"scope": "ckpt.commit.0", "kind": "error", "at": [0]}]})
+    try:
+        with pytest.raises(OSError):
+            ckpt.save_state_dict({"w": jnp.ones(3)}, str(tmp_path / "b"))
+    finally:
+        chaos.clear()
+    assert not ckpt.is_complete(str(tmp_path / "b"))
+    assert os.path.isdir(tmp_path / "b.tmp")     # invisible, torn-safe
+
+
+def test_overlapped_save_restore_one_executable_zero_sharded(tmp_path):
+    """THE overlap acceptance probe (DistributedTrainStep side): an
+    async save + restore into the LIVE ZeRO-sharded step holds ONE
+    executable and keeps donation/commitment — previously this exact
+    shape heap-corrupted ~2-in-3 runs (restored leaves were numpy-owned
+    through make_array_from_callback and got donated in place; see
+    checkpoint._xla_owned)."""
+    mesh_mod.init_mesh(dp=2, sharding=4)
+    try:
+        m, xs, ys = _tiny_model_and_data()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        st = dist.DistributedTrainStep(m, _loss_fn, opt,
+                                       zero_level="os_g")
+        for _ in range(3):
+            st(xs, ys)
+        cp = ckpt.Checkpointer(str(tmp_path / "z"), model=m,
+                               train_step=st, async_save=True)
+        cp.save(3)
+        cp.wait()
+        assert cp.load_latest() == 3
+        for _ in range(2):
+            st(xs, ys)
+        assert st.compile_stats()["executables"] == 1
+    finally:
+        mesh_mod.reset_mesh()
 
 
 @pytest.mark.chaos

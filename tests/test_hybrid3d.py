@@ -187,6 +187,50 @@ def test_analyze_step_hybrid3d():
     assert not [f for f in report.findings if f.rule == "PTL502"]
 
 
+def test_hybrid_save_restore_one_executable_and_parity(tmp_path):
+    """ISSUE-14 overlap-acceptance probe (HybridTrainStep side): an
+    OVERLAPPED (async) save plus a checkpoint restore into a fresh 3D
+    step must (a) reproduce the uninterrupted loss trajectory exactly,
+    (b) hold ONE executable across the whole lifecycle — restored
+    accumulators are re-placed onto their mesh shardings at build so
+    the first dispatch's signature already matches steady state — and
+    (c) keep donation fully held. Restored leaves are XLA-owned
+    (checkpoint._xla_owned): before that fix this path heap-corrupted
+    ~2-in-3 runs."""
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+
+    rng = np.random.default_rng(5)
+    ids_np = rng.integers(0, 256, (8, 16))
+    cfg3d = hybrid3d.Hybrid3DConfig(dp=2, tp=2, pp=2)
+    m, step = _hybrid_step(cfg3d)
+    ids = paddle.to_tensor(ids_np)
+    for _ in range(3):
+        step(ids)
+    cp = ckpt_mod.Checkpointer(str(tmp_path / "h"), model=m,
+                               train_step=step, async_save=True)
+    cp.save(3)
+    cp.wait()
+    ref = [float(step(ids).numpy()) for _ in range(2)]
+    assert step.compile_stats()["executables"] == 1
+
+    # fresh (differently-seeded) model + step, restored pre-first-step
+    mesh_mod.reset_mesh()
+    hybrid3d.init_hybrid_mesh(cfg3d)
+    paddle.seed(11)
+    m2 = hybrid3d.build_gpt3d(CFG, cfg3d)
+    opt2 = paddle.optimizer.AdamW(1e-3, parameters=m2.parameters())
+    step2 = hybrid3d.HybridTrainStep(m2, lambda mm, i: mm.loss(i), opt2,
+                                     config=cfg3d)
+    cp2 = ckpt_mod.Checkpointer(str(tmp_path / "h"), model=m2,
+                                train_step=step2)
+    assert cp2.load_latest() == 3
+    res = [float(step2(ids).numpy()) for _ in range(2)]
+    np.testing.assert_allclose(res, ref, rtol=1e-5, atol=1e-6)
+    stats = step2.compile_stats(check_donation=True)
+    assert stats["executables"] == 1
+    assert stats["donation"]["held"]
+
+
 def test_zero_composes_on_dp_axis():
     """config.zero='os' shards the optimizer moments over the DP axis
     (the replica group IS the ZeRO group); params stay on their TP/PP
