@@ -580,6 +580,16 @@ def bench_serving():
             "mean_batch_size": round(server.mean_batch_size, 1)}
 
 
+def _quiet_trace():
+    """Trace for WARM-UP submits: stamps but emits nothing, so the
+    compile stall inside a warm request's prefill segment never enters
+    the pt_request_phase_seconds distribution or the recent-requests
+    view the phase-breakdown stamps read (observability.reqtrace)."""
+    from paddle_tpu.observability import reqtrace
+
+    return reqtrace.quiet_trace()
+
+
 def bench_llm_serve():
     """Continuous-batching LLM engine vs the static-batch generate()
     baseline under ONE Poisson workload with mixed prompt AND mixed
@@ -708,8 +718,8 @@ def bench_llm_serve():
             # warmup's low-occupancy steps from the stats the occupancy
             # metric averages over.
             server.submit(np.zeros((2 * budget,), np.int32),
-                          max_new_tokens=max(2, decode_k + 1)
-                          ).result(timeout=1800)
+                          max_new_tokens=max(2, decode_k + 1),
+                          trace=_quiet_trace()).result(timeout=1800)
             server.engine.stats.update(
                 {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
             m0 = server.metrics()
@@ -790,10 +800,14 @@ def bench_llm_serve():
                 "totals_s": [round(r[0], 2) for r in runs],
                 # registry-sourced (LLMServer.metrics of the best run):
                 # occupancy/preemptions/token split/dispatch
-                # amortization + latency percentiles with attribution
+                # amortization + latency percentiles with attribution.
+                # recent_requests (per-request phase timelines) stays
+                # out of the trend record — the per-phase percentiles
+                # in request_phase_seconds carry the aggregate story
                 "metrics": {k: (round(v, 4)
                                 if isinstance(v, float) else v)
-                            for k, v in m.items()}}
+                            for k, v in m.items()
+                            if k != "recent_requests"}}
 
     result = {
         "model": name,
@@ -900,8 +914,8 @@ def _bench_llm_serve_spec():
         outs, lat = {}, [None] * n_req
         with server:
             server.submit(np.zeros((2 * budget,), np.int32),
-                          max_new_tokens=max(2, spec_k + 2)
-                          ).result(timeout=1800)
+                          max_new_tokens=max(2, spec_k + 2),
+                          trace=_quiet_trace()).result(timeout=1800)
             server.engine.stats.update(
                 {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
             # per-RUN acceptance: the registry counters are
@@ -1032,7 +1046,8 @@ def bench_llm_serve_int8():
         outs, lat = {}, [None] * n_req
         with server:
             server.submit(np.zeros((1,), np.int32),
-                          max_new_tokens=1).result(timeout=1800)
+                          max_new_tokens=1,
+                          trace=_quiet_trace()).result(timeout=1800)
             server.engine.stats.update(
                 {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
             pool_bytes = server.engine.pool_bytes()
@@ -1398,7 +1413,8 @@ def bench_llm_fleet_multi():
         with server:
             # warm both executables outside the timed window
             server.submit(np.zeros((2,), np.int32),
-                          max_new_tokens=fused_k + 1).result(timeout=300)
+                          max_new_tokens=fused_k + 1,
+                          trace=_quiet_trace()).result(timeout=300)
             outs, ttfts, total = drive(
                 lambda j, p: server.submit(
                     p, max_new_tokens=int(gens[j])))
@@ -1471,6 +1487,29 @@ def bench_llm_fleet_multi():
                      "single": [round(r[2], 2) for r in s_runs]},
     }
 
+    # guarded extra 0: TTFT phase decomposition of the winning fleet
+    # run (observability.reqtrace): p50/p99 per phase over the router's
+    # merged per-request timelines — the serving-economics attribution
+    # (queue vs route vs prefill vs transfer vs decode) the ISSUE-15
+    # tracing plane exists to price
+    try:
+        segs = {}
+        for tl in m_metrics.get("recent_requests", []):
+            for s in tl.get("phases", [])[1:]:   # [0] is the anchor
+                segs.setdefault(s["phase"], []).append(s["dt_s"])
+        result["ttft_phase_breakdown_ms"] = {
+            ph: {"p50": round(float(np.percentile(v, 50)) * 1e3, 2),
+                 "p99": round(float(np.percentile(v, 99)) * 1e3, 2),
+                 "n": len(v)}
+            for ph, v in sorted(segs.items())}
+        log(f"[bench] llm_fleet_multi ttft phases: "
+            + ", ".join(f"{ph} p50={d['p50']}ms"
+                        for ph, d in
+                        result['ttft_phase_breakdown_ms'].items()))
+    except Exception as e:
+        log(f"[bench] llm_fleet_multi phase stamp failed: {e!r}")
+        result["ttft_phase_breakdown_ms"] = {"error": repr(e)}
+
     # guarded extra 1: seeded replica-kill recovery mid-stream
     try:
         k_out, k_total, k_metrics = run_multi("kill", chaos_kill=12)
@@ -1519,7 +1558,8 @@ def bench_llm_fleet_multi():
             fork_model(base), inference.LLMEngineConfig(**ecfg_kw))
         with server:
             server.submit(np.zeros((2,), np.int32),
-                          max_new_tokens=fused_k + 1).result(timeout=300)
+                          max_new_tokens=fused_k + 1,
+                          trace=_quiet_trace()).result(timeout=300)
             sp_out, sp_ttft, _ = drive(
                 lambda j, p: server.submit(
                     p, max_new_tokens=storm_gen(j)),
@@ -1564,6 +1604,104 @@ def bench_llm_fleet_multi():
             f"{e!r}")
         result["prefill_storm"] = {"error": repr(e)}
     return result
+
+
+def bench_tracing_overhead_ab():
+    """Full-mode tracing overhead A/B (ISSUE-15 satellite): the SAME
+    Poisson llm_serve-shaped workload served once per telemetry mode —
+    `full` (spans + per-request phase chrome events + flight-recorder
+    feed live) vs the default `metrics` mode — interleaved F/M/F/M,
+    each side scoring its best run (the llm_serve noise defense).
+    Bar: full-mode wall time <= 1.05x metrics mode; greedy outputs
+    must be identical across modes (tracing must observe, not
+    perturb)."""
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, observability
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
+
+    paddle.seed(0)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        cfg, n_req, name = gpt_tiny(), 96, "gpt-tiny-tracing-ab"
+    else:
+        cfg, n_req, name = gpt_small(), 64, "gpt-small-tracing-ab"
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(L),)).astype(
+        np.int32) for L in rng.integers(8, 48, n_req)]
+    gens = rng.integers(16, 33, n_req)
+    arrive = np.cumsum(rng.exponential(0.002, n_req))
+    # span flushes must not land in the repo: scratch telemetry dir
+    # (setdefault would call mkdtemp eagerly and orphan a dir per run)
+    if "PT_TELEMETRY_DIR" not in os.environ:
+        os.environ["PT_TELEMETRY_DIR"] = tempfile.mkdtemp(
+            prefix="pt_trace_ab_")
+    fused_k = int(os.environ.get("BENCH_DECODE_K", "8"))
+    ecfg = dict(num_slots=4, page_size=16, token_budget=48,
+                max_model_len=96, decode_k=fused_k)
+
+    def run(mode):
+        prev = observability.set_mode(mode)
+        n_events = 0
+        try:
+            # servers are built SEQUENTIALLY over one model (the
+            # shared-model warm caveat: only one engine traces at a
+            # time), and each warms outside its timed window
+            server = inference.LLMServer(
+                model, inference.LLMEngineConfig(**ecfg))
+            with server:
+                server.submit(np.zeros((2,), np.int32),
+                              max_new_tokens=fused_k + 1,
+                              trace=_quiet_trace()).result(
+                                  timeout=300)
+                futs, nxt = [None] * n_req, 0
+                t0 = time.perf_counter()
+                while nxt < n_req:
+                    now = time.perf_counter() - t0
+                    if arrive[nxt] <= now:
+                        futs[nxt] = server.submit(
+                            prompts[nxt], max_new_tokens=int(gens[nxt]))
+                        nxt += 1
+                    else:
+                        time.sleep(min(0.002, arrive[nxt] - now))
+                outs = [f.result(timeout=600) for f in futs]
+                total = time.perf_counter() - t0
+                n_events = len(tracing.chrome_events())
+        finally:
+            observability.set_mode(prev)
+            tracing.reset()
+        return outs, total, n_events
+
+    totals = {"full": [], "metrics": []}
+    ref, match, events_full = None, True, 0
+    for rep in range(2):
+        for mode in ("full", "metrics"):
+            outs, t, nev = run(mode)
+            totals[mode].append(round(t, 3))
+            if mode == "full":
+                events_full = max(events_full, nev)
+            if ref is None:
+                ref = outs
+            else:
+                match = match and all(np.array_equal(a, b)
+                                      for a, b in zip(ref, outs))
+            log(f"[bench] tracing_overhead_ab {mode}[{rep}]: {t:.2f}s")
+    f_best, m_best = min(totals["full"]), min(totals["metrics"])
+    ratio = f_best / m_best
+    log(f"[bench] tracing_overhead_ab: full {f_best:.2f}s vs metrics "
+        f"{m_best:.2f}s = {ratio:.3f}x (bar 1.05), match={match}")
+    return {"model": name, "requests": n_req, "decode_k": fused_k,
+            "totals_s": totals,
+            "best_s": {"full": f_best, "metrics": m_best},
+            "overhead_ratio": round(ratio, 4),
+            "within_bar": bool(ratio <= 1.05),
+            "greedy_match": bool(match),
+            "trace_events_full": events_full}
 
 
 def bench_probe():
@@ -1800,6 +1938,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "llm_serve_int8": bench_llm_serve_int8,
             "llm_fleet": bench_llm_fleet,
             "llm_fleet_multi": bench_llm_fleet_multi,
+            "tracing_overhead_ab": bench_tracing_overhead_ab,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
 
@@ -2034,18 +2173,20 @@ def main():
         # traffic — llm_serve's small-batch A/B is the fused-decode
         # acceptance regime, ISSUE 8)
         extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
-                  "train_3d")
+                  "tracing_overhead_ab", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
-                  "llm_fleet_multi", "train_3d")
+                  "llm_fleet_multi", "tracing_overhead_ab", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both
-        # compiles: they need a wider cap than the single-model arms
+        # compiles — and the tracing A/B runs FOUR — so they need a
+        # wider cap than the single-model arms
         status, res = _run_worker(
             which,
-            timeout_s=900 if which.startswith("llm_") else 420,
+            timeout_s=900 if which.startswith(("llm_", "tracing_"))
+            else 420,
             extra_env=fallback_env)
         if status == "ok":
             log(f"[bench] {which} result: {json.dumps(res)}")

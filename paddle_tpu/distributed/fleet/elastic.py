@@ -306,6 +306,17 @@ def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
         except DivergenceRollback as e:
             record("train_rollback", start_step=start, step=e.step,
                    reason=e.reason)
+            # postmortem BEFORE the restore overwrites the live state:
+            # the ring holds the journal/span trail that led into the
+            # divergence (docs/OBSERVABILITY.md "Flight recorder")
+            try:
+                from ...observability import flight_recorder as _fr
+
+                _fr.dump("divergence_rollback", step=e.step,
+                         rollback_reason=e.reason, start_step=start,
+                         value=str(e.value))
+            except Exception:
+                pass
             _drain_checkpointer(checkpointer)
             continue
         except Exception as e:

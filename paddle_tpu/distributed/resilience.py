@@ -121,6 +121,15 @@ class AnomalyJournal:
                  "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0")),
                  "kind": kind}
         entry.update(fields)
+        # journal events also flow into the flight recorder's ring, so
+        # a postmortem dump carries the anomalies that PRECEDED the
+        # failure (one event stream: docs/OBSERVABILITY.md)
+        try:
+            from ..observability.flight_recorder import record_event
+
+            record_event("journal", entry=dict(entry))
+        except Exception:
+            pass
         with self._lock:
             self.events.append(entry)
             path = self._resolve()

@@ -692,7 +692,11 @@ def send_bytes(data: bytes, dst: int, tag: int = 0,
         seq = _p2p_send_seq.get((me, dst, tag), 0)
         _p2p_send_seq[(me, dst, tag)] = seq + 1
     _BYTES_TOTAL.labels(channel="p2p").inc(len(data))
-    with _trace_span("xproc.send", dst=dst, tag=tag, bytes=len(data)):
+    # seq in the span args: the merged timeline pairs this frame with
+    # the peer's matching xproc.recv by (src, dst, tag, seq) — the
+    # transfer leg of a disaggregated request's causal chain
+    with _trace_span("xproc.send", dst=dst, tag=tag, seq=seq,
+                     bytes=len(data)):
         if not _use_kv_transport():
             _socket_transport().send(data, dst, tag, seq, timeout_ms)
             return
@@ -709,12 +713,12 @@ def recv_bytes(src: int, tag: int = 0, timeout_ms: int = 600_000) -> bytes:
         seq = _p2p_recv_seq.get((src, me, tag), 0)
         _p2p_recv_seq[(src, me, tag)] = seq + 1
     if not _use_kv_transport():
-        with _trace_span("xproc.recv", src=src, tag=tag):
+        with _trace_span("xproc.recv", src=src, tag=tag, seq=seq):
             return _socket_transport().recv(src, tag, seq, timeout_ms)
     import base64
 
     key = f"pt_p2p/{src}/{me}/{tag}/{seq}"
-    with _trace_span("xproc.recv", src=src, tag=tag):
+    with _trace_span("xproc.recv", src=src, tag=tag, seq=seq):
         val = _kv_get(key, timeout_ms)
     # consumed: delete the entry, or bulk transfers (global_shuffle ships
     # whole dataset buckets) grow the coordinator without bound

@@ -78,16 +78,22 @@ class KVPagePayload:
                  order), byte-for-byte as stored
     scales       the fp32 scale planes [num_pages, page_size, H] per
                  pool for quantized kv_dtypes, else []
+    trace        the request's TraceContext wire dict (observability.
+                 reqtrace: trace_id + phase stamps so far) or None —
+                 rides the frame header, so the importing replica's
+                 spans/phases join the SAME trace the router minted
     """
 
     def __init__(self, tokens, n_prefilled, page_size, kv_dtype, kv,
-                 scales):
+                 scales, trace=None):
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.n_prefilled = int(n_prefilled)
         self.page_size = int(page_size)
         self.kv_dtype = str(kv_dtype)
         self.kv = list(kv)
         self.scales = list(scales)
+        self.trace = trace          # wire dict (json-able)
+        self.trace_ctx = None       # restored TraceContext (recv side)
 
     @property
     def num_pages(self):
@@ -119,6 +125,7 @@ def pack_kv_payload(payload):
         "n_kv": len(payload.kv),
         "n_scales": len(payload.scales),
         "pool_dtypes": [str(a.dtype) for a in payload.kv],
+        "trace": payload.trace,
     }).encode("utf-8")
     buf = io.BytesIO()
     buf.write(_HDR.pack(_MAGIC, _VERSION, len(meta)))
@@ -152,22 +159,40 @@ def unpack_kv_payload(raw):
     scales = [np.load(buf, allow_pickle=False)
               for _ in range(meta["n_scales"])]
     return KVPagePayload(tokens, meta["n_prefilled"], meta["page_size"],
-                         meta["kv_dtype"], kv, scales)
+                         meta["kv_dtype"], kv, scales,
+                         trace=meta.get("trace"))
 
 
 def send_kv_payload(payload, dst, tag=KV_STREAM_TAG, timeout_ms=600_000):
     """Stream one payload to rank `dst` over the xproc p2p transport.
     Byte-for-byte: the frame is already pool-quantized, so it must NOT
     ride the PTQ8 float re-encoder (`send_bytes`, not `send_np`) —
-    re-quantizing quantized codes would corrupt them."""
+    re-quantizing quantized codes would corrupt them. The payload's
+    trace rides the frame header AND the `xproc.send` span (ambient),
+    so the transfer leg shows under the request's trace_id on both
+    sides of the merged timeline."""
     from ...distributed import xproc
+    from ...observability import reqtrace, tracing
 
-    xproc.send_bytes(pack_kv_payload(payload), dst, tag=tag,
-                     timeout_ms=timeout_ms)
+    ctx = (reqtrace.TraceContext.from_dict(payload.trace)
+           if payload.trace else None)
+    with tracing.ambient_trace(ctx):
+        xproc.send_bytes(pack_kv_payload(payload), dst, tag=tag,
+                         timeout_ms=timeout_ms)
 
 
 def recv_kv_payload(src, tag=KV_STREAM_TAG, timeout_ms=600_000):
     from ...distributed import xproc
+    from ...observability import reqtrace
 
-    return unpack_kv_payload(xproc.recv_bytes(src, tag=tag,
-                                              timeout_ms=timeout_ms))
+    payload = unpack_kv_payload(xproc.recv_bytes(src, tag=tag,
+                                                 timeout_ms=timeout_ms))
+    if payload.trace:
+        # restore the exporter's trace and stamp the transfer's END on
+        # it — the kv_export -> kv_transfer segment lands on THIS rank
+        # (wall clocks align the cross-process chain, like span `ts`)
+        ctx = reqtrace.TraceContext.from_dict(payload.trace)
+        ctx.stamp("kv_transfer")
+        payload.trace = ctx.to_dict()
+        payload.trace_ctx = ctx
+    return payload

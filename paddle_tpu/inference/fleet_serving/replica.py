@@ -49,6 +49,7 @@ import numpy as np
 
 from ...distributed import chaos
 from ...observability import metrics as _obs
+from ...observability import tracing as _tracing
 
 __all__ = ["ReplicaRegistry", "LocalReplica", "fork_model",
            "stream_prefill", "recv_and_decode"]
@@ -57,6 +58,17 @@ _REPLICA_LIVE = _obs.gauge(
     "pt_router_replica_live",
     "replicas currently alive in the registry (heartbeat fresh, loop "
     "running) — the fleet-capacity gauge the autoscaler moves")
+_REPLICA_QUEUE = _obs.gauge(
+    "pt_replica_queue_depth",
+    "per-replica waiting requests (engine queue + server inbox), "
+    "refreshed every serve-loop tick — the fleet-wide /metrics view's "
+    "per-member load signal (series removed on retirement/death)",
+    labelnames=("replica",))
+_REPLICA_OCC = _obs.gauge(
+    "pt_replica_slot_occupancy",
+    "per-replica live slots / num_slots, refreshed every serve-loop "
+    "tick (series removed on retirement/death)",
+    labelnames=("replica",))
 
 _replica_ids = itertools.count()
 
@@ -156,22 +168,41 @@ def _make_server_class():
             super().__init__(model, config)
             self._replica = replica
 
+        def _loop(self):
+            # every span this serve thread emits carries the replica
+            # name — the merged timeline's per-replica lanes
+            _tracing.set_replica(self._replica.name)
+            try:
+                super()._loop()
+            finally:
+                _tracing.set_replica(None)
+
         def _tick_hook(self):
             rep = self._replica
             if not rep._killed:
                 rep.last_tick = time.monotonic()
                 rep._registry.beat(rep.name)
+                eng = self._engine
+                _REPLICA_QUEUE.labels(replica=rep.name).set(
+                    len(eng.waiting) + self._q.qsize())
+                _REPLICA_OCC.labels(replica=rep.name).set(
+                    sum(r is not None for r in list(eng._slots))
+                    / eng.num_slots)
                 # the kill scopes count BUSY ticks only: an idle loop
                 # polls on a wall-clock cadence, so a seeded call
                 # index would name a moment, not a serving state —
                 # counting work ticks makes "kill at tick N" mean
                 # "mid-stream after N scheduling rounds" on every run
-                if self._engine.has_work() or not self._q.empty():
+                if eng.has_work() or not self._q.empty():
                     try:
                         chaos.fire("replica.kill")
                         chaos.fire(f"replica.kill.{rep.name}")
                     except chaos.InjectedFault:
                         rep._killed = True
+                        # postmortem at the moment of death, from the
+                        # dying thread: the ring still holds the
+                        # victim requests' phase/span trail
+                        rep._flight_dump("chaos_replica_kill")
             # True aborts the loop dead: in-flight futures stay
             # unresolved and heartbeats stop — the router requeues
             return rep._killed
@@ -221,11 +252,17 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
         first-request latency off the serving path). A short request
         long enough to cross one fused window warms both the
         single-tick and the fused/spec paths."""
+        from ...observability import reqtrace as _reqtrace
+
         eng = self.engine
         k = max(eng.decode_k,
                 eng._spec.k + 1 if eng._spec is not None else 1)
+        # quiet traces: the warm requests' prefill segments ARE the
+        # executable compiles — they must not enter the TTFT phase
+        # distribution or the recent-requests view
         req = eng.add_request(np.zeros((2,), np.int32),
-                              max_new_tokens=k + 1)
+                              max_new_tokens=k + 1,
+                              trace=_reqtrace.quiet_trace())
         while eng.has_work():
             eng.step()
         req.future.result(timeout=0)
@@ -235,11 +272,13 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
         # reuses — the first streamed payload must not pay a compile
         # stall on the decode tier's admission path
         pr = eng.add_request(np.zeros((2,), np.int32),
-                             prefill_only=True)
+                             prefill_only=True,
+                             trace=_reqtrace.quiet_trace())
         while eng.has_work():
             eng.step()
         ir = eng.import_kv_pages(pr.future.result(timeout=0),
-                                 max_new_tokens=1)
+                                 max_new_tokens=1,
+                                 trace=_reqtrace.quiet_trace())
         while eng.has_work():
             eng.step()
         ir.future.result(timeout=0)
@@ -297,17 +336,57 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
 
     # ---- lifecycle ----
 
+    def _flight_dump(self, reason):
+        """Postmortem into the flight recorder (best-effort): the dead
+        member's name plus the requests it was holding, with their
+        trace ids — what the failover's requeue is about to replay."""
+        try:
+            from ...observability import flight_recorder as _fr
+
+            eng = self.engine
+            inflight = [{"rid": r.rid, "trace_id": r.trace.trace_id}
+                        for r in list(eng._slots) if r is not None]
+            _fr.dump(reason, replica=self.name, role=self.role,
+                     inflight=inflight, queued=len(eng.waiting))
+        except Exception:
+            pass
+
+    def _drop_gauges(self):
+        """Remove this replica's labeled gauge series — a dead/retired
+        member must not export frozen last-tick values forever."""
+        _REPLICA_QUEUE.remove(replica=self.name)
+        _REPLICA_OCC.remove(replica=self.name)
+
+    def export_telemetry(self, directory=None):
+        """Per-replica telemetry file (`metrics.rank<r>.<name>.json`).
+        Threaded replicas share one rank — rank-only naming made them
+        overwrite each other's at-exit export; naming by replica keeps
+        every member's final view (observability.export_replica)."""
+        from ...observability import export_replica
+
+        return export_replica(self.name, self.metrics, directory)
+
     def kill(self):
         """Die like a lost process: the serve loop exits at its next
         tick without resolving anything, heartbeats stop. (The chaos
         `replica.kill` injector lands here too.)"""
         self._killed = True
+        self._flight_dump("replica_kill")
 
     def stop(self):
         """Graceful retirement (scale-down): drain the queue, stop the
-        loop, deregister."""
+        loop, deregister — and export this member's telemetry view in
+        full mode (per-replica file naming: see export_telemetry)."""
         self._server.stop()
         self._registry.deregister(self.name)
+        self._drop_gauges()
+        try:
+            from ...observability import full_enabled
+
+            if full_enabled():
+                self.export_telemetry()
+        except Exception:
+            pass
 
 
 # ---- cross-process disaggregation (xproc transport) -----------------

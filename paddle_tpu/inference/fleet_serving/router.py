@@ -49,7 +49,9 @@ import time
 
 import numpy as np
 
+from ...observability import flight_recorder as _flight
 from ...observability import metrics as _obs
+from ...observability import reqtrace as _reqtrace
 from .replica import LocalReplica, ReplicaRegistry
 
 __all__ = ["AutoscalePolicy", "FleetRouter"]
@@ -71,6 +73,11 @@ _MONITOR_ERRORS = _obs.counter(
     "ticks (supervision survives a bad tick, but a persistently "
     "failing one — e.g. a factory that cannot build replicas — must "
     "be visible, not a silent poll-rate retry loop)")
+_ROUTER_TTFT = _obs.histogram(
+    "pt_router_ttft_seconds",
+    "client-observed TTFT at the ROUTER ingress (submit -> the serving "
+    "replica's first-token stamp) — the fleet-wide latency the "
+    "per-engine pt_llm_ttft_seconds cannot see across a hand-off")
 
 
 class AutoscalePolicy:
@@ -105,11 +112,17 @@ class AutoscalePolicy:
 class _RoutedRequest:
     _ids = itertools.count()
 
-    def __init__(self, prompt, kwargs, future):
+    def __init__(self, prompt, kwargs, future, trace=None):
         self.rid = next(_RoutedRequest._ids)
         self.prompt = prompt
         self.kwargs = kwargs       # submit kwargs (eos, sampling, SLA)
         self.future = future       # client-facing
+        # fleet-wide identity: every engine request, span, and KV
+        # payload this request touches — on ANY replica — carries this
+        # trace (observability.reqtrace). Requeue/replay attempts share
+        # it; first-wins stamps keep the first attempt's timeline.
+        self.trace = trace if trace is not None else _reqtrace.new_trace()
+        self.trace.stamp("queued")
         self.replica = None        # name currently serving it
         self.internal = None       # the replica-side Future
         self.stage = None          # "prefill" | "decode"
@@ -155,8 +168,12 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         self._affinity = {}        # name -> {prefix-key: last-use clock}
         self._clock = itertools.count()
         self._inflight = {}        # rid -> _RoutedRequest
-        self._ttfts = []           # completed-request TTFTs (bounded)
+        # per-ROUTER TTFT distribution (unregistered Histogram: the
+        # registry's pt_router_ttft_seconds is process-global — two
+        # routers in one process must not blur each other's view)
+        self._ttft_hist = _obs.Histogram("router_ttft_local")
         self._monitor = None
+        self._http = None
         self._running = False
         self._last_scale = 0.0
         self._pressure_ticks = 0
@@ -194,6 +211,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                 "FleetRouter needs at least one serve-role replica "
                 "(pass replicas=[...] or a factory)")
         self._running = True
+        # dump-time state: every postmortem carries this router's full
+        # fleet view (unique key — tests run several routers)
+        self._fr_key = f"router:{id(self):x}"
+        _flight.add_state_provider(self._fr_key, self.metrics)
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          name="fleet-router",
                                          daemon=True)
@@ -202,6 +223,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
 
     def stop(self):
         self._running = False
+        _flight.remove_state_provider(getattr(self, "_fr_key", ""))
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
         if self._monitor is not None:
             self._monitor.join(timeout=30)
             self._monitor = None
@@ -240,9 +265,13 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         if not self._running:
             raise RuntimeError("router not started (use `with router:`)")
         prompt = np.asarray(prompt).reshape(-1)
+        # a caller-minted trace (a gateway in front of this router)
+        # must not collide with the per-replica submit's own trace kwarg
+        trace = kw.pop("trace", None)
         rr = _RoutedRequest(
             prompt, dict(max_new_tokens=int(max_new_tokens),
-                         eos_token_id=eos_token_id, **kw), Future())
+                         eos_token_id=eos_token_id, **kw), Future(),
+            trace=trace)
         with self._lock:
             self._inflight[rr.rid] = rr
             self.stats["requests"] += 1
@@ -329,8 +358,9 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             pre = self._pick_prefill(exclude)
             if pre is not None:
                 rr.stage, rr.replica = "prefill", pre.name
+                rr.trace.stamp("routed")
                 rr.internal = pre.submit_prefill(
-                    rr.prompt,
+                    rr.prompt, trace=rr.trace,
                     **{k: rr.kwargs[k] for k in
                        ("tenant", "priority", "ttft_slo_s")
                        if k in rr.kwargs})
@@ -351,13 +381,16 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             _AFFINITY_HITS.inc()
         rr.stage = "decode"
         rr.replica = rep.name
+        rr.trace.stamp("routed")
         if rr.payload is not None:
             with self._lock:
                 self.stats["disagg_handoffs"] += 1
             payload, rr.payload = rr.payload, None  # consumed
-            rr.internal = rep.submit_imported(payload, **rr.kwargs)
+            rr.internal = rep.submit_imported(payload, trace=rr.trace,
+                                              **rr.kwargs)
         else:
-            rr.internal = rep.submit(rr.prompt, **rr.kwargs)
+            rr.internal = rep.submit(rr.prompt, trace=rr.trace,
+                                     **rr.kwargs)
         rr.internal.add_done_callback(
             lambda f, rr=rr: self._on_decode_done(rr, f))
 
@@ -375,6 +408,7 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             self._dispatch(rr)
             return
         rr.payload = fut.result()
+        rr.trace.stamp("kv_transfer")   # the in-process hand-off moment
         self._dispatch(rr)
 
     def _on_decode_done(self, rr, fut):
@@ -411,10 +445,9 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             if not rr.future.done():
                 rr.future.set_result(fut.result())
             if req is not None and req.t_first_token is not None:
-                with self._lock:
-                    self._ttfts.append(req.t_first_token - rr.t_submit)
-                    if len(self._ttfts) > 10000:
-                        del self._ttfts[:5000]
+                ttft = req.t_first_token - rr.t_submit
+                self._ttft_hist.observe(ttft)
+                _ROUTER_TTFT.observe(ttft)
         with self._lock:
             self._inflight.pop(rr.rid, None)
 
@@ -426,6 +459,7 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         # ending supervision) — but every swallowed error is COUNTED
         # and kept in the snapshot, and the ticks fail independently
         # (an autoscale error must not mask the failover scan)
+        last_state = 0.0
         while self._running:
             time.sleep(self.policy.poll_s)
             try:
@@ -436,6 +470,25 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                 self._autoscale_tick()
             except Exception as e:
                 self._note_monitor_error(e)
+            now = time.monotonic()
+            if now - last_state >= 0.5:
+                # throttled fleet-state capture into the flight ring:
+                # a postmortem shows the minutes BEFORE the failure,
+                # not just its instant
+                last_state = now
+                try:
+                    with self._lock:
+                        reps = list(self._replicas.values()) + list(
+                            self._prefill.values())
+                        inflight = len(self._inflight)
+                    _flight.record_event(
+                        "router_state", inflight=inflight,
+                        requeues=self.stats["requeues"],
+                        replicas={r.name: {"alive": r.alive,
+                                           "queue": r.queue_depth()}
+                                  for r in reps})
+                except Exception as e:
+                    self._note_monitor_error(e)
 
     def _note_monitor_error(self, exc):
         _MONITOR_ERRORS.inc()
@@ -488,8 +541,14 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                        and rr.replica is not None
                        and rr.replica not in members
                        and not rr.future.done()]
+        orphan_info = [{"rid": rr.rid, "trace_id": rr.trace.trace_id,
+                        "was_on": rr.replica} for rr in orphans]
         for rr in orphans:
             self._requeue(rr, exclude={rr.replica})
+        if orphans:
+            # a requeue with NO death this tick (the dispatch-vs-death
+            # TOCTOU): still a failover event worth a postmortem
+            _flight.dump("failover_requeue", requeued=orphan_info)
         self.registry._publish()
 
     def _requeue(self, rr, exclude):
@@ -499,6 +558,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         with self._lock:
             self.stats["requeues"] += 1
         _REQUEUES.inc()
+        _flight.record_event("failover_requeue", rid=rr.rid,
+                             trace_id=rr.trace.trace_id,
+                             exclude=sorted(exclude),
+                             attempt=rr.requeues)
         self._dispatch(rr, exclude=exclude)
 
     def _handle_death(self, name, rep):
@@ -517,8 +580,19 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                        if rr.replica == name and not rr.future.done()]
             self.stats["replicas_lost"] += 1
         self.registry.deregister(name)
+        rep._drop_gauges()   # a dead member must not export frozen load
         for rr in victims:
             self._requeue(rr, exclude={name})
+        # postmortem: the dead member, everything it was serving (with
+        # trace ids — the merged timeline's keys), and the ring that
+        # holds the last seconds of spans/phases/journal leading in
+        _flight.dump(
+            "replica_death", replica=name, role=rep.role,
+            last_tick_age_s=round(time.monotonic() - rep.last_tick, 3),
+            requeued=[{"rid": rr.rid, "trace_id": rr.trace.trace_id,
+                       "stage": rr.stage, "requeues": rr.requeues}
+                      for rr in victims],
+            stats=dict(self.stats))
 
     def _autoscale_tick(self):
         pol = self.policy
@@ -593,14 +667,21 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             return len(self._replicas)
 
     def ttft_quantile(self, q):
-        with self._lock:
-            samples = list(self._ttfts)
-        if not samples:
+        """Router-ingress TTFT percentile (the histogram replaces the
+        old hand-kept sample list — satellite: percentiles come from
+        the metrics substrate, not per-caller np.percentile)."""
+        if self._ttft_hist.count == 0:
             return None
-        return float(np.percentile(np.asarray(samples), q * 100))
+        return self._ttft_hist.quantile(q)
 
     def metrics(self):
-        """Router snapshot + per-replica engine views (scrape-safe)."""
+        """ONE fleet-wide snapshot (scrape-safe): router policy state,
+        per-replica engine views keyed by replica name (the labels the
+        per-process islands lacked), the fleet TTFT distribution, the
+        process-wide TTFT phase decomposition, and the last requests'
+        merged timelines. `start_metrics_http` serves this under
+        /metrics.json "extra"; the Prometheus text side carries the
+        same per-replica identity via pt_replica_*{replica} series."""
         with self._lock:
             reqs = self.stats["requests"]
             hits = self.stats["affinity_hits"]
@@ -608,20 +689,60 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             inflight = len(self._inflight)
             reps = list(self._replicas.values()) + list(
                 self._prefill.values())
+        replicas = {}
+        recent = []
+        for r in reps:
+            info = {"role": r.role, "alive": r.alive,
+                    "queue_depth": r.queue_depth(),
+                    "mean_slot_occupancy": r.engine.mean_occupancy}
+            try:
+                eng = r.engine.metrics()
+                recent += eng.pop("recent_requests", [])
+                info["engine"] = eng
+            except Exception as e:   # a dying member must not kill the
+                info["engine_error"] = repr(e)   # whole fleet scrape
+            replicas[r.name] = info
+        # one fleet-wide timeline list: requests interleave across
+        # replicas; order by their first stamp. A disaggregated request
+        # appears on BOTH tiers (the prefill engine notes it at export,
+        # the decode engine at first token) — same trace, snapshotted
+        # at two moments — keep the fuller one. NOTE the per-engine
+        # deques are bounded (64 each) — under sustained traffic this
+        # is the TAIL, not history.
+        by_trace = {}
+        for tl in recent:
+            cur = by_trace.get(tl["trace_id"])
+            if cur is None or len(tl.get("phases", ())) >= len(
+                    cur.get("phases", ())):
+                by_trace[tl["trace_id"]] = tl
+        recent = sorted(by_trace.values(),
+                        key=lambda tl: tl["phases"][0]["t"]
+                        if tl.get("phases") else 0.0)
         snap.update({
             "inflight": inflight,
             "affinity_hit_rate": hits / reqs if reqs else None,
             "ttft_p50_s": self.ttft_quantile(0.5),
+            "ttft_p95_s": self.ttft_quantile(0.95),
             "ttft_p99_s": self.ttft_quantile(0.99),
+            "request_phase_seconds": _reqtrace.phase_summary(),
+            "recent_requests": recent[-128:],
             "replica_ages": self.registry.ages(),
-            "replicas": {
-                r.name: {"role": r.role, "alive": r.alive,
-                         "queue_depth": r.queue_depth(),
-                         "mean_slot_occupancy":
-                             r.engine.mean_occupancy}
-                for r in reps},
+            "replicas": replicas,
         })
         return snap
+
+    def start_metrics_http(self, port=0, host="127.0.0.1"):
+        """Fleet-wide pull endpoint: GET /metrics is the process
+        registry (per-replica pt_replica_* series included) in
+        Prometheus text, /metrics.json adds this router's `metrics()`
+        under "extra" — ONE scrape for the whole in-process fleet
+        instead of per-replica islands. Stopped with the router."""
+        if self._http is None:
+            from ...observability import start_http_server
+
+            self._http = start_http_server(port=port, host=host,
+                                           extra_json=self.metrics)
+        return self._http
 
 
 _scale_names = itertools.count(1000)   # factory-built replica names

@@ -78,6 +78,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import metrics as _obs
+from ..observability import reqtrace as _reqtrace
 from ..observability.tracing import trace_span as _trace_span
 from .fleet_serving import Priority, RadixPrefixCache, SLAScheduler
 from .serving import _FutureQueueServer
@@ -147,6 +148,19 @@ from ..jit import _DONATION_HELD
 
 class PoolExhausted(RuntimeError):
     """No free KV pages (the scheduler preempts and retries on this)."""
+
+
+def _payload_trace(payload):
+    """The TraceContext a KVPagePayload carries (restored once and
+    cached on the payload), or None — the disaggregated hand-off's
+    identity continuity, shared by `LLMServer.submit` and
+    `LLMEngine.add_request` so NEITHER ingress mints a fresh trace
+    over a payload that already has one."""
+    ctx = getattr(payload, "trace_ctx", None)
+    if ctx is None and getattr(payload, "trace", None):
+        ctx = _reqtrace.TraceContext.from_dict(payload.trace)
+        payload.trace_ctx = ctx
+    return ctx
 
 
 class PagePool:  # ptlint: thread-shared (scraped by /metrics)
@@ -579,6 +593,9 @@ class _Request:
         self.t_submit = _time.perf_counter()
         self.t_first_admit = None
         self.t_first_token = None
+        # request-scoped trace identity + TTFT phase stamps
+        # (observability.reqtrace; assigned by add_request)
+        self.trace = None
 
     @property
     def do_sample(self):
@@ -719,6 +736,9 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                       "finished": 0, "preemptions": 0,
                       "occupancy_sum": 0.0, "fused_steps": 0,
                       "stage_hits": 0}
+        # recent per-request phase timelines (reqtrace), appended at
+        # first token / prefill export — the `metrics()` drill-down
+        self._timelines = collections.deque(maxlen=64)
         # speculative decoding (draft_model configured): draft pools
         # mirror this pool's page ids, the big model verifies k+1
         # ragged positions per slot in one dispatch — the spec window
@@ -745,7 +765,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     future=None, tenant="default", priority=None,
                     ttft_slo_s=None, temperature=0.0, top_p=1.0,
-                    prefill_only=False, kv_import=None):
+                    prefill_only=False, kv_import=None, trace=None):
         """Enqueue one request. The disaggregated-serving knobs
         (docs/SERVING.md "Disaggregated fleet"):
 
@@ -783,6 +803,13 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         req.sample_stream = next(self._sample_streams)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
         _REQS_TOTAL.inc()
+        # trace identity: the caller's (router/server — already stamped
+        # `queued` at the ingress), else the payload's (a disaggregated
+        # hand-off continues the prefill side's trace), else fresh
+        if trace is None and kv_import is not None:
+            trace = _payload_trace(kv_import)
+        req.trace = trace if trace is not None else _reqtrace.new_trace()
+        req.trace.stamp("queued")   # no-op when the ingress stamped it
         if kv_import is not None:
             self._check_import(req, kv_import)
             req._kv_import = kv_import
@@ -793,7 +820,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 # nothing before the frontier: an empty export (the
                 # decode side prefills the single prompt token itself)
                 if not req.future.cancelled():
-                    req.future.set_result(self._empty_payload(toks))
+                    req.future.set_result(
+                        self._empty_payload(toks, req.trace))
                 return req
         elif req.target <= req.prompt_len:
             # zero budget (same contract as generate()): prompt echoes back
@@ -928,9 +956,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                   for a in gathered[len(self._kv):]]
         self.stats["kv_pages_exported"] = (
             self.stats.get("kv_pages_exported", 0) + n)
+        req.trace.stamp("kv_export")
         return KVPagePayload(np.asarray(req.tokens, np.int32),
                              req.n_prefilled, self.page_size,
-                             self.kv_dtype, kv, scales)
+                             self.kv_dtype, kv, scales,
+                             trace=req.trace.to_dict())
 
     def import_kv_pages(self, payload, **kw):
         """Admit one request whose prompt KV arrives pre-computed (a
@@ -940,15 +970,18 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         prompt. Accepts the `add_request` keyword surface."""
         return self.add_request(payload.tokens, kv_import=payload, **kw)
 
-    def _empty_payload(self, toks):
+    def _empty_payload(self, toks, trace=None):
         from .fleet_serving.kv_transfer import KVPagePayload
 
+        if trace is not None:
+            trace.stamp("kv_export")
         return KVPagePayload(
             toks, 0, self.page_size, self.kv_dtype,
             [np.zeros((0,) + p.shape[1:], np.asarray(p[:0]).dtype)
              for p in self._kv],
             [np.zeros((0,) + s.shape[1:], np.float32)
-             for s in self._kv_scales])
+             for s in self._kv_scales],
+            trace=trace.to_dict() if trace is not None else None)
 
     def _check_import(self, req, payload):
         """Loud geometry validation at submit time (an import that
@@ -1047,7 +1080,9 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         """Retire a prefill-only request AT its frontier: export the
         payload, release the slot/pages, resolve the future to the
         payload (docs/SERVING.md "Disaggregated fleet")."""
+        req.trace.stamp("prefill_end")
         payload = self.export_kv_pages(req)
+        self._note_timeline(req)
         self._release(slot, req)
         self.stats["finished"] += 1
         self.stats["prefill_exports"] = (
@@ -1055,6 +1090,21 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         _FINISHED_TOTAL.inc()
         if not req.future.cancelled():
             req.future.set_result(payload)
+
+    def _note_timeline(self, req):
+        """Record the request's phase timeline (reqtrace) for the
+        `metrics()["recent_requests"]` drill-down. Quiet traces
+        (warm-up requests — their prefill segment is an XLA compile
+        stall, not serving latency) stay out of the view."""
+        if req.trace.quiet:
+            return
+        self._timelines.append({
+            "rid": req.rid, "trace_id": req.trace.trace_id,
+            "phases": req.trace.timeline(),
+            # unrounded like the timeline's dt_s: the exported
+            # invariant is sum(dt_s) == total_s (to float addition
+            # error) — rounding one side would break it by up to 5e-7
+            "total_s": req.trace.total_s()})
 
     def kv_fragmentation(self):
         """Internal fragmentation of the live KV pages: unwritten
@@ -1110,8 +1160,13 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             "admission_p50_s": _ADMIT_SECONDS.quantile(0.5),
             "admission_p99_s": _ADMIT_SECONDS.quantile(0.99),
             "ttft_p50_s": _TTFT_SECONDS.quantile(0.5),
+            "ttft_p95_s": _TTFT_SECONDS.quantile(0.95),
             "ttft_p99_s": _TTFT_SECONDS.quantile(0.99),
             "request_tok_per_s_p50": _REQ_TOK_RATE.quantile(0.5),
+            # TTFT decomposition (observability.reqtrace): per-phase
+            # percentiles + the last requests' full timelines
+            "request_phase_seconds": _reqtrace.phase_summary(),
+            "recent_requests": list(self._timelines),
             "executables": self._step_fn.cache_size(),
         }
 
@@ -1142,6 +1197,14 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         release all pages, and re-zero the pools — a step that died
         mid-donation leaves the old kv buffers deleted, so the engine
         must not reuse them."""
+        try:
+            from ..observability import flight_recorder as _fr
+
+            _fr.dump("engine_abort", error=repr(exc), inflight=[
+                {"rid": r.rid, "trace_id": r.trace.trace_id}
+                for r in self._slots if r is not None])
+        except Exception:
+            pass
         for slot, req in enumerate(self._slots):
             if req is not None:
                 self._release(slot, req)
@@ -1380,6 +1443,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                          for _ in range(imp.num_pages)]
             self._write_imported_pages(req.pages, imp)
             req.n_prefilled = imp.n_prefilled
+            req.trace.stamp("kv_import")
         # mirrored draft pool: a shared page's draft rows were written
         # by the publishing request's own catch-up (same page ids, same
         # tokens, same draft model), so the mapped prefix is draft-valid
@@ -1401,6 +1465,14 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         if req.t_first_admit is None:
             req.t_first_admit = _time.perf_counter()
             _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
+        # phase stamps (first-wins: a preemption replay re-admits
+        # without rewriting the original timeline)
+        if req.n_prefilled < len(req.tokens) - 1:
+            req.trace.stamp("prefill_start")
+        else:
+            # a full import / full trie hit: the frontier is already
+            # covered, no prefill ever runs on this engine
+            req.trace.stamp("prefill_end")
         if (req.prefill_only
                 and req.n_prefilled >= req.prompt_len - 1):
             # an import (or full trie hit) already covers the frontier:
@@ -1511,6 +1583,9 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             frontier = [(s, r) for s, r in active
                         if r.n_prefilled == len(r.tokens) - 1]
             if frontier:
+                for _s, r in frontier:
+                    if r.num_generated == 0:
+                        r.trace.stamp("first_decode_dispatch")
                 out = (self._spec.try_window(frontier)
                        if self._spec is not None
                        else self._try_step_fused(frontier))
@@ -1654,6 +1729,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             if gen_before[slot] == 0 and emitted > 0:
                 ttft = now - req.t_submit
                 req.t_first_token = now
+                req.trace.stamp("first_token")
+                self._note_timeline(req)
                 _TTFT_SECONDS.observe(ttft)
                 self.sched.note_first_token(req, ttft)
             if done:
@@ -1757,6 +1834,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 widx[row] = (req.pages[p // self.page_size]
                              * self.page_size + p % self.page_size)
                 klen[row] = p + 1
+                if req.num_generated == 0:
+                    req.trace.stamp("first_decode_dispatch")
             i = len(plan)
         else:
             from ..distributed import mesh as mesh_mod
@@ -1780,6 +1859,13 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                     if p == len(req.tokens) - 1:
                         sample_idx[slot] = i
                         sample_slots.append(slot)
+                        if req.num_generated == 0:
+                            # this dispatch carries the frontier row:
+                            # prefill ends and decode begins HERE (in
+                            # that order — the timeline reads left to
+                            # right even when one dispatch does both)
+                            req.trace.stamp("prefill_end")
+                            req.trace.stamp("first_decode_dispatch")
                     i += 1
             # committed like the staged copies: a committed/uncommitted
             # flip at one arg position would cost a second executable
@@ -1842,6 +1928,10 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         finished = []
         for slot, req, take in plan:
             req.n_prefilled += take
+            if req.n_prefilled >= len(req.tokens) - 1:
+                # the sampling frontier is reached: prefill is over
+                # (first-wins — steady-state decode ticks are no-ops)
+                req.trace.stamp("prefill_end")
             # per-tenant fair-queuing meter: flat tokens actually spent
             self.sched.note_tokens(req.tenant, take)
             if self.prefix_cache is not None:
@@ -1863,6 +1953,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             if req.num_generated == 1:      # replays don't re-count
                 ttft = now - req.t_submit
                 req.t_first_token = now
+                req.trace.stamp("first_token")
+                self._note_timeline(req)
                 _TTFT_SECONDS.observe(ttft)
                 self.sched.note_first_token(req, ttft)
             if ((req.eos is not None and t == req.eos)
@@ -1919,7 +2011,7 @@ class LLMServer(_FutureQueueServer):
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                tenant="default", priority=None, ttft_slo_s=None,
                temperature=0.0, top_p=1.0, prefill_only=False,
-               kv_import=None):
+               kv_import=None, trace=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
         after it) — or, with `prefill_only=True`, to the exported
@@ -1943,13 +2035,24 @@ class LLMServer(_FutureQueueServer):
         given engine seed whatever decode_k is."""
         fut = Future()
         fut.pt_request = None
+        # trace identity minted at the INGRESS (this thread), so the
+        # `queued` stamp covers the server queue, not just the engine's
+        # — unless the payload already carries one (the cross-process
+        # decode half: recv_and_decode -> submit_imported must CONTINUE
+        # the prefill tier's trace, not start a fresh id)
+        if trace is None and kv_import is not None:
+            trace = _payload_trace(kv_import)
+        if trace is None:
+            trace = _reqtrace.new_trace()
+        trace.stamp("queued")
         self._enqueue(dict(
             prompt=np.asarray(prompt).reshape(-1),
             max_new_tokens=int(max_new_tokens),
             eos_token_id=eos_token_id, future=fut, tenant=tenant,
             priority=priority, ttft_slo_s=ttft_slo_s,
             temperature=float(temperature), top_p=float(top_p),
-            prefill_only=bool(prefill_only), kv_import=kv_import))
+            prefill_only=bool(prefill_only), kv_import=kv_import,
+            trace=trace))
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):

@@ -498,6 +498,8 @@ class SpeculativeDecoder:
             if gen_before[slot] == 0 and emitted > 0:
                 ttft = now - req.t_submit
                 req.t_first_token = now
+                req.trace.stamp("first_token")
+                eng._note_timeline(req)
                 _TTFT_SECONDS.observe(ttft)
                 eng.sched.note_first_token(req, ttft)
             if done:

@@ -31,18 +31,20 @@ import json
 import os
 import threading
 
-from . import metrics, tracing
+from . import flight_recorder, metrics, reqtrace, tracing  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       counter, gauge, histogram, registry, snapshot,
                       to_jsonl, to_prometheus, _STATE)
+from .reqtrace import TraceContext, new_trace  # noqa: F401
 from .tracing import chrome_events, flush, trace_span  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "counter", "gauge", "histogram", "registry", "snapshot",
            "to_prometheus", "to_jsonl", "trace_span", "chrome_events",
            "flush", "set_mode", "mode", "metrics_enabled", "full_enabled",
-           "export_all", "journal_snapshot", "bench_snapshot",
-           "start_http_server", "telemetry_dir"]
+           "export_all", "export_replica", "journal_snapshot",
+           "bench_snapshot", "start_http_server", "telemetry_dir",
+           "TraceContext", "new_trace", "reqtrace", "flight_recorder"]
 
 _MODES = {"off": _STATE.OFF, "metrics": _STATE.METRICS,
           "full": _STATE.FULL}
@@ -126,6 +128,38 @@ def export_all(directory=None, journal=True):
         except Exception:
             pass
     return d
+
+
+def export_replica(name, view_fn=None, directory=None):
+    """Per-REPLICA telemetry export: `metrics.rank<r>.<name>.json`.
+
+    Threaded `LocalReplica`s share one process (one rank) — an at-exit
+    export named by rank alone makes N replicas overwrite each other's
+    files, leaving whichever replica stopped last as the only record.
+    Naming by replica keeps every member's final view
+    (tests/test_request_tracing.py pins two-replicas-two-files).
+    `view_fn()` supplies the replica-local snapshot (the shared
+    process registry rides along for context). Best-effort; returns
+    the path or None."""
+    import re
+
+    d = directory or telemetry_dir()
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(name)) or "replica"
+    path = os.path.join(d, f"metrics.rank{_rank()}.{safe}.json")
+    payload = {"replica": str(name)}
+    if view_fn is not None:
+        try:
+            payload["view"] = view_fn()
+        except Exception as e:
+            payload["view_error"] = repr(e)
+    payload["metrics"] = registry().compact()
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+    except OSError:
+        return None
+    return path
 
 
 _atexit_installed = False

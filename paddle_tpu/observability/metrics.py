@@ -189,6 +189,17 @@ class _HistogramCell:
         return float(self._bounds[-1])
 
 
+def summarize_histogram_cell(cell):
+    """{count, sum, p50, p95, p99} for one histogram cell — the ONE
+    percentile-view shape (`Histogram.summary()` for unlabeled
+    histograms, `reqtrace.phase_summary()` per label)."""
+    _counts, total, n = cell.merged()
+    return {"count": n, "sum": total,
+            "p50": cell.quantile(0.50),
+            "p95": cell.quantile(0.95),
+            "p99": cell.quantile(0.99)}
+
+
 _CELL_TYPES = {"counter": _CounterCell, "gauge": _GaugeCell,
                "histogram": _HistogramCell}
 
@@ -336,6 +347,14 @@ class Histogram(_Metric):
     def quantile(self, q):
         return self._cell().quantile(q)
 
+    def summary(self):
+        """{count, sum, p50, p95, p99} for the (unlabeled) series —
+        the one-call percentile view for consumers holding a histogram
+        handle (labeled histograms: summarize each `_series()` cell
+        via `summarize_histogram_cell`, as reqtrace.phase_summary
+        does)."""
+        return summarize_histogram_cell(self._cell())
+
     @property
     def count(self):
         return self._cell().count
@@ -426,6 +445,7 @@ class MetricsRegistry:
                             [str(b) for b in m.buckets] + ["+Inf"],
                             counts)),
                         "p50": cell.quantile(0.50),
+                        "p95": cell.quantile(0.95),
                         "p99": cell.quantile(0.99)})
                 else:
                     series.append({"labels": labels, "value": cell.value})
@@ -434,13 +454,22 @@ class MetricsRegistry:
         return out
 
     def to_prometheus(self):
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. Each histogram is
+        followed by a `{name}_quantile` GAUGE family (p50/p95/p99 by a
+        `quantile` label), so scrapers (and the bench stamps / router
+        load view) read percentiles directly instead of re-deriving
+        them from bucket counts — a SEPARATE family emitted after the
+        histogram's own, because foreign samples inside a
+        `# TYPE ... histogram` block violate the exposition format and
+        split the family in spec parsers (verified against
+        prometheus_client's reference parser)."""
         lines = []
         for m in self:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             ptype = m.kind
             lines.append(f"# TYPE {m.name} {ptype}")
+            qlines = []
             for values, cell in m._series():
                 if m.kind == "histogram":
                     counts, total, n = cell.merged()
@@ -454,9 +483,18 @@ class MetricsRegistry:
                     lab = _fmt_labels(m.labelnames, values)
                     lines.append(f"{m.name}_sum{lab} {_fmt_num(total)}")
                     lines.append(f"{m.name}_count{lab} {n}")
+                    for q in (0.5, 0.95, 0.99):
+                        qlab = _fmt_labels(m.labelnames, values,
+                                           [("quantile", q)])
+                        qlines.append(
+                            f"{m.name}_quantile{qlab} "
+                            f"{_fmt_num(cell.quantile(q))}")
                 else:
                     lab = _fmt_labels(m.labelnames, values)
                     lines.append(f"{m.name}{lab} {_fmt_num(cell.value)}")
+            if qlines:
+                lines.append(f"# TYPE {m.name}_quantile gauge")
+                lines += qlines
         return "\n".join(lines) + "\n"
 
     def to_jsonl(self):
@@ -483,6 +521,7 @@ class MetricsRegistry:
                         continue
                     out[key] = {"count": n, "sum": round(total, 6),
                                 "p50": round(cell.quantile(0.5), 6),
+                                "p95": round(cell.quantile(0.95), 6),
                                 "p99": round(cell.quantile(0.99), 6)}
                 else:
                     v = cell.value

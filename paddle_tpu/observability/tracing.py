@@ -27,7 +27,9 @@ import time
 from .metrics import _STATE, counter
 
 __all__ = ["trace_span", "chrome_events", "flush", "reset",
-           "trace_path", "MAX_EVENTS"]
+           "trace_path", "MAX_EVENTS", "set_replica", "current_replica",
+           "ambient_trace", "current_trace", "add_event", "add_sink",
+           "remove_sink"]
 
 MAX_EVENTS = int(os.environ.get("PT_TRACE_BUFFER", "200000"))
 
@@ -36,6 +38,88 @@ _flush_lock = threading.Lock()
 _flushed_paths = set()      # paths this PROCESS already wrote (see flush)
 _dropped = counter("pt_trace_events_dropped_total",
                    "span events dropped by the bounded trace buffer")
+
+# request-identity ambience (reqtrace.py is the user-facing surface).
+# Thread-locals, because the serving runtime's unit of concurrency is
+# the thread: a replica's serve loop tags every span it emits with its
+# replica name, and a transport call made under `ambient_trace(ctx)`
+# tags its spans with the request's trace_id — that is how one
+# disaggregated request reads as a single causal chain across replica
+# lanes and process boundaries in the merged timeline.
+_tls = threading.local()
+
+# event sinks: each completed event (span exit or add_event) is handed
+# to every registered sink — the flight recorder's feed. Full mode
+# only (below full, no events exist to feed).
+_sinks = []
+
+
+def set_replica(name):
+    """Tag every span THIS thread emits with `replica` (a replica's
+    serve loop calls this at start; None clears)."""
+    _tls.replica = name
+
+
+def current_replica():
+    return getattr(_tls, "replica", None)
+
+
+def current_trace():
+    """The thread's ambient TraceContext (reqtrace), or None."""
+    return getattr(_tls, "trace", None)
+
+
+class ambient_trace:
+    """Context manager: spans emitted by this thread inside the block
+    carry `ctx.trace_id` (ctx None = no-op passthrough)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "trace", None)
+        if self._ctx is not None:
+            _tls.trace = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.trace = self._prev
+        return False
+
+
+def add_sink(fn):
+    """Register an event sink: fn(event_dict) on every completed span
+    event (full mode). Sinks must be cheap and never raise."""
+    if fn not in _sinks:
+        _sinks.append(fn)
+
+
+def remove_sink(fn):
+    try:
+        _sinks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _finish_event(ev):
+    """Stamp ambient identity, buffer (bounded), feed sinks."""
+    rep = getattr(_tls, "replica", None)
+    if rep is not None:
+        ev["replica"] = rep
+    tr = getattr(_tls, "trace", None)
+    if tr is not None:
+        ev.setdefault("args", {}).setdefault("trace_id", tr.trace_id)
+    if len(_events) >= MAX_EVENTS:
+        _dropped.inc()
+    else:
+        _events.append(ev)      # list.append is atomic under the GIL
+    for s in list(_sinks):
+        try:
+            s(ev)
+        except Exception:
+            pass
 
 
 def _rank():
@@ -81,9 +165,6 @@ class _Span:
             return False
         dur_us = int((time.perf_counter() - self._t0) * 1e6)
         self._t0 = None
-        if len(_events) >= MAX_EVENTS:
-            _dropped.inc()
-            return False
         ev = {"name": self.name, "ph": "X",
               "ts": int(self._wall0 * 1e6), "dur": dur_us,
               "pid": _rank(), "tid": threading.get_ident()}
@@ -93,7 +174,7 @@ class _Span:
             ev["args"] = dict(self.args)
         if exc_type is not None:
             ev.setdefault("args", {})["error"] = exc_type.__name__
-        _events.append(ev)          # list.append is atomic under the GIL
+        _finish_event(ev)
         return False
 
     def __call__(self, fn):
@@ -113,6 +194,19 @@ def trace_span(name, **args):
     """Span factory: ``with trace_span("x", k=v): ...`` or
     ``@trace_span("x")``. No-op (one mode check) below full telemetry."""
     return _Span(name, args)
+
+
+def add_event(name, ts_us, dur_us, args=None):
+    """Record one pre-timed complete event (the reqtrace phase
+    segments: their start is a stamp taken earlier, not a span entry on
+    this thread). Full mode only; buffered/sunk like span exits."""
+    if _STATE.mode < 2:
+        return
+    ev = {"name": name, "ph": "X", "ts": int(ts_us), "dur": int(dur_us),
+          "pid": _rank(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = dict(args)
+    _finish_event(ev)
 
 
 def chrome_events():

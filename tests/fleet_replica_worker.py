@@ -80,6 +80,7 @@ def main():
     if rank == 0:
         eng = LLMEngine(model, _ecfg())
         sent_pages = 0
+        trace_ids = []
         for p in prompts:
             r = eng.add_request(p, prefill_only=True)
             _drain(eng)
@@ -87,9 +88,13 @@ def main():
             kv_transfer.send_kv_payload(payload, dst=1,
                                         timeout_ms=300_000)
             sent_pages += payload.num_pages
+            # the trace identity that must survive the wire (and the
+            # injected sock.send fault's resend) intact
+            trace_ids.append(payload.trace["trace_id"])
         out = {"sent_pages": sent_pages,
                "send_retries": int(xproc.stats["send_retries"]),
-               "generated_on_prefill_tier": eng.stats["generated"]}
+               "generated_on_prefill_tier": eng.stats["generated"],
+               "trace_ids": trace_ids}
     else:
         # local single-engine reference
         ref_eng = LLMEngine(model, _ecfg())
@@ -100,9 +105,12 @@ def main():
 
         # disaggregated decode from the streamed pages
         dec = LLMEngine(model, _ecfg())
-        outs = []
+        outs, recv_trace_ids, transfer_stamped = [], [], True
         for p in prompts:
             payload = kv_transfer.recv_kv_payload(0, timeout_ms=300_000)
+            recv_trace_ids.append(payload.trace["trace_id"])
+            transfer_stamped = (transfer_stamped and
+                                "kv_transfer" in payload.trace["phases"])
             r = dec.import_kv_pages(payload, max_new_tokens=MAX_NEW)
             _drain(dec)
             outs.append(r.future.result(timeout=0))
@@ -128,6 +136,8 @@ def main():
         out = {
             "disagg_match": bool(disagg_match),
             "kv_pages_imported": dec.stats.get("kv_pages_imported", 0),
+            "recv_trace_ids": recv_trace_ids,
+            "transfer_stamped": bool(transfer_stamped),
             "router_match": all(np.array_equal(a, b)
                                 for a, b in zip(ref, r_outs)),
             "replicas_lost": m["replicas_lost"],

@@ -602,7 +602,13 @@ def test_fleet_replica_2proc_kv_stream_chaos(tmp_path):
            "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
            os.path.join(ROOT, "tests", "fleet_replica_worker.py"),
            str(tmp_path)]
-    r = subprocess.run(cmd, env=_env({chaos.ENV_PLAN: plan}), cwd=ROOT,
+    r = subprocess.run(cmd, env=_env({chaos.ENV_PLAN: plan,
+                                      # ISSUE-15: full tracing + flight
+                                      # postmortems land in tmp
+                                      "PT_TELEMETRY": "1",
+                                      "PT_TELEMETRY_DIR": str(tmp_path),
+                                      "PT_FLIGHT_DIR": str(tmp_path)}),
+                       cwd=ROOT,
                        capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
     with open(tmp_path / "fleet_out_0.json") as f:
@@ -617,6 +623,13 @@ def test_fleet_replica_2proc_kv_stream_chaos(tmp_path):
     # the seeded replica kill requeued mid-stream work, outputs intact
     assert out1["router_match"] is True
     assert out1["replicas_lost"] == 1
+    # the trace identity crossed the xproc KV stream intact — same
+    # ids, same order, UNDER the injected sock.send fault (the resend
+    # must carry the identical frame) — and the receiving side stamped
+    # the transfer leg onto each restored trace
+    assert out1["recv_trace_ids"] == out0["trace_ids"]
+    assert len(set(out0["trace_ids"])) == len(out0["trace_ids"]) > 0
+    assert out1["transfer_stamped"] is True
     # both injections journaled per rank
     for rank, scope in ((0, "sock.send"), (1, "replica.kill.a")):
         journal = tmp_path / "log" / f"anomalies.rank{rank}.jsonl"
@@ -624,3 +637,31 @@ def test_fleet_replica_2proc_kv_stream_chaos(tmp_path):
                   for line in journal.read_text().splitlines()]
         assert any(e["kind"] == "chaos_injected"
                    and e.get("scope") == scope for e in events), scope
+    # the flight recorder's postmortem for the seeded replica kill:
+    # names the dead replica, lists the requeued requests with trace
+    # ids, and its ring holds those requests' phase/span events
+    deaths = sorted(
+        tmp_path.glob("postmortem.rank1.*.replica_death.json"))
+    assert deaths, list(tmp_path.iterdir())
+    with open(deaths[0]) as f:
+        post = json.load(f)
+    assert post["reason"] == "replica_death"
+    assert post["context"]["replica"] == "a"
+    requeued = post["context"]["requeued"]
+    assert requeued and out1["requeues"] >= len(requeued) > 0
+    victim_traces = {v["trace_id"] for v in requeued}
+
+    def _ev_trace(e):
+        if e.get("trace_id"):
+            return {e["trace_id"]}
+        span = e.get("span") or {}
+        t = (span.get("args") or {}).get("trace_id")
+        return {t} if t else set()
+
+    ring_traces = set()
+    for e in post["events"]:
+        ring_traces |= _ev_trace(e)
+    assert victim_traces & ring_traces, (victim_traces, ring_traces)
+    # the chaos kill ALSO dumped from the dying serve thread itself
+    assert sorted(
+        tmp_path.glob("postmortem.rank1.*.chaos_replica_kill.json"))

@@ -196,6 +196,41 @@ def test_prometheus_and_jsonl_exports_parse():
         assert rec["metric"] and rec["type"]
 
 
+def test_histogram_percentile_summaries_in_exporters():
+    """Satellite (ISSUE 15): p50/p95/p99 ship in the snapshot/compact
+    dicts AND as summary-style quantile series in the Prometheus text,
+    so consumers stop re-deriving percentiles from bucket counts."""
+    reg = _reg()
+    h = reg.histogram("q_h", "a hist", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    series = reg.snapshot()["q_h"]["series"][0]
+    assert {"p50", "p95", "p99"} <= set(series)
+    assert 0.1 <= series["p50"] <= 1.0
+    compact = reg.compact()["q_h"]
+    assert {"count", "sum", "p50", "p95", "p99"} <= set(compact)
+    text = reg.to_prometheus()
+    for q in ("0.5", "0.95", "0.99"):
+        # a SEPARATE `_quantile` gauge family — quantile samples under
+        # the bare name inside a histogram family split the family in
+        # spec parsers
+        assert f'q_h_quantile{{quantile="{q}"}}' in text, text
+    assert "# TYPE q_h_quantile gauge" in text
+    # the scrape must stay parseable by the reference parser when the
+    # library is available (the format-violation regression fence)
+    try:
+        from prometheus_client.parser import text_string_to_metric_families
+    except ImportError:
+        pass
+    else:
+        fams = {f.name: f.type
+                for f in text_string_to_metric_families(text)}
+        assert fams.get("q_h") == "histogram", fams
+    # the one-call view metrics() consumers use
+    s = h.summary()
+    assert s["count"] == 4 and {"p50", "p95", "p99"} <= set(s)
+
+
 # ---------------------------------------------------------------- tracing
 
 def test_span_nesting_and_chrome_roundtrip(mode, tmp_path):

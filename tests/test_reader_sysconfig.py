@@ -174,3 +174,24 @@ def test_coverage_citations_resolve():
         # audited on a machine where the tree is not mounted
         pytest.skip(f"external citation roots not mounted: "
                     f"{sorted(unverifiable)}")
+
+
+def test_metric_catalogue_in_sync():
+    """Every pt_* metric registered under paddle_tpu/ has a catalogue
+    entry in docs/OBSERVABILITY.md and no entry points at a metric that
+    no longer exists (tools/audit_metrics.py — the telemetry sibling of
+    the citation audit above; the catalogue drifted from code for three
+    PRs before this gate)."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "audit_metrics", os.path.join(root, "tools", "audit_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    missing, dead = mod.audit()
+    assert missing == {}, f"uncatalogued metrics: {missing}"
+    assert dead == [], f"dead catalogue rows: {dead}"
+    # the audit itself sees a sane tree (empty sets would also 'pass')
+    assert len(mod.emitted_metrics()) > 40

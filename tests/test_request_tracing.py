@@ -1,0 +1,506 @@
+"""Fleet-wide request tracing (ISSUE 15): cross-process trace
+propagation, TTFT decomposition, and the failure flight recorder.
+
+The acceptance suite for the observability plane: TraceContext stamp /
+wire-form semantics, engine phase timelines whose segments sum exactly
+to the wall-clock TTFT, the in-process disaggregated router run whose
+spans all carry ONE trace_id (prefill replica, wire hand-off, decode
+replica) and merge into one chrome timeline, the flight recorder's
+ring + postmortem (a seeded replica kill names the dead member and the
+requeued requests, with their phase events in the ring), and the
+per-replica telemetry export fix (two threaded replicas, two files).
+The 2-proc xproc side of trace propagation rides the existing launch
+test in test_fleet_router.py.
+"""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos
+from paddle_tpu.inference.fleet_serving import (AutoscalePolicy,
+                                                FleetRouter,
+                                                LocalReplica, fork_model)
+from paddle_tpu.inference.llm_engine import LLMEngine, LLMEngineConfig
+from paddle_tpu.observability import flight_recorder, reqtrace, tracing
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = [pytest.mark.observability, pytest.mark.serving]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # module-scoped fixtures build before the autouse mesh reset runs
+    # for the first test — reset here too (the test_fleet_router.py
+    # mixed-placement lesson)
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=4, page_size=16, token_budget=32,
+                max_model_len=96)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _drain(eng, cap=800):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+        assert steps < cap
+    return steps
+
+
+def _phases(ctx):
+    return [s["phase"] for s in ctx.timeline()]
+
+
+# --------------------------------------------------------------------
+# TraceContext semantics
+# --------------------------------------------------------------------
+
+def test_trace_context_stamps_first_wins_and_sum():
+    ctx = reqtrace.new_trace()
+    t0 = time.time()
+    ctx.stamp("queued", t0)
+    ctx.stamp("routed", t0 + 0.010)
+    # first-wins: a replay cannot rewrite the timeline
+    assert ctx.stamp("routed", t0 + 99.0) is False
+    ctx.stamp("first_token", t0 + 0.050)
+    tl = ctx.timeline()
+    assert [s["phase"] for s in tl] == ["queued", "routed",
+                                       "first_token"]
+    # segments sum EXACTLY to the total (one monotone chain)
+    assert sum(s["dt_s"] for s in tl) == pytest.approx(ctx.total_s())
+    assert ctx.total_s() == pytest.approx(0.050, abs=1e-6)
+
+
+def test_trace_context_wire_roundtrip_resumes_chain():
+    ctx = reqtrace.new_trace()
+    t0 = time.time()
+    ctx.stamp("queued", t0)
+    ctx.stamp("kv_export", t0 + 0.020)
+    # the wire form crosses a process boundary and keeps accumulating
+    restored = reqtrace.TraceContext.from_dict(ctx.to_dict())
+    assert restored.trace_id == ctx.trace_id
+    restored.stamp("kv_transfer", t0 + 0.030)
+    tl = restored.timeline()
+    assert [s["phase"] for s in tl] == ["queued", "kv_export",
+                                       "kv_transfer"]
+    # the resumed segment measures from the exporter's LAST stamp
+    assert tl[-1]["dt_s"] == pytest.approx(0.010, abs=1e-6)
+
+
+def test_phase_histogram_observes_segments():
+    before = reqtrace._PHASE_SECONDS.labels(phase="routed").count
+    ctx = reqtrace.new_trace()
+    t0 = time.time()
+    ctx.stamp("queued", t0)
+    ctx.stamp("routed", t0 + 0.001)
+    assert reqtrace._PHASE_SECONDS.labels(
+        phase="routed").count == before + 1
+    assert "routed" in reqtrace.phase_summary()
+
+
+# --------------------------------------------------------------------
+# Engine-side timelines
+# --------------------------------------------------------------------
+
+def test_engine_request_phases_sum_to_ttft(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(0)
+    eng = LLMEngine(model, _ecfg())
+    req = eng.add_request(
+        rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32),
+        max_new_tokens=6)
+    _drain(eng)
+    ph = _phases(req.trace)
+    assert ph == ["queued", "prefill_start", "prefill_end",
+                  "first_decode_dispatch", "first_token"]
+    # the decomposition accounts for the WHOLE latency: segments sum to
+    # the wall-clock queued -> first_token interval exactly
+    tl = req.trace.timeline()
+    assert sum(s["dt_s"] for s in tl) == pytest.approx(
+        req.trace.total_s(), abs=1e-6)
+    m = eng.metrics()
+    assert any(t["trace_id"] == req.trace.trace_id
+               for t in m["recent_requests"])
+    assert "first_token" in m["request_phase_seconds"]
+    # histogram summaries carry p95 now (satellite: percentile export)
+    assert {"p50", "p95", "p99"} <= set(
+        m["request_phase_seconds"]["first_token"])
+
+
+def test_disagg_import_continues_the_prefill_trace(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(1)
+    eng = LLMEngine(model, _ecfg())
+    pr = eng.add_request(
+        rng.integers(0, cfg.vocab_size, (33,)).astype(np.int32),
+        prefill_only=True)
+    _drain(eng)
+    payload = pr.future.result(timeout=0)
+    assert payload.trace["trace_id"] == pr.trace.trace_id
+    ir = eng.import_kv_pages(payload, max_new_tokens=4)
+    _drain(eng)
+    ir.future.result(timeout=0)
+    # SAME trace across the hand-off; the import stamped its phases on
+    assert ir.trace.trace_id == pr.trace.trace_id
+    assert _phases(ir.trace) == [
+        "queued", "prefill_start", "prefill_end", "kv_export",
+        "kv_import", "first_decode_dispatch", "first_token"]
+
+
+def test_submit_imported_continues_wire_trace(tiny_model):
+    """Review regression: the cross-process decode half goes
+    recv_and_decode -> submit_imported -> LLMServer.submit with NO
+    explicit trace — the server ingress must continue the payload's
+    wire-carried trace (and its quiet flag) instead of minting a
+    fresh id, or the merged timeline shows every disaggregated
+    request dying at kv_transfer."""
+    from paddle_tpu.inference.fleet_serving import (pack_kv_payload,
+                                                    unpack_kv_payload)
+
+    cfg, model = tiny_model
+    rng = np.random.default_rng(2)
+    eng = LLMEngine(model, _ecfg())
+    pr = eng.add_request(
+        rng.integers(0, cfg.vocab_size, (36,)).astype(np.int32),
+        prefill_only=True)
+    _drain(eng)
+    # simulate the xproc hop: pack -> unpack -> restore (what
+    # recv_kv_payload does)
+    payload = unpack_kv_payload(pack_kv_payload(
+        pr.future.result(timeout=0)))
+    assert payload.trace["trace_id"] == pr.trace.trace_id
+    ctx = reqtrace.TraceContext.from_dict(payload.trace)
+    ctx.stamp("kv_transfer")
+    payload.trace_ctx = ctx
+    rep = LocalReplica(fork_model(model), name="wirecont",
+                       config=_ecfg())
+    try:
+        fut = rep.submit_imported(payload, max_new_tokens=4)
+        fut.result(timeout=60)
+        req = fut.pt_request
+        assert req.trace.trace_id == pr.trace.trace_id
+        assert {"kv_export", "kv_transfer", "kv_import",
+                "first_token"} <= set(req.trace.phases)
+    finally:
+        rep.stop()
+    # the quiet flag survives the wire round trip too
+    q = reqtrace.quiet_trace()
+    q.stamp("queued")
+    assert reqtrace.TraceContext.from_dict(q.to_dict()).quiet is True
+
+
+# --------------------------------------------------------------------
+# The acceptance run: disaggregated request, one merged timeline
+# --------------------------------------------------------------------
+
+def test_disagg_router_single_trace_merged_timeline(tiny_model,
+                                                    tmp_path,
+                                                    monkeypatch):
+    """Prefill on replica A, decode on replica B, KV over the payload
+    hand-off: ONE trace_id covers queue -> route -> prefill ->
+    transfer -> decode -> first_token; the phases sum to within 10% of
+    the router-observed TTFT; the flushed span file merges into one
+    chrome timeline whose per-replica lanes carry the chain."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+    # full mode auto-exports (replica stop) go to tmp, not ./telemetry
+    monkeypatch.setenv("PT_TELEMETRY_DIR", str(tmp_path))
+    prev = obs.set_mode("full")
+    tracing.reset()
+    try:
+        router = FleetRouter(
+            replicas=[LocalReplica(fork_model(model), name="dec",
+                                   config=_ecfg())],
+            prefill_replicas=[LocalReplica(fork_model(model),
+                                           name="pre", role="prefill",
+                                           config=_ecfg())],
+            prefill_min_tokens=32,
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=1))
+        with router:
+            t_submit = time.time()
+            fut = router.submit(
+                rng.integers(0, cfg.vocab_size, (48,)).astype(np.int32),
+                max_new_tokens=6)
+            fut.result(timeout=120)
+            m = router.metrics()
+        req = fut.pt_request
+        ctx = req.trace
+        assert _phases(ctx) == [
+            "queued", "routed", "prefill_start", "prefill_end",
+            "kv_export", "kv_transfer", "kv_import",
+            "first_decode_dispatch", "first_token"]
+        assert m["disagg_handoffs"] == 1
+        # the acceptance bar: the phases sum to within 10% of the TTFT
+        # this test OBSERVED client-side (submit call -> the request's
+        # first-token wall stamp). The router's histogram view must be
+        # populated too (bucket-interpolated, so not the 10% anchor).
+        phase_sum = sum(s["dt_s"] for s in ctx.timeline())
+        observed = ctx.phases["first_token"] - t_submit
+        assert observed > 0
+        assert abs(phase_sum - observed) <= 0.10 * observed + 0.02, (
+            phase_sum, observed)
+        assert m["ttft_p50_s"] is not None
+        # fleet-wide view: one deduped timeline for the request
+        mine = [tl for tl in m["recent_requests"]
+                if tl["trace_id"] == ctx.trace_id]
+        assert len(mine) == 1 and len(mine[0]["phases"]) == 9
+        # every phase event in the span buffer carries the ONE id, and
+        # both replica lanes contributed spans
+        evs = [e for e in obs.chrome_events()
+               if e.get("args", {}).get("trace_id") == ctx.trace_id]
+        names = {e["name"] for e in evs}
+        assert {"phase.routed", "phase.kv_export", "phase.kv_transfer",
+                "phase.kv_import", "phase.first_token"} <= names
+        lanes = {e.get("replica") for e in obs.chrome_events()}
+        assert {"pre", "dec"} <= lanes
+        # ... and trace_merge folds the flushed file into ONE timeline
+        # for that id, replica lanes included
+        path = tracing.flush(str(tmp_path))
+        assert path
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_merge", os.path.join(ROOT, "tools",
+                                        "trace_merge.py"))
+        tm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tm)
+        merged = tm.merge([path], trace_id=ctx.trace_id)
+        data = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["name"] for e in data} >= {"phase.kv_transfer",
+                                             "phase.first_token"}
+        lane_names = {e["args"]["name"]
+                      for e in merged["traceEvents"]
+                      if e.get("ph") == "M"}
+        assert any("pre" in n for n in lane_names)
+        assert any("dec" in n for n in lane_names)
+        # the chain is causal: events ordered queue -> ... -> token
+        by_name = {e["name"]: e["ts"] for e in data}
+        assert (by_name["phase.routed"] <= by_name["phase.kv_export"]
+                <= by_name["phase.kv_transfer"]
+                <= by_name["phase.first_token"])
+    finally:
+        obs.set_mode(prev)
+        tracing.reset()
+
+
+# --------------------------------------------------------------------
+# Flight recorder
+# --------------------------------------------------------------------
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = flight_recorder.FlightRecorder(capacity=4)
+    for i in range(7):
+        rec.record("tick", i=i)
+    evs = rec.events()
+    assert len(evs) == 4 and evs[0]["i"] == 3     # bounded, oldest out
+    rec.add_state_provider("ok", lambda: {"a": 1})
+    rec.add_state_provider("boom", lambda: 1 / 0)
+    path = rec.dump("manual", directory=str(tmp_path), note="n")
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        post = json.load(f)
+    assert post["reason"] == "manual"
+    assert post["context"]["note"] == "n"
+    assert post["states"]["ok"] == {"a": 1}
+    assert "error" in post["states"]["boom"]      # guarded provider
+    assert [e["i"] for e in post["events"]] == [3, 4, 5, 6]
+    assert isinstance(post["metrics"], dict)
+
+
+def test_divergence_rollback_dumps_postmortem(tmp_path, monkeypatch):
+    """The PR-14 rollback path dumps a postmortem before restoring
+    (regression: a kwarg collision made the guarded dump silently
+    no-op — the counter pins it actually firing now)."""
+    from paddle_tpu.distributed.fleet.elastic import (
+        run_with_fault_tolerance)
+    from paddle_tpu.distributed.resilience import DivergenceRollback
+
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+
+    class FakeCkpt:
+        def load_latest(self):
+            return 0
+
+        def wait(self):
+            pass
+
+    calls = {"n": 0}
+
+    def train_fn(start):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise DivergenceRollback("nan at 3", step=3, reason="nan",
+                                     value=float("nan"))
+        return 7
+
+    before = flight_recorder._DUMPS_TOTAL.labels(
+        reason="divergence_rollback").value
+    assert run_with_fault_tolerance(train_fn, FakeCkpt()) == 7
+    assert flight_recorder._DUMPS_TOTAL.labels(
+        reason="divergence_rollback").value == before + 1
+    dumps = sorted(glob.glob(str(
+        tmp_path / "postmortem.rank0.*.divergence_rollback.json")))
+    assert dumps
+    with open(dumps[0]) as f:
+        post = json.load(f)
+    assert post["context"]["step"] == 3
+    assert post["context"]["rollback_reason"] == "nan"
+
+
+def test_journal_events_reach_the_ring():
+    from paddle_tpu.distributed import resilience
+
+    marker = f"fr_test_{os.getpid()}_{time.monotonic_ns()}"
+    resilience.record("fr_probe", marker=marker)
+    assert any(e.get("entry", {}).get("marker") == marker
+               for e in flight_recorder.recorder().events("journal"))
+
+
+def test_replica_kill_postmortem_names_dead_and_requeued(
+        tiny_model, tmp_path, monkeypatch):
+    """Seeded chaos kill mid-stream: the router requeues the victims
+    (outputs stay correct — pinned elsewhere) and the postmortem file
+    names the dead replica AND the requeued requests, whose phase
+    events sit in the dumped ring."""
+    cfg, model = tiny_model
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(L),)).astype(
+        np.int32) for L in rng.integers(24, 60, 6)]
+    chaos.install({"seed": 9, "injectors": [
+        {"scope": "replica.kill.victim", "kind": "error", "at": [4]}]})
+    try:
+        router = FleetRouter(
+            replicas=[LocalReplica(fork_model(model), name="victim",
+                                   config=_ecfg()),
+                      LocalReplica(fork_model(model), name="other",
+                                   config=_ecfg())],
+            policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                   heartbeat_timeout_s=0.5,
+                                   poll_s=0.01))
+        with router:
+            futs = [router.submit(p, max_new_tokens=8)
+                    for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            m = router.metrics()
+    finally:
+        chaos.clear()
+    assert len(outs) == len(prompts)
+    assert m["replicas_lost"] == 1 and m["requeues"] > 0
+    deaths = sorted(glob.glob(
+        str(tmp_path / "postmortem.rank0.*.replica_death.json")))
+    assert deaths, os.listdir(tmp_path)
+    with open(deaths[0]) as f:
+        post = json.load(f)
+    assert post["context"]["replica"] == "victim"
+    requeued = post["context"]["requeued"]
+    assert requeued
+    victim_traces = {v["trace_id"] for v in requeued}
+    ring_traces = {e.get("trace_id") for e in post["events"]
+                   if e.get("kind") == "request_phase"}
+    assert victim_traces & ring_traces
+    # the dying serve thread dumped its own postmortem too
+    assert glob.glob(str(
+        tmp_path / "postmortem.rank0.*.chaos_replica_kill.json"))
+    # the router's dump-time state provider was unregistered at stop
+    assert not any(
+        k.startswith("router:") for k in
+        flight_recorder.recorder()._providers)
+
+
+# --------------------------------------------------------------------
+# Per-replica telemetry export (satellite: the overwrite fix)
+# --------------------------------------------------------------------
+
+def test_per_replica_export_two_replicas_two_files(tiny_model,
+                                                   tmp_path):
+    cfg, model = tiny_model
+    reps = [LocalReplica(fork_model(model), name=n, config=_ecfg())
+            for n in ("expA", "expB")]
+    try:
+        for r in reps:
+            r.submit(np.arange(4, dtype=np.int32),
+                     max_new_tokens=2).result(timeout=60)
+    finally:
+        for r in reps:
+            r.stop()
+    paths = [r.export_telemetry(str(tmp_path)) for r in reps]
+    assert all(p is not None for p in paths)
+    assert len(set(paths)) == 2           # the overwrite bug: 1 file
+    for r, p in zip(reps, paths):
+        assert f".{r.name}.json" in os.path.basename(p)
+        with open(p) as f:
+            data = json.load(f)
+        assert data["replica"] == r.name
+        assert data["view"]["replica"]["name"] == r.name
+
+
+def test_warmup_requests_stay_out_of_phase_telemetry(tiny_model):
+    """Review regression: a replica's constructor warm-up (whose
+    prefill segment IS the executable compile, seconds long) must not
+    enter pt_request_phase_seconds or recent_requests — it would
+    report the compile stall as serving latency (quiet traces)."""
+    cfg, model = tiny_model
+    cell = reqtrace._PHASE_SECONDS.labels(phase="prefill_end")
+    before = cell.count
+    rep = LocalReplica(fork_model(model), name="warmq", config=_ecfg())
+    try:
+        assert rep.engine.metrics()["recent_requests"] == []
+        assert cell.count == before
+        # a REAL request still records its timeline
+        rep.submit(np.arange(6, dtype=np.int32),
+                   max_new_tokens=2).result(timeout=60)
+        assert cell.count == before + 1
+        assert len(rep.engine.metrics()["recent_requests"]) == 1
+    finally:
+        rep.stop()
+
+
+def test_replica_gauges_removed_on_stop(tiny_model):
+    cfg, model = tiny_model
+    rep = LocalReplica(fork_model(model), name="gaugeX",
+                       config=_ecfg())
+    rep.submit(np.arange(4, dtype=np.int32),
+               max_new_tokens=2).result(timeout=60)
+    from paddle_tpu.inference.fleet_serving.replica import (
+        _REPLICA_OCC, _REPLICA_QUEUE)
+
+    assert ("gaugeX",) in dict(_REPLICA_QUEUE._series())
+    rep.stop()
+    assert ("gaugeX",) not in dict(_REPLICA_QUEUE._series())
+    assert ("gaugeX",) not in dict(_REPLICA_OCC._series())

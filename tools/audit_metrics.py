@@ -1,0 +1,103 @@
+"""Audit the metric catalogue: code and docs/OBSERVABILITY.md in sync.
+
+Every ``pt_*`` metric registered anywhere under ``paddle_tpu/`` must
+have a catalogue entry in docs/OBSERVABILITY.md, and every ``pt_*``
+name the catalogue mentions must still exist in code — the catalogue
+is the operator-facing contract, and it has historically drifted one
+PR at a time (a renamed gauge keeps its stale row; a new counter ships
+rowless). Mirrors tools/audit_coverage.py (the citation audit this
+runs next to, in tests/test_reader_sysconfig.py).
+
+Code side: AST walk of every .py under paddle_tpu/ for calls to
+``counter`` / ``gauge`` / ``histogram`` (bare or attribute form —
+``_obs.counter``, ``registry.histogram``, ...) whose first argument is
+a string literal starting with ``pt_``. Dynamically-composed names are
+invisible to this audit by design — name metrics with literals.
+
+Doc side: every ``pt_[a-z0-9_]+`` token inside backticks.
+
+Run: python tools/audit_metrics.py   (also a tier-1 test)
+"""
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "paddle_tpu")
+CATALOGUE = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+_DOC_NAME = re.compile(r"`[^`\n]*`")
+# boundary-guarded: `ckpt_overlap_ab` must not read as pt_overlap_ab
+_PT_NAME = re.compile(r"(?<![A-Za-z0-9_])pt_[a-z0-9_]+")
+
+
+def emitted_metrics(pkg_dir=PKG):
+    """{metric name: first defining file (repo-relative)}."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = (node.func.id if isinstance(node.func, ast.Name)
+                         else node.func.attr
+                         if isinstance(node.func, ast.Attribute)
+                         else None)
+                if fname not in _FACTORIES:
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("pt_")):
+                    out.setdefault(arg.value,
+                                   os.path.relpath(path, ROOT))
+    return out
+
+
+def catalogued_metrics(md_path=CATALOGUE):
+    """pt_* names mentioned (in backticks) by the catalogue doc."""
+    with open(md_path) as f:
+        text = f.read()
+    names = set()
+    for seg in _DOC_NAME.findall(text):
+        names.update(_PT_NAME.findall(seg))
+    return names
+
+
+def audit():
+    """(missing_rows, dead_rows): emitted-but-uncatalogued names (with
+    their defining file) and catalogued-but-never-emitted names."""
+    emitted = emitted_metrics()
+    catalogued = catalogued_metrics()
+    missing = {n: f for n, f in sorted(emitted.items())
+               if n not in catalogued}
+    dead = sorted(catalogued - set(emitted))
+    return missing, dead
+
+
+def main():
+    missing, dead = audit()
+    for name, where in missing.items():
+        print(f"MISSING ROW {name} (registered in {where})")
+    for name in dead:
+        print(f"DEAD ROW    {name} (catalogued but never registered)")
+    if missing or dead:
+        print(f"metric catalogue out of sync: {len(missing)} missing, "
+              f"{len(dead)} dead — edit docs/OBSERVABILITY.md")
+        return 1
+    print("metric catalogue OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

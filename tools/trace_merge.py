@@ -12,10 +12,17 @@ chrome://tracing and https://ui.perfetto.dev viewers load directly:
     python tools/trace_merge.py run1/trace.rank0.jsonl run2/*.jsonl
 
 Each rank becomes one "process" lane (pid = rank, named via metadata
-events); threads keep their tids. Timestamps are re-based to the
-earliest event so the viewer opens at t=0. Malformed lines are counted
-and skipped (a crashed rank's torn last line must not hide the rest of
-the run). Stdlib only.
+events); threads keep their tids. Events carrying a ``replica`` field
+(spans emitted by a fleet replica's serve thread — several replicas
+share one rank/process) get their OWN lane per (rank, replica), so a
+disaggregated request reads router -> prefill replica -> wire ->
+decode replica top-to-bottom. ``--trace <trace_id>`` keeps only the
+events of ONE request (span args carry ``trace_id`` — the
+observability.reqtrace identity), which is the "debugging a slow
+request" workflow in docs/OBSERVABILITY.md. Timestamps are re-based to
+the earliest event so the viewer opens at t=0. Malformed lines are
+counted and skipped (a crashed rank's torn last line must not hide the
+rest of the run). Stdlib only.
 """
 import argparse
 import glob
@@ -55,20 +62,43 @@ def collect(paths):
     return events, bad
 
 
-def merge(paths):
-    """chrome trace dict from per-rank JSONL paths."""
+def merge(paths, trace_id=None):
+    """chrome trace dict from per-rank JSONL paths. `trace_id` keeps
+    only the events whose span args carry that request identity."""
     events, bad = collect(paths)
+    if trace_id is not None:
+        events = [e for e in events
+                  if e.get("args", {}).get("trace_id") == trace_id]
     if events:
         t0 = min(e["ts"] for e in events)
         for e in events:
             e["ts"] -= t0
     events.sort(key=lambda e: (e["ts"], e.get("dur", 0)))
-    pids = sorted({e["pid"] for e in events})
+    # lane assignment: rank lanes keep pid = rank; a replica's events
+    # (several threaded replicas share one rank) move to a synthetic
+    # pid per (rank, replica) so each member is its own swimlane. The
+    # replica name moves into args (chrome has no top-level field).
+    base = max((e["pid"] for e in events), default=0) + 1
+    lanes = {}
+    for e in events:
+        rep = e.pop("replica", None)
+        if rep is None:
+            lanes.setdefault((e["pid"], None), e["pid"])
+            continue
+        key = (e["pid"], rep)
+        if key not in lanes:
+            lanes[key] = base + len([k for k in lanes if k[1]])
+        e.setdefault("args", {})["replica"] = rep
+        e["pid"] = lanes[key]
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "args": {"name": f"rank {pid}"}} for pid in pids]
+             "args": {"name": f"rank {rank}" if rep is None
+                      else f"rank {rank} · {rep}"}}
+            for (rank, rep), pid in sorted(lanes.items(),
+                                           key=lambda kv: kv[1])]
     return {"traceEvents": meta + events,
             "displayTimeUnit": "ms",
             "otherData": {"skipped_lines": bad,
+                          "trace_id": trace_id,
                           "source_files": [os.path.basename(p)
                                            for p in paths]}}
 
@@ -97,12 +127,15 @@ def main(argv=None):
                     help="telemetry dir(s) or trace*.jsonl file(s)")
     ap.add_argument("-o", "--output", default="trace.json",
                     help="merged chrome trace path (default trace.json)")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="keep only one request's events (the reqtrace "
+                         "trace_id its spans carry)")
     args = ap.parse_args(argv)
     paths = expand(args.inputs)
     if not paths:
         print("no trace files found", file=sys.stderr)
         return 1
-    trace = merge(paths)
+    trace = merge(paths, trace_id=args.trace)
     with open(args.output, "w") as f:
         json.dump(trace, f)
     n = len(trace["traceEvents"])
