@@ -1606,6 +1606,177 @@ def bench_llm_fleet_multi():
     return result
 
 
+def bench_overload_storm_ab():
+    """Overload-control-plane A/B (ISSUE-16 acceptance): the SAME
+    seeded Poisson storm at ~2.5x fleet capacity, with one replica
+    running SLOW under a seeded chaos delay, served twice — overload
+    plane OFF (every arrival admitted, latency unbounded) and ON
+    (per-request deadlines, brownout ladder, hedging). Headline:
+    admitted-TTFT p99 on vs off — the plane must buy bounded latency
+    for what it admits — plus the shed rate that bound costs and the
+    per-level brownout dwell. Each side builds fresh forked replicas
+    and warms outside the timed window; both sides replay the SAME
+    arrival sleeps (cut from the off side's measured warm capacity),
+    so the comparison never measures two different storms. Guarded
+    stamps: an overload-introspection failure can't kill the
+    headline."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.distributed import chaos
+    from paddle_tpu.inference.fleet_serving import (AutoscalePolicy,
+                                                    FleetRouter,
+                                                    LocalReplica,
+                                                    OverloadPolicy,
+                                                    RequestCancelled,
+                                                    RequestShed,
+                                                    fork_model)
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
+
+    paddle.seed(0)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        cfg, n_req, name = gpt_tiny(), 40, "gpt-tiny-overload-storm"
+    else:
+        cfg, n_req, name = gpt_small(), 64, "gpt-small-overload-storm"
+    base = GPTForCausalLM(cfg)
+    base.eval()
+    rng = np.random.default_rng(25)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(L),)).astype(
+        np.int32) for L in rng.integers(8, 24, n_req)]
+    gen = 12
+    burst = 12      # opening burst deeper than the fleet's 8 slots
+    ecfg_kw = dict(num_slots=4, page_size=16, token_budget=48,
+                   max_model_len=128)
+
+    def pctl(vals, p):
+        vals = [v for v in vals if v is not None]
+        return float(np.percentile(np.asarray(vals), p)) if vals else -1.0
+
+    state = {"sleeps": None, "deadline_s": None}
+
+    def run_side(tag, overload, with_deadlines):
+        """One storm pass; returns (outcome lists, ttfts, totals,
+        router introspection). The slow replica is `<tag>a` — the
+        chaos scope is per-name, so each side gets its own injector
+        against an identically-shaped plan."""
+        chaos.install({"seed": 17, "injectors": [
+            {"scope": f"replica.kill.{tag}a", "kind": "delay",
+             "p": 0.35, "delay_s": 0.05}]})
+        router = FleetRouter(
+            replicas=[LocalReplica(
+                fork_model(base), name=f"{tag}{s}",
+                config=inference.LLMEngineConfig(**ecfg_kw))
+                for s in ("a", "b")],
+            policy=AutoscalePolicy(min_replicas=2, max_replicas=2,
+                                   heartbeat_timeout_s=60.0,
+                                   poll_s=0.02),
+            overload=overload)
+        try:
+            with router:
+                # unloaded warm-up: compile + TTFT baseline + capacity
+                tw = time.monotonic()
+                for p in prompts[:4]:
+                    router.submit(p, max_new_tokens=gen).result(
+                        timeout=600)
+                warm_elapsed = max(time.monotonic() - tw, 1e-3)
+                if state["sleeps"] is None:
+                    rate = 4.0 / warm_elapsed
+                    state["sleeps"] = [min(float(rng.exponential(
+                        1.0 / (2.5 * rate))), 0.05)
+                        for _ in range(n_req)]
+                    state["deadline_s"] = max(
+                        2.0 * router.ttft_quantile(0.99), 1.0)
+                t_sub, t_done, futs = [], {}, []
+                t0 = time.perf_counter()
+                for i, p in enumerate(prompts):
+                    if i >= burst:
+                        time.sleep(state["sleeps"][i])
+                    kw = ({"deadline_s": state["deadline_s"]}
+                          if with_deadlines else {})
+                    t_sub.append(time.perf_counter())
+                    f = router.submit(p, max_new_tokens=gen, **kw)
+                    f.add_done_callback(
+                        lambda _f, i=i: t_done.setdefault(
+                            i, time.perf_counter()))
+                    futs.append(f)
+                done, shed, cancelled, reasons = [], [], [], {}
+                for i, f in enumerate(futs):
+                    try:
+                        f.result(timeout=600)
+                        done.append(i)
+                    except RequestShed as e:
+                        shed.append(i)
+                        reasons[e.reason] = reasons.get(e.reason, 0) + 1
+                    except RequestCancelled as e:
+                        cancelled.append(i)
+                        reasons["cancelled:" + e.reason] = reasons.get(
+                            "cancelled:" + e.reason, 0) + 1
+                total = time.perf_counter() - t0
+                ttfts = []
+                for i in done:
+                    req = getattr(futs[i], "pt_request", None)
+                    t = getattr(req, "t_first_token", None)
+                    ttfts.append(t - t_sub[i] if t is not None
+                                 else t_done[i] - t_sub[i])
+                # let the ladder drain back to L0 before teardown so
+                # dwell() prices the WHOLE episode, recovery included
+                if overload is not None:
+                    cool = time.monotonic() + 20
+                    while (router.stats.get("brownout_level", 0) != 0
+                           and time.monotonic() < cool):
+                        time.sleep(0.05)
+                dwell = (list(router._brownout_ctl.dwell())
+                         if overload is not None else None)
+                ov = router.metrics() if overload is not None else None
+        finally:
+            chaos.clear()
+        return done, shed, cancelled, reasons, ttfts, total, dwell, ov
+
+    off = run_side("off", None, with_deadlines=False)
+    log(f"[bench] overload_storm off: {len(off[0])} done in "
+        f"{off[5]:.2f}s, ttft p99 {pctl(off[4], 99) * 1e3:.0f}ms")
+    on = run_side("on", OverloadPolicy(
+        brownout_high=0.5, brownout_low=0.1, brownout_step_ticks=2,
+        brownout_recover_ticks=4, hedge_after_s=2.0, hedge_stale_s=1.0,
+        max_parked=64), with_deadlines=True)
+    o_done, o_shed, o_cancel, o_reasons, o_ttft, o_total, dwell, ov = on
+    shed_rate = (len(o_shed) + len(o_cancel)) / float(n_req)
+    log(f"[bench] overload_storm on: {len(o_done)} done, "
+        f"{len(o_shed)} shed, {len(o_cancel)} cancelled "
+        f"({shed_rate:.0%}), ttft p99 {pctl(o_ttft, 99) * 1e3:.0f}ms "
+        f"in {o_total:.2f}s")
+    result = {
+        "model": name, "requests": n_req, "gen_tokens_each": gen,
+        "storm_x_capacity": 2.5, "burst": burst,
+        "deadline_s": round(state["deadline_s"], 3),
+        "admitted_ttft_p99_ms": {"off": round(pctl(off[4], 99) * 1e3, 1),
+                                 "on": round(pctl(o_ttft, 99) * 1e3, 1)},
+        "admitted_ttft_p50_ms": {"off": round(pctl(off[4], 50) * 1e3, 1),
+                                 "on": round(pctl(o_ttft, 50) * 1e3, 1)},
+        "outcomes_on": {"done": len(o_done), "shed": len(o_shed),
+                        "cancelled": len(o_cancel)},
+        "shed_rate": round(shed_rate, 4),
+        "shed_reasons": o_reasons,
+        "totals_s": {"off": round(off[5], 2), "on": round(o_total, 2)},
+    }
+    # guarded: brownout dwell per level + control-plane introspection
+    try:
+        result["brownout_dwell_s"] = {
+            f"L{lv}": round(d, 3) for lv, d in enumerate(dwell)}
+        result["brownout_max_level"] = max(
+            [0] + [lv for lv, d in enumerate(dwell) if d > 0])
+        if ov is not None:
+            result["breaker_state"] = ov["overload"]["breaker"]["state"]
+            result["hedges"] = ov.get("hedges", 0)
+        log(f"[bench] overload_storm dwell: "
+            f"{result['brownout_dwell_s']}")
+    except Exception as e:
+        log(f"[bench] overload_storm dwell stamp failed: {e!r}")
+        result["brownout_dwell_s"] = {"error": repr(e)}
+    return result
+
+
 def bench_tracing_overhead_ab():
     """Full-mode tracing overhead A/B (ISSUE-15 satellite): the SAME
     Poisson llm_serve-shaped workload served once per telemetry mode —
@@ -1938,6 +2109,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "llm_serve_int8": bench_llm_serve_int8,
             "llm_fleet": bench_llm_fleet,
             "llm_fleet_multi": bench_llm_fleet_multi,
+            "overload_storm_ab": bench_overload_storm_ab,
             "tracing_overhead_ab": bench_tracing_overhead_ab,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
@@ -2173,11 +2345,12 @@ def main():
         # traffic — llm_serve's small-batch A/B is the fused-decode
         # acceptance regime, ISSUE 8)
         extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
-                  "tracing_overhead_ab", "train_3d")
+                  "overload_storm_ab", "tracing_overhead_ab", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
-                  "llm_fleet_multi", "tracing_overhead_ab", "train_3d")
+                  "llm_fleet_multi", "overload_storm_ab",
+                  "tracing_overhead_ab", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both
@@ -2185,7 +2358,8 @@ def main():
         # wider cap than the single-model arms
         status, res = _run_worker(
             which,
-            timeout_s=900 if which.startswith(("llm_", "tracing_"))
+            timeout_s=900 if which.startswith(("llm_", "tracing_",
+                                               "overload_"))
             else 420,
             extra_env=fallback_env)
         if status == "ok":

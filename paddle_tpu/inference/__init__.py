@@ -29,6 +29,7 @@ __all__ = [
     "fleet_serving", "RadixPrefixCache", "SLAPolicy", "SLAScheduler",
     "Priority", "SpeculativeDecoder", "FleetRouter", "AutoscalePolicy",
     "LocalReplica", "ReplicaRegistry", "KVPagePayload",
+    "OverloadPolicy", "RequestShed", "RequestCancelled",
 ]
 
 from .serving import BatchingConfig, InferenceServer  # noqa: E402,F401
@@ -38,8 +39,8 @@ from .speculative import SpeculativeDecoder  # noqa: E402,F401
 from . import fleet_serving  # noqa: E402,F401
 from .fleet_serving import (  # noqa: E402,F401
     AutoscalePolicy, FleetRouter, KVPagePayload, LocalReplica,
-    Priority, RadixPrefixCache, ReplicaRegistry, SLAPolicy,
-    SLAScheduler)
+    OverloadPolicy, Priority, RadixPrefixCache, ReplicaRegistry,
+    RequestCancelled, RequestShed, SLAPolicy, SLAScheduler)
 
 
 class DataType:

@@ -50,6 +50,10 @@ Docs: docs/SERVING.md. Bench: `python bench.py --worker llm_fleet`
 from .kv_transfer import (KVPagePayload, pack_kv_payload,
                           recv_kv_payload, send_kv_payload,
                           unpack_kv_payload)
+from .overload import (DEFAULT_BROWNOUT_LEVELS, BrownoutController,
+                       CircuitBreaker, OverloadPolicy, RequestCancelled,
+                       RequestShed, TTFTEstimator, note_cancelled,
+                       note_hedge, note_shed)
 from .prefix_cache import RadixPrefixCache
 from .replica import (LocalReplica, ReplicaRegistry, fork_model,
                       recv_and_decode, stream_prefill)
@@ -61,4 +65,8 @@ __all__ = ["RadixPrefixCache", "Priority", "SLAPolicy", "SLAScheduler",
            "send_kv_payload", "recv_kv_payload",
            "LocalReplica", "ReplicaRegistry", "fork_model",
            "stream_prefill", "recv_and_decode",
-           "AutoscalePolicy", "FleetRouter"]
+           "AutoscalePolicy", "FleetRouter",
+           "OverloadPolicy", "RequestShed", "RequestCancelled",
+           "TTFTEstimator", "CircuitBreaker", "BrownoutController",
+           "DEFAULT_BROWNOUT_LEVELS", "note_shed", "note_cancelled",
+           "note_hedge"]

@@ -303,6 +303,17 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
         return self._server.submit(payload.tokens, kv_import=payload,
                                    **kw)
 
+    def abort(self, request_id, reason="client", counted=False):
+        """Cancel one in-flight request on this replica's engine
+        (cancellation propagation — the overload control plane's
+        router `cancel` lands here). Rides the server queue; a
+        stopped/killed replica swallows it: the request dies with the
+        replica anyway and the router owns the client future."""
+        try:
+            self._server.abort(request_id, reason=reason, counted=counted)
+        except RuntimeError:
+            pass   # server not started / already stopped
+
     # ---- liveness / load ----
 
     @property

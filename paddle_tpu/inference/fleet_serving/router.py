@@ -52,7 +52,11 @@ import numpy as np
 from ...observability import flight_recorder as _flight
 from ...observability import metrics as _obs
 from ...observability import reqtrace as _reqtrace
+from .overload import (BrownoutController, CircuitBreaker, OverloadPolicy,
+                       RequestCancelled, RequestShed, TTFTEstimator,
+                       note_cancelled, note_hedge, note_shed)
 from .replica import LocalReplica, ReplicaRegistry
+from .scheduler import Priority
 
 __all__ = ["AutoscalePolicy", "FleetRouter"]
 
@@ -132,6 +136,10 @@ class _RoutedRequest:
         self.affinity_hit = False
         self.resolved = False      # exactly-one-outcome gate (lock-held)
         self.t_submit = time.perf_counter()
+        # overload control plane (fleet_serving.overload)
+        self.deadline_t = None     # absolute perf_counter hard deadline
+        self.hedges = 0            # hedged re-dispatches taken
+        self._prefill_t0 = None    # hand-off latency (breaker window)
 
 
 class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica callbacks)
@@ -152,8 +160,13 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
     def __init__(self, replicas=None, factory=None, policy=None,
                  hash_block_tokens=16, max_affinity_blocks=8,
                  prefill_replicas=None, prefill_min_tokens=None,
-                 registry=None):
+                 registry=None, overload=None):
         self.policy = policy or AutoscalePolicy()
+        # overload control plane (fleet_serving.overload; docs/SERVING
+        # "Overload and degradation") — defaults are inert where
+        # behaviour would change: brownout/hedging opt-in, generous
+        # parking bound, failure-count-only breaker
+        self.overload = overload or OverloadPolicy()
         self.registry = registry if registry is not None else \
             ReplicaRegistry(timeout_s=self.policy.heartbeat_timeout_s)
         self._factory = factory
@@ -179,7 +192,19 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         self._pressure_ticks = 0
         self.stats = {"requests": 0, "affinity_hits": 0, "requeues": 0,
                       "scale_ups": 0, "scale_downs": 0,
-                      "disagg_handoffs": 0, "replicas_lost": 0}
+                      "disagg_handoffs": 0, "replicas_lost": 0,
+                      "shed": 0, "cancelled": 0, "hedges": 0,
+                      "brownout_level": 0}
+        pol = self.overload
+        self._estimator = TTFTEstimator()
+        self._breaker = CircuitBreaker(
+            window=pol.breaker_window,
+            failure_rate=pol.breaker_failure_rate,
+            latency_s=pol.breaker_latency_s,
+            min_events=pol.breaker_min_events,
+            reset_s=pol.breaker_reset_s)
+        self._brownout_ctl = BrownoutController(
+            pol, apply_fn=self._apply_brownout)
         for r in (replicas or ()):
             self._adopt(r)
         for r in (prefill_replicas or ()):
@@ -192,6 +217,13 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             else:
                 self._replicas[replica.name] = replica
                 self._affinity.setdefault(replica.name, {})
+        if self._brownout_ctl.level:
+            # a member joining mid-brownout (scale-up, recovery) must
+            # degrade like the rest of the fleet
+            try:
+                replica.engine.apply_brownout(self._brownout_ctl.caps())
+            except Exception:
+                pass
         if replica._registry is not self.registry:
             # one membership view: the router's failover watches ITS
             # registry, so members must beat into it
@@ -259,7 +291,12 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                **kw):
         """Route one prompt; returns the client Future (tokens). The
-        kwargs surface is `LLMServer.submit`'s."""
+        kwargs surface is `LLMServer.submit`'s, plus the overload
+        knobs: `deadline_s` is a HARD deadline — a request whose
+        deadline is provably unmeetable sheds at submit with a typed
+        `RequestShed` (retry_after_s hint attached), one that expires
+        mid-flight cancels with `RequestCancelled`. The returned
+        future carries `pt_rid`, the handle `cancel(pt_rid)` takes."""
         from concurrent.futures import Future
 
         if not self._running:
@@ -268,19 +305,148 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         # a caller-minted trace (a gateway in front of this router)
         # must not collide with the per-replica submit's own trace kwarg
         trace = kw.pop("trace", None)
+        deadline_s = kw.pop("deadline_s", None)
         rr = _RoutedRequest(
             prompt, dict(max_new_tokens=int(max_new_tokens),
                          eos_token_id=eos_token_id, **kw), Future(),
             trace=trace)
+        rr.future.pt_rid = rr.rid     # the cancel() handle
+        if deadline_s is not None:
+            rr.deadline_t = rr.t_submit + float(deadline_s)
         with self._lock:
             self._inflight[rr.rid] = rr
             self.stats["requests"] += 1
         _ROUTER_REQS.inc()
-        self._dispatch(rr)
+        self._estimator.note_prompt(len(prompt))
+        self._admission_control(rr, deadline_s)
+        if not rr.future.done():
+            self._dispatch(rr)
         return rr.future
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
         return self.submit(prompt, max_new_tokens, eos_token_id).result()
+
+    def cancel(self, request_id, reason="client"):
+        """Cancel one in-flight request (`fut.pt_rid` is the handle).
+        The abort propagates across every tier the request currently
+        touches — router bookkeeping, the replica engine serving it
+        (slot, pool pages, trie pins), and any KV payload parked
+        between the prefill and decode stages — and the client future
+        resolves with `RequestCancelled`. Returns False when the id is
+        unknown or already resolved (result delivery won the race)."""
+        with self._lock:
+            rr = self._inflight.get(int(request_id))
+            if rr is None or rr.resolved:
+                return False
+            rr.resolved = True       # exactly-one-outcome gate
+            self._inflight.pop(rr.rid, None)
+            self.stats["cancelled"] += 1
+            rep = (self._replicas.get(rr.replica)
+                   or self._prefill.get(rr.replica))
+        note_cancelled(reason)
+        rr.trace.stamp("cancelled")
+        _flight.record_event("request_cancelled", rid=rr.rid,
+                             trace_id=rr.trace.trace_id, reason=reason,
+                             stage=rr.stage, replica=rr.replica)
+        rr.payload = None            # KV parked between stages: dropped
+        internal = rr.internal
+        if internal is not None:
+            req = getattr(internal, "pt_request", None)
+            if req is not None and rep is not None:
+                # already ingested by the replica engine: evict there
+                # (counted here — the engine must not double-count)
+                rep.abort(req.rid, reason=reason, counted=True)
+            else:
+                # still in the server queue: the ingest loop skips
+                # cancelled futures without touching the engine
+                internal.cancel()
+        if not rr.future.done():
+            rr.future.set_exception(RequestCancelled(
+                reason=reason, trace_id=rr.trace.trace_id))
+        return True
+
+    # ---- admission control (fleet_serving.overload) ----
+
+    def _shed_key(self, rr):
+        """Shed order under pressure: LOWEST priority class first, then
+        LATEST deadline (no deadline = infinitely patient = first to
+        go), then newest. max() of this key picks the victim."""
+        pri = rr.kwargs.get("priority")
+        pri = int(Priority.STANDARD if pri is None else pri)
+        dl = (float("inf") if rr.deadline_t is None
+              else rr.deadline_t)
+        return (pri, dl, rr.rid)
+
+    def _shed(self, rr, reason, retry_after_s=None):
+        """Typed admission refusal: pop from inflight, count, flight-
+        record, resolve the client future with RequestShed. Respects
+        the exactly-one-outcome gate; returns False when rr already
+        resolved."""
+        with self._lock:
+            if rr.resolved:
+                return False
+            rr.resolved = True
+            self._inflight.pop(rr.rid, None)
+            self.stats["shed"] += 1
+        note_shed(reason)
+        _flight.record_event("request_shed", rid=rr.rid,
+                             trace_id=rr.trace.trace_id, reason=reason,
+                             retry_after_s=retry_after_s)
+        if not rr.future.done():
+            rr.future.set_exception(RequestShed(
+                reason, retry_after_s=retry_after_s,
+                trace_id=rr.trace.trace_id))
+        return True
+
+    def _queued_tokens(self):
+        """Work ahead of a new arrival, in tokens (queue depths ×
+        the EMA prompt length) — the TTFT lower bound's numerator."""
+        depth = sum(r.queue_depth() for r in self._alive_replicas())
+        with self._lock:
+            depth += sum(rr.stage == "parked"
+                         for rr in self._inflight.values())
+        return depth * self._estimator.avg_prompt_tokens()
+
+    def _admission_control(self, rr, deadline_s):
+        """Reject-early checks at submit (docs/SERVING.md "Overload
+        and degradation"): expired deadline, provably-unmeetable
+        deadline (optimistic TTFT lower bound from live telemetry vs
+        the deadline), brownout best-effort-class shed, and the
+        max_inflight capacity bound (worst parked victim — or the
+        newcomer — sheds)."""
+        pol = self.overload
+        if deadline_s is not None:
+            ds = float(deadline_s)
+            if ds <= 0.0:
+                self._shed(rr, "deadline")
+                return
+            lb = self._estimator.lower_bound_ttft(
+                self._queued_tokens() + len(rr.prompt))
+            if lb > ds:
+                # provable: even at the best service rate ever
+                # observed the first token lands after the deadline
+                self._shed(rr, "deadline_unmeetable",
+                           retry_after_s=round(lb - ds, 3))
+                return
+        sp = self._brownout_ctl.shed_priority()
+        if sp is not None:
+            pri = rr.kwargs.get("priority")
+            pri = int(Priority.STANDARD if pri is None else pri)
+            if pri >= int(sp):
+                self._shed(rr, "brownout", retry_after_s=max(
+                    0.05, round(self._estimator.lower_bound_ttft(
+                        self._queued_tokens()), 3)))
+                return
+        if pol.max_inflight is not None:
+            with self._lock:
+                over = len(self._inflight) > pol.max_inflight
+                cands = ([x for x in self._inflight.values()
+                          if x.stage == "parked"] + [rr]) if over else ()
+            if over:
+                victim = max(cands, key=self._shed_key)
+                self._shed(victim, "capacity", retry_after_s=max(
+                    0.05, round(self._estimator.lower_bound_ttft(
+                        self._queued_tokens()), 3)))
 
     # ---- routing ----
 
@@ -345,10 +511,22 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             return None
         return min(alive, key=lambda r: r.load())
 
+    def _deadlined(self, kwargs, rr):
+        """Per-dispatch submit kwargs: the REMAINING deadline rides to
+        the replica engine (which expires it mid-flight) — remaining,
+        not absolute, so a requeued attempt keeps the original
+        contract. kwargs is copied; rr.kwargs stays pristine for
+        re-dispatch."""
+        kw = dict(kwargs)
+        if rr.deadline_t is not None:
+            kw["deadline_s"] = rr.deadline_t - time.perf_counter()
+        return kw
+
     def _dispatch(self, rr, exclude=()):
         """Place `rr` on a replica (possibly via the prefill stage).
-        Called at submit, at stage hand-off, and at failover requeue —
-        always with rr NOT currently bound to a live internal future."""
+        Called at submit, at stage hand-off, at failover requeue, and
+        at hedged re-dispatch — a superseded internal future's outcome
+        is suppressed by the stale-attempt checks."""
         if rr.future.done():
             return
         disagg = (self.prefill_min_tokens is not None
@@ -356,22 +534,28 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                   and len(rr.prompt) >= self.prefill_min_tokens)
         if disagg:
             pre = self._pick_prefill(exclude)
-            if pre is not None:
-                rr.stage, rr.replica = "prefill", pre.name
-                rr.trace.stamp("routed")
-                rr.internal = pre.submit_prefill(
-                    rr.prompt, trace=rr.trace,
-                    **{k: rr.kwargs[k] for k in
-                       ("tenant", "priority", "ttft_slo_s")
-                       if k in rr.kwargs})
-                rr.internal.add_done_callback(
-                    lambda f, rr=rr: self._on_prefill_done(rr, f))
+            if pre is None:
+                rr.no_disagg = True  # no live prefill: serve whole
+            elif self._breaker.allow() and self._dispatch_prefill(rr, pre):
+                # breaker open ≠ no_disagg: the tier is SICK, not
+                # absent — a later (requeued) dispatch may retry it
+                # once the breaker half-opens
                 return
-            rr.no_disagg = True  # no live prefill replica: serve whole
         rep, matched = self._pick(rr.prompt, exclude)
         if rep is None:
             # no live replica AT ALL: park it — the monitor requeues
-            # once the factory (or a recovering heartbeat) restores one
+            # once the factory (or a recovering heartbeat) restores
+            # one. The parking queue is BOUNDED: past max_parked the
+            # worst-placed request (shed order) gets a typed shed
+            # instead of unbounded growth.
+            with self._lock:
+                parked = [x for x in self._inflight.values()
+                          if x.stage == "parked" and x is not rr]
+            if len(parked) >= self.overload.max_parked:
+                victim = max(parked + [rr], key=self._shed_key)
+                self._shed(victim, "no_capacity")
+                if victim is rr:
+                    return
             rr.stage, rr.replica, rr.internal = "parked", None, None
             return
         if matched and rr.requeues == 0 and rr.payload is None:
@@ -386,13 +570,36 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             with self._lock:
                 self.stats["disagg_handoffs"] += 1
             payload, rr.payload = rr.payload, None  # consumed
-            rr.internal = rep.submit_imported(payload, trace=rr.trace,
-                                              **rr.kwargs)
+            rr.internal = rep.submit_imported(
+                payload, trace=rr.trace, **self._deadlined(rr.kwargs, rr))
         else:
-            rr.internal = rep.submit(rr.prompt, trace=rr.trace,
-                                     **rr.kwargs)
+            rr.internal = rep.submit(
+                rr.prompt, trace=rr.trace,
+                **self._deadlined(rr.kwargs, rr))
         rr.internal.add_done_callback(
             lambda f, rr=rr: self._on_decode_done(rr, f))
+
+    def _dispatch_prefill(self, rr, pre):
+        """Bind rr to the prefill tier; False when the submit itself
+        fails (a stopping replica) — counted against the breaker, and
+        the caller falls through to whole-request serving."""
+        rr.stage, rr.replica = "prefill", pre.name
+        rr.trace.stamp("routed")
+        rr._prefill_t0 = time.monotonic()
+        try:
+            rr.internal = pre.submit_prefill(
+                rr.prompt, trace=rr.trace,
+                **self._deadlined(
+                    {k: rr.kwargs[k] for k in
+                     ("tenant", "priority", "ttft_slo_s")
+                     if k in rr.kwargs}, rr))
+        except Exception:
+            self._breaker.record_failure()
+            rr.stage, rr.replica = None, None
+            return False
+        rr.internal.add_done_callback(
+            lambda f, rr=rr: self._on_prefill_done(rr, f))
+        return True
 
     def _on_prefill_done(self, rr, fut):
         if rr.future.done() or fut is not rr.internal:
@@ -400,13 +607,30 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             # another replica — the live attempt owns the hand-off
             return
         err = fut.exception()
+        if isinstance(err, (RequestCancelled, RequestShed)):
+            # the ENGINE cancelled/shed this very request (deadline
+            # expiry, brownout class): that is the request's typed
+            # outcome, not tier sickness — propagate, don't fall back
+            # and don't count against the breaker
+            with self._lock:
+                if rr.resolved:
+                    return
+                rr.resolved = True
+                self._inflight.pop(rr.rid, None)
+            if not rr.future.done():
+                rr.future.set_exception(err)
+            return
         if err is not None:
             # prefill failed (bad request / replica abort): fall back
             # to serving the whole request on a decode replica — only a
             # request the DECODE side also rejects errors the client
+            self._breaker.record_failure()
             rr.no_disagg = True
             self._dispatch(rr)
             return
+        self._breaker.record_success(
+            0.0 if rr._prefill_t0 is None
+            else time.monotonic() - rr._prefill_t0)
         rr.payload = fut.result()
         rr.trace.stamp("kv_transfer")   # the in-process hand-off moment
         self._dispatch(rr)
@@ -468,6 +692,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                 self._note_monitor_error(e)
             try:
                 self._autoscale_tick()
+            except Exception as e:
+                self._note_monitor_error(e)
+            try:
+                self._overload_tick()
             except Exception as e:
                 self._note_monitor_error(e)
             now = time.monotonic()
@@ -641,6 +869,97 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             return any(rr.replica == name
                        for rr in self._inflight.values())
 
+    # ---- overload tick (fleet_serving.overload) ----
+
+    def _apply_brownout(self, level, caps):
+        """BrownoutController apply_fn: push the level's caps to every
+        member engine (serve AND prefill tier — the prefill engines
+        honour shed_priority/deadline the same way)."""
+        with self._lock:
+            reps = (list(self._replicas.values())
+                    + list(self._prefill.values()))
+            self.stats["brownout_level"] = level
+        for r in reps:
+            try:
+                r.engine.apply_brownout(caps)
+            except Exception as e:
+                self._note_monitor_error(e)
+
+    def _overload_tick(self):
+        """One monitor pass of the overload control plane: feed the
+        admission estimator (fleet service rate), feed the brownout
+        controller (pressure = queue depth per alive replica), expire
+        deadlines the engines cannot see (parked / between-stages
+        requests — plus a grace-lagged sweep behind a wedged engine),
+        and hedge requests stuck behind a replica that stopped ticking
+        (BEFORE failover's heartbeat timeout would fire)."""
+        pol = self.overload
+        now_m = time.monotonic()
+        alive = self._alive_replicas()
+        with self._lock:
+            pre_alive = [p for p in self._prefill.values() if p.alive]
+        # service-rate sample: cumulative tokens_in across the fleet —
+        # the estimator keeps the PEAK inter-tick rate and discards
+        # negative deltas (a member died/re-warmed out of the sum)
+        try:
+            tokens = sum(int(r.engine.stats.get("tokens_in", 0))
+                         for r in alive + pre_alive)
+            self._estimator.note_progress(tokens, now_m)
+        except Exception:
+            pass
+        # brownout pressure
+        if alive:
+            with self._lock:
+                parked = sum(rr.stage == "parked"
+                             for rr in self._inflight.values())
+            depth = sum(r.queue_depth() for r in alive) + parked
+            self._brownout_ctl.note_pressure(depth / len(alive), now_m)
+        # deadline sweep: the engines expire their own requests, but a
+        # PARKED request has no engine, and a request on a WEDGED
+        # engine never reaches the expiry scan — sweep those here
+        # (grace-lagged for dispatched stages so a healthy engine's
+        # own cancel, with its fuller timeline, wins the race)
+        now_p = time.perf_counter()
+        with self._lock:
+            expired = [rr for rr in self._inflight.values()
+                       if rr.deadline_t is not None and not rr.resolved
+                       and now_p > rr.deadline_t
+                       + (0.0 if rr.stage == "parked" else 0.25)]
+        for rr in expired:
+            self.cancel(rr.rid, reason="deadline")
+        # hedged re-dispatch
+        if pol.hedge_after_s is None:
+            return
+        stale_s = (pol.hedge_stale_s if pol.hedge_stale_s is not None
+                   else 0.25 * self.policy.heartbeat_timeout_s)
+        with self._lock:
+            cands = [rr for rr in self._inflight.values()
+                     if rr.stage in ("prefill", "decode")
+                     and not rr.resolved and rr.hedges == 0
+                     and rr.internal is not None
+                     and not rr.internal.done()
+                     and now_p - rr.t_submit >= pol.hedge_after_s]
+            reps = dict(self._replicas)
+            reps.update(self._prefill)
+        for rr in cands:
+            rep = reps.get(rr.replica)
+            if rep is None or not rep.running:
+                continue    # dead member: failover owns the requeue
+            if now_m - rep.last_tick < stale_s:
+                continue    # still making progress: not wedged
+            if not self._alive_replicas(exclude={rr.replica}):
+                continue    # nowhere to hedge to
+            rr.hedges += 1
+            with self._lock:
+                self.stats["hedges"] += 1
+            note_hedge()
+            _flight.record_event(
+                "request_hedged", rid=rr.rid,
+                trace_id=rr.trace.trace_id, was_on=rr.replica,
+                tick_age_s=round(now_m - rep.last_tick, 3))
+            rr.payload = None   # a stale stage hand-off is not reusable
+            self._dispatch(rr, exclude={rr.replica})
+
     def _scale_up(self):
         name = f"replica{next(_scale_names)}"
         rep = self._factory(name)
@@ -728,6 +1047,11 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             "recent_requests": recent[-128:],
             "replica_ages": self.registry.ages(),
             "replicas": replicas,
+            "overload": {
+                "breaker": self._breaker.snapshot(),
+                "brownout": self._brownout_ctl.snapshot(),
+                "estimator": self._estimator.snapshot(),
+            },
         })
         return snap
 

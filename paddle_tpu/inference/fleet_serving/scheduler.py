@@ -173,6 +173,25 @@ class SLAScheduler:  # ptlint: thread-shared (scraped by /metrics)
             dq = self._q[key] = collections.deque()
         return dq
 
+    def remove(self, req):
+        """Remove ONE specific waiting request (single-request abort /
+        deadline expiry). Returns False when it is not queued here —
+        already admitted to a slot, or already finished."""
+        key = (int(req.priority), req.tenant)
+        dq = self._q.get(key)
+        if dq is None:
+            return False
+        try:
+            dq.remove(req)
+        except ValueError:
+            return False
+        self._n -= 1
+        if not dq:
+            del self._q[key]        # same key hygiene as pop_next
+        if self._counts_slo(req):
+            self._n_slo -= 1
+        return True
+
     def drain(self):
         """Pop every waiting request (abort path)."""
         out = []

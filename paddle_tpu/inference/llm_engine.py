@@ -80,7 +80,9 @@ import jax.numpy as jnp
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _reqtrace
 from ..observability.tracing import trace_span as _trace_span
-from .fleet_serving import Priority, RadixPrefixCache, SLAScheduler
+from .fleet_serving import (Priority, RadixPrefixCache, RequestCancelled,
+                            RequestShed, SLAScheduler, note_cancelled,
+                            note_shed)
 from .serving import _FutureQueueServer
 
 __all__ = ["PagePool", "PoolExhausted", "LLMEngineConfig", "LLMEngine",
@@ -593,6 +595,8 @@ class _Request:
         self.t_submit = _time.perf_counter()
         self.t_first_admit = None
         self.t_first_token = None
+        # hard deadline (absolute perf_counter; overload control plane)
+        self.deadline_t = None
         # request-scoped trace identity + TTFT phase stamps
         # (observability.reqtrace; assigned by add_request)
         self.trace = None
@@ -739,6 +743,12 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         # recent per-request phase timelines (reqtrace), appended at
         # first token / prefill export — the `metrics()` drill-down
         self._timelines = collections.deque(maxlen=64)
+        # overload control plane (fleet_serving.overload): the brownout
+        # caps dict is REPLACED whole by apply_brownout (GIL-atomic) and
+        # read at host decision points only — never inside a trace
+        self._brownout = {}
+        self._spec_stash = None    # spec decoder parked by brownout L2
+        self._deadlines_armed = False  # any deadline request ever seen
         # speculative decoding (draft_model configured): draft pools
         # mirror this pool's page ids, the big model verifies k+1
         # ragged positions per slot in one dispatch — the spec window
@@ -765,7 +775,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     future=None, tenant="default", priority=None,
                     ttft_slo_s=None, temperature=0.0, top_p=1.0,
-                    prefill_only=False, kv_import=None, trace=None):
+                    prefill_only=False, kv_import=None, trace=None,
+                    deadline_s=None):
         """Enqueue one request. The disaggregated-serving knobs
         (docs/SERVING.md "Disaggregated fleet"):
 
@@ -810,6 +821,25 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             trace = _payload_trace(kv_import)
         req.trace = trace if trace is not None else _reqtrace.new_trace()
         req.trace.stamp("queued")   # no-op when the ingress stamped it
+        # overload control plane (docs/SERVING.md "Overload and
+        # degradation"): brownout ingress caps + the hard deadline. A
+        # shed RESOLVES the future typed (never raises out of here —
+        # the server loop and direct drivers share one contract).
+        caps = self._brownout
+        sp = caps.get("shed_priority")
+        if sp is not None and req.priority >= int(sp):
+            return self._shed_at_admit(req, "brownout")
+        if not prefill_only:
+            cap = caps.get("max_new_cap")
+            if cap is not None:
+                req.target = min(req.target,
+                                 req.prompt_len + max(1, int(cap)))
+        if deadline_s is not None:
+            ds = float(deadline_s)
+            if ds <= 0.0:   # expired before admission: reject at submit
+                return self._shed_at_admit(req, "deadline")
+            req.deadline_t = req.t_submit + ds
+            self._deadlines_armed = True
         if kv_import is not None:
             self._check_import(req, kv_import)
             req._kv_import = kv_import
@@ -1231,6 +1261,138 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         _LIVE_SLOTS.set(0)
         _SLOT_OCC.set(0.0)
 
+    def abort(self, request_id, reason="client", exc=None,
+              counted=False):
+        """Evict ONE request (client cancel / deadline expiry) wherever
+        it lives. A slot occupant releases through `_release` — pool
+        pages decref (shared trie pages keep the trie's own reference;
+        the request's pins go), the page-table row zeroes, and its
+        draft-pool rows need no touch (keyed by slot, overwritten by
+        the next occupant's catch-up). A queued request leaves the
+        scheduler with exact class/SLO bookkeeping (`sched.remove`).
+        The future resolves with `exc` (default: RequestCancelled)
+        unless already done. Returns False when the id is unknown —
+        already finished — and touches nothing. Co-resident requests
+        are unperturbed: no pool re-zero, no reseed, no executable
+        churn (contrast `abort_all`). `counted=True` means the caller
+        (the router's `cancel`) already counted this cancellation —
+        pt_requests_cancelled_total stays exact, one per request."""
+        rid = int(request_id)
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.rid == rid:
+                self._release(slot, req)
+                self._resolve_cancel(req, reason, exc, counted=counted)
+                live = sum(r is not None for r in self._slots)
+                _LIVE_SLOTS.set(live)
+                _SLOT_OCC.set(live / self.num_slots if self.num_slots
+                              else 0.0)
+                return True
+        for req in list(self.sched):
+            if req.rid == rid:
+                if not self.sched.remove(req):
+                    return False
+                self._resolve_cancel(req, reason, exc, counted=counted)
+                _QUEUE_DEPTH.set(len(self.sched))
+                return True
+        return False
+
+    def _resolve_cancel(self, req, reason, exc=None, counted=False):
+        """Shared tail of every cancellation path: count, stamp the
+        phase timeline, flight-record (trace_id rides the event), and
+        resolve the client future typed."""
+        if not counted:
+            note_cancelled(reason)
+        req.trace.stamp("cancelled")
+        self._note_timeline(req)
+        try:
+            from ..observability import flight_recorder as _fr
+
+            _fr.record_event("request_cancelled", rid=req.rid,
+                             trace_id=req.trace.trace_id, reason=reason)
+        except Exception:
+            pass
+        if not req.future.done():
+            req.future.set_exception(
+                exc if exc is not None
+                else RequestCancelled(reason=reason,
+                                      trace_id=req.trace.trace_id))
+
+    def _shed_at_admit(self, req, reason):
+        """Typed admission refusal (add_request): the future RESOLVES
+        with RequestShed — no fleet work was consumed, nothing to
+        release. Returns the request (add_request's contract)."""
+        note_shed(reason)
+        try:
+            from ..observability import flight_recorder as _fr
+
+            _fr.record_event("request_shed", rid=req.rid,
+                             trace_id=req.trace.trace_id, reason=reason)
+        except Exception:
+            pass
+        if not req.future.done():
+            req.future.set_exception(
+                RequestShed(reason, trace_id=req.trace.trace_id))
+        return req
+
+    def _expire_deadlines(self):
+        """Cancel every live/queued request whose hard deadline passed
+        (top of step(), armed only once a deadline request exists)."""
+        now = _time.perf_counter()
+        hit = False
+        for slot, req in enumerate(self._slots):
+            if (req is not None and req.deadline_t is not None
+                    and now > req.deadline_t):
+                self._release(slot, req)
+                self._resolve_cancel(req, "deadline")
+                hit = True
+        stale = [r for r in self.sched
+                 if r.deadline_t is not None and now > r.deadline_t]
+        for req in stale:
+            if self.sched.remove(req):
+                self._resolve_cancel(req, "deadline")
+                hit = True
+        if hit:
+            live = sum(r is not None for r in self._slots)
+            _LIVE_SLOTS.set(live)
+            _SLOT_OCC.set(live / self.num_slots if self.num_slots
+                          else 0.0)
+            _QUEUE_DEPTH.set(len(self.sched))
+
+    # ---- brownout (fleet_serving.overload) ----
+
+    def apply_brownout(self, caps):
+        """Install the fleet's brownout caps (BrownoutController
+        apply_fn; {} = full service). Runs on the router monitor
+        thread: the dict is replaced WHOLE (GIL-atomic) and read at
+        host decision points only (admission caps, window clamps); the
+        spec park/restore transition runs on the engine thread at the
+        top of step() (`_sync_brownout`) — the draft pytree is only
+        ever touched by the thread that dispatches on it."""
+        self._brownout = dict(caps)
+
+    def _sync_brownout(self):
+        """Engine-thread half of the ladder's L2: park the speculative
+        decoder and RELEASE its draft pool (the HBM returns to the
+        fleet now, not at the next GC), or restore it — `reset_pools`
+        rebuilds zeroed pools and the slots' draft_prefilled reset
+        makes the next window's catch-up replay the draft KV."""
+        caps = self._brownout
+        enabled = caps.get("spec_enabled", True)
+        if self._spec is not None and enabled is False:
+            self._spec_stash, self._spec = self._spec, None
+            self._spec_stash.release_pools()
+            _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(
+                self.pool_bytes())
+        elif (self._spec is None and self._spec_stash is not None
+                and enabled):
+            self._spec, self._spec_stash = self._spec_stash, None
+            self._spec.reset_pools()
+            for r in self._slots:
+                if r is not None:
+                    r.draft_prefilled = 0   # draft pool is cold: replay
+            _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(
+                self.pool_bytes())
+
     def close(self):
         """Retire the engine: drop the prefix trie (its clear()
         publishes the NEGATIVE resident-pages delta, so a process that
@@ -1577,6 +1739,9 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         The straggler joins windows at the boundary after its prefill
         completes, and per-request greedy/sampled outputs are
         schedule-invariant, so nothing observable changes per request."""
+        self._sync_brownout()
+        if self._deadlines_armed:
+            self._expire_deadlines()
         self._admit()
         if self._spec is not None or self.decode_k > 1:
             active = self._active()
@@ -1634,7 +1799,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         avail = self.pool.num_free
         if self.prefix_cache is not None:
             avail += self.prefix_cache.reclaimable_pages()
-        w = k
+        # brownout window cap: a smaller w rides the `rem` runtime
+        # argument of the SAME k-scan executable — degrading the window
+        # never recompiles (overload.BrownoutController L3)
+        cap = self._brownout.get("decode_k_cap")
+        w = k if cap is None else max(1, min(k, int(cap)))
         while w > 1 and pages_needed(w) > avail:
             w -= 1        # spill: the largest window the pool covers
         if pages_needed(w) > avail:
@@ -2011,7 +2180,7 @@ class LLMServer(_FutureQueueServer):
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                tenant="default", priority=None, ttft_slo_s=None,
                temperature=0.0, top_p=1.0, prefill_only=False,
-               kv_import=None, trace=None):
+               kv_import=None, trace=None, deadline_s=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
         after it) — or, with `prefill_only=True`, to the exported
@@ -2052,14 +2221,41 @@ class LLMServer(_FutureQueueServer):
             priority=priority, ttft_slo_s=ttft_slo_s,
             temperature=float(temperature), top_p=float(top_p),
             prefill_only=bool(prefill_only), kv_import=kv_import,
-            trace=trace))
+            trace=trace, deadline_s=deadline_s))
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
         return self.submit(prompt, max_new_tokens, eos_token_id).result()
 
+    def abort(self, request_id, reason="client", counted=False):
+        """Cancel ONE in-flight request by its engine rid (overload
+        control plane; docs/SERVING.md "Overload and degradation").
+        The abort rides the SAME queue as submissions, so the engine
+        thread applies it between steps — no cross-thread engine
+        access. Unknown/finished rids are a no-op on the engine; the
+        caller (router `cancel`) owns the client-future resolution
+        (and, with `counted=True`, the cancellation count)."""
+        self._enqueue({"_abort": int(request_id),
+                       "_abort_reason": str(reason),
+                       "_abort_counted": bool(counted)})
+
     def _ingest(self, payload):
+        if "_abort" in payload:   # control message, not a submission
+            try:
+                self._engine.abort(payload["_abort"],
+                                   reason=payload.get("_abort_reason",
+                                                      "client"),
+                                   counted=payload.get("_abort_counted",
+                                                       False))
+            except Exception:     # never kill the serve loop
+                pass
+            return
         fut = payload.pop("future")
+        if fut.cancelled():
+            # client cancelled between submit and ingest: the request
+            # never reaches the engine (resolving a cancelled future
+            # would raise InvalidStateError out of the serve loop)
+            return
         try:
             fut.pt_request = self._engine.add_request(future=fut,
                                                       **payload)

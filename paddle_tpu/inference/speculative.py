@@ -283,6 +283,13 @@ class SpeculativeDecoder:
         shared PRNG key via its own reseed)."""
         self._kv, self._kv_scales = self._fresh_pools()
 
+    def release_pools(self):
+        """Brownout L2 (fleet_serving.overload): drop the draft pool
+        arrays — the HBM returns to the fleet NOW. pool_bytes() reads
+        0 until `reset_pools` rebuilds; the engine parks this decoder
+        while released, so no window can touch the empty lists."""
+        self._kv, self._kv_scales = [], []
+
     # ---- draft catch-up ----
 
     def _catch_up(self, rows):
@@ -352,9 +359,15 @@ class SpeculativeDecoder:
         # reserve pages: verify writes positions pos0..pos0+width (the
         # propose scan writes a prefix of the same range in the
         # mirrored draft pool — one reservation covers both)
+        # brownout spec_k cap: a narrower proposal rides the `wid`/`rem`
+        # runtime arguments of the SAME k-scan — degrading never
+        # recompiles (fleet_serving.overload, ladder L1)
+        cap = eng._brownout.get("spec_k_cap")
+        k_eff = k if cap is None else max(0, min(k, int(cap)))
+
         width = {}
         for slot, req in frontier:
-            w = min(k, req.target - len(req.tokens))
+            w = min(k_eff, req.target - len(req.tokens))
             last = req.n_prefilled + w
             try:
                 while last // ps >= len(req.pages):

@@ -1,0 +1,786 @@
+"""Overload control plane (ISSUE 16): deadline-aware shedding,
+cancellation propagation, brownout degradation, prefill circuit
+breaker + hedging.
+
+The acceptance suite: typed-rejection unit contracts (breaker state
+machine incl. the half-open probe age-out, brownout hysteresis/journal/
+dwell, the provable TTFT lower bound), single-request engine abort
+that frees pages while co-residents are unperturbed, end-to-end hard
+deadlines (expired-at-submit / mid-decode expiry / met-deadline
+identity), the bounded all-replicas-dead parking queue, router-level
+cancellation across tiers, the sick-prefill breaker fallback, hedged
+re-dispatch ahead of failover, and the chaos overload-storm test:
+Poisson arrivals beyond fleet capacity plus an injected slow replica,
+with exact typed accounting, bounded admitted TTFT, token-identical
+completed outputs, and a brownout ladder that steps down AND recovers.
+"""
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import chaos
+from paddle_tpu.inference.fleet_serving import (
+    AutoscalePolicy, BrownoutController, CircuitBreaker, FleetRouter,
+    LocalReplica, OverloadPolicy, Priority, RequestCancelled,
+    RequestShed, TTFTEstimator, fork_model)
+from paddle_tpu.inference.fleet_serving import overload as ovl
+from paddle_tpu.inference.llm_engine import LLMEngine, LLMEngineConfig
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _drain(eng, cap=800):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+    return steps
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=4, page_size=16, token_budget=32,
+                max_model_len=96)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _reference(model, prompts, max_new=12, **cfg_kw):
+    eng = LLMEngine(model, _ecfg(**cfg_kw))
+    reqs = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    _drain(eng)
+    return [r.future.result(timeout=0) for r in reqs]
+
+
+def _prompts(rng, cfg, lens):
+    return [rng.integers(0, cfg.vocab_size, (int(L),)).astype(np.int32)
+            for L in lens]
+
+
+def _mk_factory(model, **cfg_kw):
+    def make(name, role="serve"):
+        return LocalReplica(fork_model(model), name=name, role=role,
+                            config=_ecfg(**cfg_kw))
+    return make
+
+
+def _shed_count():
+    return sum(c.value for _, c in ovl._SHED_TOTAL._series())
+
+
+def _cancel_count():
+    return sum(c.value for _, c in ovl._CANCELLED_TOTAL._series())
+
+
+# --------------------------------------------------------------------
+# typed rejections + unit contracts (no model)
+# --------------------------------------------------------------------
+
+def test_typed_rejections_carry_context():
+    e = RequestShed("deadline_unmeetable", retry_after_s=0.25,
+                    trace_id="t-1")
+    assert e.reason == "deadline_unmeetable"
+    assert e.retry_after_s == 0.25 and e.trace_id == "t-1"
+    assert "retry after" in str(e)
+    assert isinstance(e, RuntimeError)
+    c = RequestCancelled(reason="deadline", trace_id="t-2")
+    assert c.reason == "deadline" and c.trace_id == "t-2"
+    assert isinstance(c, RuntimeError)
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(window=8, failure_rate=0.5, min_events=4,
+                        reset_s=1.0)
+    assert br.state == "closed" and br.allow(now=0.0)
+    # below min_events: never evaluates, stays closed
+    br.record_failure(now=0.0)
+    br.record_failure(now=0.0)
+    br.record_failure(now=0.0)
+    assert br.state == "closed"
+    # 4th event crosses min_events with 4/4 bad -> open
+    br.record_failure(now=0.0)
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow(now=0.5)            # still inside reset_s
+    assert br.allow(now=1.5)                # half-open: the ONE probe
+    assert br.state == "half_open"
+    assert not br.allow(now=1.6)            # probe outstanding
+    br.record_failure(now=1.7)              # probe failed -> re-open
+    assert br.state == "open" and br.opens == 2
+    assert br.allow(now=3.0)                # half-open again
+    br.record_success(latency_s=0.0, now=3.1)
+    assert br.state == "closed"             # clean probe closes...
+    assert br.snapshot()["window"] == []    # ...and forgets the window
+
+
+def test_circuit_breaker_latency_counts_as_bad():
+    br = CircuitBreaker(window=8, failure_rate=0.5, min_events=4,
+                        latency_s=0.1, reset_s=1.0)
+    for _ in range(4):
+        br.record_success(latency_s=0.5, now=0.0)   # slow = bad
+    assert br.state == "open"
+    # without latency_s the same successes keep it closed
+    br2 = CircuitBreaker(window=8, failure_rate=0.5, min_events=4)
+    for _ in range(8):
+        br2.record_success(latency_s=9.9, now=0.0)
+    assert br2.state == "closed"
+
+
+def test_circuit_breaker_abandoned_probe_ages_out():
+    br = CircuitBreaker(window=4, failure_rate=0.5, min_events=2,
+                        reset_s=1.0)
+    br.record_failure(now=0.0)
+    br.record_failure(now=0.0)
+    assert br.state == "open"
+    assert br.allow(now=1.5)        # the probe goes out...
+    assert not br.allow(now=1.6)    # ...and never reports back
+    # a dead probe must not wedge the breaker half-open forever
+    assert br.allow(now=1.5 + max(br.reset_s, 1.0) + 0.1)
+
+
+def test_brownout_hysteresis_journal_and_dwell():
+    applied = []
+    pol = OverloadPolicy(brownout_high=4.0, brownout_low=1.0,
+                         brownout_step_ticks=2,
+                         brownout_recover_ticks=3)
+    ctl = BrownoutController(pol, apply_fn=lambda lv, caps:
+                             applied.append((lv, caps)))
+    assert ctl.enabled and ctl.level == 0
+    assert ctl.shed_priority() is None
+    # one hot tick is NOT a step (hysteresis)
+    assert ctl.note_pressure(9.0, now=0.0) == 0
+    # a mid-band tick resets the hot streak
+    assert ctl.note_pressure(2.0, now=0.1) == 0
+    assert ctl.note_pressure(9.0, now=0.2) == 0
+    assert ctl.note_pressure(9.0, now=0.3) == 1      # 2 consecutive
+    assert applied[-1][0] == 1
+    # ride the ladder down to the bottom
+    t = 0.4
+    while ctl.level < len(ctl.levels) - 1:
+        ctl.note_pressure(9.0, now=t)
+        t += 0.1
+    assert ctl.level == 4
+    assert ctl.shed_priority() == int(Priority.BATCH)
+    assert ctl.caps()["spec_enabled"] is False
+    # saturated: more hot ticks do not overflow the ladder
+    ctl.note_pressure(9.0, now=t)
+    ctl.note_pressure(9.0, now=t + 0.1)
+    assert ctl.level == 4
+    # cool ticks step UP only after recover_ticks in a row
+    t += 1.0
+    ctl.note_pressure(0.0, now=t)
+    ctl.note_pressure(0.0, now=t + 0.1)
+    assert ctl.level == 4
+    ctl.note_pressure(0.0, now=t + 0.2)
+    assert ctl.level == 3
+    # the journal recorded every transition, in order
+    hops = [(j["from"], j["to"]) for j in ctl.journal]
+    assert hops == [(0, 1), (1, 2), (2, 3), (3, 4), (4, 3)]
+    # dwell accounting covers all time since the first tick
+    dw = ctl.dwell(now=t + 0.2)
+    assert len(dw) == len(ctl.levels)
+    assert abs(sum(dw) - (t + 0.2)) < 1e-6
+    assert ctl.snapshot()["transitions"] == 5
+
+
+def test_brownout_disabled_is_inert():
+    ctl = BrownoutController(OverloadPolicy())   # brownout_high=None
+    assert not ctl.enabled
+    for i in range(50):
+        assert ctl.note_pressure(1e9, now=float(i)) == 0
+    assert ctl.journal == []
+
+
+def test_ttft_estimator_provable_lower_bound():
+    est = TTFTEstimator()
+    # no observed rate -> no proof -> bound 0 (always admit)
+    assert est.lower_bound_ttft(10_000) == 0.0
+    est.note_progress(0.0, t=100.0)
+    est.note_progress(500.0, t=101.0)       # 500 tok/s
+    est.note_progress(600.0, t=102.0)       # 100 tok/s: peak kept
+    assert est.peak_rate() == pytest.approx(500.0)
+    # negative delta (a replica left the sum) is discarded
+    est.note_progress(50.0, t=103.0)
+    assert est.peak_rate() == pytest.approx(500.0)
+    assert est.lower_bound_ttft(1000) == pytest.approx(2.0)
+    est.note_prompt(20)
+    est.note_prompt(40)
+    assert 20.0 < est.avg_prompt_tokens() < 40.0
+    snap = est.snapshot()
+    assert snap["peak_rate_tok_s"] == pytest.approx(500.0)
+
+
+# --------------------------------------------------------------------
+# single-request engine abort (satellite: LLMEngine.abort)
+# --------------------------------------------------------------------
+
+def test_engine_abort_frees_pool_coresidents_unperturbed(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+    pa, pb = _prompts(rng, cfg, [20, 17])
+    ref_b = _reference(model, [pb], max_new=12)[0]
+
+    eng = LLMEngine(model, _ecfg())
+    assert eng.pool.num_live == 0
+    ra = eng.add_request(pa, max_new_tokens=40)
+    rb = eng.add_request(pb, max_new_tokens=12)
+    for _ in range(3):
+        eng.step()
+    assert ra.slot is not None and rb.slot is not None
+    pages_b = len(rb.pages)
+    t0 = len(flight.recorder().events("request_cancelled"))
+    c0 = _cancel_count()
+    assert eng.abort(ra.rid) is True
+    # the victim's pages returned; the co-resident keeps exactly its own
+    assert eng.pool.num_live == pages_b
+    eng.pool.assert_consistent()
+    with pytest.raises(RequestCancelled) as ei:
+        ra.future.result(timeout=0)
+    assert ei.value.reason == "client"
+    assert "cancelled" in ra.trace.phases
+    assert _cancel_count() == c0 + 1
+    evs = flight.recorder().events("request_cancelled")
+    assert len(evs) == t0 + 1
+    assert evs[-1]["trace_id"] == ra.trace.trace_id
+    # the survivor is untouched: token-identical to its solo run
+    _drain(eng)
+    assert np.array_equal(rb.future.result(timeout=0), ref_b)
+    assert eng.pool.num_live == 0
+    # unknown / already-finished rid: no-op
+    assert eng.abort(ra.rid) is False
+    assert eng.abort(10**9) is False
+
+
+def test_engine_abort_queued_request(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(8)
+    pa, pb = _prompts(rng, cfg, [12, 12])
+    eng = LLMEngine(model, _ecfg(num_slots=1))
+    ra = eng.add_request(pa, max_new_tokens=8)
+    rb = eng.add_request(pb, max_new_tokens=8)
+    eng.step()
+    assert ra.slot is not None and rb.slot is None   # rb still queued
+    assert eng.abort(rb.rid) is True
+    with pytest.raises(RequestCancelled):
+        rb.future.result(timeout=0)
+    assert "cancelled" in rb.trace.phases
+    _drain(eng)
+    assert ra.future.result(timeout=0) is not None
+    assert eng.pool.num_live == 0
+
+
+# --------------------------------------------------------------------
+# hard deadlines, engine tier (satellite: end-to-end deadlines)
+# --------------------------------------------------------------------
+
+def test_engine_deadline_expired_at_submit_rejects_typed(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(9)
+    (p,) = _prompts(rng, cfg, [10])
+    eng = LLMEngine(model, _ecfg())
+    s0 = _shed_count()
+    for ds in (0.0, -1.0):
+        req = eng.add_request(p, max_new_tokens=8, deadline_s=ds)
+        with pytest.raises(RequestShed) as ei:
+            req.future.result(timeout=0)
+        assert ei.value.reason == "deadline"
+    assert _shed_count() == s0 + 2
+    assert not eng.has_work()            # nothing was admitted
+
+
+def test_engine_deadline_expires_mid_decode(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(10)
+    (p,) = _prompts(rng, cfg, [8])
+    eng = LLMEngine(model, _ecfg())
+    req = eng.add_request(p, max_new_tokens=64, deadline_s=0.15)
+    steps = 0
+    while not req.future.done():
+        eng.step()
+        time.sleep(0.01)
+        steps += 1
+        assert steps < 400, "deadline never fired"
+    with pytest.raises(RequestCancelled) as ei:
+        req.future.result(timeout=0)
+    assert ei.value.reason == "deadline"
+    # the phase timeline records the abort moment
+    assert "cancelled" in req.trace.phases
+    assert len(req.tokens) < len(p) + 64      # it really stopped early
+    assert not eng.has_work()
+    assert eng.pool.num_live == 0
+    eng.pool.assert_consistent()
+
+
+def test_engine_deadline_met_is_byte_identical(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(11)
+    (p,) = _prompts(rng, cfg, [14])
+    ref = _reference(model, [p], max_new=10)[0]
+    eng = LLMEngine(model, _ecfg())
+    req = eng.add_request(p, max_new_tokens=10, deadline_s=60.0)
+    _drain(eng)
+    out = req.future.result(timeout=0)
+    assert out.tobytes() == ref.tobytes()
+    assert "cancelled" not in req.trace.phases
+
+
+# --------------------------------------------------------------------
+# brownout caps on the engine (ladder levels are runtime clamps)
+# --------------------------------------------------------------------
+
+def test_engine_brownout_caps_max_new_and_window(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(12)
+    (p,) = _prompts(rng, cfg, [10])
+    ref = _reference(model, [p], max_new=12)[0]
+
+    eng = LLMEngine(model, _ecfg())
+    eng.apply_brownout({"max_new_cap": 2})
+    req = eng.add_request(p, max_new_tokens=12)
+    _drain(eng)
+    out = req.future.result(timeout=0)
+    assert len(out) == len(p) + 2                 # output capped...
+    assert out.tobytes() == ref[:len(out)].tobytes()   # ...not altered
+
+    # lifting the caps restores full service, token-identical
+    eng.apply_brownout({})
+    req2 = eng.add_request(p, max_new_tokens=12)
+    _drain(eng)
+    assert req2.future.result(timeout=0).tobytes() == ref.tobytes()
+
+    # decode_k_cap clamps the fused window WIDTH (a runtime argument):
+    # outputs stay token-identical under the clamp
+    eng2 = LLMEngine(model, _ecfg(decode_k=4))
+    eng2.apply_brownout({"decode_k_cap": 1})
+    req3 = eng2.add_request(p, max_new_tokens=12)
+    _drain(eng2)
+    assert req3.future.result(timeout=0).tobytes() == ref.tobytes()
+
+
+def test_engine_brownout_shed_priority_class(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(13)
+    pa, pb = _prompts(rng, cfg, [10, 10])
+    eng = LLMEngine(model, _ecfg())
+    eng.apply_brownout({"shed_priority": int(Priority.BATCH)})
+    shed = eng.add_request(pa, max_new_tokens=4,
+                           priority=Priority.BATCH)
+    kept = eng.add_request(pb, max_new_tokens=4,
+                           priority=Priority.STANDARD)
+    with pytest.raises(RequestShed) as ei:
+        shed.future.result(timeout=0)
+    assert ei.value.reason == "brownout"
+    _drain(eng)
+    assert kept.future.result(timeout=0) is not None
+
+
+def test_engine_brownout_spec_park_and_restore(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(14)
+    prompts = _prompts(rng, cfg, [12, 18])
+    ref = _reference(model, prompts, max_new=8)
+
+    paddle.seed(31)
+    draft = GPTForCausalLM(gpt_tiny())
+    draft.eval()
+    eng = LLMEngine(model, _ecfg(draft_model=draft, spec_k=4))
+    bytes_full = eng.pool_bytes()
+    assert eng._spec is not None
+
+    # L2: speculation off — the draft pool's HBM returns NOW
+    eng.apply_brownout({"spec_enabled": False})
+    reqs = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    _drain(eng)
+    assert eng._spec is None and eng._spec_stash is not None
+    assert eng.pool_bytes() < bytes_full
+    for r, want in zip(reqs, ref):
+        assert r.future.result(timeout=0).tobytes() == want.tobytes()
+
+    # recovery: the stashed decoder comes back with rebuilt pools
+    eng.apply_brownout({})
+    reqs = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    _drain(eng)
+    assert eng._spec is not None and eng._spec_stash is None
+    assert eng.pool_bytes() == bytes_full
+    for r, want in zip(reqs, ref):
+        assert r.future.result(timeout=0).tobytes() == want.tobytes()
+
+    # L1: spec_k_cap shrinks the speculation window, identity holds
+    eng.apply_brownout({"spec_k_cap": 1})
+    reqs = [eng.add_request(p, max_new_tokens=8) for p in prompts]
+    _drain(eng)
+    for r, want in zip(reqs, ref):
+        assert r.future.result(timeout=0).tobytes() == want.tobytes()
+
+
+# --------------------------------------------------------------------
+# bounded parking queue (satellite: all-replicas-dead bound)
+# --------------------------------------------------------------------
+
+def test_router_parking_queue_is_bounded(tiny_model):
+    """All replicas dead, no factory: requests PARK awaiting recovery —
+    but only up to OverloadPolicy.max_parked; past the bound the worst-
+    placed request (shed order) gets a typed RequestShed instead of
+    unbounded queue growth. This pins the regression: the parking
+    queue was unbounded before the overload control plane."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(20)
+    prompts = _prompts(rng, cfg, [8] * 5)
+    make = _mk_factory(model)
+    a = make("a")
+    router = FleetRouter(
+        replicas=[a],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1,
+                               heartbeat_timeout_s=0.3, poll_s=0.01),
+        overload=OverloadPolicy(max_parked=3))
+    with router:
+        a.kill()
+        deadline = time.monotonic() + 20
+        while router.num_replicas() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert router.num_replicas() == 0
+        futs = [router.submit(p, max_new_tokens=4) for p in prompts]
+        # exactly max_parked survive; the 2 newest shed typed
+        with router._lock:
+            parked = sum(rr.stage == "parked"
+                         for rr in router._inflight.values())
+        assert parked == 3
+        assert router.stats["shed"] == 2
+        for f in futs[3:]:
+            with pytest.raises(RequestShed) as ei:
+                f.result(timeout=5)
+            assert ei.value.reason == "no_capacity"
+        for f in futs[:3]:
+            assert not f.done()
+    # stop() resolves what never found a replica — no future hangs
+    for f in futs[:3]:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=5)
+
+
+# --------------------------------------------------------------------
+# cancellation propagation across tiers (tentpole)
+# --------------------------------------------------------------------
+
+def test_router_cancel_propagates_to_engine_and_frees(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(21)
+    p_long, p_b = _prompts(rng, cfg, [8, 12])
+    ref_b = _reference(model, [p_b], max_new=8)[0]
+    make = _mk_factory(model)
+    a = make("a")
+    router = FleetRouter(
+        replicas=[a],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1,
+                               heartbeat_timeout_s=5.0, poll_s=0.01))
+    with router:
+        fut = router.submit(p_long, max_new_tokens=60)
+        rid = fut.pt_rid
+        # wait until the replica engine has INGESTED it (slot + pages
+        # live) so the cancel exercises the full cross-tier path
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with router._lock:
+                rr = router._inflight.get(rid)
+            if rr is None or (rr.internal is not None and
+                              getattr(rr.internal, "pt_request", None)
+                              is not None):
+                break
+            time.sleep(0.01)
+        c0 = _cancel_count()
+        assert router.cancel(rid, reason="client") is True
+        with pytest.raises(RequestCancelled) as ei:
+            fut.result(timeout=10)
+        assert ei.value.reason == "client"
+        # counted EXACTLY once across router + engine tiers
+        assert _cancel_count() == c0 + 1
+        assert router.stats["cancelled"] == 1
+        # the flight ring carries the cancellation with its trace
+        evs = flight.recorder().events("request_cancelled")
+        assert evs and evs[-1].get("trace_id")
+        # the engine frees the slot/pages (abort rides the serve queue)
+        deadline = time.monotonic() + 20
+        while (a.engine.pool.num_live > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert a.engine.pool.num_live == 0
+        a.engine.pool.assert_consistent()
+        # co-resident traffic is unperturbed
+        out = router.submit(p_b, max_new_tokens=8).result(timeout=60)
+        assert np.array_equal(out, ref_b)
+        # cancelling a finished/unknown rid reports False
+        assert router.cancel(rid) is False
+
+
+# --------------------------------------------------------------------
+# deadline admission at the router (satellite: end-to-end deadlines)
+# --------------------------------------------------------------------
+
+def test_router_deadline_admission_and_identity(tiny_model):
+    cfg, model = tiny_model
+    rng = np.random.default_rng(22)
+    p_small, p_big = _prompts(rng, cfg, [12, 64])
+    ref = _reference(model, [p_small], max_new=8)[0]
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("a")],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1,
+                               heartbeat_timeout_s=5.0, poll_s=0.01))
+    with router:
+        # already-expired deadline: typed shed AT SUBMIT
+        with pytest.raises(RequestShed) as ei:
+            router.submit(p_small, max_new_tokens=8,
+                          deadline_s=0.0).result(timeout=5)
+        assert ei.value.reason == "deadline"
+        assert ei.value.retry_after_s is None
+        # provably-unmeetable deadline: the estimator's PEAK-rate lower
+        # bound exceeds it -> shed with a retry-after hint
+        router._estimator.note_progress(0.0, t=100.0)
+        router._estimator.note_progress(500.0, t=101.0)  # 500 tok/s
+        with pytest.raises(RequestShed) as ei:
+            router.submit(p_big, max_new_tokens=8,
+                          deadline_s=0.001).result(timeout=5)
+        assert ei.value.reason == "deadline_unmeetable"
+        assert ei.value.retry_after_s > 0
+        # a COMFORTABLE deadline changes nothing: byte-identical
+        out = router.submit(p_small, max_new_tokens=8,
+                            deadline_s=60.0).result(timeout=60)
+        assert out.tobytes() == ref.tobytes()
+        assert router.stats["shed"] == 2
+
+
+# --------------------------------------------------------------------
+# circuit breaker on a SICK (not dead) prefill tier (tentpole)
+# --------------------------------------------------------------------
+
+def test_router_breaker_opens_on_sick_prefill_tier(tiny_model):
+    """A prefill tier that keeps FAILING hand-offs (here: a replica
+    whose max_model_len rejects every prompt — alive, heartbeating,
+    useless) trips the windowed breaker; the router stops burning the
+    hand-off latency and serves whole requests on the decode tier.
+    Failover never fires — the replica is sick, not dead."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(23)
+    prompts = _prompts(rng, cfg, [48] * 6)
+    ref = _reference(model, prompts, max_new=12)
+    make = _mk_factory(model)
+    sick_pre = LocalReplica(fork_model(model), name="pre",
+                            role="prefill",
+                            config=_ecfg(max_model_len=32))
+    router = FleetRouter(
+        replicas=[make("a")], prefill_replicas=[sick_pre],
+        prefill_min_tokens=40,
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1,
+                               heartbeat_timeout_s=10.0, poll_s=0.01))
+    with router:
+        outs = [router.submit(p, max_new_tokens=12).result(timeout=120)
+                for p in prompts]
+        m = router.metrics()
+    for want, got in zip(ref, outs):
+        assert np.array_equal(want, got)   # fallback serves correctly
+    br = m["overload"]["breaker"]
+    assert br["opens"] >= 1
+    assert br["state"] != "closed"
+    assert m["disagg_handoffs"] == 0       # no hand-off ever succeeded
+    assert m["replicas_lost"] == 0         # sick != dead: no failover
+    assert ovl._BREAKER_STATE.value in (0.5, 1.0)
+
+
+# --------------------------------------------------------------------
+# hedged re-dispatch ahead of failover (tentpole)
+# --------------------------------------------------------------------
+
+def test_router_hedge_rescues_wedged_replica(tiny_model):
+    """A replica that stops ticking mid-request (chaos delay injector)
+    with a heartbeat timeout too long for failover to help: hedging
+    re-dispatches its stuck requests to a healthy member BEFORE the
+    failover timer would fire, first completion wins, outputs stay
+    token-identical."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(24)
+    prompts = _prompts(rng, cfg, rng.integers(6, 24, 8))
+    ref = _reference(model, prompts, max_new=16)
+    chaos.install({"seed": 4, "injectors": [
+        {"scope": "replica.kill.a", "kind": "delay", "at": [3],
+         "delay_s": 4.0}]})
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("a"), make("b")],
+        policy=AutoscalePolicy(min_replicas=2, max_replicas=2,
+                               heartbeat_timeout_s=30.0, poll_s=0.01),
+        overload=OverloadPolicy(hedge_after_s=0.3, hedge_stale_s=0.25))
+    t0 = time.monotonic()
+    with router:
+        futs = [router.submit(p, max_new_tokens=16) for p in prompts]
+        outs = [f.result(timeout=60) for f in futs]
+        m = router.metrics()
+    elapsed = time.monotonic() - t0
+    for want, got in zip(ref, outs):
+        assert np.array_equal(want, got)
+    assert m["hedges"] >= 1                # the hedge actually fired
+    assert m["replicas_lost"] == 0         # ...and failover did NOT
+    assert chaos.get_plan().injected.get("replica.kill.a", 0) >= 1
+    # rescued well before the 30s heartbeat timeout could have
+    assert elapsed < 25.0
+
+
+# --------------------------------------------------------------------
+# the chaos overload storm (acceptance)
+# --------------------------------------------------------------------
+
+def test_chaos_overload_storm_acceptance(tiny_model):
+    """ISSUE 16 acceptance: Poisson arrivals beyond fleet capacity
+    with an injected SLOW (not dead) replica. Every future resolves
+    typed (zero unresolved), typed-shed/cancel accounting is EXACT
+    across tiers, admitted requests that complete do so inside their
+    2x-unloaded-p99 deadline, completed outputs are token-identical
+    to the unloaded single-engine reference, and the brownout ladder
+    steps down under pressure and recovers to full service."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(25)
+    lens = rng.integers(8, 20, 24)
+    prompts = _prompts(rng, cfg, lens)
+    ref = _reference(model, prompts, max_new=10)
+    warm = _prompts(rng, cfg, [10, 14, 12, 16])
+
+    # replica "a" runs SLOW: a seeded 35%-of-ticks stall — alive and
+    # heartbeating (heartbeat_timeout_s keeps failover out of the
+    # picture; the overload plane must cope, not the failover plane)
+    chaos.install({"seed": 17, "injectors": [
+        {"scope": "replica.kill.a", "kind": "delay", "p": 0.35,
+         "delay_s": 0.05}]})
+    make = _mk_factory(model)
+    router = FleetRouter(
+        replicas=[make("a"), make("b")],
+        policy=AutoscalePolicy(min_replicas=2, max_replicas=2,
+                               heartbeat_timeout_s=60.0, poll_s=0.02),
+        overload=OverloadPolicy(
+            brownout_high=0.5, brownout_low=0.1,
+            brownout_step_ticks=2, brownout_recover_ticks=4,
+            hedge_after_s=2.0, hedge_stale_s=1.0, max_parked=64))
+    with router:
+        # unloaded warm-up: compile + the TTFT baseline + capacity
+        tw = time.monotonic()
+        for p in warm:
+            router.submit(p, max_new_tokens=10).result(timeout=120)
+        warm_elapsed = max(time.monotonic() - tw, 1e-3)
+        p99_unloaded = router.ttft_quantile(0.99)
+        deadline_s = max(2.0 * p99_unloaded, 1.0)
+        rate = len(warm) / warm_elapsed          # ~fleet capacity
+        s0, c0 = _shed_count(), _cancel_count()
+
+        # the storm: a 12-deep opening burst (the fleet has 8 slots
+        # total, so measured queue pressure is immediate), then Poisson
+        # arrivals at
+        # ~2.5x capacity (inter-arrival clamped so a compile-skewed
+        # capacity estimate cannot dilute the storm); three requests
+        # carry an already-expired deadline (deterministic typed sheds
+        # inside the storm). Completion times stamp via done-callback —
+        # result()-loop timing would charge request 0 the whole
+        # submission window.
+        t_sub, t_done, futs = [], {}, []
+        for i, p in enumerate(prompts):
+            if i >= 12:
+                time.sleep(min(float(rng.exponential(
+                    1.0 / (2.5 * rate))), 0.05))
+            ds = 0.0 if i in (5, 15, 21) else deadline_s
+            t_sub.append(time.perf_counter())
+            f = router.submit(p, max_new_tokens=10, deadline_s=ds)
+            f.add_done_callback(
+                lambda _f, i=i: t_done.setdefault(i, time.perf_counter()))
+            futs.append(f)
+
+        done, shed, cancelled = [], [], []
+        for i, f in enumerate(futs):
+            try:
+                out = f.result(timeout=120)
+                done.append((i, out))
+            except RequestShed as e:
+                assert e.reason in ("deadline", "deadline_unmeetable",
+                                    "brownout", "capacity",
+                                    "no_capacity")
+                shed.append(i)
+            except RequestCancelled as e:
+                assert e.reason in ("client", "deadline")
+                cancelled.append(i)
+        # every future resolved, every outcome typed
+        assert all(f.done() for f in futs)
+        assert len(done) + len(shed) + len(cancelled) == len(futs)
+        assert len(done) >= 1                  # the fleet still serves
+        assert {5, 15, 21} <= set(shed)        # deterministic sheds
+        # EXACT cross-tier accounting: one counter bump per outcome
+        assert _shed_count() - s0 == len(shed)
+        assert _cancel_count() - c0 == len(cancelled)
+        # completed outputs: token-identical to the unloaded reference
+        for i, out in done:
+            assert np.array_equal(out, ref[i])
+        # admitted requests that completed did so INSIDE the deadline
+        # (2x unloaded p99, floored): the engine's expiry sweep allows
+        # at most one step + the sweep grace past it
+        for i, out in done:
+            if i in (5, 15, 21):
+                continue
+            latency = t_done[i] - t_sub[i]
+            assert latency <= deadline_s + 0.8, (
+                f"request {i} completed {latency:.3f}s after submit "
+                f"(deadline {deadline_s:.3f}s)")
+            req = getattr(futs[i], "pt_request", None)
+            if req is not None and req.t_first_token is not None:
+                assert req.t_first_token - t_sub[i] <= deadline_s + 0.8
+        # the brownout ladder stepped DOWN under the storm...
+        journal = router._brownout_ctl.journal
+        assert any(j["to"] > j["from"] for j in journal), \
+            "brownout never engaged under a 2.5x storm"
+        # ...and recovers to full service once pressure drains
+        deadline = time.monotonic() + 30
+        while (router.stats["brownout_level"] != 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert router.stats["brownout_level"] == 0
+        assert any(j["to"] < j["from"] for j in journal)
+        # zero unresolved futures tracked anywhere
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with router._lock:
+                if not router._inflight:
+                    break
+            time.sleep(0.05)
+        with router._lock:
+            assert not router._inflight
+        m = router.metrics()
+    assert m["overload"]["brownout"]["level"] == 0
+    assert m["overload"]["estimator"]["peak_rate_tok_s"] > 0
+    assert chaos.get_plan().injected.get("replica.kill.a", 0) >= 1
