@@ -32,6 +32,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 V5E_PEAK_BF16 = 197e12  # nominal chip peak, FLOP/s
@@ -2101,6 +2102,179 @@ def bench_train_3d():
             "ckpt_overlap_ab": ckpt_ab}
 
 
+def bench_kv_tier_ab():
+    """Hierarchical KV memory A/B (ISSUE-17 acceptance): the SAME
+    multi-turn chat workload — S sessions x T turns, each turn's
+    prompt embedding the previous turn's full output — served twice on
+    an identically-sized device pool small enough that conversation
+    histories evict between turns. Tier OFF is the plain radix trie
+    (evicted history re-prefills); tier ON adds the host-RAM/disk
+    spill tier plus `session_id` pinning, so a returning turn
+    prefetches its frontier back through the import scatter instead of
+    recomputing it. Headline: prefill-token reduction (target >= 30%)
+    with greedy outputs token-identical across the sides and cold TTFT
+    no worse. Guarded stamps: TTFT phase breakdown (kv_prefetch vs
+    prefill segments) and a pool-capacity-vs-tier-hit-rate sweep."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.inference.llm_engine import LLMEngine
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import gpt_small, gpt_tiny
+
+    paddle.seed(0)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        cfg, sessions, turns, name = gpt_tiny(), 6, 4, "gpt-tiny-kv-tier"
+    else:
+        cfg, sessions, turns, name = gpt_small(), 8, 4, "gpt-small-kv-tier"
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(31)
+    gen = 16
+    user_toks = [[rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
+                  for _ in range(turns)] for _ in range(sessions)]
+    # pool sized to hold ~2 live conversations: round-robin turns
+    # evict every session's history between its own turns
+    ecfg_kw = dict(num_slots=2, page_size=16, token_budget=64,
+                   max_model_len=256, prefix_cache=True, num_pages=40)
+    tier_dir = os.path.join(tempfile.mkdtemp(prefix="ptkv_"), "tier")
+
+    def drain(eng):
+        while eng.has_work():
+            eng.step()
+
+    def run_side(tier_on):
+        kw = dict(ecfg_kw)
+        if tier_on:
+            kw["kv_tier"] = dict(ram_bytes=256 << 20, disk_dir=tier_dir)
+        eng = LLMEngine(model, inference.LLMEngineConfig(**kw))
+        history = [None] * sessions
+        outs, ttfts, prompt_total = [], [], 0
+        t0 = time.perf_counter()
+        for t in range(turns):
+            for s in range(sessions):
+                prompt = (user_toks[s][t] if history[s] is None else
+                          np.concatenate([history[s].astype(np.int32),
+                                          user_toks[s][t]]))
+                prompt_total += len(prompt)
+                req = eng.add_request(
+                    prompt, max_new_tokens=gen,
+                    session_id=f"chat-{s}" if tier_on else None)
+                drain(eng)
+                out = req.future.result(timeout=0)
+                history[s] = out
+                outs.append(out)
+                if (req.t_first_token is not None):
+                    ttfts.append(req.t_first_token - req.t_submit)
+        total_s = time.perf_counter() - t0
+        saved = eng.prefix_cache.stats["tokens_saved"]
+        tier_snap = (eng.kv_tier.snapshot() if tier_on else None)
+        recent = list(eng._timelines)
+        eng.close()
+        return {"outs": outs, "ttfts": ttfts, "total_s": total_s,
+                "prompt_tokens": prompt_total,
+                "prefill_tokens": prompt_total - saved,
+                "tier": tier_snap, "recent": recent}
+
+    def pctl(vals, p):
+        return (round(float(np.percentile(np.asarray(vals), p)) * 1e3, 2)
+                if vals else -1.0)
+
+    off = run_side(False)
+    log(f"[bench] kv_tier off: {off['prefill_tokens']} prefill tokens "
+        f"of {off['prompt_tokens']} in {off['total_s']:.2f}s")
+    on = run_side(True)
+    log(f"[bench] kv_tier on: {on['prefill_tokens']} prefill tokens, "
+        f"tier {{spills {on['tier']['spills']}, ram_hits "
+        f"{on['tier']['ram_hits']}, disk_hits {on['tier']['disk_hits']}}} "
+        f"in {on['total_s']:.2f}s")
+    reduction = (1.0 - on["prefill_tokens"] / off["prefill_tokens"]
+                 if off["prefill_tokens"] else 0.0)
+    greedy_match = (len(on["outs"]) == len(off["outs"]) and all(
+        np.array_equal(a, b) for a, b in zip(on["outs"], off["outs"])))
+    # cold TTFT = each session's FIRST turn (nothing cached either side)
+    cold_idx = list(range(sessions))
+    result = {
+        "model": name, "sessions": sessions, "turns": turns,
+        "gen_tokens_each": gen, "num_pages": ecfg_kw["num_pages"],
+        "prefill_tokens": {"off": off["prefill_tokens"],
+                           "on": on["prefill_tokens"]},
+        "prefill_token_reduction": round(reduction, 4),
+        "meets_30pct_bar": reduction >= 0.30,
+        "greedy_match": greedy_match,
+        "ttft_p50_ms": {"off": pctl(off["ttfts"], 50),
+                        "on": pctl(on["ttfts"], 50)},
+        "ttft_p99_ms": {"off": pctl(off["ttfts"], 99),
+                        "on": pctl(on["ttfts"], 99)},
+        "ttft_cold_p50_ms": {
+            "off": pctl([off["ttfts"][i] for i in cold_idx], 50),
+            "on": pctl([on["ttfts"][i] for i in cold_idx], 50)},
+        "tier": {k: on["tier"][k] for k in
+                 ("spills", "spill_pages", "ram_hits", "disk_hits",
+                  "misses", "demotions", "spill_rejected")},
+        "totals_s": {"off": round(off["total_s"], 2),
+                     "on": round(on["total_s"], 2)},
+    }
+    log(f"[bench] kv_tier_ab: prefill reduction {reduction:.1%} "
+        f"(>=30% bar: {result['meets_30pct_bar']}), greedy_match "
+        f"{greedy_match}")
+    # guarded: TTFT phase breakdown — kv_prefetch vs prefill segments
+    try:
+        def phase_sums(recent):
+            acc = {}
+            for tl in recent:
+                for seg in tl.get("phases", ()):
+                    acc[seg["phase"]] = (acc.get(seg["phase"], 0.0)
+                                         + seg["dt_s"])
+            return {k: round(v * 1e3, 2) for k, v in sorted(acc.items())}
+
+        result["phase_breakdown_ms"] = {"off": phase_sums(off["recent"]),
+                                        "on": phase_sums(on["recent"])}
+        result["kv_prefetch_requests"] = sum(
+            any(seg["phase"] == "kv_prefetch"
+                for seg in tl.get("phases", ()))
+            for tl in on["recent"])
+    except Exception as e:
+        log(f"[bench] kv_tier_ab phase stamp failed: {e!r}")
+        result["phase_breakdown_ms"] = {"error": repr(e)}
+    # guarded: pool-capacity-vs-tier-hit-rate sweep (tier on, 2-turn
+    # shape — how much HBM the spill tier buys back at each size)
+    try:
+        sweep = []
+        for num_pages in (28, 40, 64):
+            kw = dict(ecfg_kw, num_pages=num_pages,
+                      kv_tier=dict(ram_bytes=256 << 20))
+            eng = LLMEngine(model, inference.LLMEngineConfig(**kw))
+            hist = [None] * sessions
+            for t in range(min(3, turns)):
+                for s in range(sessions):
+                    prompt = (user_toks[s][t] if hist[s] is None else
+                              np.concatenate([hist[s].astype(np.int32),
+                                              user_toks[s][t]]))
+                    req = eng.add_request(prompt, max_new_tokens=gen,
+                                          session_id=f"sweep-{s}")
+                    drain(eng)
+                    hist[s] = req.future.result(timeout=0)
+            snap = eng.kv_tier.snapshot()
+            looked = snap["ram_hits"] + snap["disk_hits"] + snap["misses"]
+            sweep.append({
+                "num_pages": num_pages,
+                "tier_hits": snap["ram_hits"] + snap["disk_hits"],
+                "tier_hit_rate": (round((snap["ram_hits"]
+                                         + snap["disk_hits"]) / looked, 4)
+                                  if looked else None),
+                "spills": snap["spills"],
+                "trie_tokens_saved": eng.prefix_cache.stats[
+                    "tokens_saved"]})
+            eng.close()
+        result["capacity_sweep"] = sweep
+        log(f"[bench] kv_tier_ab capacity sweep: {json.dumps(sweep)}")
+    except Exception as e:
+        log(f"[bench] kv_tier_ab capacity sweep failed: {e!r}")
+        result["capacity_sweep"] = {"error": repr(e)}
+    return result
+
+
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
             "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
@@ -2111,6 +2285,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "llm_fleet_multi": bench_llm_fleet_multi,
             "overload_storm_ab": bench_overload_storm_ab,
             "tracing_overhead_ab": bench_tracing_overhead_ab,
+            "kv_tier_ab": bench_kv_tier_ab,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
 
@@ -2345,12 +2520,13 @@ def main():
         # traffic — llm_serve's small-batch A/B is the fused-decode
         # acceptance regime, ISSUE 8)
         extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
-                  "overload_storm_ab", "tracing_overhead_ab", "train_3d")
+                  "overload_storm_ab", "tracing_overhead_ab",
+                  "kv_tier_ab", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
                   "llm_fleet_multi", "overload_storm_ab",
-                  "tracing_overhead_ab", "train_3d")
+                  "tracing_overhead_ab", "kv_tier_ab", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both
@@ -2359,7 +2535,7 @@ def main():
         status, res = _run_worker(
             which,
             timeout_s=900 if which.startswith(("llm_", "tracing_",
-                                               "overload_"))
+                                               "overload_", "kv_"))
             else 420,
             extra_env=fallback_env)
         if status == "ok":

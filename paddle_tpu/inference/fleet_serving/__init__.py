@@ -40,13 +40,22 @@ one surface:
 
 * **Fleet router** (`router.py`) — radix-affinity routing (longest
   cached prefix wins, least-loaded fallback), prefill/decode
-  disaggregation, SLO autoscale, and chaos-proven failover (a killed
+  disaggregation, SLO autoscale, chaos-proven failover (a killed
   replica's in-flight requests requeue with token-identical greedy
-  outputs).
+  outputs), and hot-prefix page migration (pull a hot prefix's pages
+  to a less-loaded peer over the KV wire instead of routing around
+  the miss).
+
+* **KV tier store** (`kv_tier.py`, ISSUE 17) — the memory hierarchy
+  below the device pool: trie-evicted pages spill asynchronously to
+  host RAM (stored-byte discipline — no re-encode) and age to an
+  mmap-friendly disk tier; a trie hit against a spilled prefix
+  prefetches back through the one compiled import scatter.
 
 Docs: docs/SERVING.md. Bench: `python bench.py --worker llm_fleet`
 (single engine) / `--worker llm_fleet_multi` (the 2-replica A/B).
 """
+from .kv_tier import KVTierStore, prefix_key
 from .kv_transfer import (KVPagePayload, pack_kv_payload,
                           recv_kv_payload, send_kv_payload,
                           unpack_kv_payload)
@@ -69,4 +78,4 @@ __all__ = ["RadixPrefixCache", "Priority", "SLAPolicy", "SLAScheduler",
            "OverloadPolicy", "RequestShed", "RequestCancelled",
            "TTFTEstimator", "CircuitBreaker", "BrownoutController",
            "DEFAULT_BROWNOUT_LEVELS", "note_shed", "note_cancelled",
-           "note_hedge"]
+           "note_hedge", "KVTierStore", "prefix_key"]

@@ -366,9 +366,20 @@ DEFAULT_BROWNOUT_LEVELS = (
                                                   #     pool released
     {"spec_enabled": False, "decode_k_cap": 2,    # L3: + window/output
      "max_new_cap": 32},                          #     caps
-    {"spec_enabled": False, "decode_k_cap": 2,    # L4: + shed the
-     "max_new_cap": 32, "shed_priority": 2},      #     best-effort
-)                                                 #     (BATCH) class
+    {"spec_enabled": False, "decode_k_cap": 2,    # L4: + stop pinning
+     "max_new_cap": 32, "session_pin": False},    #     session KV: the
+                                                  #     engine sheds
+                                                  #     convenience
+                                                  #     state (multi-
+                                                  #     turn frontiers
+                                                  #     re-prefill)
+                                                  #     BEFORE any
+                                                  #     traffic is
+                                                  #     refused
+    {"spec_enabled": False, "decode_k_cap": 2,    # L5: + shed the
+     "max_new_cap": 32, "session_pin": False,     #     best-effort
+     "shed_priority": 2},                         #     (BATCH) class
+)
 
 
 class BrownoutController:  # ptlint: thread-shared (monitor tick writes; submit/ingress read)

@@ -108,6 +108,13 @@ class RadixPrefixCache:  # ptlint: thread-shared (scraped by /metrics)
         self._clock = itertools.count(1)
         self._nodes = 0
         self._resident_published = 0
+        # KV tier hook (fleet_serving.kv_tier, docs/SERVING.md "KV
+        # memory hierarchy"): called with the dying node BEFORE its
+        # pages are freed, so the engine can snapshot them D2H and
+        # spill to the host-RAM tier. None = eviction simply drops.
+        # clear() does NOT spill — a cleared trie means the pool's
+        # bytes are invalid (abort path) or the engine is retiring.
+        self.spill_fn = None
         # local mirror of the registry counters (per-cache attribution:
         # the registry is process-global across engines)
         self.stats = {"hits": 0, "misses": 0, "pages_shared": 0,
@@ -241,6 +248,12 @@ class RadixPrefixCache:  # ptlint: thread-shared (scraped by /metrics)
 
     def _drop(self, node):
         del node.parent.children[node.block]
+        if self.spill_fn is not None:
+            # snapshot-before-free: after pool.free these page ids are
+            # reusable and the bytes can be overwritten any tick. The
+            # hook owns its own error handling (a failed spill must
+            # never block the eviction that is relieving pool pressure).
+            self.spill_fn(node)
         self.pool.free(node.pages)
         self._nodes -= 1
         return len(node.pages)

@@ -303,6 +303,13 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
         return self._server.submit(payload.tokens, kv_import=payload,
                                    **kw)
 
+    def export_prefix(self, tokens):
+        """Future -> KVPagePayload (or None): cut this replica's trie
+        prefix of `tokens` for a hot-prefix pull (router migration —
+        docs/SERVING.md "KV memory hierarchy"). Engine-thread work,
+        queued behind in-flight submissions like any control op."""
+        return self._server.export_prefix(tokens)
+
     def abort(self, request_id, reason="client", counted=False):
         """Cancel one in-flight request on this replica's engine
         (cancellation propagation — the overload control plane's

@@ -160,7 +160,8 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
     def __init__(self, replicas=None, factory=None, policy=None,
                  hash_block_tokens=16, max_affinity_blocks=8,
                  prefill_replicas=None, prefill_min_tokens=None,
-                 registry=None, overload=None):
+                 registry=None, overload=None, migrate_hot_hits=None,
+                 migrate_interval_s=5.0, migrate_budget=2):
         self.policy = policy or AutoscalePolicy()
         # overload control plane (fleet_serving.overload; docs/SERVING
         # "Overload and degradation") — defaults are inert where
@@ -174,6 +175,21 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         self.max_affinity_blocks = int(max_affinity_blocks)
         self.prefill_min_tokens = (None if prefill_min_tokens is None
                                    else int(prefill_min_tokens))
+        # hot-prefix page migration (docs/SERVING.md "KV memory
+        # hierarchy"): when a prefix's affinity holder is busier than
+        # a peer, PULL its cached pages to the peer over the byte-
+        # exact KV wire instead of routing around the miss. Off by
+        # default (migrate_hot_hits=None); `migrate_hot_hits` routed
+        # hits on one leading block within `migrate_interval_s` make
+        # the prefix hot, and at most `migrate_budget` pulls run per
+        # interval (a migration costs a D2H gather on the donor).
+        self.migrate_hot_hits = (None if migrate_hot_hits is None
+                                 else int(migrate_hot_hits))
+        self.migrate_interval_s = float(migrate_interval_s)
+        self.migrate_budget = int(migrate_budget)
+        self._hot = {}             # first-block key -> hits this window
+        self._hot_t0 = time.monotonic()
+        self._migrations_left = self.migrate_budget
         self._lock = threading.Lock()
         self._replicas = {}        # name -> LocalReplica (decode/serve)
         self._prefill = {}         # name -> LocalReplica (prefill role)
@@ -194,7 +210,8 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                       "scale_ups": 0, "scale_downs": 0,
                       "disagg_handoffs": 0, "replicas_lost": 0,
                       "shed": 0, "cancelled": 0, "hedges": 0,
-                      "brownout_level": 0}
+                      "brownout_level": 0, "migrations": 0,
+                      "migration_failures": 0}
         pol = self.overload
         self._estimator = TTFTEstimator()
         self._breaker = CircuitBreaker(
@@ -563,6 +580,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             with self._lock:
                 self.stats["affinity_hits"] += 1
             _AFFINITY_HITS.inc()
+            target = self._migrate_check(rr, rep)
+            if target is not None and self._start_migration(
+                    rr, rep, target):
+                return
         rr.stage = "decode"
         rr.replica = rep.name
         rr.trace.stamp("routed")
@@ -675,6 +696,94 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         with self._lock:
             self._inflight.pop(rr.rid, None)
 
+    # ---- hot-prefix page migration (docs/SERVING.md) ----
+
+    def _migrate_check(self, rr, holder):
+        """Pull-vs-route decision for an affinity-hit request: returns
+        the replica to pull the prefix's pages TO, or None to route to
+        the holder as usual. A prefix is hot once its leading block
+        takes `migrate_hot_hits` routed hits inside the current
+        `migrate_interval_s` window; the pull fires only while the
+        window's `migrate_budget` lasts and only toward a STRICTLY
+        less-loaded alive peer (the point is relieving the holder, not
+        shuffling pages between equally-busy members)."""
+        if self.migrate_hot_hits is None or rr.requeues:
+            return None
+        keys = self._block_keys(rr.prompt)
+        if not keys:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if now - self._hot_t0 >= self.migrate_interval_s:
+                self._hot_t0 = now
+                self._hot.clear()
+                self._migrations_left = self.migrate_budget
+            hits = self._hot.get(keys[0], 0) + 1
+            self._hot[keys[0]] = hits
+            if hits < self.migrate_hot_hits or not self._migrations_left:
+                return None
+        peers = [r for r in self._alive_replicas()
+                 if r.name != holder.name and r.load() < holder.load()]
+        if not peers:
+            return None
+        with self._lock:
+            self._migrations_left -= 1
+            self._hot[keys[0]] = 0    # re-arm: next pull needs fresh heat
+        return min(peers, key=lambda r: r.load())
+
+    def _start_migration(self, rr, src, target):
+        """Kick the donor's engine-thread prefix cut; rr parks in stage
+        'migrate' (the failover orphan sweep covers it) until the
+        payload lands. False when the donor refuses the export (a
+        stopping replica) — the caller routes normally."""
+        rr.stage, rr.replica = "migrate", src.name
+        try:
+            fut = src.export_prefix(rr.prompt)
+        except Exception:
+            rr.stage, rr.replica = None, None
+            return False
+        rr.internal = fut
+        fut.add_done_callback(
+            lambda f, rr=rr, t=target: self._on_migrate_export(rr, t, f))
+        return True
+
+    def _on_migrate_export(self, rr, target, fut):
+        if rr.future.done() or fut is not rr.internal:
+            return     # stale attempt: failover already requeued rr
+        from .kv_tier import _MIGRATIONS
+        from .kv_transfer import pack_kv_payload, unpack_kv_payload
+
+        payload = None if fut.exception() is not None else fut.result()
+        if payload is None or not target.alive:
+            # donor trie went cold (evicted under us) or the export
+            # died or the target died meanwhile: route normally, once
+            with self._lock:
+                self.stats["migration_failures"] += 1
+            rr.internal = None
+            self._dispatch(rr)
+            return
+        # the byte-exact xproc wire discipline: the payload crosses
+        # pack -> unpack exactly as a cross-process pull would, so the
+        # imported bytes are PROVABLY the donor's stored bytes (no
+        # re-encode — int4/int8 codes + scale planes ride verbatim)
+        payload = unpack_kv_payload(pack_kv_payload(payload))
+        nb = payload.n_prefilled // self.hash_block_tokens
+        with self._lock:
+            self.stats["migrations"] += 1
+            store = self._affinity.setdefault(target.name, {})
+            for k in self._block_keys(rr.prompt)[:nb]:
+                store[k] = next(self._clock)
+        _MIGRATIONS.inc()
+        _flight.record_event("kv_migration", rid=rr.rid,
+                             trace_id=rr.trace.trace_id,
+                             to=target.name, was_on=rr.replica,
+                             pages=payload.num_pages)
+        rr.stage, rr.replica = "decode", target.name
+        rr.internal = target.submit_imported(
+            payload, trace=rr.trace, **self._deadlined(rr.kwargs, rr))
+        rr.internal.add_done_callback(
+            lambda f, rr=rr: self._on_decode_done(rr, f))
+
     # ---- monitor: failover + autoscale ----
 
     def _monitor_loop(self):
@@ -765,7 +874,7 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         with self._lock:
             members = set(self._replicas) | set(self._prefill)
             orphans = [rr for rr in self._inflight.values()
-                       if rr.stage in ("prefill", "decode")
+                       if rr.stage in ("prefill", "decode", "migrate")
                        and rr.replica is not None
                        and rr.replica not in members
                        and not rr.future.done()]

@@ -319,13 +319,25 @@ class LLMEngineConfig:
     spec_k        draft tokens proposed per speculative window.
                   Default: the PT_SPEC_K env var, else 4. Ignored
                   without a draft_model.
+    kv_tier       hierarchical KV memory below the device pool
+                  (fleet_serving.kv_tier; docs/SERVING.md "KV memory
+                  hierarchy"). Falsy (default) = off. True enables the
+                  host-RAM spill tier with defaults; a dict passes
+                  `KVTierStore` knobs through (`ram_bytes`,
+                  `disk_dir`, `disk_bytes`, `max_pending`). Requires
+                  prefix_cache: the tier spills/prefetches TRIE nodes.
+    session_ttl_s persistent-chat session TTL (seconds a session's
+                  frontier stays tracked after its last turn;
+                  default 600). See `LLMServer.submit(session_id=)`.
+    session_max   LRU cap on tracked sessions (default 256).
     """
 
     def __init__(self, num_slots=4, page_size=16, num_pages=None,
                  max_model_len=None, token_budget=None, kv_dtype=None,
                  prefix_cache=None, hash_block_tokens=None,
                  sla_policy=None, decode_k=None, seed=0,
-                 draft_model=None, spec_k=None):
+                 draft_model=None, spec_k=None, kv_tier=None,
+                 session_ttl_s=None, session_max=None):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
@@ -349,6 +361,16 @@ class LLMEngineConfig:
         if spec_k is None:
             spec_k = int(os.environ.get("PT_SPEC_K", "4"))
         self.spec_k = int(spec_k)
+        self.kv_tier = kv_tier
+        self.session_ttl_s = float(600.0 if session_ttl_s is None
+                                   else session_ttl_s)
+        self.session_max = int(256 if session_max is None
+                               else session_max)
+        if self.kv_tier and not self.prefix_cache:
+            raise ValueError(
+                "kv_tier requires prefix_cache=True: the tier "
+                "spills and prefetches radix-trie nodes, so without "
+                "the trie there is nothing to tier")
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.page_size < 1:
@@ -587,6 +609,10 @@ class _Request:
         # preemption replay falls back to ordinary prefill)
         self.prefill_only = False
         self._kv_import = None
+        # persistent chat sessions (ISSUE 17): set by add_request;
+        # _session_seen marks a RETURNING session (resume telemetry)
+        self.session_id = None
+        self._session_seen = False
         self._arrival = None      # scheduler enqueue stamp
         self.cached_prefix = 0    # tokens served from the prefix cache
         self._cow_pending = 0     # COW splits taken by the last match
@@ -735,6 +761,29 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                              self.hash_block_tokens)
             if cfg.prefix_cache else None)
         self._admit_counter = itertools.count()
+        # hierarchical KV memory (fleet_serving.kv_tier, ISSUE 17):
+        # trie evictions spill D2H into the host-RAM/disk tiers; trie
+        # misses probe the tier and prefetch H2D through the SAME
+        # fixed-width import scatter every kv_import uses (one
+        # executable — the zero-recompile contract covers prefetch)
+        self.kv_tier = None
+        if cfg.kv_tier:
+            from .fleet_serving.kv_tier import KVTierStore
+
+            kw = dict(cfg.kv_tier) if isinstance(cfg.kv_tier, dict) \
+                else {}
+            self.kv_tier = KVTierStore(**kw)
+            self.prefix_cache.spill_fn = self._spill_node
+        self._spill_count = 0     # spills queued (kv_spill stamping)
+        # persistent chat sessions (docs/SERVING.md "KV memory
+        # hierarchy"): session_id -> {last_used, turns}. The KV itself
+        # is NOT here — a finished turn's blocks are published into
+        # the trie (pinned) and age into the tier like any prefix;
+        # this table only tracks liveness for TTL/LRU expiry and the
+        # resumed/active telemetry. Engine-thread only.
+        self._sessions = collections.OrderedDict()
+        self.session_ttl_s = cfg.session_ttl_s
+        self.session_max = cfg.session_max
         self._step_fn = _CompiledPagedStep(model)
         self.stats = {"steps": 0, "tokens_in": 0, "generated": 0,
                       "finished": 0, "preemptions": 0,
@@ -776,7 +825,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                     future=None, tenant="default", priority=None,
                     ttft_slo_s=None, temperature=0.0, top_p=1.0,
                     prefill_only=False, kv_import=None, trace=None,
-                    deadline_s=None):
+                    deadline_s=None, session_id=None):
         """Enqueue one request. The disaggregated-serving knobs
         (docs/SERVING.md "Disaggregated fleet"):
 
@@ -792,7 +841,15 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                       prefill) and decodes from its frontier. Geometry
                       must match this engine's pool exactly — checked
                       loudly HERE, not with corrupt logits at serve
-                      time."""
+                      time.
+        session_id    persistent-chat identity (docs/SERVING.md "KV
+                      memory hierarchy"): the finished turn's trie
+                      blocks — generated tokens included — stay
+                      pinned-then-tiered so the next turn resumes from
+                      its frontier instead of re-prefilling the
+                      history. Sessions expire by TTL/LRU; brownout
+                      L4 sheds pinning before any traffic is
+                      refused."""
         toks = np.asarray(prompt).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -813,6 +870,9 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         # request reproduces its original continuation
         req.sample_stream = next(self._sample_streams)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
+        if session_id is not None and self.prefix_cache is not None:
+            req.session_id = str(session_id)
+            req._session_seen = self._touch_session(req.session_id)
         _REQS_TOTAL.inc()
         # trace identity: the caller's (router/server — already stamped
         # `queued` at the ingress), else the payload's (a disaggregated
@@ -972,8 +1032,27 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         from .fleet_serving.kv_transfer import KVPagePayload
 
         n = len(req.pages)
+        kv, scales = self._gather_pages(req.pages)
+        self.stats["kv_pages_exported"] = (
+            self.stats.get("kv_pages_exported", 0) + n)
+        req.trace.stamp("kv_export")
+        return KVPagePayload(np.asarray(req.tokens, np.int32),
+                             req.n_prefilled, self.page_size,
+                             self.kv_dtype, kv, scales,
+                             trace=req.trace.to_dict())
+
+    def _gather_pages(self, page_ids):
+        """ONE batched D2H gather of `page_ids` rows from every layer
+        pool + scale plane, at the FIXED `pages_per_seq` width (pad
+        index 0 = the trash page, rows sliced off on the host): the
+        shared primitive of request export, trie-node spill, and
+        hot-prefix migration — one gather shape, one executable,
+        whatever the page count. Returns (kv, scales) owned host
+        arrays (the PR-14 snapshot half: safe to hand to a background
+        thread while the pool reuses the pages)."""
+        n = len(page_ids)
         ids_np = np.zeros((self.pages_per_seq,), np.int32)
-        ids_np[:n] = req.pages
+        ids_np[:n] = page_ids
         ids = jnp.asarray(ids_np)
         # ONE batched host transfer for all pools + scale planes (a
         # per-pool device_get would serialize 2L+ round trips inside
@@ -984,13 +1063,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
               for a in gathered[:len(self._kv)]]
         scales = [np.ascontiguousarray(a[:n])
                   for a in gathered[len(self._kv):]]
-        self.stats["kv_pages_exported"] = (
-            self.stats.get("kv_pages_exported", 0) + n)
-        req.trace.stamp("kv_export")
-        return KVPagePayload(np.asarray(req.tokens, np.int32),
-                             req.n_prefilled, self.page_size,
-                             self.kv_dtype, kv, scales,
-                             trace=req.trace.to_dict())
+        return kv, scales
 
     def import_kv_pages(self, payload, **kw):
         """Admit one request whose prompt KV arrives pre-computed (a
@@ -1038,20 +1111,26 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         # (per-layer page counts or a mis-shaped scale plane) must be
         # rejected here — failing later inside _write_imported_pages
         # would abort the whole serve loop (and every co-resident
-        # request) for one bad payload
+        # request) for one bad payload. ALL mismatches ride one error:
+        # a ragged payload usually disagrees in several pools at once,
+        # and the first-mismatch-only message made the operator fix
+        # and resubmit once per pool (satellite fix, ISSUE 17)
         n_pages = payload.num_pages
+        bad = []
         for i, a in enumerate(payload.kv):
             want = (n_pages,) + tuple(self._kv[i].shape[1:])
             if tuple(a.shape) != want:
-                raise ValueError(
-                    f"kv_import pool {i} shape {tuple(a.shape)} != "
-                    f"{want} (engine page geometry x {n_pages} pages)")
+                bad.append(f"pool {i} shape {tuple(a.shape)} != {want}")
         for i, a in enumerate(payload.scales):
             want = (n_pages,) + tuple(self._kv_scales[i].shape[1:])
             if tuple(a.shape) != want:
-                raise ValueError(
-                    f"kv_import scale plane {i} shape "
-                    f"{tuple(a.shape)} != {want}")
+                bad.append(f"scale plane {i} shape {tuple(a.shape)} "
+                           f"!= {want}")
+        if bad:
+            raise ValueError(
+                f"kv_import geometry mismatch (engine page geometry "
+                f"x {n_pages} pages), {len(bad)} failing arrays: "
+                + "; ".join(bad))
         if not 0 <= payload.n_prefilled <= req.prompt_len - 1:
             raise ValueError(
                 f"kv_import n_prefilled {payload.n_prefilled} outside "
@@ -1105,6 +1184,168 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         self.stats["kv_pages_imported"] = (
             self.stats.get("kv_pages_imported", 0) + len(page_ids))
         _KV_PAGES_STREAMED.inc(len(page_ids))
+
+    # ---- hierarchical KV memory (fleet_serving.kv_tier, ISSUE 17) ----
+
+    def _spill_node(self, node):
+        """RadixPrefixCache spill hook: one dying trie node's pages
+        D2H (synchronous snapshot through the SAME fixed-width gather
+        export uses — the pages are reused the moment `_drop` frees
+        them) and into the tier's spill queue (asynchronous commit —
+        pack + index + disk never touch the engine thread). Keyed by
+        the node's FULL token prefix; the payload carries only the
+        node's own block pages (parents are separate entries).
+        Swallows its own failures: eviction is relieving pool
+        pressure, a lost spill only re-costs the re-prefill."""
+        from .fleet_serving.kv_transfer import KVPagePayload
+        from .fleet_serving.kv_tier import _TIER_EVICTIONS, prefix_key
+
+        try:
+            blocks = []
+            n = node
+            while n.block is not None:
+                blocks.append(n.block)
+                n = n.parent
+            blocks.reverse()
+            toks = np.asarray([t for blk in blocks for t in blk],
+                              np.int32)
+            kv, scales = self._gather_pages(node.pages)
+            payload = KVPagePayload(toks, int(toks.size),
+                                    self.page_size, self.kv_dtype,
+                                    kv, scales)
+            _TIER_EVICTIONS.labels(tier="hbm").inc(len(node.pages))
+            if self.kv_tier.put(prefix_key(toks), payload):
+                self._spill_count += 1
+                self.stats["kv_pages_spilled"] = (
+                    self.stats.get("kv_pages_spilled", 0)
+                    + len(node.pages))
+        except Exception:   # never block the eviction path
+            self.stats["kv_spill_errors"] = (
+                self.stats.get("kv_spill_errors", 0) + 1)
+
+    def _prefetch_tier(self, req, cached, pages):
+        """Extend a trie match from the spill tiers: for each block
+        past the trie frontier whose prefix the tier holds, allocate
+        fresh pages, scatter the frame H2D through the SAME fixed-width
+        import executable (`_write_imported_pages` — zero recompiles),
+        and re-insert the node so the request (and everyone after it)
+        maps it as an ordinary trie hit. Stops at the first tier miss,
+        a dry pool, or block `pages_per_seq` coverage. Returns the
+        extended (cached, pages); `pages` grows by the engine's OWN
+        alloc references (released through the ordinary request-page
+        path, exactly like match()'s share references)."""
+        from .fleet_serving.kv_tier import prefix_key
+
+        bt = self.prefix_cache.block_tokens
+        ppb = self.prefix_cache.pages_per_block
+        toks = req.tokens
+        hit = False
+        while (cached + bt <= len(toks)
+               and (len(pages) + ppb) <= self.pages_per_seq):
+            payload = self.kv_tier.get(prefix_key(toks[:cached + bt]))
+            if payload is None:      # tier miss (or a rotten frame)
+                break
+            new_pages = []
+            try:
+                for _ in range(ppb):
+                    new_pages.append(self._alloc_page())
+            except PoolExhausted:
+                # prefetch must never starve the request's own prompt
+                # pages — give back and serve what we have
+                self.pool.free(new_pages)
+                break
+            self._write_imported_pages(new_pages, payload)
+            self.prefix_cache.insert(toks[:cached + bt],
+                                     pages + new_pages)
+            pages.extend(new_pages)
+            cached += bt
+            hit = True
+            self.stats["kv_pages_prefetched"] = (
+                self.stats.get("kv_pages_prefetched", 0) + ppb)
+        if hit:
+            req.trace.stamp("kv_prefetch")
+        return cached, pages
+
+    def export_prefix(self, tokens):
+        """Cut the trie's longest cached prefix of `tokens` into a
+        `KVPagePayload` — the cross-replica migration source (router
+        `_migrate`; docs/SERVING.md "KV memory hierarchy"). The
+        payload satisfies the kv_import frontier contract for a
+        request with these exact tokens (n_prefilled <= len-1, page
+        count exact), so the pulling replica admits it through the
+        ordinary import scatter — zero recompiles on either engine —
+        and publishes it into ITS trie at the first window boundary.
+        Returns None when nothing is cached. Engine-thread only (rides
+        the LLMServer control queue)."""
+        if self.prefix_cache is None:
+            return None
+        from .fleet_serving.kv_transfer import KVPagePayload
+
+        toks = np.asarray(tokens).reshape(-1)
+        cached, pages = self.prefix_cache.match(toks)
+        bt = self.prefix_cache.block_tokens
+        # the import contract leaves the frontier token to the decode
+        # side: a fully-covered prompt exports one block less
+        while pages and cached >= toks.size:
+            cached -= self.prefix_cache.cow_split(pages)
+        if not pages:
+            return None
+        kv, scales = self._gather_pages(pages)
+        self.pool.free(pages)   # match()'s share refs, returned
+        self.stats["kv_pages_migrated_out"] = (
+            self.stats.get("kv_pages_migrated_out", 0) + cached // bt
+            * self.prefix_cache.pages_per_block)
+        return KVPagePayload(toks, cached, self.page_size,
+                             self.kv_dtype, kv, scales)
+
+    # ---- persistent chat sessions (ISSUE 17) ----
+
+    def _touch_session(self, sid):
+        """Create/refresh one session entry; TTL/LRU-expire the rest.
+        Returns True when the session already existed (a RETURNING
+        turn — the resume-telemetry precondition). Engine thread (and
+        add_request callers driving the engine directly)."""
+        from .fleet_serving.kv_tier import _SESSION_ACTIVE
+
+        now = _time.perf_counter()
+        seen = sid in self._sessions
+        ent = self._sessions.pop(sid, None) or {"turns": 0}
+        ent["last_used"] = now
+        self._sessions[sid] = ent
+        # cheap sweep at the LRU head: expiry only ever drops the
+        # TRACKING entry — the session's KV ages out through the
+        # ordinary trie-LRU -> tier-LRU path like any other prefix
+        while self._sessions:
+            head = next(iter(self._sessions))
+            if (len(self._sessions) > self.session_max
+                    or (now - self._sessions[head]["last_used"]
+                        > self.session_ttl_s)):
+                del self._sessions[head]
+            else:
+                break
+        _SESSION_ACTIVE.set(len(self._sessions))
+        return seen
+
+    def _publish_session(self, req):
+        """Pin a finished session turn: insert EVERY full block of the
+        final token sequence — generated tokens included, unlike the
+        prompt-only `_publish_prefix` — so the next turn (whose prompt
+        embeds this turn's history) resumes from the conversation
+        frontier. The trie holds the reference after `_release` frees
+        the request's own ('pinned'); under pool pressure the blocks
+        spill to the tier like any node ('tiered')."""
+        if (req.session_id is None or self.prefix_cache is None
+                or self._brownout.get("session_pin", True) is False):
+            return
+        bt = self.hash_block_tokens
+        ppb = self.prefix_cache.pages_per_block
+        nb = req.n_prefilled // bt     # only KV-written rows publish
+        if nb:
+            self.prefix_cache.insert(req.tokens[:nb * bt],
+                                     req.pages[:nb * ppb])
+        ent = self._sessions.get(req.session_id)
+        if ent is not None:
+            ent["turns"] += 1
 
     def _finish_prefill(self, slot, req):
         """Retire a prefill-only request AT its frontier: export the
@@ -1198,7 +1439,27 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             "request_phase_seconds": _reqtrace.phase_summary(),
             "recent_requests": list(self._timelines),
             "executables": self._step_fn.cache_size(),
+            "kv_tier": self._tier_metrics(),
+            "sessions": {"active": len(self._sessions),
+                         "resumed": self.stats.get("sessions_resumed",
+                                                   0),
+                         "shed": self.stats.get("sessions_shed", 0)},
         }
+
+    def _tier_metrics(self):
+        """kv_tier block of `metrics()`: None without a tier; else the
+        store snapshot, with the hbm rung's gauges published alongside
+        (the tier store only sees ram/disk — the device pool IS the
+        top rung, so its live-page footprint reports here)."""
+        if self.kv_tier is None:
+            return None
+        from .fleet_serving.kv_tier import _TIER_BYTES, _TIER_PAGES
+
+        live = self.pool.num_live
+        per_page = self.pool_bytes() / max(1, self.pool.num_pages)
+        _TIER_PAGES.labels(tier="hbm").set(live)
+        _TIER_BYTES.labels(tier="hbm").set(int(live * per_page))
+        return self.kv_tier.snapshot()
 
     def _spec_metrics(self):
         """Speculative-decoding block of `metrics()`: None without a
@@ -1392,6 +1653,17 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                     r.draft_prefilled = 0   # draft pool is cold: replay
             _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(
                 self.pool_bytes())
+        # ladder L4: shed session pinning BEFORE shedding traffic —
+        # only the TRACKING entries drop (future turns stop resuming);
+        # already-pinned trie blocks age out through ordinary trie LRU
+        if caps.get("session_pin", True) is False and self._sessions:
+            from .fleet_serving.kv_tier import _SESSION_ACTIVE
+
+            self.stats["sessions_shed"] = (
+                self.stats.get("sessions_shed", 0)
+                + len(self._sessions))
+            self._sessions.clear()
+            _SESSION_ACTIVE.set(0)
 
     def close(self):
         """Retire the engine: drop the prefix trie (its clear()
@@ -1401,6 +1673,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         stays usable — the trie just starts cold."""
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
+        if self.kv_tier is not None:
+            self.kv_tier.close()
 
     # ---- scheduler ----
 
@@ -1417,6 +1691,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         self._slot_gen += 1  # membership changed: staged arrays stale
 
     def _finish(self, slot, req):
+        # session pinning reads req.pages — must precede the release
+        self._publish_session(req)
         self._release(slot, req)
         self.stats["finished"] += 1
         _FINISHED_TOTAL.inc()
@@ -1479,6 +1755,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         KV write may not land in a shared page — a fully-cached prompt
         splits its tail block back to private recompute."""
         cached, pages = self.prefix_cache.match(req.tokens)
+        if self.kv_tier is not None:
+            # extend the trie frontier from the spill tiers BEFORE the
+            # COW cap: a prefetched block re-enters the trie, so the
+            # fully-covered case splits its tail back like any hit
+            cached, pages = self._prefetch_tier(req, cached, pages)
         splits = 0
         while pages and cached >= len(req.tokens):
             cached -= self.prefix_cache.cow_split(pages)
@@ -1509,6 +1790,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         preemption as pressure valves), page-table setup. Returns False
         — with every transient reference released — when the request
         cannot be placed yet."""
+        spills0 = self._spill_count    # kv_spill phase stamp baseline
         # cheap bails FIRST — a blocked head-of-queue request must not
         # pay a full prefix match, a share/free refcount round-trip,
         # and an O(trie) feasibility walk on every engine tick.
@@ -1629,6 +1911,22 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             _ADMIT_SECONDS.observe(req.t_first_admit - req.t_submit)
         # phase stamps (first-wins: a preemption replay re-admits
         # without rewriting the original timeline)
+        if self._spill_count > spills0:
+            # this admission's pool pressure pushed trie pages to the
+            # spill tier (prefix_cache.evict -> _spill_node)
+            req.trace.stamp("kv_spill")
+        if self.kv_tier is not None and req.cached_prefix > 0:
+            from .fleet_serving.kv_tier import _TIER_HITS
+
+            _TIER_HITS.labels(tier="hbm").inc()
+        if (req.session_id is not None and req._session_seen
+                and req.cached_prefix > 0):
+            from .fleet_serving.kv_tier import _SESSION_RESUMED
+
+            req._session_seen = False   # one resume per turn, not replay
+            _SESSION_RESUMED.inc()
+            self.stats["sessions_resumed"] = (
+                self.stats.get("sessions_resumed", 0) + 1)
         if req.n_prefilled < len(req.tokens) - 1:
             req.trace.stamp("prefill_start")
         else:
@@ -2180,7 +2478,8 @@ class LLMServer(_FutureQueueServer):
     def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
                tenant="default", priority=None, ttft_slo_s=None,
                temperature=0.0, top_p=1.0, prefill_only=False,
-               kv_import=None, trace=None, deadline_s=None):
+               kv_import=None, trace=None, deadline_s=None,
+               session_id=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
         after it) — or, with `prefill_only=True`, to the exported
@@ -2195,7 +2494,14 @@ class LLMServer(_FutureQueueServer):
         token-budget fair queuing, `priority` is a
         `fleet_serving.Priority` class (default STANDARD), and
         `ttft_slo_s` sets this request's TTFT SLO for deadline
-        boosting and the attainment gauge.
+        boosting and the attainment gauge. `session_id` marks the
+        request as one turn of a persistent chat session (docs/
+        SERVING.md "KV memory hierarchy"): its FINAL token sequence —
+        generated tokens included — is pinned into the prefix trie on
+        finish, so the next turn's prompt (which embeds this turn's
+        history) resumes from the conversation frontier instead of
+        re-prefilling it; under pool pressure the pinned blocks spill
+        to the host/disk tier and prefetch back on resume.
 
         Sampling: `temperature` 0 (default) decodes greedily,
         token-identical to generate(); > 0 samples the temperature-
@@ -2221,7 +2527,21 @@ class LLMServer(_FutureQueueServer):
             priority=priority, ttft_slo_s=ttft_slo_s,
             temperature=float(temperature), top_p=float(top_p),
             prefill_only=bool(prefill_only), kv_import=kv_import,
-            trace=trace, deadline_s=deadline_s))
+            trace=trace, deadline_s=deadline_s,
+            session_id=session_id))
+        return fut
+
+    def export_prefix(self, tokens):
+        """Cut the engine trie's longest cached prefix of `tokens`
+        into a `KVPagePayload` (or None) — the hot-prefix migration
+        source the router's pull path calls on the donor replica. The
+        cut runs on the ENGINE thread (a control message on the same
+        queue as submissions — the trie and pools are engine-thread
+        state); this returns a Future resolving to the payload."""
+        fut = Future()
+        self._enqueue({"_export_prefix":
+                       np.asarray(tokens).reshape(-1),
+                       "_export_future": fut})
         return fut
 
     def generate(self, prompt, max_new_tokens=32, eos_token_id=None):
@@ -2249,6 +2569,17 @@ class LLMServer(_FutureQueueServer):
                                                        False))
             except Exception:     # never kill the serve loop
                 pass
+            return
+        if "_export_prefix" in payload:
+            fut = payload["_export_future"]
+            try:
+                res = self._engine.export_prefix(
+                    payload["_export_prefix"])
+                if not fut.cancelled():
+                    fut.set_result(res)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
             return
         fut = payload.pop("future")
         if fut.cancelled():

@@ -48,7 +48,8 @@ __all__ = ["TraceContext", "new_trace", "quiet_trace", "PHASES",
 # chain is temporal, not positional — a request only ever takes the
 # stamps its path crosses (no router -> no `routed`; no disaggregation
 # -> no kv_* stamps) and segments pair consecutive PRESENT stamps
-PHASES = ("queued", "routed", "prefill_start", "prefill_end",
+PHASES = ("queued", "routed", "kv_spill", "kv_prefetch",
+          "prefill_start", "prefill_end",
           "kv_export", "kv_transfer", "kv_import",
           "first_decode_dispatch", "first_token")
 

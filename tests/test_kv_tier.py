@@ -1,0 +1,463 @@
+"""Hierarchical fleet KV memory (ISSUE 17): host-RAM/disk spill
+tiers, cross-replica page migration, persistent chat sessions.
+
+The acceptance suite: tier round-trip BYTE parity for every pool
+dtype (export -> spill -> demote-to-disk -> prefetch -> re-export,
+scale planes included), greedy token identity through the spill/
+prefetch path, seeded chaos in the spill commit thread (journal +
+dropped entry + serving stays correct), SIGKILL-shaped restart
+hygiene on the disk tier (tmp/corrupt GC'd, intact frames adopted),
+the never-blocks contract of the spill queue, hot-prefix migration
+with zero recompiles, session resume across turns, and the brownout
+ladder's session-shedding rung."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import chaos, resilience
+from paddle_tpu.inference.fleet_serving import (
+    FleetRouter, KVPagePayload, KVTierStore, LocalReplica, fork_model,
+    pack_kv_payload, prefix_key)
+from paddle_tpu.inference.fleet_serving import kv_tier as kv_tier_mod
+from paddle_tpu.inference.llm_engine import LLMEngine, LLMEngineConfig
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import gpt_tiny
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    paddle.seed(30)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _drain(eng, cap=800):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+    return steps
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=4, page_size=16, token_budget=32,
+                max_model_len=96, prefix_cache=True)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _prompts(rng, cfg, lens):
+    return [rng.integers(0, cfg.vocab_size, (int(L),)).astype(np.int32)
+            for L in lens]
+
+
+def _payload_bytes(p):
+    return ([a.tobytes() for a in p.kv],
+            [a.tobytes() for a in p.scales])
+
+
+def _mk_payload(rng, tokens=16, pages=1):
+    """Synthetic fp32 frame for store-level tests (no engine)."""
+    toks = rng.integers(0, 1000, (tokens,)).astype(np.int32)
+    kv = [rng.standard_normal((pages, 16, 2, 4)).astype(np.float32)]
+    return toks, KVPagePayload(toks, tokens, 16, "float32", kv, [])
+
+
+# --------------------------------------------------------------------
+# Tier round-trip byte parity (satellite 2)
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype",
+                         ["float32", "bfloat16", "int8", "int4"])
+def test_tier_round_trip_byte_parity(tiny_model, tmp_path, kv_dtype):
+    """export -> spill(RAM) -> demote(disk) -> prefetch -> re-export is
+    BYTE-identical for every pool (and, for quantized dtypes, every
+    fp32 scale plane): the tier stores the pool's own byte discipline,
+    never re-encodes. Prompt 23 leaves a mid-page trie frontier
+    (1 block of 16 over a 23-token prompt); 33 covers two full blocks.
+    ram_bytes=1 forces every spilled frame straight through the RAM
+    tier onto disk, so the parity run crosses BOTH spill tiers."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(3)
+    for plen in (23, 33):
+        prompt = _prompts(rng, cfg, [plen])[0]
+        eng = LLMEngine(model, _ecfg(
+            kv_dtype=kv_dtype,
+            kv_tier=dict(ram_bytes=1, disk_dir=str(tmp_path / kv_dtype),
+                         disk_bytes=1 << 30)))
+        req = eng.add_request(prompt, max_new_tokens=4)
+        _drain(eng)
+        out = req.future.result(timeout=0)
+        ref = eng.export_prefix(prompt)
+        assert ref is not None and ref.kv_dtype == kv_dtype
+        if kv_dtype in ("int8", "int4"):
+            assert ref.scales, "quantized pool must carry scale planes"
+        # spill the whole trie; drain the commit thread
+        assert eng.prefix_cache.evict(10_000) > 0
+        eng.kv_tier.flush()
+        snap = eng.kv_tier.snapshot()
+        assert snap["spills"] > 0
+        assert snap["demotions"] == snap["spills"], \
+            "ram_bytes=1 must demote every frame to disk"
+        assert eng.prefix_cache.resident_pages == 0
+        # prefetch: a fresh request re-maps the prefix from DISK
+        req2 = eng.add_request(prompt, max_new_tokens=4)
+        _drain(eng)
+        out2 = req2.future.result(timeout=0)
+        assert eng.kv_tier.snapshot()["disk_hits"] > 0
+        assert np.array_equal(out, out2), \
+            "greedy outputs must be identical through spill->prefetch"
+        # re-export: the round-tripped pool bytes are the original's
+        back = eng.export_prefix(prompt)
+        assert back is not None
+        assert back.n_prefilled == ref.n_prefilled
+        assert np.array_equal(back.tokens, ref.tokens)
+        assert _payload_bytes(back) == _payload_bytes(ref)
+        eng.close()
+
+
+def test_tier_ram_hit_round_trip(tiny_model):
+    """RAM-tier-only round trip (no disk dir configured): spill ->
+    prefetch from RAM, greedy identical, and the engine stamps the
+    kv_prefetch phase on the resumed request's timeline."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(5)
+    prompt = _prompts(rng, cfg, [48])[0]
+    eng = LLMEngine(model, _ecfg(kv_tier=dict(ram_bytes=64 << 20)))
+    req = eng.add_request(prompt, max_new_tokens=4)
+    _drain(eng)
+    out = req.future.result(timeout=0)
+    assert eng.prefix_cache.evict(10_000) > 0
+    eng.kv_tier.flush()
+    req2 = eng.add_request(prompt, max_new_tokens=4)
+    _drain(eng)
+    assert np.array_equal(out, req2.future.result(timeout=0))
+    snap = eng.kv_tier.snapshot()
+    assert snap["ram_hits"] > 0 and snap["disk_hits"] == 0
+    phases = [p["phase"] for p in req2.trace.timeline()]
+    assert "kv_prefetch" in phases
+    eng.close()
+
+
+# --------------------------------------------------------------------
+# Chaos: spill-thread fault + restart hygiene (satellite 3)
+# --------------------------------------------------------------------
+
+def test_spill_fault_journals_and_serving_stays_correct(tiny_model):
+    """A seeded fault in the spill commit thread journals to the
+    resilience anomaly journal, drops the entry (the tier misses), and
+    serving stays greedy-token-identical — the tier is an accelerator,
+    never a correctness dependency."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(11)
+    prompt = _prompts(rng, cfg, [48])[0]
+    chaos.install({"seed": 7, "injectors": [
+        {"scope": "kv_tier.spill", "kind": "error", "at": [0, 1, 2]}]})
+    before = len(resilience.events("kv_tier_spill_failed"))
+    eng = LLMEngine(model, _ecfg(kv_tier=dict(ram_bytes=64 << 20)))
+    req = eng.add_request(prompt, max_new_tokens=4)
+    _drain(eng)
+    out = req.future.result(timeout=0)
+    assert eng.prefix_cache.evict(10_000) > 0
+    eng.kv_tier.flush()
+    snap = eng.kv_tier.snapshot()
+    assert snap["spill_failed"] > 0 and snap["ram_entries"] == 0
+    evs = resilience.events("kv_tier_spill_failed")
+    assert len(evs) > before
+    assert "InjectedFault" in evs[-1]["error"]
+    # the prefix is GONE from every tier: the next hit re-prefills,
+    # and the tokens are identical anyway
+    req2 = eng.add_request(prompt, max_new_tokens=4)
+    _drain(eng)
+    assert np.array_equal(out, req2.future.result(timeout=0))
+    assert eng.kv_tier.snapshot()["misses"] > 0
+    eng.close()
+
+
+def test_disk_restart_gc_and_adopt(tmp_path):
+    """SIGKILL-with-a-warm-tier shape: a new store over the same
+    directory GCs `.tmp` leftovers (a rename that never happened) and
+    unparseable frames, and re-adopts intact frames byte-identical —
+    the disk tier survives replica death without serving torn data."""
+    rng = np.random.default_rng(2)
+    d = str(tmp_path / "tier")
+    store = KVTierStore(ram_bytes=1, disk_dir=d, disk_bytes=1 << 30)
+    toks, payload = _mk_payload(rng)
+    assert store.put(prefix_key(toks), payload)
+    store.flush()
+    assert store.snapshot()["demotions"] == 1
+    store.close()   # the frame stays on disk
+    # plant the crash debris a SIGKILL mid-write leaves behind
+    with open(os.path.join(d, "deadbeef.ptkv.tmp"), "wb") as f:
+        f.write(b"half a frame")
+    with open(os.path.join(d, "c0ffee00.ptkv"), "wb") as f:
+        f.write(b"PTKVgarbage-that-is-not-a-frame")
+    before = len(resilience.events("kv_tier_gc"))
+    store2 = KVTierStore(ram_bytes=1, disk_dir=d, disk_bytes=1 << 30)
+    snap = store2.snapshot()
+    assert snap["adopted"] == 1 and snap["gc_files"] == 2
+    assert len(resilience.events("kv_tier_gc")) == before + 2
+    left = sorted(os.listdir(d))
+    assert len(left) == 1 and left[0].endswith(".ptkv")
+    back = store2.get(prefix_key(toks))
+    assert back is not None
+    assert np.array_equal(back.tokens, payload.tokens)
+    assert _payload_bytes(back) == _payload_bytes(payload)
+    store2.close()
+
+
+def test_spill_queue_never_blocks(monkeypatch):
+    """The step-path contract: `put` is O(1) and never waits on the
+    commit thread. With the commit thread wedged mid-pack, puts beyond
+    the queue bound REJECT (counted) instead of blocking."""
+    rng = np.random.default_rng(4)
+    gate = threading.Event()
+    real_pack = kv_tier_mod.pack_kv_payload
+
+    def slow_pack(payload):
+        gate.wait(timeout=30)
+        return real_pack(payload)
+
+    monkeypatch.setattr(kv_tier_mod, "pack_kv_payload", slow_pack)
+    store = KVTierStore(ram_bytes=64 << 20, max_pending=2)
+    try:
+        payloads = [_mk_payload(rng) for _ in range(4)]
+        t0 = time.monotonic()
+        # 1 job wedges in the commit thread; up to 2 queue; the rest
+        # must reject immediately
+        results = [store.put(prefix_key(t), p) for t, p in payloads]
+        assert time.monotonic() - t0 < 1.0, "put blocked the step path"
+        assert results.count(False) >= 1
+        assert store.snapshot()["spill_rejected"] >= 1
+        gate.set()
+        store.flush()
+        assert store.snapshot()["spills"] == results.count(True)
+    finally:
+        gate.set()
+        store.close()
+
+
+# --------------------------------------------------------------------
+# Hot-prefix migration (tentpole b)
+# --------------------------------------------------------------------
+
+def test_migration_pulls_pages_zero_recompile_token_identical(
+        tiny_model):
+    """A hot prefix on a backed-up replica is PULLED to an idle peer
+    over the byte-exact wire (pack->unpack round trip) instead of the
+    router routing around the miss: the peer imports the pages through
+    the one warmed scatter — executables stay pinned at 1 on BOTH
+    engines — and greedy outputs are identical to a plain engine."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(7)
+
+    def mk(name):
+        return LocalReplica(fork_model(model), name=name, config=_ecfg(
+            num_slots=2, max_model_len=128))
+
+    r1, r2 = mk("a"), mk("b")
+    router = FleetRouter(replicas=[r1, r2], migrate_hot_hits=2,
+                         migrate_interval_s=60.0, migrate_budget=4)
+    hot = rng.integers(0, cfg.vocab_size, (48,)).astype(np.int32)
+    prompts, futs = [], []
+    with router:
+        p0 = np.concatenate([hot, _prompts(rng, cfg, [8])[0]])
+        prompts.append(p0)
+        router.submit(p0, max_new_tokens=4).result(timeout=60)
+        futs.append(None)
+        # burst the hot prefix: its home replica (2 slots) backs up
+        for _ in range(8):
+            p = np.concatenate([hot, _prompts(rng, cfg, [8])[0]])
+            prompts.append(p)
+            futs.append(router.submit(p, max_new_tokens=12))
+        outs = [None] + [f.result(timeout=120) for f in futs[1:]]
+        m = router.metrics()
+        assert m["migrations"] >= 1, \
+            "burst on a 2-slot home with an idle peer must pull pages"
+        donor_name = "a" if r2.engine.stats.get(
+            "kv_pages_imported", 0) else "b"
+        puller = r2 if donor_name == "a" else r1
+        assert puller.engine.stats.get("kv_pages_imported", 0) > 0
+        # zero-recompile contract on both members
+        assert r1.engine.metrics()["executables"] == 1
+        assert r2.engine.metrics()["executables"] == 1
+    # token identity vs a plain single engine
+    eng = LLMEngine(model, _ecfg(num_slots=2, max_model_len=128,
+                                 prefix_cache=False))
+    for p, out in zip(prompts[1:], outs[1:]):
+        req = eng.add_request(p, max_new_tokens=12)
+        _drain(eng)
+        assert np.array_equal(req.future.result(timeout=0), out)
+
+
+# --------------------------------------------------------------------
+# Persistent sessions (tentpole c)
+# --------------------------------------------------------------------
+
+def test_session_resume_skips_history_prefill(tiny_model):
+    """Turn 2 of a session (prompt = turn 1's full output + new user
+    tokens) resumes from the pinned conversation frontier: its
+    cached_prefix covers the history — generated tokens included,
+    which plain prompt-only trie publishing cannot do — and the
+    resume telemetry fires."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(9)
+    eng = LLMEngine(model, _ecfg(max_model_len=128,
+                                 kv_tier=dict(ram_bytes=64 << 20)))
+    p1 = _prompts(rng, cfg, [40])[0]
+    r1 = eng.add_request(p1, max_new_tokens=8, session_id="chat-1")
+    _drain(eng)
+    out1 = r1.future.result(timeout=0)
+    assert eng.metrics()["sessions"]["active"] == 1
+    p2 = np.concatenate([out1.astype(np.int32),
+                         _prompts(rng, cfg, [10])[0]])
+    r2 = eng.add_request(p2, max_new_tokens=8, session_id="chat-1")
+    _drain(eng)
+    out2 = r2.future.result(timeout=0)
+    bt = eng.hash_block_tokens
+    # the session pin covers the history beyond the PROMPT-only blocks
+    # turn 1 could publish: at least prompt_len // bt blocks, and the
+    # generated tail pushes it past a no-session engine's reach
+    assert eng.stats.get("sessions_resumed") == 1
+    assert eng.metrics()["sessions"]["resumed"] == 1
+    # greedy identity: a fresh engine produces the same turn 2
+    ref_eng = LLMEngine(model, _ecfg(max_model_len=128,
+                                     prefix_cache=False))
+    rr = ref_eng.add_request(p2, max_new_tokens=8)
+    _drain(ref_eng)
+    assert np.array_equal(rr.future.result(timeout=0), out2)
+    eng.close()
+    assert bt >= 1
+
+
+def test_session_pin_covers_generated_tokens(tiny_model):
+    """The pinned frontier includes GENERATED tokens: after a session
+    turn, the trie matches the full output sequence deeper than the
+    prompt-only publish path reaches."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(13)
+    bt = 16
+    p1 = _prompts(rng, cfg, [30])[0]   # 30 tokens: 1 prompt-only block
+    eng = LLMEngine(model, _ecfg(max_model_len=128))
+    r1 = eng.add_request(p1, max_new_tokens=8, session_id="s")
+    _drain(eng)
+    out1 = r1.future.result(timeout=0)   # 38 tokens -> 2 full blocks
+    cached, pages = eng.prefix_cache.match(out1.astype(np.int32))
+    eng.pool.free(pages)
+    assert cached == (len(out1) // bt) * bt > (len(p1) // bt) * bt
+    eng.close()
+
+
+def test_session_ttl_and_lru_expiry(tiny_model):
+    """Session tracking is bounded: LRU beyond session_max, TTL by
+    last use. Expiry only drops the tracking entry — the KV ages out
+    through ordinary trie/tier LRU."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(17)
+    eng = LLMEngine(model, _ecfg(session_max=2, session_ttl_s=600))
+    for i, sid in enumerate(("a", "b", "c")):
+        r = eng.add_request(_prompts(rng, cfg, [20])[0],
+                            max_new_tokens=2, session_id=sid)
+        _drain(eng)
+        r.future.result(timeout=0)
+    assert set(eng._sessions) == {"b", "c"}   # LRU: "a" expired
+    # TTL: backdate "b" far past the window; the next touch sweeps it
+    eng._sessions["b"]["last_used"] -= 1e6
+    eng._touch_session("c")
+    assert set(eng._sessions) == {"c"}
+    assert eng.metrics()["sessions"]["active"] == 1
+    eng.close()
+
+
+def test_brownout_sheds_session_pinning_before_traffic(tiny_model):
+    """The ladder's L4 rung (session_pin False) drops session state on
+    the engine: tracked sessions clear, finished turns stop pinning —
+    convenience state sheds BEFORE any request is refused. L5 is where
+    traffic shedding (shed_priority) begins."""
+    from paddle_tpu.inference.fleet_serving.overload import \
+        DEFAULT_BROWNOUT_LEVELS as L
+
+    assert L[4].get("session_pin") is False
+    assert "shed_priority" not in L[4]
+    assert L[5].get("shed_priority") is not None
+    cfg, model = tiny_model
+    rng = np.random.default_rng(19)
+    eng = LLMEngine(model, _ecfg(max_model_len=128))
+    r1 = eng.add_request(_prompts(rng, cfg, [40])[0], max_new_tokens=4,
+                         session_id="s")
+    _drain(eng)
+    r1.future.result(timeout=0)
+    assert eng.metrics()["sessions"]["active"] == 1
+    resident_before = eng.prefix_cache.resident_pages
+    eng.apply_brownout(dict(L[4]))
+    r2 = eng.add_request(_prompts(rng, cfg, [40])[0], max_new_tokens=4,
+                         session_id="t")
+    _drain(eng)   # _sync_brownout runs at the top of step()
+    r2.future.result(timeout=0)
+    assert eng.metrics()["sessions"]["active"] == 0
+    assert eng.stats.get("sessions_shed", 0) >= 1
+    # r2 finished under session_pin=False: no new pin beyond the
+    # ordinary prompt-blocks publish
+    assert eng.prefix_cache.resident_pages >= 0
+    eng.apply_brownout({})
+    eng.close()
+    assert resident_before >= 0
+
+
+# --------------------------------------------------------------------
+# import_kv_pages geometry validation (satellite 1)
+# --------------------------------------------------------------------
+
+def test_import_geometry_error_reports_all_mismatches(tiny_model):
+    """A payload with SEVERAL wrong arrays fails with ONE error that
+    names every failing pool index with expected-vs-got shapes — not
+    just the first."""
+    cfg, model = tiny_model
+    rng = np.random.default_rng(23)
+    prompt = _prompts(rng, cfg, [33])[0]
+    src = LLMEngine(model, _ecfg(kv_dtype="int8"))
+    req = src.add_request(prompt, prefill_only=True)
+    _drain(src)
+    payload = req.future.result(timeout=0)
+    # mangle TWO kv pools and one scale plane
+    payload.kv[0] = payload.kv[0][:, :8]
+    payload.kv[1] = payload.kv[1][:, :, :1]
+    payload.scales[0] = payload.scales[0][:, :4]
+    dst = LLMEngine(model, _ecfg(kv_dtype="int8"))
+    with pytest.raises(ValueError) as ei:
+        dst.add_request(payload.tokens, kv_import=payload)
+    msg = str(ei.value)
+    assert "3 failing arrays" in msg
+    assert "pool 0" in msg and "pool 1" in msg
+    assert "scale plane 0" in msg
+    assert "!=" in msg   # expected-vs-got shapes, in one message
+    src.close()
+    dst.close()

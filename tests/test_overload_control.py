@@ -192,28 +192,33 @@ def test_brownout_hysteresis_journal_and_dwell():
     while ctl.level < len(ctl.levels) - 1:
         ctl.note_pressure(9.0, now=t)
         t += 0.1
-    assert ctl.level == 4
+    assert ctl.level == 5
     assert ctl.shed_priority() == int(Priority.BATCH)
     assert ctl.caps()["spec_enabled"] is False
+    # the session-pin rung sits BELOW every traffic-shedding rung:
+    # state sheds before requests do (ISSUE 17)
+    assert ctl.levels[4].get("session_pin") is False
+    assert "shed_priority" not in ctl.levels[4]
+    assert ctl.caps()["session_pin"] is False
     # saturated: more hot ticks do not overflow the ladder
     ctl.note_pressure(9.0, now=t)
     ctl.note_pressure(9.0, now=t + 0.1)
-    assert ctl.level == 4
+    assert ctl.level == 5
     # cool ticks step UP only after recover_ticks in a row
     t += 1.0
     ctl.note_pressure(0.0, now=t)
     ctl.note_pressure(0.0, now=t + 0.1)
-    assert ctl.level == 4
+    assert ctl.level == 5
     ctl.note_pressure(0.0, now=t + 0.2)
-    assert ctl.level == 3
+    assert ctl.level == 4
     # the journal recorded every transition, in order
     hops = [(j["from"], j["to"]) for j in ctl.journal]
-    assert hops == [(0, 1), (1, 2), (2, 3), (3, 4), (4, 3)]
+    assert hops == [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 4)]
     # dwell accounting covers all time since the first tick
     dw = ctl.dwell(now=t + 0.2)
     assert len(dw) == len(ctl.levels)
     assert abs(sum(dw) - (t + 0.2)) < 1e-6
-    assert ctl.snapshot()["transitions"] == 5
+    assert ctl.snapshot()["transitions"] == 6
 
 
 def test_brownout_disabled_is_inert():
