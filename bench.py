@@ -77,15 +77,12 @@ def _worker_bootstrap():
 
 def gpt_flops_per_step(cfg, batch, seq):
     """Analytic fwd+bwd FLOPs: 6·P per token for matmuls (fwd 2P + bwd 4P)
-    plus causal attention scores/context terms."""
-    d, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
-    ffn = cfg.ffn_size
-    per_layer = 4 * d * d + 2 * d * ffn   # qkv+proj, fc1+fc2 weights
-    p_matmul = L * per_layer + v * d      # + tied lm head
-    tokens = batch * seq
-    matmul = 6 * p_matmul * tokens
-    attn = L * batch * (4 * seq * seq * d) * 3 * 0.5  # fwd+2×bwd, causal
-    return matmul + attn
+    plus causal attention scores/context terms. ONE accountant shared
+    with the live pt_train_mfu gauge (observability.steptrace) — bench
+    math and continuous telemetry must agree on the numerator."""
+    from paddle_tpu.observability.steptrace import model_flops
+
+    return model_flops(cfg, batch, seq)
 
 
 def bench_gpt():
@@ -1876,6 +1873,90 @@ def bench_tracing_overhead_ab():
             "trace_events_full": events_full}
 
 
+def bench_steptrace_overhead_ab():
+    """Steptrace overhead A/B (ISSUE-18 satellite): the SAME train-step
+    workload run once per telemetry mode — `full` (phase stamps + chrome
+    step events + flight feed + grad-norm aux live) vs `metrics` —
+    interleaved F/M/F/M, each side scoring its best run. Bar: full-mode
+    wall time <= 1.05x metrics mode, and the per-step losses must be
+    BIT-identical across modes (the phase plane must observe the step,
+    never perturb its numerics)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import observability
+    from paddle_tpu.observability import steptrace, tracing
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        GPTPretrainingCriterion)
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64)
+    batch, seq, steps = 8, 32, 30
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seq))
+    crit = GPTPretrainingCriterion()
+    if "PT_TELEMETRY_DIR" not in os.environ:
+        import tempfile
+
+        os.environ["PT_TELEMETRY_DIR"] = tempfile.mkdtemp(
+            prefix="pt_steptrace_ab_")
+
+    def run(mode):
+        prev = observability.set_mode(mode)
+        try:
+            steptrace.reset()
+            steptrace.arm_goodput(
+                flops_per_step=gpt_flops_per_step(cfg, batch, seq),
+                tokens_per_step=batch * seq)
+            paddle.seed(0)
+            m = GPTForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(1e-3,
+                                         parameters=m.parameters())
+            step = paddle.jit.TrainStep(m, lambda mm, i: crit(mm(i), i),
+                                        opt)
+            ids = paddle.to_tensor(ids_np)
+            step(ids)            # compile (quiet warm-up)
+            step(ids)            # warm
+            losses = []
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                losses.append(step(ids))
+            total = time.perf_counter() - t0
+            loss_vals = [float(lo.numpy()) for lo in losses]
+            summary = steptrace.phase_summary()
+        finally:
+            observability.set_mode(prev)
+            steptrace.reset()
+            tracing.reset()
+        return loss_vals, total, summary
+
+    totals = {"full": [], "metrics": []}
+    ref, match, phases_full = None, True, {}
+    for rep in range(2):
+        for mode in ("full", "metrics"):
+            losses, t, summary = run(mode)
+            totals[mode].append(round(t, 4))
+            if mode == "full":
+                phases_full = summary
+            if ref is None:
+                ref = losses
+            else:
+                match = match and losses == ref   # BIT-identical floats
+            log(f"[bench] steptrace_overhead_ab {mode}[{rep}]: "
+                f"{t:.3f}s for {steps} steps")
+    f_best, m_best = min(totals["full"]), min(totals["metrics"])
+    ratio = f_best / m_best
+    log(f"[bench] steptrace_overhead_ab: full {f_best:.3f}s vs metrics "
+        f"{m_best:.3f}s = {ratio:.3f}x (bar 1.05), loss_match={match}")
+    return {"model": "gpt-bench-4l", "steps": steps,
+            "totals_s": totals,
+            "best_s": {"full": f_best, "metrics": m_best},
+            "overhead_ratio": round(ratio, 4),
+            "within_bar": bool(ratio <= 1.05),
+            "loss_match": bool(match),
+            "phase_seconds_full": phases_full}
+
+
 def bench_probe():
     """Prove the backend can COMPUTE, not just enumerate devices.
 
@@ -1970,6 +2051,39 @@ def bench_train_3d():
             log(f"[bench] train_3d spmd stamp failed: {e!r}")
             spmd = {"per_axis_bytes": {}, "per_axis_counts": {},
                     "num_findings": -1, "error": repr(e)}
+        # steptrace phase breakdown (ISSUE-18): p50/p99 per phase over
+        # a short metrics-mode window. Separate from the timed loop so
+        # the headline ms_per_step trend stays comparable with the
+        # mode-off captures; guarded like the spmd stamp.
+        try:
+            from paddle_tpu import observability
+            from paddle_tpu.observability import steptrace
+
+            prev_mode = observability.set_mode("metrics")
+            steptrace.reset()
+            try:
+                for _ in range(8):
+                    step(ids)
+                recs = steptrace.recent_steps()
+            finally:
+                observability.set_mode(prev_mode)
+                steptrace.reset()
+            phase_samples = {}
+            for r in recs:
+                for e in r["timeline"]:
+                    if e["phase"] == "start":
+                        continue
+                    phase_samples.setdefault(e["phase"],
+                                             []).append(e["dt_s"])
+            breakdown = {
+                p: {"p50_ms": round(
+                        float(np.percentile(v, 50)) * 1e3, 3),
+                    "p99_ms": round(
+                        float(np.percentile(v, 99)) * 1e3, 3)}
+                for p, v in sorted(phase_samples.items())}
+        except Exception as e:
+            log(f"[bench] train_3d phase breakdown failed: {e!r}")
+            breakdown = {"error": repr(e)}
         out[cfg3d.tag()] = {
             **cfg3d.describe(),
             "compile_s": round(compile_s, 2),
@@ -1981,6 +2095,7 @@ def bench_train_3d():
             "collective_bytes_per_axis": spmd["per_axis_bytes"],
             "collective_execs_per_axis": spmd["per_axis_counts"],
             "spmd_findings": spmd["num_findings"],
+            "step_phase_breakdown_ms": breakdown,
         }
         log(f"[bench] train_3d {cfg3d.tag()}: {dt*1e3:.1f} ms/step, "
             f"donation_held={stats['donation']['held']}, "
@@ -2014,6 +2129,23 @@ def bench_train_3d():
                 "ms_per_step": {"exact": base["ms_per_step"],
                                 "quant": rec["ms_per_step"]},
             }
+            # collective-time attribution (ISSUE-18): join the per-axis
+            # byte deltas of the quant on/off twins with their measured
+            # step-time delta -> achieved bytes/s per mesh axis (None
+            # where noise swamps the signal — honest, not invented)
+            try:
+                from paddle_tpu.observability.steptrace import (
+                    collective_bytes_per_second)
+
+                quant_ab[tag]["achieved_axis_bytes_per_s"] = \
+                    collective_bytes_per_second(
+                        rec["collective_bytes_per_axis"],
+                        rec["ms_per_step"] / 1e3,
+                        base["collective_bytes_per_axis"],
+                        base["ms_per_step"] / 1e3)
+            except Exception as e:
+                quant_ab[tag]["achieved_axis_bytes_per_s"] = {
+                    "error": repr(e)}
             log(f"[bench] train_3d quant_ab {tag}: dp bytes "
                 f"{b_dp} -> {q_dp} "
                 f"({quant_ab[tag]['dp_bytes_ratio']}x), loss delta "
@@ -2285,6 +2417,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "llm_fleet_multi": bench_llm_fleet_multi,
             "overload_storm_ab": bench_overload_storm_ab,
             "tracing_overhead_ab": bench_tracing_overhead_ab,
+            "steptrace_overhead_ab": bench_steptrace_overhead_ab,
             "kv_tier_ab": bench_kv_tier_ab,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
@@ -2521,12 +2654,13 @@ def main():
         # acceptance regime, ISSUE 8)
         extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
                   "overload_storm_ab", "tracing_overhead_ab",
-                  "kv_tier_ab", "train_3d")
+                  "steptrace_overhead_ab", "kv_tier_ab", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
                   "llm_fleet_multi", "overload_storm_ab",
-                  "tracing_overhead_ab", "kv_tier_ab", "train_3d")
+                  "tracing_overhead_ab", "steptrace_overhead_ab",
+                  "kv_tier_ab", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both
@@ -2535,6 +2669,7 @@ def main():
         status, res = _run_worker(
             which,
             timeout_s=900 if which.startswith(("llm_", "tracing_",
+                                               "steptrace_",
                                                "overload_", "kv_"))
             else 420,
             extra_env=fallback_env)

@@ -48,6 +48,11 @@ scope               injection point
                     commit marker (THE torn-commit window)
 ``step``            train-step entry (crash/hang at step N; fired by
                     StepGuard.check AND DivergenceSentinel.check)
+``step.dispatch``   inside the instrumented train step, between its
+                    ``h2d`` and ``dispatch`` phase stamps — a
+                    rank-scoped delay here is how the steptrace
+                    straggler chaos test makes ONE rank slow in ONE
+                    attributable phase (observability.steptrace)
 ``step.nan``        StepGuard/DivergenceSentinel loss poisoning
                     (NaN/Inf grad shape)
 ``replica.kill``    fleet-replica serve-loop tick (fleet_serving

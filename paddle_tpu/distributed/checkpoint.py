@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 
 from ..observability import metrics as _obs
+from ..observability import steptrace as _steptrace
 from ..observability.tracing import trace_span as _trace_span
 from ..tensor_core import Tensor
 from . import chaos
@@ -877,6 +878,7 @@ class Checkpointer:
             if opt_sd:
                 state["train_step_opt"] = opt_sd
         _, nproc = _proc_index()
+        t_wall0 = _steptrace.now()
         if nproc == 1 and not self.async_save:
             self._last = self.retry.run(
                 save_state_dict, state, self._dir(step),
@@ -885,6 +887,11 @@ class Checkpointer:
             self._last = save_state_dict(state, self._dir(step),
                                          async_save=self.async_save,
                                          _stall_start=t_stall0)
+        # the synchronous slice of this save (snapshot + commit
+        # hand-off; async commits run off the step path) becomes the
+        # next step's ckpt_snapshot phase segment — the wall-time the
+        # training loop actually lost to checkpointing
+        _steptrace.note_ckpt_snapshot(t_wall0, _steptrace.now())
         self._prune()
         return self._last
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import steptrace as _steptrace
 from ..tensor_core import Tensor
 from . import mesh as mesh_mod
 
@@ -130,6 +131,12 @@ class DistributedTrainStep:
         self._opt_states = None
         self._compiled = None
         self._aot_fallback = None   # retracing jit behind the AOT path
+        # phase-trace state (observability.steptrace): batch-signature
+        # set drives the quiet-warm-up exclusion + recompile sentinel
+        # (same accounting as jit.TrainStep), prev_end anchors the
+        # next step's data_wait segment
+        self._batch_signatures = set()
+        self._steptrace_prev_end = None
 
     # ---- shardings ----
     def _param_shardings(self, objs):
@@ -363,6 +370,10 @@ class DistributedTrainStep:
     _STEP_ARG_NAMES = ("train_vals", "frozen_vals", "opt_state", "lr",
                        "batch", "step_idx", "base_key")
     _donate_argnums = (0, 1, 2)
+    # step-family label for pt_train_phase_seconds flight events and
+    # pt_step_recompiles_total (jit.TrainStep publishes as "train",
+    # HybridTrainStep as "hybrid3d")
+    _steptrace_family = "dist"
 
     def _step_args(self, batch_vals):
         """Positional args of the compiled step for the CURRENT live
@@ -397,15 +408,43 @@ class DistributedTrainStep:
         return {"executables": 1 + int(n_fb)}
 
     def __call__(self, *batch):
+        t_entry = _steptrace.now()
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
+        t_h2d = _steptrace.now()
         if self._compiled is None:
             self._build(batch_vals)
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
+        new_sig = sig not in self._batch_signatures
+        if new_sig:
+            self._batch_signatures.add(sig)
+            if len(self._batch_signatures) > 1:
+                _steptrace.note_recompile(
+                    self._steptrace_family,
+                    step=int(self.optimizer._step_count),
+                    signatures=len(self._batch_signatures),
+                    batch_sig=repr(sig))
+        # phase trace (observability.steptrace): a new batch signature
+        # compiles — run QUIET so the stall stays out of the histograms
+        tr = _steptrace.begin_step(
+            self._steptrace_family, int(self.optimizer._step_count),
+            prev_end=self._steptrace_prev_end, quiet=new_sig,
+            t_entry=t_entry)
+        tr.stamp("h2d", t_h2d)
+        _steptrace.chaos_fire("step.dispatch")
         loss, new_vals, self._opt_states, new_frozen = self._compiled(
             *self._step_args(batch_vals))
+        tr.stamp("dispatch")
+        if _steptrace.active():
+            # device_step = block_until_ready delta (see jit.TrainStep)
+            jax.block_until_ready(
+                (loss, new_vals, self._opt_states, new_frozen))
+            tr.stamp("device_step")
         it = iter(new_vals)
         it_f = iter(new_frozen)
         for p, t in zip(self._param_objs, self._trainable):
             p._value = next(it) if t else next(it_f)
         self.optimizer._step_count += 1
+        tr.stamp("opt_publish")
+        _, self._steptrace_prev_end = _steptrace.end_step(tr)
         return Tensor(loss)

@@ -1146,8 +1146,43 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         recent = sorted(by_trace.values(),
                         key=lambda tl: tl["phases"][0]["t"]
                         if tl.get("phases") else 0.0)
+        # tier-aware autoscale signals (ROADMAP item 2 follow-on): fold
+        # the per-replica kv_tier snapshots (the pt_kv_tier_* family,
+        # fleet_serving/kv_tier.py) into ONE fleet block with hit and
+        # spill-pressure RATES, so the autoscale monitor sees memory
+        # pressure building without scraping every engine view
+        tier_totals, tier_n = {}, 0
+        for info in replicas.values():
+            t = (info.get("engine") or {}).get("kv_tier")
+            if not t:
+                continue
+            tier_n += 1
+            for k, v in t.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    tier_totals[k] = tier_totals.get(k, 0) + v
+        kv_tier = None
+        if tier_n:
+            g = tier_totals.get
+            lookups = g("ram_hits", 0) + g("disk_hits", 0) + g("misses", 0)
+            attempts = (g("spills", 0) + g("spill_failed", 0)
+                        + g("spill_rejected", 0))
+            dropped = (g("spill_rejected", 0) + g("ram_dropped", 0)
+                       + g("disk_dropped", 0))
+            kv_tier = dict(tier_totals)
+            kv_tier.update({
+                "replicas_with_tier": tier_n,
+                # spilled-prefix lookups served below HBM / all lookups
+                "hit_rate": ((g("ram_hits", 0) + g("disk_hits", 0))
+                             / lookups) if lookups else None,
+                # fraction of spill attempts the tier had to reject or
+                # age out — rising pressure means the fleet's cold
+                # capacity is saturating (scale out, or grow the tier)
+                "spill_pressure": (dropped / (attempts + dropped)
+                                   if attempts + dropped else None),
+            })
         snap.update({
             "inflight": inflight,
+            "kv_tier": kv_tier,
             "affinity_hit_rate": hits / reqs if reqs else None,
             "ttft_p50_s": self.ttft_quantile(0.5),
             "ttft_p95_s": self.ttft_quantile(0.95),

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..autograd import engine
 from ..observability import metrics as _obs
+from ..observability import steptrace as _steptrace
 from ..observability.tracing import trace_span as _trace_span
 from ..tensor_core import Parameter, Tensor
 
@@ -474,6 +475,10 @@ class TrainStep:
         self._batch_signatures = set()
         self._sig_warned = False
         self.max_batch_signatures = 8
+        # previous step's last phase stamp — the next step's data_wait
+        # anchor (observability.steptrace; per-instance so interleaved
+        # steps don't cross-pollute their input-wait attribution)
+        self._steptrace_prev_end = None
 
     @property
     def num_batch_signatures(self):
@@ -607,6 +612,7 @@ class TrainStep:
         return self._compiled.lower(*self._step_args(batch_vals))
 
     def __call__(self, *batch):
+        t_entry = _steptrace.now()
         if self._compiled is None:
             self._build()
         train_vals, frozen_vals = self._split_vals()
@@ -614,15 +620,25 @@ class TrainStep:
             self._opt_states = self._init_opt_states(train_vals)
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
+        t_h2d = _steptrace.now()
         # recompile guard: every distinct batch signature is a separate
         # XLA compile. Ragged text pipelines that skip bucketing
         # (io.BucketedBatchSampler + pad_to_bucket_collate) would
         # silently compile per unique length — warn once past the
         # threshold (reference LoD workloads, SURVEY hard part 3).
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in batch_vals)
-        if sig not in self._batch_signatures:
+        new_sig = sig not in self._batch_signatures
+        if new_sig:
             self._batch_signatures.add(sig)
             _COMPILES_TOTAL.inc()
+            if len(self._batch_signatures) > 1:
+                # post-warm-up signature growth: the recompile sentinel
+                # (counts + flight-recorder postmortem)
+                _steptrace.note_recompile(
+                    self._donation_gauge_label,
+                    step=int(self.optimizer._step_count),
+                    signatures=len(self._batch_signatures),
+                    batch_sig=repr(sig))
             # abstract batch signature for the donation probe
             # (compile_stats(check_donation=True) re-lowers without a
             # batch) — captured per SIGNATURE, not per step: this is
@@ -651,6 +667,14 @@ class TrainStep:
         # of device transfers.
         lr = np.float32(self.optimizer.get_lr())
         step_idx = jnp.asarray(self.optimizer._step_count, jnp.uint32)
+        # phase trace (observability.steptrace): compile steps run
+        # QUIET so their stall never enters pt_train_phase_seconds
+        tr = _steptrace.begin_step(
+            self._donation_gauge_label, int(self.optimizer._step_count),
+            prev_end=self._steptrace_prev_end, quiet=new_sig,
+            t_entry=t_entry)
+        tr.stamp("h2d", t_h2d)
+        _steptrace.chaos_fire("step.dispatch")
         t0 = _time.perf_counter()
         with _trace_span("jit.TrainStep",
                          step=int(self.optimizer._step_count)):
@@ -671,11 +695,20 @@ class TrainStep:
                 out = self._compiled(
                     train_vals, frozen_vals, self._opt_states, lr,
                     batch_vals, step_idx, self._base_key)
+        tr.stamp("dispatch")
         if self._telemetry_full:
             loss, new_vals, self._opt_states, new_frozen, grad_norm = out
         else:
             loss, new_vals, self._opt_states, new_frozen = out
             grad_norm = None
+        if _steptrace.active():
+            # device_step = the block_until_ready delta. Only paid
+            # with telemetry on — and cheap even then: donated-buffer
+            # steps chain, so the dispatch-side wall this sync exposes
+            # is time the NEXT dispatch would have blocked on anyway.
+            jax.block_until_ready(
+                (loss, new_vals, self._opt_states, new_frozen))
+            tr.stamp("device_step")
         _STEP_SECONDS.observe(_time.perf_counter() - t0)
         _STEPS_TOTAL.inc()
         it = iter(new_vals)
@@ -687,13 +720,20 @@ class TrainStep:
             # full telemetry accepts the device sync these reads force
             _LOSS_GAUGE.set(float(np.asarray(loss)))
             _GRAD_NORM.observe(float(np.asarray(grad_norm)))
+        tr.stamp("opt_publish")
+        total_s, self._steptrace_prev_end = _steptrace.end_step(tr)
         from ..profiler import benchmark
 
         bm = benchmark()
         if bm.enabled:  # armed ips meter (reference profiler/timer.py)
             n = batch_vals[0].shape[0] if batch_vals and \
                 getattr(batch_vals[0], "ndim", 0) else None
-            bm.auto_step(num_samples=n)
+            # feed the steptrace-measured wall (anchor -> opt_publish)
+            # so the ips meter and the phase plane report ONE number;
+            # quiet/compile steps keep the meter's own clock
+            bm.auto_step(num_samples=n,
+                         dt=(total_s if _steptrace.active()
+                             and not tr.quiet else None))
         return Tensor(loss)
 
     def compile_stats(self, check_donation=False):

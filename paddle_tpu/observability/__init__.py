@@ -31,7 +31,7 @@ import json
 import os
 import threading
 
-from . import flight_recorder, metrics, reqtrace, tracing  # noqa: F401
+from . import flight_recorder, metrics, reqtrace, steptrace, tracing  # noqa: F401,E501
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       counter, gauge, histogram, registry, snapshot,
                       to_jsonl, to_prometheus, _STATE)
@@ -44,7 +44,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "flush", "set_mode", "mode", "metrics_enabled", "full_enabled",
            "export_all", "export_replica", "journal_snapshot",
            "bench_snapshot", "start_http_server", "telemetry_dir",
-           "TraceContext", "new_trace", "reqtrace", "flight_recorder"]
+           "TraceContext", "new_trace", "reqtrace", "steptrace",
+           "flight_recorder"]
 
 _MODES = {"off": _STATE.OFF, "metrics": _STATE.METRICS,
           "full": _STATE.FULL}

@@ -144,16 +144,20 @@ class _StepTimer:
     def disable(self):
         self.enabled = False
 
-    def auto_step(self, num_samples=None, auto=True):
+    def auto_step(self, num_samples=None, auto=True, dt=None):
         """Tick from an instrumented step (TrainStep). Steps chain
         through donated buffers, so wall deltas converge to true step
         time once the dispatch pipeline fills. auto=False ticks without
         claiming the auto-fed flag — for a HOST-side driver (hapi's
         ProgBarLogger on an eager loop) that must stand down the moment
-        a compiled step starts feeding the meter itself."""
+        a compiled step starts feeding the meter itself. `dt` is an
+        externally measured step wall (observability.steptrace's
+        anchor→opt_publish total): when the phase plane is on, the
+        instrumented steps pass it so this meter, hapi's bar, and
+        pt_train_phase_seconds cannot disagree about step cost."""
         if auto:
             self.auto_fed = True
-        self.step()
+        self.step(dt=dt)
         if num_samples:
             self.samples += int(num_samples)
             _SAMPLES_TOTAL.inc(int(num_samples))
@@ -178,9 +182,16 @@ class _StepTimer:
         self.reader_costs.append(dt)
         _READER_COST.observe(dt)
 
-    def step(self):
+    def step(self, dt=None):
+        """One step tick. `dt=None` measures the wall delta since the
+        last tick (the self-clocked path); an explicit dt records the
+        caller's measurement instead (steptrace routing, auto_step)."""
         now = time.perf_counter()
-        if self._t_last is not None:
+        if dt is not None:
+            dt = float(dt)
+            self.step_times.append(dt)
+            _BATCH_COST.observe(dt)
+        elif self._t_last is not None:
             dt = now - self._t_last
             self.step_times.append(dt)
             _BATCH_COST.observe(dt)

@@ -1,0 +1,64 @@
+"""Worker for the 2-proc straggler-attribution chaos test
+(test_steptrace.py::test_two_proc_straggler_attribution).
+
+Each rank runs a few compiled TrainSteps under PT_TELEMETRY=1 (full
+mode) with a seeded chaos plan delaying ONE rank's ``step.dispatch``
+scope. The ranks then exchange their last step view over xproc and
+rank-agnostically compute the straggler (steptrace.straggler_of) —
+every rank must agree on the delayed rank AND the phase the delay
+landed in — before exporting telemetry so the test can rebuild the
+same attribution offline from the merged chrome trace
+(tools/trace_merge.py train report).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, observability as obs  # noqa: E402
+from paddle_tpu.distributed import xproc  # noqa: E402
+from paddle_tpu.observability import steptrace  # noqa: E402
+
+STEPS = 5
+
+
+def main():
+    out_dir = sys.argv[1]
+    os.environ.setdefault("PT_TELEMETRY_DIR",
+                          os.path.join(out_dir, "telemetry"))
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(
+        m, lambda mm, x, y: nn.functional.cross_entropy(mm(x), y), opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (8,)))
+    for _ in range(STEPS):
+        step(x, y)
+
+    recent = steptrace.recent_steps()
+    assert recent, "telemetry on but no non-quiet steps recorded"
+    # live cross-rank attribution: every rank contributes its view of
+    # the last step; straggler_of is deterministic, so all ranks agree
+    views = xproc.all_gather_obj(recent[-1])
+    straggler = steptrace.straggler_of(views)
+    xproc.barrier()
+
+    obs.export_all()     # flush trace.rank<r>.jsonl for the merge side
+    with open(os.path.join(out_dir, f"steptrace_out_{rank}.json"),
+              "w") as f:
+        json.dump({"rank": rank, "recent": recent,
+                   "straggler": straggler, "mode": obs.mode()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -665,3 +665,63 @@ def test_fleet_replica_2proc_kv_stream_chaos(tmp_path):
     # the chaos kill ALSO dumped from the dying serve thread itself
     assert sorted(
         tmp_path.glob("postmortem.rank1.*.chaos_replica_kill.json"))
+
+
+# --------------------------------------------- fleet kv-tier metrics
+
+def test_router_metrics_aggregates_kv_tier_rates():
+    """ISSUE-18 satellite: FleetRouter.metrics() folds the per-replica
+    kv_tier snapshots (the pt_kv_tier_* family) into ONE fleet block
+    with hit_rate and spill_pressure, so the autoscale monitor sees
+    memory pressure without scraping every engine view. Replicas
+    without a tier leave the block None."""
+
+    class _FakeEngine:
+        mean_occupancy = 0.0
+
+        def __init__(self, kv_tier):
+            self._kv_tier = kv_tier
+
+        def metrics(self):
+            out = {"recent_requests": []}
+            if self._kv_tier is not None:
+                out["kv_tier"] = dict(self._kv_tier)
+            return out
+
+    class _FakeReplica:
+        role = "serve"
+        alive = True
+        running = True
+        _registry = None
+
+        def __init__(self, name, kv_tier):
+            self.name = name
+            self.rid = f"rid-{name}"
+            self.engine = _FakeEngine(kv_tier)
+
+        def queue_depth(self):
+            return 0
+
+    tier_a = {"spills": 6, "spill_pages": 12, "spill_failed": 1,
+              "spill_rejected": 1, "ram_hits": 6, "disk_hits": 2,
+              "misses": 2, "ram_dropped": 1, "disk_dropped": 0,
+              "ram_bytes": 4096, "disk_bytes": 1024}
+    tier_b = {"spills": 2, "spill_pages": 4, "spill_failed": 0,
+              "spill_rejected": 0, "ram_hits": 2, "disk_hits": 0,
+              "misses": 8, "ram_dropped": 0, "disk_dropped": 0,
+              "ram_bytes": 2048, "disk_bytes": 0}
+    router = FleetRouter(replicas=[_FakeReplica("a", tier_a),
+                                   _FakeReplica("b", tier_b)])
+    kv = router.metrics()["kv_tier"]
+    assert kv["replicas_with_tier"] == 2
+    # summed counters: 8+2 hits over 10+10 lookups
+    assert kv["ram_hits"] == 8 and kv["disk_hits"] == 2
+    assert kv["hit_rate"] == pytest.approx(10 / 20)
+    # dropped = rejected 1 + ram_dropped 1; attempts = spills 8 +
+    # failed 1 + rejected 1
+    assert kv["spill_pressure"] == pytest.approx(2 / 12)
+    assert kv["ram_bytes"] == 6144
+
+    # tierless fleet: the block is None, never a zero-division
+    router2 = FleetRouter(replicas=[_FakeReplica("c", None)])
+    assert router2.metrics()["kv_tier"] is None

@@ -103,6 +103,56 @@ def merge(paths, trace_id=None):
                                            for p in paths]}}
 
 
+def train_report(events):
+    """Per-step per-rank training-phase attribution from the
+    ``step.<phase>`` events the steptrace plane emits in full mode
+    (observability/steptrace.py). For every train step present in the
+    merged streams: each rank's per-phase milliseconds and total, the
+    SLOWEST rank, and its slow phase — the segment where that rank's
+    time exceeds the fastest other rank's by the most (a delay
+    injected on one rank names that rank and the phase the delay
+    landed in; uniform slowdowns name the longest phase). Events keep
+    pid = rank (call before merge()'s lane reassignment)."""
+    steps = {}
+    for e in events:
+        name = e.get("name", "")
+        if not name.startswith("step."):
+            continue
+        args = e.get("args") or {}
+        if "step" not in args:
+            continue
+        phase = name[len("step."):]
+        rec = steps.setdefault(int(args["step"]), {}).setdefault(
+            int(e.get("pid", 0)),
+            {"phases_us": {}, "total_us": 0, "family": args.get("family")})
+        dur = int(e.get("dur", 0))
+        rec["phases_us"][phase] = rec["phases_us"].get(phase, 0) + dur
+        rec["total_us"] += dur
+    out = []
+    for step in sorted(steps):
+        ranks = steps[step]
+        slow = max(ranks, key=lambda r: ranks[r]["total_us"])
+        segs = ranks[slow]["phases_us"]
+        others = [ranks[r]["phases_us"] for r in ranks if r != slow]
+        slow_phase, lag = None, -1
+        for phase, dur in segs.items():
+            base = min((o.get(phase, 0) for o in others), default=0)
+            if dur - base > lag:
+                slow_phase, lag = phase, dur - base
+        out.append({
+            "step": step,
+            "slowest_rank": slow,
+            "slow_phase": slow_phase,
+            "lag_ms": round(max(0, lag) / 1e3, 3),
+            "ranks": {
+                r: {"total_ms": round(v["total_us"] / 1e3, 3),
+                    "family": v["family"],
+                    "phases_ms": {p: round(us / 1e3, 3)
+                                  for p, us in v["phases_us"].items()}}
+                for r, v in sorted(ranks.items())}})
+    return out
+
+
 def expand(inputs):
     """Args → concrete trace files (a dir means its trace*.jsonl)."""
     paths = []
@@ -130,11 +180,23 @@ def main(argv=None):
     ap.add_argument("--trace", default=None, metavar="TRACE_ID",
                     help="keep only one request's events (the reqtrace "
                          "trace_id its spans carry)")
+    ap.add_argument("--train-report", default=None, metavar="OUT_JSON",
+                    help="also write the per-step per-rank training "
+                         "phase report (slowest rank + slow phase per "
+                         "step, from the steptrace step.<phase> events)")
     args = ap.parse_args(argv)
     paths = expand(args.inputs)
     if not paths:
         print("no trace files found", file=sys.stderr)
         return 1
+    if args.train_report:
+        # raw events, pid still = rank (merge() reassigns lanes)
+        events, _ = collect(paths)
+        report = train_report(events)
+        with open(args.train_report, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"{args.train_report}: {len(report)} step(s)",
+              file=sys.stderr)
     trace = merge(paths, trace_id=args.trace)
     with open(args.output, "w") as f:
         json.dump(trace, f)
