@@ -2407,6 +2407,167 @@ def bench_kv_tier_ab():
     return result
 
 
+def bench_llm_structured_ab():
+    """Structured-decoding A/B (the ISSUE-19 acceptance arms): one
+    char-level model (vocab 96 = eos + printable ASCII) built with
+    `token_strs`, so grammars close over real token text.
+
+      * arm A — constrained overhead: the never-accepting grammar
+        `[0-9]{200,}` keeps every constrained row generating for its
+        full max_new budget, so U (all plain) vs C (all constrained)
+        is a clean per-token cost A/B on identical schedules; the M
+        (mixed) run pins the co-residency contract — unconstrained
+        rows must be token-identical to run U.
+      * arm B — draft-free n-gram speculation vs the fused-k engine
+        on a grammar-TEMPLATED workload (`\\[(\\{"k":[0-9]\\},){8,12}\\]`):
+        the literal scaffolding between the model-chosen digits is
+        exactly what prompt-lookup proposes, so the stamped
+        acceptance/speedup measure the subsystem, not model memory.
+
+    Both arms interleave x2 and take best-of-2 per side; greedy
+    identity, 100% grammar validity, and zero fused recompiles under
+    constrained traffic are ASSERTED — a mask/verify regression must
+    fail the bench loudly, not ship a false-speedup JSON."""
+    import re
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.text.models import GPTForCausalLM
+    from paddle_tpu.text.models.gpt import GPTConfig
+
+    toks = [""] + [chr(c) for c in range(32, 127)]  # token 0 = eos
+    paddle.seed(30)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=len(toks), hidden_size=128, num_layers=6,
+        num_heads=4, max_seq_len=512))
+    model.eval()
+    rng = np.random.default_rng(19)
+    n_req, spec_k = 6, int(os.environ.get("BENCH_SPEC_K", "8"))
+    prompts = [rng.integers(1, len(toks), (24,)).astype(np.int32)
+               for _ in range(n_req)]
+    base = dict(num_slots=4, page_size=16, token_budget=16,
+                max_model_len=256, token_strs=toks, grammar_states=256)
+    digits = r"[0-9]{200,}"               # no accepting state in budget
+    template = r'\[(\{"k":[0-9]\},){8,12}\]'
+
+    def text_of(j, out):
+        return "".join(toks[t] for t in out[len(prompts[j]):])
+
+    def run(cfg, max_new, grammars, warm_grammar=None):
+        """One timed serve; `grammars` maps request index -> regex (or
+        absent = unconstrained). Grammar compile + arena load happen
+        in the WARMUP submit, so the timed region is decode-only —
+        the same steady state the cache-hit path serves."""
+        server = inference.LLMServer(model, cfg)
+        outs = {}
+        with server:
+            wk = {"grammar": warm_grammar} if warm_grammar else {}
+            server.submit(np.ones((8,), np.int32), max_new_tokens=4,
+                          eos_token_id=0, trace=_quiet_trace(),
+                          **wk).result(timeout=1800)
+            server.engine.stats.update(
+                {"steps": 0, "tokens_in": 0, "occupancy_sum": 0.0})
+            st = server.engine.stats
+            p0 = st.get("ngram_proposed", 0)
+            a0 = st.get("ngram_accepted", 0)
+            t0 = time.perf_counter()
+            futs = [server.submit(prompts[j], max_new_tokens=max_new,
+                                  eos_token_id=0, grammar=grammars.get(j))
+                    for j in range(n_req)]
+            for j, f in enumerate(futs):
+                outs[j] = f.result(timeout=1800)
+            total = time.perf_counter() - t0
+            dp = st.get("ngram_proposed", 0) - p0
+            acc = (st.get("ngram_accepted", 0) - a0) / dp if dp else None
+            cs = server.engine.compile_stats()
+        return outs, total, acc, cs
+
+    # arm A: constrained-overhead + co-residency (fused-k engine)
+    fused_cfg = inference.LLMEngineConfig(decode_k=spec_k, **base)
+    all_digits = {j: digits for j in range(n_req)}
+    mixed = {j: digits for j in range(0, n_req, 2)}
+    a_runs = {"U": [], "C": [], "M": []}
+    for rep in range(2):
+        for kind, (gr, warm) in (("U", ({}, None)),
+                                 ("C", (all_digits, digits)),
+                                 ("M", (mixed, digits))):
+            r = run(fused_cfg, 96, gr, warm_grammar=warm)
+            log(f"[bench] llm_structured_ab A:{kind}[{rep}]: "
+                f"{r[1]:.2f}s")
+            a_runs[kind].append(r)
+    a_best = {k: min(v, key=lambda r: r[1]) for k, v in a_runs.items()}
+    for kind in ("C", "M"):
+        gr = all_digits if kind == "C" else mixed
+        for j in gr:
+            txt = text_of(j, a_best[kind][0][j])
+            assert re.fullmatch(r"[0-9]+", txt), (
+                f"arm A {kind} row {j} escaped the grammar: {txt!r}")
+    coresident_ok = all(
+        np.array_equal(a_best["M"][0][j], a_best["U"][0][j])
+        for j in range(n_req) if j not in mixed)
+    assert coresident_ok, \
+        "arm A: constrained co-residents perturbed unconstrained rows"
+    gen = {k: sum(len(a_best[k][0][j]) - len(prompts[j])
+                  for j in range(n_req)) for k in a_best}
+    per_tok = {k: a_best[k][1] / gen[k] for k in a_best}
+    overhead_pct = (per_tok["C"] / per_tok["U"] - 1.0) * 100.0
+    recompiles = a_best["C"][3].get("fused_executables", 1) - 1
+    assert recompiles == 0, (
+        f"arm A: constrained traffic recompiled the fused step "
+        f"({recompiles} extra executables)")
+    log(f"[bench] llm_structured_ab arm A: constrained overhead "
+        f"{overhead_pct:+.1f}%/tok, co-resident identity "
+        f"{coresident_ok}, fused recompiles {recompiles}")
+
+    # arm B: n-gram speculation vs fused-k on the templated grammar
+    ngram_cfg = inference.LLMEngineConfig(
+        spec_mode="ngram", spec_k=spec_k, **base)
+    all_tmpl = {j: template for j in range(n_req)}
+    b_runs = {"ngram": [], "fused": []}
+    for rep in range(2):
+        for kind, cfg in (("ngram", ngram_cfg), ("fused", fused_cfg)):
+            r = run(cfg, 120, all_tmpl, warm_grammar=template)
+            log(f"[bench] llm_structured_ab B:{kind}[{rep}]: "
+                f"{r[1]:.2f}s")
+            b_runs[kind].append(r)
+    b_best = {k: min(v, key=lambda r: r[1]) for k, v in b_runs.items()}
+    b_match = all(np.array_equal(b_best["ngram"][0][j],
+                                 b_best["fused"][0][j])
+                  for j in range(n_req))
+    assert b_match, "arm B: ngram greedy outputs diverged from fused"
+    for j in range(n_req):
+        txt = text_of(j, b_best["ngram"][0][j])
+        assert re.fullmatch(template, txt), (
+            f"arm B row {j} not grammar-valid: {txt!r}")
+    b_gen = sum(len(b_best["ngram"][0][j]) - len(prompts[j])
+                for j in range(n_req))
+    tps = {k: b_gen / v[1] for k, v in b_best.items()}
+    acc = b_best["ngram"][2]
+    log(f"[bench] llm_structured_ab arm B: ngram {tps['ngram']:,.0f} "
+        f"tok/s vs fused-k{spec_k} {tps['fused']:,.0f} = "
+        f"{tps['ngram'] / tps['fused']:.2f}x, acceptance="
+        f"{acc if acc is None else round(acc, 3)}, "
+        f"greedy_match={b_match}")
+    return {
+        "spec_k": spec_k, "requests": n_req,
+        "greedy_match": bool(b_match),
+        "coresident_identity": bool(coresident_ok),
+        "grammar_valid_pct": 100.0,
+        "constrained_overhead_pct": round(overhead_pct, 2),
+        "constrained_fused_recompiles": recompiles,
+        "ngram_speedup_vs_fused": round(tps["ngram"] / tps["fused"], 3),
+        "acceptance_rate": (None if acc is None else round(acc, 4)),
+        "gen_tokens": {"overhead_arm": gen, "ngram_arm": b_gen},
+        "tokens_per_sec": {k: round(v) for k, v in tps.items()},
+        "totals_s": {
+            "overhead_arm": {k: [round(r[1], 2) for r in v]
+                             for k, v in a_runs.items()},
+            "ngram_arm": {k: [round(r[1], 2) for r in v]
+                          for k, v in b_runs.items()}},
+    }
+
+
 _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "deepfm": bench_deepfm, "mnist": bench_mnist,
             "generate": bench_generate, "gpt1p3b": bench_gpt1p3b,
@@ -2419,6 +2580,7 @@ _WORKERS = {"gpt": bench_gpt, "resnet": bench_resnet, "bert": bench_bert,
             "tracing_overhead_ab": bench_tracing_overhead_ab,
             "steptrace_overhead_ab": bench_steptrace_overhead_ab,
             "kv_tier_ab": bench_kv_tier_ab,
+            "llm_structured_ab": bench_llm_structured_ab,
             "train_3d": bench_train_3d, "probe": bench_probe}
 
 
@@ -2654,13 +2816,14 @@ def main():
         # acceptance regime, ISSUE 8)
         extras = ("llm_serve", "llm_fleet", "llm_fleet_multi",
                   "overload_storm_ab", "tracing_overhead_ab",
-                  "steptrace_overhead_ab", "kv_tier_ab", "train_3d")
+                  "steptrace_overhead_ab", "kv_tier_ab",
+                  "llm_structured_ab", "train_3d")
     else:
         extras = ("resnet", "bert", "deepfm", "mnist", "generate",
                   "serving", "llm_serve", "llm_serve_int8", "llm_fleet",
                   "llm_fleet_multi", "overload_storm_ab",
                   "tracing_overhead_ab", "steptrace_overhead_ab",
-                  "kv_tier_ab", "train_3d")
+                  "kv_tier_ab", "llm_structured_ab", "train_3d")
     for which in extras:
         # the llm_serve/llm_fleet arms run TWO serving phases each
         # (engine vs baseline / int8 vs fp32 / fleet vs fifo) plus both

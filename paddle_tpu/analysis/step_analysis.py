@@ -417,18 +417,24 @@ def _fused_step_args(engine):
     S = engine.num_slots
     i32 = np.int32
     sf = engine._ensure_fused()
+    # grammar args must MATCH the live dispatch (committed device
+    # tables on a token_strs engine, all-None otherwise), or the
+    # probe itself would trace a second signature
+    gst, gtrans, gmask = engine._grammar_args(())
     return (
         [p._value for p in sf._params],
         np.zeros((S,), i32), np.zeros((S,), i32), np.ones((S,), i32),
         np.zeros((S,), bool), np.full((S,), -1, i32),
         np.zeros((S,), np.float32), np.ones((S,), np.float32),
-        np.zeros((S,), i32), engine._page_tables,
+        np.zeros((S,), i32), gst, gtrans, gmask,
+        engine._page_tables,
         (engine._kv, engine._kv_scales, engine._key),
     )
 
 
 _FUSED_NAMES = ("weights", "tok0", "pos0", "rem", "fin0", "eos",
-                "temps", "top_ps", "streams", "page_tables", "kv_state")
+                "temps", "top_ps", "streams", "gstate0", "gtrans",
+                "gmask", "page_tables", "kv_state")
 
 
 def _verify_step_args(engine):
@@ -443,6 +449,7 @@ def _verify_step_args(engine):
             "configure LLMEngineConfig(draft_model=..., spec_k=...)")
     S = engine.num_slots
     i32 = np.int32
+    gst, gtrans, gmask = engine._grammar_args(())
     return (
         [p._value for p in spec._verify_fn._params],
         np.zeros((S,), i32), np.zeros((S,), i32),
@@ -450,6 +457,7 @@ def _verify_step_args(engine):
         np.ones((S,), i32), np.zeros((S,), bool),
         np.full((S,), -1, i32), np.zeros((S,), np.float32),
         np.ones((S,), np.float32), np.zeros((S,), i32),
+        gst, gtrans, gmask,
         engine._page_tables,
         (engine._kv, engine._kv_scales, engine._key),
     )
@@ -457,6 +465,7 @@ def _verify_step_args(engine):
 
 _VERIFY_NAMES = ("weights", "tok0", "pos0", "drafts", "width", "rem",
                  "fin0", "eos", "temps", "top_ps", "streams",
+                 "gstate0", "gtrans", "gmask",
                  "page_tables", "kv_state")
 
 
@@ -496,7 +505,7 @@ def _analyze_engine(engine, check_donation, which="paged"):
         # key pytree (gauge pt_step_donation_held{step="spec_verify"})
         args = _verify_step_args(engine)
         return analyze_jit(engine._spec._verify_fn._jit, args,
-                           donate_argnums=(12,), kind="SpecVerify",
+                           donate_argnums=(15,), kind="SpecVerify",
                            names=_VERIFY_NAMES,
                            check_donation=check_donation)
     if which == "propose":
@@ -514,7 +523,7 @@ def _analyze_engine(engine, check_donation, which="paged"):
         # donation of the pools + scales + PRNG key pytree
         args = _fused_step_args(engine)
         return analyze_jit(engine._fused_fn._jit, args,
-                           donate_argnums=(10,), kind="FusedDecode",
+                           donate_argnums=(13,), kind="FusedDecode",
                            names=_FUSED_NAMES,
                            check_donation=check_donation)
     args = _paged_step_args(engine)

@@ -112,11 +112,37 @@ def prefix_key(tokens):
     return np.asarray(tokens, np.int32).tobytes()
 
 
-def _read_frame(path):
+def _mmap_array(f, path):
+    """One npy array as a read-only `np.memmap` over the OS page
+    cache: parse the npy header off the stream, map the data span in
+    place, and advance `f` past it exactly like `np.load` would — but
+    with ZERO eager host copy. The bytes materialize lazily as the
+    prefetch scatter touches them; a frame evicted while a mapping is
+    live stays readable (POSIX unlink keeps the open mapping valid)."""
+    version = np.lib.format.read_magic(f)
+    if version == (1, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+    else:
+        raise ValueError(f"unsupported npy version {version}: {path}")
+    if dtype.hasobject:
+        raise ValueError(f"object array in KV frame: {path}")
+    offset = f.tell()
+    count = int(np.prod(shape, dtype=np.int64))
+    arr = np.memmap(path, dtype=dtype, mode="r", offset=offset,
+                    shape=shape, order="F" if fortran else "C")
+    f.seek(offset + count * dtype.itemsize)
+    return arr
+
+
+def _read_frame(path, use_mmap=False):
     """Parse one on-disk PTKV frame STREAMING from the file handle
     (np.load per array straight off the OS page cache — no whole-frame
-    host copy on the read path). Raises on any truncation/corruption;
-    callers GC the file."""
+    host copy on the read path). With ``use_mmap`` the array payloads
+    are `np.memmap` views instead of copies — byte-identical (pinned
+    by tests/test_kv_tier.py), just lazier. Raises on any
+    truncation/corruption; callers GC the file."""
     with open(path, "rb") as f:
         hdr = f.read(_HDR.size)
         if len(hdr) != _HDR.size:
@@ -127,11 +153,15 @@ def _read_frame(path):
         if ver != _VERSION:
             raise ValueError(f"KV frame version {ver} != {_VERSION}")
         meta = json.loads(f.read(meta_len).decode("utf-8"))
-        tokens = np.load(f, allow_pickle=False)
-        kv = [np.load(f, allow_pickle=False)
-              for _ in range(meta["n_kv"])]
-        scales = [np.load(f, allow_pickle=False)
-                  for _ in range(meta["n_scales"])]
+        if use_mmap:
+            def rd():
+                return _mmap_array(f, path)
+        else:
+            def rd():
+                return np.load(f, allow_pickle=False)
+        tokens = rd()
+        kv = [rd() for _ in range(meta["n_kv"])]
+        scales = [rd() for _ in range(meta["n_scales"])]
     from .kv_transfer import _np_dtype
 
     kv = [a if a.dtype == _np_dtype(n) else a.view(_np_dtype(n))
@@ -155,13 +185,21 @@ class KVTierStore:  # ptlint: thread-shared (commit thread + engine serve loop +
     max_pending  spill-queue bound: a saturated commit thread REJECTS
                  new spills (counted, journal-free) instead of ever
                  blocking the engine thread
+    mmap         disk-tier read path: True maps frames with np.memmap
+                 (lazy, zero eager copy — default), False streams with
+                 np.load. None reads env PT_KV_TIER_MMAP ("0" opts
+                 out). Byte-identity either way.
     """
 
     def __init__(self, ram_bytes=256 << 20, disk_dir=None,
-                 disk_bytes=1 << 30, max_pending=64):
+                 disk_bytes=1 << 30, max_pending=64, mmap=None):
         self.ram_bytes = int(ram_bytes)
         self.disk_dir = disk_dir
         self.disk_bytes = int(disk_bytes) if disk_dir else 0
+        if mmap is None:
+            mmap = os.environ.get(
+                "PT_KV_TIER_MMAP", "1").lower() not in ("0", "false")
+        self.use_mmap = bool(mmap)
         self._lock = threading.Lock()
         self._ram = collections.OrderedDict()   # key -> (frame, pages)
         self._ram_used = 0
@@ -175,7 +213,7 @@ class KVTierStore:  # ptlint: thread-shared (commit thread + engine serve loop +
                       "spill_rejected": 0, "ram_hits": 0,
                       "disk_hits": 0, "misses": 0, "demotions": 0,
                       "ram_dropped": 0, "disk_dropped": 0,
-                      "gc_files": 0, "adopted": 0}
+                      "gc_files": 0, "adopted": 0, "mmap_reads": 0}
         if self.disk_dir:
             os.makedirs(self.disk_dir, exist_ok=True)
             self._restart_scan()
@@ -362,7 +400,11 @@ class KVTierStore:  # ptlint: thread-shared (commit thread + engine serve loop +
             return unpack_kv_payload(ent[0])
         _TIER_HITS.labels(tier="disk").inc()
         try:
-            return _read_frame(dent[0])
+            payload = _read_frame(dent[0], use_mmap=self.use_mmap)
+            if self.use_mmap:
+                with self._lock:
+                    self.stats["mmap_reads"] += 1
+            return payload
         except Exception as e:
             # a frame that rots on disk is dropped like a failed spill
             with self._lock:

@@ -318,6 +318,24 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
 
         if not self._running:
             raise RuntimeError("router not started (use `with router:`)")
+        # loud ingress hardening (same pattern as the engine's
+        # _check_import): an unknown kwarg or a malformed structured-
+        # decoding constraint raises HERE, at submit(), with the
+        # offending name — not as a serve-loop error on whichever
+        # replica the request lands, where it would abort co-resident
+        # requests. Grammar COMPILATION still happens replica-side
+        # (it needs the engine's token_strs); this gate is structural.
+        from ..llm_engine import SUBMIT_KWARGS
+        from ..structured import validate_constraints
+
+        unknown = set(kw) - SUBMIT_KWARGS
+        if unknown:
+            raise TypeError(
+                f"submit() got unknown kwarg(s) {sorted(unknown)} — "
+                f"the surface is {sorted(SUBMIT_KWARGS)}")
+        validate_constraints(grammar=kw.get("grammar"),
+                             json_schema=kw.get("json_schema"),
+                             spec_mode=kw.get("spec_mode"))
         prompt = np.asarray(prompt).reshape(-1)
         # a caller-minted trace (a gateway in front of this router)
         # must not collide with the per-replica submit's own trace kwarg

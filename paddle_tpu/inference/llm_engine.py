@@ -69,6 +69,7 @@ import collections
 import itertools
 import os
 import queue
+import threading
 import time as _time
 from concurrent.futures import Future
 
@@ -80,6 +81,7 @@ import jax.numpy as jnp
 from ..observability import metrics as _obs
 from ..observability import reqtrace as _reqtrace
 from ..observability.tracing import trace_span as _trace_span
+from .structured.compiler import _STRUCT_CACHE_HITS, _STRUCT_REQS
 from .fleet_serving import (Priority, RadixPrefixCache, RequestCancelled,
                             RequestShed, SLAScheduler, note_cancelled,
                             note_shed)
@@ -318,7 +320,29 @@ class LLMEngineConfig:
                   single-tick paths.
     spec_k        draft tokens proposed per speculative window.
                   Default: the PT_SPEC_K env var, else 4. Ignored
-                  without a draft_model.
+                  without speculation enabled.
+    spec_mode     speculation source: None (off unless draft_model is
+                  set, which implies "draft"), "draft" (requires
+                  draft_model), or "ngram" — draft-model-FREE
+                  prompt-lookup proposals (inference/structured/
+                  ngram.py): the request's own prompt+generated
+                  suffix proposes spec_k tokens into the SAME ragged
+                  verify executable, no second model resident.
+                  "ngram" with a draft_model is a config error.
+    token_strs    per-token surface strings (len == vocab_size) —
+                  enables STRUCTURED DECODING (inference/structured,
+                  docs/SERVING.md "Structured decoding"): per-request
+                  `grammar=` / `json_schema=` constraints compile to
+                  token-level DFAs masked inside the compiled scans.
+                  None (default) = constrained requests are rejected
+                  loudly at submit.
+    grammar_states
+                  grammar-arena DFA state budget (table rows resident
+                  at once across all live grammars; row 0 is the
+                  mask-identity). A grammar over the budget raises
+                  GrammarError at submit. Default 128; ignored
+                  without token_strs (the arena collapses to the
+                  identity row).
     kv_tier       hierarchical KV memory below the device pool
                   (fleet_serving.kv_tier; docs/SERVING.md "KV memory
                   hierarchy"). Falsy (default) = off. True enables the
@@ -337,7 +361,8 @@ class LLMEngineConfig:
                  prefix_cache=None, hash_block_tokens=None,
                  sla_policy=None, decode_k=None, seed=0,
                  draft_model=None, spec_k=None, kv_tier=None,
-                 session_ttl_s=None, session_max=None):
+                 session_ttl_s=None, session_max=None, spec_mode=None,
+                 token_strs=None, grammar_states=None):
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = num_pages
@@ -361,6 +386,29 @@ class LLMEngineConfig:
         if spec_k is None:
             spec_k = int(os.environ.get("PT_SPEC_K", "4"))
         self.spec_k = int(spec_k)
+        if spec_mode is None and draft_model is not None:
+            spec_mode = "draft"
+        if spec_mode not in (None, "draft", "ngram"):
+            raise ValueError(
+                "spec_mode must be None, 'draft', or 'ngram', got "
+                f"{spec_mode!r}")
+        if spec_mode == "draft" and draft_model is None:
+            raise ValueError(
+                "spec_mode='draft' needs draft_model= (pass "
+                "spec_mode='ngram' for draft-model-free speculation)")
+        if spec_mode == "ngram" and draft_model is not None:
+            raise ValueError(
+                "spec_mode='ngram' is draft-model-free — drop "
+                "draft_model= (or use spec_mode='draft')")
+        self.spec_mode = spec_mode
+        self.token_strs = (None if token_strs is None
+                           else list(token_strs))
+        self.grammar_states = int(128 if grammar_states is None
+                                  else grammar_states)
+        if self.grammar_states < 2:
+            raise ValueError(
+                "grammar_states must be >= 2 (row 0 is the reserved "
+                f"mask-identity row), got {self.grammar_states}")
         self.kv_tier = kv_tier
         self.session_ttl_s = float(600.0 if session_ttl_s is None
                                    else session_ttl_s)
@@ -528,7 +576,7 @@ class _CompiledFusedStep(_CompiledStepBase):
         ps = int(page_size)
 
         def pure(param_vals, tok0, pos0, rem, fin0, eos, temps, top_ps,
-                 streams, pt, kv_state):
+                 streams, gstate0, gtrans, gmask, pt, kv_state):
             from ..autograd import engine as eng
 
             kv_vals, kv_scales, key = kv_state
@@ -540,19 +588,20 @@ class _CompiledFusedStep(_CompiledStepBase):
                     emits, new_kv, new_scales = model._paged_decode_fused(
                         self.k, ps, tok0, pos0, rem, fin0, eos, temps,
                         top_ps, streams, pt, list(kv_vals),
-                        list(kv_scales) if kv_scales else None, key)
+                        list(kv_scales) if kv_scales else None, key,
+                        gstate0=gstate0, gtrans=gtrans, gmask=gmask)
             finally:
                 for p, v in zip(self._params, originals):
                     p._value = v
             return emits, (new_kv, new_scales, key)
 
-        self._jit = jax.jit(pure, donate_argnums=(10,))
+        self._jit = jax.jit(pure, donate_argnums=(13,))
 
     def __call__(self, tok0, pos0, rem, fin0, eos, temps, top_ps,
-                 streams, pt, kv_state):
+                 streams, gstate0, gtrans, gmask, pt, kv_state):
         return self._run([p._value for p in self._params], tok0, pos0,
-                         rem, fin0, eos, temps, top_ps, streams, pt,
-                         kv_state)
+                         rem, fin0, eos, temps, top_ps, streams,
+                         gstate0, gtrans, gmask, pt, kv_state)
 
 
 class _Request:
@@ -613,6 +662,15 @@ class _Request:
         # _session_seen marks a RETURNING session (resume telemetry)
         self.session_id = None
         self._session_seen = False
+        # structured decoding (inference/structured): the compiled
+        # token-level DFA and the request's grammar-LOCAL state. The
+        # state is a pure function of the generated tokens (the engine
+        # replays every emitted token through `grammar.advance`), and
+        # `tokens` survives preemption, so a preempted constrained
+        # request resumes at the correct DFA state for free.
+        self.grammar = None
+        self.gstate = 0
+        self.spec_off = False     # per-request spec_mode="off" opt-out
         self._arrival = None      # scheduler enqueue stamp
         self.cached_prefix = 0    # tokens served from the prefix cache
         self._cow_pending = 0     # COW splits taken by the last match
@@ -804,6 +862,31 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         # replaces the fused window for pure-decode ticks
         # (inference/speculative.py; late import: train-only use must
         # not drag the speculative machinery in)
+        # structured decoding (inference/structured, docs/SERVING.md
+        # "Structured decoding"): the grammar arena's device tables
+        # thread through the fused/verify executables at an
+        # engine-static shape — [grammar_states, vocab] when token_strs
+        # is configured, the lone mask-identity row otherwise (so
+        # engines that never see a constraint pay a few KB, not MB).
+        # The compile cache is lock-guarded: `LLMServer.submit`
+        # compiles grammars on the CALLER's thread (loud reject at
+        # submit), while add_request may compile on the engine thread.
+        self.spec_mode = cfg.spec_mode
+        self.token_strs = (list(cfg.token_strs)
+                           if cfg.token_strs is not None else None)
+        if (self.token_strs is not None
+                and len(self.token_strs) != mcfg.vocab_size):
+            raise ValueError(
+                f"token_strs has {len(self.token_strs)} entries but "
+                f"the model vocab is {mcfg.vocab_size} — one surface "
+                "string per token id")
+        from .structured.arena import GrammarArena, GrammarCache
+
+        self.grammar_arena = GrammarArena(
+            mcfg.vocab_size,
+            cfg.grammar_states if self.token_strs is not None else 1)
+        self._grammar_cache = GrammarCache()
+        self.stats["structured_requests"] = 0
         if cfg.draft_model is not None:
             from .speculative import SpeculativeDecoder
 
@@ -811,6 +894,14 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                                             cfg.spec_k)
             _KV_POOL_BYTES.labels(dtype=self.kv_dtype).set(
                 self.pool_bytes())
+        elif cfg.spec_mode == "ngram":
+            # draft-model-free speculation: the request's own token
+            # history proposes into the same ragged verify executable
+            # (inference/structured/ngram.py) — no draft pool, so
+            # pool_bytes/brownout-L2 accounting are untouched
+            from .structured.ngram import NgramSpeculator
+
+            self._spec = NgramSpeculator(self, cfg.spec_k)
 
     @property
     def waiting(self):
@@ -819,13 +910,120 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         (docs/SERVING.md), not necessarily arrival."""
         return self.sched
 
+    # ---- structured decoding: the constraint surface ----
+
+    def compile_constraint(self, grammar=None, json_schema=None,
+                           eos_token_id=None):
+        """Compile one per-request constraint to a `CompiledGrammar`,
+        through the engine's hash-keyed cache (a hot schema compiles
+        once per replica — `pt_structured_cache_hits` counts reuse).
+        Thread-safe: `LLMServer.submit` calls this on the CALLER's
+        thread so a bad grammar raises at submit() time, never inside
+        the serve loop. Raises GrammarError (a ValueError) for
+        unsupported syntax or a DFA over the arena budget."""
+        from .structured import (GrammarError, compiler as _gcomp,
+                                 schema_to_regex)
+
+        if self.token_strs is None:
+            raise GrammarError(
+                ("json_schema=" if json_schema is not None
+                 else "grammar=") +
+                ": this engine has no token_strs — pass "
+                "LLMEngineConfig(token_strs=[...]) to enable "
+                "structured decoding")
+        if isinstance(grammar, _gcomp.CompiledGrammar):
+            if grammar.vocab != len(self.token_strs):
+                raise GrammarError(
+                    f"grammar=: CompiledGrammar vocab {grammar.vocab} "
+                    f"!= engine vocab {len(self.token_strs)}")
+            return grammar
+        if eos_token_id is None:
+            raise GrammarError(
+                ("json_schema=" if json_schema is not None
+                 else "grammar=") +
+                ": constrained decoding needs eos_token_id= (the "
+                "grammar decides WHEN the output is complete by "
+                "unmasking eos in accepting states)")
+        pattern = (grammar if grammar is not None
+                   else schema_to_regex(json_schema))
+        ck = (pattern, int(eos_token_id))
+        hit = self._grammar_cache.lookup(ck)
+        if hit is not None:
+            _STRUCT_CACHE_HITS.inc()
+            return hit
+        # compile OUTSIDE the cache lock (pure host work, possibly
+        # slow); a racing duplicate compile is wasted work, not
+        # corruption — GrammarCache.insert keeps the first copy
+        try:
+            cg = _gcomp.compile_regex(
+                pattern, self.token_strs, eos_id=int(eos_token_id),
+                max_states=self.grammar_arena.capacity)
+        except GrammarError:
+            self._grammar_cache.reject()
+            raise
+        return self._grammar_cache.insert(ck, cg)
+
+    def _resolve_constraint(self, grammar, json_schema, eos_token_id,
+                            spec_mode):
+        """add_request's ingress gate: structural validation (shared
+        with every remote ingress), engine-context checks, and the
+        grammar compile. Returns the CompiledGrammar or None."""
+        from .structured import validate_constraints
+
+        validate_constraints(grammar=grammar, json_schema=json_schema,
+                             spec_mode=spec_mode)
+        if spec_mode not in (None, "off") and spec_mode != (
+                self.spec_mode or "off"):
+            raise ValueError(
+                f"spec_mode={spec_mode!r}: this engine runs "
+                f"spec_mode={self.spec_mode!r} — speculation is an "
+                "engine resource; per-request spec_mode can only "
+                "opt OUT ('off') or restate the engine's mode")
+        if grammar is None and json_schema is None:
+            return None
+        return self.compile_constraint(grammar=grammar,
+                                       json_schema=json_schema,
+                                       eos_token_id=eos_token_id)
+
+    def _live_grammar_hashes(self):
+        """Hashes of grammars still referenced by queued or running
+        requests — what arena compaction must keep."""
+        live = set()
+        for r in self._slots:
+            if r is not None and r.grammar is not None:
+                live.add(r.grammar.hash)
+        for r in self.sched:
+            if r.grammar is not None:
+                live.add(r.grammar.hash)
+        return live
+
+    def _grammar_args(self, rows):
+        """Per-dispatch grammar arguments for the fused/verify
+        executables: arena-ABSOLUTE DFA states [num_slots] (0 = the
+        mask-identity row unconstrained slots ride) plus the committed
+        device tables. Shapes are engine-static — grammar churn swaps
+        values, never triggers a retrace. Without token_strs no
+        request can EVER be constrained, so all three are None and the
+        executables compile the pre-structured graph — engines outside
+        the constraint surface pay zero trace or dispatch cost."""
+        if self.token_strs is None:
+            return None, None, None
+        gst = np.zeros((self.num_slots,), np.int32)
+        for slot, req in rows:
+            if req.grammar is not None:
+                gst[slot] = (self.grammar_arena.base_of(req.grammar)
+                             + req.gstate)
+        gtrans, gmask = self.grammar_arena.device_tables()
+        return gst, gtrans, gmask
+
     # ---- client side ----
 
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
                     future=None, tenant="default", priority=None,
                     ttft_slo_s=None, temperature=0.0, top_p=1.0,
                     prefill_only=False, kv_import=None, trace=None,
-                    deadline_s=None, session_id=None):
+                    deadline_s=None, session_id=None, grammar=None,
+                    json_schema=None, spec_mode=None):
         """Enqueue one request. The disaggregated-serving knobs
         (docs/SERVING.md "Disaggregated fleet"):
 
@@ -849,7 +1047,28 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                       its frontier instead of re-prefilling the
                       history. Sessions expire by TTL/LRU; brownout
                       L4 sheds pinning before any traffic is
-                      refused."""
+                      refused.
+
+        Structured decoding (docs/SERVING.md "Structured decoding"):
+
+        grammar       a regex string (or pre-compiled
+                      structured.CompiledGrammar) constraining the
+                      OUTPUT tokens — compiled to a token-level DFA
+                      masked inside the decode executables. Requires
+                      LLMEngineConfig(token_strs=...) and an
+                      eos_token_id; rejected loudly HERE otherwise.
+        json_schema   a JSON-schema dict lowered to a grammar
+                      (structured.schema_to_regex) — canonical
+                      no-whitespace JSON output. Mutually exclusive
+                      with grammar=.
+        spec_mode     per-request speculation override: None inherits
+                      the engine's mode; "off"/the engine's own mode
+                      are accepted; asking for a mode the engine
+                      doesn't run raises (speculation is an ENGINE
+                      resource — a request can't conjure a draft
+                      model)."""
+        grammar_obj = self._resolve_constraint(grammar, json_schema,
+                                               eos_token_id, spec_mode)
         toks = np.asarray(prompt).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -870,6 +1089,20 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         # request reproduces its original continuation
         req.sample_stream = next(self._sample_streams)
         req.target = min(req.prompt_len + req.max_new, self.max_model_len)
+        if grammar_obj is not None:
+            # load into the arena NOW (loud GrammarError at submit,
+            # not mid-serve); the device tables refresh lazily at the
+            # next window dispatch — a value swap, never a recompile
+            req.grammar = grammar_obj
+            try:
+                self.grammar_arena.load(
+                    grammar_obj, live=self._live_grammar_hashes())
+            except Exception:
+                self._grammar_cache.reject()
+                raise
+            _STRUCT_REQS.inc()
+            self.stats["structured_requests"] += 1
+        req.spec_off = spec_mode == "off"
         if session_id is not None and self.prefix_cache is not None:
             req.session_id = str(session_id)
             req._session_seen = self._touch_session(req.session_id)
@@ -983,13 +1216,17 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             _DONATION_HELD.labels(step="spec_verify").set(
                 1.0 if vrep.donation["held"] else 0.0)
             # BOTH kv pytrees of the speculative contract: the draft
-            # propose scan donates the draft pools + shared key too
-            prep = analysis.analyze_step(self, check_donation=True,
-                                         which="propose")
-            out["propose"] = {"donation": prep.donation,
-                              "host_calls": prep.host_calls}
-            _DONATION_HELD.labels(step="spec_propose").set(
-                1.0 if prep.donation["held"] else 0.0)
+            # propose scan donates the draft pools + shared key too.
+            # The n-gram speculator has no propose executable (its
+            # proposals are host-mined), so only the verify probe
+            # applies there.
+            if getattr(self._spec, "_propose_fn", None) is not None:
+                prep = analysis.analyze_step(self, check_donation=True,
+                                             which="propose")
+                out["propose"] = {"donation": prep.donation,
+                                  "host_calls": prep.host_calls}
+                _DONATION_HELD.labels(step="spec_propose").set(
+                    1.0 if prep.donation["held"] else 0.0)
         return out
 
     def reseed(self, seed):
@@ -1425,6 +1662,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 int(_TOKENS_TOTAL.labels(phase="decode").value),
             "decode_k": self.decode_k,
             "spec": self._spec_metrics(),
+            "ngram": self._ngram_metrics(),
+            "structured": self._structured_metrics(),
             "fused_steps": int(_FUSED_STEPS.value),
             "dispatches": int(_DISPATCHES.value),
             "tokens_per_dispatch": _TOK_PER_DISPATCH.value,
@@ -1465,8 +1704,11 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
         """Speculative-decoding block of `metrics()`: None without a
         draft model; else the window/acceptance view (counters are
         PROCESS-cumulative — docs/OBSERVABILITY.md; the per-engine
-        window/proposed/accepted splits ride `stats`)."""
-        if self._spec is None:
+        window/proposed/accepted splits ride `stats`). The n-gram
+        speculator reports under the `ngram` block instead — its
+        counters are a different family."""
+        if self._spec is None or getattr(self._spec, "mode",
+                                         "draft") != "draft":
             return None
         from .speculative import (_SPEC_ACCEPTED, _SPEC_DRAFT_SECONDS,
                                   _SPEC_PROPOSED)
@@ -1481,6 +1723,40 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 _SPEC_ACCEPTED.value / proposed if proposed else None),
             "draft_seconds": round(float(_SPEC_DRAFT_SECONDS.value), 4),
             "draft_pool_bytes": self._spec.pool_bytes(),
+        }
+
+    def _ngram_metrics(self):
+        """n-gram speculation block of `metrics()`: None unless this
+        engine runs spec_mode='ngram'."""
+        if getattr(self._spec, "mode", None) != "ngram":
+            return None
+        proposed = self.stats.get("ngram_proposed", 0)
+        accepted = self.stats.get("ngram_accepted", 0)
+        return {
+            "spec_k": self._spec.k,
+            "windows": self.stats.get("ngram_windows", 0),
+            "proposed": int(proposed),
+            "accepted": int(accepted),
+            "acceptance_rate": (accepted / proposed if proposed
+                                else None),
+        }
+
+    def _structured_metrics(self):
+        """Structured-decoding block of `metrics()`: None unless the
+        engine has token_strs (the constraint surface enabled).
+        Engine-local counts — the `pt_structured_*` counters are
+        process-cumulative across every engine in the process."""
+        if self.token_strs is None:
+            return None
+        gc = self._grammar_cache.snapshot()
+        return {
+            "grammars_resident": len(self.grammar_arena._loaded),
+            "states_used": self.grammar_arena.states_used,
+            "state_budget": self.grammar_arena.n_states,
+            "requests": self.stats.get("structured_requests", 0),
+            "compiles": gc["compiles"],
+            "cache_hits": gc["cache_hits"],
+            "rejects": gc["rejects"],
         }
 
     def abort_all(self, exc):
@@ -2149,6 +2425,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             streams[slot] = req.sample_stream
             gen_before[slot] = req.num_generated
 
+        gst, gtrans, gmask = self._grammar_args(active)
         fused = self._ensure_fused()
         t0 = _time.perf_counter()
         try:
@@ -2156,7 +2433,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                              live=len(active)):
                 emits, (self._kv, self._kv_scales, self._key) = fused(
                     tok0, pos0, rem, fin0, eos, temps, tops, streams,
-                    self._page_tables,
+                    gst, gtrans, gmask, self._page_tables,
                     (self._kv, self._kv_scales, self._key))
                 emits = np.asarray(emits)   # the once-per-k host sync
         except Exception as e:
@@ -2184,6 +2461,10 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             for j in range(int(rem[slot])):
                 t = int(emits[j, slot])
                 req.tokens.append(t)
+                if req.grammar is not None:
+                    # host replay of the in-scan DFA advance: gstate
+                    # stays a pure function of the emitted tokens
+                    req.gstate = req.grammar.advance(req.gstate, t)
                 emitted += 1
                 if ((req.eos is not None and t == req.eos)
                         or len(req.tokens) >= req.target):
@@ -2385,6 +2666,16 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             rows = jnp.asarray(sample_slots, jnp.int32)
             lv = jnp.take(logits[0], rows, axis=0).astype(jnp.float32)
             frontier = [self._slots[s] for s in sample_slots]
+            if any(r.grammar is not None for r in frontier):
+                # host-path grammar masking: mask the logit VALUES
+                # before the (single-trace) jitted sampler / argmax —
+                # identical picks to the in-scan mask, zero new traces
+                allow = np.ones((len(frontier), lv.shape[1]), bool)
+                for jr, r in enumerate(frontier):
+                    if r.grammar is not None:
+                        allow[jr] = r.grammar.allowed_np(r.gstate)
+                lv = jnp.where(jnp.asarray(allow), lv,
+                               jnp.float32(-1e30))
             if any(r.do_sample for r in frontier):
                 nxt = np.asarray(self._host_sample_rows(lv, frontier))
             else:
@@ -2416,6 +2707,8 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             req = self._slots[slot]
             t = int(tok_id)
             req.tokens.append(t)
+            if req.grammar is not None:
+                req.gstate = req.grammar.advance(req.gstate, t)
             self.stats["generated"] += 1
             if req.num_generated == 1:      # replays don't re-count
                 ttft = now - req.t_submit
@@ -2429,6 +2722,17 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
                 self._finish(slot, req)
                 finished.append(req)
         return finished
+
+
+# the full `LLMServer.submit` kwarg surface — remote ingresses
+# (FleetRouter.submit) screen unknown kwargs against this set so a
+# typo'd knob raises at submit() time with its name, instead of dying
+# as a TypeError inside a replica's serve loop
+SUBMIT_KWARGS = frozenset((
+    "max_new_tokens", "eos_token_id", "tenant", "priority",
+    "ttft_slo_s", "temperature", "top_p", "prefill_only", "kv_import",
+    "trace", "deadline_s", "session_id", "grammar", "json_schema",
+    "spec_mode"))
 
 
 class LLMServer(_FutureQueueServer):
@@ -2479,7 +2783,8 @@ class LLMServer(_FutureQueueServer):
                tenant="default", priority=None, ttft_slo_s=None,
                temperature=0.0, top_p=1.0, prefill_only=False,
                kv_import=None, trace=None, deadline_s=None,
-               session_id=None):
+               session_id=None, grammar=None, json_schema=None,
+               spec_mode=None):
         """Enqueue one prompt (1-D int token ids). Returns a Future
         resolving to np.int64 [prompt + generated] (eos kept, nothing
         after it) — or, with `prefill_only=True`, to the exported
@@ -2507,7 +2812,21 @@ class LLMServer(_FutureQueueServer):
         token-identical to generate(); > 0 samples the temperature-
         scaled, `top_p`-truncated distribution, seeded from the engine
         PRNG key and keyed on (stream, position) — reproducible for a
-        given engine seed whatever decode_k is."""
+        given engine seed whatever decode_k is.
+
+        Structured decoding (docs/SERVING.md "Structured decoding"):
+        `grammar=` (regex / CompiledGrammar) or `json_schema=` (dict)
+        constrain the output tokens; `spec_mode=` opts a request out
+        of ("off") or restates the engine's speculation mode. All
+        three validate — and the grammar COMPILES, through the
+        engine's hash-keyed cache — on THIS thread, so a malformed
+        constraint raises here at submit() with the offending kwarg
+        named, never inside the serve loop where it would abort
+        co-resident requests (same hardening as `_check_import`)."""
+        # loud submit-time gate: structural validation + engine-context
+        # checks + grammar compile (GrammarError over the table budget)
+        grammar = self._engine._resolve_constraint(
+            grammar, json_schema, eos_token_id, spec_mode)
         fut = Future()
         fut.pt_request = None
         # trace identity minted at the INGRESS (this thread), so the
@@ -2528,7 +2847,8 @@ class LLMServer(_FutureQueueServer):
             temperature=float(temperature), top_p=float(top_p),
             prefill_only=bool(prefill_only), kv_import=kv_import,
             trace=trace, deadline_s=deadline_s,
-            session_id=session_id))
+            session_id=session_id, grammar=grammar,
+            json_schema=None, spec_mode=spec_mode))
         return fut
 
     def export_prefix(self, tokens):

@@ -154,7 +154,8 @@ class _CompiledVerifyStep(_CompiledStepBase):
         ps = int(page_size)
 
         def pure(param_vals, tok0, pos0, drafts, width, rem, fin0, eos,
-                 temps, top_ps, streams, pt, kv_state):
+                 temps, top_ps, streams, gstate0, gtrans, gmask, pt,
+                 kv_state):
             from ..autograd import engine as eng
 
             kv_vals, kv_scales, key = kv_state
@@ -167,19 +168,20 @@ class _CompiledVerifyStep(_CompiledStepBase):
                         self.k, ps, tok0, pos0, drafts, width, rem,
                         fin0, eos, temps, top_ps, streams, pt,
                         list(kv_vals),
-                        list(kv_scales) if kv_scales else None, key)
+                        list(kv_scales) if kv_scales else None, key,
+                        gstate0=gstate0, gtrans=gtrans, gmask=gmask)
             finally:
                 for p, v in zip(self._params, originals):
                     p._value = v
             return emits, (new_kv, new_scales, key)
 
-        self._jit = jax.jit(pure, donate_argnums=(12,))
+        self._jit = jax.jit(pure, donate_argnums=(15,))
 
     def __call__(self, tok0, pos0, drafts, width, rem, fin0, eos, temps,
-                 top_ps, streams, pt, kv_state):
+                 top_ps, streams, gstate0, gtrans, gmask, pt, kv_state):
         return self._run([p._value for p in self._params], tok0, pos0,
                          drafts, width, rem, fin0, eos, temps, top_ps,
-                         streams, pt, kv_state)
+                         streams, gstate0, gtrans, gmask, pt, kv_state)
 
 
 class SpeculativeDecoder:
@@ -187,6 +189,8 @@ class SpeculativeDecoder:
     (module docstring has the design). Owned by `LLMEngine` when
     `LLMEngineConfig(draft_model=...)` is set; `try_window(frontier)`
     is the spec sibling of `_try_step_fused`."""
+
+    mode = "draft"   # vs the n-gram speculator's "ngram" (metrics split)
 
     def __init__(self, engine, draft_model, spec_k):
         from ..distributed import mesh as mesh_mod
@@ -367,7 +371,8 @@ class SpeculativeDecoder:
 
         width = {}
         for slot, req in frontier:
-            w = min(k_eff, req.target - len(req.tokens))
+            w = min(0 if req.spec_off else k_eff,
+                    req.target - len(req.tokens))
             last = req.n_prefilled + w
             try:
                 while last // ps >= len(req.pages):
@@ -420,6 +425,12 @@ class SpeculativeDecoder:
             streams[slot] = req.sample_stream
             gen_before[slot] = req.num_generated
 
+        # structured decoding: arena DFA states + tables for the
+        # verify's in-executable masking (the draft propose scan stays
+        # unmasked — a grammar-illegal proposal simply fails
+        # exact-match and truncates acceptance, losslessly)
+        gst, gtrans, gmask = eng._grammar_args(frontier)
+
         t0 = _time.perf_counter()
         try:
             with _trace_span("llm_engine.spec_window", k=k,
@@ -451,7 +462,8 @@ class SpeculativeDecoder:
                 emits, (eng._kv, eng._kv_scales, eng._key) = \
                     self._verify_fn(
                         tok0, pos0, drafts, wid, rem, fin_v, eos,
-                        temps, tops, streams, eng._page_tables,
+                        temps, tops, streams, gst, gtrans, gmask,
+                        eng._page_tables,
                         (eng._kv, eng._kv_scales, eng._key))
                 emits = np.asarray(emits)  # [k+1, S]: the host sync
                 # already materialized by the sync above — the host
@@ -483,6 +495,10 @@ class SpeculativeDecoder:
                 if t < 0:
                     break
                 req.tokens.append(t)
+                if req.grammar is not None:
+                    # host replay of the DFA advance (llm_engine keeps
+                    # gstate a pure function of the emitted tokens)
+                    req.gstate = req.grammar.advance(req.gstate, t)
                 # exact accepted count: an emitted pick equals the
                 # draft at its position IFF that draft was accepted
                 # (a rejected position's pick differs by definition),

@@ -1,0 +1,65 @@
+"""Structured decoding — grammar-constrained generation, n-gram
+speculation, and the per-request constraint surface.
+
+Three legs (docs/SERVING.md "Structured decoding"):
+
+* `compiler` / `schema` — host-side grammar compilation: a regex (or a
+  JSON schema lowered through `schema_to_regex`) becomes a token-level
+  DFA (`CompiledGrammar`) whose tables the engine's `GrammarArena`
+  threads into the compiled decode executables, so constrained rows
+  mask logits INSIDE the fused/verify scans at zero recompiles.
+* `arena` — the fixed-shape device-table arena (mask-identity row 0:
+  unconstrained rows pay nothing).
+* `ngram` — `NgramSpeculator`, draft-model-free prompt-lookup
+  speculation through the existing ragged verify executable
+  (`LLMEngineConfig(spec_mode="ngram")`).
+
+`validate_constraints` is the shared submit-time gate every ingress
+(`LLMServer.submit`, `LocalReplica.submit`, `FleetRouter.submit`,
+`LLMEngine.add_request`) runs, so a malformed constraint kwarg raises
+at submit() with the offending name instead of dying inside the serve
+loop and aborting co-resident requests.
+
+`NgramSpeculator` is NOT imported here: ngram pulls in the speculative
+/ engine stack, which imports this package for validation — import it
+from `paddle_tpu.inference.structured.ngram` (the engine does).
+"""
+from .arena import GrammarArena
+from .compiler import CompiledGrammar, GrammarError, compile_regex
+from .schema import schema_to_regex
+
+__all__ = [
+    "CompiledGrammar", "GrammarArena", "GrammarError", "SPEC_MODES",
+    "compile_regex", "schema_to_regex", "validate_constraints",
+]
+
+SPEC_MODES = ("off", "draft", "ngram")
+
+
+def validate_constraints(grammar=None, json_schema=None,
+                         spec_mode=None):
+    """Structural validation of the per-request constraint kwargs —
+    loud, at submit() time, naming the offending kwarg. Engine-context
+    checks (token_strs configured, spec_mode matching the engine's,
+    grammar compilation itself) run on the engine's submit surface;
+    this gate is what remote ingresses (the fleet router) can run
+    without an engine in hand."""
+    if grammar is not None and json_schema is not None:
+        raise ValueError(
+            "grammar=/json_schema=: pass ONE constraint per request, "
+            "not both")
+    if grammar is not None and not isinstance(
+            grammar, (str, CompiledGrammar)):
+        raise ValueError(
+            "grammar= must be a regex string or a CompiledGrammar, "
+            f"got {type(grammar).__name__}")
+    if isinstance(grammar, str) and not grammar:
+        raise ValueError("grammar= must be a non-empty regex string")
+    if json_schema is not None and not isinstance(json_schema, dict):
+        raise ValueError(
+            "json_schema= must be a dict (a parsed JSON schema), got "
+            f"{type(json_schema).__name__}")
+    if spec_mode is not None and spec_mode not in SPEC_MODES:
+        raise ValueError(
+            f"spec_mode= must be one of {SPEC_MODES} or None, got "
+            f"{spec_mode!r}")

@@ -1,0 +1,186 @@
+"""Grammar arena — fixed-shape device tables for in-scan masking.
+
+The zero-recompile contract (docs/SERVING.md "Fused decode") means the
+fused/spec executables can never see a new array SHAPE. So the engine
+does not thread per-grammar tables into the scan; it threads ONE
+engine-lifetime arena:
+
+* ``trans`` int32 ``[G, vocab]`` — arena-ABSOLUTE next state for token
+  ``t`` in arena state ``g``;
+* ``mask``  uint32 ``[G, ceil(vocab/32)]`` — per-state allowed-token
+  bitsets, expanded to a boolean row inside the executable.
+
+Row 0 is the MASK-IDENTITY row: every token allowed, self-transition.
+Unconstrained slots carry arena state 0 through the whole window, the
+mask row is all-ones (a value-level no-op on the logits), and a
+``lax.cond`` on ``any(gstate > 0)`` skips even that gather when no
+constrained row is resident — unconstrained traffic pays nothing,
+same discipline as the all-greedy fast path in ``sample_tokens``.
+
+Compiled grammars load at base offsets ≥ 1 with their local next
+states rebased to arena-absolute; disallowed transitions clamp to 0,
+which is safe because masking (fused) / exact-match acceptance
+(verify) guarantees a disallowed token's transition is never consumed.
+``G`` is static for the engine's lifetime (`LLMEngineConfig(
+grammar_states=...)`); a grammar that cannot fit even after compacting
+away unreferenced entries raises ``GrammarError`` loudly. Device
+copies are remade only when the host arena changed (value swap, same
+shape/sharding — never a recompile).
+"""
+import threading
+
+import numpy as np
+
+from .compiler import GrammarError, _STRUCT_REJECTS, _STRUCT_STATES
+
+__all__ = ["GrammarArena", "GrammarCache"]
+
+
+class GrammarCache:  # ptlint: thread-shared
+    """Hash-keyed ``(pattern, eos_id) -> CompiledGrammar`` compile
+    cache plus its compile/hit/reject counters, lock-guarded:
+    ``LLMServer.submit`` compiles grammars on the CALLER's thread
+    (loud reject at submit) while ``add_request`` may compile on the
+    engine thread. Split out of ``LLMEngine`` so the lock naming this
+    one multi-writer contract does not drag the whole engine — whose
+    stats are serve-loop-owned, single-writer — under the class-wide
+    lock fence (ptlint PTL702)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self.compiles = 0
+        self.cache_hits = 0
+        self.rejects = 0
+
+    def lookup(self, key):
+        """The cached grammar for ``key`` (counting the hit), or None."""
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self.cache_hits += 1
+            return hit
+
+    def insert(self, key, grammar):
+        """Publish a freshly-compiled grammar; first writer wins (a
+        racing duplicate compile is wasted work, not corruption)."""
+        with self._lock:
+            self.compiles += 1
+            return self._cache.setdefault(key, grammar)
+
+    def reject(self):
+        with self._lock:
+            self.rejects += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"compiles": self.compiles,
+                    "cache_hits": self.cache_hits,
+                    "rejects": self.rejects}
+
+
+class GrammarArena:
+    def __init__(self, vocab, n_states):
+        self.vocab = int(vocab)
+        self.n_states = max(1, int(n_states))
+        self.words = (self.vocab + 31) // 32
+        self.trans = np.zeros((self.n_states, self.vocab), np.int32)
+        self.mask = np.zeros((self.n_states, self.words), np.uint32)
+        # identity row: all tokens allowed (surplus bits past vocab in
+        # the last word are set too — they index nothing), stay in 0
+        self.mask[0, :] = np.uint32(0xFFFFFFFF)
+        self._next = 1
+        self._loaded = {}            # hash -> (base, CompiledGrammar)
+        self._dirty = True
+        self._dev = None             # (trans_dev, mask_dev)
+
+    @property
+    def capacity(self):
+        """States available to a single grammar (row 0 is reserved)."""
+        return self.n_states - 1
+
+    @property
+    def states_used(self):
+        return self._next
+
+    def base_of(self, grammar):
+        """Arena base offset of a loaded grammar (by object or hash)."""
+        h = grammar if isinstance(grammar, str) else grammar.hash
+        return self._loaded[h][0]
+
+    def load(self, grammar, live=None):
+        """Ensure `grammar` is resident; return its base offset. When
+        the arena is full, compact away grammars outside `live` (an
+        iterable of hashes still referenced by queued/running
+        requests) and retry; still over budget → loud GrammarError."""
+        ent = self._loaded.get(grammar.hash)
+        if ent is not None:
+            return ent[0]
+        need = grammar.n_states
+        if self._next + need > self.n_states:
+            keep = set(live or ())
+            self._compact(keep)
+        if self._next + need > self.n_states:
+            _STRUCT_REJECTS.inc()
+            raise GrammarError(
+                f"grammar=: arena full ({self._next}/{self.n_states} "
+                f"states used, grammar needs {need}); raise "
+                "LLMEngineConfig(grammar_states=...) or retire live "
+                "constrained requests")
+        base = self._next
+        self._write(base, grammar)
+        self._loaded[grammar.hash] = (base, grammar)
+        self._next = base + need
+        self._dirty = True
+        _STRUCT_STATES.set(float(self._next))
+        return base
+
+    def _write(self, base, grammar):
+        n = grammar.n_states
+        t = grammar.trans.astype(np.int64)
+        allowed = t >= 0
+        # rebase local next states to arena-absolute; clamp disallowed
+        # to 0 (never consumed — the mask/acceptance gate runs first)
+        self.trans[base:base + n] = np.where(
+            allowed, t + base, 0).astype(np.int32)
+        words = np.zeros((n, self.words), np.uint32)
+        q_idx, t_idx = np.nonzero(allowed)
+        np.bitwise_or.at(
+            words, (q_idx, t_idx // 32),
+            (np.uint32(1) << (t_idx % 32).astype(np.uint32)))
+        self.mask[base:base + n] = words
+
+    def _compact(self, keep):
+        """Rebuild the arena keeping only grammars in `keep` — the
+        rebase invalidates dropped grammars' offsets, which is fine
+        because nothing references them."""
+        survivors = [g for h, (_, g) in sorted(self._loaded.items(),
+                                               key=lambda kv: kv[1][0])
+                     if h in keep]
+        self.trans[1:] = 0
+        self.mask[1:] = 0
+        self._loaded = {}
+        self._next = 1
+        for g in survivors:
+            base = self._next
+            self._write(base, g)
+            self._loaded[g.hash] = (base, g)
+            self._next = base + g.n_states
+        self._dirty = True
+        _STRUCT_STATES.set(float(self._next))
+
+    def device_tables(self):
+        """The committed (trans, mask) device pair the executables
+        take as plain arguments. Re-placed only when the host arena
+        changed — a VALUE swap at fixed shape/dtype/sharding, so the
+        one-executable contract holds across grammar churn."""
+        if self._dirty or self._dev is None:
+            import jax
+            import jax.numpy as jnp
+            from ...distributed import mesh as mesh_mod
+            sharding = mesh_mod.named_sharding()
+            self._dev = (
+                jax.device_put(jnp.asarray(self.trans), sharding),
+                jax.device_put(jnp.asarray(self.mask), sharding))
+            self._dirty = False
+        return self._dev

@@ -328,7 +328,8 @@ def _layer_forward_paged(layer, x, cache_k, cache_v, write_idx,
     return out, ck, cv, cks, cvs
 
 
-def sample_tokens(logits, temps, top_ps, streams, positions, key):
+def sample_tokens(logits, temps, top_ps, streams, positions, key,
+                  allowed=None):
     """Greedy / temperature / top-p next-token sampler — pure jnp,
     shared by the engine's host tick (first tokens after prefill) and
     the fused decode window's in-executable scan, so both paths pick
@@ -336,7 +337,13 @@ def sample_tokens(logits, temps, top_ps, streams, positions, key):
 
     logits [S, vocab] f32; temps/top_ps [S] f32; streams/positions [S]
     int32; key uint32[2] (the engine-owned PRNG key, threaded as a step
-    ARGUMENT so reseeding never recompiles).
+    ARGUMENT so reseeding never recompiles). allowed (optional)
+    [S, vocab] bool — the structured-decoding grammar mask: False
+    entries are excluded BEFORE both the greedy argmax and the top-p
+    truncation, so a constrained row's pick is always grammar-legal
+    under either decode mode. An all-True row is a value-level no-op:
+    unconstrained rows pick bit-identically to `allowed=None` (the
+    engine's mask-identity contract rides on this).
 
     Rows with temps <= 0 take the greedy argmax (the generate()/engine
     default pick, bit-identical to the host argmax path). Sampling rows
@@ -345,10 +352,15 @@ def sample_tokens(logits, temps, top_ps, streams, positions, key):
     depends ONLY on (engine seed, request stream, token position), so a
     request's sampled continuation is invariant to the window size k,
     to batch composition, and to preemption replays (the same
-    determinism contract greedy decode gets for free)."""
+    determinism contract greedy decode gets for free). The grammar mask
+    reshapes the distribution but not the key: constrained +
+    speculative composes losslessly because acceptance is exact-match
+    against this same keyed pick, masked or not."""
     import jax
     import jax.numpy as jnp
 
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, jnp.float32(-1e30))
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def drawn(_):
@@ -376,6 +388,21 @@ def sample_tokens(logits, temps, top_ps, streams, positions, key):
     # dispatch-bound serving the fused window exists to speed up
     return jax.lax.cond(jnp.any(temps > 0), drawn,
                         lambda _: greedy, None)
+
+
+def grammar_allowed(gmask, gstate, vocab):
+    """Expand grammar-arena mask bitsets to a boolean logits mask:
+    gmask [G, ceil(vocab/32)] uint32, gstate [R] int32 (arena-absolute
+    DFA state per row) → [R, vocab] bool for `sample_tokens(allowed=)`.
+    Pure jnp — runs inside the fused/verify executables
+    (inference/structured has the arena contract: row 0 is the
+    mask-identity every unconstrained row carries)."""
+    import jax.numpy as jnp
+
+    words = gmask[gstate]                       # [R, W] uint32
+    v = jnp.arange(int(vocab), dtype=jnp.int32)
+    bits = words[:, v // 32] >> (v % 32).astype(jnp.uint32)
+    return (bits & jnp.uint32(1)).astype(jnp.bool_)
 
 
 class GPTGenerationMixin:
@@ -496,7 +523,8 @@ class GPTGenerationMixin:
     def _paged_decode_fused(self, k, page_size, tok0, pos0, rem, fin0,
                             eos_ids, temps, top_ps, streams,
                             page_tables, kv, kv_scales, key,
-                            lag=None, frontier=None):
+                            lag=None, frontier=None, gstate0=None,
+                            gtrans=None, gmask=None):
         """k decode ticks fused into ONE `lax.scan` over the paged step
         — the body of the engine's fused executable (`_CompiledFusedStep`
         in inference/llm_engine.py): per iteration, write the frontier
@@ -533,7 +561,21 @@ class GPTGenerationMixin:
         separate catch-up tick; iteration 0's carry is FORCED to
         `frontier` (the already-known token at pos0) for lag rows, so
         the later proposals condition on the true sequence, not on
-        the draft's guess of a token the engine already holds."""
+        the draft's guess of a token the engine already holds.
+
+        gstate0/gtrans/gmask (structured decoding — all three or
+        none): gstate0 [S] int32 arena-absolute grammar DFA states,
+        gtrans [G, vocab] int32 / gmask [G, ceil(vocab/32)] uint32 the
+        engine's grammar-arena tables. The DFA state rides the scan
+        carry like the token does: each iteration masks the live rows'
+        logits through `grammar_allowed` BEFORE sampling and advances
+        `gs2 = gtrans[gs, nxt]`. Arena row 0 is the mask identity, so
+        unconstrained rows sample bit-identically — and a whole-window
+        `lax.cond` on `any(gstate0 > 0)` skips the gather/expand
+        entirely when no constrained row is resident (same discipline
+        as the all-greedy fast path in `sample_tokens`). The tables
+        are plain arguments at engine-static shapes: grammar churn is
+        a value swap, never a retrace."""
         import jax
         import jax.numpy as jnp
 
@@ -545,12 +587,18 @@ class GPTGenerationMixin:
         start = pos0 if lag is None else pos0 - lag
         klen0 = start + 1
         pad = jnp.asarray(-1, jnp.int32)
+        structured = gtrans is not None
+        if structured:
+            any_g = jnp.any(gstate0 > 0)
 
         def t(v):
             return Tensor(v, stop_gradient=True)
 
         def body(carry, i):
-            tok, fin, kv_c, kvs_c = carry
+            if structured:
+                tok, fin, gs, kv_c, kvs_c = carry
+            else:
+                tok, fin, kv_c, kvs_c = carry
             live = ~fin
             tok_in = jnp.where(live, tok, 0)
             pos_in = jnp.where(live, start + i, 0)
@@ -568,8 +616,15 @@ class GPTGenerationMixin:
             kv2 = [x._value for x in new[:n]]
             kvs2 = [x._value for x in new[n:]]
             lv = logits._value[0].astype(jnp.float32)  # [S, vocab]
+            allowed = None
+            if structured:
+                V = lv.shape[1]
+                allowed = jax.lax.cond(
+                    any_g,
+                    lambda s: grammar_allowed(gmask, s, V),
+                    lambda s: jnp.ones((S, V), jnp.bool_), gs)
             nxt = sample_tokens(lv, temps, top_ps, streams, pos_in + 1,
-                                key)
+                                key, allowed=allowed)
             if lag is not None:
                 # propose mode: a lag row's iteration-0 output IS the
                 # already-known frontier token — force it so later
@@ -579,16 +634,23 @@ class GPTGenerationMixin:
             fin2 = (fin | (live & (eos_ids >= 0) & (nxt == eos_ids))
                     | (live & (i + 1 >= rem)))
             tok2 = jnp.where(live, nxt, tok)
+            if structured:
+                gs2 = jnp.where(live, gtrans[gs, nxt], gs)
+                return (tok2, fin2, gs2, kv2, kvs2), emit
             return (tok2, fin2, kv2, kvs2), emit
 
-        (_, _, kv_f, kvs_f), emits = jax.lax.scan(
-            body, (tok0, fin0, list(kv), list(kv_scales or [])),
-            jnp.arange(int(k), dtype=jnp.int32))
+        init = ((tok0, fin0, gstate0, list(kv), list(kv_scales or []))
+                if structured
+                else (tok0, fin0, list(kv), list(kv_scales or [])))
+        carry_f, emits = jax.lax.scan(
+            body, init, jnp.arange(int(k), dtype=jnp.int32))
+        kv_f, kvs_f = carry_f[-2], carry_f[-1]
         return emits, kv_f, kvs_f
 
     def _paged_verify_fused(self, k, page_size, tok0, pos0, drafts,
                             width, rem, fin0, eos_ids, temps, top_ps,
-                            streams, page_tables, kv, kv_scales, key):
+                            streams, page_tables, kv, kv_scales, key,
+                            gstate0=None, gtrans=None, gmask=None):
         """Speculative-decoding verify: score ALL k+1 positions of every
         slot — the real frontier token plus k draft proposals — in ONE
         ragged batched step, then accept the longest prefix of drafts
@@ -631,10 +693,27 @@ class GPTGenerationMixin:
         is positional, no cleanup pass (the draft pool relies on the
         same property — tests pin it).
 
+        gstate0/gtrans/gmask (structured decoding — all three or
+        none): same arena tables the fused scan threads. The k+1
+        per-position DFA states are chained HYPOTHETICALLY through the
+        draft tokens (`st_{j+1} = gtrans[st_j, drafts[:, j]]` — a
+        static k-step chain, no scan) and each flat row's logits are
+        masked through `grammar_allowed` before the keyed pick.
+        Lossless composition falls out: up to the first rejected
+        draft the hypothetical states ARE the true states, so every
+        accepted pick saw exactly the mask the non-speculative fused
+        scan would have applied; states past the first mismatch are
+        garbage but their picks are never emitted (acceptance is the
+        exact-match prefix). Arena row 0 keeps unconstrained rows
+        bit-identical, and the whole-window `lax.cond` on
+        `any(gstate0 > 0)` skips the expansion when no constrained
+        row is resident.
+
         Returns (emits [k+1, S] int32, new_kv, new_scales): column s
         holds the accepted target picks — between 1 and k+1 tokens —
         then -1 padding; EOS and budget masking applied in-executable
         (the emitted eos is kept, nothing after it)."""
+        import jax
         import jax.numpy as jnp
 
         from ...tensor_core import Tensor
@@ -672,9 +751,23 @@ class GPTGenerationMixin:
         kv2 = [x._value for x in new[:n]]
         kvs2 = [x._value for x in new[n:]]
         lv = logits._value[0].astype(jnp.float32)       # [T, vocab]
+        allowed = None
+        if gtrans is not None:
+            # hypothetical DFA state per (slot, position): chain the
+            # draft tokens through the arena table (static k steps)
+            sts = [gstate0]
+            for jj in range(int(k)):
+                sts.append(gtrans[sts[-1], drafts[:, jj]])
+            st_flat = jnp.stack(sts, axis=1).reshape(T)
+            V = lv.shape[1]
+            allowed = jax.lax.cond(
+                jnp.any(gstate0 > 0),
+                lambda s: grammar_allowed(gmask, s, V),
+                lambda s: jnp.ones((T, V), jnp.bool_), st_flat)
         picks = sample_tokens(
             lv, jnp.repeat(temps, Q), jnp.repeat(top_ps, Q),
-            jnp.repeat(streams, Q), posf + 1, key).reshape(S, Q)
+            jnp.repeat(streams, Q), posf + 1, key,
+            allowed=allowed).reshape(S, Q)
         # longest matching draft prefix, clamped to the window width
         match = (drafts == picks[:, :k]) & (
             jnp.arange(int(k), dtype=jnp.int32)[None, :]

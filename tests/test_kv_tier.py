@@ -234,6 +234,52 @@ def test_disk_restart_gc_and_adopt(tmp_path):
     store2.close()
 
 
+def test_disk_mmap_read_byte_identity(tmp_path, monkeypatch):
+    """The mmap fast path (ISSUE 19 satellite): disk-tier prefetch via
+    `np.memmap` is a pure read-strategy swap — frames come back
+    byte-identical to the streamed `np.load` read of the same files,
+    the knob flows through `KVTierStore(mmap=...)` and the
+    PT_KV_TIER_MMAP env default, and the path is observable
+    (`mmap_reads` in the snapshot)."""
+    rng = np.random.default_rng(7)
+    d = str(tmp_path / "tier")
+    store = KVTierStore(ram_bytes=1, disk_dir=d, disk_bytes=1 << 30,
+                        mmap=True)
+    frames = {}
+    for i in range(3):
+        toks, payload = _mk_payload(rng, tokens=8 + i)
+        frames[prefix_key(toks)] = payload
+        assert store.put(prefix_key(toks), payload)
+    store.flush()
+    assert store.snapshot()["demotions"] == 3
+    for key, ref in frames.items():
+        back = store.get(key)
+        assert isinstance(back.kv[0], np.memmap)
+        assert _payload_bytes(back) == _payload_bytes(ref)
+        assert np.array_equal(np.asarray(back.tokens), ref.tokens)
+    assert store.snapshot()["mmap_reads"] == 3
+    store.close()
+    # the streamed reader over the SAME files agrees byte-for-byte
+    store2 = KVTierStore(ram_bytes=1, disk_dir=d, disk_bytes=1 << 30,
+                         mmap=False)
+    assert store2.snapshot()["adopted"] == 3
+    for key, ref in frames.items():
+        back = store2.get(key)
+        assert not isinstance(back.kv[0], np.memmap)
+        assert _payload_bytes(back) == _payload_bytes(ref)
+    assert store2.snapshot()["mmap_reads"] == 0
+    store2.close()
+    # env knob: PT_KV_TIER_MMAP=0 opts the default out
+    monkeypatch.setenv("PT_KV_TIER_MMAP", "0")
+    store3 = KVTierStore(ram_bytes=1 << 20)
+    assert store3.use_mmap is False
+    store3.close()
+    monkeypatch.delenv("PT_KV_TIER_MMAP")
+    store4 = KVTierStore(ram_bytes=1 << 20)
+    assert store4.use_mmap is True
+    store4.close()
+
+
 def test_spill_queue_never_blocks(monkeypatch):
     """The step-path contract: `put` is O(1) and never waits on the
     commit thread. With the commit thread wedged mid-pack, puts beyond

@@ -1,0 +1,498 @@
+"""Structured decoding subsystem (ISSUE 19): grammar-constrained
+generation in the scan, draft-free n-gram speculation, fleet-wide
+per-request constraints.
+
+The acceptance suite: the host regex/schema compilers cross-checked
+against Python `re` and `json.loads`, the five serving scenarios —
+unconstrained greedy identity with a constrained row co-resident,
+grammar-valid constrained output under greedy AND sampled policies
+across spec_k {1, 4}, constrained+speculative token-identity to the
+constrained non-speculative engine, n-gram speculation greedy-identical
+to the plain engine on a repetitive-suffix workload, and preemption
+replay resuming the exact DFA state — plus the zero-recompile /
+donation probes with constrained traffic live, the grammar cache /
+state-budget discipline, and the loud submit-time validation at every
+fleet ingress (engine, server, router).
+
+The model is a ~96-token char-level GPT (token i = one printable
+ASCII char, token 0 = eos) so grammar strings and token strings are
+the same alphabet and every assertion reads as text.
+"""
+import json
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.inference.llm_engine import (LLMEngine, LLMEngineConfig,
+                                             SUBMIT_KWARGS)
+from paddle_tpu.inference.structured import (GrammarArena, GrammarError,
+                                             compile_regex,
+                                             schema_to_regex,
+                                             validate_constraints)
+from paddle_tpu.text.models import GPTForCausalLM
+from paddle_tpu.text.models.gpt import GPTConfig
+
+pytestmark = [pytest.mark.serving, pytest.mark.structured]
+
+# token i>0 = chr(31+i); token 0 = the eos token (empty string)
+TOKS = [""] + [chr(c) for c in range(32, 127)]
+
+
+@pytest.fixture(autouse=True)
+def _serial_mesh():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    yield
+
+
+@pytest.fixture(scope="module")
+def char_model():
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    mesh_mod.reset_mesh()
+    paddle.seed(30)
+    cfg = GPTConfig(vocab_size=len(TOKS), hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+@pytest.fixture(scope="module")
+def draft_model():
+    paddle.seed(31)
+    cfg = GPTConfig(vocab_size=len(TOKS), hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=128)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=3, page_size=16, token_budget=8,
+                max_model_len=128, token_strs=TOKS)
+    base.update(kw)
+    return LLMEngineConfig(**base)
+
+
+def _drain(eng, cap=900):
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        eng.pool.assert_consistent()
+        steps += 1
+        assert steps < cap, "engine failed to drain (livelock?)"
+
+
+def _gen_text(req):
+    out = req.future.result(timeout=0)
+    return "".join(TOKS[t] for t in out[req.prompt_len:] if t != 0)
+
+
+def _gen_toks(req):
+    return [int(t) for t in req.future.result(timeout=0)]
+
+
+def _prompt(rng, n):
+    return rng.integers(1, len(TOKS), (n,)).tolist()
+
+
+def _accepts(cg, s):
+    """Drive the compiled DFA the way the engine does — mask gate
+    first, then advance — and ask if eos would be unmasked at the
+    end. The reference semantics `re.fullmatch` is checked against."""
+    state = 0
+    for ch in s:
+        t = TOKS.index(ch)
+        if not cg.allowed_np(state)[t]:
+            return False
+        state = cg.advance(state, t)
+    return cg.is_complete(state)
+
+
+# --------------------------------------------------------------------
+# Host compilers: regex -> DFA, JSON schema -> regex
+# --------------------------------------------------------------------
+
+@pytest.mark.parametrize("pattern,yes,no", [
+    (r"abc", ["abc"], ["ab", "abcd", "abd", ""]),
+    (r"a|bc", ["a", "bc"], ["b", "abc", "c"]),
+    (r"[0-9]+", ["0", "42", "007"], ["", "4a", "a4"]),
+    (r"[a-f]{2,4}", ["ab", "face"], ["a", "abcde", "gh"]),
+    (r"(ab)*c", ["c", "abc", "ababc"], ["ac", "ab", "abab"]),
+    (r"\d\d:\d\d", ["09:30"], ["9:30", "09-30"]),
+    (r'"[^"]*"', ['""', '"hi there"'], ['"', 'hi', '"a"b"']),
+    (r"x?y+", ["y", "xy", "xyyy"], ["x", "", "yx"]),
+    (r"a.c", ["abc", "a c", "azc"], ["ac", "abbc"]),
+    (r"\{\}", ["{}"], ["{", "}"]),
+])
+def test_regex_compiler_matches_python_re(pattern, yes, no):
+    cg = compile_regex(pattern, TOKS, eos_id=0)
+    for s in yes:
+        assert re.fullmatch(pattern, s), f"bad fixture {s!r}"
+        assert _accepts(cg, s), (pattern, s)
+        # replay (the preemption-resume reference) agrees with the
+        # step-wise advance, and accepting states unmask eos
+        st = cg.replay([TOKS.index(c) for c in s])
+        assert cg.is_complete(st) and cg.allowed_np(st)[0]
+    for s in no:
+        assert not re.fullmatch(pattern, s), f"bad fixture {s!r}"
+        assert not _accepts(cg, s), (pattern, s)
+
+
+def test_regex_compiler_loud_rejects():
+    with pytest.raises(GrammarError, match="unterminated"):
+        compile_regex(r"(ab", TOKS, eos_id=0)
+    with pytest.raises(GrammarError, match="anchor"):
+        compile_regex(r"^abc$", TOKS, eos_id=0)
+    # the state budget aborts IN the subset construction, loudly
+    with pytest.raises(GrammarError, match="state"):
+        compile_regex(r"[0-9]{40,60}", TOKS, eos_id=0, max_states=16)
+    with pytest.raises(ValueError, match="grammar"):
+        validate_constraints(grammar="")
+    with pytest.raises(ValueError, match="spec_mode"):
+        validate_constraints(spec_mode="turbo")
+    with pytest.raises(ValueError, match="not both"):
+        validate_constraints(grammar="a", json_schema={"type": "null"})
+
+
+def test_schema_to_regex_canonical_json():
+    schema = {"type": "object", "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "score": {"type": "number"},
+        "ok": {"type": "boolean"},
+        "tags": {"type": "array", "items": {"type": "integer"},
+                 "maxItems": 2},
+    }}
+    pat = schema_to_regex(schema)
+    good = '{"name":"ada","age":36,"score":1.5,"ok":true,"tags":[1,2]}'
+    assert re.fullmatch(pat, good)
+    obj = json.loads(good)          # the regex language IS valid JSON
+    assert obj["age"] == 36 and obj["tags"] == [1, 2]
+    for bad in ('{"name":"ada"}',             # missing keys
+                '{"age":36,"name":"ada",'     # wrong declaration order
+                '"score":1,"ok":true,"tags":[]}',
+                '{ "name" : "ada" }'):        # whitespace: not canonical
+        assert not re.fullmatch(pat, bad), bad
+    # enums and nested paths; unsupported shapes name the path
+    assert re.fullmatch(schema_to_regex(
+        {"type": "string", "enum": ["a", "b"]}), '"b"')
+    with pytest.raises(GrammarError, match=r"\$\.child"):
+        schema_to_regex({"type": "object", "properties": {
+            "child": {"type": "blob"}}})
+
+
+def test_grammar_arena_identity_row_and_budget():
+    cg = compile_regex(r"[0-9]{2}", TOKS, eos_id=0)
+    ar = GrammarArena(len(TOKS), 16)
+    base = ar.load(cg)
+    assert base >= 1 and ar.load(cg) == base     # idempotent reload
+    trans, mask = ar.device_tables()
+    assert trans.shape == (16, len(TOKS))
+    # row 0 is the mask-identity row every unconstrained slot points at
+    m0 = np.asarray(mask)[0]
+    assert (np.bitwise_count(m0).sum() if hasattr(np, "bitwise_count")
+            else bin(int.from_bytes(m0.tobytes(), "little")).count("1")
+            ) >= len(TOKS)
+    assert int(np.asarray(trans)[0].max()) == 0
+    # a grammar the remaining budget can't hold rejects loudly;
+    # compaction keeps live grammars
+    big = compile_regex(r"[0-9]{10,12}", TOKS, eos_id=0)
+    with pytest.raises(GrammarError, match="budget|states"):
+        ar.load(big, live={cg.hash})
+
+
+# --------------------------------------------------------------------
+# Scenario 1+2: co-resident constrained/unconstrained, fused scan
+# --------------------------------------------------------------------
+
+def test_constrained_and_unconstrained_coresident_greedy(char_model):
+    """One fused-window engine serving a grammar-constrained row next
+    to unconstrained rows: the constrained output fullmatches its
+    grammar (eos included), the unconstrained rows are token-identical
+    to an engine that never saw a grammar, and the whole run holds the
+    one-executable contract."""
+    cfg, model = char_model
+    pat = r'\{"a":[0-9]{1,3}\}'
+    rng = np.random.default_rng(0)
+    p1, p2, p3 = _prompt(rng, 6), _prompt(rng, 9), _prompt(rng, 12)
+    eng = LLMEngine(model, _ecfg(decode_k=4))
+    r1 = eng.add_request(p1, max_new_tokens=20, eos_token_id=0,
+                         grammar=pat)
+    r2 = eng.add_request(p2, max_new_tokens=20, eos_token_id=0)
+    r3 = eng.add_request(p3, max_new_tokens=20, eos_token_id=0)
+    _drain(eng)
+    assert re.fullmatch(pat, _gen_text(r1))
+    assert eng.compile_stats() == {"executables": 1,
+                                   "fused_executables": 1}
+    m = eng.metrics()["structured"]
+    assert m["requests"] == 1 and m["grammars_resident"] == 1
+    plain = LLMEngine(model, _ecfg(decode_k=4))
+    q2 = plain.add_request(p2, max_new_tokens=20, eos_token_id=0)
+    q3 = plain.add_request(p3, max_new_tokens=20, eos_token_id=0)
+    _drain(plain)
+    assert _gen_toks(r2) == _gen_toks(q2)
+    assert _gen_toks(r3) == _gen_toks(q3)
+
+
+# --------------------------------------------------------------------
+# Scenario 2+3: grammar-valid under greedy AND sampled, spec_k {1,4},
+# and constrained+speculative token-identity to constrained non-spec
+# --------------------------------------------------------------------
+
+def test_constrained_speculative_identity_and_validity(
+        char_model, draft_model):
+    """Greedy (T=0) and sampled (T=0.8) constrained rows ride ONE
+    engine per config as co-residents — draws are keyed on
+    (seed, stream, position), so spec_k {1,4} must reproduce the
+    non-spec reference token-for-token at BOTH temperatures."""
+    cfg, model = char_model
+    pat = r'\{"a":[0-9]{1,3}\}'
+    temps = (0.0, 0.8)
+    rng = np.random.default_rng(0)
+    p = _prompt(rng, 6)
+
+    def run(**extra):
+        eng = LLMEngine(model, _ecfg(**extra))
+        rs = [eng.add_request(p, max_new_tokens=24, eos_token_id=0,
+                              grammar=pat, temperature=t, top_p=0.9)
+              for t in temps]
+        _drain(eng)
+        return [_gen_toks(r) for r in rs]
+
+    ref = run(decode_k=4)
+    for k in (1, 4):
+        got = run(draft_model=draft_model, spec_k=k)
+        for temperature, g, r in zip(temps, got, ref):
+            assert g == r, (temperature, k)
+            text = "".join(TOKS[t] for t in g[len(p):] if t != 0)
+            assert re.fullmatch(pat, text), (temperature, k, text)
+
+
+def test_constrained_json_schema_end_to_end(char_model):
+    """json_schema= submits compile through schema_to_regex and the
+    engine emits parseable, schema-shaped JSON."""
+    cfg, model = char_model
+    rng = np.random.default_rng(3)
+    eng = LLMEngine(model, _ecfg(decode_k=4))
+    r = eng.add_request(_prompt(rng, 8), max_new_tokens=32,
+                        eos_token_id=0,
+                        json_schema={"type": "object", "properties": {
+                            "a": {"type": "integer"},
+                            "b": {"type": "boolean"}}})
+    _drain(eng)
+    obj = json.loads(_gen_text(r))
+    assert set(obj) == {"a", "b"}
+    assert isinstance(obj["a"], int) and isinstance(obj["b"], bool)
+
+
+# --------------------------------------------------------------------
+# Scenario 4: n-gram speculation
+# --------------------------------------------------------------------
+
+def test_ngram_spec_greedy_identity_repetitive_suffix(char_model):
+    """spec_mode="ngram" on a repetitive-suffix workload (the
+    prompt-lookup sweet spot): token-identical to the plain engine,
+    windows actually proposed, and the verify executable holds the
+    zero-host-call / full-donation / one-executable contract."""
+    from paddle_tpu import analysis
+
+    cfg, model = char_model
+    body = [TOKS.index(c) for c in "the cat sat on the mat. " * 4]
+    rng = np.random.default_rng(5)
+    prompts = [body, _prompt(rng, 11) + body[:30], _prompt(rng, 7)]
+
+    plain = LLMEngine(model, _ecfg(decode_k=1))
+    refs = [plain.add_request(p, max_new_tokens=24, eos_token_id=0)
+            for p in prompts]
+    _drain(plain)
+
+    eng = LLMEngine(model, _ecfg(spec_mode="ngram", spec_k=4))
+    rs = [eng.add_request(p, max_new_tokens=24, eos_token_id=0)
+          for p in prompts]
+    _drain(eng)
+    for a, b in zip(refs, rs):
+        assert _gen_toks(a) == _gen_toks(b)
+    m = eng.metrics()
+    assert m["ngram"]["windows"] > 0 and m["ngram"]["proposed"] > 0
+    assert m["spec"] is None        # draft-decoder metrics stay silent
+    stats = eng.compile_stats(check_donation=True)
+    assert stats["executables"] == 1
+    assert stats["verify"]["host_calls"] == {}, stats["verify"]
+    assert stats["verify"]["donation"]["held"], stats["verify"]
+    rep = analysis.analyze_step(eng, which="verify")
+    assert rep.host_calls == {}
+    assert rep.donation["aliased"] == rep.donation["expected"] > 0
+
+
+def test_ngram_per_request_opt_out(char_model):
+    """spec_mode="off" per request disables proposals for that row
+    only; restating the engine's own mode is a no-op; asking for a
+    mode the engine doesn't run is a loud submit-time error."""
+    cfg, model = char_model
+    rng = np.random.default_rng(6)
+    eng = LLMEngine(model, _ecfg(spec_mode="ngram", spec_k=4))
+    body = [TOKS.index(c) for c in "ab ab ab ab ab ab ab ab "]
+    r_off = eng.add_request(body, max_new_tokens=12, eos_token_id=0,
+                            spec_mode="off")
+    r_on = eng.add_request(list(body), max_new_tokens=12,
+                           eos_token_id=0, spec_mode="ngram")
+    _drain(eng)
+    assert _gen_toks(r_off)[len(body):] == _gen_toks(r_on)[len(body):]
+    with pytest.raises(ValueError, match="engine resource"):
+        eng.add_request(_prompt(rng, 4), max_new_tokens=4,
+                        spec_mode="draft")
+
+
+# --------------------------------------------------------------------
+# Scenario 5: preemption replays the DFA state
+# --------------------------------------------------------------------
+
+def test_constrained_preemption_resumes_dfa_state(char_model):
+    """Constrained rows through a pool tight enough to preempt:
+    outputs stay token-identical to the unpressured engine, stay
+    grammar-shaped, and every request's resumed host DFA state equals
+    a pure replay of its emitted tokens (the state is a function of
+    the tokens, so eviction/readmission cannot desync it).
+    `[0-9]{25,}` never reaches an accepting state within max_new, so
+    rows run full length and the pool actually tightens."""
+    cfg, model = char_model
+    pat = r"[0-9]{25,}"
+    rng = np.random.default_rng(7)
+    prompts = [_prompt(rng, 20) for _ in range(4)]
+
+    def run(**extra):
+        eng = LLMEngine(model, _ecfg(max_model_len=48, **extra))
+        rs = [eng.add_request(p, max_new_tokens=20, eos_token_id=0,
+                              grammar=pat) for p in prompts]
+        _drain(eng)
+        return rs, eng
+
+    refs, _ = run(decode_k=1)
+    rs, eng = run(decode_k=2, num_pages=6)
+    assert eng.stats["preemptions"] > 0, "pool was not tight enough"
+    for a, b in zip(refs, rs):
+        assert _gen_toks(a) == _gen_toks(b)
+        text = _gen_text(b)
+        assert text.isdigit() and len(text) == 20
+        gen = _gen_toks(b)[b.prompt_len:]
+        assert b.gstate == b.grammar.replay(gen)
+
+
+# --------------------------------------------------------------------
+# Zero recompiles with constrained traffic; grammar cache
+# --------------------------------------------------------------------
+
+def test_zero_recompile_grammar_swap_and_cache(char_model):
+    """After warm-up, NEW grammars are value swaps into the arena
+    tables — never recompiles: a second wave under a different grammar
+    (and a third reusing the first) holds the exact one-executable
+    census, the fused probe shows zero host calls and full donation,
+    and the compiled-grammar cache serves the reuse."""
+    from paddle_tpu import analysis
+
+    cfg, model = char_model
+    rng = np.random.default_rng(9)
+    eng = LLMEngine(model, _ecfg(decode_k=4))
+    r = eng.add_request(_prompt(rng, 6), max_new_tokens=16,
+                        eos_token_id=0, grammar=r"[0-9]{1,8}")
+    _drain(eng)
+    assert eng.compile_stats() == {"executables": 1,
+                                   "fused_executables": 1}
+    # wave 2: different grammar (arena write), plus unconstrained
+    r2 = eng.add_request(_prompt(rng, 9), max_new_tokens=16,
+                         eos_token_id=0, grammar=r"[a-z ]{1,9}!")
+    eng.add_request(_prompt(rng, 5), max_new_tokens=8, eos_token_id=0)
+    _drain(eng)
+    # wave 3: grammar 1 again — the compile cache, not a recompile
+    r3 = eng.add_request(_prompt(rng, 7), max_new_tokens=16,
+                         eos_token_id=0, grammar=r"[0-9]{1,8}")
+    _drain(eng)
+    assert eng.compile_stats() == {"executables": 1,
+                                   "fused_executables": 1}
+    assert re.fullmatch(r"[a-z ]{1,9}!", _gen_text(r2))
+    assert re.fullmatch(r"[0-9]{1,8}", _gen_text(r3))
+    m = eng.metrics()["structured"]
+    assert m["compiles"] == 2 and m["cache_hits"] >= 1
+    assert m["grammars_resident"] == 2
+    assert m["states_used"] <= m["state_budget"]
+    stats = eng.compile_stats(check_donation=True)
+    assert stats["fused"]["host_calls"] == {}, stats["fused"]
+    assert stats["fused"]["donation"]["held"], stats["fused"]
+    rep = analysis.analyze_step(eng, which="fused")
+    assert rep.host_calls == {} and rep.kind == "FusedDecode"
+
+
+# --------------------------------------------------------------------
+# Loud fleet-wide submit validation
+# --------------------------------------------------------------------
+
+def test_submit_validation_every_ingress(char_model):
+    cfg, model = char_model
+    rng = np.random.default_rng(11)
+    p = _prompt(rng, 5)
+    # engine ingress
+    eng = LLMEngine(model, _ecfg())
+    with pytest.raises(ValueError, match="not both"):
+        eng.add_request(p, grammar="a+", json_schema={"type": "null"},
+                        eos_token_id=0)
+    with pytest.raises(ValueError, match="CompiledGrammar"):
+        eng.add_request(p, grammar=12, eos_token_id=0)
+    with pytest.raises(GrammarError, match="eos_token_id"):
+        eng.add_request(p, grammar="a+")
+    # an engine without token_strs names the missing config knob
+    bare = LLMEngine(model, LLMEngineConfig(num_slots=2, page_size=16,
+                                            max_model_len=64))
+    with pytest.raises(ValueError, match="token_strs"):
+        bare.add_request(p, grammar="a+", eos_token_id=0)
+    # a grammar over the arena's state budget rejects AT submit
+    tight = LLMEngine(model, _ecfg(grammar_states=8))
+    with pytest.raises(GrammarError, match="state"):
+        tight.add_request(p, grammar=r"[0-9]{30,40}", eos_token_id=0)
+    assert tight.metrics()["structured"]["rejects"] >= 1
+    # server ingress: caller thread, server survives
+    with inference.LLMServer(model, _ecfg()) as server:
+        with pytest.raises(TypeError, match="grammer"):
+            server.submit(p, max_new_tokens=4, grammer="a+")
+        with pytest.raises(ValueError, match="spec_mode"):
+            server.submit(p, max_new_tokens=4, spec_mode="warp")
+        f = server.submit(p, max_new_tokens=6, eos_token_id=0,
+                          grammar=r"[0-9]{1,4}")
+        assert re.fullmatch(r"[0-9]{1,4}",
+                            "".join(TOKS[t] for t in
+                                    f.result(timeout=120)[len(p):]
+                                    if t != 0))
+
+
+def test_router_ingress_validation(char_model):
+    from paddle_tpu.inference.fleet_serving import (AutoscalePolicy,
+                                                    FleetRouter,
+                                                    LocalReplica,
+                                                    fork_model)
+
+    cfg, model = char_model
+    rng = np.random.default_rng(13)
+    p = np.asarray(_prompt(rng, 5))
+    router = FleetRouter(
+        replicas=[LocalReplica(fork_model(model), name="a",
+                               config=_ecfg())],
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=1))
+    with router:
+        with pytest.raises(TypeError, match="gramar"):
+            router.submit(p, max_new_tokens=4, gramar="a+")
+        with pytest.raises(ValueError, match="CompiledGrammar"):
+            router.submit(p, max_new_tokens=4, grammar=3.5)
+        with pytest.raises(ValueError, match="not both"):
+            router.submit(p, max_new_tokens=4, grammar="a+",
+                          json_schema={"type": "null"})
+        f = router.submit(p, max_new_tokens=8, eos_token_id=0,
+                          grammar=r"[0-9]{1,4}")
+        out = np.asarray(f.result(timeout=180))
+        text = "".join(TOKS[t] for t in out[len(p):] if t != 0)
+        assert re.fullmatch(r"[0-9]{1,4}", text)
+        assert SUBMIT_KWARGS >= {"grammar", "json_schema", "spec_mode"}
