@@ -64,7 +64,7 @@ def _worker_bootstrap():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     try:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
+    except Exception:  # ptlint: disable=PTL804 (knob probe; absent in older jax)
         pass  # knob not present in this jax — default is fine
     try:
         devs = jax.devices()
@@ -2670,12 +2670,22 @@ def _ptlint_stamp():
         # owns a live step
         spmd_ast = sum(1 for f in res["findings"]
                        if f.rule.startswith(("PTL6", "PTL7")))
+        # the lock-discipline graph rides the same stamp (ISSUE-20):
+        # a perf trend across PRs is only comparable when the lock
+        # topology is the blessed one — a new cross-class edge can BE
+        # the regression (serialization the profiler sees as idle)
+        lock_rep = mod.lock_graph_report(
+            [os.path.join(here, p) for p in cli.DEFAULT_PATHS])
         return {"version": mod.PTLINT_VERSION,
                 "findings": len(res["findings"]),
                 "suppressed": res["suppressed"],
                 "files": res["files"],
                 "spmd": {"version": mod.SPMD_ANALYSIS_VERSION,
-                         "ast_findings": spmd_ast}}
+                         "ast_findings": spmd_ast},
+                "locks": {"version": mod.LOCK_ANALYSIS_VERSION,
+                          "classes": lock_rep["classes"],
+                          "edges": lock_rep["edges"],
+                          "findings": len(lock_rep["findings"])}}
     except Exception as e:  # metadata must never kill the headline
         log(f"[bench] ptlint stamp failed: {e!r}")
         return {"error": repr(e)}

@@ -13,6 +13,16 @@ two layers that exist here:
   ordering. `tools/ptlint.py` is the CLI/CI gate; the tier-1 suite
   pins the shipped tree at zero findings.
 
+* **Concurrency & aliasing level** (`lint`, lock/alias passes): the
+  same AST pass also builds a per-class lock-acquisition graph across
+  the tree (PTL801 lock-order cycles — a static deadlock detector
+  with `tests/golden/fleet_lock_order.json` pinning the blessed
+  cross-class edge set), lints blocking calls and caller-supplied
+  callbacks under a held lock (PTL802/803), silent exception
+  swallowing (PTL804), and zero-copy aliasing escapes into long-lived
+  state (PTL501/502). `build_lock_graph` / `lock_graph_report` export
+  the graph for CI and `tools/ptlint.py --locks`.
+
 * **jaxpr/HLO level** (`step_analysis`): `analyze_step()` traces a
   live `jit.TrainStep` / `inference.LLMEngine` and reports donation
   coverage (did the compiled executable really alias the donated
@@ -24,8 +34,10 @@ Rule catalogue with the real shipped-bug each rule would have caught:
 docs/ANALYSIS.md.
 """
 from .lint import (  # noqa: F401
-    PTLINT_VERSION, SPMD_ANALYSIS_VERSION, RULES, Rule, Finding,
-    lint_source, lint_file, lint_paths, iter_python_files)
+    PTLINT_VERSION, SPMD_ANALYSIS_VERSION, LOCK_ANALYSIS_VERSION,
+    RULES, Rule, Finding,
+    lint_source, lint_file, lint_paths, iter_python_files,
+    build_lock_graph, lock_graph_report)
 from .step_analysis import (  # noqa: F401
     ANALYSIS_RULES, StepReport, analyze_step, analyze_jit,
     donation_coverage, signature_diff)
@@ -35,9 +47,10 @@ from .spmd_analysis import (  # noqa: F401
     spmd_report)
 
 __all__ = [
-    "PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "RULES", "Rule",
-    "Finding",
+    "PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "LOCK_ANALYSIS_VERSION",
+    "RULES", "Rule", "Finding",
     "lint_source", "lint_file", "lint_paths", "iter_python_files",
+    "build_lock_graph", "lock_graph_report",
     "ANALYSIS_RULES", "StepReport", "analyze_step", "analyze_jit",
     "donation_coverage", "signature_diff",
     "SPMD_RULES", "Collective", "CollectiveSchedule",

@@ -51,15 +51,19 @@ import os
 import re
 import types
 
-__all__ = ["PTLINT_VERSION", "SPMD_ANALYSIS_VERSION", "RULES", "Rule",
+__all__ = ["PTLINT_VERSION", "SPMD_ANALYSIS_VERSION",
+           "LOCK_ANALYSIS_VERSION", "RULES", "Rule",
            "Finding", "lint_source", "lint_file", "lint_paths",
-           "iter_python_files"]
+           "iter_python_files", "build_lock_graph", "lock_graph_report"]
 
-PTLINT_VERSION = "1.2.0"
+PTLINT_VERSION = "1.3.0"
 # version of the jaxpr-level SPMD pass suite (analysis/spmd_analysis.py).
 # Declared HERE so the stdlib-only loaders (tools/ptlint.py, bench.py's
 # supervisor-side stamp) can report it without importing jax.
 SPMD_ANALYSIS_VERSION = "1.0.0"
+# version of the tree-wide lock-acquisition-graph pass (PTL801 and the
+# fleet_lock_order.json golden) — stdlib-only, lives in this module
+LOCK_ANALYSIS_VERSION = "1.0.0"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +138,28 @@ RULES = {r.id: r for r in [
          "hangs the pod; interprocedural since ISSUE-11 — a helper "
          "that reaches a collective is as divergent as the "
          "collective itself"),
+    Rule("PTL501", "aliasing-escape",
+         "a value aliasing caller-owned storage (np.asarray/"
+         "jnp.asarray/frombuffer/memoryview of an argument) escapes "
+         "into an attribute or shared container that outlives the "
+         "call",
+         "the set_state_dict class: a restore path stored VIEWS of "
+         "the caller's arrays, so a later in-place update (or a "
+         "donating executable consuming the origin) silently "
+         "corrupted the caller's copy — the zero-copy aliasing "
+         "family behind years of 'platform-bug' flakes, fenced at "
+         "runtime since PR 11 and now a static fail; np.array / "
+         "jnp.array(copy=True) / .copy() are the documented fixes "
+         "and launder the taint"),
+    Rule("PTL502", "host-view-into-jit",
+         "a host view of caller storage (asarray/frombuffer of an "
+         "argument) handed to a recorded jitted callable without a "
+         "defensive copy",
+         "the make_array_from_callback root cause: a zero-copy host "
+         "view entering a compiled step can be aliased by the "
+         "runtime — donation frees the caller's buffer, and async "
+         "dispatch races any caller-side mutation of the view; copy "
+         "first (np.array / jnp.array(copy=True))"),
     Rule("PTL601", "concat-into-partial-shard-map-spec",
          "a jnp.concatenate/stack-derived value enters shard_map "
          "through a partial in_spec (a PartitionSpec leaving mesh "
@@ -169,6 +195,49 @@ RULES = {r.id: r for r in [
          "tenant fair-queuing defaultdict materialized a 0.0 "
          "meter per merely-waiting tenant (mutation on the read "
          "path), fixed to .get in review pass 2"),
+    Rule("PTL801", "lock-order-cycle",
+         "the per-class lock-acquisition graph (with self.<lock>: "
+         "nesting, direct and through self/cls helper calls and "
+         "cross-class method calls, transitively) contains a cycle — "
+         "or a non-reentrant Lock is re-acquired on a path that "
+         "already holds it",
+         "the PR-13 wedged-replica flap shape: two threads taking "
+         "the same pair of locks in opposite order wedge both "
+         "forever with zero CPU — the blessed fleet-wide order is "
+         "pinned in tests/golden/fleet_lock_order.json the same way "
+         "the dp2.tp2.pp2 collective schedule is pinned"),
+    Rule("PTL802", "blocking-call-under-lock",
+         "a blocking call (time.sleep, file open, socket "
+         "send/recv/accept/connect, thread/process join, "
+         "Future.result, Event.wait, block_until_ready) runs while a "
+         "declared class lock is held — directly or through any "
+         "helper-call depth in the module",
+         "a lock held across a disk/network/device wait serializes "
+         "every other thread behind I/O — the anomaly journal held "
+         "its lock across open()+write, so one slow disk stalled "
+         "every thread that journaled; the fixes are the kv_tier "
+         "idioms: snapshot-then-release, or a bounded-queue hand-off "
+         "to a worker thread"),
+    Rule("PTL803", "callback-under-lock",
+         "a caller-supplied callback (an attribute assigned verbatim "
+         "from a constructor/method parameter, or a function "
+         "parameter called directly) is invoked while a class lock "
+         "is held",
+         "the re-entrancy shape: spill_fn / event sinks / registered "
+         "state providers are arbitrary caller code — invoked under "
+         "the lock they can call back into the class and self-"
+         "deadlock (non-reentrant Lock), or wedge on a second lock; "
+         "snapshot the callback and its arguments, release, THEN "
+         "invoke"),
+    Rule("PTL804", "silent-except-pass",
+         "a bare `except:` / `except Exception:` whose handler body "
+         "is only pass/continue — a swallowed failure with no "
+         "journal, counter, or log",
+         "the PR-15 class: the kwarg-collision dump path failed "
+         "silently for three releases because its guard was `except "
+         "Exception: pass` — best-effort code must leave a trace "
+         "(resilience.record(...), a pt_* counter, or a log call in "
+         "the handler makes it legal)"),
 ]}
 
 _SLUG_TO_ID = {r.name: r.id for r in RULES.values()}
@@ -256,6 +325,40 @@ _DICT_FACTORIES = {"dict", "defaultdict", "OrderedDict", "Counter"}
 _LAZY_ITER_WRAPPERS = {"enumerate", "zip", "map", "filter", "iter",
                        "reversed", "chain"}
 
+# ---- PTL5xx (aliasing/donation escape) tables ----
+# zero-copy constructors: their result ALIASES the argument's storage
+# whenever numpy/jax can avoid the copy. np.array / jnp.array(copy=True)
+# / .copy() are the documented fixes — they simply don't match, so
+# correct code launders the taint by construction.
+_ALIAS_VIEW_FUNCS = {"asarray", "frombuffer"}
+# ndarray methods that return views of views — aliasing survives them
+_VIEW_METHODS = {"view", "reshape", "ravel", "transpose", "squeeze",
+                 "swapaxes"}
+# container mutators through which an alias escapes into shared state
+_CONTAINER_STORES = {"append", "add", "insert", "setdefault", "extend"}
+
+# ---- PTL8xx (lock discipline) tables ----
+# attribute calls that block the calling thread (socket, future,
+# queue, subprocess and device waits). `.join` is handled separately
+# in _blocking_call with a strict signature guard so str.join and
+# os.path.join never match.
+_BLOCKING_METHODS = {"result", "recv", "recvfrom", "accept", "connect",
+                     "sendall", "send", "communicate",
+                     "block_until_ready", "wait"}
+# method names too generic to resolve a cross-class lock edge by bare
+# name (half the tree defines a close()/get()/put()) — the lock graph
+# follows a bare-name call only when exactly ONE lock-owning class
+# defines it AND the name is specific enough to mean that class
+_GENERIC_METHODS = frozenset({
+    "get", "put", "pop", "add", "append", "remove", "clear", "update",
+    "close", "start", "stop", "run", "join", "wait", "notify", "send",
+    "recv", "read", "write", "flush", "reset", "register", "submit",
+    "shutdown", "metrics", "snapshot", "load", "save", "set", "step",
+    "call", "apply", "copy", "result", "next", "keys", "values",
+    "items", "acquire", "release", "drain", "tick", "poll", "open",
+    "cancel",   # Future.cancel — dogfood FP: resolved to FleetRouter
+})
+
 
 @dataclasses.dataclass
 class _ClassInfo:
@@ -265,6 +368,10 @@ class _ClassInfo:
     dict_attrs: frozenset = frozenset()        # self attrs holding dicts
     defaultdict_attrs: frozenset = frozenset()
     lock_attrs: frozenset = frozenset()        # self attrs holding locks
+    # self attrs assigned VERBATIM from a method parameter — the
+    # caller-supplied-callback shape (spill_fn, event sinks) PTL803
+    # fences when invoked under a lock
+    callback_attrs: frozenset = frozenset()
 
 
 @dataclasses.dataclass
@@ -415,6 +522,64 @@ def _is_rankish(test):
     return False
 
 
+def _blocking_call(node):
+    """Short description when `node` is a Call that blocks the
+    calling thread, else None (the PTL802 table lookup)."""
+    comp = _component(node.func)
+    if isinstance(node.func, ast.Name):
+        if comp == "open":
+            return "open()"
+        if comp == "sleep":
+            return "sleep()"
+    root = _root(node.func)
+    if comp == "sleep" and root in ("time", "_time"):
+        return "time.sleep()"
+    if isinstance(node.func, ast.Attribute):
+        if comp in _BLOCKING_METHODS:
+            return f".{comp}()"
+        if comp == "join":
+            # strict: thread/process/queue join only — zero args, a
+            # single numeric timeout, or a timeout= kwarg. str.join
+            # (`",".join(parts)`) and os.path.join always take a
+            # non-numeric argument and never match.
+            if not node.args and not node.keywords:
+                return ".join()"
+            if len(node.args) == 1 and not node.keywords and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, (int, float)) and \
+                    not isinstance(node.args[0].value, bool):
+                return ".join(timeout)"
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                return ".join(timeout=...)"
+    return None
+
+
+def _broad_handler_type(t):
+    """None (bare except) or Exception/BaseException, incl. tuples."""
+    if t is None:
+        return True
+    if isinstance(t, (ast.Tuple, ast.List)):
+        return any(_broad_handler_type(e) for e in t.elts)
+    return _component(t) in ("Exception", "BaseException")
+
+
+def _silent_handler(h):
+    """PTL804 shape: a broad handler whose body swallows the failure
+    without a trace — only pass/continue/break/docstring statements.
+    ANY call in the handler (journal, counter, log, re-raise helper)
+    makes it legal."""
+    if not _broad_handler_type(h.type):
+        return False
+    for stmt in h.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
 # ------------------------------------------------- module-level discovery
 
 class _TracedDiscovery(ast.NodeVisitor):
@@ -488,6 +653,13 @@ class _FunctionLinter:
         self.array = set()
         self.int8_names = set()
         self.concat_names = set()   # names derived from jnp.concatenate
+        # PTL501/502 state: names whose value ALIASES caller-owned
+        # storage (asarray/frombuffer/memoryview of a parameter, and
+        # views thereof) — flow-sensitive like concat_names
+        self.alias_names = set()
+        # this function's own parameter names (PTL803: a parameter
+        # called directly under a lock is caller-supplied code)
+        self.param_names = set()
         # PTL601 state: key -> in_specs AST node of a shard_map wrapper
         self.shard_wraps = {}
         # PTL201 state: key -> donated positions (from jax.jit assigns
@@ -623,6 +795,7 @@ class _FunctionLinter:
             if i == 0 and n in ("self", "cls"):
                 continue
             self.tainted.add(n)
+            self.param_names.add(n)
 
     def _prescan_int8(self, body):
         for n in _walk_shallow(body):
@@ -760,6 +933,8 @@ class _FunctionLinter:
         sub.array |= self.array
         sub.int8_names |= self.int8_names
         sub.concat_names |= self.concat_names
+        sub.alias_names |= self.alias_names
+        sub.param_names |= self.param_names   # closures capture them
         sub.jitted.update(self.jitted)
         sub.shard_wraps.update(self.shard_wraps)
         sub._lock_depth = self._lock_depth
@@ -838,6 +1013,12 @@ class _FunctionLinter:
                 self.concat_names.add(t.id)
             else:
                 self.concat_names.discard(t.id)
+            # same shape for the aliasing taint: np.array(...) /
+            # .copy() reassignments launder
+            if value is not None and self._is_alias(value):
+                self.alias_names.add(t.id)
+            else:
+                self.alias_names.discard(t.id)
         elif isinstance(t, (ast.Tuple, ast.List)):
             for e in t.elts:
                 inner = e.value if isinstance(e, ast.Starred) else e
@@ -847,11 +1028,81 @@ class _FunctionLinter:
             key = _target_key(t)
             if key:
                 self._record_store(key)
+            self._check_alias_escape(t, value)
 
     def _record_store(self, key):
         self.consumed.pop(key, None)
         for stores in self._loop_stores:
             stores.add(key)
+
+    # ---- PTL5xx: aliasing / donation escape ---------------------------
+
+    def _is_alias(self, node):
+        """Does this expression's value ALIAS caller-owned storage?
+        Sources are the zero-copy constructors applied to a
+        parameter-derived value; aliasing survives view methods and
+        container literals but NOT arbitrary calls — np.array /
+        jnp.array(copy=True) / .copy() launder by construction."""
+        if isinstance(node, ast.Name):
+            return node.id in self.alias_names
+        if isinstance(node, ast.Call):
+            comp = _component(node.func)
+            root = _root(node.func)
+            if comp in _ALIAS_VIEW_FUNCS and \
+                    root in ("np", "numpy", "jnp", "jax"):
+                return bool(node.args) and \
+                    (self._is_tainted(node.args[0])
+                     or self._is_alias(node.args[0]))
+            if comp == "memoryview" and isinstance(node.func, ast.Name):
+                return bool(node.args) and \
+                    (self._is_tainted(node.args[0])
+                     or self._is_alias(node.args[0]))
+            if isinstance(node.func, ast.Attribute) and \
+                    comp in _VIEW_METHODS:
+                return self._is_alias(node.func.value)
+            return False
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            return any(self._is_alias(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(v is not None and self._is_alias(v)
+                       for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self._is_alias(node.body) or \
+                self._is_alias(node.orelse)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_alias(v) for v in node.values)
+        if isinstance(node, ast.Starred):
+            return self._is_alias(node.value)
+        return False
+
+    def _check_alias_escape(self, t, value):
+        """PTL501 (attribute form): an alias of caller storage stored
+        into a self/cls attribute — or a subscript of one — outlives
+        the call. `__init__` is NOT exempt: constructors are exactly
+        where set_state_dict-style restore paths capture views."""
+        node = t.value if isinstance(t, ast.Subscript) else t
+        key = _target_key(node)
+        if not key or not key.startswith(("self.", "cls.")):
+            return
+        if value is None or not self._is_alias(value):
+            return
+        self._emit(
+            "PTL501", t,
+            f"storing a zero-copy view of caller-owned storage into "
+            f"'{key}' — a later in-place update (or a donating "
+            "executable consuming the origin) corrupts the caller's "
+            "copy; take ownership with np.array / "
+            "jnp.array(copy=True) / .copy()")
+
+    # ---- PTL8xx: lock-discipline gating -------------------------------
+
+    def _lock_fence_active(self):
+        """PTL802/803 fire while a declared class lock is held,
+        outside __init__ (a constructor's lock cannot be contended
+        yet)."""
+        return (self._lock_depth > 0 and self.cls_info is not None
+                and not (self.fn is not None
+                         and self.fn.name == "__init__"))
 
     def _visit_If(self, node):
         self._expr(node.test)
@@ -903,6 +1154,32 @@ class _FunctionLinter:
         key = _target_key(expr)
         return bool(key) and key.startswith("self.") and \
             key[len("self."):] in self.cls_info.lock_attrs
+
+    def _visit_Try(self, node):
+        for stmt in node.body:
+            self._visit(stmt)
+        for h in node.handlers:
+            if _silent_handler(h):
+                # PTL804: everywhere, not just thread-shared classes —
+                # a swallowed failure is invisible in ANY plane
+                what = "bare except" if h.type is None else \
+                    f"except {_dotted(h.type) or '(broad tuple)'}"
+                self._emit(
+                    "PTL804", h,
+                    f"{what} swallows the failure with no trace — "
+                    "narrow the exception type, or leave a record "
+                    "(resilience.record(...), a pt_* counter, or a "
+                    "log call) in the handler")
+            if h.type is not None:
+                self._expr(h.type)
+            for stmt in h.body:
+                self._visit(stmt)
+        for stmt in node.orelse:
+            self._visit(stmt)
+        for stmt in node.finalbody:
+            self._visit(stmt)
+
+    _visit_TryStar = _visit_Try
 
     # ---- PTL7xx: host-concurrency race fence -------------------------
 
@@ -1107,6 +1384,8 @@ class _FunctionLinter:
                 sub.tainted.add(p.arg)
         sub.int8_names = set(self.int8_names)
         sub.concat_names = set(self.concat_names)
+        sub.alias_names = set(self.alias_names)
+        sub.param_names = set(self.param_names)
         sub.jitted = dict(self.jitted)
         sub.shard_wraps = dict(self.shard_wraps)
         # ast.walk in _expr yields the body node itself first, so a
@@ -1218,6 +1497,66 @@ class _FunctionLinter:
                        "its call chain) under a rank-conditioned "
                        "branch — peers that skip it deadlock the pod")
 
+        # ---- PTL802/803: work under a held class lock ----
+        if self._lock_fence_active():
+            desc = _blocking_call(node)
+            if desc is not None:
+                self._emit(
+                    "PTL802", node,
+                    f"blocking call {desc} while a "
+                    f"{self.cls_info.name} lock is held — every other "
+                    "thread queues behind this wait; snapshot state, "
+                    "release the lock, then block (or hand off "
+                    "through a bounded queue as kv_tier does)")
+            elif comp in self.m.blocking_reach and \
+                    (isinstance(node.func, ast.Name) or
+                     (isinstance(node.func, ast.Attribute) and
+                      isinstance(node.func.value, ast.Name) and
+                      node.func.value.id in ("self", "cls"))):
+                # interprocedural, same bare-name matching caveats as
+                # PTL401's collective closure
+                via = self.m.blocking_reach[comp]
+                self._emit(
+                    "PTL802", node,
+                    f"{comp}() reaches blocking call {via} (through "
+                    f"its call chain) while a {self.cls_info.name} "
+                    "lock is held — snapshot, release, then block")
+            cb = None
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in ("self", "cls") and \
+                    node.func.attr in self.cls_info.callback_attrs:
+                cb = f"self.{node.func.attr}"
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in self.param_names:
+                cb = node.func.id
+            if cb is not None:
+                self._emit(
+                    "PTL803", node,
+                    f"invoking caller-supplied callback '{cb}' while "
+                    f"a {self.cls_info.name} lock is held — arbitrary "
+                    "caller code can re-enter the class and "
+                    "self-deadlock; snapshot the callback and its "
+                    "arguments, release, THEN invoke")
+
+        # PTL501 (container form): an alias escaping into a shared
+        # container through a mutator — self.pages.append(view)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONTAINER_STORES:
+            rnode = node.func.value
+            if isinstance(rnode, ast.Subscript):
+                rnode = rnode.value
+            rkey = _target_key(rnode)
+            if rkey and rkey.startswith(("self.", "cls.")) and \
+                    any(self._is_alias(a) for a in node.args):
+                self._emit(
+                    "PTL501", node,
+                    f"'{rkey}.{node.func.attr}(...)' stores a "
+                    "zero-copy view of caller-owned storage in a "
+                    "container that outlives the call — take "
+                    "ownership with np.array / jnp.array(copy=True) "
+                    "/ .copy()")
+
         # PTL601: a concatenate-family result entering shard_map
         # through a partial in_spec (the PR-6 partitioner bug shape)
         in_specs = None
@@ -1267,6 +1606,18 @@ class _FunctionLinter:
         key = _target_key(node.func)
         if key and key in self.jitted:
             donated = self.jitted[key]
+            # PTL502: a host view of caller storage entering the
+            # compiled step — donation frees the caller's buffer and
+            # async dispatch races caller-side mutation of the view
+            for a in node.args:
+                if self._is_alias(a):
+                    self._emit(
+                        "PTL502", node,
+                        f"zero-copy host view handed to jitted "
+                        f"'{key}' without a defensive copy — the "
+                        "runtime may alias (or donation may free) "
+                        "the caller's buffer; copy first with "
+                        "np.array / jnp.array(copy=True)")
             starred = any(isinstance(a, ast.Starred) for a in node.args)
             if not starred:
                 end = getattr(node, "end_lineno", node.lineno)
@@ -1324,6 +1675,7 @@ class _ModuleLint:
                     if key and key.startswith(("self.", "cls.")):
                         self.jitted_attrs[key] = donated
         self.collective_reach = self._collective_reach()
+        self.blocking_reach = self._blocking_reach()
 
     def _collective_reach(self):
         """PTL401 interprocedural closure: function name -> the
@@ -1346,6 +1698,39 @@ class _ModuleLint:
             # UNION when defs share a name (overloads/methods across
             # classes) — overwriting would make reach depend on
             # definition order
+            calls.setdefault(n.name, set()).update(called)
+        reach = dict(direct)
+        changed = True
+        while changed:
+            changed = False
+            for fn, called in calls.items():
+                if fn in reach:
+                    continue
+                for c in called:
+                    if c in reach:
+                        reach[fn] = reach[c]
+                        changed = True
+                        break
+        return reach
+
+    def _blocking_reach(self):
+        """PTL802 interprocedural closure: function name -> the
+        blocking call it (transitively) reaches — the PTL401 shape
+        applied to lock discipline. Same bare-name matching, same
+        union-on-shared-names caveats."""
+        direct, calls = {}, {}
+        for n in ast.walk(self.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            called = set()
+            for sub in _walk_shallow(n.body):
+                if isinstance(sub, ast.Call):
+                    desc = _blocking_call(sub)
+                    if desc is not None:
+                        direct.setdefault(n.name, desc)
+                    comp = _component(sub.func)
+                    if comp:
+                        called.add(comp)
             calls.setdefault(n.name, set()).update(called)
         reach = dict(direct)
         changed = True
@@ -1405,6 +1790,13 @@ class _ModuleLint:
                                      ast.AsyncFunctionDef,
                                      ast.ClassDef)):
                 top._visit(stmt)
+        # PTL801: per-module lock-order pass (cross-FILE cycles are
+        # caught by the tree-wide build_lock_graph / golden gate)
+        for _path, line, func, msg in _lock_findings(
+                _scan_lock_classes(self.tree, self.path)):
+            self.emit("PTL801",
+                      types.SimpleNamespace(lineno=line, col_offset=0),
+                      msg, func)
         # lambdas are visited both in their enclosing expression walk
         # and as sub-scopes — dedup identical findings
         seen, unique = set(), []
@@ -1454,11 +1846,34 @@ class _ModuleLint:
                             dd_attrs.add(attr)
                     elif comp in _LOCK_FACTORIES:
                         lock_attrs.add(attr)
+        # PTL803 input: self attributes assigned VERBATIM from a
+        # method parameter — the caller-supplied-callback shape
+        callback_attrs = set()
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            a = meth.args
+            params = {p.arg for p in
+                      (a.posonlyargs + a.args + a.kwonlyargs)}
+            params.discard("self")
+            params.discard("cls")
+            for n in _walk_shallow(meth.body):
+                if not isinstance(n, ast.Assign) or \
+                        not (isinstance(n.value, ast.Name)
+                             and n.value.id in params):
+                    continue
+                for t in n.targets:
+                    key = _target_key(t)
+                    if key and key.startswith("self.") and \
+                            "." not in key[len("self."):]:
+                        callback_attrs.add(key[len("self."):])
         return _ClassInfo(name=node.name,
                           shared=marked or bool(lock_attrs),
                           dict_attrs=frozenset(dict_attrs),
                           defaultdict_attrs=frozenset(dd_attrs),
-                          lock_attrs=frozenset(lock_attrs))
+                          lock_attrs=frozenset(lock_attrs),
+                          callback_attrs=frozenset(callback_attrs))
 
     def _run_def(self, node, prefix, cls_info=None):
         if isinstance(node, ast.ClassDef):
@@ -1551,3 +1966,327 @@ def lint_paths(paths, select=None, ignore=None):
         suppressed += sup
     return {"findings": findings, "suppressed": suppressed,
             "files": nfiles, "version": PTLINT_VERSION}
+
+
+# ------------------------------------------- lock-acquisition graph (801)
+#
+# The PTL801 pass proper: every lock-owning class contributes nodes
+# ("Class.lockattr") and its methods contribute edges — a with-nesting
+# inside one method, or a call made while a lock is held that
+# (transitively, through self/cls helpers and uniquely-resolvable
+# cross-class methods) acquires another lock. A cycle in this graph is
+# a deadlock two threads can walk into from opposite ends; the blessed
+# acyclic edge set is pinned in tests/golden/fleet_lock_order.json.
+
+@dataclasses.dataclass
+class _LockMethod:
+    acquires: set = dataclasses.field(default_factory=set)
+    # (outer_attr, inner_attr, line): with-nesting inside this method
+    nested: list = dataclasses.field(default_factory=list)
+    # (held_attr, callee, selfish, line): calls made under a held lock
+    under: list = dataclasses.field(default_factory=list)
+    # (callee, selfish): every named call (for the acquires* closure)
+    calls: set = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _LockClass:
+    name: str
+    path: str
+    locks: dict      # attr -> factory name ("Lock" | "RLock")
+    methods: dict    # method name -> _LockMethod
+
+
+class _LockMethodScan(ast.NodeVisitor):
+    """Per-method scan: which locks it acquires (`with self.<lock>:`),
+    same-method nesting pairs, and every call made while a lock is
+    held. Nested defs/lambdas don't run at definition time — skipped
+    (they lint in their own right through _FunctionLinter)."""
+
+    def __init__(self, lock_attrs):
+        self.lock_attrs = lock_attrs
+        self.held = []
+        self.out = _LockMethod()
+
+    def visit_FunctionDef(self, node):   # do not descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def _acquired(self, expr):
+        key = _target_key(expr)
+        if key and key.startswith("self.") and \
+                key[len("self."):] in self.lock_attrs:
+            return key[len("self."):]
+        return None
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)   # calls inside the expr
+            attr = self._acquired(item.context_expr)
+            if attr is not None:
+                self.out.acquires.add(attr)
+                for h in self.held:
+                    self.out.nested.append((h, attr, node.lineno))
+                self.held.append(attr)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        comp = _component(node.func)
+        if comp:
+            selfish = (isinstance(node.func, ast.Attribute)
+                       and isinstance(node.func.value, ast.Name)
+                       and node.func.value.id in ("self", "cls"))
+            self.out.calls.add((comp, selfish))
+            for h in self.held:
+                self.out.under.append((h, comp, selfish, node.lineno))
+        self.generic_visit(node)
+
+
+def _class_lock_attrs(cnode):
+    """attr -> factory name, from Assign/AnnAssign anywhere in the
+    class body except nested classes (their locks are their own)."""
+    locks = {}
+    stack = list(cnode.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.ClassDef):
+            continue
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+        if isinstance(n, ast.AnnAssign):
+            targets, v = [n.target], n.value
+        elif isinstance(n, ast.Assign):
+            targets, v = n.targets, n.value
+        else:
+            continue
+        if not isinstance(v, ast.Call):
+            continue
+        comp = _component(v.func)
+        if comp not in _LOCK_FACTORIES:
+            continue
+        for t in targets:
+            key = _target_key(t)
+            if key and key.startswith("self.") and \
+                    "." not in key[len("self."):]:
+                locks[key[len("self."):]] = comp
+    return locks
+
+
+def _scan_lock_classes(tree, path):
+    """Every lock-owning class in the tree: its declared locks plus a
+    per-method acquisition scan — the PTL801 graph input."""
+    out = []
+    for cnode in ast.walk(tree):
+        if not isinstance(cnode, ast.ClassDef):
+            continue
+        locks = _class_lock_attrs(cnode)
+        if not locks:
+            continue
+        methods = {}
+        for meth in cnode.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            scan = _LockMethodScan(frozenset(locks))
+            for stmt in meth.body:
+                scan.visit(stmt)
+            methods[meth.name] = scan.out
+        out.append(_LockClass(name=cnode.name, path=path,
+                              locks=locks, methods=methods))
+    return out
+
+
+def _lock_graph(classes):
+    """The acquisition graph: {(src, dst): [(path, line,
+    'Class.method'), ...]} over lock nodes 'Class.attr', after a
+    global fixpoint computing acquires*(class, method) through self
+    calls and uniquely-resolvable cross-class calls."""
+    by_method = {}
+    for c in classes:
+        for m in c.methods:
+            by_method.setdefault(m, []).append(c)
+
+    def resolve(cls, callee, selfish):
+        if selfish:
+            return [(cls, callee)] if callee in cls.methods else []
+        # cross-class by bare name: only when exactly ONE other
+        # lock-owning class defines it and the name isn't generic —
+        # `self.log_file.flush()` must not inherit another class's
+        # acquisitions
+        if callee in _GENERIC_METHODS:
+            return []
+        owners = [c for c in by_method.get(callee, ()) if c is not cls]
+        return [(owners[0], callee)] if len(owners) == 1 else []
+
+    acq = {}
+    for c in classes:
+        for mname, m in c.methods.items():
+            acq[(id(c), mname)] = {f"{c.name}.{a}" for a in m.acquires}
+    changed = True
+    while changed:
+        changed = False
+        for c in classes:
+            for mname, m in c.methods.items():
+                cur = acq[(id(c), mname)]
+                for callee, selfish in m.calls:
+                    for tc, tm in resolve(c, callee, selfish):
+                        for lock_node in acq.get((id(tc), tm), ()):
+                            if lock_node not in cur:
+                                cur.add(lock_node)
+                                changed = True
+    edges = {}
+    for c in classes:
+        for mname, m in c.methods.items():
+            where = f"{c.name}.{mname}"
+            for outer, inner, line in m.nested:
+                edges.setdefault(
+                    (f"{c.name}.{outer}", f"{c.name}.{inner}"),
+                    []).append((c.path, line, where))
+            for held, callee, selfish, line in m.under:
+                src = f"{c.name}.{held}"
+                for tc, tm in resolve(c, callee, selfish):
+                    for dst in acq[(id(tc), tm)]:
+                        edges.setdefault((src, dst), []).append(
+                            (c.path, line, where))
+    return edges, acq
+
+
+def _sccs(graph):
+    """Tarjan's strongly-connected components, iterative."""
+    index, low, onstack = {}, {}, set()
+    stack, out, counter = [], [], [0]
+    for start in sorted(graph):
+        if start in index:
+            continue
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        onstack.add(start)
+        work = [(start, iter(sorted(graph[start])))]
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    onstack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt,
+                                                            ())))))
+                    advanced = True
+                    break
+                if nxt in onstack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+    return out
+
+
+def _lock_findings(classes):
+    """PTL801 findings from a class set: (path, line, func, message)
+    per lock-order cycle (ONE per SCC, anchored at its smallest-line
+    site) and per non-reentrant self-re-acquisition."""
+    edges, _acq = _lock_graph(classes)
+    factory = {}
+    for c in classes:
+        for a, fac in c.locks.items():
+            factory.setdefault(f"{c.name}.{a}", fac)
+    out = []
+    for (src, dst), sites in sorted(edges.items()):
+        if src != dst or factory.get(src) == "RLock":
+            continue
+        path, line, where = min(sites, key=lambda s: s[1])
+        out.append((path, line, where,
+                    f"non-reentrant Lock '{src}' is re-acquired on a "
+                    "path that already holds it — the thread wedges "
+                    "against itself; split the locked region (or make "
+                    "the re-entry explicit with RLock)"))
+    graph = {}
+    for (src, dst), _sites in edges.items():
+        if src != dst:
+            graph.setdefault(src, set()).add(dst)
+            graph.setdefault(dst, set())
+    for scc in _sccs(graph):
+        if len(scc) < 2:
+            continue
+        sites = [site
+                 for (s, d), ss in edges.items()
+                 if s in scc and d in scc and s != d
+                 for site in ss]
+        path, line, where = min(sites, key=lambda s: s[1])
+        order = " -> ".join(sorted(scc))
+        out.append((path, line, where,
+                    f"lock-order cycle {order} — two threads entering "
+                    "it from opposite ends wedge forever with zero "
+                    "CPU (the wedged-replica flap); pick ONE global "
+                    "order and pin it in "
+                    "tests/golden/fleet_lock_order.json"))
+    out.sort(key=lambda t: (t[0], t[1]))
+    return out
+
+
+def build_lock_graph(paths):
+    """Parse every .py under `paths` (stdlib-only, no imports
+    executed) and return (classes, edges, findings) for the tree-wide
+    lock-order pass. Cross-file edges resolve here — the per-module
+    PTL801 pass only sees cycles within one file."""
+    classes = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            src = f.read()
+        if re.search(r"#\s*ptlint:\s*skip-file", src):
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        classes.extend(_scan_lock_classes(tree, path))
+    edges, _acq = _lock_graph(classes)
+    findings = [
+        Finding(rule="PTL801", name=RULES["PTL801"].name, path=p,
+                line=line, col=0, message=msg, func=func)
+        for p, line, func, msg in _lock_findings(classes)]
+    return classes, edges, findings
+
+
+def lock_graph_report(paths):
+    """JSON-able tree-wide lock report — the source of truth for
+    tests/golden/fleet_lock_order.json and bench.py's `locks` stamp."""
+    classes, edges, findings = build_lock_graph(paths)
+    edge_sites = {}
+    for (s, d), ss in edges.items():
+        edge_sites[f"{s} -> {d}"] = [
+            {"path": p, "line": line, "func": fn}
+            for p, line, fn in sorted(ss)]
+    return {
+        "version": LOCK_ANALYSIS_VERSION,
+        "classes": len(classes),
+        "locks": sum(len(c.locks) for c in classes),
+        "edges": sorted(f"{s} -> {d}" for (s, d) in edges),
+        "edge_sites": edge_sites,
+        "findings": [f.as_dict() for f in findings],
+    }

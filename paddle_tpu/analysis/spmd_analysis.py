@@ -55,12 +55,16 @@ import numpy as np
 
 import jax
 
-from .lint import Finding, SPMD_ANALYSIS_VERSION
+from .lint import (Finding, SPMD_ANALYSIS_VERSION,
+                   LOCK_ANALYSIS_VERSION, build_lock_graph,
+                   lock_graph_report)
 
 __all__ = ["SPMD_ANALYSIS_VERSION", "SPMD_RULES", "Collective",
            "CollectiveSchedule", "collectives_of_jaxpr",
            "extract_schedule", "schedule_diff", "rank_divergence",
-           "check_placement", "spmd_report", "reference_report"]
+           "check_placement", "spmd_report", "reference_report",
+           "LOCK_ANALYSIS_VERSION", "build_lock_graph",
+           "lock_graph_report", "lock_order_diff"]
 
 # the jaxpr-level SPMD finding ids (the AST linter owns PTL6xx's
 # source-visible shapes; these need a trace)
@@ -452,7 +456,7 @@ def check_placement(step):
             expected = sharding_of(val, spec)
             actual = val.sharding
             same = actual.is_equivalent_to(expected, val.ndim)
-        except Exception:      # degenerate mesh / non-addressable
+        except Exception:  # ptlint: disable=PTL804 (degenerate mesh / non-addressable; entry skipped)
             continue
         if not same:
             name = getattr(p, "name", "") or f"param{i}"
@@ -513,3 +517,34 @@ def reference_report():
         return rep
     finally:
         mesh_mod.reset_mesh()
+
+
+# ------------------------------------------------- lock-order export
+
+def lock_order_diff(report, golden):
+    """Divergences between a live `lock_graph_report()` and the pinned
+    golden (`tests/golden/fleet_lock_order.json`) — the lock-graph
+    twin of `schedule_diff`. Empty = the fleet still acquires locks in
+    the blessed order.
+
+    A NEW edge is not automatically a bug — cross-class lock nesting
+    that stays acyclic is legal — but it IS a contract change: the
+    golden pins the blessed edge set the same way the dp2.tp2.pp2
+    collective schedule is pinned, so the author of a new edge must
+    look at the cycle report and re-bless the golden consciously.
+    Findings (actual cycles) are always divergences.
+    """
+    out = []
+    live = set(report.get("edges", []))
+    pinned = set(golden.get("edges", []))
+    for e in sorted(live - pinned):
+        out.append(f"new lock-order edge not in golden: {e}")
+    for e in sorted(pinned - live):
+        out.append(f"golden edge no longer acquired: {e}")
+    gv = golden.get("version")
+    if gv is not None and gv != report.get("version"):
+        out.append(f"lock-analysis version drift: live="
+                   f"{report.get('version')} vs golden={gv}")
+    for f in report.get("findings", []):
+        out.append(f"lock-order finding: {f}")
+    return out

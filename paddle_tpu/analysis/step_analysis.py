@@ -50,9 +50,9 @@ __all__ = ["StepReport", "analyze_step", "analyze_jit",
 # analyzer owns PTL5xx — same Finding shape, same suppression story in
 # reports)
 ANALYSIS_RULES = {
-    "PTL501": "donation-dropped",
-    "PTL502": "f64-in-program",
-    "PTL503": "host-callback-in-step",
+    "PTL511": "donation-dropped",
+    "PTL512": "f64-in-program",
+    "PTL513": "host-callback-in-step",
 }
 
 _HOST_CALL_PRIMS = ("callback", "infeed", "outfeed")
@@ -288,7 +288,7 @@ def analyze_jit(jitfn, args, donate_argnums=(), kind="jit", names=None,
             line=0, col=0, message=msg, func=kind))
 
     if not donation["held"]:
-        f("PTL501",
+        f("PTL511",
           f"donation dropped for {len(donation['dropped'])} of "
           f"{donation['expected']} donated buffers "
           f"({', '.join(donation['dropped'][:4])}"
@@ -298,12 +298,12 @@ def analyze_jit(jitfn, args, donate_argnums=(), kind="jit", names=None,
     f64 = {k: n for k, n in conversions.items()
            if k.endswith("->float64")}
     if f64:
-        f("PTL502",
+        f("PTL512",
           f"program promotes into float64 ({f64}) — TPU has no f64 "
           "MXU path; pin dtypes (weak python scalars under x64 are "
           "the usual source)")
     if host_calls:
-        f("PTL503",
+        f("PTL513",
           f"host callbacks inside the step body ({dict(host_calls)}) "
           "— each is a per-step device-host round trip")
 
@@ -500,7 +500,7 @@ _PROPOSE_NAMES = ("weights", "tok0", "pos0", "rem", "fin0", "eos",
 def _analyze_engine(engine, check_donation, which="paged"):
     if which == "verify":
         # the speculative CI contract (tests/test_speculative.py):
-        # zero host callbacks (PTL503) in the one-dispatch ragged
+        # zero host callbacks (PTL513) in the one-dispatch ragged
         # verify and full donation of the big pools + scales + PRNG
         # key pytree (gauge pt_step_donation_held{step="spec_verify"})
         args = _verify_step_args(engine)
@@ -519,7 +519,7 @@ def _analyze_engine(engine, check_donation, which="paged"):
                            check_donation=check_donation)
     if which == "fused":
         # the fused-window CI contract (tests/test_fused_decode.py):
-        # zero host callbacks (PTL503) in the k-step scan and full
+        # zero host callbacks (PTL513) in the k-step scan and full
         # donation of the pools + scales + PRNG key pytree
         args = _fused_step_args(engine)
         return analyze_jit(engine._fused_fn._jit, args,

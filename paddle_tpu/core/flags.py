@@ -92,7 +92,7 @@ def _sync_debug_nans(v):
         import jax
 
         jax.config.update("jax_debug_nans", bool(v))
-    except Exception:
+    except Exception:  # ptlint: disable=PTL804 (knob probe; jax absent or knob unknown)
         pass
 
 

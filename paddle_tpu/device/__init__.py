@@ -51,7 +51,7 @@ def set_device(device):
     _current = pool[min(idx, len(pool) - 1)]
     try:
         jax.config.update("jax_default_device", _current)
-    except Exception:
+    except Exception:  # ptlint: disable=PTL804 (knob probe; default-device knob may not exist)
         pass
     return _current
 

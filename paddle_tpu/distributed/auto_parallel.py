@@ -60,7 +60,7 @@ def shard_tensor(x, process_mesh=None, shard_spec=None):
             try:
                 x._value = jax.device_put(
                     x._value, mesh_mod.named_sharding(*shard_spec))
-            except Exception:
+            except Exception:  # ptlint: disable=PTL804 (placement is advisory; jit in_shardings re-places)
                 pass  # placed lazily by the compiled step's in_shardings
     return x
 
@@ -77,7 +77,7 @@ def shard_op(op, process_mesh=None, in_shard_specs=None,
             try:
                 out._value = jax.lax.with_sharding_constraint(
                     out._value, mesh_mod.named_sharding(*spec))
-            except Exception:
+            except Exception:  # ptlint: disable=PTL804 (placement is advisory; constraint re-applied in jit)
                 pass
         return out
 

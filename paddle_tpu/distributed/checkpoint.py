@@ -321,7 +321,7 @@ def save_state_dict(state, path, async_save=False, _stall_start=None):
                 entry["shards"].append({"index": idx, "file": fname})
                 try:
                     sh.data.copy_to_host_async()
-                except Exception:
+                except Exception:  # ptlint: disable=PTL804 (prefetch hint; the sync copy path follows)
                     pass
                 pending.append((os.path.join(tmp, "shards", fname), sh.data))
             leaves.append(entry)

@@ -119,7 +119,7 @@ class Coordinator:
             # coordination store without limit (xproc.py pt_p2p pattern)
             try:
                 kv.key_value_delete(key)
-            except Exception:
+            except Exception:  # ptlint: disable=PTL804 (idempotent KV cleanup; key may already be gone)
                 pass
         return infos
 
@@ -177,7 +177,7 @@ class FLClient:
         raw = kv.blocking_key_value_get(key, timeout_ms)
         try:
             kv.key_value_delete(key)
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (idempotent KV cleanup; key may already be gone)
             pass
         self._round += 1
         return json.loads(raw)

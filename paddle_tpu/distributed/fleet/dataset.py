@@ -79,7 +79,9 @@ class TreeIndex(Index):
     def _init_from(self, ids, branch):
         if branch < 2:
             raise ValueError("branch must be >= 2")
-        ids = np.asarray(ids, np.int64)
+        # own the leaf-id array: np.array copies even when the caller
+        # hands us an int64 ndarray it may mutate later (PTL501)
+        ids = np.array(ids, np.int64)
         n = len(ids)
         if n == 0:
             raise ValueError("TreeIndex needs at least one item")

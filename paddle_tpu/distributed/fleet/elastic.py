@@ -315,7 +315,7 @@ def run_with_fault_tolerance(train_fn, checkpointer, max_restarts=3,
                 _fr.dump("divergence_rollback", step=e.step,
                          rollback_reason=e.reason, start_step=start,
                          value=str(e.value))
-            except Exception:
+            except Exception:  # ptlint: disable=PTL804 (the guard wraps the flight-recorder dump itself)
                 pass
             _drain_checkpointer(checkpointer)
             continue

@@ -42,7 +42,7 @@ def mark_sharding(param, *spec):
         try:
             param._value = jax.device_put(
                 param._value, jax.sharding.NamedSharding(mesh, param._pspec))
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (placement is advisory; spec kept for jit)
             pass  # single-device or incompatible mesh: spec kept for jit
     return param
 

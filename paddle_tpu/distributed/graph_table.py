@@ -60,8 +60,11 @@ class GraphTable:
 
     # -- construction --
     def add_edges(self, src, dst, weights=None):
-        src = np.asarray(src, np.int64).reshape(-1)
-        dst = np.asarray(dst, np.int64).reshape(-1)
+        # np.array (not asarray): the edge lists are retained — an
+        # aliased caller buffer mutated later would silently rewrite
+        # the graph (PTL501)
+        src = np.array(src, np.int64).reshape(-1)
+        dst = np.array(dst, np.int64).reshape(-1)
         if len(src) != len(dst):
             raise ValueError("src/dst length mismatch")
         self._src.append(src)
@@ -70,7 +73,7 @@ class GraphTable:
             if self._src[:-1] and not self._weighted:
                 raise ValueError(
                     "mixing weighted and unweighted add_edges")
-            w = np.asarray(weights, np.float64).reshape(-1)
+            w = np.array(weights, np.float64).reshape(-1)
             if len(w) != len(src):
                 raise ValueError("weights length mismatch")
             self._w.append(w)
@@ -221,13 +224,14 @@ class GraphTable:
         return sd
 
     def set_state_dict(self, sd):
-        ids_s = np.asarray(sd["ids"], np.int64)
-        indptr = np.asarray(sd["indptr"], np.int64)
-        nbrs = np.asarray(sd["nbrs"], np.int64)
+        # copies, not views: the state dict stays caller-owned
+        ids_s = np.array(sd["ids"], np.int64)
+        indptr = np.array(sd["indptr"], np.int64)
+        nbrs = np.array(sd["nbrs"], np.int64)
         src = np.repeat(ids_s, np.diff(indptr))
         self._src, self._dst = [src], [nbrs]
         if "weights" in sd:
-            self._w = [np.asarray(sd["weights"], np.float64)]
+            self._w = [np.array(sd["weights"], np.float64)]
             self._weighted = True
         else:
             self._w, self._weighted = [], False

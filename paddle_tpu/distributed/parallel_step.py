@@ -87,7 +87,7 @@ def shard_params_and_opt(model, optimizer, level="os_g", axis="sharding"):
         try:
             p._value = jax.device_put(
                 p._value, sharding_of(p._value, p._pspec))
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (placement is advisory; first jit call re-places)
             pass
     return model
 

@@ -320,7 +320,9 @@ class MemorySparseTable:
         self._ensure(ids)
         idx = np.fromiter((self._rows[int(i)] for i in ids), np.int64,
                           len(ids))
-        self._data[idx] = rows
+        # fancy-index assignment copies VALUES into the table's own
+        # storage; `rows` itself is never retained
+        self._data[idx] = rows  # ptlint: disable=PTL501
 
     # -- CTR accessor (reference ctr_accessor.cc) --
     def update_show_click(self, ids, shows, clicks):
@@ -377,14 +379,17 @@ class MemorySparseTable:
         ids = np.asarray(sd["ids"]._value if isinstance(sd["ids"], Tensor)
                          else sd["ids"]).reshape(-1)
         self._rows = {int(i): k for k, i in enumerate(ids)}
-        self._data = np.asarray(
+        # np.array (not asarray): the table owns its storage — an
+        # aliased state-dict buffer mutated by the caller after load
+        # would silently corrupt rows (PTL501)
+        self._data = np.array(
             sd["data"]._value if isinstance(sd["data"], Tensor)
             else sd["data"], np.float32)
-        self._slots = np.asarray(
+        self._slots = np.array(
             sd["slots"]._value if isinstance(sd["slots"], Tensor)
             else sd["slots"], np.float32)
         if self.accessor:
-            self._meta = (np.asarray(
+            self._meta = (np.array(
                 sd["meta"]._value if isinstance(sd.get("meta"), Tensor)
                 else sd["meta"], np.float32) if "meta" in sd
                 else np.zeros((len(ids), 3), np.float32))
@@ -733,7 +738,10 @@ class ShardedSparseTable:
     def push(self, ids, grads):
         """Queue gradients; flush every `staleness`-th call."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
-        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        # np.array: grads are QUEUED until flush() — the training loop
+        # reuses its gradient buffers every step, so an aliased view
+        # here would flush later steps' values (PTL501)
+        grads = np.array(grads, np.float32).reshape(len(ids), self.dim)
         self._pending_ids.append(ids)
         self._pending_grads.append(grads)
         # single-writer: push() runs only on the training-loop thread;

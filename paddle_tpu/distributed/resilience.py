@@ -128,19 +128,24 @@ class AnomalyJournal:
             from ..observability.flight_recorder import record_event
 
             record_event("journal", entry=dict(entry))
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the journal cannot journal its own mirror failure)
             pass
+        # append + path resolution under the lock; file I/O OUTSIDE it —
+        # open()/write() on a slow (or hung NFS) log dir must not queue
+        # every other journaling thread behind disk (PTL802). Lines may
+        # interleave across threads, but each json.dumps is a single
+        # write() of one line, and jsonl readers don't care about order.
         with self._lock:
             self.events.append(entry)
             path = self._resolve()
-            if path:
-                try:
-                    os.makedirs(os.path.dirname(path) or ".",
-                                exist_ok=True)
-                    with open(path, "a") as f:
-                        f.write(json.dumps(entry) + "\n")
-                except OSError:
-                    pass
+        if path:
+            try:
+                os.makedirs(os.path.dirname(path) or ".",
+                            exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+            except OSError:
+                pass
         return entry
 
 

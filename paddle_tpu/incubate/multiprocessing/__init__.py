@@ -110,7 +110,7 @@ def _reduce_tensor(tensor):
 
         # cleanup responsibility moves to the consumer / atexit sweep
         resource_tracker.unregister("/" + name, "shared_memory")
-    except Exception:
+    except Exception:  # ptlint: disable=PTL804 (tracker entry may already be unregistered)
         pass
     _shipped_names.add(name)
     return (_rebuild_tensor,

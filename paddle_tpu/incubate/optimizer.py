@@ -51,8 +51,11 @@ class LookAhead:
             k = f"@slow_{i}"
             if k in sd:
                 v = sd[k]
+                # jnp.array (copy): jnp.asarray of a jax input aliases
+                # the caller's buffer — donation on either side would
+                # corrupt the slow weights (PTL501)
                 self._slow[i] = v._value if isinstance(v, Tensor) \
-                    else jnp.asarray(v)
+                    else jnp.array(v)
 
 
 class ModelAverage:

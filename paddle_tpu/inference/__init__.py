@@ -155,7 +155,9 @@ class InferTensor:
             self._data = np.zeros(shape, np.float32)
 
     def copy_from_cpu(self, arr):
-        self._data = np.asarray(arr)
+        # the name is the contract: np.array COPIES, np.asarray would
+        # alias the caller's buffer (PTL501)
+        self._data = np.array(arr)
 
     def copy_to_cpu(self):
         return np.asarray(self._data)

@@ -86,7 +86,10 @@ class KVPagePayload:
 
     def __init__(self, tokens, n_prefilled, page_size, kv_dtype, kv,
                  scales, trace=None):
-        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        # np.array: the payload outlives the call (it rides the wire
+        # encoder later) — an aliased token buffer the scheduler then
+        # extends in place would ship the wrong prefix (PTL501)
+        self.tokens = np.array(tokens, np.int32).reshape(-1)
         self.n_prefilled = int(n_prefilled)
         self.page_size = int(page_size)
         self.kv_dtype = str(kv_dtype)

@@ -459,8 +459,11 @@ class BrownoutController:  # ptlint: thread-shared (monitor tick writes; submit/
         if fn is not None:
             try:
                 fn(target, caps)
-            except Exception:
-                pass
+            except Exception as e:
+                # the ladder still advanced — but a failing apply hook
+                # means the fleet did NOT degrade; leave a trace
+                _flight.record_event("brownout_apply_failed",
+                                     level=target, error=repr(e))
         return target
 
     def dwell(self, now=None):

@@ -366,7 +366,7 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
                         for r in list(eng._slots) if r is not None]
             _fr.dump(reason, replica=self.name, role=self.role,
                      inflight=inflight, queued=len(eng.waiting))
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the flight-recorder dump itself)
             pass
 
     def _drop_gauges(self):
@@ -403,7 +403,7 @@ class LocalReplica:  # ptlint: thread-shared (router monitor reads; engine threa
 
             if full_enabled():
                 self.export_telemetry()
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (best-effort telemetry export at stop)
             pass
 
 

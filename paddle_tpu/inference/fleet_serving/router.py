@@ -77,6 +77,12 @@ _MONITOR_ERRORS = _obs.counter(
     "ticks (supervision survives a bad tick, but a persistently "
     "failing one — e.g. a factory that cannot build replicas — must "
     "be visible, not a silent poll-rate retry loop)")
+_SPILL_SCALEUPS = _obs.counter(
+    "pt_router_spill_scale_ups",
+    "scale-ups triggered by sustained fleet KV spill pressure rather "
+    "than queue depth (the memory-bound growth signal: queues look "
+    "healthy while the tier sheds pages, so TTFT regresses via cold "
+    "recompute instead of visible backlog)")
 _ROUTER_TTFT = _obs.histogram(
     "pt_router_ttft_seconds",
     "client-observed TTFT at the ROUTER ingress (submit -> the serving "
@@ -90,6 +96,13 @@ class AutoscalePolicy:
     min_replicas / max_replicas  fleet size bounds
     queue_high       mean waiting-per-replica that triggers scale-UP
                      (sustained: two consecutive monitor ticks)
+    spill_high       fleet KV spill_pressure (fraction of spill
+                     attempts the host-RAM/disk tier rejected or aged
+                     out — kv_tier block in metrics()) at/above which
+                     the fleet grows even with healthy queues; memory-
+                     bound traffic sheds pages long before it queues.
+                     Shares queue_high's two-tick hysteresis; an
+                     over-pressure fleet never retires replicas
     queue_low        fleet-wide waiting total at/below which an IDLE
                      replica (no queue, no in-flight) may retire
     cooldown_s       minimum seconds between scaling actions
@@ -99,11 +112,12 @@ class AutoscalePolicy:
 
     def __init__(self, min_replicas=1, max_replicas=4, queue_high=8,
                  queue_low=0, cooldown_s=1.0, heartbeat_timeout_s=2.0,
-                 poll_s=0.02):
+                 poll_s=0.02, spill_high=0.5):
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.queue_high = float(queue_high)
         self.queue_low = float(queue_low)
+        self.spill_high = float(spill_high)
         self.cooldown_s = float(cooldown_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.poll_s = float(poll_s)
@@ -208,6 +222,7 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         self._pressure_ticks = 0
         self.stats = {"requests": 0, "affinity_hits": 0, "requeues": 0,
                       "scale_ups": 0, "scale_downs": 0,
+                      "spill_scale_ups": 0,
                       "disagg_handoffs": 0, "replicas_lost": 0,
                       "shed": 0, "cancelled": 0, "hedges": 0,
                       "brownout_level": 0, "migrations": 0,
@@ -240,7 +255,9 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
             try:
                 replica.engine.apply_brownout(self._brownout_ctl.caps())
             except Exception:
-                pass
+                # an engine without brownout support degrades later —
+                # but the miss must be visible, not silent (PTL804)
+                _MONITOR_ERRORS.inc()
         if replica._registry is not self.registry:
             # one membership view: the router's failover watches ITS
             # registry, so members must beat into it
@@ -972,10 +989,22 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         if len(alive) < pol.min_replicas:
             self._scale_up()
             return
-        if (alive and depth / len(alive) >= pol.queue_high
-                and len(alive) < pol.max_replicas):
+        queue_hot = bool(alive) and depth / len(alive) >= pol.queue_high
+        # memory-bound growth signal: the KV tier shedding pages is
+        # pressure the queue never shows (lookups still succeed — they
+        # just recompute cold prefixes, so TTFT regresses silently).
+        # Only scraped when the queue is NOT already hot: one signal
+        # firing is enough, and the scrape costs a metrics() call per
+        # replica.
+        spill_hot = False
+        if not queue_hot and alive:
+            sp = self._fleet_spill_pressure(alive)
+            spill_hot = sp is not None and sp >= pol.spill_high
+        if (queue_hot or spill_hot) and len(alive) < pol.max_replicas:
             # sustained pressure only: one hot tick must not double the
-            # fleet
+            # fleet. Queue and spill pressure SHARE the tick counter —
+            # both are "the fleet is too small", and alternating
+            # signals should not reset each other's evidence.
             with self._lock:
                 self._pressure_ticks += 1
                 fire = self._pressure_ticks >= 2
@@ -983,9 +1012,17 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                     self._pressure_ticks = 0
             if fire:
                 self._scale_up()
+                if spill_hot and not queue_hot:
+                    with self._lock:
+                        self.stats["spill_scale_ups"] += 1
+                    _SPILL_SCALEUPS.inc()
             return
         self._pressure_ticks = 0
-        if (depth <= pol.queue_low and len(alive) > pol.min_replicas):
+        # an over-pressure tier also vetoes retirement: killing a
+        # replica while the fleet sheds pages trades the idle slot for
+        # MORE cold recompute
+        if (depth <= pol.queue_low and len(alive) > pol.min_replicas
+                and not spill_hot):
             idle = [r for r in alive if r.load() == (0, 0.0)
                     and not self._has_inflight(r.name)]
             if idle:
@@ -995,6 +1032,58 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         with self._lock:
             return any(rr.replica == name
                        for rr in self._inflight.values())
+
+    @staticmethod
+    def _tier_block(tiers):
+        """Fold per-replica kv_tier snapshots into ONE fleet block
+        with hit/spill-pressure rates. Shared by `metrics()` (the
+        scrape view) and `_autoscale_tick` (the growth signal) so the
+        number an operator reads is the number the autoscaler acts
+        on. `tiers` yields kv_tier dicts (None/empty skipped)."""
+        tier_totals, tier_n = {}, 0
+        for t in tiers:
+            if not t:
+                continue
+            tier_n += 1
+            for k, v in t.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    tier_totals[k] = tier_totals.get(k, 0) + v
+        if not tier_n:
+            return None
+        g = tier_totals.get
+        lookups = g("ram_hits", 0) + g("disk_hits", 0) + g("misses", 0)
+        attempts = (g("spills", 0) + g("spill_failed", 0)
+                    + g("spill_rejected", 0))
+        dropped = (g("spill_rejected", 0) + g("ram_dropped", 0)
+                   + g("disk_dropped", 0))
+        kv_tier = dict(tier_totals)
+        kv_tier.update({
+            "replicas_with_tier": tier_n,
+            # spilled-prefix lookups served below HBM / all lookups
+            "hit_rate": ((g("ram_hits", 0) + g("disk_hits", 0))
+                         / lookups) if lookups else None,
+            # fraction of spill attempts the tier had to reject or
+            # age out — rising pressure means the fleet's cold
+            # capacity is saturating (scale out, or grow the tier)
+            "spill_pressure": (dropped / (attempts + dropped)
+                               if attempts + dropped else None),
+        })
+        return kv_tier
+
+    def _fleet_spill_pressure(self, alive):
+        """Fleet-wide KV spill_pressure from the alive replicas'
+        engine views, or None when no replica runs a tier (tierless
+        fleets autoscale on queue depth alone). Per-replica scrape
+        failures are skipped — a dying member must not stall the
+        autoscale decision for the rest."""
+        tiers = []
+        for r in alive:
+            try:
+                tiers.append(r.engine.metrics().get("kv_tier"))
+            except Exception:   # ptlint: disable=PTL804 (scrape failure of one replica; the failover scan owns its death)
+                pass
+        block = self._tier_block(tiers)
+        return block["spill_pressure"] if block else None
 
     # ---- overload tick (fleet_serving.overload) ----
 
@@ -1033,7 +1122,9 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
                          for r in alive + pre_alive)
             self._estimator.note_progress(tokens, now_m)
         except Exception:
-            pass
+            # a malformed stats dict skips ONE rate sample — count it
+            # (a persistently failing sample starves the estimator)
+            _MONITOR_ERRORS.inc()
         # brownout pressure
         if alive:
             with self._lock:
@@ -1169,35 +1260,10 @@ class FleetRouter:  # ptlint: thread-shared (client submits + monitor + replica 
         # fleet_serving/kv_tier.py) into ONE fleet block with hit and
         # spill-pressure RATES, so the autoscale monitor sees memory
         # pressure building without scraping every engine view
-        tier_totals, tier_n = {}, 0
-        for info in replicas.values():
-            t = (info.get("engine") or {}).get("kv_tier")
-            if not t:
-                continue
-            tier_n += 1
-            for k, v in t.items():
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    tier_totals[k] = tier_totals.get(k, 0) + v
-        kv_tier = None
-        if tier_n:
-            g = tier_totals.get
-            lookups = g("ram_hits", 0) + g("disk_hits", 0) + g("misses", 0)
-            attempts = (g("spills", 0) + g("spill_failed", 0)
-                        + g("spill_rejected", 0))
-            dropped = (g("spill_rejected", 0) + g("ram_dropped", 0)
-                       + g("disk_dropped", 0))
-            kv_tier = dict(tier_totals)
-            kv_tier.update({
-                "replicas_with_tier": tier_n,
-                # spilled-prefix lookups served below HBM / all lookups
-                "hit_rate": ((g("ram_hits", 0) + g("disk_hits", 0))
-                             / lookups) if lookups else None,
-                # fraction of spill attempts the tier had to reject or
-                # age out — rising pressure means the fleet's cold
-                # capacity is saturating (scale out, or grow the tier)
-                "spill_pressure": (dropped / (attempts + dropped)
-                                   if attempts + dropped else None),
-            })
+        # (`_tier_block` — the same fold `_autoscale_tick` reads)
+        kv_tier = self._tier_block(
+            (info.get("engine") or {}).get("kv_tier")
+            for info in replicas.values())
         snap.update({
             "inflight": inflight,
             "kv_tier": kv_tier,

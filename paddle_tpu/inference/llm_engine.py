@@ -1770,7 +1770,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
             _fr.dump("engine_abort", error=repr(exc), inflight=[
                 {"rid": r.rid, "trace_id": r.trace.trace_id}
                 for r in self._slots if r is not None])
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the flight-recorder dump itself)
             pass
         for slot, req in enumerate(self._slots):
             if req is not None:
@@ -1846,7 +1846,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
 
             _fr.record_event("request_cancelled", rid=req.rid,
                              trace_id=req.trace.trace_id, reason=reason)
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the trace event itself)
             pass
         if not req.future.done():
             req.future.set_exception(
@@ -1864,7 +1864,7 @@ class LLMEngine:  # ptlint: thread-shared (scraped by /metrics)
 
             _fr.record_event("request_shed", rid=req.rid,
                              trace_id=req.trace.trace_id, reason=reason)
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the trace event itself)
             pass
         if not req.future.done():
             req.future.set_exception(
@@ -2887,7 +2887,7 @@ class LLMServer(_FutureQueueServer):
                                                       "client"),
                                    counted=payload.get("_abort_counted",
                                                        False))
-            except Exception:     # never kill the serve loop
+            except Exception:  # ptlint: disable=PTL804 (abort of unknown rid is a no-op; never kill the serve loop)
                 pass
             return
         if "_export_prefix" in payload:

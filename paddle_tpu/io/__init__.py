@@ -170,7 +170,10 @@ class RandomSampler(Sampler):
 class WeightedRandomSampler(Sampler):
     def __init__(self, weights, num_samples, replacement=True,
                  generator=None):
-        self.weights = np.asarray(weights, dtype=np.float64)
+        # np.array: the sampler keeps weights across epochs — aliasing
+        # a caller list/array mutated mid-training would skew draws
+        # silently (PTL501)
+        self.weights = np.array(weights, dtype=np.float64)
         self.num_samples = num_samples
         self.replacement = replacement
         self.generator = generator

@@ -142,7 +142,7 @@ def _ship(seq, batch):
         from multiprocessing import resource_tracker
 
         resource_tracker.unregister("/" + name, "shared_memory")
-    except Exception:
+    except Exception:  # ptlint: disable=PTL804 (tracker entry may already be unregistered)
         pass
     return (seq, (spec, metas, name), None)
 
@@ -259,8 +259,8 @@ def _shutdown(state):
     for _ in state.procs:
         try:
             state.work_q.put_nowait(None)
-        except Exception:
-            pass
+        except queue_mod.Full:
+            pass   # queue full = workers have wake-up work anyway
     deadline = 5.0
     for p in state.procs:
         p.join(timeout=deadline)

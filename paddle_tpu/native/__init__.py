@@ -147,7 +147,7 @@ class NativeSparseTable:
             if getattr(self, "_h", None):
                 self._lib.pt_table_destroy(self._h)
                 self._h = None
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (__del__ must never raise)
             pass
 
     def pull(self, ids):

@@ -126,7 +126,7 @@ def export_all(directory=None, journal=True):
     if journal:
         try:
             journal_snapshot(note="export_all")
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the journal snapshot itself)
             pass
     return d
 

@@ -138,7 +138,7 @@ class FlightRecorder:  # ptlint: thread-shared (every runtime thread records; du
 
             record("flight_dump", reason=reason, path=path,
                    n_events=len(payload["events"]))
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the recorder cannot record its own failure)
             pass
         return path
 

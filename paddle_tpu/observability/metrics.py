@@ -394,12 +394,16 @@ class MetricsRegistry:
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         m = self._metrics.get(name)
         if m is None:
+            # construct OUTSIDE the lock: the metric class arrives as
+            # an argument, and caller-visible code under the registry
+            # lock is the PTL803 re-entrancy shape; a losing racer
+            # just discards its fresh instance
+            fresh = cls(name, help=help, labelnames=labelnames, **kw)
             with self._lock:
                 m = self._metrics.get(name)
                 if m is None:
-                    m = cls(name, help=help, labelnames=labelnames, **kw)
-                    self._metrics[name] = m
-                    return m
+                    self._metrics[name] = fresh
+                    return fresh
         if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
             raise ValueError(
                 f"metric {name} already registered as {m.kind}"

@@ -116,7 +116,7 @@ class TraceContext:
                          phase=phase, prev=prev[0], t=self.phases[phase],
                          dur_s=round(dt, 6),
                          replica=tracing.current_replica())
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (the guard wraps the trace event itself)
             pass
         # chrome event (full mode): ts = the segment's START stamp
         tracing.add_event(f"phase.{phase}", int(prev[1] * 1e6),

@@ -118,7 +118,7 @@ def _finish_event(ev):
     for s in list(_sinks):
         try:
             s(ev)
-        except Exception:
+        except Exception:  # ptlint: disable=PTL804 (a failing sink must not take down the data path)
             pass
 
 

@@ -44,7 +44,10 @@ class Tensor:
             value = value._value
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             value = jnp.asarray(value)
-        self._value = value
+        # ownership-by-contract: Tensor WRAPS the buffer zero-copy —
+        # jax arrays are immutable, so sharing is safe; donation
+        # hazards are the caller's to manage (documented)
+        self._value = value  # ptlint: disable=PTL501
         self.stop_gradient = stop_gradient
         self.grad = None
         self._grad_node = None
@@ -196,7 +199,8 @@ class Tensor:
                 f"set_value shape mismatch {value.shape} vs {self._value.shape}"
             )
         self._check_mutation("set_value")
-        self._value = value
+        # ownership-by-contract: immutable jax buffer, shared on purpose
+        self._value = value  # ptlint: disable=PTL501
         self._grad_node = None
         return self
 

@@ -1,0 +1,20 @@
+"""ptlint seeded violation: PTL501 aliasing-escape.
+
+A `set_state_dict` restore path storing a zero-copy view of the
+caller's state dict into a long-lived attribute container — the
+caller later feeds the same arrays to a donating executable (or
+mutates them in place) and the "restored" weights change under the
+module's feet. This is the regression class that took three PRs to
+root-cause at runtime; the fix is ownership at the boundary
+(np.array / jnp.array(copy=True)). Never executed — linted only.
+"""
+import jax.numpy as jnp
+
+
+class _StateOwner:
+    def __init__(self):
+        self.params = {}
+
+    def set_state_dict(self, sd):
+        for name in sd:
+            self.params[name] = jnp.asarray(sd[name])  # FLAG

@@ -1,0 +1,21 @@
+"""ptlint seeded violation: PTL502 host-view-into-jit.
+
+A host-side zero-copy view (np.asarray of a caller array) handed
+straight to a compiled step that donates its first argument — XLA may
+alias the donated buffer, so the caller's array is garbage after the
+dispatch, and on CPU backends the view means the executable can read
+storage the caller is still mutating. Defensive-copy at the boundary
+(np.array) is the documented launder. Never executed — linted only.
+"""
+import jax
+import numpy as np
+
+
+def _mul(w, b):
+    return w * b
+
+
+def serve(weights, batch):
+    step = jax.jit(_mul, donate_argnums=(0,))
+    wv = np.asarray(weights)
+    return step(wv, batch)  # FLAG

@@ -1,0 +1,41 @@
+"""ptlint seeded violation: PTL801 lock-order cycle.
+
+Two classes that call into each other under their own locks, in
+OPPOSITE orders: the router dispatches into the replica while holding
+the router lock (router -> replica), and the replica pulls admission
+state from the router while holding the replica lock (replica ->
+router). Two threads entering from opposite ends wedge forever with
+zero CPU — the wedged-replica flap. tests/test_analysis.py also runs
+this exact shape on two REAL threads (with acquire timeouts) to prove
+the static finding corresponds to a live deadlock.
+Never executed — linted only.
+"""
+import threading
+
+
+class _StressRouter:
+    def __init__(self, replica):
+        self._lock = threading.Lock()
+        self.replica = replica
+
+    def dispatch(self):
+        with self._lock:
+            return self.replica.report_queue()  # FLAG
+
+    def router_admit(self):
+        with self._lock:
+            return 2
+
+
+class _StressReplica:
+    def __init__(self, router):
+        self._rlock = threading.Lock()
+        self.router = router
+
+    def engine_pull(self):
+        with self._rlock:
+            return self.router.router_admit()
+
+    def report_queue(self):
+        with self._rlock:
+            return 1

@@ -1,0 +1,23 @@
+"""ptlint seeded violation: PTL802 blocking-call-under-lock.
+
+A journal that sleeps (stand-in for any blocking syscall: file I/O,
+socket send, future.result, thread.join) while holding the class
+lock — every other thread touching the journal queues behind the
+block, and under the GIL-released wait the "fast path" serializes on
+disk latency. The clean idiom is snapshot-then-release: mutate state
+under the lock, do the slow thing outside it. Never executed —
+linted only.
+"""
+import threading
+import time
+
+
+class _Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def write(self, entry):
+        with self._lock:
+            self.events.append(entry)
+            time.sleep(0.05)  # FLAG

@@ -1,0 +1,23 @@
+"""ptlint seeded violation: PTL803 callback-under-lock.
+
+A tier store invoking a CALLER-SUPPLIED callback (`spill_fn`, wired
+in at construction) while holding its own lock — the re-entrancy
+shape: the callback can call back into the store (self-deadlock on a
+non-reentrant lock) or grab its own lock (a cross-class lock-order
+edge nobody blessed). The clean idiom is to snapshot the work under
+the lock and invoke the callback after release. Never executed —
+linted only.
+"""
+import threading
+
+
+class _TierStore:
+    def __init__(self, spill_fn):
+        self._lock = threading.Lock()
+        self.spill_fn = spill_fn
+        self.pages = {}
+
+    def evict(self, key, page):
+        with self._lock:
+            self.pages.pop(key, None)
+            self.spill_fn(key, page)  # FLAG

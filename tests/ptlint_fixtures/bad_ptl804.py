@@ -1,0 +1,19 @@
+"""ptlint seeded violation: PTL804 silent-exception-swallow.
+
+`except Exception: pass` with no logging, no counter, no narrowing —
+the shape that hid a week of router monitor failures (the factory
+threw on every tick; the fleet just never scaled, silently). A broad
+handler is legal when it DOES something (journals, increments a
+counter, re-raises a narrowed class); swallowing everything including
+bugs is not. Never executed — linted only.
+"""
+
+
+def load_optional(path):
+    data = None
+    try:
+        with open(path) as f:
+            data = f.read()
+    except Exception:  # FLAG
+        pass
+    return data

@@ -158,3 +158,106 @@ class LockedCounter:
     def hit(self):
         with self._lock:
             self.hits += 1
+
+
+class OwnedStateOwner:
+    # PTL501: ownership at the restore boundary — np.array /
+    # jnp.array COPY, so the caller's state dict stays the caller's
+    def __init__(self):
+        self.params = {}
+        self.rows = []
+
+    def set_state_dict(self, sd):
+        for name in sd:
+            self.params[name] = jnp.array(sd[name])
+
+    def add_rows(self, rows):
+        self.rows.append(np.array(rows, np.float32))
+
+
+def serve_copied(weights, batch):
+    # PTL502: defensive copy before the donating dispatch — the
+    # executable consumes ITS OWN buffer, never the caller's view
+    step = jax.jit(lambda w, b: w * b, donate_argnums=(0,))
+    wv = np.array(weights)
+    return step(wv, batch)
+
+
+class OrderedRouter:
+    # PTL801: cross-class lock nesting in ONE direction only
+    # (router -> replica) — an edge, not a cycle
+    def __init__(self, replica):
+        self._lock = threading.Lock()
+        self.replica = replica
+
+    def dispatch_ordered(self):
+        with self._lock:
+            return self.replica.pull_ordered()
+
+    def admission_state(self):
+        with self._lock:
+            return 2
+
+
+class OrderedReplica:
+    def __init__(self, router):
+        self._rlock = threading.Lock()
+        self.router = router
+
+    def pull_ordered(self):
+        with self._rlock:
+            return 1
+
+    def refresh_admission(self):
+        # the reverse call happens with NO lock held: snapshot the
+        # router's answer first, then take our lock
+        admitted = self.router.admission_state()
+        with self._rlock:
+            return admitted
+
+
+class SnapshotJournal:
+    # PTL802: snapshot-then-release — mutate under the lock, do the
+    # slow I/O outside it. str.join under the lock is NOT a thread
+    # join and stays silent (the false-positive fence).
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self.events = []
+        self.path = path
+
+    def write(self, parts):
+        with self._lock:
+            line = ", ".join(parts)
+            self.events.append(line)
+            path = self.path
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+class SnapshotTierStore:
+    # PTL803: snapshot the caller-supplied callback's work under the
+    # lock, invoke it AFTER release — no re-entrancy under the lock
+    def __init__(self, spill_fn):
+        self._lock = threading.Lock()
+        self.spill_fn = spill_fn
+        self.pages = {}
+
+    def evict(self, key):
+        with self._lock:
+            page = self.pages.pop(key, None)
+        if page is not None:
+            self.spill_fn(key, page)
+
+
+def load_optional_journaled(path, journal):
+    # PTL804: narrow handlers pass freely; a broad handler is legal
+    # when it DOES something (here: journals the swallow)
+    data = None
+    try:
+        with open(path) as f:
+            data = f.read()
+    except FileNotFoundError:
+        pass
+    except Exception as e:
+        journal.write(["load_optional failed", repr(e)])
+    return data

@@ -725,3 +725,151 @@ def test_router_metrics_aggregates_kv_tier_rates():
     # tierless fleet: the block is None, never a zero-division
     router2 = FleetRouter(replicas=[_FakeReplica("c", None)])
     assert router2.metrics()["kv_tier"] is None
+
+
+def test_spill_pressure_scale_up_with_hysteresis():
+    """ISSUE-20 satellite: sustained fleet KV spill_pressure >=
+    policy.spill_high grows the fleet even with EMPTY queues — the
+    memory-bound signal (the tier shedding pages regresses TTFT via
+    cold recompute long before a queue forms). Shares queue_high's
+    two-tick hysteresis: one hot tick must not scale; and an
+    over-pressure fleet never retires a replica (no flap)."""
+
+    class _TierEngine:
+        mean_occupancy = 0.0
+
+        def __init__(self, kv_tier):
+            self._kv_tier = kv_tier
+
+        def metrics(self):
+            out = {"recent_requests": []}
+            if self._kv_tier is not None:
+                out["kv_tier"] = dict(self._kv_tier)
+            return out
+
+    class _TierReplica:
+        role = "serve"
+        alive = True
+        running = True
+        _registry = None
+
+        def __init__(self, name, kv_tier):
+            self.name = name
+            self.rid = f"rid-{name}"
+            self.engine = _TierEngine(kv_tier)
+
+        def queue_depth(self):
+            return 0
+
+        def load(self):
+            return (0, 0.0)
+
+        def stop(self):
+            self.alive = False
+
+    # dropped 8 / (attempts 6 + dropped 8) = 0.571 >= spill_high 0.5
+    tier_hot = {"spills": 2, "spill_failed": 0, "spill_rejected": 4,
+                "ram_hits": 1, "disk_hits": 1, "misses": 0,
+                "ram_dropped": 4, "disk_dropped": 0}
+    tier_cold = {"spills": 0, "spill_failed": 0, "spill_rejected": 0,
+                 "ram_hits": 0, "disk_hits": 0, "misses": 0,
+                 "ram_dropped": 0, "disk_dropped": 0}
+    built = []
+
+    def factory(name):
+        rep = _TierReplica(name, dict(tier_cold))
+        built.append(rep)
+        return rep
+
+    router = FleetRouter(
+        replicas=[_TierReplica("hot0", tier_hot)],
+        factory=factory,
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               queue_high=1000, cooldown_s=0.0,
+                               spill_high=0.5))
+    try:
+        # tick 1: spill-hot but NOT sustained yet — no growth
+        router._autoscale_tick()
+        assert not built and router.stats["spill_scale_ups"] == 0
+        # tick 2: sustained — grow, attributed to spill (queues empty)
+        router._autoscale_tick()
+        assert len(built) == 1
+        assert router.stats["spill_scale_ups"] == 1
+        assert router.stats["scale_ups"] == 1
+        # ticks 3-4: at max_replicas, queues empty, pressure still
+        # high — the spill veto keeps the idle replica alive (no flap)
+        router._autoscale_tick()
+        router._autoscale_tick()
+        assert len(built) == 1
+        assert router.stats["scale_downs"] == 0
+        assert len(router._alive_replicas()) == 2
+    finally:
+        for rep in router._alive_replicas():
+            rep.stop()
+
+
+def test_tier_block_folds_tier_snapshots():
+    """`_tier_block` is the single fold shared by metrics() and the
+    autoscaler: numeric fields sum across replicas, the derived rates
+    come from the summed totals, and a fleet with no tiers is None
+    (not a zeroed block a dashboard would mistake for `healthy`)."""
+    a = {"spills": 2, "spill_rejected": 1, "ram_hits": 3, "misses": 1,
+         "ram_dropped": 0, "disk_dropped": 0}
+    b = {"spills": 1, "spill_rejected": 0, "ram_hits": 1, "misses": 3,
+         "ram_dropped": 1, "disk_dropped": 0}
+    block = FleetRouter._tier_block([a, None, {}, b])
+    assert block["replicas_with_tier"] == 2
+    assert block["spills"] == 3 and block["misses"] == 4
+    # hit_rate = (ram_hits 4 + disk_hits 0) / lookups 8
+    assert abs(block["hit_rate"] - 0.5) < 1e-9
+    # dropped 2 / (attempts 4 + dropped 2)
+    assert abs(block["spill_pressure"] - 2 / 6) < 1e-9
+    assert FleetRouter._tier_block([]) is None
+    assert FleetRouter._tier_block([None, {}]) is None
+
+
+def test_fleet_spill_pressure_none_without_tiers():
+    """A fleet whose engines expose no kv_tier block (spill disabled)
+    must read as `no signal` — the autoscaler then never treats it as
+    spill-hot, and scale-down stays allowed."""
+
+    class _BareEngine:
+        mean_occupancy = 0.0
+
+        def metrics(self):
+            return {"recent_requests": []}
+
+    class _BareReplica:
+        role = "serve"
+        alive = True
+        running = True
+        _registry = None
+
+        def __init__(self):
+            self.name = "bare0"
+            self.rid = "rid-bare0"
+            self.engine = _BareEngine()
+
+        def queue_depth(self):
+            return 0
+
+        def load(self):
+            return (0, 0.0)
+
+        def stop(self):
+            self.alive = False
+
+    router = FleetRouter(replicas=[_BareReplica()],
+                         policy=AutoscalePolicy(min_replicas=1,
+                                                max_replicas=2,
+                                                cooldown_s=0.0))
+    try:
+        assert router._fleet_spill_pressure(
+            router._alive_replicas()) is None
+        router._autoscale_tick()
+        router._autoscale_tick()
+        assert router.stats["scale_ups"] == 0
+        assert router.stats["spill_scale_ups"] == 0
+    finally:
+        for rep in router._alive_replicas():
+            rep.stop()

@@ -6,7 +6,7 @@ engine (incl. EOS mid-window, preemption at a boundary, prefix-cache
 on, int8 KV), seeded temperature/top-p reproducibility across k, the
 PRNG-key-in-donated-pytree recompile probe (reseed() must never
 recompile), and the CI assertion that the fused executable has ZERO
-host callbacks (PTL503) with full donation — the host loop is dead
+host callbacks (PTL513) with full donation — the host loop is dead
 inside the window by construction, not by luck.
 
 Budget note: every (k, geometry) pair compiles a fresh fused scan, so
@@ -239,7 +239,7 @@ def test_request_sampling_validation(tiny_model):
 def test_fused_zero_host_callbacks_donation_and_recompile_probe(
         tiny_model, prompts):
     """The ISSUE-8 CI assertion, one engine end-to-end: (1) the fused
-    k-step executable has ZERO host callbacks (PTL503) and every leaf
+    k-step executable has ZERO host callbacks (PTL513) and every leaf
     of the kv pytree — pools AND the PRNG key — donated; (2) reseed()
     swaps the key without a recompile (the key is an ARGUMENT); (3)
     steady-state serving holds ONE executable per (k, geometry)."""

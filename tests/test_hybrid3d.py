@@ -137,6 +137,7 @@ def test_label_shift_survives_partial_shard_spec():
 
 # ------------------------------------------- one executable per config
 
+@pytest.mark.slow
 def test_one_donated_executable_per_config_and_parity():
     """The acceptance invariant: per mesh config the 3D step is ONE
     donated executable (zero recompiles across steps, every donated
@@ -184,7 +185,7 @@ def test_analyze_step_hybrid3d():
     assert report.donation["held"]
     assert report.donation["aliased"] == report.donation["expected"] > 0
     assert not report.host_calls
-    assert not [f for f in report.findings if f.rule == "PTL502"]
+    assert not [f for f in report.findings if f.rule == "PTL512"]
 
 
 def test_hybrid_save_restore_one_executable_and_parity(tmp_path):

@@ -318,6 +318,7 @@ def _moe_losses(mesh_kw, ids_np, steps=3, cf=1.25, with_aux=False):
     return (losses, auxs) if with_aux else losses
 
 
+@pytest.mark.slow
 def test_moe_in_pipeline_trajectory_matches_serial():
     # lossless capacity (cf = E ⇒ C ≥ tokens/group): the a2a grouped
     # dispatch keeps exactly the serial full-batch token set, and gate

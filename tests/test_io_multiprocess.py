@@ -78,6 +78,7 @@ class TestMPDataLoader:
             seen.extend(y.tolist())
         assert seen == list(range(n))  # deterministic order across workers
 
+    @pytest.mark.slow
     def test_multiple_epochs(self):
         dl = io.DataLoader(_ArrDataset(20), batch_size=5, num_workers=2)
         for _ in range(3):

@@ -26,6 +26,7 @@ def _train_some(steps, prof=None):
             prof.step(num_samples=4)
 
 
+@pytest.mark.slow
 def test_profiler_trace_and_timer(tmp_path):
     prof = profiler.Profiler(
         scheduler=(1, 3),

@@ -446,6 +446,7 @@ class TestSSDSparseTable:
             SSDSparseTable(8, p)
 
 
+@pytest.mark.slow
 def test_fleet_ps_lifecycle(tmp_path):
     """fleet PS-mode API: init_server/run_server/init_worker/stop_worker
     + table save/restore (reference fleet.py PS lifecycle; here trainers

@@ -272,6 +272,7 @@ def _hybrid_pair(quant, schedule="1f1b", steps=6):
 
 
 @pytest.mark.hybrid3d
+@pytest.mark.slow
 def test_hybrid_quant_training_parity_and_probes():
     """quant_allreduce=True on the compiled pipeline step: the loss
     trajectory tracks the exact run within 5% at every step, the step

@@ -398,6 +398,7 @@ def test_quantize_model_int4_swaps_and_skips_odd():
     assert isinstance(m.a, qrt.Int4WeightOnlyLinear)
 
 
+@pytest.mark.slow
 def test_int4_gpt_logits_parity_bounded():
     """`Int4WeightOnlyLinear` on the tier-1 GPT: logits track fp32
     within the int4 budget and the argmax survives on most positions

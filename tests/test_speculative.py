@@ -9,7 +9,7 @@ rejected), sampled-path invariance to spec_k via the shared
 (seed, stream, position) PRNG keying, draft-KV rollback correctness
 after rejection — plus the CI probe: `{"executables": 1,
 "verify_executables": 1}` zero-recompile after warmup, zero host
-callbacks (PTL503) in the verify executable, and full donation of the
+callbacks (PTL513) in the verify executable, and full donation of the
 big kv pytree (`pt_step_donation_held{step="spec_verify"}`). The
 PR-8-leftover ragged-window fallback (a straggler prefill row no
 longer forces the whole engine onto single ticks) is pinned here for
@@ -387,7 +387,7 @@ def test_ragged_window_straggler(pair, prompts, mode):
 def test_spec_zero_host_callbacks_donation_and_recompile_probe(
         pair, prompts):
     """The ISSUE-10 CI assertion, one engine end-to-end: (1) the
-    verify executable has ZERO host callbacks (PTL503) and every leaf
+    verify executable has ZERO host callbacks (PTL513) and every leaf
     of the big kv pytree — pools AND the PRNG key — donated
     (pt_step_donation_held{step="spec_verify"}); (2) reseed() swaps
     the key without recompiling ANY of the four executables; (3)

@@ -175,6 +175,7 @@ def test_preemption_restore_keeps_identity_and_ckpt_phase(mode,
         assert EMITTING <= {e["phase"] for e in rec["timeline"]}
 
 
+@pytest.mark.slow
 def test_quiet_warmup_distributed_and_hybrid_families(mode):
     """All three step classes run their compile step quiet: two calls
     on one batch → exactly ONE ring record, correctly family-labeled,

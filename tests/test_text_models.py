@@ -36,6 +36,7 @@ class TestGPT:
         assert model.gpt.layers[0].qkv.weight.grad is not None
         assert model.gpt.layers[-1].fc2.weight.grad is not None
 
+    @pytest.mark.slow
     def test_trainstep_matches_eager_step(self):
         mesh_mod.reset_mesh()
         paddle.seed(1)
@@ -152,6 +153,7 @@ class TestBert:
         np.testing.assert_allclose(out_masked.numpy()[:, :16],
                                    out_short.numpy(), rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_tp_matches_serial(self):
         from paddle_tpu.text.models import BertForPretraining, bert_tiny
 
@@ -333,6 +335,7 @@ class TestGeneration:
         assert "_decode_step_static" not in type(model).__dict__
 
 
+@pytest.mark.slow
 def test_bert_fused_mlm_loss_matches_criterion():
     import numpy as np
 

@@ -17,6 +17,16 @@ nonzero on findings, so CI can gate on it:
                                                 # jax — NOT the fast path)
     python tools/ptlint.py --spmd --json        # machine-readable
                                                 # schedule dump
+    python tools/ptlint.py --locks              # lock-acquisition graph:
+                                                # cross-class edges +
+                                                # PTL801 cycle findings
+                                                # (stdlib-only, fast)
+    python tools/ptlint.py --locks --json       # the exact shape pinned
+                                                # in tests/golden/
+                                                # fleet_lock_order.json
+    python tools/ptlint.py --changed            # fast mode: lint only
+                                                # files changed vs HEAD
+    python tools/ptlint.py --changed main       # ...vs another ref
 
 Suppressions: `# ptlint: disable=PTL101` (ids or slugs, comma-
 separated, `all`) on the offending line or the enclosing `def` line;
@@ -87,6 +97,78 @@ def _spmd_main(args):
     return 1 if rep["num_findings"] else 0
 
 
+def _locks_main(args, lint):
+    """The lock-discipline gate: build the tree-wide lock-acquisition
+    graph and report cross-class edges + PTL801 cycles. Same stdlib-
+    only loading as the AST gate — no jax import. `--json` emits the
+    exact dict `tests/golden/fleet_lock_order.json` pins."""
+    paths = args.paths or [os.path.join(_REPO, p)
+                           for p in DEFAULT_PATHS]
+    rep = lint.lock_graph_report(paths)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(f"lock-graph {rep['version']}: {rep['classes']} "
+              f"lock-owning class(es), {rep['locks']} lock(s), "
+              f"{len(rep['edges'])} cross-class edge(s)")
+        for e in rep["edges"]:
+            sites = rep["edge_sites"].get(e, [])
+            at = (f" [{sites[0]['path']}:{sites[0]['line']}"
+                  f" {sites[0]['func']}"
+                  + (f" +{len(sites) - 1} more" if len(sites) > 1
+                     else "") + "]") if sites else ""
+            print(f"  {e}{at}")
+        for f in rep["findings"]:
+            print(f"  PTL801 {f['path']}:{f['line']} {f['func']}: "
+                  f"{f['message']}")
+        print(f"lock-graph: {len(rep['findings'])} finding(s)")
+    return 1 if rep["findings"] else 0
+
+
+def _in_gated_tree(rel):
+    """Keep --changed scoped to the tree the full gate lints: a diff
+    touching tests/ (seeded bad_ptl* fixtures!) or scratch scripts
+    must not fail the pre-commit fast path when the CI gate would
+    stay green."""
+    for root in DEFAULT_PATHS:
+        if rel == root or rel.startswith(root + "/"):
+            return True
+    return False
+
+
+def _changed_paths(ref):
+    """Python files changed vs REF (`git diff --name-only`), plus
+    untracked ones — the pre-commit fast path. Scoped to
+    DEFAULT_PATHS (the gated tree). Returns None when git is
+    unavailable (caller falls back to the full tree)."""
+    import subprocess
+
+    out = []
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            r = subprocess.run(cmd, cwd=_REPO, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        out.extend(r.stdout.splitlines())
+    seen, changed = set(), []
+    for rel in out:
+        rel = rel.strip()
+        if not rel.endswith(".py") or rel in seen:
+            continue
+        if not _in_gated_tree(rel):
+            continue
+        seen.add(rel)
+        path = os.path.join(_REPO, rel)
+        if os.path.exists(path):
+            changed.append(path)
+    return changed
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="ptlint", description=__doc__,
@@ -110,6 +192,18 @@ def main(argv=None):
                          "schedule + placement) on the tier-1 "
                          "dp2.tp2.pp2 reference step — imports jax, "
                          "so it is NOT part of the ~4 s AST gate")
+    ap.add_argument("--locks", action="store_true",
+                    help="build the tree-wide lock-acquisition graph "
+                         "and report cross-class edges + PTL801 "
+                         "lock-order cycles (stdlib-only; --json "
+                         "emits the golden-pinned shape)")
+    ap.add_argument("--changed", nargs="?", const="HEAD",
+                    metavar="REF",
+                    help="fast mode: lint only .py files changed vs "
+                         "REF (default HEAD, via `git diff "
+                         "--name-only`) plus untracked ones — the "
+                         "pre-commit path; positional paths are "
+                         "ignored")
     args = ap.parse_args(argv)
 
     if args.spmd:
@@ -120,6 +214,22 @@ def main(argv=None):
     except Exception as e:   # pragma: no cover - broken checkout
         print(f"ptlint: cannot load linter: {e!r}", file=sys.stderr)
         return 2
+
+    if args.locks:
+        return _locks_main(args, lint)
+
+    if args.changed is not None:
+        changed = _changed_paths(args.changed)
+        if changed is None:
+            print("ptlint: --changed needs git; falling back to the "
+                  "full tree", file=sys.stderr)
+        elif not changed:
+            print(f"ptlint {lint.PTLINT_VERSION}: 0 finding(s) in "
+                  "0 file(s) (no gated .py changes vs "
+                  f"{args.changed})")
+            return 0
+        else:
+            args.paths = changed
 
     if args.version:
         print(lint.PTLINT_VERSION)
